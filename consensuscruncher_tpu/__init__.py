@@ -1,0 +1,31 @@
+"""consensuscruncher_tpu — a TPU-native UMI duplex-sequencing error-suppression framework.
+
+A from-scratch rebuild of the capabilities of oicr-gsi/ConsensusCruncher
+(Wang et al., Nucleic Acids Research 2019;47(15):e87), designed TPU-first.
+
+Architecture (modules land in the build order of SURVEY.md §7; any module
+named below that is not yet importable is planned, not shipped):
+
+- The per-family per-position majority vote (``consensus_helper.consensus_maker``
+  in the reference) is a jitted, vmapped one-hot/argmax kernel over padded
+  ``(family, position, 5-base)`` tensors (``ops.consensus_tpu``).
+- Duplex agreement (``DCS_maker.duplex_consensus``) is an elementwise equality
+  vote kernel (``ops.duplex_tpu``).
+- Singleton rescue (``singleton_correction.py``) is a host-side hash join on
+  mirrored duplex tags, with an optional vectorized Hamming barcode matcher.
+- BAM/BGZF/SAM/FASTQ I/O is first-party (``io/``): the environment has no
+  pysam/htslib, so this package ships its own codec with a native C++ hot path.
+- Multi-chip scaling uses ``jax.sharding.Mesh`` + ``shard_map`` with XLA
+  collectives (``parallel/``) — families sharded over the data axis, family
+  members reducible over a member axis via ``psum``.
+
+Reference provenance: the read-only mount at /root/reference was EMPTY at build
+time (see SURVEY.md header). All reference citations in this package are of the
+form ``<path>:<function>`` against the public upstream repo and are flagged
+unverified where SURVEY.md flags them; every such semantic is pinned by an
+explicit, documented definition in this package (see core/consensus_cpu.py).
+"""
+
+__version__ = "0.1.0"
+
+from consensuscruncher_tpu.utils.phred import SANGER_OFFSET  # noqa: F401

@@ -1,0 +1,157 @@
+"""CPU oracle for consensus calling — the semantic ground truth.
+
+Reference parity target: ``ConsensusCruncher/consensus_helper.py:consensus_maker``
+(THE hot loop, SURVEY.md §3.3).  The /root/reference mount was empty at build
+time, so the quality-aggregation formula and Phred-filter behaviour flagged
+"(unverified)" in SURVEY.md are PINNED here as the framework's defined
+semantics.  Every backend (numpy fast path, jitted TPU kernel, Pallas kernel,
+sharded multi-chip path) must reproduce this function bit-for-bit; the test
+suite enforces that.
+
+Pinned semantics, per position ``i`` over a family of ``F`` reads:
+
+1. **Effective base**: read ``j``'s base ``b[j,i]``, demoted to ``N`` when
+   ``qual[j,i] < qual_threshold`` (low-quality bases vote for N, keeping the
+   denominator at ``F`` — they count *against* every real base).
+2. **Modal base**: the effective base with the highest count; ties broken by
+   first occurrence in read-list order (CPython ``collections.Counter``
+   insertion-order semantics — reproduced exactly on TPU via a first-seen
+   index, see ops/consensus_tpu.py).
+3. **Cutoff**: the vote passes iff ``count * den >= num * F`` where
+   ``cutoff = num/den`` as an exact rational (``cutoff_fraction``).  Exact
+   integer comparison makes CPU float64 and TPU float32 agree at boundaries
+   like ``0.7 * 10 == 7``.
+4. **Output**: if passed and modal base is not N → consensus base = modal
+   base, consensus qual = ``min(sum of quals of reads whose effective base is
+   the modal base, qual_cap)``.  Otherwise base = N, qual = 0.
+
+Defaults: ``cutoff=0.7`` (reference SSCS_maker ``--cutoff`` default),
+``qual_threshold=0`` (no Phred masking unless requested via the
+``--qualscore`` surface), ``qual_cap=60`` (duplex-sequencing convention for
+summed-evidence caps; unverified upstream, pinned here).
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from fractions import Fraction
+
+import numpy as np
+
+from consensuscruncher_tpu.utils.phred import N, NUM_BASES
+
+DEFAULT_CUTOFF = 0.7
+DEFAULT_QUAL_CAP = 60
+DEFAULT_QUAL_THRESHOLD = 0
+
+
+def cutoff_fraction(cutoff: float) -> tuple[int, int]:
+    """Exact rational ``(num, den)`` for a float cutoff.
+
+    ``limit_denominator(10**6)`` recovers the human-entered decimal (0.7 →
+    7/10) rather than the float's binary expansion, so the integer comparison
+    ``count * den >= num * F`` matches the intent of ``count/F >= cutoff``.
+    """
+    frac = Fraction(cutoff).limit_denominator(10**6)
+    return frac.numerator, frac.denominator
+
+
+def _validate_family(seqs: np.ndarray, quals: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Shared input contract for every consensus backend.
+
+    Inputs must be un-padded ``(F, L)`` code arrays with codes in A..N (0..4);
+    PAD (5) is a *tensor-layout* artifact that batching layers must mask out
+    before consensus (the TPU kernel does this internally via member masks).
+    Enforcing the contract here keeps the oracle and the vectorized backends
+    bit-identical on every input they can both legally see.
+    """
+    seqs = np.asarray(seqs, dtype=np.uint8)
+    quals = np.asarray(quals, dtype=np.uint8)
+    if seqs.ndim != 2 or seqs.shape != quals.shape:
+        raise ValueError(f"seqs/quals must be matching (F, L) arrays, got {seqs.shape}/{quals.shape}")
+    if seqs.shape[0] == 0:
+        raise ValueError("empty family")
+    if seqs.size and seqs.max() > N:
+        raise ValueError("base codes above N (4) — strip PAD before consensus")
+    return seqs, quals
+
+
+def consensus_maker(
+    seqs: np.ndarray,
+    quals: np.ndarray,
+    cutoff: float = DEFAULT_CUTOFF,
+    qual_threshold: int = DEFAULT_QUAL_THRESHOLD,
+    qual_cap: int = DEFAULT_QUAL_CAP,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Collapse one UMI family to a consensus sequence + qualities.
+
+    Args:
+      seqs:  ``(F, L)`` uint8 base codes (A=0..N=4), one row per family member.
+      quals: ``(F, L)`` uint8 Phred scores.
+      cutoff / qual_threshold / qual_cap: see module docstring.
+
+    Returns:
+      ``(consensus_codes, consensus_quals)`` — two ``(L,)`` uint8 arrays.
+
+    This is the readable, obviously-correct oracle (Counter-based, Python
+    loops).  Use ``ops.consensus_numpy``/``ops.consensus_tpu`` for speed.
+    """
+    seqs, quals = _validate_family(seqs, quals)
+    fam, length = seqs.shape
+    num, den = cutoff_fraction(cutoff)
+
+    out_base = np.full(length, N, dtype=np.uint8)
+    out_qual = np.zeros(length, dtype=np.uint8)
+
+    for i in range(length):
+        counter: Counter = Counter()
+        for j in range(fam):
+            b = seqs[j, i]
+            eff = N if quals[j, i] < qual_threshold else int(b)
+            counter[eff] += 1
+        modal, count = counter.most_common(1)[0]
+        if modal != N and count * den >= num * fam:
+            qsum = 0
+            for j in range(fam):
+                if seqs[j, i] == modal and quals[j, i] >= qual_threshold:
+                    qsum += int(quals[j, i])
+            out_base[i] = modal
+            out_qual[i] = min(qsum, qual_cap)
+    return out_base, out_qual
+
+
+def consensus_maker_numpy(
+    seqs: np.ndarray,
+    quals: np.ndarray,
+    cutoff: float = DEFAULT_CUTOFF,
+    qual_threshold: int = DEFAULT_QUAL_THRESHOLD,
+    qual_cap: int = DEFAULT_QUAL_CAP,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Vectorized CPU backend, bit-identical to ``consensus_maker``.
+
+    Same algorithm as the TPU kernel (one-hot counts, first-seen tie-break,
+    rational cutoff) expressed in numpy — this is the ``--backend cpu`` fast
+    path and doubles as an executable spec for ops/consensus_tpu.py.
+    """
+    seqs, quals = _validate_family(seqs, quals)
+    fam, length = seqs.shape
+    num, den = cutoff_fraction(cutoff)
+
+    eff = np.where(quals < qual_threshold, np.uint8(N), seqs)  # (F, L)
+    onehot = eff[:, :, None] == np.arange(NUM_BASES, dtype=np.uint8)  # (F, L, 5)
+    counts = onehot.sum(axis=0, dtype=np.int64)  # (L, 5)
+    member_idx = np.arange(fam, dtype=np.int64)[:, None, None]
+    first_seen = np.where(onehot, member_idx, fam).min(axis=0)  # (L, 5)
+    # Lexicographic (count desc, first_seen asc) via a single integer score.
+    score = counts * (fam + 1) + (fam - first_seen)
+    modal = score.argmax(axis=1)  # (L,) — ties impossible: distinct first_seen
+    modal_count = np.take_along_axis(counts, modal[:, None], axis=1)[:, 0]
+    passed = (modal != N) & (modal_count * den >= num * fam)
+    # Quality sum over reads whose ORIGINAL base equals the modal base and
+    # passes the threshold (matches the oracle's agreeing-read definition;
+    # for modal != N these are exactly the reads whose effective base agrees).
+    agree = (seqs == modal[None, :].astype(np.uint8)) & (quals >= qual_threshold)
+    qsum = np.where(agree, quals.astype(np.int64), 0).sum(axis=0)
+    out_base = np.where(passed, modal, N).astype(np.uint8)
+    out_qual = np.where(passed, np.minimum(qsum, qual_cap), 0).astype(np.uint8)
+    return out_base, out_qual

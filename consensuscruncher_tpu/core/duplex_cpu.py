@@ -1,0 +1,54 @@
+"""CPU oracle for duplex consensus and singleton correction math.
+
+Reference parity target: ``ConsensusCruncher/DCS_maker.py:duplex_consensus``
+and the per-base correction step of ``singleton_correction.py`` (both flagged
+"(unverified)" in SURVEY.md §2 — the mount was empty; formulas PINNED here).
+
+Pinned semantics, per position ``i`` over two strand sequences:
+
+- base kept iff both strands agree AND the agreed base is not N:
+  ``out[i] = s1[i] if s1[i] == s2[i] != N else N``.
+- quality of a kept base is the summed evidence of the two strands, capped:
+  ``q[i] = min(q1[i] + q2[i], qual_cap)``; disagreeing/N positions get 0.
+
+Singleton correction uses the *same* formula (a singleton corrected against a
+complementary-strand partner is exactly a 2-deep duplex vote), so
+``duplex_consensus`` is the single source of truth for both stages.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from consensuscruncher_tpu.core.consensus_cpu import DEFAULT_QUAL_CAP
+from consensuscruncher_tpu.utils.phred import N
+
+
+def duplex_consensus(
+    seq1: np.ndarray,
+    qual1: np.ndarray,
+    seq2: np.ndarray,
+    qual2: np.ndarray,
+    qual_cap: int = DEFAULT_QUAL_CAP,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Elementwise two-strand agreement vote.
+
+    Args: four ``(L,)`` uint8 arrays (base codes / Phred scores).
+    Returns: ``(codes, quals)`` — two ``(L,)`` uint8 arrays.
+    """
+    seq1 = np.asarray(seq1, dtype=np.uint8)
+    seq2 = np.asarray(seq2, dtype=np.uint8)
+    qual1 = np.asarray(qual1, dtype=np.uint8)
+    qual2 = np.asarray(qual2, dtype=np.uint8)
+    if not (seq1.shape == seq2.shape == qual1.shape == qual2.shape):
+        raise ValueError("duplex inputs must share one (L,) shape")
+    if (seq1.size and seq1.max() > N) or (seq2.size and seq2.max() > N):
+        raise ValueError("base codes above N (4) — strip PAD before duplex consensus")
+    agree = (seq1 == seq2) & (seq1 < N)
+    out_base = np.where(agree, seq1, np.uint8(N))
+    qsum = qual1.astype(np.int64) + qual2.astype(np.int64)
+    out_qual = np.where(agree, np.minimum(qsum, qual_cap), 0).astype(np.uint8)
+    return out_base, out_qual
+
+
+correct_singleton = duplex_consensus

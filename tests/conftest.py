@@ -1,0 +1,16 @@
+"""Test harness config: force JAX onto CPU with 8 virtual devices.
+
+Must run before any ``import jax`` (pytest imports conftest first), so the
+multi-chip sharding tests (SURVEY.md §4 item 4) exercise real ``Mesh`` /
+``shard_map`` / collective paths without TPU hardware.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

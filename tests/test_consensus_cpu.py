@@ -1,0 +1,145 @@
+import numpy as np
+import pytest
+
+from consensuscruncher_tpu.core import consensus_cpu as cc
+from consensuscruncher_tpu.utils.phred import encode_seq, N
+
+
+def fam(*seqs, quals=None, default_q=30):
+    s = np.stack([encode_seq(x) for x in seqs])
+    if quals is None:
+        q = np.full_like(s, default_q)
+    else:
+        q = np.asarray(quals, dtype=np.uint8)
+    return s, q
+
+
+def test_unanimous_family():
+    s, q = fam("ACGT", "ACGT", "ACGT")
+    base, qual = cc.consensus_maker(s, q)
+    assert base.tolist() == encode_seq("ACGT").tolist()
+    assert qual.tolist() == [min(90, 60)] * 4  # 3*30 capped at 60
+
+
+def test_majority_below_cutoff_gives_N():
+    # 2/3 = 0.666 < 0.7 at position 0; 3/3 at others
+    s, q = fam("TCGT", "ACGT", "ACGT")
+    base, _ = cc.consensus_maker(s, q, cutoff=0.7)
+    assert base[0] == N
+    assert base[1:].tolist() == encode_seq("CGT").tolist()
+
+
+def test_cutoff_boundary_is_inclusive_exact():
+    # 7/10 == 0.7 exactly — must pass (rational compare, no float wobble)
+    seqs = ["A"] * 7 + ["C"] * 3
+    s, q = fam(*seqs)
+    base, _ = cc.consensus_maker(s, q, cutoff=0.7)
+    assert base[0] == encode_seq("A")[0]
+    base, _ = cc.consensus_maker(s, q, cutoff=0.71)
+    assert base[0] == N
+
+
+def test_tie_break_is_first_seen_order():
+    s, q = fam("AC", "CA")
+    base, _ = cc.consensus_maker(s, q, cutoff=0.5)
+    # pos0: A seen first, pos1: C seen first
+    assert base.tolist() == encode_seq("AC").tolist()
+    s, q = fam("CA", "AC")
+    base, _ = cc.consensus_maker(s, q, cutoff=0.5)
+    assert base.tolist() == encode_seq("CA").tolist()
+
+
+def test_modal_N_never_emitted_as_call():
+    s, q = fam("NN", "NN", "AN")
+    base, qual = cc.consensus_maker(s, q, cutoff=0.5)
+    assert base.tolist() == [N, N]
+    assert qual.tolist() == [0, 0]
+
+
+def test_qual_threshold_demotes_to_N():
+    s, q = fam("AA", "AA", "AA", quals=[[30, 30], [2, 30], [2, 30]])
+    # pos0: only 1/3 effective A (others demoted) -> below 0.7 -> N
+    base, qual = cc.consensus_maker(s, q, cutoff=0.7, qual_threshold=10)
+    assert base[0] == N and base[1] != N
+    assert qual[1] == 60  # 90 capped
+
+
+def test_qual_sum_cap():
+    s, q = fam("A", "A", quals=[[20], [20]])
+    _, qual = cc.consensus_maker(s, q, qual_cap=60)
+    assert qual[0] == 40
+    _, qual = cc.consensus_maker(s, q, qual_cap=35)
+    assert qual[0] == 35
+
+
+def test_singleton_family_passes_through():
+    s, q = fam("ACGTN", default_q=33)
+    base, qual = cc.consensus_maker(s, q, cutoff=0.7)
+    assert base.tolist() == encode_seq("ACGTN").tolist()
+    assert qual.tolist() == [33, 33, 33, 33, 0]
+
+
+@pytest.mark.parametrize("fam_size", [1, 2, 3, 5, 8, 17])
+@pytest.mark.parametrize("cutoff", [0.5, 0.7, 1.0])
+def test_numpy_backend_matches_oracle(fam_size, cutoff):
+    rng = np.random.default_rng(fam_size * 100 + int(cutoff * 10))
+    L = 23
+    s = rng.integers(0, 5, size=(fam_size, L)).astype(np.uint8)
+    q = rng.integers(0, 42, size=(fam_size, L)).astype(np.uint8)
+    b1, q1 = cc.consensus_maker(s, q, cutoff=cutoff, qual_threshold=13)
+    b2, q2 = cc.consensus_maker_numpy(s, q, cutoff=cutoff, qual_threshold=13)
+    np.testing.assert_array_equal(b1, b2)
+    np.testing.assert_array_equal(q1, q2)
+
+
+def test_permutation_invariance_modulo_tiebreak():
+    # Property (SURVEY §4.5): with no ties, consensus is permutation-invariant.
+    rng = np.random.default_rng(0)
+    s = rng.integers(0, 4, size=(5, 31)).astype(np.uint8)
+    q = rng.integers(20, 40, size=(5, 31)).astype(np.uint8)
+    b0, q0 = cc.consensus_maker(s, q, cutoff=0.6)
+    for _ in range(5):
+        perm = rng.permutation(5)
+        b1, q1 = cc.consensus_maker(s[perm], q[perm], cutoff=0.6)
+        # qual sums are order-independent always; bases only when no tie —
+        # use an odd family with cutoff>0.5 so the modal base is unique
+        # whenever it passes.
+        passed = b0 != N
+        np.testing.assert_array_equal(b0[passed], b1[passed])
+        np.testing.assert_array_equal(q0[passed], q1[passed])
+
+
+def test_cutoff_monotonicity():
+    # Higher cutoff => never fewer N's (SURVEY §4.5).
+    rng = np.random.default_rng(7)
+    s = rng.integers(0, 5, size=(6, 40)).astype(np.uint8)
+    q = rng.integers(0, 41, size=(6, 40)).astype(np.uint8)
+    prev_n = -1
+    for cutoff in (0.3, 0.5, 0.7, 0.9, 1.0):
+        base, _ = cc.consensus_maker(s, q, cutoff=cutoff)
+        n_count = int((base == N).sum())
+        assert n_count >= prev_n
+        prev_n = n_count
+
+
+def test_pad_codes_rejected_by_all_backends():
+    # Regression: PAD (5) must never be votable — both backends refuse it.
+    s = np.full((3, 2), 5, dtype=np.uint8)
+    q = np.full((3, 2), 30, dtype=np.uint8)
+    for fn in (cc.consensus_maker, cc.consensus_maker_numpy):
+        with pytest.raises(ValueError, match="PAD"):
+            fn(s, q)
+
+
+def test_empty_family_rejected_by_both_backends():
+    s = np.zeros((0, 3), dtype=np.uint8)
+    q = np.zeros((0, 3), dtype=np.uint8)
+    for fn in (cc.consensus_maker, cc.consensus_maker_numpy):
+        with pytest.raises(ValueError, match="empty family"):
+            fn(s, q)
+
+
+def test_cutoff_fraction_exact():
+    assert cc.cutoff_fraction(0.7) == (7, 10)
+    assert cc.cutoff_fraction(0.5) == (1, 2)
+    assert cc.cutoff_fraction(1.0) == (1, 1)

@@ -1,0 +1,34 @@
+import numpy as np
+
+from consensuscruncher_tpu.core.duplex_cpu import duplex_consensus, correct_singleton
+from consensuscruncher_tpu.utils.phred import encode_seq, N
+
+
+def test_agreement_and_disagreement():
+    s1, s2 = encode_seq("ACGTA"), encode_seq("ACGTC")
+    q1 = np.array([30, 30, 30, 30, 30], dtype=np.uint8)
+    q2 = np.array([20, 20, 20, 40, 20], dtype=np.uint8)
+    base, qual = duplex_consensus(s1, q1, s2, q2)
+    assert base.tolist() == encode_seq("ACGTN").tolist()
+    assert qual.tolist() == [50, 50, 50, 60, 0]  # 70 capped at 60
+
+
+def test_agreeing_N_stays_N_with_zero_qual():
+    s = encode_seq("NN")
+    q = np.array([30, 30], dtype=np.uint8)
+    base, qual = duplex_consensus(s, q, s, q)
+    assert base.tolist() == [N, N]
+    assert qual.tolist() == [0, 0]
+
+
+def test_correct_singleton_is_duplex():
+    assert correct_singleton is duplex_consensus
+
+
+def test_pad_codes_rejected():
+    import pytest
+
+    pad = np.full(3, 5, dtype=np.uint8)
+    q = np.full(3, 30, dtype=np.uint8)
+    with pytest.raises(ValueError, match="PAD"):
+        duplex_consensus(pad, q, pad, q)

@@ -1,0 +1,27 @@
+import numpy as np
+
+from consensuscruncher_tpu.utils import phred
+
+
+def test_encode_decode_roundtrip():
+    s = "ACGTNacgtn"
+    codes = phred.encode_seq(s)
+    assert codes.tolist() == [0, 1, 2, 3, 4, 0, 1, 2, 3, 4]
+    assert phred.decode_seq(codes[:5]) == "ACGTN"
+
+
+def test_unknown_bases_map_to_N():
+    assert phred.encode_seq("RYKM-.").tolist() == [phred.N] * 6
+
+
+def test_qual_string_roundtrip():
+    q = np.array([0, 20, 41, 93], dtype=np.uint8)
+    s = phred.array_to_qual_string(q)
+    assert s == "!5J~"
+    assert phred.qual_string_to_array(s).tolist() == q.tolist()
+
+
+def test_complement():
+    codes = phred.encode_seq("ACGTN")
+    assert phred.decode_seq(phred.complement_codes(codes)) == "TGCAN"
+    assert phred.revcomp_str("AACGTN") == "NACGTT"

@@ -1,0 +1,77 @@
+from dataclasses import dataclass
+
+from consensuscruncher_tpu.core import tags
+
+
+@dataclass
+class FakeRead:
+    ref: str
+    pos: int
+    mate_ref: str
+    mate_pos: int
+    is_read1: bool
+    is_reverse: bool
+
+
+def _fragment_reads():
+    """The four read groups of one duplex fragment [100, 300] on chr1."""
+    a_r1 = FakeRead("chr1", 100, "chr1", 300, True, False)   # strand A, R1 fwd @ Lo
+    a_r2 = FakeRead("chr1", 300, "chr1", 100, False, True)   # strand A, R2 rev @ Hi
+    b_r1 = FakeRead("chr1", 300, "chr1", 100, True, True)    # strand B, R1 rev @ Hi
+    b_r2 = FakeRead("chr1", 100, "chr1", 300, False, False)  # strand B, R2 fwd @ Lo
+    ta1 = tags.unique_tag(a_r1, "AAA.CCC")
+    ta2 = tags.unique_tag(a_r2, "AAA.CCC")
+    tb1 = tags.unique_tag(b_r1, "CCC.AAA")
+    tb2 = tags.unique_tag(b_r2, "CCC.AAA")
+    return ta1, ta2, tb1, tb2
+
+
+def test_barcode_helpers():
+    assert tags.mirror_barcode("AAA.CCC") == "CCC.AAA"
+    assert tags.mirror_barcode(tags.mirror_barcode("AAA.CCC")) == "AAA.CCC"
+    assert tags.barcode_from_qname("x:y:z|AAA.CCC") == "AAA.CCC"
+
+
+def test_four_groups_are_distinct_families():
+    assert len({*(_fragment_reads())}) == 4
+
+
+def test_mate_tag_links_the_pair():
+    ta1, ta2, tb1, tb2 = _fragment_reads()
+    assert tags.mate_tag(ta1) == ta2
+    assert tags.mate_tag(ta2) == ta1
+    assert tags.mate_tag(tb1) == tb2
+
+
+def test_duplex_tag_links_complementary_strands():
+    ta1, ta2, tb1, tb2 = _fragment_reads()
+    # Strand A's R1 (fwd @ Lo) duplexes with strand B's R2 (fwd @ Lo).
+    assert tags.duplex_tag(ta1) == tb2
+    assert tags.duplex_tag(tb2) == ta1
+    assert tags.duplex_tag(ta2) == tb1
+
+
+def test_sscs_qname_pairs_mates_but_separates_strands():
+    ta1, ta2, tb1, tb2 = _fragment_reads()
+    assert tags.sscs_qname(ta1) == tags.sscs_qname(ta2)
+    assert tags.sscs_qname(tb1) == tags.sscs_qname(tb2)
+    assert tags.sscs_qname(ta1) != tags.sscs_qname(tb1)
+
+
+def test_sscs_qname_separates_strands_with_palindromic_barcode():
+    # Regression: with BC1 == BC2 the barcode can't separate strands — the
+    # read number at the low-coordinate end must.
+    a_r1 = FakeRead("chr1", 100, "chr1", 300, True, False)
+    a_r2 = FakeRead("chr1", 300, "chr1", 100, False, True)
+    b_r1 = FakeRead("chr1", 300, "chr1", 100, True, True)
+    b_r2 = FakeRead("chr1", 100, "chr1", 300, False, False)
+    ta1, ta2, tb1, tb2 = (tags.unique_tag(r, "AAA.AAA") for r in (a_r1, a_r2, b_r1, b_r2))
+    assert tags.sscs_qname(ta1) == tags.sscs_qname(ta2)
+    assert tags.sscs_qname(tb1) == tags.sscs_qname(tb2)
+    assert tags.sscs_qname(ta1) != tags.sscs_qname(tb1)
+
+
+def test_dcs_qname_unifies_everything():
+    ta1, ta2, tb1, tb2 = _fragment_reads()
+    names = {tags.dcs_qname(t) for t in (ta1, ta2, tb1, tb2)}
+    assert len(names) == 1
