@@ -1,0 +1,181 @@
+"""BGZF (Blocked GNU Zip Format) codec — the container framing of BAM files.
+
+First-party implementation: this environment has no pysam/htslib, so the
+framework ships its own codec (reference parity: the htslib layer under
+pysam, SURVEY.md §2 "Native components").  A native C++ hot path lives in
+``io/native`` (ctypes-loaded); this module is the pure-Python fallback and the
+single place that defines the framing.
+
+Format (htslib SAM spec §4.1): a BGZF file is a series of gzip members, each
+at most 64 KiB of payload, carrying a ``BC`` extra subfield whose 16-bit value
+``BSIZE`` is (total block length - 1).  The file ends with a fixed 28-byte
+empty block (EOF marker).  Because every block is a valid gzip member, plain
+``gzip`` tools can read BGZF — but not vice versa, so the writer here always
+emits real blocks + EOF marker for htslib compatibility.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import struct
+import zlib
+from typing import BinaryIO, Iterator
+
+MAX_BLOCK_PAYLOAD = 0xFF00  # htslib convention: keep compressed block < 64 KiB
+
+BGZF_EOF = bytes.fromhex("1f8b08040000000000ff0600424302001b0003000000000000000000")
+
+_TAIL = struct.Struct("<2I")  # CRC32, ISIZE
+
+
+def _is_pathlike(x) -> bool:
+    return isinstance(x, (str, bytes, os.PathLike))
+
+
+def _block_header(block_size: int) -> bytes:
+    return struct.pack(
+        "<4BIBBHBBHH",
+        0x1F, 0x8B, 0x08, 0x04,  # gzip magic, deflate, FEXTRA
+        0,                        # mtime
+        0, 0xFF,                  # XFL, OS=unknown
+        6,                        # XLEN
+        0x42, 0x43, 2,            # 'B', 'C', SLEN=2
+        block_size - 1,           # BSIZE
+    )
+
+
+def compress_block(payload: bytes, level: int = 6) -> bytes:
+    """One ≤64 KiB payload -> one complete BGZF block."""
+    if len(payload) > 0x10000:
+        raise ValueError(f"BGZF payload too large: {len(payload)}")
+    comp = zlib.compressobj(level, zlib.DEFLATED, -15)
+    data = comp.compress(payload) + comp.flush()
+    block_size = len(data) + 26  # 18 header + data + 8 tail
+    return _block_header(block_size) + data + _TAIL.pack(zlib.crc32(payload), len(payload))
+
+
+def iter_blocks(fh: BinaryIO) -> Iterator[bytes]:
+    """Yield decompressed payloads block by block, validating framing + CRC."""
+    while True:
+        header = fh.read(18)
+        if len(header) == 0:
+            return  # clean EOF (tolerated even without the marker block)
+        if len(header) < 18:
+            raise ValueError("truncated BGZF block header")
+        if header[0] != 0x1F or header[1] != 0x8B:
+            raise ValueError("not a BGZF/gzip stream (bad magic)")
+        if header[3] & 0x04 == 0:
+            raise ValueError("gzip member lacks the BGZF BC extra subfield")
+        # Scan the extra field for the BC subfield (SAM spec §4.1 allows other
+        # subfields alongside it, so the 18-byte fast layout is not assumed).
+        (xlen,) = struct.unpack_from("<H", header, 10)
+        extra = header[12:18]
+        if xlen > 6:
+            extra += fh.read(xlen - 6)
+            if len(extra) < xlen:
+                raise ValueError("truncated BGZF extra field")
+        bsize = None
+        off = 0
+        while off + 4 <= xlen:
+            si1, si2, slen = extra[off], extra[off + 1], struct.unpack_from("<H", extra, off + 2)[0]
+            if si1 == 0x42 and si2 == 0x43 and slen == 2:
+                (bsize,) = struct.unpack_from("<H", extra, off + 4)
+                break
+            off += 4 + slen
+        if bsize is None:
+            raise ValueError("gzip member lacks the BGZF BC extra subfield")
+        block_size = bsize + 1
+        consumed = 12 + xlen
+        rest = fh.read(block_size - consumed)
+        if len(rest) < block_size - consumed:
+            raise ValueError("truncated BGZF block")
+        data, (crc, isize) = rest[:-8], _TAIL.unpack(rest[-8:])
+        payload = zlib.decompress(data, -15) if isize else b""
+        if len(payload) != isize:
+            raise ValueError(f"BGZF ISIZE mismatch: {len(payload)} != {isize}")
+        if zlib.crc32(payload) != crc:
+            raise ValueError("BGZF CRC mismatch")
+        if payload:
+            yield payload
+
+
+class BgzfReader(io.RawIOBase):
+    """File-like sequential reader over BGZF blocks."""
+
+    def __init__(self, path_or_fh):
+        self._own = _is_pathlike(path_or_fh)
+        self._fh = open(path_or_fh, "rb") if self._own else path_or_fh
+        self._blocks = iter_blocks(self._fh)
+        self._buf = b""
+        self._pos = 0
+
+    def readable(self) -> bool:
+        return True
+
+    def read(self, n: int = -1) -> bytes:
+        chunks = []
+        if n < 0:
+            chunks.append(self._buf[self._pos:])
+            self._buf, self._pos = b"", 0
+            for payload in self._blocks:
+                chunks.append(payload)
+            return b"".join(chunks)
+        need = n
+        while need > 0:
+            avail = len(self._buf) - self._pos
+            if avail == 0:
+                nxt = next(self._blocks, None)
+                if nxt is None:
+                    break
+                self._buf, self._pos = nxt, 0
+                continue
+            take = min(avail, need)
+            chunks.append(self._buf[self._pos : self._pos + take])
+            self._pos += take
+            need -= take
+        return b"".join(chunks)
+
+    def close(self) -> None:
+        if self._own:
+            self._fh.close()
+        super().close()
+
+
+class BgzfWriter(io.RawIOBase):
+    """File-like writer that emits proper BGZF blocks + EOF marker on close."""
+
+    def __init__(self, path_or_fh, level: int = 6):
+        self._own = _is_pathlike(path_or_fh)
+        self._fh = open(path_or_fh, "wb") if self._own else path_or_fh
+        self._level = level
+        self._buf = bytearray()
+
+    def writable(self) -> bool:
+        return True
+
+    def write(self, data) -> int:
+        self._buf += data
+        while len(self._buf) >= MAX_BLOCK_PAYLOAD:
+            self._flush_block(MAX_BLOCK_PAYLOAD)
+        return len(data)
+
+    def _flush_block(self, size: int) -> None:
+        payload, self._buf = bytes(self._buf[:size]), self._buf[size:]
+        self._fh.write(compress_block(payload, self._level))
+
+    def close(self) -> None:
+        if self.closed:
+            return
+        if self._buf:
+            self._flush_block(len(self._buf))
+        self._fh.write(BGZF_EOF)
+        if self._own:
+            self._fh.close()
+        super().close()
+
+
+def decompress_file(path) -> bytes:
+    """Whole-file BGZF -> bytes (convenience for small files/tests)."""
+    with open(path, "rb") as fh:
+        return b"".join(iter_blocks(fh))

@@ -1,0 +1,55 @@
+"""FASTQ reader/writer (gzip-transparent).
+
+Reference parity: the Biopython ``FastqGeneralIterator`` + ``gzip`` usage in
+``ConsensusCruncher/extract_barcodes.py`` (SURVEY.md §2; Biopython is absent
+here, so the framework owns the parser).  Records are ``(name, seq, qual)``
+string triples; ``name`` excludes the leading ``@`` and keeps any comment.
+"""
+
+from __future__ import annotations
+
+import gzip
+from typing import Iterator, TextIO
+
+
+def _open_text(path, mode: str):
+    p = str(path)
+    if p.endswith(".gz"):
+        return gzip.open(p, mode + "t", encoding="ascii")
+    return open(p, mode, encoding="ascii")
+
+
+def read_fastq(path) -> Iterator[tuple[str, str, str]]:
+    """Yield ``(name, seq, qual)`` triples; validates 4-line framing."""
+    with _open_text(path, "r") as fh:
+        while True:
+            head = fh.readline()
+            if not head:
+                return
+            if not head.startswith("@"):
+                raise ValueError(f"bad FASTQ header line: {head!r}")
+            seq = fh.readline().rstrip("\r\n")
+            plus = fh.readline()
+            qual = fh.readline().rstrip("\r\n")
+            if not plus.startswith("+"):
+                raise ValueError(f"bad FASTQ separator for {head.strip()!r}")
+            if len(seq) != len(qual):
+                raise ValueError(f"seq/qual length mismatch for {head.strip()!r}")
+            yield head[1:].rstrip("\r\n"), seq, qual
+
+
+class FastqWriter:
+    def __init__(self, path):
+        self._fh: TextIO = _open_text(path, "w")
+
+    def write(self, name: str, seq: str, qual: str) -> None:
+        self._fh.write(f"@{name}\n{seq}\n+\n{qual}\n")
+
+    def close(self) -> None:
+        self._fh.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

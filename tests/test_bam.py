@@ -1,0 +1,226 @@
+import numpy as np
+import pytest
+
+from consensuscruncher_tpu.io import bam
+from consensuscruncher_tpu.io.bam import BamHeader, BamRead, BamReader, BamWriter
+
+
+HEADER = BamHeader.from_refs([("chr1", 1000000), ("chr2", 500000)])
+
+
+def mk_read(qname="r1|AAA.CCC", flag=99, ref="chr1", pos=100, **kw):
+    seq = kw.pop("seq", "ACGTACGTAC")
+    qual = kw.pop("qual", np.arange(len(seq), dtype=np.uint8) + 30)
+    return BamRead(
+        qname=qname, flag=flag, ref=ref, pos=pos, mapq=60,
+        cigar=[("M", len(seq))], mate_ref=kw.pop("mate_ref", "chr1"),
+        mate_pos=kw.pop("mate_pos", 300), tlen=kw.pop("tlen", 210),
+        seq=seq, qual=qual, tags=kw.pop("tags", {}),
+    )
+
+
+def test_record_roundtrip_all_fields(tmp_path):
+    p = tmp_path / "x.bam"
+    r = mk_read(tags={
+        "NM": ("i", 2),
+        "MD": ("Z", "10A5"),
+        "AS": ("i", -3),
+        "XF": ("f", 1.5),
+        "XA": ("A", "c"),
+        "XB": ("B", ("i", [1, -2, 3])),
+    })
+    r.cigar = [("S", 2), ("M", 6), ("I", 1), ("D", 2), ("M", 1)]
+    with BamWriter(str(p), HEADER) as w:
+        w.write(r)
+    with BamReader(str(p)) as rd:
+        assert rd.header.refs == HEADER.refs
+        (got,) = list(rd)
+    assert got.qname == r.qname
+    assert got.flag == r.flag
+    assert got.ref == "chr1" and got.pos == 100
+    assert got.mate_ref == "chr1" and got.mate_pos == 300
+    assert got.tlen == 210 and got.mapq == 60
+    assert got.cigar == r.cigar
+    assert got.seq == r.seq
+    np.testing.assert_array_equal(got.qual, r.qual)
+    assert got.tags["NM"] == ("i", 2)
+    assert got.tags["MD"] == ("Z", "10A5")
+    assert got.tags["AS"] == ("i", -3)
+    assert got.tags["XA"] == ("A", "c")
+    assert abs(got.tags["XF"][1] - 1.5) < 1e-6
+    assert got.tags["XB"] == ("B", ("i", [1, -2, 3]))
+
+
+def test_unmapped_and_starless(tmp_path):
+    p = tmp_path / "x.bam"
+    r = BamRead(qname="u1", flag=bam.FUNMAP, ref="*", pos=-1, seq="ACGT",
+                qual=np.zeros(0, dtype=np.uint8))
+    with BamWriter(str(p), HEADER) as w:
+        w.write(r)
+    with BamReader(str(p)) as rd:
+        (got,) = list(rd)
+    assert got.ref == "*" and got.pos == -1 and got.is_unmapped
+    assert got.qual.size == 0  # '*' qualities round-trip as absent
+
+
+def test_odd_length_seq_roundtrip(tmp_path):
+    p = tmp_path / "x.bam"
+    with BamWriter(str(p), HEADER) as w:
+        w.write(mk_read(seq="ACGTN", qual=np.array([1, 2, 3, 4, 5], dtype=np.uint8)))
+    with BamReader(str(p)) as rd:
+        (got,) = list(rd)
+    assert got.seq == "ACGTN"
+    assert got.qual.tolist() == [1, 2, 3, 4, 5]
+
+
+def test_many_records_stream(tmp_path):
+    p = tmp_path / "many.bam"
+    with BamWriter(str(p), HEADER) as w:
+        for i in range(5000):
+            w.write(mk_read(qname=f"r{i}", pos=i))
+    with BamReader(str(p)) as rd:
+        got = list(rd)
+    assert len(got) == 5000
+    assert got[4999].pos == 4999
+
+
+def test_flag_properties():
+    r = mk_read(flag=99)  # paired, proper, mate-reverse, read1
+    assert r.is_paired and r.is_read1 and not r.is_read2
+    assert not r.is_reverse and r.mate_is_reverse
+    r2 = mk_read(flag=147)  # paired, proper, reverse, read2
+    assert r2.is_reverse and r2.is_read2
+
+
+def test_sort_bam(tmp_path):
+    import random
+
+    rng = random.Random(0)
+    p = tmp_path / "unsorted.bam"
+    positions = list(range(2000))
+    rng.shuffle(positions)
+    with BamWriter(str(p), HEADER) as w:
+        for i, pos in enumerate(positions):
+            ref = "chr2" if pos % 3 == 0 else "chr1"
+            w.write(mk_read(qname=f"r{i}", ref=ref, pos=pos))
+    out = tmp_path / "sorted.bam"
+    bam.sort_bam(str(p), str(out))
+    with BamReader(str(out)) as rd:
+        assert "SO:coordinate" in rd.header.text
+        keys = [(rd.header.ref_id(r.ref), r.pos) for r in rd]
+    assert keys == sorted(keys)
+    assert len(keys) == 2000
+
+
+def test_sort_bam_with_spill(tmp_path):
+    import random
+
+    rng = random.Random(1)
+    p = tmp_path / "unsorted.bam"
+    positions = list(range(1500))
+    rng.shuffle(positions)
+    with BamWriter(str(p), HEADER) as w:
+        for i, pos in enumerate(positions):
+            w.write(mk_read(qname=f"r{i}", pos=pos))
+    out = tmp_path / "sorted.bam"
+    bam.sort_bam(str(p), str(out), max_in_memory=200)  # force 8 spills
+    with BamReader(str(out)) as rd:
+        poss = [r.pos for r in rd]
+    assert poss == sorted(poss)
+    assert len(poss) == 1500
+
+
+def test_merge_bams(tmp_path):
+    paths = []
+    for k in range(3):
+        p = tmp_path / f"in{k}.bam"
+        with BamWriter(str(p), HEADER) as w:
+            for pos in range(k, 300, 3):
+                w.write(mk_read(qname=f"r{k}_{pos}", pos=pos))
+        paths.append(str(p))
+    out = tmp_path / "merged.bam"
+    bam.merge_bams(paths, str(out))
+    with BamReader(str(out)) as rd:
+        poss = [r.pos for r in rd]
+    assert poss == list(range(300))
+
+
+def test_merge_mismatched_refs_rejected(tmp_path):
+    a = tmp_path / "a.bam"
+    b = tmp_path / "b.bam"
+    with BamWriter(str(a), HEADER) as w:
+        w.write(mk_read())
+    h2 = BamHeader.from_refs([("chrX", 500)])
+    with BamWriter(str(b), h2) as w:
+        w.write(mk_read(ref="chrX", mate_ref="chrX", pos=5, mate_pos=50))
+    with pytest.raises(ValueError, match="reference dictionary"):
+        bam.merge_bams([str(a), str(b)], str(tmp_path / "out.bam"))
+
+
+def test_not_a_bam_rejected(tmp_path):
+    from consensuscruncher_tpu.io import bgzf
+
+    p = tmp_path / "x.bam"
+    with bgzf.BgzfWriter(str(p)) as w:
+        w.write(b"JUNK----")
+    with pytest.raises(ValueError, match="not a BAM"):
+        BamReader(str(p))
+
+
+def test_qual_seq_length_mismatch_rejected(tmp_path):
+    r = mk_read()
+    r.qual = np.zeros(3, dtype=np.uint8)
+    with pytest.raises(ValueError, match="qual length"):
+        bam.encode_record(r, HEADER)
+
+
+def test_atomic_writer_aborts_on_exception(tmp_path):
+    p = tmp_path / "x.bam"
+    with pytest.raises(RuntimeError):
+        with BamWriter(str(p), HEADER, atomic=True) as w:
+            w.write(mk_read())
+            raise RuntimeError("mid-write crash")
+    assert not p.exists()  # partial output never promoted
+    assert not (tmp_path / "x.bam.tmp").exists()  # tmp cleaned up
+
+
+def test_pathlib_paths_accepted(tmp_path):
+    p = tmp_path / "x.bam"  # a pathlib.Path, not str
+    with BamWriter(p, HEADER) as w:
+        w.write(mk_read())
+    with BamReader(p) as rd:
+        assert len(list(rd)) == 1
+
+
+def test_unknown_base_roundtrips_as_N(tmp_path):
+    p = tmp_path / "x.bam"
+    with BamWriter(str(p), HEADER) as w:
+        w.write(mk_read(seq="AC-U", qual=np.array([1, 2, 3, 4], dtype=np.uint8)))
+    with BamReader(str(p)) as rd:
+        (got,) = list(rd)
+    assert got.seq == "ACNN"  # htslib behavior: junk -> N, never '='
+
+
+def test_sort_adds_SO_when_HD_lacks_it(tmp_path):
+    hdr = BamHeader(text="@HD\tVN:1.6\n@SQ\tSN:chr1\tLN:1000000\n@CO\tSO:unsorted mentioned in a comment\n",
+                    refs=[("chr1", 1000000)])
+    p = tmp_path / "x.bam"
+    with BamWriter(str(p), hdr) as w:
+        w.write(mk_read())
+    out = tmp_path / "s.bam"
+    bam.sort_bam(str(p), str(out))
+    with BamReader(str(out)) as rd:
+        lines = rd.header.text.splitlines()
+    assert lines[0] == "@HD\tVN:1.6\tSO:coordinate"
+    assert lines[2] == "@CO\tSO:unsorted mentioned in a comment"  # untouched
+
+
+def test_atomic_writer(tmp_path):
+    p = tmp_path / "x.bam"
+    w = BamWriter(str(p), HEADER, atomic=True)
+    w.write(mk_read())
+    assert not p.exists()  # nothing visible until close
+    w.close()
+    assert p.exists()
+    with BamReader(str(p)) as rd:
+        assert len(list(rd)) == 1

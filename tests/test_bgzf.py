@@ -1,0 +1,92 @@
+import gzip
+import io
+
+import pytest
+
+from consensuscruncher_tpu.io import bgzf
+
+
+def test_roundtrip_small(tmp_path):
+    p = tmp_path / "x.bgzf"
+    with bgzf.BgzfWriter(str(p)) as w:
+        w.write(b"hello bgzf world")
+    assert bgzf.decompress_file(str(p)) == b"hello bgzf world"
+
+
+def test_roundtrip_multi_block(tmp_path):
+    data = bytes(range(256)) * 2000  # 512000 bytes -> several blocks
+    p = tmp_path / "big.bgzf"
+    with bgzf.BgzfWriter(str(p)) as w:
+        w.write(data)
+    assert bgzf.decompress_file(str(p)) == data
+
+
+def test_gzip_can_read_our_bgzf(tmp_path):
+    # BGZF is valid multi-member gzip — stdlib gzip must read our output.
+    data = b"ACGT" * 100000
+    p = tmp_path / "x.bgzf"
+    with bgzf.BgzfWriter(str(p)) as w:
+        w.write(data)
+    assert gzip.decompress(p.read_bytes()) == data
+
+
+def test_eof_marker_written(tmp_path):
+    p = tmp_path / "x.bgzf"
+    with bgzf.BgzfWriter(str(p)) as w:
+        w.write(b"x")
+    assert p.read_bytes().endswith(bgzf.BGZF_EOF)
+
+
+def test_empty_file_has_only_eof(tmp_path):
+    p = tmp_path / "x.bgzf"
+    bgzf.BgzfWriter(str(p)).close()
+    assert p.read_bytes() == bgzf.BGZF_EOF
+    assert bgzf.decompress_file(str(p)) == b""
+
+
+def test_reader_incremental_reads(tmp_path):
+    data = b"0123456789" * 20000
+    p = tmp_path / "x.bgzf"
+    with bgzf.BgzfWriter(str(p)) as w:
+        w.write(data)
+    r = bgzf.BgzfReader(str(p))
+    out = bytearray()
+    while chunk := r.read(777):
+        out += chunk
+    assert bytes(out) == data
+    r.close()
+
+
+def test_bc_subfield_found_among_other_subfields():
+    # SAM spec §4.1: other extra subfields may precede BC — scan, don't assume.
+    import struct, zlib
+
+    payload = b"spec-valid block"
+    comp = zlib.compressobj(6, zlib.DEFLATED, -15)
+    data = comp.compress(payload) + comp.flush()
+    extra = b"XX" + struct.pack("<H", 3) + b"abc"  # foreign subfield first
+    xlen = len(extra) + 6
+    block_size = 12 + xlen + len(data) + 8
+    extra += b"BC" + struct.pack("<H", 2) + struct.pack("<H", block_size - 1)
+    hdr = struct.pack("<4BIBBH", 0x1F, 0x8B, 8, 4, 0, 0, 0xFF, xlen)
+    block = hdr + extra + data + struct.pack("<2I", zlib.crc32(payload), len(payload))
+    assert list(bgzf.iter_blocks(io.BytesIO(block))) == [payload]
+
+
+def test_corrupt_crc_detected():
+    block = bytearray(bgzf.compress_block(b"payload"))
+    block[-6] ^= 0xFF  # flip a CRC byte
+    with pytest.raises(ValueError, match="CRC"):
+        list(bgzf.iter_blocks(io.BytesIO(bytes(block))))
+
+
+def test_plain_gzip_rejected():
+    g = gzip.compress(b"not bgzf")
+    with pytest.raises(ValueError, match="BC extra"):
+        list(bgzf.iter_blocks(io.BytesIO(g)))
+
+
+def test_truncated_block_detected():
+    block = bgzf.compress_block(b"payload" * 100)
+    with pytest.raises(ValueError, match="truncated"):
+        list(bgzf.iter_blocks(io.BytesIO(block[: len(block) // 2])))

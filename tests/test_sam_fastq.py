@@ -1,0 +1,77 @@
+import io
+
+import numpy as np
+
+from consensuscruncher_tpu.io import sam
+from consensuscruncher_tpu.io.bam import BamRead
+from consensuscruncher_tpu.io.fastq import FastqWriter, read_fastq
+
+SAM_TEXT = """\
+@HD\tVN:1.6\tSO:unsorted
+@SQ\tSN:chr1\tLN:1000000
+@SQ\tSN:chr2\tLN:500000
+r1|AAA.CCC\t99\tchr1\t101\t60\t10M\t=\t301\t210\tACGTACGTAC\tIIIIIIIIII\tNM:i:0\tMD:Z:10
+r2\t4\t*\t0\t0\t*\t*\t0\t0\tACGT\t*
+"""
+
+
+def test_sam_parse_and_format_roundtrip():
+    header, records = sam.read_sam(io.StringIO(SAM_TEXT))
+    assert header.refs == [("chr1", 1000000), ("chr2", 500000)]
+    r1, r2 = list(records)
+    assert r1.qname == "r1|AAA.CCC" and r1.flag == 99
+    assert r1.pos == 100  # 1-based SAM -> 0-based internal
+    assert r1.mate_ref == "chr1" and r1.mate_pos == 300
+    assert r1.tags["NM"] == ("i", 0)
+    assert r2.is_unmapped and r2.qual.size == 0 and r2.cigar == []
+    # format back
+    line = sam.format_record(r1)
+    assert line.split("\t")[:9] == ["r1|AAA.CCC", "99", "chr1", "101", "60", "10M", "=", "301", "210"]
+    reparsed = sam.parse_record(line)
+    assert reparsed == r1
+
+
+def test_sam_bam_cross_conversion(tmp_path):
+    from consensuscruncher_tpu.io.bam import BamReader, BamWriter
+
+    header, records = sam.read_sam(io.StringIO(SAM_TEXT))
+    p = tmp_path / "x.bam"
+    with BamWriter(str(p), header) as w:
+        for r in records:
+            w.write(r)
+    with BamReader(str(p)) as rd:
+        back = list(rd)
+    assert [sam.format_record(r) for r in back] == [
+        l for l in SAM_TEXT.splitlines() if not l.startswith("@")
+    ]
+
+
+def test_fastq_roundtrip_gz(tmp_path):
+    p = tmp_path / "x.fastq.gz"
+    with FastqWriter(str(p)) as w:
+        w.write("read1 comment", "ACGT", "IIII")
+        w.write("read2", "NNNN", "!!!!")
+    got = list(read_fastq(str(p)))
+    assert got == [("read1 comment", "ACGT", "IIII"), ("read2", "NNNN", "!!!!")]
+
+
+def test_fastq_plain_text(tmp_path):
+    p = tmp_path / "x.fastq"
+    with FastqWriter(str(p)) as w:
+        w.write("a", "ACG", "III")
+    assert list(read_fastq(str(p))) == [("a", "ACG", "III")]
+
+
+def test_fastq_crlf_tolerated(tmp_path):
+    p = tmp_path / "crlf.fastq"
+    p.write_bytes(b"@a comment\r\nACGT\r\n+\r\nIIII\r\n")
+    assert list(read_fastq(str(p))) == [("a comment", "ACGT", "IIII")]
+
+
+def test_fastq_malformed_detected(tmp_path):
+    import pytest
+
+    p = tmp_path / "bad.fastq"
+    p.write_text("@a\nACGT\n+\nIII\n")  # qual too short
+    with pytest.raises(ValueError, match="length mismatch"):
+        list(read_fastq(str(p)))
