@@ -48,11 +48,13 @@ DEFAULT_QUAL_THRESHOLD = 0
 def cutoff_fraction(cutoff: float) -> tuple[int, int]:
     """Exact rational ``(num, den)`` for a float cutoff.
 
-    ``limit_denominator(10**6)`` recovers the human-entered decimal (0.7 →
-    7/10) rather than the float's binary expansion, so the integer comparison
-    ``count * den >= num * F`` matches the intent of ``count/F >= cutoff``.
+    ``limit_denominator(1000)`` recovers the human-entered decimal (0.7 →
+    7/10, and 0.333... → 1/3) rather than the float's binary expansion, so
+    the integer comparison ``count * den >= num * F`` matches the intent of
+    ``count/F >= cutoff``.  The small denominator bound also keeps the
+    cross-multiply int32-safe on device for family buckets up to ~2M reads.
     """
-    frac = Fraction(cutoff).limit_denominator(10**6)
+    frac = Fraction(cutoff).limit_denominator(1000)
     return frac.numerator, frac.denominator
 
 

@@ -365,8 +365,12 @@ class BamReader:
     def __iter__(self):
         while True:
             raw = self._bgzf.read(4)
-            if len(raw) < 4:
+            if len(raw) == 0:
                 return
+            if len(raw) < 4:
+                # A partial length prefix is never valid — a file truncated at
+                # a BGZF block boundary must not read as a complete dataset.
+                raise ValueError("truncated BAM record (partial length prefix)")
             (block_size,) = struct.unpack("<i", raw)
             body = self._bgzf.read(block_size)
             if len(body) < block_size:
@@ -481,7 +485,9 @@ def _spill(buf: list[BamRead], header: BamHeader) -> str:
     buf.sort(key=lambda r: _coord_key(r, header))
     fd, path = tempfile.mkstemp(suffix=".bam", prefix="ccsort.")
     os.close(fd)
-    with BamWriter(path, header) as w:
+    # level 1: spill chunks are throwaway (read back once, deleted) — don't
+    # pay full deflate on the sort hot path; the merged output stays level 6.
+    with BamWriter(path, header, level=1) as w:
         for read in buf:
             w.write(read)
     return path

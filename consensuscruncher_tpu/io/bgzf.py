@@ -46,9 +46,17 @@ def _block_header(block_size: int) -> bytes:
 
 
 def compress_block(payload: bytes, level: int = 6) -> bytes:
-    """One ≤64 KiB payload -> one complete BGZF block."""
-    if len(payload) > 0x10000:
-        raise ValueError(f"BGZF payload too large: {len(payload)}")
+    """One payload of at most MAX_BLOCK_PAYLOAD bytes -> one complete BGZF block.
+
+    The cap leaves headroom for deflate's worst-case expansion on
+    incompressible data: compressed size + 26 framing bytes must fit the
+    16-bit BSIZE field (htslib uses the same 0xFF00 payload bound).
+    """
+    if len(payload) > MAX_BLOCK_PAYLOAD:
+        raise ValueError(
+            f"BGZF payload too large: {len(payload)} > {MAX_BLOCK_PAYLOAD} "
+            "(incompressible data must still fit the 16-bit BSIZE field)"
+        )
     comp = zlib.compressobj(level, zlib.DEFLATED, -15)
     data = comp.compress(payload) + comp.flush()
     block_size = len(data) + 26  # 18 header + data + 8 tail

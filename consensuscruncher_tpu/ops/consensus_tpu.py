@@ -1,0 +1,161 @@
+"""TPU consensus kernel — the rebuilt hot loop of the reference pipeline.
+
+Reference parity: ``ConsensusCruncher/consensus_helper.py:consensus_maker``
+(SURVEY.md §3.3).  The per-position ``collections.Counter`` loop becomes a
+jitted, ``vmap``-ed tensor program over padded ``(batch, family, length)``
+uint8 arrays:
+
+  one-hot counts (F,L,5) → sum over F → lexicographic (count, first-seen)
+  argmax → rational cutoff compare → masked Phred sum.
+
+Bit-parity with the CPU oracle (``core.consensus_cpu.consensus_maker``) is
+guaranteed by construction and enforced by tests:
+
+- **Tie-break**: CPython ``Counter.most_common`` resolves ties by insertion
+  order (first-seen read).  On TPU that is reproduced by scoring each base
+  ``count * (F+1) + (F - first_seen)`` and taking one argmax — higher count
+  wins, then earlier first occurrence; distinct first-seen indices make the
+  score unique so argmax never sees a tie.
+- **Cutoff**: exact integer compare ``count * den >= num * fam_size`` with the
+  rational cutoff from ``core.consensus_cpu.cutoff_fraction`` — immune to
+  float32-vs-float64 boundary wobble (e.g. 7/10 at cutoff 0.7).
+- **Padding**: PAD (5) never matches a vote lane; padded members/positions are
+  additionally masked by ``fam_size``/length.  Zero-size (all-padding) batch
+  slots emit all-N with qual 0.
+
+All shapes are static per (B, F, L) bucket — no data-dependent control flow —
+so XLA compiles one fused program per bucket (recompiles bounded by the
+bucketing policy in ``parallel.batching``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import lru_cache, partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from consensuscruncher_tpu.core.consensus_cpu import (
+    DEFAULT_CUTOFF,
+    DEFAULT_QUAL_CAP,
+    DEFAULT_QUAL_THRESHOLD,
+    cutoff_fraction,
+)
+from consensuscruncher_tpu.utils.phred import N, NUM_BASES, PAD
+
+
+@dataclass(frozen=True)
+class ConsensusConfig:
+    """Static (compile-time) consensus parameters."""
+
+    cutoff: float = DEFAULT_CUTOFF
+    qual_threshold: int = DEFAULT_QUAL_THRESHOLD
+    qual_cap: int = DEFAULT_QUAL_CAP
+
+    @property
+    def cutoff_rational(self) -> tuple[int, int]:
+        return cutoff_fraction(self.cutoff)
+
+
+def _consensus_one_family(bases, quals, fam_size, *, num, den, qual_threshold, qual_cap):
+    """Consensus of one padded family: (F, L) uint8 -> (L,) uint8 pair."""
+    fam_cap, _length = bases.shape
+    member = (jnp.arange(fam_cap, dtype=jnp.int32) < fam_size)[:, None]  # (F, 1)
+
+    eff = jnp.where(quals >= qual_threshold, bases, jnp.uint8(N))
+    eff = jnp.where(member, eff, jnp.uint8(PAD))  # padded slots never vote
+
+    lanes = jnp.arange(NUM_BASES, dtype=jnp.uint8)
+    onehot = eff[:, :, None] == lanes  # (F, L, 5) bool
+    counts = onehot.sum(axis=0, dtype=jnp.int32)  # (L, 5)
+    member_idx = jnp.arange(fam_cap, dtype=jnp.int32)[:, None, None]
+    first_seen = jnp.where(onehot, member_idx, fam_cap).min(axis=0)  # (L, 5)
+
+    # Lexicographic (count desc, first_seen asc) WITHOUT a combined score
+    # product (which would overflow int32 for huge family buckets; JAX
+    # silently downcasts int64 when x64 is off, so int32-safe algebra is the
+    # only reliable form): take the max count, then argmin first-seen among
+    # the bases achieving it.
+    max_count = counts.max(axis=1)  # (L,)
+    cand_first = jnp.where(counts == max_count[:, None], first_seen, fam_cap + 1)
+    modal = cand_first.argmin(axis=1).astype(jnp.int32)  # (L,)
+
+    # Static trace-time guard: the rational-cutoff cross-multiply must fit
+    # int32 (den <= 1000 from cutoff_fraction, so this allows fam_cap ~2M).
+    if fam_cap * max(den, num) >= 2**31:
+        raise ValueError(
+            f"family bucket {fam_cap} with cutoff {num}/{den} would overflow "
+            "the int32 cutoff compare — split the family or coarsen the cutoff"
+        )
+    passed = (modal != N) & (max_count * den >= num * fam_size) & (fam_size > 0)
+
+    agree = (bases == modal[None, :].astype(jnp.uint8)) & (quals >= qual_threshold) & member
+    qsum = jnp.where(agree, quals.astype(jnp.int32), 0).sum(axis=0)  # (L,)
+
+    out_base = jnp.where(passed, modal, N).astype(jnp.uint8)
+    out_qual = jnp.where(passed, jnp.minimum(qsum, qual_cap), 0).astype(jnp.uint8)
+    return out_base, out_qual
+
+
+@lru_cache(maxsize=None)
+def _compiled_batch_fn(num: int, den: int, qual_threshold: int, qual_cap: int):
+    """One jitted vmapped program per consensus config (shapes specialize
+    further inside jit's own cache, bounded by the bucketing policy)."""
+    fn = partial(
+        _consensus_one_family, num=num, den=den, qual_threshold=qual_threshold, qual_cap=qual_cap
+    )
+    return jax.jit(jax.vmap(fn, in_axes=(0, 0, 0)))
+
+
+def consensus_batch(
+    bases,
+    quals,
+    fam_sizes,
+    config: ConsensusConfig = ConsensusConfig(),
+):
+    """Batched consensus on device.
+
+    Args:
+      bases: ``(B, F, L)`` uint8 codes, PAD in unused member slots/positions.
+      quals: ``(B, F, L)`` uint8 Phred scores.
+      fam_sizes: ``(B,)`` int32 true family sizes (0 = dummy batch slot).
+      config: static consensus parameters.
+
+    Returns ``(consensus_bases, consensus_quals)`` as ``(B, L)`` uint8 device
+    arrays; dummy slots come back all-N/0.
+    """
+    num, den = config.cutoff_rational
+    fn = _compiled_batch_fn(num, den, int(config.qual_threshold), int(config.qual_cap))
+    return fn(
+        jnp.asarray(bases, dtype=jnp.uint8),
+        jnp.asarray(quals, dtype=jnp.uint8),
+        jnp.asarray(fam_sizes, dtype=jnp.int32),
+    )
+
+
+def consensus_batch_host(bases, quals, fam_sizes, config: ConsensusConfig = ConsensusConfig()):
+    """Same as :func:`consensus_batch` but returns host numpy arrays."""
+    b, q = consensus_batch(bases, quals, fam_sizes, config)
+    return np.asarray(b), np.asarray(q)
+
+
+def consensus_families(families, config: ConsensusConfig = ConsensusConfig(), max_batch: int = 1024):
+    """Stream ragged families through the device kernel.
+
+    ``families`` yields ``(key, member_seqs, member_quals)`` (ragged lists of
+    1-D uint8 arrays); yields ``(key, consensus_base, consensus_qual)`` with
+    outputs sliced to each family's true consensus length.  Batches are
+    dispatched per (F, L) bucket; device->host transfer happens once per
+    batch.
+    """
+    from consensuscruncher_tpu.parallel.batching import bucket_families
+
+    for batch in bucket_families(families, max_batch=max_batch):
+        out_b, out_q = consensus_batch(batch.bases, batch.quals, batch.fam_sizes, config)
+        out_b = np.asarray(out_b)
+        out_q = np.asarray(out_q)
+        for i, key in enumerate(batch.keys):
+            length = int(batch.lengths[i])
+            yield key, out_b[i, :length], out_q[i, :length]

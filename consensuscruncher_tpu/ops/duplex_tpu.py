@@ -1,0 +1,46 @@
+"""TPU duplex-consensus kernel (reference: DCS_maker.py:duplex_consensus).
+
+Elementwise two-strand agreement vote over batched ``(B, L)`` tensors —
+bit-identical to ``core.duplex_cpu.duplex_consensus`` (the pinned formula:
+keep agreeing non-N bases with summed-capped quality).  Also used batched for
+singleton correction (a correction is a 2-deep duplex vote, SURVEY.md §3.5).
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from consensuscruncher_tpu.core.consensus_cpu import DEFAULT_QUAL_CAP
+from consensuscruncher_tpu.utils.phred import N
+
+
+@lru_cache(maxsize=None)
+def _compiled(qual_cap: int):
+    def fn(seq1, qual1, seq2, qual2):
+        agree = (seq1 == seq2) & (seq1 < N)
+        out_base = jnp.where(agree, seq1, jnp.uint8(N))
+        qsum = qual1.astype(jnp.int32) + qual2.astype(jnp.int32)
+        out_qual = jnp.where(agree, jnp.minimum(qsum, qual_cap), 0).astype(jnp.uint8)
+        return out_base, out_qual
+
+    return jax.jit(fn)
+
+
+def duplex_batch(seq1, qual1, seq2, qual2, qual_cap: int = DEFAULT_QUAL_CAP):
+    """Batched duplex vote: four ``(B, L)`` uint8 arrays -> two ``(B, L)``."""
+    fn = _compiled(int(qual_cap))
+    return fn(
+        jnp.asarray(seq1, dtype=jnp.uint8),
+        jnp.asarray(qual1, dtype=jnp.uint8),
+        jnp.asarray(seq2, dtype=jnp.uint8),
+        jnp.asarray(qual2, dtype=jnp.uint8),
+    )
+
+
+def duplex_batch_host(seq1, qual1, seq2, qual2, qual_cap: int = DEFAULT_QUAL_CAP):
+    b, q = duplex_batch(seq1, qual1, seq2, qual2, qual_cap)
+    return np.asarray(b), np.asarray(q)

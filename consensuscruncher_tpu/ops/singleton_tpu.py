@@ -1,0 +1,73 @@
+"""Vectorized barcode matching for singleton rescue.
+
+Reference parity: ``ConsensusCruncher/singleton_correction.py`` (SURVEY.md
+§3.5).  The default rescue path is **exact** complementary-tag matching, which
+is a host-side hash join (already optimal, stays on CPU — see
+``stages/singleton_correction.py``).  This module supplies the optional
+**Hamming-tolerant** barcode matcher described by BASELINE.json's north star:
+an all-pairs mismatch count between query barcodes (uncorrected singletons)
+and candidate barcodes (mirrored SSCS/singleton partners at the same
+coordinates), tiled on device.
+
+Design note (TPU-first): barcodes are tiny (8–24 nt), so one (n, m) tile of
+pairwise compares is an elementwise broadcast + reduction over the barcode
+axis — VPU work that XLA fuses into a single kernel; tiling bounds memory at
+``tile_n * tile_m * L`` bytes.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@lru_cache(maxsize=None)
+def _compiled_tile():
+    def fn(a, b):  # a: (n, L) uint8, b: (m, L) uint8
+        return (a[:, None, :] != b[None, :, :]).sum(axis=-1, dtype=jnp.int32)
+
+    return jax.jit(fn)
+
+
+def pairwise_hamming(a: np.ndarray, b: np.ndarray, tile: int = 2048) -> np.ndarray:
+    """All-pairs Hamming distance between two barcode code matrices.
+
+    Args:
+      a: ``(n, L)`` uint8 barcode codes.
+      b: ``(m, L)`` uint8 barcode codes (same L).
+      tile: max rows per device dispatch on each side.
+
+    Returns ``(n, m)`` int32 distance matrix on host.
+    """
+    a = np.asarray(a, dtype=np.uint8)
+    b = np.asarray(b, dtype=np.uint8)
+    if a.ndim != 2 or b.ndim != 2 or a.shape[1] != b.shape[1]:
+        raise ValueError(f"barcode matrices must be (n, L)/(m, L), got {a.shape}/{b.shape}")
+    fn = _compiled_tile()
+    out = np.empty((a.shape[0], b.shape[0]), dtype=np.int32)
+    for i in range(0, a.shape[0], tile):
+        for j in range(0, b.shape[0], tile):
+            out[i : i + tile, j : j + tile] = np.asarray(
+                fn(jnp.asarray(a[i : i + tile]), jnp.asarray(b[j : j + tile]))
+            )
+    return out
+
+
+def best_matches(a: np.ndarray, b: np.ndarray, max_mismatch: int, tile: int = 2048):
+    """For each row of ``a``: index of the unique best row of ``b`` within
+    ``max_mismatch``, or -1 (no candidate / ambiguous tie for best).
+
+    Ambiguity (two candidates at the same best distance) returns -1 rather
+    than guessing — a rescue must be unambiguous to be trusted.
+    """
+    if b.shape[0] == 0:
+        return np.full(a.shape[0], -1, dtype=np.int64)
+    dist = pairwise_hamming(a, b, tile=tile)
+    best = dist.argmin(axis=1)
+    best_d = dist[np.arange(dist.shape[0]), best]
+    ties = (dist == best_d[:, None]).sum(axis=1) > 1
+    ok = (best_d <= max_mismatch) & ~ties
+    return np.where(ok, best, -1)

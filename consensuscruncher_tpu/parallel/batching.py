@@ -1,0 +1,141 @@
+"""Bucketed padding: ragged UMI families -> static-shape device batches.
+
+This is the raggedness answer demanded by SURVEY.md §7 ("hard parts" #1):
+family sizes vary 1→50+ and read lengths vary, but XLA wants static shapes.
+Policy (bounds recompiles to |fam_buckets| x |len_buckets| x |batch_buckets|):
+
+- **Family axis**: capacity = next power of two ≥ family size; padded member
+  slots are masked inside the kernel via ``fam_size`` (they never vote).
+- **Length axis**: capacity = next multiple of ``LEN_QUANTUM`` (32) ≥ the
+  family's consensus length; padded positions are sliced off after the kernel.
+- **Batch axis**: families sharing an (F, L) bucket are packed up to
+  ``max_batch``; the final partial batch is padded to a power of two with
+  ``fam_size=0`` dummy slots (kernel emits all-N, caller drops them).
+
+Rectangularization semantics (pinned; mixed-length families are rare but
+legal): the family's consensus length is its **modal member length** (ties →
+longer, matching Counter-of-lengths first-seen over a length-sorted list);
+shorter members are padded with (N, qual 0) — N-votes count against every
+real base, exactly like a low-quality-demoted base — and longer members are
+truncated.  The CPU oracle sees the same rectangular arrays, so backends stay
+bit-identical.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from dataclasses import dataclass
+from typing import Iterable, Iterator, Sequence
+
+import numpy as np
+
+from consensuscruncher_tpu.utils.phred import N, PAD
+
+LEN_QUANTUM = 32
+MIN_BATCH = 8
+
+
+def next_pow2(n: int) -> int:
+    return 1 << max(0, (n - 1).bit_length())
+
+
+def fam_bucket(fam_size: int) -> int:
+    return max(1, next_pow2(fam_size))
+
+
+def len_bucket(length: int) -> int:
+    return max(LEN_QUANTUM, ((length + LEN_QUANTUM - 1) // LEN_QUANTUM) * LEN_QUANTUM)
+
+
+def consensus_length(lengths: Sequence[int]) -> int:
+    """Modal member length; ties resolved toward the longer length."""
+    counts = Counter(sorted(lengths, reverse=True))
+    return counts.most_common(1)[0][0]
+
+
+def rectangularize(
+    seqs: Sequence[np.ndarray], quals: Sequence[np.ndarray]
+) -> tuple[np.ndarray, np.ndarray, int]:
+    """Stack ragged member reads into (F, L*) arrays (see module docstring).
+
+    Returns ``(bases, quals, consensus_length)``.
+    """
+    target = consensus_length([len(s) for s in seqs])
+    fam = len(seqs)
+    out_s = np.full((fam, target), N, dtype=np.uint8)
+    out_q = np.zeros((fam, target), dtype=np.uint8)
+    for j, (s, q) in enumerate(zip(seqs, quals)):
+        k = min(len(s), target)
+        out_s[j, :k] = s[:k]
+        out_q[j, :k] = q[:k]
+    return out_s, out_q, target
+
+
+@dataclass
+class FamilyBatch:
+    """One static-shape device batch; ``keys[i]`` owns row ``i`` (i < n_real)."""
+
+    keys: list
+    bases: np.ndarray  # (B, F, L) uint8, PAD in unused slots
+    quals: np.ndarray  # (B, F, L) uint8
+    fam_sizes: np.ndarray  # (B,) int32; 0 for dummy rows
+    lengths: np.ndarray  # (B,) int32 true consensus length per row
+
+    @property
+    def n_real(self) -> int:
+        return len(self.keys)
+
+
+class _Bucket:
+    __slots__ = ("keys", "bases", "quals", "fam_sizes", "lengths")
+
+    def __init__(self):
+        self.keys, self.bases, self.quals, self.fam_sizes, self.lengths = [], [], [], [], []
+
+
+def bucket_families(
+    families: Iterable[tuple[object, Sequence[np.ndarray], Sequence[np.ndarray]]],
+    max_batch: int = 1024,
+) -> Iterator[FamilyBatch]:
+    """Stream ``(key, member_seqs, member_quals)`` into padded batches.
+
+    Emits a batch whenever a bucket fills to ``max_batch``; flushes all
+    partial buckets (padded up to a power-of-two batch, min ``MIN_BATCH``)
+    at the end of the stream.
+    """
+    buckets: dict[tuple[int, int], _Bucket] = {}
+    for key, seqs, quals in families:
+        if len(seqs) == 0:
+            raise ValueError(f"empty family for key {key!r}")
+        rect_s, rect_q, true_len = rectangularize(seqs, quals)
+        fb, lb = fam_bucket(rect_s.shape[0]), len_bucket(true_len)
+        padded_s = np.full((fb, lb), PAD, dtype=np.uint8)
+        padded_q = np.zeros((fb, lb), dtype=np.uint8)
+        padded_s[: rect_s.shape[0], :true_len] = rect_s
+        padded_q[: rect_q.shape[0], :true_len] = rect_q
+        bucket = buckets.setdefault((fb, lb), _Bucket())
+        bucket.keys.append(key)
+        bucket.bases.append(padded_s)
+        bucket.quals.append(padded_q)
+        bucket.fam_sizes.append(rect_s.shape[0])
+        bucket.lengths.append(true_len)
+        if len(bucket.keys) >= max_batch:
+            yield _emit(buckets.pop((fb, lb)), fb, lb, pad_to=max_batch)
+    for (fb, lb), bucket in sorted(buckets.items()):
+        yield _emit(bucket, fb, lb, pad_to=None)
+
+
+def _emit(bucket: _Bucket, fb: int, lb: int, pad_to: int | None) -> FamilyBatch:
+    n = len(bucket.keys)
+    cap = pad_to if pad_to is not None else max(MIN_BATCH, next_pow2(n))
+    bases = np.full((cap, fb, lb), PAD, dtype=np.uint8)
+    quals = np.zeros((cap, fb, lb), dtype=np.uint8)
+    bases[:n] = np.stack(bucket.bases)
+    quals[:n] = np.stack(bucket.quals)
+    fam_sizes = np.zeros(cap, dtype=np.int32)
+    fam_sizes[:n] = bucket.fam_sizes
+    lengths = np.zeros(cap, dtype=np.int32)
+    lengths[:n] = bucket.lengths
+    return FamilyBatch(
+        keys=list(bucket.keys), bases=bases, quals=quals, fam_sizes=fam_sizes, lengths=lengths
+    )

@@ -1,0 +1,57 @@
+import numpy as np
+import pytest
+
+from consensuscruncher_tpu.parallel import batching
+from consensuscruncher_tpu.utils.phred import PAD
+
+
+def test_bucket_sizes():
+    assert [batching.fam_bucket(n) for n in (1, 2, 3, 5, 8, 9, 50)] == [1, 2, 4, 8, 8, 16, 64]
+    assert batching.len_bucket(1) == 32
+    assert batching.len_bucket(32) == 32
+    assert batching.len_bucket(33) == 64
+    assert batching.len_bucket(151) == 160
+
+
+def test_consensus_length_modal_ties_longer():
+    assert batching.consensus_length([10, 10, 7]) == 10
+    assert batching.consensus_length([7, 10]) == 10  # tie -> longer
+    assert batching.consensus_length([5]) == 5
+
+
+def mk_fam(key, fam, length, seed=0):
+    rng = np.random.default_rng(seed)
+    seqs = [rng.integers(0, 4, size=length).astype(np.uint8) for _ in range(fam)]
+    quals = [np.full(length, 30, dtype=np.uint8) for _ in range(fam)]
+    return key, seqs, quals
+
+
+def test_batches_grouped_by_bucket_and_padded():
+    fams = [mk_fam(f"a{i}", 3, 100, i) for i in range(5)] + [mk_fam(f"b{i}", 17, 151, i) for i in range(3)]
+    batches = list(batching.bucket_families(iter(fams), max_batch=1024))
+    shapes = {b.bases.shape for b in batches}
+    assert shapes == {(8, 4, 128), (8, 32, 160)}
+    for b in batches:
+        assert b.fam_sizes[b.n_real :].sum() == 0
+        assert (b.bases[b.n_real :] == PAD).all()
+
+
+def test_max_batch_triggers_emission():
+    fams = [mk_fam(f"k{i}", 2, 50, i) for i in range(10)]
+    batches = list(batching.bucket_families(iter(fams), max_batch=4))
+    assert [b.n_real for b in batches] == [4, 4, 2]
+    assert batches[0].bases.shape[0] == 4  # full batches not padded beyond max
+    assert batches[2].bases.shape[0] == 8  # final partial padded to MIN_BATCH
+    assert [k for b in batches for k in b.keys] == [f"k{i}" for i in range(10)]
+
+
+def test_empty_family_rejected():
+    with pytest.raises(ValueError, match="empty family"):
+        list(batching.bucket_families([("k", [], [])]))
+
+
+def test_deterministic_flush_order():
+    fams = [mk_fam("z", 2, 100), mk_fam("a", 9, 100), mk_fam("m", 2, 40)]
+    b1 = [b.keys for b in batching.bucket_families(iter(fams))]
+    b2 = [b.keys for b in batching.bucket_families(iter(fams))]
+    assert b1 == b2  # flush order sorted by bucket -> reproducible output order

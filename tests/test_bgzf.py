@@ -73,6 +73,19 @@ def test_bc_subfield_found_among_other_subfields():
     assert list(bgzf.iter_blocks(io.BytesIO(block))) == [payload]
 
 
+def test_incompressible_max_payload_fits_bsize():
+    import os as _os
+
+    blob = _os.urandom(bgzf.MAX_BLOCK_PAYLOAD)  # worst case for deflate
+    block = bgzf.compress_block(blob)
+    assert list(bgzf.iter_blocks(io.BytesIO(block))) == [blob]
+
+
+def test_oversized_payload_rejected_cleanly():
+    with pytest.raises(ValueError, match="payload too large"):
+        bgzf.compress_block(b"x" * (bgzf.MAX_BLOCK_PAYLOAD + 1))
+
+
 def test_corrupt_crc_detected():
     block = bytearray(bgzf.compress_block(b"payload"))
     block[-6] ^= 0xFF  # flip a CRC byte

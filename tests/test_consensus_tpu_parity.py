@@ -1,0 +1,150 @@
+"""CPU-oracle ≡ TPU-kernel bit-parity — the golden tests (SURVEY.md §4.1)."""
+
+import numpy as np
+import pytest
+
+from consensuscruncher_tpu.core import consensus_cpu as cc
+from consensuscruncher_tpu.core.duplex_cpu import duplex_consensus
+from consensuscruncher_tpu.ops.consensus_tpu import (
+    ConsensusConfig,
+    consensus_batch_host,
+    consensus_families,
+)
+from consensuscruncher_tpu.ops.duplex_tpu import duplex_batch_host
+from consensuscruncher_tpu.utils.phred import N, PAD
+
+
+def random_family(rng, fam, length):
+    s = rng.integers(0, 5, size=(fam, length)).astype(np.uint8)
+    q = rng.integers(0, 42, size=(fam, length)).astype(np.uint8)
+    return s, q
+
+
+def pad_batch(families, fam_cap, len_cap):
+    B = len(families)
+    bases = np.full((B, fam_cap, len_cap), PAD, dtype=np.uint8)
+    quals = np.zeros((B, fam_cap, len_cap), dtype=np.uint8)
+    sizes = np.zeros(B, dtype=np.int32)
+    for i, (s, q) in enumerate(families):
+        bases[i, : s.shape[0], : s.shape[1]] = s
+        quals[i, : q.shape[0], : q.shape[1]] = q
+        sizes[i] = s.shape[0]
+    return bases, quals, sizes
+
+
+@pytest.mark.parametrize("cutoff", [0.5, 0.7, 0.75, 1.0])
+@pytest.mark.parametrize("qual_threshold", [0, 13, 30])
+def test_kernel_matches_oracle_random(cutoff, qual_threshold):
+    rng = np.random.default_rng(hash((cutoff, qual_threshold)) % 2**32)
+    fams = [random_family(rng, int(rng.integers(1, 9)), 17) for _ in range(32)]
+    bases, quals, sizes = pad_batch(fams, fam_cap=8, len_cap=17)
+    cfg = ConsensusConfig(cutoff=cutoff, qual_threshold=qual_threshold)
+    got_b, got_q = consensus_batch_host(bases, quals, sizes, cfg)
+    for i, (s, q) in enumerate(fams):
+        exp_b, exp_q = cc.consensus_maker(s, q, cutoff=cutoff, qual_threshold=qual_threshold)
+        np.testing.assert_array_equal(got_b[i, : s.shape[1]], exp_b, err_msg=f"family {i} bases")
+        np.testing.assert_array_equal(got_q[i, : s.shape[1]], exp_q, err_msg=f"family {i} quals")
+
+
+def test_kernel_tie_break_matches_counter_order():
+    # adversarial: every position is a 2-2 tie with different insertion orders
+    fams = [
+        (np.array([[0, 1], [1, 0], [0, 1], [1, 0]], dtype=np.uint8),
+         np.full((4, 2), 30, dtype=np.uint8)),
+        (np.array([[3, 2], [3, 2], [2, 3], [2, 3]], dtype=np.uint8),
+         np.full((4, 2), 30, dtype=np.uint8)),
+    ]
+    bases, quals, sizes = pad_batch(fams, fam_cap=4, len_cap=2)
+    cfg = ConsensusConfig(cutoff=0.5)
+    got_b, _ = consensus_batch_host(bases, quals, sizes, cfg)
+    for i, (s, q) in enumerate(fams):
+        exp_b, _ = cc.consensus_maker(s, q, cutoff=0.5)
+        np.testing.assert_array_equal(got_b[i], exp_b)
+
+
+def test_dummy_slots_emit_all_N():
+    bases = np.full((4, 2, 8), PAD, dtype=np.uint8)
+    quals = np.zeros((4, 2, 8), dtype=np.uint8)
+    sizes = np.zeros(4, dtype=np.int32)
+    got_b, got_q = consensus_batch_host(bases, quals, sizes)
+    assert (got_b == N).all() and (got_q == 0).all()
+
+
+def test_padded_members_never_vote():
+    # One real member (A everywhere, qual 30) + 7 padding slots: the single
+    # read is 1/1 = 100% ≥ cutoff, so consensus is all-A — padding must not
+    # dilute the denominator or vote for anything.
+    bases = np.full((1, 8, 16), PAD, dtype=np.uint8)
+    quals = np.zeros((1, 8, 16), dtype=np.uint8)
+    bases[0, 0] = 0
+    quals[0, 0] = 30
+    got_b, got_q = consensus_batch_host(bases, quals, np.array([1], dtype=np.int32))
+    assert (got_b[0] == 0).all()
+    assert (got_q[0] == 30).all()
+
+
+def test_consensus_families_streaming_end_to_end():
+    rng = np.random.default_rng(42)
+    fams = {}
+    for k in range(100):
+        fam = int(rng.integers(1, 20))
+        length = int(rng.choice([100, 150, 151]))
+        s = rng.integers(0, 4, size=(fam, length)).astype(np.uint8)
+        q = rng.integers(10, 41, size=(fam, length)).astype(np.uint8)
+        fams[f"fam{k}"] = (s, q)
+
+    def gen():
+        for key, (s, q) in fams.items():
+            yield key, list(s), list(q)
+
+    cfg = ConsensusConfig()
+    got = {key: (b, q) for key, b, q in consensus_families(gen(), cfg, max_batch=16)}
+    assert set(got) == set(fams)
+    for key, (s, q) in fams.items():
+        exp_b, exp_q = cc.consensus_maker(s, q)
+        np.testing.assert_array_equal(got[key][0], exp_b, err_msg=key)
+        np.testing.assert_array_equal(got[key][1], exp_q, err_msg=key)
+
+
+def test_mixed_length_family_rectangularized_consistently():
+    # 3 reads of length 10, one of length 7, one of 12: consensus length 10;
+    # short read pads with N (votes against), long read truncates.
+    rng = np.random.default_rng(3)
+    seqs = [rng.integers(0, 4, size=L).astype(np.uint8) for L in (10, 10, 10, 7, 12)]
+    quals = [np.full(len(s), 30, dtype=np.uint8) for s in seqs]
+
+    from consensuscruncher_tpu.parallel.batching import rectangularize
+
+    rect_s, rect_q, L = rectangularize(seqs, quals)
+    assert L == 10 and rect_s.shape == (5, 10)
+    assert (rect_s[3, 7:] == N).all() and (rect_q[3, 7:] == 0).all()
+
+    got = list(consensus_families([("k", seqs, quals)]))
+    exp_b, exp_q = cc.consensus_maker(rect_s, rect_q)
+    np.testing.assert_array_equal(got[0][1], exp_b)
+    np.testing.assert_array_equal(got[0][2], exp_q)
+
+
+def test_duplex_kernel_matches_oracle():
+    rng = np.random.default_rng(9)
+    B, L = 64, 151
+    s1 = rng.integers(0, 5, size=(B, L)).astype(np.uint8)
+    s2 = np.where(rng.random((B, L)) < 0.7, s1, rng.integers(0, 5, (B, L))).astype(np.uint8)
+    q1 = rng.integers(0, 61, size=(B, L)).astype(np.uint8)
+    q2 = rng.integers(0, 61, size=(B, L)).astype(np.uint8)
+    got_b, got_q = duplex_batch_host(s1, q1, s2, q2)
+    for i in range(B):
+        exp_b, exp_q = duplex_consensus(s1[i], q1[i], s2[i], q2[i])
+        np.testing.assert_array_equal(got_b[i], exp_b)
+        np.testing.assert_array_equal(got_q[i], exp_q)
+
+
+def test_large_family_stress_bucket():
+    # BASELINE.json config 4: ultra-deep families (size >= 50)
+    rng = np.random.default_rng(11)
+    s, q = random_family(rng, 64, 151)
+    bases, quals, sizes = pad_batch([(s, q)], fam_cap=64, len_cap=151)
+    got_b, got_q = consensus_batch_host(bases, quals, sizes)
+    exp_b, exp_q = cc.consensus_maker_numpy(s, q)
+    np.testing.assert_array_equal(got_b[0], exp_b)
+    np.testing.assert_array_equal(got_q[0], exp_q)

@@ -1,0 +1,49 @@
+import numpy as np
+import pytest
+
+from consensuscruncher_tpu.ops.singleton_tpu import best_matches, pairwise_hamming
+from consensuscruncher_tpu.utils.phred import encode_seq
+
+
+def codes(*barcodes):
+    return np.stack([encode_seq(b) for b in barcodes])
+
+
+def test_pairwise_hamming_basic():
+    a = codes("AAAA", "ACGT")
+    b = codes("AAAA", "AAAT", "TTTT")
+    d = pairwise_hamming(a, b)
+    assert d.tolist() == [[0, 1, 4], [3, 2, 3]]
+
+
+def test_pairwise_hamming_tiled_matches_untiled():
+    rng = np.random.default_rng(0)
+    a = rng.integers(0, 4, size=(100, 12)).astype(np.uint8)
+    b = rng.integers(0, 4, size=(77, 12)).astype(np.uint8)
+    np.testing.assert_array_equal(pairwise_hamming(a, b), pairwise_hamming(a, b, tile=16))
+
+
+def test_best_matches_unique_within_threshold():
+    a = codes("AAAA", "CCCC", "GGGG")
+    b = codes("AAAT", "CCCC", "CCCA")
+    m = best_matches(a, b, max_mismatch=1)
+    assert m[0] == 0   # AAAA->AAAT at distance 1
+    assert m[1] == 1   # exact
+    assert m[2] == -1  # GGGG: nothing within 1
+
+
+def test_best_matches_ambiguity_refused():
+    a = codes("AAAA")
+    b = codes("AAAT", "AAAC")  # both at distance 1 — ambiguous
+    assert best_matches(a, b, max_mismatch=1).tolist() == [-1]
+
+
+def test_best_matches_empty_candidates():
+    a = codes("AAAA")
+    b = np.zeros((0, 4), dtype=np.uint8)
+    assert best_matches(a, b, max_mismatch=1).tolist() == [-1]
+
+
+def test_shape_mismatch_rejected():
+    with pytest.raises(ValueError, match="barcode matrices"):
+        pairwise_hamming(np.zeros((2, 4), np.uint8), np.zeros((2, 5), np.uint8))
