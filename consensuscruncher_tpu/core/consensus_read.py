@@ -1,0 +1,88 @@
+"""Build output BAM records from consensus results.
+
+Reference parity: ``ConsensusCruncher/consensus_helper.py:create_aligned_segment``
+(SURVEY.md §2 — builds the output ``pysam.AlignedSegment`` from a template
+read).  Pinned semantics (mount empty):
+
+- **template** = first read of the family in stream order (deterministic:
+  grouping emits reads in coordinate order).
+- **flag** keeps only the pairing/strand/readnumber bits (paired, proper,
+  reverse, mate-reverse, read1, read2); consensus reads are never secondary/
+  supplementary/dup/qcfail by construction.
+- **cigar** = modal cigar string over the family (ties → first seen in family
+  order), matching the Counter semantics used everywhere else.
+- **mapq** = max over the family (best evidence for the mapping).
+- coordinates/tlen from the template; qname supplied by the caller
+  (``sscs_qname``/``dcs_qname``).
+
+Framework-native BAM tags on every consensus read (self-contained lineage —
+the TPU-era replacement for re-deriving tags from qnames):
+
+- ``XT:Z`` the family tag string (lets DCS/singleton stages mirror without
+  re-parsing qnames),
+- ``XF:i`` the family size (evidence depth).
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+
+import numpy as np
+
+from consensuscruncher_tpu.core.tags import FamilyTag
+from consensuscruncher_tpu.io.bam import (
+    BamRead,
+    FMREVERSE,
+    FPAIRED,
+    FPROPER,
+    FREAD1,
+    FREAD2,
+    FREVERSE,
+    cigar_from_string,
+)
+from consensuscruncher_tpu.utils.phred import decode_seq
+
+_KEEP_FLAGS = FPAIRED | FPROPER | FREVERSE | FMREVERSE | FREAD1 | FREAD2
+
+
+def modal_cigar(members: list[BamRead], seq_length: int) -> list[tuple[str, int]]:
+    """Modal cigar among members whose read length matches the consensus
+    length (ties → first seen).  Restricting to length-matched members keeps
+    the cigar's query span consistent with the consensus seq — a cigar from a
+    shorter/longer member would make a malformed record."""
+    candidates = [m for m in members if len(m.seq) == seq_length]
+    if not candidates:  # all members truncated (target longer than every read)
+        return [("M", seq_length)]
+    counts = Counter(m.cigar_string() for m in candidates)
+    return cigar_from_string(counts.most_common(1)[0][0])
+
+
+def build_consensus_read(
+    tag: FamilyTag,
+    members: list[BamRead],
+    codes: np.ndarray,
+    quals: np.ndarray,
+    qname: str,
+    extra_tags: dict | None = None,
+) -> BamRead:
+    template = members[0]
+    bam_tags = {
+        "XT": ("Z", str(tag)),
+        "XF": ("i", len(members)),
+    }
+    if extra_tags:
+        bam_tags.update(extra_tags)
+    return BamRead(
+        qname=qname,
+        flag=template.flag & _KEEP_FLAGS,
+        ref=template.ref,
+        pos=template.pos,
+        mapq=max(m.mapq for m in members),
+        cigar=modal_cigar(members, len(codes)),
+        mate_ref=template.mate_ref,
+        mate_pos=template.mate_pos,
+        tlen=template.tlen,
+        seq=decode_seq(codes),
+        qual=np.asarray(quals, dtype=np.uint8),
+        tags=bam_tags,
+    )

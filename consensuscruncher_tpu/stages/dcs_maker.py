@@ -1,0 +1,193 @@
+"""DCS stage: pair complementary-strand SSCSes into duplex consensus reads.
+
+Reference parity: ``ConsensusCruncher/DCS_maker.py`` (SURVEY.md §3.2).
+Outputs:
+
+- ``<p>.dcs.sorted.bam``             duplex consensus reads (one per strand
+  pair per mate — both R1-side and R2-side DCS, pairable by qname)
+- ``<p>.sscs.singleton.sorted.bam``  SSCSes with no complementary partner
+- ``<p>.dcs_stats.txt|.json``
+
+Pairing model (see core/tags.py): an SSCS's family tag is re-derived from the
+read itself plus its ``XT`` barcode tag; the partner is ``duplex_tag(tag)``
+(mirrored barcode halves, flipped read number) and is anchored at the SAME
+``(ref, pos)`` — so pairing streams through one position window at a time
+(O(window) memory, no whole-BAM dicts, no index).
+
+Pinned semantics: a pair produces ONE duplex read, emitted under the qname
+``dcs_qname(tag)`` with the template taken from the strand whose barcode is
+the canonical (lexicographically smaller) arrangement; both members must have
+equal length (unequal-length partners are left unpaired — a deliberate,
+documented tightening; the mount was empty).  The duplex vote is the pinned
+agree-or-N formula of ``core.duplex_cpu``/``ops.duplex_tpu``.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Iterator
+
+import numpy as np
+
+from consensuscruncher_tpu.core import tags as tags_mod
+from consensuscruncher_tpu.core.consensus_read import build_consensus_read
+from consensuscruncher_tpu.core.duplex_cpu import duplex_consensus
+from consensuscruncher_tpu.io.bam import BamHeader, BamReader, BamRead, BamWriter, sort_bam
+from consensuscruncher_tpu.ops.duplex_tpu import duplex_batch_host
+from consensuscruncher_tpu.utils.phred import encode_seq
+from consensuscruncher_tpu.utils.stats import StageStats
+
+
+@dataclass
+class DcsResult:
+    dcs_bam: str
+    sscs_singleton_bam: str
+    stats: StageStats
+
+
+def derive_tag(read: BamRead) -> tags_mod.FamilyTag:
+    """Reconstruct the family tag of a consensus read (coords/flags + XT)."""
+    if "XT" not in read.tags:
+        raise ValueError(f"consensus read {read.qname} lacks the XT barcode tag")
+    return tags_mod.unique_tag(read, read.tags["XT"][1])
+
+
+def position_windows(reader: BamReader) -> Iterator[dict[tags_mod.FamilyTag, BamRead]]:
+    """Group a sorted consensus BAM into per-(ref,pos) tag->read windows."""
+    window: dict[tags_mod.FamilyTag, BamRead] = {}
+    cur = None
+    for read in reader:
+        tag = derive_tag(read)
+        key = (reader.header.ref_id(read.ref), read.pos)
+        if cur is not None and key != cur:
+            yield window
+            window = {}
+        cur = key
+        window[tag] = read
+    if window:
+        yield window
+
+
+class _DuplexBatcher:
+    """Accumulate strand pairs per read length; flush through the device
+    kernel in batches (keeps device dispatches large and few)."""
+
+    def __init__(self, qual_cap: int, flush_at: int = 2048, backend: str = "tpu"):
+        self.qual_cap = qual_cap
+        self.flush_at = flush_at
+        self.backend = backend
+        self._by_len: dict[int, list] = {}
+
+    def add(self, canon_tag, canon_read, other_read, sink) -> None:
+        L = len(canon_read.seq)
+        self._by_len.setdefault(L, []).append((canon_tag, canon_read, other_read, sink))
+        if len(self._by_len[L]) >= self.flush_at:
+            self._flush_len(L)
+
+    def _flush_len(self, L: int) -> None:
+        entries = self._by_len.pop(L, [])
+        if not entries:
+            return
+        s1 = np.stack([encode_seq(e[1].seq) for e in entries])
+        s2 = np.stack([encode_seq(e[2].seq) for e in entries])
+        q1 = np.stack([e[1].qual for e in entries])
+        q2 = np.stack([e[2].qual for e in entries])
+        if self.backend == "tpu":
+            out_b, out_q = duplex_batch_host(s1, q1, s2, q2, self.qual_cap)
+        else:
+            out_b = np.empty_like(s1)
+            out_q = np.empty_like(q1)
+            for i in range(s1.shape[0]):
+                out_b[i], out_q[i] = duplex_consensus(s1[i], q1[i], s2[i], q2[i], self.qual_cap)
+        for i, (tag, canon, other, entry_sink) in enumerate(entries):
+            entry_sink(tag, canon, other, out_b[i], out_q[i])
+
+    def flush(self) -> None:
+        for L in sorted(self._by_len):
+            self._flush_len(L)
+
+
+def run_dcs(
+    sscs_bam: str,
+    out_prefix: str,
+    qual_cap: int = 60,
+    backend: str = "tpu",
+) -> DcsResult:
+    stats = StageStats("DCS")
+    dcs_path = f"{out_prefix}.dcs.sorted.bam"
+    unpaired_path = f"{out_prefix}.sscs.singleton.sorted.bam"
+    dcs_tmp = f"{out_prefix}.dcs.unsorted.bam"
+    unpaired_tmp = f"{out_prefix}.sscs.singleton.unsorted.bam"
+
+    reader = BamReader(sscs_bam)
+    dcs_writer = BamWriter(dcs_tmp, reader.header)
+    unpaired_writer = BamWriter(unpaired_tmp, reader.header)
+
+    def sink(tag, canon, other, codes, quals):
+        fam_size = canon.tags.get("XF", ("i", 1))[1] + other.tags.get("XF", ("i", 1))[1]
+        read = build_consensus_read(
+            tag, [canon], codes, quals, qname=tags_mod.dcs_qname(tag),
+            extra_tags={"XT": ("Z", tag.barcode), "XF": ("i", fam_size)},
+        )
+        dcs_writer.write(read)
+        stats.incr("dcs_written")
+
+    batcher = _DuplexBatcher(qual_cap, backend=backend)
+    try:
+        for window in position_windows(reader):
+            paired: set = set()
+            for tag in sorted(window, key=str):
+                if tag in paired:
+                    continue
+                stats.incr("sscs_total")
+                partner = tags_mod.duplex_tag(tag)
+                other = window.get(partner)
+                if other is None or partner in paired:
+                    stats.incr("sscs_unpaired")
+                    unpaired_writer.write(window[tag])
+                    continue
+                stats.incr("sscs_total")  # partner consumed here
+                paired.add(tag)
+                paired.add(partner)
+                read, oread = window[tag], other
+                if len(read.seq) != len(oread.seq):
+                    stats.incr("sscs_unpaired", 2)
+                    stats.incr("length_mismatch_pairs")
+                    unpaired_writer.write(read)
+                    unpaired_writer.write(oread)
+                    continue
+                # canonical strand: barcode lexicographically <= its mirror
+                if tag.barcode <= partner.barcode:
+                    batcher.add(tag, read, oread, sink)
+                else:
+                    batcher.add(partner, oread, read, sink)
+                stats.incr("pairs")
+        batcher.flush()
+    finally:
+        reader.close()
+        dcs_writer.close()
+        unpaired_writer.close()
+
+    sort_bam(dcs_tmp, dcs_path)
+    sort_bam(unpaired_tmp, unpaired_path)
+    os.unlink(dcs_tmp)
+    os.unlink(unpaired_tmp)
+    stats.set("backend", backend)
+    stats.write(f"{out_prefix}.dcs_stats.txt")
+    return DcsResult(dcs_path, unpaired_path, stats)
+
+
+def main(argv=None):
+    import argparse
+
+    p = argparse.ArgumentParser(description="Make duplex consensus sequences")
+    p.add_argument("--infile", required=True, help="sorted SSCS BAM")
+    p.add_argument("--outfile", required=True, help="output prefix")
+    p.add_argument("--backend", choices=("cpu", "tpu"), default="tpu")
+    args = p.parse_args(argv)
+    run_dcs(args.infile, args.outfile, backend=args.backend)
+
+
+if __name__ == "__main__":
+    main()

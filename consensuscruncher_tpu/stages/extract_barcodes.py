@@ -1,0 +1,163 @@
+"""Barcode extraction: move inline UMIs from read sequence into the qname.
+
+Reference parity: ``ConsensusCruncher/extract_barcodes.py`` (SURVEY.md §2).
+Supported modes, mirroring the reference surface:
+
+- ``--bpattern`` e.g. ``NNT``: applied to the 5' end of BOTH mates; ``N``
+  positions are UMI bases (extracted), any other letter is a spacer position
+  (trimmed, not validated — the reference trims without checking).  The whole
+  pattern length is removed from seq+qual.
+- ``--blist``: whitelist file (one barcode per line).  With a pattern, each
+  mate's extracted UMI must be in the list; without a pattern, the UMI length
+  is taken from the list entries (which must share one length).
+- Reads whose UMI fails the whitelist go to ``<p>_r1_bad.fastq.gz`` /
+  ``<p>_r2_bad.fastq.gz`` with original sequence intact.
+
+Output qname (pinned format): ``<original first token><bdelim><UMI1>.<UMI2>``
+— both mates get the identical pair so downstream grouping sees one barcode.
+Emits ``<p>_r1.fastq.gz`` / ``<p>_r2.fastq.gz``, a barcode-distribution file
+``<p>.barcode_distribution.txt`` (barcode<TAB>count) and stats.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from dataclasses import dataclass
+
+from consensuscruncher_tpu.core.tags import BARCODE_SEP, DEFAULT_BDELIM
+from consensuscruncher_tpu.io.fastq import FastqWriter, read_fastq
+from consensuscruncher_tpu.utils.stats import StageStats
+
+
+@dataclass(frozen=True)
+class BarcodePattern:
+    """Compiled ``--bpattern``: which prefix positions are UMI vs spacer."""
+
+    pattern: str
+
+    def __post_init__(self):
+        if not self.pattern or not self.pattern.isalpha():
+            raise ValueError(f"invalid barcode pattern {self.pattern!r}")
+
+    @property
+    def length(self) -> int:
+        return len(self.pattern)
+
+    @property
+    def umi_positions(self) -> tuple[int, ...]:
+        return tuple(i for i, c in enumerate(self.pattern) if c.upper() == "N")
+
+    def extract(self, seq: str) -> str:
+        return "".join(seq[i] for i in self.umi_positions)
+
+
+def load_blist(path) -> set[str]:
+    barcodes = set()
+    with open(path) as fh:
+        for line in fh:
+            bc = line.strip().upper()
+            if bc:
+                barcodes.add(bc)
+    if not barcodes:
+        raise ValueError(f"empty barcode list: {path}")
+    lengths = {len(b) for b in barcodes}
+    if len(lengths) != 1:
+        raise ValueError(f"barcode list {path} mixes lengths {sorted(lengths)}")
+    return barcodes
+
+
+@dataclass
+class ExtractResult:
+    r1_out: str
+    r2_out: str
+    stats: StageStats
+
+
+def run_extract(
+    read1: str,
+    read2: str,
+    out_prefix: str,
+    bpattern: str | None = None,
+    blist: str | None = None,
+    bdelim: str = DEFAULT_BDELIM,
+) -> ExtractResult:
+    if bpattern is None and blist is None:
+        raise ValueError("need --bpattern and/or --blist to locate UMIs")
+    whitelist = load_blist(blist) if blist else None
+    if bpattern is None:
+        umi_len = len(next(iter(whitelist)))
+        pattern = BarcodePattern("N" * umi_len)
+    else:
+        pattern = BarcodePattern(bpattern)
+        if whitelist is not None:
+            wl_len = len(next(iter(whitelist)))
+            if wl_len != len(pattern.umi_positions):
+                raise ValueError(
+                    f"--bpattern extracts {len(pattern.umi_positions)}-base UMIs but "
+                    f"--blist contains {wl_len}-base barcodes — every read would be rejected"
+                )
+
+    stats = StageStats("extract_barcodes")
+    distribution: Counter = Counter()
+    paths = {
+        "r1": f"{out_prefix}_r1.fastq.gz",
+        "r2": f"{out_prefix}_r2.fastq.gz",
+        "r1_bad": f"{out_prefix}_r1_bad.fastq.gz",
+        "r2_bad": f"{out_prefix}_r2_bad.fastq.gz",
+    }
+    writers = {k: FastqWriter(p) for k, p in paths.items()}
+    try:
+        for (n1, s1, q1), (n2, s2, q2) in zip(
+            read_fastq(read1), read_fastq(read2), strict=True
+        ):
+            stats.incr("read_pairs")
+            tok1, tok2 = n1.split()[0], n2.split()[0]
+            if tok1 != tok2:
+                raise ValueError(f"R1/R2 qname mismatch: {tok1!r} vs {tok2!r}")
+            if len(s1) < pattern.length or len(s2) < pattern.length:
+                stats.incr("too_short")
+                writers["r1_bad"].write(n1, s1, q1)
+                writers["r2_bad"].write(n2, s2, q2)
+                continue
+            umi1 = pattern.extract(s1).upper()
+            umi2 = pattern.extract(s2).upper()
+            if whitelist is not None and (umi1 not in whitelist or umi2 not in whitelist):
+                stats.incr("bad_barcode")
+                writers["r1_bad"].write(n1, s1, q1)
+                writers["r2_bad"].write(n2, s2, q2)
+                continue
+            barcode = f"{umi1}{BARCODE_SEP}{umi2}"
+            distribution[barcode] += 1
+            stats.incr("extracted")
+            qname = f"{tok1}{bdelim}{barcode}"
+            writers["r1"].write(qname, s1[pattern.length :], q1[pattern.length :])
+            writers["r2"].write(qname, s2[pattern.length :], q2[pattern.length :])
+    finally:
+        for w in writers.values():
+            w.close()
+
+    with open(f"{out_prefix}.barcode_distribution.txt", "w") as fh:
+        fh.write("barcode\tcount\n")
+        for bc, count in sorted(distribution.items()):
+            fh.write(f"{bc}\t{count}\n")
+    stats.set("unique_barcodes", len(distribution))
+    stats.write(f"{out_prefix}.extract_stats.txt")
+    return ExtractResult(paths["r1"], paths["r2"], stats)
+
+
+def main(argv=None):
+    import argparse
+
+    p = argparse.ArgumentParser(description="Extract UMIs from FASTQ into read names")
+    p.add_argument("--read1", required=True)
+    p.add_argument("--read2", required=True)
+    p.add_argument("--outfile", required=True, help="output prefix")
+    p.add_argument("--bpattern", default=None, help="e.g. NNT (N=UMI base, else spacer)")
+    p.add_argument("--blist", default=None, help="barcode whitelist file")
+    p.add_argument("--bdelim", default=DEFAULT_BDELIM)
+    args = p.parse_args(argv)
+    run_extract(args.read1, args.read2, args.outfile, args.bpattern, args.blist, args.bdelim)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,72 @@
+"""Plot generation from stage stats files.
+
+Reference parity: ``ConsensusCruncher/generate_plots.py`` (SURVEY.md §2) —
+matplotlib PNGs of the family-size distribution and read-recovery summary,
+read back from the stats files on disk (not from memory, so plots can be
+regenerated standalone, exactly like the reference).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import matplotlib
+
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt  # noqa: E402
+
+from consensuscruncher_tpu.utils.stats import FamilySizeHistogram  # noqa: E402
+
+
+def plot_family_size(read_families_txt: str, out_png: str) -> None:
+    counts = FamilySizeHistogram.read(read_families_txt)
+    sizes = sorted(counts)
+    fig, ax = plt.subplots(figsize=(7, 4.5))
+    ax.bar(sizes, [counts[s] for s in sizes], color="#4477aa")
+    ax.set_xlabel("UMI family size")
+    ax.set_ylabel("families")
+    ax.set_yscale("log")
+    ax.set_title("UMI family-size distribution")
+    fig.tight_layout()
+    fig.savefig(out_png, dpi=120)
+    plt.close(fig)
+
+
+def plot_read_recovery(stats_jsons: list[str], out_png: str) -> None:
+    labels, values = [], []
+    for path in stats_jsons:
+        with open(path) as fh:
+            data = json.load(fh)
+        stage = data.pop("stage", os.path.basename(path))
+        for key in ("sscs_written", "singletons", "dcs_written", "rescued_by_sscs",
+                    "rescued_by_singleton", "remaining", "bad_reads"):
+            if key in data:
+                labels.append(f"{stage}:{key}")
+                values.append(data[key])
+    fig, ax = plt.subplots(figsize=(8, 4.5))
+    ax.barh(labels, values, color="#66ccee")
+    ax.set_xlabel("reads")
+    ax.set_title("read recovery by stage")
+    fig.tight_layout()
+    fig.savefig(out_png, dpi=120)
+    plt.close(fig)
+
+
+def main(argv=None):
+    import argparse
+
+    p = argparse.ArgumentParser(description="Generate stats plots")
+    p.add_argument("--families", help="read_families.txt path")
+    p.add_argument("--stats", nargs="*", default=[], help="stage *_stats.json paths")
+    p.add_argument("--outdir", required=True)
+    args = p.parse_args(argv)
+    os.makedirs(args.outdir, exist_ok=True)
+    if args.families:
+        plot_family_size(args.families, os.path.join(args.outdir, "family_size.png"))
+    if args.stats:
+        plot_read_recovery(args.stats, os.path.join(args.outdir, "read_recovery.png"))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,84 @@
+"""Streaming UMI-family grouping from a coordinate-sorted BAM.
+
+Reference parity: ``ConsensusCruncher/consensus_helper.py:read_bam`` (SURVEY.md
+§3.2), which fills whole-chromosome ``tag -> [reads]`` dicts.  Rebuilt as a
+**position-windowed stream**: every member of a family shares the read's own
+``(ref, pos)`` (that pair is part of the family key), so once the sorted
+stream advances past a position, all families anchored there are complete and
+can be flushed.  Memory is bounded by one position window instead of one
+chromosome, and no BAI index / per-region ``fetch`` is needed at all.
+
+Read filtering (pinned; reference routes these to a "badRead" BAM):
+unmapped, mate-unmapped, secondary, supplementary, QC-fail reads, and reads
+whose qname carries no barcode delimiter.  Duplicate-flagged reads are kept —
+UMI consensus is itself the deduplicator.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Iterator
+
+from consensuscruncher_tpu.core import tags as tags_mod
+from consensuscruncher_tpu.io.bam import BamHeader, BamRead
+
+
+class NotCoordinateSorted(ValueError):
+    pass
+
+
+def classify_bad(read: BamRead, bdelim: str) -> str | None:
+    """Reason string if the read must be routed to the badRead BAM, else None."""
+    if read.is_unmapped:
+        return "unmapped"
+    if not read.is_paired or read.mate_is_unmapped:
+        return "mate_unmapped"
+    if read.is_secondary:
+        return "secondary"
+    if read.is_supplementary:
+        return "supplementary"
+    if read.is_qcfail:
+        return "qcfail"
+    try:
+        tags_mod.barcode_from_qname(read.qname, bdelim)
+    except ValueError:
+        return "no_barcode"
+    return None
+
+
+def stream_families(
+    reads: Iterable[BamRead],
+    header: BamHeader,
+    bdelim: str = tags_mod.DEFAULT_BDELIM,
+) -> Iterator[tuple[str, object, object]]:
+    """Yield ``("bad", read, reason)`` and ``("family", tag, [reads])`` events.
+
+    Families are emitted as soon as the sorted stream passes their anchor
+    position (deterministic order: by position, then tag string).  Raises
+    :class:`NotCoordinateSorted` if the input violates coordinate order.
+    """
+    pending: dict[tags_mod.FamilyTag, list[BamRead]] = {}
+    cur: tuple[int, int] | None = None  # (ref_id, pos) high-water mark
+
+    def flush() -> Iterator[tuple[str, object, object]]:
+        for tag in sorted(pending, key=lambda t: (t.pos, str(t))):
+            yield "family", tag, pending[tag]
+        pending.clear()
+
+    for read in reads:
+        reason = classify_bad(read, bdelim)
+        if reason is not None:
+            yield "bad", read, reason
+            continue
+        key = (header.ref_id(read.ref), read.pos)
+        if cur is not None and key < cur:
+            raise NotCoordinateSorted(
+                f"input BAM is not coordinate-sorted: {read.qname} at {read.ref}:{read.pos} "
+                f"after ref_id={cur[0]} pos={cur[1]} — run sort first"
+            )
+        if cur is not None and key != cur:
+            yield from flush()
+        cur = key
+        barcode = tags_mod.barcode_from_qname(read.qname, bdelim)
+        tag = tags_mod.unique_tag(read, barcode)
+        pending.setdefault(tag, []).append(read)
+    yield from flush()

@@ -1,0 +1,210 @@
+"""Singleton correction: rescue size-1 families against the complementary strand.
+
+Reference parity: ``ConsensusCruncher/singleton_correction.py`` (SURVEY.md
+§3.5).  A singleton is rescued when a complementary-strand partner exists at
+the same genomic anchor — either an SSCS (singleton–SSCS rescue, stronger
+evidence) or another singleton (singleton–singleton rescue).  Outputs:
+
+- ``<p>.sscs.rescue.sorted.bam``       singletons corrected against an SSCS
+- ``<p>.singleton.rescue.sorted.bam``  singletons corrected against a singleton
+- ``<p>.remaining.singleton.sorted.bam``  uncorrected singletons
+- ``<p>.singleton_stats.txt|.json``
+
+Matching is **exact** complementary-tag matching by default — a host-side
+merge-join: both inputs are coordinate-sorted and a partner shares the
+singleton's own ``(ref, pos)`` anchor, so the join streams one position
+window at a time (no whole-BAM dicts).  SURVEY.md §2 notes BASELINE.json
+describes Hamming-tolerant rescue; that generalization is available via
+``max_mismatch > 0``, which routes barcode matching through the vectorized
+device matcher (``ops.singleton_tpu.best_matches``), refusing ambiguous ties.
+
+Correction formula (pinned): the rescued read's bases/quals are the duplex
+vote of singleton vs partner (``core.duplex_cpu.correct_singleton``) —
+agreement keeps the base with summed-capped quality, disagreement yields N.
+Partners of unequal read length are not rescued (documented tightening).
+In singleton–singleton rescue BOTH reads are corrected and written.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Iterator
+
+import numpy as np
+import os
+
+from consensuscruncher_tpu.core import tags as tags_mod
+from consensuscruncher_tpu.core.duplex_cpu import correct_singleton
+from consensuscruncher_tpu.io.bam import BamReader, BamRead, BamWriter, sort_bam
+from consensuscruncher_tpu.ops.singleton_tpu import best_matches
+from consensuscruncher_tpu.stages.dcs_maker import derive_tag
+from consensuscruncher_tpu.utils.phred import decode_seq, encode_seq
+from consensuscruncher_tpu.utils.stats import StageStats
+
+
+@dataclass
+class SingletonResult:
+    sscs_rescue_bam: str
+    singleton_rescue_bam: str
+    remaining_bam: str
+    stats: StageStats
+
+
+def _windows_by_pos(reader: BamReader) -> Iterator[tuple[tuple[int, int], dict]]:
+    window: dict = {}
+    cur = None
+    for read in reader:
+        tag = derive_tag(read)
+        key = (reader.header.ref_id(read.ref), read.pos)
+        if cur is not None and key != cur:
+            yield cur, window
+            window = {}
+        cur = key
+        window[tag] = read
+    if window:
+        yield cur, window
+
+
+def _merge_windows(a: Iterator, b: Iterator) -> Iterator[tuple[dict, dict]]:
+    """Lockstep position-join of two sorted window streams."""
+    wa = next(a, None)
+    wb = next(b, None)
+    while wa is not None or wb is not None:
+        if wb is None or (wa is not None and wa[0] < wb[0]):
+            yield wa[1], {}
+            wa = next(a, None)
+        elif wa is None or wb[0] < wa[0]:
+            yield {}, wb[1]
+            wb = next(b, None)
+        else:
+            yield wa[1], wb[1]
+            wa = next(a, None)
+            wb = next(b, None)
+
+
+def _corrected(read: BamRead, partner: BamRead) -> BamRead:
+    s, q = correct_singleton(
+        encode_seq(read.seq),
+        read.qual if read.qual.size else np.zeros(len(read.seq), dtype=np.uint8),
+        encode_seq(partner.seq),
+        partner.qual if partner.qual.size else np.zeros(len(partner.seq), dtype=np.uint8),
+    )
+    out = BamRead(
+        qname=read.qname, flag=read.flag, ref=read.ref, pos=read.pos, mapq=read.mapq,
+        cigar=read.cigar, mate_ref=read.mate_ref, mate_pos=read.mate_pos, tlen=read.tlen,
+        seq=decode_seq(s), qual=q, tags=dict(read.tags),
+    )
+    out.tags["XR"] = ("Z", "sscs" if "XF" in partner.tags and partner.tags["XF"][1] > 1 else "singleton")
+    return out
+
+
+def _hamming_partner(tag, candidates: dict, max_mismatch: int):
+    """Barcode-tolerant partner lookup among same-anchor candidates whose
+    non-barcode tag fields match the mirrored tag exactly."""
+    mirror = tags_mod.duplex_tag(tag)
+    pool = [
+        t for t in candidates
+        if (t.ref, t.pos, t.mate_ref, t.mate_pos, t.read_number, t.orientation)
+        == (mirror.ref, mirror.pos, mirror.mate_ref, mirror.mate_pos, mirror.read_number, mirror.orientation)
+        and len(t.barcode) == len(mirror.barcode)
+    ]
+    if not pool:
+        return None
+    a = encode_seq(mirror.barcode.replace(tags_mod.BARCODE_SEP, ""))[None, :]
+    b = np.stack([encode_seq(t.barcode.replace(tags_mod.BARCODE_SEP, "")) for t in pool])
+    idx = best_matches(a, b, max_mismatch=max_mismatch)[0]
+    return pool[idx] if idx >= 0 else None
+
+
+def run_singleton_correction(
+    singleton_bam: str,
+    sscs_bam: str,
+    out_prefix: str,
+    max_mismatch: int = 0,
+) -> SingletonResult:
+    stats = StageStats("singleton_correction")
+    paths = {
+        "sscs_rescue": f"{out_prefix}.sscs.rescue.sorted.bam",
+        "singleton_rescue": f"{out_prefix}.singleton.rescue.sorted.bam",
+        "remaining": f"{out_prefix}.remaining.singleton.sorted.bam",
+    }
+    tmps = {k: p.replace(".sorted.bam", ".unsorted.bam") for k, p in paths.items()}
+
+    s_reader = BamReader(singleton_bam)
+    x_reader = BamReader(sscs_bam)
+    writers = {k: BamWriter(t, s_reader.header) for k, t in tmps.items()}
+
+    try:
+        for singles, sscses in _merge_windows(
+            _windows_by_pos(s_reader), _windows_by_pos(x_reader)
+        ):
+            done: set = set()
+            for tag in sorted(singles, key=str):
+                if tag in done:
+                    continue
+                stats.incr("singletons_total")
+                read = singles[tag]
+                mirror = tags_mod.duplex_tag(tag)
+
+                partner_tag, pool = None, None
+                if mirror in sscses:
+                    partner_tag, pool = mirror, sscses
+                elif mirror in singles and mirror != tag and mirror not in done:
+                    partner_tag, pool = mirror, singles
+                elif max_mismatch > 0:
+                    partner_tag = _hamming_partner(tag, sscses, max_mismatch)
+                    pool = sscses
+                    if partner_tag is None:
+                        # exclude self AND already-consumed singletons — a
+                        # singleton may be corrected at most once
+                        avail = {t: r for t, r in singles.items() if t != tag and t not in done}
+                        partner_tag = _hamming_partner(tag, avail, max_mismatch)
+                        pool = singles
+
+                partner = pool.get(partner_tag) if partner_tag is not None else None
+                if partner is None or len(partner.seq) != len(read.seq):
+                    if partner is not None:
+                        stats.incr("length_mismatch")
+                    stats.incr("remaining")
+                    writers["remaining"].write(read)
+                    continue
+
+                if pool is sscses:
+                    stats.incr("rescued_by_sscs")
+                    writers["sscs_rescue"].write(_corrected(read, partner))
+                else:
+                    # symmetric singleton-singleton rescue: correct both now
+                    stats.incr("rescued_by_singleton", 2)
+                    stats.incr("singletons_total")
+                    writers["singleton_rescue"].write(_corrected(read, partner))
+                    writers["singleton_rescue"].write(_corrected(partner, read))
+                    done.add(partner_tag)
+    finally:
+        s_reader.close()
+        x_reader.close()
+        for w in writers.values():
+            w.close()
+
+    for k in paths:
+        sort_bam(tmps[k], paths[k])
+        os.unlink(tmps[k])
+    stats.set("max_mismatch", max_mismatch)
+    stats.write(f"{out_prefix}.singleton_stats.txt")
+    return SingletonResult(paths["sscs_rescue"], paths["singleton_rescue"], paths["remaining"], stats)
+
+
+def main(argv=None):
+    import argparse
+
+    p = argparse.ArgumentParser(description="Rescue singletons against the complementary strand")
+    p.add_argument("--singleton", required=True, help="sorted singleton BAM")
+    p.add_argument("--bamfile", required=True, help="sorted SSCS BAM")
+    p.add_argument("--outfile", required=True, help="output prefix")
+    p.add_argument("--max-mismatch", type=int, default=0,
+                   help="barcode Hamming tolerance (0 = exact complementary match)")
+    args = p.parse_args(argv)
+    run_singleton_correction(args.singleton, args.bamfile, args.outfile, args.max_mismatch)
+
+
+if __name__ == "__main__":
+    main()

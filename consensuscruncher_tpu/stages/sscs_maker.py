@@ -1,0 +1,189 @@
+"""SSCS stage: collapse UMI families into single-strand consensus sequences.
+
+Reference parity: ``ConsensusCruncher/SSCS_maker.py`` (SURVEY.md §2/§3.2).
+Outputs (pinned names; ``<p>`` = output prefix):
+
+- ``<p>.sscs.sorted.bam``       consensus reads (families of size ≥ 2)
+- ``<p>.singleton.sorted.bam``  size-1 families (renamed to consensus qname,
+  barcode preserved in ``XT``, for downstream correction/pairing)
+- ``<p>.badReads.bam``          unmapped/secondary/supplementary/qcfail/
+  mate-unmapped/barcode-less reads
+- ``<p>.sscs_stats.txt|.json``  stage stats
+- ``<p>.read_families.txt``     family-size histogram
+- ``<p>.time_tracker.txt``      wall-clock marks
+
+Backends (bit-identical by the parity test suite):
+- ``tpu``: families stream through ``ops.consensus_tpu.consensus_families``
+  (bucketed, batched, jitted device kernel).
+- ``cpu``: vectorized numpy oracle per family.
+
+Both write consensus reads in bucket/stream order to a temp BAM, then
+coordinate-sort atomically — the reference reaches the same state via
+``samtools sort`` subprocesses (SURVEY.md §3.2).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+import numpy as np
+
+from consensuscruncher_tpu.core import tags as tags_mod
+from consensuscruncher_tpu.core.consensus_cpu import consensus_maker_numpy
+from consensuscruncher_tpu.core.consensus_read import build_consensus_read
+from consensuscruncher_tpu.io.bam import BamReader, BamWriter, sort_bam
+from consensuscruncher_tpu.ops.consensus_tpu import ConsensusConfig, consensus_families
+from consensuscruncher_tpu.parallel.batching import rectangularize
+from consensuscruncher_tpu.stages.grouping import stream_families
+from consensuscruncher_tpu.utils.phred import encode_seq
+from consensuscruncher_tpu.utils.stats import FamilySizeHistogram, StageStats, TimeTracker
+
+
+@dataclass
+class SscsResult:
+    sscs_bam: str
+    singleton_bam: str
+    bad_bam: str
+    stats: StageStats
+    histogram: FamilySizeHistogram
+
+
+def _member_arrays(members):
+    seqs, quals = [], []
+    for m in members:
+        s = encode_seq(m.seq)
+        q = m.qual if m.qual.size else np.zeros(len(m.seq), dtype=np.uint8)
+        seqs.append(s)
+        quals.append(q)
+    return seqs, quals
+
+
+def run_sscs(
+    in_bam: str,
+    out_prefix: str,
+    cutoff: float = 0.7,
+    qual_threshold: int = 0,
+    qual_cap: int = 60,
+    backend: str = "tpu",
+    bdelim: str = tags_mod.DEFAULT_BDELIM,
+    max_batch: int = 1024,
+) -> SscsResult:
+    if backend not in ("cpu", "tpu"):
+        raise ValueError(f"unknown backend {backend!r} (expected 'cpu' or 'tpu')")
+    tracker = TimeTracker()
+    stats = StageStats("SSCS")
+    hist = FamilySizeHistogram()
+    cfg = ConsensusConfig(cutoff=cutoff, qual_threshold=qual_threshold, qual_cap=qual_cap)
+
+    sscs_path = f"{out_prefix}.sscs.sorted.bam"
+    singleton_path = f"{out_prefix}.singleton.sorted.bam"
+    bad_path = f"{out_prefix}.badReads.bam"
+    sscs_tmp = f"{out_prefix}.sscs.unsorted.bam"
+    singleton_tmp = f"{out_prefix}.singleton.unsorted.bam"
+
+    reader = BamReader(in_bam)
+    header = reader.header
+    bad_writer = BamWriter(bad_path, header, atomic=True)
+    sscs_writer = BamWriter(sscs_tmp, header)
+    singleton_writer = BamWriter(singleton_tmp, header)
+
+    pending: dict[int, tuple] = {}
+
+    def events():
+        """Route grouping events; yield consensus jobs for families >= 2."""
+        next_id = 0
+        for kind, a, b in stream_families(reader, header, bdelim):
+            if kind == "bad":
+                stats.incr("total_reads")
+                stats.incr(f"bad_{b}")
+                stats.incr("bad_reads")
+                bad_writer.write(a)
+                continue
+            tag, members = a, b
+            stats.incr("total_reads", len(members))
+            hist.add(len(members))
+            stats.incr("families")
+            if len(members) == 1:
+                stats.incr("singletons")
+                read = members[0]
+                out = read
+                out.qname = tags_mod.sscs_qname(tag)
+                out.tags = dict(out.tags)
+                out.tags["XT"] = ("Z", tag.barcode)
+                out.tags["XF"] = ("i", 1)
+                singleton_writer.write(out)
+                continue
+            seqs, quals = _member_arrays(members)
+            pending[next_id] = (tag, members)
+            yield next_id, seqs, quals
+            next_id += 1
+
+    def emit(fid, codes, quals):
+        tag, members = pending.pop(fid)
+        read = build_consensus_read(
+            tag, members, codes, quals, qname=tags_mod.sscs_qname(tag),
+            extra_tags={"XT": ("Z", tag.barcode)},
+        )
+        sscs_writer.write(read)
+        stats.incr("sscs_written")
+
+    ok = False
+    try:
+        if backend == "tpu":
+            for fid, codes, quals in consensus_families(events(), cfg, max_batch=max_batch):
+                emit(fid, codes, quals)
+        else:
+            for fid, seqs, quals in events():
+                rect_s, rect_q, _ = rectangularize(seqs, quals)
+                codes, cquals = consensus_maker_numpy(
+                    rect_s, rect_q, cutoff=cutoff, qual_threshold=qual_threshold, qual_cap=qual_cap
+                )
+                emit(fid, codes, cquals)
+        ok = True
+    finally:
+        reader.close()
+        for w in (bad_writer, sscs_writer, singleton_writer):
+            # never promote a partial atomic output on error (abort is a
+            # close for non-atomic writers' purposes; their tmps get removed)
+            w.close() if ok else w.abort()
+    tracker.mark("consensus")
+
+    sort_bam(sscs_tmp, sscs_path)
+    sort_bam(singleton_tmp, singleton_path)
+    os.unlink(sscs_tmp)
+    os.unlink(singleton_tmp)
+    tracker.mark("sort")
+
+    stats.set("backend", backend)
+    stats.set("cutoff", cutoff)
+    stats.write(f"{out_prefix}.sscs_stats.txt")
+    hist.write(f"{out_prefix}.read_families.txt")
+    tracker.write(f"{out_prefix}.time_tracker.txt")
+    return SscsResult(sscs_path, singleton_path, bad_path, stats, hist)
+
+
+def main(argv=None):
+    """Standalone entry (reference: each stage script runs independently)."""
+    import argparse
+
+    p = argparse.ArgumentParser(description="Make single-strand consensus sequences")
+    p.add_argument("--infile", required=True, help="coordinate-sorted input BAM")
+    p.add_argument("--outfile", required=True, help="output prefix (files get suffixes)")
+    p.add_argument("--cutoff", type=float, default=0.7, help="consensus base fraction cutoff")
+    p.add_argument("--qualscore", type=int, default=0, help="Phred threshold; lower-quality bases vote N")
+    p.add_argument("--backend", choices=("cpu", "tpu"), default="tpu")
+    p.add_argument("--bdelim", default=tags_mod.DEFAULT_BDELIM, help="barcode delimiter in qnames")
+    args = p.parse_args(argv)
+    run_sscs(
+        args.infile,
+        args.outfile,
+        cutoff=args.cutoff,
+        qual_threshold=args.qualscore,
+        backend=args.backend,
+        bdelim=args.bdelim,
+    )
+
+
+if __name__ == "__main__":
+    main()

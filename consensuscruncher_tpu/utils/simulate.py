@@ -1,0 +1,117 @@
+"""Synthetic duplex-sequencing dataset generator (test fixtures + benchmarks).
+
+SURVEY.md §4.3 calls for "synthetic BAM fixtures ... with controlled family
+sizes, strands, errors"; this module is that generator, and also feeds
+``bench.py``'s scale configs.  It fabricates duplex fragments the same way
+the wet lab does: a true molecule sequence, two strands, R1/R2 per strand,
+per-read sequencing errors, barcodes recorded in swapped order on opposite
+strands (see core/tags.py's physical model).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from consensuscruncher_tpu.core.tags import BARCODE_SEP, DEFAULT_BDELIM
+from consensuscruncher_tpu.io.bam import BamHeader, BamRead, BamWriter, sort_bam
+
+BASES = "ACGT"
+
+
+@dataclass
+class SimConfig:
+    n_fragments: int = 100
+    read_len: int = 100
+    umi_len: int = 6
+    ref_len: int = 100_000
+    ref_name: str = "chr1"
+    mean_family_size: float = 3.0
+    duplex_fraction: float = 0.8  # fraction of fragments with both strands
+    error_rate: float = 0.005
+    seed: int = 0
+    bdelim: str = DEFAULT_BDELIM
+
+
+@dataclass
+class SimTruth:
+    """Ground truth for assertions: fragment -> molecule sequence + families."""
+
+    molecules: dict = field(default_factory=dict)  # frag id -> (start, seq)
+    family_sizes: dict = field(default_factory=dict)  # frag id -> (a_size, b_size)
+
+
+def _rand_seq(rng, n):
+    return "".join(BASES[i] for i in rng.integers(0, 4, n))
+
+
+def simulate_bam(path: str, cfg: SimConfig) -> SimTruth:
+    """Write a coordinate-sorted, barcode-extracted BAM of duplex families."""
+    rng = np.random.default_rng(cfg.seed)
+    header = BamHeader.from_refs([(cfg.ref_name, cfg.ref_len)])
+    truth = SimTruth()
+    tmp = path + ".unsorted"
+    serial = 0
+    with BamWriter(tmp, header) as w:
+        for frag in range(cfg.n_fragments):
+            lo = int(rng.integers(1000, cfg.ref_len - 3 * cfg.read_len))
+            hi = lo + 2 * cfg.read_len + int(rng.integers(0, cfg.read_len))
+            mol = _rand_seq(rng, hi + cfg.read_len - lo)
+            umi_a = _rand_seq(rng, cfg.umi_len)
+            umi_b = _rand_seq(rng, cfg.umi_len)
+            a_size = max(1, int(rng.poisson(cfg.mean_family_size)))
+            b_size = (
+                max(1, int(rng.poisson(cfg.mean_family_size)))
+                if rng.random() < cfg.duplex_fraction
+                else 0
+            )
+            truth.molecules[frag] = (lo, mol)
+            truth.family_sizes[frag] = (a_size, b_size)
+            r1_seq = mol[: cfg.read_len]
+            r2_seq = mol[hi - lo : hi - lo + cfg.read_len]
+            for strand, size in (("A", a_size), ("B", b_size)):
+                bc = (
+                    f"{umi_a}{BARCODE_SEP}{umi_b}"
+                    if strand == "A"
+                    else f"{umi_b}{BARCODE_SEP}{umi_a}"
+                )
+                for _ in range(size):
+                    serial += 1
+                    qname = f"sim:{frag}:{strand}:{serial}{cfg.bdelim}{bc}"
+                    s1 = _mutate(rng, r1_seq, cfg.error_rate)
+                    s2 = _mutate(rng, r2_seq, cfg.error_rate)
+                    q1 = rng.integers(25, 41, cfg.read_len).astype(np.uint8)
+                    q2 = rng.integers(25, 41, cfg.read_len).astype(np.uint8)
+                    # strand A: R1 fwd@lo / R2 rev@hi ; strand B mirrored
+                    r1_read1 = strand == "A"
+                    w.write(BamRead(
+                        qname=qname,
+                        flag=(0x1 | 0x2 | 0x20 | (0x40 if r1_read1 else 0x80)),
+                        ref=cfg.ref_name, pos=lo, mapq=60,
+                        cigar=[("M", cfg.read_len)],
+                        mate_ref=cfg.ref_name, mate_pos=hi, tlen=hi - lo + cfg.read_len,
+                        seq=s1, qual=q1,
+                    ))
+                    w.write(BamRead(
+                        qname=qname,
+                        flag=(0x1 | 0x2 | 0x10 | (0x80 if r1_read1 else 0x40)),
+                        ref=cfg.ref_name, pos=hi, mapq=60,
+                        cigar=[("M", cfg.read_len)],
+                        mate_ref=cfg.ref_name, mate_pos=lo, tlen=-(hi - lo + cfg.read_len),
+                        seq=s2, qual=q2,
+                    ))
+    sort_bam(tmp, path)
+    import os
+
+    os.unlink(tmp)
+    return truth
+
+
+def _mutate(rng, seq: str, rate: float) -> str:
+    if rate <= 0:
+        return seq
+    arr = list(seq)
+    for i in np.nonzero(rng.random(len(arr)) < rate)[0]:
+        arr[i] = BASES[int(rng.integers(0, 4))]
+    return "".join(arr)

@@ -1,16 +1,35 @@
 """Test harness config: force JAX onto CPU with 8 virtual devices.
 
-Must run before any ``import jax`` (pytest imports conftest first), so the
-multi-chip sharding tests (SURVEY.md §4 item 4) exercise real ``Mesh`` /
-``shard_map`` / collective paths without TPU hardware.
+Hermeticity is load-bearing here, in two layers:
+
+1. ``JAX_PLATFORMS=cpu`` must be FORCED (the environment ships
+   ``JAX_PLATFORMS=axon`` — the single-tenant real-TPU tunnel, which tests
+   must never contend for; the driver and bench own it).
+2. The axon PJRT plugin is registered in *every* python process by a
+   ``sitecustomize.py`` on PYTHONPATH, and ``jax.backends()`` initializes
+   every registered plugin — so the env var alone still dials the tunnel.
+   Dropping the axon backend factory before any backend init keeps test
+   processes fully off the hardware.
+
+This gives the multi-chip sharding tests (SURVEY.md §4 item 4) real
+``Mesh``/``shard_map``/collective execution on 8 virtual CPU devices.
 """
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+import jax._src.xla_bridge as _xb  # noqa: E402
+
+# sitecustomize.py already imported jax (with JAX_PLATFORMS=axon snapshotted
+# into the live config) before this file ran — override the config object,
+# not just the env var, and drop the axon backend factory.
+jax.config.update("jax_platforms", "cpu")
+_xb._backend_factories.pop("axon", None)
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,102 @@
+import pytest
+
+from consensuscruncher_tpu.io.fastq import FastqWriter, read_fastq
+from consensuscruncher_tpu.stages.extract_barcodes import BarcodePattern, load_blist, run_extract
+
+
+def write_pair(tmp_path, records):
+    r1, r2 = tmp_path / "r1.fastq.gz", tmp_path / "r2.fastq.gz"
+    with FastqWriter(str(r1)) as w1, FastqWriter(str(r2)) as w2:
+        for name, s1, q1, s2, q2 in records:
+            w1.write(name, s1, q1)
+            w2.write(name, s2, q2)
+    return str(r1), str(r2)
+
+
+def test_pattern_parsing():
+    p = BarcodePattern("NNT")
+    assert p.length == 3 and p.umi_positions == (0, 1)
+    assert p.extract("ACGTT") == "AC"
+    with pytest.raises(ValueError):
+        BarcodePattern("NN2")
+
+
+def test_extract_with_pattern(tmp_path):
+    r1, r2 = write_pair(tmp_path, [
+        ("read1 extra", "ACTGGGGGGG", "IIIIIIIIII", "GGTCCCCCCC", "JJJJJJJJJJ"),
+    ])
+    res = run_extract(r1, r2, str(tmp_path / "out"), bpattern="NNT")
+    got1 = list(read_fastq(res.r1_out))
+    got2 = list(read_fastq(res.r2_out))
+    # NNT on "ACTGGGGGGG": UMI "AC", spacer T trimmed -> seq "GGGGGGG"
+    assert got1 == [("read1|AC.GG", "GGGGGGG", "IIIIIII")]
+    assert got2 == [("read1|AC.GG", "CCCCCCC", "JJJJJJJ")]
+    assert res.stats.get("extracted") == 1
+
+
+def test_extract_with_whitelist(tmp_path):
+    bl = tmp_path / "list.txt"
+    bl.write_text("ACT\nGGT\n")
+    r1, r2 = write_pair(tmp_path, [
+        ("ok", "ACTAAAA", "IIIIIII", "GGTCCCC", "IIIIIII"),
+        ("bad", "TTTAAAA", "IIIIIII", "GGTCCCC", "IIIIIII"),
+    ])
+    res = run_extract(r1, r2, str(tmp_path / "out"), blist=str(bl))
+    assert res.stats.get("extracted") == 1
+    assert res.stats.get("bad_barcode") == 1
+    bad1 = list(read_fastq(str(tmp_path / "out_r1_bad.fastq.gz")))
+    assert bad1[0][1] == "TTTAAAA"  # original untouched
+    dist = (tmp_path / "out.barcode_distribution.txt").read_text().splitlines()
+    assert dist == ["barcode\tcount", "ACT.GGT\t1"]
+
+
+def test_extract_qname_mismatch_detected(tmp_path):
+    r1, r2 = write_pair(tmp_path, [("a", "ACTG", "IIII", "ACTG", "IIII")])
+    r2b = tmp_path / "r2b.fastq.gz"
+    with FastqWriter(str(r2b)) as w:
+        w.write("DIFFERENT", "ACTG", "IIII")
+    with pytest.raises(ValueError, match="qname mismatch"):
+        run_extract(r1, str(r2b), str(tmp_path / "out"), bpattern="NN")
+
+
+def test_extract_too_short_routed_bad(tmp_path):
+    r1, r2 = write_pair(tmp_path, [("a", "AC", "II", "ACTGG", "IIIII")])
+    res = run_extract(r1, r2, str(tmp_path / "out"), bpattern="NNNN")
+    assert res.stats.get("too_short") == 1
+
+
+def test_pattern_whitelist_length_mismatch_rejected(tmp_path):
+    bl = tmp_path / "list.txt"
+    bl.write_text("ACT\n")  # 3-base barcodes
+    r1, r2 = write_pair(tmp_path, [("a", "ACTGG", "IIIII", "ACTGG", "IIIII")])
+    with pytest.raises(ValueError, match="every read would be rejected"):
+        run_extract(r1, r2, str(tmp_path / "out"), bpattern="NNT", blist=str(bl))
+
+
+def test_mixed_length_blist_rejected(tmp_path):
+    bl = tmp_path / "bad.txt"
+    bl.write_text("ACT\nACTG\n")
+    with pytest.raises(ValueError, match="mixes lengths"):
+        load_blist(str(bl))
+
+
+def test_plots_generated(tmp_path):
+    from consensuscruncher_tpu.stages import generate_plots
+    from consensuscruncher_tpu.utils.stats import FamilySizeHistogram, StageStats
+
+    hist = FamilySizeHistogram()
+    for s in (1, 1, 2, 3, 3, 3, 8):
+        hist.add(s)
+    fam_path = tmp_path / "fams.txt"
+    hist.write(str(fam_path))
+    st = StageStats("SSCS")
+    st.incr("sscs_written", 10)
+    st.incr("singletons", 4)
+    st.write(str(tmp_path / "sscs_stats.txt"))
+    generate_plots.main([
+        "--families", str(fam_path),
+        "--stats", str(tmp_path / "sscs_stats.json"),
+        "--outdir", str(tmp_path / "plots"),
+    ])
+    assert (tmp_path / "plots" / "family_size.png").stat().st_size > 1000
+    assert (tmp_path / "plots" / "read_recovery.png").stat().st_size > 1000

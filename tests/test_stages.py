@@ -1,0 +1,202 @@
+"""Stage tests on synthetic duplex data (SURVEY.md §4.3 fixtures)."""
+
+import numpy as np
+import pytest
+
+from consensuscruncher_tpu.io.bam import BamReader
+from consensuscruncher_tpu.stages.dcs_maker import run_dcs
+from consensuscruncher_tpu.stages.sscs_maker import run_sscs
+from consensuscruncher_tpu.stages.singleton_correction import run_singleton_correction
+from consensuscruncher_tpu.utils.simulate import SimConfig, simulate_bam
+
+
+@pytest.fixture(scope="module")
+def sim(tmp_path_factory):
+    d = tmp_path_factory.mktemp("sim")
+    path = str(d / "input.bam")
+    truth = simulate_bam(path, SimConfig(n_fragments=60, seed=1, mean_family_size=3.0,
+                                         duplex_fraction=0.7, error_rate=0.01))
+    return path, truth, d
+
+
+def read_all(path):
+    with BamReader(path) as rd:
+        return list(rd)
+
+
+def test_sscs_stage_cpu(sim, tmp_path):
+    in_bam, truth, _ = sim
+    res = run_sscs(in_bam, str(tmp_path / "out"), backend="cpu")
+    sscs = read_all(res.sscs_bam)
+    singles = read_all(res.singleton_bam)
+    assert len(read_all(res.bad_bam)) == 0
+    # every strand family of size>=2 yields 2 SSCS reads (R1-side + R2-side)
+    expected_sscs = 2 * sum(
+        (1 if a >= 2 else 0) + (1 if b >= 2 else 0) for a, b in truth.family_sizes.values()
+    )
+    expected_singletons = 2 * sum(
+        (1 if a == 1 else 0) + (1 if b == 1 else 0) for a, b in truth.family_sizes.values()
+    )
+    assert len(sscs) == expected_sscs
+    assert len(singles) == expected_singletons
+    # consensus outvotes the 1% error: SSCS sequences match the molecule
+    n_checked = 0
+    by_pos = {}
+    for frag, (lo, mol) in truth.molecules.items():
+        by_pos.setdefault(lo, []).append(mol[:100])
+    for read in sscs:
+        if not read.is_reverse and read.pos in by_pos and read.tags["XF"][1] >= 4:
+            assert any(read.seq.replace("N", "x") in m or _agree(read.seq, m)
+                       for m in by_pos[read.pos])
+            n_checked += 1
+    assert n_checked > 0
+    # stats + histogram written
+    assert res.stats.get("families") == res.stats.get("sscs_written") + res.stats.get("singletons")
+
+
+def _agree(seq, mol):
+    return sum(1 for a, b in zip(seq, mol) if a == b or a == "N") == len(seq)
+
+
+def test_sscs_backends_bit_identical(sim, tmp_path):
+    in_bam, _, _ = sim
+    r_cpu = run_sscs(in_bam, str(tmp_path / "cpu"), backend="cpu")
+    r_tpu = run_sscs(in_bam, str(tmp_path / "tpu"), backend="tpu")
+    for a_path, b_path in ((r_cpu.sscs_bam, r_tpu.sscs_bam),
+                           (r_cpu.singleton_bam, r_tpu.singleton_bam)):
+        a, b = read_all(a_path), read_all(b_path)
+        assert len(a) == len(b)
+        for ra, rb in zip(a, b):
+            assert ra == rb, f"record mismatch: {ra.qname}"
+
+
+def test_sscs_rejects_unsorted(tmp_path):
+    from consensuscruncher_tpu.io.bam import BamHeader, BamRead, BamWriter
+    from consensuscruncher_tpu.stages.grouping import NotCoordinateSorted
+
+    p = tmp_path / "unsorted.bam"
+    hdr = BamHeader.from_refs([("chr1", 10000)])
+    with BamWriter(str(p), hdr) as w:
+        for pos in (500, 100):
+            w.write(BamRead(qname=f"r{pos}|AAA.CCC", flag=99, ref="chr1", pos=pos,
+                            cigar=[("M", 4)], mate_ref="chr1", mate_pos=pos + 50,
+                            seq="ACGT", qual=np.full(4, 30, dtype=np.uint8)))
+    with pytest.raises(NotCoordinateSorted):
+        run_sscs(str(p), str(tmp_path / "out"), backend="cpu")
+
+
+def test_modal_cigar_matches_consensus_length():
+    # Regression: cigar must come from members whose read length equals the
+    # consensus length, or the record's cigar span disagrees with its seq.
+    from consensuscruncher_tpu.core.consensus_read import modal_cigar
+    from consensuscruncher_tpu.io.bam import BamRead
+
+    def rd(seq, cig):
+        return BamRead(qname="x", seq=seq, cigar=cig)
+
+    members = [rd("A" * 60, [("M", 60)]), rd("A" * 100, [("M", 100)]),
+               rd("A" * 100, [("M", 90), ("S", 10)])]
+    assert modal_cigar(members, 100) == [("M", 100)]  # first-seen among len-100
+    assert modal_cigar(members, 60) == [("M", 60)]
+    assert modal_cigar(members, 70) == [("M", 70)]  # no member matches: plain M
+
+
+def test_dcs_stage(sim, tmp_path):
+    in_bam, truth, _ = sim
+    sscs_res = run_sscs(in_bam, str(tmp_path / "s"), backend="tpu")
+    dcs_res = run_dcs(sscs_res.sscs_bam, str(tmp_path / "d"), backend="tpu")
+    dcs = read_all(dcs_res.dcs_bam)
+    unpaired = read_all(dcs_res.sscs_singleton_bam)
+    # fragments where BOTH strands have >= 2 reads produce 2 DCS (R1+R2 side)
+    expected_dcs = 2 * sum(1 for a, b in truth.family_sizes.values() if a >= 2 and b >= 2)
+    assert len(dcs) == expected_dcs
+    # each DCS read consumes TWO SSCS reads (one per strand)
+    assert 2 * len(dcs) + len(unpaired) == len(read_all(sscs_res.sscs_bam))
+    for read in dcs:
+        assert read.tags["XT"][1] == min(
+            read.tags["XT"][1],
+            ".".join(reversed(read.tags["XT"][1].split("."))),
+        )  # canonical barcode arrangement
+    # DCS qnames pair up R1/R2 sides: each qname appears exactly twice
+    from collections import Counter
+
+    qn = Counter(r.qname for r in dcs)
+    assert all(v == 2 for v in qn.values())
+
+
+def test_dcs_backends_bit_identical(sim, tmp_path):
+    in_bam, _, _ = sim
+    sscs_res = run_sscs(in_bam, str(tmp_path / "s"), backend="cpu")
+    a = run_dcs(sscs_res.sscs_bam, str(tmp_path / "a"), backend="cpu")
+    b = run_dcs(sscs_res.sscs_bam, str(tmp_path / "b"), backend="tpu")
+    ra, rb = read_all(a.dcs_bam), read_all(b.dcs_bam)
+    assert len(ra) == len(rb)
+    for x, y in zip(ra, rb):
+        assert x == y
+
+
+def test_singleton_correction(sim, tmp_path):
+    in_bam, truth, _ = sim
+    sscs_res = run_sscs(in_bam, str(tmp_path / "s"), backend="tpu")
+    res = run_singleton_correction(sscs_res.singleton_bam, sscs_res.sscs_bam,
+                                   str(tmp_path / "c"))
+    rescued_sscs = read_all(res.sscs_rescue_bam)
+    rescued_single = read_all(res.singleton_rescue_bam)
+    remaining = read_all(res.remaining_bam)
+    total_singletons = len(read_all(sscs_res.singleton_bam))
+    assert len(rescued_sscs) + len(rescued_single) + len(remaining) == total_singletons
+    # singleton(1) vs partner family>=2 -> rescued_by_sscs; both strands size1 -> singleton rescue
+    exp_sscs_rescue = 2 * sum(
+        (1 if a == 1 and b >= 2 else 0) + (1 if b == 1 and a >= 2 else 0)
+        for a, b in truth.family_sizes.values()
+    )
+    exp_single_rescue = 2 * 2 * sum(1 for a, b in truth.family_sizes.values() if a == 1 and b == 1)
+    assert len(rescued_sscs) == exp_sscs_rescue
+    assert len(rescued_single) == exp_single_rescue
+    for read in rescued_sscs:
+        assert read.tags["XR"][1] == "sscs"
+    for read in rescued_single:
+        assert read.tags["XR"][1] == "singleton"
+
+
+def test_singleton_correction_hamming_rescues_near_miss(tmp_path):
+    # Build one fragment: strand A singleton with a 1-mismatch barcode vs
+    # strand B SSCS family of 3 — exact match fails, hamming 1 rescues.
+    from consensuscruncher_tpu.io.bam import BamHeader, BamRead, BamWriter, sort_bam
+    import os
+
+    hdr = BamHeader.from_refs([("chr1", 100000)])
+    lo, hi, L = 1000, 1220, 100
+    reads = []
+
+    def pair(qname, bc, strand, seq1, seq2):
+        r1_read1 = strand == "A"
+        reads.append(BamRead(qname=f"{qname}|{bc}", flag=0x1 | 0x2 | 0x20 | (0x40 if r1_read1 else 0x80),
+                             ref="chr1", pos=lo, mapq=60, cigar=[("M", L)], mate_ref="chr1",
+                             mate_pos=hi, tlen=hi - lo + L, seq=seq1,
+                             qual=np.full(L, 30, dtype=np.uint8)))
+        reads.append(BamRead(qname=f"{qname}|{bc}", flag=0x1 | 0x2 | 0x10 | (0x80 if r1_read1 else 0x40),
+                             ref="chr1", pos=hi, mapq=60, cigar=[("M", L)], mate_ref="chr1",
+                             mate_pos=lo, tlen=-(hi - lo + L), seq=seq2,
+                             qual=np.full(L, 30, dtype=np.uint8)))
+
+    mol1, mol2 = "A" * L, "C" * L
+    pair("s1", "AAATTT.CCCGGG", "A", mol1, mol2)  # singleton, strand A
+    for i in range(3):  # strand B family: barcode mirror with 1 mismatch (CCCGGG->CCCGGA)
+        pair(f"b{i}", "CCCGGA.AAATTT", "B", mol1, mol2)
+    tmp = tmp_path / "in.unsorted.bam"
+    with BamWriter(str(tmp), hdr) as w:
+        for r in reads:
+            w.write(r)
+    in_bam = tmp_path / "in.bam"
+    sort_bam(str(tmp), str(in_bam))
+    os.unlink(str(tmp))
+
+    sscs_res = run_sscs(str(in_bam), str(tmp_path / "s"), backend="cpu")
+    exact = run_singleton_correction(sscs_res.singleton_bam, sscs_res.sscs_bam,
+                                     str(tmp_path / "e"), max_mismatch=0)
+    assert len(read_all(exact.remaining_bam)) == 2  # both mates uncorrected
+    fuzzy = run_singleton_correction(sscs_res.singleton_bam, sscs_res.sscs_bam,
+                                     str(tmp_path / "f"), max_mismatch=1)
+    assert len(read_all(fuzzy.sscs_rescue_bam)) == 2
+    assert len(read_all(fuzzy.remaining_bam)) == 0
