@@ -1,0 +1,10 @@
+#!/usr/bin/env python3
+"""Surface-parity shim: the reference repo exposes ``ConsensusCruncher.py``
+at the repo root (SURVEY.md §1); this forwards to the framework CLI."""
+
+import sys
+
+from consensuscruncher_tpu.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

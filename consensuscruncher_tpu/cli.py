@@ -1,0 +1,280 @@
+"""Top-level orchestrator CLI: ``fastq2bam`` + ``consensus`` subcommands.
+
+Reference parity: ``ConsensusCruncher.py`` at the reference repo root
+(SURVEY.md §1/§3) — argparse subcommands whose flags mirror the
+``[fastq2bam]`` / ``[consensus]`` sections of ``config.ini``, with CLI flags
+overriding config values.  TPU-era additions to the surface: ``--backend
+{cpu,tpu}`` on ``consensus`` (north star in BASELINE.json) and built-in
+sort/merge (this framework owns BAM I/O, so no samtools binary is invoked;
+the ``bwa`` aligner remains an external subprocess exactly like the
+reference).
+
+``fastq2bam`` flow (reference §3.1):  extract barcodes → pipe ``bwa mem``
+SAM straight into the framework's BAM codec → coordinate sort.  The
+``--bwa`` command is configurable; its stdout is consumed in-stream (no SAM
+ever hits disk).
+
+``consensus`` flow (reference §3.2):  SSCS → (optional) singleton
+correction → DCS → "all unique" merges → plots, writing the output tree::
+
+    <output>/<name>/
+      sscs/        consensus + singleton + badReads BAMs, stats, histogram
+      singleton/   rescue BAMs + stats               (with --scorrect)
+      dcs/         duplex BAMs + stats
+      all_unique/  merged SSCS-path and DCS-path BAMs
+      plots/       family-size + read-recovery PNGs
+"""
+
+from __future__ import annotations
+
+import argparse
+import configparser
+import os
+import shlex
+import subprocess
+import sys
+
+from consensuscruncher_tpu import __version__
+from consensuscruncher_tpu.core.tags import DEFAULT_BDELIM
+from consensuscruncher_tpu.io import sam as sam_mod
+from consensuscruncher_tpu.io.bam import BamWriter, merge_bams, sort_bam
+from consensuscruncher_tpu.stages.extract_barcodes import run_extract
+from consensuscruncher_tpu.stages.dcs_maker import run_dcs
+from consensuscruncher_tpu.stages.generate_plots import plot_family_size, plot_read_recovery
+from consensuscruncher_tpu.stages.singleton_correction import run_singleton_correction
+from consensuscruncher_tpu.stages.sscs_maker import run_sscs
+
+
+def _config_defaults(path: str | None, section: str) -> dict:
+    if not path:
+        return {}
+    parser = configparser.ConfigParser()
+    if not parser.read(path):
+        raise SystemExit(f"config file not found: {path}")
+    if section not in parser:
+        return {}
+    return dict(parser[section])
+
+
+def _bool(v) -> bool:
+    return str(v).lower() in ("1", "true", "yes", "on")
+
+
+# ------------------------------------------------------------------ fastq2bam
+
+def fastq2bam(args) -> dict:
+    os.makedirs(args.output, exist_ok=True)
+    tag_dir = os.path.join(args.output, "fastq_tag")
+    bam_dir = os.path.join(args.output, "bamfiles")
+    os.makedirs(tag_dir, exist_ok=True)
+    os.makedirs(bam_dir, exist_ok=True)
+    name = args.name or os.path.basename(args.fastq1).split(".")[0]
+
+    extract = run_extract(
+        args.fastq1,
+        args.fastq2,
+        os.path.join(tag_dir, name),
+        bpattern=args.bpattern,
+        blist=args.blist,
+        bdelim=args.bdelim,
+    )
+
+    out_bam = os.path.join(bam_dir, f"{name}.sorted.bam")
+    align_and_sort(args.bwa, args.ref, extract.r1_out, extract.r2_out, out_bam)
+    print(f"fastq2bam: wrote {out_bam}")
+    return {"bam": out_bam, "extract": extract}
+
+
+def align_and_sort(bwa: str, ref: str, r1: str, r2: str, out_bam: str) -> None:
+    """Run the external aligner, consume its SAM stdout into BAM, sort.
+
+    Reference parity: ``bwa mem | samtools view -b`` + ``samtools sort``
+    subprocesses (SURVEY.md §3.1) — here the SAM→BAM and sort legs are
+    in-process (framework-owned codec), only the aligner stays external.
+    """
+    cmd = shlex.split(bwa) + ["mem", ref, r1, r2]
+    try:
+        proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, text=True)
+    except FileNotFoundError:
+        raise SystemExit(
+            f"aligner not found: {cmd[0]!r} — install bwa or point --bwa at an "
+            "executable that speaks `<bwa> mem <ref> <r1> <r2>` and emits SAM"
+        )
+    unsorted = out_bam + ".unsorted"
+    try:
+        header, records = sam_mod.read_sam(proc.stdout)
+        with BamWriter(unsorted, header) as w:
+            for read in records:
+                w.write(read)
+    except Exception as exc:
+        # A truncated/garbled SAM stream usually means the aligner died
+        # mid-run — report ITS status, not the downstream parse error.
+        proc.kill()
+        status = proc.wait()
+        if os.path.exists(unsorted):
+            os.unlink(unsorted)
+        raise SystemExit(
+            f"aligner output unreadable ({exc}); aligner exit status {status}"
+        ) from exc
+    if proc.wait() != 0:
+        os.unlink(unsorted)
+        raise SystemExit(f"aligner exited with status {proc.returncode}")
+    sort_bam(unsorted, out_bam)
+    os.unlink(unsorted)
+
+
+# ------------------------------------------------------------------ consensus
+
+def consensus(args) -> dict:
+    name = args.name or os.path.basename(args.input).split(".")[0]
+    base = os.path.join(args.output, name)
+    dirs = {k: os.path.join(base, k) for k in ("sscs", "singleton", "dcs", "all_unique", "plots")}
+    for d in dirs.values():
+        os.makedirs(d, exist_ok=True)
+
+    sscs_res = run_sscs(
+        args.input,
+        os.path.join(dirs["sscs"], name),
+        cutoff=args.cutoff,
+        qual_threshold=args.qualscore,
+        backend=args.backend,
+        bdelim=args.bdelim,
+    )
+
+    sscs_path_parts = [sscs_res.sscs_bam]
+    stats_jsons = [os.path.join(dirs["sscs"], f"{name}.sscs_stats.json")]
+
+    # DCS pairs over SSCSes PLUS rescued singletons (that's the point of the
+    # rescue: a corrected singleton can now form a duplex with its partner —
+    # reference merges sscs + rescue BAMs before DCS_maker, SURVEY.md §3.2).
+    dcs_input = sscs_res.sscs_bam
+    if args.scorrect:
+        corr = run_singleton_correction(
+            sscs_res.singleton_bam,
+            sscs_res.sscs_bam,
+            os.path.join(dirs["singleton"], name),
+            max_mismatch=args.max_mismatch,
+        )
+        sscs_path_parts += [corr.sscs_rescue_bam, corr.singleton_rescue_bam, corr.remaining_bam]
+        stats_jsons.append(os.path.join(dirs["singleton"], f"{name}.singleton_stats.json"))
+        dcs_input = os.path.join(dirs["dcs"], f"{name}.sscs.rescued.bam")
+        merge_bams(
+            [sscs_res.sscs_bam, corr.sscs_rescue_bam, corr.singleton_rescue_bam], dcs_input
+        )
+    else:
+        sscs_path_parts.append(sscs_res.singleton_bam)
+
+    dcs_res = run_dcs(dcs_input, os.path.join(dirs["dcs"], name), backend=args.backend)
+    stats_jsons.append(os.path.join(dirs["dcs"], f"{name}.dcs_stats.json"))
+
+    # "all unique" merges (reference: samtools merge, SURVEY.md §3.2):
+    # SSCS path = every unique molecule's best single-strand evidence;
+    # DCS path  = duplex reads plus SSCSes that found no partner.
+    all_sscs = os.path.join(dirs["all_unique"], f"{name}.all.unique.sscs.bam")
+    merge_bams([p for p in sscs_path_parts if _nonempty(p)], all_sscs)
+    all_dcs = os.path.join(dirs["all_unique"], f"{name}.all.unique.dcs.bam")
+    merge_bams([p for p in (dcs_res.dcs_bam, dcs_res.sscs_singleton_bam) if _nonempty(p)], all_dcs)
+
+    plot_family_size(
+        os.path.join(dirs["sscs"], f"{name}.read_families.txt"),
+        os.path.join(dirs["plots"], f"{name}.family_size.png"),
+    )
+    plot_read_recovery(stats_jsons, os.path.join(dirs["plots"], f"{name}.read_recovery.png"))
+
+    if args.cleanup:
+        for path in (sscs_res.bad_bam,):
+            if os.path.exists(path):
+                os.unlink(path)
+
+    print(f"consensus: outputs under {base}")
+    return {"all_sscs": all_sscs, "all_dcs": all_dcs, "dirs": dirs}
+
+
+def _nonempty(path: str) -> bool:
+    """Merge inputs may legitimately hold zero records; keep them (headers
+    merge fine) but drop paths that don't exist at all."""
+    return os.path.exists(path)
+
+
+# ------------------------------------------------------------------- argparse
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="ConsensusCruncher",
+        description="TPU-native UMI duplex-sequencing error suppression",
+    )
+    p.add_argument("--version", action="version", version=__version__)
+    sub = p.add_subparsers(dest="command", required=True)
+
+    # Every value-bearing flag defaults to None so precedence works as the
+    # reference's: CLI flag > config.ini value > built-in default (applied in
+    # main; argparse-level defaults would mask the config layer).
+    f = sub.add_parser("fastq2bam", help="extract UMIs and align FASTQ pairs")
+    f.add_argument("-c", "--config", default=None)
+    f.add_argument("--fastq1", "-f1")
+    f.add_argument("--fastq2", "-f2")
+    f.add_argument("--output", "-o")
+    f.add_argument("--name", "-n")
+    f.add_argument("--bwa", "-b", help="aligner executable (invoked as '<bwa> mem ref r1 r2')")
+    f.add_argument("--ref", "-r", help="reference genome fasta (passed to the aligner)")
+    f.add_argument("--bpattern", "-p")
+    f.add_argument("--blist", "-l")
+    f.add_argument("--bdelim")
+    f.set_defaults(func=fastq2bam, config_section="fastq2bam",
+                   required_args=("fastq1", "fastq2", "output", "ref"),
+                   builtin_defaults={"bwa": "bwa", "bdelim": DEFAULT_BDELIM})
+
+    c = sub.add_parser("consensus", help="collapse UMI families into SSCS/DCS")
+    c.add_argument("-c", "--config", default=None)
+    c.add_argument("--input", "-i", help="coordinate-sorted barcoded BAM")
+    c.add_argument("--output", "-o")
+    c.add_argument("--name", "-n")
+    c.add_argument("--cutoff", type=float)
+    c.add_argument("--qualscore", "-q", type=int)
+    c.add_argument("--scorrect", help="singleton correction on/off")
+    c.add_argument("--max_mismatch", type=int,
+                   help="barcode Hamming tolerance for singleton rescue")
+    c.add_argument("--backend", choices=("cpu", "tpu"))
+    c.add_argument("--bdelim")
+    c.add_argument("--cleanup", help="remove intermediate BAMs")
+    c.set_defaults(func=consensus, config_section="consensus",
+                   required_args=("input", "output"),
+                   builtin_defaults={
+                       "cutoff": 0.7, "qualscore": 0, "scorrect": "True",
+                       "max_mismatch": 0, "backend": "tpu",
+                       "bdelim": DEFAULT_BDELIM, "cleanup": "False",
+                   })
+    return p
+
+
+def main(argv=None) -> int:
+    parser = build_parser()
+    args = parser.parse_args(argv)
+
+    # precedence: CLI flag > config.ini value > built-in default
+    config_values = _config_defaults(args.config, args.config_section)
+    for key, value in config_values.items():
+        if hasattr(args, key) and getattr(args, key) is None:
+            setattr(args, key, value)
+    for key, value in args.builtin_defaults.items():
+        if getattr(args, key) is None:
+            setattr(args, key, value)
+    missing = [a for a in args.required_args if getattr(args, a, None) in (None, "")]
+    if missing:
+        parser.error(f"missing required arguments (flag or config.ini): {', '.join('--' + m for m in missing)}")
+
+    args.scorrect = _bool(getattr(args, "scorrect", "True"))
+    args.cleanup = _bool(getattr(args, "cleanup", "False"))
+    if hasattr(args, "cutoff"):
+        args.cutoff = float(args.cutoff)
+    if hasattr(args, "qualscore"):
+        args.qualscore = int(args.qualscore)
+    if hasattr(args, "max_mismatch"):
+        args.max_mismatch = int(args.max_mismatch)
+
+    args.func(args)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -26,14 +26,12 @@ from __future__ import annotations
 
 import os
 from dataclasses import dataclass
-from typing import Iterator
-
 import numpy as np
 
 from consensuscruncher_tpu.core import tags as tags_mod
 from consensuscruncher_tpu.core.consensus_read import build_consensus_read
 from consensuscruncher_tpu.core.duplex_cpu import duplex_consensus
-from consensuscruncher_tpu.io.bam import BamHeader, BamReader, BamRead, BamWriter, sort_bam
+from consensuscruncher_tpu.io.bam import BamReader, BamRead, BamWriter, sort_bam
 from consensuscruncher_tpu.ops.duplex_tpu import duplex_batch_host
 from consensuscruncher_tpu.utils.phred import encode_seq
 from consensuscruncher_tpu.utils.stats import StageStats
@@ -46,27 +44,8 @@ class DcsResult:
     stats: StageStats
 
 
-def derive_tag(read: BamRead) -> tags_mod.FamilyTag:
-    """Reconstruct the family tag of a consensus read (coords/flags + XT)."""
-    if "XT" not in read.tags:
-        raise ValueError(f"consensus read {read.qname} lacks the XT barcode tag")
-    return tags_mod.unique_tag(read, read.tags["XT"][1])
-
-
-def position_windows(reader: BamReader) -> Iterator[dict[tags_mod.FamilyTag, BamRead]]:
-    """Group a sorted consensus BAM into per-(ref,pos) tag->read windows."""
-    window: dict[tags_mod.FamilyTag, BamRead] = {}
-    cur = None
-    for read in reader:
-        tag = derive_tag(read)
-        key = (reader.header.ref_id(read.ref), read.pos)
-        if cur is not None and key != cur:
-            yield window
-            window = {}
-        cur = key
-        window[tag] = read
-    if window:
-        yield window
+# Shared with singleton_correction (re-exported for stage symmetry).
+from consensuscruncher_tpu.stages.grouping import consensus_windows, derive_tag  # noqa: E402,F401
 
 
 class _DuplexBatcher:
@@ -135,7 +114,7 @@ def run_dcs(
 
     batcher = _DuplexBatcher(qual_cap, backend=backend)
     try:
-        for window in position_windows(reader):
+        for _key, window in consensus_windows(reader):
             paired: set = set()
             for tag in sorted(window, key=str):
                 if tag in paired:

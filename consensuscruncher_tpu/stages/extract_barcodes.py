@@ -38,6 +38,12 @@ class BarcodePattern:
     def __post_init__(self):
         if not self.pattern or not self.pattern.isalpha():
             raise ValueError(f"invalid barcode pattern {self.pattern!r}")
+        if "N" not in self.pattern.upper():
+            raise ValueError(
+                f"barcode pattern {self.pattern!r} has no N (UMI) positions — "
+                "every read would get an empty UMI and families would collapse "
+                "by position alone"
+            )
 
     @property
     def length(self) -> int:

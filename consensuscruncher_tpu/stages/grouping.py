@@ -26,6 +26,45 @@ class NotCoordinateSorted(ValueError):
     pass
 
 
+def derive_tag(read):
+    """Reconstruct a consensus read's family tag (coords/flags + XT barcode).
+
+    Consensus/singleton reads written by the SSCS stage carry their barcode
+    in ``XT``; everything else in the family key lives on the read itself.
+    """
+    if "XT" not in read.tags:
+        raise ValueError(f"consensus read {read.qname} lacks the XT barcode tag")
+    return tags_mod.unique_tag(read, read.tags["XT"][1])
+
+
+def consensus_windows(reader):
+    """Group a coordinate-sorted consensus BAM into per-(ref,pos) windows.
+
+    Yields ``(key, {FamilyTag: read})`` with ``key = (ref_id, pos)``.  Shared
+    by the DCS and singleton-correction stages (their pairing partners always
+    share the anchor position).  Raises :class:`NotCoordinateSorted` on
+    order violations — silent mispairing on unsorted input would complete
+    "successfully" with everything unpaired.
+    """
+    window: dict = {}
+    cur = None
+    for read in reader:
+        tag = derive_tag(read)
+        key = (reader.header.ref_id(read.ref), read.pos)
+        if cur is not None and key < cur:
+            raise NotCoordinateSorted(
+                f"consensus BAM is not coordinate-sorted: {read.qname} at "
+                f"{read.ref}:{read.pos} after ref_id={cur[0]} pos={cur[1]}"
+            )
+        if cur is not None and key != cur:
+            yield cur, window
+            window = {}
+        cur = key
+        window[tag] = read
+    if window:
+        yield cur, window
+
+
 def classify_bad(read: BamRead, bdelim: str) -> str | None:
     """Reason string if the read must be routed to the badRead BAM, else None."""
     if read.is_unmapped:

@@ -37,7 +37,7 @@ from consensuscruncher_tpu.core import tags as tags_mod
 from consensuscruncher_tpu.core.duplex_cpu import correct_singleton
 from consensuscruncher_tpu.io.bam import BamReader, BamRead, BamWriter, sort_bam
 from consensuscruncher_tpu.ops.singleton_tpu import best_matches
-from consensuscruncher_tpu.stages.dcs_maker import derive_tag
+from consensuscruncher_tpu.stages.grouping import consensus_windows
 from consensuscruncher_tpu.utils.phred import decode_seq, encode_seq
 from consensuscruncher_tpu.utils.stats import StageStats
 
@@ -48,21 +48,6 @@ class SingletonResult:
     singleton_rescue_bam: str
     remaining_bam: str
     stats: StageStats
-
-
-def _windows_by_pos(reader: BamReader) -> Iterator[tuple[tuple[int, int], dict]]:
-    window: dict = {}
-    cur = None
-    for read in reader:
-        tag = derive_tag(read)
-        key = (reader.header.ref_id(read.ref), read.pos)
-        if cur is not None and key != cur:
-            yield cur, window
-            window = {}
-        cur = key
-        window[tag] = read
-    if window:
-        yield cur, window
 
 
 def _merge_windows(a: Iterator, b: Iterator) -> Iterator[tuple[dict, dict]]:
@@ -136,7 +121,7 @@ def run_singleton_correction(
 
     try:
         for singles, sscses in _merge_windows(
-            _windows_by_pos(s_reader), _windows_by_pos(x_reader)
+            consensus_windows(s_reader), consensus_windows(x_reader)
         ):
             done: set = set()
             for tag in sorted(singles, key=str):

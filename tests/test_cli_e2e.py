@@ -1,0 +1,217 @@
+"""End-to-end CLI tests: fastq2bam (with a stub aligner) + consensus tree."""
+
+import os
+import stat
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from consensuscruncher_tpu.cli import main
+from consensuscruncher_tpu.io.bam import BamReader
+from consensuscruncher_tpu.io.fastq import FastqWriter
+from consensuscruncher_tpu.utils.simulate import SimConfig, simulate_bam
+
+FAKE_BWA = '''#!/usr/bin/env python3
+"""Stub aligner: `fake-bwa mem <ref> <r1> <r2>` -> SAM on stdout.
+Read names look like `frag<k>:<lo>:<hi>:<strand>:<i>|BC`; coordinates are
+taken from the name so alignment is deterministic."""
+import gzip, sys
+
+_, _, ref, r1, r2 = sys.argv[:5]
+
+def reads(path):
+    with gzip.open(path, "rt") as fh:
+        while True:
+            h = fh.readline()
+            if not h:
+                return
+            s = fh.readline().strip(); fh.readline(); q = fh.readline().strip()
+            yield h[1:].strip(), s, q
+
+print("@HD\\tVN:1.6\\tSO:unsorted")
+print("@SQ\\tSN:chr1\\tLN:1000000")
+for (n1, s1, q1), (n2, s2, q2) in zip(reads(r1), reads(r2)):
+    name = n1.split("|")[0]
+    _, lo, hi, strand, _i = name.split(":")
+    lo, hi = int(lo), int(hi)
+    L1, L2 = len(s1), len(s2)
+    tlen = hi - lo + L2
+    if strand == "A":   # R1 fwd@lo, R2 rev@hi
+        f1, f2 = 99, 147
+        p1, p2 = lo, hi
+    else:               # strand B: R1 rev@hi, R2 fwd@lo
+        f1, f2 = 83, 163
+        p1, p2 = hi, lo
+    print(f"{n1}\\t{f1}\\tchr1\\t{p1+1}\\t60\\t{L1}M\\tchr1\\t{p2+1}\\t{tlen}\\t{s1}\\t{q1}")
+    print(f"{n1}\\t{f2}\\tchr1\\t{p2+1}\\t60\\t{L2}M\\tchr1\\t{p1+1}\\t{-tlen}\\t{s2}\\t{q2}")
+'''
+
+
+@pytest.fixture()
+def fake_bwa(tmp_path):
+    path = tmp_path / "fake-bwa"
+    path.write_text(FAKE_BWA)
+    path.chmod(path.stat().st_mode | stat.S_IEXEC)
+    return str(path)
+
+
+def _write_fastqs(tmp_path, n_frags=12, fam=3):
+    r1, r2 = tmp_path / "s_R1.fastq.gz", tmp_path / "s_R2.fastq.gz"
+    rng = np.random.default_rng(0)
+    bases = "ACGT"
+    with FastqWriter(str(r1)) as w1, FastqWriter(str(r2)) as w2:
+        for k in range(n_frags):
+            lo = 1000 + 37 * k
+            hi = lo + 180
+            umi_a = "".join(bases[i] for i in rng.integers(0, 4, 2))
+            umi_b = "".join(bases[i] for i in rng.integers(0, 4, 2))
+            mol1 = "".join(bases[i] for i in rng.integers(0, 4, 50))
+            mol2 = "".join(bases[i] for i in rng.integers(0, 4, 50))
+            for strand in "AB":
+                # inline UMI prefix: NNT pattern (2 UMI bases + T spacer)
+                u1, u2 = (umi_a, umi_b) if strand == "A" else (umi_b, umi_a)
+                for i in range(fam):
+                    name = f"frag{k}:{lo}:{hi}:{strand}:{i}"
+                    w1.write(name, u1 + "T" + mol1, "I" * 53)
+                    w2.write(name, u2 + "T" + mol2, "I" * 53)
+    return str(r1), str(r2)
+
+
+def test_fastq2bam_end_to_end(tmp_path, fake_bwa):
+    r1, r2 = _write_fastqs(tmp_path)
+    out = tmp_path / "out"
+    rc = main([
+        "fastq2bam", "--fastq1", r1, "--fastq2", r2, "--output", str(out),
+        "--name", "s", "--bwa", fake_bwa, "--ref", "unused.fa", "--bpattern", "NNT",
+    ])
+    assert rc == 0
+    bam = out / "bamfiles" / "s.sorted.bam"
+    with BamReader(str(bam)) as rd:
+        reads = list(rd)
+        keys = [(rd.header.ref_id(r.ref), r.pos) for r in reads]
+    assert len(reads) == 12 * 2 * 3 * 2  # frags x strands x fam x mates
+    assert keys == sorted(keys)
+    assert all("|" in r.qname and "." in r.qname.split("|")[1] for r in reads)
+    # UMI + spacer trimmed from sequence
+    assert all(len(r.seq) == 50 for r in reads)
+
+
+def test_full_pipeline_fastq_to_dcs(tmp_path, fake_bwa):
+    r1, r2 = _write_fastqs(tmp_path, n_frags=10, fam=3)
+    out = tmp_path / "out"
+    main(["fastq2bam", "-f1", r1, "-f2", r2, "-o", str(out), "-n", "s",
+          "--bwa", fake_bwa, "-r", "x.fa", "-p", "NNT"])
+    rc = main([
+        "consensus", "-i", str(out / "bamfiles" / "s.sorted.bam"),
+        "-o", str(out / "consensus"), "-n", "s", "--backend", "cpu",
+    ])
+    assert rc == 0
+    base = out / "consensus" / "s"
+    # full output tree
+    for sub in ("sscs", "singleton", "dcs", "all_unique", "plots"):
+        assert (base / sub).is_dir()
+    with BamReader(str(base / "all_unique" / "s.all.unique.dcs.bam")) as rd:
+        dcs_all = list(rd)
+    # every fragment has both strands with fam=3 -> all SSCS pair: 10*2 DCS
+    assert len(dcs_all) == 20
+    with BamReader(str(base / "all_unique" / "s.all.unique.sscs.bam")) as rd:
+        sscs_all = list(rd)
+    assert len(sscs_all) == 40  # 10 frags x 2 strands x 2 mates
+    assert (base / "plots" / "s.family_size.png").exists()
+    assert (base / "plots" / "s.read_recovery.png").exists()
+
+
+def test_consensus_with_config_ini(tmp_path):
+    bam = tmp_path / "in.bam"
+    simulate_bam(str(bam), SimConfig(n_fragments=10, seed=3))
+    cfg = tmp_path / "run.ini"
+    cfg.write_text(
+        f"[consensus]\ninput = {bam}\noutput = {tmp_path / 'o'}\nname = cfg\n"
+        "backend = cpu\nscorrect = False\ncutoff = 0.8\n"
+    )
+    rc = main(["consensus", "-c", str(cfg)])
+    assert rc == 0
+    assert (tmp_path / "o" / "cfg" / "all_unique" / "cfg.all.unique.sscs.bam").exists()
+    # scorrect=False: no singleton rescue outputs
+    assert not any((tmp_path / "o" / "cfg" / "singleton").iterdir())
+
+
+def test_rescued_singletons_feed_dcs(tmp_path):
+    # Regression: with scorrect on, a strand-A family(>=2) + strand-B
+    # singleton must produce DCS reads (the rescued singleton pairs).
+    bam = tmp_path / "in.bam"
+    truth = simulate_bam(str(bam), SimConfig(n_fragments=40, seed=11,
+                                             mean_family_size=2.0, duplex_fraction=1.0))
+    rescue_frags = sum(
+        1 for a, b in truth.family_sizes.values()
+        if (a == 1) != (b == 1) and max(a, b) >= 2
+    )
+    assert rescue_frags > 0, "fixture must contain rescueable fragments"
+    main(["consensus", "-i", str(bam), "-o", str(tmp_path / "on"), "-n", "s",
+          "--backend", "cpu", "--scorrect", "True"])
+    main(["consensus", "-i", str(bam), "-o", str(tmp_path / "off"), "-n", "s",
+          "--backend", "cpu", "--scorrect", "False"])
+
+    def dcs_count(base):
+        with BamReader(str(base / "s" / "dcs" / "s.dcs.sorted.bam")) as rd:
+            return sum(1 for _ in rd)
+
+    assert dcs_count(tmp_path / "on") > dcs_count(tmp_path / "off")
+
+
+def test_unsorted_consensus_bam_detected(tmp_path):
+    # Regression: DCS/singleton windows must reject unsorted input instead
+    # of silently writing everything unpaired.
+    from consensuscruncher_tpu.io.bam import BamHeader, BamRead, BamWriter
+    from consensuscruncher_tpu.stages.dcs_maker import run_dcs
+    from consensuscruncher_tpu.stages.grouping import NotCoordinateSorted
+
+    hdr = BamHeader.from_refs([("chr1", 10000)])
+    p = tmp_path / "u.bam"
+    with BamWriter(str(p), hdr) as w:
+        for pos in (700, 100):
+            w.write(BamRead(qname=f"q{pos}", flag=99, ref="chr1", pos=pos,
+                            cigar=[("M", 4)], mate_ref="chr1", mate_pos=pos + 9,
+                            seq="ACGT", qual=np.full(4, 30, dtype=np.uint8),
+                            tags={"XT": ("Z", "AA.CC"), "XF": ("i", 2)}))
+    with pytest.raises(NotCoordinateSorted):
+        run_dcs(str(p), str(tmp_path / "d"), backend="cpu")
+
+
+def test_pattern_without_N_rejected():
+    from consensuscruncher_tpu.stages.extract_barcodes import BarcodePattern
+
+    with pytest.raises(ValueError, match="no N"):
+        BarcodePattern("ATG")
+
+
+def test_cli_missing_args_error(capsys):
+    with pytest.raises(SystemExit):
+        main(["consensus"])
+    err = capsys.readouterr().err
+    assert "--input" in err and "--output" in err
+
+
+def test_cli_version(capsys):
+    with pytest.raises(SystemExit) as e:
+        main(["--version"])
+    assert e.value.code == 0
+
+
+def test_missing_aligner_clear_error(tmp_path):
+    r1, r2 = _write_fastqs(tmp_path, n_frags=1, fam=1)
+    with pytest.raises(SystemExit, match="aligner not found"):
+        main(["fastq2bam", "-f1", r1, "-f2", r2, "-o", str(tmp_path / "o"),
+              "--bwa", "/nonexistent/bwa", "-r", "x.fa", "-p", "NNT"])
+
+
+def test_root_shim_runs(tmp_path):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = "/root/repo:" + env.get("PYTHONPATH", "")
+    res = subprocess.run(
+        [sys.executable, "/root/repo/ConsensusCruncher.py", "--version"],
+        capture_output=True, text=True, env=env,
+    )
+    assert res.returncode == 0
