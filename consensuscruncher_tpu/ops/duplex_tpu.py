@@ -8,7 +8,7 @@ singleton correction (a correction is a 2-deep duplex vote, SURVEY.md §3.5).
 
 from __future__ import annotations
 
-from functools import lru_cache
+from functools import lru_cache, partial
 
 import jax
 import jax.numpy as jnp
@@ -18,16 +18,26 @@ from consensuscruncher_tpu.core.consensus_cpu import DEFAULT_QUAL_CAP
 from consensuscruncher_tpu.utils.phred import N
 
 
+def duplex_vote(seq1, qual1, seq2, qual2, *, qual_cap: int = DEFAULT_QUAL_CAP, agree_mask=None):
+    """The pinned duplex formula as a plain traceable elementwise program.
+
+    Single source of truth for every device-side duplex vote (here and in
+    ``parallel.mesh.full_pipeline_step``) — mirrors
+    ``core.duplex_cpu.duplex_consensus`` bit for bit.  ``agree_mask``
+    optionally vetoes agreement (e.g. batch slots lacking a strand).
+    """
+    agree = (seq1 == seq2) & (seq1 < N)
+    if agree_mask is not None:
+        agree = agree & agree_mask
+    out_base = jnp.where(agree, seq1, jnp.uint8(N))
+    qsum = qual1.astype(jnp.int32) + qual2.astype(jnp.int32)
+    out_qual = jnp.where(agree, jnp.minimum(qsum, qual_cap), 0).astype(jnp.uint8)
+    return out_base, out_qual
+
+
 @lru_cache(maxsize=None)
 def _compiled(qual_cap: int):
-    def fn(seq1, qual1, seq2, qual2):
-        agree = (seq1 == seq2) & (seq1 < N)
-        out_base = jnp.where(agree, seq1, jnp.uint8(N))
-        qsum = qual1.astype(jnp.int32) + qual2.astype(jnp.int32)
-        out_qual = jnp.where(agree, jnp.minimum(qsum, qual_cap), 0).astype(jnp.uint8)
-        return out_base, out_qual
-
-    return jax.jit(fn)
+    return jax.jit(partial(duplex_vote, qual_cap=qual_cap))
 
 
 def duplex_batch(seq1, qual1, seq2, qual2, qual_cap: int = DEFAULT_QUAL_CAP):

@@ -1,0 +1,208 @@
+"""Multi-chip sharding: family batches over a ``jax.sharding.Mesh``.
+
+The reference pipeline is single-process single-thread (SURVEY.md §2,
+"Parallelism & communication": no NCCL/MPI/Gloo — inter-stage transport is
+BAM files on disk).  The TPU rebuild makes scale a first-class axis instead:
+UMI families are embarrassingly parallel, so the natural mesh is a single
+``"families"`` data axis — each chip votes its shard of the family batch and
+the only cross-chip traffic is a tiny ``psum`` of stage statistics over ICI.
+
+Design notes (why this shape and not TP/PP):
+
+- There is no model and no weights; the "forward step" is the consensus
+  vote (``ops.consensus_tpu``) + duplex vote (``ops.duplex_tpu``).  The
+  analog of data parallelism is family-sharding; the analog of sequence
+  parallelism is the position axis, which at 100-300 bp never needs
+  sharding (SURVEY.md §5 "Long-context").
+- ``shard_map`` (not pjit-with-annotations) because the per-shard program
+  is already a complete vmapped kernel and we want the collective (one
+  ``psum`` of the stats vector) to be explicit and auditable.
+- Stats ride ICI as a single ``(4,)`` int32 vector — families processed,
+  consensus positions, N positions, quality sum — matching the per-stage
+  ``*_stats.txt`` counters of the reference (SSCS_maker.py stats output).
+
+Multi-host (DCN) note: because each shard's program is self-contained and
+the only collective is the stats ``psum``, the same ``shard_map`` program
+runs unchanged under ``jax.distributed.initialize`` with a global mesh over
+multiple hosts — families stream from each host's local BAM shard, exactly
+the "one BAM per chip" 8-sample config in BASELINE.md.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import lru_cache, partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from consensuscruncher_tpu.ops.consensus_tpu import ConsensusConfig, _consensus_one_family
+from consensuscruncher_tpu.ops.duplex_tpu import duplex_vote
+from consensuscruncher_tpu.utils.phred import N
+
+FAMILY_AXIS = "families"
+
+
+def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
+    """A 1-D mesh over the first ``n_devices`` available devices."""
+    devs = list(devices if devices is not None else jax.devices())
+    if n_devices is not None:
+        if n_devices > len(devs):
+            raise ValueError(f"asked for {n_devices} devices, only {len(devs)} available")
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), (FAMILY_AXIS,))
+
+
+@dataclass(frozen=True)
+class StepStats:
+    """Globally ``psum``-reduced counters for one sharded pipeline step."""
+
+    families: int
+    positions: int
+    n_positions: int  # positions that failed the vote (emitted as N)
+    qual_sum: int
+
+    @staticmethod
+    def from_vector(vec: np.ndarray) -> "StepStats":
+        v = np.asarray(vec).astype(np.int64)
+        return StepStats(int(v[0]), int(v[1]), int(v[2]), int(v[3]))
+
+
+def _shard_step(bases, quals, fam_sizes, lengths, *, num, den, qual_threshold, qual_cap):
+    """Per-device program: vmapped consensus vote + local stats, psum'd stats.
+
+    Runs on one shard of the batch axis; the single collective is the
+    ``psum`` of the (4,) stats vector over the families axis.  ``lengths``
+    is each family's true consensus length — stats only count positions
+    ``< length`` so the LEN_QUANTUM padding of ``parallel.batching`` (always
+    emitted as N, sliced off by callers) never inflates the counters.
+    """
+    vote = partial(
+        _consensus_one_family, num=num, den=den, qual_threshold=qual_threshold, qual_cap=qual_cap
+    )
+    out_b, out_q = jax.vmap(vote, in_axes=(0, 0, 0))(bases, quals, fam_sizes)
+
+    real = fam_sizes > 0  # (B_local,)
+    in_len = jnp.arange(bases.shape[-1], dtype=jnp.int32)[None, :] < lengths[:, None]
+    counted = real[:, None] & in_len  # (B_local, L)
+    pos_count = counted.sum(dtype=jnp.int32)
+    n_count = jnp.where(counted, (out_b == N).astype(jnp.int32), 0).sum()
+    q_sum = jnp.where(counted, out_q.astype(jnp.int32), 0).sum()
+    local = jnp.stack([real.sum().astype(jnp.int32), pos_count, n_count, q_sum])
+    stats = jax.lax.psum(local, axis_name=FAMILY_AXIS)
+    return out_b, out_q, stats
+
+
+@lru_cache(maxsize=None)
+def _compiled_sharded_step(mesh: Mesh, num, den, qual_threshold, qual_cap):
+    fn = partial(
+        _shard_step, num=num, den=den, qual_threshold=qual_threshold, qual_cap=qual_cap
+    )
+    mapped = jax.shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(P(FAMILY_AXIS),) * 4,
+        out_specs=(P(FAMILY_AXIS), P(FAMILY_AXIS), P()),
+    )
+    return jax.jit(mapped)
+
+
+def pad_batch_to_mesh(bases, quals, fam_sizes, mesh: Mesh, lengths=None):
+    """Pad the batch axis to a multiple of the mesh size with dummy slots.
+
+    Dummy slots carry ``fam_size == 0`` (and length 0) and are excluded from
+    stats and dropped by callers.  Returns ``(bases, quals, fam_sizes,
+    lengths, n_real)``; ``lengths`` is None iff it was passed as None.
+    """
+    n = bases.shape[0]
+    size = mesh.devices.size
+    cap = ((n + size - 1) // size) * size
+    if cap != n:
+        pad = cap - n
+        bases = np.concatenate([bases, np.zeros((pad,) + bases.shape[1:], bases.dtype)])
+        quals = np.concatenate([quals, np.zeros((pad,) + quals.shape[1:], quals.dtype)])
+        fam_sizes = np.concatenate([fam_sizes, np.zeros(pad, fam_sizes.dtype)])
+        if lengths is not None:
+            lengths = np.concatenate([lengths, np.zeros(pad, np.int32)])
+    return bases, quals, fam_sizes, lengths, n
+
+
+def sharded_consensus_batch(
+    bases,
+    quals,
+    fam_sizes,
+    mesh: Mesh,
+    config: ConsensusConfig = ConsensusConfig(),
+    lengths=None,
+):
+    """Family-sharded consensus over the mesh.
+
+    Like ``ops.consensus_tpu.consensus_batch`` but the batch axis is sharded
+    across chips and global ``StepStats`` ride a ``psum``.  The batch axis
+    must already be a multiple of the mesh size (use ``pad_batch_to_mesh``).
+    ``lengths`` is the per-family true consensus length (``FamilyBatch
+    .lengths``); omitted means every position is real.
+
+    Returns ``(consensus_bases, consensus_quals, stats)``.
+    """
+    num, den = config.cutoff_rational
+    fn = _compiled_sharded_step(mesh, num, den, int(config.qual_threshold), int(config.qual_cap))
+    if lengths is None:
+        lengths = np.full(np.shape(bases)[0], np.shape(bases)[-1], np.int32)
+    sharding = NamedSharding(mesh, P(FAMILY_AXIS))
+    b = jax.device_put(jnp.asarray(bases, dtype=jnp.uint8), sharding)
+    q = jax.device_put(jnp.asarray(quals, dtype=jnp.uint8), sharding)
+    s = jax.device_put(jnp.asarray(fam_sizes, dtype=jnp.int32), sharding)
+    ln = jax.device_put(jnp.asarray(lengths, dtype=jnp.int32), sharding)
+    out_b, out_q, stats = fn(b, q, s, ln)
+    return out_b, out_q, StepStats.from_vector(jax.device_get(stats))
+
+
+def full_pipeline_step(mesh: Mesh, config: ConsensusConfig = ConsensusConfig()):
+    """The jittable whole-pipeline device step for one sharded batch.
+
+    This is the "training step" analog the driver dry-runs: per shard it
+    (1) votes SSCS consensus for a batch of strand-A families and a batch
+    of strand-B families, (2) pairs them into duplex (DCS) consensus —
+    the two-strand agreement vote of ``ops.duplex_tpu`` — and (3) psums
+    global stats.  Everything is one XLA program per (B, F, L) bucket.
+
+    Returns a jitted ``fn(bases_a, quals_a, sizes_a, bases_b, quals_b,
+    sizes_b) -> (sscs_a, qual_a, sscs_b, qual_b, dcs, dcs_qual, stats)``
+    with batch axes sharded over the mesh.
+    """
+    num, den = config.cutoff_rational
+    qual_threshold, qual_cap = int(config.qual_threshold), int(config.qual_cap)
+
+    def shard_fn(bases_a, quals_a, sizes_a, bases_b, quals_b, sizes_b):
+        vote = partial(
+            _consensus_one_family,
+            num=num, den=den, qual_threshold=qual_threshold, qual_cap=qual_cap,
+        )
+        vmapped = jax.vmap(vote, in_axes=(0, 0, 0))
+        sscs_a, qa = vmapped(bases_a, quals_a, sizes_a)
+        sscs_b, qb = vmapped(bases_b, quals_b, sizes_b)
+
+        both = (sizes_a > 0) & (sizes_b > 0)
+        dcs, dq = duplex_vote(
+            sscs_a, qa, sscs_b, qb, qual_cap=qual_cap, agree_mask=both[:, None]
+        )
+
+        real = ((sizes_a > 0) | (sizes_b > 0)).sum().astype(jnp.int32)
+        duplexes = both.sum().astype(jnp.int32)
+        n_count = jnp.where(both[:, None], (dcs == N).astype(jnp.int32), 0).sum()
+        q_sum = jnp.where(both[:, None], dq.astype(jnp.int32), 0).sum()
+        local = jnp.stack([real, duplexes, n_count, q_sum])
+        stats = jax.lax.psum(local, axis_name=FAMILY_AXIS)
+        return sscs_a, qa, sscs_b, qb, dcs, dq, stats
+
+    spec = P(FAMILY_AXIS)
+    mapped = jax.shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(spec,) * 6,
+        out_specs=(spec, spec, spec, spec, spec, spec, P()),
+    )
+    return jax.jit(mapped)

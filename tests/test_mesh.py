@@ -1,0 +1,139 @@
+"""Multi-chip sharding tests on the 8-virtual-device CPU mesh (conftest).
+
+SURVEY.md §4 item 4: pmap/shard_map tests with no TPU via
+``xla_force_host_platform_device_count``.  Parity oracle: the Counter-loop
+``core.consensus_cpu.consensus_maker`` + ``core.duplex_cpu.duplex_consensus``.
+"""
+
+import numpy as np
+import pytest
+
+from consensuscruncher_tpu.core.consensus_cpu import consensus_maker
+from consensuscruncher_tpu.core.duplex_cpu import duplex_consensus
+from consensuscruncher_tpu.ops.consensus_tpu import ConsensusConfig
+from consensuscruncher_tpu.parallel.mesh import (
+    StepStats,
+    full_pipeline_step,
+    make_mesh,
+    pad_batch_to_mesh,
+    sharded_consensus_batch,
+)
+from consensuscruncher_tpu.utils.phred import N, PAD
+
+
+def _random_strand(rng, batch, fam, length, min_size=1):
+    bases = rng.integers(0, 4, (batch, fam, length)).astype(np.uint8)
+    quals = rng.integers(2, 41, (batch, fam, length)).astype(np.uint8)
+    sizes = rng.integers(min_size, fam + 1, (batch,)).astype(np.int32)
+    for i in range(batch):  # PAD out unused member slots like batching does
+        bases[i, sizes[i] :] = PAD
+        quals[i, sizes[i] :] = 0
+    return bases, quals, sizes
+
+
+def test_make_mesh_sizes():
+    assert make_mesh().devices.size == 8
+    assert make_mesh(4).devices.size == 4
+    with pytest.raises(ValueError):
+        make_mesh(64)
+
+
+def test_sharded_consensus_matches_oracle():
+    rng = np.random.default_rng(7)
+    mesh = make_mesh(8)
+    bases, quals, sizes = _random_strand(rng, batch=32, fam=8, length=64)
+    out_b, out_q, stats = sharded_consensus_batch(bases, quals, sizes, mesh)
+    out_b, out_q = np.asarray(out_b), np.asarray(out_q)
+    for i in range(32):
+        f = int(sizes[i])
+        exp_b, exp_q = consensus_maker(bases[i, :f], quals[i, :f])
+        np.testing.assert_array_equal(out_b[i], exp_b)
+        np.testing.assert_array_equal(out_q[i], exp_q)
+    assert stats.families == 32
+    assert stats.positions == 32 * 64
+    assert stats.n_positions == int((out_b == N).sum())
+    assert stats.qual_sum == int(out_q.astype(np.int64).sum())
+
+
+def test_sharded_equals_unsharded_mesh_sizes():
+    """Same batch through 1-, 2-, 4-, 8-device meshes -> identical bits."""
+    rng = np.random.default_rng(11)
+    bases, quals, sizes = _random_strand(rng, batch=16, fam=4, length=32)
+    outs = []
+    for n in (1, 2, 4, 8):
+        b, q, stats = sharded_consensus_batch(bases, quals, sizes, make_mesh(n))
+        outs.append((np.asarray(b), np.asarray(q), stats))
+    for b, q, stats in outs[1:]:
+        np.testing.assert_array_equal(b, outs[0][0])
+        np.testing.assert_array_equal(q, outs[0][1])
+        assert stats == outs[0][2]
+
+
+def test_pad_batch_to_mesh():
+    mesh = make_mesh(8)
+    rng = np.random.default_rng(3)
+    bases, quals, sizes = _random_strand(rng, batch=13, fam=2, length=32)
+    pb, pq, ps, pl, n = pad_batch_to_mesh(bases, quals, sizes, mesh)
+    assert n == 13 and pb.shape[0] == 16 and ps[13:].sum() == 0 and pl is None
+    out_b, out_q, stats = sharded_consensus_batch(pb, pq, ps, mesh)
+    assert stats.families == 13  # dummy slots excluded from stats
+    assert (np.asarray(out_b)[13:] == N).all()
+    assert (np.asarray(out_q)[13:] == 0).all()
+
+
+def test_stats_exclude_length_padding():
+    """Families padded to a wider L bucket must not inflate StepStats."""
+    rng = np.random.default_rng(17)
+    mesh = make_mesh(4)
+    batch, fam, true_len, bucket_len = 8, 4, 50, 64
+    bases, quals, sizes = _random_strand(rng, batch, fam, true_len)
+    pb = np.full((batch, fam, bucket_len), PAD, np.uint8)
+    pq = np.zeros((batch, fam, bucket_len), np.uint8)
+    pb[:, :, :true_len] = bases
+    pq[:, :, :true_len] = quals
+    lengths = np.full(batch, true_len, np.int32)
+    out_b, out_q, stats = sharded_consensus_batch(pb, pq, sizes, mesh, lengths=lengths)
+    out_b, out_q = np.asarray(out_b), np.asarray(out_q)
+    assert stats.positions == batch * true_len
+    assert stats.n_positions == int((out_b[:, :true_len] == N).sum())
+    assert stats.qual_sum == int(out_q[:, :true_len].astype(np.int64).sum())
+    # and the padded tail itself is all-N/0 as callers assume before slicing
+    assert (out_b[:, true_len:] == N).all() and (out_q[:, true_len:] == 0).all()
+
+
+def test_full_pipeline_step_parity():
+    """Sharded SSCS+DCS step == CPU oracle SSCS + duplex, bit for bit."""
+    rng = np.random.default_rng(23)
+    mesh = make_mesh(8)
+    batch, fam, length = 24, 4, 48
+    ba, qa, na = _random_strand(rng, batch, fam, length)
+    bb, qb, nb = _random_strand(rng, batch, fam, length)
+    nb[::5] = 0  # some molecules lack strand B
+    for i in np.nonzero(nb == 0)[0]:
+        bb[i] = PAD
+        qb[i] = 0
+
+    step = full_pipeline_step(mesh, ConsensusConfig())
+    sa, sqa, sb, sqb, dcs, dq, stats = [np.asarray(x) for x in step(ba, qa, na, bb, qb, nb)]
+
+    n_dup = 0
+    for i in range(batch):
+        exp_a, exp_qa = consensus_maker(ba[i, : na[i]], qa[i, : na[i]])
+        np.testing.assert_array_equal(sa[i], exp_a)
+        np.testing.assert_array_equal(sqa[i], exp_qa)
+        if nb[i] > 0:
+            n_dup += 1
+            exp_b, exp_qb = consensus_maker(bb[i, : nb[i]], qb[i, : nb[i]])
+            exp_d, exp_dq = duplex_consensus(exp_a, exp_qa, exp_b, exp_qb)
+            np.testing.assert_array_equal(sb[i], exp_b)
+            np.testing.assert_array_equal(dcs[i], exp_d)
+            np.testing.assert_array_equal(dq[i], exp_dq)
+        else:
+            assert (dcs[i] == N).all() and (dq[i] == 0).all()
+    assert int(stats[0]) == batch
+    assert int(stats[1]) == n_dup
+
+
+def test_stepstats_from_vector():
+    s = StepStats.from_vector(np.array([1, 2, 3, 4]))
+    assert (s.families, s.positions, s.n_positions, s.qual_sum) == (1, 2, 3, 4)
