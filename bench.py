@@ -5,18 +5,22 @@ Prints ONE JSON line:
 
 The driver metric (BASELINE.json) is UMI families/sec/chip for SSCS+DCS.
 The reference publishes no throughput numbers (BASELINE.md), so the
-baseline denominator is measured here, in-process: a faithful
-reference-style implementation — the per-position ``collections.Counter``
-loop of ``consensus_helper.consensus_maker`` plus the per-position duplex
-agreement vote of ``DCS_maker.duplex_consensus`` — timed on a subsample
-and expressed as duplex families (strand pairs) per second.
+baseline denominator is measured here, in-process: the repo's own faithful
+reimplementation of the reference hot loop (``core.consensus_cpu
+.consensus_maker`` — the per-position ``collections.Counter`` program of
+``consensus_helper.consensus_maker`` — plus ``core.duplex_cpu
+.duplex_consensus``), timed per duplex pair on a subsample.
 
-The TPU path is the real production code: ``parallel.mesh.full_pipeline_step``
-(the same jitted shard_map program the driver dry-runs), timed end-to-end
-including host->device transfer and device->host stats fetch.
+The TPU path is the production sharded program (``parallel.mesh
+.packed_pipeline_step``): host packing into the 1-byte wire format
+(``ops.packing``), host->device transfer, the jitted shard_map vote+duplex
+step, and device->host fetch of every output — timed **host-to-host**
+(``np.asarray`` on all outputs; plain ``block_until_ready`` does not
+guarantee completion through the axon tunnel, which is also why transfer
+volume, not FLOPs, is the Amdahl term this format attacks).
 
 Scale knobs (env): CCT_BENCH_PAIRS (default 20000), CCT_BENCH_LEN (100),
-CCT_BENCH_MEAN_FAM (4), CCT_BENCH_CPU_SAMPLE (300).
+CCT_BENCH_MEAN_FAM (4), CCT_BENCH_CPU_SAMPLE (200).
 """
 
 from __future__ import annotations
@@ -35,8 +39,9 @@ def _env_int(name: str, default: int) -> int:
 N_PAIRS = _env_int("CCT_BENCH_PAIRS", 20_000)
 READ_LEN = _env_int("CCT_BENCH_LEN", 100)
 MEAN_FAM = _env_int("CCT_BENCH_MEAN_FAM", 4)
-CPU_SAMPLE = _env_int("CCT_BENCH_CPU_SAMPLE", 300)
+CPU_SAMPLE = _env_int("CCT_BENCH_CPU_SAMPLE", 200)
 FAM_CAP = 16
+BINNED_QUALS = np.array([2, 12, 23, 37], np.uint8)  # NovaSeq RTA3 bins
 
 
 def make_dataset(rng):
@@ -49,7 +54,7 @@ def make_dataset(rng):
         # Member slots beyond fam_size are random too; both backends mask
         # them by fam_size, so PAD-ing them out here would only hide bugs.
         bases = rng.integers(0, 4, (N_PAIRS, FAM_CAP, READ_LEN)).astype(np.uint8)
-        quals = rng.integers(20, 41, (N_PAIRS, FAM_CAP, READ_LEN)).astype(np.uint8)
+        quals = BINNED_QUALS[rng.integers(0, len(BINNED_QUALS), (N_PAIRS, FAM_CAP, READ_LEN))]
         return bases, quals
 
     ba, qa = strand()
@@ -64,14 +69,7 @@ def make_dataset(rng):
 
 
 def cpu_reference_pair(ba, qa, na, bb, qb, nb):
-    """Reference-style SSCS x2 + duplex vote for ONE pair.
-
-    Uses the repo's own Counter-loop oracle (`core.consensus_cpu
-    .consensus_maker` — the faithful reimplementation of the reference's
-    ``consensus_helper.consensus_maker``) and ``core.duplex_cpu
-    .duplex_consensus``, so the baseline can never drift from the pinned
-    semantics or the defaults the TPU path uses.
-    """
+    """Reference-style SSCS x2 + duplex vote for ONE pair (Counter loop)."""
     from consensuscruncher_tpu.core.consensus_cpu import consensus_maker
     from consensuscruncher_tpu.core.duplex_cpu import duplex_consensus
 
@@ -83,10 +81,9 @@ def cpu_reference_pair(ba, qa, na, bb, qb, nb):
 
 
 def main():
-    import jax
-
     from consensuscruncher_tpu.ops.consensus_tpu import ConsensusConfig
-    from consensuscruncher_tpu.parallel.mesh import full_pipeline_step, make_mesh
+    from consensuscruncher_tpu.ops.packing import build_codebook, pack
+    from consensuscruncher_tpu.parallel.mesh import make_mesh, packed_pipeline_step
 
     rng = np.random.default_rng(42)
     (ba, qa, na), (bb, qb, nb) = make_dataset(rng)
@@ -98,19 +95,26 @@ def main():
         cpu_reference_pair(ba[i], qa[i], int(na[i]), bb[i], qb[i], int(nb[i]))
     cpu_fps = k / (time.perf_counter() - t0)
 
-    # --- TPU path: full sharded SSCS+DCS step over all available chips ---
+    # --- TPU path: packed sharded SSCS+DCS step over all available chips ---
     mesh = make_mesh()
-    step = full_pipeline_step(mesh, ConsensusConfig())
+    step = packed_pipeline_step(mesh, ConsensusConfig())
     n_dev = mesh.devices.size
     cap = (N_PAIRS // n_dev) * n_dev  # trim to mesh multiple
-    args = (ba[:cap], qa[:cap], na[:cap], bb[:cap], qb[:cap], nb[:cap])
+    book = build_codebook(BINNED_QUALS)
 
-    jax.block_until_ready(step(*args))  # compile + warm
+    def run():
+        """Host-to-host: pack, ship, vote, fetch every output."""
+        pa = pack(ba[:cap], qa[:cap], book)
+        pb = pack(bb[:cap], qb[:cap], book)
+        out = step(pa, na[:cap], pb, nb[:cap], book)
+        return [np.asarray(x) for x in out]
+
+    out = run()  # compile + warm
+    assert int(out[-1][0]) == cap  # stats: every slot has at least strand A
     best = float("inf")
-    for _ in range(3):
+    for _ in range(2):
         t0 = time.perf_counter()
-        out = step(*args)
-        jax.block_until_ready(out)
+        run()
         best = min(best, time.perf_counter() - t0)
     tpu_fps = cap / best
 

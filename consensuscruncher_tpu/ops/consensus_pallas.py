@@ -1,0 +1,191 @@
+"""Pallas TPU kernel for the consensus vote (the reference hot loop).
+
+Reference parity: ``ConsensusCruncher/consensus_helper.py:consensus_maker``
+(SURVEY.md §3.3) — same program as ``ops.consensus_tpu`` and bit-identical
+to the ``core.consensus_cpu`` oracle (enforced by tests/test_pallas.py).
+
+Why a hand kernel when XLA already fuses (SURVEY.md §7 step 5): the XLA
+path is free to materialize the ``(B, F, L, 5)`` one-hot and first-seen
+intermediates in HBM between fusions, which is 5-10x the input traffic of an
+op that is purely HBM-bandwidth-bound (VPU counting work, no MXU).  The
+Pallas kernel streams one family member per grid step into VMEM and keeps
+the vote state — per-lane count, first-seen and quality-sum planes — in
+VMEM scratch, so bases and quals are read from HBM exactly once and only
+the two ``(Bt, L)`` consensus planes go back out.
+
+Kernel shape notes (Mosaic): everything is kept 2-D ``(Bt, L)`` — 3-D bool
+intermediates trip a Mosaic relayout bug on v5e — and the family axis is the
+*inner sequential grid dimension* with scratch accumulation (the matmul-k
+pattern): init at ``j == 0``, accumulate per member, finalize + write
+outputs at ``j == F-1``.  The device layout is therefore ``(F, B, L)``
+(family-major), so each grid step's block is a clean tile-aligned
+``(1, Bt, L)`` plane; the wrapper transposes from the batching layer's
+``(B, F, L)``.  All shapes are static per (F, L) bucket, same as the XLA
+path.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache, partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from consensuscruncher_tpu.ops.consensus_tpu import ConsensusConfig
+from consensuscruncher_tpu.utils.phred import N, NUM_BASES
+
+_MAX_BT = 128  # batch rows per grid step (largest pow2 tile that divides B)
+
+
+def _vote_kernel(sizes_ref, bases_ref, quals_ref, out_b_ref, out_q_ref,
+                 counts_ref, firsts_ref, qsums_ref, *, fam_cap, num, den,
+                 qual_threshold, qual_cap):
+    j = pl.program_id(1)
+    bt = out_b_ref.shape[0]
+
+    @pl.when(j == 0)
+    def _init():
+        counts_ref[:] = jnp.zeros_like(counts_ref)
+        firsts_ref[:] = jnp.full_like(firsts_ref, fam_cap)
+        qsums_ref[:] = jnp.zeros_like(qsums_ref)
+
+    fam_sizes = sizes_ref[:]  # (Bt, 1) int32
+    # Widen uint8 -> int32 BEFORE any comparison: i1 vectors born from 8-bit
+    # compares hit a Mosaic relayout bug on v5e ("Invalid relayout ... i1").
+    base_j = bases_ref[0].astype(jnp.int32)  # (Bt, L) — member j of each family
+    qual_j = quals_ref[0].astype(jnp.int32)
+    row_valid = j < fam_sizes  # (Bt, 1) — member slot j exists in this family
+    qual_ok = qual_j >= qual_threshold
+    # Low-qual members vote N (reference demotes them, they still count
+    # against the cutoff denominator via fam_size).
+    eff_j = jnp.where(qual_ok, base_j, N)
+
+    for b in range(NUM_BASES):
+        sl = slice(b * bt, (b + 1) * bt)
+        eq = (eff_j == b) & row_valid
+        counts_ref[sl] += eq.astype(jnp.int32)
+        firsts_ref[sl] = jnp.minimum(firsts_ref[sl], jnp.where(eq, j, fam_cap))
+        agree = (base_j == b) & qual_ok & row_valid
+        qsums_ref[sl] += jnp.where(agree, qual_j, 0)
+
+    @pl.when(j == fam_cap - 1)
+    def _finalize():
+        counts = [counts_ref[b * bt : (b + 1) * bt] for b in range(NUM_BASES)]
+        firsts = [firsts_ref[b * bt : (b + 1) * bt] for b in range(NUM_BASES)]
+        max_count = counts[0]
+        for b in range(1, NUM_BASES):
+            max_count = jnp.maximum(max_count, counts[b])
+        # Lexicographic tie-break: among bases hitting max_count, earliest
+        # first-seen wins (CPython Counter insertion order); unrolled 5-lane
+        # argmin (Mosaic only lowers float argmin).
+        best_first = jnp.where(counts[0] == max_count, firsts[0], fam_cap + 1)
+        modal = jnp.zeros_like(max_count)
+        for b in range(1, NUM_BASES):
+            cand = jnp.where(counts[b] == max_count, firsts[b], fam_cap + 1)
+            better = cand < best_first
+            best_first = jnp.where(better, cand, best_first)
+            modal = jnp.where(better, b, modal)
+
+        qsum = jnp.zeros_like(max_count)
+        for b in range(NUM_BASES):
+            qsum = jnp.where(modal == b, qsums_ref[b * bt : (b + 1) * bt], qsum)
+
+        passed = (modal != N) & (max_count * den >= num * fam_sizes) & (fam_sizes > 0)
+        out_b_ref[:] = jnp.where(passed, modal, N).astype(jnp.uint8)
+        out_q_ref[:] = jnp.where(passed, jnp.minimum(qsum, qual_cap), 0).astype(jnp.uint8)
+
+
+def _pick_bt(batch: int) -> int:
+    """Largest pow2 tile <= _MAX_BT dividing batch (callers pad batch to a
+    multiple of 8, so bt is always tile-aligned or equal to the full axis)."""
+    bt = 1
+    while bt < _MAX_BT and batch % (bt * 2) == 0:
+        bt *= 2
+    return bt
+
+
+@lru_cache(maxsize=None)
+def _compiled_pallas(batch, fam_cap, length, num, den, qual_threshold, qual_cap, interpret):
+    bt = _pick_bt(batch)
+    kernel = partial(
+        _vote_kernel, fam_cap=fam_cap, num=num, den=den,
+        qual_threshold=qual_threshold, qual_cap=qual_cap,
+    )
+    fn = pl.pallas_call(
+        kernel,
+        grid=(batch // bt, fam_cap),
+        in_specs=[
+            pl.BlockSpec((bt, 1), lambda i, j: (i, 0)),
+            pl.BlockSpec((1, bt, length), lambda i, j: (j, i, 0)),
+            pl.BlockSpec((1, bt, length), lambda i, j: (j, i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((bt, length), lambda i, j: (i, 0)),
+            pl.BlockSpec((bt, length), lambda i, j: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((batch, length), jnp.uint8),
+            jax.ShapeDtypeStruct((batch, length), jnp.uint8),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((NUM_BASES * bt, length), jnp.int32),  # counts
+            pltpu.VMEM((NUM_BASES * bt, length), jnp.int32),  # first-seen
+            pltpu.VMEM((NUM_BASES * bt, length), jnp.int32),  # qual sums
+        ],
+        interpret=interpret,
+    )
+    return jax.jit(fn)
+
+
+def consensus_batch_pallas(
+    bases,
+    quals,
+    fam_sizes,
+    config: ConsensusConfig = ConsensusConfig(),
+    interpret: bool | None = None,
+):
+    """Drop-in Pallas twin of ``ops.consensus_tpu.consensus_batch``.
+
+    ``interpret=None`` auto-selects: real kernel on TPU backends, Pallas
+    interpreter elsewhere (CPU test meshes), keeping call sites portable.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    bases = np.asarray(bases, dtype=np.uint8)
+    quals = np.asarray(quals, dtype=np.uint8)
+    sizes = np.asarray(fam_sizes, dtype=np.int32)
+    batch, fam_cap, length = bases.shape
+    num, den = config.cutoff_rational
+    if fam_cap * max(num, den) >= 2**31:
+        raise ValueError("cutoff cross-multiply would overflow int32 — split the family bucket")
+
+    # Family-major layout + batch padded to a tile-aligned multiple of 8.
+    # Host-side transpose keeps the device read single-pass (a device-side
+    # transpose would cost the extra HBM round trip the kernel exists to
+    # avoid); np.ascontiguousarray pays one memcpy on the host instead.
+    pad = (-batch) % 8 if batch >= 8 else 0
+    if pad:
+        bases = np.concatenate([bases, np.zeros((pad, fam_cap, length), np.uint8)])
+        quals = np.concatenate([quals, np.zeros((pad, fam_cap, length), np.uint8)])
+        sizes = np.concatenate([sizes, np.zeros(pad, np.int32)])
+    fb = np.ascontiguousarray(bases.transpose(1, 0, 2))
+    fq = np.ascontiguousarray(quals.transpose(1, 0, 2))
+
+    fn = _compiled_pallas(
+        batch + pad, fam_cap, length, num, den,
+        int(config.qual_threshold), int(config.qual_cap), bool(interpret),
+    )
+    out_b, out_q = fn(sizes.reshape(-1, 1), fb, fq)
+    if pad:
+        out_b, out_q = out_b[:batch], out_q[:batch]
+    return out_b, out_q
+
+
+def consensus_batch_pallas_host(bases, quals, fam_sizes,
+                                config: ConsensusConfig = ConsensusConfig(),
+                                interpret: bool | None = None):
+    b, q = consensus_batch_pallas(bases, quals, fam_sizes, config, interpret)
+    return np.asarray(b), np.asarray(q)

@@ -40,6 +40,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from consensuscruncher_tpu.ops.consensus_tpu import ConsensusConfig, _consensus_one_family
 from consensuscruncher_tpu.ops.duplex_tpu import duplex_vote
+from consensuscruncher_tpu.ops.packing import unpack_device
 from consensuscruncher_tpu.utils.phred import N
 
 FAMILY_AXIS = "families"
@@ -160,19 +161,8 @@ def sharded_consensus_batch(
     return out_b, out_q, StepStats.from_vector(jax.device_get(stats))
 
 
-def full_pipeline_step(mesh: Mesh, config: ConsensusConfig = ConsensusConfig()):
-    """The jittable whole-pipeline device step for one sharded batch.
-
-    This is the "training step" analog the driver dry-runs: per shard it
-    (1) votes SSCS consensus for a batch of strand-A families and a batch
-    of strand-B families, (2) pairs them into duplex (DCS) consensus —
-    the two-strand agreement vote of ``ops.duplex_tpu`` — and (3) psums
-    global stats.  Everything is one XLA program per (B, F, L) bucket.
-
-    Returns a jitted ``fn(bases_a, quals_a, sizes_a, bases_b, quals_b,
-    sizes_b) -> (sscs_a, qual_a, sscs_b, qual_b, dcs, dcs_qual, stats)``
-    with batch axes sharded over the mesh.
-    """
+def _pipeline_shard_fn(config: ConsensusConfig):
+    """Per-shard SSCS+DCS program shared by the raw and packed step builders."""
     num, den = config.cutoff_rational
     qual_threshold, qual_cap = int(config.qual_threshold), int(config.qual_cap)
 
@@ -198,11 +188,55 @@ def full_pipeline_step(mesh: Mesh, config: ConsensusConfig = ConsensusConfig()):
         stats = jax.lax.psum(local, axis_name=FAMILY_AXIS)
         return sscs_a, qa, sscs_b, qb, dcs, dq, stats
 
+    return shard_fn
+
+
+def full_pipeline_step(mesh: Mesh, config: ConsensusConfig = ConsensusConfig()):
+    """The jittable whole-pipeline device step for one sharded batch.
+
+    This is the "training step" analog the driver dry-runs: per shard it
+    (1) votes SSCS consensus for a batch of strand-A families and a batch
+    of strand-B families, (2) pairs them into duplex (DCS) consensus —
+    the two-strand agreement vote of ``ops.duplex_tpu`` — and (3) psums
+    global stats.  Everything is one XLA program per (B, F, L) bucket.
+
+    Returns a jitted ``fn(bases_a, quals_a, sizes_a, bases_b, quals_b,
+    sizes_b) -> (sscs_a, qual_a, sscs_b, qual_b, dcs, dcs_qual, stats)``
+    with batch axes sharded over the mesh.
+    """
+    shard_fn = _pipeline_shard_fn(config)
     spec = P(FAMILY_AXIS)
     mapped = jax.shard_map(
         shard_fn,
         mesh=mesh,
         in_specs=(spec,) * 6,
+        out_specs=(spec, spec, spec, spec, spec, spec, P()),
+    )
+    return jax.jit(mapped)
+
+
+def packed_pipeline_step(mesh: Mesh, config: ConsensusConfig = ConsensusConfig()):
+    """`full_pipeline_step` over the 1-byte wire format of ``ops.packing``.
+
+    Halves host->device traffic — the Amdahl term of the whole pipeline —
+    by shipping base+qual as one packed byte per member-position; the
+    unpack (mask/shift/tiny gather) fuses into the vote kernel's first
+    read.  Signature: ``fn(packed_a, sizes_a, packed_b, sizes_b, codebook)
+    -> (sscs_a, qual_a, sscs_b, qual_b, dcs, dcs_qual, stats)`` with batch
+    axes sharded over the mesh and the (16,) codebook replicated.
+    """
+    step = _pipeline_shard_fn(config)
+
+    def shard_fn(packed_a, sizes_a, packed_b, sizes_b, codebook):
+        bases_a, quals_a = unpack_device(packed_a, codebook)
+        bases_b, quals_b = unpack_device(packed_b, codebook)
+        return step(bases_a, quals_a, sizes_a, bases_b, quals_b, sizes_b)
+
+    spec = P(FAMILY_AXIS)
+    mapped = jax.shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(spec, spec, spec, spec, P()),
         out_specs=(spec, spec, spec, spec, spec, spec, P()),
     )
     return jax.jit(mapped)
