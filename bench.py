@@ -11,13 +11,16 @@ reimplementation of the reference hot loop (``core.consensus_cpu
 ``consensus_helper.consensus_maker`` — plus ``core.duplex_cpu
 .duplex_consensus``), timed per duplex pair on a subsample.
 
-The TPU path is the production sharded program (``parallel.mesh
-.packed_pipeline_step``): host packing into the 1-byte wire format
-(``ops.packing``), host->device transfer, the jitted shard_map vote+duplex
-step, and device->host fetch of every output — timed **host-to-host**
-(``np.asarray`` on all outputs; plain ``block_until_ready`` does not
-guarantee completion through the axon tunnel, which is also why transfer
-volume, not FLOPs, is the Amdahl term this format attacks).
+The TPU path is the transfer-optimal production program
+(``ops.consensus_segment``): the ragged families ship as a zero-padding
+flat member stream in the 4-bit wire format (``ops.packing.pack4`` — 2
+member-positions per byte for ACGT reads with NovaSeq-binned quals), one
+jitted segment-reduction SSCS+DCS step runs on device, and the outputs
+come back packed (3 bytes/position; DCS re-derived on host).  Timed
+**host-to-host** including packing and output derivation (``np.asarray``
+on all outputs; plain ``block_until_ready`` does not guarantee completion
+through the axon tunnel, which is also why transfer volume, not FLOPs, is
+the Amdahl term this layout attacks).
 
 Scale knobs (env): CCT_BENCH_PAIRS (default 20000), CCT_BENCH_LEN (100),
 CCT_BENCH_MEAN_FAM (4), CCT_BENCH_CPU_SAMPLE (200).
@@ -80,10 +83,28 @@ def cpu_reference_pair(ba, qa, na, bb, qb, nb):
     return duplex_consensus(sa, qa_out, sb, qb_out)
 
 
+def flatten_members(ba, qa, na, bb, qb, nb):
+    """Dense per-strand arrays -> flat member stream (host-side, vectorized)."""
+    from consensuscruncher_tpu.ops.consensus_segment import build_member_stream
+
+    fam_ids, ranks, sizes = build_member_stream([na, nb])
+    # Row gather: member k of family slot f lives at (f % N_PAIRS, rank) in
+    # the strand-(f // N_PAIRS) dense array.
+    n_pairs = na.shape[0]
+    strand_b = fam_ids >= n_pairs
+    row = np.where(strand_b, fam_ids - n_pairs, fam_ids)
+    rows = np.where(strand_b[:, None], bb[row, ranks], ba[row, ranks])
+    qrows = np.where(strand_b[:, None], qb[row, ranks], qa[row, ranks])
+    return rows.astype(np.uint8), qrows.astype(np.uint8), fam_ids, ranks, sizes
+
+
 def main():
+    from consensuscruncher_tpu.ops.consensus_segment import (
+        derive_host_outputs,
+        segment_duplex_step,
+    )
     from consensuscruncher_tpu.ops.consensus_tpu import ConsensusConfig
-    from consensuscruncher_tpu.ops.packing import build_codebook, pack
-    from consensuscruncher_tpu.parallel.mesh import make_mesh, packed_pipeline_step
+    from consensuscruncher_tpu.ops.packing import build_codebook4, pack4
 
     rng = np.random.default_rng(42)
     (ba, qa, na), (bb, qb, nb) = make_dataset(rng)
@@ -95,28 +116,27 @@ def main():
         cpu_reference_pair(ba[i], qa[i], int(na[i]), bb[i], qb[i], int(nb[i]))
     cpu_fps = k / (time.perf_counter() - t0)
 
-    # --- TPU path: packed sharded SSCS+DCS step over all available chips ---
-    mesh = make_mesh()
-    step = packed_pipeline_step(mesh, ConsensusConfig())
-    n_dev = mesh.devices.size
-    cap = (N_PAIRS // n_dev) * n_dev  # trim to mesh multiple
-    book = build_codebook(BINNED_QUALS)
+    # --- TPU path: zero-padding segment SSCS+DCS step, packed both ways ---
+    step = segment_duplex_step(N_PAIRS, READ_LEN, ConsensusConfig(), packed_out=True)
+    book = build_codebook4(BINNED_QUALS)
+    rows, qrows, fam_ids, ranks, sizes = flatten_members(ba, qa, na, bb, qb, nb)
 
     def run():
-        """Host-to-host: pack, ship, vote, fetch every output."""
-        pa = pack(ba[:cap], qa[:cap], book)
-        pb = pack(bb[:cap], qb[:cap], book)
-        out = step(pa, na[:cap], pb, nb[:cap], book)
-        return [np.asarray(x) for x in out]
+        """Host-to-host: pack, ship, vote, fetch, derive final outputs."""
+        packed = pack4(rows, qrows, book)
+        pk, out_qa, out_qb, stats = step(packed, sizes, book)
+        return derive_host_outputs(
+            np.asarray(pk), np.asarray(out_qa), np.asarray(out_qb), na, nb
+        ), np.asarray(stats)
 
-    out = run()  # compile + warm
-    assert int(out[-1][0]) == cap  # stats: every slot has at least strand A
+    _, stats = run()  # compile + warm
+    assert int(stats[0]) == N_PAIRS  # every slot has at least strand A
     best = float("inf")
     for _ in range(2):
         t0 = time.perf_counter()
         run()
         best = min(best, time.perf_counter() - t0)
-    tpu_fps = cap / best
+    tpu_fps = N_PAIRS / best
 
     print(
         json.dumps(

@@ -1,0 +1,201 @@
+"""Segment-reduction consensus: zero-padding wire format for ragged families.
+
+The dense kernels (``ops.consensus_tpu``, ``ops.consensus_pallas``) pad every
+family to a power-of-two member capacity — perfect for compute-bound regimes,
+but the end-to-end pipeline is **host->device-transfer-bound**, and with mean
+family size ~4 in a 16-cap bucket the dense layout ships ~4x more bytes than
+there are reads.  This module is the transfer-optimal layout:
+
+- wire: a flat ``(M, L)`` member stream (every real read exactly once, 4-bit
+  packed via ``ops.packing.pack4``) + per-family ``sizes`` only — the
+  per-member ``fam_ids``/``ranks`` are derived on device from ``sizes``
+  (``derive_ids_device``), so they cost nothing to ship.
+- device: the per-family one-hot vote becomes five lane-unrolled
+  ``jax.ops.segment_sum`` / ``segment_min`` reductions over the member axis
+  (XLA lowers these to sorted-segment scatters; ``num_segments`` is static),
+  then the usual dense (NF, L) modal/tie-break/cutoff/quality program of the
+  reference ``consensus_maker`` semantics — bit-identical to the oracle.
+
+Family slots are caller-assigned: for duplex data, put strand A of pair i in
+slot ``i`` and strand B in slot ``n_pairs + i`` — SSCS of both strands comes
+out of ONE segment pass and the duplex vote is a row-split elementwise step.
+
+Reference parity: consensus_helper.consensus_maker + DCS_maker
+.duplex_consensus (SURVEY.md §3.3, §3.2); tie-break and rational-cutoff
+semantics identical to ops/consensus_tpu.py.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache, partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from consensuscruncher_tpu.ops.consensus_tpu import ConsensusConfig
+from consensuscruncher_tpu.ops.duplex_tpu import duplex_vote
+from consensuscruncher_tpu.ops.packing import unpack4_device
+from consensuscruncher_tpu.utils.phred import N, NUM_BASES
+
+
+def derive_ids_device(sizes, total_members: int):
+    """``(fam_ids, ranks)`` from per-family sizes, on device.
+
+    ``total_members`` must be the static ``sizes.sum()`` (it is the member
+    stream's leading dim, so callers always have it).
+    """
+    sizes = sizes.astype(jnp.int32)
+    nf = sizes.shape[0]
+    fam_ids = jnp.repeat(jnp.arange(nf, dtype=jnp.int32), sizes,
+                         total_repeat_length=total_members)
+    starts = jnp.concatenate([jnp.zeros(1, jnp.int32), jnp.cumsum(sizes)[:-1]])
+    ranks = jnp.arange(total_members, dtype=jnp.int32) - jnp.take(starts, fam_ids)
+    return fam_ids, ranks
+
+
+def _segment_vote(bases, quals, fam_ids, ranks, sizes, *, num_families, num, den,
+                  qual_threshold, qual_cap):
+    """(M, L) member stream -> (NF, L) consensus via segment reductions."""
+    m, length = bases.shape
+    bases = bases.astype(jnp.int32)  # widen before compares (cheap, VPU)
+    quals = quals.astype(jnp.int32)
+    qual_ok = quals >= qual_threshold
+    eff = jnp.where(qual_ok, bases, N)
+    rank_col = ranks[:, None]
+
+    counts, firsts, qsums = [], [], []
+    for b in range(NUM_BASES):
+        eq = eff == b  # (M, L) bool
+        counts.append(jax.ops.segment_sum(eq.astype(jnp.int32), fam_ids,
+                                          num_segments=num_families))
+        firsts.append(jax.ops.segment_min(jnp.where(eq, rank_col, m), fam_ids,
+                                          num_segments=num_families))
+        agree = (bases == b) & qual_ok
+        qsums.append(jax.ops.segment_sum(jnp.where(agree, quals, 0), fam_ids,
+                                         num_segments=num_families))
+
+    max_count = counts[0]
+    for b in range(1, NUM_BASES):
+        max_count = jnp.maximum(max_count, counts[b])
+    best_first = jnp.where(counts[0] == max_count, firsts[0], m + 1)
+    modal = jnp.zeros_like(max_count)
+    for b in range(1, NUM_BASES):
+        cand = jnp.where(counts[b] == max_count, firsts[b], m + 1)
+        better = cand < best_first
+        best_first = jnp.where(better, cand, best_first)
+        modal = jnp.where(better, b, modal)
+
+    qsum = jnp.zeros_like(max_count)
+    for b in range(NUM_BASES):
+        qsum = jnp.where(modal == b, qsums[b], qsum)
+
+    fam = sizes[:, None]  # (NF, 1)
+    passed = (modal != N) & (max_count * den >= num * fam) & (fam > 0)
+    out_b = jnp.where(passed, modal, N).astype(jnp.uint8)
+    out_q = jnp.where(passed, jnp.minimum(qsum, qual_cap), 0).astype(jnp.uint8)
+    return out_b, out_q
+
+
+@lru_cache(maxsize=None)
+def _compiled_segment_duplex(num_pairs, length, num, den, qual_threshold, qual_cap,
+                             packed_out):
+    """One jitted program: unpack4 -> segment SSCS for both strands -> duplex.
+
+    Family slots: strand A of pair i -> i, strand B -> num_pairs + i (slots
+    with size 0 = absent strand).  ``packed_out=False`` returns the dense
+    7-tuple (sscs_a, qual_a, sscs_b, qual_b, dcs, dcs_qual, stats);
+    ``packed_out=True`` returns ``(packed_bases, qual_a, qual_b, stats)``
+    where ``packed_bases = sscs_a | sscs_b << 3`` — 3 bytes/position on the
+    wire instead of 6; the DCS is a pure function of the SSCS pair, so the
+    host derives it (``derive_host_outputs``) instead of downloading it.
+    """
+    nf = 2 * num_pairs
+
+    def fn(packed, sizes, codebook4):
+        # fam_ids/ranks are pure functions of sizes — derive them on device
+        # (O(M) VPU work) instead of shipping 8 bytes/member over the wire.
+        m = packed.shape[0]
+        # Trace-time guard (mirrors consensus_tpu): the rational-cutoff
+        # cross-multiply must fit int32 (JAX silently downcasts int64 when
+        # x64 is off); M bounds any family's size in this layout.
+        if m * max(num, den) >= 2**31:
+            raise ValueError(
+                f"member stream of {m} with cutoff {num}/{den} could overflow the "
+                "int32 cutoff compare — chunk the stream"
+            )
+        fam_ids, ranks = derive_ids_device(sizes, m)
+        bases, quals = unpack4_device(packed, codebook4, length)
+        out_b, out_q = _segment_vote(
+            bases, quals, fam_ids, ranks, sizes,
+            num_families=nf, num=num, den=den,
+            qual_threshold=qual_threshold, qual_cap=qual_cap,
+        )
+        sscs_a, qa = out_b[:num_pairs], out_q[:num_pairs]
+        sscs_b, qb = out_b[num_pairs:], out_q[num_pairs:]
+        both = (sizes[:num_pairs] > 0) & (sizes[num_pairs:] > 0)
+        dcs, dq = duplex_vote(sscs_a, qa, sscs_b, qb, qual_cap=qual_cap,
+                              agree_mask=both[:, None])
+        real = ((sizes[:num_pairs] > 0) | (sizes[num_pairs:] > 0)).sum().astype(jnp.int32)
+        duplexes = both.sum().astype(jnp.int32)
+        n_count = jnp.where(both[:, None], (dcs == N).astype(jnp.int32), 0).sum()
+        q_sum = jnp.where(both[:, None], dq.astype(jnp.int32), 0).sum()
+        stats = jnp.stack([real, duplexes, n_count, q_sum])
+        if packed_out:
+            return (sscs_a | sscs_b << 3).astype(jnp.uint8), qa, qb, stats
+        return sscs_a, qa, sscs_b, qb, dcs, dq, stats
+
+    return jax.jit(fn)
+
+
+def segment_duplex_step(num_pairs: int, length: int,
+                        config: ConsensusConfig = ConsensusConfig(),
+                        packed_out: bool = False):
+    """Build the jitted zero-padding SSCS+DCS step (see _compiled_segment_duplex)."""
+    num, den = config.cutoff_rational
+    return _compiled_segment_duplex(
+        num_pairs, length, num, den, int(config.qual_threshold), int(config.qual_cap),
+        bool(packed_out),
+    )
+
+
+def derive_host_outputs(packed_bases, qa, qb, sizes_a, sizes_b,
+                        config: ConsensusConfig = ConsensusConfig()):
+    """Host-side inverse of ``packed_out=True``: unpack SSCS bases and
+    re-derive the DCS exactly as the device's ``duplex_vote`` would (the DCS
+    is a pure elementwise function of the SSCS pair; recomputing ~MBs in
+    numpy is ~100x cheaper than downloading it through the tunnel).
+
+    ``config`` must be the SAME ConsensusConfig the step was built with —
+    the qual cap feeds the duplex quality sum.
+
+    Returns ``(sscs_a, qa, sscs_b, qb, dcs, dq)`` uint8 arrays.
+    """
+    qual_cap = int(config.qual_cap)
+    packed_bases = np.asarray(packed_bases, dtype=np.uint8)
+    qa = np.asarray(qa, dtype=np.uint8)
+    qb = np.asarray(qb, dtype=np.uint8)
+    sscs_a = packed_bases & 7
+    sscs_b = packed_bases >> 3
+    both = (np.asarray(sizes_a) > 0) & (np.asarray(sizes_b) > 0)
+    agree = (sscs_a == sscs_b) & (sscs_a < N) & both[:, None]
+    dcs = np.where(agree, sscs_a, np.uint8(N)).astype(np.uint8)
+    qsum = qa.astype(np.int32) + qb.astype(np.int32)
+    dq = np.where(agree, np.minimum(qsum, qual_cap), 0).astype(np.uint8)
+    return sscs_a, qa, sscs_b, qb, dcs, dq
+
+
+def build_member_stream(size_arrays: list[np.ndarray]):
+    """Host-side prep: per-family sizes -> (fam_ids, ranks, sizes) for the
+    slot layout ``concatenate(size_arrays)`` (strand A slots then strand B).
+
+    Returns int32 arrays; total members M = sizes.sum().  The member rows
+    themselves must be stacked by the caller in the same order (all of
+    family 0's reads, then family 1's, ...).
+    """
+    sizes = np.concatenate([np.asarray(s, dtype=np.int32) for s in size_arrays])
+    fam_ids = np.repeat(np.arange(sizes.size, dtype=np.int32), sizes)
+    # rank within family: global arange minus each family's start offset
+    starts = np.concatenate([[0], np.cumsum(sizes)[:-1]]).astype(np.int32)
+    ranks = np.arange(fam_ids.size, dtype=np.int32) - starts[fam_ids]
+    return fam_ids, ranks, sizes

@@ -35,8 +35,9 @@ def build_codebook(quals: np.ndarray) -> np.ndarray | None:
     uniq = np.unique(np.asarray(quals, dtype=np.uint8))
     if uniq.size > CODEBOOK_SIZE:
         return None
-    # Pad with the max value so the whole array stays sorted (pack's
-    # searchsorted depends on it); duplicate tail entries are harmless.
+    # Pad with the max value: duplicate tail entries are harmless because
+    # the qual->index LUT maps a duplicated value to its last slot and every
+    # duplicate slot decodes back to the same value.
     book = np.full(CODEBOOK_SIZE, uniq[-1] if uniq.size else 0, dtype=np.uint8)
     book[: uniq.size] = uniq
     return book
@@ -46,16 +47,25 @@ def can_pack(quals: np.ndarray) -> bool:
     return np.unique(np.asarray(quals, dtype=np.uint8)).size <= CODEBOOK_SIZE
 
 
+def _qual_lut(codebook: np.ndarray) -> np.ndarray:
+    """256-entry qual->index LUT (O(1) per element vs searchsorted's log k;
+    packing runs over tens of MB per batch, so per-element cost is the whole
+    game).  Entries not in the codebook map to 255 so pack can detect them."""
+    lut = np.full(256, 255, dtype=np.uint8)
+    lut[codebook] = np.arange(len(codebook), dtype=np.uint8)
+    return lut
+
+
 def pack(bases: np.ndarray, quals: np.ndarray, codebook: np.ndarray) -> np.ndarray:
     """Pack base codes + quals into one uint8 array of the same shape."""
     bases = np.asarray(bases, dtype=np.uint8)
     quals = np.asarray(quals, dtype=np.uint8)
     if bases.max(initial=0) > _BASE_MASK:
         raise ValueError("base codes exceed 3 bits")
-    idx = np.searchsorted(codebook, quals)  # codebook sorted in its prefix
-    if not (codebook[np.minimum(idx, CODEBOOK_SIZE - 1)] == quals).all():
+    idx = _qual_lut(codebook)[quals]
+    if idx.max(initial=0) >= CODEBOOK_SIZE:
         raise ValueError("quals not in codebook — rebuild with build_codebook")
-    return (bases | (idx.astype(np.uint8) << _BASE_BITS)).astype(np.uint8)
+    return bases | (idx << _BASE_BITS)
 
 
 def unpack_host(packed: np.ndarray, codebook: np.ndarray):
@@ -75,4 +85,113 @@ def unpack_device(packed, codebook):
     packed = packed.astype(jnp.uint8)
     bases = packed & _BASE_MASK
     quals = jnp.take(codebook.astype(jnp.uint8), (packed >> _BASE_BITS).astype(jnp.int32))
+    return bases, quals
+
+
+# ---------------------------------------------------------------------------
+# 4-bit mode: two member-positions per byte (base 2 bits + qual-bin 2 bits).
+#
+# Covers the dominant case — ACGT-only reads (no in-read no-calls) with
+# basecaller-binned quals (NovaSeq RTA3 emits exactly 4 bins) — for another
+# 2x on the wire.  Dead slots (member rows >= fam_size, positions >= true
+# length) must be encoded as (base 0, bin 0): the vote kernel masks them by
+# fam_size and callers slice by true length, so their decoded value never
+# reaches an output (same contract the 8-bit path's random-slot tests pin).
+# ---------------------------------------------------------------------------
+
+CODEBOOK4_SIZE = 4
+
+
+def can_pack4(bases: np.ndarray, quals: np.ndarray) -> bool:
+    """True iff bases are pure ACGT and quals fit a 4-entry codebook."""
+    return (
+        int(np.asarray(bases, dtype=np.uint8).max(initial=0)) < 4
+        and np.unique(np.asarray(quals, dtype=np.uint8)).size <= CODEBOOK4_SIZE
+    )
+
+
+def sanitize_for_pack4(bases: np.ndarray, quals: np.ndarray, fam_sizes: np.ndarray,
+                       fill_qual: int, lengths: np.ndarray | None = None):
+    """Rewrite dead slots of a bucketed ``(B, F, L)`` batch so it packs.
+
+    ``parallel.batching`` fills member rows >= fam_size — and, when given
+    ``lengths``, positions >= the family's true consensus length — with PAD
+    (5) bases and qual 0, neither of which the 4-bit wire admits.  The vote
+    kernels mask dead rows by ``fam_sizes`` and callers slice positions by
+    ``lengths``, so those contents are free — encode them as (base A,
+    ``fill_qual``) where ``fill_qual`` is any codebook value (use
+    ``codebook4[0]``).  Returns new arrays; inputs are not modified.  After
+    this, ``can_pack4`` decides on the *live* data alone.
+
+    Caveat: length-padded positions of LIVE rows do reach the vote (they
+    lose to real bases only by emitting N there in the PAD encoding); with
+    this sanitization they vote (A, fill_qual) instead, so positions >=
+    length come back as A-consensus rather than N.  Callers must slice
+    outputs to ``lengths`` — which the stage layer already does.
+    """
+    bases = np.asarray(bases, dtype=np.uint8).copy()
+    quals = np.asarray(quals, dtype=np.uint8).copy()
+    fam_sizes = np.asarray(fam_sizes)
+    dead = np.arange(bases.shape[1])[None, :, None] >= fam_sizes[:, None, None]
+    if lengths is not None:
+        dead = dead | (np.arange(bases.shape[2])[None, None, :] >= np.asarray(lengths)[:, None, None])
+    dead = np.broadcast_to(dead, bases.shape)
+    bases[dead] = 0
+    quals[dead] = fill_qual
+    return bases, quals
+
+
+def build_codebook4(quals: np.ndarray) -> np.ndarray | None:
+    """Sorted unique quals padded to 4 entries, or None if they don't fit."""
+    uniq = np.unique(np.asarray(quals, dtype=np.uint8))
+    if uniq.size > CODEBOOK4_SIZE:
+        return None
+    book = np.full(CODEBOOK4_SIZE, uniq[-1] if uniq.size else 0, dtype=np.uint8)
+    book[: uniq.size] = uniq
+    return book
+
+
+def pack4(bases: np.ndarray, quals: np.ndarray, codebook4: np.ndarray) -> np.ndarray:
+    """Pack to two positions per byte along the last axis.
+
+    Returns uint8 of shape ``(..., ceil(L/2))``; odd lengths are padded with
+    a zero nibble (decoded as base A / bin-0 qual — callers slice by true
+    length, see module note).
+    """
+    bases = np.asarray(bases, dtype=np.uint8)
+    quals = np.asarray(quals, dtype=np.uint8)
+    if bases.max(initial=0) > 3:
+        raise ValueError("4-bit mode requires pure-ACGT bases")
+    idx = _qual_lut(codebook4)[quals]
+    if idx.max(initial=0) >= CODEBOOK4_SIZE:
+        raise ValueError("quals not in 4-entry codebook")
+    nib = bases | (idx << 2)  # (..., L) 4-bit values
+    if nib.shape[-1] % 2:
+        pad = np.zeros(nib.shape[:-1] + (1,), np.uint8)
+        nib = np.concatenate([nib, pad], axis=-1)
+    return (nib[..., 0::2] | (nib[..., 1::2] << 4)).astype(np.uint8)
+
+
+def unpack4_host(packed: np.ndarray, codebook4: np.ndarray, length: int):
+    """Host-side inverse of :func:`pack4` (tests / debugging)."""
+    packed = np.asarray(packed, dtype=np.uint8)
+    nib = np.empty(packed.shape[:-1] + (packed.shape[-1] * 2,), np.uint8)
+    nib[..., 0::2] = packed & 0xF
+    nib[..., 1::2] = packed >> 4
+    nib = nib[..., :length]
+    return nib & 3, np.asarray(codebook4, dtype=np.uint8)[nib >> 2]
+
+
+def unpack4_device(packed, codebook4, length: int):
+    """Traceable device-side inverse of :func:`pack4`.
+
+    ``length`` is static (the true position count before nibble padding).
+    """
+    packed = packed.astype(jnp.uint8)
+    lo = packed & 0xF
+    hi = packed >> 4
+    nib = jnp.stack([lo, hi], axis=-1).reshape(packed.shape[:-1] + (packed.shape[-1] * 2,))
+    nib = nib[..., :length]
+    bases = nib & 3
+    quals = jnp.take(codebook4.astype(jnp.uint8), (nib >> 2).astype(jnp.int32))
     return bases, quals

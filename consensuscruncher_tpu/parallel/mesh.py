@@ -40,7 +40,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from consensuscruncher_tpu.ops.consensus_tpu import ConsensusConfig, _consensus_one_family
 from consensuscruncher_tpu.ops.duplex_tpu import duplex_vote
-from consensuscruncher_tpu.ops.packing import unpack_device
+from consensuscruncher_tpu.ops.packing import unpack4_device, unpack_device
 from consensuscruncher_tpu.utils.phred import N
 
 FAMILY_AXIS = "families"
@@ -230,6 +230,38 @@ def packed_pipeline_step(mesh: Mesh, config: ConsensusConfig = ConsensusConfig()
     def shard_fn(packed_a, sizes_a, packed_b, sizes_b, codebook):
         bases_a, quals_a = unpack_device(packed_a, codebook)
         bases_b, quals_b = unpack_device(packed_b, codebook)
+        return step(bases_a, quals_a, sizes_a, bases_b, quals_b, sizes_b)
+
+    spec = P(FAMILY_AXIS)
+    mapped = jax.shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(spec, spec, spec, spec, P()),
+        out_specs=(spec, spec, spec, spec, spec, spec, P()),
+    )
+    return jax.jit(mapped)
+
+
+def packed4_pipeline_step(mesh: Mesh, length: int, config: ConsensusConfig = ConsensusConfig()):
+    """`full_pipeline_step` over the 4-bit wire format (``ops.packing.pack4``).
+
+    Quarter the raw host->device traffic for the dominant data shape:
+    pure-ACGT reads with 4-bin (NovaSeq) quals, two member-positions per
+    byte.  ``length`` is the true (pre-nibble-padding) position count and
+    is static per compiled step.  Signature: ``fn(packed_a, sizes_a,
+    packed_b, sizes_b, codebook4) -> (sscs_a, qual_a, sscs_b, qual_b, dcs,
+    dcs_qual, stats)``.
+
+    Batches from ``parallel.batching`` carry PAD (5) in dead slots, which
+    the 4-bit wire can't encode — run them through
+    ``ops.packing.sanitize_for_pack4`` first (the vote kernels mask dead
+    rows by fam_size, so the rewrite never changes live output).
+    """
+    step = _pipeline_shard_fn(config)
+
+    def shard_fn(packed_a, sizes_a, packed_b, sizes_b, codebook4):
+        bases_a, quals_a = unpack4_device(packed_a, codebook4, length)
+        bases_b, quals_b = unpack4_device(packed_b, codebook4, length)
         return step(bases_a, quals_a, sizes_a, bases_b, quals_b, sizes_b)
 
     spec = P(FAMILY_AXIS)

@@ -6,13 +6,18 @@ import pytest
 from consensuscruncher_tpu.ops.packing import (
     CODEBOOK_SIZE,
     build_codebook,
+    build_codebook4,
     can_pack,
+    can_pack4,
     pack,
+    pack4,
+    unpack4_host,
     unpack_host,
 )
 from consensuscruncher_tpu.parallel.mesh import (
     full_pipeline_step,
     make_mesh,
+    packed4_pipeline_step,
     packed_pipeline_step,
 )
 from consensuscruncher_tpu.utils.phred import PAD
@@ -48,6 +53,84 @@ def test_codebook_limits():
     assert build_codebook(too_many) is None
     with pytest.raises(ValueError):
         pack(np.zeros(4, np.uint8), np.full(4, 99, np.uint8), build_codebook(BINNED_QUALS))
+
+
+def test_pack4_roundtrip_even_and_odd_lengths():
+    rng = np.random.default_rng(2)
+    for L in (64, 33):
+        bases = rng.integers(0, 4, (4, 3, L)).astype(np.uint8)
+        quals = BINNED_QUALS[rng.integers(0, 4, (4, 3, L))]
+        assert can_pack4(bases, quals)
+        book = build_codebook4(quals)
+        packed = pack4(bases, quals, book)
+        assert packed.shape == (4, 3, (L + 1) // 2)
+        ub, uq = unpack4_host(packed, book, L)
+        np.testing.assert_array_equal(ub, bases)
+        np.testing.assert_array_equal(uq, quals)
+
+
+def test_pack4_rejects_n_bases_and_wide_quals():
+    bases_n = np.array([[4, 0]], np.uint8)  # an in-read no-call
+    quals = np.array([[2, 2]], np.uint8)
+    assert not can_pack4(bases_n, quals)
+    with pytest.raises(ValueError):
+        pack4(bases_n, quals, build_codebook4(quals))
+    wide = np.arange(5, dtype=np.uint8)
+    assert build_codebook4(wide) is None
+
+
+def test_packed4_step_matches_raw_step():
+    rng = np.random.default_rng(6)
+    mesh = make_mesh(8)
+    L = 33  # odd: exercises the nibble padding
+    ba = rng.integers(0, 4, (16, 4, L)).astype(np.uint8)
+    qa = BINNED_QUALS[rng.integers(0, 4, (16, 4, L))]
+    bb = rng.integers(0, 4, (16, 4, L)).astype(np.uint8)
+    qb = BINNED_QUALS[rng.integers(0, 4, (16, 4, L))]
+    na = rng.integers(1, 5, 16).astype(np.int32)
+    nb = rng.integers(0, 5, 16).astype(np.int32)
+
+    raw = full_pipeline_step(mesh)
+    p4 = packed4_pipeline_step(mesh, L)
+    book = build_codebook4(BINNED_QUALS)
+    raw_out = [np.asarray(x) for x in raw(ba, qa, na, bb, qb, nb)]
+    p4_out = [np.asarray(x) for x in p4(pack4(ba, qa, book), na, pack4(bb, qb, book), nb, book)]
+    for r, p in zip(raw_out, p4_out):
+        np.testing.assert_array_equal(r, p)
+
+
+def test_sanitize_for_pack4_bucketed_batch():
+    """A real bucket_families batch (PAD-filled dead slots) packs after
+    sanitization and yields the same consensus as the raw dense step."""
+    from consensuscruncher_tpu.parallel.batching import bucket_families
+    from consensuscruncher_tpu.ops.consensus_tpu import consensus_batch_host
+
+    rng = np.random.default_rng(9)
+    fams = []
+    for i in range(12):
+        f = int(rng.integers(1, 5))
+        seqs = [rng.integers(0, 4, 40).astype(np.uint8) for _ in range(f)]
+        quals = [BINNED_QUALS[rng.integers(0, 4, 40)] for _ in range(f)]
+        fams.append((i, seqs, quals))
+    batches = list(bucket_families(iter(fams)))
+    book = build_codebook4(BINNED_QUALS)
+    from consensuscruncher_tpu.ops.packing import sanitize_for_pack4, unpack4_host
+
+    for batch in batches:
+        assert not can_pack4(batch.bases, batch.quals)  # PAD slots block it
+        sb, sq = sanitize_for_pack4(
+            batch.bases, batch.quals, batch.fam_sizes, int(book[0]), batch.lengths
+        )
+        assert can_pack4(sb, sq)
+        L = batch.bases.shape[2]
+        packed = pack4(sb, sq, book)
+        ub, uq = unpack4_host(packed, book, L)
+        raw_b, raw_q = consensus_batch_host(batch.bases, batch.quals, batch.fam_sizes)
+        san_b, san_q = consensus_batch_host(ub, uq, batch.fam_sizes)
+        for i in range(batch.n_real):
+            ln = int(batch.lengths[i])  # live positions only (see sanitize caveat)
+            np.testing.assert_array_equal(san_b[i, :ln], raw_b[i, :ln])
+            np.testing.assert_array_equal(san_q[i, :ln], raw_q[i, :ln])
 
 
 def test_packed_step_matches_raw_step():

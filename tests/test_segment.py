@@ -1,0 +1,98 @@
+"""Segment-reduction consensus parity vs the Counter-loop oracle."""
+
+import numpy as np
+
+from consensuscruncher_tpu.core.consensus_cpu import consensus_maker
+from consensuscruncher_tpu.core.duplex_cpu import duplex_consensus
+from consensuscruncher_tpu.ops.consensus_segment import (
+    build_member_stream,
+    segment_duplex_step,
+)
+from consensuscruncher_tpu.ops.packing import build_codebook4, pack4
+from consensuscruncher_tpu.utils.phred import N
+
+BINNED = np.array([2, 12, 23, 37], np.uint8)
+
+
+def test_build_member_stream():
+    fam_ids, ranks, sizes = build_member_stream([np.array([2, 1]), np.array([0, 3])])
+    np.testing.assert_array_equal(sizes, [2, 1, 0, 3])
+    np.testing.assert_array_equal(fam_ids, [0, 0, 1, 3, 3, 3])
+    np.testing.assert_array_equal(ranks, [0, 1, 0, 0, 1, 2])
+
+
+def test_segment_duplex_matches_oracle():
+    rng = np.random.default_rng(3)
+    n_pairs, L = 16, 33
+    na = rng.integers(1, 6, n_pairs).astype(np.int32)
+    nb = rng.integers(0, 6, n_pairs).astype(np.int32)
+    fam_ids, ranks, sizes = build_member_stream([na, nb])
+    m = int(sizes.sum())
+    bases = rng.integers(0, 4, (m, L)).astype(np.uint8)
+    quals = BINNED[rng.integers(0, 4, (m, L))]
+
+    book = build_codebook4(BINNED)
+    step = segment_duplex_step(n_pairs, L)
+    out = [np.asarray(x) for x in step(pack4(bases, quals, book), sizes, book)]
+    sscs_a, qa, sscs_b, qb, dcs, dq, stats = out
+
+    starts = np.concatenate([[0], np.cumsum(sizes)[:-1]])
+    n_dup = 0
+    for i in range(n_pairs):
+        sa, sq = consensus_maker(bases[starts[i] : starts[i] + na[i]],
+                                 quals[starts[i] : starts[i] + na[i]])
+        np.testing.assert_array_equal(sscs_a[i], sa)
+        np.testing.assert_array_equal(qa[i], sq)
+        j = n_pairs + i
+        if nb[i]:
+            n_dup += 1
+            sb, sbq = consensus_maker(bases[starts[j] : starts[j] + nb[i]],
+                                      quals[starts[j] : starts[j] + nb[i]])
+            np.testing.assert_array_equal(sscs_b[i], sb)
+            ed, edq = duplex_consensus(sa, sq, sb, sbq)
+            np.testing.assert_array_equal(dcs[i], ed)
+            np.testing.assert_array_equal(dq[i], edq)
+        else:
+            assert (sscs_b[i] == N).all() and (qb[i] == 0).all()
+            assert (dcs[i] == N).all() and (dq[i] == 0).all()
+    assert int(stats[0]) == n_pairs and int(stats[1]) == n_dup
+
+
+def test_packed_out_matches_dense_out():
+    from consensuscruncher_tpu.ops.consensus_segment import derive_host_outputs
+
+    rng = np.random.default_rng(8)
+    n_pairs, L = 8, 16
+    na = rng.integers(1, 4, n_pairs).astype(np.int32)
+    nb = rng.integers(0, 4, n_pairs).astype(np.int32)
+    fam_ids, ranks, sizes = build_member_stream([na, nb])
+    m = int(sizes.sum())
+    bases = rng.integers(0, 4, (m, L)).astype(np.uint8)
+    quals = BINNED[rng.integers(0, 4, (m, L))]
+    book = build_codebook4(BINNED)
+    packed = pack4(bases, quals, book)
+
+    dense = [np.asarray(x) for x in
+             segment_duplex_step(n_pairs, L)(packed, sizes, book)]
+    pk = [np.asarray(x) for x in
+          segment_duplex_step(n_pairs, L, packed_out=True)(packed, sizes, book)]
+    derived = derive_host_outputs(pk[0], pk[1], pk[2], na, nb)
+    for d, e in zip(derived, dense[:6]):
+        np.testing.assert_array_equal(d, e)
+    np.testing.assert_array_equal(pk[3], dense[6])
+
+
+def test_segment_tie_break_first_seen():
+    # Family of 2 disagreeing at cutoff 0.5: first member's base wins.
+    from consensuscruncher_tpu.ops.consensus_tpu import ConsensusConfig
+
+    na, nb = np.array([2], np.int32), np.array([0], np.int32)
+    fam_ids, ranks, sizes = build_member_stream([na, nb])
+    bases = np.array([[3], [1]], np.uint8)
+    quals = np.array([[37], [37]], np.uint8)
+    book = build_codebook4(BINNED)
+    step = segment_duplex_step(1, 1, ConsensusConfig(cutoff=0.5))
+    out = [np.asarray(x) for x in step(pack4(bases, quals, book), sizes, book)]
+    exp_b, exp_q = consensus_maker(bases, quals, cutoff=0.5)
+    np.testing.assert_array_equal(out[0][0], exp_b)
+    np.testing.assert_array_equal(out[1][0], exp_q)
