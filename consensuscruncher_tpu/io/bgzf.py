@@ -22,6 +22,10 @@ import struct
 import zlib
 from typing import BinaryIO, Iterator
 
+import numpy as np
+
+from consensuscruncher_tpu.io import native
+
 MAX_BLOCK_PAYLOAD = 0xFF00  # htslib convention: keep compressed block < 64 KiB
 
 BGZF_EOF = bytes.fromhex("1f8b08040000000000ff0600424302001b0003000000000000000000")
@@ -108,13 +112,96 @@ def iter_blocks(fh: BinaryIO) -> Iterator[bytes]:
             yield payload
 
 
+def scan_block_metas(buf: bytes) -> tuple[tuple, int]:
+    """Scan complete BGZF blocks at the head of ``buf`` (framing only).
+
+    Returns ``((src_off, comp_len, isize, crc), consumed)`` where the four
+    uint arrays describe each complete block's raw-deflate span and expected
+    payload, and ``consumed`` is the byte offset of the first incomplete
+    block (callers carry the tail into the next scan).  Raises ValueError on
+    malformed framing — the same conditions ``iter_blocks`` rejects.
+    """
+    offs, lens, sizes, crcs = [], [], [], []
+    pos, end = 0, len(buf)
+    while True:
+        if pos + 18 > end:
+            break
+        if buf[pos] != 0x1F or buf[pos + 1] != 0x8B:
+            raise ValueError("not a BGZF/gzip stream (bad magic)")
+        if buf[pos + 3] & 0x04 == 0:
+            raise ValueError("gzip member lacks the BGZF BC extra subfield")
+        (xlen,) = struct.unpack_from("<H", buf, pos + 10)
+        if pos + 12 + xlen > end:
+            break
+        bsize = None
+        off = pos + 12
+        while off + 4 <= pos + 12 + xlen:
+            si1, si2, slen = buf[off], buf[off + 1], struct.unpack_from("<H", buf, off + 2)[0]
+            if si1 == 0x42 and si2 == 0x43 and slen == 2:
+                (bsize,) = struct.unpack_from("<H", buf, off + 4)
+                break
+            off += 4 + slen
+        if bsize is None:
+            raise ValueError("gzip member lacks the BGZF BC extra subfield")
+        block_size = bsize + 1
+        if pos + block_size > end:
+            break
+        data_off = pos + 12 + xlen
+        data_len = block_size - (12 + xlen) - 8
+        if data_len < 0:
+            raise ValueError("corrupt BGZF block (BSIZE smaller than framing)")
+        crc, isize = _TAIL.unpack_from(buf, pos + block_size - 8)
+        offs.append(data_off)
+        lens.append(data_len)
+        sizes.append(isize)
+        crcs.append(crc)
+        pos += block_size
+    metas = (
+        np.asarray(offs, dtype=np.uint64),
+        np.asarray(lens, dtype=np.uint32),
+        np.asarray(sizes, dtype=np.uint32),
+        np.asarray(crcs, dtype=np.uint32),
+    )
+    return metas, pos
+
+
+_NATIVE_READ_CHUNK = 8 << 20  # compressed bytes per native inflate batch
+
+
+def _iter_chunks_native(fh: BinaryIO) -> Iterator[bytes]:
+    """Yield decompressed chunks via the native batch codec (multi-block)."""
+    tail = b""
+    while True:
+        metas, consumed = scan_block_metas(tail)
+        while consumed == 0:
+            more = fh.read(_NATIVE_READ_CHUNK)
+            if not more:
+                if tail:
+                    raise ValueError("truncated BGZF block")
+                return
+            tail += more
+            metas, consumed = scan_block_metas(tail)
+        payload = native.inflate_blocks(tail, *metas)
+        tail = tail[consumed:]
+        if payload:
+            yield payload
+
+
 class BgzfReader(io.RawIOBase):
-    """File-like sequential reader over BGZF blocks."""
+    """File-like sequential reader over BGZF blocks.
+
+    When the native C++ codec (``io/native``) is available, blocks are
+    inflated in parallel batches; otherwise the pure-Python ``iter_blocks``
+    path serves identical bytes.
+    """
 
     def __init__(self, path_or_fh):
         self._own = _is_pathlike(path_or_fh)
         self._fh = open(path_or_fh, "rb") if self._own else path_or_fh
-        self._blocks = iter_blocks(self._fh)
+        if native.available():
+            self._blocks = _iter_chunks_native(self._fh)
+        else:
+            self._blocks = iter_blocks(self._fh)
         self._buf = b""
         self._pos = 0
 
@@ -150,33 +237,55 @@ class BgzfReader(io.RawIOBase):
         super().close()
 
 
+_NATIVE_WRITE_TARGET = 4 << 20  # payload bytes buffered per native deflate batch
+
+
 class BgzfWriter(io.RawIOBase):
-    """File-like writer that emits proper BGZF blocks + EOF marker on close."""
+    """File-like writer that emits proper BGZF blocks + EOF marker on close.
+
+    With the native C++ codec available, payload is buffered and deflated in
+    parallel multi-block batches; block boundaries (every MAX_BLOCK_PAYLOAD
+    bytes) and the deflate parameters match the pure-Python path, so both
+    produce byte-identical files.
+    """
 
     def __init__(self, path_or_fh, level: int = 6):
         self._own = _is_pathlike(path_or_fh)
         self._fh = open(path_or_fh, "wb") if self._own else path_or_fh
         self._level = level
         self._buf = bytearray()
+        self._native = native.available()
 
     def writable(self) -> bool:
         return True
 
     def write(self, data) -> int:
         self._buf += data
-        while len(self._buf) >= MAX_BLOCK_PAYLOAD:
-            self._flush_block(MAX_BLOCK_PAYLOAD)
+        if self._native:
+            if len(self._buf) >= _NATIVE_WRITE_TARGET:
+                n_full = (len(self._buf) // MAX_BLOCK_PAYLOAD) * MAX_BLOCK_PAYLOAD
+                self._flush_native(n_full)
+        else:
+            while len(self._buf) >= MAX_BLOCK_PAYLOAD:
+                self._flush_block(MAX_BLOCK_PAYLOAD)
         return len(data)
 
     def _flush_block(self, size: int) -> None:
         payload, self._buf = bytes(self._buf[:size]), self._buf[size:]
         self._fh.write(compress_block(payload, self._level))
 
+    def _flush_native(self, size: int) -> None:
+        payload, self._buf = bytes(self._buf[:size]), self._buf[size:]
+        self._fh.write(native.deflate_payload(payload, self._level))
+
     def close(self) -> None:
         if self.closed:
             return
         if self._buf:
-            self._flush_block(len(self._buf))
+            if self._native:
+                self._flush_native(len(self._buf))
+            else:
+                self._flush_block(len(self._buf))
         self._fh.write(BGZF_EOF)
         if self._own:
             self._fh.close()

@@ -1,0 +1,177 @@
+"""ctypes loader for the native C++ BGZF codec (``bgzf_native.cpp``).
+
+The shared library is compiled lazily with ``g++`` the first time it's
+needed and cached next to the source; a content hash in the cache name
+means editing the .cpp (or bumping the ABI) transparently rebuilds.  Every
+entry point degrades to the pure-Python codec in ``io/bgzf.py`` when the
+toolchain is missing or ``CCT_NO_NATIVE=1`` is set — the native layer is a
+throughput optimization, never a correctness dependency.
+
+Public surface:
+- ``available()`` — is the native codec usable?
+- ``inflate_blocks(src, src_off, comp_len, isize, crc)`` — batch raw-inflate
+  with CRC/ISIZE checks (metadata arrays from ``io.bgzf.scan_block_metas``)
+- ``deflate_payload(data, level)`` — payload bytes -> framed BGZF blocks
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+import threading
+
+import numpy as np
+
+_ABI_VERSION = 3
+_SRC = os.path.join(os.path.dirname(__file__), "bgzf_native.cpp")
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_tried = False
+
+
+def _build_and_load() -> ctypes.CDLL | None:
+    with open(_SRC, "rb") as fh:
+        digest = hashlib.sha256(fh.read()).hexdigest()[:16]
+    cache_dir = os.environ.get("CCT_NATIVE_CACHE", os.path.dirname(_SRC))
+    so_path = os.path.join(cache_dir, f"bgzf_native-{digest}.so")
+    if not os.path.exists(so_path):
+        # Everything filesystem/toolchain-shaped is guarded: an unwritable
+        # cache dir or missing g++ must degrade to the pure-Python codec,
+        # never crash the open (the module's "optional, not a dependency"
+        # contract).
+        tmp = None
+        try:
+            fd, tmp = tempfile.mkstemp(suffix=".so", dir=cache_dir)
+            os.close(fd)
+            cmd = ["g++", "-O3", "-shared", "-fPIC", "-pthread", _SRC, "-o", tmp, "-lz"]
+            subprocess.run(cmd, check=True, capture_output=True, timeout=300)
+            os.replace(tmp, so_path)  # atomic: concurrent builders race benignly
+        except (OSError, subprocess.SubprocessError):
+            if tmp is not None and os.path.exists(tmp):
+                os.unlink(tmp)
+            return None
+    try:
+        lib = ctypes.CDLL(so_path)
+    except OSError:
+        return None
+    lib.cct_version.restype = ctypes.c_int
+    if lib.cct_version() != _ABI_VERSION:
+        return None
+    lib.cct_out_stride.restype = ctypes.c_uint32
+    lib.cct_inflate_blocks.restype = ctypes.c_int
+    lib.cct_inflate_blocks.argtypes = [
+        ctypes.c_char_p,                    # src
+        ctypes.POINTER(ctypes.c_uint64),    # src_off
+        ctypes.POINTER(ctypes.c_uint32),    # comp_len
+        ctypes.POINTER(ctypes.c_uint32),    # isize
+        ctypes.POINTER(ctypes.c_uint32),    # crc
+        ctypes.c_int64,                     # n
+        ctypes.c_char_p,                    # out
+        ctypes.POINTER(ctypes.c_uint64),    # out_off
+        ctypes.c_int32,                     # n_threads
+    ]
+    lib.cct_deflate_blocks.restype = ctypes.c_int
+    lib.cct_deflate_blocks.argtypes = [
+        ctypes.c_char_p,                    # payload
+        ctypes.c_uint64,                    # payload_len
+        ctypes.c_int32,                     # level
+        ctypes.c_int32,                     # n_threads
+        ctypes.c_char_p,                    # out
+        ctypes.POINTER(ctypes.c_uint32),    # out_sizes
+    ]
+    return lib
+
+
+def _get() -> ctypes.CDLL | None:
+    global _lib, _tried
+    if _tried:
+        return _lib
+    with _lock:
+        if not _tried:
+            if os.environ.get("CCT_NO_NATIVE", "") not in ("", "0"):
+                _lib = None
+            else:
+                _lib = _build_and_load()
+            _tried = True
+    return _lib
+
+
+def available() -> bool:
+    return _get() is not None
+
+
+def _as_u32_ptr(arr: np.ndarray):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32))
+
+
+def _as_u64_ptr(arr: np.ndarray):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64))
+
+
+def inflate_blocks(
+    src: bytes,
+    src_off: np.ndarray,
+    comp_len: np.ndarray,
+    isize: np.ndarray,
+    crc: np.ndarray,
+    n_threads: int = 0,
+) -> bytes:
+    """Inflate a batch of raw-deflate spans of ``src`` (CRC/ISIZE-checked).
+
+    The four metadata arrays come from the Python-side framing scan
+    (``io.bgzf.scan_block_metas``).  Returns the concatenated payloads as a
+    memoryview (zero-copy over the inflate buffer — callers slice/join it).
+    Raises ValueError if any block fails validation.
+    """
+    lib = _get()
+    if lib is None:
+        raise RuntimeError("native BGZF codec unavailable")
+    n = len(src_off)
+    out_off = np.zeros(n, dtype=np.uint64)
+    if n > 1:
+        np.cumsum(isize[:-1].astype(np.uint64), out=out_off[1:])
+    total = int(isize.sum(dtype=np.uint64))
+    # np.empty (no zero-fill) + one tobytes copy: ctypes.create_string_buffer
+    # memsets and its .raw is pathologically slow at tens of MB.
+    out = np.empty(max(total, 1), dtype=np.uint8)
+    rc = lib.cct_inflate_blocks(
+        src,
+        _as_u64_ptr(np.ascontiguousarray(src_off, dtype=np.uint64)),
+        _as_u32_ptr(np.ascontiguousarray(comp_len, dtype=np.uint32)),
+        _as_u32_ptr(np.ascontiguousarray(isize, dtype=np.uint32)),
+        _as_u32_ptr(np.ascontiguousarray(crc, dtype=np.uint32)),
+        n,
+        out.ctypes.data_as(ctypes.c_char_p),
+        _as_u64_ptr(out_off),
+        int(n_threads),
+    )
+    if rc != 0:
+        raise ValueError(f"BGZF native inflate failed at block {rc - 1} (bad stream or CRC)")
+    return out[:total].data
+
+
+def deflate_payload(data: bytes, level: int = 6, n_threads: int = 0) -> bytes:
+    """Compress ``data`` into complete framed BGZF blocks (no EOF marker)."""
+    lib = _get()
+    if lib is None:
+        raise RuntimeError("native BGZF codec unavailable")
+    if not data:
+        return b""
+    stride = int(lib.cct_out_stride())
+    from consensuscruncher_tpu.io.bgzf import MAX_BLOCK_PAYLOAD
+
+    n_blocks = (len(data) + MAX_BLOCK_PAYLOAD - 1) // MAX_BLOCK_PAYLOAD
+    out = np.empty(n_blocks * stride, dtype=np.uint8)
+    sizes = np.zeros(n_blocks, dtype=np.uint32)
+    rc = lib.cct_deflate_blocks(
+        data, len(data), int(level), int(n_threads),
+        out.ctypes.data_as(ctypes.c_char_p), _as_u32_ptr(sizes),
+    )
+    if rc != 0:
+        raise ValueError(f"BGZF native deflate failed at block {rc - 1}")
+    mv = memoryview(out)
+    return b"".join(mv[i * stride : i * stride + int(sizes[i])] for i in range(n_blocks))

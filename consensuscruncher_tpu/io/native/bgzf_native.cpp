@@ -1,0 +1,192 @@
+// Native BGZF hot path: multithreaded block inflate/deflate over zlib.
+//
+// Role in the framework (see io/bgzf.py): BGZF files are sequences of
+// independent <=64 KiB gzip members, which makes the codec embarrassingly
+// parallel at block granularity.  The reference pipeline gets this layer
+// from htslib (via pysam, SURVEY.md §2 "Native components"); this is the
+// framework's first-party equivalent.  Python scans block framing (cheap:
+// one 18-byte header per 64 KiB) and hands batches of raw-deflate spans to
+// these entry points, which fan out across std::thread workers.
+//
+// C ABI (ctypes-loaded by io/native/__init__.py):
+//   cct_inflate_blocks  — batch raw-inflate with CRC32 + ISIZE validation
+//   cct_deflate_blocks  — batch payload -> complete BGZF blocks (header +
+//                         deflate + CRC32/ISIZE tail), stride-sliced output
+//   cct_version         — ABI version stamp so a stale .so is never trusted
+//
+// Build (done lazily by the Python wrapper):
+//   g++ -O3 -shared -fPIC -pthread bgzf_native.cpp -o bgzf_native.so -lz
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+#include <zlib.h>
+
+namespace {
+
+constexpr int kAbiVersion = 3;
+constexpr uint32_t kMaxBlockPayload = 0xFF00;  // htslib payload bound
+constexpr uint32_t kOutStride = 0x10400;       // per-block output slot (worst case + slack)
+
+// BGZF block header for a complete block of `block_size` total bytes.
+void write_block_header(uint8_t* dst, uint32_t block_size) {
+  static const uint8_t fixed[16] = {
+      0x1f, 0x8b, 0x08, 0x04,  // gzip magic, deflate, FEXTRA
+      0,    0,    0,    0,     // mtime
+      0,    0xff,              // XFL, OS=unknown
+      6,    0,                 // XLEN = 6
+      0x42, 0x43, 2,    0,     // 'B','C', SLEN=2
+  };
+  std::memcpy(dst, fixed, 16);
+  const uint32_t bsize = block_size - 1;
+  dst[16] = static_cast<uint8_t>(bsize & 0xff);
+  dst[17] = static_cast<uint8_t>((bsize >> 8) & 0xff);
+}
+
+void put_le32(uint8_t* dst, uint32_t v) {
+  dst[0] = static_cast<uint8_t>(v & 0xff);
+  dst[1] = static_cast<uint8_t>((v >> 8) & 0xff);
+  dst[2] = static_cast<uint8_t>((v >> 16) & 0xff);
+  dst[3] = static_cast<uint8_t>((v >> 24) & 0xff);
+}
+
+int clamp_threads(int32_t n_threads, int64_t n_items) {
+  int hw = static_cast<int>(std::thread::hardware_concurrency());
+  if (hw <= 0) hw = 4;
+  int n = n_threads > 0 ? n_threads : hw;
+  if (static_cast<int64_t>(n) > n_items) n = static_cast<int>(n_items);
+  return n < 1 ? 1 : n;
+}
+
+// Run fn(i) over [0, n) on up to n_threads workers; first nonzero return
+// (1-based error code) wins.
+template <typename Fn>
+int parallel_for(int64_t n, int32_t n_threads, Fn fn) {
+  if (n <= 0) return 0;
+  const int workers = clamp_threads(n_threads, n);
+  if (workers == 1) {
+    for (int64_t i = 0; i < n; ++i) {
+      int rc = fn(i);
+      if (rc) return rc;
+    }
+    return 0;
+  }
+  std::atomic<int64_t> next(0);
+  std::atomic<int> err(0);
+  std::vector<std::thread> pool;
+  pool.reserve(workers);
+  for (int w = 0; w < workers; ++w) {
+    pool.emplace_back([&] {
+      for (;;) {
+        const int64_t i = next.fetch_add(1, std::memory_order_relaxed);
+        if (i >= n || err.load(std::memory_order_relaxed)) return;
+        int rc = fn(i);
+        if (rc) {
+          int expected = 0;
+          err.compare_exchange_strong(expected, rc);
+          return;
+        }
+      }
+    });
+  }
+  for (auto& t : pool) t.join();
+  return err.load();
+}
+
+// Raw-deflate `src` into `dst`; returns compressed size or 0 on failure.
+uint32_t raw_deflate(const uint8_t* src, uint32_t src_len, int level, uint8_t* dst,
+                     uint32_t dst_cap) {
+  z_stream zs;
+  std::memset(&zs, 0, sizeof(zs));
+  if (deflateInit2(&zs, level, Z_DEFLATED, -15, 8, Z_DEFAULT_STRATEGY) != Z_OK) return 0;
+  zs.next_in = const_cast<uint8_t*>(src);
+  zs.avail_in = src_len;
+  zs.next_out = dst;
+  zs.avail_out = dst_cap;
+  const int rc = deflate(&zs, Z_FINISH);
+  const uint32_t produced = dst_cap - zs.avail_out;
+  deflateEnd(&zs);
+  return rc == Z_STREAM_END ? produced : 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+int cct_version() { return kAbiVersion; }
+
+uint32_t cct_out_stride() { return kOutStride; }
+
+// Inflate n raw-deflate spans of `src` into `out`, validating CRC32 + ISIZE.
+//
+//   src_off[i]  : offset of block i's deflate data within src
+//   comp_len[i] : its length (tail excluded)
+//   isize[i]    : expected inflated size (from the block tail)
+//   crc[i]      : expected CRC32 of the inflated payload
+//   out_off[i]  : where payload i lands in `out` (caller-prefixed cumsum)
+//
+// Returns 0 on success, i+1 if block i failed (bad stream / CRC / ISIZE).
+int cct_inflate_blocks(const uint8_t* src, const uint64_t* src_off, const uint32_t* comp_len,
+                       const uint32_t* isize, const uint32_t* crc, int64_t n, uint8_t* out,
+                       const uint64_t* out_off, int32_t n_threads) {
+  return parallel_for(n, n_threads, [&](int64_t i) -> int {
+    uint8_t* dst = out + out_off[i];
+    const uint32_t want = isize[i];
+    if (want == 0) {
+      // Empty block (e.g. EOF marker): nothing to inflate, CRC of "" is 0.
+      return crc[i] == 0 ? 0 : static_cast<int>(i + 1);
+    }
+    z_stream zs;
+    std::memset(&zs, 0, sizeof(zs));
+    if (inflateInit2(&zs, -15) != Z_OK) return static_cast<int>(i + 1);
+    zs.next_in = const_cast<uint8_t*>(src + src_off[i]);
+    zs.avail_in = comp_len[i];
+    zs.next_out = dst;
+    zs.avail_out = want;
+    const int rc = inflate(&zs, Z_FINISH);
+    const uint32_t produced = want - zs.avail_out;
+    inflateEnd(&zs);
+    if (rc != Z_STREAM_END || produced != want) return static_cast<int>(i + 1);
+    if (crc32(crc32(0L, Z_NULL, 0), dst, want) != crc[i]) return static_cast<int>(i + 1);
+    return 0;
+  });
+}
+
+// Compress `payload` into complete BGZF blocks of <= kMaxBlockPayload bytes
+// each.  Output is stride-sliced: block i is written at out + i*kOutStride,
+// its total size recorded in out_sizes[i]; the caller compacts the slices.
+// Incompressible data that would overflow the 16-bit BSIZE field is retried
+// as stored (level 0) deflate, which always fits (htslib does the same).
+//
+// Returns 0 on success, i+1 if block i failed.
+int cct_deflate_blocks(const uint8_t* payload, uint64_t payload_len, int32_t level,
+                       int32_t n_threads, uint8_t* out, uint32_t* out_sizes) {
+  const int64_t n_blocks =
+      payload_len == 0 ? 0
+                       : static_cast<int64_t>((payload_len + kMaxBlockPayload - 1) / kMaxBlockPayload);
+  return parallel_for(n_blocks, n_threads, [&](int64_t i) -> int {
+    const uint64_t start = static_cast<uint64_t>(i) * kMaxBlockPayload;
+    const uint32_t len = static_cast<uint32_t>(
+        payload_len - start < kMaxBlockPayload ? payload_len - start : kMaxBlockPayload);
+    const uint8_t* src = payload + start;
+    uint8_t* slot = out + static_cast<uint64_t>(i) * kOutStride;
+    uint8_t* data = slot + 18;
+    const uint32_t data_cap = kOutStride - 26;
+    uint32_t comp = raw_deflate(src, len, level, data, data_cap);
+    if (comp == 0 || comp + 26 > 0xFFFF) {
+      comp = raw_deflate(src, len, 0, data, data_cap);  // stored: always fits
+      if (comp == 0 || comp + 26 > 0xFFFF) return static_cast<int>(i + 1);
+    }
+    const uint32_t block_size = comp + 26;
+    write_block_header(slot, block_size);
+    put_le32(data + comp, static_cast<uint32_t>(crc32(crc32(0L, Z_NULL, 0), src, len)));
+    put_le32(data + comp + 4, len);
+    out_sizes[i] = block_size;
+    return 0;
+  });
+}
+
+}  // extern "C"
