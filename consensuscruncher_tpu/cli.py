@@ -39,10 +39,12 @@ from consensuscruncher_tpu.core.tags import DEFAULT_BDELIM
 from consensuscruncher_tpu.io import sam as sam_mod
 from consensuscruncher_tpu.io.bam import BamWriter, merge_bams, sort_bam
 from consensuscruncher_tpu.stages.extract_barcodes import run_extract
-from consensuscruncher_tpu.stages.dcs_maker import run_dcs
+from consensuscruncher_tpu.stages import dcs_maker, singleton_correction, sscs_maker
+from consensuscruncher_tpu.stages.dcs_maker import DcsResult, run_dcs
 from consensuscruncher_tpu.stages.generate_plots import plot_family_size, plot_read_recovery
-from consensuscruncher_tpu.stages.singleton_correction import run_singleton_correction
-from consensuscruncher_tpu.stages.sscs_maker import run_sscs
+from consensuscruncher_tpu.stages.singleton_correction import SingletonResult, run_singleton_correction
+from consensuscruncher_tpu.stages.sscs_maker import SscsResult, run_sscs
+from consensuscruncher_tpu.utils.manifest import RunManifest
 
 
 def _config_defaults(path: str | None, section: str) -> dict:
@@ -132,48 +134,106 @@ def consensus(args) -> dict:
     for d in dirs.values():
         os.makedirs(d, exist_ok=True)
 
-    sscs_res = run_sscs(
-        args.input,
-        os.path.join(dirs["sscs"], name),
-        cutoff=args.cutoff,
-        qual_threshold=args.qualscore,
-        backend=args.backend,
-        bdelim=args.bdelim,
+    # Explicit checkpoint/resume over the stage-file model (SURVEY.md §5):
+    # with --resume, stages whose recorded inputs/outputs/params still
+    # fingerprint-match are skipped; any upstream change invalidates the rest.
+    manifest = RunManifest(os.path.join(base, "manifest.json"))
+    resume = getattr(args, "resume", False)
+
+    def checkpointed(stage, inputs, outputs, params, run, rebuild):
+        """Run a stage unless --resume can prove its outputs are intact."""
+        if resume and manifest.can_skip(stage, inputs, params):
+            print(f"consensus: resume — skipping {stage} (outputs intact)")
+            return rebuild()
+        result = run()
+        manifest.record(stage, inputs, outputs, params)
+        return result
+
+    sscs_prefix = os.path.join(dirs["sscs"], name)
+    sscs_paths = sscs_maker.output_paths(sscs_prefix)
+    # badReads.bam is excluded from the manifest: --cleanup may delete it,
+    # and nothing downstream consumes it — its absence must not force a
+    # re-run.  time_tracker changes every run, so it's excluded too.
+    sscs_res = checkpointed(
+        "sscs",
+        [args.input],
+        [sscs_paths[k] for k in ("sscs", "singleton", "stats_txt", "stats_json", "families")],
+        {"cutoff": args.cutoff, "qualscore": args.qualscore, "bdelim": args.bdelim},
+        run=lambda: run_sscs(
+            args.input,
+            sscs_prefix,
+            cutoff=args.cutoff,
+            qual_threshold=args.qualscore,
+            backend=args.backend,
+            bdelim=args.bdelim,
+        ),
+        rebuild=lambda: SscsResult.from_prefix(sscs_prefix),
     )
 
     sscs_path_parts = [sscs_res.sscs_bam]
-    stats_jsons = [os.path.join(dirs["sscs"], f"{name}.sscs_stats.json")]
+    stats_jsons = [sscs_paths["stats_json"]]
 
     # DCS pairs over SSCSes PLUS rescued singletons (that's the point of the
     # rescue: a corrected singleton can now form a duplex with its partner —
     # reference merges sscs + rescue BAMs before DCS_maker, SURVEY.md §3.2).
     dcs_input = sscs_res.sscs_bam
     if args.scorrect:
-        corr = run_singleton_correction(
-            sscs_res.singleton_bam,
-            sscs_res.sscs_bam,
-            os.path.join(dirs["singleton"], name),
-            max_mismatch=args.max_mismatch,
+        corr_prefix = os.path.join(dirs["singleton"], name)
+        corr_paths = singleton_correction.output_paths(corr_prefix)
+        corr = checkpointed(
+            "singleton_correction",
+            [sscs_res.singleton_bam, sscs_res.sscs_bam],
+            list(corr_paths.values()),
+            {"max_mismatch": args.max_mismatch},
+            run=lambda: run_singleton_correction(
+                sscs_res.singleton_bam,
+                sscs_res.sscs_bam,
+                corr_prefix,
+                max_mismatch=args.max_mismatch,
+            ),
+            rebuild=lambda: SingletonResult.from_prefix(corr_prefix),
         )
         sscs_path_parts += [corr.sscs_rescue_bam, corr.singleton_rescue_bam, corr.remaining_bam]
-        stats_jsons.append(os.path.join(dirs["singleton"], f"{name}.singleton_stats.json"))
+        stats_jsons.append(corr_paths["stats_json"])
         dcs_input = os.path.join(dirs["dcs"], f"{name}.sscs.rescued.bam")
-        merge_bams(
-            [sscs_res.sscs_bam, corr.sscs_rescue_bam, corr.singleton_rescue_bam], dcs_input
+        merge_inputs = [sscs_res.sscs_bam, corr.sscs_rescue_bam, corr.singleton_rescue_bam]
+        checkpointed(
+            "merge_rescued", merge_inputs, [dcs_input], {},
+            run=lambda: merge_bams(merge_inputs, dcs_input),
+            rebuild=lambda: None,
         )
     else:
         sscs_path_parts.append(sscs_res.singleton_bam)
 
-    dcs_res = run_dcs(dcs_input, os.path.join(dirs["dcs"], name), backend=args.backend)
-    stats_jsons.append(os.path.join(dirs["dcs"], f"{name}.dcs_stats.json"))
+    dcs_prefix = os.path.join(dirs["dcs"], name)
+    dcs_paths = dcs_maker.output_paths(dcs_prefix)
+    dcs_res = checkpointed(
+        "dcs",
+        [dcs_input],
+        list(dcs_paths.values()),
+        {},
+        run=lambda: run_dcs(dcs_input, dcs_prefix, backend=args.backend),
+        rebuild=lambda: DcsResult.from_prefix(dcs_prefix),
+    )
+    stats_jsons.append(dcs_paths["stats_json"])
 
     # "all unique" merges (reference: samtools merge, SURVEY.md §3.2):
     # SSCS path = every unique molecule's best single-strand evidence;
     # DCS path  = duplex reads plus SSCSes that found no partner.
     all_sscs = os.path.join(dirs["all_unique"], f"{name}.all.unique.sscs.bam")
-    merge_bams([p for p in sscs_path_parts if _nonempty(p)], all_sscs)
+    sscs_merge_in = [p for p in sscs_path_parts if _nonempty(p)]
+    checkpointed(
+        "merge_all_sscs", sscs_merge_in, [all_sscs], {},
+        run=lambda: merge_bams(sscs_merge_in, all_sscs),
+        rebuild=lambda: None,
+    )
     all_dcs = os.path.join(dirs["all_unique"], f"{name}.all.unique.dcs.bam")
-    merge_bams([p for p in (dcs_res.dcs_bam, dcs_res.sscs_singleton_bam) if _nonempty(p)], all_dcs)
+    dcs_merge_in = [p for p in (dcs_res.dcs_bam, dcs_res.sscs_singleton_bam) if _nonempty(p)]
+    checkpointed(
+        "merge_all_dcs", dcs_merge_in, [all_dcs], {},
+        run=lambda: merge_bams(dcs_merge_in, all_dcs),
+        rebuild=lambda: None,
+    )
 
     plot_family_size(
         os.path.join(dirs["sscs"], f"{name}.read_families.txt"),
@@ -237,12 +297,14 @@ def build_parser() -> argparse.ArgumentParser:
     c.add_argument("--backend", choices=("cpu", "tpu"))
     c.add_argument("--bdelim")
     c.add_argument("--cleanup", help="remove intermediate BAMs")
+    c.add_argument("--resume", help="skip stages whose manifest-recorded outputs are intact")
     c.set_defaults(func=consensus, config_section="consensus",
                    required_args=("input", "output"),
                    builtin_defaults={
                        "cutoff": 0.7, "qualscore": 0, "scorrect": "True",
                        "max_mismatch": 0, "backend": "tpu",
                        "bdelim": DEFAULT_BDELIM, "cleanup": "False",
+                       "resume": "False",
                    })
     return p
 
@@ -265,6 +327,8 @@ def main(argv=None) -> int:
 
     args.scorrect = _bool(getattr(args, "scorrect", "True"))
     args.cleanup = _bool(getattr(args, "cleanup", "False"))
+    if hasattr(args, "resume"):
+        args.resume = _bool(args.resume)
     if hasattr(args, "cutoff"):
         args.cutoff = float(args.cutoff)
     if hasattr(args, "qualscore"):

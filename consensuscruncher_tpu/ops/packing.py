@@ -30,17 +30,22 @@ _BASE_BITS = 3
 _BASE_MASK = (1 << _BASE_BITS) - 1
 
 
-def build_codebook(quals: np.ndarray) -> np.ndarray | None:
-    """Sorted unique quals padded to CODEBOOK_SIZE, or None if they don't fit."""
+def _build_codebook(quals: np.ndarray, size: int) -> np.ndarray | None:
+    """Sorted unique quals padded to ``size`` entries, or None if they don't fit."""
     uniq = np.unique(np.asarray(quals, dtype=np.uint8))
-    if uniq.size > CODEBOOK_SIZE:
+    if uniq.size > size:
         return None
     # Pad with the max value: duplicate tail entries are harmless because
     # the qual->index LUT maps a duplicated value to its last slot and every
     # duplicate slot decodes back to the same value.
-    book = np.full(CODEBOOK_SIZE, uniq[-1] if uniq.size else 0, dtype=np.uint8)
+    book = np.full(size, uniq[-1] if uniq.size else 0, dtype=np.uint8)
     book[: uniq.size] = uniq
     return book
+
+
+def build_codebook(quals: np.ndarray) -> np.ndarray | None:
+    """1-byte wire codebook (CODEBOOK_SIZE entries)."""
+    return _build_codebook(quals, CODEBOOK_SIZE)
 
 
 def can_pack(quals: np.ndarray) -> bool:
@@ -142,13 +147,8 @@ def sanitize_for_pack4(bases: np.ndarray, quals: np.ndarray, fam_sizes: np.ndarr
 
 
 def build_codebook4(quals: np.ndarray) -> np.ndarray | None:
-    """Sorted unique quals padded to 4 entries, or None if they don't fit."""
-    uniq = np.unique(np.asarray(quals, dtype=np.uint8))
-    if uniq.size > CODEBOOK4_SIZE:
-        return None
-    book = np.full(CODEBOOK4_SIZE, uniq[-1] if uniq.size else 0, dtype=np.uint8)
-    book[: uniq.size] = uniq
-    return book
+    """4-bit wire codebook (CODEBOOK4_SIZE entries)."""
+    return _build_codebook(quals, CODEBOOK4_SIZE)
 
 
 def pack4(bases: np.ndarray, quals: np.ndarray, codebook4: np.ndarray) -> np.ndarray:

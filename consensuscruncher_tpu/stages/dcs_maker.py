@@ -41,7 +41,24 @@ from consensuscruncher_tpu.utils.stats import StageStats
 class DcsResult:
     dcs_bam: str
     sscs_singleton_bam: str
-    stats: StageStats
+    stats: StageStats | None  # None when reconstructed from a resume skip
+
+    @classmethod
+    def from_prefix(cls, out_prefix: str) -> "DcsResult":
+        """Path-only result for a stage skipped by --resume."""
+        p = output_paths(out_prefix)
+        return cls(p["dcs"], p["unpaired"], None)
+
+
+def output_paths(out_prefix: str) -> dict[str, str]:
+    """Canonical output paths for a prefix — the single naming authority
+    shared by the stage body and the CLI's resume manifest."""
+    return {
+        "dcs": f"{out_prefix}.dcs.sorted.bam",
+        "unpaired": f"{out_prefix}.sscs.singleton.sorted.bam",
+        "stats_txt": f"{out_prefix}.dcs_stats.txt",
+        "stats_json": f"{out_prefix}.dcs_stats.json",
+    }
 
 
 # Shared with singleton_correction (re-exported for stage symmetry).
@@ -94,8 +111,8 @@ def run_dcs(
     backend: str = "tpu",
 ) -> DcsResult:
     stats = StageStats("DCS")
-    dcs_path = f"{out_prefix}.dcs.sorted.bam"
-    unpaired_path = f"{out_prefix}.sscs.singleton.sorted.bam"
+    paths = output_paths(out_prefix)
+    dcs_path, unpaired_path = paths["dcs"], paths["unpaired"]
     dcs_tmp = f"{out_prefix}.dcs.unsorted.bam"
     unpaired_tmp = f"{out_prefix}.sscs.singleton.unsorted.bam"
 
@@ -153,7 +170,7 @@ def run_dcs(
     os.unlink(dcs_tmp)
     os.unlink(unpaired_tmp)
     stats.set("backend", backend)
-    stats.write(f"{out_prefix}.dcs_stats.txt")
+    stats.write(paths["stats_txt"])
     return DcsResult(dcs_path, unpaired_path, stats)
 
 

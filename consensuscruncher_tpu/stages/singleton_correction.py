@@ -47,7 +47,25 @@ class SingletonResult:
     sscs_rescue_bam: str
     singleton_rescue_bam: str
     remaining_bam: str
-    stats: StageStats
+    stats: StageStats | None  # None when reconstructed from a resume skip
+
+    @classmethod
+    def from_prefix(cls, out_prefix: str) -> "SingletonResult":
+        """Path-only result for a stage skipped by --resume."""
+        p = output_paths(out_prefix)
+        return cls(p["sscs_rescue"], p["singleton_rescue"], p["remaining"], None)
+
+
+def output_paths(out_prefix: str) -> dict[str, str]:
+    """Canonical output paths for a prefix — the single naming authority
+    shared by the stage body and the CLI's resume manifest."""
+    return {
+        "sscs_rescue": f"{out_prefix}.sscs.rescue.sorted.bam",
+        "singleton_rescue": f"{out_prefix}.singleton.rescue.sorted.bam",
+        "remaining": f"{out_prefix}.remaining.singleton.sorted.bam",
+        "stats_txt": f"{out_prefix}.singleton_stats.txt",
+        "stats_json": f"{out_prefix}.singleton_stats.json",
+    }
 
 
 def _merge_windows(a: Iterator, b: Iterator) -> Iterator[tuple[dict, dict]]:
@@ -108,11 +126,8 @@ def run_singleton_correction(
     max_mismatch: int = 0,
 ) -> SingletonResult:
     stats = StageStats("singleton_correction")
-    paths = {
-        "sscs_rescue": f"{out_prefix}.sscs.rescue.sorted.bam",
-        "singleton_rescue": f"{out_prefix}.singleton.rescue.sorted.bam",
-        "remaining": f"{out_prefix}.remaining.singleton.sorted.bam",
-    }
+    all_paths = output_paths(out_prefix)
+    paths = {k: all_paths[k] for k in ("sscs_rescue", "singleton_rescue", "remaining")}
     tmps = {k: p.replace(".sorted.bam", ".unsorted.bam") for k, p in paths.items()}
 
     s_reader = BamReader(singleton_bam)
@@ -174,7 +189,7 @@ def run_singleton_correction(
         sort_bam(tmps[k], paths[k])
         os.unlink(tmps[k])
     stats.set("max_mismatch", max_mismatch)
-    stats.write(f"{out_prefix}.singleton_stats.txt")
+    stats.write(all_paths["stats_txt"])
     return SingletonResult(paths["sscs_rescue"], paths["singleton_rescue"], paths["remaining"], stats)
 
 

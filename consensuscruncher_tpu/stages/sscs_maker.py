@@ -45,8 +45,28 @@ class SscsResult:
     sscs_bam: str
     singleton_bam: str
     bad_bam: str
-    stats: StageStats
-    histogram: FamilySizeHistogram
+    stats: StageStats | None  # None when reconstructed from a resume skip
+    histogram: FamilySizeHistogram | None
+
+    @classmethod
+    def from_prefix(cls, out_prefix: str) -> "SscsResult":
+        """Path-only result for a stage skipped by --resume."""
+        p = output_paths(out_prefix)
+        return cls(p["sscs"], p["singleton"], p["bad"], None, None)
+
+
+def output_paths(out_prefix: str) -> dict[str, str]:
+    """Canonical output paths for a prefix — the single naming authority
+    shared by the stage body and the CLI's resume manifest."""
+    return {
+        "sscs": f"{out_prefix}.sscs.sorted.bam",
+        "singleton": f"{out_prefix}.singleton.sorted.bam",
+        "bad": f"{out_prefix}.badReads.bam",
+        "stats_txt": f"{out_prefix}.sscs_stats.txt",
+        "stats_json": f"{out_prefix}.sscs_stats.json",
+        "families": f"{out_prefix}.read_families.txt",
+        "time_tracker": f"{out_prefix}.time_tracker.txt",
+    }
 
 
 def _member_arrays(members):
@@ -76,9 +96,8 @@ def run_sscs(
     hist = FamilySizeHistogram()
     cfg = ConsensusConfig(cutoff=cutoff, qual_threshold=qual_threshold, qual_cap=qual_cap)
 
-    sscs_path = f"{out_prefix}.sscs.sorted.bam"
-    singleton_path = f"{out_prefix}.singleton.sorted.bam"
-    bad_path = f"{out_prefix}.badReads.bam"
+    paths = output_paths(out_prefix)
+    sscs_path, singleton_path, bad_path = paths["sscs"], paths["singleton"], paths["bad"]
     sscs_tmp = f"{out_prefix}.sscs.unsorted.bam"
     singleton_tmp = f"{out_prefix}.singleton.unsorted.bam"
 
@@ -157,9 +176,9 @@ def run_sscs(
 
     stats.set("backend", backend)
     stats.set("cutoff", cutoff)
-    stats.write(f"{out_prefix}.sscs_stats.txt")
-    hist.write(f"{out_prefix}.read_families.txt")
-    tracker.write(f"{out_prefix}.time_tracker.txt")
+    stats.write(paths["stats_txt"])
+    hist.write(paths["families"])
+    tracker.write(paths["time_tracker"])
     return SscsResult(sscs_path, singleton_path, bad_path, stats, hist)
 
 

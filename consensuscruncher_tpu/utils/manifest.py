@@ -1,0 +1,124 @@
+"""Run manifest: explicit checkpoint/resume over the stage-file model.
+
+The reference pipeline's recovery story is implicit — every stage persists
+full BAM outputs, so a crash loses at most the running stage and "resume" is
+re-running by hand (SURVEY.md §5 "Checkpoint / resume": the rebuild makes it
+explicit with a manifest of stage outputs + hashes).  This module is that
+manifest:
+
+- each completed stage records fingerprints of its inputs, outputs, and the
+  parameters that shaped them;
+- on ``--resume``, a stage is skipped iff its recorded inputs, outputs, and
+  parameters all still match — inputs are re-fingerprinted so an upstream
+  change invalidates everything downstream, and outputs are re-fingerprinted
+  so a half-written file (non-atomic writer, disk-full) never masquerades as
+  a checkpoint;
+- the manifest file itself is written atomically (write-then-rename), the
+  same discipline the BAM writers use.
+
+Fingerprints are ``(size, sha256(head 1 MiB), sha256(tail 1 MiB))`` —
+content-based (mtime survives copies/rsync badly) but O(1) in file size, so
+resuming a 100M-read run never re-hashes hundreds of GB.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import tempfile
+
+_CHUNK = 1 << 20  # head/tail bytes hashed per file
+
+MANIFEST_VERSION = 1
+
+
+def fingerprint(path: str) -> dict | None:
+    """Content fingerprint of ``path``; None if it doesn't exist."""
+    try:
+        size = os.path.getsize(path)
+    except OSError:
+        return None
+    head = hashlib.sha256()
+    tail = hashlib.sha256()
+    with open(path, "rb") as fh:
+        head.update(fh.read(_CHUNK))
+        if size > _CHUNK:
+            fh.seek(max(size - _CHUNK, _CHUNK))
+            tail.update(fh.read(_CHUNK))
+    return {"size": size, "head": head.hexdigest(), "tail": tail.hexdigest()}
+
+
+class RunManifest:
+    """Stage-completion ledger for one pipeline run directory."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._stages: dict[str, dict] = {}
+        if os.path.exists(path):
+            try:
+                with open(path) as fh:
+                    data = json.load(fh)
+                if data.get("version") == MANIFEST_VERSION:
+                    self._stages = data.get("stages", {})
+            except (OSError, json.JSONDecodeError):
+                # A corrupt manifest only disables skipping, never the run.
+                self._stages = {}
+
+    # ------------------------------------------------------------- recording
+
+    def record(self, stage: str, inputs: list[str], outputs: list[str], params: dict) -> None:
+        """Mark ``stage`` complete; fingerprints are taken now (outputs must
+        already be fully written — call after the stage's atomic renames)."""
+        entry = {
+            "params": dict(params),
+            "inputs": {p: fingerprint(p) for p in inputs},
+            "outputs": {p: fingerprint(p) for p in outputs},
+        }
+        missing = [p for p, f in entry["outputs"].items() if f is None]
+        if missing:
+            raise FileNotFoundError(f"stage {stage!r} recorded missing outputs: {missing}")
+        self._stages[stage] = entry
+        self._flush()
+
+    def _flush(self) -> None:
+        data = {"version": MANIFEST_VERSION, "stages": self._stages}
+        d = os.path.dirname(self.path) or "."
+        fd, tmp = tempfile.mkstemp(prefix=".manifest.", dir=d)
+        try:
+            with os.fdopen(fd, "w") as fh:
+                json.dump(data, fh, indent=2)
+                fh.write("\n")
+            os.replace(tmp, self.path)
+        except BaseException:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise
+
+    # ------------------------------------------------------------- skipping
+
+    def can_skip(self, stage: str, inputs: list[str], params: dict) -> bool:
+        """True iff ``stage`` completed with these exact inputs + params and
+        every recorded output is still intact on disk."""
+        entry = self._stages.get(stage)
+        if entry is None:
+            return False
+        if entry["params"] != params:
+            return False
+        if set(entry["inputs"]) != set(inputs):
+            return False
+        for p, recorded in entry["inputs"].items():
+            if recorded is None or fingerprint(p) != recorded:
+                return False
+        for p, recorded in entry["outputs"].items():
+            if fingerprint(p) != recorded:
+                return False
+        return True
+
+    def outputs_of(self, stage: str) -> list[str]:
+        entry = self._stages.get(stage)
+        return list(entry["outputs"]) if entry else []
+
+    def invalidate(self, stage: str) -> None:
+        if self._stages.pop(stage, None) is not None:
+            self._flush()

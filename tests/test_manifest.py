@@ -1,0 +1,120 @@
+"""Checkpoint/resume: manifest fingerprints + CLI --resume stage skipping."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from consensuscruncher_tpu.utils.manifest import RunManifest, fingerprint
+
+
+def _write(path, data=b"payload"):
+    with open(path, "wb") as fh:
+        fh.write(data)
+    return str(path)
+
+
+def test_fingerprint_tracks_content(tmp_path):
+    p = _write(tmp_path / "f.bin", b"abc" * 1000)
+    f1 = fingerprint(p)
+    assert f1["size"] == 3000
+    _write(p, b"abd" * 1000)
+    assert fingerprint(p) != f1
+    assert fingerprint(str(tmp_path / "missing")) is None
+
+
+def test_fingerprint_large_file_head_tail(tmp_path):
+    big = np.zeros(3 << 20, dtype=np.uint8)
+    p = _write(tmp_path / "big.bin", big.tobytes())
+    f1 = fingerprint(p)
+    big[-1] = 7  # tail change
+    _write(p, big.tobytes())
+    assert fingerprint(p) != f1
+
+
+def test_record_and_skip_cycle(tmp_path):
+    inp = _write(tmp_path / "in.bam", b"input")
+    out = _write(tmp_path / "out.bam", b"output")
+    m = RunManifest(str(tmp_path / "manifest.json"))
+    params = {"cutoff": 0.7}
+    assert not m.can_skip("sscs", [inp], params)
+    m.record("sscs", [inp], [out], params)
+    assert m.can_skip("sscs", [inp], params)
+
+    # fresh instance (simulates a new process) reads the persisted state
+    m2 = RunManifest(str(tmp_path / "manifest.json"))
+    assert m2.can_skip("sscs", [inp], params)
+    assert m2.outputs_of("sscs") == [out]
+
+    # changed params, changed input, missing output each disable the skip
+    assert not m2.can_skip("sscs", [inp], {"cutoff": 0.8})
+    _write(inp, b"different input")
+    assert not m2.can_skip("sscs", [inp], params)
+    _write(inp, b"input")
+    assert m2.can_skip("sscs", [inp], params)
+    os.unlink(out)
+    assert not m2.can_skip("sscs", [inp], params)
+
+
+def test_record_refuses_missing_output(tmp_path):
+    inp = _write(tmp_path / "in.bam")
+    m = RunManifest(str(tmp_path / "manifest.json"))
+    with pytest.raises(FileNotFoundError):
+        m.record("s", [inp], [str(tmp_path / "never_written.bam")], {})
+
+
+def test_corrupt_manifest_only_disables_skipping(tmp_path):
+    path = tmp_path / "manifest.json"
+    path.write_text("{ not json")
+    m = RunManifest(str(path))
+    inp = _write(tmp_path / "in.bam")
+    out = _write(tmp_path / "out.bam")
+    assert not m.can_skip("s", [inp], {})
+    m.record("s", [inp], [out], {})
+    assert json.loads(path.read_text())["version"] == 1
+
+
+def test_invalidate(tmp_path):
+    inp = _write(tmp_path / "in.bam")
+    out = _write(tmp_path / "out.bam")
+    m = RunManifest(str(tmp_path / "manifest.json"))
+    m.record("s", [inp], [out], {})
+    m.invalidate("s")
+    assert not m.can_skip("s", [inp], {})
+
+
+def test_cli_resume_skips_stages(tmp_path, capsys):
+    from consensuscruncher_tpu import cli
+    from consensuscruncher_tpu.utils.simulate import SimConfig, simulate_bam
+
+    bam = str(tmp_path / "in.sorted.bam")
+    simulate_bam(bam, SimConfig(n_fragments=12, read_len=40, seed=3))
+    out = str(tmp_path / "out")
+    argv = ["consensus", "-i", bam, "-o", out, "-n", "s", "--backend", "cpu",
+            "--scorrect", "True"]
+    assert cli.main(argv) == 0
+    capsys.readouterr()
+
+    # Second run with --resume: every stage skips, outputs unchanged.
+    before = {}
+    for sub in ("sscs", "dcs", "all_unique"):
+        d = os.path.join(out, "s", sub)
+        for f in os.listdir(d):
+            if f.endswith(".bam"):
+                before[f] = os.path.getmtime(os.path.join(d, f))
+    assert cli.main(argv + ["--resume", "True"]) == 0
+    text = capsys.readouterr().out
+    for stage in ("sscs", "singleton_correction", "dcs",
+                  "merge_rescued", "merge_all_sscs", "merge_all_dcs"):
+        assert f"skipping {stage}" in text, stage
+    for sub in ("sscs", "dcs", "all_unique"):
+        d = os.path.join(out, "s", sub)
+        for f in os.listdir(d):
+            if f.endswith(".bam"):
+                assert os.path.getmtime(os.path.join(d, f)) == before[f], f
+
+    # Changing a consensus parameter invalidates the skip.
+    assert cli.main(argv + ["--resume", "True", "--cutoff", "0.8"]) == 0
+    text = capsys.readouterr().out
+    assert "skipping sscs" not in text
