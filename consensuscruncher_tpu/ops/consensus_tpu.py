@@ -141,21 +141,54 @@ def consensus_batch_host(bases, quals, fam_sizes, config: ConsensusConfig = Cons
     return np.asarray(b), np.asarray(q)
 
 
-def consensus_families(families, config: ConsensusConfig = ConsensusConfig(), max_batch: int = 1024):
-    """Stream ragged families through the device kernel.
+def consensus_families(
+    families,
+    config: ConsensusConfig = ConsensusConfig(),
+    max_batch: int = 1024,
+    prefetch_depth: int | None = None,
+):
+    """Stream ragged families through the device kernel, double-buffered.
 
     ``families`` yields ``(key, member_seqs, member_quals)`` (ragged lists of
     1-D uint8 arrays); yields ``(key, consensus_base, consensus_qual)`` with
-    outputs sliced to each family's true consensus length.  Batches are
-    dispatched per (F, L) bucket; device->host transfer happens once per
-    batch.
+    outputs sliced to each family's true consensus length, in input bucket
+    order.  Batches are dispatched per (F, L) bucket; device->host transfer
+    happens once per batch.
+
+    Throughput shape (SURVEY.md §7.5): host-side grouping/padding runs on a
+    prefetch thread ``prefetch_depth`` batches ahead, and the device always
+    has one batch in flight — JAX's async dispatch makes ``consensus_batch``
+    return before compute finishes, so the ``np.asarray`` drain of batch *k*
+    overlaps the compute of batch *k+1*.  ``prefetch_depth=0`` disables both
+    (strictly serial; used by parity tests to pin identical results).
     """
     from consensuscruncher_tpu.parallel.batching import bucket_families
+    from consensuscruncher_tpu.parallel.prefetch import DEFAULT_DEPTH, pipelined, prefetch
 
-    for batch in bucket_families(families, max_batch=max_batch):
-        out_b, out_q = consensus_batch(batch.bases, batch.quals, batch.fam_sizes, config)
-        out_b = np.asarray(out_b)
-        out_q = np.asarray(out_q)
+    if prefetch_depth is None:
+        prefetch_depth = DEFAULT_DEPTH
+    batches = bucket_families(families, max_batch=max_batch)
+
+    def dispatch(batch):
+        return consensus_batch(batch.bases, batch.quals, batch.fam_sizes, config)
+
+    def fetch(batch, handle):
+        out_b, out_q = (np.asarray(x) for x in handle)
         for i, key in enumerate(batch.keys):
             length = int(batch.lengths[i])
             yield key, out_b[i, :length], out_q[i, :length]
+
+    if prefetch_depth <= 0:
+        # Strictly serial: no producer thread, no batch in flight.
+        for batch in batches:
+            yield from fetch(batch, dispatch(batch))
+        return
+
+    stream = prefetch(batches, depth=prefetch_depth)
+    try:
+        yield from pipelined(stream, dispatch, fetch)
+    finally:
+        # Deterministic even when the consumer abandons this generator:
+        # closing `stream` stops AND joins the producer thread, so callers'
+        # cleanup (closing writers the producer writes to) cannot race it.
+        stream.close()

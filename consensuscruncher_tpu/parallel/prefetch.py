@@ -1,0 +1,114 @@
+"""Host↔device double-buffering: background producer + one-batch-in-flight.
+
+SURVEY.md §7.5's throughput item: while the TPU votes batch *k*, the host
+should already be grouping/padding batch *k+1* (CPU work: BAM decode, dict
+grouping, rectangularize/bucket copies) — and batch *k*'s device→host fetch
+should wait until *k+1* has been dispatched, so transfer overlaps compute.
+Two pieces:
+
+- :func:`prefetch` — run any iterator on a daemon thread behind a bounded
+  queue.  Order-preserving (single FIFO), exception-propagating, and safe
+  to abandon mid-stream (the producer notices and exits instead of blocking
+  on a full queue forever).
+- :func:`pipelined` — software-pipeline a dispatch/fetch pair over a batch
+  stream with exactly one batch in flight: dispatch(k+1) happens before
+  fetch(k).  With JAX's async dispatch this overlaps device compute and
+  D2H transfer with host work without any explicit streams.
+
+Thread-safety contract for ``prefetch(gen)``: the generator body runs on
+the producer thread while consumers run downstream of the queue — state
+shared between the generator and its consumer must be confined to one side
+or be GIL-atomic (the SSCS stage's writer/stats split is arranged this
+way; see stages/sscs_maker.py).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Iterable, Iterator, TypeVar
+
+T = TypeVar("T")
+_SENTINEL = object()
+
+DEFAULT_DEPTH = 2
+
+
+def prefetch(iterable: Iterable[T], depth: int = DEFAULT_DEPTH) -> Iterator[T]:
+    """Yield from ``iterable``, produced on a background daemon thread.
+
+    ``depth`` bounds the number of buffered items (memory bound for big
+    batches).  ``depth <= 0`` degrades to plain iteration (no thread).
+    Exceptions raised by the producer re-raise at the consumer's next pull,
+    and abandoning the consumer (``close()`` / GC) unblocks the producer.
+    """
+    if depth <= 0:
+        yield from iterable
+        return
+
+    q: queue.Queue = queue.Queue(maxsize=depth)
+    stop = threading.Event()
+    failure: list[BaseException] = []
+
+    def worker():
+        try:
+            for item in iterable:
+                while not stop.is_set():
+                    try:
+                        q.put(item, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                if stop.is_set():
+                    return
+        except BaseException as exc:  # re-raised on the consumer side
+            failure.append(exc)
+        finally:
+            while not stop.is_set():
+                try:
+                    q.put(_SENTINEL, timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
+
+    thread = threading.Thread(target=worker, daemon=True, name="cct-prefetch")
+    thread.start()
+    try:
+        while True:
+            item = q.get()
+            if item is _SENTINEL:
+                if failure:
+                    raise failure[0]
+                return
+            yield item
+    finally:
+        stop.set()
+        # Deterministic shutdown: close() must not return while the producer
+        # can still touch state shared with the consumer's cleanup (e.g. the
+        # SSCS stage aborts BAM writers that events() writes to).  The
+        # producer polls `stop` every 0.1 s, so this join is bounded unless
+        # the underlying iterable itself blocks indefinitely.
+        thread.join(timeout=30.0)
+
+
+def pipelined(
+    batches: Iterable[T],
+    dispatch: Callable[[T], object],
+    fetch: Callable[[T, object], Iterable],
+) -> Iterator:
+    """One-batch-in-flight software pipeline over ``batches``.
+
+    For each batch: ``handle = dispatch(batch)`` (should be async — e.g. a
+    jitted call returning device arrays), then the PREVIOUS batch's
+    ``fetch(prev_batch, prev_handle)`` results are yielded — so the device
+    is always working on one batch ahead of the host-side drain.  Ordering
+    across batches is preserved.
+    """
+    inflight: tuple[T, object] | None = None
+    for batch in batches:
+        handle = dispatch(batch)
+        if inflight is not None:
+            yield from fetch(*inflight)
+        inflight = (batch, handle)
+    if inflight is not None:
+        yield from fetch(*inflight)

@@ -150,8 +150,17 @@ def run_sscs(
     ok = False
     try:
         if backend == "tpu":
-            for fid, codes, quals in consensus_families(events(), cfg, max_batch=max_batch):
-                emit(fid, codes, quals)
+            stream = consensus_families(events(), cfg, max_batch=max_batch)
+            try:
+                for fid, codes, quals in stream:
+                    emit(fid, codes, quals)
+            finally:
+                # Must run BEFORE the writers close below: closing the stream
+                # stops and joins the prefetch producer thread, which is the
+                # thread executing events() — i.e. the thread writing to
+                # bad_writer/singleton_writer.  Abandoning it to GC would
+                # race w.abort() against in-flight writes on error paths.
+                stream.close()
         else:
             for fid, seqs, quals in events():
                 rect_s, rect_q, _ = rectangularize(seqs, quals)

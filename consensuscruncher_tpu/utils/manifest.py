@@ -59,8 +59,10 @@ class RunManifest:
             try:
                 with open(path) as fh:
                     data = json.load(fh)
-                if data.get("version") == MANIFEST_VERSION:
-                    self._stages = data.get("stages", {})
+                if isinstance(data, dict) and data.get("version") == MANIFEST_VERSION:
+                    stages = data.get("stages")
+                    if isinstance(stages, dict):
+                        self._stages = stages
             except (OSError, json.JSONDecodeError):
                 # A corrupt manifest only disables skipping, never the run.
                 self._stages = {}

@@ -64,15 +64,22 @@ def test_record_refuses_missing_output(tmp_path):
         m.record("s", [inp], [str(tmp_path / "never_written.bam")], {})
 
 
-def test_corrupt_manifest_only_disables_skipping(tmp_path):
+@pytest.mark.parametrize("content", [
+    "{ not json",
+    '{"version": 1, "stages": []}',      # valid JSON, wrong container type
+    '{"version": 1, "stages": "oops"}',
+    '[1, 2, 3]',                          # valid JSON, not an object
+])
+def test_corrupt_manifest_only_disables_skipping(tmp_path, content):
     path = tmp_path / "manifest.json"
-    path.write_text("{ not json")
+    path.write_text(content)
     m = RunManifest(str(path))
     inp = _write(tmp_path / "in.bam")
     out = _write(tmp_path / "out.bam")
     assert not m.can_skip("s", [inp], {})
-    m.record("s", [inp], [out], {})
+    m.record("s", [inp], [out], {})  # recording must work despite the damage
     assert json.loads(path.read_text())["version"] == 1
+    assert m.can_skip("s", [inp], {})
 
 
 def test_invalidate(tmp_path):

@@ -1,0 +1,138 @@
+"""Prefetch thread + one-in-flight pipeline: ordering, errors, overlap, parity."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from consensuscruncher_tpu.parallel.prefetch import pipelined, prefetch
+
+
+def test_prefetch_preserves_order():
+    assert list(prefetch(iter(range(100)), depth=3)) == list(range(100))
+
+
+def test_prefetch_zero_depth_is_plain_iteration():
+    assert list(prefetch(iter(range(10)), depth=0)) == list(range(10))
+
+
+def test_prefetch_propagates_producer_exception():
+    def gen():
+        yield 1
+        yield 2
+        raise RuntimeError("producer blew up")
+
+    it = prefetch(gen(), depth=2)
+    assert next(it) == 1
+    assert next(it) == 2
+    with pytest.raises(RuntimeError, match="producer blew up"):
+        next(it)
+
+
+def test_prefetch_abandonment_unblocks_producer():
+    produced = []
+    done = threading.Event()
+
+    def gen():
+        try:
+            for i in range(10_000):
+                produced.append(i)
+                yield i
+        finally:
+            done.set()
+
+    it = prefetch(gen(), depth=1)
+    assert next(it) == 0
+    it.close()  # abandon mid-stream
+    # The producer must notice (stop event) rather than block on the full
+    # queue forever; give it a moment to wind down.
+    for _ in range(100):
+        if done.is_set():
+            break
+        time.sleep(0.02)
+    assert done.is_set()
+    assert len(produced) < 10_000
+
+
+def test_prefetch_close_joins_producer():
+    """close() must not return while the producer thread is alive."""
+    started = threading.Event()
+
+    def gen():
+        started.set()
+        for i in range(10_000):
+            yield i
+
+    it = prefetch(gen(), depth=1)
+    assert next(it) == 0
+    assert started.is_set()
+    before = threading.active_count()
+    it.close()
+    # After close() returns, the cct-prefetch thread has been joined.
+    names = [t.name for t in threading.enumerate()]
+    assert "cct-prefetch" not in names, names
+    assert threading.active_count() <= before
+
+
+def test_prefetch_producer_runs_ahead():
+    """The producer fills the queue while the consumer sleeps."""
+    produced = []
+
+    def gen():
+        for i in range(5):
+            produced.append(i)
+            yield i
+
+    it = prefetch(gen(), depth=4)
+    assert next(it) == 0
+    time.sleep(0.2)  # producer should prefetch the rest meanwhile
+    assert len(produced) == 5
+    assert list(it) == [1, 2, 3, 4]
+
+
+def test_pipelined_orders_dispatch_before_fetch():
+    events = []
+
+    def dispatch(b):
+        events.append(("dispatch", b))
+        return b * 10
+
+    def fetch(b, h):
+        events.append(("fetch", b))
+        yield h
+
+    out = list(pipelined([1, 2, 3], dispatch, fetch))
+    assert out == [10, 20, 30]
+    # dispatch(k+1) must precede fetch(k); fetch(3) drains at the end
+    assert events == [
+        ("dispatch", 1), ("dispatch", 2), ("fetch", 1),
+        ("dispatch", 3), ("fetch", 2), ("fetch", 3),
+    ]
+
+
+def test_pipelined_empty_stream():
+    assert list(pipelined([], lambda b: b, lambda b, h: [h])) == []
+
+
+def test_consensus_families_prefetch_parity():
+    """Double-buffered and strictly-serial paths emit identical streams."""
+    from consensuscruncher_tpu.ops.consensus_tpu import consensus_families
+
+    rng = np.random.default_rng(0)
+
+    def families():
+        for k in range(57):
+            fam = int(rng.integers(1, 9))
+            length = int(rng.integers(30, 120))
+            seqs = [rng.integers(0, 4, length).astype(np.uint8) for _ in range(fam)]
+            quals = [rng.integers(10, 41, length).astype(np.uint8) for _ in range(fam)]
+            yield k, seqs, quals
+
+    fams = list(families())
+    serial = list(consensus_families(iter(fams), max_batch=16, prefetch_depth=0))
+    buffered = list(consensus_families(iter(fams), max_batch=16, prefetch_depth=2))
+    assert [k for k, _, _ in serial] == [k for k, _, _ in buffered]
+    for (_, b1, q1), (_, b2, q2) in zip(serial, buffered):
+        np.testing.assert_array_equal(b1, b2)
+        np.testing.assert_array_equal(q1, q2)
