@@ -89,6 +89,14 @@ def prefetch(iterable: Iterable[T], depth: int = DEFAULT_DEPTH) -> Iterator[T]:
         # producer polls `stop` every 0.1 s, so this join is bounded unless
         # the underlying iterable itself blocks indefinitely.
         thread.join(timeout=30.0)
+        if thread.is_alive():
+            # Returning here would let callers tear down state the producer
+            # still touches (the use-after-abort race close() exists to
+            # prevent) — surface the hang instead of racing.
+            raise RuntimeError(
+                "prefetch producer thread failed to stop within 30s; "
+                "the source iterable is blocked"
+            )
 
 
 def pipelined(

@@ -62,7 +62,14 @@ class RunManifest:
                 if isinstance(data, dict) and data.get("version") == MANIFEST_VERSION:
                     stages = data.get("stages")
                     if isinstance(stages, dict):
-                        self._stages = stages
+                        self._stages = {
+                            name: entry
+                            for name, entry in stages.items()
+                            if isinstance(entry, dict)
+                            and isinstance(entry.get("params"), dict)
+                            and isinstance(entry.get("inputs"), dict)
+                            and isinstance(entry.get("outputs"), dict)
+                        }
             except (OSError, json.JSONDecodeError):
                 # A corrupt manifest only disables skipping, never the run.
                 self._stages = {}

@@ -69,6 +69,9 @@ def test_record_refuses_missing_output(tmp_path):
     '{"version": 1, "stages": []}',      # valid JSON, wrong container type
     '{"version": 1, "stages": "oops"}',
     '[1, 2, 3]',                          # valid JSON, not an object
+    '{"version": 1, "stages": {"s": "oops"}}',   # malformed stage entry
+    '{"version": 1, "stages": {"s": {}}}',       # entry missing params/inputs/outputs
+    '{"version": 1, "stages": {"s": {"params": [], "inputs": {}, "outputs": {}}}}',
 ])
 def test_corrupt_manifest_only_disables_skipping(tmp_path, content):
     path = tmp_path / "manifest.json"
