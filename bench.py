@@ -101,6 +101,7 @@ def flatten_members(ba, qa, na, bb, qb, nb):
 def main():
     from consensuscruncher_tpu.ops.consensus_segment import (
         derive_host_outputs,
+        pick_member_cap,
         segment_duplex_step,
     )
     from consensuscruncher_tpu.ops.consensus_tpu import ConsensusConfig
@@ -116,10 +117,16 @@ def main():
         cpu_reference_pair(ba[i], qa[i], int(na[i]), bb[i], qb[i], int(nb[i]))
     cpu_fps = k / (time.perf_counter() - t0)
 
-    # --- TPU path: zero-padding segment SSCS+DCS step, packed both ways ---
-    step = segment_duplex_step(N_PAIRS, READ_LEN, ConsensusConfig(), packed_out=True)
+    # --- TPU path: zero-padding segment SSCS+DCS step, packed both ways.
+    # member_cap routes the vote through the gather-to-dense reduction (the
+    # fast path on TPU — segment_sum lowers to serialized scatters); one
+    # call for the whole batch because the tunnel's per-call overhead beats
+    # any overlap chunked pipelining would buy (run_duplex_pipelined is the
+    # multi-call variant for fast links).
     book = build_codebook4(BINNED_QUALS)
     rows, qrows, fam_ids, ranks, sizes = flatten_members(ba, qa, na, bb, qb, nb)
+    step = segment_duplex_step(N_PAIRS, READ_LEN, ConsensusConfig(), packed_out=True,
+                               member_cap=pick_member_cap(sizes))
 
     def run():
         """Host-to-host: pack, ship, vote, fetch, derive final outputs."""

@@ -25,6 +25,7 @@ way; see stages/sscs_maker.py).
 from __future__ import annotations
 
 import queue
+import sys
 import threading
 from typing import Callable, Iterable, Iterator, TypeVar
 
@@ -92,11 +93,13 @@ def prefetch(iterable: Iterable[T], depth: int = DEFAULT_DEPTH) -> Iterator[T]:
         if thread.is_alive():
             # Returning here would let callers tear down state the producer
             # still touches (the use-after-abort race close() exists to
-            # prevent) — surface the hang instead of racing.
+            # prevent) — surface the hang instead of racing.  Chain any
+            # in-flight exception (consumer error or GeneratorExit from
+            # close()) so this never masks the root cause.
             raise RuntimeError(
                 "prefetch producer thread failed to stop within 30s; "
                 "the source iterable is blocked"
-            )
+            ) from sys.exc_info()[1]
 
 
 def pipelined(

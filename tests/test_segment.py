@@ -82,6 +82,110 @@ def test_packed_out_matches_dense_out():
     np.testing.assert_array_equal(pk[3], dense[6])
 
 
+def test_gather_dense_vote_matches_segment_path():
+    rng = np.random.default_rng(11)
+    n_pairs, L = 24, 21
+    na = rng.integers(1, 9, n_pairs).astype(np.int32)
+    nb = rng.integers(0, 9, n_pairs).astype(np.int32)
+    _f, _r, sizes = build_member_stream([na, nb])
+    m = int(sizes.sum())
+    bases = rng.integers(0, 4, (m, L)).astype(np.uint8)
+    quals = BINNED[rng.integers(0, 4, (m, L))]
+    book = build_codebook4(BINNED)
+    packed = pack4(bases, quals, book)
+
+    from consensuscruncher_tpu.ops.consensus_segment import pick_member_cap
+
+    cap = pick_member_cap(sizes)
+    assert cap == 8
+    seg = [np.asarray(x) for x in segment_duplex_step(n_pairs, L)(packed, sizes, book)]
+    dense = [np.asarray(x) for x in
+             segment_duplex_step(n_pairs, L, member_cap=cap)(packed, sizes, book)]
+    for s, d in zip(seg, dense):
+        np.testing.assert_array_equal(s, d)
+
+
+def test_gather_dense_low_qual_and_ties():
+    # Low-qual members vote N; ties resolve to first-seen — through the
+    # dense path specifically (qual_threshold masks + rank sentinels).
+    from consensuscruncher_tpu.ops.consensus_tpu import ConsensusConfig
+
+    na, nb = np.array([4], np.int32), np.array([0], np.int32)
+    _f, _r, sizes = build_member_stream([na, nb])
+    bases = np.array([[2], [1], [1], [2]], np.uint8)
+    quals = np.array([[2], [37], [37], [37]], np.uint8)  # member 0 below threshold
+    book = build_codebook4(BINNED)
+    cfg = ConsensusConfig(cutoff=0.5, qual_threshold=10)
+    out = [np.asarray(x) for x in
+           segment_duplex_step(1, 1, cfg, member_cap=4)(pack4(bases, quals, book), sizes, book)]
+    exp_b, exp_q = consensus_maker(bases, quals, cutoff=0.5, qual_threshold=10)
+    np.testing.assert_array_equal(out[0][0], exp_b)
+    np.testing.assert_array_equal(out[1][0], exp_q)
+
+
+def test_pick_member_cap():
+    from consensuscruncher_tpu.ops.consensus_segment import (
+        MAX_DENSE_CAP,
+        pick_member_cap,
+    )
+
+    assert pick_member_cap(np.array([1])) == 1
+    assert pick_member_cap(np.array([0, 0])) == 1
+    assert pick_member_cap(np.array([5, 2])) == 8
+    assert pick_member_cap(np.array([16])) == 16
+    assert pick_member_cap(np.array([MAX_DENSE_CAP])) == MAX_DENSE_CAP
+    assert pick_member_cap(np.array([MAX_DENSE_CAP + 1])) is None
+
+
+def test_run_duplex_pipelined_matches_single_shot():
+    from consensuscruncher_tpu.ops.consensus_segment import run_duplex_pipelined
+
+    rng = np.random.default_rng(13)
+    n_pairs, L = 50, 17
+    na = rng.integers(1, 6, n_pairs).astype(np.int32)
+    nb = rng.integers(0, 6, n_pairs).astype(np.int32)
+    _f, _r, sizes = build_member_stream([na, nb])
+    m = int(sizes.sum())
+    bases = rng.integers(0, 4, (m, L)).astype(np.uint8)
+    quals = BINNED[rng.integers(0, 4, (m, L))]
+    book = build_codebook4(BINNED)
+
+    single = [np.asarray(x) for x in
+              segment_duplex_step(n_pairs, L)(pack4(bases, quals, book), sizes, book)]
+    # chunk_pairs forces 4 chunks incl. a ragged final one; tiny member
+    # bucket forces member-axis padding on every chunk.
+    out = run_duplex_pipelined(bases, quals, na, nb, book,
+                               chunk_pairs=16, member_bucket=32)
+    for got, exp in zip(out[:6], single[:6]):
+        np.testing.assert_array_equal(got, exp)
+    np.testing.assert_array_equal(out[6], single[6])
+
+
+def test_run_duplex_pipelined_segment_fallback_with_padding():
+    # member_cap=None (the >MAX_DENSE_CAP fallback) must survive the
+    # member-axis zero-padding: phantom rows are rerouted to a discarded
+    # overflow segment, not voted into the chunk's last family.
+    from consensuscruncher_tpu.ops.consensus_segment import run_duplex_pipelined
+
+    rng = np.random.default_rng(17)
+    n_pairs, L = 20, 9
+    na = rng.integers(1, 4, n_pairs).astype(np.int32)
+    nb = rng.integers(0, 4, n_pairs).astype(np.int32)
+    _f, _r, sizes = build_member_stream([na, nb])
+    m = int(sizes.sum())
+    bases = rng.integers(0, 4, (m, L)).astype(np.uint8)
+    quals = BINNED[rng.integers(0, 4, (m, L))]
+    book = build_codebook4(BINNED)
+
+    single = [np.asarray(x) for x in
+              segment_duplex_step(n_pairs, L)(pack4(bases, quals, book), sizes, book)]
+    out = run_duplex_pipelined(bases, quals, na, nb, book,
+                               chunk_pairs=8, member_bucket=64, member_cap=None)
+    for got, exp in zip(out[:6], single[:6]):
+        np.testing.assert_array_equal(got, exp)
+    np.testing.assert_array_equal(out[6], single[6])
+
+
 def test_segment_tie_break_first_seen():
     # Family of 2 disagreeing at cutoff 0.5: first member's base wins.
     from consensuscruncher_tpu.ops.consensus_tpu import ConsensusConfig
