@@ -68,7 +68,8 @@ def _gather_dense_vote(bases, quals, sizes, *, cap, num, den,
     ``cap / mean_size`` redundant HBM reads (never redundant wire bytes:
     the wire format is unchanged).
     """
-    m, length = bases.shape
+    from consensuscruncher_tpu.ops.consensus_tpu import _consensus_one_family
+
     sizes = sizes.astype(jnp.int32)
     starts = jnp.concatenate([jnp.zeros(1, jnp.int32), jnp.cumsum(sizes)[:-1]])
     r = jnp.arange(cap, dtype=jnp.int32)
@@ -76,43 +77,12 @@ def _gather_dense_vote(bases, quals, sizes, *, cap, num, den,
     safe = jnp.where(valid, starts[:, None] + r[None, :], 0)  # (NF, cap)
     db = jnp.take(bases.astype(jnp.uint8), safe, axis=0)      # (NF, cap, L)
     dq = jnp.take(quals.astype(jnp.uint8), safe, axis=0)
-    qual_ok = dq >= jnp.uint8(qual_threshold)
-    live = valid[:, :, None]
-    # eff: low-qual bases vote N (reference semantics); dead member slots
-    # get 7 — outside 0..4, so they vote for nothing.
-    eff = jnp.where(qual_ok, db, jnp.uint8(N))
-    eff = jnp.where(live, eff, jnp.uint8(7))
-
-    counts, firsts, qsums = [], [], []
-    rank_sentinel = jnp.int32(cap)
-    rank_grid = jnp.broadcast_to(r[None, :, None], (sizes.shape[0], cap, length))
-    for b in range(NUM_BASES):
-        eq = eff == b
-        counts.append(eq.astype(jnp.int32).sum(axis=1))       # (NF, L)
-        firsts.append(jnp.where(eq, rank_grid, rank_sentinel).min(axis=1))
-        agree = (db == b) & qual_ok & live
-        qsums.append(jnp.where(agree, dq, jnp.uint8(0)).astype(jnp.int32).sum(axis=1))
-
-    max_count = counts[0]
-    for b in range(1, NUM_BASES):
-        max_count = jnp.maximum(max_count, counts[b])
-    best_first = jnp.where(counts[0] == max_count, firsts[0], cap + 1)
-    modal = jnp.zeros_like(max_count)
-    for b in range(1, NUM_BASES):
-        cand = jnp.where(counts[b] == max_count, firsts[b], cap + 1)
-        better = cand < best_first
-        best_first = jnp.where(better, cand, best_first)
-        modal = jnp.where(better, b, modal)
-
-    qsum = jnp.zeros_like(max_count)
-    for b in range(NUM_BASES):
-        qsum = jnp.where(modal == b, qsums[b], qsum)
-
-    fam = sizes[:, None]  # (NF, 1)
-    passed = (modal != N) & (max_count * den >= num * fam) & (fam > 0)
-    out_b = jnp.where(passed, modal, N).astype(jnp.uint8)
-    out_q = jnp.where(passed, jnp.minimum(qsum, qual_cap), 0).astype(jnp.uint8)
-    return out_b, out_q
+    # Dead slots (r >= size) gather row 0's content; _consensus_one_family
+    # masks them out by fam_size, so the one dense-family kernel is the
+    # single source of the modal/tie-break/cutoff/quality semantics here.
+    vote = partial(_consensus_one_family, num=num, den=den,
+                   qual_threshold=qual_threshold, qual_cap=qual_cap)
+    return jax.vmap(vote, in_axes=(0, 0, 0))(db, dq, sizes)
 
 
 def _segment_vote(bases, quals, fam_ids, ranks, sizes, *, num_families, num, den,
@@ -177,10 +147,11 @@ def _compiled_segment_duplex(num_pairs, length, num, den, qual_threshold, qual_c
         # fam_ids/ranks are pure functions of sizes — derive them on device
         # (O(M) VPU work) instead of shipping 8 bytes/member over the wire.
         m = packed.shape[0]
-        # Trace-time guard (mirrors consensus_tpu): the rational-cutoff
-        # cross-multiply must fit int32 (JAX silently downcasts int64 when
-        # x64 is off); M bounds any family's size in this layout.
-        if m * max(num, den) >= 2**31:
+        # Trace-time int32-overflow guard for the SEGMENT branch only: there
+        # the cutoff cross-multiply is bounded by M (one family can span the
+        # whole stream).  The gather branch is bounded by member_cap, and
+        # _consensus_one_family carries its own cap-based guard.
+        if member_cap is None and m * max(num, den) >= 2**31:
             raise ValueError(
                 f"member stream of {m} with cutoff {num}/{den} could overflow the "
                 "int32 cutoff compare — chunk the stream"
@@ -325,6 +296,14 @@ def run_duplex_pipelined(rows, qrows, sizes_a, sizes_b, codebook4,
     length = rows.shape[1]
     if member_cap == "auto":
         member_cap = pick_member_cap(np.concatenate([sizes_a, sizes_b]))
+    max_size = int(max(sizes_a.max(initial=0), sizes_b.max(initial=0)))
+    if member_cap is not None and max_size > member_cap:
+        # An undersized cap would silently drop members past it from the
+        # vote while the cutoff denominator still uses the full family size.
+        raise ValueError(
+            f"member_cap={member_cap} < max family size {max_size} — "
+            "raise the cap or pass member_cap=None for the segment path"
+        )
 
     ends_a = np.cumsum(sizes_a, dtype=np.int64)
     starts_a = ends_a - sizes_a

@@ -33,14 +33,18 @@ class StageStats:
         return self._items.get(key, default)
 
     def write(self, path) -> None:
+        # Sorted keys: backends touch counters in different orders (e.g. the
+        # tpu path batches sscs_written increments), and stats files are
+        # parity artifacts — emission order must not encode execution order.
         with open(path, "w") as fh:
             fh.write(f"# {self.stage} stats\n")
-            for key, value in self._items.items():
-                fh.write(f"{key}: {value}\n")
+            for key in sorted(self._items):
+                fh.write(f"{key}: {self._items[key]}\n")
         root, ext = os.path.splitext(str(path))
         json_path = root + ".json" if ext == ".txt" else str(path) + ".json"
         with open(json_path, "w") as fh:
-            json.dump({"stage": self.stage, **self._items}, fh, indent=2)
+            json.dump({"stage": self.stage, **dict(sorted(self._items.items()))},
+                      fh, indent=2)
             fh.write("\n")
 
 

@@ -1,0 +1,169 @@
+"""Regenerate the bundled test dataset + golden digests.
+
+The reference ships a small ``test/`` dataset used for end-to-end smoke
+runs (SURVEY.md §2 "Test data", §4).  This is our equivalent: a
+deterministic ~600-fragment duplex BAM (and a raw FASTQ pair with inline
+UMIs for the extraction stage), plus ``golden.json`` — content digests of
+every pipeline output, canonicalized record-by-record so they are stable
+across BGZF compression levels and writer implementations.
+
+Run from the repo root:  python test/make_test_data.py
+Only run it to *intentionally* re-freeze the goldens after a semantic
+change; tests/test_golden.py pins the pipeline against this file.
+"""
+
+from __future__ import annotations
+
+import gzip
+import hashlib
+import json
+import os
+import shutil
+import sys
+import tempfile
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from consensuscruncher_tpu.io.bam import BamReader  # noqa: E402
+from consensuscruncher_tpu.utils.simulate import SimConfig, simulate_bam  # noqa: E402
+
+DATA_DIR = os.path.join(REPO, "test", "data")
+GOLDEN_PATH = os.path.join(REPO, "test", "golden.json")
+
+SIM = SimConfig(
+    n_fragments=600,
+    read_len=80,
+    umi_len=6,
+    mean_family_size=3.0,
+    duplex_fraction=0.8,
+    error_rate=0.005,
+    seed=20260729,
+)
+
+# FASTQ pair for the extraction stage: 6-base UMI + 1-base spacer 'T'
+# in front of the insert on both mates (bpattern NNNNNNT).
+FASTQ_N = 400
+FASTQ_READ_LEN = 60
+FASTQ_SEED = 73
+BPATTERN = "NNNNNNT"
+
+
+def canonical_bam_digest(path: str) -> str:
+    """sha256 over one text line per record (qname, flag, ref, pos, mapq,
+    cigar, mate, tlen, seq, qual) — the full reference-visible surface of a
+    BAM, independent of compression byte layout."""
+    h = hashlib.sha256()
+    with BamReader(path) as reader:
+        for read in reader:
+            line = "\t".join([
+                read.qname, str(read.flag), read.ref or "*", str(read.pos),
+                str(read.mapq), read.cigar_string(), read.mate_ref or "*",
+                str(read.mate_pos), str(read.tlen), read.seq,
+                "".join(chr(q + 33) for q in read.qual),
+            ])
+            h.update(line.encode())
+            h.update(b"\n")
+    return h.hexdigest()
+
+
+def text_digest(path: str) -> str:
+    """sha256 of a (possibly gzipped) text file's decompressed bytes.
+
+    Lines naming the compute backend are dropped first: cpu and tpu
+    backends must produce identical consensus content, and the stats files
+    record which backend ran — the one legitimate difference."""
+    op = gzip.open if path.endswith(".gz") else open
+    with op(path, "rb") as fh:
+        data = fh.read()
+    kept = [ln for ln in data.split(b"\n") if b"backend" not in ln]
+    return hashlib.sha256(b"\n".join(kept)).hexdigest()
+
+
+def make_fastq_pair(r1_path: str, r2_path: str) -> None:
+    from consensuscruncher_tpu.io.fastq import FastqWriter
+
+    rng = np.random.default_rng(FASTQ_SEED)
+    bases = np.frombuffer(b"ACGT", np.uint8)
+    with FastqWriter(r1_path) as w1, FastqWriter(r2_path) as w2:
+        for i in range(FASTQ_N):
+            for w, mate in ((w1, 1), (w2, 2)):
+                umi = bytes(bases[rng.integers(0, 4, 6)]).decode()
+                insert = bytes(bases[rng.integers(0, 4, FASTQ_READ_LEN)]).decode()
+                seq = umi + "T" + insert
+                qual = "".join(chr(int(q) + 33) for q in rng.integers(25, 41, len(seq)))
+                w.write(f"frag{i} {mate}:N:0:1", seq, qual)
+
+
+def run_pipeline(bam_path: str, out_dir: str, name: str) -> dict[str, str]:
+    """Full consensus pipeline (cpu backend) -> {relative output: digest}."""
+    from consensuscruncher_tpu.cli import main as cli_main
+
+    cli_main([
+        "consensus", "-i", bam_path, "-o", out_dir, "-n", name,
+        "--backend", "cpu", "--scorrect", "True",
+    ])
+    digests = {}
+    base = os.path.join(out_dir, name)
+    for root, _dirs, files in os.walk(base):
+        for f in sorted(files):
+            p = os.path.join(root, f)
+            rel = os.path.relpath(p, base)
+            if f.endswith(".bam"):
+                digests[rel] = canonical_bam_digest(p)
+            elif f.endswith((".txt", ".json")) and f != "manifest.json" \
+                    and "time_tracker" not in f:
+                # manifest + time tracker hold fingerprints/wall-clock —
+                # inherently run-specific, checked by their own tests.
+                digests[rel] = text_digest(p)
+    return digests
+
+
+def run_extract(r1: str, r2: str, out_prefix: str) -> dict[str, str]:
+    from consensuscruncher_tpu.stages.extract_barcodes import run_extract as extract
+
+    extract(r1, r2, out_prefix, bpattern=BPATTERN)
+    digests = {}
+    for suffix in ("_r1.fastq.gz", "_r2.fastq.gz", "_r1_bad.fastq.gz",
+                   "_r2_bad.fastq.gz", ".barcode_distribution.txt",
+                   ".extract_stats.txt"):
+        p = out_prefix + suffix
+        assert os.path.exists(p), f"missing extract output {p}"
+        digests["extract/" + os.path.basename(p)] = text_digest(p)
+    return digests
+
+
+def main() -> None:
+    os.makedirs(DATA_DIR, exist_ok=True)
+    bam = os.path.join(DATA_DIR, "sample.bam")
+    simulate_bam(bam, SIM)
+    r1 = os.path.join(DATA_DIR, "sample_R1.fastq.gz")
+    r2 = os.path.join(DATA_DIR, "sample_R2.fastq.gz")
+    make_fastq_pair(r1, r2)
+
+    tmp = tempfile.mkdtemp(prefix="golden.")
+    try:
+        golden = {
+            "inputs": {
+                "sample.bam": canonical_bam_digest(bam),
+                "sample_R1.fastq.gz": text_digest(r1),
+                "sample_R2.fastq.gz": text_digest(r2),
+            },
+            "consensus": run_pipeline(bam, tmp, "golden"),
+            "extract": run_extract(r1, r2, os.path.join(tmp, "ex")),
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    with open(GOLDEN_PATH, "w") as fh:
+        json.dump(golden, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    print(f"wrote {bam} ({os.path.getsize(bam)} bytes) + fastq pair")
+    print(f"wrote {GOLDEN_PATH}: {len(golden['consensus'])} consensus outputs, "
+          f"{len(golden['extract'])} extract outputs")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,77 @@
+"""Golden-parity tests on the bundled ``test/`` dataset.
+
+SURVEY.md §4.1: the pipeline's own frozen outputs are the test oracle.
+``test/data/sample.bam`` (600 simulated duplex fragments) + the raw FASTQ
+pair run through the full consensus / extraction pipelines and every
+output must match the content digests frozen in ``test/golden.json``
+(regenerate deliberately with ``python test/make_test_data.py`` after a
+semantic change).  Digests canonicalize BAMs record-by-record, so any
+writer/compression change that preserves content still passes — only
+semantic drift fails.
+
+The TPU backend must additionally reproduce the CPU goldens bit-for-bit
+(backend parity on real data, not just synthetic unit batches).
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "test"))
+
+from make_test_data import (  # noqa: E402
+    BPATTERN,
+    canonical_bam_digest,
+    text_digest,
+)
+
+DATA = os.path.join(REPO, "test", "data")
+GOLDEN = json.load(open(os.path.join(REPO, "test", "golden.json")))
+
+
+def test_bundled_inputs_unchanged():
+    assert canonical_bam_digest(os.path.join(DATA, "sample.bam")) == \
+        GOLDEN["inputs"]["sample.bam"]
+    for f in ("sample_R1.fastq.gz", "sample_R2.fastq.gz"):
+        assert text_digest(os.path.join(DATA, f)) == GOLDEN["inputs"][f]
+
+
+@pytest.mark.parametrize("backend", ["cpu", "tpu"])
+def test_consensus_pipeline_matches_golden(tmp_path, backend):
+    from consensuscruncher_tpu.cli import main as cli_main
+
+    cli_main([
+        "consensus", "-i", os.path.join(DATA, "sample.bam"),
+        "-o", str(tmp_path), "-n", "golden",
+        "--backend", backend, "--scorrect", "True",
+    ])
+    base = tmp_path / "golden"
+    mismatches = []
+    for rel, expected in GOLDEN["consensus"].items():
+        p = base / rel
+        assert p.exists(), f"missing output {rel}"
+        got = canonical_bam_digest(str(p)) if rel.endswith(".bam") else text_digest(str(p))
+        if got != expected:
+            mismatches.append(rel)
+    assert not mismatches, f"{backend} outputs diverge from golden: {mismatches}"
+
+
+def test_extract_matches_golden(tmp_path):
+    from consensuscruncher_tpu.stages.extract_barcodes import run_extract
+
+    prefix = str(tmp_path / "ex")
+    run_extract(
+        os.path.join(DATA, "sample_R1.fastq.gz"),
+        os.path.join(DATA, "sample_R2.fastq.gz"),
+        prefix, bpattern=BPATTERN,
+    )
+    mismatches = []
+    for rel, expected in GOLDEN["extract"].items():
+        p = prefix + rel.removeprefix("extract/ex")
+        assert os.path.exists(p), f"missing output {rel}"
+        if text_digest(p) != expected:
+            mismatches.append(rel)
+    assert not mismatches, f"extract outputs diverge from golden: {mismatches}"

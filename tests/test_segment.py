@@ -161,6 +161,19 @@ def test_run_duplex_pipelined_matches_single_shot():
     np.testing.assert_array_equal(out[6], single[6])
 
 
+def test_run_duplex_pipelined_rejects_undersized_cap():
+    import pytest
+
+    from consensuscruncher_tpu.ops.consensus_segment import run_duplex_pipelined
+
+    na, nb = np.array([9], np.int32), np.array([0], np.int32)
+    bases = np.zeros((9, 4), np.uint8)
+    quals = np.full((9, 4), 37, np.uint8)
+    book = build_codebook4(BINNED)
+    with pytest.raises(ValueError, match="member_cap=4 < max family size 9"):
+        run_duplex_pipelined(bases, quals, na, nb, book, member_cap=4)
+
+
 def test_run_duplex_pipelined_segment_fallback_with_padding():
     # member_cap=None (the >MAX_DENSE_CAP fallback) must survive the
     # member-axis zero-padding: phantom rows are rerouted to a discarded
