@@ -37,6 +37,7 @@ import sys
 from consensuscruncher_tpu import __version__
 from consensuscruncher_tpu.core.tags import DEFAULT_BDELIM
 from consensuscruncher_tpu.io import sam as sam_mod
+from consensuscruncher_tpu.io.bai import index_bam
 from consensuscruncher_tpu.io.bam import BamWriter, merge_bams, sort_bam
 from consensuscruncher_tpu.stages.extract_barcodes import run_extract
 from consensuscruncher_tpu.stages import dcs_maker, singleton_correction, sscs_maker
@@ -83,6 +84,7 @@ def fastq2bam(args) -> dict:
 
     out_bam = os.path.join(bam_dir, f"{name}.sorted.bam")
     align_and_sort(args.bwa, args.ref, extract.r1_out, extract.r2_out, out_bam)
+    index_bam(out_bam)  # reference: `samtools index` after every sort (§3.1)
     print(f"fastq2bam: wrote {out_bam}")
     return {"bam": out_bam, "extract": extract}
 
@@ -234,6 +236,17 @@ def consensus(args) -> dict:
         run=lambda: merge_bams(dcs_merge_in, all_dcs),
         rebuild=lambda: None,
     )
+
+    # Index every surviving coordinate-sorted BAM (reference: `samtools
+    # index` after each sort/merge; downstream tools region-fetch these).
+    index_parts = [all_sscs, all_dcs, dcs_res.dcs_bam, dcs_res.sscs_singleton_bam,
+                   sscs_res.sscs_bam, sscs_res.singleton_bam]
+    if args.scorrect:
+        index_parts += [corr.sscs_rescue_bam, corr.singleton_rescue_bam,
+                        corr.remaining_bam, dcs_input]
+    for path in index_parts:
+        if os.path.exists(path):
+            index_bam(path)
 
     plot_family_size(
         os.path.join(dirs["sscs"], f"{name}.read_families.txt"),

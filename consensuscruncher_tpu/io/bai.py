@@ -1,0 +1,441 @@
+"""BAI index: build (``samtools index`` parity) and random-access fetch
+(``pysam.AlignmentFile.fetch`` parity).
+
+The reference pipeline runs ``samtools index`` after every sort and then
+streams regions per chromosome through ``pysam.fetch``
+(SURVEY.md §1 "External tools", §3.2).  Neither tool exists in this
+image, and the rebuild's reader is first-party — so the index is too.
+Format: SAM spec §5.2 (UCSC R-tree binning + 16 kb linear index, virtual
+file offsets ``coffset << 16 | uoffset``), including the samtools
+metadata pseudo-bin 37450 and the trailing no-coordinate count.
+
+Everything here is host-side I/O; nothing touches the device.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from dataclasses import dataclass, field
+
+from consensuscruncher_tpu.io import bgzf
+from consensuscruncher_tpu.io.bam import BAM_MAGIC, BamHeader, decode_record
+
+BAI_MAGIC = b"BAI\x01"
+_PSEUDO_BIN = 37450  # samtools metadata bin (bin(4681,8191) + 1 + ...)
+_LINEAR_SHIFT = 14  # 16 kb linear-index windows
+# CIGAR ops that consume reference: M, D, N, =, X  (spec order MIDNSHP=X)
+_REF_CONSUMING = frozenset(b"MDN=X".decode())
+_CIGAR_OPS = "MIDNSHP=X"
+
+
+def reg2bin(beg: int, end: int) -> int:
+    """SAM spec §5.3 bin for a [beg, end) interval."""
+    end -= 1
+    if beg >> 14 == end >> 14:
+        return ((1 << 15) - 1) // 7 + (beg >> 14)
+    if beg >> 17 == end >> 17:
+        return ((1 << 12) - 1) // 7 + (beg >> 17)
+    if beg >> 20 == end >> 20:
+        return ((1 << 9) - 1) // 7 + (beg >> 20)
+    if beg >> 23 == end >> 23:
+        return ((1 << 6) - 1) // 7 + (beg >> 23)
+    if beg >> 26 == end >> 26:
+        return ((1 << 3) - 1) // 7 + (beg >> 26)
+    return 0
+
+
+def reg2bins(beg: int, end: int) -> list[int]:
+    """All bins that may hold records overlapping [beg, end) (spec §5.3)."""
+    bins = [0]
+    end -= 1
+    for shift, base in ((26, 1), (23, 9), (20, 73), (17, 585), (14, 4681)):
+        bins.extend(range(base + (beg >> shift), base + (end >> shift) + 1))
+    return bins
+
+
+@dataclass
+class _RefIndex:
+    bins: dict[int, list[list[int]]] = field(default_factory=dict)  # bin -> [[beg,end]...]
+    linear: list[int] = field(default_factory=list)  # 16kb window -> min voffset
+    n_mapped: int = 0
+    n_unmapped: int = 0
+    off_beg: int = -1
+    off_end: int = 0
+
+    def add(self, beg: int, end: int, vbeg: int, vend: int, mapped: bool) -> None:
+        if self.off_beg < 0:
+            self.off_beg = vbeg
+        self.off_end = vend
+        if mapped:
+            self.n_mapped += 1
+        else:
+            self.n_unmapped += 1
+        chunks = self.bins.setdefault(reg2bin(beg, end), [])
+        # htslib merge rule: coalesce with the previous chunk when the new
+        # one starts in the same compressed block the previous one ends in.
+        if chunks and chunks[-1][1] >> 16 == vbeg >> 16:
+            chunks[-1][1] = vend
+        else:
+            chunks.append([vbeg, vend])
+        w_beg, w_end = beg >> _LINEAR_SHIFT, max(beg, end - 1) >> _LINEAR_SHIFT
+        if len(self.linear) <= w_end:
+            self.linear.extend([0] * (w_end + 1 - len(self.linear)))
+        for w in range(w_beg, w_end + 1):
+            if self.linear[w] == 0:
+                self.linear[w] = vbeg
+
+
+class _VoffsetTracker:
+    """Maps global uncompressed offsets to virtual file offsets while
+    streaming blocks in order.  Blocks are registered monotonically; lookups
+    are monotonic too, so spent anchors are dropped as we go."""
+
+    def __init__(self):
+        self._anchors: list[tuple[int, int, int]] = []  # (u_start, coffset, len)
+
+    def add_block(self, u_start: int, coffset: int, length: int) -> None:
+        self._anchors.append((u_start, coffset, length))
+
+    def voffset(self, u: int) -> int:
+        """Virtual offset of global uncompressed position ``u``.  Positions
+        at a block boundary resolve into the LATER block (a record never
+        starts in the spent tail of a block)."""
+        while len(self._anchors) > 1 and self._anchors[1][0] <= u:
+            self._anchors.pop(0)
+        u_start, coffset, _len = self._anchors[0]
+        if u < u_start:
+            raise ValueError("voffset lookups must be monotonic")
+        return (coffset << 16) | (u - u_start)
+
+    def voffset_end(self, u_end: int) -> int:
+        """Virtual offset just past a record ending at global position
+        ``u_end`` — stays in the block holding the record's last byte (so a
+        record ending exactly at a block boundary gets uoffset == block
+        length, matching htslib's post-read file-pointer convention)."""
+        while len(self._anchors) > 1 and self._anchors[1][0] <= u_end - 1:
+            self._anchors.pop(0)
+        u_start, coffset, _len = self._anchors[0]
+        return (coffset << 16) | (u_end - u_start)
+
+
+def _iter_blocks_with_offsets(fh):
+    """Yield ``(file_offset, payload)`` per BGZF block, batch-inflating
+    through the native codec when available (the reader path's fast lane —
+    ``index_bam`` re-reads whole BAMs, so serial Python zlib would be the
+    indexer's wall clock)."""
+    from consensuscruncher_tpu.io import native
+
+    if not native.available():
+        while True:
+            off = fh.tell()
+            payload = bgzf.read_block(fh)
+            if payload is None:
+                return
+            yield off, payload
+        return
+    base = fh.tell()
+    tail = b""
+    while True:
+        metas, consumed = bgzf.scan_block_metas(tail)
+        while consumed == 0:
+            more = fh.read(bgzf._NATIVE_READ_CHUNK)
+            if not more:
+                if tail:
+                    raise ValueError("truncated BGZF block")
+                return
+            tail += more
+            metas, consumed = bgzf.scan_block_metas(tail)
+        data_offs, comp_lens, isizes, _crcs = metas
+        payload = native.inflate_blocks(tail, *metas)
+        # Block k starts where the previous one ended: data_off points at the
+        # raw-deflate span, so start_k+1 = data_off_k + comp_len_k + 8 (CRC +
+        # ISIZE tail), and start_0 = 0 within this scan window.
+        u = 0
+        start = 0
+        for k in range(len(isizes)):
+            size = int(isizes[k])
+            yield base + start, payload[u : u + size]
+            u += size
+            start = int(data_offs[k]) + int(comp_lens[k]) + 8
+        base += consumed
+        tail = tail[consumed:]
+
+
+def _record_span(body: bytes) -> tuple[int, int, int, bool]:
+    """(ref_id, pos, end, mapped) from a raw record body (no full decode)."""
+    ref_id, pos = struct.unpack_from("<ii", body, 0)
+    l_read_name = body[8]
+    (n_cigar,) = struct.unpack_from("<H", body, 12)
+    (flag,) = struct.unpack_from("<H", body, 14)
+    mapped = (flag & 0x4) == 0
+    end = pos + 1
+    if mapped and n_cigar:
+        off = 32 + l_read_name
+        ref_len = 0
+        for i in range(n_cigar):
+            (v,) = struct.unpack_from("<I", body, off + 4 * i)
+            if _CIGAR_OPS[v & 0xF] in _REF_CONSUMING:
+                ref_len += v >> 4
+        end = pos + max(ref_len, 1)
+    return ref_id, pos, end, mapped
+
+
+def index_bam(bam_path, bai_path=None) -> str:
+    """Build ``<bam>.bai`` for a coordinate-sorted BAM.  Returns the path."""
+    bam_path = os.fspath(bam_path)
+    bai_path = bai_path or bam_path + ".bai"
+
+    refs: list[_RefIndex] = []
+    n_no_coor = 0
+    tracker = _VoffsetTracker()
+    last_ref, last_pos = -1, -1
+
+    with open(bam_path, "rb") as fh:
+        # Walk raw blocks so every record's virtual offset is known.
+        blocks = _iter_blocks_with_offsets(fh)
+        buf = bytearray()
+        buf_u = 0  # global uncompressed offset of buf[0]
+        eof = False
+
+        def fill(need: int) -> bool:
+            nonlocal eof
+            while len(buf) < need and not eof:
+                try:
+                    coffset, payload = next(blocks)
+                except StopIteration:
+                    eof = True
+                    return len(buf) >= need
+                tracker.add_block(buf_u + len(buf), coffset, len(payload))
+                buf.extend(payload)
+            return len(buf) >= need
+
+        def take(n: int) -> bytes:
+            nonlocal buf, buf_u
+            out = bytes(buf[:n])
+            del buf[:n]
+            buf_u += n
+            return out
+
+        # Header: magic, text, refs — indexed content starts after it.
+        if not fill(12):
+            raise ValueError("truncated BAM header")
+        if bytes(buf[:4]) != BAM_MAGIC:
+            raise ValueError(f"not a BAM file: {bam_path!r}")
+        (l_text,) = struct.unpack_from("<i", buf, 4)
+        if not fill(12 + l_text):
+            raise ValueError("truncated BAM header")
+        take(8 + l_text)
+        (n_ref,) = struct.unpack("<i", take(4))
+        for _ in range(n_ref):
+            if not fill(8):
+                raise ValueError("truncated BAM header")
+            (l_name,) = struct.unpack("<i", take(4))
+            if not fill(l_name + 4):
+                raise ValueError("truncated BAM header")
+            take(l_name + 4)
+            refs.append(_RefIndex())
+
+        while True:
+            if not fill(4):
+                break
+            (block_size,) = struct.unpack("<i", bytes(buf[:4]))
+            if not fill(4 + block_size):
+                raise ValueError("truncated BAM record")
+            vbeg = tracker.voffset(buf_u)
+            body = take(4 + block_size)[4:]
+            vend = tracker.voffset_end(buf_u)
+            ref_id, pos, end, mapped = _record_span(body)
+            if ref_id < 0:
+                n_no_coor += 1
+                continue
+            if ref_id < last_ref or (ref_id == last_ref and pos < last_pos):
+                raise ValueError(
+                    f"{bam_path!r} is not coordinate-sorted "
+                    f"(ref {ref_id} pos {pos} after ref {last_ref} pos {last_pos})"
+                )
+            last_ref, last_pos = ref_id, pos
+            refs[ref_id].add(pos, end, vbeg, vend, mapped)
+
+    tmp = bai_path + ".tmp"
+    with open(tmp, "wb") as out:
+        out.write(BAI_MAGIC)
+        out.write(struct.pack("<i", len(refs)))
+        for r in refs:
+            has_meta = r.off_beg >= 0
+            out.write(struct.pack("<i", len(r.bins) + (1 if has_meta else 0)))
+            for b in sorted(r.bins):
+                chunks = r.bins[b]
+                out.write(struct.pack("<Ii", b, len(chunks)))
+                for beg, end in chunks:
+                    out.write(struct.pack("<QQ", beg, end))
+            if has_meta:
+                out.write(struct.pack("<Ii", _PSEUDO_BIN, 2))
+                out.write(struct.pack("<QQ", r.off_beg, r.off_end))
+                out.write(struct.pack("<QQ", r.n_mapped, r.n_unmapped))
+            out.write(struct.pack("<i", len(r.linear)))
+            for v in r.linear:
+                out.write(struct.pack("<Q", v))
+        out.write(struct.pack("<Q", n_no_coor))
+    os.replace(tmp, bai_path)
+    return bai_path
+
+
+@dataclass
+class BaiIndex:
+    """Loaded .bai: per-ref bins/linear + metadata."""
+
+    bins: list[dict[int, list[tuple[int, int]]]]
+    linear: list[list[int]]
+    meta: list[tuple[int, int, int, int] | None]  # (off_beg, off_end, mapped, unmapped)
+    n_no_coor: int
+
+    @classmethod
+    def load(cls, path) -> "BaiIndex":
+        with open(path, "rb") as fh:
+            data = fh.read()
+        if data[:4] != BAI_MAGIC:
+            raise ValueError(f"not a BAI index: {os.fspath(path)!r}")
+        off = 4
+        (n_ref,) = struct.unpack_from("<i", data, off)
+        off += 4
+        bins, linear, meta = [], [], []
+        for _ in range(n_ref):
+            (n_bin,) = struct.unpack_from("<i", data, off)
+            off += 4
+            ref_bins: dict[int, list[tuple[int, int]]] = {}
+            ref_meta = None
+            for _ in range(n_bin):
+                b, n_chunk = struct.unpack_from("<Ii", data, off)
+                off += 8
+                chunks = []
+                for _ in range(n_chunk):
+                    beg, end = struct.unpack_from("<QQ", data, off)
+                    off += 16
+                    chunks.append((beg, end))
+                if b == _PSEUDO_BIN:
+                    ref_meta = (chunks[0][0], chunks[0][1], chunks[1][0], chunks[1][1])
+                else:
+                    ref_bins[b] = chunks
+            (n_intv,) = struct.unpack_from("<i", data, off)
+            off += 4
+            ref_linear = list(struct.unpack_from(f"<{n_intv}Q", data, off))
+            off += 8 * n_intv
+            bins.append(ref_bins)
+            linear.append(ref_linear)
+            meta.append(ref_meta)
+        n_no_coor = struct.unpack_from("<Q", data, off)[0] if off + 8 <= len(data) else 0
+        return cls(bins=bins, linear=linear, meta=meta, n_no_coor=n_no_coor)
+
+
+class IndexedBamReader:
+    """Random-access BAM reader over a .bai (``pysam.fetch`` parity).
+
+    ``fetch(ref, beg, end)`` yields exactly the records overlapping
+    [beg, end) on ``ref``, in file (coordinate) order, touching only the
+    compressed blocks the index points at.
+    """
+
+    def __init__(self, bam_path, bai_path=None):
+        bam_path = os.fspath(bam_path)
+        bai_path = bai_path or bam_path + ".bai"
+        if not os.path.exists(bai_path):
+            index_bam(bam_path, bai_path)
+        self.index = BaiIndex.load(bai_path)
+        # Header decode first (pins the ref name -> id mapping); the raw
+        # handle opens last so a parse failure can't leak it.
+        from consensuscruncher_tpu.io.bam import BamReader
+
+        with BamReader(bam_path) as r:
+            self.header: BamHeader = r.header
+        self._fh = open(bam_path, "rb")
+
+    def close(self) -> None:
+        self._fh.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # ------------------------------------------------------------- internals
+
+    def _read_from(self, voffset: int):
+        """Yield (vbeg, body) record stream starting at ``voffset``."""
+        self._fh.seek(voffset >> 16)
+        buf = bytearray()
+        u = 0
+        skip = voffset & 0xFFFF
+
+        tracker = _VoffsetTracker()
+        eof = False
+
+        def fill(need: int) -> bool:
+            nonlocal eof
+            while len(buf) - skip < need and not eof:
+                coffset = self._fh.tell()
+                payload = bgzf.read_block(self._fh)
+                if payload is None:
+                    eof = True
+                    break
+                tracker.add_block(u + len(buf), coffset, len(payload))
+                buf.extend(payload)
+            return len(buf) - skip >= need
+
+        # Drop the intra-block skip once, keeping anchor math consistent.
+        if not fill(0) and not buf:
+            return
+        while True:
+            if skip:
+                del buf[:skip]
+                # anchors track global u; advancing u by skip keeps them valid
+                u += skip
+                skip = 0
+            if not fill(4):
+                return
+            vbeg = tracker.voffset(u)
+            (block_size,) = struct.unpack_from("<i", buf, 0)
+            if not fill(4 + block_size):
+                raise ValueError("truncated BAM record")
+            body = bytes(buf[4 : 4 + block_size])
+            del buf[: 4 + block_size]
+            u += 4 + block_size
+            yield vbeg, body
+
+    def fetch(self, ref: str, beg: int = 0, end: int | None = None):
+        """Yield decoded records overlapping [beg, end) on ``ref``."""
+        rid = self.header.ref_id(ref)
+        if end is None:
+            end = self.header.refs[rid][1]
+        ref_bins = self.index.bins[rid]
+        chunks: list[tuple[int, int]] = []
+        for b in reg2bins(beg, end):
+            chunks.extend(ref_bins.get(b, ()))
+        if not chunks:
+            return
+        # Linear-index floor: skip chunks that end before the first record
+        # that could overlap beg.
+        lin = self.index.linear[rid]
+        w = beg >> _LINEAR_SHIFT
+        min_off = lin[w] if w < len(lin) else (lin[-1] if lin else 0)
+        chunks = sorted(c for c in chunks if c[1] > min_off)
+        if not chunks:
+            return
+        # Merge overlapping/adjacent chunk runs to avoid re-reading blocks.
+        merged = [list(chunks[0])]
+        for cb, ce in chunks[1:]:
+            if cb <= merged[-1][1]:
+                merged[-1][1] = max(merged[-1][1], ce)
+            else:
+                merged.append([cb, ce])
+        for cb, ce in merged:
+            start = max(cb, min_off)
+            for vbeg, body in self._read_from(start):
+                if vbeg >= ce:
+                    break
+                ref_id, pos, rec_end, _mapped = _record_span(body)
+                if ref_id != rid or pos >= end:
+                    break
+                if rec_end > beg:
+                    yield decode_record(body, self.header)

@@ -67,47 +67,57 @@ def compress_block(payload: bytes, level: int = 6) -> bytes:
     return _block_header(block_size) + data + _TAIL.pack(zlib.crc32(payload), len(payload))
 
 
+def read_block(fh: BinaryIO) -> bytes | None:
+    """Read ONE BGZF block from ``fh``: decompressed payload (b"" for empty
+    blocks, e.g. the EOF marker), or None at clean EOF.  Validates framing +
+    CRC exactly like :func:`iter_blocks` (which is built on this)."""
+    header = fh.read(18)
+    if len(header) == 0:
+        return None  # clean EOF (tolerated even without the marker block)
+    if len(header) < 18:
+        raise ValueError("truncated BGZF block header")
+    if header[0] != 0x1F or header[1] != 0x8B:
+        raise ValueError("not a BGZF/gzip stream (bad magic)")
+    if header[3] & 0x04 == 0:
+        raise ValueError("gzip member lacks the BGZF BC extra subfield")
+    # Scan the extra field for the BC subfield (SAM spec §4.1 allows other
+    # subfields alongside it, so the 18-byte fast layout is not assumed).
+    (xlen,) = struct.unpack_from("<H", header, 10)
+    extra = header[12:18]
+    if xlen > 6:
+        extra += fh.read(xlen - 6)
+        if len(extra) < xlen:
+            raise ValueError("truncated BGZF extra field")
+    bsize = None
+    off = 0
+    while off + 4 <= xlen:
+        si1, si2, slen = extra[off], extra[off + 1], struct.unpack_from("<H", extra, off + 2)[0]
+        if si1 == 0x42 and si2 == 0x43 and slen == 2:
+            (bsize,) = struct.unpack_from("<H", extra, off + 4)
+            break
+        off += 4 + slen
+    if bsize is None:
+        raise ValueError("gzip member lacks the BGZF BC extra subfield")
+    block_size = bsize + 1
+    consumed = 12 + xlen
+    rest = fh.read(block_size - consumed)
+    if len(rest) < block_size - consumed:
+        raise ValueError("truncated BGZF block")
+    data, (crc, isize) = rest[:-8], _TAIL.unpack(rest[-8:])
+    payload = zlib.decompress(data, -15) if isize else b""
+    if len(payload) != isize:
+        raise ValueError(f"BGZF ISIZE mismatch: {len(payload)} != {isize}")
+    if zlib.crc32(payload) != crc:
+        raise ValueError("BGZF CRC mismatch")
+    return payload
+
+
 def iter_blocks(fh: BinaryIO) -> Iterator[bytes]:
     """Yield decompressed payloads block by block, validating framing + CRC."""
     while True:
-        header = fh.read(18)
-        if len(header) == 0:
-            return  # clean EOF (tolerated even without the marker block)
-        if len(header) < 18:
-            raise ValueError("truncated BGZF block header")
-        if header[0] != 0x1F or header[1] != 0x8B:
-            raise ValueError("not a BGZF/gzip stream (bad magic)")
-        if header[3] & 0x04 == 0:
-            raise ValueError("gzip member lacks the BGZF BC extra subfield")
-        # Scan the extra field for the BC subfield (SAM spec §4.1 allows other
-        # subfields alongside it, so the 18-byte fast layout is not assumed).
-        (xlen,) = struct.unpack_from("<H", header, 10)
-        extra = header[12:18]
-        if xlen > 6:
-            extra += fh.read(xlen - 6)
-            if len(extra) < xlen:
-                raise ValueError("truncated BGZF extra field")
-        bsize = None
-        off = 0
-        while off + 4 <= xlen:
-            si1, si2, slen = extra[off], extra[off + 1], struct.unpack_from("<H", extra, off + 2)[0]
-            if si1 == 0x42 and si2 == 0x43 and slen == 2:
-                (bsize,) = struct.unpack_from("<H", extra, off + 4)
-                break
-            off += 4 + slen
-        if bsize is None:
-            raise ValueError("gzip member lacks the BGZF BC extra subfield")
-        block_size = bsize + 1
-        consumed = 12 + xlen
-        rest = fh.read(block_size - consumed)
-        if len(rest) < block_size - consumed:
-            raise ValueError("truncated BGZF block")
-        data, (crc, isize) = rest[:-8], _TAIL.unpack(rest[-8:])
-        payload = zlib.decompress(data, -15) if isize else b""
-        if len(payload) != isize:
-            raise ValueError(f"BGZF ISIZE mismatch: {len(payload)} != {isize}")
-        if zlib.crc32(payload) != crc:
-            raise ValueError("BGZF CRC mismatch")
+        payload = read_block(fh)
+        if payload is None:
+            return
         if payload:
             yield payload
 

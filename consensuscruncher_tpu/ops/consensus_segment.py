@@ -33,7 +33,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from consensuscruncher_tpu.ops.consensus_tpu import ConsensusConfig
+from consensuscruncher_tpu.ops.consensus_tpu import ConsensusConfig, _consensus_one_family
 from consensuscruncher_tpu.ops.duplex_tpu import duplex_vote
 from consensuscruncher_tpu.ops.packing import pack4, unpack4_device
 from consensuscruncher_tpu.utils.phred import N, NUM_BASES
@@ -68,8 +68,6 @@ def _gather_dense_vote(bases, quals, sizes, *, cap, num, den,
     ``cap / mean_size`` redundant HBM reads (never redundant wire bytes:
     the wire format is unchanged).
     """
-    from consensuscruncher_tpu.ops.consensus_tpu import _consensus_one_family
-
     sizes = sizes.astype(jnp.int32)
     starts = jnp.concatenate([jnp.zeros(1, jnp.int32), jnp.cumsum(sizes)[:-1]])
     r = jnp.arange(cap, dtype=jnp.int32)
