@@ -130,6 +130,17 @@ def align_and_sort(bwa: str, ref: str, r1: str, r2: str, out_bam: str) -> None:
 # ------------------------------------------------------------------ consensus
 
 def consensus(args) -> dict:
+    # SURVEY.md §5 tracing: --profile <dir> wraps the whole run in a
+    # jax.profiler trace (XLA + host timeline; open in TensorBoard or
+    # Perfetto).  Stage-level wall-clock always lands in the per-stage
+    # *.metrics.json / *.time_tracker.txt regardless.
+    from consensuscruncher_tpu.utils.profiling import maybe_profile
+
+    with maybe_profile(getattr(args, "profile", None)):
+        return _consensus_impl(args)
+
+
+def _consensus_impl(args) -> dict:
     name = args.name or os.path.basename(args.input).split(".")[0]
     base = os.path.join(args.output, name)
     dirs = {k: os.path.join(base, k) for k in ("sscs", "singleton", "dcs", "all_unique", "plots")}
@@ -246,7 +257,7 @@ def consensus(args) -> dict:
                         corr.remaining_bam, dcs_input]
     for path in index_parts:
         if os.path.exists(path):
-            index_bam(path)
+            index_bam(path, skip_if_fresh=True)
 
     plot_family_size(
         os.path.join(dirs["sscs"], f"{name}.read_families.txt"),
@@ -311,6 +322,8 @@ def build_parser() -> argparse.ArgumentParser:
     c.add_argument("--bdelim")
     c.add_argument("--cleanup", help="remove intermediate BAMs")
     c.add_argument("--resume", help="skip stages whose manifest-recorded outputs are intact")
+    c.add_argument("--profile", default=None, metavar="DIR",
+                   help="write a jax.profiler trace of the run into DIR")
     c.set_defaults(func=consensus, config_section="consensus",
                    required_args=("input", "output"),
                    builtin_defaults={

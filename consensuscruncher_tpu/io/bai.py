@@ -119,49 +119,6 @@ class _VoffsetTracker:
         return (coffset << 16) | (u_end - u_start)
 
 
-def _iter_blocks_with_offsets(fh):
-    """Yield ``(file_offset, payload)`` per BGZF block, batch-inflating
-    through the native codec when available (the reader path's fast lane —
-    ``index_bam`` re-reads whole BAMs, so serial Python zlib would be the
-    indexer's wall clock)."""
-    from consensuscruncher_tpu.io import native
-
-    if not native.available():
-        while True:
-            off = fh.tell()
-            payload = bgzf.read_block(fh)
-            if payload is None:
-                return
-            yield off, payload
-        return
-    base = fh.tell()
-    tail = b""
-    while True:
-        metas, consumed = bgzf.scan_block_metas(tail)
-        while consumed == 0:
-            more = fh.read(bgzf._NATIVE_READ_CHUNK)
-            if not more:
-                if tail:
-                    raise ValueError("truncated BGZF block")
-                return
-            tail += more
-            metas, consumed = bgzf.scan_block_metas(tail)
-        data_offs, comp_lens, isizes, _crcs = metas
-        payload = native.inflate_blocks(tail, *metas)
-        # Block k starts where the previous one ended: data_off points at the
-        # raw-deflate span, so start_k+1 = data_off_k + comp_len_k + 8 (CRC +
-        # ISIZE tail), and start_0 = 0 within this scan window.
-        u = 0
-        start = 0
-        for k in range(len(isizes)):
-            size = int(isizes[k])
-            yield base + start, payload[u : u + size]
-            u += size
-            start = int(data_offs[k]) + int(comp_lens[k]) + 8
-        base += consumed
-        tail = tail[consumed:]
-
-
 def _record_span(body: bytes) -> tuple[int, int, int, bool]:
     """(ref_id, pos, end, mapped) from a raw record body (no full decode)."""
     ref_id, pos = struct.unpack_from("<ii", body, 0)
@@ -181,10 +138,18 @@ def _record_span(body: bytes) -> tuple[int, int, int, bool]:
     return ref_id, pos, end, mapped
 
 
-def index_bam(bam_path, bai_path=None) -> str:
-    """Build ``<bam>.bai`` for a coordinate-sorted BAM.  Returns the path."""
+def index_bam(bam_path, bai_path=None, skip_if_fresh: bool = False) -> str:
+    """Build ``<bam>.bai`` for a coordinate-sorted BAM.  Returns the path.
+
+    ``skip_if_fresh``: return without re-reading the BAM when the index
+    already exists and is at least as new as it (the --resume fast path —
+    indexing re-inflates the whole file, so it must not defeat skip-if-
+    intact runs)."""
     bam_path = os.fspath(bam_path)
     bai_path = bai_path or bam_path + ".bai"
+    if (skip_if_fresh and os.path.exists(bai_path)
+            and os.path.getmtime(bai_path) >= os.path.getmtime(bam_path)):
+        return bai_path
 
     refs: list[_RefIndex] = []
     n_no_coor = 0
@@ -193,7 +158,7 @@ def index_bam(bam_path, bai_path=None) -> str:
 
     with open(bam_path, "rb") as fh:
         # Walk raw blocks so every record's virtual offset is known.
-        blocks = _iter_blocks_with_offsets(fh)
+        blocks = bgzf.iter_blocks_with_offsets(fh)
         buf = bytearray()
         buf_u = 0  # global uncompressed offset of buf[0]
         eof = False
@@ -408,6 +373,8 @@ class IndexedBamReader:
         rid = self.header.ref_id(ref)
         if end is None:
             end = self.header.refs[rid][1]
+        if end <= beg:
+            return  # [beg, end) is empty — nothing can overlap it
         ref_bins = self.index.bins[rid]
         chunks: list[tuple[int, int]] = []
         for b in reg2bins(beg, end):

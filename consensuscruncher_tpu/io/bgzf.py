@@ -178,8 +178,13 @@ def scan_block_metas(buf: bytes) -> tuple[tuple, int]:
 _NATIVE_READ_CHUNK = 8 << 20  # compressed bytes per native inflate batch
 
 
-def _iter_chunks_native(fh: BinaryIO) -> Iterator[bytes]:
-    """Yield decompressed chunks via the native batch codec (multi-block)."""
+def _iter_native_batches(fh: BinaryIO) -> Iterator[tuple[int, tuple, bytes]]:
+    """Yield ``(base_offset, metas, payload)`` per native inflate batch:
+    ``metas`` is the :func:`scan_block_metas` tuple for the batch's blocks
+    (offsets relative to ``base_offset``) and ``payload`` their concatenated
+    decompressed bytes.  The single native read loop — every consumer of
+    batch inflation goes through here so framing/tail handling lives once."""
+    base = fh.tell()
     tail = b""
     while True:
         metas, consumed = scan_block_metas(tail)
@@ -192,9 +197,42 @@ def _iter_chunks_native(fh: BinaryIO) -> Iterator[bytes]:
             tail += more
             metas, consumed = scan_block_metas(tail)
         payload = native.inflate_blocks(tail, *metas)
+        yield base, metas, payload
+        base += consumed
         tail = tail[consumed:]
+
+
+def _iter_chunks_native(fh: BinaryIO) -> Iterator[bytes]:
+    """Yield decompressed chunks via the native batch codec (multi-block)."""
+    for _base, _metas, payload in _iter_native_batches(fh):
         if payload:
             yield payload
+
+
+def iter_blocks_with_offsets(fh: BinaryIO) -> Iterator[tuple[int, bytes]]:
+    """Yield ``(file_offset, payload)`` per BGZF block — the shape indexers
+    need (virtual offsets are built from block starts).  Uses the native
+    batch codec when available, else the per-block Python path."""
+    if not native.available():
+        while True:
+            off = fh.tell()
+            payload = read_block(fh)
+            if payload is None:
+                return
+            yield off, payload
+        return
+    for base, metas, payload in _iter_native_batches(fh):
+        data_offs, comp_lens, isizes, _crcs = metas
+        # Block k starts where k-1 ended: data_off points at the raw-deflate
+        # span, so start_{k+1} = data_off_k + comp_len_k + 8 (CRC + ISIZE
+        # tail); start_0 = 0 within the batch window.
+        u = 0
+        start = 0
+        for k in range(len(isizes)):
+            size = int(isizes[k])
+            yield base + start, payload[u : u + size]
+            u += size
+            start = int(data_offs[k]) + int(comp_lens[k]) + 8
 
 
 class BgzfReader(io.RawIOBase):

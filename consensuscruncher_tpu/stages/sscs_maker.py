@@ -37,6 +37,7 @@ from consensuscruncher_tpu.ops.consensus_tpu import ConsensusConfig, consensus_f
 from consensuscruncher_tpu.parallel.batching import rectangularize
 from consensuscruncher_tpu.stages.grouping import stream_families
 from consensuscruncher_tpu.utils.phred import encode_seq
+from consensuscruncher_tpu.utils.profiling import write_metrics
 from consensuscruncher_tpu.utils.stats import FamilySizeHistogram, StageStats, TimeTracker
 
 
@@ -188,6 +189,11 @@ def run_sscs(
     stats.write(paths["stats_txt"])
     hist.write(paths["families"])
     tracker.write(paths["time_tracker"])
+    write_metrics(
+        f"{out_prefix}.metrics.json", "SSCS", tracker.as_phases(),
+        {"backend": backend, "n_families": stats.get("families"),
+         "n_reads": stats.get("total_reads")},
+    )
     return SscsResult(sscs_path, singleton_path, bad_path, stats, hist)
 
 

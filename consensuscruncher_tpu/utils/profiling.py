@@ -1,0 +1,52 @@
+"""Tracing/profiling (SURVEY.md §5 "Tracing / profiling").
+
+The reference's only instrumentation is the coarse wall-clock
+``*.time_tracker.txt`` the SSCS stage writes.  The rebuild keeps that file
+for parity (``utils.stats.TimeTracker``) and adds the TPU-era pieces:
+
+- :func:`maybe_profile` — wrap any region in a ``jax.profiler.trace``
+  (XLA + host timeline, viewable in TensorBoard/Perfetto) when a trace
+  directory is given; zero overhead when not.
+- :func:`write_metrics` — structured per-stage metrics JSON
+  (phase wall-clock + derived throughput such as families/sec, the
+  BASELINE.json driver metric), sitting next to the human-readable
+  tracker file.  Run-specific by nature, so excluded from golden digests
+  exactly like the tracker.
+"""
+
+from __future__ import annotations
+
+import json
+from contextlib import contextmanager
+
+
+@contextmanager
+def maybe_profile(trace_dir: str | None):
+    """``jax.profiler.trace(trace_dir)`` when ``trace_dir`` is set, else a
+    no-op.  Imports jax lazily so pure-CPU tools don't pay for it."""
+    if not trace_dir:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(trace_dir):
+        yield
+
+
+def write_metrics(path, stage: str, phases: dict[str, float],
+                  counters: dict[str, object]) -> None:
+    """Structured metrics sidecar: ``{stage, phases_s, **counters}`` plus
+    derived ``<unit>_per_sec`` rates for any counter named ``n_<unit>``
+    against the total phase time."""
+    total = sum(phases.values())
+    doc: dict[str, object] = {"stage": stage, "phases_s": {
+        k: round(v, 6) for k, v in phases.items()
+    }, "total_s": round(total, 6)}
+    doc.update(counters)
+    if total > 0:
+        for key, value in counters.items():
+            if key.startswith("n_") and isinstance(value, (int, float)):
+                doc[f"{key[2:]}_per_sec"] = round(value / total, 2)
+    with open(path, "w") as fh:
+        json.dump(doc, fh, indent=2, sort_keys=True)
+        fh.write("\n")

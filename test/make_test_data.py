@@ -114,9 +114,10 @@ def run_pipeline(bam_path: str, out_dir: str, name: str) -> dict[str, str]:
             if f.endswith(".bam"):
                 digests[rel] = canonical_bam_digest(p)
             elif f.endswith((".txt", ".json")) and f != "manifest.json" \
-                    and "time_tracker" not in f:
-                # manifest + time tracker hold fingerprints/wall-clock —
-                # inherently run-specific, checked by their own tests.
+                    and "time_tracker" not in f and "metrics" not in f:
+                # manifest, time tracker and metrics hold fingerprints /
+                # wall-clock — inherently run-specific, checked by their
+                # own tests.
                 digests[rel] = text_digest(p)
     return digests
 

@@ -108,6 +108,33 @@ def test_fetch_multi_ref_and_block_spanning(tmp_path):
                 assert got == exp, (ref, beg, end)
 
 
+def test_fetch_empty_and_reversed_interval(tmp_path):
+    bai = str(tmp_path / "s.bai")
+    index_bam(SAMPLE, bai)
+    with IndexedBamReader(SAMPLE, bai) as r:
+        ref, _ = r.header.refs[0]
+        some = next(iter(r.fetch(ref)), None)
+        assert some is not None
+        at = some.pos + 1  # inside a covered region
+        assert list(r.fetch(ref, at, at)) == []
+        assert list(r.fetch(ref, at, at - 100)) == []
+
+
+def test_index_bam_skip_if_fresh(tmp_path):
+    import shutil
+
+    bam = str(tmp_path / "s.bam")
+    shutil.copy(SAMPLE, bam)
+    bai = index_bam(bam, skip_if_fresh=True)
+    mtime = os.path.getmtime(bai)
+    assert index_bam(bam, skip_if_fresh=True) == bai
+    assert os.path.getmtime(bai) == mtime  # untouched
+    # touching the BAM invalidates the freshness fast path
+    os.utime(bam, (mtime + 10, mtime + 10))
+    index_bam(bam, skip_if_fresh=True)
+    assert os.path.getmtime(bai) > mtime
+
+
 def test_unmapped_and_no_coor_counting(tmp_path):
     header = BamHeader.from_refs([("chr1", 10_000)])
     path = str(tmp_path / "um.bam")

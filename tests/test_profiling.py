@@ -1,0 +1,50 @@
+"""Tracing/metrics subsystem (SURVEY.md §5)."""
+
+import json
+import os
+
+import numpy as np
+
+from consensuscruncher_tpu.utils.profiling import maybe_profile, write_metrics
+
+
+def test_maybe_profile_noop():
+    with maybe_profile(None):
+        x = 1 + 1
+    assert x == 2
+
+
+def test_maybe_profile_writes_trace(tmp_path):
+    import jax.numpy as jnp
+
+    d = str(tmp_path / "trace")
+    with maybe_profile(d):
+        float(np.asarray(jnp.ones((4, 4)).sum()))
+    # jax.profiler.trace writes a plugins/profile/<ts>/ tree
+    found = [f for root, _d, fs in os.walk(d) for f in fs]
+    assert found, "profiler trace produced no files"
+
+
+def test_write_metrics_rates(tmp_path):
+    p = str(tmp_path / "m.json")
+    write_metrics(p, "SSCS", {"consensus": 2.0, "sort": 2.0},
+                  {"backend": "tpu", "n_families": 1000, "n_reads": 4000})
+    doc = json.load(open(p))
+    assert doc["stage"] == "SSCS"
+    assert doc["total_s"] == 4.0
+    assert doc["families_per_sec"] == 250.0
+    assert doc["reads_per_sec"] == 1000.0
+    assert doc["backend"] == "tpu"
+
+
+def test_sscs_stage_emits_metrics(tmp_path):
+    from consensuscruncher_tpu.stages.sscs_maker import run_sscs
+    from consensuscruncher_tpu.utils.simulate import SimConfig, simulate_bam
+
+    bam = str(tmp_path / "in.bam")
+    simulate_bam(bam, SimConfig(n_fragments=40, read_len=30, seed=3))
+    run_sscs(bam, str(tmp_path / "out"), backend="cpu")
+    doc = json.load(open(tmp_path / "out.metrics.json"))
+    assert doc["stage"] == "SSCS" and doc["backend"] == "cpu"
+    assert set(doc["phases_s"]) == {"consensus", "sort"}
+    assert doc["n_families"] > 0 and "families_per_sec" in doc
