@@ -179,6 +179,7 @@ def _consensus_impl(args) -> dict:
             qual_threshold=args.qualscore,
             backend=args.backend,
             bdelim=args.bdelim,
+            devices=args.devices,
         ),
         rebuild=lambda: SscsResult.from_prefix(sscs_prefix),
     )
@@ -324,6 +325,10 @@ def build_parser() -> argparse.ArgumentParser:
     c.add_argument("--resume", help="skip stages whose manifest-recorded outputs are intact")
     c.add_argument("--profile", default=None, metavar="DIR",
                    help="write a jax.profiler trace of the run into DIR")
+    c.add_argument("--devices", type=int, default=None, metavar="N",
+                   help="shard the SSCS vote's family batches across N chips "
+                        "(family-data-parallel mesh; the vote dominates device "
+                        "compute — DCS/rescue stay single-device). Default: 1")
     c.set_defaults(func=consensus, config_section="consensus",
                    required_args=("input", "output"),
                    builtin_defaults={
@@ -361,6 +366,8 @@ def main(argv=None) -> int:
         args.qualscore = int(args.qualscore)
     if hasattr(args, "max_mismatch"):
         args.max_mismatch = int(args.max_mismatch)
+    if getattr(args, "devices", None) is not None:
+        args.devices = int(args.devices)
 
     args.func(args)
     return 0

@@ -146,6 +146,7 @@ def consensus_families(
     config: ConsensusConfig = ConsensusConfig(),
     max_batch: int = 1024,
     prefetch_depth: int | None = None,
+    mesh=None,
 ):
     """Stream ragged families through the device kernel, double-buffered.
 
@@ -161,6 +162,11 @@ def consensus_families(
     return before compute finishes, so the ``np.asarray`` drain of batch *k*
     overlaps the compute of batch *k+1*.  ``prefetch_depth=0`` disables both
     (strictly serial; used by parity tests to pin identical results).
+
+    ``mesh``: a ``jax.sharding.Mesh`` from ``parallel.mesh.make_mesh`` —
+    each batch's family axis is then sharded across the mesh's devices
+    (same kernel per shard, stats psum over ICI), turning the stage's
+    streaming path into the multi-chip path with no other change.
     """
     from consensuscruncher_tpu.parallel.batching import bucket_families
     from consensuscruncher_tpu.parallel.prefetch import DEFAULT_DEPTH, pipelined, prefetch
@@ -169,8 +175,17 @@ def consensus_families(
         prefetch_depth = DEFAULT_DEPTH
     batches = bucket_families(families, max_batch=max_batch)
 
-    def dispatch(batch):
-        return consensus_batch(batch.bases, batch.quals, batch.fam_sizes, config)
+    if mesh is None:
+        def dispatch(batch):
+            return consensus_batch(batch.bases, batch.quals, batch.fam_sizes, config)
+    else:
+        from consensuscruncher_tpu.parallel.mesh import pad_batch_to_mesh, sharded_vote_async
+
+        def dispatch(batch):
+            bases, quals, sizes, _lengths, _n = pad_batch_to_mesh(
+                batch.bases, batch.quals, batch.fam_sizes, mesh, batch.lengths
+            )
+            return sharded_vote_async(bases, quals, sizes, mesh, config)
 
     def fetch(batch, handle):
         out_b, out_q = (np.asarray(x) for x in handle)

@@ -130,6 +130,69 @@ def pad_batch_to_mesh(bases, quals, fam_sizes, mesh: Mesh, lengths=None):
     return bases, quals, fam_sizes, lengths, n
 
 
+@lru_cache(maxsize=None)
+def _compiled_sharded_vote(mesh: Mesh, num, den, qual_threshold, qual_cap):
+    """Stats-free sharded vote for the streaming stage path: no psum, no
+    per-batch collective — the stage accumulates its own host-side stats,
+    so the only cross-chip traffic is the result gather."""
+    vote = partial(
+        _consensus_one_family, num=num, den=den,
+        qual_threshold=qual_threshold, qual_cap=qual_cap,
+    )
+    fn = jax.vmap(vote, in_axes=(0, 0, 0))
+    mapped = jax.shard_map(
+        lambda b, q, s: fn(b, q, s),
+        mesh=mesh,
+        in_specs=(P(FAMILY_AXIS),) * 3,
+        out_specs=(P(FAMILY_AXIS), P(FAMILY_AXIS)),
+    )
+    return jax.jit(mapped)
+
+
+def sharded_vote_async(bases, quals, fam_sizes, mesh: Mesh,
+                       config: ConsensusConfig = ConsensusConfig()):
+    """Dispatch one family-sharded vote (no stats); returns device arrays.
+    Batch axis must be a multiple of the mesh size (``pad_batch_to_mesh``)."""
+    num, den = config.cutoff_rational
+    fn = _compiled_sharded_vote(mesh, num, den, int(config.qual_threshold),
+                                int(config.qual_cap))
+    sharding = NamedSharding(mesh, P(FAMILY_AXIS))
+    b = jax.device_put(jnp.asarray(bases, dtype=jnp.uint8), sharding)
+    q = jax.device_put(jnp.asarray(quals, dtype=jnp.uint8), sharding)
+    s = jax.device_put(jnp.asarray(fam_sizes, dtype=jnp.int32), sharding)
+    return fn(b, q, s)
+
+
+def sharded_consensus_batch_async(
+    bases,
+    quals,
+    fam_sizes,
+    mesh: Mesh,
+    config: ConsensusConfig = ConsensusConfig(),
+    lengths=None,
+):
+    """Dispatch one family-sharded consensus batch; return DEVICE arrays.
+
+    The async building block (JAX async dispatch returns before compute
+    finishes) — callers that pipeline batches drain with ``np.asarray``
+    later, overlapping device work with host work.  The batch axis must
+    already be a multiple of the mesh size (``pad_batch_to_mesh``).
+
+    Returns ``(consensus_bases, consensus_quals, stats_vector)`` device
+    arrays; ``stats_vector`` is the psum'd ``(4,)`` int32 counters.
+    """
+    num, den = config.cutoff_rational
+    fn = _compiled_sharded_step(mesh, num, den, int(config.qual_threshold), int(config.qual_cap))
+    if lengths is None:
+        lengths = np.full(np.shape(bases)[0], np.shape(bases)[-1], np.int32)
+    sharding = NamedSharding(mesh, P(FAMILY_AXIS))
+    b = jax.device_put(jnp.asarray(bases, dtype=jnp.uint8), sharding)
+    q = jax.device_put(jnp.asarray(quals, dtype=jnp.uint8), sharding)
+    s = jax.device_put(jnp.asarray(fam_sizes, dtype=jnp.int32), sharding)
+    ln = jax.device_put(jnp.asarray(lengths, dtype=jnp.int32), sharding)
+    return fn(b, q, s, ln)
+
+
 def sharded_consensus_batch(
     bases,
     quals,
@@ -148,16 +211,9 @@ def sharded_consensus_batch(
 
     Returns ``(consensus_bases, consensus_quals, stats)``.
     """
-    num, den = config.cutoff_rational
-    fn = _compiled_sharded_step(mesh, num, den, int(config.qual_threshold), int(config.qual_cap))
-    if lengths is None:
-        lengths = np.full(np.shape(bases)[0], np.shape(bases)[-1], np.int32)
-    sharding = NamedSharding(mesh, P(FAMILY_AXIS))
-    b = jax.device_put(jnp.asarray(bases, dtype=jnp.uint8), sharding)
-    q = jax.device_put(jnp.asarray(quals, dtype=jnp.uint8), sharding)
-    s = jax.device_put(jnp.asarray(fam_sizes, dtype=jnp.int32), sharding)
-    ln = jax.device_put(jnp.asarray(lengths, dtype=jnp.int32), sharding)
-    out_b, out_q, stats = fn(b, q, s, ln)
+    out_b, out_q, stats = sharded_consensus_batch_async(
+        bases, quals, fam_sizes, mesh, config, lengths
+    )
     return out_b, out_q, StepStats.from_vector(jax.device_get(stats))
 
 

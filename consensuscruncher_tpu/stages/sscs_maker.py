@@ -89,9 +89,20 @@ def run_sscs(
     backend: str = "tpu",
     bdelim: str = tags_mod.DEFAULT_BDELIM,
     max_batch: int = 1024,
+    devices: int | None = None,
 ) -> SscsResult:
+    """``devices``: shard each family batch across this many chips
+    (``parallel.mesh`` family-data-parallel path); None/1 = single device.
+    Only meaningful with ``backend="tpu"``."""
     if backend not in ("cpu", "tpu"):
         raise ValueError(f"unknown backend {backend!r} (expected 'cpu' or 'tpu')")
+    mesh = None
+    if devices is not None and devices > 1:
+        if backend != "tpu":
+            raise ValueError("--devices > 1 requires the tpu backend")
+        from consensuscruncher_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh(devices)
     tracker = TimeTracker()
     stats = StageStats("SSCS")
     hist = FamilySizeHistogram()
@@ -151,7 +162,7 @@ def run_sscs(
     ok = False
     try:
         if backend == "tpu":
-            stream = consensus_families(events(), cfg, max_batch=max_batch)
+            stream = consensus_families(events(), cfg, max_batch=max_batch, mesh=mesh)
             try:
                 for fid, codes, quals in stream:
                     emit(fid, codes, quals)

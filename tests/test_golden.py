@@ -39,15 +39,25 @@ def test_bundled_inputs_unchanged():
         assert text_digest(os.path.join(DATA, f)) == GOLDEN["inputs"][f]
 
 
-@pytest.mark.parametrize("backend", ["cpu", "tpu"])
-def test_consensus_pipeline_matches_golden(tmp_path, backend):
+@pytest.mark.parametrize("backend,devices", [
+    ("cpu", None),
+    ("tpu", None),
+    # Family batches sharded across the 8 virtual devices (conftest mesh)
+    # must reproduce the single-device goldens byte-for-byte — the
+    # multi-chip path is a layout change, never a semantic one.
+    ("tpu", 8),
+])
+def test_consensus_pipeline_matches_golden(tmp_path, backend, devices):
     from consensuscruncher_tpu.cli import main as cli_main
 
-    cli_main([
+    argv = [
         "consensus", "-i", os.path.join(DATA, "sample.bam"),
         "-o", str(tmp_path), "-n", "golden",
         "--backend", backend, "--scorrect", "True",
-    ])
+    ]
+    if devices:
+        argv += ["--devices", str(devices)]
+    cli_main(argv)
     base = tmp_path / "golden"
     mismatches = []
     for rel, expected in GOLDEN["consensus"].items():
@@ -56,7 +66,8 @@ def test_consensus_pipeline_matches_golden(tmp_path, backend):
         got = canonical_bam_digest(str(p)) if rel.endswith(".bam") else text_digest(str(p))
         if got != expected:
             mismatches.append(rel)
-    assert not mismatches, f"{backend} outputs diverge from golden: {mismatches}"
+    assert not mismatches, \
+        f"{backend}/devices={devices} outputs diverge from golden: {mismatches}"
 
 
 def test_extract_matches_golden(tmp_path):
