@@ -96,6 +96,9 @@ def align_and_sort(bwa: str, ref: str, r1: str, r2: str, out_bam: str) -> None:
     subprocesses (SURVEY.md §3.1) — here the SAM→BAM and sort legs are
     in-process (framework-owned codec), only the aligner stays external.
     """
+    if bwa == "builtin":
+        _align_builtin(ref, r1, r2, out_bam)
+        return
     cmd = shlex.split(bwa) + ["mem", ref, r1, r2]
     try:
         proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, text=True)
@@ -125,6 +128,41 @@ def align_and_sort(bwa: str, ref: str, r1: str, r2: str, out_bam: str) -> None:
         raise SystemExit(f"aligner exited with status {proc.returncode}")
     sort_bam(unsorted, out_bam)
     os.unlink(unsorted)
+
+
+def _align_builtin(ref: str, r1: str, r2: str, out_bam: str) -> None:
+    """``--bwa builtin``: the in-process k-mer aligner (stages/align.py) —
+    runs the full fastq2bam flow when no external aligner exists (test/demo
+    scope: substitutions only, no indels)."""
+    import numpy as np
+
+    from consensuscruncher_tpu.io.bam import BamHeader
+    from consensuscruncher_tpu.io.fastq import read_fastq
+    from consensuscruncher_tpu.stages.align import BuiltinAligner, align_pairs
+
+    aligner = BuiltinAligner(ref)
+    header = BamHeader.from_refs(aligner.refs)
+
+    def pairs():
+        for (n1, s1, q1), (n2, s2, q2) in zip(
+            read_fastq(r1), read_fastq(r2), strict=True
+        ):
+            tok1, tok2 = n1.split()[0], n2.split()[0]
+            if tok1 != tok2:
+                raise SystemExit(f"R1/R2 qname mismatch: {tok1!r} vs {tok2!r}")
+            yield (tok1, s1,
+                   np.frombuffer(q1.encode(), np.uint8) - 33, s2,
+                   np.frombuffer(q2.encode(), np.uint8) - 33)
+
+    unsorted = out_bam + ".unsorted"
+    try:
+        with BamWriter(unsorted, header) as w:
+            for read in align_pairs(aligner, pairs(), header):
+                w.write(read)
+        sort_bam(unsorted, out_bam)
+    finally:
+        if os.path.exists(unsorted):
+            os.unlink(unsorted)
 
 
 # ------------------------------------------------------------------ consensus

@@ -165,8 +165,10 @@ def consensus_families(
 
     ``mesh``: a ``jax.sharding.Mesh`` from ``parallel.mesh.make_mesh`` —
     each batch's family axis is then sharded across the mesh's devices
-    (same kernel per shard, stats psum over ICI), turning the stage's
-    streaming path into the multi-chip path with no other change.
+    (same kernel per shard; NO collective — the stage accumulates stats
+    host-side, so the only cross-chip traffic is the result gather),
+    turning the stage's streaming path into the multi-chip path with no
+    other change.
     """
     from consensuscruncher_tpu.parallel.batching import bucket_families
     from consensuscruncher_tpu.parallel.prefetch import DEFAULT_DEPTH, pipelined, prefetch

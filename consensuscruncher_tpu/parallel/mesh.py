@@ -141,7 +141,7 @@ def _compiled_sharded_vote(mesh: Mesh, num, den, qual_threshold, qual_cap):
     )
     fn = jax.vmap(vote, in_axes=(0, 0, 0))
     mapped = jax.shard_map(
-        lambda b, q, s: fn(b, q, s),
+        fn,
         mesh=mesh,
         in_specs=(P(FAMILY_AXIS),) * 3,
         out_specs=(P(FAMILY_AXIS), P(FAMILY_AXIS)),

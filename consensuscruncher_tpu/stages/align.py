@@ -1,0 +1,184 @@
+"""Built-in paired-end aligner: ``fastq2bam --bwa builtin``.
+
+The reference delegates alignment to an external ``bwa mem`` subprocess
+(``ConsensusCruncher.py`` fastq2bam, SURVEY.md §3.1) and so does this
+framework by default.  This module exists for the environments the
+reference cannot handle at all — no aligner installed — so the FULL
+fastq2bam flow still runs: k-mer seeding against an in-memory reference
+index + ungapped extension with mismatch counting, emitting the same
+coordinate-sorted barcoded BAM the external path produces.
+
+Scope is deliberate: exact-stride seeds and ungapped extension handle
+substitution-style sequencing error (the consensus pipeline's whole
+subject) but NOT indels/clipping/splicing — it is a test/demo aligner
+with honest limits, not a bwa replacement.  CIGAR is always full-length
+``M``; unalignable reads come out unmapped (flag 0x4) and flow to the
+pipeline's badReads path.
+
+The seeding/voting layout is array-friendly on purpose: reads are held as
+uint8 code matrices and seed votes are numpy bincounts, so a batched
+device port (classic systolic-array scoring) can slot in behind the same
+interface.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from consensuscruncher_tpu.io.fasta import read_fasta
+
+_CODE = np.full(256, 255, np.uint8)
+for _i, _c in enumerate(b"ACGT"):
+    _CODE[_c] = _i
+    _CODE[ord(chr(_c).lower())] = _i
+_COMP = {"A": "T", "C": "G", "G": "C", "T": "A", "N": "N"}
+
+
+def revcomp(seq: str) -> str:
+    return "".join(_COMP.get(c, "N") for c in reversed(seq))
+
+
+def _encode(seq: str) -> np.ndarray:
+    return _CODE[np.frombuffer(seq.encode(), np.uint8)]
+
+
+@dataclass(frozen=True)
+class Hit:
+    ref: str
+    pos: int  # 0-based leftmost
+    reverse: bool
+    nm: int  # mismatches
+    mapq: int
+
+
+class BuiltinAligner:
+    """K-mer seed + ungapped extend against an in-memory reference."""
+
+    def __init__(self, fasta_path, k: int = 21, seed_stride: int = 7,
+                 max_mismatch_frac: float = 0.1):
+        self.k = k
+        self.seed_stride = seed_stride
+        self.max_mismatch_frac = max_mismatch_frac
+        self.refs: list[tuple[str, int]] = []
+        self._ref_codes: dict[str, np.ndarray] = {}
+        self._index: dict[int, list[tuple[str, int]]] = {}
+        for name, seq in read_fasta(fasta_path).items():
+            self.refs.append((name, len(seq)))
+            codes = _encode(seq)
+            self._ref_codes[name] = codes
+            # Roll k-mers into ints (2 bits/base); skip any window with N.
+            if len(codes) < k:
+                continue
+            valid = codes < 4
+            kmers = np.zeros(len(codes) - k + 1, np.int64)
+            ok = np.ones(len(codes) - k + 1, bool)
+            for j in range(k):
+                window = codes[j : j + len(kmers)]
+                kmers = (kmers << 2) | window
+                ok &= valid[j : j + len(kmers)]
+            for p in range(0, len(kmers), 1):
+                if ok[p]:
+                    self._index.setdefault(int(kmers[p]), []).append((name, p))
+
+    def _seed_votes(self, codes: np.ndarray):
+        """Candidate (ref, diagonal) offsets from strided seed lookups."""
+        k = self.k
+        votes: dict[tuple[str, int], int] = {}
+        if len(codes) < k:
+            return votes
+        for start in range(0, len(codes) - k + 1, self.seed_stride):
+            window = codes[start : start + k]
+            if (window >= 4).any():
+                continue
+            key = 0
+            for v in window:
+                key = (key << 2) | int(v)
+            for ref, p in self._index.get(key, ()):
+                diag = p - start
+                votes[(ref, diag)] = votes.get((ref, diag), 0) + 1
+        return votes
+
+    def _extend(self, codes: np.ndarray, ref: str, pos: int) -> int | None:
+        """Ungapped mismatch count at (ref, pos), or None if out of bounds."""
+        rc = self._ref_codes[ref]
+        if pos < 0 or pos + len(codes) > len(rc):
+            return None
+        window = rc[pos : pos + len(codes)]
+        return int((window != codes).sum())
+
+    def align(self, seq: str) -> Hit | None:
+        """Best ungapped placement of ``seq`` on either strand."""
+        max_nm = int(len(seq) * self.max_mismatch_frac)
+        candidates: list[tuple[int, str, int, bool]] = []
+        for reverse in (False, True):
+            s = revcomp(seq) if reverse else seq
+            codes = _encode(s)
+            votes = self._seed_votes(codes)
+            # Try diagonals by vote count; a few candidates suffice for
+            # substitution-only error.
+            for (ref, diag), _n in sorted(votes.items(), key=lambda kv: -kv[1])[:4]:
+                nm = self._extend(codes, ref, diag)
+                if nm is not None and nm <= max_nm:
+                    candidates.append((nm, ref, diag, reverse))
+        if not candidates:
+            return None
+        candidates.sort(key=lambda c: c[0])
+        nm, ref, pos, reverse = candidates[0]
+        # bwa-flavoured mapq: confident when the runner-up is clearly worse.
+        mapq = 60 if len(candidates) == 1 else \
+            max(0, min(60, 10 * (candidates[1][0] - nm)))
+        return Hit(ref=ref, pos=pos, reverse=reverse, nm=nm, mapq=mapq)
+
+
+def align_pairs(aligner: BuiltinAligner, pairs, header):
+    """Yield ``BamRead`` pairs for ``(qname, s1, q1, s2, q2)`` tuples.
+
+    Sets the reference's expected flag layout for FR proper pairs: paired +
+    proper (both mates placed on the same ref, opposite strands), mate
+    strand/position/tlen cross-filled, read1/read2 bits, and unmapped flags
+    when a mate fails to place (such reads flow to badReads downstream).
+    """
+    from consensuscruncher_tpu.io.bam import BamRead
+
+    for qname, s1, q1, s2, q2 in pairs:
+        h1, h2 = aligner.align(s1), aligner.align(s2)
+        proper = (
+            h1 is not None and h2 is not None and h1.ref == h2.ref
+            and h1.reverse != h2.reverse
+        )
+        for this, mate, seq, qual, read1 in ((h1, h2, s1, q1, True), (h2, h1, s2, q2, False)):
+            flag = 0x1 | (0x40 if read1 else 0x80)
+            if proper:
+                flag |= 0x2
+            if this is None:
+                flag |= 0x4
+            elif this.reverse:
+                flag |= 0x10
+            if mate is None:
+                flag |= 0x8
+            elif mate.reverse:
+                flag |= 0x20
+            out_seq = revcomp(seq) if (this is not None and this.reverse) else seq
+            out_qual = np.asarray(qual[::-1] if (this is not None and this.reverse) else qual,
+                                  np.uint8)
+            pos = this.pos if this is not None else (mate.pos if mate is not None else -1)
+            ref = this.ref if this is not None else (mate.ref if mate is not None else None)
+            mate_pos = mate.pos if mate is not None else pos
+            mate_ref = mate.ref if mate is not None else ref
+            tlen = 0
+            if proper:
+                lo = min(h1.pos, h2.pos)
+                hi = max(h1.pos + len(s1), h2.pos + len(s2))
+                tlen = (hi - lo) if this.pos == lo else -(hi - lo)
+            yield BamRead(
+                qname=qname,
+                flag=flag,
+                ref=ref, pos=pos,
+                mapq=this.mapq if this is not None else 0,
+                cigar=[("M", len(seq))] if this is not None else [],
+                mate_ref=mate_ref, mate_pos=mate_pos, tlen=tlen,
+                seq=out_seq, qual=out_qual,
+                tags={"NM": ("i", this.nm)} if this is not None else {},
+            )

@@ -1,0 +1,138 @@
+"""Built-in aligner + full fastq2bam -> consensus end-to-end.
+
+The external-aligner leg of fastq2bam can't run in this image (no bwa),
+so the builtin aligner is what makes the reference's §3.1 flow fully
+exercisable: these tests pin single-read placement (both strands, error
+tolerance, multi-ref), FR pair flag layout, and the complete
+fastq2bam --bwa builtin -> consensus pipeline on reads simulated from a
+known reference genome.
+"""
+
+import gzip
+import os
+
+import numpy as np
+import pytest
+
+from consensuscruncher_tpu.io.fasta import read_fasta, write_fasta
+from consensuscruncher_tpu.stages.align import BuiltinAligner, align_pairs, revcomp
+
+BASES = "ACGT"
+
+
+def _rand_seq(rng, n):
+    return "".join(BASES[i] for i in rng.integers(0, 4, n))
+
+
+@pytest.fixture(scope="module")
+def genome(tmp_path_factory):
+    rng = np.random.default_rng(21)
+    refs = {"chrA": _rand_seq(rng, 12_000), "chrB": _rand_seq(rng, 8_000)}
+    path = str(tmp_path_factory.mktemp("ref") / "ref.fa")
+    write_fasta(path, refs)
+    return path, refs
+
+
+def test_fasta_roundtrip(genome):
+    path, refs = genome
+    assert read_fasta(path) == refs
+
+
+def test_align_exact_and_mismatch(genome):
+    path, refs = genome
+    al = BuiltinAligner(path)
+    read = refs["chrA"][2000:2100]
+    hit = al.align(read)
+    assert (hit.ref, hit.pos, hit.reverse, hit.nm) == ("chrA", 2000, False, 0)
+    assert hit.mapq == 60
+
+    # two substitutions still place correctly
+    mutated = "G" if read[10] != "G" else "C"
+    noisy = read[:10] + mutated + read[11:50] + mutated + read[51:]
+    hit = al.align(noisy)
+    assert (hit.ref, hit.pos) == ("chrA", 2000)
+    assert hit.nm == sum(a != b for a, b in zip(noisy, read))
+
+    # reverse strand
+    hit = al.align(revcomp(refs["chrB"][500:600]))
+    assert (hit.ref, hit.pos, hit.reverse) == ("chrB", 500, True)
+
+    # garbage doesn't place
+    assert al.align(_rand_seq(np.random.default_rng(1), 100)) is None
+
+
+def test_align_pairs_fr_layout(genome):
+    path, refs = genome
+    al = BuiltinAligner(path)
+    frag = refs["chrA"][3000:3300]
+    r1 = frag[:100]                  # forward at 3000
+    r2 = revcomp(frag[-100:])        # reverse at 3200
+    q = np.full(100, 35, np.uint8)
+    from consensuscruncher_tpu.io.bam import BamHeader
+
+    header = BamHeader.from_refs(al.refs)
+    reads = list(align_pairs(al, [("frag|AAA.CCC", r1, q, r2, q)], header))
+    assert len(reads) == 2
+    a, b = reads
+    assert a.flag & 0x1 and a.flag & 0x2 and a.flag & 0x40 and not a.flag & 0x10
+    assert b.flag & 0x2 and b.flag & 0x80 and b.flag & 0x10 and b.flag & 0x20 == 0
+    assert (a.ref, a.pos) == ("chrA", 3000)
+    assert (b.ref, b.pos) == ("chrA", 3200)
+    assert a.tlen == 300 and b.tlen == -300
+    assert b.seq == frag[-100:]  # stored forward-strand
+
+
+def _write_fastq_pair(path1, path2, records):
+    with gzip.open(path1, "wt") as f1, gzip.open(path2, "wt") as f2:
+        for qname, s1, s2 in records:
+            qual1 = "I" * len(s1)
+            qual2 = "I" * len(s2)
+            f1.write(f"@{qname}\n{s1}\n+\n{qual1}\n")
+            f2.write(f"@{qname}\n{s2}\n+\n{qual2}\n")
+
+
+def test_fastq2bam_builtin_to_consensus(genome, tmp_path):
+    # Simulate duplex families straight from the reference genome: inline
+    # 6-base UMI + 1-base 'T' spacer in front of each mate's insert.
+    path, refs = genome
+    rng = np.random.default_rng(33)
+    records = []
+    n_frags = 30
+    for i in range(n_frags):
+        lo = int(rng.integers(0, 10_000))
+        frag = refs["chrA"][lo : lo + 260]
+        umi_a, umi_b = _rand_seq(rng, 6), _rand_seq(rng, 6)
+        for strand, (u1, u2) in (("A", (umi_a, umi_b)), ("B", (umi_b, umi_a))):
+            ins1 = frag[:80] if strand == "A" else revcomp(frag[-80:])
+            ins2 = revcomp(frag[-80:]) if strand == "A" else frag[:80]
+            for copy in range(2):  # family size 2 per strand
+                records.append((f"f{i}:{strand}:{copy}", u1 + "T" + ins1, u2 + "T" + ins2))
+    r1, r2 = str(tmp_path / "r1.fastq.gz"), str(tmp_path / "r2.fastq.gz")
+    _write_fastq_pair(r1, r2, records)
+
+    from consensuscruncher_tpu.cli import main as cli_main
+
+    out = str(tmp_path / "out")
+    cli_main(["fastq2bam", "-f1", r1, "-f2", r2, "-o", out, "-r", path,
+              "--bwa", "builtin", "--bpattern", "NNNNNNT", "-n", "sample"])
+    bam = os.path.join(out, "bamfiles", "sample.sorted.bam")
+    assert os.path.exists(bam) and os.path.exists(bam + ".bai")
+
+    from consensuscruncher_tpu.io.bam import BamReader
+
+    with BamReader(bam) as r:
+        placed = [read for read in r if not read.is_unmapped]
+    assert len(placed) == len(records) * 2  # every mate aligned
+    assert all("|" in read.qname for read in placed)  # UMI moved to qname
+
+    cons = str(tmp_path / "cons")
+    cli_main(["consensus", "-i", bam, "-o", cons, "-n", "s",
+              "--backend", "cpu", "--scorrect", "True"])
+    stats = open(os.path.join(cons, "s", "sscs", "s.sscs_stats.txt")).read()
+    assert "families:" in stats
+    # 30 fragments x 2 strands x R1/R2-coordinate families = families formed
+    import json
+
+    doc = json.load(open(os.path.join(cons, "s", "sscs", "s.sscs_stats.json")))
+    assert doc["families"] == n_frags * 2 * 2
+    assert doc["sscs_written"] == doc["families"]  # all size 2 -> all collapse
