@@ -94,8 +94,10 @@ def run_sscs(
     """``devices``: shard each family batch across this many chips
     (``parallel.mesh`` family-data-parallel path); None/1 = single device.
     Only meaningful with ``backend="tpu"``."""
-    if backend not in ("cpu", "tpu"):
-        raise ValueError(f"unknown backend {backend!r} (expected 'cpu' or 'tpu')")
+    if backend not in ("cpu", "tpu", "reference"):
+        raise ValueError(
+            f"unknown backend {backend!r} (expected 'cpu', 'tpu', or 'reference')"
+        )
     mesh = None
     if devices is not None and devices > 1:
         if backend != "tpu":
@@ -174,9 +176,21 @@ def run_sscs(
                 # race w.abort() against in-flight writes on error paths.
                 stream.close()
         else:
+            # "reference" = the per-position Counter loop
+            # (``core.consensus_cpu.consensus_maker``, the pinned oracle of
+            # ``consensus_helper.consensus_maker``) so ``bench.py`` can time
+            # a true reference-style stage run as its vs_baseline
+            # denominator; "cpu" = the vectorized numpy twin.  Identical
+            # semantics by the parity suite.
+            if backend == "reference":
+                from consensuscruncher_tpu.core.consensus_cpu import consensus_maker
+
+                vote = consensus_maker
+            else:
+                vote = consensus_maker_numpy
             for fid, seqs, quals in events():
                 rect_s, rect_q, _ = rectangularize(seqs, quals)
-                codes, cquals = consensus_maker_numpy(
+                codes, cquals = vote(
                     rect_s, rect_q, cutoff=cutoff, qual_threshold=qual_threshold, qual_cap=qual_cap
                 )
                 emit(fid, codes, cquals)
