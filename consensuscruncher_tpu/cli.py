@@ -85,6 +85,12 @@ def fastq2bam(args) -> dict:
     out_bam = os.path.join(bam_dir, f"{name}.sorted.bam")
     align_and_sort(args.bwa, args.ref, extract.r1_out, extract.r2_out, out_bam)
     index_bam(out_bam)  # reference: `samtools index` after every sort (§3.1)
+    if getattr(args, "cleanup", False):
+        # The tag FASTQs are intermediates once the BAM exists; the barcode
+        # stats/distribution files stay (they feed QC).
+        for path in (extract.r1_out, extract.r2_out):
+            if os.path.exists(path):
+                os.unlink(path)
     print(f"fastq2bam: wrote {out_bam}")
     return {"bam": out_bam, "extract": extract}
 
@@ -179,6 +185,13 @@ def consensus(args) -> dict:
 
 
 def _consensus_impl(args) -> dict:
+    # Fail fast (bounded watchdog) if the requested device backend can't
+    # initialize — a sick axon tunnel HANGS on first touch rather than
+    # erroring, which without this probe meant an indefinite silent hang.
+    from consensuscruncher_tpu.utils.backend_probe import ensure_backend
+
+    ensure_backend(args.backend)
+
     name = args.name or os.path.basename(args.input).split(".")[0]
     base = os.path.join(args.output, name)
     dirs = {k: os.path.join(base, k) for k in ("sscs", "singleton", "dcs", "all_unique", "plots")}
@@ -293,7 +306,9 @@ def _consensus_impl(args) -> dict:
                    sscs_res.sscs_bam, sscs_res.singleton_bam]
     if args.scorrect:
         index_parts += [corr.sscs_rescue_bam, corr.singleton_rescue_bam,
-                        corr.remaining_bam, dcs_input]
+                        corr.remaining_bam]
+        if not args.cleanup:  # pointless to index a file cleanup deletes below
+            index_parts.append(dcs_input)
     for path in index_parts:
         if os.path.exists(path):
             index_bam(path, skip_if_fresh=True)
@@ -305,7 +320,17 @@ def _consensus_impl(args) -> dict:
     plot_read_recovery(stats_jsons, os.path.join(dirs["plots"], f"{name}.read_recovery.png"))
 
     if args.cleanup:
-        for path in (sscs_res.bad_bam,):
+        # Intermediates only (SURVEY.md §5): badReads, and the rescued-merge
+        # BAM that exists only to feed DCS (its content lives on in the
+        # all_unique merges).  Stage outputs with stats attached stay.
+        # Known tradeoff: dcs_input is a manifest-recorded output of
+        # merge_rescued, so a later --resume re-runs that (cheap,
+        # deterministic) merge to restore it — which is required anyway for
+        # the DCS stage's input fingerprint check.
+        doomed = [sscs_res.bad_bam]
+        if args.scorrect:
+            doomed += [dcs_input, dcs_input + ".bai"]
+        for path in doomed:
             if os.path.exists(path):
                 os.unlink(path)
 
@@ -343,9 +368,11 @@ def build_parser() -> argparse.ArgumentParser:
     f.add_argument("--bpattern", "-p")
     f.add_argument("--blist", "-l")
     f.add_argument("--bdelim")
+    f.add_argument("--cleanup", help="remove intermediate tag FASTQs after alignment")
     f.set_defaults(func=fastq2bam, config_section="fastq2bam",
                    required_args=("fastq1", "fastq2", "output", "ref"),
-                   builtin_defaults={"bwa": "bwa", "bdelim": DEFAULT_BDELIM})
+                   builtin_defaults={"bwa": "bwa", "bdelim": DEFAULT_BDELIM,
+                                     "cleanup": "False"})
 
     c = sub.add_parser("consensus", help="collapse UMI families into SSCS/DCS")
     c.add_argument("-c", "--config", default=None)

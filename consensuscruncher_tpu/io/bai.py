@@ -9,6 +9,13 @@ Format: SAM spec §5.2 (UCSC R-tree binning + 16 kb linear index, virtual
 file offsets ``coffset << 16 | uoffset``), including the samtools
 metadata pseudo-bin 37450 and the trailing no-coordinate count.
 
+Parity is SEMANTIC (identical fetch results, fuzz-tested against a linear
+scan), not byte-level vs ``samtools index``: a record starting exactly at a
+BGZF block boundary is anchored here as ``(next_coffset << 16) | 0`` while
+htslib records ``(prev_coffset << 16) | prev_block_len`` — both address the
+same byte; the only observable difference is that htslib's chunk coalescing
+fires slightly more often across block boundaries.
+
 Everything here is host-side I/O; nothing touches the device.
 """
 
@@ -221,6 +228,18 @@ def index_bam(bam_path, bai_path=None, skip_if_fresh: bool = False) -> str:
                 )
             last_ref, last_pos = ref_id, pos
             refs[ref_id].add(pos, end, vbeg, vend, mapped)
+
+    for r in refs:
+        # Forward-fill empty 16 kb windows with the previous window's offset
+        # (htslib carries values forward in hts_idx_finish) so fetch's
+        # linear floor never degrades to 0 when beg lands in a coverage gap.
+        # Leading zeros (windows before the first record) stay 0.
+        last = 0
+        for i, v in enumerate(r.linear):
+            if v == 0:
+                r.linear[i] = last
+            else:
+                last = v
 
     tmp = bai_path + ".tmp"
     with open(tmp, "wb") as out:

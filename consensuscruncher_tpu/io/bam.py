@@ -11,10 +11,11 @@ Supported surface (everything the pipeline needs):
 - optional tags: A c C s S i I f Z H B (arrays),
 - streaming read, streaming write, in-memory/spilled coordinate sort, merge.
 
-Not implemented (not needed by any pipeline stage): BAI/CSI random access —
-stages stream coordinate-sorted inputs start-to-finish instead of per-region
-``fetch`` (a deliberate design difference from the reference's per-chromosome
-``pysam.fetch`` loop; streaming needs no index files at all).
+Random access lives next door in ``io/bai.py`` (spec BAI build + region
+``fetch``), used for ``samtools index`` parity on outputs.  The pipeline
+stages themselves stream coordinate-sorted inputs start-to-finish — a
+deliberate design difference from the reference's per-chromosome
+``pysam.fetch`` loop; the streaming path needs no index files at all.
 """
 
 from __future__ import annotations

@@ -171,7 +171,13 @@ def align_pairs(aligner: BuiltinAligner, pairs, header):
             if proper:
                 lo = min(h1.pos, h2.pos)
                 hi = max(h1.pos + len(s1), h2.pos + len(s2))
-                tlen = (hi - lo) if this.pos == lo else -(hi - lo)
+                if h1.pos == h2.pos:
+                    # SAM convention: the two tlens must sum to zero — when
+                    # both mates share the leftmost position, break the tie
+                    # deterministically (read1 +, read2 -).
+                    tlen = (hi - lo) if read1 else -(hi - lo)
+                else:
+                    tlen = (hi - lo) if this.pos == lo else -(hi - lo)
             yield BamRead(
                 qname=qname,
                 flag=flag,

@@ -182,6 +182,9 @@ def main(argv=None):
     p.add_argument("--outfile", required=True, help="output prefix")
     p.add_argument("--backend", choices=("cpu", "tpu"), default="tpu")
     args = p.parse_args(argv)
+    from consensuscruncher_tpu.utils.backend_probe import ensure_backend
+
+    ensure_backend(args.backend)
     run_dcs(args.infile, args.outfile, backend=args.backend)
 
 

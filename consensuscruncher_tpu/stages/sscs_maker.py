@@ -234,6 +234,9 @@ def main(argv=None):
     p.add_argument("--backend", choices=("cpu", "tpu"), default="tpu")
     p.add_argument("--bdelim", default=tags_mod.DEFAULT_BDELIM, help="barcode delimiter in qnames")
     args = p.parse_args(argv)
+    from consensuscruncher_tpu.utils.backend_probe import ensure_backend
+
+    ensure_backend(args.backend)
     run_sscs(
         args.infile,
         args.outfile,

@@ -1,0 +1,65 @@
+"""Fail-fast TPU backend probe for user-facing entry points.
+
+SURVEY.md §5 (failure detection): the reference fails loudly when bwa or
+samtools is missing; the analogous failure here is a sick TPU backend.  The
+axon PJRT plugin's init can hang *indefinitely* (not error) when the tunnel
+is down, so a try/except is not enough — the first device touch needs a
+watchdog.  ``ensure_backend`` runs the init in the calling process under a
+timer: on timeout it prints an actionable message (naming ``--backend cpu``
+as the workaround) and hard-exits, instead of hanging silently forever.
+
+The watchdog costs nothing when the backend is healthy — the init the CLI
+would do anyway simply happens here, first, and jit reuses it.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+
+DEFAULT_TIMEOUT_S = float(os.environ.get("CCT_TPU_INIT_TIMEOUT", 120.0))
+
+
+def ensure_backend(backend: str, timeout_s: float | None = None) -> None:
+    """Initialize the device backend now, bounded by a watchdog.
+
+    No-op for ``backend="cpu"``/``"reference"`` (pure numpy paths — nothing
+    to probe).  For ``"tpu"``, touches ``jax.devices()`` under a timer:
+
+    - init hangs  -> message + ``os._exit(3)`` (only way out of a hung
+      C-extension call; Python exceptions can't interrupt it)
+    - init raises -> ``SystemExit`` with the cause and the workaround
+    - init works  -> returns; the warmed backend is reused by the stages
+    """
+    if backend != "tpu":
+        return
+    if timeout_s is None:
+        timeout_s = DEFAULT_TIMEOUT_S
+    done = threading.Event()
+
+    def watchdog() -> None:
+        if not done.wait(timeout_s):
+            print(
+                f"ERROR: TPU backend init did not complete within {timeout_s:.0f}s — "
+                "the TPU (or its tunnel) looks unavailable.\n"
+                "  workaround: re-run with --backend cpu\n"
+                "  or wait longer: CCT_TPU_INIT_TIMEOUT=<seconds>",
+                file=sys.stderr,
+                flush=True,
+            )
+            os._exit(3)
+
+    threading.Thread(target=watchdog, daemon=True).start()
+    try:
+        import jax
+
+        devices = jax.devices()
+    except Exception as exc:
+        done.set()
+        raise SystemExit(
+            f"TPU backend unavailable ({exc}) — re-run with --backend cpu"
+        ) from None
+    done.set()
+    if not devices:
+        raise SystemExit("TPU backend reports no devices — re-run with --backend cpu")

@@ -82,6 +82,26 @@ def test_align_pairs_fr_layout(genome):
     assert b.seq == frag[-100:]  # stored forward-strand
 
 
+def test_align_pairs_tlen_tie_signs(genome):
+    """Mates sharing the leftmost position: tlens must still sum to zero
+    (read1 +, read2 - by the documented tie-break)."""
+    path, refs = genome
+    al = BuiltinAligner(path)
+    frag = refs["chrA"][5000:5100]
+    r1 = frag                      # forward at 5000
+    r2 = revcomp(frag)             # reverse, also leftmost 5000
+    q = np.full(100, 35, np.uint8)
+    from consensuscruncher_tpu.io.bam import BamHeader
+
+    header = BamHeader.from_refs(al.refs)
+    reads = list(align_pairs(al, [("tie|AAA.CCC", r1, q, r2, q)], header))
+    assert len(reads) == 2
+    a, b = reads
+    assert a.pos == b.pos == 5000
+    assert a.tlen == 100 and b.tlen == -100
+    assert a.tlen + b.tlen == 0
+
+
 def _write_fastq_pair(path1, path2, records):
     with gzip.open(path1, "wt") as f1, gzip.open(path2, "wt") as f2:
         for qname, s1, s2 in records:

@@ -108,6 +108,31 @@ def test_fetch_multi_ref_and_block_spanning(tmp_path):
                 assert got == exp, (ref, beg, end)
 
 
+def test_linear_index_forward_fills_coverage_gaps(tmp_path):
+    """Empty 16 kb windows carry the previous window's offset (htslib
+    convention) so a fetch starting in a gap keeps its pruning floor."""
+    bam = str(tmp_path / "gap.bam")
+    header = BamHeader.from_refs([("chr1", 1_000_000)])
+    with BamWriter(bam, header) as w:
+        for pos in (100, 500, 700_000):  # ~42 empty windows between clusters
+            w.write(BamRead(qname=f"r{pos}", flag=0, ref="chr1", pos=pos,
+                            mapq=60, cigar=[("M", 50)], mate_ref=None,
+                            mate_pos=-1, tlen=0, seq="A" * 50,
+                            qual=np.full(50, 30, np.uint8)))
+    bai = index_bam(bam)
+    idx = BaiIndex.load(bai)
+    lin = idx.linear[0]
+    first = lin[0]
+    assert first != 0
+    gap_windows = lin[1 : 700_000 >> 14]
+    assert gap_windows, "expected non-trivial gap"
+    assert all(v == first for v in gap_windows)  # forward-filled, not 0
+    # fetch starting inside the gap still returns the right records
+    with IndexedBamReader(bam, bai) as reader:
+        assert [r.qname for r in reader.fetch("chr1", 300_000, 800_000)] == ["r700000"]
+        assert [r.qname for r in reader.fetch("chr1", 0, 1000)] == ["r100", "r500"]
+
+
 def test_fetch_empty_and_reversed_interval(tmp_path):
     bai = str(tmp_path / "s.bai")
     index_bam(SAMPLE, bai)

@@ -161,6 +161,33 @@ def test_rescued_singletons_feed_dcs(tmp_path):
     assert dcs_count(tmp_path / "on") > dcs_count(tmp_path / "off")
 
 
+def test_cleanup_removes_intermediates(tmp_path):
+    from consensuscruncher_tpu.utils.simulate import SimConfig, simulate_bam
+
+    bam = str(tmp_path / "in.bam")
+    simulate_bam(bam, SimConfig(n_fragments=20, seed=3))
+    rc = main(["consensus", "-i", bam, "-o", str(tmp_path / "o"), "-n", "s",
+               "--backend", "cpu", "--scorrect", "True", "--cleanup", "True"])
+    assert rc == 0
+    base = tmp_path / "o" / "s"
+    assert not (base / "sscs" / "s.badReads.bam").exists()
+    assert not (base / "dcs" / "s.sscs.rescued.bam").exists()
+    assert not (base / "dcs" / "s.sscs.rescued.bam.bai").exists()
+    # real outputs survive
+    assert (base / "all_unique" / "s.all.unique.dcs.bam").exists()
+    assert (base / "sscs" / "s.sscs.sorted.bam").exists()
+
+
+def test_backend_probe_paths():
+    """cpu/reference are no-ops; 'tpu' under the hermetic test env (axon
+    factory popped by conftest) resolves to the virtual cpu devices fast."""
+    from consensuscruncher_tpu.utils.backend_probe import ensure_backend
+
+    ensure_backend("cpu")
+    ensure_backend("reference")
+    ensure_backend("tpu", timeout_s=60.0)  # must return well before 60s
+
+
 def test_unsorted_consensus_bam_detected(tmp_path):
     # Regression: DCS/singleton windows must reject unsorted input instead
     # of silently writing everything unpaired.

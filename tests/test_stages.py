@@ -70,6 +70,20 @@ def test_sscs_backends_bit_identical(sim, tmp_path):
             assert ra == rb, f"record mismatch: {ra.qname}"
 
 
+def test_sscs_reference_backend_bit_identical(sim, tmp_path):
+    """The Counter-oracle stage path (bench.py's baseline denominator) must
+    produce byte-for-byte the same outputs as the production backends."""
+    in_bam, _, _ = sim
+    r_ref = run_sscs(in_bam, str(tmp_path / "ref"), backend="reference")
+    r_cpu = run_sscs(in_bam, str(tmp_path / "cpu"), backend="cpu")
+    for a_path, b_path in ((r_ref.sscs_bam, r_cpu.sscs_bam),
+                           (r_ref.singleton_bam, r_cpu.singleton_bam)):
+        a, b = read_all(a_path), read_all(b_path)
+        assert len(a) == len(b)
+        for ra, rb in zip(a, b):
+            assert ra == rb, f"record mismatch: {ra.qname}"
+
+
 def test_sscs_rejects_unsorted(tmp_path):
     from consensuscruncher_tpu.io.bam import BamHeader, BamRead, BamWriter
     from consensuscruncher_tpu.stages.grouping import NotCoordinateSorted
