@@ -255,6 +255,7 @@ def _consensus_impl(args) -> dict:
                 sscs_res.sscs_bam,
                 corr_prefix,
                 max_mismatch=args.max_mismatch,
+                backend=args.backend,
             ),
             rebuild=lambda: SingletonResult.from_prefix(corr_prefix),
         )

@@ -9,12 +9,19 @@ string triples; ``name`` excludes the leading ``@`` and keeps any comment.
 from __future__ import annotations
 
 import gzip
+import io
 from typing import Iterator, TextIO
 
 
 def _open_text(path, mode: str):
     p = str(path)
     if p.endswith(".gz"):
+        if "w" in mode:
+            # mtime=0 keeps writes byte-deterministic (same content -> same
+            # .gz bytes), so regenerated fixtures don't dirty VCS history.
+            return io.TextIOWrapper(
+                gzip.GzipFile(p, "wb", mtime=0), encoding="ascii"
+            )
         return gzip.open(p, mode + "t", encoding="ascii")
     return open(p, mode, encoding="ascii")
 

@@ -24,6 +24,10 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def _next_pow2(n: int) -> int:
+    return 1 if n <= 1 else 1 << (n - 1).bit_length()
+
+
 @lru_cache(maxsize=None)
 def _compiled_tile():
     def fn(a, b):  # a: (n, L) uint8, b: (m, L) uint8
@@ -32,13 +36,19 @@ def _compiled_tile():
     return jax.jit(fn)
 
 
-def pairwise_hamming(a: np.ndarray, b: np.ndarray, tile: int = 2048) -> np.ndarray:
+def pairwise_hamming(
+    a: np.ndarray, b: np.ndarray, tile: int = 2048, device: bool = True
+) -> np.ndarray:
     """All-pairs Hamming distance between two barcode code matrices.
 
     Args:
       a: ``(n, L)`` uint8 barcode codes.
       b: ``(m, L)`` uint8 barcode codes (same L).
-      tile: max rows per device dispatch on each side.
+      tile: max rows per dispatch on each side.
+      device: route tiles through the jitted device kernel (the production
+        TPU path).  ``False`` computes the same broadcast in numpy — used by
+        ``--backend cpu`` runs, which must never touch (or wait on) a
+        device backend.
 
     Returns ``(n, m)`` int32 distance matrix on host.
     """
@@ -46,17 +56,34 @@ def pairwise_hamming(a: np.ndarray, b: np.ndarray, tile: int = 2048) -> np.ndarr
     b = np.asarray(b, dtype=np.uint8)
     if a.ndim != 2 or b.ndim != 2 or a.shape[1] != b.shape[1]:
         raise ValueError(f"barcode matrices must be (n, L)/(m, L), got {a.shape}/{b.shape}")
-    fn = _compiled_tile()
+    fn = _compiled_tile() if device else None
     out = np.empty((a.shape[0], b.shape[0]), dtype=np.int32)
     for i in range(0, a.shape[0], tile):
         for j in range(0, b.shape[0], tile):
-            out[i : i + tile, j : j + tile] = np.asarray(
-                fn(jnp.asarray(a[i : i + tile]), jnp.asarray(b[j : j + tile]))
-            )
+            ta, tb = a[i : i + tile], b[j : j + tile]
+            if device:
+                # Pad each tile to the next power of two so the jit cache
+                # sees a handful of shapes, not one per candidate-pool size
+                # (the stage calls this with a different (1, k) every tag).
+                # Padded rows are sliced off before any argmin/tie logic,
+                # so they can never win or tie.
+                pn, pm = _next_pow2(ta.shape[0]), _next_pow2(tb.shape[0])
+                pa = np.zeros((pn, ta.shape[1]), np.uint8)
+                pb = np.zeros((pm, tb.shape[1]), np.uint8)
+                pa[: ta.shape[0]] = ta
+                pb[: tb.shape[0]] = tb
+                block = np.asarray(fn(jnp.asarray(pa), jnp.asarray(pb)))
+                block = block[: ta.shape[0], : tb.shape[0]]
+            else:
+                block = (ta[:, None, :] != tb[None, :, :]).sum(axis=-1, dtype=np.int32)
+            out[i : i + tile, j : j + tile] = block
     return out
 
 
-def best_matches(a: np.ndarray, b: np.ndarray, max_mismatch: int, tile: int = 2048):
+def best_matches(
+    a: np.ndarray, b: np.ndarray, max_mismatch: int, tile: int = 2048,
+    device: bool = True,
+):
     """For each row of ``a``: index of the unique best row of ``b`` within
     ``max_mismatch``, or -1 (no candidate / ambiguous tie for best).
 
@@ -65,7 +92,7 @@ def best_matches(a: np.ndarray, b: np.ndarray, max_mismatch: int, tile: int = 20
     """
     if b.shape[0] == 0:
         return np.full(a.shape[0], -1, dtype=np.int64)
-    dist = pairwise_hamming(a, b, tile=tile)
+    dist = pairwise_hamming(a, b, tile=tile, device=device)
     best = dist.argmin(axis=1)
     best_d = dist[np.arange(dist.shape[0]), best]
     ties = (dist == best_d[:, None]).sum(axis=1) > 1

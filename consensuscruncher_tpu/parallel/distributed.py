@@ -84,13 +84,18 @@ def _force_cpu_for_dryrun(local_devices: int) -> None:
     cpu platform, give this process ``local_devices`` virtual devices, and
     drop the axon PJRT factory before any backend init can hang on it."""
     import os
+    import re
 
     os.environ["JAX_PLATFORMS"] = "cpu"
-    flags = os.environ.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in flags:
-        os.environ["XLA_FLAGS"] = (
-            f"{flags} --xla_force_host_platform_device_count={local_devices}"
-        ).strip()
+    # Overwrite (not merely append) any inherited device-count flag:
+    # --local-devices must win or the global mesh comes up the wrong size.
+    flags = re.sub(
+        r"--xla_force_host_platform_device_count=\S+", "",
+        os.environ.get("XLA_FLAGS", ""),
+    ).strip()
+    os.environ["XLA_FLAGS"] = (
+        f"{flags} --xla_force_host_platform_device_count={local_devices}"
+    ).strip()
     import jax
 
     jax.config.update("jax_platforms", "cpu")

@@ -101,7 +101,7 @@ def _corrected(read: BamRead, partner: BamRead) -> BamRead:
     return out
 
 
-def _hamming_partner(tag, candidates: dict, max_mismatch: int):
+def _hamming_partner(tag, candidates: dict, max_mismatch: int, device: bool):
     """Barcode-tolerant partner lookup among same-anchor candidates whose
     non-barcode tag fields match the mirrored tag exactly."""
     mirror = tags_mod.duplex_tag(tag)
@@ -115,7 +115,7 @@ def _hamming_partner(tag, candidates: dict, max_mismatch: int):
         return None
     a = encode_seq(mirror.barcode.replace(tags_mod.BARCODE_SEP, ""))[None, :]
     b = np.stack([encode_seq(t.barcode.replace(tags_mod.BARCODE_SEP, "")) for t in pool])
-    idx = best_matches(a, b, max_mismatch=max_mismatch)[0]
+    idx = best_matches(a, b, max_mismatch=max_mismatch, device=device)[0]
     return pool[idx] if idx >= 0 else None
 
 
@@ -124,7 +124,11 @@ def run_singleton_correction(
     sscs_bam: str,
     out_prefix: str,
     max_mismatch: int = 0,
+    backend: str = "tpu",
 ) -> SingletonResult:
+    """``backend="cpu"`` keeps the Hamming matcher in numpy — a cpu run
+    must never touch (or wait on) a device backend."""
+    use_device = backend == "tpu"
     stats = StageStats("singleton_correction")
     all_paths = output_paths(out_prefix)
     paths = {k: all_paths[k] for k in ("sscs_rescue", "singleton_rescue", "remaining")}
@@ -152,13 +156,13 @@ def run_singleton_correction(
                 elif mirror in singles and mirror != tag and mirror not in done:
                     partner_tag, pool = mirror, singles
                 elif max_mismatch > 0:
-                    partner_tag = _hamming_partner(tag, sscses, max_mismatch)
+                    partner_tag = _hamming_partner(tag, sscses, max_mismatch, use_device)
                     pool = sscses
                     if partner_tag is None:
                         # exclude self AND already-consumed singletons — a
                         # singleton may be corrected at most once
                         avail = {t: r for t, r in singles.items() if t != tag and t not in done}
-                        partner_tag = _hamming_partner(tag, avail, max_mismatch)
+                        partner_tag = _hamming_partner(tag, avail, max_mismatch, use_device)
                         pool = singles
 
                 partner = pool.get(partner_tag) if partner_tag is not None else None
@@ -202,8 +206,14 @@ def main(argv=None):
     p.add_argument("--outfile", required=True, help="output prefix")
     p.add_argument("--max-mismatch", type=int, default=0,
                    help="barcode Hamming tolerance (0 = exact complementary match)")
+    p.add_argument("--backend", choices=("cpu", "tpu"), default="tpu")
     args = p.parse_args(argv)
-    run_singleton_correction(args.singleton, args.bamfile, args.outfile, args.max_mismatch)
+    if args.max_mismatch > 0:
+        from consensuscruncher_tpu.utils.backend_probe import ensure_backend
+
+        ensure_backend(args.backend)
+    run_singleton_correction(args.singleton, args.bamfile, args.outfile,
+                             args.max_mismatch, backend=args.backend)
 
 
 if __name__ == "__main__":

@@ -30,6 +30,11 @@ class SimConfig:
     mean_family_size: float = 3.0
     duplex_fraction: float = 0.8  # fraction of fragments with both strands
     error_rate: float = 0.005
+    # Per-read probability of one substitution error INSIDE the UMI — such
+    # reads split off as spurious singleton families whose barcode is
+    # Hamming-1 from the true family's, the exact population
+    # --max_mismatch rescue exists to reclaim.
+    barcode_error_rate: float = 0.0
     seed: int = 0
     bdelim: str = DEFAULT_BDELIM
 
@@ -78,7 +83,18 @@ def simulate_bam(path: str, cfg: SimConfig) -> SimTruth:
                 )
                 for _ in range(size):
                     serial += 1
-                    qname = f"sim:{frag}:{strand}:{serial}{cfg.bdelim}{bc}"
+                    bc_read = bc
+                    # Short-circuit keeps the rng stream identical to older
+                    # datasets when the rate is 0 (golden stability).
+                    if cfg.barcode_error_rate > 0 and rng.random() < cfg.barcode_error_rate:
+                        chars = list(bc_read)
+                        pool = [i for i, c in enumerate(chars) if c != BARCODE_SEP]
+                        i = pool[int(rng.integers(0, len(pool)))]
+                        chars[i] = BASES[
+                            (BASES.index(chars[i]) + 1 + int(rng.integers(0, 3))) % 4
+                        ]
+                        bc_read = "".join(chars)
+                    qname = f"sim:{frag}:{strand}:{serial}{cfg.bdelim}{bc_read}"
                     s1 = _mutate(rng, r1_seq, cfg.error_rate)
                     s2 = _mutate(rng, r2_seq, cfg.error_rate)
                     q1 = rng.integers(25, 41, cfg.read_len).astype(np.uint8)

@@ -70,6 +70,33 @@ def test_consensus_pipeline_matches_golden(tmp_path, backend, devices):
         f"{backend}/devices={devices} outputs diverge from golden: {mismatches}"
 
 
+@pytest.mark.parametrize("backend", ["cpu", "tpu"])
+@pytest.mark.parametrize("section,name,mm", [
+    ("consensus_bcerr_exact", "golden_bcerr", 0),
+    ("consensus_mm1", "golden_mm1", 1),
+])
+def test_hamming_rescue_matches_golden(tmp_path, backend, section, name, mm):
+    """The tolerant rescue path (--max_mismatch 1) is digest-frozen on the
+    barcode-error fixture, where distance-1 rescue reclaims a real
+    population (the goldens for exact vs mm1 differ in 12 outputs)."""
+    from consensuscruncher_tpu.cli import main as cli_main
+
+    cli_main([
+        "consensus", "-i", os.path.join(DATA, "sample_bcerr.bam"),
+        "-o", str(tmp_path), "-n", name,
+        "--backend", backend, "--scorrect", "True", "--max_mismatch", str(mm),
+    ])
+    base = tmp_path / name
+    mismatches = []
+    for rel, expected in GOLDEN[section].items():
+        p = base / rel
+        assert p.exists(), f"missing output {rel}"
+        got = canonical_bam_digest(str(p)) if rel.endswith(".bam") else text_digest(str(p))
+        if got != expected:
+            mismatches.append(rel)
+    assert not mismatches, f"{backend} {section} diverges: {mismatches}"
+
+
 def test_extract_matches_golden(tmp_path):
     from consensuscruncher_tpu.stages.extract_barcodes import run_extract
 

@@ -44,6 +44,30 @@ def test_best_matches_empty_candidates():
     assert best_matches(a, b, max_mismatch=1).tolist() == [-1]
 
 
+def test_best_matches_10k_pool_device_vs_numpy():
+    """Large candidate pool through the tiled device matcher (forces
+    multiple tiles) — must agree exactly with the numpy path and with a
+    brute-force check on sampled rows."""
+    rng = np.random.default_rng(11)
+    L = 12
+    queries = rng.integers(0, 4, (257, L)).astype(np.uint8)
+    pool = rng.integers(0, 4, (10_240, L)).astype(np.uint8)
+    # plant unique near-misses for the first 10 queries
+    for i in range(10):
+        pool[i * 100] = queries[i]
+        pool[i * 100][0] = (pool[i * 100][0] + 1) % 4
+
+    dev = best_matches(queries, pool, max_mismatch=1, tile=2048, device=True)
+    cpu = best_matches(queries, pool, max_mismatch=1, tile=4096, device=False)
+    np.testing.assert_array_equal(dev, cpu)
+    for i in range(10):
+        d = (pool != queries[i]).sum(axis=1)
+        if (d == d.min()).sum() == 1 and d.min() <= 1:
+            assert dev[i] == int(d.argmin()), i
+        else:
+            assert dev[i] == -1, i
+
+
 def test_shape_mismatch_rejected():
     with pytest.raises(ValueError, match="barcode matrices"):
         pairwise_hamming(np.zeros((2, 4), np.uint8), np.zeros((2, 5), np.uint8))

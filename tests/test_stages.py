@@ -214,3 +214,56 @@ def test_singleton_correction_hamming_rescues_near_miss(tmp_path):
                                      str(tmp_path / "f"), max_mismatch=1)
     assert len(read_all(fuzzy.sscs_rescue_bam)) == 2
     assert len(read_all(fuzzy.remaining_bam)) == 0
+    # numpy matcher (--backend cpu) must agree bit-for-bit with the device one
+    fuzzy_cpu = run_singleton_correction(sscs_res.singleton_bam, sscs_res.sscs_bam,
+                                         str(tmp_path / "fc"), max_mismatch=1,
+                                         backend="cpu")
+    a_reads = read_all(fuzzy.sscs_rescue_bam)
+    b_reads = read_all(fuzzy_cpu.sscs_rescue_bam)
+    assert len(a_reads) == len(b_reads) == 2
+    assert a_reads == b_reads
+
+
+def test_singleton_correction_hamming_refuses_ambiguity(tmp_path):
+    """Two same-anchor SSCS candidates at the same best distance: the rescue
+    must refuse (stage level, not just the matcher unit test)."""
+    from consensuscruncher_tpu.io.bam import BamHeader, BamRead, BamWriter, sort_bam
+    import os
+
+    hdr = BamHeader.from_refs([("chr1", 100000)])
+    lo, hi, L = 1000, 1220, 100
+    reads = []
+
+    def pair(qname, bc, strand, seq1, seq2):
+        r1_read1 = strand == "A"
+        reads.append(BamRead(qname=f"{qname}|{bc}", flag=0x1 | 0x2 | 0x20 | (0x40 if r1_read1 else 0x80),
+                             ref="chr1", pos=lo, mapq=60, cigar=[("M", L)], mate_ref="chr1",
+                             mate_pos=hi, tlen=hi - lo + L, seq=seq1,
+                             qual=np.full(L, 30, dtype=np.uint8)))
+        reads.append(BamRead(qname=f"{qname}|{bc}", flag=0x1 | 0x2 | 0x10 | (0x80 if r1_read1 else 0x40),
+                             ref="chr1", pos=hi, mapq=60, cigar=[("M", L)], mate_ref="chr1",
+                             mate_pos=lo, tlen=-(hi - lo + L), seq=seq2,
+                             qual=np.full(L, 30, dtype=np.uint8)))
+
+    mol1, mol2 = "A" * L, "C" * L
+    pair("s1", "AAATTT.CCCGGG", "A", mol1, mol2)  # singleton, strand A
+    # two strand-B families, both Hamming-1 from the mirror CCCGGG.AAATTT
+    for i in range(3):
+        pair(f"b{i}", "CCCGGA.AAATTT", "B", mol1, mol2)
+    for i in range(3):
+        pair(f"c{i}", "CCCGGT.AAATTT", "B", mol1, mol2)
+    tmp = tmp_path / "in.unsorted.bam"
+    with BamWriter(str(tmp), hdr) as w:
+        for r in reads:
+            w.write(r)
+    in_bam = tmp_path / "in.bam"
+    sort_bam(str(tmp), str(in_bam))
+    os.unlink(str(tmp))
+
+    sscs_res = run_sscs(str(in_bam), str(tmp_path / "s"), backend="cpu")
+    for backend in ("tpu", "cpu"):
+        res = run_singleton_correction(sscs_res.singleton_bam, sscs_res.sscs_bam,
+                                       str(tmp_path / f"r_{backend}"),
+                                       max_mismatch=1, backend=backend)
+        assert len(read_all(res.sscs_rescue_bam)) == 0, backend
+        assert len(read_all(res.remaining_bam)) == 2, backend  # both mates refused
