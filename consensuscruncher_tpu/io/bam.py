@@ -439,9 +439,23 @@ def _coord_key(read: BamRead, header: BamHeader):
     return (rid if rid >= 0 else 1 << 30, read.pos, read.qname, read.flag)
 
 
+# Compressed-size ceiling for the in-memory columnar sort (~4x expansion
+# plus one gathered copy); larger inputs take the spill/merge object path.
+_COLUMNAR_SORT_MAX_BYTES = int(os.environ.get("CCT_COLUMNAR_SORT_MAX_BYTES", 96 << 20))
+
+
 def sort_bam(in_path, out_path, max_in_memory: int = 2_000_000) -> None:
     """Coordinate sort (samtools-sort parity). Spills chunks to temp BAMs and
-    heap-merges when the input exceeds ``max_in_memory`` records."""
+    heap-merges when the input exceeds ``max_in_memory`` records.
+
+    Inputs whose compressed size fits ``CCT_COLUMNAR_SORT_MAX_BYTES`` take
+    the columnar fast path (``io.columnar.sort_bam_columnar``): identical
+    total order, but a pure byte shuffle — records are never decoded."""
+    if os.path.getsize(in_path) <= _COLUMNAR_SORT_MAX_BYTES:
+        from consensuscruncher_tpu.io.columnar import sort_bam_columnar
+
+        if sort_bam_columnar(in_path, out_path, max_records=max_in_memory):
+            return
     reader = BamReader(in_path)
     header = reader.header
     chunks: list[str] = []

@@ -1,0 +1,373 @@
+"""Columnar BAM decode + sort: the host-side Amdahl fix (SURVEY.md §7 #3).
+
+The per-record object path (``BamReader`` -> ``BamRead`` dataclasses) costs
+~12 us/record in pure-Python struct work, which dominates the whole pipeline
+once the consensus vote runs on an accelerator (measured: the XLA vote is
+~2% of SSCS stage wall-clock; decode+group+sort are ~80%).  This module is
+the TPU-first answer on the host side: decode a whole batch of records into
+**columns** (numpy arrays) with a single serial offset scan plus vectorized
+gathers, so per-record Python work disappears from the hot path.
+
+Layout per batch (record fields per SAM spec §4.2):
+
+- fixed-width columns: ``ref_id pos flag mapq mate_ref_id mate_pos tlen
+  l_seq n_cigar l_qname`` — one numpy array each, shape ``(n,)``.
+- ragged payloads are *views into the undecoded buffer* described by
+  ``(start, length)`` column pairs; materialized on demand via
+  :func:`ragged_gather` (qnames, cigars, tags) or the nibble-expanding
+  :func:`seq_codes` (sequence -> pipeline base codes A=0..N=4).
+- ``raw`` record blobs (length-prefixed, byte-exact) remain addressable via
+  ``rec_off`` for passthrough writes — a coordinate sort is then a pure
+  byte shuffle (lexsort + gather), never a decode/re-encode round trip.
+
+Parity: every field agrees bit-for-bit with ``BamReader`` (tests/
+test_columnar.py proves it record-by-record), and :func:`sort_bam_columnar`
+reproduces ``io.bam.sort_bam``'s exact total order — the same
+``(ref_id, pos, qname, flag)`` key, stable for equal keys (np.lexsort and
+Python sort are both stable).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from dataclasses import dataclass
+from functools import cached_property
+
+import numpy as np
+
+from consensuscruncher_tpu.io import bgzf
+from consensuscruncher_tpu.io.bam import (
+    BAM_MAGIC,
+    BamHeader,
+    CIGAR_OPS,
+    SEQ_NIBBLES,
+    decode_record,
+)
+from consensuscruncher_tpu.utils.phred import N as CODE_N, encode_seq
+
+# nibble (0-15, spec '=ACMGRSVTWYHKDBN') -> pipeline base code (A=0..N=4);
+# every ambiguity code collapses to N exactly like decode->encode_seq does.
+NIB2CODE = encode_seq(SEQ_NIBBLES)
+
+
+def _gather_view(buf: np.ndarray, off: np.ndarray, width: int, dtype: str) -> np.ndarray:
+    """Vectorized unaligned little-endian field gather at ``off`` (n,)."""
+    raw = buf[off[:, None] + np.arange(width, dtype=np.int64)]
+    return np.ascontiguousarray(raw).view(dtype).ravel()
+
+
+def ragged_gather(buf: np.ndarray, starts: np.ndarray, lengths: np.ndarray):
+    """Gather ``n`` variable-length byte runs into one packed array.
+
+    Returns ``(data, offsets)`` with ``offsets`` shaped ``(n+1,)`` —
+    run ``i`` is ``data[offsets[i]:offsets[i+1]]``.
+    """
+    lengths = lengths.astype(np.int64)
+    off = np.zeros(len(lengths) + 1, dtype=np.int64)
+    np.cumsum(lengths, out=off[1:])
+    total = int(off[-1])
+    if total == 0:
+        return np.empty(0, dtype=np.uint8), off
+    idx = (
+        np.arange(total, dtype=np.int64)
+        - np.repeat(off[:-1], lengths)
+        + np.repeat(starts.astype(np.int64), lengths)
+    )
+    return buf[idx], off
+
+
+@dataclass
+class ColumnarBatch:
+    """One decoded batch; all arrays share the record axis ``(n,)``."""
+
+    header: BamHeader
+    buf: np.ndarray  # uint8: the uncompressed bytes these records live in
+    rec_off: np.ndarray  # (n+1,) int64 record starts (at the length prefix)
+    ref_id: np.ndarray
+    pos: np.ndarray
+    flag: np.ndarray
+    mapq: np.ndarray
+    mate_ref_id: np.ndarray
+    mate_pos: np.ndarray
+    tlen: np.ndarray
+    l_seq: np.ndarray
+    n_cigar: np.ndarray
+    l_qname: np.ndarray  # includes the trailing NUL
+
+    @property
+    def n(self) -> int:
+        return len(self.rec_off) - 1
+
+    # ---- derived ragged payload geometry (all (n,) int64) ----
+
+    @cached_property
+    def qname_start(self) -> np.ndarray:
+        return self.rec_off[:-1] + 36
+
+    @cached_property
+    def cigar_start(self) -> np.ndarray:
+        return self.qname_start + self.l_qname
+
+    @cached_property
+    def seq_start(self) -> np.ndarray:
+        return self.cigar_start + 4 * self.n_cigar.astype(np.int64)
+
+    @cached_property
+    def qual_start(self) -> np.ndarray:
+        return self.seq_start + (self.l_seq.astype(np.int64) + 1) // 2
+
+    @cached_property
+    def tags_start(self) -> np.ndarray:
+        return self.qual_start + self.l_seq
+
+    # ---- materialized payloads ----
+
+    @cached_property
+    def qnames(self):
+        """``(data, offsets)`` of qname bytes (no trailing NUL)."""
+        return ragged_gather(self.buf, self.qname_start, self.l_qname - 1)
+
+    @cached_property
+    def qname_matrix(self) -> np.ndarray:
+        """``(n, W)`` uint8, zero-padded to the batch's longest qname —
+        the vectorized-lexicographic form (NUL pads sort before any ascii
+        byte, exactly like Python's shorter-string-first comparison)."""
+        data, off = self.qnames
+        lens = np.diff(off)
+        w = int(lens.max()) if len(lens) else 0
+        out = np.zeros((self.n, w), dtype=np.uint8)
+        if w:
+            idx = np.arange(int(lens.sum()), dtype=np.int64) - np.repeat(off[:-1], lens)
+            out[np.repeat(np.arange(self.n), lens), idx] = data
+        return out
+
+    def seq_codes(self):
+        """``(codes, offsets)``: 4-bit seq fields nibble-expanded straight to
+        pipeline base codes (A=0..N=4) — no string round trip."""
+        l = self.l_seq.astype(np.int64)
+        off = np.zeros(self.n + 1, dtype=np.int64)
+        np.cumsum(l, out=off[1:])
+        total = int(off[-1])
+        if total == 0:
+            return np.empty(0, dtype=np.uint8), off
+        rel = np.arange(total, dtype=np.int64) - np.repeat(off[:-1], l)
+        byte_idx = np.repeat(self.seq_start, l) + rel // 2
+        b = self.buf[byte_idx]
+        nib = np.where(rel % 2 == 0, b >> 4, b & 0xF)
+        return NIB2CODE[nib], off
+
+    def quals(self):
+        """``(quals, offsets)``; the spec's 0xFF missing marker maps to 0,
+        matching the stages' missing-qual convention."""
+        data, off = ragged_gather(self.buf, self.qual_start, self.l_seq)
+        return np.where(data == 0xFF, 0, data).astype(np.uint8), off
+
+    def cigar_string(self, i: int) -> str:
+        """Cigar of record ``i`` as text ('*' when empty)."""
+        nc = int(self.n_cigar[i])
+        if nc == 0:
+            return "*"
+        start = int(self.cigar_start[i])
+        words = (
+            np.ascontiguousarray(self.buf[start : start + 4 * nc]).view("<u4")
+        )
+        return "".join(f"{int(w) >> 4}{CIGAR_OPS[int(w) & 0xF]}" for w in words)
+
+    def record_blob(self, i: int) -> bytes:
+        """Byte-exact record ``i`` including the length prefix."""
+        return self.buf[self.rec_off[i] : self.rec_off[i + 1]].tobytes()
+
+    def materialize(self, i: int):
+        """Full ``BamRead`` for record ``i`` (slow path: bad reads,
+        singletons — anything that needs the object surface)."""
+        body = self.buf[self.rec_off[i] + 4 : self.rec_off[i + 1]].tobytes()
+        return decode_record(body, self.header)
+
+
+def _scan_offsets(chunk: bytes, limit: int) -> np.ndarray:
+    """Record boundaries in ``chunk[:limit]`` — the single serial pass."""
+    offs = [0]
+    o = 0
+    unpack_from = struct.unpack_from
+    while o + 4 <= limit:
+        (bs,) = unpack_from("<i", chunk, o)
+        if bs < 32:
+            raise ValueError(f"corrupt BAM record: block_size {bs} at offset {o}")
+        if o + 4 + bs > limit:
+            break
+        o += 4 + bs
+        offs.append(o)
+    return np.asarray(offs, dtype=np.int64)
+
+
+def _make_batch(header: BamHeader, buf: np.ndarray, rec_off: np.ndarray) -> ColumnarBatch:
+    off = rec_off[:-1]
+    return ColumnarBatch(
+        header=header,
+        buf=buf,
+        rec_off=rec_off,
+        ref_id=_gather_view(buf, off + 4, 4, "<i4"),
+        pos=_gather_view(buf, off + 8, 4, "<i4"),
+        l_qname=buf[off + 12].astype(np.int64),
+        mapq=buf[off + 13].copy(),
+        n_cigar=_gather_view(buf, off + 16, 2, "<u2").astype(np.int32),
+        flag=_gather_view(buf, off + 18, 2, "<u2").astype(np.int32),
+        l_seq=_gather_view(buf, off + 20, 4, "<i4"),
+        mate_ref_id=_gather_view(buf, off + 24, 4, "<i4"),
+        mate_pos=_gather_view(buf, off + 28, 4, "<i4"),
+        tlen=_gather_view(buf, off + 32, 4, "<i4"),
+    )
+
+
+class ColumnarReader:
+    """Streaming columnar BAM reader: ``for batch in reader.batches(): ...``
+
+    ``batch_bytes`` bounds memory (uncompressed bytes per batch); records
+    never split across batches.
+    """
+
+    def __init__(self, path, batch_bytes: int = 64 << 20):
+        self._bgzf = bgzf.BgzfReader(path)
+        self._batch_bytes = batch_bytes
+        magic = self._bgzf.read(4)
+        if magic != BAM_MAGIC:
+            raise ValueError(f"not a BAM file: magic {magic!r}")
+        (l_text,) = struct.unpack("<i", self._bgzf.read(4))
+        text = self._bgzf.read(l_text).decode("ascii", errors="replace").rstrip("\x00")
+        (n_ref,) = struct.unpack("<i", self._bgzf.read(4))
+        refs = []
+        for _ in range(n_ref):
+            (l_name,) = struct.unpack("<i", self._bgzf.read(4))
+            name = self._bgzf.read(l_name)[:-1].decode("ascii")
+            (l_ref,) = struct.unpack("<i", self._bgzf.read(4))
+            refs.append((name, l_ref))
+        self.header = BamHeader(text=text, refs=refs)
+        self._carry = b""
+
+    def batches(self):
+        while True:
+            chunk = self._carry + self._bgzf.read(self._batch_bytes)
+            if not chunk:
+                return
+            offs = _scan_offsets(chunk, len(chunk))
+            end = int(offs[-1])
+            if end == 0:
+                # no complete record in the window: either a giant record
+                # (grow the read) or EOF mid-record (truncation)
+                more = self._bgzf.read(self._batch_bytes)
+                if not more:
+                    raise ValueError("truncated BAM record at end of file")
+                self._carry = chunk + more
+                continue
+            self._carry = chunk[end:]
+            buf = np.frombuffer(chunk, dtype=np.uint8, count=end)
+            yield _make_batch(self.header, buf, offs)
+
+    def close(self) -> None:
+        self._bgzf.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+# ------------------------------------------------------------------ sort
+
+def sort_bam_columnar(
+    in_path,
+    out_path,
+    level: int = 6,
+    max_records: int = 2_000_000,
+    max_raw_bytes: int = 768 << 20,
+) -> bool:
+    """In-memory coordinate sort as a pure byte shuffle.
+
+    Same total order as ``io.bam.sort_bam`` — key ``(ref_id_or_last, pos,
+    qname, flag)``, stable — but the records are never decoded: lexsort the
+    key columns, then gather the raw length-prefixed blobs in permuted
+    order and stream them through BGZF.  Returns ``True`` on success,
+    ``False`` when the input exceeds the in-memory bounds (record count or
+    UNCOMPRESSED bytes — compressed size is no proxy: low-complexity reads
+    BGZF-compress 10-30x), in which case the caller falls back to the
+    bounded spill/merge object sort.
+    """
+    from consensuscruncher_tpu.io.bam import _sorted_header
+
+    reader = ColumnarReader(in_path, batch_bytes=64 << 20)
+    batches = []
+    n_total = 0
+    raw_total = 0
+    try:
+        header = reader.header
+        for b in reader.batches():
+            batches.append(b)
+            n_total += b.n
+            raw_total += len(b.buf)
+            if n_total > max_records or raw_total > max_raw_bytes:
+                return False  # let the spill/merge path handle it
+    finally:
+        reader.close()
+
+    # key columns across batches
+    if n_total:
+        rid = np.concatenate([b.ref_id for b in batches])
+        rid = np.where(rid < 0, 1 << 30, rid)
+        pos = np.concatenate([b.pos for b in batches])
+        flag = np.concatenate([b.flag for b in batches])
+        w = max(b.qname_matrix.shape[1] for b in batches)
+        qm = np.zeros((n_total, w), dtype=np.uint8)
+        row = 0
+        for b in batches:
+            m = b.qname_matrix
+            qm[row : row + b.n, : m.shape[1]] = m
+            row += b.n
+        # significance (most -> least): rid, pos, qname bytes, flag;
+        # np.lexsort's primary key is the LAST element.
+        keys = [flag] + [qm[:, i] for i in range(w - 1, -1, -1)] + [pos, rid]
+        perm = np.lexsort(keys)
+    else:
+        perm = np.empty(0, dtype=np.int64)
+
+    tmp = os.fspath(out_path) + ".tmp"
+    writer = bgzf.BgzfWriter(tmp, level=level)
+    try:
+        hdr = _sorted_header(header)
+        text = hdr.text.encode("ascii")
+        out = bytearray(BAM_MAGIC)
+        out += struct.pack("<i", len(text)) + text
+        out += struct.pack("<i", len(hdr.refs))
+        for name, length in hdr.refs:
+            bname = name.encode("ascii") + b"\x00"
+            out += struct.pack("<i", len(bname)) + bname + struct.pack("<i", length)
+        writer.write(bytes(out))
+
+        if n_total:
+            starts = np.concatenate([b.rec_off[:-1] for b in batches])
+            lengths = np.concatenate([np.diff(b.rec_off) for b in batches])
+            # per-batch buffers -> one global buffer for the gather
+            if len(batches) == 1:
+                big = batches[0].buf
+            else:
+                base = np.zeros(len(batches), dtype=np.int64)
+                sizes = [len(b.buf) for b in batches]
+                base[1:] = np.cumsum(sizes[:-1])
+                big = np.concatenate([b.buf for b in batches])
+                rec_base = np.repeat(base, [b.n for b in batches])
+                starts = starts + rec_base
+            data, _ = ragged_gather(big, starts[perm], lengths[perm])
+            # stream in slices: BgzfWriter re-chunks to 64 KB blocks; slice
+            # copies stay small instead of one full tobytes() duplicate
+            step = 8 << 20
+            for i in range(0, data.size, step):
+                writer.write(data[i : i + step].tobytes())
+        writer.close()
+        os.replace(tmp, out_path)
+        return True
+    except BaseException:
+        writer.close()
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise

@@ -68,10 +68,16 @@ def pairwise_hamming(
                 # Padded rows are sliced off before any argmin/tie logic,
                 # so they can never win or tie.
                 pn, pm = _next_pow2(ta.shape[0]), _next_pow2(tb.shape[0])
-                pa = np.zeros((pn, ta.shape[1]), np.uint8)
-                pb = np.zeros((pm, tb.shape[1]), np.uint8)
-                pa[: ta.shape[0]] = ta
-                pb[: tb.shape[0]] = tb
+                if pn != ta.shape[0]:
+                    pa = np.zeros((pn, ta.shape[1]), np.uint8)
+                    pa[: ta.shape[0]] = ta
+                else:
+                    pa = ta
+                if pm != tb.shape[0]:
+                    pb = np.zeros((pm, tb.shape[1]), np.uint8)
+                    pb[: tb.shape[0]] = tb
+                else:
+                    pb = tb
                 block = np.asarray(fn(jnp.asarray(pa), jnp.asarray(pb)))
                 block = block[: ta.shape[0], : tb.shape[0]]
             else:

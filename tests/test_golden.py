@@ -32,6 +32,18 @@ DATA = os.path.join(REPO, "test", "data")
 GOLDEN = json.load(open(os.path.join(REPO, "test", "golden.json")))
 
 
+def assert_outputs_match_golden(base, section: str, label: str) -> None:
+    """Every frozen digest in GOLDEN[section] must match under ``base``."""
+    mismatches = []
+    for rel, expected in GOLDEN[section].items():
+        p = base / rel
+        assert p.exists(), f"missing output {rel}"
+        got = canonical_bam_digest(str(p)) if rel.endswith(".bam") else text_digest(str(p))
+        if got != expected:
+            mismatches.append(rel)
+    assert not mismatches, f"{label} outputs diverge from golden: {mismatches}"
+
+
 def test_bundled_inputs_unchanged():
     assert canonical_bam_digest(os.path.join(DATA, "sample.bam")) == \
         GOLDEN["inputs"]["sample.bam"]
@@ -58,16 +70,9 @@ def test_consensus_pipeline_matches_golden(tmp_path, backend, devices):
     if devices:
         argv += ["--devices", str(devices)]
     cli_main(argv)
-    base = tmp_path / "golden"
-    mismatches = []
-    for rel, expected in GOLDEN["consensus"].items():
-        p = base / rel
-        assert p.exists(), f"missing output {rel}"
-        got = canonical_bam_digest(str(p)) if rel.endswith(".bam") else text_digest(str(p))
-        if got != expected:
-            mismatches.append(rel)
-    assert not mismatches, \
-        f"{backend}/devices={devices} outputs diverge from golden: {mismatches}"
+    assert_outputs_match_golden(
+        tmp_path / "golden", "consensus", f"{backend}/devices={devices}"
+    )
 
 
 @pytest.mark.parametrize("backend", ["cpu", "tpu"])
@@ -86,15 +91,7 @@ def test_hamming_rescue_matches_golden(tmp_path, backend, section, name, mm):
         "-o", str(tmp_path), "-n", name,
         "--backend", backend, "--scorrect", "True", "--max_mismatch", str(mm),
     ])
-    base = tmp_path / name
-    mismatches = []
-    for rel, expected in GOLDEN[section].items():
-        p = base / rel
-        assert p.exists(), f"missing output {rel}"
-        got = canonical_bam_digest(str(p)) if rel.endswith(".bam") else text_digest(str(p))
-        if got != expected:
-            mismatches.append(rel)
-    assert not mismatches, f"{backend} {section} diverges: {mismatches}"
+    assert_outputs_match_golden(tmp_path / name, section, f"{backend} {section}")
 
 
 def test_extract_matches_golden(tmp_path):
