@@ -344,24 +344,31 @@ def _reg2bin(beg: int, end: int) -> int:
 
 # ---------------------------------------------------------------- file objects
 
+def read_bam_header(bgzf_reader) -> BamHeader:
+    """Parse the BAM magic + header block from an open BGZF stream, leaving
+    it positioned at the first alignment record (shared by the object and
+    columnar readers so header handling cannot diverge between them)."""
+    magic = bgzf_reader.read(4)
+    if magic != BAM_MAGIC:
+        raise ValueError(f"not a BAM file: magic {magic!r}")
+    (l_text,) = struct.unpack("<i", bgzf_reader.read(4))
+    text = bgzf_reader.read(l_text).decode("ascii", errors="replace").rstrip("\x00")
+    (n_ref,) = struct.unpack("<i", bgzf_reader.read(4))
+    refs = []
+    for _ in range(n_ref):
+        (l_name,) = struct.unpack("<i", bgzf_reader.read(4))
+        name = bgzf_reader.read(l_name)[:-1].decode("ascii")
+        (l_ref,) = struct.unpack("<i", bgzf_reader.read(4))
+        refs.append((name, l_ref))
+    return BamHeader(text=text, refs=refs)
+
+
 class BamReader:
     """Streaming BAM reader: ``for read in BamReader(path): ...``"""
 
     def __init__(self, path):
         self._bgzf = bgzf.BgzfReader(path)
-        magic = self._bgzf.read(4)
-        if magic != BAM_MAGIC:
-            raise ValueError(f"not a BAM file: magic {magic!r}")
-        (l_text,) = struct.unpack("<i", self._bgzf.read(4))
-        text = self._bgzf.read(l_text).decode("ascii", errors="replace").rstrip("\x00")
-        (n_ref,) = struct.unpack("<i", self._bgzf.read(4))
-        refs = []
-        for _ in range(n_ref):
-            (l_name,) = struct.unpack("<i", self._bgzf.read(4))
-            name = self._bgzf.read(l_name)[:-1].decode("ascii")
-            (l_ref,) = struct.unpack("<i", self._bgzf.read(4))
-            refs.append((name, l_ref))
-        self.header = BamHeader(text=text, refs=refs)
+        self.header = read_bam_header(self._bgzf)
 
     def __iter__(self):
         while True:

@@ -43,6 +43,7 @@ from consensuscruncher_tpu.io.bam import (
     CIGAR_OPS,
     SEQ_NIBBLES,
     decode_record,
+    read_bam_header,
 )
 from consensuscruncher_tpu.utils.phred import N as CODE_N, encode_seq
 
@@ -230,19 +231,7 @@ class ColumnarReader:
     def __init__(self, path, batch_bytes: int = 64 << 20):
         self._bgzf = bgzf.BgzfReader(path)
         self._batch_bytes = batch_bytes
-        magic = self._bgzf.read(4)
-        if magic != BAM_MAGIC:
-            raise ValueError(f"not a BAM file: magic {magic!r}")
-        (l_text,) = struct.unpack("<i", self._bgzf.read(4))
-        text = self._bgzf.read(l_text).decode("ascii", errors="replace").rstrip("\x00")
-        (n_ref,) = struct.unpack("<i", self._bgzf.read(4))
-        refs = []
-        for _ in range(n_ref):
-            (l_name,) = struct.unpack("<i", self._bgzf.read(4))
-            name = self._bgzf.read(l_name)[:-1].decode("ascii")
-            (l_ref,) = struct.unpack("<i", self._bgzf.read(4))
-            refs.append((name, l_ref))
-        self.header = BamHeader(text=text, refs=refs)
+        self.header = read_bam_header(self._bgzf)
         self._carry = b""
 
     def batches(self):
@@ -357,12 +346,22 @@ def sort_bam_columnar(
                 big = np.concatenate([b.buf for b in batches])
                 rec_base = np.repeat(base, [b.n for b in batches])
                 starts = starts + rec_base
-            data, _ = ragged_gather(big, starts[perm], lengths[perm])
-            # stream in slices: BgzfWriter re-chunks to 64 KB blocks; slice
-            # copies stay small instead of one full tobytes() duplicate
-            step = 8 << 20
-            for i in range(0, data.size, step):
-                writer.write(data[i : i + step].tobytes())
+            # Gather + write in bounded record chunks: ragged_gather builds
+            # ~24 bytes of int64 index per output byte, so one whole-file
+            # gather would transiently need an order of magnitude more
+            # memory than the data itself.  ~8 MB output per chunk keeps the
+            # transient index footprint a couple hundred MB at worst.
+            sp, lp = starts[perm], lengths[perm]
+            csum = np.cumsum(lp)
+            target = 8 << 20
+            i0 = 0
+            while i0 < n_total:
+                floor = int(csum[i0 - 1]) if i0 else 0
+                i1 = int(np.searchsorted(csum, floor + target)) + 1
+                i1 = min(max(i1, i0 + 1), n_total)
+                data, _ = ragged_gather(big, sp[i0:i1], lp[i0:i1])
+                writer.write(data.tobytes())
+                i0 = i1
         writer.close()
         os.replace(tmp, out_path)
         return True

@@ -365,6 +365,151 @@ def run_duplex_pipelined(rows, qrows, sizes_a, sizes_b, codebook4,
     return out_a, out_qa, out_b, out_qb, out_d, out_dq, stats
 
 
+# ------------------------------------------------------------------ stage
+#
+# The streaming stage path over the member-stream wire: the drop-in twin of
+# ``ops.consensus_tpu.consensus_families`` (same generator contract, same
+# bit-exact outputs) with the transfer-optimal layout.  Measured on the
+# axon-tunneled v5e, the dense path's h2d transfer is ~80% of SSCS stage
+# wall-clock; this path ships each member base+qual in 0.5-1 byte with no
+# family padding instead of 2 bytes at ~4x padding redundancy.
+
+@lru_cache(maxsize=None)
+def _compiled_stream_vote(wire: str, num, den, qual_threshold, qual_cap,
+                          member_cap: int | None):
+    """Jitted wire-decode + vote: (a, b, sizes) -> (NF, L) consensus pair.
+
+    ``(a, b)`` by wire mode — raw: (bases, quals) both (M, L); pack8:
+    (packed (M, L), 16-entry codebook); pack4: (packed (M, L/2), 4-entry
+    codebook).  Shapes specialize inside jit's own cache; the lru key is
+    only the semantics + wire + gather capacity.
+    """
+
+    def fn(a, b, sizes):
+        sizes = sizes.astype(jnp.int32)
+        nf = sizes.shape[0]
+        if wire == "raw":
+            bases, quals = a.astype(jnp.uint8), b.astype(jnp.uint8)
+        elif wire == "pack8":
+            from consensuscruncher_tpu.ops.packing import unpack_device
+
+            bases, quals = unpack_device(a, b)
+        else:  # pack4 — length buckets are multiples of 32, so 2*packed width
+            bases, quals = unpack4_device(a, b, 2 * a.shape[-1])
+        if member_cap is not None:
+            return _gather_dense_vote(
+                bases, quals, sizes, cap=member_cap, num=num, den=den,
+                qual_threshold=qual_threshold, qual_cap=qual_cap,
+            )
+        m = bases.shape[0]
+        if m * max(num, den) >= 2**31:
+            raise ValueError(
+                f"member stream of {m} with cutoff {num}/{den} could overflow "
+                "the int32 cutoff compare — chunk the stream"
+            )
+        fam_ids, ranks = derive_ids_device(sizes, m)
+        total = sizes.sum()
+        fam_ids = jnp.where(jnp.arange(m, dtype=jnp.int32) < total, fam_ids, nf)
+        sizes_ov = jnp.concatenate([sizes, jnp.zeros(1, jnp.int32)])
+        out_b, out_q = _segment_vote(
+            bases, quals, fam_ids, ranks, sizes_ov, num_families=nf + 1,
+            num=num, den=den, qual_threshold=qual_threshold, qual_cap=qual_cap,
+        )
+        return out_b[:nf], out_q[:nf]
+
+    return jax.jit(fn)
+
+
+def encode_member_batch(batch):
+    """Host-side wire encode of a ``parallel.batching.MemberBatch``.
+
+    Picks the densest wire the batch admits — pack4 (pure-ACGT live bases,
+    ≤4 distinct live quals), pack8 (≤16 distinct live quals), else raw —
+    and rewrites dead cells (qual sentinel) to codebook-legal values (their
+    content never reaches a live output; see MemberBatch docstring).
+    Returns ``(wire, a, b, member_cap)`` ready for the jitted step.  Runs
+    on the prefetch producer thread in the streaming path, overlapping
+    device compute.
+    """
+    from consensuscruncher_tpu.ops.packing import (
+        CODEBOOK4_SIZE,
+        CODEBOOK_SIZE,
+        build_codebook,
+        build_codebook4,
+        pack,
+    )
+    from consensuscruncher_tpu.parallel.batching import QUAL_FILL_SENTINEL
+
+    rows, qrows = batch.rows, batch.qrows
+    uniq = np.unique(qrows)
+    uniq = uniq[uniq != QUAL_FILL_SENTINEL]
+    member_cap = pick_member_cap(batch.sizes[: batch.n_real])
+    if int(rows.max(initial=0)) < 4 and uniq.size <= CODEBOOK4_SIZE and uniq.size > 0:
+        book = build_codebook4(uniq)
+        qf = np.where(qrows == QUAL_FILL_SENTINEL, book[0], qrows)
+        return "pack4", pack4(rows, qf, book), book, member_cap
+    if uniq.size <= CODEBOOK_SIZE:
+        book = build_codebook(uniq if uniq.size else np.zeros(1, np.uint8))
+        qf = np.where(qrows == QUAL_FILL_SENTINEL, book[0], qrows)
+        return "pack8", pack(rows, qf, book), book, member_cap
+    qf = np.where(qrows == QUAL_FILL_SENTINEL, 0, qrows).astype(np.uint8)
+    return "raw", rows, qf, member_cap
+
+
+def consensus_families_stream(
+    families,
+    config: ConsensusConfig = ConsensusConfig(),
+    max_batch: int = 1024,
+    member_limit: int = 8192,
+    prefetch_depth: int | None = None,
+):
+    """Member-stream twin of ``consensus_tpu.consensus_families``.
+
+    Same contract: consumes ``(key, member_seqs, member_quals)``, yields
+    ``(key, consensus_base, consensus_qual)`` sliced to true length, in
+    batch order; bit-identical outputs (the vote is the same
+    ``_consensus_one_family`` program, fed through the packed wire).
+    Grouping, rectangularization, and wire packing all run on the prefetch
+    producer thread; the device keeps one batch in flight.
+    """
+    from consensuscruncher_tpu.parallel.batching import bucket_members
+    from consensuscruncher_tpu.parallel.prefetch import DEFAULT_DEPTH, pipelined, prefetch
+
+    if prefetch_depth is None:
+        prefetch_depth = DEFAULT_DEPTH
+    num, den = config.cutoff_rational
+    qt, qc = int(config.qual_threshold), int(config.qual_cap)
+
+    def encoded():
+        for batch in bucket_members(families, max_batch=max_batch,
+                                    member_limit=member_limit):
+            wire, a, b, member_cap = encode_member_batch(batch)
+            yield batch, wire, a, b, member_cap
+
+    def dispatch(item):
+        batch, wire, a, b, member_cap = item
+        fn = _compiled_stream_vote(wire, num, den, qt, qc, member_cap)
+        return fn(a, b, batch.sizes)
+
+    def fetch(item, handle):
+        batch = item[0]
+        out_b, out_q = (np.asarray(x) for x in handle)
+        for i, key in enumerate(batch.keys):
+            length = int(batch.lengths[i])
+            yield key, out_b[i, :length], out_q[i, :length]
+
+    if prefetch_depth <= 0:
+        for item in encoded():
+            yield from fetch(item, dispatch(item))
+        return
+
+    stream = prefetch(encoded(), depth=prefetch_depth)
+    try:
+        yield from pipelined(stream, dispatch, fetch)
+    finally:
+        stream.close()
+
+
 def build_member_stream(size_arrays: list[np.ndarray]):
     """Host-side prep: per-family sizes -> (fam_ids, ranks, sizes) for the
     slot layout ``concatenate(size_arrays)`` (strand A slots then strand B).

@@ -125,6 +125,112 @@ def bucket_families(
         yield _emit(bucket, fb, lb, pad_to=None)
 
 
+# ---------------------------------------------------------------- members
+#
+# Member-stream layout (the transfer-optimal wire, SURVEY.md §7.5): no
+# family-axis padding at all — every real member row appears exactly once in
+# a flat (M, L) stream, and the device derives family structure from the
+# per-family sizes.  At mean family size ~4 in a 16-cap dense bucket this
+# ships ~4x fewer rows than FamilyBatch before packing even starts.
+
+# Sentinel for never-written qual cells (BAM caps Phred at 93, and the
+# reader maps the spec's 0xFF missing marker to 0 — 255 cannot occur live).
+QUAL_FILL_SENTINEL = 255
+
+MEMBER_QUANTUM = 1024  # member-axis padding quantum (bounds recompiles)
+
+
+@dataclass
+class MemberBatch:
+    """One member-stream batch; families share a length bucket ``L``.
+
+    ``rows[start_i : start_i + sizes[i]]`` are family *i*'s members in
+    insertion order (starts = exclusive cumsum of sizes).  ``sizes`` is
+    padded with zeros to a static family count; ``rows``/``qrows`` are
+    padded with dead rows to a MEMBER_QUANTUM multiple.  Dead cells — rows
+    beyond the real member total, and positions ≥ the owning family's true
+    length — hold base 0 and qual QUAL_FILL_SENTINEL; they are never
+    gathered into a live family's vote, and live families' overhang
+    positions are sliced off by ``lengths`` downstream, so wire encoders
+    may rewrite them freely.
+    """
+
+    keys: list
+    rows: np.ndarray  # (M_pad, L) uint8 base codes
+    qrows: np.ndarray  # (M_pad, L) uint8 quals (QUAL_FILL_SENTINEL in dead cells)
+    sizes: np.ndarray  # (NF_cap,) int32; 0 for dummy slots
+    lengths: np.ndarray  # (NF_cap,) int32 true consensus length per family
+    n_real: int
+    n_members: int  # real member rows (before member-axis padding)
+
+
+class _MemberBucket:
+    __slots__ = ("keys", "rows", "qrows", "sizes", "lengths", "members")
+
+    def __init__(self):
+        self.keys, self.rows, self.qrows, self.sizes, self.lengths = [], [], [], [], []
+        self.members = 0
+
+
+def bucket_members(
+    families: Iterable[tuple[object, Sequence[np.ndarray], Sequence[np.ndarray]]],
+    max_batch: int = 1024,
+    member_limit: int = 8192,
+) -> Iterator[MemberBatch]:
+    """Stream ``(key, member_seqs, member_quals)`` into member-stream batches.
+
+    Same rectangularization semantics as :func:`bucket_families` (bit-parity
+    with the dense path is pinned by reusing :func:`rectangularize`), but
+    batches bucket by length only; a bucket flushes when it holds
+    ``max_batch`` families or ``member_limit`` member rows, whichever first
+    (so one giant family still flushes as its own batch).
+    """
+    buckets: dict[int, _MemberBucket] = {}
+    for key, seqs, quals in families:
+        if len(seqs) == 0:
+            raise ValueError(f"empty family for key {key!r}")
+        rect_s, rect_q, true_len = rectangularize(seqs, quals)
+        lb = len_bucket(true_len)
+        bucket = buckets.setdefault(lb, _MemberBucket())
+        bucket.keys.append(key)
+        bucket.rows.append(rect_s)
+        bucket.qrows.append(rect_q)
+        bucket.sizes.append(rect_s.shape[0])
+        bucket.lengths.append(true_len)
+        bucket.members += rect_s.shape[0]
+        if len(bucket.keys) >= max_batch or bucket.members >= member_limit:
+            yield _emit_members(buckets.pop(lb), lb)
+    for lb, bucket in sorted(buckets.items()):
+        yield _emit_members(bucket, lb)
+
+
+def _emit_members(bucket: _MemberBucket, lb: int) -> MemberBatch:
+    # Family-axis cap: pow2 >= n (a member_limit flush can hold far fewer
+    # families than max_batch — padding those to max_batch would make the
+    # gather-dense vote do up to max_batch/n redundant work; the pow2 set
+    # keeps recompiles as bounded as a fixed cap would).
+    n = len(bucket.keys)
+    cap = max(MIN_BATCH, next_pow2(n))
+    m = bucket.members
+    m_pad = max(MEMBER_QUANTUM, -(-m // MEMBER_QUANTUM) * MEMBER_QUANTUM)
+    rows = np.zeros((m_pad, lb), dtype=np.uint8)
+    qrows = np.full((m_pad, lb), QUAL_FILL_SENTINEL, dtype=np.uint8)
+    r = 0
+    for rect_s, rect_q in zip(bucket.rows, bucket.qrows):
+        f, L = rect_s.shape
+        rows[r : r + f, :L] = rect_s
+        qrows[r : r + f, :L] = rect_q
+        r += f
+    sizes = np.zeros(cap, dtype=np.int32)
+    sizes[:n] = bucket.sizes
+    lengths = np.zeros(cap, dtype=np.int32)
+    lengths[:n] = bucket.lengths
+    return MemberBatch(
+        keys=list(bucket.keys), rows=rows, qrows=qrows, sizes=sizes,
+        lengths=lengths, n_real=n, n_members=m,
+    )
+
+
 def _emit(bucket: _Bucket, fb: int, lb: int, pad_to: int | None) -> FamilyBatch:
     n = len(bucket.keys)
     cap = pad_to if pad_to is not None else max(MIN_BATCH, next_pow2(n))

@@ -90,14 +90,23 @@ def run_sscs(
     bdelim: str = tags_mod.DEFAULT_BDELIM,
     max_batch: int = 1024,
     devices: int | None = None,
+    wire: str = "stream",
 ) -> SscsResult:
     """``devices``: shard each family batch across this many chips
     (``parallel.mesh`` family-data-parallel path); None/1 = single device.
-    Only meaningful with ``backend="tpu"``."""
+    Only meaningful with ``backend="tpu"``.
+
+    ``wire``: device wire layout for the tpu backend — ``"stream"`` (packed
+    member stream, the production default: ~8-16x fewer h2d bytes, which
+    dominates stage wall-clock on tunneled devices) or ``"dense"`` (padded
+    ``(B, F, L)`` batches; also what the ``devices>1`` mesh path uses).
+    Both are bit-identical by the parity suite."""
     if backend not in ("cpu", "tpu", "reference"):
         raise ValueError(
             f"unknown backend {backend!r} (expected 'cpu', 'tpu', or 'reference')"
         )
+    if wire not in ("stream", "dense"):
+        raise ValueError(f"unknown wire {wire!r} (expected 'stream' or 'dense')")
     mesh = None
     if devices is not None and devices > 1:
         if backend != "tpu":
@@ -164,7 +173,14 @@ def run_sscs(
     ok = False
     try:
         if backend == "tpu":
-            stream = consensus_families(events(), cfg, max_batch=max_batch, mesh=mesh)
+            if mesh is None and wire == "stream":
+                from consensuscruncher_tpu.ops.consensus_segment import (
+                    consensus_families_stream,
+                )
+
+                stream = consensus_families_stream(events(), cfg, max_batch=max_batch)
+            else:
+                stream = consensus_families(events(), cfg, max_batch=max_batch, mesh=mesh)
             try:
                 for fid, codes, quals in stream:
                     emit(fid, codes, quals)

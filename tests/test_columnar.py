@@ -140,7 +140,6 @@ def test_ragged_gather_empty_and_basic():
 
 
 def test_columnar_truncation_detected(tmp_path):
-    import gzip as _g
     src = str(tmp_path / "t.bam")
     _write_adversarial(src)
     # chop the last BGZF block's payload mid-record

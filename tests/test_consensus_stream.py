@@ -1,0 +1,150 @@
+"""Member-stream wire path ≡ dense path ≡ CPU oracle (SURVEY.md §4.1).
+
+The streaming SSCS production path (``ops.consensus_segment.
+consensus_families_stream``) ships families as a packed flat member stream
+instead of dense padded batches; these tests pin that every wire mode
+(pack4 / pack8 / raw), the gather-dense vote, and the segment fallback all
+reproduce the oracle bit-for-bit, and that the stage emits byte-identical
+BAMs over either wire.
+"""
+
+import numpy as np
+import pytest
+
+from consensuscruncher_tpu.core import consensus_cpu as cc
+from consensuscruncher_tpu.ops.consensus_segment import (
+    MAX_DENSE_CAP,
+    consensus_families_stream,
+    encode_member_batch,
+)
+from consensuscruncher_tpu.ops.consensus_tpu import ConsensusConfig, consensus_families
+from consensuscruncher_tpu.parallel.batching import bucket_members
+
+
+def ragged_family(rng, fam, lengths, base_hi=5, quals_pool=None):
+    seqs, quals = [], []
+    for j in range(fam):
+        L = int(lengths[j % len(lengths)])
+        seqs.append(rng.integers(0, base_hi, L).astype(np.uint8))
+        if quals_pool is None:
+            quals.append(rng.integers(0, 42, L).astype(np.uint8))
+        else:
+            quals.append(rng.choice(quals_pool, L).astype(np.uint8))
+    return seqs, quals
+
+
+def oracle_stream(families, cfg: ConsensusConfig):
+    from consensuscruncher_tpu.parallel.batching import rectangularize
+
+    out = {}
+    for key, seqs, quals in families:
+        rs, rq, _ = rectangularize(seqs, quals)
+        out[key] = cc.consensus_maker(
+            rs, rq, cutoff=cfg.cutoff,
+            qual_threshold=cfg.qual_threshold, qual_cap=cfg.qual_cap,
+        )
+    return out
+
+
+def assert_stream_matches_oracle(fams, cfg, **kw):
+    expected = oracle_stream(fams, cfg)
+    got = {k: (b.copy(), q.copy())
+           for k, b, q in consensus_families_stream(iter(fams), cfg, **kw)}
+    assert set(got) == set(expected)
+    for k in expected:
+        np.testing.assert_array_equal(got[k][0], expected[k][0], err_msg=f"{k} bases")
+        np.testing.assert_array_equal(got[k][1], expected[k][1], err_msg=f"{k} quals")
+
+
+WIRE_CASES = {
+    # wire mode -> (base_hi, quals_pool)
+    "pack4": (4, np.array([2, 12, 23, 37], np.uint8)),
+    "pack8": (5, np.arange(25, 41, dtype=np.uint8)),
+    "raw": (5, None),  # 42 distinct quals -> no codebook fits
+}
+
+
+@pytest.mark.parametrize("wire", sorted(WIRE_CASES))
+@pytest.mark.parametrize("qual_threshold", [0, 13])
+def test_stream_matches_oracle_per_wire(wire, qual_threshold):
+    base_hi, pool = WIRE_CASES[wire]
+    rng = np.random.default_rng(hash((wire, qual_threshold)) % 2**32)
+    fams = []
+    for i in range(60):
+        fam = int(rng.integers(1, 12))
+        fams.append((f"f{i}",) + ragged_family(rng, fam, [33], base_hi, pool))
+    # confirm the generator actually hits the intended wire mode
+    batch = next(bucket_members(iter([f for f in fams]), max_batch=1024))
+    assert encode_member_batch(batch)[0] == wire
+    cfg = ConsensusConfig(cutoff=0.7, qual_threshold=qual_threshold)
+    assert_stream_matches_oracle(fams, cfg)
+
+
+def test_stream_mixed_lengths_and_batch_splits():
+    """Rectangularization (N-pad + qual 0) plus multi-batch flushes: the
+    qual-0 length padding forces pack8/raw even on binned data, and small
+    max_batch/member_limit exercise flush boundaries + ordering."""
+    rng = np.random.default_rng(7)
+    fams = []
+    for i in range(40):
+        fam = int(rng.integers(2, 9))
+        fams.append((i,) + ragged_family(rng, fam, [30, 35, 35], 5, None))
+    cfg = ConsensusConfig()
+    assert_stream_matches_oracle(fams, cfg, max_batch=8, member_limit=48)
+
+
+def test_stream_giant_family_segment_fallback():
+    """A family larger than MAX_DENSE_CAP must route to the segment vote
+    (member_cap=None) and still match the oracle."""
+    rng = np.random.default_rng(11)
+    big = MAX_DENSE_CAP + 5
+    fams = [
+        ("giant",) + ragged_family(rng, big, [40], 4, np.array([20, 30], np.uint8)),
+        ("small",) + ragged_family(rng, 3, [40], 4, np.array([20, 30], np.uint8)),
+    ]
+    batches = list(bucket_members(iter(fams), max_batch=1024))
+    caps = [encode_member_batch(b)[3] for b in batches]
+    assert None in caps  # the giant family's batch fell back to segment
+    assert_stream_matches_oracle(fams, ConsensusConfig())
+
+
+def test_stream_matches_dense_path_exactly():
+    """The two device wires must agree with each other, not just the oracle
+    (guards slicing/ordering drift between the stage's two tpu paths)."""
+    rng = np.random.default_rng(3)
+    fams = []
+    for i in range(50):
+        fam = int(rng.integers(1, 10))
+        fams.append((i,) + ragged_family(rng, fam, [33, 65], 5, None))
+    cfg = ConsensusConfig(cutoff=0.75, qual_threshold=10)
+    dense = {k: (b.copy(), q.copy())
+             for k, b, q in consensus_families(iter(fams), cfg, max_batch=16)}
+    stream = {k: (b.copy(), q.copy())
+              for k, b, q in consensus_families_stream(iter(fams), cfg, max_batch=16)}
+    assert set(dense) == set(stream)
+    for k in dense:
+        np.testing.assert_array_equal(stream[k][0], dense[k][0])
+        np.testing.assert_array_equal(stream[k][1], dense[k][1])
+
+
+def test_stream_empty_input():
+    assert list(consensus_families_stream(iter([]), ConsensusConfig())) == []
+
+
+def test_stage_wire_parity(tmp_path):
+    """run_sscs over wire='stream' and wire='dense' writes byte-identical
+    consensus BAMs on the bundled dataset."""
+    import hashlib
+
+    from consensuscruncher_tpu.stages.sscs_maker import run_sscs
+
+    src = "test/data/sample.bam"
+    outs = {}
+    for wire in ("stream", "dense"):
+        prefix = str(tmp_path / wire)
+        res = run_sscs(src, prefix, backend="tpu", wire=wire)
+        outs[wire] = tuple(
+            hashlib.sha256(open(p, "rb").read()).hexdigest()
+            for p in (res.sscs_bam, res.singleton_bam, res.bad_bam)
+        )
+    assert outs["stream"] == outs["dense"]
