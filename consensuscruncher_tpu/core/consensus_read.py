@@ -49,8 +49,11 @@ def modal_cigar(members: list[BamRead], seq_length: int) -> list[tuple[str, int]
     """Modal cigar among members whose read length matches the consensus
     length (ties → first seen).  Restricting to length-matched members keeps
     the cigar's query span consistent with the consensus seq — a cigar from a
-    shorter/longer member would make a malformed record."""
-    candidates = [m for m in members if len(m.seq) == seq_length]
+    shorter/longer member would make a malformed record.
+
+    ``members`` may be ``io.bam.BamRead`` or the columnar ``MemberView`` —
+    both expose ``seq_len`` / ``cigar_string()`` / ``mapq``."""
+    candidates = [m for m in members if m.seq_len == seq_length]
     if not candidates:  # all members truncated (target longer than every read)
         return [("M", seq_length)]
     counts = Counter(m.cigar_string() for m in candidates)

@@ -102,6 +102,22 @@ class BamRead:
     qual: np.ndarray = field(default_factory=lambda: np.zeros(0, dtype=np.uint8))
     tags: dict[str, tuple[str, object]] = field(default_factory=dict)
 
+    @property
+    def seq_len(self) -> int:
+        """Uniform length accessor shared with the columnar MemberView."""
+        return len(self.seq)
+
+    @property
+    def codes(self) -> np.ndarray:
+        """Pipeline base codes (A=0..N=4) — MemberView-uniform accessor."""
+        from consensuscruncher_tpu.utils.phred import encode_seq
+
+        return encode_seq(self.seq)
+
+    def materialize(self) -> "BamRead":
+        """MemberView-uniform accessor: a BamRead already is materialized."""
+        return self
+
     # -- flag properties (pysam-compatible names where it matters) --
     @property
     def is_paired(self) -> bool:

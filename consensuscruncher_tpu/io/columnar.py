@@ -159,10 +159,17 @@ class ColumnarBatch:
         return NIB2CODE[nib], off
 
     def quals(self):
-        """``(quals, offsets)``; the spec's 0xFF missing marker maps to 0,
-        matching the stages' missing-qual convention."""
+        """``(quals, offsets)``; a read whose FIRST qual byte is the spec's
+        0xFF missing marker decodes as all-zero — exactly ``decode_record``'s
+        whole-read-missing rule (a stray mid-read 0xFF stays 255, so the
+        columnar and object paths can never diverge on malformed input)."""
         data, off = ragged_gather(self.buf, self.qual_start, self.l_seq)
-        return np.where(data == 0xFF, 0, data).astype(np.uint8), off
+        l = self.l_seq.astype(np.int64)
+        nonempty = l > 0
+        first = np.zeros(self.n, dtype=np.uint8)
+        first[nonempty] = self.buf[self.qual_start[nonempty]]
+        missing = np.repeat(nonempty & (first == 0xFF), l)
+        return np.where(missing, 0, data).astype(np.uint8), off
 
     def cigar_string(self, i: int) -> str:
         """Cigar of record ``i`` as text ('*' when empty)."""

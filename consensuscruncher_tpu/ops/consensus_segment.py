@@ -397,25 +397,29 @@ def _compiled_stream_vote(wire: str, num, den, qual_threshold, qual_cap,
         else:  # pack4 — length buckets are multiples of 32, so 2*packed width
             bases, quals = unpack4_device(a, b, 2 * a.shape[-1])
         if member_cap is not None:
-            return _gather_dense_vote(
+            out_b, out_q = _gather_dense_vote(
                 bases, quals, sizes, cap=member_cap, num=num, den=den,
                 qual_threshold=qual_threshold, qual_cap=qual_cap,
             )
-        m = bases.shape[0]
-        if m * max(num, den) >= 2**31:
-            raise ValueError(
-                f"member stream of {m} with cutoff {num}/{den} could overflow "
-                "the int32 cutoff compare — chunk the stream"
+        else:
+            m = bases.shape[0]
+            if m * max(num, den) >= 2**31:
+                raise ValueError(
+                    f"member stream of {m} with cutoff {num}/{den} could overflow "
+                    "the int32 cutoff compare — chunk the stream"
+                )
+            fam_ids, ranks = derive_ids_device(sizes, m)
+            total = sizes.sum()
+            fam_ids = jnp.where(jnp.arange(m, dtype=jnp.int32) < total, fam_ids, nf)
+            sizes_ov = jnp.concatenate([sizes, jnp.zeros(1, jnp.int32)])
+            out_b, out_q = _segment_vote(
+                bases, quals, fam_ids, ranks, sizes_ov, num_families=nf + 1,
+                num=num, den=den, qual_threshold=qual_threshold, qual_cap=qual_cap,
             )
-        fam_ids, ranks = derive_ids_device(sizes, m)
-        total = sizes.sum()
-        fam_ids = jnp.where(jnp.arange(m, dtype=jnp.int32) < total, fam_ids, nf)
-        sizes_ov = jnp.concatenate([sizes, jnp.zeros(1, jnp.int32)])
-        out_b, out_q = _segment_vote(
-            bases, quals, fam_ids, ranks, sizes_ov, num_families=nf + 1,
-            num=num, den=den, qual_threshold=qual_threshold, qual_cap=qual_cap,
-        )
-        return out_b[:nf], out_q[:nf]
+            out_b, out_q = out_b[:nf], out_q[:nf]
+        # One stacked output plane -> one d2h transfer per batch (tunnel
+        # roundtrips, not bytes, are the remaining device-side cost).
+        return jnp.stack([out_b, out_q])
 
     return jax.jit(fn)
 
@@ -459,8 +463,8 @@ def encode_member_batch(batch):
 def consensus_families_stream(
     families,
     config: ConsensusConfig = ConsensusConfig(),
-    max_batch: int = 1024,
-    member_limit: int = 8192,
+    max_batch: int = 4096,
+    member_limit: int = 32768,
     prefetch_depth: int | None = None,
 ):
     """Member-stream twin of ``consensus_tpu.consensus_families``.
@@ -493,7 +497,8 @@ def consensus_families_stream(
 
     def fetch(item, handle):
         batch = item[0]
-        out_b, out_q = (np.asarray(x) for x in handle)
+        out = np.asarray(handle)
+        out_b, out_q = out[0], out[1]
         for i, key in enumerate(batch.keys):
             length = int(batch.lengths[i])
             yield key, out_b[i, :length], out_q[i, :length]

@@ -18,8 +18,21 @@ from __future__ import annotations
 
 from typing import Iterable, Iterator
 
+import numpy as np
+
 from consensuscruncher_tpu.core import tags as tags_mod
-from consensuscruncher_tpu.io.bam import BamHeader, BamRead
+from consensuscruncher_tpu.io.bam import (
+    BamHeader,
+    BamRead,
+    FMUNMAP,
+    FPAIRED,
+    FQCFAIL,
+    FREAD1,
+    FREVERSE,
+    FSECONDARY,
+    FSUPPLEMENTARY,
+    FUNMAP,
+)
 
 
 class NotCoordinateSorted(ValueError):
@@ -121,3 +134,292 @@ def stream_families(
         tag = tags_mod.unique_tag(read, barcode)
         pending.setdefault(tag, []).append(read)
     yield from flush()
+
+
+# ------------------------------------------------------------- columnar path
+#
+# Vectorized twin of stream_families over io.columnar batches (the host-side
+# Amdahl fix, SURVEY.md §7 hard-part #3): per-READ work — decode, bad-read
+# classification, barcode extraction, family-key building, sortedness
+# checking — happens as numpy column operations over whole batches; Python
+# objects exist only per FAMILY (the tag + one lightweight view per member).
+# Event stream, filtering semantics, and emission order are identical to
+# stream_families (same events, same flush-per-coordinate model, families
+# sorted by str(tag) within a coordinate), so stage outputs are byte-equal.
+
+# classify_bad reason codes, in classify_bad's priority order.
+_BAD_REASONS = (None, "unmapped", "mate_unmapped", "secondary",
+                "supplementary", "qcfail", "no_barcode")
+
+
+class MemberView:
+    """Zero-copy member of a columnar family: consensus inputs as views,
+    template/BAM fields materialized lazily from the owning batch."""
+
+    __slots__ = ("codes", "qual", "_batch", "_idx")
+
+    def __init__(self, codes, qual, batch, idx):
+        self.codes = codes
+        self.qual = qual
+        self._batch = batch
+        self._idx = idx
+
+    @property
+    def seq_len(self) -> int:
+        return self.codes.shape[0]
+
+    @property
+    def mapq(self) -> int:
+        return int(self._batch.mapq[self._idx])
+
+    @property
+    def flag(self) -> int:
+        return int(self._batch.flag[self._idx])
+
+    @property
+    def ref(self) -> str:
+        return self._batch.header.ref_name(int(self._batch.ref_id[self._idx]))
+
+    @property
+    def pos(self) -> int:
+        return int(self._batch.pos[self._idx])
+
+    @property
+    def mate_ref(self) -> str:
+        return self._batch.header.ref_name(int(self._batch.mate_ref_id[self._idx]))
+
+    @property
+    def mate_pos(self) -> int:
+        return int(self._batch.mate_pos[self._idx])
+
+    @property
+    def tlen(self) -> int:
+        return int(self._batch.tlen[self._idx])
+
+    def cigar_string(self) -> str:
+        return self._batch.cigar_string(self._idx)
+
+    def materialize(self) -> BamRead:
+        """Full BamRead (singleton renames, bad-read writes)."""
+        return self._batch.materialize(self._idx)
+
+
+class _Seg:
+    """Good-read rows of one coordinate, within one columnar batch."""
+
+    __slots__ = ("batch", "gidx", "bcm", "bclen", "mate_rid", "mate_pos",
+                 "rn", "rev", "codes_data", "codes_off", "qual_data", "qual_off")
+
+    def __init__(self, batch, gidx, bcm, bclen, mate_rid, mate_pos, rn, rev,
+                 codes_data, codes_off, qual_data, qual_off):
+        self.batch = batch
+        self.gidx = gidx
+        self.bcm = bcm
+        self.bclen = bclen
+        self.mate_rid = mate_rid
+        self.mate_pos = mate_pos
+        self.rn = rn
+        self.rev = rev
+        self.codes_data = codes_data
+        self.codes_off = codes_off
+        self.qual_data = qual_data
+        self.qual_off = qual_off
+
+    def __len__(self):
+        return len(self.gidx)
+
+
+def _classify_batch(batch, bdelim_byte: int):
+    """Vectorized classify_bad + barcode locate for one batch.
+
+    Returns ``(reason, last, bclen)`` — reason 0 = good (codes index
+    _BAD_REASONS), ``last`` the delimiter column in the qname matrix.
+    """
+    flag = batch.flag
+    qm = batch.qname_matrix
+    qlen = batch.l_qname - 1  # int64, actual qname length
+    w = qm.shape[1]
+    eq = qm == bdelim_byte
+    has = eq.any(axis=1)
+    last = np.where(has, w - 1 - np.argmax(eq[:, ::-1], axis=1), -1)
+    bclen = np.where(has, qlen - last - 1, 0)
+    reason = np.select(
+        [
+            (flag & FUNMAP) != 0,
+            ((flag & FPAIRED) == 0) | ((flag & FMUNMAP) != 0),
+            (flag & FSECONDARY) != 0,
+            (flag & FSUPPLEMENTARY) != 0,
+            (flag & FQCFAIL) != 0,
+            ~(has & (bclen > 0)),
+        ],
+        [1, 2, 3, 4, 5, 6],
+        default=0,
+    ).astype(np.int8)
+    return reason, last, bclen
+
+
+def _good_segments(batch, reason, last, bclen):
+    """Split a batch's good rows into per-coordinate _Seg runs (in stream
+    order) and validate coordinate sortedness among them."""
+    good = np.nonzero(reason == 0)[0]
+    if good.size == 0:
+        return [], None
+    rid = batch.ref_id[good]
+    pos = batch.pos[good]
+    ok = (rid[1:] > rid[:-1]) | ((rid[1:] == rid[:-1]) & (pos[1:] >= pos[:-1]))
+    if not ok.all():
+        i = int(np.argmin(ok)) + 1
+        read = batch.materialize(int(good[i]))
+        raise NotCoordinateSorted(
+            f"input BAM is not coordinate-sorted: {read.qname} at "
+            f"{read.ref}:{read.pos} after ref_id={int(rid[i - 1])} "
+            f"pos={int(pos[i - 1])} — run sort first"
+        )
+    # coordinate run boundaries among good rows
+    change = np.nonzero((rid[1:] != rid[:-1]) | (pos[1:] != pos[:-1]))[0] + 1
+    bounds = np.concatenate([[0], change, [good.size]])
+
+    qm = batch.qname_matrix
+    w = qm.shape[1]
+    wb = int(bclen[good].max(initial=0))
+    cols = np.arange(wb, dtype=np.int64)
+    src = last[good][:, None] + 1 + cols[None, :]
+    valid = cols[None, :] < bclen[good][:, None]
+    bcm = np.where(valid, qm[good[:, None], np.minimum(src, w - 1)], 0).astype(np.uint8)
+
+    codes_data, codes_off = batch.seq_codes()
+    qual_data, qual_off = batch.quals()
+    rn = np.where((batch.flag[good] & FREAD1) != 0, 1, 2).astype(np.int8)
+    rev = ((batch.flag[good] & FREVERSE) != 0).astype(np.int8)
+    segs = []
+    for a, b in zip(bounds[:-1], bounds[1:]):
+        g = good[a:b]
+        segs.append(_Seg(
+            batch, g, bcm[a:b], bclen[g],
+            batch.mate_ref_id[g], batch.mate_pos[g], rn[a:b], rev[a:b],
+            codes_data, codes_off, qual_data, qual_off,
+        ))
+    return segs, (int(rid[-1]), int(pos[-1]))
+
+
+def _emit_group(segs: list[_Seg], header: BamHeader):
+    """All families of one coordinate (possibly spanning batches): lexsort
+    by key columns (stable -> members keep stream order), split runs, build
+    one FamilyTag per family, emit sorted by str(tag) — exactly the object
+    path's ``sorted(pending, key=(pos, str(tag)))`` within-coordinate order."""
+    if len(segs) == 1:
+        s = segs[0]
+        bcm, bclen = s.bcm, s.bclen
+        mate_rid, mate_pos = s.mate_rid, s.mate_pos
+        rn, rev = s.rn, s.rev
+    else:
+        wb = max(s.bcm.shape[1] for s in segs)
+        bcm = np.zeros((sum(len(s) for s in segs), wb), dtype=np.uint8)
+        row = 0
+        for s in segs:
+            bcm[row : row + len(s), : s.bcm.shape[1]] = s.bcm
+            row += len(s)
+        bclen = np.concatenate([s.bclen for s in segs])
+        mate_rid = np.concatenate([s.mate_rid for s in segs])
+        mate_pos = np.concatenate([s.mate_pos for s in segs])
+        rn = np.concatenate([s.rn for s in segs])
+        rev = np.concatenate([s.rev for s in segs])
+
+    n = bcm.shape[0]
+    # lexsort: last key is primary; barcode bytes most-significant overall
+    keys = [rev, rn, mate_pos, mate_rid]
+    keys += [bcm[:, j] for j in range(bcm.shape[1] - 1, -1, -1)]
+    order = np.lexsort(keys)
+
+    kb = bcm[order]
+    same = np.ones(n, dtype=bool)
+    if n > 1:
+        same[1:] = (
+            (kb[1:] == kb[:-1]).all(axis=1)
+            & (mate_rid[order][1:] == mate_rid[order][:-1])
+            & (mate_pos[order][1:] == mate_pos[order][:-1])
+            & (rn[order][1:] == rn[order][:-1])
+            & (rev[order][1:] == rev[order][:-1])
+        )
+    starts = np.nonzero(~same)[0]
+    bounds = np.concatenate([[0], starts, [n]])
+
+    # map flat group-local row -> (segment, local row)
+    seg_of = np.repeat(np.arange(len(segs)), [len(s) for s in segs])
+    loc = np.concatenate([np.arange(len(s)) for s in segs])
+
+    s0 = segs[0]
+    anchor_ref = header.ref_name(int(s0.batch.ref_id[s0.gidx[0]]))
+    anchor_pos = int(s0.batch.pos[s0.gidx[0]])
+
+    families = []
+    for a, b in zip(bounds[:-1], bounds[1:]):
+        rows = order[a:b]
+        first = rows[0]
+        barcode = bcm[first, : bclen[first]].tobytes().decode("ascii")
+        tag = tags_mod.FamilyTag(
+            barcode=barcode,
+            ref=anchor_ref,
+            pos=anchor_pos,
+            mate_ref=header.ref_name(int(mate_rid[first])),
+            mate_pos=int(mate_pos[first]),
+            read_number=int(rn[first]),
+            orientation="rev" if rev[first] else "fwd",
+        )
+        members = []
+        for r in rows:
+            s = segs[seg_of[r]]
+            i = int(s.gidx[loc[r]])
+            codes = s.codes_data[s.codes_off[i] : s.codes_off[i + 1]]
+            qual = s.qual_data[s.qual_off[i] : s.qual_off[i + 1]]
+            members.append(MemberView(codes, qual, s.batch, i))
+        families.append((str(tag), tag, members))
+    families.sort(key=lambda t: t[0])
+    for _, tag, members in families:
+        yield "family", tag, members
+
+
+def stream_families_columnar(
+    creader,
+    header: BamHeader,
+    bdelim: str = tags_mod.DEFAULT_BDELIM,
+) -> Iterator[tuple[str, object, object]]:
+    """Columnar twin of :func:`stream_families` over a
+    ``io.columnar.ColumnarReader`` — same events, same order guarantees."""
+    bdelim_byte = ord(bdelim)
+    carry: list[_Seg] = []
+    carry_key: tuple[int, int] | None = None
+    for batch in creader.batches():
+        reason, last, bclen = _classify_batch(batch, bdelim_byte)
+        bad = np.nonzero(reason != 0)[0]
+        for i in bad:
+            yield "bad", batch.materialize(int(i)), _BAD_REASONS[int(reason[i])]
+        segs, _tail = _good_segments(batch, reason, last, bclen)
+        if not segs:
+            continue
+        s0 = segs[0]
+        first_key = (int(s0.batch.ref_id[s0.gidx[0]]), int(s0.batch.pos[s0.gidx[0]]))
+        if carry and carry_key is not None:
+            if first_key < carry_key:
+                read = s0.batch.materialize(int(s0.gidx[0]))
+                raise NotCoordinateSorted(
+                    f"input BAM is not coordinate-sorted: {read.qname} at "
+                    f"{read.ref}:{read.pos} after ref_id={carry_key[0]} "
+                    f"pos={carry_key[1]} — run sort first"
+                )
+            if first_key == carry_key:
+                carry.append(segs.pop(0))
+            if segs:  # a later coordinate arrived: the carry is complete
+                yield from _emit_group(carry, header)
+                carry = []
+        for seg in segs[:-1]:
+            yield from _emit_group([seg], header)
+        if segs:
+            tail = segs[-1]
+            carry.append(tail)
+            carry_key = (
+                int(tail.batch.ref_id[tail.gidx[0]]),
+                int(tail.batch.pos[tail.gidx[0]]),
+            )
+    if carry:
+        yield from _emit_group(carry, header)

@@ -153,3 +153,34 @@ def test_columnar_truncation_detected(tmp_path):
     with pytest.raises(ValueError, match="truncated"):
         for _ in reader.batches():
             pass
+
+
+def test_columnar_stray_mid_read_0xff_qual_matches_object_reader(tmp_path):
+    """Only a LEADING 0xFF marks a whole read's quals missing (decode_record
+    rule); a stray mid-read 0xFF must stay 255 in both readers — the
+    cpu/tpu consensus backends read quals through the columnar path while
+    the reference backend reads objects, so any divergence here breaks the
+    bit-identical-backends contract."""
+    from consensuscruncher_tpu.io.bam import BamHeader, BamRead, BamWriter
+
+    path = str(tmp_path / "ff.bam")
+    header = BamHeader.from_refs([("chr1", 10_000)])
+    q_stray = np.full(8, 30, np.uint8)
+    q_stray[3] = 0xFF  # out-of-spec but parseable
+    q_missing = np.full(8, 0xFF, np.uint8)  # spec whole-read-missing marker
+    with BamWriter(path, header) as w:
+        w.write(BamRead(qname="a|AC.GT", flag=0x43, ref="chr1", pos=100,
+                        cigar=[("M", 8)], mate_ref="chr1", mate_pos=200,
+                        seq="ACGTACGT", qual=q_stray))
+        w.write(BamRead(qname="b|AC.GT", flag=0x43, ref="chr1", pos=150,
+                        cigar=[("M", 8)], mate_ref="chr1", mate_pos=250,
+                        seq="ACGTACGT", qual=q_missing))
+    with BamReader(path) as r:
+        objects = list(r)
+    (batch,) = ColumnarReader(path).batches()
+    quals, off = batch.quals()
+    for j, o in enumerate(objects):
+        exp = o.qual if o.qual.size else np.zeros(len(o.seq), np.uint8)
+        np.testing.assert_array_equal(quals[off[j]:off[j + 1]], exp)
+    assert quals[off[0] + 3] == 0xFF  # the stray byte survived
+    assert (quals[off[1]:off[2]] == 0).all()  # the missing read zeroed
