@@ -42,7 +42,11 @@ from consensuscruncher_tpu.io.bam import BamWriter, merge_bams, sort_bam
 from consensuscruncher_tpu.stages.extract_barcodes import run_extract
 from consensuscruncher_tpu.stages import dcs_maker, singleton_correction, sscs_maker
 from consensuscruncher_tpu.stages.dcs_maker import DcsResult, run_dcs
-from consensuscruncher_tpu.stages.generate_plots import plot_family_size, plot_read_recovery
+from consensuscruncher_tpu.stages.generate_plots import (
+    plot_family_size,
+    plot_read_recovery,
+    plot_stage_times,
+)
 from consensuscruncher_tpu.stages.singleton_correction import SingletonResult, run_singleton_correction
 from consensuscruncher_tpu.stages.sscs_maker import SscsResult, run_sscs
 from consensuscruncher_tpu.utils.manifest import RunManifest
@@ -319,6 +323,10 @@ def _consensus_impl(args) -> dict:
         os.path.join(dirs["plots"], f"{name}.family_size.png"),
     )
     plot_read_recovery(stats_jsons, os.path.join(dirs["plots"], f"{name}.read_recovery.png"))
+    plot_stage_times(
+        [os.path.join(dirs["sscs"], f"{name}.metrics.json")],
+        os.path.join(dirs["plots"], f"{name}.stage_times.png"),
+    )
 
     if args.cleanup:
         # Intermediates only (SURVEY.md §5): badReads, and the rescued-merge

@@ -31,9 +31,8 @@ import numpy as np
 from consensuscruncher_tpu.core import tags as tags_mod
 from consensuscruncher_tpu.core.consensus_read import build_consensus_read
 from consensuscruncher_tpu.core.duplex_cpu import duplex_consensus
-from consensuscruncher_tpu.io.bam import BamReader, BamRead, BamWriter, sort_bam
+from consensuscruncher_tpu.io.bam import BamWriter, sort_bam
 from consensuscruncher_tpu.ops.duplex_tpu import duplex_batch_host
-from consensuscruncher_tpu.utils.phred import encode_seq
 from consensuscruncher_tpu.utils.stats import StageStats
 
 
@@ -62,7 +61,44 @@ def output_paths(out_prefix: str) -> dict[str, str]:
 
 
 # Shared with singleton_correction (re-exported for stage symmetry).
-from consensuscruncher_tpu.stages.grouping import consensus_windows, derive_tag  # noqa: E402,F401
+from consensuscruncher_tpu.stages.grouping import (  # noqa: E402,F401
+    consensus_windows,
+    consensus_windows_columnar,
+    derive_tag,
+    fam_size_of,
+)
+
+
+class _PinnedMember:
+    """Self-contained snapshot of a ConsensusReadView for deferred batching.
+
+    Views hold a reference to their whole source ColumnarBatch (tens of MB);
+    buffering them until a length bucket fills would pin every touched batch
+    in memory.  This copies exactly what the duplex sink needs (~2L bytes +
+    a few scalars) so the batch can be released."""
+
+    __slots__ = ("codes", "qual", "flag", "ref", "pos", "mate_ref",
+                 "mate_pos", "tlen", "mapq", "xf", "_cigar")
+
+    def __init__(self, view):
+        self.codes = np.array(view.codes)
+        self.qual = np.array(view.qual)
+        self.flag = view.flag
+        self.ref = view.ref
+        self.pos = view.pos
+        self.mate_ref = view.mate_ref
+        self.mate_pos = view.mate_pos
+        self.tlen = view.tlen
+        self.mapq = view.mapq
+        self.xf = fam_size_of(view)
+        self._cigar = view.cigar_string()
+
+    @property
+    def seq_len(self) -> int:
+        return self.codes.shape[0]
+
+    def cigar_string(self) -> str:
+        return self._cigar
 
 
 class _DuplexBatcher:
@@ -76,7 +112,11 @@ class _DuplexBatcher:
         self._by_len: dict[int, list] = {}
 
     def add(self, canon_tag, canon_read, other_read, sink) -> None:
-        L = len(canon_read.seq)
+        if hasattr(canon_read, "_batch"):  # columnar view: snapshot to unpin
+            canon_read = _PinnedMember(canon_read)
+        if hasattr(other_read, "_batch"):
+            other_read = _PinnedMember(other_read)
+        L = canon_read.seq_len
         self._by_len.setdefault(L, []).append((canon_tag, canon_read, other_read, sink))
         if len(self._by_len[L]) >= self.flush_at:
             self._flush_len(L)
@@ -85,8 +125,8 @@ class _DuplexBatcher:
         entries = self._by_len.pop(L, [])
         if not entries:
             return
-        s1 = np.stack([encode_seq(e[1].seq) for e in entries])
-        s2 = np.stack([encode_seq(e[2].seq) for e in entries])
+        s1 = np.stack([e[1].codes for e in entries])  # BamRead or columnar view
+        s2 = np.stack([e[2].codes for e in entries])
         q1 = np.stack([e[1].qual for e in entries])
         q2 = np.stack([e[2].qual for e in entries])
         if self.backend == "tpu":
@@ -116,12 +156,14 @@ def run_dcs(
     dcs_tmp = f"{out_prefix}.dcs.unsorted.bam"
     unpaired_tmp = f"{out_prefix}.sscs.singleton.unsorted.bam"
 
-    reader = BamReader(sscs_bam)
+    from consensuscruncher_tpu.io.columnar import ColumnarReader
+
+    reader = ColumnarReader(sscs_bam)
     dcs_writer = BamWriter(dcs_tmp, reader.header)
     unpaired_writer = BamWriter(unpaired_tmp, reader.header)
 
     def sink(tag, canon, other, codes, quals):
-        fam_size = canon.tags.get("XF", ("i", 1))[1] + other.tags.get("XF", ("i", 1))[1]
+        fam_size = fam_size_of(canon) + fam_size_of(other)
         read = build_consensus_read(
             tag, [canon], codes, quals, qname=tags_mod.dcs_qname(tag),
             extra_tags={"XT": ("Z", tag.barcode), "XF": ("i", fam_size)},
@@ -131,7 +173,7 @@ def run_dcs(
 
     batcher = _DuplexBatcher(qual_cap, backend=backend)
     try:
-        for _key, window in consensus_windows(reader):
+        for _key, window in consensus_windows_columnar(reader):
             paired: set = set()
             for tag in sorted(window, key=str):
                 if tag in paired:
@@ -141,17 +183,17 @@ def run_dcs(
                 other = window.get(partner)
                 if other is None or partner in paired:
                     stats.incr("sscs_unpaired")
-                    unpaired_writer.write(window[tag])
+                    unpaired_writer.write(window[tag].materialize())
                     continue
                 stats.incr("sscs_total")  # partner consumed here
                 paired.add(tag)
                 paired.add(partner)
                 read, oread = window[tag], other
-                if len(read.seq) != len(oread.seq):
+                if read.seq_len != oread.seq_len:
                     stats.incr("sscs_unpaired", 2)
                     stats.incr("length_mismatch_pairs")
-                    unpaired_writer.write(read)
-                    unpaired_writer.write(oread)
+                    unpaired_writer.write(read.materialize())
+                    unpaired_writer.write(oread.materialize())
                     continue
                 # canonical strand: barcode lexicographically <= its mirror
                 if tag.barcode <= partner.barcode:

@@ -1,9 +1,22 @@
 """Plot generation from stage stats files.
 
 Reference parity: ``ConsensusCruncher/generate_plots.py`` (SURVEY.md §2) —
-matplotlib PNGs of the family-size distribution and read-recovery summary,
-read back from the stats files on disk (not from memory, so plots can be
-regenerated standalone, exactly like the reference).
+matplotlib PNGs read back from the stats files on disk (not from memory, so
+plots can be regenerated standalone, exactly like the reference).  The
+reference's exact plot set is unverifiable against the empty mount; this
+module pins a superset of what its stats files can express:
+
+- ``family_size.png``   families per size AND reads per size (two panels —
+  the read-weighted view is what shows where the sequencing depth went),
+  plus the cumulative read fraction by family size.
+- ``read_recovery.png`` pipeline-ordered read-accounting funnel across all
+  stage stats files.
+- ``stage_times.png``   per-stage wall-clock from ``*.metrics.json``
+  (framework-native observability; no reference counterpart).
+
+Design notes: every chart encodes one magnitude, so each uses a single hue
+(no categorical cycling); values are direct-labeled where the bar count is
+small; log scales are labeled explicitly.
 """
 
 from __future__ import annotations
@@ -18,36 +31,126 @@ import matplotlib.pyplot as plt  # noqa: E402
 
 from consensuscruncher_tpu.utils.stats import FamilySizeHistogram  # noqa: E402
 
+# Single sequential hue (all plots encode magnitude) + neutral accents.
+_BAR = "#4477aa"
+_ACCENT = "#b0b7c3"
+
 
 def plot_family_size(read_families_txt: str, out_png: str) -> None:
     counts = FamilySizeHistogram.read(read_families_txt)
     sizes = sorted(counts)
-    fig, ax = plt.subplots(figsize=(7, 4.5))
-    ax.bar(sizes, [counts[s] for s in sizes], color="#4477aa")
-    ax.set_xlabel("UMI family size")
-    ax.set_ylabel("families")
-    ax.set_yscale("log")
-    ax.set_title("UMI family-size distribution")
+    fams = [counts[s] for s in sizes]
+    reads = [s * counts[s] for s in sizes]
+    total_reads = sum(reads) or 1
+
+    fig, (ax1, ax2, ax3) = plt.subplots(
+        3, 1, figsize=(7.5, 8.5), sharex=True,
+        gridspec_kw={"height_ratios": [3, 3, 2]},
+    )
+    ax1.bar(sizes, fams, color=_BAR)
+    ax1.set_ylabel("families (log)")
+    ax1.set_yscale("log")
+    ax1.set_title("UMI family-size distribution")
+
+    ax2.bar(sizes, reads, color=_BAR)
+    ax2.set_ylabel("reads (log)")
+    ax2.set_yscale("log")
+
+    cum = []
+    acc = 0
+    for r in reads:
+        acc += r
+        cum.append(acc / total_reads)
+    ax3.plot(sizes, cum, color=_BAR, linewidth=2)
+    ax3.set_ylim(0, 1.02)
+    ax3.set_ylabel("cum. read fraction")
+    ax3.set_xlabel("UMI family size")
+    ax3.grid(True, alpha=0.3)
+
+    for ax in (ax1, ax2, ax3):
+        ax.spines[["top", "right"]].set_visible(False)
     fig.tight_layout()
     fig.savefig(out_png, dpi=120)
     plt.close(fig)
 
 
+# Pipeline-ordered read-accounting keys (stage, key, human label).
+_RECOVERY_KEYS = (
+    ("SSCS", "total_reads", "input reads"),
+    ("SSCS", "bad_reads", "bad reads"),
+    ("SSCS", "families", "UMI families"),
+    ("SSCS", "sscs_written", "SSCS consensus"),
+    ("SSCS", "singletons", "singletons"),
+    ("singleton_correction", "rescued_by_sscs", "rescued by SSCS"),
+    ("singleton_correction", "rescued_by_singleton", "rescued by singleton"),
+    ("singleton_correction", "remaining", "unrescued singletons"),
+    ("DCS", "pairs", "duplex pairs"),
+    ("DCS", "dcs_written", "DCS consensus"),
+    ("DCS", "sscs_unpaired", "unpaired SSCS"),
+)
+
+
 def plot_read_recovery(stats_jsons: list[str], out_png: str) -> None:
-    labels, values = [], []
+    by_stage: dict[str, dict] = {}
     for path in stats_jsons:
         with open(path) as fh:
             data = json.load(fh)
-        stage = data.pop("stage", os.path.basename(path))
-        for key in ("sscs_written", "singletons", "dcs_written", "rescued_by_sscs",
-                    "rescued_by_singleton", "remaining", "bad_reads"):
-            if key in data:
-                labels.append(f"{stage}:{key}")
-                values.append(data[key])
-    fig, ax = plt.subplots(figsize=(8, 4.5))
-    ax.barh(labels, values, color="#66ccee")
-    ax.set_xlabel("reads")
+        by_stage[data.get("stage", os.path.basename(path))] = data
+
+    labels, values = [], []
+    for stage, key, label in _RECOVERY_KEYS:
+        data = by_stage.get(stage)
+        if data is not None and key in data:
+            labels.append(label)
+            values.append(data[key])
+    if not labels:  # nothing recognizable: fall back to every numeric key
+        for stage, data in by_stage.items():
+            for key, val in data.items():
+                if isinstance(val, (int, float)) and key != "stage":
+                    labels.append(f"{stage}:{key}")
+                    values.append(val)
+
+    fig, ax = plt.subplots(figsize=(8, 0.45 * len(labels) + 1.8))
+    y = range(len(labels))[::-1]  # pipeline order top-to-bottom
+    ax.barh(list(y), values, color=_BAR)
+    ax.set_yticks(list(y), labels)
+    vmax = max(values) if values else 1
+    for yi, v in zip(y, values):
+        ax.text(v + vmax * 0.01, yi, f"{v:,}", va="center", fontsize=8)
+    ax.set_xlim(0, vmax * 1.12)
+    ax.set_xlabel("count")
     ax.set_title("read recovery by stage")
+    ax.spines[["top", "right"]].set_visible(False)
+    fig.tight_layout()
+    fig.savefig(out_png, dpi=120)
+    plt.close(fig)
+
+
+def plot_stage_times(metrics_jsons: list[str], out_png: str) -> None:
+    """Per-stage wall-clock breakdown from ``*.metrics.json`` files."""
+    labels, values = [], []
+    for path in metrics_jsons:
+        if not os.path.exists(path):
+            continue
+        with open(path) as fh:
+            data = json.load(fh)
+        stage = data.get("stage", os.path.basename(path))
+        for name, seconds in data.get("phases_s", {}).items():
+            labels.append(f"{stage}: {name}")
+            values.append(seconds)
+    if not labels:
+        return
+    fig, ax = plt.subplots(figsize=(8, 0.45 * len(labels) + 1.8))
+    y = range(len(labels))[::-1]
+    ax.barh(list(y), values, color=_BAR)
+    ax.set_yticks(list(y), labels)
+    vmax = max(values)
+    for yi, v in zip(y, values):
+        ax.text(v + vmax * 0.01, yi, f"{v:.2f}s", va="center", fontsize=8)
+    ax.set_xlim(0, vmax * 1.14)
+    ax.set_xlabel("wall-clock seconds")
+    ax.set_title("stage timing")
+    ax.spines[["top", "right"]].set_visible(False)
     fig.tight_layout()
     fig.savefig(out_png, dpi=120)
     plt.close(fig)
@@ -59,6 +162,7 @@ def main(argv=None):
     p = argparse.ArgumentParser(description="Generate stats plots")
     p.add_argument("--families", help="read_families.txt path")
     p.add_argument("--stats", nargs="*", default=[], help="stage *_stats.json paths")
+    p.add_argument("--metrics", nargs="*", default=[], help="stage *.metrics.json paths")
     p.add_argument("--outdir", required=True)
     args = p.parse_args(argv)
     os.makedirs(args.outdir, exist_ok=True)
@@ -66,6 +170,8 @@ def main(argv=None):
         plot_family_size(args.families, os.path.join(args.outdir, "family_size.png"))
     if args.stats:
         plot_read_recovery(args.stats, os.path.join(args.outdir, "read_recovery.png"))
+    if args.metrics:
+        plot_stage_times(args.metrics, os.path.join(args.outdir, "stage_times.png"))
 
 
 if __name__ == "__main__":

@@ -423,3 +423,133 @@ def stream_families_columnar(
             )
     if carry:
         yield from _emit_group(carry, header)
+
+
+# -------------------------------------------------- columnar consensus path
+#
+# Columnar twin of consensus_windows for the DCS stage: SSCS/consensus BAMs
+# are read as columnar batches; the XT (family barcode) and XF (family
+# size) tags the SSCS stage writes FIRST in every record's tag block are
+# parsed vectorized from a fixed byte window, with a per-read object
+# fallback for records whose tag block doesn't lead with XT (foreign BAMs).
+
+_XT_WINDOW = 96  # tag-block prefix bytes scanned vectorized (barcode + XF)
+
+
+class ConsensusReadView(MemberView):
+    """A consensus read in a columnar batch: MemberView + parsed XT/XF."""
+
+    __slots__ = ("xt", "xf")
+
+    def __init__(self, codes, qual, batch, idx, xt: str, xf: int):
+        super().__init__(codes, qual, batch, idx)
+        self.xt = xt
+        self.xf = xf
+
+    @property
+    def fam_size(self) -> int:
+        return self.xf
+
+
+def fam_size_of(read) -> int:
+    """XF family size of a consensus read (BamRead or ConsensusReadView)."""
+    xf = getattr(read, "xf", None)
+    if xf is not None:
+        return xf
+    return read.tags.get("XF", ("i", 1))[1]
+
+
+def _parse_xt_xf(batch):
+    """Vectorized XT:Z + XF:i parse from each record's tag-block prefix.
+
+    Returns ``(ok, bc_start, bc_len, xf)`` — rows with ``ok=False`` need the
+    object fallback.  Offsets are into ``batch.buf`` so barcode bytes can be
+    sliced per read without another gather.
+    """
+    ts = batch.tags_start
+    te = batch.rec_off[1:]
+    n = batch.n
+    span = te - ts
+    w = int(min(_XT_WINDOW, span.max(initial=0)))
+    if w < 8:
+        return np.zeros(n, bool), ts, np.zeros(n, np.int64), np.ones(n, np.int64)
+    cols = np.arange(w, dtype=np.int64)
+    idx = ts[:, None] + cols[None, :]
+    win = np.where(idx < te[:, None], batch.buf[np.minimum(idx, len(batch.buf) - 1)], 0)
+    ok = (win[:, 0] == ord("X")) & (win[:, 1] == ord("T")) & (win[:, 2] == ord("Z"))
+    z = win[:, 3:] == 0
+    has_nul = z.any(axis=1)
+    zpos = np.argmax(z, axis=1).astype(np.int64)  # first NUL at/after byte 3
+    ok &= has_nul
+    # XF:i must follow the barcode NUL and fit inside the scanned window.
+    xf_off = 3 + zpos + 1
+    fits = xf_off + 7 <= w
+    ok &= fits
+    safe = np.where(ok, xf_off, 0)
+    tag_ok = (
+        (np.take_along_axis(win, safe[:, None], 1)[:, 0] == ord("X"))
+        & (np.take_along_axis(win, (safe + 1)[:, None], 1)[:, 0] == ord("F"))
+        & (np.take_along_axis(win, (safe + 2)[:, None], 1)[:, 0] == ord("i"))
+    )
+    ok &= tag_ok
+    b = [np.take_along_axis(win, (safe + 3 + k)[:, None], 1)[:, 0].astype(np.int64)
+         for k in range(4)]
+    xf_raw = b[0] | (b[1] << 8) | (b[2] << 16) | (b[3] << 24)
+    xf = np.where(xf_raw >= 1 << 31, xf_raw - (1 << 32), xf_raw)  # int32 LE
+    return ok, ts + 3, zpos, xf
+
+
+def consensus_windows_columnar(creader):
+    """Columnar twin of :func:`consensus_windows` over a ColumnarReader.
+
+    Yields ``(key, {FamilyTag: ConsensusReadView-or-BamRead})`` with the same
+    semantics (last read wins a duplicate tag, NotCoordinateSorted on order
+    violations, one window per distinct (ref_id, pos)).
+    """
+    header = creader.header
+    window: dict = {}
+    cur = None
+    for batch in creader.batches():
+        ok, bc_start, bc_len, xf = _parse_xt_xf(batch)
+        codes_data, codes_off = batch.seq_codes()
+        qual_data, qual_off = batch.quals()
+        rid_col, pos_col = batch.ref_id, batch.pos
+        flag_col = batch.flag
+        buf = batch.buf
+        for i in range(batch.n):
+            if ok[i]:
+                codes = codes_data[codes_off[i] : codes_off[i + 1]]
+                qual = qual_data[qual_off[i] : qual_off[i + 1]]
+                xt = buf[bc_start[i] : bc_start[i] + bc_len[i]].tobytes().decode("ascii")
+                read = ConsensusReadView(codes, qual, batch, i, xt, int(xf[i]))
+            else:  # foreign tag layout: full object decode
+                read = batch.materialize(i)
+                if "XT" not in read.tags:
+                    raise ValueError(
+                        f"consensus read {read.qname} lacks the XT barcode tag"
+                    )
+                xt = read.tags["XT"][1]
+            rid = int(rid_col[i])
+            tag = tags_mod.FamilyTag(
+                barcode=xt,
+                ref=header.ref_name(rid),
+                pos=int(pos_col[i]),
+                mate_ref=header.ref_name(int(batch.mate_ref_id[i])),
+                mate_pos=int(batch.mate_pos[i]),
+                read_number=1 if (int(flag_col[i]) & FREAD1) else 2,
+                orientation="rev" if (int(flag_col[i]) & FREVERSE) else "fwd",
+            )
+            key = (rid, int(pos_col[i]))
+            if cur is not None and key < cur:
+                qname = batch.materialize(i).qname
+                raise NotCoordinateSorted(
+                    f"consensus BAM is not coordinate-sorted: {qname} at "
+                    f"{tag.ref}:{tag.pos} after ref_id={cur[0]} pos={cur[1]}"
+                )
+            if cur is not None and key != cur:
+                yield cur, window
+                window = {}
+            cur = key
+            window[tag] = read
+    if window:
+        yield cur, window

@@ -100,3 +100,21 @@ def test_plots_generated(tmp_path):
     ])
     assert (tmp_path / "plots" / "family_size.png").stat().st_size > 1000
     assert (tmp_path / "plots" / "read_recovery.png").stat().st_size > 1000
+
+
+def test_stage_times_plot(tmp_path):
+    import json
+
+    from consensuscruncher_tpu.stages import generate_plots
+
+    m = tmp_path / "x.metrics.json"
+    m.write_text(json.dumps({
+        "stage": "SSCS",
+        "phases_s": {"consensus": 3.2, "sort": 0.7},
+        "n_reads": 100,
+    }))
+    generate_plots.main([
+        "--metrics", str(m), str(tmp_path / "missing.metrics.json"),
+        "--outdir", str(tmp_path / "plots"),
+    ])
+    assert (tmp_path / "plots" / "stage_times.png").stat().st_size > 1000
