@@ -431,6 +431,11 @@ class BamWriter:
     def write(self, read: BamRead) -> None:
         self._bgzf.write(encode_record(read, self.header))
 
+    def write_encoded(self, blob) -> None:
+        """Append pre-encoded, length-prefixed record bytes (the vectorized
+        ``io.encode.encode_records`` output) verbatim."""
+        self._bgzf.write(blob.tobytes() if isinstance(blob, np.ndarray) else blob)
+
     def close(self) -> None:
         self._bgzf.close()
         if self._final_path is not None:

@@ -50,6 +50,10 @@ from consensuscruncher_tpu.utils.phred import N as CODE_N, encode_seq
 # nibble (0-15, spec '=ACMGRSVTWYHKDBN') -> pipeline base code (A=0..N=4);
 # every ambiguity code collapses to N exactly like decode->encode_seq does.
 NIB2CODE = encode_seq(SEQ_NIBBLES)
+# byte -> its two nibbles' codes (high nibble first), for paired expansion
+NIB2CODE_PAIR = np.stack(
+    [NIB2CODE[np.arange(256) >> 4], NIB2CODE[np.arange(256) & 0xF]], axis=1
+).astype(np.uint8)
 
 
 def _gather_view(buf: np.ndarray, off: np.ndarray, width: int, dtype: str) -> np.ndarray:
@@ -152,6 +156,14 @@ class ColumnarBatch:
         total = int(off[-1])
         if total == 0:
             return np.empty(0, dtype=np.uint8), off
+        # Fast path (reads overwhelmingly have even lengths): gather each
+        # record's seq BYTES once and expand byte -> two codes via a (256, 2)
+        # LUT — half the index math of per-nibble gathering.  A pad nibble
+        # from an odd-length read would misalign everything after it, so any
+        # odd length falls back to the per-nibble form.
+        if not (l & 1).any():
+            data, _ = ragged_gather(self.buf, self.seq_start, l >> 1)
+            return NIB2CODE_PAIR[data].reshape(-1), off
         rel = np.arange(total, dtype=np.int64) - np.repeat(off[:-1], l)
         byte_idx = np.repeat(self.seq_start, l) + rel // 2
         b = self.buf[byte_idx]

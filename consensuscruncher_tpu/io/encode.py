@@ -1,0 +1,237 @@
+"""Vectorized BAM record encoding: struct-of-arrays -> one byte blob.
+
+The per-record ``encode_record`` path (struct pack + nibble pack + tag
+encode per read) costs ~12 us/record of pure Python — the dominant term of
+consensus OUTPUT writing once everything upstream is vectorized.  This
+module encodes a whole batch of records with ~a dozen numpy passes:
+fixed-width core fields scatter as one (n, 40) block; every ragged section
+(qname, cigar, seq nibbles, qual, tags) scatters with cumulative-offset
+index math.  Byte-parity with ``io.bam.encode_record`` is pinned by
+tests/test_encode.py (same core struct, same reg2bin, same nibble packing,
+same missing-qual convention).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from consensuscruncher_tpu.io.bam import SEQ_NIBBLES
+
+# pipeline base code (A=0 C=1 G=2 T=3 N=4) -> BAM seq nibble
+_NIB_OF_CHAR = {c: i for i, c in enumerate(SEQ_NIBBLES)}
+CODE2NIB = np.array([_NIB_OF_CHAR[c] for c in "ACGTN"], dtype=np.uint8)
+
+# cigar ops consuming reference (MDN=X) by op code index in "MIDNSHP=X"
+_REF_CONSUMING = np.array([1, 0, 1, 1, 0, 0, 0, 1, 1], dtype=np.int64)
+
+
+def _scatter_ragged(out: np.ndarray, dst_starts: np.ndarray, data: np.ndarray,
+                    lens: np.ndarray) -> None:
+    """out[dst_starts[i] : dst_starts[i]+lens[i]] = data[run i] for all i."""
+    lens = lens.astype(np.int64)
+    total = int(lens.sum())
+    if total == 0:
+        return
+    src_off = np.zeros(len(lens), dtype=np.int64)
+    np.cumsum(lens[:-1], out=src_off[1:])
+    idx = (
+        np.arange(total, dtype=np.int64)
+        - np.repeat(src_off, lens)
+        + np.repeat(dst_starts.astype(np.int64), lens)
+    )
+    out[idx] = data[:total]
+
+
+def reg2bin_vec(beg: np.ndarray, end: np.ndarray) -> np.ndarray:
+    """Vectorized SAM-spec reg2bin; beg < 0 yields 4680 (encode_record rule)."""
+    beg = beg.astype(np.int64)
+    e = end.astype(np.int64) - 1
+    out = np.full(len(beg), -1, dtype=np.int64)
+    for shift, base in ((14, 4681), (17, 585), (20, 73), (23, 9), (26, 1)):
+        hit = (out < 0) & (beg >> shift == e >> shift)
+        out[hit] = base + (beg[hit] >> shift)
+    out[out < 0] = 0
+    out[beg < 0] = 4680
+    return out
+
+
+def encode_records(
+    qname_data: np.ndarray, qname_lens: np.ndarray,
+    flag: np.ndarray, rid: np.ndarray, pos: np.ndarray, mapq: np.ndarray,
+    cigar_words: np.ndarray, cigar_lens: np.ndarray,
+    mrid: np.ndarray, mpos: np.ndarray, tlen: np.ndarray,
+    codes_data: np.ndarray, codes_lens: np.ndarray,
+    qual_data: np.ndarray,
+    tag_data: np.ndarray, tag_lens: np.ndarray,
+) -> np.ndarray:
+    """Encode ``n`` records; every ``*_data`` is the concatenation of the
+    per-record runs whose lengths are the matching ``*_lens`` array.
+
+    ``qname_data`` excludes the NUL terminators (added here); ``cigar_words``
+    is uint32 (op in low 4 bits); ``codes_data``/``qual_data`` are aligned
+    (every record's qual length equals its seq length — consensus reads
+    always carry quals); ``tag_data`` is the already-encoded tag block.
+    Returns one uint8 blob of length-prefixed records, byte-identical to
+    concatenating ``encode_record`` over the same records.
+    """
+    n = len(flag)
+    if n == 0:
+        return np.empty(0, dtype=np.uint8)
+    qname_lens = qname_lens.astype(np.int64)
+    cigar_lens = cigar_lens.astype(np.int64)
+    codes_lens = codes_lens.astype(np.int64)
+    tag_lens = tag_lens.astype(np.int64)
+
+    lq = qname_lens + 1  # with NUL
+    if lq.max(initial=0) > 255:
+        raise ValueError(
+            "qname longer than 254 bytes cannot be encoded (l_read_name is a "
+            "single byte) — encode_record raises on the same input"
+        )
+    nsb = (codes_lens + 1) // 2
+    rec_len = 36 + lq + 4 * cigar_lens + nsb + codes_lens + tag_lens
+    starts = np.zeros(n, dtype=np.int64)
+    np.cumsum(rec_len[:-1], out=starts[1:])
+    total = int(rec_len.sum())
+    out = np.zeros(total, dtype=np.uint8)
+
+    # ref span for reg2bin: sum of M/D/N/=/X lengths per record (min 1)
+    if len(cigar_words):
+        consumes = _REF_CONSUMING[cigar_words & 0xF] * (cigar_words >> 4).astype(np.int64)
+        cig_off = np.zeros(n + 1, dtype=np.int64)
+        np.cumsum(cigar_lens, out=cig_off[1:])
+        span = np.add.reduceat(
+            np.concatenate([consumes, [0]]), np.minimum(cig_off[:-1], len(consumes))
+        )[:n]
+        span[cigar_lens == 0] = 0
+    else:
+        span = np.zeros(n, dtype=np.int64)
+    end = pos.astype(np.int64) + np.maximum(1, span)
+    bins = reg2bin_vec(np.asarray(pos), end)
+
+    # (n, 36) fixed block: 4-byte block_size + the 32-byte <iiBBHHHiiii core
+    head = np.zeros((n, 36), dtype=np.uint8)
+    hv = head.view("<i4")  # (n, 9) int32 view
+    hv[:, 0] = (rec_len - 4).astype(np.int32)
+    hv[:, 1] = np.asarray(rid, dtype=np.int32)
+    hv[:, 2] = np.asarray(pos, dtype=np.int32)
+    head[:, 12] = lq.astype(np.uint8)
+    head[:, 13] = np.asarray(mapq, dtype=np.uint8)
+    hb = head.view("<u2")  # (n, 18) uint16 view
+    hb[:, 7] = bins.astype(np.uint16)
+    hb[:, 8] = cigar_lens.astype(np.uint16)
+    hb[:, 9] = np.asarray(flag, dtype=np.uint16)
+    hv[:, 5] = codes_lens.astype(np.int32)
+    hv[:, 6] = np.asarray(mrid, dtype=np.int32)
+    hv[:, 7] = np.asarray(mpos, dtype=np.int32)
+    hv[:, 8] = np.asarray(tlen, dtype=np.int32)
+    out[(starts[:, None] + np.arange(36)).ravel()] = head.ravel()
+
+    cur = starts + 36
+    _scatter_ragged(out, cur, np.asarray(qname_data, dtype=np.uint8), qname_lens)
+    # NUL terminators land at cur + qname_lens (out is zero-initialized)
+    cur = cur + lq
+    if len(cigar_words):
+        _scatter_ragged(
+            out, cur, cigar_words.astype("<u4").view(np.uint8), 4 * cigar_lens
+        )
+    cur = cur + 4 * cigar_lens
+
+    # seq: pad odd-length records with a zero nibble, then pack pairs
+    pad_lens = codes_lens + (codes_lens & 1)
+    padded = np.zeros(int(pad_lens.sum()), dtype=np.uint8)
+    pstarts = np.zeros(n, dtype=np.int64)
+    np.cumsum(pad_lens[:-1], out=pstarts[1:])
+    _scatter_ragged(padded, pstarts, CODE2NIB[np.asarray(codes_data)], codes_lens)
+    packed = (padded[0::2] << 4) | padded[1::2]
+    _scatter_ragged(out, cur, packed, nsb)
+    cur = cur + nsb
+
+    _scatter_ragged(out, cur, np.asarray(qual_data, dtype=np.uint8), codes_lens)
+    cur = cur + codes_lens
+    _scatter_ragged(out, cur, np.asarray(tag_data, dtype=np.uint8), tag_lens)
+    return out
+
+
+def cigar_string_to_words(cigar: list[tuple[str, int]]) -> np.ndarray:
+    """``[("M", 100)] -> uint32 words`` (op in low nibble)."""
+    from consensuscruncher_tpu.io.bam import _CIGAR_OP_OF
+
+    return np.array([(n << 4) | _CIGAR_OP_OF[op] for op, n in cigar], dtype=np.uint32)
+
+
+class ConsensusRecordWriter:
+    """Column-accumulating consensus-record writer.
+
+    ``add`` costs a dozen list appends per record; every ``flush_at``
+    records the columns are encoded in one vectorized ``encode_records``
+    pass and appended to the underlying ``BamWriter`` via
+    ``write_encoded`` — byte-identical to per-record ``encode_record``
+    writes in the same order, ~10x cheaper per record.
+    """
+
+    def __init__(self, writer, flush_at: int = 8192):
+        self._writer = writer
+        self._flush_at = flush_at
+        self._reset()
+        self.n_written = 0
+
+    def _reset(self):
+        self._qnames: list[bytes] = []
+        self._flag: list[int] = []
+        self._rid: list[int] = []
+        self._pos: list[int] = []
+        self._mapq: list[int] = []
+        self._cigars: list[np.ndarray] = []
+        self._mrid: list[int] = []
+        self._mpos: list[int] = []
+        self._tlen: list[int] = []
+        self._codes: list[np.ndarray] = []
+        self._quals: list[np.ndarray] = []
+        self._tags: list[bytes] = []
+
+    def add(self, qname: str, flag: int, rid: int, pos: int, mapq: int,
+            cigar_words: np.ndarray, mrid: int, mpos: int, tlen: int,
+            codes: np.ndarray, quals: np.ndarray, tag_blob: bytes) -> None:
+        self._qnames.append(qname.encode("ascii"))
+        self._flag.append(flag)
+        self._rid.append(rid)
+        self._pos.append(pos)
+        self._mapq.append(mapq)
+        self._cigars.append(cigar_words)
+        self._mrid.append(mrid)
+        self._mpos.append(mpos)
+        self._tlen.append(tlen)
+        self._codes.append(codes)
+        self._quals.append(quals)
+        self._tags.append(tag_blob)
+        if len(self._flag) >= self._flush_at:
+            self.flush()
+
+    def flush(self) -> None:
+        n = len(self._flag)
+        if n == 0:
+            return
+        blob = encode_records(
+            np.frombuffer(b"".join(self._qnames), np.uint8),
+            np.array([len(q) for q in self._qnames], np.int64),
+            np.asarray(self._flag, np.int64),
+            np.asarray(self._rid, np.int64),
+            np.asarray(self._pos, np.int64),
+            np.asarray(self._mapq, np.int64),
+            (np.concatenate(self._cigars).astype(np.uint32)
+             if any(len(c) for c in self._cigars) else np.empty(0, np.uint32)),
+            np.array([len(c) for c in self._cigars], np.int64),
+            np.asarray(self._mrid, np.int64),
+            np.asarray(self._mpos, np.int64),
+            np.asarray(self._tlen, np.int64),
+            np.concatenate(self._codes) if self._codes else np.empty(0, np.uint8),
+            np.array([len(c) for c in self._codes], np.int64),
+            (np.concatenate(self._quals).astype(np.uint8)
+             if self._quals else np.empty(0, np.uint8)),
+            np.frombuffer(b"".join(self._tags), np.uint8),
+            np.array([len(t) for t in self._tags], np.int64),
+        )
+        self._writer.write_encoded(blob)
+        self.n_written += n
+        self._reset()

@@ -28,10 +28,13 @@ import os
 from dataclasses import dataclass
 import numpy as np
 
+import struct
+
 from consensuscruncher_tpu.core import tags as tags_mod
-from consensuscruncher_tpu.core.consensus_read import build_consensus_read
+from consensuscruncher_tpu.core.consensus_read import _KEEP_FLAGS
 from consensuscruncher_tpu.core.duplex_cpu import duplex_consensus
 from consensuscruncher_tpu.io.bam import BamWriter, sort_bam
+from consensuscruncher_tpu.io.encode import ConsensusRecordWriter
 from consensuscruncher_tpu.ops.duplex_tpu import duplex_batch_host
 from consensuscruncher_tpu.utils.stats import StageStats
 
@@ -77,35 +80,32 @@ class _PinnedMember:
     in memory.  This copies exactly what the duplex sink needs (~2L bytes +
     a few scalars) so the batch can be released."""
 
-    __slots__ = ("codes", "qual", "flag", "ref", "pos", "mate_ref",
-                 "mate_pos", "tlen", "mapq", "xf", "_cigar")
+    __slots__ = ("codes", "qual", "flag", "rid", "pos", "mrid",
+                 "mate_pos", "tlen", "mapq", "xf", "cigar")
 
     def __init__(self, view):
         self.codes = np.array(view.codes)
         self.qual = np.array(view.qual)
         self.flag = view.flag
-        self.ref = view.ref
+        self.rid = view.rid
         self.pos = view.pos
-        self.mate_ref = view.mate_ref
+        self.mrid = view.mrid
         self.mate_pos = view.mate_pos
         self.tlen = view.tlen
         self.mapq = view.mapq
         self.xf = fam_size_of(view)
-        self._cigar = view.cigar_string()
+        self.cigar = np.array(view.cigar_words())
 
     @property
     def seq_len(self) -> int:
         return self.codes.shape[0]
-
-    def cigar_string(self) -> str:
-        return self._cigar
 
 
 class _DuplexBatcher:
     """Accumulate strand pairs per read length; flush through the device
     kernel in batches (keeps device dispatches large and few)."""
 
-    def __init__(self, qual_cap: int, flush_at: int = 2048, backend: str = "tpu"):
+    def __init__(self, qual_cap: int, flush_at: int = 16384, backend: str = "tpu"):
         self.qual_cap = qual_cap
         self.flush_at = flush_at
         self.backend = backend
@@ -162,13 +162,23 @@ def run_dcs(
     dcs_writer = BamWriter(dcs_tmp, reader.header)
     unpaired_writer = BamWriter(unpaired_tmp, reader.header)
 
+    rec_writer = ConsensusRecordWriter(dcs_writer)
+
     def sink(tag, canon, other, codes, quals):
-        fam_size = fam_size_of(canon) + fam_size_of(other)
-        read = build_consensus_read(
-            tag, [canon], codes, quals, qname=tags_mod.dcs_qname(tag),
-            extra_tags={"XT": ("Z", tag.barcode), "XF": ("i", fam_size)},
+        # canon is a _PinnedMember (columnar path); same record bytes as
+        # build_consensus_read + encode_record, accumulated column-wise.
+        fam_size = canon.xf + other.xf
+        L = codes.shape[0]
+        words = canon.cigar if canon.seq_len == L else np.array([L << 4], np.uint32)
+        tag_blob = (
+            b"XTZ" + tag.barcode.encode("ascii")
+            + b"\x00XFi" + struct.pack("<i", fam_size)
         )
-        dcs_writer.write(read)
+        rec_writer.add(
+            tags_mod.dcs_qname(tag), canon.flag & _KEEP_FLAGS, canon.rid,
+            canon.pos, canon.mapq, words, canon.mrid, canon.mate_pos,
+            canon.tlen, codes, quals, tag_blob,
+        )
         stats.incr("dcs_written")
 
     batcher = _DuplexBatcher(qual_cap, backend=backend)
@@ -202,6 +212,7 @@ def run_dcs(
                     batcher.add(partner, oread, read, sink)
                 stats.incr("pairs")
         batcher.flush()
+        rec_writer.flush()
     finally:
         reader.close()
         dcs_writer.close()

@@ -196,8 +196,25 @@ class MemberView:
     def tlen(self) -> int:
         return int(self._batch.tlen[self._idx])
 
+    @property
+    def rid(self) -> int:
+        return int(self._batch.ref_id[self._idx])
+
+    @property
+    def mrid(self) -> int:
+        return int(self._batch.mate_ref_id[self._idx])
+
     def cigar_string(self) -> str:
         return self._batch.cigar_string(self._idx)
+
+    def cigar_bytes(self) -> np.ndarray:
+        """Raw little-endian cigar words as a byte view (cheap equality)."""
+        b = self._batch
+        start = int(b.cigar_start[self._idx])
+        return b.buf[start : start + 4 * int(b.n_cigar[self._idx])]
+
+    def cigar_words(self) -> np.ndarray:
+        return np.ascontiguousarray(self.cigar_bytes()).view("<u4")
 
     def materialize(self) -> BamRead:
         """Full BamRead (singleton renames, bad-read writes)."""

@@ -25,14 +25,21 @@ coordinate-sort atomically — the reference reaches the same state via
 from __future__ import annotations
 
 import os
+import struct
 from dataclasses import dataclass
 
 import numpy as np
 
 from consensuscruncher_tpu.core import tags as tags_mod
 from consensuscruncher_tpu.core.consensus_cpu import consensus_maker_numpy
-from consensuscruncher_tpu.core.consensus_read import build_consensus_read
+from consensuscruncher_tpu.core.consensus_read import (
+    _KEEP_FLAGS,
+    build_consensus_read,
+    modal_cigar,
+)
 from consensuscruncher_tpu.io.bam import BamReader, BamWriter, sort_bam
+from consensuscruncher_tpu.io.encode import ConsensusRecordWriter, cigar_string_to_words
+from consensuscruncher_tpu.stages.grouping import MemberView
 from consensuscruncher_tpu.ops.consensus_tpu import ConsensusConfig, consensus_families
 from consensuscruncher_tpu.parallel.batching import rectangularize
 from consensuscruncher_tpu.stages.grouping import stream_families
@@ -172,13 +179,40 @@ def run_sscs(
             yield next_id, seqs, quals
             next_id += 1
 
+    rec_writer = ConsensusRecordWriter(sscs_writer)
+
     def emit(fid, codes, quals):
         tag, members = pending.pop(fid)
-        read = build_consensus_read(
-            tag, members, codes, quals, qname=tags_mod.sscs_qname(tag),
-            extra_tags={"XT": ("Z", tag.barcode)},
-        )
-        sscs_writer.write(read)
+        t = members[0]
+        if isinstance(t, MemberView):
+            # Columnar fast path: identical record bytes to
+            # build_consensus_read + encode_record, built column-wise.
+            L = codes.shape[0]
+            cand = [m for m in members if m.seq_len == L]
+            first = cand[0].cigar_bytes() if cand else None
+            if first is not None and all(
+                np.array_equal(m.cigar_bytes(), first) for m in cand[1:]
+            ):
+                # np.array copy: a zero-copy view would pin the whole source
+                # batch buffer inside the record writer until its next flush
+                words = np.array(np.ascontiguousarray(first).view("<u4"))
+            else:  # mixed cigars / all-truncated: exact modal_cigar semantics
+                words = cigar_string_to_words(modal_cigar(members, L))
+            tag_blob = (
+                b"XTZ" + tag.barcode.encode("ascii")
+                + b"\x00XFi" + struct.pack("<i", len(members))
+            )
+            rec_writer.add(
+                tags_mod.sscs_qname(tag), t.flag & _KEEP_FLAGS, t.rid, t.pos,
+                max(m.mapq for m in members), words, t.mrid, t.mate_pos,
+                t.tlen, codes, quals, tag_blob,
+            )
+        else:
+            read = build_consensus_read(
+                tag, members, codes, quals, qname=tags_mod.sscs_qname(tag),
+                extra_tags={"XT": ("Z", tag.barcode)},
+            )
+            sscs_writer.write(read)
         stats.incr("sscs_written")
 
     ok = False
@@ -224,6 +258,7 @@ def run_sscs(
                     rect_s, rect_q, cutoff=cutoff, qual_threshold=qual_threshold, qual_cap=qual_cap
                 )
                 emit(fid, codes, cquals)
+        rec_writer.flush()
         ok = True
     finally:
         reader.close()

@@ -1,0 +1,149 @@
+"""Vectorized record encoder ≡ io.bam.encode_record, byte for byte."""
+
+import numpy as np
+import pytest
+
+from consensuscruncher_tpu.io.bam import (
+    BamHeader,
+    BamRead,
+    decode_record,
+    encode_record,
+)
+from consensuscruncher_tpu.io.encode import (
+    cigar_string_to_words,
+    encode_records,
+    reg2bin_vec,
+)
+from consensuscruncher_tpu.utils.phred import decode_seq, encode_seq
+
+
+def _reg2bin_scalar(beg, end):
+    from consensuscruncher_tpu.io.bam import _reg2bin
+
+    return _reg2bin(beg, end)
+
+
+def test_reg2bin_vec_matches_scalar():
+    rng = np.random.default_rng(5)
+    begs = np.concatenate([
+        rng.integers(0, 1 << 28, 500), [0, 1, (1 << 29) - 2]
+    ]).astype(np.int64)
+    ends = begs + np.concatenate([rng.integers(1, 1 << 18, 500), [1, 1, 1]])
+    got = reg2bin_vec(begs, ends)
+    for b, e, g in zip(begs, ends, got):
+        assert g == _reg2bin_scalar(int(b), int(e))
+    assert reg2bin_vec(np.array([-1]), np.array([1]))[0] == 4680
+
+
+def _random_reads(rng, n, header):
+    reads = []
+    for i in range(n):
+        L = int(rng.integers(1, 40))
+        seq = decode_seq(rng.integers(0, 5, L).astype(np.uint8))
+        cigar_pool = [
+            [("M", L)],
+            [("S", 2), ("M", max(1, L - 2))],
+            [("M", max(1, L // 2)), ("D", 3), ("M", L - max(1, L // 2))],
+            [],
+        ]
+        reads.append(BamRead(
+            qname=f"read:{i}|" + "ACGT"[i % 4] * int(rng.integers(1, 9)),
+            flag=int(rng.integers(0, 1 << 12)),
+            ref="chr1" if i % 3 else "chr2",
+            pos=int(rng.integers(0, 1 << 24)),
+            mapq=int(rng.integers(0, 61)),
+            cigar=cigar_pool[int(rng.integers(0, len(cigar_pool)))],
+            mate_ref="chr1",
+            mate_pos=int(rng.integers(0, 1 << 24)),
+            tlen=int(rng.integers(-500, 500)),
+            seq=seq,
+            qual=rng.integers(0, 61, L).astype(np.uint8),
+            tags={"XT": ("Z", f"AAA.CC{i}"), "XF": ("i", int(rng.integers(1, 99)))},
+        ))
+    return reads
+
+
+def test_encode_records_matches_encode_record():
+    from consensuscruncher_tpu.io.bam import _encode_tags
+
+    header = BamHeader.from_refs([("chr1", 1 << 28), ("chr2", 1 << 28)])
+    rng = np.random.default_rng(11)
+    reads = _random_reads(rng, 300, header)
+
+    qnames = [r.qname.encode() for r in reads]
+    cigars = [cigar_string_to_words(r.cigar) for r in reads]
+    codes = [encode_seq(r.seq) for r in reads]
+    tags = [_encode_tags(r.tags) for r in reads]
+    blob = encode_records(
+        np.frombuffer(b"".join(qnames), np.uint8),
+        np.array([len(q) for q in qnames]),
+        np.array([r.flag for r in reads]),
+        np.array([header.ref_id(r.ref) for r in reads]),
+        np.array([r.pos for r in reads]),
+        np.array([r.mapq for r in reads]),
+        np.concatenate(cigars) if cigars else np.empty(0, np.uint32),
+        np.array([len(c) for c in cigars]),
+        np.array([header.ref_id(r.mate_ref) for r in reads]),
+        np.array([r.mate_pos for r in reads]),
+        np.array([r.tlen for r in reads]),
+        np.concatenate(codes),
+        np.array([len(c) for c in codes]),
+        np.concatenate([r.qual for r in reads]),
+        np.frombuffer(b"".join(tags), np.uint8),
+        np.array([len(t) for t in tags]),
+    )
+    expect = b"".join(encode_record(r, header) for r in reads)
+    assert blob.tobytes() == expect
+
+
+def test_encode_records_round_trip_decode():
+    header = BamHeader.from_refs([("chr1", 1 << 28), ("chr2", 1 << 28)])
+    rng = np.random.default_rng(13)
+    reads = _random_reads(rng, 40, header)
+    from consensuscruncher_tpu.io.bam import _encode_tags
+
+    qnames = [r.qname.encode() for r in reads]
+    cigars = [cigar_string_to_words(r.cigar) for r in reads]
+    codes = [encode_seq(r.seq) for r in reads]
+    tags = [_encode_tags(r.tags) for r in reads]
+    blob = encode_records(
+        np.frombuffer(b"".join(qnames), np.uint8),
+        np.array([len(q) for q in qnames]),
+        np.array([r.flag for r in reads]),
+        np.array([header.ref_id(r.ref) for r in reads]),
+        np.array([r.pos for r in reads]),
+        np.array([r.mapq for r in reads]),
+        np.concatenate(cigars),
+        np.array([len(c) for c in cigars]),
+        np.array([header.ref_id(r.mate_ref) for r in reads]),
+        np.array([r.mate_pos for r in reads]),
+        np.array([r.tlen for r in reads]),
+        np.concatenate(codes),
+        np.array([len(c) for c in codes]),
+        np.concatenate([r.qual for r in reads]),
+        np.frombuffer(b"".join(tags), np.uint8),
+        np.array([len(t) for t in tags]),
+    )
+    buf = blob.tobytes()
+    got = []
+    off = 0
+    import struct
+
+    while off < len(buf):
+        (bs,) = struct.unpack_from("<i", buf, off)
+        got.append(decode_record(buf[off + 4 : off + 4 + bs], header))
+        off += 4 + bs
+    assert got == reads
+
+
+def test_encode_records_empty():
+    assert encode_records(
+        np.empty(0, np.uint8), np.empty(0, np.int64),
+        np.empty(0, np.int64), np.empty(0, np.int64), np.empty(0, np.int64),
+        np.empty(0, np.int64),
+        np.empty(0, np.uint32), np.empty(0, np.int64),
+        np.empty(0, np.int64), np.empty(0, np.int64), np.empty(0, np.int64),
+        np.empty(0, np.uint8), np.empty(0, np.int64),
+        np.empty(0, np.uint8),
+        np.empty(0, np.uint8), np.empty(0, np.int64),
+    ).size == 0
