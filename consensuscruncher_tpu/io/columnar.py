@@ -74,6 +74,13 @@ def ragged_gather(buf: np.ndarray, starts: np.ndarray, lengths: np.ndarray):
     total = int(off[-1])
     if total == 0:
         return np.empty(0, dtype=np.uint8), off
+    n = len(lengths)
+    # Uniform-length fast path (fixed-length reads dominate real BAMs): one
+    # 2-D gather instead of three total-length int64 index arrays.
+    if n and int(lengths[0]) and (lengths == lengths[0]).all():
+        l0 = int(lengths[0])
+        out = buf[starts.astype(np.int64)[:, None] + np.arange(l0, dtype=np.int64)]
+        return out.reshape(-1), off
     idx = (
         np.arange(total, dtype=np.int64)
         - np.repeat(off[:-1], lengths)
@@ -147,9 +154,17 @@ class ColumnarBatch:
             out[np.repeat(np.arange(self.n), lens), idx] = data
         return out
 
+    @cached_property
+    def _seq_codes_cache(self):
+        return self._seq_codes_impl()
+
     def seq_codes(self):
         """``(codes, offsets)``: 4-bit seq fields nibble-expanded straight to
-        pipeline base codes (A=0..N=4) — no string round trip."""
+        pipeline base codes (A=0..N=4) — no string round trip.  Cached: the
+        block producer touches a batch from several sources."""
+        return self._seq_codes_cache
+
+    def _seq_codes_impl(self):
         l = self.l_seq.astype(np.int64)
         off = np.zeros(self.n + 1, dtype=np.int64)
         np.cumsum(l, out=off[1:])
@@ -170,11 +185,19 @@ class ColumnarBatch:
         nib = np.where(rel % 2 == 0, b >> 4, b & 0xF)
         return NIB2CODE[nib], off
 
+    @cached_property
+    def _quals_cache(self):
+        return self._quals_impl()
+
     def quals(self):
         """``(quals, offsets)``; a read whose FIRST qual byte is the spec's
         0xFF missing marker decodes as all-zero — exactly ``decode_record``'s
         whole-read-missing rule (a stray mid-read 0xFF stays 255, so the
-        columnar and object paths can never diverge on malformed input)."""
+        columnar and object paths can never diverge on malformed input).
+        Cached, like :meth:`seq_codes`."""
+        return self._quals_cache
+
+    def _quals_impl(self):
         data, off = ragged_gather(self.buf, self.qual_start, self.l_seq)
         l = self.l_seq.astype(np.int64)
         nonempty = l > 0

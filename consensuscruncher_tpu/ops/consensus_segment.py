@@ -376,13 +376,15 @@ def run_duplex_pipelined(rows, qrows, sizes_a, sizes_b, codebook4,
 
 @lru_cache(maxsize=None)
 def _compiled_stream_vote(wire: str, num, den, qual_threshold, qual_cap,
-                          member_cap: int | None):
+                          member_cap: int | None, out_len: int | None = None):
     """Jitted wire-decode + vote: (a, b, sizes) -> (NF, L) consensus pair.
 
     ``(a, b)`` by wire mode — raw: (bases, quals) both (M, L); pack8:
     (packed (M, L), 16-entry codebook); pack4: (packed (M, L/2), 4-entry
     codebook).  Shapes specialize inside jit's own cache; the lru key is
-    only the semantics + wire + gather capacity.
+    only the semantics + wire + gather capacity.  ``out_len`` (static)
+    truncates the output planes to the batch's true max consensus length
+    before the d2h transfer (the length bucket can be up to 31 cols wider).
     """
 
     def fn(a, b, sizes):
@@ -419,7 +421,8 @@ def _compiled_stream_vote(wire: str, num, den, qual_threshold, qual_cap,
             out_b, out_q = out_b[:nf], out_q[:nf]
         # One stacked output plane -> one d2h transfer per batch (tunnel
         # roundtrips, not bytes, are the remaining device-side cost).
-        return jnp.stack([out_b, out_q])
+        out = jnp.stack([out_b, out_q])
+        return out if out_len is None else out[:, :, :out_len]
 
     return jax.jit(fn)
 
@@ -460,23 +463,16 @@ def encode_member_batch(batch):
     return "raw", rows, qf, member_cap
 
 
-def consensus_families_stream(
-    families,
-    config: ConsensusConfig = ConsensusConfig(),
-    max_batch: int = 4096,
-    member_limit: int = 32768,
-    prefetch_depth: int | None = None,
-):
-    """Member-stream twin of ``consensus_tpu.consensus_families``.
+def _run_member_batch_stream(batches, config: ConsensusConfig,
+                             prefetch_depth: int | None):
+    """Shared streaming harness: MemberBatch iterable -> per-family results.
 
-    Same contract: consumes ``(key, member_seqs, member_quals)``, yields
-    ``(key, consensus_base, consensus_qual)`` sliced to true length, in
-    batch order; bit-identical outputs (the vote is the same
-    ``_consensus_one_family`` program, fed through the packed wire).
-    Grouping, rectangularization, and wire packing all run on the prefetch
-    producer thread; the device keeps one batch in flight.
+    Wire-encodes each batch on the prefetch producer thread, keeps one batch
+    in flight on the device, and yields ``(key, bases, quals)`` sliced to
+    each family's true length, in batch order.  The single owner of the
+    prefetch lifecycle / close-ordering / d2h conventions for both the
+    per-family and the block producers.
     """
-    from consensuscruncher_tpu.parallel.batching import bucket_members
     from consensuscruncher_tpu.parallel.prefetch import DEFAULT_DEPTH, pipelined, prefetch
 
     if prefetch_depth is None:
@@ -485,14 +481,14 @@ def consensus_families_stream(
     qt, qc = int(config.qual_threshold), int(config.qual_cap)
 
     def encoded():
-        for batch in bucket_members(families, max_batch=max_batch,
-                                    member_limit=member_limit):
+        for batch in batches:
             wire, a, b, member_cap = encode_member_batch(batch)
             yield batch, wire, a, b, member_cap
 
     def dispatch(item):
         batch, wire, a, b, member_cap = item
-        fn = _compiled_stream_vote(wire, num, den, qt, qc, member_cap)
+        out_len = int(batch.lengths.max(initial=0)) or None
+        fn = _compiled_stream_vote(wire, num, den, qt, qc, member_cap, out_len)
         return fn(a, b, batch.sizes)
 
     def fetch(item, handle):
@@ -513,6 +509,49 @@ def consensus_families_stream(
         yield from pipelined(stream, dispatch, fetch)
     finally:
         stream.close()
+
+
+def consensus_families_stream(
+    families,
+    config: ConsensusConfig = ConsensusConfig(),
+    max_batch: int = 4096,
+    member_limit: int = 32768,
+    prefetch_depth: int | None = None,
+):
+    """Member-stream twin of ``consensus_tpu.consensus_families``.
+
+    Same contract: consumes ``(key, member_seqs, member_quals)``, yields
+    ``(key, consensus_base, consensus_qual)`` sliced to true length, in
+    batch order; bit-identical outputs (the vote is the same
+    ``_consensus_one_family`` program, fed through the packed wire).
+    """
+    from consensuscruncher_tpu.parallel.batching import bucket_members
+
+    yield from _run_member_batch_stream(
+        bucket_members(families, max_batch=max_batch, member_limit=member_limit),
+        config, prefetch_depth,
+    )
+
+
+def consensus_blocks_stream(
+    items,
+    config: ConsensusConfig = ConsensusConfig(),
+    max_batch: int = 4096,
+    member_limit: int = 32768,
+    prefetch_depth: int | None = None,
+):
+    """FamilyBlock twin of :func:`consensus_families_stream`.
+
+    ``items`` yields ``(block, fam_idx, keys)`` (see
+    ``parallel.batching.bucket_member_blocks``); yields the same
+    ``(key, consensus_base, consensus_qual)`` stream, bit-identical.
+    """
+    from consensuscruncher_tpu.parallel.batching import bucket_member_blocks
+
+    yield from _run_member_batch_stream(
+        bucket_member_blocks(items, max_batch=max_batch, member_limit=member_limit),
+        config, prefetch_depth,
+    )
 
 
 def build_member_stream(size_arrays: list[np.ndarray]):

@@ -245,3 +245,147 @@ def _emit(bucket: _Bucket, fb: int, lb: int, pad_to: int | None) -> FamilyBatch:
     return FamilyBatch(
         keys=list(bucket.keys), bases=bases, quals=quals, fam_sizes=fam_sizes, lengths=lengths
     )
+
+
+def _scatter_from(flat, dst_starts, src, src_starts, lens):
+    """flat[dst_starts[i]:+lens[i]] = src[src_starts[i]:+lens[i]] per run."""
+    lens = lens.astype(np.int64)
+    total = int(lens.sum())
+    if total == 0:
+        return
+    n = len(lens)
+    # Fixed-length-read fast path: uniform run length means one 2-D gather;
+    # if destinations are also evenly strided (contiguous rows of a matrix),
+    # the write is a plain slice assignment — near-memcpy speed.
+    if n and (lens == lens[0]).all():
+        l0 = int(lens[0])
+        vals = src[src_starts.astype(np.int64)[:, None] + np.arange(l0)]
+        d0 = int(dst_starts[0])
+        if n == 1 or ((np.diff(dst_starts) == dst_starts[1] - dst_starts[0]).all()):
+            stride = int(dst_starts[1] - dst_starts[0]) if n > 1 else l0
+            if stride >= l0:
+                view = np.lib.stride_tricks.as_strided(
+                    flat[d0:], shape=(n, l0),
+                    strides=(stride * flat.itemsize, flat.itemsize),
+                    writeable=True,
+                )
+                view[:] = vals
+                return
+        flat[dst_starts.astype(np.int64)[:, None] + np.arange(l0)] = vals
+        return
+    rel = np.arange(total, dtype=np.int64) - np.repeat(
+        np.concatenate([[0], np.cumsum(lens[:-1])]), lens
+    )
+    flat[np.repeat(dst_starts.astype(np.int64), lens) + rel] = src[
+        np.repeat(src_starts.astype(np.int64), lens) + rel
+    ]
+
+
+def _fill_const(flat, dst_starts, lens, value):
+    """flat[dst_starts[i]:+lens[i]] = value per run."""
+    lens = lens.astype(np.int64)
+    total = int(lens.sum())
+    if total == 0:
+        return
+    rel = np.arange(total, dtype=np.int64) - np.repeat(
+        np.concatenate([[0], np.cumsum(lens[:-1])]), lens
+    )
+    flat[np.repeat(dst_starts.astype(np.int64), lens) + rel] = value
+
+
+class _BlockBucket:
+    __slots__ = ("chunks", "keys", "sizes", "lengths", "members")
+
+    def __init__(self):
+        # each chunk: (codes_data, qual_data, mem_start, mem_len, mem_target,
+        #              dst_row) — dst_row is the member's absolute row in the
+        # flushed matrix, assigned at append time so per-source partitioning
+        # cannot disturb family-contiguous member order.
+        self.chunks = []
+        self.keys: list = []
+        self.sizes: list[np.ndarray] = []
+        self.lengths: list[np.ndarray] = []
+        self.members = 0
+
+
+def bucket_member_blocks(
+    items: Iterable[tuple[object, np.ndarray, list]],
+    max_batch: int = 4096,
+    member_limit: int = 32768,
+) -> Iterator[MemberBatch]:
+    """FamilyBlock twin of :func:`bucket_members` — fully array-level.
+
+    ``items`` yields ``(block, fam_idx, keys)``: the selected families of a
+    ``stages.grouping.FamilyBlock`` and their stream keys.  Rectangular-
+    ization semantics are identical to :func:`rectangularize` (truncate to
+    the modal length, pad short members with (N, qual 0)), applied as
+    scatter passes at flush time instead of per-family copies.
+    """
+    buckets: dict[int, _BlockBucket] = {}
+
+    def flush(lb: int) -> MemberBatch:
+        bucket = buckets.pop(lb)
+        n = len(bucket.keys)
+        cap = max(MIN_BATCH, next_pow2(n))
+        m = bucket.members
+        m_pad = max(MEMBER_QUANTUM, -(-m // MEMBER_QUANTUM) * MEMBER_QUANTUM)
+        rows = np.zeros((m_pad, lb), dtype=np.uint8)
+        qrows = np.full((m_pad, lb), QUAL_FILL_SENTINEL, dtype=np.uint8)
+        flat_r = rows.reshape(-1)
+        flat_q = qrows.reshape(-1)
+        for codes_data, qual_data, mstart, mlen, mtarget, dst_row in bucket.chunks:
+            dst = dst_row * lb
+            minlt = np.minimum(mlen, mtarget)
+            _scatter_from(flat_r, dst, codes_data, mstart, minlt)
+            _scatter_from(flat_q, dst, qual_data, mstart, minlt)
+            gap = mtarget - minlt  # short members pad with (N, qual 0)
+            _fill_const(flat_r, dst + minlt, gap, N)
+            _fill_const(flat_q, dst + minlt, gap, 0)
+            # dead cells past target keep init values (0 / sentinel)
+        sizes = np.zeros(cap, dtype=np.int32)
+        lengths = np.zeros(cap, dtype=np.int32)
+        sizes[:n] = np.concatenate(bucket.sizes)
+        lengths[:n] = np.concatenate(bucket.lengths)
+        return MemberBatch(
+            keys=list(bucket.keys), rows=rows, qrows=qrows, sizes=sizes,
+            lengths=lengths, n_real=n, n_members=m,
+        )
+
+    for block, fam_idx, keys in items:
+        fam_idx = np.asarray(fam_idx, dtype=np.int64)
+        tl = block.target_len[fam_idx]
+        lbs = np.maximum(
+            LEN_QUANTUM, ((tl + LEN_QUANTUM - 1) // LEN_QUANTUM) * LEN_QUANTUM
+        )
+        for lb in np.unique(lbs):
+            lb = int(lb)
+            m = lbs == lb
+            fams = fam_idx[m]
+            counts = block.sizes[fams].astype(np.int64)
+            starts = block.fam_off[fams]
+            tot = int(counts.sum())
+            rel = np.arange(tot, dtype=np.int64) - np.repeat(
+                np.concatenate([[0], np.cumsum(counts[:-1])]), counts
+            )
+            midx = np.repeat(starts, counts) + rel
+            bucket = buckets.setdefault(lb, _BlockBucket())
+            dst_row = bucket.members + np.arange(tot, dtype=np.int64)
+            mtarget = np.repeat(block.target_len[fams], counts)
+            chunk_of = block.mem_chunk[midx]
+            for ci in np.unique(chunk_of):
+                cm = chunk_of == ci
+                codes_data, qual_data = block.data_chunks[int(ci)]
+                bucket.chunks.append((
+                    codes_data, qual_data,
+                    block.mem_start[midx[cm]], block.mem_len[midx[cm]],
+                    mtarget[cm], dst_row[cm],
+                ))
+            sel = np.nonzero(m)[0]
+            bucket.keys.extend(keys[int(j)] for j in sel)
+            bucket.sizes.append(block.sizes[fams])
+            bucket.lengths.append(block.target_len[fams])
+            bucket.members += tot
+            if len(bucket.keys) >= max_batch or bucket.members >= member_limit:
+                yield flush(lb)
+    for lb in sorted(buckets):
+        yield flush(lb)

@@ -87,14 +87,35 @@ class _PinnedMember:
         self.codes = np.array(view.codes)
         self.qual = np.array(view.qual)
         self.flag = view.flag
-        self.rid = view.rid
         self.pos = view.pos
-        self.mrid = view.mrid
         self.mate_pos = view.mate_pos
         self.tlen = view.tlen
         self.mapq = view.mapq
         self.xf = fam_size_of(view)
+        self.rid = view.rid
+        self.mrid = view.mrid
         self.cigar = np.array(view.cigar_words())
+
+    @classmethod
+    def from_bam_read(cls, read, header):
+        """Foreign-tag-layout fallback: consensus_windows_columnar yields a
+        plain BamRead when a record's tag block doesn't lead with XT."""
+        self = cls.__new__(cls)
+        self.codes = read.codes
+        q = read.qual
+        self.qual = q if q.size else np.zeros(len(read.seq), dtype=np.uint8)
+        self.flag = read.flag
+        self.pos = read.pos
+        self.mate_pos = read.mate_pos
+        self.tlen = read.tlen
+        self.mapq = read.mapq
+        self.xf = fam_size_of(read)
+        self.rid = header.ref_id(read.ref)
+        self.mrid = header.ref_id(read.mate_ref)
+        from consensuscruncher_tpu.io.encode import cigar_string_to_words
+
+        self.cigar = cigar_string_to_words(read.cigar)
+        return self
 
     @property
     def seq_len(self) -> int:
@@ -105,17 +126,24 @@ class _DuplexBatcher:
     """Accumulate strand pairs per read length; flush through the device
     kernel in batches (keeps device dispatches large and few)."""
 
-    def __init__(self, qual_cap: int, flush_at: int = 16384, backend: str = "tpu"):
+    def __init__(self, qual_cap: int, header, flush_at: int = 16384,
+                 backend: str = "tpu"):
         self.qual_cap = qual_cap
+        self.header = header
         self.flush_at = flush_at
         self.backend = backend
         self._by_len: dict[int, list] = {}
 
+    def _pin(self, read):
+        if hasattr(read, "_batch"):  # columnar view: snapshot to unpin
+            return _PinnedMember(read)
+        if not hasattr(read, "xf"):  # BamRead (foreign tag layout fallback)
+            return _PinnedMember.from_bam_read(read, self.header)
+        return read
+
     def add(self, canon_tag, canon_read, other_read, sink) -> None:
-        if hasattr(canon_read, "_batch"):  # columnar view: snapshot to unpin
-            canon_read = _PinnedMember(canon_read)
-        if hasattr(other_read, "_batch"):
-            other_read = _PinnedMember(other_read)
+        canon_read = self._pin(canon_read)
+        other_read = self._pin(other_read)
         L = canon_read.seq_len
         self._by_len.setdefault(L, []).append((canon_tag, canon_read, other_read, sink))
         if len(self._by_len[L]) >= self.flush_at:
@@ -181,7 +209,7 @@ def run_dcs(
         )
         stats.incr("dcs_written")
 
-    batcher = _DuplexBatcher(qual_cap, backend=backend)
+    batcher = _DuplexBatcher(qual_cap, reader.header, backend=backend)
     try:
         for _key, window in consensus_windows_columnar(reader):
             paired: set = set()

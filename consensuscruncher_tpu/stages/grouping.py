@@ -570,3 +570,369 @@ def consensus_windows_columnar(creader):
             window[tag] = read
     if window:
         yield cur, window
+
+
+
+# ------------------------------------------------------------ family blocks
+#
+# The fully-vectorized producer (v3): ONE FamilyBlock per columnar batch
+# (the trailing coordinate defers to the next batch, exactly like the
+# window carry above).  The coordinate is part of the family key, so
+# grouping lexsorts it together with the barcode/mate/flag columns; runs of
+# equal keys are families, stable sort preserves member stream order, and
+# families emit sorted by (rid, pos, str(tag)) — the object path's global
+# order.  Per-family Python shrinks to tag/qname strings and dict inserts;
+# everything else is array passes.
+
+
+class FamilyBlock:
+    """All families of one columnar batch, as struct-of-arrays.
+
+    Per family (emission order): ``tags``, ``sizes``, ``target_len`` (modal
+    member length, ties -> longer), ``tmpl_*`` template fields, ``mapq_max``,
+    ``cigar_words`` (modal cigar, owned copies), ``tmpl_src`` (batch, row).
+    Per member (family-contiguous): ``mem_start``/``mem_len`` into
+    ``data_chunks[mem_chunk[i]]`` (codes and quals share offsets), with
+    ``fam_off`` boundaries.
+    """
+
+    __slots__ = ("tags", "sizes", "target_len", "tmpl_flag", "tmpl_rid",
+                 "tmpl_pos", "tmpl_mrid", "tmpl_mpos", "tmpl_tlen",
+                 "mapq_max", "cigar_words", "tmpl_src", "data_chunks",
+                 "mem_chunk", "mem_start", "mem_len", "fam_off")
+
+    @property
+    def n_fam(self) -> int:
+        return len(self.tags)
+
+
+class _BlockSrc:
+    """Good rows of one batch contributing to a block (or carried over)."""
+
+    __slots__ = ("batch", "rows", "bcm", "bclen", "codes_data", "codes_off",
+                 "qual_data")
+
+    def __init__(self, batch, rows, bcm, bclen):
+        self.batch = batch
+        self.rows = rows
+        self.bcm = bcm
+        self.bclen = bclen
+        self.codes_data, self.codes_off = batch.seq_codes()
+        self.qual_data, _ = batch.quals()
+
+
+def _modal_lengths(fam_ids, lens, n_fam):
+    """Per-family modal member length, ties -> longer (the pinned
+    ``parallel.batching.consensus_length`` semantics), vectorized."""
+    order = np.lexsort((lens, fam_ids))
+    f, l = fam_ids[order], lens[order]
+    new_run = np.ones(len(f), dtype=bool)
+    new_run[1:] = (f[1:] != f[:-1]) | (l[1:] != l[:-1])
+    run_idx = np.nonzero(new_run)[0]
+    run_fam, run_len = f[run_idx], l[run_idx]
+    counts = np.diff(np.concatenate([run_idx, [len(f)]]))
+    # per family pick (max count, then max len): lexsort runs by
+    # (fam, count, len) and take the LAST run of each family
+    ro = np.lexsort((run_len, counts, run_fam))
+    last = np.zeros(len(ro), dtype=bool)
+    if len(ro):
+        last[-1] = True
+        last[:-1] = run_fam[ro][1:] != run_fam[ro][:-1]
+    out = np.zeros(n_fam, dtype=np.int64)
+    out[run_fam[ro][last]] = run_len[ro][last]
+    return out
+
+
+def _fill_rows_at(mat, row_idx, data, off, lens):
+    """mat[row_idx[i], :lens[i]] = data[off[i]:off[i+1]] for all i."""
+    lens = lens.astype(np.int64)
+    total = int(lens.sum())
+    if total == 0:
+        return
+    w = mat.shape[1]
+    flat = mat.reshape(-1)
+    dst = (
+        np.arange(total, dtype=np.int64)
+        - np.repeat(off[:-1], lens)
+        + np.repeat(row_idx.astype(np.int64) * w, lens)
+    )
+    flat[dst] = data
+
+
+def _modal_cigars(sources, srci, gidx, fam_off, mem_len, target, n_fam):
+    """Per-family modal cigar words (core.consensus_read.modal_cigar
+    semantics): vectorized all-candidates-equal fast path, exact
+    Counter-of-strings fallback for the rare mixed families.
+
+    ``srci``/``gidx``: per member (family-contiguous order) the source index
+    and original batch row.
+    """
+    from consensuscruncher_tpu.io.columnar import ragged_gather
+
+    n = len(srci)
+    sizes = np.diff(fam_off)
+    target_rep = np.repeat(target, sizes)
+    cand = mem_len == target_rep
+
+    nc = np.empty(n, dtype=np.int64)
+    cstart = np.empty(n, dtype=np.int64)
+    for k, s in enumerate(sources):
+        m = srci == k
+        rows = gidx[m]
+        nc[m] = s.batch.n_cigar[rows]
+        cstart[m] = s.batch.cigar_start[rows]
+
+    BIG = n + 1
+    idx = np.where(cand, np.arange(n), BIG)
+    first_cand = np.minimum.reduceat(idx, fam_off[:-1]) if n_fam else idx[:0]
+    has_cand = first_cand < BIG
+
+    out: list = [None] * n_fam
+    wmax = int(nc[cand].max(initial=0)) if n else 0
+    if wmax == 0:
+        for j in range(n_fam):
+            out[j] = (np.empty(0, dtype=np.uint32) if has_cand[j]
+                      else np.array([int(target[j]) << 4], dtype=np.uint32))
+        return out
+
+    # candidate cigar byte matrix; non-candidates copy their family's first
+    # candidate so they can never break the equality test
+    fc = np.where(has_cand, first_cand, 0)
+    fc_rep = np.repeat(fc, sizes)
+    eff = np.where(cand, np.arange(n), fc_rep)
+    W = 4 * wmax
+    mat = np.zeros((n, W), dtype=np.uint8)
+    lens = 4 * nc[eff]
+    for k, s in enumerate(sources):
+        m = srci[eff] == k
+        rows = np.nonzero(m)[0]
+        if rows.size:
+            data, off2 = ragged_gather(s.batch.buf, cstart[eff][rows], lens[rows])
+            _fill_rows_at(mat, rows, data, off2, lens[rows])
+
+    eq = (mat == mat[fc_rep]).all(axis=1) & (nc[eff] == nc[fc_rep])
+    all_eq = np.logical_and.reduceat(eq, fam_off[:-1]) if n_fam else eq[:0]
+
+    for j in range(n_fam):
+        if not has_cand[j]:
+            out[j] = np.array([int(target[j]) << 4], dtype=np.uint32)
+        elif all_eq[j]:
+            i = int(first_cand[j])
+            out[j] = np.array(
+                np.ascontiguousarray(mat[i, : int(lens[i])]).view("<u4")
+            )
+        else:  # exact Counter-of-strings fallback
+            from collections import Counter
+
+            from consensuscruncher_tpu.io.bam import cigar_from_string
+            from consensuscruncher_tpu.io.encode import cigar_string_to_words
+
+            counts = Counter(
+                sources[int(srci[i])].batch.cigar_string(int(gidx[i]))
+                for i in range(fam_off[j], fam_off[j + 1])
+                if cand[i]
+            )
+            out[j] = cigar_string_to_words(
+                cigar_from_string(counts.most_common(1)[0][0])
+            )
+    return out
+
+
+def _build_block(sources: list[_BlockSrc], header: BamHeader) -> FamilyBlock:
+    """Vectorized family construction over one or more row sources."""
+    def col(fn):
+        return np.concatenate([fn(s) for s in sources])
+
+    rid = col(lambda s: s.batch.ref_id[s.rows])
+    pos = col(lambda s: s.batch.pos[s.rows])
+    mrid = col(lambda s: s.batch.mate_ref_id[s.rows])
+    mpos = col(lambda s: s.batch.mate_pos[s.rows])
+    flag = col(lambda s: s.batch.flag[s.rows])
+    mapq = col(lambda s: s.batch.mapq[s.rows].astype(np.int64))
+    tlen = col(lambda s: s.batch.tlen[s.rows])
+    mstart = col(lambda s: s.codes_off[s.rows])
+    mlen = col(lambda s: s.codes_off[s.rows + 1] - s.codes_off[s.rows])
+    gidx = col(lambda s: s.rows)
+    srci = np.repeat(
+        np.arange(len(sources), dtype=np.int64), [len(s.rows) for s in sources]
+    )
+    bclen = np.concatenate([s.bclen for s in sources])
+    wb = max((s.bcm.shape[1] for s in sources), default=0)
+    n = len(rid)
+    bcm = np.zeros((n, wb), dtype=np.uint8)
+    row = 0
+    for s in sources:
+        bcm[row : row + len(s.rows), : s.bcm.shape[1]] = s.bcm
+        row += len(s.rows)
+
+    rn = np.where((flag & FREAD1) != 0, 1, 2).astype(np.int8)
+    rev = ((flag & FREVERSE) != 0).astype(np.int8)
+
+    keys = [rev, rn, mpos, mrid]
+    keys += [bcm[:, j] for j in range(wb - 1, -1, -1)]
+    keys += [pos, rid]
+    order = np.lexsort(keys)
+
+    def srt(a):
+        return a[order]
+
+    kb = bcm[order]
+    same = np.ones(n, dtype=bool)
+    if n > 1:
+        same[1:] = (
+            (kb[1:] == kb[:-1]).all(axis=1)
+            & (srt(rid)[1:] == srt(rid)[:-1])
+            & (srt(pos)[1:] == srt(pos)[:-1])
+            & (srt(mrid)[1:] == srt(mrid)[:-1])
+            & (srt(mpos)[1:] == srt(mpos)[:-1])
+            & (srt(rn)[1:] == srt(rn)[:-1])
+            & (srt(rev)[1:] == srt(rev)[:-1])
+        )
+    fam_start = np.nonzero(~same)[0]
+    fam_off = np.concatenate([[0], fam_start, [n]]) if n else np.zeros(1, np.int64)
+    sizes = np.diff(fam_off)
+    n_fam = len(sizes)
+    fam_ids = np.repeat(np.arange(n_fam), sizes)
+
+    mem_len_s = srt(mlen)
+    target = _modal_lengths(fam_ids, mem_len_s, n_fam)
+    mapq_max = np.maximum.reduceat(srt(mapq), fam_off[:-1]) if n else srt(mapq)
+
+    first = order[fam_off[:-1]]
+    cigars = _modal_cigars(
+        sources, srt(srci), srt(gidx), fam_off, mem_len_s, target, n_fam
+    )
+
+    # per-family python: barcode string + tag; emission order (rid, pos, str)
+    ref_names = [header.ref_name(i) for i in range(len(header.refs))]
+
+    def _rname(i):
+        return ref_names[i] if i >= 0 else "*"
+
+    tags = []
+    for j in range(n_fam):
+        i = first[j]
+        tags.append(tags_mod.FamilyTag(
+            barcode=bcm[i, : bclen[i]].tobytes().decode("ascii"),
+            ref=_rname(int(rid[i])),
+            pos=int(pos[i]),
+            mate_ref=_rname(int(mrid[i])),
+            mate_pos=int(mpos[i]),
+            read_number=int(rn[i]),
+            orientation="rev" if rev[i] else "fwd",
+        ))
+    frid = rid[first]
+    fpos = pos[first]
+    perm = sorted(range(n_fam),
+                  key=lambda j: (int(frid[j]), int(fpos[j]), str(tags[j])))
+    perm_arr = np.asarray(perm, dtype=np.int64)
+
+    blk = FamilyBlock()
+    blk.tags = [tags[j] for j in perm]
+    blk.sizes = sizes[perm_arr]
+    blk.target_len = target[perm_arr]
+    blk.tmpl_flag = flag[first][perm_arr]
+    blk.tmpl_rid = rid[first][perm_arr]
+    blk.tmpl_pos = pos[first][perm_arr]
+    blk.tmpl_mrid = mrid[first][perm_arr]
+    blk.tmpl_mpos = mpos[first][perm_arr]
+    blk.tmpl_tlen = tlen[first][perm_arr]
+    blk.mapq_max = mapq_max[perm_arr]
+    blk.cigar_words = [cigars[j] for j in perm]
+    fsrc = srci[first]
+    fgid = gidx[first]
+    blk.tmpl_src = [
+        (sources[int(fsrc[j])].batch, int(fgid[j])) for j in perm
+    ]
+    blk.data_chunks = [(s.codes_data, s.qual_data) for s in sources]
+    # permute member geometry to emission order without per-family slicing:
+    # rank families by perm, stable-argsort members by their family's rank
+    fam_rank = np.empty(n_fam, dtype=np.int64)
+    fam_rank[perm_arr] = np.arange(n_fam)
+    msel = np.argsort(fam_rank[fam_ids], kind="stable")
+    final = order[msel]
+    blk.mem_start = mstart[final]
+    blk.mem_len = mlen[final]
+    blk.mem_chunk = srci[final].astype(np.uint8)
+    new_off = np.zeros(n_fam + 1, dtype=np.int64)
+    np.cumsum(blk.sizes, out=new_off[1:])
+    blk.fam_off = new_off
+    return blk
+
+
+def stream_family_blocks(
+    creader,
+    header: BamHeader,
+    bdelim: str = tags_mod.DEFAULT_BDELIM,
+) -> Iterator[tuple[str, object, object]]:
+    """Block producer: ``("bad", read, reason)`` / ``("block", FamilyBlock,
+    None)`` events with stream_families' grouping/order/filter semantics."""
+    bdelim_byte = ord(bdelim)
+    carry: list[_BlockSrc] = []
+    carry_key: tuple[int, int] | None = None
+    for batch in creader.batches():
+        reason, last, bclen = _classify_batch(batch, bdelim_byte)
+        bad = np.nonzero(reason != 0)[0]
+        for i in bad:
+            yield "bad", batch.materialize(int(i)), _BAD_REASONS[int(reason[i])]
+        good = np.nonzero(reason == 0)[0]
+        if good.size == 0:
+            continue
+        rid = batch.ref_id[good]
+        pos = batch.pos[good]
+        ok = (rid[1:] > rid[:-1]) | ((rid[1:] == rid[:-1]) & (pos[1:] >= pos[:-1]))
+        if not ok.all():
+            i = int(np.argmin(ok)) + 1
+            read = batch.materialize(int(good[i]))
+            raise NotCoordinateSorted(
+                f"input BAM is not coordinate-sorted: {read.qname} at "
+                f"{read.ref}:{read.pos} after ref_id={int(rid[i - 1])} "
+                f"pos={int(pos[i - 1])} — run sort first"
+            )
+        first_key = (int(rid[0]), int(pos[0]))
+        if carry_key is not None and first_key < carry_key:
+            read = batch.materialize(int(good[0]))
+            raise NotCoordinateSorted(
+                f"input BAM is not coordinate-sorted: {read.qname} at "
+                f"{read.ref}:{read.pos} after ref_id={carry_key[0]} "
+                f"pos={carry_key[1]} — run sort first"
+            )
+        # barcode matrix for good rows
+        qm = batch.qname_matrix
+        w = qm.shape[1]
+        wb = int(bclen[good].max(initial=0))
+        cols = np.arange(wb, dtype=np.int64)
+        src = last[good][:, None] + 1 + cols[None, :]
+        valid = cols[None, :] < bclen[good][:, None]
+        bcm = np.where(valid, qm[good[:, None], np.minimum(src, w - 1)], 0).astype(np.uint8)
+
+        # defer the trailing coordinate (it may continue in the next batch)
+        tail_mask = (rid == rid[-1]) & (pos == pos[-1])
+        n_tail = int(tail_mask.sum())
+        body_n = good.size - n_tail
+        body_src = (
+            _BlockSrc(batch, good[:body_n], bcm[:body_n], bclen[good[:body_n]])
+            if body_n else None
+        )
+        tail_src = _BlockSrc(batch, good[body_n:], bcm[body_n:], bclen[good[body_n:]])
+
+        if body_n:
+            if carry and first_key == carry_key:
+                # carry's coordinate continues into this batch's body
+                yield "block", _build_block(carry + [body_src], header), None
+            elif carry:
+                yield "block", _build_block(carry, header), None
+                yield "block", _build_block([body_src], header), None
+            else:
+                yield "block", _build_block([body_src], header), None
+            carry = [tail_src]
+        else:  # whole batch is one coordinate
+            if carry and first_key == carry_key:
+                carry.append(tail_src)
+            else:
+                if carry:
+                    yield "block", _build_block(carry, header), None
+                carry = [tail_src]
+        carry_key = (int(rid[-1]), int(pos[-1]))
+    if carry:
+        yield "block", _build_block(carry, header), None

@@ -140,10 +140,14 @@ def run_sscs(
         # Production path: columnar batch decode + vectorized grouping
         # (same events, same order — stage outputs are byte-identical).
         from consensuscruncher_tpu.io.columnar import ColumnarReader
-        from consensuscruncher_tpu.stages.grouping import stream_families_columnar
 
         reader = ColumnarReader(in_bam)
         header = reader.header
+        source = None  # built below once the pipeline flavor is known
+    use_blocks = backend == "tpu" and wire == "stream" and mesh is None
+    if backend != "reference" and not use_blocks:
+        from consensuscruncher_tpu.stages.grouping import stream_families_columnar
+
         source = stream_families_columnar(reader, header, bdelim)
     bad_writer = BamWriter(bad_path, header, atomic=True)
     sscs_writer = BamWriter(sscs_tmp, header)
@@ -179,7 +183,61 @@ def run_sscs(
             yield next_id, seqs, quals
             next_id += 1
 
+    def block_items():
+        """Fully-vectorized producer: route FamilyBlock events, register
+        pending families, hand the device pipeline array-level items."""
+        from consensuscruncher_tpu.stages.grouping import stream_family_blocks
+
+        next_id = 0
+        for kind, a, b in stream_family_blocks(reader, header, bdelim):
+            if kind == "bad":
+                stats.incr("total_reads")
+                stats.incr(f"bad_{b}")
+                stats.incr("bad_reads")
+                bad_writer.write(a)
+                continue
+            block = a
+            sizes = block.sizes
+            stats.incr("total_reads", int(sizes.sum()))
+            stats.incr("families", block.n_fam)
+            for s in sizes:
+                hist.add(int(s))
+            multi = np.nonzero(sizes >= 2)[0]
+            stats.incr("singletons", block.n_fam - len(multi))
+            for j in np.nonzero(sizes == 1)[0]:
+                batch, idx = block.tmpl_src[int(j)]
+                out = batch.materialize(idx)
+                tag = block.tags[int(j)]
+                out.qname = tags_mod.sscs_qname(tag)
+                out.tags = dict(out.tags)
+                out.tags["XT"] = ("Z", tag.barcode)
+                out.tags["XF"] = ("i", 1)
+                singleton_writer.write(out)
+            if len(multi) == 0:
+                continue
+            ids = list(range(next_id, next_id + len(multi)))
+            for fid, j in zip(ids, multi):
+                pending[fid] = (block, int(j))
+            next_id += len(multi)
+            yield block, multi, ids
+
     rec_writer = ConsensusRecordWriter(sscs_writer)
+
+    def emit_block(fid, codes, quals):
+        block, j = pending.pop(fid)
+        tag = block.tags[j]
+        tag_blob = (
+            b"XTZ" + tag.barcode.encode("ascii")
+            + b"\x00XFi" + struct.pack("<i", int(block.sizes[j]))
+        )
+        rec_writer.add(
+            tags_mod.sscs_qname(tag), int(block.tmpl_flag[j]) & _KEEP_FLAGS,
+            int(block.tmpl_rid[j]), int(block.tmpl_pos[j]),
+            int(block.mapq_max[j]), block.cigar_words[j],
+            int(block.tmpl_mrid[j]), int(block.tmpl_mpos[j]),
+            int(block.tmpl_tlen[j]), codes, quals, tag_blob,
+        )
+        stats.incr("sscs_written")
 
     def emit(fid, codes, quals):
         tag, members = pending.pop(fid)
@@ -218,20 +276,22 @@ def run_sscs(
     ok = False
     try:
         if backend == "tpu":
-            if mesh is None and wire == "stream":
+            if use_blocks:
                 from consensuscruncher_tpu.ops.consensus_segment import (
-                    consensus_families_stream,
+                    consensus_blocks_stream,
                 )
 
                 # 4x the dense batch size: the packed wire makes bytes cheap,
                 # and on a tunneled device per-dispatch roundtrip latency is
                 # the cost that's left — fewer, larger batches win.
-                stream = consensus_families_stream(events(), cfg, max_batch=4 * max_batch)
+                stream = consensus_blocks_stream(block_items(), cfg, max_batch=4 * max_batch)
+                sink = emit_block
             else:
                 stream = consensus_families(events(), cfg, max_batch=max_batch, mesh=mesh)
+                sink = emit
             try:
                 for fid, codes, quals in stream:
-                    emit(fid, codes, quals)
+                    sink(fid, codes, quals)
             finally:
                 # Must run BEFORE the writers close below: closing the stream
                 # stops and joins the prefetch producer thread, which is the
