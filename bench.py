@@ -55,8 +55,8 @@ def _env_int(name: str, default: int) -> int:
     return int(os.environ.get(name, default))
 
 
-FRAGMENTS = _env_int("CCT_BENCH_FRAGMENTS", 5_000)
-REF_FRAGMENTS = _env_int("CCT_BENCH_REF_FRAGMENTS", 400)
+FRAGMENTS = _env_int("CCT_BENCH_FRAGMENTS", 20_000)
+REF_FRAGMENTS = _env_int("CCT_BENCH_REF_FRAGMENTS", 1_000)
 READ_LEN = _env_int("CCT_BENCH_LEN", 100)
 MEAN_FAM = _env_int("CCT_BENCH_MEAN_FAM", 4)
 TPU_TIMEOUT = _env_int("CCT_BENCH_TPU_TIMEOUT", 600)

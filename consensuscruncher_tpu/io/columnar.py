@@ -46,6 +46,7 @@ from consensuscruncher_tpu.io.bam import (
     read_bam_header,
 )
 from consensuscruncher_tpu.utils.phred import N as CODE_N, encode_seq
+from consensuscruncher_tpu.utils.ragged import gather_runs
 
 # nibble (0-15, spec '=ACMGRSVTWYHKDBN') -> pipeline base code (A=0..N=4);
 # every ambiguity code collapses to N exactly like decode->encode_seq does.
@@ -63,30 +64,9 @@ def _gather_view(buf: np.ndarray, off: np.ndarray, width: int, dtype: str) -> np
 
 
 def ragged_gather(buf: np.ndarray, starts: np.ndarray, lengths: np.ndarray):
-    """Gather ``n`` variable-length byte runs into one packed array.
-
-    Returns ``(data, offsets)`` with ``offsets`` shaped ``(n+1,)`` —
-    run ``i`` is ``data[offsets[i]:offsets[i+1]]``.
-    """
-    lengths = lengths.astype(np.int64)
-    off = np.zeros(len(lengths) + 1, dtype=np.int64)
-    np.cumsum(lengths, out=off[1:])
-    total = int(off[-1])
-    if total == 0:
-        return np.empty(0, dtype=np.uint8), off
-    n = len(lengths)
-    # Uniform-length fast path (fixed-length reads dominate real BAMs): one
-    # 2-D gather instead of three total-length int64 index arrays.
-    if n and int(lengths[0]) and (lengths == lengths[0]).all():
-        l0 = int(lengths[0])
-        out = buf[starts.astype(np.int64)[:, None] + np.arange(l0, dtype=np.int64)]
-        return out.reshape(-1), off
-    idx = (
-        np.arange(total, dtype=np.int64)
-        - np.repeat(off[:-1], lengths)
-        + np.repeat(starts.astype(np.int64), lengths)
-    )
-    return buf[idx], off
+    """Gather ``n`` variable-length byte runs into one packed array — the
+    shared :func:`utils.ragged.gather_runs` under its historical name."""
+    return gather_runs(buf, starts, lengths)
 
 
 @dataclass

@@ -16,6 +16,7 @@ from __future__ import annotations
 import numpy as np
 
 from consensuscruncher_tpu.io.bam import SEQ_NIBBLES
+from consensuscruncher_tpu.utils.ragged import gather_runs, scatter_runs
 
 # pipeline base code (A=0 C=1 G=2 T=3 N=4) -> BAM seq nibble
 _NIB_OF_CHAR = {c: i for i, c in enumerate(SEQ_NIBBLES)}
@@ -23,23 +24,6 @@ CODE2NIB = np.array([_NIB_OF_CHAR[c] for c in "ACGTN"], dtype=np.uint8)
 
 # cigar ops consuming reference (MDN=X) by op code index in "MIDNSHP=X"
 _REF_CONSUMING = np.array([1, 0, 1, 1, 0, 0, 0, 1, 1], dtype=np.int64)
-
-
-def _scatter_ragged(out: np.ndarray, dst_starts: np.ndarray, data: np.ndarray,
-                    lens: np.ndarray) -> None:
-    """out[dst_starts[i] : dst_starts[i]+lens[i]] = data[run i] for all i."""
-    lens = lens.astype(np.int64)
-    total = int(lens.sum())
-    if total == 0:
-        return
-    src_off = np.zeros(len(lens), dtype=np.int64)
-    np.cumsum(lens[:-1], out=src_off[1:])
-    idx = (
-        np.arange(total, dtype=np.int64)
-        - np.repeat(src_off, lens)
-        + np.repeat(dst_starts.astype(np.int64), lens)
-    )
-    out[idx] = data[:total]
 
 
 def reg2bin_vec(beg: np.ndarray, end: np.ndarray) -> np.ndarray:
@@ -128,11 +112,11 @@ def encode_records(
     out[(starts[:, None] + np.arange(36)).ravel()] = head.ravel()
 
     cur = starts + 36
-    _scatter_ragged(out, cur, np.asarray(qname_data, dtype=np.uint8), qname_lens)
+    scatter_runs(out, cur, np.asarray(qname_data, dtype=np.uint8), qname_lens)
     # NUL terminators land at cur + qname_lens (out is zero-initialized)
     cur = cur + lq
     if len(cigar_words):
-        _scatter_ragged(
+        scatter_runs(
             out, cur, cigar_words.astype("<u4").view(np.uint8), 4 * cigar_lens
         )
     cur = cur + 4 * cigar_lens
@@ -142,14 +126,14 @@ def encode_records(
     padded = np.zeros(int(pad_lens.sum()), dtype=np.uint8)
     pstarts = np.zeros(n, dtype=np.int64)
     np.cumsum(pad_lens[:-1], out=pstarts[1:])
-    _scatter_ragged(padded, pstarts, CODE2NIB[np.asarray(codes_data)], codes_lens)
+    scatter_runs(padded, pstarts, CODE2NIB[np.asarray(codes_data)], codes_lens)
     packed = (padded[0::2] << 4) | padded[1::2]
-    _scatter_ragged(out, cur, packed, nsb)
+    scatter_runs(out, cur, packed, nsb)
     cur = cur + nsb
 
-    _scatter_ragged(out, cur, np.asarray(qual_data, dtype=np.uint8), codes_lens)
+    scatter_runs(out, cur, np.asarray(qual_data, dtype=np.uint8), codes_lens)
     cur = cur + codes_lens
-    _scatter_ragged(out, cur, np.asarray(tag_data, dtype=np.uint8), tag_lens)
+    scatter_runs(out, cur, np.asarray(tag_data, dtype=np.uint8), tag_lens)
     return out
 
 
@@ -235,3 +219,121 @@ class ConsensusRecordWriter:
         self._writer.write_encoded(blob)
         self.n_written += n
         self._reset()
+
+
+class RenameRetagWriter:
+    """Batched qname-rename + tag-append over raw columnar records.
+
+    The SSCS singleton path rewrites each size-1 family's read with a
+    consensus qname and XT/XF tags; doing that through decode_record +
+    encode_record costs ~20 us/read.  This writer performs the rewrite as
+    blob surgery: the record's cigar+seq+qual+tags span is one contiguous
+    byte slice, so the output is [patched 36-byte head][new qname NUL]
+    [original mid slice][appended tag blob] — assembled for a whole batch
+    with the same scatter passes as ``encode_records``.  The bin field is
+    recomputed from pos + cigar span exactly like ``encode_record``, so
+    bytes match the object path (which re-encodes) on self-produced BAMs.
+
+    Caller contract: records must NOT already carry any appended tag key
+    (the object path's dict would replace in place; here we only append) —
+    the SSCS stage routes reads that already have XT through the object
+    fallback.
+    """
+
+    def __init__(self, writer, flush_at: int = 8192, max_batches: int = 4):
+        self._writer = writer
+        self._flush_at = flush_at
+        self._max_batches = max_batches
+        self._items: list[tuple] = []  # (batch, idx, qname bytes, tag blob)
+        self._batch_ids: set[int] = set()
+
+    def add(self, batch, idx: int, qname: str, tag_blob: bytes) -> None:
+        self._items.append((batch, idx, qname.encode("ascii"), tag_blob))
+        self._batch_ids.add(id(batch))
+        # Bound retention in BYTES too: every buffered item pins its whole
+        # source batch (tens of MB); sparse singletons would otherwise hold
+        # hundreds of batches alive before the count-based flush fires.
+        if (len(self._items) >= self._flush_at
+                or len(self._batch_ids) > self._max_batches):
+            self.flush()
+
+    def flush(self) -> None:
+        if not self._items:
+            return
+        by_batch: dict[int, list[int]] = {}
+        batches: list = []
+        for k, (batch, *_rest) in enumerate(self._items):
+            bid = id(batch)
+            if bid not in by_batch:
+                by_batch[bid] = []
+                batches.append(batch)
+            by_batch[bid].append(k)
+        # assemble in add order; per-record source columns gathered per batch
+        n = len(self._items)
+        idx_arr = np.fromiter((it[1] for it in self._items), np.int64, n)
+        qnames = [it[2] for it in self._items]
+        tags = [it[3] for it in self._items]
+        qlen = np.fromiter((len(q) for q in qnames), np.int64, n)
+        tglen = np.fromiter((len(t) for t in tags), np.int64, n)
+
+        rec_off = np.empty(n, np.int64)
+        rec_end = np.empty(n, np.int64)
+        cig_start = np.empty(n, np.int64)
+        ncig = np.empty(n, np.int64)
+        pos = np.empty(n, np.int64)
+        src_of = np.empty(n, np.int64)
+        for bi, batch in enumerate(batches):
+            rows = np.asarray(by_batch[id(batch)], np.int64)
+            ridx = idx_arr[rows]
+            rec_off[rows] = batch.rec_off[ridx]
+            rec_end[rows] = batch.rec_off[ridx + 1]
+            cig_start[rows] = batch.cigar_start[ridx]
+            ncig[rows] = batch.n_cigar[ridx]
+            pos[rows] = batch.pos[ridx]
+            src_of[rows] = bi
+
+        mid_len = rec_end - cig_start
+        rec_len = 36 + (qlen + 1) + mid_len + tglen
+        starts = np.zeros(n, np.int64)
+        np.cumsum(rec_len[:-1], out=starts[1:])
+        out = np.zeros(int(rec_len.sum()), np.uint8)
+
+        # heads: original core bytes, then patch block_size/l_qname/bin
+        head = np.zeros((n, 36), np.uint8)
+        for bi, batch in enumerate(batches):
+            rows = np.nonzero(src_of == bi)[0]
+            head[rows] = batch.buf[
+                rec_off[rows][:, None] + np.arange(36, dtype=np.int64)
+            ]
+        hv = head.view("<i4")
+        hv[:, 0] = (rec_len - 4).astype(np.int32)
+        head[:, 12] = (qlen + 1).astype(np.uint8)
+        # recompute bin from pos + cigar span (encode_record parity)
+        span = np.zeros(n, np.int64)
+        for bi, batch in enumerate(batches):
+            rows = np.nonzero((src_of == bi) & (ncig > 0))[0]
+            if not rows.size:
+                continue
+            data, off = gather_runs(batch.buf, cig_start[rows], 4 * ncig[rows])
+            words = np.ascontiguousarray(data).view("<u4")
+            consumes = _REF_CONSUMING[words & 0xF] * (words >> 4).astype(np.int64)
+            woff = (off // 4)[:-1]
+            span[rows] = np.add.reduceat(
+                np.concatenate([consumes, [0]]), np.minimum(woff, len(consumes))
+            )[: len(rows)]
+        hb = head.view("<u2")
+        hb[:, 7] = reg2bin_vec(pos, pos + np.maximum(1, span)).astype(np.uint16)
+        out[(starts[:, None] + np.arange(36)).ravel()] = head.ravel()
+
+        cur = starts + 36
+        scatter_runs(out, cur, np.frombuffer(b"".join(qnames), np.uint8), qlen)
+        cur = cur + qlen + 1  # NUL from zero-init
+        for bi, batch in enumerate(batches):
+            rows = np.nonzero(src_of == bi)[0]
+            data, _ = gather_runs(batch.buf, cig_start[rows], mid_len[rows])
+            scatter_runs(out, cur[rows], data, mid_len[rows])
+        cur = cur + mid_len
+        scatter_runs(out, cur, np.frombuffer(b"".join(tags), np.uint8), tglen)
+        self._writer.write_encoded(out)
+        self._items.clear()
+        self._batch_ids.clear()

@@ -487,7 +487,10 @@ def _run_member_batch_stream(batches, config: ConsensusConfig,
 
     def dispatch(item):
         batch, wire, a, b, member_cap = item
-        out_len = int(batch.lengths.max(initial=0)) or None
+        # quantize the d2h slice length to 8 so jit specializations stay
+        # bounded (<=4 per 32-wide length bucket, not 32)
+        out_len = int(batch.lengths.max(initial=0))
+        out_len = -(-out_len // 8) * 8 or None
         fn = _compiled_stream_vote(wire, num, den, qt, qc, member_cap, out_len)
         return fn(a, b, batch.sizes)
 

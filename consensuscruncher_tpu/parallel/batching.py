@@ -30,6 +30,7 @@ from typing import Iterable, Iterator, Sequence
 import numpy as np
 
 from consensuscruncher_tpu.utils.phred import N, PAD
+from consensuscruncher_tpu.utils.ragged import fill_runs, scatter_runs
 
 LEN_QUANTUM = 32
 MIN_BATCH = 8
@@ -247,52 +248,6 @@ def _emit(bucket: _Bucket, fb: int, lb: int, pad_to: int | None) -> FamilyBatch:
     )
 
 
-def _scatter_from(flat, dst_starts, src, src_starts, lens):
-    """flat[dst_starts[i]:+lens[i]] = src[src_starts[i]:+lens[i]] per run."""
-    lens = lens.astype(np.int64)
-    total = int(lens.sum())
-    if total == 0:
-        return
-    n = len(lens)
-    # Fixed-length-read fast path: uniform run length means one 2-D gather;
-    # if destinations are also evenly strided (contiguous rows of a matrix),
-    # the write is a plain slice assignment — near-memcpy speed.
-    if n and (lens == lens[0]).all():
-        l0 = int(lens[0])
-        vals = src[src_starts.astype(np.int64)[:, None] + np.arange(l0)]
-        d0 = int(dst_starts[0])
-        if n == 1 or ((np.diff(dst_starts) == dst_starts[1] - dst_starts[0]).all()):
-            stride = int(dst_starts[1] - dst_starts[0]) if n > 1 else l0
-            if stride >= l0:
-                view = np.lib.stride_tricks.as_strided(
-                    flat[d0:], shape=(n, l0),
-                    strides=(stride * flat.itemsize, flat.itemsize),
-                    writeable=True,
-                )
-                view[:] = vals
-                return
-        flat[dst_starts.astype(np.int64)[:, None] + np.arange(l0)] = vals
-        return
-    rel = np.arange(total, dtype=np.int64) - np.repeat(
-        np.concatenate([[0], np.cumsum(lens[:-1])]), lens
-    )
-    flat[np.repeat(dst_starts.astype(np.int64), lens) + rel] = src[
-        np.repeat(src_starts.astype(np.int64), lens) + rel
-    ]
-
-
-def _fill_const(flat, dst_starts, lens, value):
-    """flat[dst_starts[i]:+lens[i]] = value per run."""
-    lens = lens.astype(np.int64)
-    total = int(lens.sum())
-    if total == 0:
-        return
-    rel = np.arange(total, dtype=np.int64) - np.repeat(
-        np.concatenate([[0], np.cumsum(lens[:-1])]), lens
-    )
-    flat[np.repeat(dst_starts.astype(np.int64), lens) + rel] = value
-
-
 class _BlockBucket:
     __slots__ = ("chunks", "keys", "sizes", "lengths", "members")
 
@@ -336,11 +291,11 @@ def bucket_member_blocks(
         for codes_data, qual_data, mstart, mlen, mtarget, dst_row in bucket.chunks:
             dst = dst_row * lb
             minlt = np.minimum(mlen, mtarget)
-            _scatter_from(flat_r, dst, codes_data, mstart, minlt)
-            _scatter_from(flat_q, dst, qual_data, mstart, minlt)
+            scatter_runs(flat_r, dst, codes_data, minlt, src_starts=mstart)
+            scatter_runs(flat_q, dst, qual_data, minlt, src_starts=mstart)
             gap = mtarget - minlt  # short members pad with (N, qual 0)
-            _fill_const(flat_r, dst + minlt, gap, N)
-            _fill_const(flat_q, dst + minlt, gap, 0)
+            fill_runs(flat_r, dst + minlt, gap, N)
+            fill_runs(flat_q, dst + minlt, gap, 0)
             # dead cells past target keep init values (0 / sentinel)
         sizes = np.zeros(cap, dtype=np.int32)
         lengths = np.zeros(cap, dtype=np.int32)

@@ -644,19 +644,14 @@ def _modal_lengths(fam_ids, lens, n_fam):
 
 
 def _fill_rows_at(mat, row_idx, data, off, lens):
-    """mat[row_idx[i], :lens[i]] = data[off[i]:off[i+1]] for all i."""
-    lens = lens.astype(np.int64)
-    total = int(lens.sum())
-    if total == 0:
-        return
-    w = mat.shape[1]
-    flat = mat.reshape(-1)
-    dst = (
-        np.arange(total, dtype=np.int64)
-        - np.repeat(off[:-1], lens)
-        + np.repeat(row_idx.astype(np.int64) * w, lens)
-    )
-    flat[dst] = data
+    """mat[row_idx[i], :lens[i]] = data[off[i]:off[i+1]] for all i.
+
+    ``data``/``off`` come from gather_runs, so the source is packed tight —
+    this is :func:`utils.ragged.scatter_runs` over the flattened matrix."""
+    from consensuscruncher_tpu.utils.ragged import scatter_runs
+
+    scatter_runs(mat.reshape(-1), row_idx.astype(np.int64) * mat.shape[1],
+                 data, lens)
 
 
 def _modal_cigars(sources, srci, gidx, fam_off, mem_len, target, n_fam):
@@ -853,7 +848,7 @@ def _build_block(sources: list[_BlockSrc], header: BamHeader) -> FamilyBlock:
     final = order[msel]
     blk.mem_start = mstart[final]
     blk.mem_len = mlen[final]
-    blk.mem_chunk = srci[final].astype(np.uint8)
+    blk.mem_chunk = srci[final].astype(np.int32)  # >256 carry sources is legal
     new_off = np.zeros(n_fam + 1, dtype=np.int64)
     np.cumsum(blk.sizes, out=new_off[1:])
     blk.fam_off = new_off

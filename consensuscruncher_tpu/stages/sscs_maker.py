@@ -38,7 +38,11 @@ from consensuscruncher_tpu.core.consensus_read import (
     modal_cigar,
 )
 from consensuscruncher_tpu.io.bam import BamReader, BamWriter, sort_bam
-from consensuscruncher_tpu.io.encode import ConsensusRecordWriter, cigar_string_to_words
+from consensuscruncher_tpu.io.encode import (
+    ConsensusRecordWriter,
+    RenameRetagWriter,
+    cigar_string_to_words,
+)
 from consensuscruncher_tpu.stages.grouping import MemberView
 from consensuscruncher_tpu.ops.consensus_tpu import ConsensusConfig, consensus_families
 from consensuscruncher_tpu.parallel.batching import rectangularize
@@ -183,6 +187,9 @@ def run_sscs(
             yield next_id, seqs, quals
             next_id += 1
 
+    single_surgery = RenameRetagWriter(singleton_writer)
+    _XF1 = struct.pack("<i", 1)
+
     def block_items():
         """Fully-vectorized producer: route FamilyBlock events, register
         pending families, hand the device pipeline array-level items."""
@@ -206,8 +213,18 @@ def run_sscs(
             stats.incr("singletons", block.n_fam - len(multi))
             for j in np.nonzero(sizes == 1)[0]:
                 batch, idx = block.tmpl_src[int(j)]
-                out = batch.materialize(idx)
                 tag = block.tags[int(j)]
+                if batch.tags_start[idx] == batch.rec_off[idx + 1]:
+                    # tag-less record: rename+retag as batched blob surgery
+                    single_surgery.add(
+                        batch, idx, tags_mod.sscs_qname(tag),
+                        b"XTZ" + tag.barcode.encode("ascii") + b"\x00XFi" + _XF1,
+                    )
+                    continue
+                # existing tags: the object path's dict-replace semantics
+                # (surgery only appends); flush first to preserve file order
+                single_surgery.flush()
+                out = batch.materialize(idx)
                 out.qname = tags_mod.sscs_qname(tag)
                 out.tags = dict(out.tags)
                 out.tags["XT"] = ("Z", tag.barcode)
@@ -319,6 +336,7 @@ def run_sscs(
                 )
                 emit(fid, codes, cquals)
         rec_writer.flush()
+        single_surgery.flush()
         ok = True
     finally:
         reader.close()

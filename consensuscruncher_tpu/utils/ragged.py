@@ -1,0 +1,100 @@
+"""Shared ragged-run primitives: the one home of the cumsum/repeat index math.
+
+Every columnar subsystem moves data as "runs" — per-record byte spans of
+varying length. Five near-identical arange-minus-repeat implementations had
+accreted across io/columnar, io/encode, parallel/batching and
+stages/grouping; this module owns the pattern (and its fast paths) so a fix
+or optimization lands everywhere at once.
+
+- :func:`gather_runs` — pull runs out of a buffer into one packed array.
+- :func:`scatter_runs` — write runs into a flat output (packed or per-run
+  addressed source), with a uniform-length fast path and a strided-slice
+  fast path for evenly spaced destinations (matrix rows).
+- :func:`fill_runs` — constant-fill runs.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _run_index(starts: np.ndarray, lens: np.ndarray) -> np.ndarray:
+    """Flat index array covering run i at starts[i] for lens[i] elements."""
+    total = int(lens.sum())
+    off = np.zeros(len(lens), dtype=np.int64)
+    np.cumsum(lens[:-1], out=off[1:])
+    return (
+        np.arange(total, dtype=np.int64)
+        - np.repeat(off, lens)
+        + np.repeat(starts.astype(np.int64), lens)
+    )
+
+
+def gather_runs(buf: np.ndarray, starts: np.ndarray, lengths: np.ndarray):
+    """Gather ``n`` variable-length runs into one packed array.
+
+    Returns ``(data, offsets)`` with ``offsets`` shaped ``(n+1,)`` — run
+    ``i`` is ``data[offsets[i]:offsets[i+1]]``.
+    """
+    lengths = lengths.astype(np.int64)
+    off = np.zeros(len(lengths) + 1, dtype=np.int64)
+    np.cumsum(lengths, out=off[1:])
+    total = int(off[-1])
+    if total == 0:
+        return np.empty(0, dtype=buf.dtype), off
+    n = len(lengths)
+    # Uniform-length fast path (fixed-length reads dominate real BAMs): one
+    # 2-D gather instead of three total-length int64 index arrays.
+    if n and int(lengths[0]) and (lengths == lengths[0]).all():
+        l0 = int(lengths[0])
+        out = buf[starts.astype(np.int64)[:, None] + np.arange(l0, dtype=np.int64)]
+        return out.reshape(-1), off
+    return buf[_run_index(starts, lengths)], off
+
+
+def scatter_runs(out: np.ndarray, dst_starts: np.ndarray, src: np.ndarray,
+                 lens: np.ndarray, src_starts: np.ndarray | None = None) -> None:
+    """``out[dst_starts[i]:+lens[i]] = src run i``.
+
+    Source runs are packed tight in ``src`` (cumsum offsets) when
+    ``src_starts`` is None, else addressed per run at ``src_starts[i]``.
+    """
+    lens = lens.astype(np.int64)
+    total = int(lens.sum())
+    if total == 0:
+        return
+    n = len(lens)
+    if n and (lens == lens[0]).all():
+        l0 = int(lens[0])
+        if src_starts is None:
+            vals = src[:total].reshape(n, l0)
+        else:
+            vals = src[src_starts.astype(np.int64)[:, None] + np.arange(l0)]
+        d = dst_starts.astype(np.int64)
+        # evenly strided destinations (rows of a matrix) write as one
+        # strided slice assignment — near-memcpy
+        if n > 1:
+            step = np.diff(d)
+            if (step == step[0]).all() and int(step[0]) >= l0:
+                view = np.lib.stride_tricks.as_strided(
+                    out[int(d[0]):], shape=(n, l0),
+                    strides=(int(step[0]) * out.itemsize, out.itemsize),
+                    writeable=True,
+                )
+                view[:] = vals
+                return
+        out[d[:, None] + np.arange(l0)] = vals
+        return
+    if src_starts is None:  # tight runs: flattened source order is sequential
+        out[_run_index(dst_starts, lens)] = src[:total]
+        return
+    out[_run_index(dst_starts, lens)] = src[_run_index(src_starts, lens)]
+
+
+def fill_runs(out: np.ndarray, dst_starts: np.ndarray, lens: np.ndarray,
+              value) -> None:
+    """``out[dst_starts[i]:+lens[i]] = value`` for every run."""
+    lens = lens.astype(np.int64)
+    if int(lens.sum()) == 0:
+        return
+    out[_run_index(dst_starts, lens)] = value
