@@ -172,6 +172,171 @@ class _DuplexBatcher:
             self._flush_len(L)
 
 
+def _run_dcs_windows(reader, stats, dcs_writer, unpaired_writer, rec_writer,
+                     qual_cap: int, backend: str) -> None:
+    """Object-window pairing walk (foreign consensus BAMs: records whose
+    tag block doesn't lead with XT:Z+XF:i)."""
+    batcher = _DuplexBatcher(qual_cap, reader.header, backend=backend)
+
+    def sink(tag, canon, other, codes, quals):
+        fam_size = canon.xf + other.xf
+        L = codes.shape[0]
+        words = canon.cigar if canon.seq_len == L else np.array([L << 4], np.uint32)
+        tag_blob = (
+            b"XTZ" + tag.barcode.encode("ascii")
+            + b"\x00XFi" + struct.pack("<i", fam_size)
+        )
+        rec_writer.add(
+            tags_mod.dcs_qname(tag), canon.flag & _KEEP_FLAGS, canon.rid,
+            canon.pos, canon.mapq, words, canon.mrid, canon.mate_pos,
+            canon.tlen, codes, quals, tag_blob,
+        )
+        stats.incr("dcs_written")
+
+    for _key, window in consensus_windows_columnar(reader):
+        paired: set = set()
+        for tag in sorted(window, key=str):
+            if tag in paired:
+                continue
+            stats.incr("sscs_total")
+            partner = tags_mod.duplex_tag(tag)
+            other = window.get(partner)
+            if other is None or partner in paired:
+                stats.incr("sscs_unpaired")
+                unpaired_writer.write(window[tag].materialize())
+                continue
+            stats.incr("sscs_total")  # partner consumed here
+            paired.add(tag)
+            paired.add(partner)
+            read, oread = window[tag], other
+            if read.seq_len != oread.seq_len:
+                stats.incr("sscs_unpaired", 2)
+                stats.incr("length_mismatch_pairs")
+                unpaired_writer.write(read.materialize())
+                unpaired_writer.write(oread.materialize())
+                continue
+            # canonical strand: barcode lexicographically <= its mirror
+            if tag.barcode <= partner.barcode:
+                batcher.add(tag, read, oread, sink)
+            else:
+                batcher.add(partner, oread, read, sink)
+            stats.incr("pairs")
+    batcher.flush()
+
+
+def _consume_pair_blocks(reader, stats, unpaired_writer, rec_writer,
+                         qual_cap: int, backend: str) -> None:
+    """Vectorized pairing (grouping.duplex_pair_blocks): unpaired reads pass
+    through as raw blobs, pairs vote in one device batch per length group,
+    and duplex records assemble through the columnar record writer."""
+    from consensuscruncher_tpu.stages.grouping import duplex_pair_blocks
+    from consensuscruncher_tpu.utils.ragged import gather_runs
+
+    header = reader.header
+    for blk in duplex_pair_blocks(reader, header):
+        stats.incr("sscs_total", blk.stats_total)
+        stats.incr("sscs_unpaired", blk.stats_unpaired)
+        stats.incr("pairs", blk.stats_pairs)
+        if blk.stats_mismatch:
+            stats.incr("length_mismatch_pairs", blk.stats_mismatch)
+
+        # unpaired: raw length-prefixed blob passthrough, in emission order
+        # (byte-equal to re-encoding for self-produced BAMs, which is the
+        # only kind this path sees)
+        k = 0
+        nu = len(blk.unpaired_row)
+        while k < nu:
+            si = int(blk.unpaired_src[k])
+            k2 = k
+            while k2 < nu and blk.unpaired_src[k2] == si:
+                k2 += 1
+            batch = blk.sources[si]
+            rows = blk.unpaired_row[k:k2]
+            data, _ = gather_runs(
+                batch.buf, batch.rec_off[rows],
+                batch.rec_off[rows + 1] - batch.rec_off[rows],
+            )
+            unpaired_writer.write_encoded(data)
+            k = k2
+
+        n_pairs = len(blk.pair_tags)
+        if n_pairs == 0:
+            continue
+        # per-pair canon columns (vectorized per source)
+        flagc = np.empty(n_pairs, np.int64)
+        ridc = np.empty(n_pairs, np.int64)
+        posc = np.empty(n_pairs, np.int64)
+        mridc = np.empty(n_pairs, np.int64)
+        mposc = np.empty(n_pairs, np.int64)
+        tlenc = np.empty(n_pairs, np.int64)
+        mapqc = np.empty(n_pairs, np.int64)
+        lseqc = np.empty(n_pairs, np.int64)
+        ncigc = np.empty(n_pairs, np.int64)
+        cstartc = np.empty(n_pairs, np.int64)
+        for si, batch in enumerate(blk.sources):
+            m = blk.pair_canon_src == si
+            rows = blk.pair_canon_row[m]
+            flagc[m] = batch.flag[rows]
+            ridc[m] = batch.ref_id[rows]
+            posc[m] = batch.pos[rows]
+            mridc[m] = batch.mate_ref_id[rows]
+            mposc[m] = batch.mate_pos[rows]
+            tlenc[m] = batch.tlen[rows]
+            mapqc[m] = batch.mapq[rows]
+            lseqc[m] = batch.l_seq[rows]
+            ncigc[m] = batch.n_cigar[rows]
+            cstartc[m] = batch.cigar_start[rows]
+
+        def member_rows(src_arr, row_arr, sel, L):
+            out_c = np.empty((int(sel.sum()), L), np.uint8)
+            out_q = np.empty_like(out_c)
+            pos_sel = np.nonzero(sel)[0]
+            for si, batch in enumerate(blk.sources):
+                m = src_arr[pos_sel] == si
+                if not m.any():
+                    continue
+                rows = row_arr[pos_sel[m]]
+                codes, coff = batch.seq_codes()
+                quals, _ = batch.quals()
+                out_c[m] = codes[coff[rows][:, None] + np.arange(L)]
+                out_q[m] = quals[coff[rows][:, None] + np.arange(L)]
+            return out_c, out_q
+
+        for L in np.unique(lseqc):
+            L = int(L)
+            sel = lseqc == L
+            s1, q1 = member_rows(blk.pair_canon_src, blk.pair_canon_row, sel, L)
+            s2, q2 = member_rows(blk.pair_other_src, blk.pair_other_row, sel, L)
+            if backend == "tpu":
+                out_b, out_q = duplex_batch_host(s1, q1, s2, q2, qual_cap)
+            else:
+                out_b = np.empty_like(s1)
+                out_q = np.empty_like(q1)
+                for i in range(s1.shape[0]):
+                    out_b[i], out_q[i] = duplex_consensus(
+                        s1[i], q1[i], s2[i], q2[i], qual_cap
+                    )
+            for k, p in enumerate(np.nonzero(sel)[0]):
+                p = int(p)
+                tag = blk.pair_tags[p]
+                batch = blk.sources[int(blk.pair_canon_src[p])]
+                cst = int(cstartc[p])
+                words = np.ascontiguousarray(
+                    batch.buf[cst : cst + 4 * int(ncigc[p])]
+                ).view("<u4")
+                tag_blob = (
+                    b"XTZ" + tag.barcode.encode("ascii")
+                    + b"\x00XFi" + struct.pack("<i", int(blk.pair_xf[p]))
+                )
+                rec_writer.add(
+                    tags_mod.dcs_qname(tag), int(flagc[p]) & _KEEP_FLAGS,
+                    int(ridc[p]), int(posc[p]), int(mapqc[p]), np.array(words),
+                    int(mridc[p]), int(mposc[p]), int(tlenc[p]),
+                    out_b[k], out_q[k], tag_blob,
+                )
+                stats.incr("dcs_written")
+
+
 def run_dcs(
     sscs_bam: str,
     out_prefix: str,
@@ -189,57 +354,30 @@ def run_dcs(
     reader = ColumnarReader(sscs_bam)
     dcs_writer = BamWriter(dcs_tmp, reader.header)
     unpaired_writer = BamWriter(unpaired_tmp, reader.header)
-
     rec_writer = ConsensusRecordWriter(dcs_writer)
 
-    def sink(tag, canon, other, codes, quals):
-        # canon is a _PinnedMember (columnar path); same record bytes as
-        # build_consensus_read + encode_record, accumulated column-wise.
-        fam_size = canon.xf + other.xf
-        L = codes.shape[0]
-        words = canon.cigar if canon.seq_len == L else np.array([L << 4], np.uint32)
-        tag_blob = (
-            b"XTZ" + tag.barcode.encode("ascii")
-            + b"\x00XFi" + struct.pack("<i", fam_size)
-        )
-        rec_writer.add(
-            tags_mod.dcs_qname(tag), canon.flag & _KEEP_FLAGS, canon.rid,
-            canon.pos, canon.mapq, words, canon.mrid, canon.mate_pos,
-            canon.tlen, codes, quals, tag_blob,
-        )
-        stats.incr("dcs_written")
-
-    batcher = _DuplexBatcher(qual_cap, reader.header, backend=backend)
     try:
-        for _key, window in consensus_windows_columnar(reader):
-            paired: set = set()
-            for tag in sorted(window, key=str):
-                if tag in paired:
-                    continue
-                stats.incr("sscs_total")
-                partner = tags_mod.duplex_tag(tag)
-                other = window.get(partner)
-                if other is None or partner in paired:
-                    stats.incr("sscs_unpaired")
-                    unpaired_writer.write(window[tag].materialize())
-                    continue
-                stats.incr("sscs_total")  # partner consumed here
-                paired.add(tag)
-                paired.add(partner)
-                read, oread = window[tag], other
-                if read.seq_len != oread.seq_len:
-                    stats.incr("sscs_unpaired", 2)
-                    stats.incr("length_mismatch_pairs")
-                    unpaired_writer.write(read.materialize())
-                    unpaired_writer.write(oread.materialize())
-                    continue
-                # canonical strand: barcode lexicographically <= its mirror
-                if tag.barcode <= partner.barcode:
-                    batcher.add(tag, read, oread, sink)
-                else:
-                    batcher.add(partner, oread, read, sink)
-                stats.incr("pairs")
-        batcher.flush()
+        try:
+            _consume_pair_blocks(
+                reader, stats, unpaired_writer, rec_writer, qual_cap, backend
+            )
+        except ValueError as e:
+            if "foreign tag layout" not in str(e):
+                raise
+            # foreign consensus BAM: restart from scratch on the object path
+            # (nothing sorted/promoted yet; the tmps are simply rewritten)
+            reader.close()
+            dcs_writer.close()
+            unpaired_writer.close()
+            stats = StageStats("DCS")
+            reader = ColumnarReader(sscs_bam)
+            dcs_writer = BamWriter(dcs_tmp, reader.header)
+            unpaired_writer = BamWriter(unpaired_tmp, reader.header)
+            rec_writer = ConsensusRecordWriter(dcs_writer)
+            _run_dcs_windows(
+                reader, stats, dcs_writer, unpaired_writer, rec_writer,
+                qual_cap, backend,
+            )
         rec_writer.flush()
     finally:
         reader.close()

@@ -931,3 +931,273 @@ def stream_family_blocks(
         carry_key = (int(rid[-1]), int(pos[-1]))
     if carry:
         yield "block", _build_block(carry, header), None
+
+
+# --------------------------------------------------------- duplex pair blocks
+#
+# Vectorized DCS pairing: the per-read tag/dict/str walk of
+# consensus_windows_columnar costs ~40 us/read; this producer pairs whole
+# batches at once.  A read's duplex partner has the mirrored barcode, the
+# flipped read number, and identical coordinates/orientation — so the
+# CANONICAL key (lexicographic min of barcode and its mirror, read number
+# flipped accordingly; palindromic barcodes normalize the read number to 1)
+# is equal for exactly a tag and its partner.  One lexsort over
+# (coordinate, canonical key) groups pairs; runs dedupe by full tag (dict
+# last-wins semantics) and split into pairs / unpaired singles.  Emission
+# order inside a coordinate window reproduces the object path's
+# sorted-by-str(tag) walk exactly (pair order by the smaller member str,
+# unpaired and length-mismatch reads interleaved by the same keys).
+
+
+class PairBlock:
+    """Pairing results for one batch of consensus reads.
+
+    ``pairs_*``: per pair, (source, row) of the canonical-strand read and
+    its partner, the precomputed FamilyTag of the canonical read, and the
+    combined family size.  ``unpaired``: (source, row) in emission order.
+    ``sources``: the ColumnarBatches rows refer to.
+    """
+
+    __slots__ = ("sources", "pair_canon_src", "pair_canon_row",
+                 "pair_other_src", "pair_other_row", "pair_tags", "pair_xf",
+                 "unpaired_src", "unpaired_row", "stats_total",
+                 "stats_unpaired", "stats_pairs", "stats_mismatch")
+
+
+def _mirror_bcm(bcm: np.ndarray, bclen: np.ndarray):
+    """Vectorized barcode mirror: ``"A.B" -> "B.A"`` per row (rows without
+    a separator mirror to themselves, like tags_mod.mirror_barcode)."""
+    n, w = bcm.shape
+    sep_byte = ord(tags_mod.BARCODE_SEP)
+    is_sep = bcm == sep_byte
+    has = is_sep.any(axis=1)
+    sep = np.where(has, np.argmax(is_sep, axis=1), bclen)  # first '.'
+    llen = sep
+    rlen = np.where(has, bclen - sep - 1, 0)
+    cols = np.arange(w, dtype=np.int64)
+    # output col j: j < rlen -> right half; j == rlen -> '.'; else left half
+    src = np.where(
+        cols[None, :] < rlen[:, None],
+        sep[:, None] + 1 + cols[None, :],
+        cols[None, :] - rlen[:, None] - 1,
+    )
+    out = np.take_along_axis(bcm, np.clip(src, 0, w - 1), axis=1)
+    out[cols[None, :] == rlen[:, None]] = sep_byte
+    out[cols[None, :] >= bclen[:, None]] = 0
+    mirrored = np.where(has[:, None], out, bcm)
+    return mirrored
+
+
+def duplex_pair_blocks(creader, header: BamHeader) -> Iterator[PairBlock]:
+    """Yield one :class:`PairBlock` per columnar batch of a consensus BAM
+    (trailing coordinate carried, exactly like the family-block producer).
+
+    Requires every record's tag block to lead with XT:Z + XF:i (true for
+    all BAMs this pipeline writes); the caller probes the first batch and
+    falls back to the object path otherwise.
+    """
+    carry: list[tuple] | None = []
+    carry_key = None
+    for batch in creader.batches():
+        ok, bc_start, bc_len, xf = _parse_xt_xf(batch)
+        if not ok.all():
+            raise ValueError("foreign tag layout (no XT/XF prefix)")
+        n = batch.n
+        rid, pos = batch.ref_id, batch.pos
+        if n:
+            sorted_ok = (rid[1:] > rid[:-1]) | ((rid[1:] == rid[:-1]) & (pos[1:] >= pos[:-1]))
+            if not sorted_ok.all():
+                i = int(np.argmin(sorted_ok)) + 1
+                read = batch.materialize(i)
+                raise NotCoordinateSorted(
+                    f"consensus BAM is not coordinate-sorted: {read.qname} at "
+                    f"{read.ref}:{read.pos}"
+                )
+        first_key = (int(rid[0]), int(pos[0])) if n else None
+        if carry_key is not None and first_key is not None and first_key < carry_key:
+            read = batch.materialize(0)
+            raise NotCoordinateSorted(
+                f"consensus BAM is not coordinate-sorted: {read.qname} at "
+                f"{read.ref}:{read.pos} after ref_id={carry_key[0]} pos={carry_key[1]}"
+            )
+        # barcode matrix for the whole batch
+        wb = int(bc_len.max(initial=0))
+        cols = np.arange(wb, dtype=np.int64)
+        idx = bc_start[:, None] + cols[None, :]
+        bcm = np.where(
+            cols[None, :] < bc_len[:, None],
+            batch.buf[np.minimum(idx, len(batch.buf) - 1)], 0,
+        ).astype(np.uint8)
+
+        rows = np.arange(n, dtype=np.int64)
+        tail_mask = (rid == rid[-1]) & (pos == pos[-1]) if n else np.zeros(0, bool)
+        n_tail = int(tail_mask.sum())
+        body_n = n - n_tail
+        src_new_body = (batch, rows[:body_n], bcm[:body_n], bc_len[:body_n], xf[:body_n])
+        src_new_tail = (batch, rows[body_n:], bcm[body_n:], bc_len[body_n:], xf[body_n:])
+
+        if body_n:
+            if carry and first_key == carry_key:
+                yield _build_pair_block(carry + [src_new_body], header)
+            elif carry:
+                yield _build_pair_block(carry, header)
+                yield _build_pair_block([src_new_body], header)
+            else:
+                yield _build_pair_block([src_new_body], header)
+            carry = [src_new_tail]
+        else:
+            if carry and first_key == carry_key:
+                carry.append(src_new_tail)
+            else:
+                if carry:
+                    yield _build_pair_block(carry, header)
+                carry = [src_new_tail]
+        if n:
+            carry_key = (int(rid[-1]), int(pos[-1]))
+    if carry:
+        yield _build_pair_block(carry, header)
+
+
+def _build_pair_block(sources: list[tuple], header: BamHeader) -> PairBlock:
+    def col(fn):
+        return np.concatenate([fn(s) for s in sources])
+
+    batches = [s[0] for s in sources]
+    rows_of = [s[1] for s in sources]
+    rid = col(lambda s: s[0].ref_id[s[1]])
+    pos = col(lambda s: s[0].pos[s[1]])
+    mrid = col(lambda s: s[0].mate_ref_id[s[1]])
+    mpos = col(lambda s: s[0].mate_pos[s[1]])
+    flag = col(lambda s: s[0].flag[s[1]])
+    lseq = col(lambda s: s[0].l_seq[s[1]])
+    xf = col(lambda s: s[4])
+    bclen = np.concatenate([s[3] for s in sources])
+    wb = max((s[2].shape[1] for s in sources), default=0)
+    n = len(rid)
+    bcm = np.zeros((n, wb), dtype=np.uint8)
+    r0 = 0
+    for s in sources:
+        bcm[r0 : r0 + len(s[1]), : s[2].shape[1]] = s[2]
+        r0 += len(s[1])
+    srci = np.repeat(np.arange(len(sources), dtype=np.int64),
+                     [len(s[1]) for s in sources])
+    grow = col(lambda s: s[1])
+
+    rn = np.where((flag & FREAD1) != 0, 1, 2).astype(np.int8)
+    rev = ((flag & FREVERSE) != 0).astype(np.int8)
+
+    mirror = _mirror_bcm(bcm, bclen)
+    a = np.ascontiguousarray(bcm).view(f"S{max(wb,1)}").ravel()
+    b = np.ascontiguousarray(mirror).view(f"S{max(wb,1)}").ravel()
+    bc_lt = a < b
+    bc_eq = a == b
+    canon_is_self = bc_lt | bc_eq
+    canon_bcm = np.where(bc_lt[:, None] | bc_eq[:, None], bcm, mirror)
+    canon_rn = np.where(bc_eq, 1, np.where(bc_lt, rn, 3 - rn)).astype(np.int8)
+
+    keys = [rev, canon_rn, mpos, mrid]
+    keys += [canon_bcm[:, j] for j in range(wb - 1, -1, -1)]
+    keys += [pos, rid]
+    order = np.lexsort(keys)
+
+    def srt(arr):
+        return arr[order]
+
+    kb = canon_bcm[order]
+    same = np.ones(n, dtype=bool)
+    if n > 1:
+        same[1:] = (
+            (kb[1:] == kb[:-1]).all(axis=1)
+            & (srt(rid)[1:] == srt(rid)[:-1])
+            & (srt(pos)[1:] == srt(pos)[:-1])
+            & (srt(mrid)[1:] == srt(mrid)[:-1])
+            & (srt(mpos)[1:] == srt(mpos)[:-1])
+            & (srt(canon_rn)[1:] == srt(canon_rn)[:-1])
+            & (srt(rev)[1:] == srt(rev)[:-1])
+        )
+    bounds = np.concatenate([[0], np.nonzero(~same)[0], [n]]) if n else np.zeros(1, np.int64)
+
+    ref_names = [header.ref_name(i) for i in range(len(header.refs))]
+
+    def _rname(i):
+        return ref_names[i] if i >= 0 else "*"
+
+    def tag_of(i):
+        return tags_mod.FamilyTag(
+            barcode=bcm[i, : bclen[i]].tobytes().decode("ascii"),
+            ref=_rname(int(rid[i])), pos=int(pos[i]),
+            mate_ref=_rname(int(mrid[i])), mate_pos=int(mpos[i]),
+            read_number=int(rn[i]), orientation="rev" if rev[i] else "fwd",
+        )
+
+    # window-local events: (window_key, sort_str, kind, payload)
+    pair_ev: list = []
+    unpaired_ev: list = []
+    stats_total = 0
+    stats_mismatch = 0
+    for a0, a1 in zip(bounds[:-1], bounds[1:]):
+        run = order[a0:a1]
+        if len(run) > 1:
+            # dedupe by FULL tag, dict last-wins: keep the last stream
+            # occurrence of each (barcode, rn) — run members share all
+            # other key fields already
+            seen: dict = {}
+            for i in run:  # stable lexsort: run is in stream order
+                seen[(bcm[i, : bclen[i]].tobytes(), int(rn[i]))] = i
+            run = sorted(seen.values(), key=lambda i: (srci[i], grow[i]))
+        stats_total += len(run)
+        wkey = (int(rid[run[0]]), int(pos[run[0]]))
+        if len(run) == 1:
+            i = int(run[0])
+            unpaired_ev.append((wkey, str(tag_of(i)), (srci[i], grow[i])))
+            continue
+        i, j = int(run[0]), int(run[1])
+        ti, tj = tag_of(i), tag_of(j)
+        si, sj = str(ti), str(tj)
+        first_str = min(si, sj)
+        if lseq[i] != lseq[j]:
+            stats_mismatch += 1
+            # the walk writes window[min-str tag] first, then the partner
+            if si <= sj:
+                unpaired_ev.append((wkey, (first_str, 0), (srci[i], grow[i])))
+                unpaired_ev.append((wkey, (first_str, 1), (srci[j], grow[j])))
+            else:
+                unpaired_ev.append((wkey, (first_str, 0), (srci[j], grow[j])))
+                unpaired_ev.append((wkey, (first_str, 1), (srci[i], grow[i])))
+            continue
+        # canonical strand: barcode lexicographically <= its mirror; for a
+        # palindromic barcode both qualify and the walk's canon is the
+        # first-VISITED tag, i.e. the smaller str (R1 side) — not stream order
+        if canon_is_self[i] and canon_is_self[j]:
+            canon, other, ctag = (i, j, ti) if si <= sj else (j, i, tj)
+        elif canon_is_self[i]:
+            canon, other, ctag = i, j, ti
+        else:
+            canon, other, ctag = j, i, tj
+        pair_ev.append((
+            wkey, first_str, (srci[canon], grow[canon]),
+            (srci[other], grow[other]), ctag, int(xf[i]) + int(xf[j]),
+        ))
+
+    # normalize unpaired sort keys ((s,) vs (s, k) tuples sort together)
+    unpaired_ev = [
+        (w, k if isinstance(k, tuple) else (k, 0), p) for w, k, p in unpaired_ev
+    ]
+    unpaired_ev.sort(key=lambda e: (e[0], e[1]))
+    pair_ev.sort(key=lambda e: (e[0], e[1]))
+
+    blk = PairBlock()
+    blk.sources = batches
+    blk.pair_canon_src = np.array([e[2][0] for e in pair_ev], np.int64)
+    blk.pair_canon_row = np.array([e[2][1] for e in pair_ev], np.int64)
+    blk.pair_other_src = np.array([e[3][0] for e in pair_ev], np.int64)
+    blk.pair_other_row = np.array([e[3][1] for e in pair_ev], np.int64)
+    blk.pair_tags = [e[4] for e in pair_ev]
+    blk.pair_xf = np.array([e[5] for e in pair_ev], np.int64)
+    blk.unpaired_src = np.array([e[2][0] for e in unpaired_ev], np.int64)
+    blk.unpaired_row = np.array([e[2][1] for e in unpaired_ev], np.int64)
+    blk.stats_total = stats_total
+    blk.stats_unpaired = len(unpaired_ev)
+    blk.stats_pairs = len(pair_ev)
+    blk.stats_mismatch = stats_mismatch
+    return blk

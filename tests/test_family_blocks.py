@@ -158,3 +158,93 @@ def test_blocks_all_truncated_family_synthesizes_m_cigar(tmp_path):
     assert got == expected
     # modal cigar is 10M (2 votes) not the 2S8M minority
     assert got[0][4] == [(10 << 4) | 0]
+
+
+def _write_sscs_like(path, seed=21, n_pos=30, palindrome_rate=0.3,
+                     mismatch_rate=0.2):
+    """Consensus-shaped BAM (XT/XF tags) stressing the duplex pairing:
+    palindromic barcodes, length-mismatched partners, unpaired strands."""
+    from consensuscruncher_tpu.core.tags import FamilyTag, sscs_qname
+
+    header = BamHeader.from_refs([("chr1", 100_000), ("chr2", 100_000)])
+    rng = np.random.default_rng(seed)
+    reads = []
+    for p in range(n_pos):
+        ref = "chr1" if p % 3 else "chr2"
+        pos = 200 + (p // 2) * 7
+        for k in range(int(rng.integers(1, 4))):
+            a = "".join("ACGT"[c] for c in rng.integers(0, 4, 4))
+            if rng.random() < palindrome_rate:
+                b = a  # palindromic barcode: partner differs only in R#
+            else:
+                b = "".join("ACGT"[c] for c in rng.integers(0, 4, 4))
+            bc = f"{a}.{b}"
+            mirror = f"{b}.{a}"
+            La = 24
+            Lb = 22 if rng.random() < mismatch_rate else 24
+            both = rng.random() < 0.75
+            specs = [(bc, 1, La)]
+            if both:
+                specs.append((mirror, 2, Lb))
+            for barcode, rn, L in specs:
+                tag = FamilyTag(barcode=barcode, ref=ref, pos=pos,
+                                mate_ref=ref, mate_pos=pos + 600,
+                                read_number=rn, orientation="fwd")
+                # random qname prefix: decouples the coordinate-sort tie
+                # order from the read number, so R2 can precede R1 in the
+                # stream (the palindromic canon-selection trap)
+                qprefix = "zab"[int(rng.integers(0, 3))]
+                reads.append(BamRead(
+                    qname=f"{qprefix}:{sscs_qname(tag)}",
+                    flag=0x1 | 0x2 | (0x40 if rn == 1 else 0x80),
+                    ref=ref, pos=pos, mapq=int(rng.integers(20, 61)),
+                    cigar=[("M", L)], mate_ref=ref, mate_pos=pos + 600,
+                    tlen=600 + L,
+                    seq="".join("ACGT"[c] for c in rng.integers(0, 4, L)),
+                    qual=rng.integers(10, 60, L).astype(np.uint8),
+                    tags={"XT": ("Z", barcode), "XF": ("i", int(rng.integers(1, 9)))},
+                ))
+    unsorted = path + ".unsorted"
+    with BamWriter(unsorted, header) as w:
+        for r in reads:
+            w.write(r)
+    sort_bam(unsorted, path)
+
+
+@pytest.mark.parametrize("batch_bytes", [1 << 12, 64 << 20])
+def test_vectorized_dcs_pairing_matches_window_walk(tmp_path, batch_bytes, monkeypatch):
+    """run_dcs's vectorized pairing must write byte-identical outputs to the
+    object-window walk on palindromes/mismatches/cross-batch windows."""
+    import hashlib
+    import json
+
+    import consensuscruncher_tpu.stages.dcs_maker as dm
+    from consensuscruncher_tpu.io import columnar as col
+
+    src = str(tmp_path / "sscs.bam")
+    _write_sscs_like(src)
+
+    orig_init = col.ColumnarReader.__init__
+
+    def small_batches(self, path, batch_bytes_arg=None, **kw):
+        orig_init(self, path, batch_bytes)
+
+    monkeypatch.setattr(col.ColumnarReader, "__init__", small_batches)
+
+    out_v = dm.run_dcs(src, str(tmp_path / "v"), backend="tpu")
+
+    # force the fallback walk by making the block path refuse
+    monkeypatch.setattr(
+        dm, "_consume_pair_blocks",
+        lambda *a, **k: (_ for _ in ()).throw(ValueError("foreign tag layout")),
+    )
+    out_w = dm.run_dcs(src, str(tmp_path / "w"), backend="tpu")
+
+    for pv, pw in ((out_v.dcs_bam, out_w.dcs_bam),
+                   (out_v.sscs_singleton_bam, out_w.sscs_singleton_bam)):
+        hv = hashlib.sha256(open(pv, "rb").read()).hexdigest()
+        hw = hashlib.sha256(open(pw, "rb").read()).hexdigest()
+        assert hv == hw, (pv, pw)
+    sv = json.load(open(str(tmp_path / "v") + ".dcs_stats.json"))
+    sw = json.load(open(str(tmp_path / "w") + ".dcs_stats.json"))
+    assert sv == sw
