@@ -122,6 +122,18 @@ class _PinnedMember:
         return self.codes.shape[0]
 
 
+def _duplex_vote_batch(s1, q1, s2, q2, qual_cap: int, backend: str):
+    """One duplex vote over stacked (P, L) pairs — the single backend
+    dispatch shared by the window-walk batcher and the vectorized path."""
+    if backend == "tpu":
+        return duplex_batch_host(s1, q1, s2, q2, qual_cap)
+    out_b = np.empty_like(s1)
+    out_q = np.empty_like(q1)
+    for i in range(s1.shape[0]):
+        out_b[i], out_q[i] = duplex_consensus(s1[i], q1[i], s2[i], q2[i], qual_cap)
+    return out_b, out_q
+
+
 class _DuplexBatcher:
     """Accumulate strand pairs per read length; flush through the device
     kernel in batches (keeps device dispatches large and few)."""
@@ -157,13 +169,7 @@ class _DuplexBatcher:
         s2 = np.stack([e[2].codes for e in entries])
         q1 = np.stack([e[1].qual for e in entries])
         q2 = np.stack([e[2].qual for e in entries])
-        if self.backend == "tpu":
-            out_b, out_q = duplex_batch_host(s1, q1, s2, q2, self.qual_cap)
-        else:
-            out_b = np.empty_like(s1)
-            out_q = np.empty_like(q1)
-            for i in range(s1.shape[0]):
-                out_b[i], out_q[i] = duplex_consensus(s1[i], q1[i], s2[i], q2[i], self.qual_cap)
+        out_b, out_q = _duplex_vote_batch(s1, q1, s2, q2, self.qual_cap, self.backend)
         for i, (tag, canon, other, entry_sink) in enumerate(entries):
             entry_sink(tag, canon, other, out_b[i], out_q[i])
 
@@ -172,7 +178,7 @@ class _DuplexBatcher:
             self._flush_len(L)
 
 
-def _run_dcs_windows(reader, stats, dcs_writer, unpaired_writer, rec_writer,
+def _run_dcs_windows(reader, stats, unpaired_writer, rec_writer,
                      qual_cap: int, backend: str) -> None:
     """Object-window pairing walk (foreign consensus BAMs: records whose
     tag block doesn't lead with XT:Z+XF:i)."""
@@ -234,9 +240,13 @@ def _consume_pair_blocks(reader, stats, unpaired_writer, rec_writer,
 
     header = reader.header
     for blk in duplex_pair_blocks(reader, header):
-        stats.incr("sscs_total", blk.stats_total)
-        stats.incr("sscs_unpaired", blk.stats_unpaired)
-        stats.incr("pairs", blk.stats_pairs)
+        # guard zero increments: the window walk only creates keys it touches
+        if blk.stats_total:
+            stats.incr("sscs_total", blk.stats_total)
+        if blk.stats_unpaired:
+            stats.incr("sscs_unpaired", blk.stats_unpaired)
+        if blk.stats_pairs:
+            stats.incr("pairs", blk.stats_pairs)
         if blk.stats_mismatch:
             stats.incr("length_mismatch_pairs", blk.stats_mismatch)
 
@@ -307,15 +317,7 @@ def _consume_pair_blocks(reader, stats, unpaired_writer, rec_writer,
             sel = lseqc == L
             s1, q1 = member_rows(blk.pair_canon_src, blk.pair_canon_row, sel, L)
             s2, q2 = member_rows(blk.pair_other_src, blk.pair_other_row, sel, L)
-            if backend == "tpu":
-                out_b, out_q = duplex_batch_host(s1, q1, s2, q2, qual_cap)
-            else:
-                out_b = np.empty_like(s1)
-                out_q = np.empty_like(q1)
-                for i in range(s1.shape[0]):
-                    out_b[i], out_q[i] = duplex_consensus(
-                        s1[i], q1[i], s2[i], q2[i], qual_cap
-                    )
+            out_b, out_q = _duplex_vote_batch(s1, q1, s2, q2, qual_cap, backend)
             for k, p in enumerate(np.nonzero(sel)[0]):
                 p = int(p)
                 tag = blk.pair_tags[p]
@@ -352,8 +354,8 @@ def run_dcs(
     from consensuscruncher_tpu.io.columnar import ColumnarReader
 
     reader = ColumnarReader(sscs_bam)
-    dcs_writer = BamWriter(dcs_tmp, reader.header)
-    unpaired_writer = BamWriter(unpaired_tmp, reader.header)
+    dcs_writer = BamWriter(dcs_tmp, reader.header, level=1)  # tmp: sorted+deleted below; final files keep level 6
+    unpaired_writer = BamWriter(unpaired_tmp, reader.header, level=1)
     rec_writer = ConsensusRecordWriter(dcs_writer)
 
     try:
@@ -371,12 +373,11 @@ def run_dcs(
             unpaired_writer.close()
             stats = StageStats("DCS")
             reader = ColumnarReader(sscs_bam)
-            dcs_writer = BamWriter(dcs_tmp, reader.header)
-            unpaired_writer = BamWriter(unpaired_tmp, reader.header)
+            dcs_writer = BamWriter(dcs_tmp, reader.header, level=1)
+            unpaired_writer = BamWriter(unpaired_tmp, reader.header, level=1)
             rec_writer = ConsensusRecordWriter(dcs_writer)
             _run_dcs_windows(
-                reader, stats, dcs_writer, unpaired_writer, rec_writer,
-                qual_cap, backend,
+                reader, stats, unpaired_writer, rec_writer, qual_cap, backend,
             )
         rec_writer.flush()
     finally:

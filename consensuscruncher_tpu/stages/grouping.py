@@ -972,8 +972,10 @@ def _mirror_bcm(bcm: np.ndarray, bclen: np.ndarray):
     is_sep = bcm == sep_byte
     has = is_sep.any(axis=1)
     sep = np.where(has, np.argmax(is_sep, axis=1), bclen)  # first '.'
-    llen = sep
     rlen = np.where(has, bclen - sep - 1, 0)
+    # mirror_barcode parity: no separator OR an empty right half ("AB.")
+    # both mirror to themselves
+    mirrors_self = ~has | (rlen == 0)
     cols = np.arange(w, dtype=np.int64)
     # output col j: j < rlen -> right half; j == rlen -> '.'; else left half
     src = np.where(
@@ -984,7 +986,7 @@ def _mirror_bcm(bcm: np.ndarray, bclen: np.ndarray):
     out = np.take_along_axis(bcm, np.clip(src, 0, w - 1), axis=1)
     out[cols[None, :] == rlen[:, None]] = sep_byte
     out[cols[None, :] >= bclen[:, None]] = 0
-    mirrored = np.where(has[:, None], out, bcm)
+    mirrored = np.where(mirrors_self[:, None], bcm, out)
     return mirrored
 
 

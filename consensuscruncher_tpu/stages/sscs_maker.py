@@ -154,8 +154,8 @@ def run_sscs(
 
         source = stream_families_columnar(reader, header, bdelim)
     bad_writer = BamWriter(bad_path, header, atomic=True)
-    sscs_writer = BamWriter(sscs_tmp, header)
-    singleton_writer = BamWriter(singleton_tmp, header)
+    sscs_writer = BamWriter(sscs_tmp, header, level=1)  # tmp: sorted+deleted below; final files keep level 6
+    singleton_writer = BamWriter(singleton_tmp, header, level=1)
 
     pending: dict[int, tuple] = {}
 

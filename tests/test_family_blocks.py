@@ -248,3 +248,23 @@ def test_vectorized_dcs_pairing_matches_window_walk(tmp_path, batch_bytes, monke
     sv = json.load(open(str(tmp_path / "v") + ".dcs_stats.json"))
     sw = json.load(open(str(tmp_path / "w") + ".dcs_stats.json"))
     assert sv == sw
+
+
+def test_mirror_bcm_matches_mirror_barcode():
+    """Vectorized mirror ≡ tags.mirror_barcode, including the edge shapes:
+    empty right half ('AB.'), empty left half ('.AB'), no separator."""
+    from consensuscruncher_tpu.core.tags import mirror_barcode
+    from consensuscruncher_tpu.stages.grouping import _mirror_bcm
+
+    cases = ["ACGT.TTAA", "AB.", ".AB", "ABCD", "A.B", ".", "AA.AA"]
+    w = max(len(c) for c in cases)
+    bcm = np.zeros((len(cases), w), np.uint8)
+    bclen = np.zeros(len(cases), np.int64)
+    for i, c in enumerate(cases):
+        bcm[i, : len(c)] = np.frombuffer(c.encode(), np.uint8)
+        bclen[i] = len(c)
+    got = _mirror_bcm(bcm, bclen)
+    for i, c in enumerate(cases):
+        expect = mirror_barcode(c)
+        assert got[i, : len(expect)].tobytes().decode() == expect, c
+        assert (got[i, len(expect):] == 0).all()
