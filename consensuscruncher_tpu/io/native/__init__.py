@@ -25,7 +25,7 @@ import threading
 
 import numpy as np
 
-_ABI_VERSION = 3
+_ABI_VERSION = 4
 _SRC = os.path.join(os.path.dirname(__file__), "bgzf_native.cpp")
 
 _lock = threading.Lock()
@@ -82,6 +82,23 @@ def _build_and_load() -> ctypes.CDLL | None:
         ctypes.c_int32,                     # n_threads
         ctypes.c_char_p,                    # out
         ctypes.POINTER(ctypes.c_uint32),    # out_sizes
+    ]
+    lib.cct_copy_runs.restype = None
+    lib.cct_copy_runs.argtypes = [
+        ctypes.c_char_p,                    # src
+        ctypes.POINTER(ctypes.c_int64),     # src_starts (bytes)
+        ctypes.c_char_p,                    # dst
+        ctypes.POINTER(ctypes.c_int64),     # dst_starts (bytes)
+        ctypes.POINTER(ctypes.c_int64),     # lens (bytes)
+        ctypes.c_int64,                     # n
+    ]
+    lib.cct_fill_runs.restype = None
+    lib.cct_fill_runs.argtypes = [
+        ctypes.c_char_p,                    # dst
+        ctypes.POINTER(ctypes.c_int64),     # starts (bytes)
+        ctypes.POINTER(ctypes.c_int64),     # lens (bytes)
+        ctypes.c_int64,                     # n
+        ctypes.c_int32,                     # value
     ]
     return lib
 
@@ -152,6 +169,73 @@ def inflate_blocks(
     if rc != 0:
         raise ValueError(f"BGZF native inflate failed at block {rc - 1} (bad stream or CRC)")
     return out[:total].data
+
+
+def _i64_ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+
+def copy_runs(
+    src: np.ndarray,
+    src_starts: np.ndarray,
+    dst: np.ndarray,
+    dst_starts: np.ndarray,
+    lens: np.ndarray,
+) -> None:
+    """``dst[dst_starts[i]:+lens[i]] = src[src_starts[i]:+lens[i]]`` via the
+    native memcpy loop.  ``src``/``dst`` are 1-D C-contiguous arrays of the
+    same itemsize; offsets/lengths are in ELEMENTS (scaled to bytes here).
+    Bounds are validated before the call — the C side trusts its inputs.
+    """
+    lib = _get()
+    if lib is None:
+        raise RuntimeError("native codec unavailable")
+    n = len(lens)
+    if n == 0:
+        return
+    item = src.dtype.itemsize
+    if dst.dtype.itemsize != item or not src.flags.c_contiguous or not dst.flags.c_contiguous:
+        raise ValueError("copy_runs needs C-contiguous arrays of equal itemsize")
+    ss = np.ascontiguousarray(src_starts, dtype=np.int64)
+    ds = np.ascontiguousarray(dst_starts, dtype=np.int64)
+    ll = np.ascontiguousarray(lens, dtype=np.int64)
+    if len(ss) != n or len(ds) != n:
+        raise ValueError("copy_runs: starts/lens length mismatch")
+    if ll.min(initial=0) < 0:
+        raise ValueError("copy_runs: negative length")
+    if n and (
+        int((ss + ll).max()) > src.size or int((ds + ll).max()) > dst.size
+        or int(ss.min()) < 0 or int(ds.min()) < 0
+    ):
+        raise ValueError("copy_runs: run out of bounds")
+    if item != 1:
+        ss, ds, ll = ss * item, ds * item, ll * item
+    lib.cct_copy_runs(
+        src.ctypes.data_as(ctypes.c_char_p), _i64_ptr(ss),
+        dst.ctypes.data_as(ctypes.c_char_p), _i64_ptr(ds),
+        _i64_ptr(ll), n,
+    )
+
+
+def fill_runs_native(dst: np.ndarray, starts: np.ndarray, lens: np.ndarray, value: int) -> None:
+    """Byte-fill runs of a 1-D contiguous uint8 array with ``value``."""
+    lib = _get()
+    if lib is None:
+        raise RuntimeError("native codec unavailable")
+    n = len(lens)
+    if n == 0:
+        return
+    if dst.dtype.itemsize != 1 or not dst.flags.c_contiguous:
+        raise ValueError("fill_runs_native needs a contiguous 1-byte-item array")
+    ss = np.ascontiguousarray(starts, dtype=np.int64)
+    ll = np.ascontiguousarray(lens, dtype=np.int64)
+    if ll.min(initial=0) < 0 or (n and (int((ss + ll).max()) > dst.size or int(ss.min()) < 0)):
+        raise ValueError("fill_runs_native: run out of bounds")
+    if not 0 <= int(value) <= 255:  # numpy fallback raises OverflowError too
+        raise OverflowError(f"fill value {value} out of bounds for a byte fill")
+    lib.cct_fill_runs(
+        dst.ctypes.data_as(ctypes.c_char_p), _i64_ptr(ss), _i64_ptr(ll), n, int(value)
+    )
 
 
 def deflate_payload(data: bytes, level: int = 6, n_threads: int = 0) -> bytes:

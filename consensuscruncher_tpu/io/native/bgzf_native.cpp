@@ -27,7 +27,7 @@
 
 namespace {
 
-constexpr int kAbiVersion = 3;
+constexpr int kAbiVersion = 4;
 constexpr uint32_t kMaxBlockPayload = 0xFF00;  // htslib payload bound
 constexpr uint32_t kOutStride = 0x10400;       // per-block output slot (worst case + slack)
 
@@ -187,6 +187,28 @@ int cct_deflate_blocks(const uint8_t* payload, uint64_t payload_len, int32_t lev
     out_sizes[i] = block_size;
     return 0;
   });
+}
+
+// Ragged-run copy: dst[dst_starts[i] : +lens[i]] = src[src_starts[i] : +lens[i]].
+//
+// The byte-level workhorse behind utils/ragged.py's gather/scatter — the
+// numpy fallback builds ~24 bytes of int64 fancy-index per payload byte,
+// while this is a straight memcpy loop.  Offsets/lengths are in BYTES; the
+// Python wrapper scales element offsets by itemsize and bounds-checks
+// before calling (this function trusts its inputs).
+void cct_copy_runs(const uint8_t* src, const int64_t* src_starts, uint8_t* dst,
+                   const int64_t* dst_starts, const int64_t* lens, int64_t n) {
+  for (int64_t i = 0; i < n; ++i) {
+    std::memcpy(dst + dst_starts[i], src + src_starts[i], static_cast<size_t>(lens[i]));
+  }
+}
+
+// Ragged-run fill: dst[starts[i] : +lens[i]] = value (byte fill).
+void cct_fill_runs(uint8_t* dst, const int64_t* starts, const int64_t* lens, int64_t n,
+                   int32_t value) {
+  for (int64_t i = 0; i < n; ++i) {
+    std::memset(dst + starts[i], value, static_cast<size_t>(lens[i]));
+  }
 }
 
 }  // extern "C"

@@ -17,6 +17,14 @@ from __future__ import annotations
 
 import numpy as np
 
+from consensuscruncher_tpu.io import native as _native
+
+
+def _native_ok(*arrays: np.ndarray) -> bool:
+    """Native memcpy path applies to C-contiguous arrays (any itemsize —
+    element offsets scale to bytes) when the codec library is loadable."""
+    return _native.available() and all(a.flags.c_contiguous for a in arrays)
+
 
 def _run_index(starts: np.ndarray, lens: np.ndarray) -> np.ndarray:
     """Flat index array covering run i at starts[i] for lens[i] elements."""
@@ -43,6 +51,10 @@ def gather_runs(buf: np.ndarray, starts: np.ndarray, lengths: np.ndarray):
     if total == 0:
         return np.empty(0, dtype=buf.dtype), off
     n = len(lengths)
+    if _native_ok(buf):
+        out = np.empty(total, dtype=buf.dtype)
+        _native.copy_runs(buf, starts, out, off[:-1], lengths)
+        return out, off
     # Uniform-length fast path (fixed-length reads dominate real BAMs): one
     # 2-D gather instead of three total-length int64 index arrays.
     if n and int(lengths[0]) and (lengths == lengths[0]).all():
@@ -64,6 +76,12 @@ def scatter_runs(out: np.ndarray, dst_starts: np.ndarray, src: np.ndarray,
     if total == 0:
         return
     n = len(lens)
+    if out.dtype == src.dtype and _native_ok(out, src):
+        if src_starts is None:
+            src_starts = np.zeros(n, dtype=np.int64)
+            np.cumsum(lens[:-1], out=src_starts[1:])
+        _native.copy_runs(src, src_starts, out, dst_starts, lens)
+        return
     if n and (lens == lens[0]).all():
         l0 = int(lens[0])
         if src_starts is None:
@@ -96,5 +114,8 @@ def fill_runs(out: np.ndarray, dst_starts: np.ndarray, lens: np.ndarray,
     """``out[dst_starts[i]:+lens[i]] = value`` for every run."""
     lens = lens.astype(np.int64)
     if int(lens.sum()) == 0:
+        return
+    if out.dtype.itemsize == 1 and _native_ok(out):
+        _native.fill_runs_native(out, dst_starts, lens, int(value))
         return
     out[_run_index(dst_starts, lens)] = value

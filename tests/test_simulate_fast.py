@@ -1,0 +1,104 @@
+"""Vectorized simulator (`utils.simulate.simulate_bam_fast`) correctness.
+
+The fast generator feeds benchmark-scale configs (BASELINE.md 2-4), so what
+matters is that its output is a valid coordinate-sorted barcode-extracted
+BAM whose family structure matches the drawn ground truth — checked here by
+running the production grouping/SSCS stage over it.
+"""
+
+import hashlib
+import os
+
+import numpy as np
+import pytest
+
+from consensuscruncher_tpu.stages.sscs_maker import run_sscs
+from consensuscruncher_tpu.utils.simulate import (
+    SimConfig,
+    simulate_bam_fast,
+)
+
+
+@pytest.fixture(scope="module")
+def fast_bam(tmp_path_factory):
+    d = tmp_path_factory.mktemp("fastsim")
+    path = str(d / "fast.bam")
+    cfg = SimConfig(
+        n_fragments=400, read_len=60, mean_family_size=3.0,
+        ref_len=200_000, seed=11,
+    )
+    truth = simulate_bam_fast(path, cfg)
+    return path, cfg, truth
+
+
+def test_deterministic(tmp_path):
+    cfg = SimConfig(n_fragments=120, read_len=50, ref_len=100_000, seed=5)
+    a, b = str(tmp_path / "a.bam"), str(tmp_path / "b.bam")
+    simulate_bam_fast(a, cfg)
+    simulate_bam_fast(b, cfg)
+    da = hashlib.sha256(open(a, "rb").read()).hexdigest()
+    db = hashlib.sha256(open(b, "rb").read()).hexdigest()
+    assert da == db
+
+
+def test_coordinate_sorted(fast_bam):
+    from consensuscruncher_tpu.io.columnar import ColumnarReader
+
+    path, _cfg, _truth = fast_bam
+    last = -1
+    with ColumnarReader(path) as r:
+        for batch in r.batches():
+            pos = batch.pos
+            assert (np.diff(pos) >= 0).all()
+            assert pos[0] >= last
+            last = int(pos[-1])
+
+
+def test_truth_matches_grouping(fast_bam, tmp_path):
+    path, _cfg, truth = fast_bam
+    res = run_sscs(path, str(tmp_path / "out"), backend="cpu")
+    # every member contributes 2 reads
+    assert res.stats.get("total_reads") == truth.n_reads
+    # each strand instance (size>0) groups into an R1 family and an R2 family
+    strands = int((truth.a_size > 0).sum() + (truth.b_size > 0).sum())
+    assert res.stats.get("families") == 2 * strands
+    singles = int((truth.a_size == 1).sum() + (truth.b_size == 1).sum())
+    assert res.stats.get("singletons") == 2 * singles
+    assert res.stats.get("sscs_written") == res.stats.get("families") - res.stats.get(
+        "singletons"
+    )
+    assert res.stats.get("bad_reads", 0) == 0
+
+
+def test_barcode_error_rate_splits_families(tmp_path):
+    cfg = SimConfig(
+        n_fragments=300, read_len=50, mean_family_size=4.0,
+        ref_len=150_000, seed=9, barcode_error_rate=0.15,
+    )
+    path = str(tmp_path / "bcerr.bam")
+    truth = simulate_bam_fast(path, cfg)
+    res = run_sscs(path, str(tmp_path / "out"), backend="cpu")
+    strands = int((truth.a_size > 0).sum() + (truth.b_size > 0).sum())
+    # barcode errors split off extra (mostly singleton) families
+    assert res.stats.get("families") > 2 * strands
+    assert res.stats.get("total_reads") == truth.n_reads
+
+
+def test_level_param_and_size(tmp_path):
+    cfg = SimConfig(n_fragments=200, read_len=50, ref_len=100_000, seed=3)
+    p1 = str(tmp_path / "l1.bam")
+    p6 = str(tmp_path / "l6.bam")
+    simulate_bam_fast(p1, cfg, level=1)
+    simulate_bam_fast(p6, cfg, level=6)
+    assert os.path.getsize(p1) > os.path.getsize(p6)
+    # same decoded records either way
+    from consensuscruncher_tpu.io.bam import BamReader
+
+    def digest(p):
+        h = hashlib.sha256()
+        with BamReader(p) as r:
+            for read in r:
+                h.update(repr((read.qname, read.flag, read.pos, read.seq)).encode())
+        return h.hexdigest()
+
+    assert digest(p1) == digest(p6)
