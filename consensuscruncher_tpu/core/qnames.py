@@ -1,0 +1,248 @@
+"""Vectorized tag-string and consensus-qname construction.
+
+The block pipeline (stages/grouping.FamilyBlock) carries family identity as
+columnar fields; materializing a ``FamilyTag`` object + ``str(tag)`` +
+``sscs_qname(tag)`` per family was the last per-family Python in the SSCS
+hot path (~10 us/family).  This module builds the same byte strings as
+array passes:
+
+- :func:`format_ints` — variable-width decimal rendering (no zero padding,
+  byte-identical to ``str(int)`` for non-negative values).
+- :func:`build_strings` — assemble per-row byte strings from a mix of
+  constant, ragged, and fixed-width segments via native scatter passes.
+- :func:`sscs_qnames_columnar` / :func:`tag_strings_columnar` — the exact
+  ``core.tags.sscs_qname`` / ``str(FamilyTag)`` byte strings, columnar.
+- :func:`lexsort_strings` — emission-order permutation: sort rows by
+  arbitrary-length byte strings (padded-and-packed uint64 lexsort), used
+  with (rid, pos) numeric leaders to reproduce the object path's
+  ``sorted(..., key=(rid, pos, str(tag)))`` order bit-for-bit.
+
+Parity with the scalar oracles is pinned by tests/test_qnames_vec.py.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from consensuscruncher_tpu.utils.ragged import scatter_runs
+
+_POW10 = np.array([10**k for k in range(19)], dtype=np.int64)
+
+
+def format_ints(vals: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Decimal bytes of non-negative ints: returns ``(digit_data, widths)``.
+
+    ``digit_data`` is the tight concatenation of each value's ASCII digits;
+    ``widths`` its per-value lengths (``len(str(v))``).  Negative input is a
+    contract violation (family coordinates are non-negative once bad reads
+    are filtered) and raises.
+    """
+    vals = np.asarray(vals, dtype=np.int64)
+    if vals.size and int(vals.min()) < 0:
+        raise ValueError("format_ints: negative values are not representable here")
+    widths = np.ones(len(vals), dtype=np.int64)
+    for p in _POW10[1:]:
+        widths += vals >= p
+    off = np.zeros(len(vals) + 1, dtype=np.int64)
+    np.cumsum(widths, out=off[1:])
+    out = np.empty(int(off[-1]), dtype=np.uint8)
+    # digit d (from the least significant): lands at off[i] + widths[i]-1-d
+    maxw = int(widths.max(initial=0))
+    for d in range(maxw):
+        m = widths > d
+        idx = off[:-1][m] + widths[m] - 1 - d
+        out[idx] = (vals[m] // _POW10[d]) % 10 + ord("0")
+    return out, widths
+
+
+class Seg:
+    """One segment of :func:`build_strings` — see factory helpers below."""
+
+    __slots__ = ("kind", "a", "b", "c")
+
+    def __init__(self, kind, a, b=None, c=None):
+        self.kind, self.a, self.b, self.c = kind, a, b, c
+
+
+def const(text: bytes) -> Seg:
+    """Same literal bytes on every row."""
+    return Seg("const", np.frombuffer(text, np.uint8))
+
+
+def ragged(data: np.ndarray, lens: np.ndarray, starts: np.ndarray | None = None) -> Seg:
+    """Per-row variable-length bytes (tight concat unless ``starts`` given)."""
+    return Seg("ragged", np.asarray(data, dtype=np.uint8), np.asarray(lens, dtype=np.int64),
+               None if starts is None else np.asarray(starts, dtype=np.int64))
+
+
+def fixed(matrix: np.ndarray) -> Seg:
+    """Per-row fixed-width bytes ((n, w) uint8)."""
+    return Seg("fixed", np.asarray(matrix, dtype=np.uint8))
+
+
+def ints(vals: np.ndarray) -> Seg:
+    """Per-row decimal rendering of non-negative ints."""
+    data, widths = format_ints(vals)
+    return Seg("ragged", data, widths, None)
+
+
+def build_strings(n: int, segments: list[Seg]) -> tuple[np.ndarray, np.ndarray]:
+    """Concatenate segments row-wise: returns ``(data, offsets)`` with row i
+    at ``data[offsets[i]:offsets[i+1]]``."""
+    widths = np.zeros(n, dtype=np.int64)
+    for s in segments:
+        if s.kind == "const":
+            widths += len(s.a)
+        elif s.kind == "fixed":
+            widths += s.a.shape[1]
+        else:
+            widths += s.b
+    off = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum(widths, out=off[1:])
+    out = np.empty(int(off[-1]), dtype=np.uint8)
+    cur = off[:-1].copy()
+    for s in segments:
+        if s.kind == "const":
+            w = len(s.a)
+            for k in range(w):
+                out[cur + k] = s.a[k]
+            cur = cur + w
+        elif s.kind == "fixed":
+            w = s.a.shape[1]
+            scatter_runs(out, cur, s.a.reshape(-1), np.full(n, w, np.int64))
+            cur = cur + w
+        else:
+            scatter_runs(out, cur, s.a, s.b, src_starts=s.c)
+            cur = cur + s.b
+    return out, off
+
+
+def ref_name_pool(ref_names: list[str]) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Encode reference names (plus the rid==-1 ``"*"`` sentinel in slot -1
+    == last) as a byte pool: returns (data, starts, lens, rank) where
+    ``rank`` orders names by Python string comparison (used for the
+    lower-coordinate-end test in ``sscs_qname``)."""
+    names = list(ref_names) + ["*"]
+    blobs = [s.encode("ascii") for s in names]
+    lens = np.array([len(b) for b in blobs], dtype=np.int64)
+    starts = np.zeros(len(blobs), dtype=np.int64)
+    np.cumsum(lens[:-1], out=starts[1:])
+    data = np.frombuffer(b"".join(blobs), np.uint8)
+    order = sorted(range(len(names)), key=lambda i: names[i])
+    rank = np.empty(len(names), dtype=np.int64)
+    rank[order] = np.arange(len(names))
+    return data, starts, lens, rank
+
+
+def sscs_qnames_columnar(
+    bcm: np.ndarray, bclen: np.ndarray,
+    rid: np.ndarray, pos: np.ndarray, mrid: np.ndarray, mpos: np.ndarray,
+    rn: np.ndarray, rev: np.ndarray,
+    pool: tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray],
+) -> tuple[np.ndarray, np.ndarray]:
+    """Byte-exact ``core.tags.sscs_qname`` over columnar families.
+
+    ``bcm``/``bclen``: per-family barcode byte matrix + lengths; ``rid`` may
+    be -1 (renders ``"*"``); ``rn`` in {1,2}; ``rev`` boolean (orientation
+    "rev"/"fwd").  Returns (data, offsets).
+    """
+    data, starts, lens, rank = pool
+    rid = np.asarray(rid, dtype=np.int64)
+    mrid = np.asarray(mrid, dtype=np.int64)
+    pos = np.asarray(pos, dtype=np.int64)
+    mpos = np.asarray(mpos, dtype=np.int64)
+    rn = np.asarray(rn, dtype=np.int64)
+    rev = np.asarray(rev, dtype=bool)
+    # low end: (ref, pos) <= (mate_ref, mate_pos) under string-name compare
+    r_rank, m_rank = rank[rid], rank[mrid]
+    low_is_self = (r_rank < m_rank) | ((r_rank == m_rank) & (pos <= mpos))
+    lo_rid = np.where(low_is_self, rid, mrid)
+    hi_rid = np.where(low_is_self, mrid, rid)
+    lo_pos = np.where(low_is_self, pos, mpos)
+    hi_pos = np.where(low_is_self, mpos, pos)
+    low_rn = np.where(low_is_self, rn, 3 - rn)
+    low_rev = np.where(low_is_self, rev, ~rev)
+
+    n = len(rid)
+    bclen = np.asarray(bclen, dtype=np.int64)
+    w = bcm.shape[1] if bcm.ndim == 2 else 0
+    bc_starts = np.arange(n, dtype=np.int64) * w
+    ori = np.where(low_rev[:, None],
+                   np.frombuffer(b"rev", np.uint8)[None, :],
+                   np.frombuffer(b"fwd", np.uint8)[None, :])
+    rn_chr = (low_rn + ord("0")).astype(np.uint8)[:, None]
+    segs = [
+        ragged(bcm.reshape(-1), bclen, starts=bc_starts),
+        const(b":"),
+        ragged(data, lens[lo_rid], starts=starts[lo_rid]),
+        const(b":"),
+        ints(lo_pos),
+        const(b":"),
+        ragged(data, lens[hi_rid], starts=starts[hi_rid]),
+        const(b":"),
+        ints(hi_pos),
+        const(b":R"),
+        fixed(rn_chr),
+        const(b":"),
+        fixed(ori),
+    ]
+    return build_strings(n, segs)
+
+
+def tag_strings_columnar(
+    bcm: np.ndarray, bclen: np.ndarray,
+    rid: np.ndarray, pos: np.ndarray, mrid: np.ndarray, mpos: np.ndarray,
+    rn: np.ndarray, rev: np.ndarray,
+    pool: tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray],
+) -> tuple[np.ndarray, np.ndarray]:
+    """Byte-exact ``str(FamilyTag)`` (the stats/text form, also the object
+    path's emission sort key)."""
+    data, starts, lens, _rank = pool
+    rid = np.asarray(rid, dtype=np.int64)
+    mrid = np.asarray(mrid, dtype=np.int64)
+    n = len(rid)
+    w = bcm.shape[1] if bcm.ndim == 2 else 0
+    bc_starts = np.arange(n, dtype=np.int64) * w
+    rn_chr = (np.asarray(rn, np.int64) + ord("0")).astype(np.uint8)[:, None]
+    ori = np.where(np.asarray(rev, bool)[:, None],
+                   np.frombuffer(b"rev", np.uint8)[None, :],
+                   np.frombuffer(b"fwd", np.uint8)[None, :])
+    segs = [
+        ragged(bcm.reshape(-1), np.asarray(bclen, np.int64), starts=bc_starts),
+        const(b"_"),
+        ragged(data, lens[rid], starts=starts[rid]),
+        const(b"_"),
+        ints(pos),
+        const(b"_"),
+        ragged(data, lens[mrid], starts=starts[mrid]),
+        const(b"_"),
+        ints(mpos),
+        const(b"_R"),
+        fixed(rn_chr),
+        const(b"_"),
+        fixed(ori),
+    ]
+    return build_strings(n, segs)
+
+
+def lexsort_strings(
+    data: np.ndarray, off: np.ndarray, leaders: list[np.ndarray] | None = None
+) -> np.ndarray:
+    """Stable sort permutation by (leaders..., byte string).
+
+    Strings sort like Python str on ASCII (shorter prefix first — rows are
+    zero-padded and NUL sorts before every ASCII byte).  ``leaders`` are
+    most-significant-first numeric keys applied before the string.
+    """
+    n = len(off) - 1
+    lens = np.diff(off)
+    wmax = int(lens.max(initial=0))
+    wpad = max(8, -(-wmax // 8) * 8)
+    mat = np.zeros((n, wpad), dtype=np.uint8)
+    scatter_runs(mat.reshape(-1), np.arange(n, dtype=np.int64) * wpad, data, lens,
+                 src_starts=off[:-1])
+    packed = mat.view(">u8")  # (n, wpad//8) big-endian words: numeric == lexicographic
+    keys = [packed[:, k] for k in range(packed.shape[1] - 1, -1, -1)]
+    if leaders:
+        keys.extend(reversed([np.asarray(x) for x in leaders]))
+    return np.lexsort(keys)

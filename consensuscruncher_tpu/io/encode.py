@@ -192,6 +192,37 @@ class ConsensusRecordWriter:
         if len(self._flag) >= self._flush_at:
             self.flush()
 
+    def add_columns(
+        self,
+        qname_data: np.ndarray, qname_lens: np.ndarray,
+        flag: np.ndarray, rid: np.ndarray, pos: np.ndarray, mapq: np.ndarray,
+        cigar_words: np.ndarray, cigar_lens: np.ndarray,
+        mrid: np.ndarray, mpos: np.ndarray, tlen: np.ndarray,
+        codes_data: np.ndarray, codes_lens: np.ndarray, qual_data: np.ndarray,
+        tag_data: np.ndarray, tag_lens: np.ndarray,
+    ) -> None:
+        """Column-form twin of ``add``: encode a whole group of records in
+        one ``encode_records`` pass and write immediately (groups are
+        batch-sized — no accumulation needed).  Flushes any scalar-``add``
+        backlog first so file order is call order."""
+        self.flush()
+        n = len(flag)
+        if n == 0:
+            return
+        blob = encode_records(
+            np.asarray(qname_data, np.uint8), np.asarray(qname_lens, np.int64),
+            np.asarray(flag, np.int64), np.asarray(rid, np.int64),
+            np.asarray(pos, np.int64), np.asarray(mapq, np.int64),
+            np.asarray(cigar_words, np.uint32), np.asarray(cigar_lens, np.int64),
+            np.asarray(mrid, np.int64), np.asarray(mpos, np.int64),
+            np.asarray(tlen, np.int64),
+            np.asarray(codes_data, np.uint8), np.asarray(codes_lens, np.int64),
+            np.asarray(qual_data, np.uint8),
+            np.asarray(tag_data, np.uint8), np.asarray(tag_lens, np.int64),
+        )
+        self._writer.write_encoded(blob)
+        self.n_written += n
+
     def flush(self) -> None:
         n = len(self._flag)
         if n == 0:
@@ -247,8 +278,10 @@ class RenameRetagWriter:
         self._items: list[tuple] = []  # (batch, idx, qname bytes, tag blob)
         self._batch_ids: set[int] = set()
 
-    def add(self, batch, idx: int, qname: str, tag_blob: bytes) -> None:
-        self._items.append((batch, idx, qname.encode("ascii"), tag_blob))
+    def add(self, batch, idx: int, qname: str | bytes, tag_blob: bytes) -> None:
+        if isinstance(qname, str):
+            qname = qname.encode("ascii")
+        self._items.append((batch, idx, qname, tag_blob))
         self._batch_ids.add(id(batch))
         # Bound retention in BYTES too: every buffered item pins its whole
         # source batch (tens of MB); sparse singletons would otherwise hold

@@ -25,7 +25,7 @@ import threading
 
 import numpy as np
 
-_ABI_VERSION = 4
+_ABI_VERSION = 5
 _SRC = os.path.join(os.path.dirname(__file__), "bgzf_native.cpp")
 
 _lock = threading.Lock()
@@ -82,6 +82,18 @@ def _build_and_load() -> ctypes.CDLL | None:
         ctypes.c_int32,                     # n_threads
         ctypes.c_char_p,                    # out
         ctypes.POINTER(ctypes.c_uint32),    # out_sizes
+    ]
+    lib.cct_pack8.restype = ctypes.c_int
+    lib.cct_pack8.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int64, ctypes.c_char_p,
+    ]
+    lib.cct_pack4.restype = ctypes.c_int
+    lib.cct_pack4.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int64, ctypes.c_char_p,
+    ]
+    lib.cct_byte_counts.restype = None
+    lib.cct_byte_counts.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64, ctypes.POINTER(ctypes.c_int64),
     ]
     lib.cct_copy_runs.restype = None
     lib.cct_copy_runs.argtypes = [
@@ -215,6 +227,93 @@ def copy_runs(
         dst.ctypes.data_as(ctypes.c_char_p), _i64_ptr(ds),
         _i64_ptr(ll), n,
     )
+
+
+def byte_counts(data: np.ndarray) -> np.ndarray:
+    """256-bin histogram of a uint8 array (one native pass; the np.unique
+    replacement for wire-batch codebook discovery)."""
+    lib = _get()
+    if lib is None:
+        raise RuntimeError("native codec unavailable")
+    data = np.ascontiguousarray(data.reshape(-1), dtype=np.uint8)
+    counts = np.zeros(256, dtype=np.int64)
+    lib.cct_byte_counts(data.ctypes.data_as(ctypes.c_char_p), data.size, _i64_ptr(counts))
+    return counts
+
+
+def pack_wire(bases: np.ndarray, quals: np.ndarray, lut: np.ndarray, four_bit: bool) -> np.ndarray:
+    """Fused base+qual-index wire pack over flattened last axis.
+
+    ``bases``/``quals``: same-shape uint8 arrays; ``lut``: 256-entry
+    qual->codebook-index table (255 = absent).  Returns the packed array
+    shaped like the input but with the last axis ``ceil(L/2)`` (4-bit mode)
+    or ``L`` (8-bit mode).  Raises ValueError on the same bad inputs as the
+    numpy path (base out of bit budget / qual not in codebook) — though
+    when a batch contains BOTH defects, which one is reported may differ
+    (numpy checks all bases first; the native scan is element-wise).
+    """
+    lib = _get()
+    if lib is None:
+        raise RuntimeError("native codec unavailable")
+    if bases.shape != quals.shape:
+        raise ValueError("bases/quals shape mismatch")
+    L = bases.shape[-1]
+    b = np.ascontiguousarray(bases.reshape(-1), dtype=np.uint8)
+    q = np.ascontiguousarray(quals.reshape(-1), dtype=np.uint8)
+    lu = np.ascontiguousarray(lut, dtype=np.uint8)
+    if lu.size != 256:
+        raise ValueError("lut must have 256 entries")
+    n = b.size
+    if four_bit:
+        if L % 2:
+            # pad each row's odd tail with a ZERO nibble (base 0, qual
+            # index 0 — byte-identical to pack4's concat-a-zero-nibble).
+            # The pad qual must hit LUT index 0 even when the codebook is
+            # duplicate-padded (a real qual can map to a later duplicate
+            # slot), so route it through a spare byte value pinned to 0.
+            rows = b.reshape(-1, L)
+            qrows = q.reshape(-1, L)
+            nr = rows.shape[0]
+            pb = np.zeros((nr, L + 1), np.uint8)
+            pq = np.zeros((nr, L + 1), np.uint8)
+            pb[:, :L] = rows
+            pq[:, :L] = qrows
+            spare = np.nonzero(lu == 255)[0]
+            lu = lu.copy()
+            if spare.size:
+                lu[spare[0]] = 0
+                pq[:, L] = spare[0]
+            else:  # <=16 codebook entries: a spare byte always exists
+                raise AssertionError("no spare LUT slot for the pad nibble")
+            pb = pb.reshape(-1)
+            pq = pq.reshape(-1)
+            out = np.empty(pb.size // 2, np.uint8)
+            rc = lib.cct_pack4(
+                pb.ctypes.data_as(ctypes.c_char_p), pq.ctypes.data_as(ctypes.c_char_p),
+                lu.ctypes.data_as(ctypes.c_char_p), pb.size,
+                out.ctypes.data_as(ctypes.c_char_p),
+            )
+        else:
+            out = np.empty((n + 1) // 2, np.uint8)
+            rc = lib.cct_pack4(
+                b.ctypes.data_as(ctypes.c_char_p), q.ctypes.data_as(ctypes.c_char_p),
+                lu.ctypes.data_as(ctypes.c_char_p), n,
+                out.ctypes.data_as(ctypes.c_char_p),
+            )
+        out_l = (L + 1) // 2
+    else:
+        out = np.empty(n, np.uint8)
+        rc = lib.cct_pack8(
+            b.ctypes.data_as(ctypes.c_char_p), q.ctypes.data_as(ctypes.c_char_p),
+            lu.ctypes.data_as(ctypes.c_char_p), n,
+            out.ctypes.data_as(ctypes.c_char_p),
+        )
+        out_l = L
+    if rc == 1:
+        raise ValueError("base codes exceed the wire bit budget")
+    if rc == 2:
+        raise ValueError("quals not in codebook")
+    return out.reshape(bases.shape[:-1] + (out_l,))
 
 
 def fill_runs_native(dst: np.ndarray, starts: np.ndarray, lens: np.ndarray, value: int) -> None:

@@ -27,7 +27,7 @@
 
 namespace {
 
-constexpr int kAbiVersion = 4;
+constexpr int kAbiVersion = 5;
 constexpr uint32_t kMaxBlockPayload = 0xFF00;  // htslib payload bound
 constexpr uint32_t kOutStride = 0x10400;       // per-block output slot (worst case + slack)
 
@@ -201,6 +201,53 @@ void cct_copy_runs(const uint8_t* src, const int64_t* src_starts, uint8_t* dst,
   for (int64_t i = 0; i < n; ++i) {
     std::memcpy(dst + dst_starts[i], src + src_starts[i], static_cast<size_t>(lens[i]));
   }
+}
+
+// Fused wire packing (ops/packing.py hot path).  lut is the 256-entry
+// qual->codebook-index table; entries of 255 mean "not in codebook".
+// Returns 0 on success, 1 if a base code exceeds the bit budget, 2 if a
+// qual is not in the codebook.
+//
+// pack8: out[i] = base[i] | (lut[qual[i]] << 3)          (n bytes out)
+// pack4: nibble per position, two positions per byte; odd n padded with a
+//        zero nibble.  out must hold (n+1)/2 bytes.
+int cct_pack8(const uint8_t* bases, const uint8_t* quals, const uint8_t* lut, int64_t n,
+              uint8_t* out) {
+  for (int64_t i = 0; i < n; ++i) {
+    const uint8_t b = bases[i];
+    const uint8_t q = lut[quals[i]];
+    if (b > 7) return 1;
+    if (q > 15) return 2;
+    out[i] = static_cast<uint8_t>(b | (q << 3));
+  }
+  return 0;
+}
+
+int cct_pack4(const uint8_t* bases, const uint8_t* quals, const uint8_t* lut, int64_t n,
+              uint8_t* out) {
+  const int64_t pairs = n / 2;
+  for (int64_t i = 0; i < pairs; ++i) {
+    const uint8_t b0 = bases[2 * i], b1 = bases[2 * i + 1];
+    const uint8_t q0 = lut[quals[2 * i]], q1 = lut[quals[2 * i + 1]];
+    if ((b0 | b1) > 3) return 1;
+    if (q0 > 3 || q1 > 3) return 2;
+    out[i] = static_cast<uint8_t>((b0 | (q0 << 2)) | ((b1 | (q1 << 2)) << 4));
+  }
+  if (n & 1) {
+    const uint8_t b = bases[n - 1];
+    const uint8_t q = lut[quals[n - 1]];
+    if (b > 3) return 1;
+    if (q > 3) return 2;
+    out[pairs] = static_cast<uint8_t>(b | (q << 2));
+  }
+  return 0;
+}
+
+// Byte-value histogram (256 bins) — the one-pass replacement for
+// np.unique over tens-of-MB uint8 wire batches.
+void cct_byte_counts(const uint8_t* data, int64_t n, int64_t* counts) {
+  std::memset(counts, 0, 256 * sizeof(int64_t));
+  for (int64_t i = 0; i < n; ++i) ++counts[data[i]];
 }
 
 // Ragged-run fill: dst[starts[i] : +lens[i]] = value (byte fill).

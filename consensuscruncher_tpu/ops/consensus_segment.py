@@ -448,30 +448,58 @@ def encode_member_batch(batch):
     from consensuscruncher_tpu.parallel.batching import QUAL_FILL_SENTINEL
 
     rows, qrows = batch.rows, batch.qrows
-    uniq = np.unique(qrows)
+    from consensuscruncher_tpu.io import native
+
+    if native.available():
+        # one-pass byte histogram: np.unique SORTS the whole wire batch
+        # (tens of MB), which showed up as a top-3 stage cost
+        uniq = np.nonzero(native.byte_counts(qrows))[0].astype(np.uint8)
+        present = np.nonzero(native.byte_counts(rows))[0]
+        base_max = int(present[-1]) if present.size else 0
+    else:
+        uniq = np.unique(qrows)
+        base_max = int(rows.max(initial=0))
     uniq = uniq[uniq != QUAL_FILL_SENTINEL]
     member_cap = pick_member_cap(batch.sizes[: batch.n_real])
-    if int(rows.max(initial=0)) < 4 and uniq.size <= CODEBOOK4_SIZE and uniq.size > 0:
-        book = build_codebook4(uniq)
+
+    def packed_wire(book, four_bit):
+        # Dead cells hold QUAL_FILL_SENTINEL (255 — never a live Phred, BAM
+        # caps at 93).  Mapping it to codebook slot 0 inside the LUT packs
+        # them as (base, book[0]) in the same fused pass, skipping the
+        # full-batch np.where rewrite; their decoded value never reaches a
+        # live output (vote kernels mask by fam_size, callers slice by
+        # length — the MemberBatch contract).
+        from consensuscruncher_tpu.ops.packing import _qual_lut
+
+        if native.available():
+            lut = _qual_lut(book)
+            lut[QUAL_FILL_SENTINEL] = 0
+            return native.pack_wire(rows, qrows, lut, four_bit=four_bit)
         qf = np.where(qrows == QUAL_FILL_SENTINEL, book[0], qrows)
-        return "pack4", pack4(rows, qf, book), book, member_cap
+        return pack4(rows, qf, book) if four_bit else pack(rows, qf, book)
+
+    if base_max < 4 and uniq.size <= CODEBOOK4_SIZE and uniq.size > 0:
+        book = build_codebook4(uniq)
+        return "pack4", packed_wire(book, True), book, member_cap
     if uniq.size <= CODEBOOK_SIZE:
         book = build_codebook(uniq if uniq.size else np.zeros(1, np.uint8))
-        qf = np.where(qrows == QUAL_FILL_SENTINEL, book[0], qrows)
-        return "pack8", pack(rows, qf, book), book, member_cap
+        return "pack8", packed_wire(book, False), book, member_cap
     qf = np.where(qrows == QUAL_FILL_SENTINEL, 0, qrows).astype(np.uint8)
     return "raw", rows, qf, member_cap
 
 
 def _run_member_batch_stream(batches, config: ConsensusConfig,
-                             prefetch_depth: int | None):
-    """Shared streaming harness: MemberBatch iterable -> per-family results.
+                             prefetch_depth: int | None, batched: bool = False):
+    """Shared streaming harness: MemberBatch iterable -> consensus results.
 
     Wire-encodes each batch on the prefetch producer thread, keeps one batch
-    in flight on the device, and yields ``(key, bases, quals)`` sliced to
-    each family's true length, in batch order.  The single owner of the
-    prefetch lifecycle / close-ordering / d2h conventions for both the
-    per-family and the block producers.
+    in flight on the device, and yields — in batch order — either
+    ``(key, bases, quals)`` per family (sliced to true length), or with
+    ``batched=True`` one ``(keys, lengths, out_bases, out_quals)`` tuple per
+    device batch (the ``(n_real, L_pad)`` result planes; callers slice rows
+    by ``lengths`` themselves, saving the per-family Python loop).  The
+    single owner of the prefetch lifecycle / close-ordering / d2h
+    conventions for both the per-family and the block producers.
     """
     from consensuscruncher_tpu.parallel.prefetch import DEFAULT_DEPTH, pipelined, prefetch
 
@@ -498,6 +526,10 @@ def _run_member_batch_stream(batches, config: ConsensusConfig,
         batch = item[0]
         out = np.asarray(handle)
         out_b, out_q = out[0], out[1]
+        if batched:
+            n = batch.n_real
+            yield batch.keys, batch.lengths[:n].astype(np.int64), out_b[:n], out_q[:n]
+            return
         for i, key in enumerate(batch.keys):
             length = int(batch.lengths[i])
             yield key, out_b[i, :length], out_q[i, :length]
@@ -554,6 +586,25 @@ def consensus_blocks_stream(
     yield from _run_member_batch_stream(
         bucket_member_blocks(items, max_batch=max_batch, member_limit=member_limit),
         config, prefetch_depth,
+    )
+
+
+def consensus_blocks_stream_batched(
+    items,
+    config: ConsensusConfig = ConsensusConfig(),
+    max_batch: int = 4096,
+    member_limit: int = 32768,
+    prefetch_depth: int | None = None,
+):
+    """Batch-granular twin of :func:`consensus_blocks_stream`: yields one
+    ``(keys, lengths, out_bases, out_quals)`` tuple per device batch so the
+    consumer can emit records with array passes instead of a per-family
+    loop.  Same vote program, bit-identical consensus bytes."""
+    from consensuscruncher_tpu.parallel.batching import bucket_member_blocks
+
+    yield from _run_member_batch_stream(
+        bucket_member_blocks(items, max_batch=max_batch, member_limit=member_limit),
+        config, prefetch_depth, batched=True,
     )
 
 

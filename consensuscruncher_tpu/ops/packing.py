@@ -65,6 +65,10 @@ def pack(bases: np.ndarray, quals: np.ndarray, codebook: np.ndarray) -> np.ndarr
     """Pack base codes + quals into one uint8 array of the same shape."""
     bases = np.asarray(bases, dtype=np.uint8)
     quals = np.asarray(quals, dtype=np.uint8)
+    from consensuscruncher_tpu.io import native
+
+    if native.available():  # fused single-pass LUT+pack (same errors)
+        return native.pack_wire(bases, quals, _qual_lut(codebook), four_bit=False)
     if bases.max(initial=0) > _BASE_MASK:
         raise ValueError("base codes exceed 3 bits")
     idx = _qual_lut(codebook)[quals]
@@ -160,6 +164,10 @@ def pack4(bases: np.ndarray, quals: np.ndarray, codebook4: np.ndarray) -> np.nda
     """
     bases = np.asarray(bases, dtype=np.uint8)
     quals = np.asarray(quals, dtype=np.uint8)
+    from consensuscruncher_tpu.io import native
+
+    if native.available():  # fused single-pass LUT+nibble pack (same errors)
+        return native.pack_wire(bases, quals, _qual_lut(codebook4), four_bit=True)
     if bases.max(initial=0) > 3:
         raise ValueError("4-bit mode requires pure-ACGT bases")
     idx = _qual_lut(codebook4)[quals]

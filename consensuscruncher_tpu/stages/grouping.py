@@ -20,6 +20,7 @@ from typing import Iterable, Iterator
 
 import numpy as np
 
+from consensuscruncher_tpu.core import qnames as qnames_mod
 from consensuscruncher_tpu.core import tags as tags_mod
 from consensuscruncher_tpu.io.bam import (
     BamHeader,
@@ -588,22 +589,68 @@ def consensus_windows_columnar(creader):
 class FamilyBlock:
     """All families of one columnar batch, as struct-of-arrays.
 
-    Per family (emission order): ``tags``, ``sizes``, ``target_len`` (modal
-    member length, ties -> longer), ``tmpl_*`` template fields, ``mapq_max``,
-    ``cigar_words`` (modal cigar, owned copies), ``tmpl_src`` (batch, row).
+    Per family (emission order): ``sizes``, ``target_len`` (modal member
+    length, ties -> longer), ``tmpl_*`` template fields, ``mapq_max``,
+    barcode bytes (``bcm``/``bclen``), consensus qnames
+    (``qname_data``/``qname_off`` — prebuilt ``sscs_qname`` strings),
+    modal cigars (``cigar_data``/``cigar_off`` uint32 words), and the
+    template source rows (``src_chunk``/``src_row`` into ``batches``).
     Per member (family-contiguous): ``mem_start``/``mem_len`` into
     ``data_chunks[mem_chunk[i]]`` (codes and quals share offsets), with
     ``fam_off`` boundaries.
+
+    ``tags`` materializes ``FamilyTag`` objects lazily (tests, stats text) —
+    the hot path never touches it.
     """
 
-    __slots__ = ("tags", "sizes", "target_len", "tmpl_flag", "tmpl_rid",
+    __slots__ = ("sizes", "target_len", "tmpl_flag", "tmpl_rid",
                  "tmpl_pos", "tmpl_mrid", "tmpl_mpos", "tmpl_tlen",
-                 "mapq_max", "cigar_words", "tmpl_src", "data_chunks",
-                 "mem_chunk", "mem_start", "mem_len", "fam_off")
+                 "mapq_max", "bcm", "bclen", "qname_data", "qname_off",
+                 "cigar_data", "cigar_off", "src_chunk", "src_row",
+                 "batches", "ref_names", "data_chunks",
+                 "mem_chunk", "mem_start", "mem_len", "fam_off",
+                 "_tags_cache")
 
     @property
     def n_fam(self) -> int:
-        return len(self.tags)
+        return len(self.sizes)
+
+    def qname(self, j: int) -> str:
+        return bytes(
+            self.qname_data[self.qname_off[j]:self.qname_off[j + 1]]
+        ).decode("ascii")
+
+    def barcode(self, j: int) -> str:
+        return bytes(self.bcm[j, : self.bclen[j]]).decode("ascii")
+
+    def cigar_words_of(self, j: int) -> np.ndarray:
+        return self.cigar_data[self.cigar_off[j]:self.cigar_off[j + 1]]
+
+    def tmpl_src(self, j: int):
+        return self.batches[int(self.src_chunk[j])], int(self.src_row[j])
+
+    @property
+    def tags(self) -> list:
+        """FamilyTag objects in emission order (lazy; cold paths only)."""
+        if self._tags_cache is None:
+            def _rname(i):
+                return self.ref_names[i] if i >= 0 else "*"
+
+            rn = np.where((self.tmpl_flag & FREAD1) != 0, 1, 2)
+            rev = (self.tmpl_flag & FREVERSE) != 0
+            self._tags_cache = [
+                tags_mod.FamilyTag(
+                    barcode=self.barcode(j),
+                    ref=_rname(int(self.tmpl_rid[j])),
+                    pos=int(self.tmpl_pos[j]),
+                    mate_ref=_rname(int(self.tmpl_mrid[j])),
+                    mate_pos=int(self.tmpl_mpos[j]),
+                    read_number=int(rn[j]),
+                    orientation="rev" if rev[j] else "fwd",
+                )
+                for j in range(self.n_fam)
+            ]
+        return self._tags_cache
 
 
 class _BlockSrc:
@@ -798,47 +845,48 @@ def _build_block(sources: list[_BlockSrc], header: BamHeader) -> FamilyBlock:
         sources, srt(srci), srt(gidx), fam_off, mem_len_s, target, n_fam
     )
 
-    # per-family python: barcode string + tag; emission order (rid, pos, str)
+    # emission order (rid, pos, str(tag)) — the object path's global order —
+    # via the vectorized tag-string builder; no per-family Python
     ref_names = [header.ref_name(i) for i in range(len(header.refs))]
-
-    def _rname(i):
-        return ref_names[i] if i >= 0 else "*"
-
-    tags = []
-    for j in range(n_fam):
-        i = first[j]
-        tags.append(tags_mod.FamilyTag(
-            barcode=bcm[i, : bclen[i]].tobytes().decode("ascii"),
-            ref=_rname(int(rid[i])),
-            pos=int(pos[i]),
-            mate_ref=_rname(int(mrid[i])),
-            mate_pos=int(mpos[i]),
-            read_number=int(rn[i]),
-            orientation="rev" if rev[i] else "fwd",
-        ))
-    frid = rid[first]
-    fpos = pos[first]
-    perm = sorted(range(n_fam),
-                  key=lambda j: (int(frid[j]), int(fpos[j]), str(tags[j])))
-    perm_arr = np.asarray(perm, dtype=np.int64)
+    pool = qnames_mod.ref_name_pool(ref_names)
+    frid, fpos = rid[first], pos[first]
+    fmrid, fmpos = mrid[first], mpos[first]
+    frn = rn[first].astype(np.int64)
+    frev = rev[first].astype(bool)
+    fbcm, fbclen = bcm[first], bclen[first].astype(np.int64)
+    tag_data, tag_off = qnames_mod.tag_strings_columnar(
+        fbcm, fbclen, frid, fpos, fmrid, fmpos, frn, frev, pool
+    )
+    perm_arr = qnames_mod.lexsort_strings(tag_data, tag_off, leaders=[frid, fpos])
 
     blk = FamilyBlock()
-    blk.tags = [tags[j] for j in perm]
+    blk._tags_cache = None
+    blk.ref_names = ref_names
     blk.sizes = sizes[perm_arr]
     blk.target_len = target[perm_arr]
     blk.tmpl_flag = flag[first][perm_arr]
-    blk.tmpl_rid = rid[first][perm_arr]
-    blk.tmpl_pos = pos[first][perm_arr]
-    blk.tmpl_mrid = mrid[first][perm_arr]
-    blk.tmpl_mpos = mpos[first][perm_arr]
+    blk.tmpl_rid = frid[perm_arr]
+    blk.tmpl_pos = fpos[perm_arr]
+    blk.tmpl_mrid = fmrid[perm_arr]
+    blk.tmpl_mpos = fmpos[perm_arr]
     blk.tmpl_tlen = tlen[first][perm_arr]
     blk.mapq_max = mapq_max[perm_arr]
-    blk.cigar_words = [cigars[j] for j in perm]
-    fsrc = srci[first]
-    fgid = gidx[first]
-    blk.tmpl_src = [
-        (sources[int(fsrc[j])].batch, int(fgid[j])) for j in perm
-    ]
+    blk.bcm = fbcm[perm_arr]
+    blk.bclen = fbclen[perm_arr]
+    blk.qname_data, blk.qname_off = qnames_mod.sscs_qnames_columnar(
+        blk.bcm, blk.bclen, blk.tmpl_rid, blk.tmpl_pos, blk.tmpl_mrid,
+        blk.tmpl_mpos, frn[perm_arr], frev[perm_arr], pool,
+    )
+    cig_lens = np.fromiter((len(c) for c in cigars), np.int64, n_fam)[perm_arr]
+    blk.cigar_off = np.zeros(n_fam + 1, dtype=np.int64)
+    np.cumsum(cig_lens, out=blk.cigar_off[1:])
+    blk.cigar_data = (
+        np.concatenate([cigars[j] for j in perm_arr]).astype(np.uint32)
+        if n_fam else np.empty(0, np.uint32)
+    )
+    blk.src_chunk = srci[first][perm_arr]
+    blk.src_row = gidx[first][perm_arr]
+    blk.batches = [s.batch for s in sources]
     blk.data_chunks = [(s.codes_data, s.qual_data) for s in sources]
     # permute member geometry to emission order without per-family slicing:
     # rank families by perm, stable-argsort members by their family's rank

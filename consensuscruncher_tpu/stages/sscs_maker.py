@@ -191,11 +191,10 @@ def run_sscs(
     _XF1 = struct.pack("<i", 1)
 
     def block_items():
-        """Fully-vectorized producer: route FamilyBlock events, register
-        pending families, hand the device pipeline array-level items."""
+        """Fully-vectorized producer: route FamilyBlock events and hand the
+        device pipeline array-level items keyed by ``(block, j)``."""
         from consensuscruncher_tpu.stages.grouping import stream_family_blocks
 
-        next_id = 0
         for kind, a, b in stream_family_blocks(reader, header, bdelim):
             if kind == "bad":
                 stats.incr("total_reads")
@@ -207,54 +206,80 @@ def run_sscs(
             sizes = block.sizes
             stats.incr("total_reads", int(sizes.sum()))
             stats.incr("families", block.n_fam)
-            for s in sizes:
-                hist.add(int(s))
+            hist.add_array(sizes)
             multi = np.nonzero(sizes >= 2)[0]
             stats.incr("singletons", block.n_fam - len(multi))
             for j in np.nonzero(sizes == 1)[0]:
-                batch, idx = block.tmpl_src[int(j)]
-                tag = block.tags[int(j)]
+                j = int(j)
+                batch, idx = block.tmpl_src(j)
                 if batch.tags_start[idx] == batch.rec_off[idx + 1]:
                     # tag-less record: rename+retag as batched blob surgery
                     single_surgery.add(
-                        batch, idx, tags_mod.sscs_qname(tag),
-                        b"XTZ" + tag.barcode.encode("ascii") + b"\x00XFi" + _XF1,
+                        batch, idx,
+                        bytes(block.qname_data[block.qname_off[j]:block.qname_off[j + 1]]),
+                        b"XTZ" + bytes(block.bcm[j, : block.bclen[j]]) + b"\x00XFi" + _XF1,
                     )
                     continue
                 # existing tags: the object path's dict-replace semantics
                 # (surgery only appends); flush first to preserve file order
                 single_surgery.flush()
                 out = batch.materialize(idx)
-                out.qname = tags_mod.sscs_qname(tag)
+                out.qname = block.qname(j)
                 out.tags = dict(out.tags)
-                out.tags["XT"] = ("Z", tag.barcode)
+                out.tags["XT"] = ("Z", block.barcode(j))
                 out.tags["XF"] = ("i", 1)
                 singleton_writer.write(out)
             if len(multi) == 0:
                 continue
-            ids = list(range(next_id, next_id + len(multi)))
-            for fid, j in zip(ids, multi):
-                pending[fid] = (block, int(j))
-            next_id += len(multi)
-            yield block, multi, ids
+            keys = [(block, int(j)) for j in multi]
+            yield block, multi, keys
 
     rec_writer = ConsensusRecordWriter(sscs_writer)
 
-    def emit_block(fid, codes, quals):
-        block, j = pending.pop(fid)
-        tag = block.tags[j]
-        tag_blob = (
-            b"XTZ" + tag.barcode.encode("ascii")
-            + b"\x00XFi" + struct.pack("<i", int(block.sizes[j]))
-        )
-        rec_writer.add(
-            tags_mod.sscs_qname(tag), int(block.tmpl_flag[j]) & _KEEP_FLAGS,
-            int(block.tmpl_rid[j]), int(block.tmpl_pos[j]),
-            int(block.mapq_max[j]), block.cigar_words[j],
-            int(block.tmpl_mrid[j]), int(block.tmpl_mpos[j]),
-            int(block.tmpl_tlen[j]), codes, quals, tag_blob,
-        )
-        stats.incr("sscs_written")
+    def emit_batch(keys, lengths, out_b, out_q):
+        """Array-level consensus emission: one encode pass per same-block
+        run of a device batch (runs are contiguous — buckets fill in block
+        order)."""
+        from consensuscruncher_tpu.core.qnames import build_strings, const, fixed, ragged
+        from consensuscruncher_tpu.utils.ragged import gather_runs
+
+        n = len(keys)
+        Lpad = out_b.shape[1]
+        flat_b, flat_q = out_b.reshape(-1), out_q.reshape(-1)
+        i = 0
+        while i < n:
+            block = keys[i][0]
+            k = i + 1
+            while k < n and keys[k][0] is block:
+                k += 1
+            js = np.fromiter((keys[t][1] for t in range(i, k)), np.int64, k - i)
+            rows = np.arange(i, k, dtype=np.int64)
+            lens = lengths[i:k]
+            codes_data, _ = gather_runs(flat_b, rows * Lpad, lens)
+            qual_data, _ = gather_runs(flat_q, rows * Lpad, lens)
+            qn_lens = block.qname_off[js + 1] - block.qname_off[js]
+            qn_data, _ = gather_runs(block.qname_data, block.qname_off[js], qn_lens)
+            cig_lens = block.cigar_off[js + 1] - block.cigar_off[js]
+            cig_data, _ = gather_runs(block.cigar_data, block.cigar_off[js], cig_lens)
+            fam_sizes = block.sizes[js].astype("<i4")
+            tag_data, tag_off = build_strings(k - i, [
+                const(b"XTZ"),
+                ragged(block.bcm.reshape(-1), block.bclen[js],
+                       starts=js * block.bcm.shape[1]),
+                const(b"\x00XFi"),
+                fixed(fam_sizes.view(np.uint8).reshape(k - i, 4)),
+            ])
+            rec_writer.add_columns(
+                qn_data, qn_lens,
+                block.tmpl_flag[js] & _KEEP_FLAGS,
+                block.tmpl_rid[js], block.tmpl_pos[js], block.mapq_max[js],
+                cig_data, cig_lens,
+                block.tmpl_mrid[js], block.tmpl_mpos[js], block.tmpl_tlen[js],
+                codes_data, lens, qual_data,
+                tag_data, np.diff(tag_off),
+            )
+            stats.incr("sscs_written", k - i)
+            i = k
 
     def emit(fid, codes, quals):
         tag, members = pending.pop(fid)
@@ -295,27 +320,32 @@ def run_sscs(
         if backend == "tpu":
             if use_blocks:
                 from consensuscruncher_tpu.ops.consensus_segment import (
-                    consensus_blocks_stream,
+                    consensus_blocks_stream_batched,
                 )
 
                 # 4x the dense batch size: the packed wire makes bytes cheap,
                 # and on a tunneled device per-dispatch roundtrip latency is
                 # the cost that's left — fewer, larger batches win.
-                stream = consensus_blocks_stream(block_items(), cfg, max_batch=4 * max_batch)
-                sink = emit_block
+                stream = consensus_blocks_stream_batched(
+                    block_items(), cfg, max_batch=4 * max_batch
+                )
+                try:
+                    for keys, lengths, out_b, out_q in stream:
+                        emit_batch(keys, lengths, out_b, out_q)
+                finally:
+                    # Must run BEFORE the writers close below: closing the
+                    # stream stops and joins the prefetch producer thread,
+                    # which executes block_items() — i.e. the thread writing
+                    # to bad_writer/singleton_writer.  Abandoning it to GC
+                    # would race w.abort() against in-flight writes.
+                    stream.close()
             else:
                 stream = consensus_families(events(), cfg, max_batch=max_batch, mesh=mesh)
-                sink = emit
-            try:
-                for fid, codes, quals in stream:
-                    sink(fid, codes, quals)
-            finally:
-                # Must run BEFORE the writers close below: closing the stream
-                # stops and joins the prefetch producer thread, which is the
-                # thread executing events() — i.e. the thread writing to
-                # bad_writer/singleton_writer.  Abandoning it to GC would
-                # race w.abort() against in-flight writes on error paths.
-                stream.close()
+                try:
+                    for fid, codes, quals in stream:
+                        emit(fid, codes, quals)
+                finally:
+                    stream.close()
         else:
             # "reference" = the per-position Counter loop
             # (``core.consensus_cpu.consensus_maker``, the pinned oracle of

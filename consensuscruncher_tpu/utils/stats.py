@@ -55,6 +55,14 @@ class FamilySizeHistogram:
     def add(self, size: int) -> None:
         self._counts[size] += 1
 
+    def add_array(self, sizes) -> None:
+        """Bulk add (one bincount instead of a per-family loop)."""
+        import numpy as np
+
+        b = np.bincount(np.asarray(sizes, dtype=np.int64))
+        for s in np.nonzero(b)[0]:
+            self._counts[int(s)] += int(b[s])
+
     def write(self, path) -> None:
         with open(path, "w") as fh:
             fh.write("family_size\tcount\n")

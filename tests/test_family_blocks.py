@@ -90,7 +90,7 @@ def _families_from_blocks(path, batch_bytes):
             out.append((
                 str(block.tags[j]), int(block.sizes[j]),
                 int(block.target_len[j]), int(block.mapq_max[j]),
-                block.cigar_words[j].tolist(),
+                block.cigar_words_of(j).tolist(),
                 int(block.tmpl_flag[j]), int(block.tmpl_pos[j]),
                 [m.tolist() for m in members],
             ))

@@ -149,3 +149,38 @@ def test_packed_step_matches_raw_step():
     packed_out = [np.asarray(x) for x in packed(pa, na, pb, nb, book)]
     for r, p in zip(raw_out, packed_out):
         np.testing.assert_array_equal(r, p)
+
+
+def test_pack_native_numpy_byte_parity_odd_length():
+    """Native and numpy wire packs are byte-identical, including the odd-
+    length 4-bit pad nibble with duplicate-padded codebooks (regression:
+    the pad must be a ZERO nibble even when the real quals map to later
+    duplicate LUT slots)."""
+    import os
+
+    from consensuscruncher_tpu.io import native
+    from consensuscruncher_tpu.ops.packing import build_codebook4, pack4
+
+    if not native.available():
+        pytest.skip("native codec unavailable")
+    rng = np.random.default_rng(11)
+    try:
+        for nq in (1, 2, 3, 4):
+            pool = np.array([12, 23, 30, 37][:nq], np.uint8)
+            for L in (5, 7, 8, 33):
+                bases = rng.integers(0, 4, (6, L)).astype(np.uint8)
+                quals = pool[rng.integers(0, nq, (6, L))]
+                book = build_codebook4(pool)
+                a = pack4(bases, quals, book)
+                os.environ["CCT_NO_NATIVE"] = "1"
+                native._tried = False
+                native._lib = None
+                b = pack4(bases, quals, book)
+                del os.environ["CCT_NO_NATIVE"]
+                native._tried = False
+                native._lib = None
+                np.testing.assert_array_equal(a, b)
+    finally:
+        os.environ.pop("CCT_NO_NATIVE", None)
+        native._tried = False
+        native._lib = None

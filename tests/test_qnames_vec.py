@@ -1,0 +1,132 @@
+"""Vectorized qname/tag-string builders vs the scalar oracles (core.tags)."""
+
+import numpy as np
+import pytest
+
+from consensuscruncher_tpu.core import qnames as qv
+from consensuscruncher_tpu.core import tags as tags_mod
+
+
+def test_format_ints_matches_str():
+    rng = np.random.default_rng(0)
+    vals = np.concatenate([
+        np.array([0, 1, 9, 10, 99, 100, 101, 12345, 10**9, 2**31 - 1], np.int64),
+        rng.integers(0, 2**31, 200),
+    ])
+    data, widths = qv.format_ints(vals)
+    off = np.zeros(len(vals) + 1, np.int64)
+    np.cumsum(widths, out=off[1:])
+    for i, v in enumerate(vals):
+        got = bytes(data[off[i]:off[i + 1]]).decode()
+        assert got == str(int(v)), (v, got)
+
+
+def test_format_ints_rejects_negative():
+    with pytest.raises(ValueError):
+        qv.format_ints(np.array([3, -1], np.int64))
+
+
+def _random_families(rng, n, ref_names):
+    """Columnar family fields + the equivalent FamilyTag objects."""
+    bcs = []
+    for _ in range(n):
+        u = rng.integers(2, 7)
+        left = "".join("ACGT"[i] for i in rng.integers(0, 4, u))
+        right = "".join("ACGT"[i] for i in rng.integers(0, 4, u))
+        bcs.append(f"{left}.{right}")
+    w = max(len(b) for b in bcs)
+    bcm = np.zeros((n, w), np.uint8)
+    bclen = np.zeros(n, np.int64)
+    for i, b in enumerate(bcs):
+        eb = b.encode()
+        bcm[i, :len(eb)] = np.frombuffer(eb, np.uint8)
+        bclen[i] = len(eb)
+    rid = rng.integers(0, len(ref_names), n)
+    mrid = rng.integers(0, len(ref_names), n)
+    pos = rng.integers(0, 10**7, n)
+    mpos = rng.integers(0, 10**7, n)
+    rn = rng.integers(1, 3, n)
+    rev = rng.integers(0, 2, n).astype(bool)
+    tags = [
+        tags_mod.FamilyTag(
+            barcode=bcs[i],
+            ref=ref_names[rid[i]], pos=int(pos[i]),
+            mate_ref=ref_names[mrid[i]], mate_pos=int(mpos[i]),
+            read_number=int(rn[i]),
+            orientation="rev" if rev[i] else "fwd",
+        )
+        for i in range(n)
+    ]
+    return (bcm, bclen, rid, pos, mrid, mpos, rn, rev), tags
+
+
+REF_NAMES = ["chr1", "chr10", "chr2", "chrM", "alt_KI270728v1"]
+
+
+def test_sscs_qnames_columnar_parity():
+    rng = np.random.default_rng(7)
+    cols, tags = _random_families(rng, 300, REF_NAMES)
+    pool = qv.ref_name_pool(REF_NAMES)
+    data, off = qv.sscs_qnames_columnar(*cols, pool)
+    for i, tag in enumerate(tags):
+        got = bytes(data[off[i]:off[i + 1]]).decode()
+        assert got == tags_mod.sscs_qname(tag), (i, got, tags_mod.sscs_qname(tag))
+
+
+def test_sscs_qnames_same_coords_both_mates():
+    # equal (ref,pos)==(mate_ref,mate_pos): low_is_self uses <= (parity with
+    # the tuple compare in tags._sorted_coords via low_is_self)
+    pool = qv.ref_name_pool(["chr3"])
+    cols = (
+        np.frombuffer(b"AA.CC", np.uint8).reshape(1, 5), np.array([5]),
+        np.array([0]), np.array([500]), np.array([0]), np.array([500]),
+        np.array([2]), np.array([True]),
+    )
+    data, off = qv.sscs_qnames_columnar(*cols, pool)
+    tag = tags_mod.FamilyTag("AA.CC", "chr3", 500, "chr3", 500, 2, "rev")
+    assert bytes(data[off[0]:off[1]]).decode() == tags_mod.sscs_qname(tag)
+
+
+def test_tag_strings_columnar_parity():
+    rng = np.random.default_rng(9)
+    cols, tags = _random_families(rng, 300, REF_NAMES)
+    pool = qv.ref_name_pool(REF_NAMES)
+    data, off = qv.tag_strings_columnar(*cols, pool)
+    for i, tag in enumerate(tags):
+        got = bytes(data[off[i]:off[i + 1]]).decode()
+        assert got == str(tag), (i, got, str(tag))
+
+
+def test_unmapped_star_rendering():
+    # rid -1 renders "*" (pool slot -1), matching _rname in the block path
+    pool = qv.ref_name_pool(["chr1"])
+    cols = (
+        np.frombuffer(b"A.C", np.uint8).reshape(1, 3), np.array([3]),
+        np.array([-1]), np.array([7]), np.array([0]), np.array([9]),
+        np.array([1]), np.array([False]),
+    )
+    data, off = qv.tag_strings_columnar(*cols, pool)
+    assert bytes(data[off[0]:off[1]]).decode() == "A.C_*_7_chr1_9_R1_fwd"
+
+
+def test_lexsort_strings_matches_python_sorted():
+    rng = np.random.default_rng(3)
+    cols, tags = _random_families(rng, 400, REF_NAMES)
+    pool = qv.ref_name_pool(REF_NAMES)
+    data, off = qv.tag_strings_columnar(*cols, pool)
+    rid, pos = cols[2], cols[3]
+    perm = qv.lexsort_strings(data, off, leaders=[rid, pos])
+    expect = sorted(range(len(tags)),
+                    key=lambda j: (int(rid[j]), int(pos[j]), str(tags[j])))
+    assert perm.tolist() == expect
+
+
+def test_lexsort_strings_prefix_order():
+    strs = [b"abc", b"ab", b"abcd", b"aBc", b"", b"zz"]
+    data = np.frombuffer(b"".join(strs), np.uint8)
+    lens = np.array([len(s) for s in strs], np.int64)
+    off = np.zeros(len(strs) + 1, np.int64)
+    np.cumsum(lens, out=off[1:])
+    perm = qv.lexsort_strings(data, off)
+    got = [strs[i] for i in perm]
+    assert got == sorted(strs)
