@@ -225,24 +225,110 @@ def tag_strings_columnar(
     return build_strings(n, segs)
 
 
-def lexsort_strings(
-    data: np.ndarray, off: np.ndarray, leaders: list[np.ndarray] | None = None
+def dcs_qnames_columnar(
+    canon_bcm: np.ndarray, canon_bclen: np.ndarray,
+    rid: np.ndarray, pos: np.ndarray, mrid: np.ndarray, mpos: np.ndarray,
+    pool: tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray],
+) -> tuple[np.ndarray, np.ndarray]:
+    """Byte-exact ``core.tags.dcs_qname`` over columnar pairs.
+
+    ``canon_bcm``/``canon_bclen`` must already hold the canonical barcode
+    (lexicographic min of the barcode and its mirror — what
+    ``stages.grouping._build_pair_block`` computes as ``canon_bcm``).
+    """
+    data, starts, lens, rank = pool
+    rid = np.asarray(rid, dtype=np.int64)
+    mrid = np.asarray(mrid, dtype=np.int64)
+    pos = np.asarray(pos, dtype=np.int64)
+    mpos = np.asarray(mpos, dtype=np.int64)
+    r_rank, m_rank = rank[rid], rank[mrid]
+    low_is_self = (r_rank < m_rank) | ((r_rank == m_rank) & (pos <= mpos))
+    lo_rid = np.where(low_is_self, rid, mrid)
+    hi_rid = np.where(low_is_self, mrid, rid)
+    lo_pos = np.where(low_is_self, pos, mpos)
+    hi_pos = np.where(low_is_self, mpos, pos)
+    n = len(rid)
+    w = canon_bcm.shape[1] if canon_bcm.ndim == 2 else 0
+    segs = [
+        ragged(canon_bcm.reshape(-1), np.asarray(canon_bclen, np.int64),
+               starts=np.arange(n, dtype=np.int64) * w),
+        const(b":"),
+        ragged(data, lens[lo_rid], starts=starts[lo_rid]),
+        const(b":"),
+        ints(lo_pos),
+        const(b":"),
+        ragged(data, lens[hi_rid], starts=starts[hi_rid]),
+        const(b":"),
+        ints(hi_pos),
+    ]
+    return build_strings(n, segs)
+
+
+def compare_string_rows(
+    data: np.ndarray,
+    starts_a: np.ndarray, lens_a: np.ndarray,
+    starts_b: np.ndarray, lens_b: np.ndarray,
 ) -> np.ndarray:
-    """Stable sort permutation by (leaders..., byte string).
+    """Row-wise lexicographic compare of two string columns drawn from the
+    same pool: returns int8 per row (-1 a<b, 0 equal, +1 a>b), with Python
+    str semantics (shorter prefix sorts first)."""
+    lens_a = np.asarray(lens_a, dtype=np.int64)
+    lens_b = np.asarray(lens_b, dtype=np.int64)
+    starts_a = np.asarray(starts_a, dtype=np.int64)
+    starts_b = np.asarray(starts_b, dtype=np.int64)
+    n = len(starts_a)
+    w = int(max(lens_a.max(initial=0), lens_b.max(initial=0), 1))
+    ma = np.zeros((n, w), dtype=np.uint8)
+    mb = np.zeros((n, w), dtype=np.uint8)
+    scatter_runs(ma.reshape(-1), np.arange(n, dtype=np.int64) * w, data, lens_a,
+                 src_starts=starts_a)
+    scatter_runs(mb.reshape(-1), np.arange(n, dtype=np.int64) * w, data, lens_b,
+                 src_starts=starts_b)
+    diff = ma != mb
+    has = diff.any(axis=1)
+    first = np.argmax(diff, axis=1)
+    rows = np.arange(n)
+    out = np.zeros(n, dtype=np.int8)
+    lt = ma[rows, first] < mb[rows, first]
+    out[has & lt] = -1
+    out[has & ~lt] = 1
+    return out
+
+
+def lexsort_strings(
+    data: np.ndarray, off: np.ndarray,
+    leaders: list[np.ndarray] | None = None,
+    trailers: list[np.ndarray] | None = None,
+) -> np.ndarray:
+    """Stable sort permutation by (leaders..., byte string, trailers...).
 
     Strings sort like Python str on ASCII (shorter prefix first — rows are
     zero-padded and NUL sorts before every ASCII byte).  ``leaders`` are
-    most-significant-first numeric keys applied before the string.
+    most-significant-first numeric keys applied before the string;
+    ``trailers`` break ties after it.
     """
-    n = len(off) - 1
-    lens = np.diff(off)
+    return lexsort_string_refs(data, off[:-1], np.diff(off), leaders, trailers)
+
+
+def lexsort_string_refs(
+    data: np.ndarray, starts: np.ndarray, lens: np.ndarray,
+    leaders: list[np.ndarray] | None = None,
+    trailers: list[np.ndarray] | None = None,
+) -> np.ndarray:
+    """:func:`lexsort_strings` over arbitrarily-addressed rows of a pool
+    (``starts``/``lens`` need not be contiguous or unique)."""
+    n = len(starts)
+    lens = np.asarray(lens, dtype=np.int64)
     wmax = int(lens.max(initial=0))
     wpad = max(8, -(-wmax // 8) * 8)
     mat = np.zeros((n, wpad), dtype=np.uint8)
     scatter_runs(mat.reshape(-1), np.arange(n, dtype=np.int64) * wpad, data, lens,
-                 src_starts=off[:-1])
+                 src_starts=np.asarray(starts, dtype=np.int64))
     packed = mat.view(">u8")  # (n, wpad//8) big-endian words: numeric == lexicographic
-    keys = [packed[:, k] for k in range(packed.shape[1] - 1, -1, -1)]
+    keys: list[np.ndarray] = []
+    if trailers:
+        keys.extend(reversed([np.asarray(x) for x in trailers]))
+    keys.extend(packed[:, k] for k in range(packed.shape[1] - 1, -1, -1))
     if leaders:
         keys.extend(reversed([np.asarray(x) for x in leaders]))
     return np.lexsort(keys)

@@ -278,13 +278,18 @@ def pack_wire(bases: np.ndarray, quals: np.ndarray, lut: np.ndarray, four_bit: b
             pq = np.zeros((nr, L + 1), np.uint8)
             pb[:, :L] = rows
             pq[:, :L] = qrows
-            spare = np.nonzero(lu == 255)[0]
+            # The spare byte must not occur in the data: doctoring lut[v]=0
+            # for a value the data contains would silently pack an
+            # out-of-codebook qual instead of raising like the numpy path.
+            present = byte_counts(q) > 0
+            spare = np.nonzero((lu == 255) & ~present)[0]
+            if not spare.size:
+                # every absent-from-codebook byte occurs in the data ->
+                # the data necessarily holds an invalid qual
+                raise ValueError("quals not in codebook")
             lu = lu.copy()
-            if spare.size:
-                lu[spare[0]] = 0
-                pq[:, L] = spare[0]
-            else:  # <=16 codebook entries: a spare byte always exists
-                raise AssertionError("no spare LUT slot for the pad nibble")
+            lu[spare[0]] = 0
+            pq[:, L] = spare[0]
             pb = pb.reshape(-1)
             pq = pq.reshape(-1)
             out = np.empty(pb.size // 2, np.uint8)

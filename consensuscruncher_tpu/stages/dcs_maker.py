@@ -269,7 +269,7 @@ def _consume_pair_blocks(reader, stats, unpaired_writer, rec_writer,
             unpaired_writer.write_encoded(data)
             k = k2
 
-        n_pairs = len(blk.pair_tags)
+        n_pairs = blk.n_pairs
         if n_pairs == 0:
             continue
         # per-pair canon columns (vectorized per source)
@@ -312,31 +312,49 @@ def _consume_pair_blocks(reader, stats, unpaired_writer, rec_writer,
                 out_q[m] = quals[coff[rows][:, None] + np.arange(L)]
             return out_c, out_q
 
+        from consensuscruncher_tpu.core.qnames import build_strings, const, fixed, ragged
+
         for L in np.unique(lseqc):
             L = int(L)
             sel = lseqc == L
             s1, q1 = member_rows(blk.pair_canon_src, blk.pair_canon_row, sel, L)
             s2, q2 = member_rows(blk.pair_other_src, blk.pair_other_row, sel, L)
             out_b, out_q = _duplex_vote_batch(s1, q1, s2, q2, qual_cap, backend)
-            for k, p in enumerate(np.nonzero(sel)[0]):
-                p = int(p)
-                tag = blk.pair_tags[p]
-                batch = blk.sources[int(blk.pair_canon_src[p])]
-                cst = int(cstartc[p])
-                words = np.ascontiguousarray(
-                    batch.buf[cst : cst + 4 * int(ncigc[p])]
-                ).view("<u4")
-                tag_blob = (
-                    b"XTZ" + tag.barcode.encode("ascii")
-                    + b"\x00XFi" + struct.pack("<i", int(blk.pair_xf[p]))
-                )
-                rec_writer.add(
-                    tags_mod.dcs_qname(tag), int(flagc[p]) & _KEEP_FLAGS,
-                    int(ridc[p]), int(posc[p]), int(mapqc[p]), np.array(words),
-                    int(mridc[p]), int(mposc[p]), int(tlenc[p]),
-                    out_b[k], out_q[k], tag_blob,
-                )
-                stats.incr("dcs_written")
+            ps = np.nonzero(sel)[0]
+            k = len(ps)
+            # modal cigar bytes per pair, gathered per source batch
+            cig_lens = ncigc[ps]
+            cig_data = np.empty(int(cig_lens.sum()) * 4, np.uint8)
+            dst = np.zeros(k, np.int64)
+            np.cumsum(4 * cig_lens[:-1], out=dst[1:])
+            for si, batch in enumerate(blk.sources):
+                m = blk.pair_canon_src[ps] == si
+                if not m.any():
+                    continue
+                gather_to = dst[m]
+                from consensuscruncher_tpu.utils.ragged import scatter_runs
+
+                scatter_runs(cig_data, gather_to, batch.buf,
+                             4 * cig_lens[m], src_starts=cstartc[ps[m]])
+            qn_lens = blk.qname_off[ps + 1] - blk.qname_off[ps]
+            qn_data, _ = gather_runs(blk.qname_data, blk.qname_off[ps], qn_lens)
+            xf_le = blk.pair_xf[ps].astype("<i4").view(np.uint8).reshape(k, 4)
+            tag_data, tag_off = build_strings(k, [
+                const(b"XTZ"),
+                ragged(blk.pair_bcm.reshape(-1), blk.pair_bclen[ps],
+                       starts=ps * blk.pair_bcm.shape[1]),
+                const(b"\x00XFi"),
+                fixed(xf_le),
+            ])
+            rec_writer.add_columns(
+                qn_data, qn_lens,
+                flagc[ps] & _KEEP_FLAGS, ridc[ps], posc[ps], mapqc[ps],
+                np.ascontiguousarray(cig_data).view("<u4"), cig_lens,
+                mridc[ps], mposc[ps], tlenc[ps],
+                out_b.reshape(-1), np.full(k, L, np.int64), out_q.reshape(-1),
+                tag_data, np.diff(tag_off),
+            )
+            stats.incr("dcs_written", k)
 
 
 def run_dcs(

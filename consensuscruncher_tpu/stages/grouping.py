@@ -780,6 +780,18 @@ def _modal_cigars(sources, srci, gidx, fam_off, mem_len, target, n_fam):
     return out
 
 
+def _header_name_pool(header: BamHeader):
+    """(ref_names, qnames ref-name pool) for a header, cached on the header
+    instance — rebuilt-per-block encode+sort of every contig name is pure
+    waste on many-contig references (GRCh38 ~3.4k, transcriptomes 100k+)."""
+    cached = getattr(header, "_cct_name_pool", None)
+    if cached is None:
+        ref_names = [header.ref_name(i) for i in range(len(header.refs))]
+        cached = (ref_names, qnames_mod.ref_name_pool(ref_names))
+        header._cct_name_pool = cached
+    return cached
+
+
 def _build_block(sources: list[_BlockSrc], header: BamHeader) -> FamilyBlock:
     """Vectorized family construction over one or more row sources."""
     def col(fn):
@@ -847,8 +859,7 @@ def _build_block(sources: list[_BlockSrc], header: BamHeader) -> FamilyBlock:
 
     # emission order (rid, pos, str(tag)) — the object path's global order —
     # via the vectorized tag-string builder; no per-family Python
-    ref_names = [header.ref_name(i) for i in range(len(header.refs))]
-    pool = qnames_mod.ref_name_pool(ref_names)
+    ref_names, pool = _header_name_pool(header)
     frid, fpos = rid[first], pos[first]
     fmrid, fmpos = mrid[first], mpos[first]
     frn = rn[first].astype(np.int64)
@@ -1000,16 +1011,23 @@ def stream_family_blocks(
 class PairBlock:
     """Pairing results for one batch of consensus reads.
 
-    ``pairs_*``: per pair, (source, row) of the canonical-strand read and
-    its partner, the precomputed FamilyTag of the canonical read, and the
-    combined family size.  ``unpaired``: (source, row) in emission order.
-    ``sources``: the ColumnarBatches rows refer to.
+    ``pair_*``: per pair in emission order — (source, row) of the
+    canonical-strand read and its partner, the canonical barcode bytes
+    (``pair_bcm``/``pair_bclen``), the prebuilt ``dcs_qname`` strings
+    (``qname_data``/``qname_off``), and the combined family size.
+    ``unpaired``: (source, row) in emission order.  ``sources``: the
+    ColumnarBatches rows refer to.
     """
 
     __slots__ = ("sources", "pair_canon_src", "pair_canon_row",
-                 "pair_other_src", "pair_other_row", "pair_tags", "pair_xf",
+                 "pair_other_src", "pair_other_row", "pair_bcm",
+                 "pair_bclen", "qname_data", "qname_off", "pair_xf",
                  "unpaired_src", "unpaired_row", "stats_total",
                  "stats_unpaired", "stats_pairs", "stats_mismatch")
+
+    @property
+    def n_pairs(self) -> int:
+        return len(self.pair_xf)
 
 
 def _mirror_bcm(bcm: np.ndarray, bclen: np.ndarray):
@@ -1165,89 +1183,111 @@ def _build_pair_block(sources: list[tuple], header: BamHeader) -> PairBlock:
             & (srt(canon_rn)[1:] == srt(canon_rn)[:-1])
             & (srt(rev)[1:] == srt(rev)[:-1])
         )
-    bounds = np.concatenate([[0], np.nonzero(~same)[0], [n]]) if n else np.zeros(1, np.int64)
+    # ---- vectorized run walk (the object semantics, no per-run Python) ----
+    # run id per SORTED element; stable lexsort => within-run order is
+    # stream order (srci, grow)
+    run_id = np.cumsum(~same) if n else np.zeros(0, np.int64)
+    n_runs = int(run_id[-1]) + 1 if n else 0
+    rn_sorted = rn[order].astype(np.int64)
 
-    ref_names = [header.ref_name(i) for i in range(len(header.refs))]
+    # Dedupe by full tag, dict last-wins.  Within a run the full tag is
+    # uniquely determined by the read number: non-palindromic barcodes put
+    # rn == canon_rn on the canonical side and 3 - canon_rn on the mirror
+    # side; palindromic barcodes have one bcm and rn in {1,2}.  So "last
+    # stream occurrence of each (barcode, rn)" == "last sorted occurrence
+    # of each (run, rn)".
+    gk = run_id * 2 + (rn_sorted - 1)
+    keep = np.ones(n, dtype=bool)
+    if n > 1:
+        s2 = np.lexsort((np.arange(n), gk))
+        g = gk[s2]
+        last = np.ones(n, dtype=bool)
+        last[:-1] = g[1:] != g[:-1]
+        keep = np.zeros(n, dtype=bool)
+        keep[s2[last]] = True
+    sidx = np.nonzero(keep)[0]            # sorted-domain survivor indices
+    srun = run_id[sidx]                   # non-decreasing
+    counts = np.bincount(srun, minlength=n_runs) if n_runs else np.zeros(0, np.int64)
+    run_start = np.searchsorted(srun, np.arange(n_runs))
+    stats_total = int(len(sidx))
 
-    def _rname(i):
-        return ref_names[i] if i >= 0 else "*"
+    orig = order[sidx]                    # original-domain survivor rows
+    _ref_names, pool = _header_name_pool(header)
+    tag_data, tag_off = qnames_mod.tag_strings_columnar(
+        bcm[orig], bclen[orig].astype(np.int64), rid[orig], pos[orig],
+        mrid[orig], mpos[orig], rn[orig].astype(np.int64),
+        rev[orig].astype(bool), pool,
+    )
+    tag_starts, tag_lens = tag_off[:-1], np.diff(tag_off)
 
-    def tag_of(i):
-        return tags_mod.FamilyTag(
-            barcode=bcm[i, : bclen[i]].tobytes().decode("ascii"),
-            ref=_rname(int(rid[i])), pos=int(pos[i]),
-            mate_ref=_rname(int(mrid[i])), mate_pos=int(mpos[i]),
-            read_number=int(rn[i]), orientation="rev" if rev[i] else "fwd",
-        )
+    singles = np.nonzero(counts == 1)[0]
+    doubles = np.nonzero(counts >= 2)[0]
+    i_s = run_start[doubles]              # survivor slots of each candidate pair
+    j_s = i_s + 1
+    i_o, j_o = orig[i_s], orig[j_s]       # original-domain rows
+    cmp = qnames_mod.compare_string_rows(
+        tag_data, tag_starts[i_s], tag_lens[i_s], tag_starts[j_s], tag_lens[j_s]
+    ) if len(doubles) else np.zeros(0, np.int8)
+    i_first = cmp <= 0                    # str(tag_i) <= str(tag_j)
+    mism = lseq[i_o] != lseq[j_o]
 
-    # window-local events: (window_key, sort_str, kind, payload)
-    pair_ev: list = []
-    unpaired_ev: list = []
-    stats_total = 0
-    stats_mismatch = 0
-    for a0, a1 in zip(bounds[:-1], bounds[1:]):
-        run = order[a0:a1]
-        if len(run) > 1:
-            # dedupe by FULL tag, dict last-wins: keep the last stream
-            # occurrence of each (barcode, rn) — run members share all
-            # other key fields already
-            seen: dict = {}
-            for i in run:  # stable lexsort: run is in stream order
-                seen[(bcm[i, : bclen[i]].tobytes(), int(rn[i]))] = i
-            run = sorted(seen.values(), key=lambda i: (srci[i], grow[i]))
-        stats_total += len(run)
-        wkey = (int(rid[run[0]]), int(pos[run[0]]))
-        if len(run) == 1:
-            i = int(run[0])
-            unpaired_ev.append((wkey, str(tag_of(i)), (srci[i], grow[i])))
-            continue
-        i, j = int(run[0]), int(run[1])
-        ti, tj = tag_of(i), tag_of(j)
-        si, sj = str(ti), str(tj)
-        first_str = min(si, sj)
-        if lseq[i] != lseq[j]:
-            stats_mismatch += 1
-            # the walk writes window[min-str tag] first, then the partner
-            if si <= sj:
-                unpaired_ev.append((wkey, (first_str, 0), (srci[i], grow[i])))
-                unpaired_ev.append((wkey, (first_str, 1), (srci[j], grow[j])))
-            else:
-                unpaired_ev.append((wkey, (first_str, 0), (srci[j], grow[j])))
-                unpaired_ev.append((wkey, (first_str, 1), (srci[i], grow[i])))
-            continue
-        # canonical strand: barcode lexicographically <= its mirror; for a
-        # palindromic barcode both qualify and the walk's canon is the
-        # first-VISITED tag, i.e. the smaller str (R1 side) — not stream order
-        if canon_is_self[i] and canon_is_self[j]:
-            canon, other, ctag = (i, j, ti) if si <= sj else (j, i, tj)
-        elif canon_is_self[i]:
-            canon, other, ctag = i, j, ti
-        else:
-            canon, other, ctag = j, i, tj
-        pair_ev.append((
-            wkey, first_str, (srci[canon], grow[canon]),
-            (srci[other], grow[other]), ctag, int(xf[i]) + int(xf[j]),
-        ))
+    # ---- unpaired events: single survivors (key: own str, tiebreak 0) and
+    # both members of length-mismatched pairs (key: min str, tiebreak 0/1) —
+    # merged and sorted by ((rid, pos), key_str, tiebreak), the walk's order
+    mm = np.nonzero(mism)[0]
+    first_slot = np.where(i_first[mm], i_s[mm], j_s[mm])
+    second_slot = np.where(i_first[mm], j_s[mm], i_s[mm])
+    up_slot = np.concatenate([run_start[singles], first_slot, second_slot])
+    # sort-key string: own tag for singles, the pair's min str for both
+    # mismatch members
+    key_slot = np.concatenate([run_start[singles], first_slot, first_slot])
+    up_k = np.concatenate([
+        np.zeros(len(singles), np.int64),
+        np.zeros(len(mm), np.int64),
+        np.ones(len(mm), np.int64),
+    ])
+    up_orig = orig[up_slot]
+    up_perm = qnames_mod.lexsort_string_refs(
+        tag_data, tag_starts[key_slot], tag_lens[key_slot],
+        leaders=[rid[up_orig], pos[up_orig]], trailers=[up_k],
+    )
+    up_rows = up_orig[up_perm]
 
-    # normalize unpaired sort keys ((s,) vs (s, k) tuples sort together)
-    unpaired_ev = [
-        (w, k if isinstance(k, tuple) else (k, 0), p) for w, k, p in unpaired_ev
-    ]
-    unpaired_ev.sort(key=lambda e: (e[0], e[1]))
-    pair_ev.sort(key=lambda e: (e[0], e[1]))
+    # ---- pair events: canonical member first, sorted by ((rid,pos), min str)
+    ok = np.nonzero(~mism)[0]
+    pi_s, pj_s = i_s[ok], j_s[ok]
+    pi_o, pj_o = i_o[ok], j_o[ok]
+    cs_i, cs_j = canon_is_self[pi_o], canon_is_self[pj_o]
+    pick_i = np.where(cs_i & cs_j, i_first[ok], cs_i)
+    canon_o = np.where(pick_i, pi_o, pj_o)
+    other_o = np.where(pick_i, pj_o, pi_o)
+    pkey_slot = np.where(i_first[ok], pi_s, pj_s)  # min-str member
+    pair_perm = qnames_mod.lexsort_string_refs(
+        tag_data, tag_starts[pkey_slot], tag_lens[pkey_slot],
+        leaders=[rid[canon_o], pos[canon_o]],
+    )
+    canon_o, other_o = canon_o[pair_perm], other_o[pair_perm]
+    pair_xf = (xf[pi_o] + xf[pj_o])[pair_perm].astype(np.int64)
 
     blk = PairBlock()
     blk.sources = batches
-    blk.pair_canon_src = np.array([e[2][0] for e in pair_ev], np.int64)
-    blk.pair_canon_row = np.array([e[2][1] for e in pair_ev], np.int64)
-    blk.pair_other_src = np.array([e[3][0] for e in pair_ev], np.int64)
-    blk.pair_other_row = np.array([e[3][1] for e in pair_ev], np.int64)
-    blk.pair_tags = [e[4] for e in pair_ev]
-    blk.pair_xf = np.array([e[5] for e in pair_ev], np.int64)
-    blk.unpaired_src = np.array([e[2][0] for e in unpaired_ev], np.int64)
-    blk.unpaired_row = np.array([e[2][1] for e in unpaired_ev], np.int64)
+    blk.pair_canon_src = srci[canon_o]
+    blk.pair_canon_row = grow[canon_o]
+    blk.pair_other_src = srci[other_o]
+    blk.pair_other_row = grow[other_o]
+    blk.pair_xf = pair_xf
+    # canonical barcode + prebuilt dcs qnames (emission order) for the
+    # columnar record writer
+    blk.pair_bcm = canon_bcm[canon_o]
+    blk.pair_bclen = bclen[canon_o].astype(np.int64)
+    blk.qname_data, blk.qname_off = qnames_mod.dcs_qnames_columnar(
+        blk.pair_bcm, blk.pair_bclen, rid[canon_o], pos[canon_o],
+        mrid[canon_o], mpos[canon_o], pool,
+    )
+    blk.unpaired_src = srci[up_rows]
+    blk.unpaired_row = grow[up_rows]
     blk.stats_total = stats_total
-    blk.stats_unpaired = len(unpaired_ev)
-    blk.stats_pairs = len(pair_ev)
-    blk.stats_mismatch = stats_mismatch
+    blk.stats_unpaired = int(len(up_rows))
+    blk.stats_pairs = int(len(canon_o))
+    blk.stats_mismatch = int(mism.sum())
     return blk

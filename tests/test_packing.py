@@ -184,3 +184,19 @@ def test_pack_native_numpy_byte_parity_odd_length():
         os.environ.pop("CCT_NO_NATIVE", None)
         native._tried = False
         native._lib = None
+
+
+def test_pack4_native_odd_length_rejects_bad_qual():
+    """Regression: the native odd-length path must still RAISE on
+    out-of-codebook quals (the pad-nibble LUT doctoring must never remap a
+    value the data actually contains)."""
+    from consensuscruncher_tpu.io import native
+    from consensuscruncher_tpu.ops.packing import build_codebook4, pack4
+
+    if not native.available():
+        pytest.skip("native codec unavailable")
+    bases = np.zeros((2, 5), np.uint8)
+    book = build_codebook4(np.array([12, 23], np.uint8))
+    bad = np.array([[12, 23, 12, 23, 0], [12, 12, 12, 12, 12]], np.uint8)
+    with pytest.raises(ValueError):
+        pack4(bases, bad, book)

@@ -130,3 +130,51 @@ def test_lexsort_strings_prefix_order():
     perm = qv.lexsort_strings(data, off)
     got = [strs[i] for i in perm]
     assert got == sorted(strs)
+
+
+def test_dcs_qnames_columnar_parity():
+    rng = np.random.default_rng(13)
+    cols, tags = _random_families(rng, 250, REF_NAMES)
+    pool = qv.ref_name_pool(REF_NAMES)
+    bcm, bclen, rid, pos, mrid, mpos, _rn, _rev = cols
+    # canonical barcode: min(bc, mirror) — build per row like the pair block
+    canon = []
+    for i, tag in enumerate(tags):
+        canon.append(min(tag.barcode, tags_mod.mirror_barcode(tag.barcode)))
+    w = max(len(c) for c in canon)
+    cbcm = np.zeros((len(canon), w), np.uint8)
+    cblen = np.zeros(len(canon), np.int64)
+    for i, c in enumerate(canon):
+        eb = c.encode()
+        cbcm[i, :len(eb)] = np.frombuffer(eb, np.uint8)
+        cblen[i] = len(eb)
+    data, off = qv.dcs_qnames_columnar(cbcm, cblen, rid, pos, mrid, mpos, pool)
+    for i, tag in enumerate(tags):
+        got = bytes(data[off[i]:off[i + 1]]).decode()
+        assert got == tags_mod.dcs_qname(tag), (i, got, tags_mod.dcs_qname(tag))
+
+
+def test_compare_string_rows():
+    strs = [(b"abc", b"abd"), (b"abc", b"abc"), (b"abc", b"ab"),
+            (b"ab", b"abc"), (b"", b"a"), (b"zz", b"z")]
+    blobs = b"".join(a + b for a, b in strs)
+    data = np.frombuffer(blobs, np.uint8)
+    sa, la, sb, lb = [], [], [], []
+    cur = 0
+    for a, b in strs:
+        sa.append(cur); la.append(len(a)); cur += len(a)
+        sb.append(cur); lb.append(len(b)); cur += len(b)
+    out = qv.compare_string_rows(
+        data, np.array(sa), np.array(la), np.array(sb), np.array(lb))
+    expect = [-1 if a < b else (0 if a == b else 1) for a, b in strs]
+    assert out.tolist() == expect
+
+
+def test_lexsort_strings_trailers():
+    strs = [b"b", b"a", b"a", b"b"]
+    k = np.array([0, 1, 0, 1])
+    data = np.frombuffer(b"".join(strs), np.uint8)
+    off = np.arange(5, dtype=np.int64)
+    perm = qv.lexsort_strings(data, off, trailers=[k])
+    got = [(strs[i], int(k[i])) for i in perm]
+    assert got == sorted(got)
