@@ -472,7 +472,7 @@ def _coord_key(read: BamRead, header: BamHeader):
 _COLUMNAR_SORT_MAX_BYTES = int(os.environ.get("CCT_COLUMNAR_SORT_MAX_BYTES", 96 << 20))
 
 
-def sort_bam(in_path, out_path, max_in_memory: int = 2_000_000) -> None:
+def sort_bam(in_path, out_path, max_in_memory: int = 2_000_000, level: int = 6) -> None:
     """Coordinate sort (samtools-sort parity). Spills chunks to temp BAMs and
     heap-merges when the input exceeds ``max_in_memory`` records.
 
@@ -482,7 +482,7 @@ def sort_bam(in_path, out_path, max_in_memory: int = 2_000_000) -> None:
     if os.path.getsize(in_path) <= _COLUMNAR_SORT_MAX_BYTES:
         from consensuscruncher_tpu.io.columnar import sort_bam_columnar
 
-        if sort_bam_columnar(in_path, out_path, max_records=max_in_memory):
+        if sort_bam_columnar(in_path, out_path, level=level, max_records=max_in_memory):
             return
     reader = BamReader(in_path)
     header = reader.header
@@ -496,13 +496,13 @@ def sort_bam(in_path, out_path, max_in_memory: int = 2_000_000) -> None:
                 buf = []
         if not chunks:
             buf.sort(key=lambda r: _coord_key(r, header))
-            with BamWriter(out_path, _sorted_header(header), atomic=True) as w:
+            with BamWriter(out_path, _sorted_header(header), level=level, atomic=True) as w:
                 for read in buf:
                     w.write(read)
             return
         if buf:
             chunks.append(_spill(buf, header))
-        _merge_paths(chunks, out_path, header)
+        _merge_paths(chunks, out_path, header, level=level)
     finally:
         reader.close()
         for c in chunks:
@@ -536,7 +536,7 @@ def _spill(buf: list[BamRead], header: BamHeader) -> str:
     return path
 
 
-def _merge_paths(paths: list[str], out_path, header: BamHeader) -> None:
+def _merge_paths(paths: list[str], out_path, header: BamHeader, level: int = 6) -> None:
     readers = [BamReader(p) for p in paths]
     streams = [iter(r) for r in readers]
     heap = []
@@ -545,7 +545,7 @@ def _merge_paths(paths: list[str], out_path, header: BamHeader) -> None:
         if read is not None:
             heap.append((_coord_key(read, header), si, read))
     heapq.heapify(heap)
-    with BamWriter(out_path, _sorted_header(header), atomic=True) as w:
+    with BamWriter(out_path, _sorted_header(header), level=level, atomic=True) as w:
         while heap:
             _key, si, read = heapq.heappop(heap)
             w.write(read)

@@ -209,7 +209,12 @@ class ColumnarBatch:
 
 
 def _scan_offsets(chunk: bytes, limit: int) -> np.ndarray:
-    """Record boundaries in ``chunk[:limit]`` — the single serial pass."""
+    """Record boundaries in ``chunk[:limit]`` — the single serial pass
+    (native C loop when the codec library is available)."""
+    from consensuscruncher_tpu.io import native
+
+    if native.available():
+        return native.scan_bam_records(chunk, limit)
     offs = [0]
     o = 0
     unpack_from = struct.unpack_from
@@ -325,7 +330,6 @@ def sort_bam_columnar(
     # key columns across batches
     if n_total:
         rid = np.concatenate([b.ref_id for b in batches])
-        rid = np.where(rid < 0, 1 << 30, rid)
         pos = np.concatenate([b.pos for b in batches])
         flag = np.concatenate([b.flag for b in batches])
         w = max(b.qname_matrix.shape[1] for b in batches)
@@ -335,60 +339,207 @@ def sort_bam_columnar(
             m = b.qname_matrix
             qm[row : row + b.n, : m.shape[1]] = m
             row += b.n
-        # significance (most -> least): rid, pos, qname bytes, flag;
-        # np.lexsort's primary key is the LAST element.
-        keys = [flag] + [qm[:, i] for i in range(w - 1, -1, -1)] + [pos, rid]
-        perm = np.lexsort(keys)
+        perm = coord_sort_perm(rid, pos, qm, flag)
     else:
         perm = np.empty(0, dtype=np.int64)
 
+    if n_total:
+        starts = np.concatenate([b.rec_off[:-1] for b in batches])
+        lengths = np.concatenate([np.diff(b.rec_off) for b in batches])
+        # per-batch buffers -> one global buffer for the gather
+        if len(batches) == 1:
+            big = batches[0].buf
+        else:
+            base = np.zeros(len(batches), dtype=np.int64)
+            sizes = [len(b.buf) for b in batches]
+            base[1:] = np.cumsum(sizes[:-1])
+            big = np.concatenate([b.buf for b in batches])
+            rec_base = np.repeat(base, [b.n for b in batches])
+            starts = starts + rec_base
+        sp, lp = starts[perm], lengths[perm]
+    else:
+        big = np.empty(0, np.uint8)
+        sp = lp = np.empty(0, np.int64)
+    _write_bam_records(out_path, _sorted_header(header), big, sp, lp, level)
+    return True
+
+
+def coord_sort_perm(rid: np.ndarray, pos: np.ndarray, qname_matrix: np.ndarray,
+                    flag: np.ndarray) -> np.ndarray:
+    """THE samtools-parity coordinate total order, as a lexsort permutation:
+    ``(ref_id with unmapped last, pos, qname bytes, flag)``, stable — the
+    single columnar definition shared by ``sort_bam_columnar`` and
+    ``SortingBamWriter`` (scalar twin: ``io.bam._coord_key``)."""
+    rid = np.where(np.asarray(rid) < 0, 1 << 30, rid)
+    w = qname_matrix.shape[1]
+    # significance (most -> least): rid, pos, qname bytes, flag;
+    # np.lexsort's primary key is the LAST element.
+    keys = [flag] + [qname_matrix[:, i] for i in range(w - 1, -1, -1)] + [pos, rid]
+    return np.lexsort(keys)
+
+
+def _write_bam_records(out_path, header: BamHeader, big: np.ndarray,
+                       starts: np.ndarray, lengths: np.ndarray, level: int) -> None:
+    """Atomically write header + the records ``big[starts[i]:+lengths[i]]``
+    (already in final order) as a BGZF BAM."""
     tmp = os.fspath(out_path) + ".tmp"
     writer = bgzf.BgzfWriter(tmp, level=level)
     try:
-        hdr = _sorted_header(header)
-        text = hdr.text.encode("ascii")
+        text = header.text.encode("ascii")
         out = bytearray(BAM_MAGIC)
         out += struct.pack("<i", len(text)) + text
-        out += struct.pack("<i", len(hdr.refs))
-        for name, length in hdr.refs:
+        out += struct.pack("<i", len(header.refs))
+        for name, length in header.refs:
             bname = name.encode("ascii") + b"\x00"
             out += struct.pack("<i", len(bname)) + bname + struct.pack("<i", length)
         writer.write(bytes(out))
-
+        n_total = len(starts)
         if n_total:
-            starts = np.concatenate([b.rec_off[:-1] for b in batches])
-            lengths = np.concatenate([np.diff(b.rec_off) for b in batches])
-            # per-batch buffers -> one global buffer for the gather
-            if len(batches) == 1:
-                big = batches[0].buf
-            else:
-                base = np.zeros(len(batches), dtype=np.int64)
-                sizes = [len(b.buf) for b in batches]
-                base[1:] = np.cumsum(sizes[:-1])
-                big = np.concatenate([b.buf for b in batches])
-                rec_base = np.repeat(base, [b.n for b in batches])
-                starts = starts + rec_base
             # Gather + write in bounded record chunks: ragged_gather builds
-            # ~24 bytes of int64 index per output byte, so one whole-file
-            # gather would transiently need an order of magnitude more
-            # memory than the data itself.  ~8 MB output per chunk keeps the
-            # transient index footprint a couple hundred MB at worst.
-            sp, lp = starts[perm], lengths[perm]
-            csum = np.cumsum(lp)
+            # per-record index state, so one whole-file gather would
+            # transiently need far more memory than the data itself.
+            csum = np.cumsum(lengths)
             target = 8 << 20
             i0 = 0
             while i0 < n_total:
                 floor = int(csum[i0 - 1]) if i0 else 0
                 i1 = int(np.searchsorted(csum, floor + target)) + 1
                 i1 = min(max(i1, i0 + 1), n_total)
-                data, _ = ragged_gather(big, sp[i0:i1], lp[i0:i1])
+                data, _ = ragged_gather(big, starts[i0:i1], lengths[i0:i1])
                 writer.write(data.tobytes())
                 i0 = i1
         writer.close()
         os.replace(tmp, out_path)
-        return True
     except BaseException:
         writer.close()
         if os.path.exists(tmp):
             os.unlink(tmp)
         raise
+
+
+class SortingBamWriter:
+    """Coordinate-sorting BAM writer: records buffer in memory as raw
+    length-prefixed blobs and are key-decoded + lexsorted + written once at
+    ``close()`` — no unsorted temp file, no BGZF round trip (the stage
+    pattern this replaces was write-L1-tmp -> inflate -> sort -> deflate-L6).
+
+    Same total order as ``io.bam.sort_bam`` (rid-with-unmapped-last, pos,
+    qname bytes, flag; stable).  Inputs beyond ``max_raw_bytes`` of raw
+    record data spill to an L1 temp BAM and finish through ``sort_bam``'s
+    bounded merge path, so memory stays bounded on any input.
+
+    Drop-in for the ``BamWriter`` surface the stages use: ``write``,
+    ``write_encoded``, ``close``, ``abort`` (abort discards everything; the
+    final path is never touched before a successful close).
+    """
+
+    def __init__(self, path, header: BamHeader, level: int = 6,
+                 max_raw_bytes: int | None = None):
+        from consensuscruncher_tpu.io.bam import _sorted_header
+
+        # Per-WRITER cap: a stage holds 2-3 sorting writers at once and
+        # close() transiently needs ~2x the buffered bytes (concat + key
+        # columns + gathered output chunks), so budget ~6-8x this figure of
+        # host RAM for a worst-case stage before the spill path bounds it.
+        if max_raw_bytes is None:
+            max_raw_bytes = int(os.environ.get(
+                "CCT_SORT_BUFFER_MAX_BYTES", 4 << 30))
+        self._path = os.fspath(path)
+        self.header = _sorted_header(header)
+        self._level = level
+        self._max_raw = max_raw_bytes
+        self._chunks: list[np.ndarray] = []
+        self._raw = 0
+        self._spill = None
+        self._spill_path = self._path + ".unsorted.tmp"
+        self._closed = False
+
+    def write(self, read) -> None:
+        from consensuscruncher_tpu.io.bam import encode_record
+
+        self.write_encoded(encode_record(read, self.header))
+
+    def write_encoded(self, blob) -> None:
+        if isinstance(blob, np.ndarray):
+            arr = np.ascontiguousarray(blob, dtype=np.uint8)
+        else:
+            arr = np.frombuffer(blob, dtype=np.uint8)
+        if arr.size == 0:
+            return
+        if self._spill is not None:
+            self._spill.write_encoded(arr)
+            return
+        self._chunks.append(arr)
+        self._raw += arr.size
+        if self._raw > self._max_raw:
+            self._start_spill()
+
+    def _start_spill(self) -> None:
+        from consensuscruncher_tpu.io.bam import BamWriter
+
+        self._spill = BamWriter(self._spill_path, self.header, level=1)
+        for c in self._chunks:
+            self._spill.write_encoded(c)
+        self._chunks = []
+        self._raw = 0
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        if self._spill is not None:
+            from consensuscruncher_tpu.io.bam import sort_bam
+
+            self._spill.close()
+            try:
+                sort_bam(self._spill_path, self._path, level=self._level)
+            finally:
+                if os.path.exists(self._spill_path):
+                    os.unlink(self._spill_path)
+            return
+        if not self._chunks:
+            big = np.empty(0, np.uint8)
+        elif len(self._chunks) == 1:
+            big = self._chunks[0]
+        else:
+            big = np.concatenate(self._chunks)
+        self._chunks = []
+        rec_off = _scan_offsets(big, len(big))
+        if int(rec_off[-1]) != len(big):
+            raise ValueError("SortingBamWriter received a partial record")
+        off = rec_off[:-1]
+        n = len(off)
+        if n:
+            rid = _gather_view(big, off + 4, 4, "<i4").astype(np.int64)
+            pos = _gather_view(big, off + 8, 4, "<i4")
+            flag = _gather_view(big, off + 18, 2, "<u2")
+            l_qname = big[off + 12].astype(np.int64)  # incl. NUL
+            w = int((l_qname - 1).max(initial=1))
+            qm = np.zeros((n, w), dtype=np.uint8)
+            from consensuscruncher_tpu.utils.ragged import scatter_runs
+
+            scatter_runs(qm.reshape(-1), np.arange(n, dtype=np.int64) * w,
+                         big, l_qname - 1, src_starts=off + 36)
+            perm = coord_sort_perm(rid, pos, qm, flag)
+            starts, lengths = off[perm], np.diff(rec_off)[perm]
+        else:
+            starts = lengths = np.empty(0, np.int64)
+        _write_bam_records(self._path, self.header, big, starts, lengths,
+                           self._level)
+
+    def abort(self) -> None:
+        self._closed = True
+        self._chunks = []
+        if self._spill is not None:
+            self._spill.abort()
+            if os.path.exists(self._spill_path):
+                os.unlink(self._spill_path)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is not None:
+            self.abort()
+        else:
+            self.close()

@@ -25,7 +25,7 @@ import threading
 
 import numpy as np
 
-_ABI_VERSION = 5
+_ABI_VERSION = 6
 _SRC = os.path.join(os.path.dirname(__file__), "bgzf_native.cpp")
 
 _lock = threading.Lock()
@@ -94,6 +94,10 @@ def _build_and_load() -> ctypes.CDLL | None:
     lib.cct_byte_counts.restype = None
     lib.cct_byte_counts.argtypes = [
         ctypes.c_char_p, ctypes.c_int64, ctypes.POINTER(ctypes.c_int64),
+    ]
+    lib.cct_scan_bam_records.restype = ctypes.c_int64
+    lib.cct_scan_bam_records.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64, ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
     ]
     lib.cct_copy_runs.restype = None
     lib.cct_copy_runs.argtypes = [
@@ -239,6 +243,26 @@ def byte_counts(data: np.ndarray) -> np.ndarray:
     counts = np.zeros(256, dtype=np.int64)
     lib.cct_byte_counts(data.ctypes.data_as(ctypes.c_char_p), data.size, _i64_ptr(counts))
     return counts
+
+
+def scan_bam_records(chunk, limit: int) -> np.ndarray:
+    """Record boundary offsets (n+1 entries) of length-prefixed BAM records
+    in ``chunk[:limit]`` — native replacement for the per-record
+    struct.unpack loop.  Raises ValueError on a corrupt block_size."""
+    lib = _get()
+    if lib is None:
+        raise RuntimeError("native codec unavailable")
+    cap = limit // 36 + 2
+    out = np.zeros(cap, dtype=np.int64)
+    if isinstance(chunk, np.ndarray):
+        chunk = np.ascontiguousarray(chunk, dtype=np.uint8)
+        src = chunk.ctypes.data_as(ctypes.c_char_p)
+    else:
+        src = bytes(chunk) if not isinstance(chunk, bytes) else chunk
+    n = lib.cct_scan_bam_records(src, int(limit), _i64_ptr(out), cap)
+    if n < 0:
+        raise ValueError("corrupt BAM record: block_size < 32")
+    return out[: n + 1]
 
 
 def pack_wire(bases: np.ndarray, quals: np.ndarray, lut: np.ndarray, four_bit: bool) -> np.ndarray:

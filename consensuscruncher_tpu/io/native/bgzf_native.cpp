@@ -27,7 +27,7 @@
 
 namespace {
 
-constexpr int kAbiVersion = 5;
+constexpr int kAbiVersion = 6;
 constexpr uint32_t kMaxBlockPayload = 0xFF00;  // htslib payload bound
 constexpr uint32_t kOutStride = 0x10400;       // per-block output slot (worst case + slack)
 
@@ -241,6 +241,27 @@ int cct_pack4(const uint8_t* bases, const uint8_t* quals, const uint8_t* lut, in
     out[pairs] = static_cast<uint8_t>(b | (q << 2));
   }
   return 0;
+}
+
+// Scan length-prefixed BAM records in buf[0:limit] (the serial pass the
+// columnar reader and the sorting writer both need).  Writes the n+1
+// record boundary offsets into out (capacity max_out) and returns n, the
+// number of COMPLETE records; -1 signals a corrupt block_size (< 32).
+// Little-endian host assumed (true of every deploy target).
+int64_t cct_scan_bam_records(const uint8_t* buf, int64_t limit, int64_t* out,
+                             int64_t max_out) {
+  int64_t o = 0, n = 0;
+  if (max_out > 0) out[0] = 0;
+  while (o + 4 <= limit) {
+    int32_t bs;
+    std::memcpy(&bs, buf + o, 4);
+    if (bs < 32) return -1;
+    if (o + 4 + static_cast<int64_t>(bs) > limit) break;
+    o += 4 + bs;
+    ++n;
+    if (n < max_out) out[n] = o;
+  }
+  return n;
 }
 
 // Byte-value histogram (256 bins) — the one-pass replacement for

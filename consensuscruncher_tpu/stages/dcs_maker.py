@@ -24,7 +24,6 @@ agree-or-N formula of ``core.duplex_cpu``/``ops.duplex_tpu``.
 
 from __future__ import annotations
 
-import os
 from dataclasses import dataclass
 import numpy as np
 
@@ -33,7 +32,7 @@ import struct
 from consensuscruncher_tpu.core import tags as tags_mod
 from consensuscruncher_tpu.core.consensus_read import _KEEP_FLAGS
 from consensuscruncher_tpu.core.duplex_cpu import duplex_consensus
-from consensuscruncher_tpu.io.bam import BamWriter, sort_bam
+from consensuscruncher_tpu.io.bam import BamWriter
 from consensuscruncher_tpu.io.encode import ConsensusRecordWriter
 from consensuscruncher_tpu.ops.duplex_tpu import duplex_batch_host
 from consensuscruncher_tpu.utils.stats import StageStats
@@ -366,16 +365,15 @@ def run_dcs(
     stats = StageStats("DCS")
     paths = output_paths(out_prefix)
     dcs_path, unpaired_path = paths["dcs"], paths["unpaired"]
-    dcs_tmp = f"{out_prefix}.dcs.unsorted.bam"
-    unpaired_tmp = f"{out_prefix}.sscs.singleton.unsorted.bam"
 
-    from consensuscruncher_tpu.io.columnar import ColumnarReader
+    from consensuscruncher_tpu.io.columnar import ColumnarReader, SortingBamWriter
 
     reader = ColumnarReader(sscs_bam)
-    dcs_writer = BamWriter(dcs_tmp, reader.header, level=1)  # tmp: sorted+deleted below; final files keep level 6
-    unpaired_writer = BamWriter(unpaired_tmp, reader.header, level=1)
+    dcs_writer = SortingBamWriter(dcs_path, reader.header)
+    unpaired_writer = SortingBamWriter(unpaired_path, reader.header)
     rec_writer = ConsensusRecordWriter(dcs_writer)
 
+    ok = False
     try:
         try:
             _consume_pair_blocks(
@@ -385,28 +383,28 @@ def run_dcs(
             if "foreign tag layout" not in str(e):
                 raise
             # foreign consensus BAM: restart from scratch on the object path
-            # (nothing sorted/promoted yet; the tmps are simply rewritten)
+            # (nothing promoted yet; the buffered writers are simply dropped)
             reader.close()
-            dcs_writer.close()
-            unpaired_writer.close()
+            dcs_writer.abort()
+            unpaired_writer.abort()
             stats = StageStats("DCS")
             reader = ColumnarReader(sscs_bam)
-            dcs_writer = BamWriter(dcs_tmp, reader.header, level=1)
-            unpaired_writer = BamWriter(unpaired_tmp, reader.header, level=1)
+            dcs_writer = SortingBamWriter(dcs_path, reader.header)
+            unpaired_writer = SortingBamWriter(unpaired_path, reader.header)
             rec_writer = ConsensusRecordWriter(dcs_writer)
             _run_dcs_windows(
                 reader, stats, unpaired_writer, rec_writer, qual_cap, backend,
             )
         rec_writer.flush()
+        ok = True
     finally:
         reader.close()
-        dcs_writer.close()
-        unpaired_writer.close()
+        if not ok:
+            dcs_writer.abort()
+            unpaired_writer.abort()
 
-    sort_bam(dcs_tmp, dcs_path)
-    sort_bam(unpaired_tmp, unpaired_path)
-    os.unlink(dcs_tmp)
-    os.unlink(unpaired_tmp)
+    dcs_writer.close()
+    unpaired_writer.close()
     stats.set("backend", backend)
     stats.write(paths["stats_txt"])
     return DcsResult(dcs_path, unpaired_path, stats)

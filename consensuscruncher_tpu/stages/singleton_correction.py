@@ -31,11 +31,10 @@ from dataclasses import dataclass
 from typing import Iterator
 
 import numpy as np
-import os
 
 from consensuscruncher_tpu.core import tags as tags_mod
 from consensuscruncher_tpu.core.duplex_cpu import correct_singleton
-from consensuscruncher_tpu.io.bam import BamReader, BamRead, BamWriter, sort_bam
+from consensuscruncher_tpu.io.bam import BamReader, BamRead
 from consensuscruncher_tpu.ops.singleton_tpu import best_matches
 from consensuscruncher_tpu.stages.grouping import consensus_windows
 from consensuscruncher_tpu.utils.phred import decode_seq, encode_seq
@@ -132,11 +131,12 @@ def run_singleton_correction(
     stats = StageStats("singleton_correction")
     all_paths = output_paths(out_prefix)
     paths = {k: all_paths[k] for k in ("sscs_rescue", "singleton_rescue", "remaining")}
-    tmps = {k: p.replace(".sorted.bam", ".unsorted.bam") for k, p in paths.items()}
+
+    from consensuscruncher_tpu.io.columnar import SortingBamWriter
 
     s_reader = BamReader(singleton_bam)
     x_reader = BamReader(sscs_bam)
-    writers = {k: BamWriter(t, s_reader.header) for k, t in tmps.items()}
+    writers = {k: SortingBamWriter(p, s_reader.header) for k, p in paths.items()}
 
     try:
         for singles, sscses in _merge_windows(
@@ -183,15 +183,16 @@ def run_singleton_correction(
                     writers["singleton_rescue"].write(_corrected(read, partner))
                     writers["singleton_rescue"].write(_corrected(partner, read))
                     done.add(partner_tag)
+    except BaseException:
+        for w in writers.values():
+            w.abort()
+        raise
     finally:
         s_reader.close()
         x_reader.close()
-        for w in writers.values():
-            w.close()
 
-    for k in paths:
-        sort_bam(tmps[k], paths[k])
-        os.unlink(tmps[k])
+    for w in writers.values():
+        w.close()  # lexsort + final BGZF write happen here
     stats.set("max_mismatch", max_mismatch)
     stats.write(all_paths["stats_txt"])
     return SingletonResult(paths["sscs_rescue"], paths["singleton_rescue"], paths["remaining"], stats)

@@ -17,14 +17,14 @@ Backends (bit-identical by the parity test suite):
   (bucketed, batched, jitted device kernel).
 - ``cpu``: vectorized numpy oracle per family.
 
-Both write consensus reads in bucket/stream order to a temp BAM, then
-coordinate-sort atomically — the reference reaches the same state via
-``samtools sort`` subprocesses (SURVEY.md §3.2).
+Both produce consensus reads in bucket/stream order; the sorting writers
+buffer them in memory and lexsort + write the final coordinate-sorted BAMs
+atomically at close — the reference reaches the same state via
+``samtools sort`` subprocesses over temp files (SURVEY.md §3.2).
 """
 
 from __future__ import annotations
 
-import os
 import struct
 from dataclasses import dataclass
 
@@ -37,7 +37,7 @@ from consensuscruncher_tpu.core.consensus_read import (
     build_consensus_read,
     modal_cigar,
 )
-from consensuscruncher_tpu.io.bam import BamReader, BamWriter, sort_bam
+from consensuscruncher_tpu.io.bam import BamReader, BamWriter
 from consensuscruncher_tpu.io.encode import (
     ConsensusRecordWriter,
     RenameRetagWriter,
@@ -131,8 +131,6 @@ def run_sscs(
 
     paths = output_paths(out_prefix)
     sscs_path, singleton_path, bad_path = paths["sscs"], paths["singleton"], paths["bad"]
-    sscs_tmp = f"{out_prefix}.sscs.unsorted.bam"
-    singleton_tmp = f"{out_prefix}.singleton.unsorted.bam"
 
     if backend == "reference":
         # True reference-style run: per-read object decode + dict grouping
@@ -153,9 +151,13 @@ def run_sscs(
         from consensuscruncher_tpu.stages.grouping import stream_families_columnar
 
         source = stream_families_columnar(reader, header, bdelim)
+    from consensuscruncher_tpu.io.columnar import SortingBamWriter
+
     bad_writer = BamWriter(bad_path, header, atomic=True)
-    sscs_writer = BamWriter(sscs_tmp, header, level=1)  # tmp: sorted+deleted below; final files keep level 6
-    singleton_writer = BamWriter(singleton_tmp, header, level=1)
+    # In-memory sorting writers: records buffer as raw blobs and sort+write
+    # once at close — no unsorted tmp file, no L1 deflate/inflate round trip
+    sscs_writer = SortingBamWriter(sscs_path, header)
+    singleton_writer = SortingBamWriter(singleton_path, header)
 
     pending: dict[int, tuple] = {}
 
@@ -370,16 +372,15 @@ def run_sscs(
         ok = True
     finally:
         reader.close()
-        for w in (bad_writer, sscs_writer, singleton_writer):
-            # never promote a partial atomic output on error (abort is a
-            # close for non-atomic writers' purposes; their tmps get removed)
-            w.close() if ok else w.abort()
+        if not ok:
+            # never promote a partial output on error
+            for w in (bad_writer, sscs_writer, singleton_writer):
+                w.abort()
     tracker.mark("consensus")
-
-    sort_bam(sscs_tmp, sscs_path)
-    sort_bam(singleton_tmp, singleton_path)
-    os.unlink(sscs_tmp)
-    os.unlink(singleton_tmp)
+    # sorting writers do their lexsort + final BGZF write inside close()
+    bad_writer.close()
+    sscs_writer.close()
+    singleton_writer.close()
     tracker.mark("sort")
 
     stats.set("backend", backend)

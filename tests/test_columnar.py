@@ -184,3 +184,64 @@ def test_columnar_stray_mid_read_0xff_qual_matches_object_reader(tmp_path):
         np.testing.assert_array_equal(quals[off[j]:off[j + 1]], exp)
     assert quals[off[0] + 3] == 0xFF  # the stray byte survived
     assert (quals[off[1]:off[2]] == 0).all()  # the missing read zeroed
+
+
+def test_sorting_writer_matches_sort_bam(tmp_path):
+    """SortingBamWriter(final) == write-unsorted-tmp + sort_bam, byte-for-byte,
+    on both the in-memory and the spill path."""
+    import hashlib
+
+    from consensuscruncher_tpu.io.bam import BamWriter, sort_bam
+    from consensuscruncher_tpu.io.columnar import SortingBamWriter
+    from consensuscruncher_tpu.utils.simulate import SimConfig, simulate_bam_fast
+
+    src = str(tmp_path / "src.bam")
+    simulate_bam_fast(src, SimConfig(n_fragments=150, read_len=60, ref_len=80_000, seed=4))
+    reader = ColumnarReader(src)
+    header = reader.header
+    batches = list(reader.batches())
+    reader.close()
+    # shuffle record order so the sort actually has work to do
+    rng = np.random.default_rng(0)
+
+    def feed(writer):
+        for b in batches:
+            order = rng.permutation(b.n)
+            for i in order:
+                writer.write_encoded(b.buf[b.rec_off[i]:b.rec_off[i + 1]])
+
+    rng = np.random.default_rng(0)
+    ref_tmp = str(tmp_path / "ref.unsorted.bam")
+    ref_out = str(tmp_path / "ref.sorted.bam")
+    with BamWriter(ref_tmp, header) as w:
+        feed(w)
+    sort_bam(ref_tmp, ref_out)
+
+    for name, kwargs in (("mem", {}), ("spill", {"max_raw_bytes": 1024})):
+        rng = np.random.default_rng(0)
+        out = str(tmp_path / f"{name}.sorted.bam")
+        w = SortingBamWriter(out, header, **kwargs)
+        feed(w)
+        w.close()
+        da = hashlib.sha256(open(ref_out, "rb").read()).hexdigest()
+        db = hashlib.sha256(open(out, "rb").read()).hexdigest()
+        assert da == db, name
+
+
+def test_sorting_writer_abort_leaves_nothing(tmp_path):
+    from consensuscruncher_tpu.io.bam import BamHeader
+    from consensuscruncher_tpu.io.columnar import SortingBamWriter
+
+    out = str(tmp_path / "x.bam")
+    header = BamHeader.from_refs([("chr1", 1000)])
+    w = SortingBamWriter(out, header, max_raw_bytes=64)
+    from consensuscruncher_tpu.io.bam import BamRead, encode_record
+
+    r = BamRead(qname="q", flag=0, ref="chr1", pos=5, mapq=60,
+                cigar=[("M", 4)], mate_ref="chr1", mate_pos=9, tlen=8,
+                seq="ACGT", qual=np.full(4, 30, np.uint8))
+    for _ in range(20):  # force the spill path
+        w.write(r)
+    w.abort()
+    import glob
+    assert glob.glob(str(tmp_path / "*")) == []
