@@ -290,9 +290,9 @@ def _run_worker(mode: str, backend: str, bam: str, outdir: str, timeout: int) ->
 
 
 def _simulate(path: str, n_fragments: int, seed: int) -> None:
-    from consensuscruncher_tpu.utils.simulate import SimConfig, simulate_bam
+    from consensuscruncher_tpu.utils.simulate import SimConfig, simulate_bam_fast
 
-    simulate_bam(
+    simulate_bam_fast(
         path,
         SimConfig(
             n_fragments=n_fragments,
