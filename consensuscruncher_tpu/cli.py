@@ -38,7 +38,7 @@ from consensuscruncher_tpu import __version__
 from consensuscruncher_tpu.core.tags import DEFAULT_BDELIM
 from consensuscruncher_tpu.io import sam as sam_mod
 from consensuscruncher_tpu.io.bai import index_bam
-from consensuscruncher_tpu.io.bam import BamWriter, merge_bams, sort_bam
+from consensuscruncher_tpu.io.bam import merge_bams
 from consensuscruncher_tpu.stages.extract_barcodes import run_extract
 from consensuscruncher_tpu.stages import dcs_maker, singleton_correction, sscs_maker
 from consensuscruncher_tpu.stages.dcs_maker import DcsResult, run_dcs
@@ -117,27 +117,28 @@ def align_and_sort(bwa: str, ref: str, r1: str, r2: str, out_bam: str) -> None:
             f"aligner not found: {cmd[0]!r} — install bwa or point --bwa at an "
             "executable that speaks `<bwa> mem <ref> <r1> <r2>` and emits SAM"
         )
-    unsorted = out_bam + ".unsorted"
+    from consensuscruncher_tpu.io.columnar import SortingBamWriter
+
+    writer = None
     try:
         header, records = sam_mod.read_sam(proc.stdout)
-        with BamWriter(unsorted, header) as w:
-            for read in records:
-                w.write(read)
+        writer = SortingBamWriter(out_bam, header)
+        for read in records:
+            writer.write(read)
     except Exception as exc:
         # A truncated/garbled SAM stream usually means the aligner died
         # mid-run — report ITS status, not the downstream parse error.
         proc.kill()
         status = proc.wait()
-        if os.path.exists(unsorted):
-            os.unlink(unsorted)
+        if writer is not None:
+            writer.abort()
         raise SystemExit(
             f"aligner output unreadable ({exc}); aligner exit status {status}"
         ) from exc
     if proc.wait() != 0:
-        os.unlink(unsorted)
+        writer.abort()
         raise SystemExit(f"aligner exited with status {proc.returncode}")
-    sort_bam(unsorted, out_bam)
-    os.unlink(unsorted)
+    writer.close()
 
 
 def _align_builtin(ref: str, r1: str, r2: str, out_bam: str) -> None:
@@ -164,15 +165,11 @@ def _align_builtin(ref: str, r1: str, r2: str, out_bam: str) -> None:
                    np.frombuffer(q1.encode(), np.uint8) - 33, s2,
                    np.frombuffer(q2.encode(), np.uint8) - 33)
 
-    unsorted = out_bam + ".unsorted"
-    try:
-        with BamWriter(unsorted, header) as w:
-            for read in align_pairs(aligner, pairs(), header):
-                w.write(read)
-        sort_bam(unsorted, out_bam)
-    finally:
-        if os.path.exists(unsorted):
-            os.unlink(unsorted)
+    from consensuscruncher_tpu.io.columnar import SortingBamWriter
+
+    with SortingBamWriter(out_bam, header) as w:
+        for read in align_pairs(aligner, pairs(), header):
+            w.write(read)
 
 
 # ------------------------------------------------------------------ consensus
