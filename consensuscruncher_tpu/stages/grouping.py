@@ -1291,3 +1291,371 @@ def _build_pair_block(sources: list[tuple], header: BamHeader) -> PairBlock:
     blk.stats_pairs = int(len(canon_o))
     blk.stats_mismatch = int(mism.sum())
     return blk
+
+
+# ---------------------------------------------------------------------------
+#
+# Vectorized singleton rescue (stages/singleton_correction.py's exact-match
+# path).  Mirrors the object window-walk's pinned semantics — including its
+# order-dependent quirks — as array passes over canonical duplex-key runs
+# spanning BOTH inputs (the singleton BAM and the SSCS BAM):
+#
+# Within one canonical-key run (same coords/orientation, barcode == or
+# mirror-of, read number on either side) at most four distinct members can
+# exist — {singleton, SSCS} x {read number 1, 2} — because a full tag is
+# either a >=2 family (SSCS) or a size-1 family (singleton), never both.
+# The walk processes singletons in sorted-str order per (ref, pos) window:
+#   1. partner = mirrored tag in the SSCS dict, else the singleton dict
+#      (not itself, not already consumed); no partner -> remaining.
+#   2. unequal read lengths -> remaining (+length_mismatch), partner NOT
+#      consumed.
+#   3. SSCS rescue writes the corrected singleton; singleton-singleton
+#      rescue writes BOTH corrected reads and consumes the partner.
+# Order-dependent quirk reproduced deliberately: when the second-processed
+# singleton of a mutual pair was NOT consumed (because the first took an
+# SSCS partner), it can re-rescue the first against itself — double-writing
+# the first read.  The emitted categories below encode exactly that table.
+
+
+class RescueBlock:
+    """Rescue decisions for one coordinate-complete slab of both inputs.
+
+    ``sources``: ColumnarBatches referenced by (src, row) pairs.
+    ``remaining_*``: uncorrected singletons, raw-blob passthrough order.
+    ``rescue_*`` (parallel arrays, emission order): the read to correct,
+    its vote partner, and the route — 0 = SSCS rescue, 1 = singleton-
+    singleton.  Stats fields mirror the object walk's counters.
+    """
+
+    __slots__ = ("sources", "remaining_src", "remaining_row",
+                 "rescue_src", "rescue_row", "partner_src", "partner_row",
+                 "rescue_route", "partner_xf", "stats_total", "stats_sscs",
+                 "stats_singleton", "stats_remaining", "stats_mismatch")
+
+
+def _rescue_src_prep(batch) -> tuple:
+    """(rows, bcm, bclen, xf) of the XT/XF-parsed rows of a batch."""
+    ok, bc_start, bc_len, xf = _parse_xt_xf(batch)
+    if not ok.all():
+        raise ValueError("foreign tag layout (no XT/XF prefix)")
+    n = batch.n
+    wb = int(bc_len.max(initial=0))
+    cols = np.arange(wb, dtype=np.int64)
+    idx = bc_start[:, None] + cols[None, :]
+    bcm = np.where(
+        cols[None, :] < bc_len[:, None],
+        batch.buf[np.minimum(idx, len(batch.buf) - 1)], 0,
+    ).astype(np.uint8)
+    return np.arange(n, dtype=np.int64), bcm, bc_len.astype(np.int64), xf.astype(np.int64)
+
+
+def singleton_rescue_blocks(s_creader, x_creader, header: BamHeader) -> Iterator[RescueBlock]:
+    """Yield :class:`RescueBlock`s over the singleton BAM (``s``) and the
+    SSCS BAM (``x``), pulling batches from both in coordinate lockstep so
+    every (ref, pos) anchor is complete within one block."""
+    def batches_with_meta(creader, srctype):
+        for batch in creader.batches():
+            rid, pos = batch.ref_id, batch.pos
+            if batch.n:
+                sorted_ok = (rid[1:] > rid[:-1]) | ((rid[1:] == rid[:-1]) & (pos[1:] >= pos[:-1]))
+                if not sorted_ok.all():
+                    i = int(np.argmin(sorted_ok)) + 1
+                    read = batch.materialize(i)
+                    raise NotCoordinateSorted(
+                        f"input BAM is not coordinate-sorted: {read.qname} at "
+                        f"{read.ref}:{read.pos}"
+                    )
+            yield srctype, batch
+
+    streams = [batches_with_meta(s_creader, 1), batches_with_meta(x_creader, 0)]
+    heads: list = [next(st, None) for st in streams]
+    # carry: list of (srctype, batch, rows, bcm, bclen, xf) with rows >= the
+    # emitted boundary
+    carry: list[tuple] = []
+
+    def last_key(item):
+        _t, b = item
+        return (int(b.ref_id[-1]), int(b.pos[-1])) if b.n else None
+
+    while heads[0] is not None or heads[1] is not None:
+        # take every stream whose current batch is present; boundary = the
+        # smallest last-key among them (keys >= boundary may continue)
+        live = [h for h in heads if h is not None]
+        bkeys = [k for k in (last_key(h) for h in live) if k is not None]
+        boundary = min(bkeys) if bkeys else None
+        pieces = list(carry)
+        carry = []
+        for si in (0, 1):
+            h = heads[si]
+            if h is None:
+                continue
+            srctype, batch = h
+            if batch.n:
+                rows, bcm, bclen, xf = _rescue_src_prep(batch)
+                pieces.append((srctype, batch, rows, bcm, bclen, xf))
+            heads[si] = next(streams[si], None)
+        done_streams = heads[0] is None and heads[1] is None
+        emit_pieces: list[tuple] = []
+        for srctype, batch, rows, bcm, bclen, xf in pieces:
+            if done_streams or boundary is None:
+                emit_pieces.append((srctype, batch, rows, bcm, bclen, xf))
+                continue
+            key_ge = (batch.ref_id[rows] > boundary[0]) | (
+                (batch.ref_id[rows] == boundary[0]) & (batch.pos[rows] >= boundary[1])
+            )
+            cut = int(np.argmax(key_ge)) if key_ge.any() else len(rows)
+            if cut:
+                emit_pieces.append((srctype, batch, rows[:cut], bcm[:cut], bclen[:cut], xf[:cut]))
+            if cut < len(rows):
+                carry.append((srctype, batch, rows[cut:], bcm[cut:], bclen[cut:], xf[cut:]))
+        if emit_pieces:
+            yield _build_rescue_block(emit_pieces, header)
+    if carry:
+        yield _build_rescue_block(carry, header)
+
+
+def _build_rescue_block(pieces: list[tuple], header: BamHeader) -> RescueBlock:
+    def col(fn):
+        return np.concatenate([fn(p) for p in pieces])
+
+    batches = [p[1] for p in pieces]
+    srct = np.concatenate([
+        np.full(len(p[2]), p[0], dtype=np.int8) for p in pieces
+    ])
+    rid = col(lambda p: p[1].ref_id[p[2]])
+    pos = col(lambda p: p[1].pos[p[2]])
+    mrid = col(lambda p: p[1].mate_ref_id[p[2]])
+    mpos = col(lambda p: p[1].mate_pos[p[2]])
+    flag = col(lambda p: p[1].flag[p[2]])
+    lseq = col(lambda p: p[1].l_seq[p[2]])
+    xf = np.concatenate([p[5] for p in pieces])
+    bclen = np.concatenate([p[4] for p in pieces])
+    grow = col(lambda p: p[2])
+    srci = np.repeat(np.arange(len(pieces), dtype=np.int64),
+                     [len(p[2]) for p in pieces])
+    wb = max((p[3].shape[1] for p in pieces), default=0)
+    n = len(rid)
+    bcm = np.zeros((n, wb), dtype=np.uint8)
+    r0 = 0
+    for p in pieces:
+        bcm[r0 : r0 + len(p[2]), : p[3].shape[1]] = p[3]
+        r0 += len(p[2])
+
+    rn = np.where((flag & FREAD1) != 0, 1, 2).astype(np.int8)
+    rev = ((flag & FREVERSE) != 0).astype(np.int8)
+    mirror = _mirror_bcm(bcm, bclen)
+    a = np.ascontiguousarray(bcm).view(f"S{max(wb, 1)}").ravel()
+    b = np.ascontiguousarray(mirror).view(f"S{max(wb, 1)}").ravel()
+    bc_lt, bc_eq = a < b, a == b
+    canon_bcm = np.where((bc_lt | bc_eq)[:, None], bcm, mirror)
+    canon_rn = np.where(bc_eq, 1, np.where(bc_lt, rn, 3 - rn)).astype(np.int8)
+
+    keys = [rev, canon_rn, mpos, mrid]
+    keys += [canon_bcm[:, j] for j in range(wb - 1, -1, -1)]
+    keys += [pos, rid]
+    order = np.lexsort(keys)
+
+    def srt(arr):
+        return arr[order]
+
+    kb = canon_bcm[order]
+    same = np.ones(n, dtype=bool)
+    if n > 1:
+        same[1:] = (
+            (kb[1:] == kb[:-1]).all(axis=1)
+            & (srt(rid)[1:] == srt(rid)[:-1])
+            & (srt(pos)[1:] == srt(pos)[:-1])
+            & (srt(mrid)[1:] == srt(mrid)[:-1])
+            & (srt(mpos)[1:] == srt(mpos)[:-1])
+            & (srt(canon_rn)[1:] == srt(canon_rn)[:-1])
+            & (srt(rev)[1:] == srt(rev)[:-1])
+        )
+    run_id = np.cumsum(~same) if n else np.zeros(0, np.int64)
+    n_runs = int(run_id[-1]) + 1 if n else 0
+
+    # slot per (srctype, rn): last stream occurrence wins (window-dict
+    # last-wins semantics; duplicates are impossible for pipeline outputs)
+    orig = order  # sorted-domain -> original-domain
+    slot = np.full((4, n_runs), -1, dtype=np.int64)
+    sl_of = (srct[orig].astype(np.int64) * 2 + (rn[orig].astype(np.int64) - 1))
+    slot[sl_of, run_id] = orig
+    x1, x2, s1, s2 = slot[0], slot[1], slot[2], slot[3]
+
+    # tag strings for singleton members (the walk's processing order)
+    _ref_names, pool = _header_name_pool(header)
+    sing_members = np.concatenate([s1[s1 >= 0], s2[s2 >= 0]])
+    tag_pos = np.full(n, -1, dtype=np.int64)
+    tag_pos[sing_members] = np.arange(len(sing_members))
+    if len(sing_members):
+        tag_data, tag_off = qnames_mod.tag_strings_columnar(
+            bcm[sing_members], bclen[sing_members], rid[sing_members],
+            pos[sing_members], mrid[sing_members], mpos[sing_members],
+            rn[sing_members].astype(np.int64), rev[sing_members].astype(bool),
+            pool,
+        )
+        tag_starts, tag_lens = tag_off[:-1], np.diff(tag_off)
+    else:
+        tag_data = np.empty(0, np.uint8)
+        tag_starts = tag_lens = np.empty(0, np.int64)
+
+    # ---- decision table over runs ----
+    p_s1, p_s2 = s1 >= 0, s2 >= 0
+    p_x1, p_x2 = x1 >= 0, x2 >= 0
+    L = np.zeros(n + 1, dtype=np.int64)
+    L[:n] = lseq
+    lx1, lx2 = L[x1], L[x2]
+    ls1, ls2 = L[s1], L[s2]
+
+    # events: (order_key, read, partner, route) collected per category then
+    # emission-sorted.  route: 0 sscs, 1 singleton.  remaining: (order_key,
+    # read).  order keys reproduce the walk: windows ascend (rid,pos), then
+    # sorted-str of the PROCESSED singleton's tag; a singleton-pair write
+    # emits corrected self then corrected partner adjacently.
+    rescue_read: list[np.ndarray] = []
+    rescue_partner: list[np.ndarray] = []
+    rescue_route: list[np.ndarray] = []
+    rescue_key: list[np.ndarray] = []     # (member whose str orders the event)
+    rescue_sub: list[np.ndarray] = []     # intra-event sequence (0 self, 1 partner)
+    remaining: list[np.ndarray] = []
+    remaining_key: list[np.ndarray] = []
+    n_mismatch = 0
+    n_pair_events = 0
+    n_pair_c = 0  # pairs whose partner was already processed (case c)
+
+    def cmp_str(mem_a, mem_b):
+        return qnames_mod.compare_string_rows(
+            tag_data,
+            tag_starts[tag_pos[mem_a]], tag_lens[tag_pos[mem_a]],
+            tag_starts[tag_pos[mem_b]], tag_lens[tag_pos[mem_b]],
+        )
+
+    # -- runs with exactly one singleton --
+    for s_slot, x_m, l_s, l_xm, has_s, has_other_s in (
+        (s1, x2, ls1, lx2, p_s1, p_s2),
+        (s2, x1, ls2, lx1, p_s2, p_s1),
+    ):
+        only = has_s & ~has_other_s
+        xm_p = only & (x_m >= 0)
+        ok_len = xm_p & (l_s == l_xm)
+        rescue_read.append(s_slot[ok_len])
+        rescue_partner.append(x_m[ok_len])
+        rescue_route.append(np.zeros(int(ok_len.sum()), np.int8))
+        rescue_key.append(s_slot[ok_len])
+        rescue_sub.append(np.zeros(int(ok_len.sum()), np.int8))
+        mm = xm_p & (l_s != l_xm)
+        n_mismatch += int(mm.sum())
+        rem = only & ((~xm_p) | mm)
+        remaining.append(s_slot[rem])
+        remaining_key.append(s_slot[rem])
+
+    # -- runs with both singletons --
+    both = p_s1 & p_s2
+    bi = np.nonzero(both)[0]
+    if len(bi):
+        c = cmp_str(s1[bi], s2[bi]) <= 0
+        first = np.where(c, s1[bi], s2[bi])
+        second = np.where(c, s2[bi], s1[bi])
+        # mirror sscs of a singleton with read number r is slot x[3-r]
+        fx = np.where(c, x2[bi], x1[bi])
+        sx = np.where(c, x1[bi], x2[bi])
+        lf, lsec = L[first], L[second]
+        lfx, lsx = L[fx], L[sx]
+
+        f_has_x = fx >= 0
+        A = f_has_x & (lf == lfx)          # first sscs-rescued
+        B = f_has_x & (lf != lfx)          # first mismatch-remaining
+        CD = ~f_has_x
+        C = CD & (lf == lsec)              # singleton pair; second consumed
+        D = CD & (lf != lsec)              # first mismatch-remaining
+
+        rescue_read.append(first[A])
+        rescue_partner.append(fx[A])
+        rescue_route.append(np.zeros(int(A.sum()), np.int8))
+        rescue_key.append(first[A])
+        rescue_sub.append(np.zeros(int(A.sum()), np.int8))
+        n_mismatch += int(B.sum()) + int(D.sum())
+        remaining.append(first[B | D])
+        remaining_key.append(first[B | D])
+        # case C: corrected(first vs second) + corrected(second vs first),
+        # ordered by first's str
+        for sub, rd, pt in ((0, first, second), (1, second, first)):
+            rescue_read.append(rd[C])
+            rescue_partner.append(pt[C])
+            rescue_route.append(np.ones(int(C.sum()), np.int8))
+            rescue_key.append(first[C])
+            rescue_sub.append(np.full(int(C.sum()), sub, np.int8))
+        n_pair_events += int(C.sum())
+
+        # step 2: second processes unless case C consumed it
+        live = ~C
+        s_has_x = live & (sx >= 0)
+        a_m = s_has_x & (lsec == lsx)
+        rescue_read.append(second[a_m])
+        rescue_partner.append(sx[a_m])
+        rescue_route.append(np.zeros(int(a_m.sum()), np.int8))
+        rescue_key.append(second[a_m])
+        rescue_sub.append(np.zeros(int(a_m.sum()), np.int8))
+        b_m = s_has_x & (lsec != lsx)
+        c_m = live & (sx < 0) & (lsec == lf)   # pairs with already-processed first
+        d_m = live & (sx < 0) & (lsec != lf)
+        n_mismatch += int(b_m.sum()) + int(d_m.sum())
+        remaining.append(second[b_m | d_m])
+        remaining_key.append(second[b_m | d_m])
+        for sub, rd, pt in ((0, second, first), (1, first, second)):
+            rescue_read.append(rd[c_m])
+            rescue_partner.append(pt[c_m])
+            rescue_route.append(np.ones(int(c_m.sum()), np.int8))
+            rescue_key.append(second[c_m])
+            rescue_sub.append(np.full(int(c_m.sum()), sub, np.int8))
+        n_pair_events += int(c_m.sum())
+        n_pair_c += int(c_m.sum())
+
+    def emission_order(keys_members, subs=None):
+        """Sort events by (rid, pos, str(key member), sub)."""
+        if len(keys_members) == 0:
+            return np.empty(0, np.int64)
+        trail = [subs] if subs is not None else None
+        return qnames_mod.lexsort_string_refs(
+            tag_data,
+            tag_starts[tag_pos[keys_members]], tag_lens[tag_pos[keys_members]],
+            leaders=[rid[keys_members], pos[keys_members]],
+            trailers=trail,
+        )
+
+    blk = RescueBlock()
+    blk.sources = batches
+    if rescue_read:
+        rr = np.concatenate(rescue_read)
+        rp = np.concatenate(rescue_partner)
+        rt = np.concatenate(rescue_route)
+        rk = np.concatenate(rescue_key)
+        rs = np.concatenate(rescue_sub)
+        perm = emission_order(rk, rs)
+        rr, rp, rt = rr[perm], rp[perm], rt[perm]
+    else:
+        rr = rp = np.empty(0, np.int64)
+        rt = np.empty(0, np.int8)
+    blk.rescue_src = srci[rr] if len(rr) else np.empty(0, np.int64)
+    blk.rescue_row = grow[rr] if len(rr) else np.empty(0, np.int64)
+    blk.partner_src = srci[rp] if len(rp) else np.empty(0, np.int64)
+    blk.partner_row = grow[rp] if len(rp) else np.empty(0, np.int64)
+    blk.rescue_route = rt
+    # the XR tag derives from the PARTNER's family size (object rule:
+    # XF > 1 -> "sscs"), not from the route
+    blk.partner_xf = xf[rp] if len(rp) else np.empty(0, np.int64)
+    if remaining:
+        rm = np.concatenate(remaining)
+        rmk = np.concatenate(remaining_key)
+        perm = emission_order(rmk)
+        rm = rm[perm]
+    else:
+        rm = np.empty(0, np.int64)
+    blk.remaining_src = srci[rm] if len(rm) else np.empty(0, np.int64)
+    blk.remaining_row = grow[rm] if len(rm) else np.empty(0, np.int64)
+    n_singles = int(len(sing_members))
+    blk.stats_total = n_singles + n_pair_c
+    blk.stats_sscs = int((rt == 0).sum())
+    blk.stats_singleton = int((rt == 1).sum())
+    blk.stats_remaining = int(len(rm))
+    blk.stats_mismatch = n_mismatch
+    return blk

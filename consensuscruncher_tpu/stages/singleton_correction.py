@@ -118,21 +118,229 @@ def _hamming_partner(tag, candidates: dict, max_mismatch: int, device: bool):
     return pool[idx] if idx >= 0 else None
 
 
+def _run_rescue_blocks(singleton_bam, sscs_bam, writers, stats, backend) -> None:
+    """Vectorized exact-match rescue: RescueBlock decisions -> batched duplex
+    votes -> columnar record rebuild (original record + new seq/qual +
+    appended XR tag).  Byte-parity with the object walk is pinned by
+    tests/test_singleton_vec.py.
+
+    Contract: consumes this pipeline's own SSCS-stage outputs (XT/XF-led tag
+    blocks, no preexisting XR tag) — foreign layouts raise and the caller
+    falls back to the object walk."""
+    from consensuscruncher_tpu.io.columnar import ColumnarReader
+    from consensuscruncher_tpu.io.encode import encode_records
+    from consensuscruncher_tpu.stages.dcs_maker import _duplex_vote_batch
+    from consensuscruncher_tpu.stages.grouping import singleton_rescue_blocks
+    from consensuscruncher_tpu.utils.ragged import gather_runs
+
+    _XR_SSCS = np.frombuffer(b"XRZsscs\x00", np.uint8)
+    _XR_SINGLE = np.frombuffer(b"XRZsingleton\x00", np.uint8)
+    s_reader = ColumnarReader(singleton_bam)
+    x_reader = ColumnarReader(sscs_bam)
+    try:
+        header = s_reader.header
+        for blk in singleton_rescue_blocks(s_reader, x_reader, header):
+            # guard zero increments: the object walk only creates counter
+            # keys it actually touches, and stats files are parity artifacts
+            for key, val in (
+                ("singletons_total", blk.stats_total),
+                ("rescued_by_sscs", blk.stats_sscs),
+                ("rescued_by_singleton", blk.stats_singleton),
+                ("remaining", blk.stats_remaining),
+                ("length_mismatch", blk.stats_mismatch),
+            ):
+                if val:
+                    stats.incr(key, val)
+
+            # remaining singletons: raw blob passthrough
+            k = 0
+            nr = len(blk.remaining_row)
+            while k < nr:
+                si = int(blk.remaining_src[k])
+                k2 = k
+                while k2 < nr and blk.remaining_src[k2] == si:
+                    k2 += 1
+                batch = blk.sources[si]
+                rows = blk.remaining_row[k:k2]
+                data, _ = gather_runs(
+                    batch.buf, batch.rec_off[rows],
+                    batch.rec_off[rows + 1] - batch.rec_off[rows],
+                )
+                writers["remaining"].write_encoded(data)
+                k = k2
+
+            n_resc = len(blk.rescue_row)
+            if n_resc == 0:
+                continue
+            # per-rescue READ columns gathered per source batch (the partner
+            # contributes only its seq/qual, via member_mat below)
+            flag = np.empty(n_resc, np.int64)
+            rid = np.empty(n_resc, np.int64)
+            posc = np.empty(n_resc, np.int64)
+            mridc = np.empty(n_resc, np.int64)
+            mposc = np.empty(n_resc, np.int64)
+            tlenc = np.empty(n_resc, np.int64)
+            mapqc = np.empty(n_resc, np.int64)
+            lseqc = np.empty(n_resc, np.int64)
+            for si, batch in enumerate(blk.sources):
+                m = blk.rescue_src == si
+                if not m.any():
+                    continue
+                rows = blk.rescue_row[m]
+                flag[m] = batch.flag[rows]
+                rid[m] = batch.ref_id[rows]
+                posc[m] = batch.pos[rows]
+                mridc[m] = batch.mate_ref_id[rows]
+                mposc[m] = batch.mate_pos[rows]
+                tlenc[m] = batch.tlen[rows]
+                mapqc[m] = batch.mapq[rows]
+                lseqc[m] = batch.l_seq[rows]
+
+            def member_mat(src_arr, row_arr, sel, L):
+                out_c = np.empty((int(sel.sum()), L), np.uint8)
+                out_q = np.empty_like(out_c)
+                pos_sel = np.nonzero(sel)[0]
+                for si, batch in enumerate(blk.sources):
+                    m = src_arr[pos_sel] == si
+                    if not m.any():
+                        continue
+                    rows = row_arr[pos_sel[m]]
+                    codes, coff = batch.seq_codes()
+                    quals, _ = batch.quals()
+                    out_c[m] = codes[coff[rows][:, None] + np.arange(L)]
+                    out_q[m] = quals[coff[rows][:, None] + np.arange(L)]
+                return out_c, out_q
+
+            for route_name, route in (("sscs_rescue", 0), ("singleton_rescue", 1)):
+                rmask = blk.rescue_route == route
+                if not rmask.any():
+                    continue
+                for L in np.unique(lseqc[rmask]):
+                    L = int(L)
+                    sel = rmask & (lseqc == L)
+                    s1m, q1m = member_mat(blk.rescue_src, blk.rescue_row, sel, L)
+                    s2m, q2m = member_mat(blk.partner_src, blk.partner_row, sel, L)
+                    out_b, out_q = _duplex_vote_batch(s1m, q1m, s2m, q2m, 60, backend)
+                    ps = np.nonzero(sel)[0]
+                    kk = len(ps)
+                    # original qname / cigar / tag bytes, gathered per source
+                    qn_start = np.empty(kk, np.int64)
+                    qn_len = np.empty(kk, np.int64)
+                    cg_start = np.empty(kk, np.int64)
+                    cg_len = np.empty(kk, np.int64)
+                    tg_start = np.empty(kk, np.int64)
+                    tg_len = np.empty(kk, np.int64)
+                    src_of = np.empty(kk, np.int64)
+                    for si, batch in enumerate(blk.sources):
+                        m = blk.rescue_src[ps] == si
+                        if not m.any():
+                            continue
+                        rows = blk.rescue_row[ps[m]]
+                        qn_start[m] = batch.qname_start[rows]
+                        qn_len[m] = batch.l_qname[rows] - 1
+                        cg_start[m] = batch.cigar_start[rows]
+                        cg_len[m] = batch.n_cigar[rows]
+                        tg_start[m] = batch.tags_start[rows]
+                        tg_len[m] = batch.rec_off[rows + 1] - batch.tags_start[rows]
+                        src_of[m] = si
+                    from consensuscruncher_tpu.utils.ragged import scatter_runs
+
+                    def gath(starts, lens):
+                        data = np.empty(int(lens.sum()), np.uint8)
+                        doff = np.zeros(kk, np.int64)
+                        np.cumsum(lens[:-1], out=doff[1:])
+                        for si, batch in enumerate(blk.sources):
+                            m = src_of == si
+                            if not m.any():
+                                continue
+                            scatter_runs(data, doff[m], batch.buf, lens[m],
+                                         src_starts=starts[m])
+                        return data
+                    qn_data = gath(qn_start, qn_len)
+                    cg_data = gath(cg_start, 4 * cg_len)
+                    tg_old = gath(tg_start, tg_len)
+                    # append XR:Z per record — value from the PARTNER's
+                    # family size (object rule: XF > 1 -> "sscs")
+                    xr_is_sscs = blk.partner_xf[ps] > 1
+                    xr_len = np.where(xr_is_sscs, len(_XR_SSCS), len(_XR_SINGLE))
+                    new_len = tg_len + xr_len
+                    new_off = np.zeros(kk, np.int64)
+                    np.cumsum(new_len[:-1], out=new_off[1:])
+                    tg_new = np.empty(int(new_len.sum()), np.uint8)
+                    scatter_runs(tg_new, new_off, tg_old, tg_len)
+                    for m, blob_arr in ((xr_is_sscs, _XR_SSCS),
+                                        (~xr_is_sscs, _XR_SINGLE)):
+                        if not m.any():
+                            continue
+                        mat = np.broadcast_to(blob_arr, (int(m.sum()), len(blob_arr)))
+                        scatter_runs(tg_new, (new_off + tg_len)[m],
+                                     np.ascontiguousarray(mat).reshape(-1),
+                                     np.full(int(m.sum()), len(blob_arr), np.int64))
+                    blob = encode_records(
+                        qn_data, qn_len,
+                        flag[ps], rid[ps], posc[ps], mapqc[ps],
+                        np.ascontiguousarray(cg_data).view("<u4"), cg_len,
+                        mridc[ps], mposc[ps], tlenc[ps],
+                        out_b.reshape(-1), np.full(kk, L, np.int64),
+                        out_q.reshape(-1),
+                        tg_new, new_len,
+                    )
+                    writers[route_name].write_encoded(blob)
+    finally:
+        s_reader.close()
+        x_reader.close()
+
+
 def run_singleton_correction(
     singleton_bam: str,
     sscs_bam: str,
     out_prefix: str,
     max_mismatch: int = 0,
     backend: str = "tpu",
+    _force_object: bool = False,
 ) -> SingletonResult:
     """``backend="cpu"`` keeps the Hamming matcher in numpy — a cpu run
-    must never touch (or wait on) a device backend."""
+    must never touch (or wait on) a device backend.
+
+    ``max_mismatch == 0`` (exact complementary-tag matching, the default)
+    runs the vectorized RescueBlock path; ``max_mismatch > 0`` (and foreign
+    tag layouts) use the object window walk.  ``_force_object`` exists for
+    the byte-parity test suite."""
     use_device = backend == "tpu"
     stats = StageStats("singleton_correction")
     all_paths = output_paths(out_prefix)
     paths = {k: all_paths[k] for k in ("sscs_rescue", "singleton_rescue", "remaining")}
 
     from consensuscruncher_tpu.io.columnar import SortingBamWriter
+
+    if max_mismatch == 0 and not _force_object:
+        hdr_reader = BamReader(singleton_bam)
+        header = hdr_reader.header
+        hdr_reader.close()
+        writers = {k: SortingBamWriter(p, header) for k, p in paths.items()}
+        ok = False
+        try:
+            try:
+                _run_rescue_blocks(singleton_bam, sscs_bam, writers, stats, backend)
+                ok = True
+            except ValueError as e:
+                if "foreign tag layout" not in str(e):
+                    raise
+        finally:
+            if not ok:
+                for w in writers.values():
+                    w.abort()
+        if ok:
+            for w in writers.values():
+                w.close()
+            stats.set("max_mismatch", max_mismatch)
+            stats.write(all_paths["stats_txt"])
+            return SingletonResult(
+                paths["sscs_rescue"], paths["singleton_rescue"],
+                paths["remaining"], stats,
+            )
+        # foreign layout: restart cleanly on the object walk below
+        stats = StageStats("singleton_correction")
 
     s_reader = BamReader(singleton_bam)
     x_reader = BamReader(sscs_bam)

@@ -1,0 +1,171 @@
+"""Vectorized singleton rescue vs the object window-walk oracle.
+
+`run_singleton_correction(max_mismatch=0)` routes through RescueBlocks
+(`stages.grouping.singleton_rescue_blocks`); `_force_object=True` runs the
+original walk.  Byte-parity of all three output BAMs plus stats equality is
+the contract — including the walk's order-dependent double-write quirk.
+"""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from consensuscruncher_tpu.io.bam import BamHeader, BamRead, BamWriter, sort_bam
+from consensuscruncher_tpu.stages.singleton_correction import (
+    run_singleton_correction,
+)
+from consensuscruncher_tpu.stages.sscs_maker import run_sscs
+from consensuscruncher_tpu.utils.simulate import SimConfig, simulate_bam_fast
+
+
+def _digests(prefix):
+    out = {}
+    for k in ("sscs.rescue", "singleton.rescue", "remaining.singleton"):
+        p = f"{prefix}.{k}.sorted.bam"
+        out[k] = hashlib.sha256(open(p, "rb").read()).hexdigest()
+    return out
+
+
+def _compare(tmp_path, singleton_bam, sscs_bam, backend="cpu"):
+    pv = str(tmp_path / "vec")
+    po = str(tmp_path / "obj")
+    rv = run_singleton_correction(singleton_bam, sscs_bam, pv, backend=backend)
+    ro = run_singleton_correction(
+        singleton_bam, sscs_bam, po, backend=backend, _force_object=True
+    )
+    assert _digests(pv) == _digests(po)
+    sv = dict(sorted(rv.stats._items.items()))
+    so = dict(sorted(ro.stats._items.items()))
+    assert sv == so, (sv, so)
+    return rv
+
+
+def test_parity_simulated(tmp_path):
+    """End-to-end parity on a simulated dataset with duplex dropout and
+    barcode errors (a realistic mix of sscs/singleton rescues)."""
+    bam = str(tmp_path / "in.bam")
+    simulate_bam_fast(bam, SimConfig(
+        n_fragments=600, read_len=60, mean_family_size=2.0,
+        duplex_fraction=0.6, ref_len=250_000, seed=17,
+        barcode_error_rate=0.1,
+    ))
+    r = run_sscs(bam, str(tmp_path / "s"), backend="cpu")
+    rv = _compare(tmp_path, r.singleton_bam, r.sscs_bam)
+    # the dataset must actually exercise both rescue routes
+    assert rv.stats.get("rescued_by_sscs", 0) > 0
+    assert rv.stats.get("rescued_by_singleton", 0) > 0
+    assert rv.stats.get("remaining", 0) > 0
+
+
+def _mk(header, qname, pos, mate_pos, rn, rev, barcode, xf, seq, qual=30):
+    flag = 0x1 | 0x2 | (0x40 if rn == 1 else 0x80)
+    if rev:
+        flag |= 0x10
+    else:
+        flag |= 0x20
+    return BamRead(
+        qname=qname, flag=flag, ref="chr1", pos=pos, mapq=60,
+        cigar=[("M", len(seq))], mate_ref="chr1", mate_pos=mate_pos,
+        tlen=mate_pos - pos + len(seq), seq=seq,
+        qual=np.full(len(seq), qual, np.uint8),
+        tags={"XT": ("Z", barcode), "XF": ("i", xf)},
+    )
+
+
+def _write_sorted(path, header, reads):
+    tmp = path + ".unsorted"
+    with BamWriter(tmp, header) as w:
+        for r in reads:
+            w.write(r)
+    sort_bam(tmp, path)
+
+
+CASES = {
+    # singleton at A-side + SSCS mirror at B-side -> sscs rescue
+    "sscs_rescue": (
+        [("q1", 100, 400, 1, False, "AAT.CCG", 1, "ACGTAC")],
+        [("x1", 100, 400, 2, False, "CCG.AAT", 3, "ACGTAC")],
+    ),
+    # mutual singletons -> singleton-singleton rescue
+    "pair": (
+        [("q1", 100, 400, 1, False, "AAT.CCG", 1, "ACGTAC"),
+         ("q2", 100, 400, 2, False, "CCG.AAT", 1, "ACGTTC")],
+        [],
+    ),
+    # both singletons + ONE sscs partner: order-dependent double-write path
+    "asymmetric_sscs": (
+        [("q1", 100, 400, 1, False, "AAT.CCG", 1, "ACGTAC"),
+         ("q2", 100, 400, 2, False, "CCG.AAT", 1, "ACGTTC")],
+        [("x1", 100, 400, 2, False, "CCG.AAT", 4, "ACGTAC")],
+    ),
+    # same, with the sscs partner on the OTHER side (flips processing order)
+    "asymmetric_sscs_flip": (
+        [("q1", 100, 400, 1, False, "AAT.CCG", 1, "ACGTAC"),
+         ("q2", 100, 400, 2, False, "CCG.AAT", 1, "ACGTTC")],
+        [("x1", 100, 400, 1, False, "AAT.CCG", 4, "ACGTAC")],
+    ),
+    # length mismatch with sscs partner -> remaining, no singleton fallback
+    "len_mismatch": (
+        [("q1", 100, 400, 1, False, "AAT.CCG", 1, "ACGTAC"),
+         ("q2", 100, 400, 2, False, "CCG.AAT", 1, "ACGT")],
+        [("x1", 100, 400, 2, False, "CCG.AAT", 4, "ACG")],
+    ),
+    # palindromic barcode: mirror == self, rn flip still pairs
+    "palindrome": (
+        [("q1", 100, 400, 1, False, "GGC.GGC", 1, "ACGTAC"),
+         ("q2", 100, 400, 2, False, "GGC.GGC", 1, "ACGTTC")],
+        [],
+    ),
+    # sscs-pool partner that itself has XF == 1: the XR tag derives from
+    # the partner's family size, not the pool (object rule)
+    "xf1_sscs_partner": (
+        [("q1", 100, 400, 1, False, "AAT.CCG", 1, "ACGTAC")],
+        [("x1", 100, 400, 2, False, "CCG.AAT", 1, "ACGTAC")],
+    ),
+    # coordinate-coincident NON-mirror families must stay separate runs
+    # (regression: canon_rn omitted from the run-equality check)
+    "coincident_nonmirror": (
+        [("q1", 100, 400, 1, False, "AAT.CCG", 1, "ACGTAC"),
+         ("q2", 100, 400, 1, False, "CCG.AAT", 1, "ACGTTC")],
+        [("x1", 100, 400, 2, False, "CCG.AAT", 3, "ACGTAC")],
+    ),
+    # lone singleton -> remaining
+    "lone": (
+        [("q1", 100, 400, 1, False, "AAT.CCG", 1, "ACGTAC")],
+        [],
+    ),
+    # two windows + an unmatched sscs read
+    "multi_window": (
+        [("q1", 100, 400, 1, False, "AAT.CCG", 1, "ACGTAC"),
+         ("q3", 900, 1200, 1, True, "TTA.GGA", 1, "ACGTAA")],
+        [("x1", 100, 400, 2, False, "CCG.AAT", 3, "ACGTAC"),
+         ("x2", 500, 800, 1, False, "AAA.CCC", 5, "ACGTAA")],
+    ),
+}
+
+
+@pytest.mark.parametrize("case", sorted(CASES))
+def test_parity_crafted(tmp_path, case):
+    singles, sscses = CASES[case]
+    header = BamHeader.from_refs([("chr1", 10_000)])
+    sp = str(tmp_path / "s.bam")
+    xp = str(tmp_path / "x.bam")
+    _write_sorted(sp, header, [_mk(header, *r) for r in singles])
+    _write_sorted(xp, header, [_mk(header, *r) for r in sscses])
+    _compare(tmp_path, sp, xp)
+
+
+def test_vectorized_adds_xr_tag(tmp_path):
+    header = BamHeader.from_refs([("chr1", 10_000)])
+    sp = str(tmp_path / "s.bam")
+    xp = str(tmp_path / "x.bam")
+    _write_sorted(sp, header, [_mk(header, "q1", 100, 400, 1, False, "AAT.CCG", 1, "ACGTAC")])
+    _write_sorted(xp, header, [_mk(header, "x1", 100, 400, 2, False, "CCG.AAT", 3, "ACGTAC")])
+    r = run_singleton_correction(sp, xp, str(tmp_path / "v"), backend="cpu")
+    from consensuscruncher_tpu.io.bam import BamReader
+
+    reads = list(BamReader(r.sscs_rescue_bam))
+    assert len(reads) == 1
+    assert reads[0].tags["XR"] == ("Z", "sscs")
+    assert reads[0].tags["XT"] == ("Z", "AAT.CCG")
