@@ -557,8 +557,16 @@ def _merge_paths(paths: list[str], out_path, header: BamHeader, level: int = 6) 
 
 
 def merge_bams(in_paths: list, out_path) -> None:
-    """samtools-merge parity: k-way heap merge of coordinate-sorted inputs
-    (headers must share a reference dictionary)."""
+    """samtools-merge parity: merge coordinate-sorted inputs (headers must
+    share a reference dictionary).
+
+    Inputs that plausibly fit the in-memory sort buffer stream through a
+    ``SortingBamWriter`` as raw blobs (one lexsort + one BGZF write — the
+    k-way order over already-sorted inputs is a special case of the full
+    coordinate sort, and the writer's key + stable-tie order match the
+    object heap merge's exactly).  Larger inputs keep the O(k)-memory
+    streaming heap merge — buffering them only to re-sort already-sorted
+    data would double the I/O."""
     headers = []
     for p in in_paths:
         r = BamReader(p)
@@ -570,4 +578,22 @@ def merge_bams(in_paths: list, out_path) -> None:
                 f"merge_bams: reference dictionary of {os.fspath(p)!r} differs from "
                 f"{os.fspath(in_paths[0])!r} — inputs must share @SQ lines"
             )
-    _merge_paths([os.fspath(p) for p in in_paths], out_path, headers[0])
+    from consensuscruncher_tpu.io.columnar import ColumnarReader, SortingBamWriter
+
+    total_compressed = sum(os.path.getsize(p) for p in in_paths)
+    writer = SortingBamWriter(os.fspath(out_path), headers[0])
+    # ~4x is a conservative BAM BGZF expansion estimate; beyond the buffer
+    # the writer would spill-and-resort, so stream-merge instead
+    if total_compressed * 4 > writer._max_raw:
+        writer.abort()
+        _merge_paths([os.fspath(p) for p in in_paths], out_path, headers[0])
+        return
+    try:
+        for p in in_paths:
+            with ColumnarReader(p) as reader:
+                for b in reader.batches():
+                    writer.write_encoded(b.buf[: int(b.rec_off[-1])])
+    except BaseException:
+        writer.abort()
+        raise
+    writer.close()

@@ -79,6 +79,170 @@ class ExtractResult:
     stats: StageStats
 
 
+def _batch_zipper(read1: str, read2: str):
+    """Yield aligned column slices from both FASTQs; raises on unequal
+    record counts (the object path's ``zip(strict=True)`` contract)."""
+    from consensuscruncher_tpu.io.fastq import read_fastq_batches
+
+    def cols(b, lo, hi):
+        return (b.data, b.name_start[lo:hi], b.name_len[lo:hi],
+                b.seq_start[lo:hi], b.seq_len[lo:hi], b.qual_start[lo:hi])
+
+    it1, it2 = read_fastq_batches(read1), read_fastq_batches(read2)
+    b1 = b2 = None
+    o1 = o2 = 0
+    while True:
+        if b1 is None or o1 >= b1.n:
+            b1, o1 = next(it1, None), 0
+        if b2 is None or o2 >= b2.n:
+            b2, o2 = next(it2, None), 0
+        if b1 is None or b2 is None:
+            break
+        k = min(b1.n - o1, b2.n - o2)
+        yield cols(b1, o1, o1 + k), cols(b2, o2, o2 + k)
+        o1 += k
+        o2 += k
+    leftover1 = (b1 is not None and o1 < b1.n) or next(it1, None) is not None
+    leftover2 = (b2 is not None and o2 < b2.n) or next(it2, None) is not None
+    if leftover1 or leftover2:
+        raise ValueError("R1/R2 FASTQ record counts differ")
+
+
+_UPPER = None
+
+
+def _upper_lut():
+    global _UPPER
+    if _UPPER is None:
+        import numpy as np
+
+        lut = np.arange(256, dtype=np.uint8)
+        lut[ord("a"):ord("z") + 1] -= 32
+        _UPPER = lut
+    return _UPPER
+
+
+def _run_extract_vectorized(
+    read1, read2, pattern, whitelist, bdelim, stats, distribution, writers
+) -> None:
+    """Columnar extract: one pass of array ops per aligned batch pair.
+    Byte-parity with the object loop is pinned by tests/test_extract_vec.py."""
+    import numpy as np
+
+    from consensuscruncher_tpu.core.qnames import build_strings, const, fixed, ragged
+
+    P = pattern.length
+    upos = np.asarray(pattern.umi_positions, dtype=np.int64)
+    U = len(upos)
+    upper = _upper_lut()
+    wl_arr = None
+    if whitelist is not None:
+        wl_arr = np.array(sorted(w.encode("ascii") for w in whitelist),
+                          dtype=f"S{U}")
+    sep_b = np.frombuffer(BARCODE_SEP.encode(), np.uint8)
+
+    def tok_matrix(data, starts, lens):
+        """(matrix, tok_len): name bytes up to the first whitespace."""
+        w = int(lens.max()) if len(lens) else 0
+        mat = np.zeros((len(starts), max(w, 1)), np.uint8)
+        from consensuscruncher_tpu.utils.ragged import scatter_runs
+
+        if w:
+            scatter_runs(mat.reshape(-1),
+                         np.arange(len(starts), dtype=np.int64) * mat.shape[1],
+                         data, lens, src_starts=starts)
+        ws = (mat == 32) | (mat == 9)
+        has = ws.any(axis=1)
+        tok_len = np.where(has, np.argmax(ws, axis=1), lens)
+        # zero out beyond the token so row equality == token equality
+        mat[np.arange(mat.shape[1])[None, :] >= tok_len[:, None]] = 0
+        return mat, tok_len
+
+    for c1, c2 in _batch_zipper(read1, read2):
+        d1, ns1, nl1, ss1, sl1, qs1 = c1
+        d2, ns2, nl2, ss2, sl2, qs2 = c2
+        k = len(ns1)
+        stats.incr("read_pairs", k)
+        t1, tl1 = tok_matrix(d1, ns1, nl1)
+        t2, tl2 = tok_matrix(d2, ns2, nl2)
+        wmin = min(t1.shape[1], t2.shape[1])
+        agree = (tl1 == tl2) & (t1[:, :wmin] == t2[:, :wmin]).all(axis=1)
+        if t1.shape[1] > wmin:
+            agree &= (t1[:, wmin:] == 0).all(axis=1)
+        if t2.shape[1] > wmin:
+            agree &= (t2[:, wmin:] == 0).all(axis=1)
+        if not agree.all():
+            i = int(np.argmin(agree))
+            a = bytes(t1[i, : tl1[i]]).decode("ascii", "replace")
+            b = bytes(t2[i, : tl2[i]]).decode("ascii", "replace")
+            raise ValueError(f"R1/R2 qname mismatch: {a!r} vs {b!r}")
+
+        too_short = (sl1 < P) | (sl2 < P)
+        u1 = upper[d1[np.minimum(ss1[:, None] + upos[None, :], len(d1) - 1)]]
+        u2 = upper[d2[np.minimum(ss2[:, None] + upos[None, :], len(d2) - 1)]]
+        if wl_arr is not None:
+            in1 = np.isin(np.ascontiguousarray(u1).view(f"S{U}").ravel(), wl_arr)
+            in2 = np.isin(np.ascontiguousarray(u2).view(f"S{U}").ravel(), wl_arr)
+            bad_bc = ~too_short & ~(in1 & in2)
+        else:
+            bad_bc = np.zeros(k, bool)
+        good = ~too_short & ~bad_bc
+        # guard zero increments: the object loop only creates counter keys
+        # it touches, and stats files are parity artifacts
+        for key, val in (("too_short", int(too_short.sum())),
+                         ("bad_barcode", int(bad_bc.sum())),
+                         ("extracted", int(good.sum()))):
+            if val:
+                stats.incr(key, val)
+
+        bad = ~good
+        if bad.any():
+            for (d, ns, nl, ss, sl, qs, wkey) in (
+                (d1, ns1, nl1, ss1, sl1, qs1, "r1_bad"),
+                (d2, ns2, nl2, ss2, sl2, qs2, "r2_bad"),
+            ):
+                data, off = build_strings(int(bad.sum()), [
+                    const(b"@"),
+                    ragged(d, nl[bad], starts=ns[bad]),
+                    const(b"\n"),
+                    ragged(d, sl[bad], starts=ss[bad]),
+                    const(b"\n+\n"),
+                    ragged(d, sl[bad], starts=qs[bad]),
+                    const(b"\n"),
+                ])
+                writers[wkey].write_bytes(data.tobytes())
+        if good.any():
+            g = np.nonzero(good)[0]
+            bc = np.empty((len(g), 2 * U + len(sep_b)), np.uint8)
+            bc[:, :U] = u1[g]
+            bc[:, U:U + len(sep_b)] = sep_b
+            bc[:, U + len(sep_b):] = u2[g]
+            # distribution (vectorized unique over the barcode matrix)
+            uq, counts = np.unique(
+                np.ascontiguousarray(bc).view(f"S{bc.shape[1]}").ravel(),
+                return_counts=True,
+            )
+            for ub, cnt in zip(uq, counts):
+                distribution[ub.decode("ascii")] += int(cnt)
+            for (d, ss, sl, qs, tok, tok_l, wkey) in (
+                (d1, ss1, sl1, qs1, t1, tl1, "r1"),
+                (d2, ss2, sl2, qs2, t2, tl2, "r2"),
+            ):
+                data, off = build_strings(len(g), [
+                    const(b"@"),
+                    ragged(tok.reshape(-1), tok_l[g],
+                           starts=g.astype(np.int64) * tok.shape[1]),
+                    const(bdelim.encode("ascii")),
+                    fixed(bc),
+                    const(b"\n"),
+                    ragged(d, sl[g] - P, starts=ss[g] + P),
+                    const(b"\n+\n"),
+                    ragged(d, sl[g] - P, starts=qs[g] + P),
+                    const(b"\n"),
+                ])
+                writers[wkey].write_bytes(data.tobytes())
+
+
 def run_extract(
     read1: str,
     read2: str,
@@ -86,6 +250,7 @@ def run_extract(
     bpattern: str | None = None,
     blist: str | None = None,
     bdelim: str = DEFAULT_BDELIM,
+    _force_object: bool = False,
 ) -> ExtractResult:
     if bpattern is None and blist is None:
         raise ValueError("need --bpattern and/or --blist to locate UMIs")
@@ -112,6 +277,21 @@ def run_extract(
         "r2_bad": f"{out_prefix}_r2_bad.fastq.gz",
     }
     writers = {k: FastqWriter(p) for k, p in paths.items()}
+    if not _force_object:
+        try:
+            _run_extract_vectorized(
+                read1, read2, pattern, whitelist, bdelim, stats, distribution, writers
+            )
+        finally:
+            for w in writers.values():
+                w.close()
+        with open(f"{out_prefix}.barcode_distribution.txt", "w") as fh:
+            fh.write("barcode\tcount\n")
+            for bc, count in sorted(distribution.items()):
+                fh.write(f"{bc}\t{count}\n")
+        stats.set("unique_barcodes", len(distribution))
+        stats.write(f"{out_prefix}.extract_stats.txt")
+        return ExtractResult(paths["r1"], paths["r2"], stats)
     try:
         for (n1, s1, q1), (n2, s2, q2) in zip(
             read_fastq(read1), read_fastq(read2), strict=True

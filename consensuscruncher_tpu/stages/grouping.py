@@ -1089,13 +1089,7 @@ def duplex_pair_blocks(creader, header: BamHeader) -> Iterator[PairBlock]:
                 f"{read.ref}:{read.pos} after ref_id={carry_key[0]} pos={carry_key[1]}"
             )
         # barcode matrix for the whole batch
-        wb = int(bc_len.max(initial=0))
-        cols = np.arange(wb, dtype=np.int64)
-        idx = bc_start[:, None] + cols[None, :]
-        bcm = np.where(
-            cols[None, :] < bc_len[:, None],
-            batch.buf[np.minimum(idx, len(batch.buf) - 1)], 0,
-        ).astype(np.uint8)
+        bcm = _barcode_matrix(batch.buf, bc_start, bc_len)
 
         rows = np.arange(n, dtype=np.int64)
         tail_mask = (rid == rid[-1]) & (pos == pos[-1]) if n else np.zeros(0, bool)
@@ -1333,19 +1327,25 @@ class RescueBlock:
                  "stats_singleton", "stats_remaining", "stats_mismatch")
 
 
+def _barcode_matrix(buf: np.ndarray, bc_start: np.ndarray, bc_len: np.ndarray) -> np.ndarray:
+    """``(n, max(bc_len))`` zero-padded barcode byte matrix (clamped gather)
+    — shared by the duplex-pair and rescue block builders."""
+    wb = int(bc_len.max(initial=0))
+    cols = np.arange(wb, dtype=np.int64)
+    idx = bc_start[:, None] + cols[None, :]
+    return np.where(
+        cols[None, :] < bc_len[:, None],
+        buf[np.minimum(idx, len(buf) - 1)], 0,
+    ).astype(np.uint8)
+
+
 def _rescue_src_prep(batch) -> tuple:
     """(rows, bcm, bclen, xf) of the XT/XF-parsed rows of a batch."""
     ok, bc_start, bc_len, xf = _parse_xt_xf(batch)
     if not ok.all():
         raise ValueError("foreign tag layout (no XT/XF prefix)")
     n = batch.n
-    wb = int(bc_len.max(initial=0))
-    cols = np.arange(wb, dtype=np.int64)
-    idx = bc_start[:, None] + cols[None, :]
-    bcm = np.where(
-        cols[None, :] < bc_len[:, None],
-        batch.buf[np.minimum(idx, len(batch.buf) - 1)], 0,
-    ).astype(np.uint8)
+    bcm = _barcode_matrix(batch.buf, bc_start, bc_len)
     return np.arange(n, dtype=np.int64), bcm, bc_len.astype(np.int64), xf.astype(np.int64)
 
 
@@ -1354,17 +1354,20 @@ def singleton_rescue_blocks(s_creader, x_creader, header: BamHeader) -> Iterator
     SSCS BAM (``x``), pulling batches from both in coordinate lockstep so
     every (ref, pos) anchor is complete within one block."""
     def batches_with_meta(creader, srctype):
+        prev_key = None
         for batch in creader.batches():
             rid, pos = batch.ref_id, batch.pos
             if batch.n:
                 sorted_ok = (rid[1:] > rid[:-1]) | ((rid[1:] == rid[:-1]) & (pos[1:] >= pos[:-1]))
-                if not sorted_ok.all():
-                    i = int(np.argmin(sorted_ok)) + 1
+                first_key = (int(rid[0]), int(pos[0]))
+                if not sorted_ok.all() or (prev_key is not None and first_key < prev_key):
+                    i = int(np.argmin(sorted_ok)) + 1 if not sorted_ok.all() else 0
                     read = batch.materialize(i)
                     raise NotCoordinateSorted(
                         f"input BAM is not coordinate-sorted: {read.qname} at "
                         f"{read.ref}:{read.pos}"
                     )
+                prev_key = (int(rid[-1]), int(pos[-1]))
             yield srctype, batch
 
     streams = [batches_with_meta(s_creader, 1), batches_with_meta(x_creader, 0)]

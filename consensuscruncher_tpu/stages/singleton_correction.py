@@ -218,9 +218,13 @@ def _run_rescue_blocks(singleton_bam, sscs_bam, writers, stats, backend) -> None
                 for L in np.unique(lseqc[rmask]):
                     L = int(L)
                     sel = rmask & (lseqc == L)
+                    from consensuscruncher_tpu.core.consensus_cpu import DEFAULT_QUAL_CAP
+
                     s1m, q1m = member_mat(blk.rescue_src, blk.rescue_row, sel, L)
                     s2m, q2m = member_mat(blk.partner_src, blk.partner_row, sel, L)
-                    out_b, out_q = _duplex_vote_batch(s1m, q1m, s2m, q2m, 60, backend)
+                    out_b, out_q = _duplex_vote_batch(
+                        s1m, q1m, s2m, q2m, DEFAULT_QUAL_CAP, backend
+                    )
                     ps = np.nonzero(sel)[0]
                     kk = len(ps)
                     # original qname / cigar / tag bytes, gathered per source

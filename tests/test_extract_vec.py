@@ -1,0 +1,143 @@
+"""Vectorized extract_barcodes vs the object loop (byte parity)."""
+
+import gzip
+import hashlib
+
+import numpy as np
+import pytest
+
+from consensuscruncher_tpu.stages.extract_barcodes import run_extract
+
+
+def _write_fq(path, recs):
+    with gzip.GzipFile(path, "wb", mtime=0) as fh:
+        for name, seq, qual in recs:
+            fh.write(f"@{name}\n{seq}\n+\n{qual}\n".encode())
+
+
+def _digest_all(prefix):
+    """Content digests: .gz files digest DECOMPRESSED (the gzip FNAME header
+    embeds the output filename, which differs between the two runs)."""
+    out = {}
+    for suffix in ("_r1.fastq.gz", "_r2.fastq.gz", "_r1_bad.fastq.gz",
+                   "_r2_bad.fastq.gz", ".barcode_distribution.txt",
+                   ".extract_stats.txt"):
+        p = f"{prefix}{suffix}"
+        raw = gzip.open(p, "rb").read() if p.endswith(".gz") else open(p, "rb").read()
+        out[suffix] = hashlib.sha256(raw).hexdigest()
+    return out
+
+
+def _mkrecs(rng, n, read_len=40, umi=3, spacer="T", with_comment=True,
+            short_every=0, lower_every=0):
+    r1, r2 = [], []
+    bases = "ACGT"
+    for i in range(n):
+        u1 = "".join(bases[j] for j in rng.integers(0, 4, umi))
+        u2 = "".join(bases[j] for j in rng.integers(0, 4, umi))
+        body1 = "".join(bases[j] for j in rng.integers(0, 4, read_len))
+        body2 = "".join(bases[j] for j in rng.integers(0, 4, read_len))
+        if lower_every and i % lower_every == 0:
+            u1 = u1.lower()
+        s1 = u1 + spacer + body1
+        s2 = u2 + spacer + body2
+        if short_every and i % short_every == 0:
+            s1 = s1[: umi - 1]
+        q1 = "".join(chr(33 + int(x)) for x in rng.integers(2, 40, len(s1)))
+        q2 = "".join(chr(33 + int(x)) for x in rng.integers(2, 40, len(s2)))
+        name = f"inst:1:{i}:xy"
+        if with_comment and i % 2 == 0:
+            r1.append((f"{name} 1:N:0:GAT", s1, q1))
+            r2.append((f"{name} 2:N:0:GAT", s2, q2))
+        else:
+            r1.append((name, s1, q1))
+            r2.append((name, s2, q2))
+    return r1, r2
+
+
+def _compare(tmp_path, r1recs, r2recs, **kw):
+    f1, f2 = str(tmp_path / "r1.fq.gz"), str(tmp_path / "r2.fq.gz")
+    _write_fq(f1, r1recs)
+    _write_fq(f2, r2recs)
+    pv = str(tmp_path / "vec")
+    po = str(tmp_path / "obj")
+    rv = run_extract(f1, f2, pv, **kw)
+    ro = run_extract(f1, f2, po, _force_object=True, **kw)
+    assert _digest_all(pv) == _digest_all(po)
+    assert dict(rv.stats._items) == dict(ro.stats._items)
+    return rv
+
+
+def test_parity_pattern(tmp_path):
+    rng = np.random.default_rng(0)
+    r1, r2 = _mkrecs(rng, 300, short_every=37, lower_every=23)
+    rv = _compare(tmp_path, r1, r2, bpattern="NNNT")
+    assert rv.stats.get("extracted") > 200
+    assert rv.stats.get("too_short") > 0
+
+
+def test_parity_whitelist(tmp_path):
+    rng = np.random.default_rng(1)
+    r1, r2 = _mkrecs(rng, 400, umi=2, spacer="")
+    wl = tmp_path / "wl.txt"
+    wl.write_text("AA\nAC\nGT\nTg\n\n")
+    rv = _compare(tmp_path, r1, r2, bpattern="NN", blist=str(wl))
+    assert rv.stats.get("bad_barcode") > 0
+    assert rv.stats.get("extracted") > 0
+
+
+def test_parity_blist_only(tmp_path):
+    rng = np.random.default_rng(2)
+    r1, r2 = _mkrecs(rng, 150, umi=3, spacer="")
+    wl = tmp_path / "wl.txt"
+    # all 64 3-mers: everything passes, length from the list
+    import itertools
+    wl.write_text("\n".join("".join(t) for t in itertools.product("ACGT", repeat=3)))
+    rv = _compare(tmp_path, r1, r2, blist=str(wl))
+    assert rv.stats.get("extracted") == 150
+
+
+def test_qname_mismatch_raises(tmp_path):
+    r1 = [("a", "ACGTACGT", "IIIIIIII")]
+    r2 = [("b", "ACGTACGT", "IIIIIIII")]
+    f1, f2 = str(tmp_path / "r1.fq.gz"), str(tmp_path / "r2.fq.gz")
+    _write_fq(f1, r1)
+    _write_fq(f2, r2)
+    with pytest.raises(ValueError, match="qname mismatch"):
+        run_extract(f1, f2, str(tmp_path / "o"), bpattern="NN")
+
+
+def test_count_mismatch_raises(tmp_path):
+    r1 = [("a", "ACGTACGT", "IIIIIIII"), ("b", "ACGTACGT", "IIIIIIII")]
+    f1, f2 = str(tmp_path / "r1.fq.gz"), str(tmp_path / "r2.fq.gz")
+    _write_fq(f1, r1)
+    _write_fq(f2, r1[:1])
+    with pytest.raises(ValueError):
+        run_extract(f1, f2, str(tmp_path / "o"), bpattern="NN")
+
+
+def test_batch_reader_roundtrip(tmp_path):
+    from consensuscruncher_tpu.io.fastq import read_fastq, read_fastq_batches
+
+    rng = np.random.default_rng(5)
+    r1, _ = _mkrecs(rng, 200, read_len=30)
+    f1 = str(tmp_path / "x.fq.gz")
+    _write_fq(f1, r1)
+    objs = list(read_fastq(f1))
+    recs = []
+    for b in read_fastq_batches(f1, chunk_bytes=1024):  # force many chunks
+        for i in range(b.n):
+            name = bytes(b.data[b.name_start[i]:b.name_start[i] + b.name_len[i]]).decode()
+            seq = bytes(b.data[b.seq_start[i]:b.seq_start[i] + b.seq_len[i]]).decode()
+            qual = bytes(b.data[b.qual_start[i]:b.qual_start[i] + b.seq_len[i]]).decode()
+            recs.append((name, seq, qual))
+    assert recs == objs
+
+
+def test_batch_reader_no_trailing_newline(tmp_path):
+    f = str(tmp_path / "x.fq")
+    open(f, "w").write("@a\nACGT\n+\nIIII")  # no final newline
+    from consensuscruncher_tpu.io.fastq import read_fastq_batches
+
+    batches = list(read_fastq_batches(f))
+    assert sum(b.n for b in batches) == 1
