@@ -192,6 +192,10 @@ def _consensus_impl(args) -> dict:
     from consensuscruncher_tpu.utils.backend_probe import ensure_backend
 
     ensure_backend(args.backend)
+    if args.backend == "xla_cpu":
+        # platform pinned by ensure_backend; the stages' device path is the
+        # same jitted program either way
+        args.backend = "tpu"
 
     name = args.name or os.path.basename(args.input).split(".")[0]
     base = os.path.join(args.output, name)
@@ -390,7 +394,10 @@ def build_parser() -> argparse.ArgumentParser:
     c.add_argument("--scorrect", help="singleton correction on/off")
     c.add_argument("--max_mismatch", type=int,
                    help="barcode Hamming tolerance for singleton rescue")
-    c.add_argument("--backend", choices=("cpu", "tpu"))
+    c.add_argument("--backend", choices=("cpu", "tpu", "xla_cpu"),
+                   help="tpu = device kernels; xla_cpu = the same jitted "
+                        "kernels pinned to the CPU platform (sick-tunnel "
+                        "fallback); cpu = pure-numpy reference path")
     c.add_argument("--bdelim")
     c.add_argument("--cleanup", help="remove intermediate BAMs")
     c.add_argument("--resume", help="skip stages whose manifest-recorded outputs are intact")

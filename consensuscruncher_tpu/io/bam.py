@@ -580,19 +580,24 @@ def merge_bams(in_paths: list, out_path) -> None:
             )
     from consensuscruncher_tpu.io.columnar import ColumnarReader, SortingBamWriter
 
-    total_compressed = sum(os.path.getsize(p) for p in in_paths)
+    # Bound on ACTUAL raw bytes while reading (compressed size is no proxy —
+    # low-complexity reads expand 10-30x); past the writer's buffer the
+    # in-memory path would spill-and-resort already-sorted data, so switch
+    # to the O(k)-memory streaming heap merge instead.
     writer = SortingBamWriter(os.fspath(out_path), headers[0])
-    # ~4x is a conservative BAM BGZF expansion estimate; beyond the buffer
-    # the writer would spill-and-resort, so stream-merge instead
-    if total_compressed * 4 > writer._max_raw:
-        writer.abort()
-        _merge_paths([os.fspath(p) for p in in_paths], out_path, headers[0])
-        return
+    raw = 0
     try:
         for p in in_paths:
             with ColumnarReader(p) as reader:
                 for b in reader.batches():
-                    writer.write_encoded(b.buf[: int(b.rec_off[-1])])
+                    blob = b.buf[: int(b.rec_off[-1])]
+                    raw += blob.size
+                    if raw > writer._max_raw:
+                        writer.abort()
+                        _merge_paths([os.fspath(p) for p in in_paths],
+                                     out_path, headers[0])
+                        return
+                    writer.write_encoded(blob)
     except BaseException:
         writer.abort()
         raise

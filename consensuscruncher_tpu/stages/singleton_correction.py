@@ -127,6 +127,7 @@ def _run_rescue_blocks(singleton_bam, sscs_bam, writers, stats, backend) -> None
     Contract: consumes this pipeline's own SSCS-stage outputs (XT/XF-led tag
     blocks, no preexisting XR tag) — foreign layouts raise and the caller
     falls back to the object walk."""
+    from consensuscruncher_tpu.core.consensus_cpu import DEFAULT_QUAL_CAP
     from consensuscruncher_tpu.io.columnar import ColumnarReader
     from consensuscruncher_tpu.io.encode import encode_records
     from consensuscruncher_tpu.stages.dcs_maker import _duplex_vote_batch
@@ -218,8 +219,6 @@ def _run_rescue_blocks(singleton_bam, sscs_bam, writers, stats, backend) -> None
                 for L in np.unique(lseqc[rmask]):
                     L = int(L)
                     sel = rmask & (lseqc == L)
-                    from consensuscruncher_tpu.core.consensus_cpu import DEFAULT_QUAL_CAP
-
                     s1m, q1m = member_mat(blk.rescue_src, blk.rescue_row, sel, L)
                     s2m, q2m = member_mat(blk.partner_src, blk.partner_row, sel, L)
                     out_b, out_q = _duplex_vote_batch(

@@ -30,17 +30,41 @@ def _default_timeout_s() -> float:
         return 120.0
 
 
+def force_cpu_platform() -> None:
+    """Pin this process's JAX to the XLA-CPU backend BEFORE any device
+    touch: env + config + dropping the axon PJRT factory (whose init hangs
+    indefinitely on a dead tunnel — an env var alone does not stop its
+    registration hooks).  Same dance as bench.py's workers and
+    tests/conftest.py."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    try:  # private API, but the only way to unregister a sick PJRT plugin
+        import jax._src.xla_bridge as _xb
+
+        _xb._backend_factories.pop("axon", None)
+    except Exception:
+        pass
+
+
 def ensure_backend(backend: str, timeout_s: float | None = None) -> None:
     """Initialize the device backend now, bounded by a watchdog.
 
     No-op for ``backend="cpu"``/``"reference"`` (pure numpy paths — nothing
-    to probe).  For ``"tpu"``, touches ``jax.devices()`` under a timer:
+    to probe).  ``backend="xla_cpu"`` pins the process to the XLA-CPU
+    platform (the production jitted kernels, CPU silicon — the sick-tunnel
+    fallback) and returns.  For ``"tpu"``, touches ``jax.devices()`` under
+    a timer:
 
     - init hangs  -> message + ``os._exit(3)`` (only way out of a hung
       C-extension call; Python exceptions can't interrupt it)
     - init raises -> ``SystemExit`` with the cause and the workaround
     - init works  -> returns; the warmed backend is reused by the stages
     """
+    if backend == "xla_cpu":
+        force_cpu_platform()
+        return
     if backend != "tpu":
         return
     if timeout_s is None:
@@ -52,7 +76,9 @@ def ensure_backend(backend: str, timeout_s: float | None = None) -> None:
             print(
                 f"ERROR: TPU backend init did not complete within {timeout_s:.0f}s — "
                 "the TPU (or its tunnel) looks unavailable.\n"
-                "  workaround: re-run with --backend cpu\n"
+                "  workarounds: --backend xla_cpu (same jitted kernels, CPU "
+                "silicon)\n"
+                "               --backend cpu (pure-numpy reference path)\n"
                 "  or wait longer: CCT_TPU_INIT_TIMEOUT=<seconds>",
                 file=sys.stderr,
                 flush=True,
