@@ -194,7 +194,15 @@ def _consensus_impl(args) -> dict:
     ensure_backend(args.backend)
     if args.backend == "xla_cpu":
         # platform pinned by ensure_backend; the stages' device path is the
-        # same jitted program either way
+        # same jitted program either way.  Never silent: stats files will
+        # say backend=tpu, so put the real silicon on record here.
+        print(
+            "NOTE: --backend xla_cpu — the jitted device kernels run on the "
+            "XLA-CPU platform; stage stats will record backend=tpu (the code "
+            "path), not the silicon",
+            file=sys.stderr,
+            flush=True,
+        )
         args.backend = "tpu"
 
     name = args.name or os.path.basename(args.input).split(".")[0]

@@ -585,6 +585,13 @@ def merge_bams(in_paths: list, out_path) -> None:
     # in-memory path would spill-and-resort already-sorted data, so switch
     # to the O(k)-memory streaming heap merge instead.
     writer = SortingBamWriter(os.fspath(out_path), headers[0])
+    # guaranteed-safe precheck: BGZF-compressed size is a lower bound on raw
+    # size, so inputs already past the buffer can skip straight to the
+    # streaming merge without buffering-then-discarding
+    if sum(os.path.getsize(os.fspath(p)) for p in in_paths) > writer._max_raw:
+        writer.abort()
+        _merge_paths([os.fspath(p) for p in in_paths], out_path, headers[0])
+        return
     raw = 0
     try:
         for p in in_paths:
