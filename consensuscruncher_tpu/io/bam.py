@@ -585,9 +585,11 @@ def merge_bams(in_paths: list, out_path) -> None:
     # in-memory path would spill-and-resort already-sorted data, so switch
     # to the O(k)-memory streaming heap merge instead.
     writer = SortingBamWriter(os.fspath(out_path), headers[0])
-    # guaranteed-safe precheck: BGZF-compressed size is a lower bound on raw
-    # size, so inputs already past the buffer can skip straight to the
-    # streaming merge without buffering-then-discarding
+    # cheap precheck: genomic BAMs virtually never expand (BGZF framing can
+    # exceed raw size only for incompressible records), so compressed-total >
+    # buffer means the in-memory path would all but certainly spill —
+    # skip straight to the streaming merge; the in-loop raw-bytes bound
+    # below remains the authoritative guard either way
     if sum(os.path.getsize(os.fspath(p)) for p in in_paths) > writer._max_raw:
         writer.abort()
         _merge_paths([os.fspath(p) for p in in_paths], out_path, headers[0])

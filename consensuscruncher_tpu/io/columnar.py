@@ -76,6 +76,10 @@ def _qname_key_matrix(buf: np.ndarray, starts: np.ndarray, lens: np.ndarray) -> 
 
 def _gather_view(buf: np.ndarray, off: np.ndarray, width: int, dtype: str) -> np.ndarray:
     """Vectorized unaligned little-endian field gather at ``off`` (n,)."""
+    from consensuscruncher_tpu.io import native
+
+    if native.available():
+        return native.gather_fixed(buf, off, width).view(dtype).ravel()
     raw = buf[off[:, None] + np.arange(width, dtype=np.int64)]
     return np.ascontiguousarray(raw).view(dtype).ravel()
 
@@ -169,6 +173,10 @@ class ColumnarBatch:
         # odd length falls back to the per-nibble form.
         if not (l & 1).any():
             data, _ = ragged_gather(self.buf, self.seq_start, l >> 1)
+            from consensuscruncher_tpu.io import native
+
+            if native.available():
+                return native.expand_nibbles(data, NIB2CODE_PAIR), off
             return NIB2CODE_PAIR[data].reshape(-1), off
         rel = np.arange(total, dtype=np.int64) - np.repeat(off[:-1], l)
         byte_idx = np.repeat(self.seq_start, l) + rel // 2

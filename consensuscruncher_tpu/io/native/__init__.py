@@ -25,7 +25,7 @@ import threading
 
 import numpy as np
 
-_ABI_VERSION = 6
+_ABI_VERSION = 7
 _SRC = os.path.join(os.path.dirname(__file__), "bgzf_native.cpp")
 
 _lock = threading.Lock()
@@ -98,6 +98,15 @@ def _build_and_load() -> ctypes.CDLL | None:
     lib.cct_scan_bam_records.restype = ctypes.c_int64
     lib.cct_scan_bam_records.argtypes = [
         ctypes.c_char_p, ctypes.c_int64, ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+    ]
+    lib.cct_expand_nibbles.restype = None
+    lib.cct_expand_nibbles.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64, ctypes.c_char_p, ctypes.c_char_p,
+    ]
+    lib.cct_gather_fixed.restype = None
+    lib.cct_gather_fixed.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+        ctypes.c_int32, ctypes.c_char_p,
     ]
     lib.cct_copy_runs.restype = None
     lib.cct_copy_runs.argtypes = [
@@ -263,6 +272,42 @@ def scan_bam_records(chunk, limit: int) -> np.ndarray:
     if n < 0:
         raise ValueError("corrupt BAM record: block_size < 32")
     return out[: n + 1]
+
+
+def expand_nibbles(src: np.ndarray, lut2: np.ndarray) -> np.ndarray:
+    """Expand each byte of ``src`` into two bytes via a ``(256, 2)`` LUT
+    (the BAM seq nibble decode).  Returns a ``(2 * len(src),)`` array."""
+    lib = _get()
+    if lib is None:
+        raise RuntimeError("native codec unavailable")
+    src = np.ascontiguousarray(src, dtype=np.uint8)
+    lut2 = np.ascontiguousarray(lut2, dtype=np.uint8)
+    if lut2.size != 512:
+        raise ValueError("lut2 must be (256, 2) bytes")
+    out = np.empty(2 * src.size, dtype=np.uint8)
+    lib.cct_expand_nibbles(
+        src.ctypes.data_as(ctypes.c_char_p), src.size,
+        lut2.ctypes.data_as(ctypes.c_char_p), out.ctypes.data_as(ctypes.c_char_p),
+    )
+    return out
+
+
+def gather_fixed(src: np.ndarray, off: np.ndarray, width: int) -> np.ndarray:
+    """``(n, width)`` byte gather at arbitrary offsets (bounds-checked)."""
+    lib = _get()
+    if lib is None:
+        raise RuntimeError("native codec unavailable")
+    src = np.ascontiguousarray(src, dtype=np.uint8)
+    off = np.ascontiguousarray(off, dtype=np.int64)
+    n = off.size
+    if n and (int(off.min()) < 0 or int(off.max()) + width > src.size):
+        raise ValueError("gather_fixed: offset out of bounds")
+    out = np.empty(n * width, dtype=np.uint8)
+    lib.cct_gather_fixed(
+        src.ctypes.data_as(ctypes.c_char_p), _i64_ptr(off), n, int(width),
+        out.ctypes.data_as(ctypes.c_char_p),
+    )
+    return out.reshape(n, width)
 
 
 def pack_wire(bases: np.ndarray, quals: np.ndarray, lut: np.ndarray, four_bit: bool) -> np.ndarray:

@@ -27,7 +27,7 @@
 
 namespace {
 
-constexpr int kAbiVersion = 6;
+constexpr int kAbiVersion = 7;
 constexpr uint32_t kMaxBlockPayload = 0xFF00;  // htslib payload bound
 constexpr uint32_t kOutStride = 0x10400;       // per-block output slot (worst case + slack)
 
@@ -262,6 +262,29 @@ int64_t cct_scan_bam_records(const uint8_t* buf, int64_t limit, int64_t* out,
     if (n < max_out) out[n] = o;
   }
   return n;
+}
+
+// Expand packed BAM seq bytes (two 4-bit nibbles each) through a
+// (256 x 2)-byte LUT: out[2i] = lut[2*src[i]], out[2i+1] = lut[2*src[i]+1].
+// The columnar reader's nibble->base-code decode, one pass in C.
+void cct_expand_nibbles(const uint8_t* src, int64_t n, const uint8_t* lut2,
+                        uint8_t* out) {
+  for (int64_t i = 0; i < n; ++i) {
+    const uint8_t b = src[i];
+    out[2 * i] = lut2[2 * b];
+    out[2 * i + 1] = lut2[2 * b + 1];
+  }
+}
+
+// Gather fixed-width little-endian fields at arbitrary byte offsets:
+// out[i*width : (i+1)*width] = src[off[i] : off[i]+width].  The columnar
+// reader's per-record header-field decode (width 2/4).
+void cct_gather_fixed(const uint8_t* src, const int64_t* off, int64_t n,
+                      int32_t width, uint8_t* out) {
+  for (int64_t i = 0; i < n; ++i) {
+    std::memcpy(out + static_cast<int64_t>(i) * width, src + off[i],
+                static_cast<size_t>(width));
+  }
 }
 
 // Byte-value histogram (256 bins) — the one-pass replacement for

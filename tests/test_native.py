@@ -139,3 +139,45 @@ def test_bam_round_trip_through_native(tmp_path, monkeypatch):
     with BamReader(path) as rd:
         back = list(rd)
     assert back == reads
+
+
+def test_gather_fixed_and_expand_nibbles_parity():
+    """Native columnar decode kernels vs the numpy fallbacks (toggled via
+    CCT_NO_NATIVE, the established pack4-parity pattern)."""
+    import os
+
+    from consensuscruncher_tpu.io import native
+    from consensuscruncher_tpu.io import columnar as col
+
+    if not native.available():
+        pytest.skip("native codec unavailable")
+    rng = np.random.default_rng(21)
+    buf = rng.integers(0, 256, 5000).astype(np.uint8)
+    off = rng.integers(0, len(buf) - 8, 300).astype(np.int64)
+
+    def both(fn):
+        a = fn()
+        os.environ["CCT_NO_NATIVE"] = "1"
+        native._tried = False
+        native._lib = None
+        try:
+            b = fn()
+        finally:
+            del os.environ["CCT_NO_NATIVE"]
+            native._tried = False
+            native._lib = None
+        return a, b
+
+    for width, dt in ((2, "<u2"), (4, "<i4")):
+        a, b = both(lambda: col._gather_view(buf, off, width, dt))
+        np.testing.assert_array_equal(a, b)
+
+    data = rng.integers(0, 256, 4096).astype(np.uint8)
+    a, b = both(
+        lambda: (
+            native.expand_nibbles(data, col.NIB2CODE_PAIR)
+            if native.available()
+            else col.NIB2CODE_PAIR[data].reshape(-1)
+        )
+    )
+    np.testing.assert_array_equal(a, b)
