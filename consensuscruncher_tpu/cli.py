@@ -194,12 +194,12 @@ def _consensus_impl(args) -> dict:
     ensure_backend(args.backend)
     if args.backend == "xla_cpu":
         # platform pinned by ensure_backend; the stages' device path is the
-        # same jitted program either way.  Never silent: stats files will
-        # say backend=tpu, so put the real silicon on record here.
+        # same jitted program either way.  Stage stats record both keys:
+        # backend=tpu (the code path) and jax_backend=cpu (the silicon).
         print(
             "NOTE: --backend xla_cpu — the jitted device kernels run on the "
-            "XLA-CPU platform; stage stats will record backend=tpu (the code "
-            "path), not the silicon",
+            "XLA-CPU platform; stage stats will record backend=tpu (code "
+            "path) with jax_backend=cpu (actual silicon)",
             file=sys.stderr,
             flush=True,
         )

@@ -35,6 +35,7 @@ from consensuscruncher_tpu.core.duplex_cpu import duplex_consensus
 from consensuscruncher_tpu.io.bam import BamWriter
 from consensuscruncher_tpu.io.encode import ConsensusRecordWriter
 from consensuscruncher_tpu.ops.duplex_tpu import duplex_batch_host
+from consensuscruncher_tpu.utils.backend_probe import record_backend
 from consensuscruncher_tpu.utils.stats import StageStats
 
 
@@ -405,7 +406,7 @@ def run_dcs(
 
     dcs_writer.close()
     unpaired_writer.close()
-    stats.set("backend", backend)
+    record_backend(stats, backend)
     stats.write(paths["stats_txt"])
     return DcsResult(dcs_path, unpaired_path, stats)
 

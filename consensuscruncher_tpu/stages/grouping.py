@@ -12,6 +12,22 @@ Read filtering (pinned; reference routes these to a "badRead" BAM):
 unmapped, mate-unmapped, secondary, supplementary, QC-fail reads, and reads
 whose qname carries no barcode delimiter.  Duplicate-flagged reads are kept —
 UMI consensus is itself the deduplicator.
+
+MAINTENANCE MAP — this module holds semantic twins of the same grouping
+rules at three altitudes; a semantic change must land in ALL of them (the
+byte-parity suite will catch a miss, this note is so you change them on
+purpose, not by accident):
+
+1. OBJECT PATH (``stream_families``, ``consensus_windows``) —
+   **reference-only fence: do not optimize.**  Survives as the honest
+   bench.py baseline denominator and the readable statement of the rules;
+   perf work here is wasted (the production pipeline never runs it) and
+   only risks parity drift.
+2. COLUMNAR PER-FAMILY PATH (``stream_families_columnar``,
+   ``consensus_windows_columnar``) — batch decode, per-family emission;
+   used by the cpu backend and the dense wire.
+3. BLOCK PATH (``stream_family_blocks`` / ``duplex_pair_blocks`` /
+   ``singleton_rescue_blocks``) — the production vectorized producers.
 """
 
 from __future__ import annotations
@@ -53,6 +69,10 @@ def derive_tag(read):
 
 def consensus_windows(reader):
     """Group a coordinate-sorted consensus BAM into per-(ref,pos) windows.
+
+    OBJECT PATH — reference-only fence (see module docstring): the readable
+    statement of the windowing rule and the fallback for foreign tag
+    layouts; do not optimize.
 
     Yields ``(key, {FamilyTag: read})`` with ``key = (ref_id, pos)``.  Shared
     by the DCS and singleton-correction stages (their pairing partners always
@@ -104,6 +124,9 @@ def stream_families(
     bdelim: str = tags_mod.DEFAULT_BDELIM,
 ) -> Iterator[tuple[str, object, object]]:
     """Yield ``("bad", read, reason)`` and ``("family", tag, [reads])`` events.
+
+    OBJECT PATH — reference-only fence (see module docstring): this is the
+    bench.py baseline denominator's grouping walk; do not optimize.
 
     Families are emitted as soon as the sorted stream passes their anchor
     position (deterministic order: by position, then tag string).  Raises

@@ -37,6 +37,7 @@ from consensuscruncher_tpu.core.duplex_cpu import correct_singleton
 from consensuscruncher_tpu.io.bam import BamReader, BamRead
 from consensuscruncher_tpu.ops.singleton_tpu import best_matches
 from consensuscruncher_tpu.stages.grouping import consensus_windows
+from consensuscruncher_tpu.utils.backend_probe import record_backend
 from consensuscruncher_tpu.utils.phred import decode_seq, encode_seq
 from consensuscruncher_tpu.utils.stats import StageStats
 
@@ -337,6 +338,7 @@ def run_singleton_correction(
             for w in writers.values():
                 w.close()
             stats.set("max_mismatch", max_mismatch)
+            record_backend(stats, backend)
             stats.write(all_paths["stats_txt"])
             return SingletonResult(
                 paths["sscs_rescue"], paths["singleton_rescue"],
@@ -405,6 +407,7 @@ def run_singleton_correction(
     for w in writers.values():
         w.close()  # lexsort + final BGZF write happen here
     stats.set("max_mismatch", max_mismatch)
+    record_backend(stats, backend)
     stats.write(all_paths["stats_txt"])
     return SingletonResult(paths["sscs_rescue"], paths["singleton_rescue"], paths["remaining"], stats)
 

@@ -47,6 +47,7 @@ from consensuscruncher_tpu.stages.grouping import MemberView
 from consensuscruncher_tpu.ops.consensus_tpu import ConsensusConfig, consensus_families
 from consensuscruncher_tpu.parallel.batching import rectangularize
 from consensuscruncher_tpu.stages.grouping import stream_families
+from consensuscruncher_tpu.utils.backend_probe import record_backend
 from consensuscruncher_tpu.utils.profiling import write_metrics
 from consensuscruncher_tpu.utils.stats import FamilySizeHistogram, StageStats, TimeTracker
 
@@ -383,14 +384,16 @@ def run_sscs(
     singleton_writer.close()
     tracker.mark("sort")
 
-    stats.set("backend", backend)
+    record_backend(stats, backend)
+    jax_backend = stats.get("jax_backend")
     stats.set("cutoff", cutoff)
     stats.write(paths["stats_txt"])
     hist.write(paths["families"])
     tracker.write(paths["time_tracker"])
     write_metrics(
         f"{out_prefix}.metrics.json", "SSCS", tracker.as_phases(),
-        {"backend": backend, "n_families": stats.get("families"),
+        {"backend": backend, "jax_backend": jax_backend,
+         "n_families": stats.get("families"),
          "n_reads": stats.get("total_reads")},
     )
     return SscsResult(sscs_path, singleton_path, bad_path, stats, hist)
