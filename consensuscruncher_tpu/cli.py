@@ -291,7 +291,8 @@ def _consensus_impl(args) -> dict:
         [dcs_input],
         list(dcs_paths.values()),
         {},
-        run=lambda: run_dcs(dcs_input, dcs_prefix, backend=args.backend),
+        run=lambda: run_dcs(dcs_input, dcs_prefix, backend=args.backend,
+                            devices=args.devices),
         rebuild=lambda: DcsResult.from_prefix(dcs_prefix),
     )
     stats_jsons.append(dcs_paths["stats_json"])

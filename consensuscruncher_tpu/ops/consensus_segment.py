@@ -374,17 +374,18 @@ def run_duplex_pipelined(rows, qrows, sizes_a, sizes_b, codebook4,
 # wall-clock; this path ships each member base+qual in 0.5-1 byte with no
 # family padding instead of 2 bytes at ~4x padding redundancy.
 
-@lru_cache(maxsize=None)
-def _compiled_stream_vote(wire: str, num, den, qual_threshold, qual_cap,
-                          member_cap: int | None, out_len: int | None = None):
-    """Jitted wire-decode + vote: (a, b, sizes) -> (NF, L) consensus pair.
+def _stream_vote_fn(wire: str, num, den, qual_threshold, qual_cap,
+                    member_cap: int | None, out_len: int | None = None):
+    """Un-jitted wire-decode + vote program: (a, b, sizes) -> stacked
+    (2, NF, L) consensus planes.
 
     ``(a, b)`` by wire mode — raw: (bases, quals) both (M, L); pack8:
     (packed (M, L), 16-entry codebook); pack4: (packed (M, L/2), 4-entry
-    codebook).  Shapes specialize inside jit's own cache; the lru key is
-    only the semantics + wire + gather capacity.  ``out_len`` (static)
-    truncates the output planes to the batch's true max consensus length
-    before the d2h transfer (the length bucket can be up to 31 cols wider).
+    codebook).  The single program behind BOTH the single-device jitted
+    step (:func:`_compiled_stream_vote`) and the family-sharded mesh step
+    (``parallel.mesh`` wraps it in ``shard_map``, where ``sizes.shape[0]``
+    and the member axis are the per-shard locals — the vote is per-family,
+    so sharding whole families needs no collective at all).
     """
 
     def fn(a, b, sizes):
@@ -424,7 +425,17 @@ def _compiled_stream_vote(wire: str, num, den, qual_threshold, qual_cap,
         out = jnp.stack([out_b, out_q])
         return out if out_len is None else out[:, :, :out_len]
 
-    return jax.jit(fn)
+    return fn
+
+
+@lru_cache(maxsize=None)
+def _compiled_stream_vote(wire: str, num, den, qual_threshold, qual_cap,
+                          member_cap: int | None, out_len: int | None = None):
+    """Jitted single-device :func:`_stream_vote_fn`.  Shapes specialize
+    inside jit's own cache; the lru key is only the semantics + wire +
+    gather capacity + d2h slice length."""
+    return jax.jit(_stream_vote_fn(wire, num, den, qual_threshold, qual_cap,
+                                   member_cap, out_len))
 
 
 def encode_member_batch(batch):
@@ -489,7 +500,8 @@ def encode_member_batch(batch):
 
 
 def _run_member_batch_stream(batches, config: ConsensusConfig,
-                             prefetch_depth: int | None, batched: bool = False):
+                             prefetch_depth: int | None, batched: bool = False,
+                             mesh=None):
     """Shared streaming harness: MemberBatch iterable -> consensus results.
 
     Wire-encodes each batch on the prefetch producer thread, keeps one batch
@@ -500,6 +512,10 @@ def _run_member_batch_stream(batches, config: ConsensusConfig,
     by ``lengths`` themselves, saving the per-family Python loop).  The
     single owner of the prefetch lifecycle / close-ordering / d2h
     conventions for both the per-family and the block producers.
+
+    ``mesh``: a ``jax.sharding.Mesh`` to family-shard each batch over
+    (``parallel.mesh`` stream sharding — same wire bytes, whole families
+    per device, no collectives); None = single device.
     """
     from consensuscruncher_tpu.parallel.prefetch import DEFAULT_DEPTH, pipelined, prefetch
 
@@ -519,12 +535,24 @@ def _run_member_batch_stream(batches, config: ConsensusConfig,
         # bounded (<=4 per 32-wide length bucket, not 32)
         out_len = int(batch.lengths.max(initial=0))
         out_len = -(-out_len // 8) * 8 or None
+        if mesh is not None:
+            from consensuscruncher_tpu.parallel.mesh import stream_vote_sharded
+
+            return stream_vote_sharded(mesh, wire, a, b, batch.sizes, num, den,
+                                       qt, qc, member_cap, out_len)
         fn = _compiled_stream_vote(wire, num, den, qt, qc, member_cap, out_len)
         return fn(a, b, batch.sizes)
 
     def fetch(item, handle):
         batch = item[0]
         out = np.asarray(handle)
+        if mesh is not None:
+            from consensuscruncher_tpu.parallel.mesh import plan_member_shards
+
+            # same pure-function plan the dispatch side derived; rows come
+            # back in per-device blocks, reorder to original slot order
+            order = plan_member_shards(batch.sizes, mesh.devices.size).order()
+            out = out[:, order]
         out_b, out_q = out[0], out[1]
         if batched:
             n = batch.n_real
@@ -595,16 +623,19 @@ def consensus_blocks_stream_batched(
     max_batch: int = 4096,
     member_limit: int = 32768,
     prefetch_depth: int | None = None,
+    mesh=None,
 ):
     """Batch-granular twin of :func:`consensus_blocks_stream`: yields one
     ``(keys, lengths, out_bases, out_quals)`` tuple per device batch so the
     consumer can emit records with array passes instead of a per-family
-    loop.  Same vote program, bit-identical consensus bytes."""
+    loop.  Same vote program, bit-identical consensus bytes.  ``mesh``
+    family-shards each device batch (``parallel.mesh``; wire bytes
+    unchanged, no collectives)."""
     from consensuscruncher_tpu.parallel.batching import bucket_member_blocks
 
     yield from _run_member_batch_stream(
         bucket_member_blocks(items, max_batch=max_batch, member_limit=member_limit),
-        config, prefetch_depth, batched=True,
+        config, prefetch_depth, batched=True, mesh=mesh,
     )
 
 

@@ -122,10 +122,15 @@ class _PinnedMember:
         return self.codes.shape[0]
 
 
-def _duplex_vote_batch(s1, q1, s2, q2, qual_cap: int, backend: str):
+def _duplex_vote_batch(s1, q1, s2, q2, qual_cap: int, backend: str, mesh=None):
     """One duplex vote over stacked (P, L) pairs — the single backend
-    dispatch shared by the window-walk batcher and the vectorized path."""
+    dispatch shared by the window-walk batcher and the vectorized path.
+    ``mesh`` shards the pair axis (elementwise vote — no collectives)."""
     if backend == "tpu":
+        if mesh is not None:
+            from consensuscruncher_tpu.parallel.mesh import duplex_batch_host_sharded
+
+            return duplex_batch_host_sharded(s1, q1, s2, q2, mesh, qual_cap)
         return duplex_batch_host(s1, q1, s2, q2, qual_cap)
     out_b = np.empty_like(s1)
     out_q = np.empty_like(q1)
@@ -139,11 +144,12 @@ class _DuplexBatcher:
     kernel in batches (keeps device dispatches large and few)."""
 
     def __init__(self, qual_cap: int, header, flush_at: int = 16384,
-                 backend: str = "tpu"):
+                 backend: str = "tpu", mesh=None):
         self.qual_cap = qual_cap
         self.header = header
         self.flush_at = flush_at
         self.backend = backend
+        self.mesh = mesh
         self._by_len: dict[int, list] = {}
 
     def _pin(self, read):
@@ -169,7 +175,8 @@ class _DuplexBatcher:
         s2 = np.stack([e[2].codes for e in entries])
         q1 = np.stack([e[1].qual for e in entries])
         q2 = np.stack([e[2].qual for e in entries])
-        out_b, out_q = _duplex_vote_batch(s1, q1, s2, q2, self.qual_cap, self.backend)
+        out_b, out_q = _duplex_vote_batch(s1, q1, s2, q2, self.qual_cap,
+                                          self.backend, self.mesh)
         for i, (tag, canon, other, entry_sink) in enumerate(entries):
             entry_sink(tag, canon, other, out_b[i], out_q[i])
 
@@ -179,10 +186,10 @@ class _DuplexBatcher:
 
 
 def _run_dcs_windows(reader, stats, unpaired_writer, rec_writer,
-                     qual_cap: int, backend: str) -> None:
+                     qual_cap: int, backend: str, mesh=None) -> None:
     """Object-window pairing walk (foreign consensus BAMs: records whose
     tag block doesn't lead with XT:Z+XF:i)."""
-    batcher = _DuplexBatcher(qual_cap, reader.header, backend=backend)
+    batcher = _DuplexBatcher(qual_cap, reader.header, backend=backend, mesh=mesh)
 
     def sink(tag, canon, other, codes, quals):
         fam_size = canon.xf + other.xf
@@ -231,7 +238,7 @@ def _run_dcs_windows(reader, stats, unpaired_writer, rec_writer,
 
 
 def _consume_pair_blocks(reader, stats, unpaired_writer, rec_writer,
-                         qual_cap: int, backend: str) -> None:
+                         qual_cap: int, backend: str, mesh=None) -> None:
     """Vectorized pairing (grouping.duplex_pair_blocks): unpaired reads pass
     through as raw blobs, pairs vote in one device batch per length group,
     and duplex records assemble through the columnar record writer."""
@@ -319,7 +326,7 @@ def _consume_pair_blocks(reader, stats, unpaired_writer, rec_writer,
             sel = lseqc == L
             s1, q1 = member_rows(blk.pair_canon_src, blk.pair_canon_row, sel, L)
             s2, q2 = member_rows(blk.pair_other_src, blk.pair_other_row, sel, L)
-            out_b, out_q = _duplex_vote_batch(s1, q1, s2, q2, qual_cap, backend)
+            out_b, out_q = _duplex_vote_batch(s1, q1, s2, q2, qual_cap, backend, mesh)
             ps = np.nonzero(sel)[0]
             k = len(ps)
             # modal cigar bytes per pair, gathered per source batch
@@ -362,7 +369,17 @@ def run_dcs(
     out_prefix: str,
     qual_cap: int = 60,
     backend: str = "tpu",
+    devices: int | None = None,
 ) -> DcsResult:
+    """``devices``: shard the duplex vote's pair axis across this many chips
+    (``parallel.mesh``); None/1 = single device.  tpu backend only."""
+    mesh = None
+    if devices is not None and devices > 1:
+        if backend != "tpu":
+            raise ValueError("--devices > 1 requires the tpu backend")
+        from consensuscruncher_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh(devices)
     stats = StageStats("DCS")
     paths = output_paths(out_prefix)
     dcs_path, unpaired_path = paths["dcs"], paths["unpaired"]
@@ -378,7 +395,7 @@ def run_dcs(
     try:
         try:
             _consume_pair_blocks(
-                reader, stats, unpaired_writer, rec_writer, qual_cap, backend
+                reader, stats, unpaired_writer, rec_writer, qual_cap, backend, mesh
             )
         except ValueError as e:
             if "foreign tag layout" not in str(e):
@@ -394,7 +411,7 @@ def run_dcs(
             unpaired_writer = SortingBamWriter(unpaired_path, reader.header)
             rec_writer = ConsensusRecordWriter(dcs_writer)
             _run_dcs_windows(
-                reader, stats, unpaired_writer, rec_writer, qual_cap, backend,
+                reader, stats, unpaired_writer, rec_writer, qual_cap, backend, mesh,
             )
         rec_writer.flush()
         ok = True

@@ -110,8 +110,9 @@ def run_sscs(
     ``wire``: device wire layout for the tpu backend — ``"stream"`` (packed
     member stream, the production default: ~8-16x fewer h2d bytes, which
     dominates stage wall-clock on tunneled devices) or ``"dense"`` (padded
-    ``(B, F, L)`` batches; also what the ``devices>1`` mesh path uses).
-    Both are bit-identical by the parity suite."""
+    ``(B, F, L)`` batches).  Both are bit-identical by the parity suite,
+    and both shard over the ``devices`` mesh (the stream wire keeps its
+    byte advantage there: whole families per device, no collectives)."""
     if backend not in ("cpu", "tpu", "reference"):
         raise ValueError(
             f"unknown backend {backend!r} (expected 'cpu', 'tpu', or 'reference')"
@@ -147,7 +148,7 @@ def run_sscs(
         reader = ColumnarReader(in_bam)
         header = reader.header
         source = None  # built below once the pipeline flavor is known
-    use_blocks = backend == "tpu" and wire == "stream" and mesh is None
+    use_blocks = backend == "tpu" and wire == "stream"
     if backend != "reference" and not use_blocks:
         from consensuscruncher_tpu.stages.grouping import stream_families_columnar
 
@@ -330,7 +331,7 @@ def run_sscs(
                 # and on a tunneled device per-dispatch roundtrip latency is
                 # the cost that's left — fewer, larger batches win.
                 stream = consensus_blocks_stream_batched(
-                    block_items(), cfg, max_batch=4 * max_batch
+                    block_items(), cfg, max_batch=4 * max_batch, mesh=mesh
                 )
                 try:
                     for keys, lengths, out_b, out_q in stream:

@@ -137,3 +137,106 @@ def test_full_pipeline_step_parity():
 def test_stepstats_from_vector():
     s = StepStats.from_vector(np.array([1, 2, 3, 4]))
     assert (s.families, s.positions, s.n_positions, s.qual_sum) == (1, 2, 3, 4)
+
+
+# ---------------------------------------------------- sharded member stream
+
+
+def _member_families(rng, n, lengths=(64,), qual_lo=2, qual_hi=41, base_hi=4):
+    """(key, seqs, quals) families with controllable alphabet so the wire
+    encoder picks pack4 / pack8 / raw deliberately."""
+    fams = []
+    for i in range(n):
+        f = int(rng.integers(1, 9))
+        length = int(rng.choice(lengths))
+        seqs = [rng.integers(0, base_hi, length).astype(np.uint8) for _ in range(f)]
+        quals = [rng.integers(qual_lo, qual_hi, length).astype(np.uint8) for _ in range(f)]
+        fams.append((i, seqs, quals))
+    return fams
+
+
+def test_plan_member_shards_properties():
+    from consensuscruncher_tpu.parallel.mesh import plan_member_shards
+
+    rng = np.random.default_rng(5)
+    sizes = rng.integers(0, 9, 50).astype(np.int32)
+    plan = plan_member_shards(sizes, 8)
+    cuts = np.asarray(plan.cuts)
+    assert cuts[0] == 0 and cuts[-1] == 50
+    widths = np.diff(cuts)
+    assert (widths >= 0).all() and widths.max() <= plan.nf_local
+    ends = np.cumsum(sizes, dtype=np.int64)
+    starts = np.concatenate([[0], ends])
+    members = starts[cuts[1:]] - starts[cuts[:-1]]
+    assert members.max() <= plan.m_local
+    order = plan.order()
+    assert len(order) == 50 and len(np.unique(order)) == 50
+    # chunk k's rows live in device k's nf_local-wide band
+    for k in range(8):
+        f0, f1 = plan.cuts[k], plan.cuts[k + 1]
+        band = order[f0:f1]
+        assert ((band >= k * plan.nf_local) & (band < (k + 1) * plan.nf_local)).all()
+
+
+@pytest.mark.parametrize("wire_shape", [
+    # (qual_lo, qual_hi, base_hi) -> forces pack4 / pack8 / raw encodes
+    (20, 24, 4),     # <=4 distinct quals, pure ACGT -> pack4
+    (20, 34, 5),     # <=16 distinct quals, Ns present -> pack8
+    (2, 41, 5),      # 39 distinct quals -> raw
+])
+def test_sharded_stream_vote_bit_parity(wire_shape):
+    """The family-sharded member-stream path must be bit-identical to the
+    single-device stream on every wire encode, including multi-length
+    buckets and batches smaller than the mesh."""
+    from consensuscruncher_tpu.ops.consensus_segment import _run_member_batch_stream
+    from consensuscruncher_tpu.parallel.batching import bucket_members
+
+    lo, hi, base_hi = wire_shape
+    rng = np.random.default_rng(lo * 100 + hi)
+    fams = _member_families(rng, 90, lengths=(48, 64), qual_lo=lo, qual_hi=hi,
+                            base_hi=base_hi)
+    cfg = ConsensusConfig()
+    single = list(_run_member_batch_stream(
+        bucket_members(iter(fams), max_batch=32), cfg, 0))
+    mesh = make_mesh(8)
+    sharded = list(_run_member_batch_stream(
+        bucket_members(iter(fams), max_batch=32), cfg, 0, mesh=mesh))
+    assert len(single) == len(sharded) == 90
+    for (k1, b1, q1), (k2, b2, q2) in zip(single, sharded):
+        assert k1 == k2
+        np.testing.assert_array_equal(b1, b2)
+        np.testing.assert_array_equal(q1, q2)
+
+
+def test_sharded_stream_vote_tiny_batch():
+    """Fewer families than devices: some shards get zero real families."""
+    from consensuscruncher_tpu.ops.consensus_segment import _run_member_batch_stream
+    from consensuscruncher_tpu.parallel.batching import bucket_members
+
+    rng = np.random.default_rng(17)
+    fams = _member_families(rng, 3)
+    cfg = ConsensusConfig()
+    single = list(_run_member_batch_stream(
+        bucket_members(iter(fams)), cfg, 0))
+    sharded = list(_run_member_batch_stream(
+        bucket_members(iter(fams)), cfg, 0, mesh=make_mesh(8)))
+    for (k1, b1, q1), (k2, b2, q2) in zip(single, sharded):
+        assert k1 == k2
+        np.testing.assert_array_equal(b1, b2)
+        np.testing.assert_array_equal(q1, q2)
+
+
+def test_duplex_sharded_parity():
+    from consensuscruncher_tpu.parallel.mesh import duplex_batch_host_sharded
+    from consensuscruncher_tpu.ops.duplex_tpu import duplex_batch_host
+
+    rng = np.random.default_rng(23)
+    n, L = 37, 48  # odd pair count forces mesh padding
+    s1 = rng.integers(0, 5, (n, L)).astype(np.uint8)
+    s2 = rng.integers(0, 5, (n, L)).astype(np.uint8)
+    q1 = rng.integers(0, 61, (n, L)).astype(np.uint8)
+    q2 = rng.integers(0, 61, (n, L)).astype(np.uint8)
+    exp_b, exp_q = duplex_batch_host(s1, q1, s2, q2, 60)
+    got_b, got_q = duplex_batch_host_sharded(s1, q1, s2, q2, make_mesh(8), 60)
+    np.testing.assert_array_equal(got_b, exp_b)
+    np.testing.assert_array_equal(got_q, exp_q)

@@ -312,3 +312,27 @@ def test_stats_record_code_path_and_silicon(sim, tmp_path):
     # without triggering a backend init (jax IS initialized here by the
     # earlier stages, so "cpu" is also acceptable)
     assert resc.stats.get("jax_backend") in ("cpu", "uninitialized")
+
+
+@pytest.mark.parametrize("wire", ["stream", "dense"])
+def test_sscs_dcs_mesh_bit_identical(sim, tmp_path, wire):
+    """--devices 8 (virtual mesh) must reproduce single-device outputs
+    byte-for-byte on BOTH wires, and the DCS pair-axis sharding likewise."""
+    in_bam, _, _ = sim
+    r1 = run_sscs(in_bam, str(tmp_path / "one"), backend="tpu", wire=wire)
+    r8 = run_sscs(in_bam, str(tmp_path / "eight"), backend="tpu", wire=wire,
+                  devices=8)
+    for a_path, b_path in ((r1.sscs_bam, r8.sscs_bam),
+                           (r1.singleton_bam, r8.singleton_bam)):
+        a, b = read_all(a_path), read_all(b_path)
+        assert len(a) == len(b)
+        for ra, rb in zip(a, b):
+            assert ra == rb, f"record mismatch: {ra.qname}"
+    d1 = run_dcs(r1.sscs_bam, str(tmp_path / "d1"), backend="tpu")
+    d8 = run_dcs(r1.sscs_bam, str(tmp_path / "d8"), backend="tpu", devices=8)
+    for a_path, b_path in ((d1.dcs_bam, d8.dcs_bam),
+                           (d1.sscs_singleton_bam, d8.sscs_singleton_bam)):
+        a, b = read_all(a_path), read_all(b_path)
+        assert len(a) == len(b)
+        for ra, rb in zip(a, b):
+            assert ra == rb, f"record mismatch: {ra.qname}"
