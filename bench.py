@@ -31,13 +31,24 @@ Modes:
   python bench.py --kernels    # dense-XLA vs Pallas vs segment kernel compare
   python bench.py --worker ... # internal subprocess entry
 
+The TPU probe RETRIES across the bench budget (the axon tunnel dies and
+revives on hour scales — a single probe at one instant is a coin flip):
+attempt 1 up front; on failure the XLA-CPU fallback measurement fills the
+first retry gap (work we need anyway), then bounded-backoff attempts
+follow.  Every attempt lands in ``tpu_probe_attempts`` so a
+tpu_unavailable line carries its own evidence.  When a probe succeeds the
+stage worker AND the kernel bake-off (``kernels_tpu``) run while the
+tunnel is alive.
+
 Scale knobs (env):
-  CCT_BENCH_FRAGMENTS (5000)      duplex fragments in the main BAM
-  CCT_BENCH_REF_FRAGMENTS (400)   fragments in the baseline subsample BAM
+  CCT_BENCH_FRAGMENTS (20000)     duplex fragments in the main BAM
+  CCT_BENCH_REF_FRAGMENTS (1000)  fragments in the baseline subsample BAM
   CCT_BENCH_LEN (100)             read length
   CCT_BENCH_MEAN_FAM (4)          mean per-strand family size
   CCT_BENCH_TPU_TIMEOUT (600)     seconds before the TPU worker is killed
-  CCT_BENCH_PROBE_TIMEOUT (240)   seconds for the cheap TPU liveness probe
+  CCT_BENCH_PROBE_TIMEOUT (120)   seconds for one TPU liveness probe
+  CCT_BENCH_PROBE_ATTEMPTS (4)    max probe attempts across the run
+  CCT_BENCH_PROBE_BACKOFF (60)    seconds between late probe attempts
   CCT_BENCH_CPU_TIMEOUT (1200)    seconds for CPU workers
 """
 
@@ -60,7 +71,9 @@ REF_FRAGMENTS = _env_int("CCT_BENCH_REF_FRAGMENTS", 1_000)
 READ_LEN = _env_int("CCT_BENCH_LEN", 100)
 MEAN_FAM = _env_int("CCT_BENCH_MEAN_FAM", 4)
 TPU_TIMEOUT = _env_int("CCT_BENCH_TPU_TIMEOUT", 600)
-PROBE_TIMEOUT = _env_int("CCT_BENCH_PROBE_TIMEOUT", 240)
+PROBE_TIMEOUT = _env_int("CCT_BENCH_PROBE_TIMEOUT", 120)
+PROBE_ATTEMPTS = _env_int("CCT_BENCH_PROBE_ATTEMPTS", 4)
+PROBE_BACKOFF = _env_int("CCT_BENCH_PROBE_BACKOFF", 60)
 CPU_TIMEOUT = _env_int("CCT_BENCH_CPU_TIMEOUT", 1_200)
 METRIC = "sscs_dcs_stage_families_per_sec"
 
@@ -304,6 +317,38 @@ def _simulate(path: str, n_fragments: int, seed: int) -> None:
     )
 
 
+def _probe_with_retries(td: str, t_start: float, attempts_log: list,
+                        run_tpu_stage) -> dict | None:
+    """Probe/stage loop: retry the liveness probe across the bench budget.
+
+    ``run_tpu_stage()`` runs the real workload and returns its result dict;
+    it is invoked only after a successful probe, while the tunnel is known
+    alive.  Returns the first ok stage result, or None when every attempt
+    (probe or stage) failed.  The first retry gap is expected to be filled
+    by the caller with useful work (the fallback measurement); later gaps
+    sleep PROBE_BACKOFF.
+    """
+    first = not attempts_log
+    while len(attempts_log) < PROBE_ATTEMPTS:
+        if not first and len(attempts_log) > 1:
+            time.sleep(PROBE_BACKOFF)
+        first = False
+        probe = _run_worker("probe", "tpu", "-", td, PROBE_TIMEOUT)
+        entry = {"at_s": round(time.perf_counter() - t_start, 1),
+                 "ok": bool(probe.get("ok"))}
+        if not probe.get("ok"):
+            entry["error"] = str(probe.get("error", "unknown"))[:200]
+        attempts_log.append(entry)
+        if probe.get("ok"):
+            result = run_tpu_stage()
+            if result.get("ok"):
+                return result
+            attempts_log[-1]["stage_error"] = str(result.get("error", "unknown"))[:200]
+        if len(attempts_log) == 1:
+            return None  # let the caller fill the first gap with real work
+    return None
+
+
 def main() -> None:
     t_start = time.perf_counter()
     extras: dict = {}
@@ -319,26 +364,33 @@ def main() -> None:
             extras["simulate_s"] = round(time.perf_counter() - t0, 1)
 
             baseline = _run_worker("stage", "reference", ref_bam, td, CPU_TIMEOUT)
-            # Cheap liveness probe first: when the axon tunnel is sick its
-            # backend init hangs forever, so don't hand the full stage
-            # workload a 10-minute rope — probe with a short one.
-            probe = _run_worker("probe", "tpu", "-", td, PROBE_TIMEOUT)
-            if probe.get("ok"):
-                result = _run_worker("stage", "tpu", bam, td, TPU_TIMEOUT)
-            else:
-                result = {"ok": False, "backend": "tpu",
-                          "error": f"probe failed: {probe.get('error', 'unknown')}"}
+
+            attempts: list[dict] = []
+            run_tpu = lambda: _run_worker("stage", "tpu", bam, td, TPU_TIMEOUT)  # noqa: E731
+            result = _probe_with_retries(td, t_start, attempts, run_tpu)
+            fallback = None
+            if result is None:
+                # Fill the first retry gap with the measurement we need
+                # anyway if the tunnel never comes back.
+                fallback = _run_worker("stage", "xla_cpu", bam, td, CPU_TIMEOUT)
+                result = _probe_with_retries(td, t_start, attempts, run_tpu)
+
             backend_used = "tpu"
-            if not result.get("ok"):
+            if result is None:
                 extras["tpu_unavailable"] = True
-                extras["tpu_error"] = result.get("error", "unknown")
-                result = _run_worker("stage", "xla_cpu", bam, td, CPU_TIMEOUT)
+                extras["tpu_error"] = (attempts[-1].get("stage_error")
+                                       or attempts[-1].get("error", "unknown")
+                                       if attempts else "no probe ran")
+                result = fallback if fallback is not None else {"ok": False,
+                                                                "error": "no fallback"}
                 backend_used = "cpu_fallback"
+            extras["tpu_probe_attempts"] = attempts
 
             if result.get("ok"):
                 value = float(result["families_per_sec"])
                 extras.update(
                     backend=backend_used,
+                    code_path="tpu",  # both silicons run the jitted device path
                     jax_backend=result.get("jax_backend"),
                     n_families=result.get("n_families"),
                     n_reads=result.get("n_reads"),
@@ -347,6 +399,12 @@ def main() -> None:
                     # per member position, both directions dominated by h2d
                     bytes_h2d_est=int(result.get("n_reads", 0)) * READ_LEN * 2,
                 )
+                if backend_used == "tpu":
+                    # The tunnel is alive NOW — grab the kernel bake-off in
+                    # the same window (VERDICT r2 item 4) under a bounded rope.
+                    extras["kernels_tpu"] = _run_worker(
+                        "kernels", "tpu", "-", td, min(TPU_TIMEOUT, 480)
+                    )
             else:
                 extras.update(backend="none", error=result.get("error", "unknown"))
 
@@ -373,17 +431,17 @@ def main() -> None:
 
 
 def main_kernels() -> None:
+    t_start = time.perf_counter()
     with tempfile.TemporaryDirectory(prefix="cct_bench_") as td:
-        probe = _run_worker("probe", "tpu", "-", td, PROBE_TIMEOUT)
-        if probe.get("ok"):
-            result = _run_worker("kernels", "tpu", "-", td, TPU_TIMEOUT)
-        else:
-            result = {"ok": False, "error": f"probe failed: {probe.get('error', 'unknown')}"}
-        if not result.get("ok"):
-            fallback = _run_worker("kernels", "cpu", "-", td, CPU_TIMEOUT)
-            fallback["tpu_unavailable"] = True
-            fallback["tpu_error"] = result.get("error", "unknown")
-            result = fallback
+        attempts: list[dict] = []
+        run_tpu = lambda: _run_worker("kernels", "tpu", "-", td, TPU_TIMEOUT)  # noqa: E731
+        result = _probe_with_retries(td, t_start, attempts, run_tpu)
+        if result is None:  # keep retrying through the remaining attempts
+            result = _probe_with_retries(td, t_start, attempts, run_tpu)
+        if result is None:
+            result = _run_worker("kernels", "cpu", "-", td, CPU_TIMEOUT)
+            result["tpu_unavailable"] = True
+        result["tpu_probe_attempts"] = attempts
     print(json.dumps(result))
 
 
