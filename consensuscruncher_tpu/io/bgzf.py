@@ -297,12 +297,17 @@ class BgzfWriter(io.RawIOBase):
     produce byte-identical files.
     """
 
-    def __init__(self, path_or_fh, level: int = 6):
+    def __init__(self, path_or_fh, level: int = 6, collect_blocks: bool = False):
         self._own = _is_pathlike(path_or_fh)
         self._fh = open(path_or_fh, "wb") if self._own else path_or_fh
         self._level = level
         self._buf = bytearray()
         self._native = native.available()
+        # When asked, record every payload block's COMPRESSED byte length in
+        # write order (payload lengths are implied: MAX_BLOCK_PAYLOAD for
+        # all but the final block).  The inline BAI builder turns these into
+        # virtual offsets without ever re-reading the file.
+        self.block_sizes: list[int] | None = [] if collect_blocks else None
 
     def writable(self) -> bool:
         return True
@@ -320,11 +325,19 @@ class BgzfWriter(io.RawIOBase):
 
     def _flush_block(self, size: int) -> None:
         payload, self._buf = bytes(self._buf[:size]), self._buf[size:]
-        self._fh.write(compress_block(payload, self._level))
+        block = compress_block(payload, self._level)
+        if self.block_sizes is not None:
+            self.block_sizes.append(len(block))
+        self._fh.write(block)
 
     def _flush_native(self, size: int) -> None:
         payload, self._buf = bytes(self._buf[:size]), self._buf[size:]
-        self._fh.write(native.deflate_payload(payload, self._level))
+        if self.block_sizes is not None:
+            data, sizes = native.deflate_payload_sizes(payload, self._level)
+            self.block_sizes.extend(sizes)
+            self._fh.write(data)
+        else:
+            self._fh.write(native.deflate_payload(payload, self._level))
 
     def close(self) -> None:
         if self.closed:

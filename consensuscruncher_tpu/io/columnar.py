@@ -408,12 +408,48 @@ def coord_sort_perm(rid: np.ndarray, pos: np.ndarray, qname_matrix: np.ndarray,
     return np.lexsort(keys)
 
 
+def _record_spans_columnar(big: np.ndarray, starts: np.ndarray):
+    """(rid, pos, end, mapped) per record, vectorized (the columnar twin of
+    ``io.bai._record_span``): end = pos + ref-consumed cigar length (min 1),
+    pos + 1 for unmapped or cigar-less records."""
+    off = starts
+    rid = _gather_view(big, off + 4, 4, "<i4").astype(np.int64)
+    pos = _gather_view(big, off + 8, 4, "<i4").astype(np.int64)
+    flag = _gather_view(big, off + 18, 2, "<u2")
+    n_cig = _gather_view(big, off + 16, 2, "<u2").astype(np.int64)
+    l_qname = big[off + 12].astype(np.int64)
+    mapped = (flag & 0x4) == 0
+    end = pos + 1
+    use = mapped & (n_cig > 0)
+    if use.any():
+        data, coff = ragged_gather(big, (off + 36 + l_qname)[use], 4 * n_cig[use])
+        words = np.ascontiguousarray(data).view("<u4").astype(np.int64)
+        ops = words & 0xF
+        # ref-consuming ops: M, D, N, =, X  (0, 2, 3, 7, 8)
+        contrib = np.where(
+            (ops == 0) | (ops == 2) | (ops == 3) | (ops == 7) | (ops == 8),
+            words >> 4, 0,
+        )
+        cs = np.concatenate([[0], np.cumsum(contrib)])
+        wb = coff // 4
+        ref_len = cs[wb[1:]] - cs[wb[:-1]]
+        end[use] = pos[use] + np.maximum(ref_len, 1)
+    return rid, pos, end, mapped
+
+
 def _write_bam_records(out_path, header: BamHeader, big: np.ndarray,
-                       starts: np.ndarray, lengths: np.ndarray, level: int) -> None:
+                       starts: np.ndarray, lengths: np.ndarray, level: int,
+                       index: bool = True) -> None:
     """Atomically write header + the records ``big[starts[i]:+lengths[i]]``
-    (already in final order) as a BGZF BAM."""
+    (already in final order) as a BGZF BAM.
+
+    With ``index=True`` (default) the ``.bai`` sidecar is built inline from
+    the same in-memory columns and the writer's block layout — measured
+    ~30% of full-pipeline wall used to go to ``index_bam``'s re-read +
+    per-record Python scan of files this function had just written.
+    """
     tmp = os.fspath(out_path) + ".tmp"
-    writer = bgzf.BgzfWriter(tmp, level=level)
+    writer = bgzf.BgzfWriter(tmp, level=level, collect_blocks=index)
     try:
         text = header.text.encode("ascii")
         out = bytearray(BAM_MAGIC)
@@ -423,6 +459,7 @@ def _write_bam_records(out_path, header: BamHeader, big: np.ndarray,
             bname = name.encode("ascii") + b"\x00"
             out += struct.pack("<i", len(bname)) + bname + struct.pack("<i", length)
         writer.write(bytes(out))
+        header_len = len(out)
         n_total = len(starts)
         if n_total:
             # Gather + write in bounded record chunks: ragged_gather builds
@@ -445,6 +482,18 @@ def _write_bam_records(out_path, header: BamHeader, big: np.ndarray,
         if os.path.exists(tmp):
             os.unlink(tmp)
         raise
+    if index:
+        from consensuscruncher_tpu.io.bai import write_bai_from_columns
+
+        rid, pos, end, mapped = _record_spans_columnar(big, starts)
+        ustart = header_len + np.concatenate(
+            [[0], np.cumsum(lengths[:-1], dtype=np.int64)]
+        ) if len(starts) else np.zeros(0, np.int64)
+        write_bai_from_columns(
+            os.fspath(out_path) + ".bai", len(header.refs),
+            rid, pos, end, mapped, ustart, ustart + lengths,
+            writer.block_sizes,
+        )
 
 
 class SortingBamWriter:
@@ -464,7 +513,7 @@ class SortingBamWriter:
     """
 
     def __init__(self, path, header: BamHeader, level: int = 6,
-                 max_raw_bytes: int | None = None):
+                 max_raw_bytes: int | None = None, index: bool = True):
         from consensuscruncher_tpu.io.bam import _sorted_header
 
         # Per-WRITER cap: a stage holds 2-3 sorting writers at once and
@@ -477,6 +526,7 @@ class SortingBamWriter:
         self._path = os.fspath(path)
         self.header = _sorted_header(header)
         self._level = level
+        self._index = index
         self._max_raw = max_raw_bytes
         self._chunks: list[np.ndarray] = []
         self._raw = 0
@@ -550,7 +600,7 @@ class SortingBamWriter:
         else:
             starts = lengths = np.empty(0, np.int64)
         _write_bam_records(self._path, self.header, big, starts, lengths,
-                           self._level)
+                           self._level, index=self._index)
 
     def abort(self) -> None:
         self._closed = True

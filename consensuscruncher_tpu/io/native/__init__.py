@@ -411,13 +411,16 @@ def fill_runs_native(dst: np.ndarray, starts: np.ndarray, lens: np.ndarray, valu
     )
 
 
-def deflate_payload(data: bytes, level: int = 6, n_threads: int = 0) -> bytes:
-    """Compress ``data`` into complete framed BGZF blocks (no EOF marker)."""
+def deflate_payload_sizes(data: bytes, level: int = 6,
+                          n_threads: int = 0) -> tuple[bytes, list[int]]:
+    """Compress ``data`` into complete framed BGZF blocks (no EOF marker);
+    also return each block's compressed byte length in order (the inline
+    BAI builder derives virtual offsets from these)."""
     lib = _get()
     if lib is None:
         raise RuntimeError("native BGZF codec unavailable")
     if not data:
-        return b""
+        return b"", []
     stride = int(lib.cct_out_stride())
     from consensuscruncher_tpu.io.bgzf import MAX_BLOCK_PAYLOAD
 
@@ -431,4 +434,10 @@ def deflate_payload(data: bytes, level: int = 6, n_threads: int = 0) -> bytes:
     if rc != 0:
         raise ValueError(f"BGZF native deflate failed at block {rc - 1}")
     mv = memoryview(out)
-    return b"".join(mv[i * stride : i * stride + int(sizes[i])] for i in range(n_blocks))
+    szs = [int(s) for s in sizes]
+    return b"".join(mv[i * stride : i * stride + szs[i]] for i in range(n_blocks)), szs
+
+
+def deflate_payload(data: bytes, level: int = 6, n_threads: int = 0) -> bytes:
+    """Compress ``data`` into complete framed BGZF blocks (no EOF marker)."""
+    return deflate_payload_sizes(data, level, n_threads)[0]

@@ -370,6 +370,7 @@ def run_dcs(
     qual_cap: int = 60,
     backend: str = "tpu",
     devices: int | None = None,
+    level: int = 6,
 ) -> DcsResult:
     """``devices``: shard the duplex vote's pair axis across this many chips
     (``parallel.mesh``); None/1 = single device.  tpu backend only."""
@@ -387,8 +388,8 @@ def run_dcs(
     from consensuscruncher_tpu.io.columnar import ColumnarReader, SortingBamWriter
 
     reader = ColumnarReader(sscs_bam)
-    dcs_writer = SortingBamWriter(dcs_path, reader.header)
-    unpaired_writer = SortingBamWriter(unpaired_path, reader.header)
+    dcs_writer = SortingBamWriter(dcs_path, reader.header, level=level)
+    unpaired_writer = SortingBamWriter(unpaired_path, reader.header, level=level)
     rec_writer = ConsensusRecordWriter(dcs_writer)
 
     ok = False
@@ -407,8 +408,9 @@ def run_dcs(
             unpaired_writer.abort()
             stats = StageStats("DCS")
             reader = ColumnarReader(sscs_bam)
-            dcs_writer = SortingBamWriter(dcs_path, reader.header)
-            unpaired_writer = SortingBamWriter(unpaired_path, reader.header)
+            dcs_writer = SortingBamWriter(dcs_path, reader.header, level=level)
+            unpaired_writer = SortingBamWriter(unpaired_path, reader.header,
+                                               level=level)
             rec_writer = ConsensusRecordWriter(dcs_writer)
             _run_dcs_windows(
                 reader, stats, unpaired_writer, rec_writer, qual_cap, backend, mesh,

@@ -302,6 +302,7 @@ def run_singleton_correction(
     max_mismatch: int = 0,
     backend: str = "tpu",
     _force_object: bool = False,
+    level: int = 6,
 ) -> SingletonResult:
     """``backend="cpu"`` keeps the Hamming matcher in numpy — a cpu run
     must never touch (or wait on) a device backend.
@@ -321,7 +322,7 @@ def run_singleton_correction(
         hdr_reader = BamReader(singleton_bam)
         header = hdr_reader.header
         hdr_reader.close()
-        writers = {k: SortingBamWriter(p, header) for k, p in paths.items()}
+        writers = {k: SortingBamWriter(p, header, level=level) for k, p in paths.items()}
         ok = False
         try:
             try:
@@ -349,7 +350,7 @@ def run_singleton_correction(
 
     s_reader = BamReader(singleton_bam)
     x_reader = BamReader(sscs_bam)
-    writers = {k: SortingBamWriter(p, s_reader.header) for k, p in paths.items()}
+    writers = {k: SortingBamWriter(p, s_reader.header, level=level) for k, p in paths.items()}
 
     try:
         for singles, sscses in _merge_windows(

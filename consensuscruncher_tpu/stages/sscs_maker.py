@@ -102,6 +102,7 @@ def run_sscs(
     max_batch: int = 1024,
     devices: int | None = None,
     wire: str = "stream",
+    level: int = 6,
 ) -> SscsResult:
     """``devices``: shard each family batch across this many chips
     (``parallel.mesh`` family-data-parallel path); None/1 = single device.
@@ -158,8 +159,8 @@ def run_sscs(
     bad_writer = BamWriter(bad_path, header, atomic=True)
     # In-memory sorting writers: records buffer as raw blobs and sort+write
     # once at close — no unsorted tmp file, no L1 deflate/inflate round trip
-    sscs_writer = SortingBamWriter(sscs_path, header)
-    singleton_writer = SortingBamWriter(singleton_path, header)
+    sscs_writer = SortingBamWriter(sscs_path, header, level=level)
+    singleton_writer = SortingBamWriter(singleton_path, header, level=level)
 
     pending: dict[int, tuple] = {}
 
