@@ -169,9 +169,27 @@ def _align_builtin(ref: str, r1: str, r2: str, out_bam: str) -> None:
 
     from consensuscruncher_tpu.io.columnar import SortingBamWriter
 
+    n_total = n_unmapped = 0
     with SortingBamWriter(out_bam, header) as w:
         for read in align_pairs(aligner, pairs(), header):
+            n_total += 1
+            if read.is_unmapped:
+                n_unmapped += 1
             w.write(read)
+    # The builtin aligner is substitutions-only (no indels, no clips): on
+    # real sequencing data it silently fails reads a gapped aligner would
+    # place.  A high unaligned fraction is the fingerprint of that failure
+    # mode — refuse to let it pass quietly (VERDICT r2 weak #6).
+    if n_total and n_unmapped / n_total > 0.10:
+        print(
+            f"WARNING: --bwa builtin left {n_unmapped}/{n_total} reads "
+            f"unaligned ({100 * n_unmapped / n_total:.0f}%). The builtin "
+            "aligner handles substitutions only — reads with indels or "
+            "clipped ends cannot align. For real sequencing data use a "
+            "gapped aligner: --bwa /path/to/bwa",
+            file=sys.stderr,
+            flush=True,
+        )
 
 
 # ------------------------------------------------------------------ consensus

@@ -110,3 +110,35 @@ def test_extract_matches_golden(tmp_path):
         if text_digest(p) != expected:
             mismatches.append(rel)
     assert not mismatches, f"extract outputs diverge from golden: {mismatches}"
+
+
+@pytest.mark.parametrize("backend,devices", [
+    ("cpu", None),
+    ("tpu", None),
+    ("tpu", 8),
+])
+def test_adversarial_pipeline_matches_golden(tmp_path, backend, devices):
+    """Full pipeline over the adversarial fixture (indel/clip cigars, mixed
+    lengths, missing quals, exotic tags, flag soup — VERDICT r2 missing #5):
+    frozen digests + backend/mesh byte parity + routing counts."""
+    import json as _json
+
+    from consensuscruncher_tpu.cli import main as cli_main
+
+    argv = [
+        "consensus", "-i", os.path.join(DATA, "sample_adversarial.bam"),
+        "-o", str(tmp_path), "-n", "golden_adv",
+        "--backend", backend, "--scorrect", "True",
+    ]
+    if devices:
+        argv += ["--devices", str(devices)]
+    cli_main(argv)
+    assert_outputs_match_golden(
+        tmp_path / "golden_adv", "consensus_adversarial",
+        f"adv {backend}/devices={devices}",
+    )
+    stats = _json.load(
+        open(tmp_path / "golden_adv" / "sscs" / "golden_adv.sscs_stats.json"))
+    expect = GOLDEN["adversarial_expect"]
+    assert stats["bad_reads"] == expect["bad_reads"]
+    assert stats["total_reads"] == expect["bad_reads"] + expect["good_reads"]
