@@ -201,8 +201,34 @@ def consensus(args) -> dict:
     # *.metrics.json / *.time_tracker.txt regardless.
     from consensuscruncher_tpu.utils.profiling import maybe_profile
 
+    # Sample batch (BASELINE.json config 5, "8-sample panel batch"): a
+    # comma-separated --input runs every BAM through the pipeline in one
+    # process — one backend init, one warm jit cache shared across samples,
+    # each sample under its own <output>/<stem>/ tree.  The TPU-first
+    # parallel shape here is deliberate: chips are engaged through the
+    # family-axis mesh (--devices) within each sample rather than pinning
+    # one whole sample per chip — sample-pinning would idle 7 chips during
+    # every sample's host-bound decode/sort phases, whereas family-sharding
+    # keeps all chips on whichever sample is in flight.
+    inputs = [p.strip() for p in str(args.input).split(",") if p.strip()]
     with maybe_profile(getattr(args, "profile", None)):
-        return _consensus_impl(args)
+        if len(inputs) <= 1:
+            return _consensus_impl(args)
+        if args.name:
+            raise SystemExit(
+                "--name cannot combine with a multi-sample --input batch "
+                "(every sample names its own output tree by file stem)"
+            )
+        import copy
+
+        results = {}
+        for inp in inputs:
+            sub = copy.copy(args)
+            sub.input = inp
+            sub.name = None  # per-sample stem
+            print(f"consensus: batch sample {inp}")
+            results[inp] = _consensus_impl(sub)
+        return results
 
 
 def _consensus_impl(args) -> dict:
@@ -425,7 +451,10 @@ def build_parser() -> argparse.ArgumentParser:
 
     c = sub.add_parser("consensus", help="collapse UMI families into SSCS/DCS")
     c.add_argument("-c", "--config", default=None)
-    c.add_argument("--input", "-i", help="coordinate-sorted barcoded BAM")
+    c.add_argument("--input", "-i",
+                   help="coordinate-sorted barcoded BAM; comma-separate "
+                        "several to run a sample batch (each sample under "
+                        "its own <output>/<stem>/ tree)")
     c.add_argument("--output", "-o")
     c.add_argument("--name", "-n")
     c.add_argument("--cutoff", type=float)

@@ -242,3 +242,44 @@ def test_root_shim_runs(tmp_path):
         capture_output=True, text=True, env=env,
     )
     assert res.returncode == 0
+
+
+def test_consensus_multi_sample_batch(tmp_path):
+    """Config-5 surface: comma-separated --input runs each BAM through the
+    pipeline in one process, outputs per-sample, identical to single runs."""
+    import json
+
+    from consensuscruncher_tpu.cli import main as cli_main
+    from consensuscruncher_tpu.utils.simulate import SimConfig, simulate_bam
+
+    a = str(tmp_path / "sampleA.bam")
+    b = str(tmp_path / "sampleB.bam")
+    simulate_bam(a, SimConfig(n_fragments=40, seed=5, mean_family_size=3.0))
+    simulate_bam(b, SimConfig(n_fragments=40, seed=6, mean_family_size=3.0))
+
+    cli_main(["consensus", "-i", f"{a},{b}", "-o", str(tmp_path / "batch"),
+              "--backend", "tpu", "--scorrect", "True"])
+    single = str(tmp_path / "single")
+    cli_main(["consensus", "-i", a, "-o", single, "--backend", "tpu",
+              "--scorrect", "True"])
+
+    for stem in ("sampleA", "sampleB"):
+        stats = json.load(open(
+            tmp_path / "batch" / stem / "sscs" / f"{stem}.sscs_stats.json"))
+        assert stats["families"] > 0
+    from consensuscruncher_tpu.io.bam import BamReader
+
+    def records(p):
+        with BamReader(p) as r:
+            return list(r)
+
+    batch_bam = tmp_path / "batch" / "sampleA" / "sscs" / "sampleA.sscs.sorted.bam"
+    single_bam = tmp_path / "single" / "sampleA" / "sscs" / "sampleA.sscs.sorted.bam"
+    assert records(str(batch_bam)) == records(str(single_bam))
+
+    # --name + batch is a collision; refuse loudly
+    import pytest
+
+    with pytest.raises(SystemExit):
+        cli_main(["consensus", "-i", f"{a},{b}", "-o", str(tmp_path / "x"),
+                  "-n", "clash", "--backend", "cpu"])
