@@ -103,3 +103,28 @@ def test_truncated_block_detected():
     block = bgzf.compress_block(b"payload" * 100)
     with pytest.raises(ValueError, match="truncated"):
         list(bgzf.iter_blocks(io.BytesIO(block[: len(block) // 2])))
+
+
+def test_codec_threads_byte_identical(tmp_path, monkeypatch):
+    """Blocks compress independently, so the threaded codec pool must
+    produce byte-identical files at any pool size (and inflate them back)."""
+    import numpy as np
+
+    from consensuscruncher_tpu.io import bgzf as bg
+
+    rng = np.random.default_rng(3)
+    data = rng.integers(0, 64, 1_500_000).astype(np.uint8).tobytes()
+
+    def write(path, threads):
+        monkeypatch.setenv("CCT_BGZF_THREADS", str(threads))
+        w = bg.BgzfWriter(str(path), level=6, collect_blocks=True)
+        w.write(data)
+        w.close()
+        return open(path, "rb").read(), list(w.block_sizes)
+
+    one, sizes1 = write(tmp_path / "t0.bam", 0)
+    par, sizes3 = write(tmp_path / "t3.bam", 3)
+    assert one == par
+    assert sizes1 == sizes3
+    monkeypatch.setenv("CCT_BGZF_THREADS", "3")
+    assert bg.decompress_file(str(tmp_path / "t3.bam")) == data
