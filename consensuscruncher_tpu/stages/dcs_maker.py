@@ -381,6 +381,9 @@ def run_dcs(
         from consensuscruncher_tpu.parallel.mesh import make_mesh
 
         mesh = make_mesh(devices)
+    from consensuscruncher_tpu.utils.stats import TimeTracker
+
+    tracker = TimeTracker()
     stats = StageStats("DCS")
     paths = output_paths(out_prefix)
     dcs_path, unpaired_path = paths["dcs"], paths["unpaired"]
@@ -423,10 +426,20 @@ def run_dcs(
             dcs_writer.abort()
             unpaired_writer.abort()
 
+    tracker.mark("pairing")
     dcs_writer.close()
     unpaired_writer.close()
+    tracker.mark("sort")
     record_backend(stats, backend)
     stats.write(paths["stats_txt"])
+    tracker.write(f"{out_prefix}.dcs.time_tracker.txt")
+    from consensuscruncher_tpu.utils.profiling import write_metrics
+
+    write_metrics(
+        f"{out_prefix}.dcs.metrics.json", "DCS", tracker.as_phases(),
+        {"backend": backend, "jax_backend": stats.get("jax_backend"),
+         "pairs": stats.get("pairs"), "sscs_total": stats.get("sscs_total")},
+    )
     return DcsResult(dcs_path, unpaired_path, stats)
 
 

@@ -311,6 +311,10 @@ def run_singleton_correction(
     runs the vectorized RescueBlock path; ``max_mismatch > 0`` (and foreign
     tag layouts) use the object window walk.  ``_force_object`` exists for
     the byte-parity test suite."""
+    from consensuscruncher_tpu.utils.profiling import write_metrics
+    from consensuscruncher_tpu.utils.stats import TimeTracker
+
+    tracker = TimeTracker()
     use_device = backend == "tpu"
     stats = StageStats("singleton_correction")
     all_paths = output_paths(out_prefix)
@@ -341,6 +345,14 @@ def run_singleton_correction(
             stats.set("max_mismatch", max_mismatch)
             record_backend(stats, backend)
             stats.write(all_paths["stats_txt"])
+            tracker.mark("rescue")
+            tracker.write(f"{out_prefix}.singleton.time_tracker.txt")
+            write_metrics(
+                f"{out_prefix}.singleton.metrics.json", "singleton_correction",
+                tracker.as_phases(),
+                {"backend": backend, "jax_backend": stats.get("jax_backend"),
+                 "singletons": stats.get("singletons_total")},
+            )
             return SingletonResult(
                 paths["sscs_rescue"], paths["singleton_rescue"],
                 paths["remaining"], stats,
@@ -410,6 +422,14 @@ def run_singleton_correction(
     stats.set("max_mismatch", max_mismatch)
     record_backend(stats, backend)
     stats.write(all_paths["stats_txt"])
+    tracker.mark("rescue")
+    tracker.write(f"{out_prefix}.singleton.time_tracker.txt")
+    write_metrics(
+        f"{out_prefix}.singleton.metrics.json", "singleton_correction",
+        tracker.as_phases(),
+        {"backend": backend, "jax_backend": stats.get("jax_backend"),
+         "singletons": stats.get("singletons_total")},
+    )
     return SingletonResult(paths["sscs_rescue"], paths["singleton_rescue"], paths["remaining"], stats)
 
 
