@@ -473,36 +473,66 @@ _COLUMNAR_SORT_MAX_BYTES = int(os.environ.get("CCT_COLUMNAR_SORT_MAX_BYTES", 96 
 
 
 def sort_bam(in_path, out_path, max_in_memory: int = 2_000_000, level: int = 6) -> None:
-    """Coordinate sort (samtools-sort parity). Spills chunks to temp BAMs and
-    heap-merges when the input exceeds ``max_in_memory`` records.
+    """Coordinate sort (samtools-sort parity). External-sorts via sorted
+    temp chunks + a columnar k-way merge when the input exceeds the
+    in-memory bounds.
 
     Inputs whose compressed size fits ``CCT_COLUMNAR_SORT_MAX_BYTES`` take
     the columnar fast path (``io.columnar.sort_bam_columnar``): identical
-    total order, but a pure byte shuffle — records are never decoded."""
+    total order, but a pure byte shuffle — records are never decoded.  The
+    external path is columnar too (chunks sort as byte shuffles, the merge
+    is ``io.columnar.merge_sorted_columnar``); the object heap merge
+    survives only as the last-resort fallback when even the merge's key
+    columns exceed the memory budget."""
     if os.path.getsize(in_path) <= _COLUMNAR_SORT_MAX_BYTES:
         from consensuscruncher_tpu.io.columnar import sort_bam_columnar
 
         if sort_bam_columnar(in_path, out_path, level=level, max_records=max_in_memory):
             return
-    reader = BamReader(in_path)
+    from consensuscruncher_tpu.io.columnar import (
+        ColumnarReader,
+        SortingBamWriter,
+        merge_sorted_columnar,
+    )
+
+    reader = ColumnarReader(in_path)
     header = reader.header
     chunks: list[str] = []
-    buf: list[BamRead] = []
+    # chunk budget: a fraction of the sort buffer so several chunks' key
+    # columns + one chunk's raw bytes coexist comfortably
+    from consensuscruncher_tpu.io.columnar import _default_sort_buffer_bytes
+
+    chunk_budget = max(256 << 20, _default_sort_buffer_bytes() // 4)
+
+    def spill_chunk(writer: SortingBamWriter) -> None:
+        writer.close()
+        chunks.append(writer._path)
+
     try:
-        for read in reader:
-            buf.append(read)
-            if len(buf) >= max_in_memory:
-                chunks.append(_spill(buf, header))
-                buf = []
-        if not chunks:
-            buf.sort(key=lambda r: _coord_key(r, header))
-            with BamWriter(out_path, _sorted_header(header), level=level, atomic=True) as w:
-                for read in buf:
-                    w.write(read)
+        w = None
+        raw = n = 0
+        for b in reader.batches():
+            if w is None:
+                fd, path = tempfile.mkstemp(suffix=".bam", prefix="ccsort.")
+                os.close(fd)
+                # level 1 + no index: throwaway chunks, read back once
+                w = SortingBamWriter(path, header, level=1, index=False,
+                                     max_raw_bytes=chunk_budget * 2)
+            blob = b.buf[: int(b.rec_off[-1])]
+            w.write_encoded(blob)
+            raw += blob.size
+            n += b.n
+            if raw > chunk_budget or n > max_in_memory:
+                spill_chunk(w)
+                w = None
+                raw = n = 0
+        if w is not None:
+            spill_chunk(w)
+        if not chunks:  # empty input
+            SortingBamWriter(os.fspath(out_path), header, level=level).close()
             return
-        if buf:
-            chunks.append(_spill(buf, header))
-        _merge_paths(chunks, out_path, header, level=level)
+        if not merge_sorted_columnar(chunks, out_path, header, level=level):
+            _merge_paths(chunks, out_path, header, level=level)
     finally:
         reader.close()
         for c in chunks:
@@ -524,18 +554,6 @@ def _sorted_header(header: BamHeader) -> BamHeader:
     return BamHeader(text="".join(lines), refs=header.refs)
 
 
-def _spill(buf: list[BamRead], header: BamHeader) -> str:
-    buf.sort(key=lambda r: _coord_key(r, header))
-    fd, path = tempfile.mkstemp(suffix=".bam", prefix="ccsort.")
-    os.close(fd)
-    # level 1: spill chunks are throwaway (read back once, deleted) — don't
-    # pay full deflate on the sort hot path; the merged output stays level 6.
-    with BamWriter(path, header, level=1) as w:
-        for read in buf:
-            w.write(read)
-    return path
-
-
 def _merge_paths(paths: list[str], out_path, header: BamHeader, level: int = 6) -> None:
     readers = [BamReader(p) for p in paths]
     streams = [iter(r) for r in readers]
@@ -554,6 +572,17 @@ def _merge_paths(paths: list[str], out_path, header: BamHeader, level: int = 6) 
                 heapq.heappush(heap, (_coord_key(nxt, header), si, nxt))
     for r in readers:
         r.close()
+
+
+def _merge_large(in_paths: list, out_path, header: BamHeader, level: int,
+                 index: bool) -> None:
+    """Beyond-buffer merge: columnar k-way shuffle, heap-merge fallback."""
+    from consensuscruncher_tpu.io.columnar import merge_sorted_columnar
+
+    paths = [os.fspath(p) for p in in_paths]
+    if not merge_sorted_columnar(paths, out_path, header, level=level,
+                                 index=index):
+        _merge_paths(paths, out_path, header, level=level)
 
 
 def merge_bams(in_paths: list, out_path, level: int = 6, index: bool = True) -> None:
@@ -597,8 +626,7 @@ def merge_bams(in_paths: list, out_path, level: int = 6, index: bool = True) -> 
     # below remains the authoritative guard either way
     if sum(os.path.getsize(os.fspath(p)) for p in in_paths) > writer._max_raw:
         writer.abort()
-        _merge_paths([os.fspath(p) for p in in_paths], out_path, headers[0],
-                     level=level)
+        _merge_large(in_paths, out_path, headers[0], level, index)
         return
     raw = 0
     try:
@@ -609,8 +637,7 @@ def merge_bams(in_paths: list, out_path, level: int = 6, index: bool = True) -> 
                     raw += blob.size
                     if raw > writer._max_raw:
                         writer.abort()
-                        _merge_paths([os.fspath(p) for p in in_paths],
-                                     out_path, headers[0], level=level)
+                        _merge_large(in_paths, out_path, headers[0], level, index)
                         return
                     writer.write_encoded(blob)
     except BaseException:

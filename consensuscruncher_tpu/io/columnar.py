@@ -408,6 +408,179 @@ def coord_sort_perm(rid: np.ndarray, pos: np.ndarray, qname_matrix: np.ndarray,
     return np.lexsort(keys)
 
 
+class _ChunkRecordStream:
+    """Sequential record-blob fetcher over a coordinate-sorted chunk BAM.
+
+    ``fetch(n)`` returns the next ``n`` records' raw length-prefixed bytes
+    as ``(data, lengths)`` — batches decode lazily, so only a window of the
+    chunk is ever resident.  Building block of the columnar k-way merge.
+    """
+
+    def __init__(self, path):
+        self._reader = ColumnarReader(path)
+        self._batches = self._reader.batches()
+        self._cur: list[tuple[np.ndarray, np.ndarray, int]] = []  # buf, off, ptr
+
+    def fetch(self, n: int) -> tuple[np.ndarray, np.ndarray]:
+        pieces: list[np.ndarray] = []
+        lens: list[np.ndarray] = []
+        need = n
+        while need:
+            if not self._cur:
+                b = next(self._batches)  # StopIteration = caller bug
+                self._cur.append((b.buf, b.rec_off, 0))
+            buf, off, ptr = self._cur[0]
+            avail = len(off) - 1 - ptr
+            take = min(avail, need)
+            lo, hi = int(off[ptr]), int(off[ptr + take])
+            pieces.append(buf[lo:hi])
+            lens.append(np.diff(off[ptr : ptr + take + 1]))
+            need -= take
+            if take == avail:
+                self._cur.pop(0)
+            else:
+                self._cur[0] = (buf, off, ptr + take)
+        data = pieces[0] if len(pieces) == 1 else np.concatenate(pieces)
+        lengths = lens[0] if len(lens) == 1 else np.concatenate(lens)
+        return data, lengths
+
+    def close(self) -> None:
+        self._reader.close()
+
+
+_MERGE_WRITE_BLOCK = 65536  # records interleaved per output write
+
+
+def merge_sorted_columnar(paths: list, out_path, header: BamHeader,
+                          level: int = 6, index: bool = True,
+                          key_budget: int | None = None) -> bool:
+    """K-way merge of coordinate-sorted BAMs as a columnar byte shuffle.
+
+    Replaces the object heap merge (BamReader -> BamRead -> heapq -> encode,
+    measured ~6x slower end-to-end on 25M-record merges): load every
+    input's KEY columns only (rid/pos/qname/flag + the BAI span columns),
+    one stable global lexsort — np.lexsort over the concatenated keys
+    reproduces the heap's earlier-input-wins tie order — then stream each
+    input's raw record blobs sequentially and interleave them into the
+    output in vectorized blocks.  Record bytes are never decoded; the
+    ``.bai`` builds inline from the permuted span columns.
+
+    Returns False (caller falls back to the heap merge) when the key
+    columns would exceed ``key_budget`` bytes (default:
+    :func:`_default_merge_key_budget` — independent of the record-buffer
+    cap) — record bytes are streamed regardless, so the budget bounds only
+    ~90 B/record of keys.
+    """
+    from consensuscruncher_tpu.io.bam import _sorted_header
+    from consensuscruncher_tpu.utils.ragged import scatter_runs
+
+    if key_budget is None:
+        key_budget = _default_merge_key_budget()
+    n_chunks = len(paths)
+    rid_l, pos_l, flag_l, qm_l, lens_l = [], [], [], [], []
+    end_l, mapped_l = [], []
+    counts = np.zeros(n_chunks, dtype=np.int64)
+    key_bytes = 0
+    for ci, p in enumerate(paths):
+        with ColumnarReader(p) as r:
+            for b in r.batches():
+                off = b.rec_off[:-1]
+                rid_l.append(b.ref_id.astype(np.int64))
+                pos_l.append(b.pos.astype(np.int64))
+                flag_l.append(b.flag.astype(np.int64))
+                qm_l.append(b.qname_matrix)
+                lens_l.append(np.diff(b.rec_off))
+                if index:
+                    _rid, _pos, end, mapped = _record_spans_columnar(b.buf, off)
+                    end_l.append(end)
+                    mapped_l.append(mapped)
+                counts[ci] += b.n
+                key_bytes += b.n * 40 + b.qname_matrix.size + (9 * b.n if index else 0)
+                if key_bytes > key_budget:
+                    return False
+    n_total = int(counts.sum())
+    tmp = os.fspath(out_path) + ".tmp"
+    out_header = _sorted_header(header)
+    writer = bgzf.BgzfWriter(tmp, level=level, collect_blocks=index)
+    streams: list[_ChunkRecordStream] = []
+    try:
+        text = out_header.text.encode("ascii")
+        head = bytearray(BAM_MAGIC)
+        head += struct.pack("<i", len(text)) + text
+        head += struct.pack("<i", len(out_header.refs))
+        for name, length in out_header.refs:
+            bname = name.encode("ascii") + b"\x00"
+            head += struct.pack("<i", len(bname)) + bname + struct.pack("<i", length)
+        writer.write(bytes(head))
+
+        if n_total:
+            rid = np.concatenate(rid_l)
+            pos = np.concatenate(pos_l)
+            flag = np.concatenate(flag_l)
+            lengths = np.concatenate(lens_l)
+            w = max(m.shape[1] for m in qm_l)
+            qm = np.zeros((n_total, w), dtype=np.uint8)
+            row = 0
+            for m in qm_l:
+                qm[row : row + len(m), : m.shape[1]] = m
+                row += len(m)
+            del qm_l
+            perm = coord_sort_perm(rid, pos, qm, flag)
+            del qm
+            chunk_of = np.repeat(np.arange(n_chunks), counts).astype(np.int32)
+            src = chunk_of[perm]
+            out_lens = lengths[perm]
+
+            streams = [_ChunkRecordStream(p) for p in paths]
+            for i0 in range(0, n_total, _MERGE_WRITE_BLOCK):
+                i1 = min(i0 + _MERGE_WRITE_BLOCK, n_total)
+                src_b = src[i0:i1]
+                lens_b = out_lens[i0:i1]
+                starts_b = np.zeros(len(lens_b), dtype=np.int64)
+                np.cumsum(lens_b[:-1], out=starts_b[1:])
+                out_buf = np.empty(int(lens_b.sum()), dtype=np.uint8)
+                for ci in range(n_chunks):
+                    slots = np.nonzero(src_b == ci)[0]
+                    if not slots.size:
+                        continue
+                    # slots appear in chunk-sequential order (the global
+                    # sort preserves each sorted input's internal order)
+                    data, dlens = streams[ci].fetch(len(slots))
+                    scatter_runs(out_buf, starts_b[slots], data, dlens)
+                writer.write(out_buf.tobytes())
+        writer.close()
+        os.replace(tmp, out_path)
+    except BaseException:
+        writer.close()
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+    finally:
+        for s in streams:
+            s.close()
+
+    if index:
+        from consensuscruncher_tpu.io.bai import write_bai_from_columns
+
+        if n_total:
+            end = np.concatenate(end_l)
+            mapped = np.concatenate(mapped_l)
+            rid_p, pos_p = rid[perm], pos[perm]
+            end_p, mapped_p = end[perm], mapped[perm]
+            ustart = len(head) + np.concatenate(
+                [[0], np.cumsum(out_lens[:-1], dtype=np.int64)])
+        else:
+            rid_p = pos_p = end_p = ustart = np.zeros(0, np.int64)
+            mapped_p = np.zeros(0, bool)
+            out_lens = np.zeros(0, np.int64)
+        write_bai_from_columns(
+            os.fspath(out_path) + ".bai", len(out_header.refs),
+            rid_p, pos_p, end_p, mapped_p, ustart, ustart + out_lens,
+            writer.block_sizes,
+        )
+    return True
+
+
 def _record_spans_columnar(big: np.ndarray, starts: np.ndarray):
     """(rid, pos, end, mapped) per record, vectorized (the columnar twin of
     ``io.bai._record_span``): end = pos + ref-consumed cigar length (min 1),
@@ -496,32 +669,45 @@ def _write_bam_records(out_path, header: BamHeader, big: np.ndarray,
         )
 
 
+def _mem_available_bytes() -> int:
+    try:
+        with open("/proc/meminfo") as fh:
+            for line in fh:
+                if line.startswith("MemAvailable:"):
+                    return int(line.split()[1]) * 1024
+    except OSError:
+        pass
+    return 0
+
+
 def _default_sort_buffer_bytes() -> int:
     """Per-writer in-memory sort budget: env override, else RAM-aware.
 
     Spilling is DRAMATICALLY slower than buffering (the spill path finishes
-    through the chunked object-heap merge — measured 1,707 s vs ~250 s for
-    the in-memory sort on the same 25M-record output), so the cap should be
-    as high as the host can actually afford, not a fixed conservative
-    number.  Budget: a stage holds 2-3 sorting writers at once and close()
-    transiently needs ~2x the buffered bytes (concat + key columns +
-    gathered output chunks), so a per-writer cap of MemAvailable/8 keeps a
-    worst-case stage within available RAM.  Floor 4 GiB (the old fixed
-    default); the env var wins outright when set.
+    through the chunked merge — the old object-heap form measured 1,707 s
+    vs ~250 s for the in-memory sort on the same 25M-record output), so the
+    cap should be as high as the host can actually afford, not a fixed
+    conservative number.  Budget: a stage holds 2-3 sorting writers at once
+    and close() transiently needs ~2x the buffered bytes (concat + key
+    columns + gathered output chunks), so a per-writer cap of
+    MemAvailable/8 keeps a worst-case stage within available RAM.  Floor
+    4 GiB (the old fixed default); the env var wins outright when set.
     """
     env = os.environ.get("CCT_SORT_BUFFER_MAX_BYTES")
     if env:
         return int(env)
-    try:
-        with open("/proc/meminfo") as fh:
-            kb = 0
-            for line in fh:
-                if line.startswith("MemAvailable:"):
-                    kb = int(line.split()[1])
-                    break
-    except OSError:
-        kb = 0
-    return max(4 << 30, (kb * 1024) // 8)
+    return max(4 << 30, _mem_available_bytes() // 8)
+
+
+def _default_merge_key_budget() -> int:
+    """Key-column budget for :func:`merge_sorted_columnar` — deliberately
+    INDEPENDENT of CCT_SORT_BUFFER_MAX_BYTES: keys are ~30x smaller than
+    raw record bytes, so a host too small to buffer records in full can
+    still afford the columnar merge (that's exactly when it matters)."""
+    env = os.environ.get("CCT_MERGE_KEY_BUDGET_BYTES")
+    if env:
+        return int(env)
+    return max(1 << 30, _mem_available_bytes() // 8)
 
 
 class SortingBamWriter:

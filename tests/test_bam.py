@@ -245,3 +245,104 @@ def test_atomic_writer(tmp_path):
     assert p.exists()
     with BamReader(str(p)) as rd:
         assert len(list(rd)) == 1
+
+
+def test_external_sort_columnar_matches_in_memory(tmp_path, monkeypatch):
+    """The spilled external sort (columnar chunks + columnar k-way merge)
+    must reproduce the in-memory sort byte-for-byte, including the inline
+    .bai, on unsorted multi-ref input with duplicate coordinates."""
+    import numpy as np
+
+    from consensuscruncher_tpu.io.bai import index_bam
+    from consensuscruncher_tpu.io.bam import (
+        BamHeader, BamRead, BamReader, BamWriter, sort_bam,
+    )
+
+    rng = np.random.default_rng(71)
+    header = BamHeader.from_refs([("chrA", 100_000), ("chrB", 100_000)])
+    unsorted = str(tmp_path / "u.bam")
+    with BamWriter(unsorted, header) as w:
+        for i in range(4000):
+            ref = ("chrA", "chrB")[int(rng.integers(0, 2))]
+            pos = int(rng.integers(0, 90_000)) & ~3  # force coordinate ties
+            w.write(BamRead(
+                qname=f"r{i:05d}", flag=int(rng.integers(0, 2)) * 16,
+                ref=ref, pos=pos, mapq=60, cigar=[("M", 50)],
+                mate_ref=ref, mate_pos=pos, tlen=50,
+                seq="ACGT" * 12 + "AC", qual=np.full(50, 30, np.uint8),
+            ))
+
+    import os
+
+    mem = str(tmp_path / "mem.bam")
+    sort_bam(unsorted, mem)  # in-memory columnar path
+
+    ext = str(tmp_path / "ext.bam")
+    # force the external path: shrink the fast-path ceiling + chunk size
+    import consensuscruncher_tpu.io.bam as bam_mod
+
+    monkeypatch.setattr(bam_mod, "_COLUMNAR_SORT_MAX_BYTES", 0)
+    sort_bam(unsorted, ext, max_in_memory=500)  # ~8 chunks
+
+    def records(p):
+        with BamReader(p) as r:
+            return list(r)
+
+    a, b = records(mem), records(ext)
+    assert len(a) == len(b) == 4000
+    for ra, rb in zip(a, b):
+        assert ra == rb, f"order mismatch at {ra.qname} vs {rb.qname}"
+
+    assert os.path.exists(ext + ".bai")
+    inline = open(ext + ".bai", "rb").read()
+    rebuilt = open(index_bam(ext, str(tmp_path / "r.bai")), "rb").read()
+    assert inline == rebuilt
+
+
+def test_merge_large_columnar_matches_heap(tmp_path, monkeypatch):
+    """merge_bams' beyond-buffer path (columnar k-way merge) must match the
+    object heap merge record-for-record, ties breaking by input order."""
+    import numpy as np
+
+    from consensuscruncher_tpu.io.bam import (
+        BamHeader, BamRead, BamReader, BamWriter, _merge_paths, merge_bams,
+    )
+    from consensuscruncher_tpu.io.columnar import SortingBamWriter
+
+    rng = np.random.default_rng(72)
+    header = BamHeader.from_refs([("chr1", 50_000)])
+    paths = []
+    for k in range(3):
+        p = str(tmp_path / f"in{k}.bam")
+        with SortingBamWriter(p, header) as w:
+            for i in range(800):
+                pos = int(rng.integers(0, 1_000))  # heavy coordinate ties
+                w.write(BamRead(
+                    qname=f"s{k}_{i:04d}", flag=0, ref="chr1", pos=pos,
+                    mapq=60, cigar=[("M", 30)], mate_ref="chr1", mate_pos=pos,
+                    tlen=30, seq="A" * 30, qual=np.full(30, 25, np.uint8),
+                ))
+        paths.append(p)
+
+    heap_out = str(tmp_path / "heap.bam")
+    _merge_paths(paths, heap_out, header)
+
+    col_out = str(tmp_path / "col.bam")
+    # force the beyond-buffer branch by shrinking the writer buffer
+    monkeypatch.setenv("CCT_SORT_BUFFER_MAX_BYTES", "1")
+    merge_bams(paths, col_out)
+    monkeypatch.delenv("CCT_SORT_BUFFER_MAX_BYTES")
+
+    def records(p):
+        with BamReader(p) as r:
+            return list(r)
+
+    # the columnar branch actually ran: it writes the inline .bai
+    # (the heap fallback does not)
+    import os
+
+    assert os.path.exists(col_out + ".bai")
+    a, b = records(heap_out), records(col_out)
+    assert len(a) == len(b) == 2400
+    for ra, rb in zip(a, b):
+        assert ra == rb, f"merge order mismatch: {ra.qname} vs {rb.qname}"
