@@ -504,35 +504,57 @@ def sort_bam(in_path, out_path, max_in_memory: int = 2_000_000, level: int = 6) 
 
     chunk_budget = max(256 << 20, _default_sort_buffer_bytes() // 4)
 
-    def spill_chunk(writer: SortingBamWriter) -> None:
-        writer.close()
-        chunks.append(writer._path)
+    def new_chunk_writer() -> SortingBamWriter:
+        fd, path = tempfile.mkstemp(suffix=".bam", prefix="ccsort.")
+        os.close(fd)
+        chunks.append(path)  # registered BEFORE use so cleanup always sees it
+        # level 1 + no index: throwaway chunks, read back once
+        return SortingBamWriter(path, header, level=1, index=False,
+                                max_raw_bytes=chunk_budget * 2)
+
+    def spill(blobs) -> None:
+        w = new_chunk_writer()
+        try:
+            for p in blobs:
+                w.write_encoded(p)
+        except BaseException:
+            w.abort()
+            raise
+        w.close()
 
     try:
-        w = None
+        pending: list = []  # raw blobs of the chunk being accumulated
         raw = n = 0
         for b in reader.batches():
-            if w is None:
-                fd, path = tempfile.mkstemp(suffix=".bam", prefix="ccsort.")
-                os.close(fd)
-                # level 1 + no index: throwaway chunks, read back once
-                w = SortingBamWriter(path, header, level=1, index=False,
-                                     max_raw_bytes=chunk_budget * 2)
             blob = b.buf[: int(b.rec_off[-1])]
-            w.write_encoded(blob)
+            pending.append(blob)
             raw += blob.size
             n += b.n
             if raw > chunk_budget or n > max_in_memory:
-                spill_chunk(w)
-                w = None
-                raw = n = 0
-        if w is not None:
-            spill_chunk(w)
-        if not chunks:  # empty input
-            SortingBamWriter(os.fspath(out_path), header, level=level).close()
+                spill(pending)
+                pending, raw, n = [], 0, 0
+        if not chunks:
+            # everything fit one buffer: sort + write the output directly
+            # (no temp round trip, inline index)
+            final = SortingBamWriter(os.fspath(out_path), header, level=level)
+            try:
+                for p in pending:
+                    final.write_encoded(p)
+            except BaseException:
+                final.abort()
+                raise
+            final.close()
             return
-        if not merge_sorted_columnar(chunks, out_path, header, level=level):
+        if pending:
+            spill(pending)
+            pending = []
+        # our own chunks are full-key-sorted by construction -> skip verify
+        if not merge_sorted_columnar(chunks, out_path, header, level=level,
+                                     verify_sorted=False):
             _merge_paths(chunks, out_path, header, level=level)
+            from consensuscruncher_tpu.io.bai import index_bam
+
+            index_bam(out_path)  # parity with the columnar merge's inline .bai
     finally:
         reader.close()
         for c in chunks:
@@ -583,6 +605,10 @@ def _merge_large(in_paths: list, out_path, header: BamHeader, level: int,
     if not merge_sorted_columnar(paths, out_path, header, level=level,
                                  index=index):
         _merge_paths(paths, out_path, header, level=level)
+        if index:  # parity with the columnar merge's inline .bai
+            from consensuscruncher_tpu.io.bai import index_bam
+
+            index_bam(out_path)
 
 
 def merge_bams(in_paths: list, out_path, level: int = 6, index: bool = True) -> None:

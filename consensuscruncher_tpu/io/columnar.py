@@ -453,7 +453,8 @@ _MERGE_WRITE_BLOCK = 65536  # records interleaved per output write
 
 def merge_sorted_columnar(paths: list, out_path, header: BamHeader,
                           level: int = 6, index: bool = True,
-                          key_budget: int | None = None) -> bool:
+                          key_budget: int | None = None,
+                          verify_sorted: bool = True) -> bool:
     """K-way merge of coordinate-sorted BAMs as a columnar byte shuffle.
 
     Replaces the object heap merge (BamReader -> BamRead -> heapq -> encode,
@@ -469,7 +470,12 @@ def merge_sorted_columnar(paths: list, out_path, header: BamHeader,
     columns would exceed ``key_budget`` bytes (default:
     :func:`_default_merge_key_budget` — independent of the record-buffer
     cap) — record bytes are streamed regardless, so the budget bounds only
-    ~90 B/record of keys.
+    ~90 B/record of keys — or when ``verify_sorted`` finds an input whose
+    physical order is not its full-key order (legal for samtools-sorted
+    foreign BAMs with arbitrary coordinate-tie order; the interleave
+    would corrupt such a file, the heap merge handles it).  Callers
+    merging THIS framework's own outputs (full-key-sorted by
+    construction) may pass ``verify_sorted=False`` to skip the check.
     """
     from consensuscruncher_tpu.io.bam import _sorted_header
     from consensuscruncher_tpu.utils.ragged import scatter_runs
@@ -481,6 +487,7 @@ def merge_sorted_columnar(paths: list, out_path, header: BamHeader,
     end_l, mapped_l = [], []
     counts = np.zeros(n_chunks, dtype=np.int64)
     key_bytes = 0
+    batch_bounds = [0]  # per-chunk [start, end) into the per-batch lists
     for ci, p in enumerate(paths):
         with ColumnarReader(p) as r:
             for b in r.batches():
@@ -498,7 +505,41 @@ def merge_sorted_columnar(paths: list, out_path, header: BamHeader,
                 key_bytes += b.n * 40 + b.qname_matrix.size + (9 * b.n if index else 0)
                 if key_bytes > key_budget:
                     return False
+        batch_bounds.append(len(rid_l))
     n_total = int(counts.sum())
+    qw = max((m.shape[1] for m in qm_l), default=0)
+    # Charge the REAL peak, not just the per-batch sum: the zero-padded
+    # global qname matrix coexists with the per-batch pieces while filling,
+    # and perm/src/out_lens/chunk_of add ~28 B/record.
+    if key_bytes + n_total * (qw + 28) > key_budget:
+        return False
+
+    if verify_sorted and n_total:
+        # The interleave assumes each input's PHYSICAL record order is its
+        # full (rid, pos, qname, flag) key order — true for every BAM this
+        # framework writes, but samtools guarantees only (rid, pos) order
+        # with arbitrary tie order, and a tie-misordered foreign input
+        # would get other records' lengths scattered over its blobs (a
+        # corrupt BAM, not just a misordering).  Verify per input; any
+        # violation -> decline, the record-decoding heap merge handles it.
+        for ci in range(n_chunks):
+            n_c = int(counts[ci])
+            if n_c <= 1:
+                continue
+            i0, i1 = batch_bounds[ci], batch_bounds[ci + 1]
+            rid_c = np.concatenate(rid_l[i0:i1])
+            pos_c = np.concatenate(pos_l[i0:i1])
+            flag_c = np.concatenate(flag_l[i0:i1])
+            w_c = max(m.shape[1] for m in qm_l[i0:i1])
+            qm_c = np.zeros((n_c, w_c), dtype=np.uint8)
+            r = 0
+            for m in qm_l[i0:i1]:
+                qm_c[r : r + len(m), : m.shape[1]] = m
+                r += len(m)
+            if not np.array_equal(coord_sort_perm(rid_c, pos_c, qm_c, flag_c),
+                                  np.arange(n_c)):
+                return False
+
     tmp = os.fspath(out_path) + ".tmp"
     out_header = _sorted_header(header)
     writer = bgzf.BgzfWriter(tmp, level=level, collect_blocks=index)

@@ -346,3 +346,54 @@ def test_merge_large_columnar_matches_heap(tmp_path, monkeypatch):
     assert len(a) == len(b) == 2400
     for ra, rb in zip(a, b):
         assert ra == rb, f"merge order mismatch: {ra.qname} vs {rb.qname}"
+
+
+def test_merge_large_foreign_tie_order_falls_back_safely(tmp_path, monkeypatch):
+    """A coordinate-sorted input whose SAME-(rid,pos) records are NOT in
+    qname order is legal samtools output; the columnar merge must decline
+    it (its interleave would corrupt the blobs) and the heap fallback must
+    produce exactly what the heap merge always produced."""
+    import os
+
+    import numpy as np
+
+    from consensuscruncher_tpu.io.bam import (
+        BamHeader, BamRead, BamReader, BamWriter, _merge_paths, merge_bams,
+    )
+
+    header = BamHeader.from_refs([("chr1", 10_000)])
+    paths = []
+    for k in range(2):
+        p = str(tmp_path / f"f{k}.bam")
+        with BamWriter(p, header) as w:
+            # ties at pos 100 deliberately in REVERSE qname order with
+            # different record lengths (the corruption trigger)
+            w.write(BamRead(qname="zzzz_long_name_" + "x" * 40, flag=0,
+                            ref="chr1", pos=100, mapq=60, cigar=[("M", 30)],
+                            mate_ref="chr1", mate_pos=100, tlen=30,
+                            seq="A" * 30, qual=np.full(30, 25, np.uint8)))
+            w.write(BamRead(qname="aaa", flag=0, ref="chr1", pos=100, mapq=60,
+                            cigar=[("M", 30)], mate_ref="chr1", mate_pos=100,
+                            tlen=30, seq="C" * 30, qual=np.full(30, 25, np.uint8)))
+            w.write(BamRead(qname="mmm", flag=0, ref="chr1", pos=500, mapq=60,
+                            cigar=[("M", 30)], mate_ref="chr1", mate_pos=500,
+                            tlen=30, seq="G" * 30, qual=np.full(30, 25, np.uint8)))
+        paths.append(p)
+
+    heap_out = str(tmp_path / "heap.bam")
+    _merge_paths(paths, heap_out, header)
+
+    out = str(tmp_path / "merged.bam")
+    monkeypatch.setenv("CCT_SORT_BUFFER_MAX_BYTES", "1")  # force large path
+    merge_bams(paths, out)
+
+    def records(p):
+        with BamReader(p) as r:
+            return list(r)
+
+    a, b = records(heap_out), records(out)
+    assert len(a) == len(b) == 6
+    for ra, rb in zip(a, b):
+        assert ra == rb
+    # heap fallback + index=True still yields the .bai (parity with inline)
+    assert os.path.exists(out + ".bai")
