@@ -210,10 +210,16 @@ def consensus(args) -> dict:
     # one whole sample per chip — sample-pinning would idle 7 chips during
     # every sample's host-bound decode/sort phases, whereas family-sharding
     # keeps all chips on whichever sample is in flight.
+    def run_one(one_args) -> dict:
+        hw = getattr(one_args, "host_workers", 1) or 1
+        if int(hw) > 1:
+            return _consensus_host_sharded(one_args)
+        return _consensus_impl(one_args)
+
     inputs = [p.strip() for p in str(args.input).split(",") if p.strip()]
     with maybe_profile(getattr(args, "profile", None)):
         if len(inputs) <= 1:
-            return _consensus_impl(args)
+            return run_one(args)
         if args.name:
             raise SystemExit(
                 "--name cannot combine with a multi-sample --input batch "
@@ -227,8 +233,144 @@ def consensus(args) -> dict:
             sub.input = inp
             sub.name = None  # per-sample stem
             print(f"consensus: batch sample {inp}")
-            results[inp] = _consensus_impl(sub)
+            results[inp] = run_one(sub)
         return results
+
+
+def _consensus_host_sharded(args) -> dict:
+    """``--host_workers N``: coordinate-range data parallelism over worker
+    processes (see ``parallel.hostshard``).  The whole consensus flow is
+    position-local, so N workers each run the standard pipeline on a
+    disjoint range slice and the parent merges every output class, sums the
+    stats/histograms, and draws the plots.  Each worker is a real process —
+    its own GIL, its own native codec pool, and (on real hardware) its own
+    chip — which is the host-side multiplier of the north-star plan that a
+    single CPython process cannot express."""
+    import shutil
+    import subprocess
+
+    from consensuscruncher_tpu.parallel import hostshard
+    from consensuscruncher_tpu.utils.stats import TimeTracker
+
+    n = int(args.host_workers)
+    if getattr(args, "resume", False):
+        raise SystemExit("--resume is not supported with --host_workers > 1")
+    name = args.name or os.path.basename(args.input).split(".")[0]
+    base = os.path.join(args.output, name)
+    dirs = {k: os.path.join(base, k) for k in ("sscs", "singleton", "dcs", "all_unique", "plots")}
+    for d in dirs.values():
+        os.makedirs(d, exist_ok=True)
+    ranges_dir = os.path.join(base, ".ranges")
+    tracker = TimeTracker()
+
+    slices = hostshard.split_bam_ranges(args.input, n, ranges_dir)
+    tracker.mark("split")
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    base_env = dict(os.environ)
+    # -m resolves via the child's sys.path; splice the repo root in so the
+    # workers import this checkout regardless of their cwd
+    base_env["PYTHONPATH"] = repo_root + os.pathsep + base_env.get("PYTHONPATH", "")
+    chips_per_worker = int(getattr(args, "devices", None) or 1)
+    procs = []
+    for i, sl in enumerate(slices):
+        argv = hostshard.worker_argv(sl, ranges_dir, f"r{i}", args)
+        env = dict(base_env)
+        if str(args.backend) == "tpu":
+            # chips x cores: worker i owns chips [i*d, (i+1)*d) — TPU
+            # runtimes are exclusive-access per process, so visibility must
+            # partition (the PJRT plugin honors TPU_VISIBLE_DEVICES /
+            # TPU_PROCESS_BOUNDS-style controls on real hardware)
+            chips = range(i * chips_per_worker, (i + 1) * chips_per_worker)
+            env["TPU_VISIBLE_DEVICES"] = ",".join(str(c) for c in chips)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "consensuscruncher_tpu.cli", *argv],
+            env=env, stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
+        ))
+    failures = []
+    for i, p in enumerate(procs):
+        _out, err = p.communicate()
+        if p.returncode != 0:
+            tail = err.decode(errors="replace").strip().splitlines()[-4:]
+            failures.append(f"worker {i} rc={p.returncode}: " + " | ".join(tail))
+    if failures:
+        raise SystemExit("host-sharded consensus failed:\n" + "\n".join(failures))
+    tracker.mark("workers")
+
+    def rpaths(rel_fmt: str) -> list[str]:
+        return [os.path.join(ranges_dir, f"r{i}", rel_fmt.format(n=f"r{i}"))
+                for i in range(n)]
+
+    level = args.compress_level
+    # BAM classes: disjoint sorted ranges -> the merge is an ordered
+    # concatenation with a fresh inline index
+    bam_classes = [
+        ("sscs/{n}.sscs.sorted.bam", os.path.join(dirs["sscs"], f"{name}.sscs.sorted.bam")),
+        ("sscs/{n}.singleton.sorted.bam", os.path.join(dirs["sscs"], f"{name}.singleton.sorted.bam")),
+        ("dcs/{n}.dcs.sorted.bam", os.path.join(dirs["dcs"], f"{name}.dcs.sorted.bam")),
+        ("dcs/{n}.sscs.singleton.sorted.bam", os.path.join(dirs["dcs"], f"{name}.sscs.singleton.sorted.bam")),
+        ("all_unique/{n}.all.unique.sscs.bam", os.path.join(dirs["all_unique"], f"{name}.all.unique.sscs.bam")),
+        ("all_unique/{n}.all.unique.dcs.bam", os.path.join(dirs["all_unique"], f"{name}.all.unique.dcs.bam")),
+    ]
+    if args.scorrect:
+        bam_classes += [
+            ("singleton/{n}.sscs.rescue.sorted.bam", os.path.join(dirs["singleton"], f"{name}.sscs.rescue.sorted.bam")),
+            ("singleton/{n}.singleton.rescue.sorted.bam", os.path.join(dirs["singleton"], f"{name}.singleton.rescue.sorted.bam")),
+            ("singleton/{n}.remaining.singleton.sorted.bam", os.path.join(dirs["singleton"], f"{name}.remaining.singleton.sorted.bam")),
+        ]
+    if args.scorrect and not args.cleanup:
+        # the rescued-merge DCS input survives a non-cleanup single-process
+        # run; keep the sharded tree shape identical
+        bam_classes.append(("dcs/{n}.sscs.rescued.bam",
+                            os.path.join(dirs["dcs"], f"{name}.sscs.rescued.bam")))
+    for rel, out in bam_classes:
+        parts = [p for p in rpaths(rel) if os.path.exists(p)]
+        merge_bams(parts, out, level=level)
+    # badReads: unsorted diagnostic stream — ordered concatenation (skipped
+    # under --cleanup, which deletes it at the end of a single-process run)
+    if not args.cleanup:
+        from consensuscruncher_tpu.io.bam import BamReader
+
+        with BamReader(slices[0]) as _r:
+            in_header = _r.header
+        hostshard.concat_bams(
+            [p for p in rpaths("sscs/{n}.badReads.bam") if os.path.exists(p)],
+            os.path.join(dirs["sscs"], f"{name}.badReads.bam"), in_header,
+            level=level,
+        )
+
+    # stats / histograms / plots
+    hostshard.aggregate_stats(rpaths("sscs/{n}.sscs_stats.json"), "SSCS",
+                              os.path.join(dirs["sscs"], f"{name}.sscs_stats.txt"))
+    stats_jsons = [os.path.join(dirs["sscs"], f"{name}.sscs_stats.json")]
+    if args.scorrect:
+        hostshard.aggregate_stats(
+            rpaths("singleton/{n}.singleton_stats.json"), "singleton_correction",
+            os.path.join(dirs["singleton"], f"{name}.singleton_stats.txt"))
+        stats_jsons.append(os.path.join(dirs["singleton"], f"{name}.singleton_stats.json"))
+    hostshard.aggregate_stats(rpaths("dcs/{n}.dcs_stats.json"), "DCS",
+                              os.path.join(dirs["dcs"], f"{name}.dcs_stats.txt"))
+    stats_jsons.append(os.path.join(dirs["dcs"], f"{name}.dcs_stats.json"))
+    families_txt = os.path.join(dirs["sscs"], f"{name}.read_families.txt")
+    hostshard.aggregate_histograms(rpaths("sscs/{n}.read_families.txt"), families_txt)
+    tracker.mark("merge")
+    tracker.write(os.path.join(dirs["sscs"], f"{name}.time_tracker.txt"))
+
+    plot_family_size(families_txt,
+                     os.path.join(dirs["plots"], f"{name}.family_size.png"))
+    plot_read_recovery(stats_jsons,
+                       os.path.join(dirs["plots"], f"{name}.read_recovery.png"))
+    plot_stage_times(
+        [os.path.join(ranges_dir, f"r{i}", "sscs", f"r{i}.metrics.json")
+         for i in range(n)],
+        os.path.join(dirs["plots"], f"{name}.stage_times.png"),
+    )
+
+    shutil.rmtree(ranges_dir, ignore_errors=True)
+    print(f"consensus: outputs under {base} ({n} host workers)")
+    return {"all_sscs": os.path.join(dirs["all_unique"], f"{name}.all.unique.sscs.bam"),
+            "all_dcs": os.path.join(dirs["all_unique"], f"{name}.all.unique.dcs.bam"),
+            "dirs": dirs}
 
 
 def _consensus_impl(args) -> dict:
@@ -480,6 +622,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="BGZF deflate level of output BAMs (default 6, the "
                         "htslib default; 1 trades ~15%% larger files for "
                         "much faster writes — deflate is a top host cost)")
+    c.add_argument("--host_workers", type=int, metavar="N",
+                   help="coordinate-range data parallelism: N worker "
+                        "processes each run the full pipeline on a disjoint "
+                        "range of the input (the flow is position-local), "
+                        "outputs merge by concatenation. The host-core "
+                        "multiplier on multi-core machines; default 1")
     c.set_defaults(func=consensus, config_section="consensus",
                    required_args=("input", "output"),
                    builtin_defaults={
@@ -487,6 +635,7 @@ def build_parser() -> argparse.ArgumentParser:
                        "max_mismatch": 0, "backend": "tpu",
                        "bdelim": DEFAULT_BDELIM, "cleanup": "False",
                        "resume": "False", "compress_level": 6,
+                       "host_workers": 1,
                    })
     return p
 
@@ -521,6 +670,8 @@ def main(argv=None) -> int:
         args.devices = int(args.devices)
     if getattr(args, "compress_level", None) is not None:
         args.compress_level = int(args.compress_level)
+    if getattr(args, "host_workers", None) is not None:
+        args.host_workers = int(args.host_workers)
 
     args.func(args)
     return 0

@@ -375,6 +375,49 @@ class BgzfWriter(io.RawIOBase):
         super().close()
 
 
+def total_isize(path) -> int:
+    """Total UNCOMPRESSED size of a BGZF file by framing hops only.
+
+    Parses each block's header exactly like :func:`read_block` (the BC
+    subfield may sit anywhere in the gzip extra field — SAM spec §4.1
+    allows neighbours, so the 18-byte fast layout is not assumed), seeks
+    past the deflate payload, reads the 4-byte ISIZE tail — never
+    inflates.  One buffered sequential pass; used to plan balanced splits.
+    """
+    total = 0
+    with open(path, "rb") as fh:
+        while True:
+            header = fh.read(18)
+            if not header:
+                return total
+            if len(header) < 18 or header[0] != 0x1F or header[1] != 0x8B:
+                raise ValueError(f"{os.fspath(path)!r}: bad BGZF framing")
+            (xlen,) = struct.unpack_from("<H", header, 10)
+            extra = header[12:18]
+            if xlen > 6:
+                extra += fh.read(xlen - 6)
+                if len(extra) < xlen:
+                    raise ValueError(f"{os.fspath(path)!r}: truncated BGZF extra field")
+            bsize = None
+            off = 0
+            while off + 4 <= xlen:
+                si1, si2 = extra[off], extra[off + 1]
+                (slen,) = struct.unpack_from("<H", extra, off + 2)
+                if si1 == 0x42 and si2 == 0x43 and slen == 2:
+                    (bsize,) = struct.unpack_from("<H", extra, off + 4)
+                    break
+                off += 4 + slen
+            if bsize is None:
+                raise ValueError(
+                    f"{os.fspath(path)!r}: gzip member lacks the BGZF BC subfield")
+            # consumed so far: 12 fixed + xlen extra; ISIZE = last 4 bytes
+            fh.seek(bsize + 1 - 12 - xlen - 4, 1)
+            isize = fh.read(4)
+            if len(isize) < 4:
+                raise ValueError(f"{os.fspath(path)!r}: truncated BGZF block")
+            total += struct.unpack("<I", isize)[0]
+
+
 def decompress_file(path) -> bytes:
     """Whole-file BGZF -> bytes (convenience for small files/tests)."""
     with open(path, "rb") as fh:

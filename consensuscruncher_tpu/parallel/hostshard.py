@@ -1,0 +1,188 @@
+"""Host-side data parallelism: coordinate-range sharding across processes.
+
+The north-star arithmetic (BASELINE.md "≥50x plan") has two multipliers: the
+chip mesh (``parallel.mesh``) and HOST cores.  The pipeline's entire
+consensus flow is position-local — family members, rescue partners, and
+duplex pairs all share one ``(ref, pos)`` anchor (core/tags.py) — so any
+coordinate boundary partitions the work exactly: N workers each run the
+FULL SSCS → rescue → DCS chain on a disjoint coordinate range of the input
+and the outputs concatenate.  This module owns the range split and the
+result aggregation; ``cli.consensus --host_workers N`` orchestrates worker
+processes around it.
+
+Design notes:
+- The reference is single-process/single-thread (SURVEY.md §2 parallelism);
+  this axis is the rebuild's answer to the CPython GIL on multi-core hosts
+  (each worker is a real process with its own interpreter, native codec
+  pool, and — on real hardware — its own TPU chip via the plugin's visible-
+  devices controls).
+- Splitting is a framing-cheap byte shuffle: one pass over the input's
+  blocks routing raw record blobs, breaking only where ``(rid, pos)``
+  changes (never inside a family) and keeping the unplaced tail (rid < 0)
+  in the final slice.  Slices are BGZF level-1 throwaways.
+- Aggregation = merge per output class (disjoint sorted ranges — the merge
+  degenerates to ordered concatenation), summed stats counters, summed
+  family-size histograms.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+from consensuscruncher_tpu.io.bam import BamWriter
+from consensuscruncher_tpu.io.bgzf import total_isize
+from consensuscruncher_tpu.utils.stats import FamilySizeHistogram, StageStats
+
+
+def split_bam_ranges(in_bam: str, n: int, out_dir: str) -> list[str]:
+    """Split a coordinate-sorted BAM into ``n`` range slices of roughly
+    equal uncompressed size.  Returns the slice paths (some may hold zero
+    records when the input has fewer distinct positions than slices).
+
+    Boundaries fall only where ``(rid, pos)`` changes, so no family — and
+    therefore no rescue or duplex pairing — ever spans two slices; records
+    with ``rid < 0`` (unplaced tail of a sorted BAM) stay in the last
+    open slice.
+    """
+    from consensuscruncher_tpu.io.columnar import ColumnarReader
+
+    os.makedirs(out_dir, exist_ok=True)
+    target = max(1, total_isize(in_bam) // n)
+    reader = ColumnarReader(in_bam)
+    paths: list[str] = []
+    writer = None
+    written = 0
+    last_key: tuple[int, int] | None = None
+
+    def next_writer() -> BamWriter:
+        nonlocal writer, written
+        if writer is not None:
+            writer.close()
+        path = os.path.join(out_dir, f"range{len(paths):03d}.bam")
+        paths.append(path)
+        writer = BamWriter(path, reader.header, level=1)
+        written = 0
+        return writer
+
+    try:
+        next_writer()
+        for b in reader.batches():
+            if not b.n:
+                continue
+            rid = b.ref_id.astype(np.int64)
+            pos = b.pos.astype(np.int64)
+            off = b.rec_off
+            # legal boundaries: (rid, pos) differs from the predecessor and
+            # the record is placed (never split or strand the unplaced tail)
+            same = np.empty(b.n, dtype=bool)
+            same[0] = last_key == (int(rid[0]), int(pos[0]))
+            np.logical_and(rid[1:] == rid[:-1], pos[1:] == pos[:-1],
+                           out=same[1:])
+            boundary = np.nonzero(~same & (rid >= 0))[0]
+            start = 0
+            # the target may have been reached exactly at the previous
+            # batch's end — rotate before writing if this batch opens on a
+            # legal boundary
+            if (written >= target and len(paths) < n and not same[0]
+                    and rid[0] >= 0):
+                next_writer()
+            while start < b.n:
+                end = b.n
+                if len(paths) < n:
+                    # earliest boundary whose preceding bytes reach target
+                    need = target - written
+                    k0 = start + int(np.searchsorted(
+                        off[start + 1 :] - off[start], need))
+                    j = np.searchsorted(boundary, max(k0, start + 1))
+                    if j < len(boundary):
+                        end = int(boundary[j])
+                writer.write_encoded(b.buf[int(off[start]) : int(off[end])])
+                written += int(off[end] - off[start])
+                last_key = (int(rid[end - 1]), int(pos[end - 1]))
+                if end < b.n:
+                    next_writer()
+                start = end
+    finally:
+        reader.close()
+        if writer is not None:
+            writer.close()
+    # materialize empty slices so workers/aggregation stay uniform
+    while len(paths) < n:
+        path = os.path.join(out_dir, f"range{len(paths):03d}.bam")
+        paths.append(path)
+        BamWriter(path, reader.header, level=1).close()
+    return paths
+
+
+_NON_SUMMED = {"stage", "backend", "jax_backend", "cutoff", "max_mismatch"}
+
+
+def aggregate_stats(json_paths: list[str], stage: str, out_txt: str) -> StageStats:
+    """Sum worker stats JSONs into one stage-stats file pair."""
+    agg = StageStats(stage)
+    for p in json_paths:
+        if not os.path.exists(p):
+            continue
+        with open(p) as fh:
+            data = json.load(fh)
+        for key, value in data.items():
+            if key == "stage":
+                continue  # StageStats carries the stage itself
+            if key in _NON_SUMMED:
+                if agg.get(key, None) in (None, 0):
+                    agg.set(key, value)
+            elif isinstance(value, (int, float)):
+                agg.incr(key, value)
+    agg.write(out_txt)
+    return agg
+
+
+def aggregate_histograms(paths: list[str], out_path: str) -> None:
+    """Sum worker family-size histograms into one ``read_families.txt``."""
+    agg = FamilySizeHistogram()
+    for p in paths:
+        if not os.path.exists(p):
+            continue
+        for size, count in FamilySizeHistogram.read(p).items():
+            agg.counts[size] += count
+    agg.write(out_path)
+
+
+def concat_bams(paths: list[str], out_path: str, header, level: int = 6) -> None:
+    """Ordered raw concatenation of BAMs (disjoint, already-ordered inputs
+    — e.g. per-range badReads in range order).  No sorting, no decode."""
+    from consensuscruncher_tpu.io.columnar import ColumnarReader
+
+    writer = BamWriter(os.fspath(out_path), header, level=level, atomic=True)
+    try:
+        for p in paths:
+            if not os.path.exists(p):
+                continue
+            with ColumnarReader(p) as r:
+                for b in r.batches():
+                    writer.write_encoded(b.buf[: int(b.rec_off[-1])])
+    except BaseException:
+        writer.abort()
+        raise
+    writer.close()
+
+
+def worker_argv(slice_path: str, out_dir: str, name: str, args) -> list[str]:
+    """Build a worker's ``consensus`` argv from the parent's parsed args
+    (original pre-coercion surface; workers re-run the normal CLI)."""
+    argv = [
+        "consensus", "-i", slice_path, "-o", out_dir, "-n", name,
+        "--backend", str(args.backend),
+        "--cutoff", str(args.cutoff),
+        "--qualscore", str(args.qualscore),
+        "--scorrect", str(args.scorrect),
+        "--max_mismatch", str(args.max_mismatch),
+        "--bdelim", args.bdelim,
+        "--compress_level", str(args.compress_level),
+    ]
+    if getattr(args, "devices", None):
+        argv += ["--devices", str(args.devices)]
+    return argv

@@ -9,6 +9,8 @@ import numpy as np
 import pytest
 
 from consensuscruncher_tpu.cli import main
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 from consensuscruncher_tpu.io.bam import BamReader
 from consensuscruncher_tpu.io.fastq import FastqWriter
 from consensuscruncher_tpu.utils.simulate import SimConfig, simulate_bam
@@ -283,3 +285,47 @@ def test_consensus_multi_sample_batch(tmp_path):
     with pytest.raises(SystemExit):
         cli_main(["consensus", "-i", f"{a},{b}", "-o", str(tmp_path / "x"),
                   "-n", "clash", "--backend", "cpu"])
+
+
+def test_consensus_host_workers_parity(tmp_path):
+    """--host_workers N (coordinate-range data parallelism over worker
+    processes) must reproduce the single-process run: identical canonical
+    BAM digests and identical summed stats/histograms on the adversarial
+    fixture (indel cigars, flag soup, unplaced tail)."""
+    import glob
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(REPO, "test"))
+    from make_test_data import canonical_bam_digest
+
+    from consensuscruncher_tpu.cli import main as cli_main
+
+    src = os.path.join(REPO, "test", "data", "sample_adversarial.bam")
+    # xla_cpu: the tpu code path pinned to CPU silicon — worker
+    # subprocesses must not dial the real axon tunnel from CI (conftest's
+    # env pin does not survive the sitecustomize plugin registration that
+    # --backend tpu's init would trigger in a fresh process)
+    cli_main(["consensus", "-i", src, "-o", str(tmp_path / "single"),
+              "-n", "a", "--backend", "xla_cpu", "--scorrect", "True"])
+    cli_main(["consensus", "-i", src, "-o", str(tmp_path / "sharded"),
+              "-n", "a", "--backend", "xla_cpu", "--scorrect", "True",
+              "--host_workers", "2"])
+    assert not os.path.exists(str(tmp_path / "sharded" / "a" / ".ranges"))
+    checked = 0
+    for p in sorted(glob.glob(str(tmp_path / "single" / "a" / "**" / "*.bam"),
+                              recursive=True)):
+        q = p.replace(os.sep + "single" + os.sep, os.sep + "sharded" + os.sep)
+        assert os.path.exists(q), q
+        assert canonical_bam_digest(p) == canonical_bam_digest(q), q
+        checked += 1
+    assert checked >= 10
+    for rel in ("sscs/a.sscs_stats.txt", "dcs/a.dcs_stats.txt",
+                "singleton/a.singleton_stats.txt", "sscs/a.read_families.txt"):
+        a = [ln for ln in open(tmp_path / "single" / "a" / rel)
+             if not ln.startswith(("backend", "jax_backend"))]
+        b = [ln for ln in open(tmp_path / "sharded" / "a" / rel)
+             if not ln.startswith(("backend", "jax_backend"))]
+        assert a == b, rel
+    for png in ("family_size", "read_recovery", "stage_times"):
+        assert os.path.exists(tmp_path / "sharded" / "a" / "plots" / f"a.{png}.png")
