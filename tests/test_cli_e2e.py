@@ -308,9 +308,11 @@ def test_consensus_host_workers_parity(tmp_path):
     # --backend tpu's init would trigger in a fresh process)
     cli_main(["consensus", "-i", src, "-o", str(tmp_path / "single"),
               "-n", "a", "--backend", "xla_cpu", "--scorrect", "True"])
+    # compose BOTH parallel axes: 2 host workers x 4-device mesh each
+    # (workers inherit the 8-virtual-device CI env)
     cli_main(["consensus", "-i", src, "-o", str(tmp_path / "sharded"),
               "-n", "a", "--backend", "xla_cpu", "--scorrect", "True",
-              "--host_workers", "2"])
+              "--host_workers", "2", "--devices", "4"])
     assert not os.path.exists(str(tmp_path / "sharded" / "a" / ".ranges"))
     checked = 0
     for p in sorted(glob.glob(str(tmp_path / "single" / "a" / "**" / "*.bam"),
