@@ -150,10 +150,67 @@ def run_dryrun_process(
     )
     out = step(*args)
     stats = np.asarray(jax.device_get(out[-1]))  # replicated -> addressable
+
+    # The PRODUCTION multi-chip wire under DCN too: the packed member
+    # stream family-sharded over the same global mesh, each process
+    # feeding only its local device slice (global device order is
+    # process-major, so a process's slice of the stacked layout is
+    # contiguous).  Verified against the host oracle per process.
+    from consensuscruncher_tpu.core.consensus_cpu import consensus_maker
+    from consensuscruncher_tpu.ops.consensus_tpu import ConsensusConfig
+    from consensuscruncher_tpu.parallel.mesh import (
+        _compiled_stream_vote_sharded,
+        plan_member_shards,
+        stack_member_shards,
+    )
+
+    n_dev = len(jax.devices())
+    local_dev = len(jax.local_devices())
+    s_sizes = rng.integers(1, 6, (6 * n_dev,)).astype(np.int32)
+    m = int(s_sizes.sum())
+    s_rows = rng.integers(0, 4, (m, length)).astype(np.uint8)
+    s_qrows = rng.integers(20, 41, (m, length)).astype(np.uint8)
+    plan = plan_member_shards(s_sizes, n_dev)
+    sizes_st, rows_st, qrows_st = stack_member_shards(plan, s_sizes,
+                                                      s_rows, s_qrows)
+    f_lo = process_id * local_dev * plan.nf_local
+    f_hi = f_lo + local_dev * plan.nf_local
+    r_lo = process_id * local_dev * plan.m_local
+    r_hi = r_lo + local_dev * plan.m_local
+    cfg = ConsensusConfig()
+    num, den = cfg.cutoff_rational
+    s_args = feed_local(mesh, rows_st[r_lo:r_hi], qrows_st[r_lo:r_hi],
+                        sizes_st[f_lo:f_hi])
+    fn = _compiled_stream_vote_sharded(
+        mesh, "raw", num, den, int(cfg.qual_threshold), int(cfg.qual_cap),
+        member_cap=8, out_len=None,
+    )
+    plane = fn(*s_args)  # (2, n_dev * nf_local, L), family-sharded
+    order = plan.order()
+    starts = np.concatenate([[0], np.cumsum(s_sizes)])
+    stream_ok = True
+    for shard in plane.addressable_shards:
+        got = np.asarray(shard.data)  # (2, nf_local, L) for one device
+        row0 = shard.index[1].start or 0
+        for local_row in range(got.shape[1]):
+            grow = row0 + local_row
+            js = np.nonzero(order == grow)[0]
+            if not js.size:  # padding slot: kernels emit all-N, callers drop
+                continue
+            j = int(js[0])
+            fam = s_rows[starts[j] : starts[j + 1]]
+            fq = s_qrows[starts[j] : starts[j + 1]]
+            exp_b, exp_q = consensus_maker(fam, fq)
+            if not (np.array_equal(got[0, local_row], exp_b)
+                    and np.array_equal(got[1, local_row], exp_q)):
+                stream_ok = False
+
     return {
         "process_id": process_id,
         "n_processes": jax.process_count(),
         "n_global_devices": len(jax.devices()),
+        "stream_wire_ok": bool(stream_ok),
+        "stream_families": int(s_sizes.size),
         "families": int(stats[0]),
         "duplexes": int(stats[1]),
         "n_count": int(stats[2]),

@@ -68,6 +68,10 @@ def test_two_process_global_mesh_psum():
     for r in results:
         assert r["n_processes"] == 2
         assert r["n_global_devices"] == 4  # 2 processes x 2 virtual devices
+        # the production packed-stream wire ran over the same global mesh
+        # and matched the host oracle on every addressable shard
+        assert r["stream_wire_ok"] is True
+        assert r["stream_families"] == 24  # 6 per global device
         # psum'd stats are global and identical on every process
         assert r["families"] == r["expect_families"] == 2 * batch
         assert r["duplexes"] == r["expect_duplexes"]
