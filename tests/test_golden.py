@@ -142,3 +142,17 @@ def test_adversarial_pipeline_matches_golden(tmp_path, backend, devices):
     expect = GOLDEN["adversarial_expect"]
     assert stats["bad_reads"] == expect["bad_reads"]
     assert stats["total_reads"] == expect["bad_reads"] + expect["good_reads"]
+
+
+def test_compress_level_preserves_content(tmp_path):
+    """--compress_level 1 must reproduce the frozen goldens exactly —
+    digests canonicalize record content, so any divergence means the
+    compression knob changed semantics, not just bytes."""
+    from consensuscruncher_tpu.cli import main as cli_main
+
+    cli_main([
+        "consensus", "-i", os.path.join(DATA, "sample.bam"),
+        "-o", str(tmp_path), "-n", "golden",
+        "--backend", "tpu", "--scorrect", "True", "--compress_level", "1",
+    ])
+    assert_outputs_match_golden(tmp_path / "golden", "consensus", "level-1")
