@@ -51,27 +51,31 @@ def test_bundled_inputs_unchanged():
         assert text_digest(os.path.join(DATA, f)) == GOLDEN["inputs"][f]
 
 
-@pytest.mark.parametrize("backend,devices", [
-    ("cpu", None),
-    ("tpu", None),
+@pytest.mark.parametrize("backend,devices,extra", [
+    ("cpu", None, []),
+    ("tpu", None, []),
     # Family batches sharded across the 8 virtual devices (conftest mesh)
     # must reproduce the single-device goldens byte-for-byte — the
     # multi-chip path is a layout change, never a semantic one.
-    ("tpu", 8),
+    ("tpu", 8, []),
+    # level 1 must reproduce the level-6 goldens exactly: digests
+    # canonicalize record content, so divergence would mean the
+    # compression knob changed semantics, not just bytes.
+    ("tpu", None, ["--compress_level", "1"]),
 ])
-def test_consensus_pipeline_matches_golden(tmp_path, backend, devices):
+def test_consensus_pipeline_matches_golden(tmp_path, backend, devices, extra):
     from consensuscruncher_tpu.cli import main as cli_main
 
     argv = [
         "consensus", "-i", os.path.join(DATA, "sample.bam"),
         "-o", str(tmp_path), "-n", "golden",
-        "--backend", backend, "--scorrect", "True",
+        "--backend", backend, "--scorrect", "True", *extra,
     ]
     if devices:
         argv += ["--devices", str(devices)]
     cli_main(argv)
     assert_outputs_match_golden(
-        tmp_path / "golden", "consensus", f"{backend}/devices={devices}"
+        tmp_path / "golden", "consensus", f"{backend}/devices={devices}/{extra}"
     )
 
 
@@ -143,16 +147,3 @@ def test_adversarial_pipeline_matches_golden(tmp_path, backend, devices):
     assert stats["bad_reads"] == expect["bad_reads"]
     assert stats["total_reads"] == expect["bad_reads"] + expect["good_reads"]
 
-
-def test_compress_level_preserves_content(tmp_path):
-    """--compress_level 1 must reproduce the frozen goldens exactly —
-    digests canonicalize record content, so any divergence means the
-    compression knob changed semantics, not just bytes."""
-    from consensuscruncher_tpu.cli import main as cli_main
-
-    cli_main([
-        "consensus", "-i", os.path.join(DATA, "sample.bam"),
-        "-o", str(tmp_path), "-n", "golden",
-        "--backend", "tpu", "--scorrect", "True", "--compress_level", "1",
-    ])
-    assert_outputs_match_golden(tmp_path / "golden", "consensus", "level-1")
