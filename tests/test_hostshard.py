@@ -1,0 +1,104 @@
+"""Range-split + aggregation primitives behind --host_workers."""
+
+import os
+
+import numpy as np
+import pytest
+
+from consensuscruncher_tpu.io.bam import BamHeader, BamRead, BamReader, BamWriter
+from consensuscruncher_tpu.parallel.hostshard import (
+    aggregate_histograms,
+    aggregate_stats,
+    split_bam_ranges,
+)
+
+
+def _random_sorted_bam(path, rng, n_records, n_unplaced=0, tie_heavy=False):
+    header = BamHeader.from_refs([("chrA", 200_000), ("chrB", 200_000)])
+    reads = []
+    for i in range(n_records):
+        ref = ("chrA", "chrB")[int(rng.integers(0, 2))]
+        pos = int(rng.integers(0, 1_000 if tie_heavy else 150_000))
+        L = int(rng.integers(30, 90))
+        reads.append(BamRead(
+            qname=f"q{i:06d}", flag=0, ref=ref, pos=pos, mapq=60,
+            cigar=[("M", L)], mate_ref=ref, mate_pos=pos, tlen=L,
+            seq="A" * L, qual=np.full(L, 25, np.uint8),
+        ))
+    for i in range(n_unplaced):
+        reads.append(BamRead(
+            qname=f"u{i}", flag=0x4, ref=None, pos=-1, mapq=0, cigar=[],
+            mate_ref=None, mate_pos=-1, tlen=0, seq="A" * 20,
+            qual=np.full(20, 25, np.uint8),
+        ))
+    reads.sort(key=lambda r: (r.ref is None, header.ref_id(r.ref), r.pos, r.qname))
+    with BamWriter(path, header) as w:
+        for read in reads:
+            w.write(read)
+    return reads
+
+
+@pytest.mark.parametrize("n_records,n_unplaced,n,tie_heavy", [
+    (2000, 0, 4, False),
+    (2000, 7, 3, False),
+    (500, 0, 8, True),    # heavy position ties: few legal boundaries
+    (3, 2, 5, False),     # more slices than positions: empty slices
+    (0, 0, 3, False),     # empty input
+])
+def test_split_bam_ranges_fuzz(tmp_path, n_records, n_unplaced, n, tie_heavy):
+    rng = np.random.default_rng(n_records + n + n_unplaced)
+    src = str(tmp_path / "in.bam")
+    _random_sorted_bam(src, rng, n_records, n_unplaced, tie_heavy)
+    with BamReader(src) as r:  # round-tripped oracle ('*' vs None etc.)
+        expected = list(r)
+
+    paths = split_bam_ranges(src, n, str(tmp_path / "ranges"))
+    assert len(paths) == n
+    got = []
+    boundary_ok = True
+    for p in paths:
+        with BamReader(p) as r:
+            recs = list(r)
+        if recs and got:
+            a = (got[-1].ref, got[-1].pos)
+            b = (recs[0].ref, recs[0].pos)
+            if b == a:
+                boundary_ok = False
+        got.extend(recs)
+    assert len(got) == len(expected)
+    assert all(a == b for a, b in zip(got, expected)), "order/content drift"
+    assert boundary_ok, "a (ref,pos) anchor spans two slices"
+    # the unplaced tail never splits
+    for p in paths[:-1]:
+        with BamReader(p) as r:
+            assert all(not rec.is_unmapped or rec.ref is not None for rec in r)
+
+
+def test_aggregate_stats_and_histograms(tmp_path):
+    import json
+
+    a, b = str(tmp_path / "a.json"), str(tmp_path / "b.json")
+    json.dump({"stage": "SSCS", "backend": "tpu", "cutoff": 0.7,
+               "families": 10, "sscs_written": 6}, open(a, "w"))
+    json.dump({"stage": "SSCS", "backend": "tpu", "cutoff": 0.7,
+               "families": 5, "sscs_written": 4, "bad_reads": 2}, open(b, "w"))
+    out = str(tmp_path / "agg.txt")
+    agg = aggregate_stats([a, b, str(tmp_path / "missing.json")], "SSCS", out)
+    assert agg.get("families") == 15
+    assert agg.get("sscs_written") == 10
+    assert agg.get("bad_reads") == 2
+    assert agg.get("cutoff") == 0.7
+    assert "stage:" not in open(out).read().splitlines()[1]
+
+    h1, h2 = str(tmp_path / "h1.txt"), str(tmp_path / "h2.txt")
+    for p, rows in ((h1, {1: 3, 4: 2}), (h2, {1: 1, 9: 5})):
+        with open(p, "w") as fh:
+            fh.write("family_size\tcount\n")
+            for s, c in rows.items():
+                fh.write(f"{s}\t{c}\n")
+    hout = str(tmp_path / "h.txt")
+    aggregate_histograms([h1, h2], hout)
+    from consensuscruncher_tpu.utils.stats import FamilySizeHistogram
+
+    agg_counts = FamilySizeHistogram.read(hout)
+    assert dict(agg_counts) == {1: 4, 4: 2, 9: 5}
