@@ -51,9 +51,17 @@ class FastqWriter:
         # 9 costs ~2.5x the deflate time for ~1% size on FASTQ — it was 90%
         # of extract_barcodes wall-clock.  Goldens hash decompressed content,
         # so the level is a pure throughput knob.
+        #
+        # .gz outputs are written as BGZF: still a valid multi-member gzip
+        # stream (gunzip/bwa/STAR all read it — bgzip's own trick), but the
+        # deflate runs through the native batch codec and its thread pool
+        # (io/bgzf.codec_threads), so extraction scales with host cores
+        # instead of serializing one zlib stream on the Python thread.
         p = str(path)
         if p.endswith(".gz"):
-            self._fh = gzip.GzipFile(p, "wb", mtime=0, compresslevel=level)
+            from consensuscruncher_tpu.io import bgzf
+
+            self._fh = bgzf.BgzfWriter(p, level=level)
         else:
             self._fh = open(p, "wb")
 
