@@ -308,6 +308,7 @@ def _consume_pair_blocks(reader, stats, unpaired_writer, rec_writer,
             out_c = np.empty((int(sel.sum()), L), np.uint8)
             out_q = np.empty_like(out_c)
             pos_sel = np.nonzero(sel)[0]
+            lens = np.full(0, L, np.int64)
             for si, batch in enumerate(blk.sources):
                 m = src_arr[pos_sel] == si
                 if not m.any():
@@ -315,8 +316,14 @@ def _consume_pair_blocks(reader, stats, unpaired_writer, rec_writer,
                 rows = row_arr[pos_sel[m]]
                 codes, coff = batch.seq_codes()
                 quals, _ = batch.quals()
-                out_c[m] = codes[coff[rows][:, None] + np.arange(L)]
-                out_q[m] = quals[coff[rows][:, None] + np.arange(L)]
+                if len(lens) != int(m.sum()):
+                    lens = np.full(int(m.sum()), L, np.int64)
+                # native ragged gather (uniform-run fast path) beats the
+                # (n, L) fancy index by ~2-3x at stage scale
+                data, _off = gather_runs(codes, coff[rows], lens)
+                out_c[m] = data.reshape(-1, L)
+                data, _off = gather_runs(quals, coff[rows], lens)
+                out_q[m] = data.reshape(-1, L)
             return out_c, out_q
 
         from consensuscruncher_tpu.core.qnames import build_strings, const, fixed, ragged
