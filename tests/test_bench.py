@@ -21,11 +21,15 @@ def _run_bench(extra_env, *argv):
 
 
 def test_bench_main_one_json_line_when_tpu_dead():
-    """Tiny-scale end-to-end: probes fail fast (CI has no tunnel), the
-    XLA-CPU fallback measures, and stdout is EXACTLY one JSON line with
-    the driver-contract keys."""
+    """Tiny-scale end-to-end: probes fail fast, the XLA-CPU fallback
+    measures, and stdout is EXACTLY one JSON line with the driver-contract
+    keys.  An empty PALLAS_AXON_POOL_IPS forces the dead-tunnel path
+    hermetically: sitecustomize skips axon registration, so the probe's
+    jax.devices() fails fast even when the real tunnel is alive (round 4:
+    it sometimes is)."""
     proc = _run_bench(
         {
+            "PALLAS_AXON_POOL_IPS": "",
             "CCT_BENCH_FRAGMENTS": "300",
             "CCT_BENCH_REF_FRAGMENTS": "60",
             "CCT_BENCH_PROBE_TIMEOUT": "3",
@@ -54,6 +58,7 @@ def test_bench_main_one_json_line_when_tpu_dead():
 def test_bench_kernels_mode_parses():
     proc = _run_bench(
         {
+            "PALLAS_AXON_POOL_IPS": "",
             "CCT_BENCH_LEN": "64",
             "CCT_BENCH_PROBE_TIMEOUT": "3",
             "CCT_BENCH_PROBE_ATTEMPTS": "1",

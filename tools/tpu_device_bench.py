@@ -1,0 +1,173 @@
+"""Device-resident kernel bake-off: on-chip throughput, tunnel excluded.
+
+The headline bench (bench.py) times host-to-host calls, which on the axon
+tunnel (~30 MB/s, measured round 4) measures the wire, not the chip.  This
+script places every input in HBM first (jax.device_put + block), then times
+the jitted programs alone with block_until_ready, leaving outputs on device.
+That is the number the roofline analysis needs: achieved HBM bytes/s vs the
+v5e peak (~819 GB/s), per kernel, per workload shape.
+
+Run it on any backend; the JSON line records jax_backend so CPU runs are
+self-identifying.  One JSON line per (shape, kernel); a final summary line.
+
+Usage:  python tools/tpu_device_bench.py [--quick]
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+
+from consensuscruncher_tpu.ops.consensus_tpu import ConsensusConfig, _compiled_batch_fn
+from consensuscruncher_tpu.ops.consensus_segment import (
+    pick_member_cap,
+    segment_duplex_step,
+    build_member_stream,
+)
+from consensuscruncher_tpu.ops.packing import build_codebook4, pack4
+
+# v5e (TPU v5 lite) public peak numbers: the roofline denominators.
+HBM_PEAK_GBS = 819.0
+
+QUICK = "--quick" in sys.argv
+REPS = 5 if not QUICK else 2
+
+
+def timed_device(fn, *args):
+    """Median-of-REPS device time for fn(*args); args already on device."""
+    out = fn(*args)
+    jax.block_until_ready(out)  # compile + warm
+    times = []
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.block_until_ready(out)
+        times.append(time.perf_counter() - t0)
+    return float(np.median(times))
+
+
+def emit(row):
+    row["jax_backend"] = jax.default_backend()
+    print(json.dumps(row), flush=True)
+    return row
+
+
+def bench_shape(B, F, L, tag, rows):
+    rng = np.random.default_rng(7)
+    cfg = ConsensusConfig()
+    num, den = cfg.cutoff_rational
+    bases = rng.integers(0, 4, (B, F, L)).astype(np.uint8)
+    quals = rng.integers(20, 41, (B, F, L)).astype(np.uint8)
+    sizes = rng.integers(1, F + 1, (B,)).astype(np.int32)
+
+    # ---- dense XLA vmap kernel -------------------------------------------
+    d_b = jax.device_put(jnp.asarray(bases))
+    d_q = jax.device_put(jnp.asarray(quals))
+    d_s = jax.device_put(jnp.asarray(sizes))
+    jax.block_until_ready((d_b, d_q, d_s))
+    fn = _compiled_batch_fn(num, den, int(cfg.qual_threshold), int(cfg.qual_cap))
+    t = timed_device(fn, d_b, d_q, d_s)
+    hbm_bytes = bases.nbytes + quals.nbytes + 2 * B * L  # in + out, uint8
+    rows.append(emit({
+        "shape": tag, "kernel": "dense_xla", "device_s": round(t, 5),
+        "families_per_sec": round(B / t, 1),
+        "hbm_gb_per_sec": round(hbm_bytes / t / 1e9, 1),
+        "hbm_frac_of_peak": round(hbm_bytes / t / 1e9 / HBM_PEAK_GBS, 3),
+    }))
+
+    # ---- Pallas kernel (real on TPU only) --------------------------------
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        from consensuscruncher_tpu.ops.consensus_pallas import _compiled_pallas
+
+        pad = (-B) % 8
+        pb = np.concatenate([bases, np.zeros((pad, F, L), np.uint8)]) if pad else bases
+        pq = np.concatenate([quals, np.zeros((pad, F, L), np.uint8)]) if pad else quals
+        ps = np.concatenate([sizes, np.zeros(pad, np.int32)]) if pad else sizes
+        fb = jax.device_put(jnp.asarray(np.ascontiguousarray(pb.transpose(1, 0, 2))))
+        fq = jax.device_put(jnp.asarray(np.ascontiguousarray(pq.transpose(1, 0, 2))))
+        fs = jax.device_put(jnp.asarray(ps.reshape(-1, 1)))
+        jax.block_until_ready((fb, fq, fs))
+        try:
+            pfn = _compiled_pallas(B + pad, F, L, num, den,
+                                   int(cfg.qual_threshold), int(cfg.qual_cap), False)
+            t = timed_device(pfn, fs, fb, fq)
+            rows.append(emit({
+                "shape": tag, "kernel": "pallas", "device_s": round(t, 5),
+                "families_per_sec": round((B + pad) / t, 1),
+                "hbm_gb_per_sec": round(hbm_bytes / t / 1e9, 1),
+                "hbm_frac_of_peak": round(hbm_bytes / t / 1e9 / HBM_PEAK_GBS, 3),
+            }))
+        except Exception as e:
+            rows.append(emit({"shape": tag, "kernel": "pallas", "error": repr(e)[:300]}))
+
+    # ---- segment/packed duplex step (production stream wire) -------------
+    BINNED = np.array([2, 12, 23, 37], np.uint8)
+    qb = BINNED[rng.integers(0, 4, (B, F, L))]
+    n_pairs = B // 2
+    sizes_a, sizes_b = sizes[:n_pairs], sizes[n_pairs:]
+    fam_ids, ranks, seg_sizes = build_member_stream([sizes_a, sizes_b])
+    strand_b = fam_ids >= n_pairs
+    row = np.where(strand_b, fam_ids - n_pairs, fam_ids)
+    mrows = np.where(strand_b[:, None], bases[n_pairs:][row, ranks], bases[:n_pairs][row, ranks])
+    qrows = np.where(strand_b[:, None], qb[n_pairs:][row, ranks], qb[:n_pairs][row, ranks])
+    book = build_codebook4(BINNED)
+    packed = pack4(mrows.astype(np.uint8), qrows.astype(np.uint8), book)
+    step = segment_duplex_step(n_pairs, L, cfg, packed_out=True,
+                               member_cap=pick_member_cap(seg_sizes))
+    d_packed = jax.device_put(jnp.asarray(packed))
+    d_sizes = jax.device_put(jnp.asarray(seg_sizes))
+    d_book = jax.device_put(jnp.asarray(book))
+    jax.block_until_ready((d_packed, d_sizes, d_book))
+    t = timed_device(step, d_packed, d_sizes, d_book)
+    # In: packed nibble wire; on-chip the unpack writes + vote reads the dense
+    # (M, L) bases+quals pair, so count that traffic too; out: packed SSCS +
+    # 2 qual planes.
+    m = packed.shape[0]
+    wire_in = packed.nbytes
+    hbm_bytes = wire_in + 2 * m * L + 3 * n_pairs * L
+    rows.append(emit({
+        "shape": tag, "kernel": "segment_packed", "device_s": round(t, 5),
+        "families_per_sec": round(B / t, 1),
+        "wire_bytes_in": int(wire_in),
+        "hbm_gb_per_sec": round(hbm_bytes / t / 1e9, 1),
+        "hbm_frac_of_peak": round(hbm_bytes / t / 1e9 / HBM_PEAK_GBS, 3),
+    }))
+
+
+def main():
+    rows: list[dict] = []
+    # Smallest shape first so the first evidence row lands within the first
+    # compile window — the tunnel flaps on ~10-minute scales (measured r4)
+    # and a row on disk survives a mid-run hang.
+    shapes = [
+        (1024, 16, 100, "B1024_F16_L100"),       # fast first row
+        (8192, 16, 100, "B8192_F16_L100"),       # bench.py headline shape
+        (65536, 8, 100, "B65536_F8_L100"),       # typical cfDNA mean-fam-4
+        (4096, 64, 100, "B4096_F64_L100"),       # ultra-deep large families
+    ]
+    if QUICK:
+        shapes = shapes[:2]
+    for B, F, L, tag in shapes:
+        bench_shape(B, F, L, tag, rows)
+    # summary: winner per shape
+    summary = {}
+    for r in rows:
+        if "families_per_sec" not in r:
+            continue
+        s = summary.setdefault(r["shape"], {})
+        s[r["kernel"]] = r["families_per_sec"]
+    print(json.dumps({"summary": summary, "hbm_peak_gbs": HBM_PEAK_GBS,
+                      "jax_backend": jax.default_backend()}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

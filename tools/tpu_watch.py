@@ -1,0 +1,228 @@
+"""Session-long TPU-window watcher (VERDICT r3 item 1).
+
+The axon tunnel dies and revives on ~10-minute-to-hour scales; a bench-shaped
+probe at one instant is a coin flip.  This daemon turns silicon evidence into
+an integral over the whole session: probe the tunnel on a bounded subprocess
+every PROBE_INTERVAL; on any live window, drain a priority queue of prepared
+on-chip jobs; record every probe and every job outcome.
+
+Artifacts (all under the repo root):
+  TPU_EVIDENCE.json            merged machine-readable state: probe counts,
+                               window spans, per-job status + parsed rows.
+                               bench.py folds this into its one-line output
+                               as last-known-good when the tunnel is dead.
+  tpu_evidence/watch_log.jsonl one line per probe attempt (ts, ok, loadavg)
+  tpu_evidence/<job>.out.jsonl streamed stdout of each job (appended, so a
+                               mid-run tunnel hang still leaves partial rows)
+  tpu_evidence/.done_<job>     success marker (job runs once)
+
+Jobs live in tools/tpu_jobs.json and are re-read every loop, so new jobs can
+be queued mid-session without restarting the watcher.  The parent process
+NEVER imports jax (a sick tunnel hangs the importing process).
+
+Usage:  python tools/tpu_watch.py          # run forever (background it)
+        python tools/tpu_watch.py --status # print TPU_EVIDENCE.json and exit
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EVIDENCE_DIR = os.path.join(REPO, "tpu_evidence")
+EVIDENCE_JSON = os.path.join(REPO, "TPU_EVIDENCE.json")
+WATCH_LOG = os.path.join(EVIDENCE_DIR, "watch_log.jsonl")
+JOBS_FILE = os.path.join(REPO, "tools", "tpu_jobs.json")
+
+PROBE_TIMEOUT = 120
+PROBE_INTERVAL_DOWN = 180     # seconds between probes while the tunnel is dead
+PROBE_INTERVAL_IDLE = 600     # all jobs done: keep recording window statistics
+MAX_ATTEMPTS = 4              # per job, across windows
+
+
+def _now() -> float:
+    return time.time()
+
+
+def _loadavg() -> float:
+    try:
+        return round(os.getloadavg()[0], 2)
+    except OSError:
+        return -1.0
+
+
+def _append_jsonl(path: str, row: dict) -> None:
+    with open(path, "a") as f:
+        f.write(json.dumps(row) + "\n")
+
+
+def probe() -> dict:
+    """Bounded liveness probe in a throwaway subprocess.
+
+    Reuses bench.py's probe worker so there is exactly ONE copy of the
+    "ok requires a real tpu platform" predicate — watcher windows and bench
+    probes must never disagree about what counts as live silicon.
+    """
+    t0 = _now()
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py"),
+             "--worker", "probe", "tpu", "-", EVIDENCE_DIR],
+            capture_output=True, text=True, timeout=PROBE_TIMEOUT, cwd=REPO,
+        )
+        for line in reversed(proc.stdout.strip().splitlines() or [""]):
+            try:
+                out = json.loads(line)
+                out["probe_s"] = round(_now() - t0, 1)
+                return out
+            except json.JSONDecodeError:
+                continue
+        return {"ok": False, "error": (proc.stderr or "no output").strip()[-200:],
+                "probe_s": round(_now() - t0, 1)}
+    except subprocess.TimeoutExpired:
+        return {"ok": False, "error": f"probe timeout after {PROBE_TIMEOUT}s",
+                "probe_s": round(_now() - t0, 1)}
+
+
+def load_jobs() -> list[dict]:
+    try:
+        with open(JOBS_FILE) as f:
+            return json.load(f)["jobs"]
+    except Exception:
+        return []
+
+
+def job_paths(name: str) -> tuple[str, str, str]:
+    return (os.path.join(EVIDENCE_DIR, f"{name}.out.jsonl"),
+            os.path.join(EVIDENCE_DIR, f"{name}.stderr"),
+            os.path.join(EVIDENCE_DIR, f".done_{name}"))
+
+
+def run_job(job: dict, state: dict) -> bool:
+    """Run one queued job with streamed stdout; True on rc==0."""
+    name = job["name"]
+    out_path, err_path, done_path = job_paths(name)
+    js = state["jobs"].setdefault(name, {"attempts": 0})
+    js["attempts"] += 1
+    js["last_start"] = _now()
+    js["loadavg_at_start"] = _loadavg()
+    env = dict(os.environ)
+    env.update(job.get("env", {}))
+    t0 = _now()
+    with open(out_path, "a") as out_f, open(err_path, "a") as err_f:
+        out_f.write(f'{{"__job_start__": "{name}", "ts": {t0:.0f}}}\n')
+        out_f.flush()
+        proc = subprocess.Popen(
+            job["cmd"], stdout=out_f, stderr=err_f, cwd=REPO, env=env,
+            start_new_session=True,
+        )
+        try:
+            rc = proc.wait(timeout=job.get("timeout", 1200))
+        except subprocess.TimeoutExpired:
+            os.killpg(proc.pid, signal.SIGKILL)
+            proc.wait()
+            rc = -9
+            js["last_error"] = f"timeout after {job.get('timeout', 1200)}s"
+    js["last_rc"] = rc
+    js["last_wall_s"] = round(_now() - t0, 1)
+    if rc == 0:
+        with open(done_path, "w") as f:
+            f.write(str(_now()))
+        js["status"] = "done"
+        return True
+    js["status"] = "failed" if js["attempts"] >= MAX_ATTEMPTS else "pending"
+    return False
+
+
+def parse_rows(name: str, limit: int = 40) -> list:
+    out_path, _, _ = job_paths(name)
+    rows = []
+    try:
+        with open(out_path) as f:
+            for line in f:
+                try:
+                    row = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(row, dict) and "__job_start__" not in row:
+                    rows.append(row)
+    except OSError:
+        pass
+    return rows[-limit:]
+
+
+def write_evidence(state: dict) -> None:
+    for name, js in state["jobs"].items():
+        js["rows"] = parse_rows(name)
+        out_path, _, done_path = job_paths(name)
+        js["out"] = os.path.relpath(out_path, REPO)
+        if os.path.exists(done_path):
+            js["status"] = "done"
+    state["updated"] = _now()
+    tmp = EVIDENCE_JSON + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(state, f, indent=1)
+    os.replace(tmp, EVIDENCE_JSON)
+
+
+def load_state() -> dict:
+    try:
+        with open(EVIDENCE_JSON) as f:
+            return json.load(f)
+    except Exception:
+        return {"probes_total": 0, "probes_ok": 0, "first_ok": None,
+                "last_ok": None, "windows": [], "jobs": {}}
+
+
+def main() -> None:
+    os.makedirs(EVIDENCE_DIR, exist_ok=True)
+    if "--status" in sys.argv:
+        print(json.dumps(load_state(), indent=1))
+        return
+    state = load_state()
+    window_open_since: float | None = None
+    while True:
+        p = probe()
+        ts = _now()
+        state["probes_total"] += 1
+        _append_jsonl(WATCH_LOG, {"ts": round(ts, 0), "ok": p.get("ok", False),
+                                  "probe_s": p.get("probe_s"),
+                                  "error": p.get("error"), "loadavg": _loadavg()})
+        if p.get("ok"):
+            state["probes_ok"] += 1
+            state["last_ok"] = ts
+            if state["first_ok"] is None:
+                state["first_ok"] = ts
+            if window_open_since is None:
+                window_open_since = ts
+                state["windows"].append({"start": ts, "end": ts})
+            else:
+                state["windows"][-1]["end"] = ts
+            write_evidence(state)
+            # Tunnel alive: drain the next pending job, then loop straight
+            # back to a fresh probe (the window may close mid-job).
+            ran = False
+            for job in load_jobs():
+                _, _, done_path = job_paths(job["name"])
+                js = state["jobs"].get(job["name"], {})
+                if os.path.exists(done_path) or js.get("status") == "failed":
+                    continue
+                run_job(job, state)
+                write_evidence(state)
+                ran = True
+                break
+            if not ran:
+                time.sleep(PROBE_INTERVAL_IDLE)
+        else:
+            window_open_since = None
+            write_evidence(state)
+            time.sleep(PROBE_INTERVAL_DOWN)
+
+
+if __name__ == "__main__":
+    main()
