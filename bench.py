@@ -100,7 +100,12 @@ def _force_cpu_jax() -> None:
 
 
 def _worker_stage(backend: str, bam: str, outdir: str) -> dict:
-    """Run the SSCS+DCS stage path twice (cold incl. compile, then warm)."""
+    """Run the SSCS+DCS stage path: cold (incl. compile) + two warm runs.
+
+    The headline is the BEST warm run (VERDICT r3 weak 7: single warm runs
+    on a 1-core host carried ~8% drift between dress rehearsal and driver);
+    loadavg is recorded per run so noisy numbers are self-explaining.
+    """
     from consensuscruncher_tpu.stages.dcs_maker import run_dcs
     from consensuscruncher_tpu.stages.sscs_maker import run_sscs
 
@@ -113,7 +118,10 @@ def _worker_stage(backend: str, bam: str, outdir: str) -> dict:
     dcs_backend = "tpu" if backend in ("tpu", "xla_cpu") else "cpu"
     runs = {}
     n_families = n_reads = 0
-    for run_name in ("cold", "warm"):
+    # Symmetric sampling: the reference denominator gets best-of-2 warm runs
+    # too, else min-of-2 vs single-sample inflates the speedup ratio.
+    run_names = ("cold", "warm", "warm2")
+    for run_name in run_names:
         prefix_dir = os.path.join(outdir, f"{backend}_{run_name}")
         os.makedirs(prefix_dir, exist_ok=True)
         prefix = os.path.join(prefix_dir, "bench")
@@ -126,10 +134,11 @@ def _worker_stage(backend: str, bam: str, outdir: str) -> dict:
             "sscs_s": round(t1 - t0, 3),
             "dcs_s": round(t2 - t1, 3),
             "total_s": round(t2 - t0, 3),
+            "loadavg": round(os.getloadavg()[0], 2),
         }
         n_families = sscs.stats.get("families")
         n_reads = sscs.stats.get("total_reads")
-    warm = runs["warm"]["total_s"]
+    warm = min(runs[r]["total_s"] for r in runs if r.startswith("warm"))
     return {
         "ok": True,
         "backend": backend,
@@ -313,6 +322,14 @@ def _run_worker(mode: str, backend: str, bam: str, outdir: str, timeout: int) ->
             "error": " | ".join(tail)[:500]}
 
 
+def _proc_is_python(pid: str) -> bool:
+    try:
+        with open(f"/proc/{pid}/cmdline", "rb") as f:
+            return b"python" in f.read().split(b"\0", 1)[0]
+    except OSError:
+        return False
+
+
 def _simulate(path: str, n_fragments: int, seed: int) -> None:
     from consensuscruncher_tpu.utils.simulate import SimConfig, simulate_bam_fast
 
@@ -474,6 +491,16 @@ def main() -> None:
         extras["harness_error"] = repr(e)[:500]
 
     _fold_tpu_evidence(extras, include_rows=bool(extras.get("tpu_unavailable")))
+    # Load context (VERDICT r3 weak 7): a contended 1-core host explains a
+    # drifting headline — make the noise self-documenting.
+    try:
+        extras["loadavg"] = [round(x, 2) for x in os.getloadavg()]
+        extras["n_python_procs"] = sum(
+            1 for pid in os.listdir("/proc") if pid.isdigit()
+            and _proc_is_python(pid)
+        )
+    except OSError:
+        pass
     extras["wall_s"] = round(time.perf_counter() - t_start, 1)
     line = {
         "metric": METRIC,

@@ -272,7 +272,23 @@ def _consensus_host_sharded(args) -> dict:
     # workers import this checkout regardless of their cwd
     base_env["PYTHONPATH"] = repo_root + os.pathsep + base_env.get("PYTHONPATH", "")
     chips_per_worker = int(getattr(args, "devices", None) or 1)
+    if str(args.backend) == "tpu":
+        # Chip-budget sanity (ADVICE r3): workers partition chip visibility
+        # as [i*d, (i+1)*d), so n*d chips must exist.  The parent avoids
+        # initializing a backend itself (a sick tunnel hangs the process);
+        # when the deployment env advertises the chip count, check up front
+        # instead of letting every worker die at backend init.
+        for var in ("TPU_NUM_DEVICES", "TPU_CHIP_COUNT"):
+            adv = os.environ.get(var)
+            if adv and adv.isdigit():
+                if n * chips_per_worker > int(adv):
+                    raise SystemExit(
+                        f"--host_workers {n} x --devices {chips_per_worker} "
+                        f"needs {n * chips_per_worker} chips but the host "
+                        f"advertises {adv} ({var}); reduce workers or devices")
+                break
     procs = []
+    err_paths = []
     for i, sl in enumerate(slices):
         argv = hostshard.worker_argv(sl, ranges_dir, f"r{i}", args)
         env = dict(base_env)
@@ -283,16 +299,27 @@ def _consensus_host_sharded(args) -> dict:
             # TPU_PROCESS_BOUNDS-style controls on real hardware)
             chips = range(i * chips_per_worker, (i + 1) * chips_per_worker)
             env["TPU_VISIBLE_DEVICES"] = ",".join(str(c) for c in chips)
-        procs.append(subprocess.Popen(
-            [sys.executable, "-m", "consensuscruncher_tpu.cli", *argv],
-            env=env, stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
-        ))
+        # Worker stderr goes to a file (ADVICE r3): a PIPE drained only
+        # after earlier workers finish can fill its ~64KB buffer and block
+        # a chatty later worker mid-run, serializing the fleet.
+        err_path = os.path.join(ranges_dir, f"r{i}.stderr")
+        err_paths.append(err_path)
+        with open(err_path, "wb") as err_f:
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "consensuscruncher_tpu.cli", *argv],
+                env=env, stdout=subprocess.DEVNULL, stderr=err_f,
+            ))
     failures = []
     for i, p in enumerate(procs):
-        _out, err = p.communicate()
+        p.wait()
         if p.returncode != 0:
-            tail = err.decode(errors="replace").strip().splitlines()[-4:]
-            failures.append(f"worker {i} rc={p.returncode}: " + " | ".join(tail))
+            try:
+                with open(err_paths[i], "rb") as f:
+                    tail = f.read().decode(errors="replace").strip().splitlines()[-8:]
+            except OSError:
+                tail = ["<stderr file unreadable>"]
+            failures.append(f"worker {i} rc={p.returncode} "
+                            f"(full log: {err_paths[i]}): " + " | ".join(tail))
     if failures:
         raise SystemExit("host-sharded consensus failed:\n" + "\n".join(failures))
     tracker.mark("workers")

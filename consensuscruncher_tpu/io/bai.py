@@ -285,6 +285,20 @@ def write_bai_from_columns(
 
     coff = np.zeros(len(block_csizes) + 1, dtype=np.int64)
     np.cumsum(np.asarray(block_csizes, dtype=np.int64), out=coff[1:])
+    # The voffset math below assumes every non-final BGZF payload block is
+    # exactly P uncompressed bytes (BgzfWriter's flush invariant).  Nothing
+    # else cross-checks it at runtime, and a future writer flush change
+    # would silently corrupt every inline index — fail loudly instead
+    # (ADVICE r3).
+    if len(uend) and len(block_csizes):
+        total_u = int(uend.max())
+        nb = len(block_csizes)
+        if not ((nb - 1) * P < total_u <= nb * P):
+            raise ValueError(
+                f"BGZF block layout violates the fixed-payload invariant: "
+                f"{nb} blocks x {P} B payload cannot span the {total_u} B "
+                "uncompressed stream — writer flush logic changed; "
+                "write_bai_from_columns voffsets would be corrupt")
     bi = ustart // P  # every non-final payload block is exactly P bytes
     vbeg = (coff[bi] << 16) | (ustart - bi * P)
     be = np.maximum(uend - 1, 0) // P
