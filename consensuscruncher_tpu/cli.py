@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import argparse
 import configparser
+import json
 import os
 import shlex
 import subprocess
@@ -253,17 +254,39 @@ def _consensus_host_sharded(args) -> dict:
     from consensuscruncher_tpu.utils.stats import TimeTracker
 
     n = int(args.host_workers)
-    if getattr(args, "resume", False):
-        raise SystemExit("--resume is not supported with --host_workers > 1")
+    resume = bool(getattr(args, "resume", False))
     name = args.name or os.path.basename(args.input).split(".")[0]
     base = os.path.join(args.output, name)
     dirs = {k: os.path.join(base, k) for k in ("sscs", "singleton", "dcs", "all_unique", "plots")}
     for d in dirs.values():
         os.makedirs(d, exist_ok=True)
     ranges_dir = os.path.join(base, ".ranges")
+    os.makedirs(ranges_dir, exist_ok=True)
     tracker = TimeTracker()
 
-    slices = hostshard.split_bam_ranges(args.input, n, ranges_dir)
+    # Workers read BAI coordinate ranges straight out of the shared input —
+    # no materialized slice files, no extra decode+rewrite pass (VERDICT r3
+    # item 4).  The plan is deterministic for (input, n); under --resume the
+    # recorded plan must match, else worker outputs would pair with stale
+    # ranges.
+    plan_path = os.path.join(ranges_dir, "ranges.json")
+    input_sig = {"path": os.path.abspath(args.input),
+                 "size": os.path.getsize(args.input),
+                 "mtime": int(os.path.getmtime(args.input)), "n": n}
+    ranges = hostshard.plan_bai_ranges(args.input, n)
+    plan = {"sig": input_sig,
+            "ranges": [hostshard.range_argv(r) for r in ranges]}
+    if resume and os.path.exists(plan_path):
+        with open(plan_path) as f:
+            prev = json.load(f)
+        if prev.get("sig") != input_sig or prev.get("ranges") != plan["ranges"]:
+            raise SystemExit(
+                "--resume: the input, --host_workers, or the computed range "
+                f"plan changed since the interrupted run (recorded "
+                f"{prev.get('sig')}, now {input_sig}); stale worker outputs "
+                "cannot pair with new ranges — rerun without --resume")
+    with open(plan_path, "w") as f:
+        json.dump(plan, f)
     tracker.mark("split")
 
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -289,8 +312,10 @@ def _consensus_host_sharded(args) -> dict:
                 break
     procs = []
     err_paths = []
-    for i, sl in enumerate(slices):
-        argv = hostshard.worker_argv(sl, ranges_dir, f"r{i}", args)
+    for i, rng in enumerate(ranges):
+        argv = hostshard.worker_argv(
+            args.input, ranges_dir, f"r{i}", args,
+            range_spec=hostshard.range_argv(rng), resume=resume)
         env = dict(base_env)
         if str(args.backend) == "tpu":
             # chips x cores: worker i owns chips [i*d, (i+1)*d) — TPU
@@ -358,7 +383,7 @@ def _consensus_host_sharded(args) -> dict:
     if not args.cleanup:
         from consensuscruncher_tpu.io.bam import BamReader
 
-        with BamReader(slices[0]) as _r:
+        with BamReader(args.input) as _r:
             in_header = _r.header
         hostshard.concat_bams(
             [p for p in rpaths("sscs/{n}.badReads.bam") if os.path.exists(p)],
@@ -393,7 +418,11 @@ def _consensus_host_sharded(args) -> dict:
         os.path.join(dirs["plots"], f"{name}.stage_times.png"),
     )
 
-    shutil.rmtree(ranges_dir, ignore_errors=True)
+    # A resumed run keeps the worker checkpoint tree (unless --cleanup):
+    # it is the evidence of what was skipped vs recomputed, and a further
+    # resume after a later failure reuses it.  Plain runs drop it.
+    if args.cleanup or not resume:
+        shutil.rmtree(ranges_dir, ignore_errors=True)
     print(f"consensus: outputs under {base} ({n} host workers)")
     return {"all_sscs": os.path.join(dirs["all_unique"], f"{name}.all.unique.sscs.bam"),
             "all_dcs": os.path.join(dirs["all_unique"], f"{name}.all.unique.dcs.bam"),
@@ -446,11 +475,20 @@ def _consensus_impl(args) -> dict:
     # badReads.bam is excluded from the manifest: --cleanup may delete it,
     # and nothing downstream consumes it — its absence must not force a
     # re-run.  time_tracker changes every run, so it's excluded too.
+    # --input_range (host-worker internal): read only a BAI coordinate
+    # range of the shared input instead of a materialized slice file.
+    range_spec = getattr(args, "input_range", None)
+    input_range = None
+    if range_spec:
+        from consensuscruncher_tpu.parallel.hostshard import parse_range_argv
+
+        input_range = parse_range_argv(range_spec)
     sscs_res = checkpointed(
         "sscs",
         [args.input],
         [sscs_paths[k] for k in ("sscs", "singleton", "stats_txt", "stats_json", "families")],
-        {"cutoff": args.cutoff, "qualscore": args.qualscore, "bdelim": args.bdelim},
+        {"cutoff": args.cutoff, "qualscore": args.qualscore,
+         "bdelim": args.bdelim, "input_range": range_spec},
         run=lambda: run_sscs(
             args.input,
             sscs_prefix,
@@ -460,6 +498,7 @@ def _consensus_impl(args) -> dict:
             bdelim=args.bdelim,
             devices=args.devices,
             level=args.compress_level,
+            input_range=input_range,
         ),
         rebuild=lambda: SscsResult.from_prefix(sscs_prefix),
     )
@@ -655,6 +694,7 @@ def build_parser() -> argparse.ArgumentParser:
                         "range of the input (the flow is position-local), "
                         "outputs merge by concatenation. The host-core "
                         "multiplier on multi-core machines; default 1")
+    c.add_argument("--input_range", default=None, help=argparse.SUPPRESS)
     c.set_defaults(func=consensus, config_section="consensus",
                    required_args=("input", "output"),
                    builtin_defaults={

@@ -16,10 +16,12 @@ Design notes:
   (each worker is a real process with its own interpreter, native codec
   pool, and — on real hardware — its own TPU chip via the plugin's visible-
   devices controls).
-- Splitting is a framing-cheap byte shuffle: one pass over the input's
-  blocks routing raw record blobs, breaking only where ``(rid, pos)``
-  changes (never inside a family) and keeping the unplaced tail (rid < 0)
-  in the final slice.  Slices are BGZF level-1 throwaways.
+- Splitting is index arithmetic, not I/O: :func:`plan_bai_ranges` picks
+  boundaries from the input's BAI linear index and each worker reads its
+  coordinate range DIRECTLY from the shared input via virtual offsets
+  (``io.columnar.BamRange``) — no slice files, no extra decode+rewrite
+  pass.  Boundaries fall only where ``(rid, pos)`` changes (never inside a
+  family); the unplaced tail (rid < 0) belongs to the final range.
 - Aggregation = merge per output class (disjoint sorted ranges — the merge
   degenerates to ordered concatenation), summed stats counters, summed
   family-size histograms.
@@ -33,88 +35,72 @@ import os
 import numpy as np
 
 from consensuscruncher_tpu.io.bam import BamWriter
-from consensuscruncher_tpu.io.bgzf import total_isize
 from consensuscruncher_tpu.utils.stats import FamilySizeHistogram, StageStats
 
 
-def split_bam_ranges(in_bam: str, n: int, out_dir: str) -> list[str]:
-    """Split a coordinate-sorted BAM into ``n`` range slices of roughly
-    equal uncompressed size.  Returns the slice paths (some may hold zero
-    records when the input has fewer distinct positions than slices).
+def plan_bai_ranges(in_bam: str, n: int) -> list["BamRange"]:
+    """Plan ``n`` disjoint coordinate ranges of a sorted BAM from its BAI —
+    workers read their range straight out of the SHARED input via virtual
+    offsets (VERDICT r3 item 4), replacing the materialized slice files
+    (which cost a full decode+re-encode pass the 101M proof run paid as
+    real minutes).
 
-    Boundaries fall only where ``(rid, pos)`` changes, so no family — and
-    therefore no rescue or duplex pairing — ever spans two slices; records
-    with ``rid < 0`` (unplaced tail of a sorted BAM) stay in the last
-    open slice.
+    Split points are 16 kb linear-index windows whose compressed offset
+    best partitions the file bytes into ``n`` even spans.  Every boundary
+    is a (rid, window_pos) key: records sharing a (rid, pos) anchor — and
+    therefore families, rescue pairs, and duplex pairs — always land in
+    exactly one range.  The unplaced tail (rid < 0) belongs to the final
+    range.  Deterministic for a given (input, n) — the property
+    ``--resume`` relies on.
     """
-    from consensuscruncher_tpu.io.columnar import ColumnarReader
+    from consensuscruncher_tpu.io.bai import BaiIndex, index_bam
+    from consensuscruncher_tpu.io.columnar import BamRange, pack_coord_key
 
-    os.makedirs(out_dir, exist_ok=True)
-    target = max(1, total_isize(in_bam) // n)
-    reader = ColumnarReader(in_bam)
-    paths: list[str] = []
-    writer = None
-    written = 0
-    last_key: tuple[int, int] | None = None
+    bai_path = index_bam(in_bam, skip_if_fresh=True)
+    idx = BaiIndex.load(bai_path)
+    # (coffset, key, voffset) per populated linear window, in key order.
+    entries: list[tuple[int, int, int]] = []
+    for rid, lin in enumerate(idx.linear):
+        prev = 0
+        for w, voff in enumerate(lin):
+            if voff and voff != prev:
+                entries.append((voff >> 16, pack_coord_key(rid, w << 14), voff))
+                prev = voff
+    csize = os.path.getsize(in_bam)
+    ranges: list[BamRange] = []
+    start_voff, start_key = 0, -1  # range 0: from the first record
+    used = 0
+    for i in range(1, n):
+        target = csize * i // n
+        j = np.searchsorted([e[0] for e in entries[used:]], target) + used
+        if j >= len(entries):
+            break
+        coff, key, voff = entries[j]
+        if key <= start_key:
+            continue
+        ranges.append(BamRange(start_voff, start_key, key))
+        start_voff, start_key = voff, key
+        used = j + 1
+    ranges.append(BamRange(start_voff, start_key, None))
+    # degenerate inputs (few/no indexed windows) yield fewer ranges; pad
+    # with empty ranges so workers/aggregation stay uniform
+    while len(ranges) < n:
+        ranges.append(BamRange(start_voff, start_key, start_key))
+    return ranges
 
-    def next_writer() -> BamWriter:
-        nonlocal writer, written
-        if writer is not None:
-            writer.close()
-        path = os.path.join(out_dir, f"range{len(paths):03d}.bam")
-        paths.append(path)
-        writer = BamWriter(path, reader.header, level=1)
-        written = 0
-        return writer
 
-    try:
-        next_writer()
-        for b in reader.batches():
-            if not b.n:
-                continue
-            rid = b.ref_id.astype(np.int64)
-            pos = b.pos.astype(np.int64)
-            off = b.rec_off
-            # legal boundaries: (rid, pos) differs from the predecessor and
-            # the record is placed (never split or strand the unplaced tail)
-            same = np.empty(b.n, dtype=bool)
-            same[0] = last_key == (int(rid[0]), int(pos[0]))
-            np.logical_and(rid[1:] == rid[:-1], pos[1:] == pos[:-1],
-                           out=same[1:])
-            boundary = np.nonzero(~same & (rid >= 0))[0]
-            start = 0
-            # the target may have been reached exactly at the previous
-            # batch's end — rotate before writing if this batch opens on a
-            # legal boundary
-            if (written >= target and len(paths) < n and not same[0]
-                    and rid[0] >= 0):
-                next_writer()
-            while start < b.n:
-                end = b.n
-                if len(paths) < n:
-                    # earliest boundary whose preceding bytes reach target
-                    need = target - written
-                    k0 = start + int(np.searchsorted(
-                        off[start + 1 :] - off[start], need))
-                    j = np.searchsorted(boundary, max(k0, start + 1))
-                    if j < len(boundary):
-                        end = int(boundary[j])
-                writer.write_encoded(b.buf[int(off[start]) : int(off[end])])
-                written += int(off[end] - off[start])
-                last_key = (int(rid[end - 1]), int(pos[end - 1]))
-                if end < b.n:
-                    next_writer()
-                start = end
-    finally:
-        reader.close()
-        if writer is not None:
-            writer.close()
-    # materialize empty slices so workers/aggregation stay uniform
-    while len(paths) < n:
-        path = os.path.join(out_dir, f"range{len(paths):03d}.bam")
-        paths.append(path)
-        BamWriter(path, reader.header, level=1).close()
-    return paths
+def range_argv(r) -> str:
+    """Serialize a BamRange for the worker command line."""
+    end = "eof" if r.end_key is None else str(r.end_key)
+    return f"{r.start_voffset}:{r.start_key}:{end}"
+
+
+def parse_range_argv(spec: str):
+    from consensuscruncher_tpu.io.columnar import BamRange
+
+    voff, start, end = spec.split(":")
+    return BamRange(int(voff), int(start),
+                    None if end == "eof" else int(end))
 
 
 _NON_SUMMED = {"stage", "backend", "jax_backend", "cutoff", "max_mismatch"}
@@ -170,11 +156,16 @@ def concat_bams(paths: list[str], out_path: str, header, level: int = 6) -> None
     writer.close()
 
 
-def worker_argv(slice_path: str, out_dir: str, name: str, args) -> list[str]:
+def worker_argv(input_path: str, out_dir: str, name: str, args,
+                range_spec: str | None = None,
+                resume: bool = False) -> list[str]:
     """Build a worker's ``consensus`` argv from the parent's parsed args
-    (original pre-coercion surface; workers re-run the normal CLI)."""
+    (original pre-coercion surface; workers re-run the normal CLI).
+    ``range_spec`` points the worker at its BAI coordinate range of the
+    shared input; ``resume`` lets an intact worker skip via its own
+    manifest."""
     argv = [
-        "consensus", "-i", slice_path, "-o", out_dir, "-n", name,
+        "consensus", "-i", input_path, "-o", out_dir, "-n", name,
         "--backend", str(args.backend),
         "--cutoff", str(args.cutoff),
         "--qualscore", str(args.qualscore),
@@ -183,6 +174,10 @@ def worker_argv(slice_path: str, out_dir: str, name: str, args) -> list[str]:
         "--bdelim", args.bdelim,
         "--compress_level", str(args.compress_level),
     ]
+    if range_spec is not None:
+        argv += ["--input_range", range_spec]
+    if resume:
+        argv += ["--resume", "True"]
     if getattr(args, "devices", None):
         argv += ["--devices", str(args.devices)]
     return argv

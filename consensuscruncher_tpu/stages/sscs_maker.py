@@ -103,6 +103,7 @@ def run_sscs(
     devices: int | None = None,
     wire: str = "stream",
     level: int = 6,
+    input_range=None,
 ) -> SscsResult:
     """``devices``: shard each family batch across this many chips
     (``parallel.mesh`` family-data-parallel path); None/1 = single device.
@@ -138,15 +139,19 @@ def run_sscs(
     if backend == "reference":
         # True reference-style run: per-read object decode + dict grouping
         # (the honest bench.py baseline denominator).
+        if input_range is not None:
+            raise ValueError("input_range requires a columnar backend")
         reader = BamReader(in_bam)
         header = reader.header
         source = stream_families(reader, header, bdelim)
     else:
         # Production path: columnar batch decode + vectorized grouping
         # (same events, same order — stage outputs are byte-identical).
+        # ``input_range``: a BAI coordinate range of the shared input
+        # (--host_workers reads ranges directly, no slice files).
         from consensuscruncher_tpu.io.columnar import ColumnarReader
 
-        reader = ColumnarReader(in_bam)
+        reader = ColumnarReader(in_bam, bam_range=input_range)
         header = reader.header
         source = None  # built below once the pipeline flavor is known
     use_blocks = backend == "tpu" and wire == "stream"

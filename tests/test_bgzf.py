@@ -128,3 +128,58 @@ def test_codec_threads_byte_identical(tmp_path, monkeypatch):
     assert sizes1 == sizes3
     monkeypatch.setenv("CCT_BGZF_THREADS", "3")
     assert bg.decompress_file(str(tmp_path / "t3.bam")) == data
+
+
+# ---- async writer (VERDICT r3 item 3: writer-side codec/compute overlap) ----
+
+def _write_chunks(path, data, **kw):
+    with bgzf.BgzfWriter(str(path), **kw) as w:
+        # uneven chunk sizes exercise buffering across block boundaries
+        for off in range(0, len(data), 70_001):
+            w.write(data[off:off + 70_001])
+
+
+def test_async_writer_byte_identical(tmp_path):
+    """Async mode must produce byte-for-byte the same file as sync mode:
+    one worker consumes chunks in enqueue order with identical block
+    boundaries and deflate level."""
+    data = bytes(range(256)) * 40_000  # ~10 MB -> many blocks + batches
+    sync_p, async_p = tmp_path / "s.bgzf", tmp_path / "a.bgzf"
+    _write_chunks(sync_p, data, async_write=False)
+    _write_chunks(async_p, data, async_write=True)
+    assert sync_p.read_bytes() == async_p.read_bytes()
+
+
+def test_async_writer_collects_identical_block_sizes(tmp_path):
+    data = b"ACGTN" * 500_000
+    sizes = {}
+    for name, mode in (("s", False), ("a", True)):
+        w = bgzf.BgzfWriter(str(tmp_path / f"{name}.bgzf"), collect_blocks=True,
+                            async_write=mode)
+        w.write(data)
+        w.close()
+        sizes[name] = list(w.block_sizes)
+    assert sizes["s"] == sizes["a"] and sizes["s"]
+
+
+def test_async_writer_surfaces_worker_errors(tmp_path):
+    class Boom(io.RawIOBase):
+        def writable(self):
+            return True
+
+        def write(self, b):
+            raise OSError("disk gone")
+
+    w = bgzf.BgzfWriter(Boom(), async_write=True)
+    w.write(b"x" * (8 << 20))  # enough to force an emit through the queue
+    with pytest.raises(RuntimeError, match="truncated") as ei:
+        w.close()
+    assert isinstance(ei.value.__cause__, OSError)
+    w.close()  # idempotent: a failed close stays closed, raises once
+
+
+def test_async_default_respects_env(monkeypatch):
+    monkeypatch.setenv("CCT_ASYNC_WRITER", "1")
+    assert bgzf.async_write_default() is True
+    monkeypatch.setenv("CCT_ASYNC_WRITER", "0")
+    assert bgzf.async_write_default() is False

@@ -331,3 +331,74 @@ def test_consensus_host_workers_parity(tmp_path):
         assert a == b, rel
     for png in ("family_size", "read_recovery", "stage_times"):
         assert os.path.exists(tmp_path / "sharded" / "a" / "plots" / f"a.{png}.png")
+
+
+def test_host_workers_resume_after_killed_worker(tmp_path):
+    """--resume composes with --host_workers (VERDICT r3 weak 4): after an
+    interrupted run in which only worker r0 finished, the resumed parent
+    skips r0's stages (outputs untouched) and completes r1, and the final
+    merged outputs match a clean sharded run digest-for-digest."""
+    import glob
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(REPO, "test"))
+    from make_test_data import canonical_bam_digest
+
+    from consensuscruncher_tpu.cli import main as cli_main
+    from consensuscruncher_tpu.parallel.hostshard import (plan_bai_ranges,
+                                                          range_argv)
+
+    src = os.path.join(REPO, "test", "data", "sample_adversarial.bam")
+    common = ["--backend", "xla_cpu", "--scorrect", "True"]
+
+    clean = tmp_path / "clean"
+    cli_main(["consensus", "-i", src, "-o", str(clean), "-n", "a",
+              "--host_workers", "2", *common])
+
+    # Interrupted state: only worker r0 ran to completion (its own manifest
+    # records every stage), r1 never started, the parent never merged.
+    resumed = tmp_path / "resumed"
+    ranges_dir = resumed / "a" / ".ranges"
+    os.makedirs(ranges_dir)
+    r0 = plan_bai_ranges(src, 2)[0]
+    cli_main(["consensus", "-i", src, "-o", str(ranges_dir), "-n", "r0",
+              "--input_range", range_argv(r0), *common])
+    r0_sscs = ranges_dir / "r0" / "sscs" / "r0.sscs.sorted.bam"
+    stamp = os.stat(r0_sscs).st_mtime_ns
+
+    cli_main(["consensus", "-i", src, "-o", str(resumed), "-n", "a",
+              "--host_workers", "2", "--resume", "True", *common])
+
+    assert os.stat(r0_sscs).st_mtime_ns == stamp  # r0's SSCS was skipped
+    checked = 0
+    for p in sorted(glob.glob(str(clean / "a" / "**" / "*.bam"),
+                              recursive=True)):
+        q = p.replace(os.sep + "clean" + os.sep, os.sep + "resumed" + os.sep)
+        assert os.path.exists(q), q
+        assert canonical_bam_digest(p) == canonical_bam_digest(q), q
+        checked += 1
+    assert checked >= 10
+
+
+def test_host_workers_resume_refuses_changed_plan(tmp_path):
+    """A resumed sharded run whose input signature changed must refuse
+    loudly instead of pairing stale worker outputs with new ranges."""
+    import json as _json
+    import os
+
+    import pytest
+
+    from consensuscruncher_tpu.cli import main as cli_main
+
+    src = os.path.join(REPO, "test", "data", "sample_adversarial.bam")
+    out = tmp_path / "o"
+    ranges_dir = out / "a" / ".ranges"
+    os.makedirs(ranges_dir)
+    with open(ranges_dir / "ranges.json", "w") as f:
+        _json.dump({"sig": {"path": "elsewhere", "size": 1, "mtime": 0,
+                            "n": 2}, "ranges": []}, f)
+    with pytest.raises(SystemExit, match="rerun without --resume"):
+        cli_main(["consensus", "-i", src, "-o", str(out), "-n", "a",
+                  "--host_workers", "2", "--resume", "True",
+                  "--backend", "xla_cpu", "--scorrect", "True"])
