@@ -42,12 +42,27 @@ def prefetch(iterable: Iterable[T], depth: int = DEFAULT_DEPTH) -> Iterator[T]:
     batches).  ``depth <= 0`` degrades to plain iteration (no thread).
     Exceptions raised by the producer re-raise at the consumer's next pull,
     and abandoning the consumer (``close()`` / GC) unblocks the producer.
+
+    The producer thread starts at the consumer's FIRST pull (generator
+    semantics).  When the point is to start producing NOW — e.g. staging
+    the next sample's decode behind the current sample's device compute —
+    use :func:`start_prefetch` instead.
     """
     if depth <= 0:
         yield from iterable
         return
+    yield from start_prefetch(iterable, depth)
 
-    q: queue.Queue = queue.Queue(maxsize=depth)
+
+def start_prefetch(iterable: Iterable[T], depth: int = DEFAULT_DEPTH) -> Iterator[T]:
+    """:func:`prefetch` with the producer thread started immediately.
+
+    Returns the draining iterator; the producer fills the bounded queue in
+    the background from the moment this function returns, whether or not
+    the consumer has begun pulling.  Same ordering/exception/abandonment
+    contract as :func:`prefetch`.
+    """
+    q: queue.Queue = queue.Queue(maxsize=max(1, depth))
     stop = threading.Event()
     failure: list[BaseException] = []
 
@@ -73,29 +88,62 @@ def prefetch(iterable: Iterable[T], depth: int = DEFAULT_DEPTH) -> Iterator[T]:
                     continue
 
     thread = threading.Thread(target=worker, daemon=True, name="cct-prefetch")
-    thread.start()
-    try:
-        while True:
-            item = q.get()
-            if item is _SENTINEL:
-                if failure:
-                    raise failure[0]
-                return
-            yield item
-    finally:
-        stop.set()
+    thread.start()  # eager: producing begins before the first pull
+    return _Prefetched(q, stop, failure, thread)
+
+
+class _Prefetched:
+    """Draining iterator over a running producer thread.
+
+    A plain class (not a generator) so :meth:`close` works even when the
+    consumer never pulled a single item — closing an unstarted generator
+    skips its ``finally`` and would leak the producer thread.
+    """
+
+    def __init__(self, q, stop, failure, thread):
+        self._q, self._stop, self._failure, self._thread = q, stop, failure, thread
+        self._done = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._done:
+            raise StopIteration
+        item = self._q.get()
+        if item is _SENTINEL:
+            self._shutdown()
+            if self._failure:
+                raise self._failure[0]
+            raise StopIteration
+        return item
+
+    def close(self) -> None:
+        self._shutdown()
+
+    def __del__(self):
+        # GC safety net (the old generator form had one via its finally):
+        # an abandoned iterator must at least signal the producer to stop —
+        # without the join/raise, which are close()'s deterministic path.
+        self._done = True
+        self._stop.set()
+
+    def _shutdown(self) -> None:
+        if self._done:
+            return
+        self._done = True
+        self._stop.set()
         # Deterministic shutdown: close() must not return while the producer
         # can still touch state shared with the consumer's cleanup (e.g. the
         # SSCS stage aborts BAM writers that events() writes to).  The
         # producer polls `stop` every 0.1 s, so this join is bounded unless
         # the underlying iterable itself blocks indefinitely.
-        thread.join(timeout=30.0)
-        if thread.is_alive():
+        self._thread.join(timeout=30.0)
+        if self._thread.is_alive():
             # Returning here would let callers tear down state the producer
             # still touches (the use-after-abort race close() exists to
             # prevent) — surface the hang instead of racing.  Chain any
-            # in-flight exception (consumer error or GeneratorExit from
-            # close()) so this never masks the root cause.
+            # in-flight exception so this never masks the root cause.
             raise RuntimeError(
                 "prefetch producer thread failed to stop within 30s; "
                 "the source iterable is blocked"

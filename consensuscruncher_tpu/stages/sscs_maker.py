@@ -81,6 +81,45 @@ def output_paths(out_prefix: str) -> dict[str, str]:
     }
 
 
+@dataclass
+class PrestagedBlocks:
+    """An input's decode already running on a producer thread.
+
+    Built by :func:`prestage_blocks` for the multi-sample batch: while
+    sample N's pipeline drains the device, sample N+1's columnar decode +
+    family grouping fills a bounded queue, so its SSCS stage starts with
+    blocks already in hand.  ``close()`` is idempotent and safe on an
+    unconsumed prestage (a resume-skipped stage must not leak the producer
+    thread or the open reader).
+    """
+
+    header: object
+    reader: object
+    events: object  # parallel.prefetch.start_prefetch iterator
+
+    def close(self) -> None:
+        try:
+            self.events.close()
+        finally:
+            self.reader.close()
+
+
+def prestage_blocks(in_bam: str, bdelim: str = tags_mod.DEFAULT_BDELIM,
+                    depth: int = 4) -> PrestagedBlocks:
+    """Start decoding ``in_bam`` into FamilyBlock events NOW, on a
+    background thread behind a ``depth``-bounded queue (memory bound:
+    blocks are the unit).  Consume via ``run_sscs(..., prestaged=...)``
+    with the same ``bdelim``."""
+    from consensuscruncher_tpu.io.columnar import ColumnarReader
+    from consensuscruncher_tpu.parallel.prefetch import start_prefetch
+    from consensuscruncher_tpu.stages.grouping import stream_family_blocks
+
+    reader = ColumnarReader(in_bam)
+    events = start_prefetch(
+        stream_family_blocks(reader, reader.header, bdelim), depth=depth)
+    return PrestagedBlocks(reader.header, reader, events)
+
+
 def _member_arrays(members):
     seqs, quals = [], []
     for m in members:
@@ -104,6 +143,7 @@ def run_sscs(
     wire: str = "stream",
     level: int = 6,
     input_range=None,
+    prestaged: "PrestagedBlocks | None" = None,
 ) -> SscsResult:
     """``devices``: shard each family batch across this many chips
     (``parallel.mesh`` family-data-parallel path); None/1 = single device.
@@ -114,7 +154,12 @@ def run_sscs(
     dominates stage wall-clock on tunneled devices) or ``"dense"`` (padded
     ``(B, F, L)`` batches).  Both are bit-identical by the parity suite,
     and both shard over the ``devices`` mesh (the stream wire keeps its
-    byte advantage there: whole families per device, no collectives)."""
+    byte advantage there: whole families per device, no collectives).
+
+    ``prestaged``: an eagerly-started decode of THIS input from
+    :func:`prestage_blocks` — the multi-sample batch overlap (sample N+1's
+    columnar decode runs behind sample N's device compute).  Requires the
+    block path (tpu backend + stream wire); byte-identical outputs."""
     if backend not in ("cpu", "tpu", "reference"):
         raise ValueError(
             f"unknown backend {backend!r} (expected 'cpu', 'tpu', or 'reference')"
@@ -136,6 +181,12 @@ def run_sscs(
     paths = output_paths(out_prefix)
     sscs_path, singleton_path, bad_path = paths["sscs"], paths["singleton"], paths["bad"]
 
+    use_blocks_early = backend == "tpu" and wire == "stream"
+    if prestaged is not None and (input_range is not None or not use_blocks_early):
+        # A prestage that cannot be consumed must not silently leak its
+        # producer thread + open reader — close it and decode normally.
+        prestaged.close()
+        prestaged = None
     if backend == "reference":
         # True reference-style run: per-read object decode + dict grouping
         # (the honest bench.py baseline denominator).
@@ -144,6 +195,10 @@ def run_sscs(
         reader = BamReader(in_bam)
         header = reader.header
         source = stream_families(reader, header, bdelim)
+    elif prestaged is not None:
+        reader = prestaged.reader
+        header = prestaged.header
+        source = None
     else:
         # Production path: columnar batch decode + vectorized grouping
         # (same events, same order — stage outputs are byte-identical).
@@ -205,7 +260,9 @@ def run_sscs(
         device pipeline array-level items keyed by ``(block, j)``."""
         from consensuscruncher_tpu.stages.grouping import stream_family_blocks
 
-        for kind, a, b in stream_family_blocks(reader, header, bdelim):
+        block_events = (prestaged.events if prestaged is not None
+                        else stream_family_blocks(reader, header, bdelim))
+        for kind, a, b in block_events:
             if kind == "bad":
                 stats.incr("total_reads")
                 stats.incr(f"bad_{b}")
@@ -379,6 +436,9 @@ def run_sscs(
         single_surgery.flush()
         ok = True
     finally:
+        if prestaged is not None:
+            # join the prestage producer BEFORE closing the reader it decodes
+            prestaged.close()
         reader.close()
         if not ok:
             # never promote a partial output on error

@@ -136,3 +136,32 @@ def test_consensus_families_prefetch_parity():
     for (_, b1, q1), (_, b2, q2) in zip(serial, buffered):
         np.testing.assert_array_equal(b1, b2)
         np.testing.assert_array_equal(q1, q2)
+
+
+def test_start_prefetch_is_eager_and_closable_unconsumed():
+    """start_prefetch begins producing before the first pull, and close()
+    on a never-pulled iterator still stops and joins the producer (the
+    abandoned-prestage case must not leak the thread)."""
+    import threading
+    import time
+
+    from consensuscruncher_tpu.parallel.prefetch import start_prefetch
+
+    started = threading.Event()
+
+    def gen():
+        started.set()
+        yield from range(100)
+
+    n0 = sum(1 for t in threading.enumerate() if t.name == "cct-prefetch")
+    it = start_prefetch(gen(), depth=2)
+    assert started.wait(5.0)  # produced without any pull
+    it.close()
+    it.close()  # idempotent
+    time.sleep(0.2)
+    assert sum(1 for t in threading.enumerate()
+               if t.name == "cct-prefetch") == n0
+
+    # and a consumed one still yields everything in order
+    it = start_prefetch(iter(range(10)), depth=3)
+    assert list(it) == list(range(10))
