@@ -34,6 +34,9 @@ for _i, _c in enumerate(b"ACGT"):
     _CODE[_c] = _i
     _CODE[ord(chr(_c).lower())] = _i
 _COMP = {"A": "T", "C": "G", "G": "C", "T": "A", "N": "N"}
+# code-space complement: A0<->T3, C1<->G2; N(4) and invalid bytes unchanged
+_REVCOMP_LUT = np.arange(256, dtype=np.uint8)
+_REVCOMP_LUT[:4] = [3, 2, 1, 0]
 
 
 def revcomp(seq: str) -> str:
@@ -53,6 +56,100 @@ class Hit:
     mapq: int
 
 
+class _SortedKmerIndex:
+    """Vectorized reference k-mer index: one sorted int64 key array + the
+    matching global positions, built with array passes only (the former
+    ``dict[kmer] -> list`` form cost one Python dict insert per reference
+    base, which at chromosome scale is minutes and gigabytes).
+
+    Refs concatenate into ``gcodes`` with a single 0xFF separator byte
+    between them — any k-window crossing a boundary contains the separator
+    and is dropped by the validity mask, so no k-mer spans two refs.
+    Equal keys keep position-ascending order (stable argsort), preserving
+    the scan order the old dict-of-lists produced.
+    """
+
+    def __init__(self, ref_codes: list[np.ndarray], k: int):
+        self.k = k
+        lens = np.array([len(c) for c in ref_codes], np.int64)
+        self.lens = lens
+        bases, parts, off = [], [], 0
+        for i, c in enumerate(ref_codes):
+            bases.append(off)
+            parts.append(c)
+            off += len(c)
+            if i < len(ref_codes) - 1:
+                parts.append(np.full(1, 0xFF, np.uint8))
+                off += 1
+        self.gbase = np.asarray(bases, np.int64)
+        self.gcodes = (np.concatenate(parts) if parts
+                       else np.zeros(0, np.uint8))
+        g = len(self.gcodes)
+        if g >= k:
+            valid = self.gcodes < 4
+            nk = g - k + 1
+            keys = np.zeros(nk, np.int64)
+            ok = np.ones(nk, bool)
+            for j in range(k):
+                keys = (keys << 2) | self.gcodes[j:j + nk].astype(np.int64)
+                ok &= valid[j:j + nk]
+            pos = np.nonzero(ok)[0]
+            keys = keys[pos] & ((np.int64(1) << (2 * k)) - 1)
+            order = np.argsort(keys, kind="stable")
+            self.skmers = keys[order]
+            self.spos = pos[order]
+        else:
+            self.skmers = np.zeros(0, np.int64)
+            self.spos = np.zeros(0, np.int64)
+        # Prefix radix table: the first PREF_BITS levels of every binary
+        # search collapse to one table lookup, and the remaining search
+        # runs inside a ~|index|/2^pref_bits-entry window (cache-resident).
+        # Plain np.searchsorted over a chromosome-scale index is a random
+        # 25-probe cold-cache walk per seed — measured 70% of the whole
+        # align leg at 30M reference bases.
+        self.pref_bits = min(2 * k, max(10, int(np.log2(max(len(self.skmers), 2))) - 6))
+        self._pref_shift = 2 * k - self.pref_bits
+        pref = self.skmers >> self._pref_shift
+        self.pref_table = np.searchsorted(
+            pref, np.arange((np.int64(1) << self.pref_bits) + 1, dtype=np.int64))
+
+    def lookup_batch(self, keys: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        """Vectorized equal-range over the sorted index: ``(lo, hi)`` per
+        key, via the prefix table + a windowed branchless binary search."""
+        pref = keys >> self._pref_shift
+        lo_l = self.pref_table[pref]
+        hi_l = self.pref_table[pref + 1]
+        lo_r, hi_r = lo_l.copy(), hi_l.copy()
+        width = int((hi_l - lo_l).max(initial=0))
+        steps = max(1, int(np.ceil(np.log2(width + 1)))) if width else 0
+        guard = max(len(self.skmers) - 1, 0)
+        for _ in range(steps):
+            # left bound: first index with skmers[i] >= key
+            mid = (lo_l + hi_l) >> 1
+            v = self.skmers[np.minimum(mid, guard)]
+            right = v < keys
+            lo_l = np.where(right, mid + 1, lo_l)
+            hi_l = np.where(right, hi_l, mid)
+            # right bound: first index with skmers[i] > key
+            mid = (lo_r + hi_r) >> 1
+            v = self.skmers[np.minimum(mid, guard)]
+            right = v <= keys
+            lo_r = np.where(right, mid + 1, lo_r)
+            hi_r = np.where(right, hi_r, mid)
+        return lo_l, lo_r
+
+    def lookup(self, key: int) -> np.ndarray:
+        """Global positions of one k-mer (position-ascending)."""
+        lo = int(np.searchsorted(self.skmers, key))
+        hi = int(np.searchsorted(self.skmers, key, side="right"))
+        return self.spos[lo:hi]
+
+    def ref_of(self, gpos: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        """Vectorized global position -> (ref_idx, local_pos)."""
+        ri = np.searchsorted(self.gbase, gpos, side="right") - 1
+        return ri, gpos - self.gbase[ri]
+
+
 class BuiltinAligner:
     """K-mer seed + ungapped extend against an in-memory reference."""
 
@@ -63,24 +160,13 @@ class BuiltinAligner:
         self.max_mismatch_frac = max_mismatch_frac
         self.refs: list[tuple[str, int]] = []
         self._ref_codes: dict[str, np.ndarray] = {}
-        self._index: dict[int, list[tuple[str, int]]] = {}
+        codes_list: list[np.ndarray] = []
         for name, seq in read_fasta(fasta_path).items():
-            self.refs.append((name, len(seq)))
             codes = _encode(seq)
+            self.refs.append((name, len(seq)))
             self._ref_codes[name] = codes
-            # Roll k-mers into ints (2 bits/base); skip any window with N.
-            if len(codes) < k:
-                continue
-            valid = codes < 4
-            kmers = np.zeros(len(codes) - k + 1, np.int64)
-            ok = np.ones(len(codes) - k + 1, bool)
-            for j in range(k):
-                window = codes[j : j + len(kmers)]
-                kmers = (kmers << 2) | window
-                ok &= valid[j : j + len(kmers)]
-            for p in range(0, len(kmers), 1):
-                if ok[p]:
-                    self._index.setdefault(int(kmers[p]), []).append((name, p))
+            codes_list.append(codes)
+        self._sidx = _SortedKmerIndex(codes_list, k)
 
     def _seed_votes(self, codes: np.ndarray):
         """Candidate (ref, diagonal) offsets from strided seed lookups."""
@@ -95,9 +181,13 @@ class BuiltinAligner:
             key = 0
             for v in window:
                 key = (key << 2) | int(v)
-            for ref, p in self._index.get(key, ()):
-                diag = p - start
-                votes[(ref, diag)] = votes.get((ref, diag), 0) + 1
+            hits = self._sidx.lookup(key)
+            if len(hits):
+                ris, lps = self._sidx.ref_of(hits)
+                for ri, lp in zip(ris, lps):
+                    diag = int(lp) - start
+                    rk = (self.refs[int(ri)][0], diag)
+                    votes[rk] = votes.get(rk, 0) + 1
         return votes
 
     def _extend(self, codes: np.ndarray, ref: str, pos: int) -> int | None:
@@ -130,6 +220,279 @@ class BuiltinAligner:
         mapq = 60 if len(candidates) == 1 else \
             max(0, min(60, 10 * (candidates[1][0] - nm)))
         return Hit(ref=ref, pos=pos, reverse=reverse, nm=nm, mapq=mapq)
+
+    # -------------------------------------------------------------- batch
+    _HIT_CAP = 64   # hits taken per seed (repetitive k-mers truncate here)
+    _TOP_C = 4      # diagonals extended per strand (matches align())
+
+    def align_batch(self, codes: np.ndarray) -> dict:
+        """Vectorized :meth:`align` over a ``(B, L)`` uint8 code batch.
+
+        One numpy pass replaces B per-read Python walks — the measured wall
+        of the 100M-read fastq2bam flow (VERDICT r3 item 6).  Semantics
+        match :meth:`align` (same seeds, same top-``_TOP_C``-by-votes
+        candidate rule with first-seen tie order, same stable min-nm pick,
+        same mapq) except that pathological repetitive seeds truncate at
+        ``_HIT_CAP`` hits.  Returns ``(B,)`` arrays: ``mapped`` (bool),
+        ``ref_idx``/``pos``/``nm``/``mapq`` (int32, -1/0 where unmapped),
+        ``reverse`` (bool).
+        """
+        B, L = codes.shape
+        k, stride = self.k, self.seed_stride
+        out = {
+            "mapped": np.zeros(B, bool),
+            "ref_idx": np.full(B, -1, np.int32),
+            "pos": np.full(B, -1, np.int64),
+            "nm": np.zeros(B, np.int32),
+            "mapq": np.zeros(B, np.int32),
+            "reverse": np.zeros(B, bool),
+        }
+        if B == 0 or L < k or not len(self._sidx.skmers):
+            return out
+        max_nm = int(L * self.max_mismatch_frac)
+
+        # Both strands as one (2B, L) block: row 2r = forward, 2r+1 = rev.
+        rc = _REVCOMP_LUT[codes[:, ::-1]]
+        allc = np.empty((2 * B, L), np.uint8)
+        allc[0::2] = codes
+        allc[1::2] = rc
+
+        # --- strided seed keys ------------------------------------------
+        starts = np.arange(0, L - k + 1, stride, dtype=np.int64)
+        S = len(starts)
+        keys = np.zeros((2 * B, S), np.int64)
+        ok = np.ones((2 * B, S), bool)
+        for j in range(k):
+            col = allc[:, starts + j]
+            keys = (keys << 2) | col.astype(np.int64)
+            ok &= col < 4
+        keys &= (np.int64(1) << (2 * k)) - 1
+
+        # --- index lookups ----------------------------------------------
+        flat_keys = keys.reshape(-1)
+        flat_ok = ok.reshape(-1)
+        lo, hi = self._sidx.lookup_batch(flat_keys)
+        cnt = np.where(flat_ok, np.minimum(hi - lo, self._HIT_CAP), 0)
+        H = int(cnt.sum())
+        if H == 0:
+            return out
+        seed_of = np.repeat(np.arange(2 * B * S, dtype=np.int64), cnt)
+        within = np.arange(H, dtype=np.int64) - np.repeat(
+            np.concatenate([[0], np.cumsum(cnt)[:-1]]), cnt)
+        gpos = self._sidx.spos[lo[seed_of] + within]
+        row = seed_of // S
+        sstart = starts[seed_of % S]
+        diag = gpos - sstart                      # global candidate start
+        # Vote key must carry the hit's REF, not just the global diagonal:
+        # hits on two adjacent refs can share a diag value and align()
+        # keeps their votes separate (per (ref, local_diag)).
+        hit_ref = np.searchsorted(self._sidx.gbase, gpos, side="right") - 1
+        vkey = (hit_ref << 44) | (diag + (np.int64(1) << 20))
+        seen = np.arange(H, dtype=np.int64)       # first-seen order = scan order
+
+        # --- vote per (row, ref, diag): run-length over the sorted pairs --
+        o = np.lexsort((seen, vkey, row))
+        row_s, vkey_s, seen_s = row[o], vkey[o], seen[o]
+        new = np.empty(H, bool)
+        new[0] = True
+        new[1:] = (row_s[1:] != row_s[:-1]) | (vkey_s[1:] != vkey_s[:-1])
+        run_start = np.nonzero(new)[0]
+        votes = np.diff(np.concatenate([run_start, [H]]))
+        c_row = row_s[run_start]
+        c_diag = diag[o][run_start]
+        c_seen = seen_s[run_start]  # min within run (seen sorted last key)
+
+        # --- top _TOP_C per row by (votes desc, first-seen asc) ----------
+        o2 = np.lexsort((c_seen, -votes, c_row))
+        rr = c_row[o2]
+        first = np.empty(len(rr), bool)
+        first[0] = True
+        first[1:] = rr[1:] != rr[:-1]
+        rank = np.arange(len(rr)) - np.maximum.accumulate(
+            np.where(first, np.arange(len(rr)), 0))
+        keep = rank < self._TOP_C
+        k_row = rr[keep]
+        k_diag = c_diag[o2][keep]
+        k_rank = rank[keep]
+
+        # --- bounds + ungapped extension --------------------------------
+        ri, lp = self._sidx.ref_of(k_diag)
+        inb = (k_diag >= 0) & (lp >= 0) & (lp + L <= self._sidx.lens[ri])
+        k_row, k_diag, k_rank, ri, lp = (a[inb] for a in
+                                         (k_row, k_diag, k_rank, ri, lp))
+        if not len(k_row):
+            return out
+        win = self._sidx.gcodes[k_diag[:, None] + np.arange(L, dtype=np.int64)]
+        nm = (win != allc[k_row]).sum(1).astype(np.int64)
+        good = nm <= max_nm
+        k_row, k_diag, k_rank, ri, lp, nm = (a[good] for a in
+                                             (k_row, k_diag, k_rank, ri, lp, nm))
+        if not len(k_row):
+            return out
+
+        # --- stable min-nm per READ across both strands ------------------
+        # candidate insertion order in align(): forward strand's top-4
+        # first, then reverse's — i.e. (strand, vote-rank); pick by
+        # (nm, order) like the stable sort in align().
+        read = k_row >> 1
+        order = (k_row & 1) * self._TOP_C + k_rank
+        o3 = np.lexsort((order, nm, read))
+        rd = read[o3]
+        first = np.empty(len(rd), bool)
+        first[0] = True
+        first[1:] = rd[1:] != rd[:-1]
+        best = np.nonzero(first)[0]
+        n_cand = np.diff(np.concatenate([best, [len(rd)]]))
+        b_read = rd[best]
+        b_nm = nm[o3][best]
+        runner_nm = np.where(n_cand > 1,
+                             nm[o3][np.minimum(best + 1, len(rd) - 1)], 0)
+        mapq = np.where(
+            n_cand == 1, 60,
+            np.clip(10 * (runner_nm - b_nm), 0, 60)).astype(np.int32)
+        out["mapped"][b_read] = True
+        out["ref_idx"][b_read] = ri[o3][best].astype(np.int32)
+        out["pos"][b_read] = lp[o3][best]
+        out["nm"][b_read] = b_nm.astype(np.int32)
+        out["mapq"][b_read] = mapq
+        out["reverse"][b_read] = (k_row[o3][best] & 1).astype(bool)
+        return out
+
+
+def align_fastqs_columnar(aligner: BuiltinAligner, r1: str, r2: str,
+                          out_bam: str, level: int = 6) -> tuple[int, int]:
+    """Columnar twin of :func:`align_pairs` over whole FASTQ batch pairs:
+    ``align_batch`` for the placement and ``encode_records`` for emission —
+    no per-read Python in the loop (the measured wall of the 100M-read
+    fastq2bam flow).  Returns ``(n_reads, n_unmapped)``.  Record bytes are
+    identical to the object path (tests pin digest parity).
+    """
+    from consensuscruncher_tpu.io.bam import BamHeader
+    from consensuscruncher_tpu.io.columnar import SortingBamWriter
+    from consensuscruncher_tpu.io.encode import encode_records
+    from consensuscruncher_tpu.stages.extract_barcodes import (_batch_zipper,
+                                                               tok_matrix)
+    from consensuscruncher_tpu.utils.phred import encode_seq
+
+    # TWO code spaces on purpose: alignment compares in _CODE space
+    # (non-ACGT -> 255, so read-N over ref-N matches, exactly like
+    # align()/_encode), while emission uses pipeline codes (N -> 4) for
+    # encode_records' seq nibbles.
+    emit_lut = encode_seq(np.arange(256, dtype=np.uint8).tobytes())
+    header = BamHeader.from_refs(aligner.refs)
+    n_total = n_unmapped = 0
+    writer = SortingBamWriter(out_bam, header, level=level)
+    try:
+        for c1, c2 in _batch_zipper(r1, r2):
+            d1, ns1, nl1, ss1, sl1, qs1 = c1
+            d2, ns2, nl2, ss2, sl2, qs2 = c2
+            tok1, tl1 = tok_matrix(d1, ns1, nl1)
+            tok2, tl2 = tok_matrix(d2, ns2, nl2)
+            w = max(tok1.shape[1], tok2.shape[1])
+            p1 = np.zeros((len(tl1), w), np.uint8)
+            p2 = np.zeros((len(tl2), w), np.uint8)
+            p1[:, :tok1.shape[1]] = tok1
+            p2[:, :tok2.shape[1]] = tok2
+            bad = (tl1 != tl2) | (p1 != p2).any(1)
+            if bad.any():
+                i = int(np.nonzero(bad)[0][0])
+                t1 = bytes(tok1[i, : tl1[i]]).decode(errors="replace")
+                t2 = bytes(tok2[i, : tl2[i]]).decode(errors="replace")
+                raise SystemExit(f"R1/R2 qname mismatch: {t1!r} vs {t2!r}")
+            # equal-length buckets (usually exactly one for real runs)
+            lkey = sl1.astype(np.int64) << 32 | sl2.astype(np.int64)
+            for key in np.unique(lkey):
+                sel = np.nonzero(lkey == key)[0]
+                l1, l2 = int(key >> 32), int(key & 0xFFFFFFFF)
+                n_total += 2 * len(sel)
+                n_unmapped += _align_emit_bucket(
+                    aligner, writer, encode_records, emit_lut,
+                    d1, ss1[sel], qs1[sel], l1,
+                    d2, ss2[sel], qs2[sel], l2,
+                    tok1[sel], tl1[sel])
+    except BaseException:
+        writer.abort()
+        raise
+    writer.close()
+    return n_total, n_unmapped
+
+
+def _align_emit_bucket(aligner, writer, encode_records, emit_lut,
+                       d1, ss1, qs1, l1, d2, ss2, qs2, l2,
+                       tok, tok_lens) -> int:
+    """Align one equal-length bucket of pairs and emit both mates'
+    records columnar.  Returns the bucket's unmapped-read count."""
+    B = len(ss1)
+    if B == 0:
+        return 0
+    span1 = ss1[:, None] + np.arange(l1, dtype=np.int64)
+    span2 = ss2[:, None] + np.arange(l2, dtype=np.int64)
+    # alignment space: non-ACGT -> 255 (see align_fastqs_columnar)
+    codes1 = emit_lut[d1[span1]]
+    codes2 = emit_lut[d2[span2]]
+    acodes1 = _CODE[d1[span1]]
+    acodes2 = _CODE[d2[span2]]
+    qual1 = d1[qs1[:, None] + np.arange(l1, dtype=np.int64)] - 33
+    qual2 = d2[qs2[:, None] + np.arange(l2, dtype=np.int64)] - 33
+    h1 = aligner.align_batch(acodes1)
+    h2 = aligner.align_batch(acodes2)
+
+    m1, m2 = h1["mapped"], h2["mapped"]
+    proper = m1 & m2 & (h1["ref_idx"] == h2["ref_idx"]) & (h1["reverse"] != h2["reverse"])
+    # FR pair tlen: leftmost gets +, by align_pairs' exact tie rule
+    lo = np.minimum(h1["pos"], h2["pos"])
+    hi = np.maximum(h1["pos"] + l1, h2["pos"] + l2)
+    span = np.where(proper, hi - lo, 0)
+    tie = h1["pos"] == h2["pos"]
+    tlen1 = np.where(proper, np.where(tie | (h1["pos"] == lo), span, -span), 0)
+    tlen2 = np.where(proper, np.where(tie, -span,
+                                      np.where(h2["pos"] == lo, span, -span)), 0)
+
+    unmapped = 0
+    for this, mate, codes, qual, L, read1, tl in (
+        (h1, h2, codes1, qual1, l1, True, tlen1),
+        (h2, h1, codes2, qual2, l2, False, tlen2),
+    ):
+        tm, mm = this["mapped"], mate["mapped"]
+        unmapped += int((~tm).sum())
+        flag = np.full(B, 0x1 | (0x40 if read1 else 0x80), np.int32)
+        flag |= np.where(proper, 0x2, 0)
+        flag |= np.where(~tm, 0x4, 0)
+        flag |= np.where(tm & this["reverse"], 0x10, 0)
+        flag |= np.where(~mm, 0x8, 0)
+        flag |= np.where(mm & mate["reverse"], 0x20, 0)
+        rid = np.where(tm, this["ref_idx"], np.where(mm, mate["ref_idx"], -1))
+        pos = np.where(tm, this["pos"], np.where(mm, mate["pos"], -1))
+        mrid = np.where(mm, mate["ref_idx"], rid)
+        mpos = np.where(mm, mate["pos"], pos)
+        rev = tm & this["reverse"]
+        out_codes = np.where(rev[:, None], _REVCOMP_LUT[codes[:, ::-1]], codes)
+        out_qual = np.where(rev[:, None], qual[:, ::-1], qual)
+        cig_lens = tm.astype(np.int64)
+        cig_words = np.full(int(cig_lens.sum()), (L << 4) | 0, np.uint32)
+        tag7 = np.zeros((B, 7), np.uint8)
+        tag7[:, :3] = np.frombuffer(b"NMi", np.uint8)
+        tag7[:, 3:] = this["nm"].astype("<i4").view(np.uint8).reshape(B, 4)
+        tag_lens = np.where(tm, 7, 0).astype(np.int64)
+        from consensuscruncher_tpu.utils.ragged import gather_runs
+
+        tok_data, _ = gather_runs(
+            tok.reshape(-1),
+            np.arange(B, dtype=np.int64) * tok.shape[1], tok_lens)
+        blob = encode_records(
+            tok_data,
+            tok_lens,
+            flag, rid.astype(np.int64), pos.astype(np.int64),
+            np.where(tm, this["mapq"], 0).astype(np.int64),
+            cig_words, cig_lens,
+            mrid.astype(np.int64), mpos.astype(np.int64), tl.astype(np.int64),
+            np.ascontiguousarray(out_codes).reshape(-1),
+            np.full(B, L, np.int64),
+            np.ascontiguousarray(out_qual).reshape(-1),
+            tag7[tm].reshape(-1), tag_lens,
+        )
+        writer.write_encoded(blob)
+    return unmapped
 
 
 def align_pairs(aligner: BuiltinAligner, pairs, header):

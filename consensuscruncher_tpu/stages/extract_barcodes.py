@@ -122,6 +122,29 @@ def _upper_lut():
     return _UPPER
 
 
+def tok_matrix(data, starts, lens):
+    """(matrix, tok_len): name bytes up to the first whitespace (space or
+    tab — the object paths' str.split() contract).  Shared by the extract
+    stage and the columnar aligner (stages/align.py)."""
+    import numpy as np
+
+    from consensuscruncher_tpu.utils.ragged import scatter_runs
+
+    w = int(lens.max()) if len(lens) else 0
+    mat = np.zeros((len(starts), max(w, 1)), np.uint8)
+    if w:
+        scatter_runs(mat.reshape(-1),
+                     np.arange(len(starts), dtype=np.int64) * mat.shape[1],
+                     data, lens.astype(np.int64),
+                     src_starts=starts.astype(np.int64))
+    ws = (mat == 32) | (mat == 9)
+    has = ws.any(axis=1)
+    tok_len = np.where(has, np.argmax(ws, axis=1), lens)
+    # zero out beyond the token so row equality == token equality
+    mat[np.arange(mat.shape[1])[None, :] >= tok_len[:, None]] = 0
+    return mat, tok_len
+
+
 def _run_extract_vectorized(
     read1, read2, pattern, whitelist, bdelim, stats, distribution, writers
 ) -> None:
@@ -140,23 +163,6 @@ def _run_extract_vectorized(
         wl_arr = np.array(sorted(w.encode("ascii") for w in whitelist),
                           dtype=f"S{U}")
     sep_b = np.frombuffer(BARCODE_SEP.encode(), np.uint8)
-
-    def tok_matrix(data, starts, lens):
-        """(matrix, tok_len): name bytes up to the first whitespace."""
-        w = int(lens.max()) if len(lens) else 0
-        mat = np.zeros((len(starts), max(w, 1)), np.uint8)
-        from consensuscruncher_tpu.utils.ragged import scatter_runs
-
-        if w:
-            scatter_runs(mat.reshape(-1),
-                         np.arange(len(starts), dtype=np.int64) * mat.shape[1],
-                         data, lens, src_starts=starts)
-        ws = (mat == 32) | (mat == 9)
-        has = ws.any(axis=1)
-        tok_len = np.where(has, np.argmax(ws, axis=1), lens)
-        # zero out beyond the token so row equality == token equality
-        mat[np.arange(mat.shape[1])[None, :] >= tok_len[:, None]] = 0
-        return mat, tok_len
 
     for c1, c2 in _batch_zipper(read1, read2):
         d1, ns1, nl1, ss1, sl1, qs1 = c1
