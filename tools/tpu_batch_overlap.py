@@ -1,0 +1,86 @@
+"""TPU-window job: measure the multi-sample batch overlap on real silicon.
+
+On the tunneled TPU the host idles during h2d/d2h and on-chip compute —
+exactly the window sample N+1's prestaged decode (cli.py batch overlap)
+exists to fill.  This job times the same 2-sample workload twice:
+sequential single-sample CLI runs vs one comma-batch run (prestaging on),
+and prints JSON lines with both walls and the ratio.
+
+Run by tools/tpu_watch.py during a live window (tools/tpu_jobs.json).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def emit(row):
+    print(json.dumps(row), flush=True)
+
+
+def cli(args, env):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "ConsensusCruncher.py"),
+         "consensus", *args],
+        env=env, capture_output=True, text=True, cwd=REPO)
+
+
+def main() -> int:
+    sys.path.insert(0, REPO)
+    from consensuscruncher_tpu.utils.simulate import SimConfig, simulate_bam_fast
+
+    env = dict(os.environ)
+    td = tempfile.mkdtemp(prefix="cct_tpu_batch_")
+    a, b = os.path.join(td, "sa.bam"), os.path.join(td, "sb.bam")
+    n_frag = int(os.environ.get("CCT_BATCH_FRAGMENTS", 40_000))
+    simulate_bam_fast(a, SimConfig(n_fragments=n_frag, read_len=100,
+                                   mean_family_size=4.0, seed=31,
+                                   ref_len=max(100_000, 40 * n_frag)))
+    simulate_bam_fast(b, SimConfig(n_fragments=n_frag, read_len=100,
+                                   mean_family_size=4.0, seed=32,
+                                   ref_len=max(100_000, 40 * n_frag)))
+    common = ["--backend", "tpu", "--scorrect", "True"]
+
+    # warm the jit cache out of the measurement (first compile ~20-40s)
+    p = cli(["-i", a, "-o", os.path.join(td, "warm"), *common], env)
+    if p.returncode != 0:
+        emit({"job": "batch_overlap", "ok": False,
+              "error": p.stderr.strip().splitlines()[-3:]})
+        return 1
+
+    t0 = time.perf_counter()
+    for s in (a, b):
+        p = cli(["-i", s, "-o", os.path.join(td, "seq"), *common], env)
+        if p.returncode != 0:
+            emit({"job": "batch_overlap", "ok": False,
+                  "error": p.stderr.strip().splitlines()[-3:]})
+            return 1
+    seq_wall = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    p = cli(["-i", f"{a},{b}", "-o", os.path.join(td, "batch"), *common], env)
+    batch_wall = time.perf_counter() - t0
+    if p.returncode != 0:
+        emit({"job": "batch_overlap", "ok": False,
+              "error": p.stderr.strip().splitlines()[-3:]})
+        return 1
+    overlapped = "(next sample prestaging)" in p.stdout
+    emit({"job": "batch_overlap", "ok": True, "backend": "tpu",
+          "n_fragments_each": n_frag,
+          "sequential_s": round(seq_wall, 1),
+          "batch_s": round(batch_wall, 1),
+          "speedup": round(seq_wall / batch_wall, 3) if batch_wall else None,
+          "prestaging_active": overlapped,
+          "loadavg": round(os.getloadavg()[0], 2)})
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
