@@ -504,6 +504,7 @@ def _consensus_impl(args) -> dict:
             backend=args.backend,
             bdelim=args.bdelim,
             devices=args.devices,
+            wire=getattr(args, "wire", "stream"),
             level=args.compress_level,
             input_range=input_range,
             prestaged=getattr(args, "_prestaged", None),
@@ -703,6 +704,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "outputs merge by concatenation. The host-core "
                         "multiplier on multi-core machines; default 1")
     c.add_argument("--input_range", default=None, help=argparse.SUPPRESS)
+    c.add_argument("--wire", choices=("stream", "dense"), default="stream",
+                   help="device wire layout for the SSCS vote: 'stream' "
+                        "(packed member stream — 8-16x fewer h2d bytes, the "
+                        "production default) or 'dense' (padded (B,F,L) "
+                        "batches; bake-off/debug). Bit-identical outputs")
     c.set_defaults(func=consensus, config_section="consensus",
                    required_args=("input", "output"),
                    builtin_defaults={

@@ -173,6 +173,7 @@ def worker_argv(input_path: str, out_dir: str, name: str, args,
         "--max_mismatch", str(args.max_mismatch),
         "--bdelim", args.bdelim,
         "--compress_level", str(args.compress_level),
+        "--wire", str(getattr(args, "wire", "stream")),
     ]
     if range_spec is not None:
         argv += ["--input_range", range_spec]
