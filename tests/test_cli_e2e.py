@@ -402,3 +402,21 @@ def test_host_workers_resume_refuses_changed_plan(tmp_path):
         cli_main(["consensus", "-i", src, "-o", str(out), "-n", "a",
                   "--host_workers", "2", "--resume", "True",
                   "--backend", "xla_cpu", "--scorrect", "True"])
+
+
+def test_consensus_wire_flag_bit_identical(tmp_path):
+    """--wire dense must reproduce the stream wire's outputs byte-for-byte
+    (the two device layouts are interchangeable by design)."""
+    import hashlib
+    import os
+
+    from consensuscruncher_tpu.cli import main as cli_main
+
+    src = os.path.join(REPO, "test", "data", "sample.bam")
+    outs = {}
+    for wire in ("stream", "dense"):
+        cli_main(["consensus", "-i", src, "-o", str(tmp_path / wire),
+                  "-n", "w", "--backend", "xla_cpu", "--wire", wire])
+        p = tmp_path / wire / "w" / "sscs" / "w.sscs.sorted.bam"
+        outs[wire] = hashlib.sha256(p.read_bytes()).hexdigest()
+    assert outs["stream"] == outs["dense"]
