@@ -124,18 +124,25 @@ class _SortedKmerIndex:
         steps = max(1, int(np.ceil(np.log2(width + 1)))) if width else 0
         guard = max(len(self.skmers) - 1, 0)
         for _ in range(steps):
+            # Converged lanes (lo == hi) must FREEZE: the fixed-step loop
+            # keeps running for the widest bucket, and a clamped re-read at
+            # lo == hi == len(skmers) compares "go right" and would walk
+            # the bound past the array (measured: top-key k-mers of a 100M
+            # reference).
             # left bound: first index with skmers[i] >= key
+            act = lo_l < hi_l
             mid = (lo_l + hi_l) >> 1
             v = self.skmers[np.minimum(mid, guard)]
-            right = v < keys
+            right = act & (v < keys)
             lo_l = np.where(right, mid + 1, lo_l)
-            hi_l = np.where(right, hi_l, mid)
+            hi_l = np.where(act & ~right, mid, hi_l)
             # right bound: first index with skmers[i] > key
+            act = lo_r < hi_r
             mid = (lo_r + hi_r) >> 1
             v = self.skmers[np.minimum(mid, guard)]
-            right = v <= keys
+            right = act & (v <= keys)
             lo_r = np.where(right, mid + 1, lo_r)
-            hi_r = np.where(right, hi_r, mid)
+            hi_r = np.where(act & ~right, mid, hi_r)
         return lo_l, lo_r
 
     def lookup(self, key: int) -> np.ndarray:

@@ -333,3 +333,29 @@ def test_columnar_parity_with_reference_N_runs(tmp_path):
     with BamReader(col_bam) as r:
         mapped = [x for x in r if not x.is_unmapped and x.flag & 0x40]
     assert len(mapped) == 30
+
+
+def test_lookup_batch_max_key_region(tmp_path):
+    """Regression (100M-ref crash): keys at the top of the k-mer space
+    converge on lo == hi == len(index); the windowed binary search must
+    freeze converged lanes instead of walking past the array."""
+    from consensuscruncher_tpu.stages.align import _SortedKmerIndex
+
+    rng = np.random.default_rng(2)
+    # reference ending in a T-run puts real k-mers at the key-space maximum
+    codes = np.concatenate([
+        rng.integers(0, 4, 5000).astype(np.uint8),
+        np.full(60, 3, np.uint8),
+    ])
+    idx = _SortedKmerIndex([codes], 21)
+    top = (np.int64(1) << 42) - 1
+    keys = np.concatenate([
+        np.array([top, top - 1, int(idx.skmers[-1]), int(idx.skmers[0]), 0],
+                 np.int64),
+        idx.skmers[rng.integers(0, len(idx.skmers), 2000)],
+        rng.integers(0, 1 << 42, 2000, dtype=np.int64),
+    ])
+    lo, hi = idx.lookup_batch(keys)
+    assert (lo == np.searchsorted(idx.skmers, keys)).all()
+    assert (hi == np.searchsorted(idx.skmers, keys, side="right")).all()
+    assert int(hi.max()) <= len(idx.skmers)
