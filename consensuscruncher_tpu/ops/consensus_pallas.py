@@ -22,6 +22,19 @@ outputs at ``j == F-1``.  The device layout is therefore ``(F, B, L)``
 ``(1, Bt, L)`` plane; the wrapper transposes from the batching layer's
 ``(B, F, L)``.  All shapes are static per (F, L) bucket, same as the XLA
 path.
+
+STATUS (round 4, first compiled execution on real v5e silicon): the kernel
+compiles and runs bit-correct, but LOSES the host-to-host bake-off —
+6,020 fam/s vs 7,979 (dense XLA) vs 15,432 (packed segment wire) at
+(8192, 16, 100); see ``tpu_evidence/kernels_r04.json``.  Over the tunnel
+every number is wire-bound, and the Pallas path pays an extra host-side
+transpose+pad on the same dense wire, so it cannot win there; the
+device-resident comparison (``tools/tpu_device_bench.py``, queued on the
+session watcher) decides whether its single-pass HBM story beats XLA's
+fusions on-chip.  NOT on any production path — the stage default is the
+packed member-stream wire (``ops.consensus_segment``), whose 2.5x smaller
+wire format dominates end-to-end regardless of the on-chip winner.  Kept
+as the Pallas reference implementation and bake-off candidate.
 """
 
 from __future__ import annotations
