@@ -88,7 +88,8 @@ def fastq2bam(args) -> dict:
     )
 
     out_bam = os.path.join(bam_dir, f"{name}.sorted.bam")
-    align_and_sort(args.bwa, args.ref, extract.r1_out, extract.r2_out, out_bam)
+    align_and_sort(args.bwa, args.ref, extract.r1_out, extract.r2_out, out_bam,
+                   host_workers=int(getattr(args, "host_workers", 1) or 1))
     # reference: `samtools index` after every sort (§3.1) — usually a no-op
     # now (the columnar sort writes its .bai inline)
     index_bam(out_bam, skip_if_fresh=True)
@@ -102,15 +103,21 @@ def fastq2bam(args) -> dict:
     return {"bam": out_bam, "extract": extract}
 
 
-def align_and_sort(bwa: str, ref: str, r1: str, r2: str, out_bam: str) -> None:
+def align_and_sort(bwa: str, ref: str, r1: str, r2: str, out_bam: str,
+                   host_workers: int = 1) -> None:
     """Run the external aligner, consume its SAM stdout into BAM, sort.
 
     Reference parity: ``bwa mem | samtools view -b`` + ``samtools sort``
     subprocesses (SURVEY.md §3.1) — here the SAM→BAM and sort legs are
     in-process (framework-owned codec), only the aligner stays external.
+
+    ``host_workers`` parallelizes the BUILTIN aligner's per-chunk compute
+    over forked processes (byte-identical output; stages/align.py).  The
+    external-aligner path ignores it — thread ``bwa mem -t N`` through
+    ``--bwa 'bwa -t N'``-style invocation instead.
     """
     if bwa == "builtin":
-        _align_builtin(ref, r1, r2, out_bam)
+        _align_builtin(ref, r1, r2, out_bam, host_workers=host_workers)
         return
     cmd = shlex.split(bwa) + ["mem", ref, r1, r2]
     try:
@@ -144,7 +151,8 @@ def align_and_sort(bwa: str, ref: str, r1: str, r2: str, out_bam: str) -> None:
     writer.close()
 
 
-def _align_builtin(ref: str, r1: str, r2: str, out_bam: str) -> None:
+def _align_builtin(ref: str, r1: str, r2: str, out_bam: str,
+                   host_workers: int = 1) -> None:
     """``--bwa builtin``: the in-process k-mer aligner (stages/align.py) —
     runs the full fastq2bam flow when no external aligner exists (test/demo
     scope: substitutions only, no indels).  Columnar path: batched seed/
@@ -154,7 +162,8 @@ def _align_builtin(ref: str, r1: str, r2: str, out_bam: str) -> None:
                                                     align_fastqs_columnar)
 
     aligner = BuiltinAligner(ref)
-    n_total, n_unmapped = align_fastqs_columnar(aligner, r1, r2, out_bam)
+    n_total, n_unmapped = align_fastqs_columnar(aligner, r1, r2, out_bam,
+                                                workers=host_workers)
     # The builtin aligner is substitutions-only (no indels, no clips): on
     # real sequencing data it silently fails reads a gapped aligner would
     # place.  A high unaligned fraction is the fingerprint of that failure
@@ -661,10 +670,14 @@ def build_parser() -> argparse.ArgumentParser:
     f.add_argument("--blist", "-l")
     f.add_argument("--bdelim")
     f.add_argument("--cleanup", help="remove intermediate tag FASTQs after alignment")
+    f.add_argument("--host_workers", type=int, metavar="N",
+                   help="fan the builtin aligner's per-chunk compute over N "
+                        "forked worker processes (byte-identical output; "
+                        "ignored for an external --bwa — use its own -t)")
     f.set_defaults(func=fastq2bam, config_section="fastq2bam",
                    required_args=("fastq1", "fastq2", "output", "ref"),
                    builtin_defaults={"bwa": "bwa", "bdelim": DEFAULT_BDELIM,
-                                     "cleanup": "False"})
+                                     "cleanup": "False", "host_workers": 1})
 
     c = sub.add_parser("consensus", help="collapse UMI families into SSCS/DCS")
     c.add_argument("-c", "--config", default=None)

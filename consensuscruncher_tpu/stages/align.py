@@ -366,21 +366,85 @@ class BuiltinAligner:
         return out
 
 
+def _align_tasks(r1: str, r2: str, pair_chunk: int):
+    """Yield compact per-chunk task tuples ``(seq1, qual1, seq2, qual2,
+    tok, tok_lens)`` — equal-length byte matrices gathered out of the
+    FASTQ batch buffers, so a task pickles as a few small arrays instead
+    of dragging the whole batch through the pool pipe."""
+    from consensuscruncher_tpu.stages.extract_barcodes import (_batch_zipper,
+                                                               tok_matrix)
+
+    for c1, c2 in _batch_zipper(r1, r2):
+        d1, ns1, nl1, ss1, sl1, qs1 = c1
+        d2, ns2, nl2, ss2, sl2, qs2 = c2
+        tok1, tl1 = tok_matrix(d1, ns1, nl1)
+        tok2, tl2 = tok_matrix(d2, ns2, nl2)
+        w = max(tok1.shape[1], tok2.shape[1])
+        p1 = np.zeros((len(tl1), w), np.uint8)
+        p2 = np.zeros((len(tl2), w), np.uint8)
+        p1[:, :tok1.shape[1]] = tok1
+        p2[:, :tok2.shape[1]] = tok2
+        bad = (tl1 != tl2) | (p1 != p2).any(1)
+        if bad.any():
+            i = int(np.nonzero(bad)[0][0])
+            t1 = bytes(tok1[i, : tl1[i]]).decode(errors="replace")
+            t2 = bytes(tok2[i, : tl2[i]]).decode(errors="replace")
+            raise SystemExit(f"R1/R2 qname mismatch: {t1!r} vs {t2!r}")
+        # equal-length buckets (usually exactly one for real runs)
+        lkey = sl1.astype(np.int64) << 32 | sl2.astype(np.int64)
+        for key in np.unique(lkey):
+            sel = np.nonzero(lkey == key)[0]
+            l1, l2 = int(key >> 32), int(key & 0xFFFFFFFF)
+            a1 = np.arange(l1, dtype=np.int64)
+            a2 = np.arange(l2, dtype=np.int64)
+            for c0 in range(0, len(sel), pair_chunk):
+                sc = sel[c0:c0 + pair_chunk]
+                yield (d1[ss1[sc, None] + a1], d1[qs1[sc, None] + a1],
+                       d2[ss2[sc, None] + a2], d2[qs2[sc, None] + a2],
+                       np.ascontiguousarray(tok1[sc]), tl1[sc])
+
+
+# Fork-pool worker state: set in the parent immediately before the pool
+# forks, inherited copy-on-write by the children (the k-mer index is
+# hundreds of MB at genome scale — pickling it per task is a non-starter).
+_POOL_ALIGNER: "BuiltinAligner | None" = None
+_POOL_EMIT_LUT: np.ndarray | None = None
+
+
+def _pool_bucket_blobs(task):
+    from consensuscruncher_tpu.io.encode import encode_records
+
+    return _bucket_blobs(_POOL_ALIGNER, encode_records, _POOL_EMIT_LUT, *task)
+
+
 def align_fastqs_columnar(aligner: BuiltinAligner, r1: str, r2: str,
-                          out_bam: str, level: int = 6) -> tuple[int, int]:
+                          out_bam: str, level: int = 6,
+                          workers: int = 1,
+                          pair_chunk: int = 16384) -> tuple[int, int]:
     """Columnar twin of :func:`align_pairs` over whole FASTQ batch pairs:
     ``align_batch`` for the placement and ``encode_records`` for emission —
     no per-read Python in the loop (the measured wall of the 100M-read
     fastq2bam flow).  Returns ``(n_reads, n_unmapped)``.  Record bytes are
     identical to the object path (tests pin digest parity).
+
+    ``workers > 1`` fans the per-chunk align+encode compute (~85% of the
+    leg's wall on one core) over a forked process pool; the parent writes
+    each chunk's blobs as they complete, in submission order, through the
+    one :class:`SortingBamWriter`.  Output bytes are IDENTICAL to the
+    serial path regardless of ``workers``/``pair_chunk``: the writer's
+    total order is content-keyed (rid, pos, qname, flag — never append
+    order), which is the same property that lets the object and columnar
+    paths byte-match.  The pool forks before the writer exists, so no
+    BGZF/codec thread state crosses the fork.
     """
+    import multiprocessing as mp
+
     from consensuscruncher_tpu.io.bam import BamHeader
     from consensuscruncher_tpu.io.columnar import SortingBamWriter
     from consensuscruncher_tpu.io.encode import encode_records
-    from consensuscruncher_tpu.stages.extract_barcodes import (_batch_zipper,
-                                                               tok_matrix)
     from consensuscruncher_tpu.utils.phred import encode_seq
 
+    global _POOL_ALIGNER, _POOL_EMIT_LUT
     # TWO code spaces on purpose: alignment compares in _CODE space
     # (non-ACGT -> 255, so read-N over ref-N matches, exactly like
     # align()/_encode), while emission uses pipeline codes (N -> 4) for
@@ -388,59 +452,77 @@ def align_fastqs_columnar(aligner: BuiltinAligner, r1: str, r2: str,
     emit_lut = encode_seq(np.arange(256, dtype=np.uint8).tobytes())
     header = BamHeader.from_refs(aligner.refs)
     n_total = n_unmapped = 0
+    tasks = _align_tasks(r1, r2, pair_chunk)
+
+    pool = None
+    if workers > 1:
+        # These stay set for the POOL'S lifetime, not just the initial
+        # fork: mp.Pool replaces dead workers by forking the parent again,
+        # and a replacement forked after a reset would inherit None state.
+        _POOL_ALIGNER, _POOL_EMIT_LUT = aligner, emit_lut
+        pool = mp.get_context("fork").Pool(workers)
+
     writer = SortingBamWriter(out_bam, header, level=level)
     try:
-        for c1, c2 in _batch_zipper(r1, r2):
-            d1, ns1, nl1, ss1, sl1, qs1 = c1
-            d2, ns2, nl2, ss2, sl2, qs2 = c2
-            tok1, tl1 = tok_matrix(d1, ns1, nl1)
-            tok2, tl2 = tok_matrix(d2, ns2, nl2)
-            w = max(tok1.shape[1], tok2.shape[1])
-            p1 = np.zeros((len(tl1), w), np.uint8)
-            p2 = np.zeros((len(tl2), w), np.uint8)
-            p1[:, :tok1.shape[1]] = tok1
-            p2[:, :tok2.shape[1]] = tok2
-            bad = (tl1 != tl2) | (p1 != p2).any(1)
-            if bad.any():
-                i = int(np.nonzero(bad)[0][0])
-                t1 = bytes(tok1[i, : tl1[i]]).decode(errors="replace")
-                t2 = bytes(tok2[i, : tl2[i]]).decode(errors="replace")
-                raise SystemExit(f"R1/R2 qname mismatch: {t1!r} vs {t2!r}")
-            # equal-length buckets (usually exactly one for real runs)
-            lkey = sl1.astype(np.int64) << 32 | sl2.astype(np.int64)
-            for key in np.unique(lkey):
-                sel = np.nonzero(lkey == key)[0]
-                l1, l2 = int(key >> 32), int(key & 0xFFFFFFFF)
-                n_total += 2 * len(sel)
-                n_unmapped += _align_emit_bucket(
-                    aligner, writer, encode_records, emit_lut,
-                    d1, ss1[sel], qs1[sel], l1,
-                    d2, ss2[sel], qs2[sel], l2,
-                    tok1[sel], tl1[sel])
+        if pool is None:
+            for task in tasks:
+                blob1, blob2, un = _bucket_blobs(
+                    aligner, encode_records, emit_lut, *task)
+                n_total += 2 * len(task[0])
+                n_unmapped += un
+                writer.write_encoded(blob1)
+                writer.write_encoded(blob2)
+        else:
+            from collections import deque
+
+            pending: deque = deque()
+            max_inflight = workers + 2
+
+            def drain_one():
+                nonlocal n_unmapped
+                blob1, blob2, un = pending.popleft().get()
+                n_unmapped += un
+                writer.write_encoded(blob1)
+                writer.write_encoded(blob2)
+
+            for task in tasks:
+                while len(pending) >= max_inflight:
+                    drain_one()
+                n_total += 2 * len(task[0])
+                pending.append(pool.apply_async(_pool_bucket_blobs, (task,)))
+            while pending:
+                drain_one()
     except BaseException:
         writer.abort()
         raise
+    finally:
+        if pool is not None:
+            pool.terminate()
+            pool.join()
+            _POOL_ALIGNER = _POOL_EMIT_LUT = None
     writer.close()
     return n_total, n_unmapped
 
 
-def _align_emit_bucket(aligner, writer, encode_records, emit_lut,
-                       d1, ss1, qs1, l1, d2, ss2, qs2, l2,
-                       tok, tok_lens) -> int:
-    """Align one equal-length bucket of pairs and emit both mates'
-    records columnar.  Returns the bucket's unmapped-read count."""
-    B = len(ss1)
+def _bucket_blobs(aligner, encode_records, emit_lut,
+                  seq1, rq1, seq2, rq2, tok, tok_lens):
+    """Align one equal-length chunk of pairs (raw seq/qual byte matrices)
+    and build both mates' encoded record blobs.  Pure compute — no writer
+    access — so it runs unchanged in a forked pool worker.  Returns
+    ``(r1_blob, r2_blob, n_unmapped)``.
+    """
+    B, l1 = seq1.shape
+    _, l2 = seq2.shape
     if B == 0:
-        return 0
-    span1 = ss1[:, None] + np.arange(l1, dtype=np.int64)
-    span2 = ss2[:, None] + np.arange(l2, dtype=np.int64)
+        z = np.zeros(0, np.uint8)
+        return z, z, 0
     # alignment space: non-ACGT -> 255 (see align_fastqs_columnar)
-    codes1 = emit_lut[d1[span1]]
-    codes2 = emit_lut[d2[span2]]
-    acodes1 = _CODE[d1[span1]]
-    acodes2 = _CODE[d2[span2]]
-    qual1 = d1[qs1[:, None] + np.arange(l1, dtype=np.int64)] - 33
-    qual2 = d2[qs2[:, None] + np.arange(l2, dtype=np.int64)] - 33
+    codes1 = emit_lut[seq1]
+    codes2 = emit_lut[seq2]
+    acodes1 = _CODE[seq1]
+    acodes2 = _CODE[seq2]
+    qual1 = rq1 - 33
+    qual2 = rq2 - 33
     h1 = aligner.align_batch(acodes1)
     h2 = aligner.align_batch(acodes2)
 
@@ -456,6 +538,7 @@ def _align_emit_bucket(aligner, writer, encode_records, emit_lut,
                                       np.where(h2["pos"] == lo, span, -span)), 0)
 
     unmapped = 0
+    blobs = []
     for this, mate, codes, qual, L, read1, tl in (
         (h1, h2, codes1, qual1, l1, True, tlen1),
         (h2, h1, codes2, qual2, l2, False, tlen2),
@@ -498,8 +581,8 @@ def _align_emit_bucket(aligner, writer, encode_records, emit_lut,
             np.ascontiguousarray(out_qual).reshape(-1),
             tag7[tm].reshape(-1), tag_lens,
         )
-        writer.write_encoded(blob)
-    return unmapped
+        blobs.append(blob)
+    return blobs[0], blobs[1], unmapped
 
 
 def align_pairs(aligner: BuiltinAligner, pairs, header):

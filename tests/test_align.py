@@ -359,3 +359,48 @@ def test_lookup_batch_max_key_region(tmp_path):
     assert (lo == np.searchsorted(idx.skmers, keys)).all()
     assert (hi == np.searchsorted(idx.skmers, keys, side="right")).all()
     assert int(hi.max()) <= len(idx.skmers)
+
+
+def test_fastq2bam_host_workers_byte_parity(tmp_path):
+    """--host_workers 2 on fastq2bam: the builtin aligner's forked-pool
+    path must produce a byte-identical BAM + BAI to the serial path — the
+    SortingBamWriter total order is content-keyed (rid, pos, qname, flag),
+    never append order, so chunk-parallel emission cannot reorder output.
+    A tiny pair_chunk forces multiple in-flight pool tasks at test size."""
+    import hashlib
+
+    from consensuscruncher_tpu.cli import main as cli_main
+    from consensuscruncher_tpu.stages.align import (BuiltinAligner,
+                                                    align_fastqs_columnar)
+    from consensuscruncher_tpu.utils.simulate import (SimConfig,
+                                                      simulate_fastq_pairs)
+
+    r1, r2, fa = simulate_fastq_pairs(
+        str(tmp_path / "sim"),
+        SimConfig(n_fragments=250, read_len=100, umi_len=6,
+                  ref_len=150_000, mean_family_size=2.0, seed=31))
+    for w in (1, 2):
+        cli_main(["fastq2bam", "-f1", r1, "-f2", r2,
+                  "-o", str(tmp_path / f"o{w}"), "-n", "s",
+                  "--bwa", "builtin", "-r", fa, "--bpattern", "NNNNNNT",
+                  "--host_workers", str(w)])
+
+    def digest(d):
+        bam = tmp_path / d / "bamfiles" / "s.sorted.bam"
+        return (hashlib.sha256(bam.read_bytes()).hexdigest(),
+                hashlib.sha256((bam.parent / "s.sorted.bam.bai")
+                               .read_bytes()).hexdigest())
+
+    assert digest("o1") == digest("o2")
+
+    # library surface, small chunks => several tasks per worker in flight
+    al = BuiltinAligner(fa)
+    tag1 = tmp_path / "o1" / "fastq_tag" / "s_r1.fastq.gz"
+    tag2 = tmp_path / "o1" / "fastq_tag" / "s_r2.fastq.gz"
+    outs = []
+    for w, chunk in ((1, 10_000), (2, 64)):
+        out = tmp_path / f"lib_w{w}.bam"
+        n, u = align_fastqs_columnar(al, str(tag1), str(tag2), str(out),
+                                     workers=w, pair_chunk=chunk)
+        outs.append((n, u, hashlib.sha256(out.read_bytes()).hexdigest()))
+    assert outs[0] == outs[1]
