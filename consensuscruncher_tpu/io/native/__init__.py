@@ -25,7 +25,7 @@ import threading
 
 import numpy as np
 
-_ABI_VERSION = 7
+_ABI_VERSION = 8
 _SRC = os.path.join(os.path.dirname(__file__), "bgzf_native.cpp")
 
 _lock = threading.Lock()
@@ -124,6 +124,17 @@ def _build_and_load() -> ctypes.CDLL | None:
         ctypes.POINTER(ctypes.c_int64),     # lens (bytes)
         ctypes.c_int64,                     # n
         ctypes.c_int32,                     # value
+    ]
+    lib.cct_equal_range_i64.restype = None
+    lib.cct_equal_range_i64.argtypes = [
+        ctypes.POINTER(ctypes.c_int64),     # arr (sorted)
+        ctypes.POINTER(ctypes.c_int64),     # keys
+        ctypes.POINTER(ctypes.c_int64),     # lo0
+        ctypes.POINTER(ctypes.c_int64),     # hi0
+        ctypes.c_int64,                     # m
+        ctypes.POINTER(ctypes.c_int64),     # out_lo
+        ctypes.POINTER(ctypes.c_int64),     # out_hi
+        ctypes.c_int32,                     # n_threads
     ]
     return lib
 
@@ -409,6 +420,35 @@ def fill_runs_native(dst: np.ndarray, starts: np.ndarray, lens: np.ndarray, valu
     lib.cct_fill_runs(
         dst.ctypes.data_as(ctypes.c_char_p), _i64_ptr(ss), _i64_ptr(ll), n, int(value)
     )
+
+
+def equal_range_windowed(arr: np.ndarray, keys: np.ndarray,
+                         lo0: np.ndarray, hi0: np.ndarray,
+                         n_threads: int = 1) -> tuple[np.ndarray, np.ndarray]:
+    """Per-key equal-range over sorted int64 ``arr``, each key searched only
+    within its ``[lo0, hi0)`` window (the aligner's prefix-table bounds).
+    Returns ``(lo, hi)`` int64 arrays.  Raises RuntimeError when the native
+    library is unavailable — callers keep their vectorized numpy fallback.
+    """
+    lib = _get()
+    if lib is None:
+        raise RuntimeError("native codec unavailable")
+    a = np.ascontiguousarray(arr, dtype=np.int64)
+    k = np.ascontiguousarray(keys, dtype=np.int64)
+    lo = np.ascontiguousarray(lo0, dtype=np.int64)
+    hi = np.ascontiguousarray(hi0, dtype=np.int64)
+    m = len(k)
+    out_lo = np.empty(m, np.int64)
+    out_hi = np.empty(m, np.int64)
+    if m:
+        if len(lo) != m or len(hi) != m:
+            raise ValueError("equal_range_windowed: window arrays mismatch keys")
+        if int(hi.max(initial=0)) > len(a) or int(lo.min(initial=0)) < 0:
+            raise ValueError("equal_range_windowed: window out of bounds")
+        lib.cct_equal_range_i64(
+            _i64_ptr(a), _i64_ptr(k), _i64_ptr(lo), _i64_ptr(hi), m,
+            _i64_ptr(out_lo), _i64_ptr(out_hi), int(n_threads))
+    return out_lo, out_hi
 
 
 def deflate_payload_sizes(data: bytes, level: int = 6,

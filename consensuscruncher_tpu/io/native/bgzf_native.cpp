@@ -17,6 +17,7 @@
 // Build (done lazily by the Python wrapper):
 //   g++ -O3 -shared -fPIC -pthread bgzf_native.cpp -o bgzf_native.so -lz
 
+#include <algorithm>
 #include <atomic>
 #include <cstdint>
 #include <cstring>
@@ -27,7 +28,7 @@
 
 namespace {
 
-constexpr int kAbiVersion = 7;
+constexpr int kAbiVersion = 8;
 constexpr uint32_t kMaxBlockPayload = 0xFF00;  // htslib payload bound
 constexpr uint32_t kOutStride = 0x10400;       // per-block output slot (worst case + slack)
 
@@ -300,6 +301,38 @@ void cct_fill_runs(uint8_t* dst, const int64_t* starts, const int64_t* lens, int
   for (int64_t i = 0; i < n; ++i) {
     std::memset(dst + starts[i], value, static_cast<size_t>(lens[i]));
   }
+}
+
+// Windowed equal-range over a sorted int64 array: per key i, search only
+// [lo0[i], hi0[i]) (the aligner's prefix-table window) and write the
+// first index with arr[j] >= key to out_lo and the first with arr[j] >
+// key to out_hi.  Replaces the numpy branchless lockstep search, whose
+// fixed-step loop pays ~6 full-array passes per level for every lane —
+// here each key's search stays in registers over a cache-resident window.
+void cct_equal_range_i64(const int64_t* arr, const int64_t* keys, const int64_t* lo0,
+                         const int64_t* hi0, int64_t m, int64_t* out_lo, int64_t* out_hi,
+                         int32_t n_threads) {
+  constexpr int64_t kChunk = 4096;  // amortize the work-queue atomic
+  const int64_t n_chunks = (m + kChunk - 1) / kChunk;
+  parallel_for(n_chunks, n_threads, [&](int64_t c) -> int {
+    const int64_t end = std::min(m, (c + 1) * kChunk);
+    for (int64_t i = c * kChunk; i < end; ++i) {
+      const int64_t key = keys[i];
+      int64_t a = lo0[i], b = hi0[i];
+      while (a < b) {
+        const int64_t mid = (a + b) >> 1;
+        if (arr[mid] < key) a = mid + 1; else b = mid;
+      }
+      out_lo[i] = a;
+      int64_t x = a, y = hi0[i];
+      while (x < y) {
+        const int64_t mid = (x + y) >> 1;
+        if (arr[mid] <= key) x = mid + 1; else y = mid;
+      }
+      out_hi[i] = x;
+    }
+    return 0;
+  });
 }
 
 }  // extern "C"

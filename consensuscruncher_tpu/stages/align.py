@@ -115,10 +115,18 @@ class _SortedKmerIndex:
 
     def lookup_batch(self, keys: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
         """Vectorized equal-range over the sorted index: ``(lo, hi)`` per
-        key, via the prefix table + a windowed branchless binary search."""
+        key, via the prefix table + a windowed binary search — native C++
+        per-key (registers over a cache-resident window) when the codec
+        library is available, else the numpy branchless lockstep loop."""
         pref = keys >> self._pref_shift
         lo_l = self.pref_table[pref]
         hi_l = self.pref_table[pref + 1]
+        try:
+            from consensuscruncher_tpu.io import native
+
+            return native.equal_range_windowed(self.skmers, keys, lo_l, hi_l)
+        except RuntimeError:
+            pass
         lo_r, hi_r = lo_l.copy(), hi_l.copy()
         width = int((hi_l - lo_l).max(initial=0))
         steps = max(1, int(np.ceil(np.log2(width + 1)))) if width else 0

@@ -181,3 +181,50 @@ def test_gather_fixed_and_expand_nibbles_parity():
         )
     )
     np.testing.assert_array_equal(a, b)
+
+
+def test_equal_range_windowed_parity_and_fallback(monkeypatch):
+    """Native windowed equal-range == np.searchsorted on full and partial
+    windows (windows always containing the true range), and the aligner's
+    lookup_batch numpy fallback stays live when the library is gone."""
+    import numpy as np
+
+    from consensuscruncher_tpu.io import native
+    from consensuscruncher_tpu.stages.align import _SortedKmerIndex
+
+    if not native.available():
+        import pytest
+        pytest.skip("native codec unavailable")
+
+    rng = np.random.default_rng(5)
+    arr = np.sort(rng.integers(0, 1 << 30, 40_000))
+    keys = np.concatenate([
+        arr[rng.integers(0, len(arr), 5_000)],
+        rng.integers(0, 1 << 30, 5_000),
+        np.array([0, int(arr[0]), int(arr[-1]), (1 << 30) - 1], np.int64),
+    ])
+    elo = np.searchsorted(arr, keys, side="left")
+    ehi = np.searchsorted(arr, keys, side="right")
+
+    full_lo = np.zeros(len(keys), np.int64)
+    full_hi = np.full(len(keys), len(arr), np.int64)
+    lo, hi = native.equal_range_windowed(arr, keys, full_lo, full_hi)
+    assert np.array_equal(lo, elo) and np.array_equal(hi, ehi)
+
+    w_lo = np.maximum(0, elo - rng.integers(0, 9, len(keys)))
+    w_hi = np.minimum(len(arr), ehi + rng.integers(0, 9, len(keys)))
+    lo, hi = native.equal_range_windowed(arr, keys, w_lo, w_hi)
+    assert np.array_equal(lo, elo) and np.array_equal(hi, ehi)
+
+    # Same queries through the aligner index, native vs forced-fallback.
+    codes = rng.integers(0, 4, 30_000).astype(np.uint8)
+    idx = _SortedKmerIndex([codes], 21)
+    qkeys = np.concatenate([
+        idx.skmers[rng.integers(0, len(idx.skmers), 3_000)],
+        rng.integers(0, 1 << 42, 3_000, dtype=np.int64),
+    ])
+    n_lo, n_hi = idx.lookup_batch(qkeys)
+    monkeypatch.setattr(native, "_lib", None)
+    monkeypatch.setattr(native, "_tried", True)
+    f_lo, f_hi = idx.lookup_batch(qkeys)
+    assert np.array_equal(n_lo, f_lo) and np.array_equal(n_hi, f_hi)
