@@ -276,10 +276,11 @@ def bucket_member_blocks(
     the modal length, pad short members with (N, qual 0)), applied as
     scatter passes at flush time instead of per-family copies.
     """
-    buckets: dict[int, _BlockBucket] = {}
+    buckets: dict[tuple[int, int], _BlockBucket] = {}
 
-    def flush(lb: int) -> MemberBatch:
-        bucket = buckets.pop(lb)
+    def flush(key: tuple[int, int]) -> MemberBatch:
+        lb = key[0]
+        bucket = buckets.pop(key)
         n = len(bucket.keys)
         cap = max(MIN_BATCH, next_pow2(n))
         m = bucket.members
@@ -312,9 +313,26 @@ def bucket_member_blocks(
         lbs = np.maximum(
             LEN_QUANTUM, ((tl + LEN_QUANTUM - 1) // LEN_QUANTUM) * LEN_QUANTUM
         )
-        for lb in np.unique(lbs):
-            lb = int(lb)
-            m = lbs == lb
+        # Size-class axis: families also split by pow2 family-size class, so
+        # a batch's gather-dense member cap (pick_member_cap = pow2 of its
+        # MAX size) matches its members instead of letting one deep family
+        # pad the whole batch — at mean family ~4 a single size-15 family
+        # used to force a cap-16 gather (~3.7x the member bytes).  Classes
+        # are the same pow2 set as the caps, so kernel variants stay
+        # bounded; final output bytes are unchanged (the sorting writers'
+        # total order is content-keyed, never batch order).
+        szs = block.sizes[fam_idx].astype(np.int64)
+        scs = np.maximum(1, 1 << np.maximum(
+            0, np.int64(np.ceil(np.log2(np.maximum(szs, 1))))))
+        # 40-bit class field: family sizes are int32, so sc < 2^32 always —
+        # the length bucket can never be corrupted by a deep family.  The
+        # extra buckets (len x ~5 size classes) pin partially-filled
+        # scatter chunks a little longer, but each class still flushes on
+        # the same member_limit, so residency stays bounded.
+        comb = lbs.astype(np.int64) << 40 | scs
+        for ck in np.unique(comb):
+            lb, sc = int(ck >> 40), int(ck & ((1 << 40) - 1))
+            m = comb == ck
             fams = fam_idx[m]
             counts = block.sizes[fams].astype(np.int64)
             starts = block.fam_off[fams]
@@ -323,7 +341,7 @@ def bucket_member_blocks(
                 np.concatenate([[0], np.cumsum(counts[:-1])]), counts
             )
             midx = np.repeat(starts, counts) + rel
-            bucket = buckets.setdefault(lb, _BlockBucket())
+            bucket = buckets.setdefault((lb, sc), _BlockBucket())
             dst_row = bucket.members + np.arange(tot, dtype=np.int64)
             mtarget = np.repeat(block.target_len[fams], counts)
             chunk_of = block.mem_chunk[midx]
@@ -341,6 +359,6 @@ def bucket_member_blocks(
             bucket.lengths.append(block.target_len[fams])
             bucket.members += tot
             if len(bucket.keys) >= max_batch or bucket.members >= member_limit:
-                yield flush(lb)
-    for lb in sorted(buckets):
-        yield flush(lb)
+                yield flush((lb, sc))
+    for key in sorted(buckets):
+        yield flush(key)

@@ -55,3 +55,44 @@ def test_deterministic_flush_order():
     b1 = [b.keys for b in batching.bucket_families(iter(fams))]
     b2 = [b.keys for b in batching.bucket_families(iter(fams))]
     assert b1 == b2  # flush order sorted by bucket -> reproducible output order
+
+
+def test_bucket_member_blocks_size_classes(tmp_path):
+    """Block-path bucketing splits each length bucket by pow2 family-size
+    class: every emitted batch holds exactly one class (so the gather-dense
+    cap matches its families) and every selected family comes out exactly
+    once with its true size and length (row bytes are pinned end-to-end by
+    the golden digests)."""
+    import numpy as np
+
+    from consensuscruncher_tpu.parallel.batching import (bucket_member_blocks,
+                                                         next_pow2)
+    from consensuscruncher_tpu.stages.sscs_maker import prestage_blocks
+
+    ps = prestage_blocks("test/data/sample.bam")
+    items, expect = [], {}
+    for kind, a, _b in ps.events:
+        if not hasattr(a, "sizes"):
+            continue
+        block = a
+        multi = np.nonzero(block.sizes >= 2)[0]
+        if not len(multi):
+            continue
+        keys = []
+        for j in multi:
+            j = int(j)
+            key = (id(block), j)
+            keys.append(key)
+            expect[key] = (int(block.sizes[j]), int(block.target_len[j]))
+        items.append((block, multi, keys))
+    assert expect, "fixture has no multi-member families"
+
+    seen = {}
+    for batch in bucket_member_blocks(iter(items), max_batch=64,
+                                      member_limit=512):
+        classes = {next_pow2(int(s)) for s in batch.sizes[:batch.n_real]}
+        assert len(classes) == 1, f"mixed size classes in one batch: {classes}"
+        for i, key in enumerate(batch.keys):
+            assert key not in seen
+            seen[key] = (int(batch.sizes[i]), int(batch.lengths[i]))
+    assert seen == expect
