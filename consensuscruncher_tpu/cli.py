@@ -78,6 +78,13 @@ def fastq2bam(args) -> dict:
     os.makedirs(bam_dir, exist_ok=True)
     name = args.name or os.path.basename(args.fastq1).split(".")[0]
 
+    # Tag FASTQs are intermediates; under --cleanup they are deleted right
+    # after alignment, so write them as stored (level 0) BGZF then — the
+    # same rule consensus applies to its deleted-at-end rescue tmps
+    # (rescued_level below).  The bad-read FASTQs are KEPT outputs either
+    # way and always get the requested level.
+    level = int(args.compress_level)
+    tag_level = 0 if _bool(getattr(args, "cleanup", False)) else level
     extract = run_extract(
         args.fastq1,
         args.fastq2,
@@ -85,11 +92,14 @@ def fastq2bam(args) -> dict:
         bpattern=args.bpattern,
         blist=args.blist,
         bdelim=args.bdelim,
+        level=tag_level,
+        bad_level=level,
     )
 
     out_bam = os.path.join(bam_dir, f"{name}.sorted.bam")
     align_and_sort(args.bwa, args.ref, extract.r1_out, extract.r2_out, out_bam,
-                   host_workers=int(getattr(args, "host_workers", 1) or 1))
+                   host_workers=int(getattr(args, "host_workers", 1) or 1),
+                   level=level)
     # reference: `samtools index` after every sort (§3.1) — usually a no-op
     # now (the columnar sort writes its .bai inline)
     index_bam(out_bam, skip_if_fresh=True)
@@ -104,7 +114,7 @@ def fastq2bam(args) -> dict:
 
 
 def align_and_sort(bwa: str, ref: str, r1: str, r2: str, out_bam: str,
-                   host_workers: int = 1) -> None:
+                   host_workers: int = 1, level: int = 6) -> None:
     """Run the external aligner, consume its SAM stdout into BAM, sort.
 
     Reference parity: ``bwa mem | samtools view -b`` + ``samtools sort``
@@ -117,7 +127,8 @@ def align_and_sort(bwa: str, ref: str, r1: str, r2: str, out_bam: str,
     ``--bwa 'bwa -t N'``-style invocation instead.
     """
     if bwa == "builtin":
-        _align_builtin(ref, r1, r2, out_bam, host_workers=host_workers)
+        _align_builtin(ref, r1, r2, out_bam, host_workers=host_workers,
+                       level=level)
         return
     cmd = shlex.split(bwa) + ["mem", ref, r1, r2]
     try:
@@ -132,7 +143,7 @@ def align_and_sort(bwa: str, ref: str, r1: str, r2: str, out_bam: str,
     writer = None
     try:
         header, records = sam_mod.read_sam(proc.stdout)
-        writer = SortingBamWriter(out_bam, header)
+        writer = SortingBamWriter(out_bam, header, level=level)
         for read in records:
             writer.write(read)
     except Exception as exc:
@@ -152,7 +163,7 @@ def align_and_sort(bwa: str, ref: str, r1: str, r2: str, out_bam: str,
 
 
 def _align_builtin(ref: str, r1: str, r2: str, out_bam: str,
-                   host_workers: int = 1) -> None:
+                   host_workers: int = 1, level: int = 6) -> None:
     """``--bwa builtin``: the in-process k-mer aligner (stages/align.py) —
     runs the full fastq2bam flow when no external aligner exists (test/demo
     scope: substitutions only, no indels).  Columnar path: batched seed/
@@ -163,6 +174,7 @@ def _align_builtin(ref: str, r1: str, r2: str, out_bam: str,
 
     aligner = BuiltinAligner(ref)
     n_total, n_unmapped = align_fastqs_columnar(aligner, r1, r2, out_bam,
+                                                level=level,
                                                 workers=host_workers)
     # The builtin aligner is substitutions-only (no indels, no clips): on
     # real sequencing data it silently fails reads a gapped aligner would
@@ -670,6 +682,11 @@ def build_parser() -> argparse.ArgumentParser:
     f.add_argument("--blist", "-l")
     f.add_argument("--bdelim")
     f.add_argument("--cleanup", help="remove intermediate tag FASTQs after alignment")
+    f.add_argument("--compress_level", type=int, choices=range(0, 10),
+                   metavar="0-9",
+                   help="BGZF deflate level for outputs (default 6); tag "
+                        "FASTQs drop to level 1 automatically under "
+                        "--cleanup since they are deleted after alignment")
     f.add_argument("--host_workers", type=int, metavar="N",
                    help="fan the builtin aligner's per-chunk compute over N "
                         "forked worker processes (byte-identical output; "
@@ -677,7 +694,8 @@ def build_parser() -> argparse.ArgumentParser:
     f.set_defaults(func=fastq2bam, config_section="fastq2bam",
                    required_args=("fastq1", "fastq2", "output", "ref"),
                    builtin_defaults={"bwa": "bwa", "bdelim": DEFAULT_BDELIM,
-                                     "cleanup": "False", "host_workers": 1})
+                                     "cleanup": "False", "host_workers": 1,
+                                     "compress_level": 6})
 
     c = sub.add_parser("consensus", help="collapse UMI families into SSCS/DCS")
     c.add_argument("-c", "--config", default=None)

@@ -256,6 +256,8 @@ def run_extract(
     bpattern: str | None = None,
     blist: str | None = None,
     bdelim: str = DEFAULT_BDELIM,
+    level: int = 6,
+    bad_level: int | None = None,
     _force_object: bool = False,
 ) -> ExtractResult:
     if bpattern is None and blist is None:
@@ -282,7 +284,11 @@ def run_extract(
         "r1_bad": f"{out_prefix}_r1_bad.fastq.gz",
         "r2_bad": f"{out_prefix}_r2_bad.fastq.gz",
     }
-    writers = {k: FastqWriter(p) for k, p in paths.items()}
+    # The bad-read FASTQs are kept outputs even when the tag FASTQs are
+    # downshifted as soon-deleted intermediates — separate level knob.
+    bl = level if bad_level is None else bad_level
+    writers = {k: FastqWriter(p, level=bl if k.endswith("_bad") else level)
+               for k, p in paths.items()}
     if not _force_object:
         try:
             _run_extract_vectorized(

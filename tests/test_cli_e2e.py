@@ -420,3 +420,39 @@ def test_consensus_wire_flag_bit_identical(tmp_path):
         p = tmp_path / wire / "w" / "sscs" / "w.sscs.sorted.bam"
         outs[wire] = hashlib.sha256(p.read_bytes()).hexdigest()
     assert outs["stream"] == outs["dense"]
+
+
+def test_fastq2bam_compress_level_and_cleanup_downshift(tmp_path):
+    """--compress_level on fastq2bam: tag-FASTQ decompressed content and
+    the final BAM's decompressed records are level-independent; --cleanup
+    auto-downshifts the (deleted-right-after) tag FASTQs to level 1."""
+    import gzip
+    import hashlib
+
+    from consensuscruncher_tpu.cli import main as cli_main
+    from consensuscruncher_tpu.utils.simulate import (SimConfig,
+                                                      simulate_fastq_pairs)
+
+    r1, r2, fa = simulate_fastq_pairs(
+        str(tmp_path / "sim"),
+        SimConfig(n_fragments=150, read_len=100, umi_len=6,
+                  ref_len=120_000, mean_family_size=2.0, seed=19))
+
+    digests = {}
+    for lv in ("6", "1"):
+        out = tmp_path / f"lv{lv}"
+        cli_main(["fastq2bam", "-f1", r1, "-f2", r2, "-o", str(out),
+                  "-n", "s", "--bwa", "builtin", "-r", fa,
+                  "--bpattern", "NNNNNNT", "--compress_level", lv])
+        tag = out / "fastq_tag" / "s_r1.fastq.gz"
+        digests[lv] = hashlib.sha256(
+            gzip.open(tag, "rb").read()).hexdigest()
+    assert digests["6"] == digests["1"]
+
+    # cleanup removes the tag FASTQs (after writing them cheaply)
+    out = tmp_path / "clean"
+    cli_main(["fastq2bam", "-f1", r1, "-f2", r2, "-o", str(out),
+              "-n", "s", "--bwa", "builtin", "-r", fa,
+              "--bpattern", "NNNNNNT", "--cleanup", "True"])
+    assert not (out / "fastq_tag" / "s_r1.fastq.gz").exists()
+    assert (out / "bamfiles" / "s.sorted.bam").exists()
