@@ -40,7 +40,9 @@ from consensuscruncher_tpu.core.tags import DEFAULT_BDELIM
 from consensuscruncher_tpu.io import sam as sam_mod
 from consensuscruncher_tpu.io.bai import index_bam
 from consensuscruncher_tpu.io.bam import merge_bams
-from consensuscruncher_tpu.stages.extract_barcodes import run_extract
+from consensuscruncher_tpu.stages import extract_barcodes as extract_mod
+from consensuscruncher_tpu.stages.extract_barcodes import (ExtractResult,
+                                                           run_extract)
 from consensuscruncher_tpu.stages import dcs_maker, singleton_correction, sscs_maker
 from consensuscruncher_tpu.stages.dcs_maker import DcsResult, run_dcs
 from consensuscruncher_tpu.stages.generate_plots import (
@@ -68,6 +70,22 @@ def _bool(v) -> bool:
     return str(v).lower() in ("1", "true", "yes", "on")
 
 
+def make_checkpointed(manifest: RunManifest, resume: bool, label: str):
+    """The one checkpoint/resume protocol both subcommands speak
+    (SURVEY.md §5): skip a stage when --resume can prove its recorded
+    inputs/outputs/params are fingerprint-intact, else run and record."""
+
+    def checkpointed(stage, inputs, outputs, params, run, rebuild):
+        if resume and manifest.can_skip(stage, inputs, params):
+            print(f"{label}: resume — skipping {stage} (outputs intact)")
+            return rebuild()
+        result = run()
+        manifest.record(stage, inputs, outputs, params)
+        return result
+
+    return checkpointed
+
+
 # ------------------------------------------------------------------ fastq2bam
 
 def fastq2bam(args) -> dict:
@@ -84,22 +102,57 @@ def fastq2bam(args) -> dict:
     # (rescued_level below).  The bad-read FASTQs are KEPT outputs either
     # way and always get the requested level.
     level = int(args.compress_level)
-    tag_level = 0 if _bool(getattr(args, "cleanup", False)) else level
-    extract = run_extract(
-        args.fastq1,
-        args.fastq2,
-        os.path.join(tag_dir, name),
-        bpattern=args.bpattern,
-        blist=args.blist,
-        bdelim=args.bdelim,
-        level=tag_level,
-        bad_level=level,
+    cleanup = _bool(getattr(args, "cleanup", False))
+    tag_level = 0 if cleanup else level
+
+    # Same explicit checkpoint/resume model as the consensus subcommand
+    # (SURVEY.md §5): stage outputs fingerprint into <output>/manifest.json;
+    # --resume skips a stage whose inputs/outputs/params are intact.  A
+    # --cleanup run deletes the tag FASTQs, so a later --resume re-runs
+    # extract (its outputs are gone) — correct, just not a shortcut.
+    # Content-bearing input FILES (fastqs, --blist, --ref) go in the
+    # fingerprinted inputs, never in params, so editing one in place
+    # invalidates the skip; ``name`` goes in params so re-running into the
+    # same output dir under a different -n cannot match stale records.
+    manifest = RunManifest(os.path.join(args.output, "manifest.json"))
+    resume = _bool(getattr(args, "resume", False))
+    checkpointed = make_checkpointed(manifest, resume, "fastq2bam")
+    prefix = os.path.join(tag_dir, name)
+    tag_paths = extract_mod.output_paths(prefix)
+    extract_inputs = [args.fastq1, args.fastq2]
+    if args.blist:
+        extract_inputs.append(args.blist)
+    extract = checkpointed(
+        "extract", extract_inputs, list(tag_paths.values()),
+        {"name": name, "bpattern": args.bpattern, "bdelim": args.bdelim,
+         "level": tag_level},
+        run=lambda: run_extract(
+            args.fastq1,
+            args.fastq2,
+            prefix,
+            bpattern=args.bpattern,
+            blist=args.blist,
+            bdelim=args.bdelim,
+            level=tag_level,
+            bad_level=level,
+        ),
+        rebuild=lambda: ExtractResult(tag_paths["r1"], tag_paths["r2"], None),
     )
 
     out_bam = os.path.join(bam_dir, f"{name}.sorted.bam")
-    align_and_sort(args.bwa, args.ref, extract.r1_out, extract.r2_out, out_bam,
-                   host_workers=int(getattr(args, "host_workers", 1) or 1),
-                   level=level)
+    # host_workers is excluded from the align params on purpose: the worker
+    # fan-out is byte-invariant, so a resume under a different N still
+    # matches.
+    checkpointed(
+        "align", [extract.r1_out, extract.r2_out, args.ref],
+        [out_bam, out_bam + ".bai"],
+        {"name": name, "bwa": args.bwa, "level": level},
+        run=lambda: align_and_sort(
+            args.bwa, args.ref, extract.r1_out, extract.r2_out, out_bam,
+            host_workers=int(getattr(args, "host_workers", 1) or 1),
+            level=level),
+        rebuild=lambda: None,
+    )
     # reference: `samtools index` after every sort (§3.1) — usually a no-op
     # now (the columnar sort writes its .bai inline)
     index_bam(out_bam, skip_if_fresh=True)
@@ -488,15 +541,7 @@ def _consensus_impl(args) -> dict:
     # fingerprint-match are skipped; any upstream change invalidates the rest.
     manifest = RunManifest(os.path.join(base, "manifest.json"))
     resume = getattr(args, "resume", False)
-
-    def checkpointed(stage, inputs, outputs, params, run, rebuild):
-        """Run a stage unless --resume can prove its outputs are intact."""
-        if resume and manifest.can_skip(stage, inputs, params):
-            print(f"consensus: resume — skipping {stage} (outputs intact)")
-            return rebuild()
-        result = run()
-        manifest.record(stage, inputs, outputs, params)
-        return result
+    checkpointed = make_checkpointed(manifest, resume, "consensus")
 
     sscs_prefix = os.path.join(dirs["sscs"], name)
     sscs_paths = sscs_maker.output_paths(sscs_prefix)
@@ -682,6 +727,8 @@ def build_parser() -> argparse.ArgumentParser:
     f.add_argument("--blist", "-l")
     f.add_argument("--bdelim")
     f.add_argument("--cleanup", help="remove intermediate tag FASTQs after alignment")
+    f.add_argument("--resume", help="skip stages whose manifest-recorded "
+                                    "outputs are intact")
     f.add_argument("--compress_level", type=int, choices=range(0, 10),
                    metavar="0-9",
                    help="BGZF deflate level for outputs (default 6); tag "
@@ -695,7 +742,7 @@ def build_parser() -> argparse.ArgumentParser:
                    required_args=("fastq1", "fastq2", "output", "ref"),
                    builtin_defaults={"bwa": "bwa", "bdelim": DEFAULT_BDELIM,
                                      "cleanup": "False", "host_workers": 1,
-                                     "compress_level": 6})
+                                     "compress_level": 6, "resume": "False"})
 
     c = sub.add_parser("consensus", help="collapse UMI families into SSCS/DCS")
     c.add_argument("-c", "--config", default=None)

@@ -79,6 +79,21 @@ class ExtractResult:
     stats: StageStats
 
 
+def output_paths(out_prefix: str) -> dict[str, str]:
+    """Every file :func:`run_extract` writes for ``out_prefix`` — the single
+    naming authority shared with the CLI's resume manifest (all are
+    deterministic for a given input; stats carry no timestamps)."""
+    return {
+        "r1": f"{out_prefix}_r1.fastq.gz",
+        "r2": f"{out_prefix}_r2.fastq.gz",
+        "r1_bad": f"{out_prefix}_r1_bad.fastq.gz",
+        "r2_bad": f"{out_prefix}_r2_bad.fastq.gz",
+        "distribution": f"{out_prefix}.barcode_distribution.txt",
+        "stats": f"{out_prefix}.extract_stats.txt",
+        "stats_json": f"{out_prefix}.extract_stats.json",
+    }
+
+
 def _batch_zipper(read1: str, read2: str):
     """Yield aligned column slices from both FASTQs; raises on unequal
     record counts (the object path's ``zip(strict=True)`` contract)."""
@@ -278,12 +293,8 @@ def run_extract(
 
     stats = StageStats("extract_barcodes")
     distribution: Counter = Counter()
-    paths = {
-        "r1": f"{out_prefix}_r1.fastq.gz",
-        "r2": f"{out_prefix}_r2.fastq.gz",
-        "r1_bad": f"{out_prefix}_r1_bad.fastq.gz",
-        "r2_bad": f"{out_prefix}_r2_bad.fastq.gz",
-    }
+    all_paths = output_paths(out_prefix)
+    paths = {k: all_paths[k] for k in ("r1", "r2", "r1_bad", "r2_bad")}
     # The bad-read FASTQs are kept outputs even when the tag FASTQs are
     # downshifted as soon-deleted intermediates — separate level knob.
     bl = level if bad_level is None else bad_level

@@ -456,3 +456,44 @@ def test_fastq2bam_compress_level_and_cleanup_downshift(tmp_path):
               "--bpattern", "NNNNNNT", "--cleanup", "True"])
     assert not (out / "fastq_tag" / "s_r1.fastq.gz").exists()
     assert (out / "bamfiles" / "s.sorted.bam").exists()
+
+
+def test_fastq2bam_resume(tmp_path, capsys):
+    """fastq2bam --resume: a re-run with intact outputs skips both stages;
+    touching an input fingerprint re-runs them (consensus-side manifest
+    model, SURVEY.md §5)."""
+    import json
+
+    from consensuscruncher_tpu.cli import main as cli_main
+    from consensuscruncher_tpu.utils.simulate import (SimConfig,
+                                                      simulate_fastq_pairs)
+
+    r1, r2, fa = simulate_fastq_pairs(
+        str(tmp_path / "sim"),
+        SimConfig(n_fragments=120, read_len=100, umi_len=6,
+                  ref_len=100_000, mean_family_size=2.0, seed=23))
+    out = tmp_path / "o"
+    argv = ["fastq2bam", "-f1", r1, "-f2", r2, "-o", str(out), "-n", "s",
+            "--bwa", "builtin", "-r", fa, "--bpattern", "NNNNNNT",
+            "--resume", "True"]
+    cli_main(argv)
+    m1 = json.loads((out / "manifest.json").read_text())
+    assert set(m1["stages"]) == {"extract", "align"}
+    bam = out / "bamfiles" / "s.sorted.bam"
+    mtime = bam.stat().st_mtime_ns
+    capsys.readouterr()
+
+    cli_main(argv)
+    msgs = capsys.readouterr().out
+    assert "skipping extract" in msgs and "skipping align" in msgs
+    assert bam.stat().st_mtime_ns == mtime  # untouched
+
+    # Input change invalidates: regenerate the pair with a new seed into
+    # the same paths (content fingerprints differ) -> no skip.
+    simulate_fastq_pairs(
+        str(tmp_path / "sim"),
+        SimConfig(n_fragments=120, read_len=100, umi_len=6,
+                  ref_len=100_000, mean_family_size=2.0, seed=24))
+    cli_main(argv)
+    msgs = capsys.readouterr().out
+    assert "skipping" not in msgs
