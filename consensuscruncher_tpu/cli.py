@@ -736,8 +736,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "--cleanup since they are deleted after alignment")
     f.add_argument("--host_workers", type=int, metavar="N",
                    help="fan the builtin aligner's per-chunk compute over N "
-                        "forked worker processes (byte-identical output; "
-                        "ignored for an external --bwa — use its own -t)")
+                        "forked worker processes (byte-identical output; 0 = "
+                        "all cores; ignored for an external --bwa — use its "
+                        "own -t)")
     f.set_defaults(func=fastq2bam, config_section="fastq2bam",
                    required_args=("fastq1", "fastq2", "output", "ref"),
                    builtin_defaults={"bwa": "bwa", "bdelim": DEFAULT_BDELIM,
@@ -776,7 +777,8 @@ def build_parser() -> argparse.ArgumentParser:
                         "htslib default; 1 trades ~15%% larger files for "
                         "much faster writes — deflate is a top host cost)")
     c.add_argument("--host_workers", type=int, metavar="N",
-                   help="coordinate-range data parallelism: N worker "
+                   help="coordinate-range data parallelism (0 = all cores): "
+                        "N worker "
                         "processes each run the full pipeline on a disjoint "
                         "range of the input (the flow is position-local), "
                         "outputs merge by concatenation. The host-core "
@@ -831,6 +833,30 @@ def main(argv=None) -> int:
         args.compress_level = int(args.compress_level)
     if getattr(args, "host_workers", None) is not None:
         args.host_workers = int(args.host_workers)
+        if args.host_workers < 0:
+            parser.error(f"--host_workers must be >= 0, got {args.host_workers}")
+        if args.host_workers == 0:
+            # 0 = "all cores": the deployment-host shorthand for the
+            # host-side multiplier (workers beyond cores only time-slice).
+            # Affinity-aware: in a cgroup/taskset-limited container
+            # cpu_count() reports the machine, not the schedulable set.
+            try:
+                cores = len(os.sched_getaffinity(0))
+            except (AttributeError, OSError):
+                cores = os.cpu_count() or 1
+            args.host_workers = max(1, cores)
+            if getattr(args, "backend", None) == "tpu":
+                # consensus workers partition chip visibility [i*d,(i+1)*d):
+                # cap the all-cores expansion at the advertised chip budget
+                # so the shorthand composes with --backend tpu instead of
+                # tripping the chip-budget check below.
+                d = int(getattr(args, "devices", None) or 1)
+                for var in ("TPU_NUM_DEVICES", "TPU_CHIP_COUNT"):
+                    adv = os.environ.get(var)
+                    if adv and adv.isdigit():
+                        args.host_workers = max(1, min(
+                            args.host_workers, int(adv) // d))
+                        break
 
     args.func(args)
     return 0
