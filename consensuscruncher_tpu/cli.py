@@ -191,12 +191,15 @@ def align_and_sort(bwa: str, ref: str, r1: str, r2: str, out_bam: str,
             f"aligner not found: {cmd[0]!r} — install bwa or point --bwa at an "
             "executable that speaks `<bwa> mem <ref> <r1> <r2>` and emits SAM"
         )
-    from consensuscruncher_tpu.io.columnar import SortingBamWriter
+    from consensuscruncher_tpu.io.columnar import (
+        SortingBamWriter, single_writer_sort_buffer_bytes)
 
+    sort_budget = single_writer_sort_buffer_bytes()
     writer = None
     try:
         header, records = sam_mod.read_sam(proc.stdout)
-        writer = SortingBamWriter(out_bam, header, level=level)
+        writer = SortingBamWriter(out_bam, header, level=level,
+                                  max_raw_bytes=sort_budget)
         for read in records:
             writer.write(read)
     except Exception as exc:

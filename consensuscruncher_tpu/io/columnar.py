@@ -821,6 +821,25 @@ def _mem_available_bytes() -> int:
     return 0
 
 
+def _sort_buffer_bytes(divisor: int) -> int:
+    """Shared CCT_SORT_BUFFER_MAX_BYTES semantics: env override wins
+    outright, else MemAvailable/divisor with a 4 GiB floor."""
+    env = os.environ.get("CCT_SORT_BUFFER_MAX_BYTES")
+    if env:
+        return int(env)
+    return max(4 << 30, _mem_available_bytes() // divisor)
+
+
+def single_writer_sort_buffer_bytes() -> int:
+    """Sort budget for a stage that holds exactly ONE sorting writer (the
+    fastq2bam align leg): the multi-writer /8 headroom of
+    :func:`_default_sort_buffer_bytes` is over-conservative there — a
+    123M-read align (27 GB raw) spilled on a 125 GB host.  /3 keeps the
+    ~2x close() transient inside available RAM with margin.
+    """
+    return _sort_buffer_bytes(3)
+
+
 def _default_sort_buffer_bytes() -> int:
     """Per-writer in-memory sort budget: env override, else RAM-aware.
 
@@ -834,10 +853,7 @@ def _default_sort_buffer_bytes() -> int:
     MemAvailable/8 keeps a worst-case stage within available RAM.  Floor
     4 GiB (the old fixed default); the env var wins outright when set.
     """
-    env = os.environ.get("CCT_SORT_BUFFER_MAX_BYTES")
-    if env:
-        return int(env)
-    return max(4 << 30, _mem_available_bytes() // 8)
+    return _sort_buffer_bytes(8)
 
 
 def _default_merge_key_budget() -> int:

@@ -470,7 +470,13 @@ def align_fastqs_columnar(aligner: BuiltinAligner, r1: str, r2: str,
         _POOL_ALIGNER, _POOL_EMIT_LUT = aligner, emit_lut
         pool = mp.get_context("fork").Pool(workers)
 
-    writer = SortingBamWriter(out_bam, header, level=level)
+    from consensuscruncher_tpu.io.columnar import single_writer_sort_buffer_bytes
+
+    # The align leg holds exactly one sorting writer, so it may claim the
+    # single-writer RAM budget — at the 100M-read class this keeps the
+    # coordinate sort in memory instead of spilling (BASELINE.md round 4).
+    writer = SortingBamWriter(out_bam, header, level=level,
+                              max_raw_bytes=single_writer_sort_buffer_bytes())
     try:
         if pool is None:
             for task in tasks:
