@@ -404,3 +404,37 @@ def test_fastq2bam_host_workers_byte_parity(tmp_path):
                                      workers=w, pair_chunk=chunk)
         outs.append((n, u, hashlib.sha256(out.read_bytes()).hexdigest()))
     assert outs[0] == outs[1]
+
+
+def test_align_pool_mixed_lengths_byte_parity(genome, tmp_path):
+    """Mixed-length FASTQ pairs exercise the task generator's equal-length
+    bucketing UNDER the fork pool: serial and workers=2 must still produce
+    byte-identical BAMs when several (l1, l2) buckets and several chunks
+    are in flight."""
+    import hashlib
+
+    from consensuscruncher_tpu.stages.align import align_fastqs_columnar
+
+    path, refs = genome
+    rng = np.random.default_rng(91)
+    name, seq = next(iter(refs.items()))
+    records = []
+    for i in range(240):
+        l1 = int(rng.choice([80, 100, 120]))
+        l2 = int(rng.choice([80, 100]))
+        lo = int(rng.integers(0, len(seq) - 400))
+        records.append((f"m{i:03d}", seq[lo:lo + l1],
+                        revcomp(seq[lo + 150:lo + 150 + l2])))
+    r1, r2 = str(tmp_path / "m1.fastq.gz"), str(tmp_path / "m2.fastq.gz")
+    _write_fastq_pair(r1, r2, records)
+
+    al = BuiltinAligner(path)
+    digests = []
+    for w, chunk in ((1, 10_000), (2, 16)):
+        out = str(tmp_path / f"mix_w{w}.bam")
+        n, u = align_fastqs_columnar(al, r1, r2, out, workers=w,
+                                     pair_chunk=chunk)
+        digests.append((n, u, hashlib.sha256(
+            open(out, "rb").read()).hexdigest()))
+    assert digests[0] == digests[1]
+    assert digests[0][0] == 480
