@@ -49,6 +49,9 @@ work goes last) and ``_pick_headline`` chooses the headline silicon.
                   HEADLINE_CPU_MARGIN; both legs are in ``stage_legs``
   "cpu_fallback"  tunnel dead (``tpu_unavailable: true``) — XLA-CPU
                   fallback measurement
+The same value is published as the explicit ``headline_leg`` field
+(ADVICE r4): read THAT plus ``stage_legs`` to know which silicon carried
+the number; ``backend`` is kept as a continuity alias.
 
 Scale knobs (env):
   CCT_BENCH_FRAGMENTS (20000)     duplex fragments in the main BAM
@@ -452,6 +455,12 @@ def _pick_headline(tpu_result: dict, fallback: dict | None,
     headline between silicons round-to-round: only a structural gap (like
     the 4.7x wire-bound one measured in round 4) can move it.  Every leg is
     recorded in ``extras["stage_legs"]`` for the judge either way.
+
+    The chosen leg is ALSO published as the explicit ``headline_leg`` field
+    (ADVICE r4): ``backend`` keeps the same value for continuity with the
+    r1–r3 two-state lines, but consumers should read ``headline_leg`` +
+    ``stage_legs`` — "which silicon carried the number" and "what every
+    leg measured" — rather than overloading ``backend``.
     """
     backend_used, result = "tpu", tpu_result
     legs = [("tpu", tpu_result)]
@@ -483,12 +492,25 @@ def main() -> None:
         with tempfile.TemporaryDirectory(prefix="cct_bench_") as td:
             bam = os.path.join(td, "bench.bam")
             ref_bam = os.path.join(td, "baseline.bam")
+            ref_full = os.environ.get("CCT_BENCH_REF_FULL") == "1"
             t0 = time.perf_counter()
             _simulate(bam, FRAGMENTS, seed=42)
-            _simulate(ref_bam, REF_FRAGMENTS, seed=43)
+            if not ref_full:  # full mode times the reference on `bam` itself
+                _simulate(ref_bam, REF_FRAGMENTS, seed=43)
             extras["simulate_s"] = round(time.perf_counter() - t0, 1)
 
-            baseline = _run_worker("stage", "reference", ref_bam, td, CPU_TIMEOUT)
+            # CCT_BENCH_REF_FULL=1: time the reference object path on the
+            # FULL bench workload instead of the REF_FRAGMENTS subsample —
+            # vs_baseline then divides by a measurement at the numerator's
+            # own scale (VERDICT r4 missing 2: the subsample denominator
+            # put ±30% noise on every quoted "x").  Costs ~FRAGMENTS/1.1k
+            # seconds of reference-path wall, so it is opt-in.
+            if ref_full:
+                extras["baseline_mode"] = "full_scale"
+                baseline = _run_worker("stage", "reference", bam, td,
+                                       max(CPU_TIMEOUT, FRAGMENTS // 10))
+            else:
+                baseline = _run_worker("stage", "reference", ref_bam, td, CPU_TIMEOUT)
 
             attempts: list[dict] = []
             run_tpu = lambda: _run_worker("stage", "tpu", bam, td, TPU_TIMEOUT)  # noqa: E731
@@ -540,6 +562,7 @@ def main() -> None:
                 value = float(result["families_per_sec"])
                 extras.update(
                     backend=backend_used,
+                    headline_leg=backend_used,
                     code_path="tpu",  # both silicons run the jitted device path
                     jax_backend=result.get("jax_backend"),
                     n_families=result.get("n_families"),

@@ -23,18 +23,42 @@ outputs at ``j == F-1``.  The device layout is therefore ``(F, B, L)``
 ``(B, F, L)``.  All shapes are static per (F, L) bucket, same as the XLA
 path.
 
-STATUS (round 4, first compiled execution on real v5e silicon): the kernel
-compiles and runs bit-correct, but LOSES the host-to-host bake-off —
-6,020 fam/s vs 7,979 (dense XLA) vs 15,432 (packed segment wire) at
-(8192, 16, 100); see ``tpu_evidence/kernels_r04.json``.  Over the tunnel
-every number is wire-bound, and the Pallas path pays an extra host-side
-transpose+pad on the same dense wire, so it cannot win there; the
-device-resident comparison (``tools/tpu_device_bench.py``, queued on the
-session watcher) decides whether its single-pass HBM story beats XLA's
-fusions on-chip.  NOT on any production path — the stage default is the
-packed member-stream wire (``ops.consensus_segment``), whose 2.5x smaller
-wire format dominates end-to-end regardless of the on-chip winner.  Kept
-as the Pallas reference implementation and bake-off candidate.
+STATUS (round 5; device-resident rows measured on real v5e, round 4 —
+``TPU_EVIDENCE.json`` ``device_quick``, 2026-07-31):
+
+  ==============  ==========  ==========  =====================
+  (B, F, L)       dense XLA   Pallas      verdict
+  ==============  ==========  ==========  =====================
+  (8192, 16,100)  104.1M f/s  85.5M f/s   dense wins 1.22x —
+                  (43.2% HBM  (35.5%)     XLA's fused one-hot
+                  peak)                   already runs near the
+                                          HBM roofline at large B
+  (1024, 16,100)  0.57M f/s   10.6M f/s   Pallas wins ~19x —
+                  (1.81 ms)   (0.10 ms)   BUT the dense row is a
+                                          dispatch/layout outlier
+                                          (8x the work at B=8192
+                                          takes 22x LESS time),
+                                          not a steady-state
+                                          kernel number
+  ==============  ==========  ==========  =====================
+
+Policy (VERDICT r4 item 3): Pallas stays OFF every production path.
+(a) The stage default is the packed member-stream wire
+(``ops.consensus_segment``) — its 2.5x smaller wire dominates end-to-end
+on any transfer-bound link regardless of the on-chip winner, and the
+segment kernel serves ragged families without dense padding.  (b) At the
+production batch (B=8192 class) dense XLA beats Pallas on-chip, so the
+dense fallback wire keeps the XLA kernel.  (c) The small-batch regime
+where Pallas "wins 19x" divides by the un-warmed dense outlier row; the
+queued silicon rows (``tools/tpu_jobs.json`` r5_dense1024_reps /
+r7_pallas1024_reps: 30 reps, per-rep times) decide whether the gap is
+dispatch overhead (amortized in the stage's pipelined loop -> keep XLA)
+or a real small-tile layout win.  Tail buckets are a minority of stage
+wall (the pow2 size-class sub-bucketing keeps batches large), so even a
+confirmed small-batch win would move end-to-end by <5% — below the
+drift band; re-evaluate only if a profile shows tail-bucket dispatch as
+a top-3 term.  Kept bit-correct (tests/test_pallas.py) as the Pallas
+reference implementation and bake-off candidate.
 """
 
 from __future__ import annotations

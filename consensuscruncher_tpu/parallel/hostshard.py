@@ -175,6 +175,8 @@ def worker_argv(input_path: str, out_dir: str, name: str, args,
         "--compress_level", str(args.compress_level),
         "--wire", str(getattr(args, "wire", "stream")),
     ]
+    if getattr(args, "intermediate_level", None) is not None:
+        argv += ["--intermediate_level", str(args.intermediate_level)]
     if range_spec is not None:
         argv += ["--input_range", range_spec]
     if resume:

@@ -417,12 +417,36 @@ def _align_tasks(r1: str, r2: str, pair_chunk: int):
 # hundreds of MB at genome scale — pickling it per task is a non-starter).
 _POOL_ALIGNER: "BuiltinAligner | None" = None
 _POOL_EMIT_LUT: np.ndarray | None = None
+_POOL_PRESTART_BARRIER = None
+
+
+def _pool_prestart_wait():
+    """Pin one pool worker until every worker has forked (see the prestart
+    barrier in :func:`align_fastqs_columnar`)."""
+    _POOL_PRESTART_BARRIER.wait(timeout=120)
 
 
 def _pool_bucket_blobs(task):
     from consensuscruncher_tpu.io.encode import encode_records
 
     return _bucket_blobs(_POOL_ALIGNER, encode_records, _POOL_EMIT_LUT, *task)
+
+
+def _shutdown_pool(pool, kill: bool) -> None:
+    """``kill=True``: abort path — SIGTERM the forked workers so in-flight
+    chunks stop NOW (executor shutdown only cancels queued futures; running
+    chunks would otherwise burn CPU + the COW index until they drain).
+    ``kill=False``: drained path — clean join."""
+    if kill:
+        pool.shutdown(wait=False, cancel_futures=True)
+        # _processes is None once the executor is broken/shut down
+        for p in list((getattr(pool, "_processes", None) or {}).values()):
+            try:
+                p.terminate()
+            except Exception:
+                pass
+    else:
+        pool.shutdown(wait=True)
 
 
 def align_fastqs_columnar(aligner: BuiltinAligner, r1: str, r2: str,
@@ -442,8 +466,12 @@ def align_fastqs_columnar(aligner: BuiltinAligner, r1: str, r2: str,
     serial path regardless of ``workers``/``pair_chunk``: the writer's
     total order is content-keyed (rid, pos, qname, flag — never append
     order), which is the same property that lets the object and columnar
-    paths byte-match.  The pool forks before the writer exists, so no
-    BGZF/codec thread state crosses the fork.
+    paths byte-match.  ALL pool workers fork before the writer exists (a
+    prestart barrier forces the executor's lazy spawns early), so no
+    BGZF/codec thread state crosses any fork; the executor never re-forks
+    replacements, and a worker death (e.g. OOM-kill at the 100M+-read
+    scale this targets) surfaces as BrokenProcessPool at the next drain
+    and aborts the run instead of hanging it.
     """
     import multiprocessing as mp
 
@@ -452,7 +480,7 @@ def align_fastqs_columnar(aligner: BuiltinAligner, r1: str, r2: str,
     from consensuscruncher_tpu.io.encode import encode_records
     from consensuscruncher_tpu.utils.phred import encode_seq
 
-    global _POOL_ALIGNER, _POOL_EMIT_LUT
+    global _POOL_ALIGNER, _POOL_EMIT_LUT, _POOL_PRESTART_BARRIER
     # TWO code spaces on purpose: alignment compares in _CODE space
     # (non-ACGT -> 255, so read-N over ref-N matches, exactly like
     # align()/_encode), while emission uses pipeline codes (N -> 4) for
@@ -464,11 +492,29 @@ def align_fastqs_columnar(aligner: BuiltinAligner, r1: str, r2: str,
 
     pool = None
     if workers > 1:
-        # These stay set for the POOL'S lifetime, not just the initial
-        # fork: mp.Pool replaces dead workers by forking the parent again,
-        # and a replacement forked after a reset would inherit None state.
+        import concurrent.futures as cf
+
+        ctx = mp.get_context("fork")
         _POOL_ALIGNER, _POOL_EMIT_LUT = aligner, emit_lut
-        pool = mp.get_context("fork").Pool(workers)
+        _POOL_PRESTART_BARRIER = ctx.Barrier(workers + 1)
+        pool = cf.ProcessPoolExecutor(workers, mp_context=ctx)
+        try:
+            # Force every worker to fork NOW: each barrier task pins the
+            # worker that picks it up, so the executor's on-demand spawner
+            # must create all `workers` processes before the parent (the
+            # +1-th party) releases them — i.e. before the sorting writer
+            # and its async BGZF thread exist below.
+            warm = [pool.submit(_pool_prestart_wait) for _ in range(workers)]
+            _POOL_PRESTART_BARRIER.wait(timeout=120)
+            for f in warm:
+                f.result(timeout=120)
+        except BaseException:
+            # warm-up failure (e.g. BrokenBarrierError on an overloaded
+            # host) must not leak the executor or pin the COW index
+            _shutdown_pool(pool, kill=True)
+            pool = None
+            _POOL_ALIGNER = _POOL_EMIT_LUT = _POOL_PRESTART_BARRIER = None
+            raise
 
     from consensuscruncher_tpu.io.columnar import single_writer_sort_buffer_bytes
 
@@ -493,8 +539,11 @@ def align_fastqs_columnar(aligner: BuiltinAligner, r1: str, r2: str,
             max_inflight = workers + 2
 
             def drain_one():
+                # result() raises BrokenProcessPool the moment any worker
+                # dies (the executor marks every in-flight future), so a
+                # killed worker aborts the run instead of blocking forever.
                 nonlocal n_unmapped
-                blob1, blob2, un = pending.popleft().get()
+                blob1, blob2, un = pending.popleft().result()
                 n_unmapped += un
                 writer.write_encoded(blob1)
                 writer.write_encoded(blob2)
@@ -503,17 +552,20 @@ def align_fastqs_columnar(aligner: BuiltinAligner, r1: str, r2: str,
                 while len(pending) >= max_inflight:
                     drain_one()
                 n_total += 2 * len(task[0])
-                pending.append(pool.apply_async(_pool_bucket_blobs, (task,)))
+                pending.append(pool.submit(_pool_bucket_blobs, task))
             while pending:
                 drain_one()
     except BaseException:
+        if pool is not None:
+            _shutdown_pool(pool, kill=True)
+            pool = None
+            _POOL_ALIGNER = _POOL_EMIT_LUT = _POOL_PRESTART_BARRIER = None
         writer.abort()
         raise
     finally:
         if pool is not None:
-            pool.terminate()
-            pool.join()
-            _POOL_ALIGNER = _POOL_EMIT_LUT = None
+            _shutdown_pool(pool, kill=False)
+            _POOL_ALIGNER = _POOL_EMIT_LUT = _POOL_PRESTART_BARRIER = None
     writer.close()
     return n_total, n_unmapped
 

@@ -1,0 +1,28 @@
+"""Opt-in CPU forcing for the TPU-window row tools (``--cpu`` flag).
+
+The row tools normally WANT the axon tunnel (the watcher runs them inside
+a live window).  For smoke tests and CI the same scripts must run fully
+off the hardware — and the axon PJRT plugin is registered by a
+``sitecustomize.py`` in every python process, so ``JAX_PLATFORMS=cpu``
+alone still dials the (possibly sick, indefinitely-hanging) tunnel at the
+first backend touch.  Same recipe as ``tests/conftest.py``: override the
+live config object and drop the axon backend factory BEFORE any backend
+init.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def force_cpu() -> None:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        import jax._src.xla_bridge as _xb
+
+        _xb._backend_factories.pop("axon", None)
+    except Exception:
+        pass

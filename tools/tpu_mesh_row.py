@@ -1,0 +1,141 @@
+"""TPU-window row: the production mesh stream-wire path on real silicon.
+
+``MULTICHIP_r0N.json`` proves the family-sharded packed-stream program
+compiles and executes on an 8-device VIRTUAL CPU mesh; this row is the
+silicon half: the SAME ``shard_map`` program (``parallel.mesh.
+_compiled_stream_vote_sharded``, pack4 wire) on a mesh of every real TPU
+device the tunnel exposes, timed device-resident, against the unsharded
+single-device step in the same process.
+
+On this tunnel that is a 1-device mesh — the row then measures the
+shard_map/mesh dispatch overhead on silicon (the "is the mesh path free?"
+number); if a future window exposes >1 chip the same script becomes the
+real scaling row with no edits.
+
+One JSON line per path; run by tools/tpu_watch.py (tools/tpu_jobs.json).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+if "--cpu" in sys.argv:  # smoke/CI mode: stay off the tunnel entirely
+    from _jax_cpu import force_cpu
+
+    force_cpu()
+
+import jax
+import jax.numpy as jnp
+
+from consensuscruncher_tpu.ops.consensus_tpu import ConsensusConfig
+from consensuscruncher_tpu.ops.consensus_segment import (
+    _compiled_stream_vote,
+    build_member_stream,
+    pick_member_cap,
+)
+from consensuscruncher_tpu.ops.packing import build_codebook4, pack4
+from consensuscruncher_tpu.parallel.mesh import (
+    _compiled_stream_vote_sharded,
+    make_mesh,
+    plan_member_shards,
+    stack_member_shards,
+)
+
+REPS = 5
+NF = 16_384          # family slots: the stage's production stream batch class
+L = 128              # pack4 wire needs L % 32 == 0 buckets
+MEAN_FAM = 4.0       # typical cfDNA family-size mean (BASELINE.md workloads)
+
+
+def emit(row):
+    row["jax_backend"] = jax.default_backend()
+    print(json.dumps(row), flush=True)
+
+
+def timed(fn, *args):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    times = []
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.block_until_ready(out)
+        times.append(time.perf_counter() - t0)
+    return float(np.median(times))
+
+
+def main() -> int:
+    if "--cpu" not in sys.argv and jax.default_backend() != "tpu":
+        # Silicon-evidence job: fail (watcher retries next window) rather
+        # than landing a CPU row as done — see tpu_device_bench.py --row.
+        emit({"error": "row job needs real tpu; backend is "
+                       + jax.default_backend()})
+        return 3
+    rng = np.random.default_rng(11)
+    cfg = ConsensusConfig()
+    num, den = cfg.cutoff_rational
+
+    # Realistic geometric-ish family sizes, mean ~4, clipped at 16: the
+    # stage's pow2 size-class sub-bucketing puts mean-4 data almost
+    # entirely in the <=16 class, so one batch at cap<=16 is the
+    # production shape (a mixed batch with a 64-read tail would force
+    # cap=64 on everything — a shape the stage never dispatches).
+    sizes = np.minimum(1 + rng.geometric(1.0 / MEAN_FAM, NF), 16).astype(np.int32)
+    fam_ids, ranks, seg_sizes = build_member_stream([sizes])
+    m = int(seg_sizes.sum())
+    mrows = rng.integers(0, 4, (m, L)).astype(np.uint8)
+    BINNED = np.array([2, 12, 23, 37], np.uint8)
+    qrows = BINNED[rng.integers(0, 4, (m, L))]
+    book = build_codebook4(BINNED)
+    packed = pack4(mrows, qrows, book)
+    cap = pick_member_cap(seg_sizes)
+
+    n_dev = len(jax.devices())
+    emit({"row": "mesh_setup", "n_devices": n_dev, "families": NF,
+          "members": m, "length": L, "member_cap": cap,
+          "wire_bytes": int(packed.nbytes)})
+
+    # --- single-device unsharded step (the stage's 1-chip path) ----------
+    fn1 = _compiled_stream_vote("pack4", num, den, int(cfg.qual_threshold),
+                                int(cfg.qual_cap), cap, None)
+    d_p = jax.device_put(jnp.asarray(packed))
+    d_b = jax.device_put(jnp.asarray(book))
+    d_s = jax.device_put(jnp.asarray(seg_sizes))
+    jax.block_until_ready((d_p, d_b, d_s))
+    t1 = timed(fn1, d_p, d_b, d_s)
+    emit({"row": "stream_single", "device_s": round(t1, 5),
+          "families_per_sec": round(NF / t1, 1)})
+
+    # --- mesh shard_map step (the production multi-chip wire) ------------
+    mesh = make_mesh(n_dev)
+    plan = plan_member_shards(seg_sizes, n_dev)
+    sizes_st, packed_st = stack_member_shards(plan, seg_sizes, packed)
+    fnm = _compiled_stream_vote_sharded(mesh, "pack4", num, den,
+                                        int(cfg.qual_threshold),
+                                        int(cfg.qual_cap), cap, None)
+    d_ps = jax.device_put(jnp.asarray(packed_st))
+    d_ss = jax.device_put(jnp.asarray(sizes_st))
+    jax.block_until_ready((d_ps, d_ss))
+    tm = timed(fnm, d_ps, d_b, d_ss)
+    emit({"row": "stream_mesh", "n_devices": n_dev, "device_s": round(tm, 5),
+          "families_per_sec": round(NF / tm, 1),
+          "vs_single": round(t1 / tm, 3),
+          "note": ("mesh overhead on 1 chip" if n_dev == 1
+                   else f"scaling over {n_dev} chips")})
+
+    # parity: mesh rows reordered == single-device rows
+    single = np.asarray(fn1(d_p, d_b, d_s))
+    meshed = np.asarray(fnm(d_ps, d_b, d_ss))[:, plan.order()]
+    ok = bool((single == meshed).all())
+    emit({"row": "mesh_parity", "byte_identical": ok})
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
