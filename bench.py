@@ -56,6 +56,11 @@ the number; ``backend`` is kept as a continuity alias.
 Scale knobs (env):
   CCT_BENCH_FRAGMENTS (20000)     duplex fragments in the main BAM
   CCT_BENCH_REF_FRAGMENTS (1000)  fragments in the baseline subsample BAM
+  CCT_BENCH_REF_FULL (unset)      "1": time the reference path on the FULL
+                                  bench workload instead of the subsample
+                                  (vs_baseline then has a same-scale
+                                  measured denominator; costs ~FRAGMENTS/1.1k
+                                  seconds of reference wall)
   CCT_BENCH_LEN (100)             read length
   CCT_BENCH_MEAN_FAM (4)          mean per-strand family size
   CCT_BENCH_TPU_TIMEOUT (600)     seconds before the TPU worker is killed
@@ -80,7 +85,12 @@ def _env_int(name: str, default: int) -> int:
 
 
 FRAGMENTS = _env_int("CCT_BENCH_FRAGMENTS", 20_000)
-REF_FRAGMENTS = _env_int("CCT_BENCH_REF_FRAGMENTS", 1_000)
+# 4000 (r5; was 1000): the vs_baseline spread across r4 dress runs (20.0x /
+# 26.1x / 33.5x) was mostly denominator noise from the tiny subsample —
+# 4x the fragments cuts the relative noise ~2x for ~12s more reference
+# wall, still nothing vs the bench budget.  CCT_BENCH_REF_FULL=1 removes
+# the subsample entirely.
+REF_FRAGMENTS = _env_int("CCT_BENCH_REF_FRAGMENTS", 4_000)
 READ_LEN = _env_int("CCT_BENCH_LEN", 100)
 MEAN_FAM = _env_int("CCT_BENCH_MEAN_FAM", 4)
 TPU_TIMEOUT = _env_int("CCT_BENCH_TPU_TIMEOUT", 600)

@@ -1,0 +1,82 @@
+"""Watcher row-granularity contract (VERDICT r4 weak 1 / item 1).
+
+The round-4 window died with the most valuable row unexecuted because the
+queue was job-granular and evidence folded only AFTER a job finished.
+These tests pin the round-5 behavior: rows land in TPU_EVIDENCE.json
+WHILE a job runs (append-on-land), and a timeout kill still leaves the
+already-landed rows on disk.
+"""
+
+import importlib.util
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_watch(tmp_path, monkeypatch, fold_s=0.2):
+    spec = importlib.util.spec_from_file_location(
+        "tpu_watch_under_test", os.path.join(REPO, "tools", "tpu_watch.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    monkeypatch.setattr(mod, "EVIDENCE_DIR", str(tmp_path))
+    monkeypatch.setattr(mod, "EVIDENCE_JSON", str(tmp_path / "EV.json"))
+    monkeypatch.setattr(mod, "WATCH_LOG", str(tmp_path / "log.jsonl"))
+    monkeypatch.setattr(mod, "FOLD_INTERVAL", fold_s)
+    return mod
+
+
+def _rows(mod, state, name):
+    path = getattr(mod, "EVIDENCE_JSON")
+    with open(path) as f:
+        return json.load(f)["jobs"][name].get("rows", [])
+
+
+def test_rows_fold_while_job_runs(tmp_path, monkeypatch):
+    mod = _load_watch(tmp_path, monkeypatch)
+    job = {
+        "name": "t",
+        "cmd": [sys.executable, "-u", "-c",
+                "import json,time;"
+                "print(json.dumps({'r':1}),flush=True);"
+                "time.sleep(3);"
+                "print(json.dumps({'r':2}),flush=True)"],
+        "timeout": 60,
+    }
+    state = {"probes_total": 0, "probes_ok": 0, "first_ok": None,
+             "last_ok": None, "windows": [], "jobs": {}}
+    ok = mod.run_job(job, state)
+    assert ok
+    # a fold DURING the run must already have landed row 1 (the file was
+    # written before the subprocess printed row 2)
+    with open(str(tmp_path / "EV.json")) as f:
+        folded = json.load(f)
+    assert {"r": 1} in folded["jobs"]["t"].get("rows", []), folded
+    # after completion the full parse sees both rows
+    mod.write_evidence(state)
+    assert {"r": 2} in _rows(mod, state, "t")
+
+
+def test_timeout_kill_keeps_landed_rows(tmp_path, monkeypatch):
+    mod = _load_watch(tmp_path, monkeypatch)
+    job = {
+        "name": "k",
+        "cmd": [sys.executable, "-u", "-c",
+                "import json,time;"
+                "print(json.dumps({'landed':True}),flush=True);"
+                "time.sleep(120)"],
+        # generous: interpreter startup alone can take seconds on a loaded
+        # 1-core host, and the row must land BEFORE the kill
+        "timeout": 8,
+    }
+    state = {"probes_total": 0, "probes_ok": 0, "first_ok": None,
+             "last_ok": None, "windows": [], "jobs": {}}
+    ok = mod.run_job(job, state)
+    assert not ok
+    js = state["jobs"]["k"]
+    assert js["last_rc"] == -9 and "timeout" in js["last_error"]
+    mod.write_evidence(state)
+    assert {"landed": True} in _rows(mod, state, "k")
+    # killed-not-failed: attempts budget left -> stays pending for retry
+    assert js["status"] == "pending"

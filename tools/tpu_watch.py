@@ -43,6 +43,8 @@ PROBE_TIMEOUT = 120
 PROBE_INTERVAL_DOWN = 180     # seconds between probes while the tunnel is dead
 PROBE_INTERVAL_IDLE = 600     # all jobs done: keep recording window statistics
 MAX_ATTEMPTS = 4              # per job, across windows
+# seconds between evidence folds WHILE a job runs (tests shrink this)
+FOLD_INTERVAL = float(os.environ.get("CCT_WATCH_FOLD_S", "20"))
 
 
 def _now() -> float:
@@ -130,9 +132,10 @@ def run_job(job: dict, state: dict) -> bool:
             start_new_session=True,
         )
         last_fold = 0.0
+        poll_s = max(0.05, min(5.0, FOLD_INTERVAL))
         while True:
             try:
-                rc = proc.wait(timeout=5)
+                rc = proc.wait(timeout=poll_s)
                 break
             except subprocess.TimeoutExpired:
                 pass
@@ -143,7 +146,7 @@ def run_job(job: dict, state: dict) -> bool:
                 rc = -9
                 js["last_error"] = f"timeout after {job.get('timeout', 1200)}s"
                 break
-            if now - last_fold >= 20:
+            if now - last_fold >= FOLD_INTERVAL:
                 write_evidence(state)
                 last_fold = now
     js["last_rc"] = rc
