@@ -55,7 +55,7 @@ the number; ``backend`` is kept as a continuity alias.
 
 Scale knobs (env):
   CCT_BENCH_FRAGMENTS (20000)     duplex fragments in the main BAM
-  CCT_BENCH_REF_FRAGMENTS (1000)  fragments in the baseline subsample BAM
+  CCT_BENCH_REF_FRAGMENTS (4000)  fragments in the baseline subsample BAM
   CCT_BENCH_REF_FULL (unset)      "1": time the reference path on the FULL
                                   bench workload instead of the subsample
                                   (vs_baseline then has a same-scale

@@ -178,44 +178,66 @@ def align_and_sort(bwa: str, ref: str, r1: str, r2: str, out_bam: str,
     over forked processes (byte-identical output; stages/align.py).  The
     external-aligner path ignores it — thread ``bwa mem -t N`` through
     ``--bwa 'bwa -t N'``-style invocation instead.
+
+    The external leg retries on aligner failure (nonzero exit, garbled SAM)
+    with exponential backoff — CCT_SUBPROC_RETRIES attempts (default 3);
+    transient node pressure must not abort a multi-hour run.  Each attempt
+    is all-or-nothing: the sorting writer is aborted between attempts, so
+    ``out_bam`` is only ever a complete single-attempt product.
     """
     if bwa == "builtin":
         _align_builtin(ref, r1, r2, out_bam, host_workers=host_workers,
                        level=level)
         return
     cmd = shlex.split(bwa) + ["mem", ref, r1, r2]
-    try:
-        proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, text=True)
-    except FileNotFoundError:
-        raise SystemExit(
-            f"aligner not found: {cmd[0]!r} — install bwa or point --bwa at an "
-            "executable that speaks `<bwa> mem <ref> <r1> <r2>` and emits SAM"
-        )
     from consensuscruncher_tpu.io.columnar import (
         SortingBamWriter, single_writer_sort_buffer_bytes)
+    from consensuscruncher_tpu.utils.faults import FaultError, retrying
 
     sort_budget = single_writer_sort_buffer_bytes()
-    writer = None
-    try:
-        header, records = sam_mod.read_sam(proc.stdout)
-        writer = SortingBamWriter(out_bam, header, level=level,
-                                  max_raw_bytes=sort_budget)
-        for read in records:
-            writer.write(read)
-    except Exception as exc:
-        # A truncated/garbled SAM stream usually means the aligner died
-        # mid-run — report ITS status, not the downstream parse error.
-        proc.kill()
-        status = proc.wait()
-        if writer is not None:
+
+    def _attempt():
+        try:
+            proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, text=True)
+        except FileNotFoundError:
+            # not transient — no retry will install bwa
+            raise SystemExit(
+                f"aligner not found: {cmd[0]!r} — install bwa or point --bwa "
+                "at an executable that speaks `<bwa> mem <ref> <r1> <r2>` "
+                "and emits SAM"
+            )
+        writer = None
+        try:
+            header, records = sam_mod.read_sam(proc.stdout)
+            writer = SortingBamWriter(out_bam, header, level=level,
+                                      max_raw_bytes=sort_budget)
+            for read in records:
+                writer.write(read)
+        except Exception as exc:
+            # A truncated/garbled SAM stream usually means the aligner died
+            # mid-run — report ITS status, not the downstream parse error.
+            proc.kill()
+            status = proc.wait()
+            if writer is not None:
+                writer.abort()
+            raise _AlignerFailure(
+                f"aligner output unreadable ({exc}); aligner exit status {status}"
+            ) from exc
+        if proc.wait() != 0:
             writer.abort()
-        raise SystemExit(
-            f"aligner output unreadable ({exc}); aligner exit status {status}"
-        ) from exc
-    if proc.wait() != 0:
-        writer.abort()
-        raise SystemExit(f"aligner exited with status {proc.returncode}")
-    writer.close()
+            raise _AlignerFailure(f"aligner exited with status {proc.returncode}")
+        writer.close()
+
+    attempts = int(os.environ.get("CCT_SUBPROC_RETRIES", "3"))
+    try:
+        retrying(_attempt, site="subprocess.bwa", attempts=attempts,
+                 retriable=(_AlignerFailure,), describe=f"aligner {cmd[0]!r}")
+    except (_AlignerFailure, FaultError) as exc:
+        raise SystemExit(str(exc)) from exc
+
+
+class _AlignerFailure(RuntimeError):
+    """External aligner attempt failed (retriable, unlike a missing binary)."""
 
 
 def _align_builtin(ref: str, r1: str, r2: str, out_bam: str,
@@ -394,12 +416,16 @@ def _consensus_host_sharded(args) -> dict:
                         f"needs {n * chips_per_worker} chips but the host "
                         f"advertises {adv} ({var}); reduce workers or devices")
                 break
-    procs = []
-    err_paths = []
+    workers = []
     for i, rng in enumerate(ranges):
         argv = hostshard.worker_argv(
             args.input, ranges_dir, f"r{i}", args,
             range_spec=hostshard.range_argv(rng), resume=resume)
+        # Retries always resume: a relaunched worker reuses the stages it
+        # committed (atomic outputs + manifest digests) before dying.
+        retry_argv = hostshard.worker_argv(
+            args.input, ranges_dir, f"r{i}", args,
+            range_spec=hostshard.range_argv(rng), resume=True)
         env = dict(base_env)
         if str(args.backend) == "tpu":
             # chips x cores: worker i owns chips [i*d, (i+1)*d) — TPU
@@ -411,26 +437,16 @@ def _consensus_host_sharded(args) -> dict:
         # Worker stderr goes to a file (ADVICE r3): a PIPE drained only
         # after earlier workers finish can fill its ~64KB buffer and block
         # a chatty later worker mid-run, serializing the fleet.
-        err_path = os.path.join(ranges_dir, f"r{i}.stderr")
-        err_paths.append(err_path)
-        with open(err_path, "wb") as err_f:
-            procs.append(subprocess.Popen(
-                [sys.executable, "-m", "consensuscruncher_tpu.cli", *argv],
-                env=env, stdout=subprocess.DEVNULL, stderr=err_f,
-            ))
-    failures = []
-    for i, p in enumerate(procs):
-        p.wait()
-        if p.returncode != 0:
-            try:
-                with open(err_paths[i], "rb") as f:
-                    tail = f.read().decode(errors="replace").strip().splitlines()[-8:]
-            except OSError:
-                tail = ["<stderr file unreadable>"]
-            failures.append(f"worker {i} rc={p.returncode} "
-                            f"(full log: {err_paths[i]}): " + " | ".join(tail))
-    if failures:
-        raise SystemExit("host-sharded consensus failed:\n" + "\n".join(failures))
+        workers.append({
+            "name": f"r{i}",
+            "cmd": [sys.executable, "-m", "consensuscruncher_tpu.cli", *argv],
+            "retry_cmd": [sys.executable, "-m", "consensuscruncher_tpu.cli",
+                          *retry_argv],
+            "env": env,
+            "err_path": os.path.join(ranges_dir, f"r{i}.stderr"),
+        })
+    hostshard.run_workers(
+        workers, retries=int(os.environ.get("CCT_WORKER_RETRIES", "1")))
     tracker.mark("workers")
 
     def rpaths(rel_fmt: str) -> list[str]:
@@ -808,14 +824,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "multiplier on multi-core machines; default 1")
     c.add_argument("--intermediate_level", type=int, choices=range(0, 10),
                    metavar="0-9",
-                   help="BGZF deflate level for the per-stage BAMs whose "
-                        "records all live on in the all_unique outputs "
-                        "(sscs/singleton/badReads, rescue BAMs, dcs parts). "
-                        "Default: follow --compress_level (reference-"
-                        "faithful). 1 cuts the pipeline's deflate wall "
-                        "while the all_unique finals stay at "
-                        "--compress_level; record content is level-"
-                        "independent")
+                   help="BGZF deflate level for the per-stage BAMs "
+                        "(sscs/singleton, rescue BAMs, dcs parts — records "
+                        "that live on in the all_unique outputs — plus "
+                        "badReads, which is a retained diagnostic stream, "
+                        "not re-merged: keep --compress_level if badReads "
+                        "files are archived long-term). Default: follow "
+                        "--compress_level (reference-faithful). 1 cuts the "
+                        "pipeline's deflate wall while the all_unique "
+                        "finals stay at --compress_level; record content "
+                        "is level-independent")
     c.add_argument("--input_range", default=None, help=argparse.SUPPRESS)
     c.add_argument("--wire", choices=("stream", "dense"), default="stream",
                    help="device wire layout for the SSCS vote: 'stream' "

@@ -27,6 +27,7 @@ from dataclasses import dataclass, field
 
 from consensuscruncher_tpu.io import bgzf
 from consensuscruncher_tpu.io.bam import BAM_MAGIC, BamHeader, decode_record
+from consensuscruncher_tpu.utils.manifest import commit_file
 
 BAI_MAGIC = b"BAI\x01"
 _PSEUDO_BIN = 37450  # samtools metadata bin (bin(4681,8191) + 1 + ...)
@@ -400,7 +401,7 @@ def _finish_and_write_bai(refs: list[_RefIndex], n_no_coor: int,
             for v in r.linear:
                 out.write(struct.pack("<Q", v))
         out.write(struct.pack("<Q", n_no_coor))
-    os.replace(tmp, bai_path)
+    commit_file(tmp, bai_path)
     return bai_path
 
 

@@ -23,6 +23,7 @@ from __future__ import annotations
 import heapq
 import os
 import struct
+import sys
 import tempfile
 from dataclasses import dataclass, field
 
@@ -380,10 +381,17 @@ def read_bam_header(bgzf_reader) -> BamHeader:
 
 
 class BamReader:
-    """Streaming BAM reader: ``for read in BamReader(path): ...``"""
+    """Streaming BAM reader: ``for read in BamReader(path): ...``
 
-    def __init__(self, path):
-        self._bgzf = bgzf.BgzfReader(path)
+    ``salvage=True``: recover what a truncated file still holds — the BGZF
+    layer stops at the last intact block and this layer stops at the last
+    complete record inside it, warning instead of raising.  The header must
+    still be intact (nothing is recoverable without it).
+    """
+
+    def __init__(self, path, salvage: bool = False):
+        self._bgzf = bgzf.BgzfReader(path, salvage=salvage)
+        self._salvage = salvage
         self.header = read_bam_header(self._bgzf)
 
     def __iter__(self):
@@ -394,10 +402,19 @@ class BamReader:
             if len(raw) < 4:
                 # A partial length prefix is never valid — a file truncated at
                 # a BGZF block boundary must not read as a complete dataset.
+                if self._salvage:
+                    print("WARNING: truncated BAM record (partial length "
+                          "prefix); stopping at last complete record",
+                          file=sys.stderr, flush=True)
+                    return
                 raise ValueError("truncated BAM record (partial length prefix)")
             (block_size,) = struct.unpack("<i", raw)
             body = self._bgzf.read(block_size)
             if len(body) < block_size:
+                if self._salvage:
+                    print("WARNING: truncated BAM record; stopping at last "
+                          "complete record", file=sys.stderr, flush=True)
+                    return
                 raise ValueError("truncated BAM record")
             yield decode_record(body, self.header)
 
@@ -439,7 +456,12 @@ class BamWriter:
     def close(self) -> None:
         self._bgzf.close()
         if self._final_path is not None:
-            os.replace(self._path, self._final_path)
+            # Durable commit (fsync + rename + dir fsync): a committed stage
+            # output must never fingerprint as complete while partially on
+            # disk — --resume trusts what it finds here.
+            from consensuscruncher_tpu.utils.manifest import commit_file
+
+            commit_file(self._path, self._final_path)
 
     def abort(self) -> None:
         """Discard the output: for atomic writers the final path is never

@@ -19,14 +19,28 @@ from __future__ import annotations
 import io
 import os
 import struct
+import sys
 import zlib
 from typing import BinaryIO, Iterator
 
 import numpy as np
 
 from consensuscruncher_tpu.io import native
+from consensuscruncher_tpu.utils import faults
 
 MAX_BLOCK_PAYLOAD = 0xFF00  # htslib convention: keep compressed block < 64 KiB
+
+
+class TruncatedBgzfError(ValueError):
+    """The stream ended mid-block: the file was cut short (died-mid-copy
+    upload, full disk, killed writer).  Distinct from generic corruption so
+    callers can offer salvage — re-reading with ``salvage=True`` recovers
+    every record up to the last intact block instead of raising."""
+
+
+def _salvage_warn(context: str) -> None:
+    print(f"WARNING: {context}; salvaging records up to the last intact "
+          "BGZF block", file=sys.stderr, flush=True)
 
 BGZF_EOF = bytes.fromhex("1f8b08040000000000ff0600424302001b0003000000000000000000")
 
@@ -71,11 +85,14 @@ def read_block(fh: BinaryIO) -> bytes | None:
     """Read ONE BGZF block from ``fh``: decompressed payload (b"" for empty
     blocks, e.g. the EOF marker), or None at clean EOF.  Validates framing +
     CRC exactly like :func:`iter_blocks` (which is built on this)."""
+    if faults.fire("bgzf.truncated_eof"):
+        raise TruncatedBgzfError("truncated BGZF block (injected)")
+    faults.fault_point("bgzf.read_stall")
     header = fh.read(18)
     if len(header) == 0:
         return None  # clean EOF (tolerated even without the marker block)
     if len(header) < 18:
-        raise ValueError("truncated BGZF block header")
+        raise TruncatedBgzfError("truncated BGZF block header")
     if header[0] != 0x1F or header[1] != 0x8B:
         raise ValueError("not a BGZF/gzip stream (bad magic)")
     if header[3] & 0x04 == 0:
@@ -87,7 +104,7 @@ def read_block(fh: BinaryIO) -> bytes | None:
     if xlen > 6:
         extra += fh.read(xlen - 6)
         if len(extra) < xlen:
-            raise ValueError("truncated BGZF extra field")
+            raise TruncatedBgzfError("truncated BGZF extra field")
     bsize = None
     off = 0
     while off + 4 <= xlen:
@@ -102,7 +119,7 @@ def read_block(fh: BinaryIO) -> bytes | None:
     consumed = 12 + xlen
     rest = fh.read(block_size - consumed)
     if len(rest) < block_size - consumed:
-        raise ValueError("truncated BGZF block")
+        raise TruncatedBgzfError("truncated BGZF block")
     data, (crc, isize) = rest[:-8], _TAIL.unpack(rest[-8:])
     payload = zlib.decompress(data, -15) if isize else b""
     if len(payload) != isize:
@@ -112,24 +129,34 @@ def read_block(fh: BinaryIO) -> bytes | None:
     return payload
 
 
-def iter_blocks(fh: BinaryIO) -> Iterator[bytes]:
-    """Yield decompressed payloads block by block, validating framing + CRC."""
+def iter_blocks(fh: BinaryIO, salvage: bool = False) -> Iterator[bytes]:
+    """Yield decompressed payloads block by block, validating framing + CRC.
+
+    ``salvage=True``: a truncated/corrupt block ends iteration with a
+    warning instead of raising — every intact leading block is served."""
     while True:
-        payload = read_block(fh)
+        try:
+            payload = read_block(fh)
+        except ValueError as e:
+            if not salvage:
+                raise
+            _salvage_warn(str(e))
+            return
         if payload is None:
             return
         if payload:
             yield payload
 
 
-def scan_block_metas(buf: bytes) -> tuple[tuple, int]:
+def scan_block_metas(buf: bytes, tolerant: bool = False) -> tuple[tuple, int]:
     """Scan complete BGZF blocks at the head of ``buf`` (framing only).
 
     Returns ``((src_off, comp_len, isize, crc), consumed)`` where the four
     uint arrays describe each complete block's raw-deflate span and expected
     payload, and ``consumed`` is the byte offset of the first incomplete
     block (callers carry the tail into the next scan).  Raises ValueError on
-    malformed framing — the same conditions ``iter_blocks`` rejects.
+    malformed framing — the same conditions ``iter_blocks`` rejects — unless
+    ``tolerant``, which stops the scan there instead (salvage mode).
     """
     offs, lens, sizes, crcs = [], [], [], []
     pos, end = 0, len(buf)
@@ -137,8 +164,12 @@ def scan_block_metas(buf: bytes) -> tuple[tuple, int]:
         if pos + 18 > end:
             break
         if buf[pos] != 0x1F or buf[pos + 1] != 0x8B:
+            if tolerant:
+                break
             raise ValueError("not a BGZF/gzip stream (bad magic)")
         if buf[pos + 3] & 0x04 == 0:
+            if tolerant:
+                break
             raise ValueError("gzip member lacks the BGZF BC extra subfield")
         (xlen,) = struct.unpack_from("<H", buf, pos + 10)
         if pos + 12 + xlen > end:
@@ -152,6 +183,8 @@ def scan_block_metas(buf: bytes) -> tuple[tuple, int]:
                 break
             off += 4 + slen
         if bsize is None:
+            if tolerant:
+                break
             raise ValueError("gzip member lacks the BGZF BC extra subfield")
         block_size = bsize + 1
         if pos + block_size > end:
@@ -159,6 +192,8 @@ def scan_block_metas(buf: bytes) -> tuple[tuple, int]:
         data_off = pos + 12 + xlen
         data_len = block_size - (12 + xlen) - 8
         if data_len < 0:
+            if tolerant:
+                break
             raise ValueError("corrupt BGZF block (BSIZE smaller than framing)")
         crc, isize = _TAIL.unpack_from(buf, pos + block_size - 8)
         offs.append(data_off)
@@ -198,33 +233,64 @@ def codec_threads() -> int:
 _NATIVE_READ_CHUNK = 8 << 20  # compressed bytes per native inflate batch
 
 
-def _iter_native_batches(fh: BinaryIO) -> Iterator[tuple[int, tuple, bytes]]:
+def _iter_native_batches(fh: BinaryIO,
+                         salvage: bool = False) -> Iterator[tuple[int, tuple, bytes]]:
     """Yield ``(base_offset, metas, payload)`` per native inflate batch:
     ``metas`` is the :func:`scan_block_metas` tuple for the batch's blocks
     (offsets relative to ``base_offset``) and ``payload`` their concatenated
     decompressed bytes.  The single native read loop — every consumer of
-    batch inflation goes through here so framing/tail handling lives once."""
+    batch inflation goes through here so framing/tail handling lives once.
+    ``salvage=True``: a truncated or corrupt tail ends iteration (with a
+    warning) after every intact leading block has been served."""
     base = fh.tell()
     tail = b""
     while True:
-        metas, consumed = scan_block_metas(tail)
+        if faults.fire("bgzf.truncated_eof"):
+            raise TruncatedBgzfError("truncated BGZF block (injected)")
+        faults.fault_point("bgzf.read_stall")
+        metas, consumed = scan_block_metas(tail, tolerant=salvage)
         while consumed == 0:
             more = fh.read(_NATIVE_READ_CHUNK)
             if not more:
                 if tail:
-                    raise ValueError("truncated BGZF block")
+                    if salvage:
+                        _salvage_warn("truncated BGZF block at EOF")
+                        return
+                    raise TruncatedBgzfError("truncated BGZF block")
                 return
             tail += more
-            metas, consumed = scan_block_metas(tail)
-        payload = native.inflate_blocks(tail, *metas, n_threads=codec_threads())
+            metas, consumed = scan_block_metas(tail, tolerant=salvage)
+        try:
+            payload = native.inflate_blocks(tail, *metas, n_threads=codec_threads())
+        except Exception as e:
+            if not salvage:
+                raise
+            # Inflate the batch block-by-block instead, keeping every block
+            # up to the first bad one — the best a cut/corrupt file allows.
+            offs, lens, sizes, crcs = metas
+            goods = []
+            for k in range(len(sizes)):
+                span = tail[int(offs[k]): int(offs[k]) + int(lens[k])]
+                try:
+                    p = zlib.decompress(span, -15) if int(sizes[k]) else b""
+                except zlib.error:
+                    break
+                if len(p) != int(sizes[k]) or zlib.crc32(p) != int(crcs[k]):
+                    break
+                goods.append(p)
+            _salvage_warn(f"BGZF batch inflate failed ({e}); "
+                          f"kept {len(goods)}/{len(sizes)} block(s)")
+            if goods:
+                yield base, tuple(m[:len(goods)] for m in metas), b"".join(goods)
+            return
         yield base, metas, payload
         base += consumed
         tail = tail[consumed:]
 
 
-def _iter_chunks_native(fh: BinaryIO) -> Iterator[bytes]:
+def _iter_chunks_native(fh: BinaryIO, salvage: bool = False) -> Iterator[bytes]:
     """Yield decompressed chunks via the native batch codec (multi-block)."""
-    for _base, _metas, payload in _iter_native_batches(fh):
+    for _base, _metas, payload in _iter_native_batches(fh, salvage=salvage):
         if payload:
             yield payload
 
@@ -263,19 +329,22 @@ class BgzfReader(io.RawIOBase):
     path serves identical bytes.
     """
 
-    def __init__(self, path_or_fh, start_voffset: int | None = None):
+    def __init__(self, path_or_fh, start_voffset: int | None = None,
+                 salvage: bool = False):
         """``start_voffset``: begin mid-file at a BAI virtual offset
         (``coffset << 16 | within``) — seek to the block boundary and
         discard the intra-block prefix.  The caller owns pointing at a
-        record boundary (BAI offsets do)."""
+        record boundary (BAI offsets do).  ``salvage``: serve bytes up to
+        the last intact block of a truncated file instead of raising
+        :class:`TruncatedBgzfError`."""
         self._own = _is_pathlike(path_or_fh)
         self._fh = open(path_or_fh, "rb") if self._own else path_or_fh
         if start_voffset is not None:
             self._fh.seek(start_voffset >> 16)
         if native.available():
-            self._blocks = _iter_chunks_native(self._fh)
+            self._blocks = _iter_chunks_native(self._fh, salvage=salvage)
         else:
-            self._blocks = iter_blocks(self._fh)
+            self._blocks = iter_blocks(self._fh, salvage=salvage)
         self._buf = b""
         self._pos = 0
         if start_voffset is not None and start_voffset & 0xFFFF:
@@ -486,7 +555,8 @@ def total_isize(path) -> int:
             if xlen > 6:
                 extra += fh.read(xlen - 6)
                 if len(extra) < xlen:
-                    raise ValueError(f"{os.fspath(path)!r}: truncated BGZF extra field")
+                    raise TruncatedBgzfError(
+                        f"{os.fspath(path)!r}: truncated BGZF extra field")
             bsize = None
             off = 0
             while off + 4 <= xlen:
@@ -503,7 +573,7 @@ def total_isize(path) -> int:
             fh.seek(bsize + 1 - 12 - xlen - 4, 1)
             isize = fh.read(4)
             if len(isize) < 4:
-                raise ValueError(f"{os.fspath(path)!r}: truncated BGZF block")
+                raise TruncatedBgzfError(f"{os.fspath(path)!r}: truncated BGZF block")
             total += struct.unpack("<I", isize)[0]
 
 

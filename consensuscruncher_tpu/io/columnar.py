@@ -46,6 +46,7 @@ from consensuscruncher_tpu.io.bam import (
     decode_record,
     read_bam_header,
 )
+from consensuscruncher_tpu.utils.manifest import commit_file
 from consensuscruncher_tpu.utils.phred import N as CODE_N, encode_seq
 from consensuscruncher_tpu.utils.ragged import gather_runs
 
@@ -678,7 +679,7 @@ def merge_sorted_columnar(paths: list, out_path, header: BamHeader,
                     scatter_runs(out_buf, starts_b[slots], data, dlens)
                 writer.write(out_buf.tobytes())
         writer.close()
-        os.replace(tmp, out_path)
+        commit_file(tmp, out_path)
     except BaseException:
         # cleanup must not mask the root cause: an async writer close()
         # re-raises its deferred worker error — suppress it here, the
@@ -784,7 +785,7 @@ def _write_bam_records(out_path, header: BamHeader, big: np.ndarray,
                 writer.write(data.tobytes())
                 i0 = i1
         writer.close()
-        os.replace(tmp, out_path)
+        commit_file(tmp, out_path)
     except BaseException:
         # cleanup must not mask the root cause: an async writer close()
         # re-raises its deferred worker error — suppress it here, the

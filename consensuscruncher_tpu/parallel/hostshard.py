@@ -31,11 +31,68 @@ from __future__ import annotations
 
 import json
 import os
+import subprocess
+import sys
+import time
 
 import numpy as np
 
 from consensuscruncher_tpu.io.bam import BamWriter
+from consensuscruncher_tpu.utils import faults
 from consensuscruncher_tpu.utils.stats import FamilySizeHistogram, StageStats
+
+
+def run_workers(workers: list[dict], retries: int = 1,
+                what: str = "host-sharded consensus") -> None:
+    """Launch the worker fleet, wait, and retry failures with backoff.
+
+    ``workers``: one dict per worker — ``cmd`` (argv list), ``err_path``
+    (stderr log file), optional ``env`` and ``retry_cmd`` (normally the
+    same invocation plus ``--resume``, so a retried worker reuses the
+    stages it already committed instead of recomputing them).  A failing
+    worker is relaunched up to ``retries`` times; if failures remain,
+    raises SystemExit carrying every failing worker's stderr tail.
+
+    The fleet runs concurrently within a round; retry rounds are a
+    recovery path (transient node pressure, injected chaos), not the
+    throughput path, so their backoff delay is shared, not per-worker.
+    """
+    def launch(w: dict, cmd: list, mode: str):
+        with open(w["err_path"], mode) as err_f:
+            return subprocess.Popen(cmd, env=w.get("env"),
+                                    stdout=subprocess.DEVNULL, stderr=err_f)
+
+    base = float(os.environ.get("CCT_RETRY_BASE_S", "0.5"))
+    live = [(w, launch(w, w["cmd"], "wb")) for w in workers]
+    failed: list[tuple[dict, int]] = []
+    for round_no in range(retries + 1):
+        failed = []
+        for w, p in live:
+            p.wait()
+            if p.returncode != 0:
+                failed.append((w, p.returncode))
+        if not failed:
+            return
+        if round_no >= retries:
+            break
+        delay = faults.backoff_delay(round_no + 1, base, cap=30.0)
+        names = ", ".join(w.get("name", "?") for w, _rc in failed)
+        print(f"WARNING: {len(failed)} worker(s) failed ({names}); "
+              f"retrying in {delay:.1f}s (round {round_no + 2}/{retries + 1})",
+              file=sys.stderr, flush=True)
+        time.sleep(delay)
+        live = [(w, launch(w, w.get("retry_cmd", w["cmd"]), "ab"))
+                for w, _rc in failed]
+    msgs = []
+    for w, rc in failed:
+        try:
+            with open(w["err_path"], "rb") as f:
+                tail = f.read().decode(errors="replace").strip().splitlines()[-8:]
+        except OSError:
+            tail = ["<stderr file unreadable>"]
+        msgs.append(f"worker {w.get('name', '?')} rc={rc} "
+                    f"(full log: {w['err_path']}): " + " | ".join(tail))
+    raise SystemExit(f"{what} failed:\n" + "\n".join(msgs))
 
 
 def plan_bai_ranges(in_bam: str, n: int) -> list["BamRange"]:

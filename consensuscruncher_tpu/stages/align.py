@@ -23,11 +23,13 @@ interface.
 
 from __future__ import annotations
 
+import sys
 from dataclasses import dataclass
 
 import numpy as np
 
 from consensuscruncher_tpu.io.fasta import read_fasta
+from consensuscruncher_tpu.utils.faults import FaultError, fault_point
 
 _CODE = np.full(256, 255, np.uint8)
 for _i, _c in enumerate(b"ACGT"):
@@ -429,6 +431,7 @@ def _pool_prestart_wait():
 def _pool_bucket_blobs(task):
     from consensuscruncher_tpu.io.encode import encode_records
 
+    fault_point("align.pool_worker")  # chaos site: injected worker death
     return _bucket_blobs(_POOL_ALIGNER, encode_records, _POOL_EMIT_LUT, *task)
 
 
@@ -447,6 +450,50 @@ def _shutdown_pool(pool, kill: bool) -> None:
                 pass
     else:
         pool.shutdown(wait=True)
+
+
+def _start_pool(workers: int, aligner, emit_lut):
+    """Fork + warm an align pool behind the prestart barrier.
+
+    Returns the executor, or None when warm-up fails (barrier timeout on an
+    overloaded host, injected fault) — the caller degrades to serial
+    alignment with a warning instead of aborting a multi-hour run.  Output
+    bytes are identical either way (the writer's order is content-keyed),
+    so degradation costs wall-clock only.
+    """
+    import concurrent.futures as cf
+    import multiprocessing as mp
+    import threading
+
+    global _POOL_ALIGNER, _POOL_EMIT_LUT, _POOL_PRESTART_BARRIER
+    ctx = mp.get_context("fork")
+    _POOL_ALIGNER, _POOL_EMIT_LUT = aligner, emit_lut
+    _POOL_PRESTART_BARRIER = ctx.Barrier(workers + 1)
+    pool = cf.ProcessPoolExecutor(workers, mp_context=ctx)
+    try:
+        # Force every worker to fork NOW: each barrier task pins the
+        # worker that picks it up, so the executor's on-demand spawner
+        # must create all `workers` processes before the parent (the
+        # +1-th party) releases them — i.e. before the sorting writer
+        # and its async BGZF thread exist.
+        warm = [pool.submit(_pool_prestart_wait) for _ in range(workers)]
+        fault_point("align.barrier")
+        _POOL_PRESTART_BARRIER.wait(timeout=120)
+        for f in warm:
+            f.result(timeout=120)
+    except (threading.BrokenBarrierError, cf.TimeoutError, FaultError) as e:
+        _shutdown_pool(pool, kill=True)
+        _POOL_ALIGNER = _POOL_EMIT_LUT = _POOL_PRESTART_BARRIER = None
+        print(f"WARNING: align pool warm-up failed ({e!r}); "
+              "falling back to serial alignment", file=sys.stderr, flush=True)
+        return None
+    except BaseException:
+        # anything else (KeyboardInterrupt, executor bug) must not leak the
+        # executor or pin the COW index
+        _shutdown_pool(pool, kill=True)
+        _POOL_ALIGNER = _POOL_EMIT_LUT = _POOL_PRESTART_BARRIER = None
+        raise
+    return pool
 
 
 def align_fastqs_columnar(aligner: BuiltinAligner, r1: str, r2: str,
@@ -469,12 +516,12 @@ def align_fastqs_columnar(aligner: BuiltinAligner, r1: str, r2: str,
     paths byte-match.  ALL pool workers fork before the writer exists (a
     prestart barrier forces the executor's lazy spawns early), so no
     BGZF/codec thread state crosses any fork; the executor never re-forks
-    replacements, and a worker death (e.g. OOM-kill at the 100M+-read
-    scale this targets) surfaces as BrokenProcessPool at the next drain
-    and aborts the run instead of hanging it.
+    replacements itself.  A worker death (e.g. OOM-kill at the 100M+-read
+    scale this targets) surfaces as BrokenProcessPool at the next drain;
+    the run then re-forks the pool ONCE and replays the lost chunks, and
+    on a second death finishes the remaining chunks serially in the
+    parent — the content-keyed order makes replay byte-transparent.
     """
-    import multiprocessing as mp
-
     from consensuscruncher_tpu.io.bam import BamHeader
     from consensuscruncher_tpu.io.columnar import SortingBamWriter
     from consensuscruncher_tpu.io.encode import encode_records
@@ -492,29 +539,7 @@ def align_fastqs_columnar(aligner: BuiltinAligner, r1: str, r2: str,
 
     pool = None
     if workers > 1:
-        import concurrent.futures as cf
-
-        ctx = mp.get_context("fork")
-        _POOL_ALIGNER, _POOL_EMIT_LUT = aligner, emit_lut
-        _POOL_PRESTART_BARRIER = ctx.Barrier(workers + 1)
-        pool = cf.ProcessPoolExecutor(workers, mp_context=ctx)
-        try:
-            # Force every worker to fork NOW: each barrier task pins the
-            # worker that picks it up, so the executor's on-demand spawner
-            # must create all `workers` processes before the parent (the
-            # +1-th party) releases them — i.e. before the sorting writer
-            # and its async BGZF thread exist below.
-            warm = [pool.submit(_pool_prestart_wait) for _ in range(workers)]
-            _POOL_PRESTART_BARRIER.wait(timeout=120)
-            for f in warm:
-                f.result(timeout=120)
-        except BaseException:
-            # warm-up failure (e.g. BrokenBarrierError on an overloaded
-            # host) must not leak the executor or pin the COW index
-            _shutdown_pool(pool, kill=True)
-            pool = None
-            _POOL_ALIGNER = _POOL_EMIT_LUT = _POOL_PRESTART_BARRIER = None
-            raise
+        pool = _start_pool(workers, aligner, emit_lut)
 
     from consensuscruncher_tpu.io.columnar import single_writer_sort_buffer_bytes
 
@@ -534,25 +559,81 @@ def align_fastqs_columnar(aligner: BuiltinAligner, r1: str, r2: str,
                 writer.write_encoded(blob2)
         else:
             from collections import deque
+            from concurrent.futures.process import BrokenProcessPool
 
-            pending: deque = deque()
+            pending: deque = deque()  # (future, task) — tasks kept for replay
             max_inflight = workers + 2
+            refork_left = 1
+
+            def run_serial(task):
+                nonlocal n_unmapped
+                blob1, blob2, un = _bucket_blobs(
+                    aligner, encode_records, emit_lut, *task)
+                n_unmapped += un
+                writer.write_encoded(blob1)
+                writer.write_encoded(blob2)
+
+            def handle_pool_death(exc):
+                # One worker death breaks EVERY in-flight future, so the
+                # whole pending window must be replayed: re-fork the pool
+                # once, and after a second death (or a failed re-fork
+                # warm-up) finish in the parent.  Replay cannot duplicate
+                # or reorder output — the writer's total order is
+                # content-keyed and n_unmapped counts only at completion.
+                nonlocal pool, refork_left
+                global _POOL_ALIGNER, _POOL_EMIT_LUT, _POOL_PRESTART_BARRIER
+                lost = [t for _f, t in pending]
+                pending.clear()
+                _shutdown_pool(pool, kill=True)
+                pool = None
+                _POOL_ALIGNER = _POOL_EMIT_LUT = _POOL_PRESTART_BARRIER = None
+                if refork_left > 0:
+                    refork_left -= 1
+                    print(f"WARNING: align pool worker died ({exc!r}); "
+                          f"re-forking once and replaying {len(lost)} "
+                          "in-flight chunk(s)", file=sys.stderr, flush=True)
+                    pool = _start_pool(workers, aligner, emit_lut)
+                else:
+                    print(f"WARNING: align pool died again ({exc!r}); "
+                          "finishing the remaining chunks serially",
+                          file=sys.stderr, flush=True)
+                for t in lost:
+                    submit_one(t)
+
+            def submit_one(task):
+                if pool is None:
+                    run_serial(task)
+                    return
+                try:
+                    pending.append((pool.submit(_pool_bucket_blobs, task), task))
+                except BrokenProcessPool as e:
+                    handle_pool_death(e)
+                    if pool is None:
+                        run_serial(task)
+                    else:
+                        pending.append((pool.submit(_pool_bucket_blobs, task), task))
 
             def drain_one():
                 # result() raises BrokenProcessPool the moment any worker
-                # dies (the executor marks every in-flight future), so a
-                # killed worker aborts the run instead of blocking forever.
+                # dies (the executor marks every in-flight future) — recover
+                # instead of blocking forever or aborting the run.
                 nonlocal n_unmapped
-                blob1, blob2, un = pending.popleft().result()
+                fut, task = pending.popleft()
+                try:
+                    blob1, blob2, un = fut.result()
+                except BrokenProcessPool as e:
+                    pending.appendleft((fut, task))  # still lost; replay it
+                    handle_pool_death(e)
+                    return
                 n_unmapped += un
                 writer.write_encoded(blob1)
                 writer.write_encoded(blob2)
 
             for task in tasks:
-                while len(pending) >= max_inflight:
+                while pool is not None and len(pending) >= max_inflight:
                     drain_one()
                 n_total += 2 * len(task[0])
-                pending.append(pool.submit(_pool_bucket_blobs, task))
+                submit_one(task)
             while pending:
                 drain_one()
     except BaseException:

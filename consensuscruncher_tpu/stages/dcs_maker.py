@@ -28,8 +28,10 @@ from dataclasses import dataclass
 import numpy as np
 
 import struct
+import sys
 
 from consensuscruncher_tpu.core import tags as tags_mod
+from consensuscruncher_tpu.utils import faults
 from consensuscruncher_tpu.core.consensus_read import _KEEP_FLAGS
 from consensuscruncher_tpu.core.duplex_cpu import duplex_consensus
 from consensuscruncher_tpu.io.bam import BamWriter
@@ -189,6 +191,7 @@ def _run_dcs_windows(reader, stats, unpaired_writer, rec_writer,
                      qual_cap: int, backend: str, mesh=None) -> None:
     """Object-window pairing walk (foreign consensus BAMs: records whose
     tag block doesn't lead with XT:Z+XF:i)."""
+    _chaos = faults.hook("dcs.midstage")  # None unless a chaos test arms it
     batcher = _DuplexBatcher(qual_cap, reader.header, backend=backend, mesh=mesh)
 
     def sink(tag, canon, other, codes, quals):
@@ -207,6 +210,8 @@ def _run_dcs_windows(reader, stats, unpaired_writer, rec_writer,
         stats.incr("dcs_written")
 
     for _key, window in consensus_windows_columnar(reader):
+        if _chaos is not None:
+            _chaos()
         paired: set = set()
         for tag in sorted(window, key=str):
             if tag in paired:
@@ -245,8 +250,11 @@ def _consume_pair_blocks(reader, stats, unpaired_writer, rec_writer,
     from consensuscruncher_tpu.stages.grouping import duplex_pair_blocks
     from consensuscruncher_tpu.utils.ragged import gather_runs
 
+    _chaos = faults.hook("dcs.midstage")  # None unless a chaos test arms it
     header = reader.header
     for blk in duplex_pair_blocks(reader, header):
+        if _chaos is not None:
+            _chaos()
         # guard zero increments: the window walk only creates keys it touches
         if blk.stats_total:
             stats.incr("sscs_total", blk.stats_total)
@@ -387,7 +395,15 @@ def run_dcs(
             raise ValueError("--devices > 1 requires the tpu backend")
         from consensuscruncher_tpu.parallel.mesh import make_mesh
 
-        mesh = make_mesh(devices)
+        try:
+            faults.fault_point("mesh.unavailable")
+            mesh = make_mesh(devices)
+        except Exception as e:
+            # Same degraded mode as run_sscs: mesh loss costs throughput,
+            # never the run (outputs bit-identical at any mesh size).
+            print(f"WARNING: {devices}-device mesh unavailable ({e}); "
+                  "degrading to single-device", file=sys.stderr, flush=True)
+            mesh = None
     from consensuscruncher_tpu.utils.stats import TimeTracker
 
     tracker = TimeTracker()

@@ -26,11 +26,13 @@ atomically at close — the reference reaches the same state via
 from __future__ import annotations
 
 import struct
+import sys
 from dataclasses import dataclass
 
 import numpy as np
 
 from consensuscruncher_tpu.core import tags as tags_mod
+from consensuscruncher_tpu.utils import faults
 from consensuscruncher_tpu.core.consensus_cpu import consensus_maker_numpy
 from consensuscruncher_tpu.core.consensus_read import (
     _KEEP_FLAGS,
@@ -172,7 +174,16 @@ def run_sscs(
             raise ValueError("--devices > 1 requires the tpu backend")
         from consensuscruncher_tpu.parallel.mesh import make_mesh
 
-        mesh = make_mesh(devices)
+        try:
+            faults.fault_point("mesh.unavailable")
+            mesh = make_mesh(devices)
+        except Exception as e:
+            # Degraded mode: a missing/short mesh (preempted chips, tunnel
+            # flap) costs throughput, never the run — outputs are
+            # bit-identical at any mesh size (parity suite).
+            print(f"WARNING: {devices}-device mesh unavailable ({e}); "
+                  "degrading to single-device", file=sys.stderr, flush=True)
+            mesh = None
     tracker = TimeTracker()
     stats = StageStats("SSCS")
     hist = FamilySizeHistogram()
@@ -224,10 +235,14 @@ def run_sscs(
 
     pending: dict[int, tuple] = {}
 
+    _chaos = faults.hook("sscs.midstage")  # None unless a chaos test arms it
+
     def events():
         """Route grouping events; yield consensus jobs for families >= 2."""
         next_id = 0
         for kind, a, b in source:
+            if _chaos is not None:
+                _chaos()
             if kind == "bad":
                 stats.incr("total_reads")
                 stats.incr(f"bad_{b}")
@@ -263,6 +278,8 @@ def run_sscs(
         block_events = (prestaged.events if prestaged is not None
                         else stream_family_blocks(reader, header, bdelim))
         for kind, a, b in block_events:
+            if _chaos is not None:
+                _chaos()
             if kind == "bad":
                 stats.incr("total_reads")
                 stats.incr(f"bad_{b}")

@@ -1,0 +1,211 @@
+"""Fault-injection registry + bounded retry: the pipeline's chaos harness.
+
+The north star is a run that survives the failures a multi-hour,
+multi-process pipeline actually sees — a pool worker OOM-killed mid-chunk,
+an external aligner flaking with rc!=0, a BGZF input truncated by a
+died-mid-copy upload, a SIGTERM landing between stages.  Those paths are
+worthless untested, and untestable without a way to *cause* the failure on
+demand inside a hermetic CPU test.  This module is that switchboard: every
+recovery path in the codebase guards a named **site**, and a test arms the
+site through the environment, so the exact production code path (including
+forked pool workers and CLI subprocesses, which inherit the environment)
+fires the fault.
+
+Spec (env, so it crosses fork/exec boundaries for free):
+
+  CCT_FAULTS       comma-separated ``site=kind[@times][:arg]`` directives,
+                   e.g. ``align.pool_worker=exit@1,subprocess.bwa=fail@2``
+  CCT_FAULTS_DIR   optional ledger directory.  When set, each site's firing
+                   budget is counted ACROSS PROCESSES via O_CREAT|O_EXCL
+                   marker files — "exactly one pool worker dies, once" is
+                   expressible even though every forked worker sees the
+                   same spec.  Without it, budgets are per-process.
+
+Kinds:
+
+  fail    raise :class:`FaultError` (arg unused)
+  exit    ``os._exit(arg or 137)`` — an un-catchable worker death
+  kill    ``os.kill(self, SIG<arg or TERM>)`` — real signal delivery
+  stall   ``time.sleep(arg or 0.05)`` — slow-I/O; correctness must hold
+
+Sites wired in this codebase (grep for ``fault_point``/``faults.hook``):
+
+  align.barrier        prestart-barrier warm-up failure -> serial fallback
+  align.pool_worker    fork-pool worker death -> re-fork once, then serial
+  subprocess.bwa       external aligner failure -> bounded retry + backoff
+  bgzf.truncated_eof   reader sees a truncated block -> clear error/salvage
+  bgzf.read_stall      slow input device (stall kind)
+  mesh.unavailable     device mesh creation -> single-device fallback
+  sscs.midstage        crash/SIGTERM inside the SSCS loop (atomicity proof)
+  dcs.midstage         crash/SIGTERM inside the DCS loop (atomicity proof)
+  watch.job            TPU watcher row job nonzero rc -> retry + backoff
+
+Everything here is stdlib-only and import-cheap: io/bgzf.py and the
+tools/ scripts (whose parents must never import jax) both import it.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import sys
+import time
+
+
+class FaultError(RuntimeError):
+    """An injected failure.  Never raised outside fault-injection runs."""
+
+
+class FaultInjector:
+    """Parsed CCT_FAULTS spec + firing budgets (see module docstring)."""
+
+    def __init__(self, spec: str, ledger_dir: str | None = None):
+        self.spec = spec
+        self.ledger_dir = ledger_dir
+        self._sites: dict[str, dict] = {}
+        self._fired: dict[str, int] = {}
+        for part in spec.split(","):
+            part = part.strip()
+            if not part or "=" not in part:
+                continue
+            site, rhs = part.split("=", 1)
+            arg = None
+            if ":" in rhs:
+                rhs, arg = rhs.split(":", 1)
+            times = 1
+            if "@" in rhs:
+                rhs, t = rhs.split("@", 1)
+                times = int(t)
+            self._sites[site.strip()] = {
+                "kind": rhs.strip(), "times": times, "arg": arg,
+            }
+
+    def armed(self, site: str) -> bool:
+        return site in self._sites
+
+    def fire(self, site: str) -> dict | None:
+        """Consume one firing of ``site``.  Returns the directive while the
+        budget lasts, then None forever — this is what makes "fail twice,
+        then succeed" expressible."""
+        d = self._sites.get(site)
+        if d is None:
+            return None
+        if self.ledger_dir:
+            # Cross-process budget: claiming marker file i < times wins
+            # exactly once across every process sharing the ledger.
+            os.makedirs(self.ledger_dir, exist_ok=True)
+            for i in range(d["times"]):
+                marker = os.path.join(self.ledger_dir, f"{site}.{i}")
+                try:
+                    fd = os.open(marker, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+                except FileExistsError:
+                    continue
+                os.close(fd)
+                return d
+            return None
+        n = self._fired.get(site, 0)
+        if n >= d["times"]:
+            return None
+        self._fired[site] = n + 1
+        return d
+
+
+_cached: tuple[str, str | None, FaultInjector] | None = None
+
+
+def get() -> FaultInjector:
+    """The process-wide injector, re-parsed whenever the env spec changes
+    (so monkeypatch.setenv works without reload, and forked children that
+    mutate nothing share the parent's budgets)."""
+    global _cached
+    spec = os.environ.get("CCT_FAULTS", "")
+    ledger = os.environ.get("CCT_FAULTS_DIR") or None
+    if _cached is None or _cached[0] != spec or _cached[1] != ledger:
+        _cached = (spec, ledger, FaultInjector(spec, ledger))
+    return _cached[2]
+
+
+def _perform(site: str, d: dict) -> None:
+    kind = d["kind"]
+    if kind == "fail":
+        raise FaultError(f"injected fault at {site}")
+    if kind == "exit":
+        os._exit(int(d["arg"] or 137))
+    if kind == "kill":
+        sig = getattr(signal, d["arg"]) if d["arg"] else signal.SIGTERM
+        os.kill(os.getpid(), sig)
+        # Default-disposition signals deliver asynchronously: block so the
+        # code after the injection point never runs in the dying process.
+        time.sleep(30)
+        return
+    if kind == "stall":
+        time.sleep(float(d["arg"] or 0.05))
+        return
+    raise ValueError(f"unknown fault kind {kind!r} at site {site!r}")
+
+
+def fault_point(site: str) -> None:
+    """The one call a subsystem plants at an injection point.  No-op (two
+    dict lookups) unless CCT_FAULTS arms ``site``."""
+    inj = get()
+    if not inj._sites:
+        return
+    d = inj.fire(site)
+    if d is not None:
+        _perform(site, d)
+
+
+def fire(site: str) -> dict | None:
+    """Like :func:`fault_point` but returns the directive instead of acting,
+    for call sites that express the fault in their own vocabulary (e.g. the
+    watcher swapping in a known-failing command)."""
+    inj = get()
+    if not inj._sites:
+        return None
+    return inj.fire(site)
+
+
+def hook(site: str):
+    """Resolve an injection point ONCE for a hot loop: None when ``site``
+    is not armed (so the loop pays a single ``if`` per iteration), else a
+    zero-arg callable that consumes budget and performs the directive."""
+    if not get().armed(site):
+        return None
+    return lambda: fault_point(site)
+
+
+def retrying(fn, *, site: str, attempts: int = 3, base_delay: float | None = None,
+             max_delay: float = 30.0, retriable: tuple = (Exception,),
+             describe: str | None = None, sleep=time.sleep):
+    """Call ``fn()`` with bounded retry + exponential backoff.
+
+    ``site`` doubles as the injection point: an armed ``site=fail@k``
+    directive makes the first k attempts fail synthetically, which is how
+    tests express "flake twice, then succeed" against the real retry loop.
+    ``base_delay=None`` reads CCT_RETRY_BASE_S (default 0.5 s; tests set it
+    to ~0 so backoff is exercised without wall-clock cost).
+    """
+    if base_delay is None:
+        base_delay = float(os.environ.get("CCT_RETRY_BASE_S", "0.5"))
+    if attempts < 1:
+        raise ValueError("attempts must be >= 1")
+    catch = tuple(retriable) + (FaultError,)
+    for attempt in range(attempts):
+        try:
+            fault_point(site)
+            return fn()
+        except catch as e:
+            if attempt + 1 >= attempts:
+                raise
+            delay = min(max_delay, base_delay * (2.0 ** attempt))
+            print(f"WARNING: {describe or site} failed ({e}); "
+                  f"retry {attempt + 2}/{attempts} in {delay:.1f}s",
+                  file=sys.stderr, flush=True)
+            sleep(delay)
+    raise AssertionError("unreachable")
+
+
+def backoff_delay(attempt: int, base: float, cap: float) -> float:
+    """Exponential backoff schedule shared by the retry loops: delay before
+    attempt ``attempt+1`` after ``attempt`` failures (attempt >= 1)."""
+    return min(cap, base * (2.0 ** max(0, attempt - 1)))

@@ -13,8 +13,9 @@ manifest:
   change invalidates everything downstream, and outputs are re-fingerprinted
   so a half-written file (non-atomic writer, disk-full) never masquerades as
   a checkpoint;
-- the manifest file itself is written atomically (write-then-rename), the
-  same discipline the BAM writers use.
+- the manifest file itself is committed durably (write tmp, fsync, rename,
+  fsync dir), the same discipline the BAM writers use via
+  :func:`commit_file` below.
 
 Fingerprints are ``(size, sha256(head 1 MiB), sha256(tail 1 MiB))`` —
 content-based (mtime survives copies/rsync badly) but O(1) in file size, so
@@ -31,6 +32,35 @@ import tempfile
 _CHUNK = 1 << 20  # head/tail bytes hashed per file
 
 MANIFEST_VERSION = 1
+
+
+def commit_file(tmp_path: str, final_path: str) -> None:
+    """Atomically and durably publish ``tmp_path`` as ``final_path``:
+    fsync the data, rename into place, fsync the directory.
+
+    This is THE stage-output commit point for the whole pipeline (BAM
+    writers, columnar merges, the manifest itself).  The rename gives
+    all-or-nothing visibility; the two fsyncs make the commit survive a
+    power cut — without them a crash can leave a fully *renamed* but
+    zero-length file, which would then fingerprint as a valid checkpoint.
+    """
+    fd = os.open(tmp_path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+    os.replace(tmp_path, final_path)
+    dirname = os.path.dirname(os.path.abspath(final_path)) or "."
+    try:
+        dfd = os.open(dirname, os.O_RDONLY)
+    except OSError:
+        return  # exotic fs that refuses O_RDONLY on dirs: rename still atomic
+    try:
+        os.fsync(dfd)
+    except OSError:
+        pass
+    finally:
+        os.close(dfd)
 
 
 def fingerprint(path: str) -> dict | None:
@@ -98,7 +128,7 @@ class RunManifest:
             with os.fdopen(fd, "w") as fh:
                 json.dump(data, fh, indent=2)
                 fh.write("\n")
-            os.replace(tmp, self.path)
+            commit_file(tmp, self.path)
         except BaseException:
             if os.path.exists(tmp):
                 os.unlink(tmp)

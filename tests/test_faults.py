@@ -1,0 +1,415 @@
+"""Chaos suite: every fault-injection site exercises the recovery it guards.
+
+The fault-tolerance layer (utils/faults.py) is worthless untested — these
+tests arm each site through CCT_FAULTS and assert the *production* recovery
+path: pool-worker death replays to golden-identical output, a flaky aligner
+retries to success, a truncated BGZF input fails loudly (and salvages on
+request), SIGTERM mid-stage leaves only committed atomic outputs that
+``--resume`` verifies and reuses.  Everything here is hermetic CPU.
+"""
+
+import gzip
+import hashlib
+import importlib.util
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from consensuscruncher_tpu.utils import faults
+from consensuscruncher_tpu.utils.faults import FaultError, retrying
+
+from test_cli_e2e import FAKE_BWA, _write_fastqs
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_faults(monkeypatch):
+    # The injector is cached per (spec, ledger) pair; without a reset, a
+    # second test arming the SAME spec string would inherit the first
+    # test's consumed budgets.
+    monkeypatch.setattr(faults, "_cached", None)
+    monkeypatch.setenv("CCT_RETRY_BASE_S", "0.001")
+    yield
+    faults._cached = None
+
+
+def _sha(path):
+    with open(path, "rb") as fh:
+        return hashlib.sha256(fh.read()).hexdigest()
+
+
+# --------------------------------------------------------------- registry
+
+
+def test_spec_parse_and_budget(monkeypatch):
+    monkeypatch.setenv("CCT_FAULTS", "a.b=fail@2, c.d=stall:0.01,junk")
+    inj = faults.get()
+    assert inj.fire("a.b") is not None
+    assert inj.fire("a.b") is not None
+    assert inj.fire("a.b") is None  # budget of 2 exhausted
+    d = inj.fire("c.d")
+    assert d["kind"] == "stall" and d["arg"] == "0.01"
+    assert inj.fire("never.armed") is None
+    assert not inj.armed("junk")  # malformed directives are ignored
+
+
+def test_ledger_budget_counts_across_injectors(tmp_path):
+    # Two injector instances = two processes sharing CCT_FAULTS_DIR: the
+    # O_EXCL marker means the single firing is claimed exactly once.
+    ledger = str(tmp_path / "ledger")
+    a = faults.FaultInjector("x.y=exit", ledger)
+    b = faults.FaultInjector("x.y=exit", ledger)
+    assert a.fire("x.y") is not None
+    assert b.fire("x.y") is None
+    assert a.fire("x.y") is None
+
+
+def test_retrying_flake_twice_then_succeeds(monkeypatch, capsys):
+    monkeypatch.setenv("CCT_FAULTS", "flaky.op=fail@2")
+    calls = []
+    out = retrying(lambda: calls.append(1) or "ok", site="flaky.op",
+                   attempts=3, describe="flaky op")
+    assert out == "ok" and len(calls) == 1
+    err = capsys.readouterr().err
+    assert err.count("WARNING") == 2 and "retry 2/3" in err
+
+
+def test_retrying_exhaustion_raises(monkeypatch):
+    monkeypatch.setenv("CCT_FAULTS", "flaky.two=fail@3")
+    with pytest.raises(FaultError):
+        retrying(lambda: "ok", site="flaky.two", attempts=3)
+
+
+# --------------------------------------------------- align pool recovery
+
+
+@pytest.fixture(scope="module")
+def aln_fixture(tmp_path_factory):
+    """Reference + paired FASTQs + the golden (serial) BAM digest."""
+    from consensuscruncher_tpu.io.fasta import write_fasta
+    from consensuscruncher_tpu.stages.align import (
+        BuiltinAligner, align_fastqs_columnar, revcomp)
+
+    rng = np.random.default_rng(77)
+    ref = "".join("ACGT"[i] for i in rng.integers(0, 4, 9_000))
+    d = tmp_path_factory.mktemp("chaos_align")
+    fa = str(d / "ref.fa")
+    write_fasta(fa, {"chrC": ref})
+    r1, r2 = str(d / "c1.fastq.gz"), str(d / "c2.fastq.gz")
+    with gzip.open(r1, "wt") as f1, gzip.open(r2, "wt") as f2:
+        for i in range(48):
+            lo = int(rng.integers(0, len(ref) - 400))
+            s1, s2 = ref[lo:lo + 100], revcomp(ref[lo + 150:lo + 250])
+            f1.write(f"@c{i:03d}\n{s1}\n+\n{'I' * len(s1)}\n")
+            f2.write(f"@c{i:03d}\n{s2}\n+\n{'I' * len(s2)}\n")
+    golden = str(d / "golden.bam")
+    align_fastqs_columnar(BuiltinAligner(fa), r1, r2, golden,
+                          workers=1, pair_chunk=16)
+    return fa, r1, r2, _sha(golden)
+
+
+def test_align_barrier_fault_serial_fallback(aln_fixture, tmp_path,
+                                             monkeypatch, capfd):
+    from consensuscruncher_tpu.stages.align import (
+        BuiltinAligner, align_fastqs_columnar)
+
+    fa, r1, r2, golden = aln_fixture
+    monkeypatch.setenv("CCT_FAULTS", "align.barrier=fail")
+    out = str(tmp_path / "b.bam")
+    align_fastqs_columnar(BuiltinAligner(fa), r1, r2, out,
+                          workers=2, pair_chunk=16)
+    assert "falling back to serial alignment" in capfd.readouterr().err
+    assert _sha(out) == golden  # degraded mode, identical bytes
+
+
+def test_align_worker_death_recovers_with_parity(aln_fixture, tmp_path,
+                                                 monkeypatch, capfd):
+    """One forked worker os._exit()s mid-run (the OOM-kill shape).  The
+    drain replays the lost window on a re-forked pool and the output is
+    byte-identical to the serial run.  The ledger is what makes 'exactly
+    one death' expressible across the forked workers."""
+    from consensuscruncher_tpu.stages.align import (
+        BuiltinAligner, align_fastqs_columnar)
+
+    fa, r1, r2, golden = aln_fixture
+    ledger = str(tmp_path / "ledger")
+    monkeypatch.setenv("CCT_FAULTS", "align.pool_worker=exit")
+    monkeypatch.setenv("CCT_FAULTS_DIR", ledger)
+    out = str(tmp_path / "w.bam")
+    align_fastqs_columnar(BuiltinAligner(fa), r1, r2, out,
+                          workers=2, pair_chunk=16)
+    assert "align pool worker died" in capfd.readouterr().err
+    assert _sha(out) == golden
+    assert os.listdir(ledger) == ["align.pool_worker.0"]  # fired exactly once
+
+
+# ------------------------------------------------- external aligner retry
+
+
+def _flaky_bwa(tmp_path, marker):
+    """FAKE_BWA that exits rc=1 on its first invocation (marker absent)."""
+    import stat
+
+    prefix = (
+        "#!/usr/bin/env python3\n"
+        "import os, sys\n"
+        f"m = {marker!r}\n"
+        "if not os.path.exists(m):\n"
+        "    open(m, 'w').close()\n"
+        "    sys.stderr.write('transient aligner crash\\n')\n"
+        "    sys.exit(1)\n"
+    )
+    path = tmp_path / "flaky-bwa"
+    path.write_text(prefix + FAKE_BWA.split("\n", 1)[1])
+    path.chmod(path.stat().st_mode | stat.S_IEXEC)
+    return str(path)
+
+
+def test_bwa_nonzero_exit_retries_to_golden(tmp_path, monkeypatch, capfd):
+    from consensuscruncher_tpu.cli import align_and_sort
+
+    r1, r2 = _write_fastqs(tmp_path, n_frags=4, fam=2)
+    flaky = _flaky_bwa(tmp_path, str(tmp_path / "crashed.once"))
+    clean = str(tmp_path / "clean.bam")
+    align_and_sort(flaky, "x.fa", r1, r2, clean)  # marker now set: succeeds
+    out = str(tmp_path / "retried.bam")
+    os.unlink(str(tmp_path / "crashed.once"))  # re-arm the rc=1 crash
+    align_and_sort(flaky, "x.fa", r1, r2, out)
+    err = capfd.readouterr().err
+    assert "retry 2/3" in err and "status 1" in err
+    assert _sha(out) == _sha(clean)
+
+
+def test_bwa_injected_failure_exhausts_cleanly(tmp_path, monkeypatch):
+    import stat
+
+    from consensuscruncher_tpu.cli import align_and_sort
+
+    r1, r2 = _write_fastqs(tmp_path, n_frags=2, fam=1)
+    stub = tmp_path / "fake-bwa"
+    stub.write_text(FAKE_BWA)
+    stub.chmod(stub.stat().st_mode | stat.S_IEXEC)
+    monkeypatch.setenv("CCT_FAULTS", "subprocess.bwa=fail@3")
+    out = str(tmp_path / "never.bam")
+    with pytest.raises(SystemExit, match="injected fault"):
+        align_and_sort(str(stub), "x.fa", r1, r2, out)
+    assert not os.path.exists(out)  # no attempt ever promoted a partial
+
+
+# --------------------------------------------------- truncated BGZF input
+
+
+@pytest.fixture(scope="module")
+def small_bam(tmp_path_factory):
+    from consensuscruncher_tpu.utils.simulate import SimConfig, simulate_bam
+
+    bam = str(tmp_path_factory.mktemp("chaos_bam") / "in.sorted.bam")
+    simulate_bam(bam, SimConfig(n_fragments=60, read_len=40, seed=9))
+    return bam
+
+
+def _read_keys(path, **kw):
+    from consensuscruncher_tpu.io.bam import BamReader
+
+    with BamReader(path, **kw) as rd:
+        return [(r.qname, r.flag, r.pos) for r in rd]
+
+
+def test_truncated_bgzf_clear_error_then_salvage(small_bam, tmp_path, capfd):
+    from consensuscruncher_tpu.io.bgzf import TruncatedBgzfError
+
+    clean = _read_keys(small_bam)
+    with open(small_bam, "rb") as fh:
+        data = fh.read()
+    cut = str(tmp_path / "cut.bam")
+    with open(cut, "wb") as fh:
+        fh.write(data[:-40])  # strip the EOF marker + tail of the last block
+    with pytest.raises(TruncatedBgzfError):
+        _read_keys(cut)
+    got = _read_keys(cut, salvage=True)
+    assert "salvaging records" in capfd.readouterr().err
+    assert 0 < len(got) < len(clean)
+    assert got == clean[:len(got)]  # strict prefix, nothing invented
+
+
+def test_injected_truncation_site(small_bam, monkeypatch):
+    from consensuscruncher_tpu.io.bgzf import TruncatedBgzfError
+
+    monkeypatch.setenv("CCT_FAULTS", "bgzf.truncated_eof=fail")
+    with pytest.raises(TruncatedBgzfError, match="injected"):
+        _read_keys(small_bam)
+
+
+def test_read_stall_is_transparent(small_bam, monkeypatch):
+    clean = _read_keys(small_bam)
+    monkeypatch.setenv("CCT_FAULTS", "bgzf.read_stall=stall@3:0.001")
+    assert _read_keys(small_bam) == clean
+
+
+# ------------------------------------------------- degraded mesh + atomic
+
+
+def test_mesh_unavailable_degrades_to_single_device(small_bam, tmp_path,
+                                                    monkeypatch, capfd):
+    from consensuscruncher_tpu.stages.sscs_maker import run_sscs
+
+    base = run_sscs(small_bam, str(tmp_path / "one"), backend="tpu")
+    monkeypatch.setenv("CCT_FAULTS", "mesh.unavailable=fail")
+    res = run_sscs(small_bam, str(tmp_path / "deg"), backend="tpu", devices=8)
+    assert "mesh unavailable" in capfd.readouterr().err
+    assert _sha(res.sscs_bam) == _sha(base.sscs_bam)  # parity at any mesh size
+
+
+def test_sscs_midstage_fault_leaves_no_final_outputs(small_bam, tmp_path,
+                                                     monkeypatch):
+    from consensuscruncher_tpu.stages import sscs_maker
+
+    monkeypatch.setenv("CCT_FAULTS", "sscs.midstage=fail")
+    prefix = str(tmp_path / "s")
+    with pytest.raises(FaultError):
+        sscs_maker.run_sscs(small_bam, prefix, backend="cpu")
+    paths = sscs_maker.output_paths(prefix)
+    for key in ("sscs", "singleton", "bad", "stats_json"):
+        assert not os.path.exists(paths[key]), key  # nothing promoted
+
+
+def test_dcs_midstage_fault_leaves_no_final_outputs(small_bam, tmp_path,
+                                                    monkeypatch):
+    from consensuscruncher_tpu.stages import dcs_maker, sscs_maker
+
+    sscs = sscs_maker.run_sscs(small_bam, str(tmp_path / "s"), backend="cpu")
+    monkeypatch.setenv("CCT_FAULTS", "dcs.midstage=fail")
+    prefix = str(tmp_path / "d")
+    with pytest.raises(FaultError):
+        dcs_maker.run_dcs(sscs.sscs_bam, prefix, backend="cpu")
+    for p in dcs_maker.output_paths(prefix).values():
+        assert not os.path.exists(p), p
+
+
+# ------------------------------------------- SIGTERM mid-stage + --resume
+
+
+_CHILD = (
+    "import sys; "
+    f"sys.path.insert(0, {REPO!r}); "
+    f"sys.path.insert(0, {os.path.join(REPO, 'tools')!r}); "
+    "from _jax_cpu import force_cpu; force_cpu(); "
+    "from consensuscruncher_tpu.cli import main; "
+    "sys.exit(main(sys.argv[1:]))"
+)
+
+
+def test_sigterm_mid_dcs_then_resume_reuses_committed_stages(tmp_path, capsys):
+    """SIGTERM lands inside the DCS loop (real signal delivery, its own
+    process).  Completed stages are committed + manifest-recorded; DCS never
+    promoted anything.  A fault-free ``--resume`` run skips the committed
+    stages and finishes with outputs byte-identical to a clean run."""
+    from consensuscruncher_tpu import cli
+    from consensuscruncher_tpu.utils.simulate import SimConfig, simulate_bam
+
+    bam = str(tmp_path / "in.sorted.bam")
+    simulate_bam(bam, SimConfig(n_fragments=30, read_len=40, seed=11))
+    argv = ["consensus", "-i", bam, "-n", "s", "--backend", "cpu",
+            "--scorrect", "True"]
+
+    golden = str(tmp_path / "golden")
+    assert cli.main(argv + ["-o", golden]) == 0
+
+    out = str(tmp_path / "out")
+    env = dict(os.environ)
+    env["CCT_FAULTS"] = "dcs.midstage=kill"
+    env.pop("CCT_FAULTS_DIR", None)
+    proc = subprocess.run(
+        [sys.executable, "-c", _CHILD] + argv + ["-o", out],
+        env=env, capture_output=True, text=True, timeout=540)
+    assert proc.returncode != 0, proc.stderr[-2000:]
+
+    # Only committed, digest-verified outputs remain: SSCS landed + was
+    # recorded; the interrupted DCS promoted nothing.
+    base = os.path.join(out, "s")
+    assert os.path.exists(os.path.join(base, "sscs", "s.sscs.sorted.bam"))
+    assert os.path.exists(os.path.join(base, "manifest.json"))
+    assert not os.path.exists(os.path.join(base, "dcs", "s.dcs.sorted.bam"))
+    assert not os.listdir(os.path.join(base, "all_unique"))
+
+    capsys.readouterr()
+    assert cli.main(argv + ["-o", out, "--resume", "True"]) == 0
+    text = capsys.readouterr().out
+    assert "skipping sscs" in text and "skipping singleton_correction" in text
+    assert "skipping dcs" not in text  # the interrupted stage re-runs
+    for rel in ("all_unique/s.all.unique.sscs.bam",
+                "all_unique/s.all.unique.dcs.bam"):
+        assert (_sha(os.path.join(out, "s", rel))
+                == _sha(os.path.join(golden, "s", rel))), rel
+
+
+def test_corrupted_output_forces_stage_rerun(tmp_path, capsys):
+    """The manifest re-fingerprints outputs: flipping one byte mid-file in a
+    committed stage output disqualifies the skip and the stage re-runs to a
+    healthy state."""
+    from consensuscruncher_tpu import cli
+    from consensuscruncher_tpu.utils.simulate import SimConfig, simulate_bam
+
+    bam = str(tmp_path / "in.sorted.bam")
+    simulate_bam(bam, SimConfig(n_fragments=12, read_len=40, seed=4))
+    out = str(tmp_path / "o")
+    argv = ["consensus", "-i", bam, "-o", out, "-n", "s", "--backend", "cpu",
+            "--scorrect", "True"]
+    assert cli.main(argv) == 0
+    sscs = os.path.join(out, "s", "sscs", "s.sscs.sorted.bam")
+    blob = bytearray(open(sscs, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF
+    with open(sscs, "wb") as fh:
+        fh.write(blob)
+    capsys.readouterr()
+    assert cli.main(argv + ["--resume", "True"]) == 0
+    assert "skipping sscs" not in capsys.readouterr().out
+    _read_keys(sscs)  # re-run restored a readable BAM
+
+
+# ------------------------------------------------------- watcher backoff
+
+
+def test_watcher_job_flakes_then_backs_off_then_lands(tmp_path, monkeypatch):
+    spec = importlib.util.spec_from_file_location(
+        "tpu_watch_chaos", os.path.join(REPO, "tools", "tpu_watch.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    monkeypatch.setattr(mod, "EVIDENCE_DIR", str(tmp_path))
+    monkeypatch.setattr(mod, "EVIDENCE_JSON", str(tmp_path / "EV.json"))
+    monkeypatch.setattr(mod, "WATCH_LOG", str(tmp_path / "log.jsonl"))
+    monkeypatch.setattr(mod, "FOLD_INTERVAL", 0.2)
+    monkeypatch.setattr(mod, "RETRY_BACKOFF_S", 0.05)
+    monkeypatch.setenv("CCT_FAULTS", "watch.job=fail@2")
+
+    job = {"name": "j", "timeout": 60,
+           "cmd": [sys.executable, "-c",
+                   "import json; print(json.dumps({'ok': 1}))"]}
+    state = {"probes_total": 0, "probes_ok": 0, "first_ok": None,
+             "last_ok": None, "windows": [], "jobs": {}}
+
+    assert not mod.run_job(job, state)  # injected rc=3
+    js = state["jobs"]["j"]
+    assert js["status"] == "pending" and js["attempts"] == 1
+    first_retry_at = js["next_retry_at"]
+    assert not mod.job_ready(js, first_retry_at - 0.01)  # backoff gates it
+    assert mod.job_ready(js, first_retry_at)
+
+    assert not mod.run_job(job, state)  # second injected failure
+    assert js["attempts"] == 2
+    # exponential: the second wait is scheduled ~2x the first
+    assert js["next_retry_at"] - js["last_start"] > 0.05
+
+    assert mod.run_job(job, state)  # budget exhausted: the real cmd lands
+    assert js["status"] == "done" and "next_retry_at" not in js
+    mod.write_evidence(state)
+    import json as _json
+
+    with open(str(tmp_path / "EV.json")) as fh:
+        assert {"ok": 1} in _json.load(fh)["jobs"]["j"]["rows"]
+
+    assert not mod.job_ready({"status": "failed"}, float("inf"))

@@ -34,6 +34,13 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+# jax-free import (the watcher parent must never import jax: a sick tunnel
+# hangs the importing process) — utils.faults is stdlib-only by design.
+from consensuscruncher_tpu.utils import faults  # noqa: E402
+
 EVIDENCE_DIR = os.path.join(REPO, "tpu_evidence")
 EVIDENCE_JSON = os.path.join(REPO, "TPU_EVIDENCE.json")
 WATCH_LOG = os.path.join(EVIDENCE_DIR, "watch_log.jsonl")
@@ -43,6 +50,11 @@ PROBE_TIMEOUT = 120
 PROBE_INTERVAL_DOWN = 180     # seconds between probes while the tunnel is dead
 PROBE_INTERVAL_IDLE = 600     # all jobs done: keep recording window statistics
 MAX_ATTEMPTS = 4              # per job, across windows
+# Exponential backoff between a job's attempts: five rounds of empty
+# jobs_done showed immediate same-window retries mostly re-lose to the same
+# tunnel flap — spacing attempts out trades latency for attempt survival.
+RETRY_BACKOFF_S = float(os.environ.get("CCT_WATCH_BACKOFF_S", "60"))
+RETRY_BACKOFF_CAP_S = 900.0
 # seconds between evidence folds WHILE a job runs (tests shrink this)
 FOLD_INTERVAL = float(os.environ.get("CCT_WATCH_FOLD_S", "20"))
 
@@ -122,13 +134,17 @@ def run_job(job: dict, state: dict) -> bool:
     js["status"] = "running"
     env = dict(os.environ)
     env.update(job.get("env", {}))
+    cmd = job["cmd"]
+    if faults.fire("watch.job"):
+        # chaos site: a known-failing command stands in for a tunnel flap
+        cmd = [sys.executable, "-c", "import sys; sys.exit(3)"]
     t0 = _now()
     deadline = t0 + job.get("timeout", 1200)
     with open(out_path, "a") as out_f, open(err_path, "a") as err_f:
         out_f.write(f'{{"__job_start__": "{name}", "ts": {t0:.0f}}}\n')
         out_f.flush()
         proc = subprocess.Popen(
-            job["cmd"], stdout=out_f, stderr=err_f, cwd=REPO, env=env,
+            cmd, stdout=out_f, stderr=err_f, cwd=REPO, env=env,
             start_new_session=True,
         )
         last_fold = 0.0
@@ -155,9 +171,23 @@ def run_job(job: dict, state: dict) -> bool:
         with open(done_path, "w") as f:
             f.write(str(_now()))
         js["status"] = "done"
+        js.pop("next_retry_at", None)
         return True
-    js["status"] = "failed" if js["attempts"] >= MAX_ATTEMPTS else "pending"
+    if js["attempts"] >= MAX_ATTEMPTS:
+        js["status"] = "failed"
+    else:
+        js["status"] = "pending"
+        js["next_retry_at"] = _now() + faults.backoff_delay(
+            js["attempts"], RETRY_BACKOFF_S, RETRY_BACKOFF_CAP_S)
     return False
+
+
+def job_ready(js: dict, now: float) -> bool:
+    """Is this job eligible to run now?  Failed jobs never are; a pending
+    retry waits out its exponential backoff (a fresh job has none)."""
+    if js.get("status") == "failed":
+        return False
+    return now >= js.get("next_retry_at", 0.0)
 
 
 def parse_rows(name: str, limit: int = 40) -> list:
@@ -231,7 +261,7 @@ def main() -> None:
             for job in load_jobs():
                 _, _, done_path = job_paths(job["name"])
                 js = state["jobs"].get(job["name"], {})
-                if os.path.exists(done_path) or js.get("status") == "failed":
+                if os.path.exists(done_path) or not job_ready(js, _now()):
                     continue
                 run_job(job, state)
                 write_evidence(state)
