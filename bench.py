@@ -72,6 +72,7 @@ Scale knobs (env):
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import subprocess
@@ -493,7 +494,26 @@ def _pick_headline(tpu_result: dict, fallback: dict | None,
     return backend_used, result
 
 
+def _emit_metric_line(doc: dict) -> None:
+    """The driver contract: the machine-readable metric line is the FINAL
+    stdout line, unconditionally.  Every earlier BENCH_r0*.json recorded
+    "parsed": null because body output (worker chatter, tpu-evidence rows)
+    interleaved after the metric print — under a 2>&1 merge even stderr
+    could land after it.  So the body runs with stdout redirected to
+    stderr (see main/main_kernels), stderr is flushed FIRST, and this
+    write to the real stdout is the process's last act before exit."""
+    sys.stderr.flush()
+    sys.stdout.write(json.dumps(doc) + "\n")
+    sys.stdout.flush()
+
+
 def main() -> None:
+    with contextlib.redirect_stdout(sys.stderr):
+        line = _main_impl()
+    _emit_metric_line(line)
+
+
+def _main_impl() -> dict:
     t_start = time.perf_counter()
     extras: dict = {}
     value = 0.0
@@ -610,17 +630,22 @@ def main() -> None:
     except OSError:
         pass
     extras["wall_s"] = round(time.perf_counter() - t_start, 1)
-    line = {
+    return {
         "metric": METRIC,
         "value": value,
         "unit": "families/s",
         "vs_baseline": vs_baseline,
         **extras,
     }
-    print(json.dumps(line))
 
 
 def main_kernels() -> None:
+    with contextlib.redirect_stdout(sys.stderr):
+        result = _main_kernels_impl()
+    _emit_metric_line(result)
+
+
+def _main_kernels_impl() -> dict:
     t_start = time.perf_counter()
     with tempfile.TemporaryDirectory(prefix="cct_bench_") as td:
         attempts: list[dict] = []
@@ -632,7 +657,7 @@ def main_kernels() -> None:
             result["tpu_unavailable"] = True
             _fold_tpu_evidence(result, include_rows=True)
         result["tpu_probe_attempts"] = attempts
-    print(json.dumps(result))
+    return result
 
 
 if __name__ == "__main__":

@@ -737,6 +737,79 @@ def _nonempty(path: str) -> bool:
     return os.path.exists(path)
 
 
+# ---------------------------------------------------------------------- serve
+
+def serve_cmd(args) -> None:
+    """Run the persistent consensus daemon (serve/ subsystem): warm the
+    kernels once, then accept jobs over a unix socket or localhost TCP.
+    Lazy imports: serve pulls in the scheduler/server only when used."""
+    from consensuscruncher_tpu.serve import warmup
+    from consensuscruncher_tpu.serve.scheduler import Scheduler
+    from consensuscruncher_tpu.serve.server import ServeServer
+    from consensuscruncher_tpu.utils.backend_probe import ensure_backend
+
+    backend = args.backend
+    ensure_backend(backend)
+    if backend == "xla_cpu":
+        backend = "tpu"  # same jitted path pinned to the CPU platform
+
+    if args.compile_cache:
+        if warmup.setup_compilation_cache(args.compile_cache):
+            print(f"serve: persistent compile cache at {args.compile_cache}")
+    shapes = warmup.parse_shapes(args.warmup_shapes)
+    if shapes:
+        n = warmup.warm_shapes(shapes)
+        print(f"serve: precompiled {n}/{len(shapes)} warmup shapes")
+
+    scheduler = Scheduler(
+        queue_bound=int(args.queue_bound), gang_size=int(args.gang_size),
+        backend=backend, max_batch=int(args.max_batch),
+    )
+    server = ServeServer(
+        scheduler, host=args.host, port=int(args.port),
+        socket_path=args.socket or None,
+    )
+    print(f"serve: listening on {server.describe()} "
+          f"(queue_bound={scheduler.queue_bound}, "
+          f"gang_size={scheduler.gang_size})", flush=True)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        print("serve: draining on interrupt", flush=True)
+        server.close()
+        scheduler.close()
+
+
+def submit_cmd(args) -> None:
+    """Submit one consensus job to a running daemon and (by default) block
+    for its result — the thin client leg of the serve/ subsystem."""
+    from consensuscruncher_tpu.serve.client import ServeClient
+
+    address = args.socket or (args.host, int(args.port))
+    client = ServeClient(address)
+    spec = {
+        "input": os.path.abspath(args.input),
+        "output": os.path.abspath(args.output),
+        "name": args.name,
+        "cutoff": args.cutoff,
+        "qualscore": args.qualscore,
+        "scorrect": args.scorrect,
+        "max_mismatch": args.max_mismatch,
+        "bdelim": args.bdelim,
+        "compress_level": args.compress_level,
+    }
+    job_id = client.submit(spec)
+    print(f"submit: job {job_id} queued on {address}")
+    if not _bool(getattr(args, "wait", "True")):
+        return
+    job = client.result(job_id)
+    if job["state"] != "done":
+        raise SystemExit(f"submit: job {job_id} {job['state']}: {job.get('error')}")
+    base = (job.get("outputs") or {}).get("base")
+    print(f"submit: job {job_id} done in {job['wall_s']}s"
+          + (f" — outputs under {base}" if base else ""))
+
+
 # ------------------------------------------------------------------- argparse
 
 def build_parser() -> argparse.ArgumentParser:
@@ -848,6 +921,63 @@ def build_parser() -> argparse.ArgumentParser:
                        "bdelim": DEFAULT_BDELIM, "cleanup": "False",
                        "resume": "False", "compress_level": 6,
                        "host_workers": 1,
+                   })
+
+    s = sub.add_parser(
+        "serve",
+        help="run the persistent consensus daemon (warm kernels, "
+             "cross-request continuous batching)")
+    s.add_argument("-c", "--config", default=None)
+    s.add_argument("--socket", help="unix socket path (overrides host/port)")
+    s.add_argument("--host", help="TCP bind host (default 127.0.0.1)")
+    s.add_argument("--port", type=int, help="TCP port (default 7733; 0 = any free)")
+    s.add_argument("--queue_bound", type=int,
+                   help="max queued jobs before submit is refused (default 16)")
+    s.add_argument("--gang_size", type=int,
+                   help="max compatible jobs batched into one device "
+                        "dispatch round (default 4)")
+    s.add_argument("--max_batch", type=int,
+                   help="families per device bucket dispatch (default 1024)")
+    s.add_argument("--backend", choices=("cpu", "tpu", "xla_cpu"),
+                   help="device backend for served jobs (default tpu)")
+    s.add_argument("--warmup_shapes",
+                   help="comma-separated BxFxL vote buckets to precompile "
+                        "at startup (e.g. '64x4x128,64x8x128'); empty = none")
+    s.add_argument("--compile_cache",
+                   help="persistent JAX compilation cache directory "
+                        "(survives daemon restarts); empty = in-process only")
+    s.set_defaults(func=serve_cmd, config_section="serve", required_args=(),
+                   builtin_defaults={
+                       "socket": "", "host": "127.0.0.1", "port": 7733,
+                       "queue_bound": 16, "gang_size": 4, "max_batch": 1024,
+                       "backend": "tpu", "warmup_shapes": "",
+                       "compile_cache": "",
+                   })
+
+    u = sub.add_parser(
+        "submit", help="submit a consensus job to a running serve daemon")
+    u.add_argument("-c", "--config", default=None)
+    u.add_argument("--socket", help="daemon unix socket path")
+    u.add_argument("--host", help="daemon TCP host (default 127.0.0.1)")
+    u.add_argument("--port", type=int, help="daemon TCP port (default 7733)")
+    u.add_argument("--input", "-i", help="coordinate-sorted barcoded BAM")
+    u.add_argument("--output", "-o")
+    u.add_argument("--name", "-n")
+    u.add_argument("--cutoff", type=float)
+    u.add_argument("--qualscore", "-q", type=int)
+    u.add_argument("--scorrect", help="singleton correction on/off")
+    u.add_argument("--max_mismatch", type=int)
+    u.add_argument("--bdelim")
+    u.add_argument("--compress_level", type=int, choices=range(0, 10),
+                   metavar="0-9")
+    u.add_argument("--wait", help="block until the job finishes (default True)")
+    u.set_defaults(func=submit_cmd, config_section="serve",
+                   required_args=("input", "output"),
+                   builtin_defaults={
+                       "socket": "", "host": "127.0.0.1", "port": 7733,
+                       "cutoff": 0.7, "qualscore": 0, "scorrect": "True",
+                       "max_mismatch": 0, "bdelim": DEFAULT_BDELIM,
+                       "compress_level": 6, "wait": "True",
                    })
     return p
 

@@ -147,6 +147,7 @@ def consensus_families(
     max_batch: int = 1024,
     prefetch_depth: int | None = None,
     mesh=None,
+    on_batch=None,
 ):
     """Stream ragged families through the device kernel, double-buffered.
 
@@ -169,6 +170,10 @@ def consensus_families(
     host-side, so the only cross-chip traffic is the result gather),
     turning the stage's streaming path into the multi-chip path with no
     other change.
+
+    ``on_batch``: optional callback invoked with each ``FamilyBatch`` at
+    dispatch time (serve/ uses it to count device dispatches for the
+    metrics endpoint); it must not mutate the batch.
     """
     from consensuscruncher_tpu.parallel.batching import bucket_families
     from consensuscruncher_tpu.parallel.prefetch import DEFAULT_DEPTH, pipelined, prefetch
@@ -179,11 +184,15 @@ def consensus_families(
 
     if mesh is None:
         def dispatch(batch):
+            if on_batch is not None:
+                on_batch(batch)
             return consensus_batch(batch.bases, batch.quals, batch.fam_sizes, config)
     else:
         from consensuscruncher_tpu.parallel.mesh import pad_batch_to_mesh, sharded_vote_async
 
         def dispatch(batch):
+            if on_batch is not None:
+                on_batch(batch)
             bases, quals, sizes, _lengths, _n = pad_batch_to_mesh(
                 batch.bases, batch.quals, batch.fam_sizes, mesh, batch.lengths
             )

@@ -362,3 +362,28 @@ def bucket_member_blocks(
                 yield flush((lb, sc))
     for key in sorted(buckets):
         yield flush(key)
+
+
+def interleave_sources(sources: Sequence[Iterable]) -> Iterator:
+    """Round-robin merge of several family streams, per-source order intact.
+
+    The continuous-batching wire for serve/: families from concurrently
+    queued jobs are drawn one-per-source per round so a single
+    :func:`bucket_families` stream packs work from every live job into the
+    same device buckets.  Per-source relative order is exactly the source's
+    own order, which is the invariant the bit-identical guarantee rests on:
+    packed family *content* is source-local (rectangularize sees one family
+    at a time), and every downstream writer orders output by content-keyed
+    sort, never batch order (see bucket_member_blocks size-class note).
+    Exhausted sources drop out; the merge ends when all are exhausted.
+    """
+    iters = [iter(s) for s in sources]
+    while iters:
+        alive = []
+        for it in iters:
+            try:
+                yield next(it)
+            except StopIteration:
+                continue
+            alive.append(it)
+        iters = alive

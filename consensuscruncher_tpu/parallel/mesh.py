@@ -45,6 +45,11 @@ from consensuscruncher_tpu.ops.duplex_tpu import duplex_vote
 from consensuscruncher_tpu.ops.packing import unpack4_device, unpack_device
 from consensuscruncher_tpu.utils.phred import N
 
+try:  # jax >= 0.4.38 exposes it at top level
+    _shard_map = jax.shard_map
+except AttributeError:  # 0.4.x: experimental home
+    from jax.experimental.shard_map import shard_map as _shard_map
+
 FAMILY_AXIS = "families"
 
 
@@ -103,7 +108,7 @@ def _compiled_sharded_step(mesh: Mesh, num, den, qual_threshold, qual_cap):
     fn = partial(
         _shard_step, num=num, den=den, qual_threshold=qual_threshold, qual_cap=qual_cap
     )
-    mapped = jax.shard_map(
+    mapped = _shard_map(
         fn,
         mesh=mesh,
         in_specs=(P(FAMILY_AXIS),) * 4,
@@ -142,7 +147,7 @@ def _compiled_sharded_vote(mesh: Mesh, num, den, qual_threshold, qual_cap):
         qual_threshold=qual_threshold, qual_cap=qual_cap,
     )
     fn = jax.vmap(vote, in_axes=(0, 0, 0))
-    mapped = jax.shard_map(
+    mapped = _shard_map(
         fn,
         mesh=mesh,
         in_specs=(P(FAMILY_AXIS),) * 3,
@@ -315,7 +320,7 @@ def _compiled_stream_vote_sharded(mesh: Mesh, wire: str, num, den,
     fn = _stream_vote_fn(wire, num, den, qual_threshold, qual_cap,
                          member_cap, out_len)
     b_spec = P(FAMILY_AXIS) if wire == "raw" else P()
-    mapped = jax.shard_map(
+    mapped = _shard_map(
         fn,
         mesh=mesh,
         in_specs=(P(FAMILY_AXIS), b_spec, P(FAMILY_AXIS)),
@@ -355,7 +360,7 @@ def _compiled_duplex_sharded(mesh: Mesh, qual_cap: int):
         out_b, out_q = duplex_vote(s1, q1, s2, q2, qual_cap=qual_cap)
         return jnp.stack([out_b, out_q])
 
-    mapped = jax.shard_map(
+    mapped = _shard_map(
         fn, mesh=mesh, in_specs=(P(FAMILY_AXIS),) * 4,
         out_specs=P(None, FAMILY_AXIS),
     )
@@ -426,7 +431,7 @@ def full_pipeline_step(mesh: Mesh, config: ConsensusConfig = ConsensusConfig()):
     """
     shard_fn = _pipeline_shard_fn(config)
     spec = P(FAMILY_AXIS)
-    mapped = jax.shard_map(
+    mapped = _shard_map(
         shard_fn,
         mesh=mesh,
         in_specs=(spec,) * 6,
@@ -453,7 +458,7 @@ def packed_pipeline_step(mesh: Mesh, config: ConsensusConfig = ConsensusConfig()
         return step(bases_a, quals_a, sizes_a, bases_b, quals_b, sizes_b)
 
     spec = P(FAMILY_AXIS)
-    mapped = jax.shard_map(
+    mapped = _shard_map(
         shard_fn,
         mesh=mesh,
         in_specs=(spec, spec, spec, spec, P()),
@@ -485,7 +490,7 @@ def packed4_pipeline_step(mesh: Mesh, length: int, config: ConsensusConfig = Con
         return step(bases_a, quals_a, sizes_a, bases_b, quals_b, sizes_b)
 
     spec = P(FAMILY_AXIS)
-    mapped = jax.shard_map(
+    mapped = _shard_map(
         shard_fn,
         mesh=mesh,
         in_specs=(spec, spec, spec, spec, P()),

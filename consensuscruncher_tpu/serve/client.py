@@ -1,0 +1,88 @@
+"""Blocking client for the serve daemon (used by ``submit`` and tests).
+
+One connection per request keeps the client stateless and retry-friendly;
+the blocking ``result`` op simply holds its connection open until the
+daemon replies (the server waits on the scheduler's condition, not the
+socket, so a long job costs one idle descriptor, not a busy loop).
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+
+
+class ServeClientError(RuntimeError):
+    """The daemon replied ``ok: false`` (error text attached)."""
+
+    def __init__(self, message: str, reply: dict | None = None):
+        super().__init__(message)
+        self.reply = reply or {}
+
+
+class ServeClient:
+    """``address`` is a unix socket path (str) or a ``(host, port)`` pair."""
+
+    def __init__(self, address, connect_timeout: float = 10.0):
+        self.address = address
+        self.connect_timeout = connect_timeout
+
+    def _request(self, doc: dict, timeout: float | None = None) -> dict:
+        if isinstance(self.address, str):
+            sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        else:
+            sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        try:
+            sock.settimeout(self.connect_timeout)
+            sock.connect(self.address if isinstance(self.address, str)
+                         else tuple(self.address))
+            # after connect, the read deadline is the op's own timeout
+            sock.settimeout(timeout)
+            sock.sendall(json.dumps(doc).encode() + b"\n")
+            buf = b""
+            while b"\n" not in buf:
+                chunk = sock.recv(65536)
+                if not chunk:
+                    raise ServeClientError("daemon closed the connection")
+                buf += chunk
+            reply = json.loads(buf.split(b"\n", 1)[0])
+        finally:
+            sock.close()
+        if not reply.get("ok"):
+            raise ServeClientError(reply.get("error", "daemon error"), reply)
+        return reply
+
+    # ----------------------------------------------------------------- ops
+
+    def submit(self, spec: dict) -> int:
+        return int(self._request({"op": "submit", "spec": spec})["job_id"])
+
+    def status(self, job_id: int) -> dict:
+        return self._request({"op": "status", "job_id": job_id})["job"]
+
+    def result(self, job_id: int, timeout: float | None = None) -> dict:
+        """Block until the job is done/failed; returns the job description.
+        ``timeout`` bounds both the server-side wait and the socket read."""
+        sock_timeout = None if timeout is None else timeout + 10.0
+        return self._request(
+            {"op": "result", "job_id": job_id, "timeout": timeout},
+            timeout=sock_timeout,
+        )["job"]
+
+    def healthz(self) -> dict:
+        return self._request({"op": "healthz"})["health"]
+
+    def metrics(self) -> dict:
+        return self._request({"op": "metrics"})["metrics"]
+
+    def drain(self, timeout: float | None = None) -> None:
+        sock_timeout = None if timeout is None else timeout + 10.0
+        self._request({"op": "drain", "timeout": timeout}, timeout=sock_timeout)
+
+    def run(self, spec: dict, timeout: float | None = None) -> dict:
+        """submit + blocking result; raises on a failed job."""
+        job = self.result(self.submit(spec), timeout=timeout)
+        if job["state"] != "done":
+            raise ServeClientError(
+                f"job {job['job_id']} {job['state']}: {job.get('error')}", job)
+        return job

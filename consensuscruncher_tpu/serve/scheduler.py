@@ -1,0 +1,527 @@
+"""Admission-controlled job queue with cross-request continuous batching.
+
+The scheduler owns a bounded queue of consensus jobs and a single
+dispatcher thread.  Each dispatch round pops a *gang* of compatible queued
+jobs (same cutoff/qualscore — the compile-time consensus parameters) and
+runs their SSCS stage as ONE merged device stream: every job's family
+events are interleaved round-robin (``parallel.batching.interleave_sources``)
+into a single ``ops.consensus_tpu.consensus_families`` call, so one bucket
+dispatch carries families from several requests — the continuous-batching
+discipline that keeps an accelerator saturated under many small inputs.
+
+Bit-identity with the one-shot CLI path holds by construction:
+
+- packed family *content* is source-local (``rectangularize`` sees one
+  family at a time), so interleaving changes batch composition but never
+  the per-family vote inputs — and dense-vs-stream wire parity is already
+  pinned by the test suite;
+- record bytes are produced by the same ``stages.sscs_maker`` helpers
+  (``write_singleton`` / ``emit_consensus``) the one-shot stage uses;
+- every sorting writer orders output by content-keyed sort, never batch
+  order, so cross-request batch composition cannot leak into file bytes.
+
+After the gang SSCS, each job's "sscs" manifest entry is recorded exactly
+as ``cli._consensus_impl`` would record it, and the job finishes through
+``cli.main(["consensus", ..., "--resume", "True"])`` — the existing resume
+path skips the recorded stage and runs the rest warm.  A failed job
+retries through the same resume path (bounded, ``CCT_SERVE_RETRIES``),
+which PR-1's atomic stage commits make safe: a death mid-stage never
+leaves a partial output to resume over.
+
+Fault sites: ``serve.dispatch`` (gang dispatch — jobs fall back to solo
+runs) and ``serve.worker`` (per-job execution — retried via resume).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+from collections import deque
+
+from consensuscruncher_tpu.utils import faults
+from consensuscruncher_tpu.utils.profiling import Counters, metrics_doc
+
+
+class AdmissionRefused(RuntimeError):
+    """Queue full or server draining — the caller should retry later."""
+
+
+_STATES = ("queued", "running", "done", "failed")
+
+
+class Job:
+    """One submitted consensus request and its lifecycle."""
+
+    _next_id = 0
+    _id_lock = threading.Lock()
+
+    def __init__(self, spec: dict):
+        with Job._id_lock:
+            Job._next_id += 1
+            self.id = Job._next_id
+        self.spec = dict(spec)
+        self.state = "queued"
+        self.error: str | None = None
+        self.outputs: dict | None = None
+        self.wall_s: float | None = None
+        self.attempts = 0
+        self.gang_size = 1  # how many jobs shared this job's SSCS dispatch
+
+    def describe(self) -> dict:
+        return {
+            "job_id": self.id, "state": self.state, "error": self.error,
+            "outputs": self.outputs, "wall_s": self.wall_s,
+            "attempts": self.attempts, "gang_size": self.gang_size,
+            "input": self.spec.get("input"),
+        }
+
+
+def job_paths(spec: dict) -> dict:
+    """Output-tree paths for a job spec — the same naming authority as
+    ``cli._consensus_impl`` (``<output>/<name>/{sscs,singleton,...}``)."""
+    from consensuscruncher_tpu.stages import sscs_maker
+
+    name = spec.get("name") or os.path.basename(spec["input"]).split(".")[0]
+    base = os.path.join(spec["output"], name)
+    dirs = {k: os.path.join(base, k)
+            for k in ("sscs", "singleton", "dcs", "all_unique", "plots")}
+    prefix = os.path.join(dirs["sscs"], name)
+    return {"name": name, "base": base, "dirs": dirs, "sscs_prefix": prefix,
+            "sscs": sscs_maker.output_paths(prefix)}
+
+
+class _GangJobState:
+    """Per-job state for one gang-SSCS run: reader, writers, stats; the
+    exact one-shot ``run_sscs`` wiring, opened once per job so the merged
+    stream can demux results back to the owning job."""
+
+    def __init__(self, spec: dict):
+        from consensuscruncher_tpu.io.bam import BamWriter
+        from consensuscruncher_tpu.io.columnar import ColumnarReader, SortingBamWriter
+        from consensuscruncher_tpu.io.encode import ConsensusRecordWriter
+        from consensuscruncher_tpu.stages.grouping import stream_families_columnar
+        from consensuscruncher_tpu.utils.stats import (
+            FamilySizeHistogram, StageStats, TimeTracker,
+        )
+
+        self.spec = spec
+        p = job_paths(spec)
+        for d in p["dirs"].values():
+            os.makedirs(d, exist_ok=True)
+        self.base = p["base"]
+        self.prefix = p["sscs_prefix"]
+        self.paths = p["sscs"]
+        level = int(spec.get("compress_level", 6))
+        self.reader = ColumnarReader(spec["input"])
+        header = self.reader.header
+        self.bad_writer = BamWriter(self.paths["bad"], header, atomic=True)
+        self.sscs_writer = SortingBamWriter(self.paths["sscs"], header, level=level)
+        self.singleton_writer = SortingBamWriter(
+            self.paths["singleton"], header, level=level)
+        self.rec_writer = ConsensusRecordWriter(self.sscs_writer)
+        self.stats = StageStats("SSCS")
+        self.hist = FamilySizeHistogram()
+        self.tracker = TimeTracker()
+        self.cum = Counters()
+        self.pending: dict[int, tuple] = {}
+        self.source = stream_families_columnar(
+            self.reader, header, spec.get("bdelim", "|"))
+
+    def events(self, job_idx: int):
+        """Yield ``((job_idx, fid), seqs, quals)`` consensus work items;
+        route bad reads and singletons inline (same accounting as the
+        one-shot ``run_sscs`` events loop)."""
+        from consensuscruncher_tpu.stages.sscs_maker import (
+            _member_arrays, write_singleton,
+        )
+
+        next_id = 0
+        for kind, a, b in self.source:
+            if kind == "bad":
+                self.stats.incr("total_reads")
+                self.stats.incr(f"bad_{b}")
+                self.stats.incr("bad_reads")
+                self.bad_writer.write(a)
+                continue
+            tag, members = a, b
+            self.stats.incr("total_reads", len(members))
+            self.hist.add(len(members))
+            self.stats.incr("families")
+            if len(members) == 1:
+                self.stats.incr("singletons")
+                write_singleton(self.singleton_writer, tag, members)
+                continue
+            seqs, quals = _member_arrays(members)
+            self.pending[next_id] = (tag, members)
+            self.cum.add("families_in")
+            yield (job_idx, next_id), seqs, quals
+            next_id += 1
+
+    def emit(self, fid: int, codes, quals) -> None:
+        from consensuscruncher_tpu.stages.sscs_maker import emit_consensus
+
+        tag, members = self.pending.pop(fid)
+        emit_consensus(self.rec_writer, self.sscs_writer, tag, members, codes, quals)
+        self.stats.incr("sscs_written")
+
+    def seal(self) -> None:
+        self.rec_writer.flush()
+
+    def abort(self) -> None:
+        for w in (self.bad_writer, self.sscs_writer, self.singleton_writer):
+            w.abort()
+
+    def close_outputs(self) -> None:
+        self.tracker.mark("consensus")
+        self.bad_writer.close()
+        self.sscs_writer.close()
+        self.singleton_writer.close()
+        self.tracker.mark("sort")
+
+    def record(self, cutoff: float, qual_threshold: int, backend: str) -> None:
+        """Stats sidecars + the manifest "sscs" entry, mirroring the
+        one-shot CLI byte-for-byte so ``--resume`` skips the stage."""
+        from consensuscruncher_tpu.utils.backend_probe import record_backend
+        from consensuscruncher_tpu.utils.manifest import RunManifest
+        from consensuscruncher_tpu.utils.profiling import write_metrics
+
+        record_backend(self.stats, backend)
+        jax_backend = self.stats.get("jax_backend")
+        self.stats.set("cutoff", cutoff)
+        self.stats.write(self.paths["stats_txt"])
+        self.hist.write(self.paths["families"])
+        self.tracker.write(self.paths["time_tracker"])
+        self.cum.add("families_out", self.stats.get("sscs_written"))
+        write_metrics(
+            f"{self.prefix}.metrics.json", "SSCS", self.tracker.as_phases(),
+            {"backend": backend, "jax_backend": jax_backend,
+             "n_families": self.stats.get("families"),
+             "n_reads": self.stats.get("total_reads")},
+            cumulative=self.cum.snapshot(),
+        )
+        manifest = RunManifest(os.path.join(self.base, "manifest.json"))
+        manifest.record(
+            "sscs", [self.spec["input"]],
+            [self.paths[k] for k in
+             ("sscs", "singleton", "stats_txt", "stats_json", "families")],
+            {"cutoff": float(self.spec.get("cutoff", 0.7)),
+             "qualscore": int(self.spec.get("qualscore", 0)),
+             "bdelim": self.spec.get("bdelim", "|"),
+             "input_range": None},
+        )
+
+
+def gang_sscs(specs: list[dict], counters: Counters | None = None,
+              max_batch: int = 1024) -> None:
+    """Run the SSCS stage for several jobs as ONE merged device stream.
+
+    Families from every job are interleaved round-robin into a single
+    ``consensus_families`` call (dense wire) keyed ``(job_idx, fid)``; the
+    results demux back to per-job writers.  Records each job's manifest
+    entry on success; aborts every job's writers on failure (no partial
+    outputs — the caller retries jobs solo via resume).
+    """
+    from consensuscruncher_tpu.ops.consensus_tpu import (
+        ConsensusConfig, consensus_families,
+    )
+    from consensuscruncher_tpu.parallel.batching import interleave_sources
+
+    cutoff = float(specs[0].get("cutoff", 0.7))
+    qualscore = int(specs[0].get("qualscore", 0))
+    for s in specs[1:]:
+        if (float(s.get("cutoff", 0.7)), int(s.get("qualscore", 0))) != (cutoff, qualscore):
+            raise ValueError("gang jobs must share cutoff/qualscore")
+    cfg = ConsensusConfig(cutoff=cutoff, qual_threshold=qualscore)
+
+    states = [_GangJobState(s) for s in specs]
+
+    def on_batch(batch):
+        if counters is not None:
+            counters.add("batches_dispatched")
+
+    ok = False
+    try:
+        stream = consensus_families(
+            interleave_sources([st.events(i) for i, st in enumerate(states)]),
+            cfg, max_batch=max_batch, on_batch=on_batch,
+        )
+        try:
+            for (ji, fid), codes, quals in stream:
+                states[ji].emit(fid, codes, quals)
+        finally:
+            # join the prefetch producer (it writes to the per-job writers)
+            # BEFORE the writers are closed/aborted below
+            stream.close()
+        for st in states:
+            st.seal()
+        ok = True
+    finally:
+        for st in states:
+            st.reader.close()
+        if not ok:
+            for st in states:
+                st.abort()
+    for st in states:
+        st.close_outputs()
+        st.record(cutoff, qualscore, "tpu")
+
+
+class Scheduler:
+    """Bounded job queue + single dispatcher thread (see module docstring).
+
+    ``queue_bound`` caps ADMITTED-but-unfinished work: submit refuses when
+    the queue is full (backpressure to the client, never OOM).
+    ``gang_size`` caps how many compatible jobs one dispatch round merges.
+    ``paused`` holds dispatch so tests can pile up a gang deterministically.
+    """
+
+    def __init__(self, queue_bound: int = 16, gang_size: int = 4,
+                 backend: str = "tpu", max_batch: int = 1024,
+                 start: bool = True, paused: bool = False):
+        self.queue_bound = int(queue_bound)
+        self.gang_size = max(1, int(gang_size))
+        self.backend = backend
+        self.max_batch = int(max_batch)
+        self.counters = Counters()
+        self._cond = threading.Condition()
+        self._queue: deque[Job] = deque()
+        self._jobs: dict[int, Job] = {}
+        self._running: list[Job] = []
+        self._draining = False
+        self._paused = bool(paused)
+        self._stop = False
+        self._started_at = time.time()
+        self._thread = threading.Thread(
+            target=self._loop, name="serve-dispatcher", daemon=True)
+        if start:
+            self._thread.start()
+
+    # ----------------------------------------------------------- admission
+
+    def submit(self, spec: dict) -> Job:
+        for req in ("input", "output"):
+            if not spec.get(req):
+                raise ValueError(f"job spec missing {req!r}")
+        with self._cond:
+            if self._draining:
+                raise AdmissionRefused("server is draining; not accepting jobs")
+            if len(self._queue) >= self.queue_bound:
+                raise AdmissionRefused(
+                    f"queue full ({len(self._queue)}/{self.queue_bound})")
+            job = Job(spec)
+            self._queue.append(job)
+            self._jobs[job.id] = job
+            self.counters.high_water("queue_depth_hwm", len(self._queue))
+            self._cond.notify_all()
+        return job
+
+    def get(self, job_id: int) -> Job | None:
+        return self._jobs.get(int(job_id))
+
+    def wait(self, job_id: int, timeout: float | None = None) -> Job:
+        """Block until the job reaches a terminal state (or timeout)."""
+        job = self._jobs[int(job_id)]
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while job.state not in ("done", "failed"):
+                remaining = None
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise TimeoutError(f"job {job.id} still {job.state}")
+                self._cond.wait(timeout=remaining)
+        return job
+
+    # ----------------------------------------------------- test/drain hooks
+
+    def pause(self) -> None:
+        with self._cond:
+            self._paused = True
+
+    def release(self) -> None:
+        with self._cond:
+            self._paused = False
+            self._cond.notify_all()
+
+    def drain(self, timeout: float | None = None) -> None:
+        """Stop admitting; block until queued + running work finishes."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            self._draining = True
+            self._paused = False
+            self._cond.notify_all()
+            while self._queue or self._running:
+                remaining = None
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise TimeoutError("drain timed out")
+                self._cond.wait(timeout=remaining)
+
+    def close(self, timeout: float | None = 60.0) -> None:
+        try:
+            self.drain(timeout=timeout)
+        finally:
+            with self._cond:
+                self._stop = True
+                self._cond.notify_all()
+            if self._thread.is_alive():
+                self._thread.join(timeout=5.0)
+
+    # ------------------------------------------------------------- metrics
+
+    def metrics(self) -> dict:
+        with self._cond:
+            jobs = [j.describe() for j in self._jobs.values()]
+            states = {s: sum(1 for j in self._jobs.values() if j.state == s)
+                      for s in _STATES}
+            doc = metrics_doc(
+                "serve", {"uptime": time.time() - self._started_at},
+                {"n_jobs": len(jobs), "queue_bound": self.queue_bound,
+                 "gang_size": self.gang_size, "draining": self._draining,
+                 "jobs_by_state": states},
+                cumulative=self.counters.snapshot(),
+            )
+            doc["jobs"] = jobs
+            return doc
+
+    def healthz(self) -> dict:
+        with self._cond:
+            return {
+                "status": "draining" if self._draining else "serving",
+                "queued": len(self._queue), "running": len(self._running),
+                "uptime_s": round(time.time() - self._started_at, 3),
+            }
+
+    # ----------------------------------------------------------- dispatcher
+
+    def _pop_gang(self) -> list[Job]:
+        """Pop up to ``gang_size`` queued jobs sharing the compile-time
+        consensus parameters (cutoff/qualscore).  Called under the lock."""
+        gang = [self._queue.popleft()]
+        key = (float(gang[0].spec.get("cutoff", 0.7)),
+               int(gang[0].spec.get("qualscore", 0)))
+        kept = deque()
+        while self._queue and len(gang) < self.gang_size:
+            job = self._queue.popleft()
+            jkey = (float(job.spec.get("cutoff", 0.7)),
+                    int(job.spec.get("qualscore", 0)))
+            if jkey == key:
+                gang.append(job)
+            else:
+                kept.append(job)
+        self._queue.extendleft(reversed(kept))
+        return gang
+
+    def _loop(self) -> None:
+        while True:
+            with self._cond:
+                while not self._stop and (self._paused or not self._queue):
+                    self._cond.wait()
+                if self._stop:
+                    return
+                gang = self._pop_gang()
+                for job in gang:
+                    job.state = "running"
+                    job.gang_size = len(gang)
+                self._running = list(gang)
+                self._cond.notify_all()
+            try:
+                self._run_gang(gang)
+            finally:
+                with self._cond:
+                    self._running = []
+                    self._cond.notify_all()
+
+    def _run_gang(self, gang: list[Job]) -> None:
+        t0 = time.monotonic()
+        if len(gang) > 1:
+            try:
+                faults.fault_point("serve.dispatch")
+                gang_sscs([j.spec for j in gang], self.counters,
+                          max_batch=self.max_batch)
+            except Exception as e:
+                # Gang failure granularity is the gang: fall back to solo
+                # runs — each job's resume path re-runs whatever its own
+                # (atomically committed) outputs can't prove done.
+                print(f"WARNING: serve gang dispatch failed ({e}); "
+                      "running jobs solo", file=sys.stderr, flush=True)
+        for job in gang:
+            jt0 = t0 if len(gang) > 1 else time.monotonic()
+            try:
+                self._run_job(job)
+                outcome = "done"
+            except Exception as e:
+                job.error = f"{type(e).__name__}: {e}"
+                outcome = "failed"
+            if outcome == "done":
+                self.aggregate_job_metrics(job)
+            with self._cond:
+                # gang jobs count from dispatch start: the shared SSCS wall
+                # belongs to every member's end-to-end latency
+                job.wall_s = round(time.monotonic() - jt0, 6)
+                job.state = outcome
+                self._cond.notify_all()
+
+    def _argv(self, spec: dict, resume: bool) -> list[str]:
+        argv = [
+            "consensus",
+            "--input", spec["input"],
+            "--output", spec["output"],
+            "--cutoff", repr(float(spec.get("cutoff", 0.7))),
+            "--qualscore", str(int(spec.get("qualscore", 0))),
+            "--scorrect", str(bool(spec.get("scorrect", True))),
+            "--max_mismatch", str(int(spec.get("max_mismatch", 0))),
+            "--backend", self.backend,
+            "--bdelim", spec.get("bdelim", "|"),
+            "--compress_level", str(int(spec.get("compress_level", 6))),
+        ]
+        if spec.get("name"):
+            argv += ["--name", spec["name"]]
+        if resume:
+            argv += ["--resume", "True"]
+        return argv
+
+    def _run_job(self, job: Job) -> None:
+        """Finish one job via the one-shot CLI with ``--resume`` (skips any
+        stage the gang already recorded), retried with backoff on failure.
+        The ``serve.worker`` fault site fires at each attempt's top."""
+        from consensuscruncher_tpu import cli
+
+        attempts = int(os.environ.get("CCT_SERVE_RETRIES", "1")) + 1
+        base = float(os.environ.get("CCT_RETRY_BASE_S", "0.5"))
+        argv = self._argv(job.spec, resume=True)
+        for attempt in range(attempts):
+            job.attempts += 1
+            try:
+                faults.fault_point("serve.worker")
+                rc = cli.main(argv)
+                if rc not in (0, None):
+                    raise RuntimeError(f"consensus exited rc={rc}")
+                job.outputs = {"base": job_paths(job.spec)["base"]}
+                return
+            except Exception as e:
+                if attempt + 1 >= attempts:
+                    raise
+                self.counters.add("retries_fired")
+                delay = faults.backoff_delay(attempt + 1, base, 30.0)
+                print(f"WARNING: serve job {job.id} attempt "
+                      f"{attempt + 1}/{attempts} failed ({e}); retrying via "
+                      f"--resume in {delay:.1f}s", file=sys.stderr, flush=True)
+                time.sleep(delay)
+
+    def aggregate_job_metrics(self, job: Job) -> None:
+        """Fold a finished job's per-stage metrics sidecar into the daemon
+        counters — the one-shot CLI and the daemon share one schema, so
+        aggregation is literally reading the stage's own cumulative block."""
+        sidecar = f"{job_paths(job.spec)['sscs_prefix']}.metrics.json"
+        try:
+            with open(sidecar) as fh:
+                cum = json.load(fh).get("cumulative", {})
+        except (OSError, ValueError):
+            return
+        for key in ("families_in", "families_out", "batches_dispatched"):
+            self.counters.add(key, int(cum.get(key, 0)))

@@ -1,0 +1,172 @@
+"""The daemon's wire front-end: newline-delimited JSON over a socket.
+
+One request per line, one JSON reply per line; a connection may carry any
+number of requests (the blocking ``result`` op holds its line open until
+the job finishes).  Transport is either a unix socket (``socket_path``) or
+localhost TCP — both are single-host by design: the daemon is a *device
+host* process, remote fan-in belongs to a reverse proxy.
+
+Ops (all replies carry ``"ok"``):
+
+  {"op": "submit", "spec": {...}}       -> {"ok": true, "job_id": N}
+  {"op": "status", "job_id": N}         -> {"ok": true, "job": {...}}
+  {"op": "result", "job_id": N,
+   "timeout": seconds|null}             -> blocks; {"ok": true, "job": {...}}
+  {"op": "healthz"}                     -> {"ok": true, "health": {...}}
+  {"op": "metrics"}                     -> {"ok": true, "metrics": {...}}
+  {"op": "drain", "timeout": s|null}    -> blocks; {"ok": true, "drained": true}
+
+Errors reply ``{"ok": false, "error": "..."}`` and keep the connection
+usable; a malformed line closes the connection.  The ``serve.accept``
+fault site fires per accepted connection (chaos tests turn accept-path
+failures into clean error replies, never daemon death).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import sys
+import threading
+
+from consensuscruncher_tpu.serve.scheduler import AdmissionRefused, Scheduler
+from consensuscruncher_tpu.utils import faults
+
+MAX_LINE = 1 << 20  # 1 MiB per request line; specs are tiny
+
+
+class ServeServer:
+    """Accept loop + per-connection handler threads over a Scheduler."""
+
+    def __init__(self, scheduler: Scheduler, host: str = "127.0.0.1",
+                 port: int = 0, socket_path: str | None = None):
+        self.scheduler = scheduler
+        self.socket_path = socket_path
+        if socket_path:
+            if os.path.exists(socket_path):
+                os.unlink(socket_path)  # stale socket from a dead daemon
+            self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            self._sock.bind(socket_path)
+            self.address: object = socket_path
+        else:
+            self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            self._sock.bind((host, port))
+            self.address = self._sock.getsockname()  # (host, real port)
+        self._sock.listen(16)
+        self._closed = False
+        self._accept_thread: threading.Thread | None = None
+
+    def describe(self) -> str:
+        if self.socket_path:
+            return f"unix:{self.socket_path}"
+        host, port = self.address
+        return f"tcp:{host}:{port}"
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> None:
+        """Run the accept loop on a background thread (tests, embedding)."""
+        self._accept_thread = threading.Thread(
+            target=self.serve_forever, name="serve-accept", daemon=True)
+        self._accept_thread.start()
+
+    def serve_forever(self) -> None:
+        while not self._closed:
+            try:
+                conn, _addr = self._sock.accept()
+            except OSError:
+                return  # socket closed under us: clean shutdown
+            t = threading.Thread(
+                target=self._handle_conn, args=(conn,), daemon=True)
+            t.start()
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._sock.close()
+        finally:
+            if self.socket_path and os.path.exists(self.socket_path):
+                try:
+                    os.unlink(self.socket_path)
+                except OSError:
+                    pass
+
+    # ----------------------------------------------------------- connection
+
+    def _handle_conn(self, conn: socket.socket) -> None:
+        try:
+            faults.fault_point("serve.accept")
+        except faults.FaultError as e:
+            self._reply(conn, {"ok": False, "error": str(e)})
+            conn.close()
+            return
+        try:
+            buf = b""
+            while True:
+                chunk = conn.recv(65536)
+                if not chunk:
+                    return
+                buf += chunk
+                if len(buf) > MAX_LINE:
+                    self._reply(conn, {"ok": False, "error": "request too large"})
+                    return
+                while b"\n" in buf:
+                    line, buf = buf.split(b"\n", 1)
+                    if not line.strip():
+                        continue
+                    try:
+                        req = json.loads(line)
+                    except ValueError:
+                        self._reply(conn, {"ok": False, "error": "bad JSON"})
+                        return
+                    self._reply(conn, self._dispatch(req))
+        except (OSError, BrokenPipeError):
+            pass  # client went away mid-exchange; nothing to clean up
+        finally:
+            conn.close()
+
+    @staticmethod
+    def _reply(conn: socket.socket, doc: dict) -> None:
+        try:
+            conn.sendall(json.dumps(doc).encode() + b"\n")
+        except (OSError, BrokenPipeError):
+            pass
+
+    # ------------------------------------------------------------- dispatch
+
+    def _dispatch(self, req: dict) -> dict:
+        if not isinstance(req, dict):
+            return {"ok": False, "error": "request must be a JSON object"}
+        op = req.get("op")
+        try:
+            if op == "submit":
+                job = self.scheduler.submit(req.get("spec") or {})
+                return {"ok": True, "job_id": job.id, "state": job.state}
+            if op == "status":
+                job = self.scheduler.get(req.get("job_id", -1))
+                if job is None:
+                    return {"ok": False, "error": "unknown job_id"}
+                return {"ok": True, "job": job.describe()}
+            if op == "result":
+                if self.scheduler.get(req.get("job_id", -1)) is None:
+                    return {"ok": False, "error": "unknown job_id"}
+                job = self.scheduler.wait(req["job_id"], timeout=req.get("timeout"))
+                return {"ok": True, "job": job.describe()}
+            if op == "healthz":
+                return {"ok": True, "health": self.scheduler.healthz()}
+            if op == "metrics":
+                return {"ok": True, "metrics": self.scheduler.metrics()}
+            if op == "drain":
+                self.scheduler.drain(timeout=req.get("timeout"))
+                return {"ok": True, "drained": True}
+            return {"ok": False, "error": f"unknown op {op!r}"}
+        except AdmissionRefused as e:
+            return {"ok": False, "error": str(e), "refused": True}
+        except TimeoutError as e:
+            return {"ok": False, "error": str(e), "timeout": True}
+        except Exception as e:  # surface, never kill the daemon
+            print(f"WARNING: serve op {op!r} failed: {e}",
+                  file=sys.stderr, flush=True)
+            return {"ok": False, "error": f"{type(e).__name__}: {e}"}

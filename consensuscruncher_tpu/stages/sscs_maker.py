@@ -50,7 +50,7 @@ from consensuscruncher_tpu.ops.consensus_tpu import ConsensusConfig, consensus_f
 from consensuscruncher_tpu.parallel.batching import rectangularize
 from consensuscruncher_tpu.stages.grouping import stream_families
 from consensuscruncher_tpu.utils.backend_probe import record_backend
-from consensuscruncher_tpu.utils.profiling import write_metrics
+from consensuscruncher_tpu.utils.profiling import Counters, write_metrics
 from consensuscruncher_tpu.utils.stats import FamilySizeHistogram, StageStats, TimeTracker
 
 
@@ -122,6 +122,54 @@ def prestage_blocks(in_bam: str, bdelim: str = tags_mod.DEFAULT_BDELIM,
     return PrestagedBlocks(reader.header, reader, events)
 
 
+def write_singleton(singleton_writer, tag, members) -> None:
+    """Route a size-1 family: rename to the consensus qname, preserve the
+    barcode in ``XT``/``XF`` tags.  Shared by the one-shot stage and the
+    serve/ gang path so singleton bytes stay identical by construction."""
+    out = members[0].materialize()  # BamRead: identity
+    out.qname = tags_mod.sscs_qname(tag)
+    out.tags = dict(out.tags)
+    out.tags["XT"] = ("Z", tag.barcode)
+    out.tags["XF"] = ("i", 1)
+    singleton_writer.write(out)
+
+
+def emit_consensus(rec_writer, sscs_writer, tag, members, codes, quals) -> None:
+    """Encode one consensus read (columnar fast path or BamRead fallback).
+    Shared by the one-shot stage and the serve/ gang path — consensus
+    record bytes are produced by exactly one code path."""
+    t = members[0]
+    if isinstance(t, MemberView):
+        # Columnar fast path: identical record bytes to
+        # build_consensus_read + encode_record, built column-wise.
+        L = codes.shape[0]
+        cand = [m for m in members if m.seq_len == L]
+        first = cand[0].cigar_bytes() if cand else None
+        if first is not None and all(
+            np.array_equal(m.cigar_bytes(), first) for m in cand[1:]
+        ):
+            # np.array copy: a zero-copy view would pin the whole source
+            # batch buffer inside the record writer until its next flush
+            words = np.array(np.ascontiguousarray(first).view("<u4"))
+        else:  # mixed cigars / all-truncated: exact modal_cigar semantics
+            words = cigar_string_to_words(modal_cigar(members, L))
+        tag_blob = (
+            b"XTZ" + tag.barcode.encode("ascii")
+            + b"\x00XFi" + struct.pack("<i", len(members))
+        )
+        rec_writer.add(
+            tags_mod.sscs_qname(tag), t.flag & _KEEP_FLAGS, t.rid, t.pos,
+            max(m.mapq for m in members), words, t.mrid, t.mate_pos,
+            t.tlen, codes, quals, tag_blob,
+        )
+    else:
+        read = build_consensus_read(
+            tag, members, codes, quals, qname=tags_mod.sscs_qname(tag),
+            extra_tags={"XT": ("Z", tag.barcode)},
+        )
+        sscs_writer.write(read)
+
+
 def _member_arrays(members):
     seqs, quals = [], []
     for m in members:
@@ -187,6 +235,7 @@ def run_sscs(
     tracker = TimeTracker()
     stats = StageStats("SSCS")
     hist = FamilySizeHistogram()
+    cum = Counters()
     cfg = ConsensusConfig(cutoff=cutoff, qual_threshold=qual_threshold, qual_cap=qual_cap)
 
     paths = output_paths(out_prefix)
@@ -255,12 +304,7 @@ def run_sscs(
             stats.incr("families")
             if len(members) == 1:
                 stats.incr("singletons")
-                out = members[0].materialize()  # BamRead: identity
-                out.qname = tags_mod.sscs_qname(tag)
-                out.tags = dict(out.tags)
-                out.tags["XT"] = ("Z", tag.barcode)
-                out.tags["XF"] = ("i", 1)
-                singleton_writer.write(out)
+                write_singleton(singleton_writer, tag, members)
                 continue
             seqs, quals = _member_arrays(members)
             pending[next_id] = (tag, members)
@@ -367,36 +411,7 @@ def run_sscs(
 
     def emit(fid, codes, quals):
         tag, members = pending.pop(fid)
-        t = members[0]
-        if isinstance(t, MemberView):
-            # Columnar fast path: identical record bytes to
-            # build_consensus_read + encode_record, built column-wise.
-            L = codes.shape[0]
-            cand = [m for m in members if m.seq_len == L]
-            first = cand[0].cigar_bytes() if cand else None
-            if first is not None and all(
-                np.array_equal(m.cigar_bytes(), first) for m in cand[1:]
-            ):
-                # np.array copy: a zero-copy view would pin the whole source
-                # batch buffer inside the record writer until its next flush
-                words = np.array(np.ascontiguousarray(first).view("<u4"))
-            else:  # mixed cigars / all-truncated: exact modal_cigar semantics
-                words = cigar_string_to_words(modal_cigar(members, L))
-            tag_blob = (
-                b"XTZ" + tag.barcode.encode("ascii")
-                + b"\x00XFi" + struct.pack("<i", len(members))
-            )
-            rec_writer.add(
-                tags_mod.sscs_qname(tag), t.flag & _KEEP_FLAGS, t.rid, t.pos,
-                max(m.mapq for m in members), words, t.mrid, t.mate_pos,
-                t.tlen, codes, quals, tag_blob,
-            )
-        else:
-            read = build_consensus_read(
-                tag, members, codes, quals, qname=tags_mod.sscs_qname(tag),
-                extra_tags={"XT": ("Z", tag.barcode)},
-            )
-            sscs_writer.write(read)
+        emit_consensus(rec_writer, sscs_writer, tag, members, codes, quals)
         stats.incr("sscs_written")
 
     ok = False
@@ -415,6 +430,8 @@ def run_sscs(
                 )
                 try:
                     for keys, lengths, out_b, out_q in stream:
+                        cum.add("batches_dispatched")
+                        cum.add("families_in", len(keys))
                         emit_batch(keys, lengths, out_b, out_q)
                 finally:
                     # Must run BEFORE the writers close below: closing the
@@ -424,7 +441,13 @@ def run_sscs(
                     # would race w.abort() against in-flight writes.
                     stream.close()
             else:
-                stream = consensus_families(events(), cfg, max_batch=max_batch, mesh=mesh)
+                def on_batch(batch):
+                    cum.add("batches_dispatched")
+                    cum.add("families_in", batch.n_real)
+
+                stream = consensus_families(
+                    events(), cfg, max_batch=max_batch, mesh=mesh, on_batch=on_batch
+                )
                 try:
                     for fid, codes, quals in stream:
                         emit(fid, codes, quals)
@@ -444,6 +467,7 @@ def run_sscs(
             else:
                 vote = consensus_maker_numpy
             for fid, seqs, quals in events():
+                cum.add("families_in")
                 rect_s, rect_q, _ = rectangularize(seqs, quals)
                 codes, cquals = vote(
                     rect_s, rect_q, cutoff=cutoff, qual_threshold=qual_threshold, qual_cap=qual_cap
@@ -474,11 +498,13 @@ def run_sscs(
     stats.write(paths["stats_txt"])
     hist.write(paths["families"])
     tracker.write(paths["time_tracker"])
+    cum.add("families_out", stats.get("sscs_written"))
     write_metrics(
         f"{out_prefix}.metrics.json", "SSCS", tracker.as_phases(),
         {"backend": backend, "jax_backend": jax_backend,
          "n_families": stats.get("families"),
          "n_reads": stats.get("total_reads")},
+        cumulative=cum.snapshot(),
     )
     return SscsResult(sscs_path, singleton_path, bad_path, stats, hist)
 

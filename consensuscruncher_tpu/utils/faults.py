@@ -39,6 +39,9 @@ Sites wired in this codebase (grep for ``fault_point``/``faults.hook``):
   sscs.midstage        crash/SIGTERM inside the SSCS loop (atomicity proof)
   dcs.midstage         crash/SIGTERM inside the DCS loop (atomicity proof)
   watch.job            TPU watcher row job nonzero rc -> retry + backoff
+  serve.accept         daemon connection accept/handling -> error reply
+  serve.dispatch       scheduler gang dispatch -> jobs retried solo
+  serve.worker         per-job worker execution -> retry via --resume
 
 Everything here is stdlib-only and import-cheap: io/bgzf.py and the
 tools/ scripts (whose parents must never import jax) both import it.

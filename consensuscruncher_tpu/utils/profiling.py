@@ -17,7 +17,46 @@ for parity (``utils.stats.TimeTracker``) and adds the TPU-era pieces:
 from __future__ import annotations
 
 import json
+import threading
 from contextlib import contextmanager
+
+#: One shared counter schema for the one-shot CLI metrics sidecars and the
+#: serve daemon's ``metrics`` endpoint.  Missing keys default to 0 so readers
+#: can rely on the full set being present wherever ``cumulative`` appears.
+CUMULATIVE_KEYS = (
+    "families_in",        # families admitted to the vote kernels
+    "families_out",       # consensus results emitted back to writers
+    "batches_dispatched",  # device dispatches (bucketed batches)
+    "retries_fired",      # job/stage retries triggered by faults
+    "queue_depth_hwm",    # high-water mark of the job queue depth
+)
+
+
+class Counters:
+    """Thread-safe cumulative counters over :data:`CUMULATIVE_KEYS`.
+
+    ``add`` accumulates, ``high_water`` keeps a running max (for gauges like
+    queue depth), ``snapshot`` returns a plain dict with every key present.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._values = {k: 0 for k in CUMULATIVE_KEYS}
+
+    def add(self, key: str, amount: int = 1) -> None:
+        with self._lock:
+            self._values[key] = self._values.get(key, 0) + int(amount)
+
+    def high_water(self, key: str, value: int) -> None:
+        with self._lock:
+            if int(value) > self._values.get(key, 0):
+                self._values[key] = int(value)
+
+    def snapshot(self) -> dict[str, int]:
+        with self._lock:
+            out = {k: 0 for k in CUMULATIVE_KEYS}
+            out.update(self._values)
+            return out
 
 
 @contextmanager
@@ -33,11 +72,13 @@ def maybe_profile(trace_dir: str | None):
         yield
 
 
-def write_metrics(path, stage: str, phases: dict[str, float],
-                  counters: dict[str, object]) -> None:
-    """Structured metrics sidecar: ``{stage, phases_s, **counters}`` plus
-    derived ``<unit>_per_sec`` rates for any counter named ``n_<unit>``
-    against the total phase time."""
+def metrics_doc(stage: str, phases: dict[str, float],
+                counters: dict[str, object],
+                cumulative: dict[str, int] | None = None) -> dict[str, object]:
+    """The metrics document shared by stage sidecars and the serve daemon's
+    ``metrics`` endpoint: ``{stage, phases_s, total_s, **counters}`` plus
+    derived ``<unit>_per_sec`` rates for any counter named ``n_<unit>``, and
+    a ``cumulative`` block normalised over :data:`CUMULATIVE_KEYS`."""
     total = sum(phases.values())
     doc: dict[str, object] = {"stage": stage, "phases_s": {
         k: round(v, 6) for k, v in phases.items()
@@ -47,6 +88,18 @@ def write_metrics(path, stage: str, phases: dict[str, float],
         for key, value in counters.items():
             if key.startswith("n_") and isinstance(value, (int, float)):
                 doc[f"{key[2:]}_per_sec"] = round(value / total, 2)
+    if cumulative is not None:
+        block = {k: 0 for k in CUMULATIVE_KEYS}
+        block.update({k: int(v) for k, v in cumulative.items()})
+        doc["cumulative"] = block
+    return doc
+
+
+def write_metrics(path, stage: str, phases: dict[str, float],
+                  counters: dict[str, object],
+                  cumulative: dict[str, int] | None = None) -> None:
+    """Write :func:`metrics_doc` as an indented-JSON sidecar."""
+    doc = metrics_doc(stage, phases, counters, cumulative=cumulative)
     with open(path, "w") as fh:
         json.dump(doc, fh, indent=2, sort_keys=True)
         fh.write("\n")

@@ -33,3 +33,11 @@ jax.config.update("jax_platforms", "cpu")
 _xb._backend_factories.pop("axon", None)
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running chaos/e2e variants, excluded from the tier-1 "
+        "run via -m 'not slow'",
+    )

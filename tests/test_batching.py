@@ -57,6 +57,59 @@ def test_deterministic_flush_order():
     assert b1 == b2  # flush order sorted by bucket -> reproducible output order
 
 
+def test_interleave_sources_round_robin():
+    order = list(batching.interleave_sources(
+        [["a0", "a1", "a2"], ["b0"], ["c0", "c1"]]))
+    assert order == ["a0", "b0", "c0", "a1", "c1", "a2"]
+    assert list(batching.interleave_sources([])) == []
+    assert list(batching.interleave_sources([[], ["x"]])) == ["x"]
+
+
+def _packed_families(fams, max_batch=8):
+    """Per-key packed content a device batch would carry: true family size,
+    padded length bucket, and the exact trimmed base/qual bytes."""
+    out = {}
+    for b in batching.bucket_families(iter(fams), max_batch=max_batch):
+        for i, key in enumerate(b.keys):
+            n = int(b.fam_sizes[i])
+            out[key] = (n, int(b.lengths[i]), b.bases.shape[2],
+                        b.bases[i, :n].tobytes(), b.quals[i, :n].tobytes())
+    return out
+
+
+def _packed_members(fams, max_batch=8):
+    out = {}
+    for b in batching.bucket_members(iter(fams), max_batch=max_batch):
+        off = 0
+        for i, key in enumerate(b.keys[: b.n_real]):
+            n = int(b.sizes[i])
+            out[key] = (n, int(b.lengths[i]), b.rows.shape[1],
+                        b.rows[off:off + n].tobytes(),
+                        b.qrows[off:off + n].tobytes())
+            off += n
+    return out
+
+
+@pytest.mark.parametrize("packed", [_packed_families, _packed_members])
+def test_two_source_interleaving_is_content_deterministic(packed):
+    """Continuous batching invariant (serve/ gang dispatch): merging family
+    streams from several jobs changes batch COMPOSITION but must never
+    change any family's packed content — the vote input is source-local.
+    Both interleaving orders must equal solo packing, every key exactly
+    once."""
+    src_a = [mk_fam(("a", i), 3 + (i % 2), 100, seed=i) for i in range(6)]
+    src_b = [mk_fam(("b", i), 5, 60, seed=100 + i) for i in range(4)]
+
+    solo = packed(src_a)
+    solo.update(packed(src_b))
+    ab = packed(list(batching.interleave_sources([src_a, src_b])))
+    ba = packed(list(batching.interleave_sources([src_b, src_a])))
+
+    assert len(ab) == len(src_a) + len(src_b)  # every key exactly once
+    assert ab == solo
+    assert ba == solo
+
+
 def test_bucket_member_blocks_size_classes(tmp_path):
     """Block-path bucketing splits each length bucket by pow2 family-size
     class: every emitted batch holds exactly one class (so the gather-dense

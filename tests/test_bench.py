@@ -55,6 +55,36 @@ def test_bench_main_one_json_line_when_tpu_dead():
     assert data["code_path"] == "tpu" and data["jax_backend"] == "cpu"
 
 
+def test_bench_metric_line_is_final_stdout_line_even_with_merged_streams():
+    """Driver contract: the metric JSON is the LAST stdout line no matter
+    what else the run prints.  Merging stderr into stdout simulates the
+    harness capturing one interleaved stream — all diagnostics must land
+    BEFORE the metric line (bench flushes stderr, then emits the line as
+    its final act, with every other print redirected off stdout)."""
+    env = dict(os.environ)
+    env.update({
+        "PALLAS_AXON_POOL_IPS": "",
+        "CCT_BENCH_FRAGMENTS": "120",
+        "CCT_BENCH_REF_FRAGMENTS": "30",
+        "CCT_BENCH_PROBE_TIMEOUT": "3",
+        "CCT_BENCH_PROBE_ATTEMPTS": "1",
+        "CCT_BENCH_CPU_TIMEOUT": "300",
+    })
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        timeout=560, env=env, cwd=REPO,
+    )
+    assert proc.returncode == 0
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln.strip()]
+    data = json.loads(lines[-1])  # the final line parses as the metric
+    assert data["metric"] == "sscs_dcs_stage_families_per_sec"
+    assert data["value"] > 0
+    # any diagnostics the run did emit landed strictly before the metric
+    for ln in lines[:-1]:
+        assert '"metric"' not in ln, f"metric line not final: {ln[:80]}"
+
+
 def test_bench_kernels_mode_parses():
     proc = _run_bench(
         {

@@ -5,7 +5,9 @@ import os
 
 import numpy as np
 
-from consensuscruncher_tpu.utils.profiling import maybe_profile, write_metrics
+from consensuscruncher_tpu.utils.profiling import (
+    CUMULATIVE_KEYS, Counters, maybe_profile, metrics_doc, write_metrics,
+)
 
 
 def test_maybe_profile_noop():
@@ -35,6 +37,56 @@ def test_write_metrics_rates(tmp_path):
     assert doc["families_per_sec"] == 250.0
     assert doc["reads_per_sec"] == 1000.0
     assert doc["backend"] == "tpu"
+
+
+def test_counters_add_high_water_snapshot():
+    c = Counters()
+    c.add("families_in")
+    c.add("families_in", 9)
+    c.high_water("queue_depth_hwm", 3)
+    c.high_water("queue_depth_hwm", 2)  # lower: must not regress
+    snap = c.snapshot()
+    assert set(snap) == set(CUMULATIVE_KEYS)  # full shared schema, always
+    assert snap["families_in"] == 10
+    assert snap["queue_depth_hwm"] == 3
+    assert snap["retries_fired"] == 0
+    snap["families_in"] = 999  # snapshot is a copy
+    assert c.snapshot()["families_in"] == 10
+
+
+def test_cumulative_block_shared_schema(tmp_path):
+    """Daemon and one-shot CLI share ONE cumulative schema: every key is
+    present (zeroed when unreported) so aggregators never need .get()."""
+    doc = metrics_doc("serve", {"uptime": 1.0}, {"n_jobs": 0},
+                      cumulative={"families_in": 7})
+    assert set(doc["cumulative"]) == set(CUMULATIVE_KEYS)
+    assert doc["cumulative"]["families_in"] == 7
+    assert doc["cumulative"]["batches_dispatched"] == 0
+
+    p = str(tmp_path / "m.json")
+    write_metrics(p, "SSCS", {"consensus": 1.0},
+                  {"backend": "cpu", "n_families": 4, "n_reads": 8},
+                  cumulative=Counters().snapshot())
+    disk = json.load(open(p))
+    assert set(disk["cumulative"]) == set(CUMULATIVE_KEYS)
+
+    # omitted entirely -> no cumulative block (back-compat with old docs)
+    write_metrics(p, "SSCS", {"consensus": 1.0},
+                  {"backend": "cpu", "n_families": 4, "n_reads": 8})
+    assert "cumulative" not in json.load(open(p))
+
+
+def test_sscs_stage_emits_cumulative_counters(tmp_path):
+    from consensuscruncher_tpu.stages.sscs_maker import run_sscs
+    from consensuscruncher_tpu.utils.simulate import SimConfig, simulate_bam
+
+    bam = str(tmp_path / "in.bam")
+    simulate_bam(bam, SimConfig(n_fragments=40, read_len=30, seed=3))
+    run_sscs(bam, str(tmp_path / "out"), backend="cpu")
+    cum = json.load(open(tmp_path / "out.metrics.json"))["cumulative"]
+    assert set(cum) == set(CUMULATIVE_KEYS)
+    assert cum["families_in"] > 0
+    assert cum["families_out"] > 0
 
 
 def test_sscs_stage_emits_metrics(tmp_path):
