@@ -333,7 +333,9 @@ def run_duplex_pipelined(rows, qrows, sizes_a, sizes_b, codebook4,
 
     def dispatch(batch):
         _i0, _i1, packed, sizes = batch
-        return step(packed, sizes, codebook4)
+        # explicit h2d at the dispatch boundary (CCT_SANITIZE transfer guard)
+        return step(jnp.asarray(packed), jnp.asarray(sizes),
+                    jnp.asarray(codebook4))
 
     out_a = np.empty((n_pairs, length), np.uint8)
     out_qa = np.empty((n_pairs, length), np.uint8)
@@ -541,7 +543,8 @@ def _run_member_batch_stream(batches, config: ConsensusConfig,
             return stream_vote_sharded(mesh, wire, a, b, batch.sizes, num, den,
                                        qt, qc, member_cap, out_len)
         fn = _compiled_stream_vote(wire, num, den, qt, qc, member_cap, out_len)
-        return fn(a, b, batch.sizes)
+        # explicit h2d at the dispatch boundary (CCT_SANITIZE transfer guard)
+        return fn(jnp.asarray(a), jnp.asarray(b), jnp.asarray(batch.sizes))
 
     def fetch(item, handle):
         batch = item[0]

@@ -149,7 +149,8 @@ def run_dryrun_process(
         bases_b[lo:hi], quals_b[lo:hi], sizes_b[lo:hi],
     )
     out = step(*args)
-    stats = np.asarray(jax.device_get(out[-1]))  # replicated -> addressable
+    # cct: allow-transfer(replicated stats fetched once at the step boundary)
+    stats = jax.device_get(out[-1])  # already a host ndarray — no re-copy
 
     # The PRODUCTION multi-chip wire under DCN too: the packed member
     # stream family-sharded over the same global mesh, each process

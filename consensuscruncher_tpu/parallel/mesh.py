@@ -221,6 +221,7 @@ def sharded_consensus_batch(
     out_b, out_q, stats = sharded_consensus_batch_async(
         bases, quals, fam_sizes, mesh, config, lengths
     )
+    # cct: allow-transfer(sync wrapper by contract: stats fetched at batch end)
     return out_b, out_q, StepStats.from_vector(jax.device_get(stats))
 
 
@@ -349,6 +350,13 @@ def stream_vote_sharded(mesh: Mesh, wire: str, a, b, sizes, num, den,
         b_st = b  # replicated codebook
     fn = _compiled_stream_vote_sharded(mesh, wire, num, den, qual_threshold,
                                        qual_cap, member_cap, out_len)
+    # Explicit h2d with the target shardings (CCT_SANITIZE transfer guard:
+    # implicit numpy->jit transfers are disallowed inside guarded stages).
+    shard = NamedSharding(mesh, P(FAMILY_AXIS))
+    repl = NamedSharding(mesh, P())
+    a_st = jax.device_put(a_st, shard)
+    sizes_st = jax.device_put(sizes_st, shard)
+    b_st = jax.device_put(b_st, shard if wire == "raw" else repl)
     return fn(a_st, b_st, sizes_st)
 
 

@@ -41,7 +41,7 @@ import threading
 import time
 from collections import deque
 
-from consensuscruncher_tpu.utils import faults
+from consensuscruncher_tpu.utils import faults, sanitize
 from consensuscruncher_tpu.utils.profiling import Counters, metrics_doc
 
 
@@ -56,7 +56,9 @@ class Job:
     """One submitted consensus request and its lifecycle."""
 
     _next_id = 0
-    _id_lock = threading.Lock()
+    # lock-order asserted under CCT_SANITIZE=1 (utils.sanitize); plain
+    # threading.Lock semantics otherwise
+    _id_lock = sanitize.tracked_lock("job.id_lock")
 
     def __init__(self, spec: dict):
         with Job._id_lock:
@@ -286,7 +288,7 @@ class Scheduler:
         self.backend = backend
         self.max_batch = int(max_batch)
         self.counters = Counters()
-        self._cond = threading.Condition()
+        self._cond = sanitize.tracked_condition("scheduler.cond")
         self._queue: deque[Job] = deque()
         self._jobs: dict[int, Job] = {}
         self._running: list[Job] = []

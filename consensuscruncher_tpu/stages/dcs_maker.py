@@ -31,7 +31,7 @@ import struct
 import sys
 
 from consensuscruncher_tpu.core import tags as tags_mod
-from consensuscruncher_tpu.utils import faults
+from consensuscruncher_tpu.utils import faults, sanitize
 from consensuscruncher_tpu.core.consensus_read import _KEEP_FLAGS
 from consensuscruncher_tpu.core.duplex_cpu import duplex_consensus
 from consensuscruncher_tpu.io.bam import BamWriter
@@ -421,9 +421,10 @@ def run_dcs(
     ok = False
     try:
         try:
-            _consume_pair_blocks(
-                reader, stats, unpaired_writer, rec_writer, qual_cap, backend, mesh
-            )
+            with sanitize.guarded_stage("dcs"):
+                _consume_pair_blocks(
+                    reader, stats, unpaired_writer, rec_writer, qual_cap, backend, mesh
+                )
         except ValueError as e:
             if "foreign tag layout" not in str(e):
                 raise
@@ -438,9 +439,10 @@ def run_dcs(
             unpaired_writer = SortingBamWriter(unpaired_path, reader.header,
                                                level=level)
             rec_writer = ConsensusRecordWriter(dcs_writer)
-            _run_dcs_windows(
-                reader, stats, unpaired_writer, rec_writer, qual_cap, backend, mesh,
-            )
+            with sanitize.guarded_stage("dcs"):
+                _run_dcs_windows(
+                    reader, stats, unpaired_writer, rec_writer, qual_cap, backend, mesh,
+                )
         rec_writer.flush()
         ok = True
     finally:

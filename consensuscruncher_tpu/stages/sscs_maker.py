@@ -32,7 +32,7 @@ from dataclasses import dataclass
 import numpy as np
 
 from consensuscruncher_tpu.core import tags as tags_mod
-from consensuscruncher_tpu.utils import faults
+from consensuscruncher_tpu.utils import faults, sanitize
 from consensuscruncher_tpu.core.consensus_cpu import consensus_maker_numpy
 from consensuscruncher_tpu.core.consensus_read import (
     _KEEP_FLAGS,
@@ -429,10 +429,12 @@ def run_sscs(
                     block_items(), cfg, max_batch=4 * max_batch, mesh=mesh
                 )
                 try:
-                    for keys, lengths, out_b, out_q in stream:
-                        cum.add("batches_dispatched")
-                        cum.add("families_in", len(keys))
-                        emit_batch(keys, lengths, out_b, out_q)
+                    with sanitize.guarded_stage("sscs"):
+                        for keys, lengths, out_b, out_q in stream:
+                            sanitize.sync_probe("sscs.sync_probe")
+                            cum.add("batches_dispatched")
+                            cum.add("families_in", len(keys))
+                            emit_batch(keys, lengths, out_b, out_q)
                 finally:
                     # Must run BEFORE the writers close below: closing the
                     # stream stops and joins the prefetch producer thread,
@@ -442,6 +444,7 @@ def run_sscs(
                     stream.close()
             else:
                 def on_batch(batch):
+                    sanitize.sync_probe("sscs.sync_probe")
                     cum.add("batches_dispatched")
                     cum.add("families_in", batch.n_real)
 
@@ -449,8 +452,9 @@ def run_sscs(
                     events(), cfg, max_batch=max_batch, mesh=mesh, on_batch=on_batch
                 )
                 try:
-                    for fid, codes, quals in stream:
-                        emit(fid, codes, quals)
+                    with sanitize.guarded_stage("sscs"):
+                        for fid, codes, quals in stream:
+                            emit(fid, codes, quals)
                 finally:
                     stream.close()
         else:

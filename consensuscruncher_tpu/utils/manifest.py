@@ -126,7 +126,7 @@ class RunManifest:
         fd, tmp = tempfile.mkstemp(prefix=".manifest.", dir=d)
         try:
             with os.fdopen(fd, "w") as fh:
-                json.dump(data, fh, indent=2)
+                json.dump(data, fh, indent=2, sort_keys=True)
                 fh.write("\n")
             commit_file(tmp, self.path)
         except BaseException:

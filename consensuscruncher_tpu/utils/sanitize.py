@@ -1,0 +1,308 @@
+"""Opt-in runtime sanitizers (``CCT_SANITIZE=1``) — cctlint's dynamic half.
+
+The static passes in ``tools/cctlint`` prove what the AST can prove; this
+module catches what only execution can: an *implicit* host->device transfer
+sneaking into a hot stage (a raw numpy array fed to a jitted call), an
+explicit mid-stage ``jax.device_get`` arriving through a call chain the
+lint can't see, and lock-order inversions that only manifest under real
+thread interleavings.  Three pieces:
+
+- :func:`guarded_stage` — wraps the SSCS/DCS device loops in JAX's
+  ``transfer_guard_host_to_device("disallow")`` plus a thread-local shim
+  over ``jax.device_get`` / ``jax.block_until_ready``, converting any
+  mid-stage sync into an actionable :class:`StageTransferError`.
+  Device->host drains via ``np.asarray(handle)`` stay legal by design —
+  the streaming fetch IS the sanctioned d2h path; the static host-sync
+  pass polices everything else.
+- :func:`allow_transfer` — sanctioned-region escape hatch, mirroring the
+  static pragma ``# cct: allow-transfer(reason)``.
+- :func:`tracked_lock` / :func:`tracked_condition` — drop-in lock wrappers
+  recording per-thread acquisition stacks; under ``CCT_SANITIZE=1`` an
+  acquisition that inverts a previously-seen order raises
+  :class:`LockOrderError` at the faulty acquire, not as a production hang.
+
+Import-cheap and jax-free at module level (the scheduler imports this; jax
+loads lazily on first guarded stage).  All state is process-local.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+
+
+class StageTransferError(RuntimeError):
+    """A host<->device sync happened inside a guarded stage."""
+
+
+class LockOrderError(RuntimeError):
+    """Two locks were acquired in opposite orders on different paths."""
+
+
+def enabled() -> bool:
+    """Read dynamically so tests can flip CCT_SANITIZE via monkeypatch."""
+    return os.environ.get("CCT_SANITIZE", "") == "1"
+
+
+# --------------------------------------------------------------- stage guard
+
+_tls = threading.local()
+
+
+def _depth() -> int:
+    return getattr(_tls, "depth", 0)
+
+
+def _allow_depth() -> int:
+    return getattr(_tls, "allow", 0)
+
+
+_shim_lock = threading.Lock()
+_shim_installed = False
+
+
+def _install_sync_shim() -> None:
+    """Patch ``jax.device_get`` / ``jax.block_until_ready`` once per process
+    with thread-local-depth-checking wrappers.  Zero effect on threads not
+    inside a guarded stage."""
+    global _shim_installed
+    with _shim_lock:
+        if _shim_installed:
+            return
+        import jax
+
+        def _blocked(what: str):
+            stage = getattr(_tls, "stage", "?")
+            raise StageTransferError(
+                f"[CCT_SANITIZE] '{what}' inside guarded stage '{stage}' — "
+                "a mid-stage host sync serialises the async dispatch "
+                "pipeline. Move the sync to the stage boundary, or wrap a "
+                "sanctioned region in sanitize.allow_transfer(reason)."
+            )
+
+        orig_get = jax.device_get
+
+        def guarded_device_get(x):
+            if _depth() > 0 and _allow_depth() == 0:
+                _blocked("jax.device_get")
+            return orig_get(x)
+
+        guarded_device_get._cct_orig = orig_get  # type: ignore[attr-defined]
+        jax.device_get = guarded_device_get
+
+        orig_block = getattr(jax, "block_until_ready", None)
+        if orig_block is not None:
+            def guarded_block(x):
+                if _depth() > 0 and _allow_depth() == 0:
+                    _blocked("jax.block_until_ready")
+                return orig_block(x)
+
+            guarded_block._cct_orig = orig_block  # type: ignore[attr-defined]
+            jax.block_until_ready = guarded_block
+        _shim_installed = True
+
+
+@contextlib.contextmanager
+def guarded_stage(name: str):
+    """No-op unless ``CCT_SANITIZE=1``; then: implicit h2d transfers raise
+    (XLA transfer guard) and explicit sync calls raise (shim), both as
+    :class:`StageTransferError` naming the stage and the fix."""
+    if not enabled():
+        yield
+        return
+    import jax
+
+    _install_sync_shim()
+    _tls.depth = _depth() + 1
+    prev_stage = getattr(_tls, "stage", None)
+    _tls.stage = name
+    try:
+        with jax.transfer_guard_host_to_device("disallow"):
+            yield
+    except StageTransferError:
+        raise
+    except Exception as exc:
+        msg = str(exc)
+        if "transfer" in msg.lower() and "disallow" in msg.lower():
+            raise StageTransferError(
+                f"[CCT_SANITIZE] implicit host->device transfer inside "
+                f"guarded stage '{name}': {msg}\nFix: make the transfer "
+                "explicit at the dispatch boundary (jnp.asarray / "
+                "jax.device_put on the batch arrays), or wrap a sanctioned "
+                "region in sanitize.allow_transfer(reason)."
+            ) from exc
+        raise
+    finally:
+        _tls.depth = _depth() - 1
+        _tls.stage = prev_stage
+
+
+@contextlib.contextmanager
+def allow_transfer(reason: str):
+    """Sanctioned transfer region inside a guarded stage.  ``reason`` is
+    mandatory, mirroring the static pragma's non-empty-reason rule."""
+    if not reason or not reason.strip():
+        raise ValueError("allow_transfer() requires a non-empty reason")
+    if not enabled() or _depth() == 0:
+        yield
+        return
+    import jax
+
+    _tls.allow = _allow_depth() + 1
+    try:
+        with jax.transfer_guard("allow"):
+            yield
+    finally:
+        _tls.allow = _allow_depth() - 1
+
+
+def sync_probe(site: str) -> None:
+    """Chaos hook proving the guard catches mid-stage syncs: when the fault
+    site ``site`` is armed (``CCT_FAULTS=<site>=fail``), perform a real
+    ``jax.device_get`` right here — under ``CCT_SANITIZE=1`` inside a
+    guarded stage that raises :class:`StageTransferError`; otherwise it is
+    a harmless no-op sync.  Unarmed cost: two dict lookups."""
+    from . import faults
+
+    if faults.fire(site) is None:
+        return
+    import jax
+
+    jax.device_get(0)
+
+
+# ------------------------------------------------------------ lock tracking
+
+#: (earlier lock, later lock) -> "file-free" first-seen marker.  Guarded by
+#: _edges_lock; held only for dict ops, never while user locks are taken.
+_edges: dict[tuple[str, str], bool] = {}
+_edges_lock = threading.Lock()
+
+
+def _held() -> list[str]:
+    held = getattr(_tls, "held", None)
+    if held is None:
+        held = _tls.held = []
+    return held
+
+
+def _note_acquire(name: str, check: bool = True) -> None:
+    held = _held()
+    if check and enabled():
+        with _edges_lock:
+            for h in held:
+                if h == name:
+                    continue
+                _edges[(h, name)] = True
+                if (name, h) in _edges:
+                    raise LockOrderError(
+                        f"[CCT_SANITIZE] lock order inversion: acquiring "
+                        f"'{name}' while holding '{h}', but the opposite "
+                        f"order '{name}' -> '{h}' was taken earlier — "
+                        "pick one global order for these locks."
+                    )
+    held.append(name)
+
+
+def _note_release(name: str) -> None:
+    held = _held()
+    if name in held:
+        # remove the innermost occurrence (out-of-order release is legal
+        # for plain Locks, rare in practice)
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] == name:
+                del held[i]
+                break
+
+
+def reset_lock_tracking() -> None:
+    """Test hook: forget every recorded ordering edge."""
+    with _edges_lock:
+        _edges.clear()
+
+
+class TrackedLock:
+    """Drop-in ``threading.Lock`` recording acquisition order per thread."""
+
+    def __init__(self, name: str, factory=threading.Lock):
+        self._name = name
+        self._lock = factory()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        _note_acquire(self._name)
+        ok = self._lock.acquire(blocking, timeout)
+        if not ok:
+            _note_release(self._name)
+        return ok
+
+    def release(self) -> None:
+        self._lock.release()
+        _note_release(self._name)
+
+    def locked(self) -> bool:
+        return self._lock.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+
+class TrackedCondition:
+    """Drop-in ``threading.Condition`` with the same order tracking.
+    ``wait`` pops the condition from the held stack for its release window
+    and re-pushes (without re-checking) on wake."""
+
+    def __init__(self, name: str):
+        self._name = name
+        self._cond = threading.Condition()
+
+    def acquire(self, *args) -> bool:
+        _note_acquire(self._name)
+        return self._cond.acquire(*args)
+
+    def release(self) -> None:
+        self._cond.release()
+        _note_release(self._name)
+
+    def wait(self, timeout: float | None = None) -> bool:
+        _note_release(self._name)
+        try:
+            return self._cond.wait(timeout)
+        finally:
+            _note_acquire(self._name, check=False)
+
+    def wait_for(self, predicate, timeout: float | None = None):
+        _note_release(self._name)
+        try:
+            return self._cond.wait_for(predicate, timeout)
+        finally:
+            _note_acquire(self._name, check=False)
+
+    def notify(self, n: int = 1) -> None:
+        self._cond.notify(n)
+
+    def notify_all(self) -> None:
+        self._cond.notify_all()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+
+def tracked_lock(name: str) -> TrackedLock:
+    """A named lock whose acquisition order is asserted under
+    ``CCT_SANITIZE=1`` (always safe to use; passthrough cost otherwise)."""
+    return TrackedLock(name)
+
+
+def tracked_condition(name: str) -> TrackedCondition:
+    return TrackedCondition(name)

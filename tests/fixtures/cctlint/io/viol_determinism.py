@@ -1,0 +1,27 @@
+"""Seeded determinism violations for the cctlint determinism pass (CCT2xx)."""
+
+import os
+import random
+import time
+
+
+def unsorted_listing(d):
+    return [open(os.path.join(d, n)).read()
+            for n in os.listdir(d)]  # CCT201: filesystem order leaks
+
+
+def set_ordered_output(items):
+    chosen = {i for i in items if i}
+    return [x.upper() for x in chosen]  # CCT202: hash-order iteration
+
+
+def stamp_record():
+    return f"run at {time.time()}"  # CCT203: clock reaches output bytes
+
+
+def jitter():
+    return random.random()  # CCT204: process-global unseeded RNG
+
+
+def sorted_listing_ok(d):
+    return sorted(os.listdir(d))  # clean: wrapped in sorted()

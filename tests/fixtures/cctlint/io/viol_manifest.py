@@ -1,0 +1,9 @@
+"""Seeded manifest-serialization violation (CCT205): the filename contains
+``manifest``, so json.dump without sort_keys must be flagged."""
+
+import json
+
+
+def write_manifest(data, path):
+    with open(path, "w") as fh:
+        json.dump(data, fh, indent=2)  # CCT205: dict build order leaks

@@ -1,0 +1,7 @@
+"""Seeded jit-discipline violation for the cctlint jitdisc pass (CCT5xx)."""
+
+import jax
+
+
+def compile_on_request_path(fn):
+    return jax.jit(fn)  # CCT501: direct jit outside ops/ and parallel/mesh.py

@@ -1,0 +1,24 @@
+"""Seeded lock-discipline violations for the cctlint locks pass (CCT4xx)."""
+
+import threading
+import time
+
+lock_a = threading.Lock()
+lock_b = threading.Lock()
+
+
+def path_one():
+    with lock_a:
+        with lock_b:  # establishes a -> b
+            pass
+
+
+def path_two():
+    with lock_b:
+        with lock_a:  # CCT401: b -> a closes the cycle
+            pass
+
+
+def slow_critical_section():
+    with lock_a:
+        time.sleep(1.0)  # CCT402: blocking call while holding lock_a

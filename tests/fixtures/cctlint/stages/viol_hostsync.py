@@ -1,0 +1,33 @@
+"""Seeded host-sync violations for the cctlint hostsync pass (CCT1xx)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def bad_device_fn(x):
+    total = jnp.sum(x)
+    return total.item()  # CCT101: host sync inside a jitted region
+
+
+def _helper(y):
+    return np.asarray(y)  # CCT101 via fixpoint: called from device code
+
+
+@jax.jit
+def bad_device_fn_indirect(x):
+    return _helper(x)
+
+
+def stage_boundary_without_pragma(arr):
+    return jax.device_get(arr)  # CCT102: un-annotated sync in stages/
+
+
+def double_copy(arr):
+    return np.asarray(jax.device_get(arr))  # CCT103: device_get is host already
+
+
+def annotated_boundary(arr):
+    # cct: allow-transfer(batch drain at the stage boundary)
+    return jax.device_get(arr)  # suppressed: pragma with reason

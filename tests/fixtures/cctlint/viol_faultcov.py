@@ -1,0 +1,7 @@
+"""Seeded fault-coverage violation for the cctlint faultcov pass (CCT3xx)."""
+
+from consensuscruncher_tpu.utils import faults
+
+
+def recovery_path():
+    faults.fault_point("fixture.not_registered")  # CCT301: unknown site

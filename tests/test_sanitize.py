@@ -1,0 +1,215 @@
+"""CCT_SANITIZE=1 runtime sanitizers: stage transfer guards + lock shim.
+
+This file doubles as a chaos test for cctlint's faultcov pass (it arms
+CCT_FAULTS): the ``sscs.sync_probe`` site injects a REAL mid-stage
+``jax.device_get`` into the SSCS device loop, and the guard must convert
+it into an actionable StageTransferError.  The golden-parity half proves
+the guard costs nothing when the pipeline behaves: guarded runs produce
+byte-identical outputs for both wires and both stages.
+"""
+
+import hashlib
+import os
+import threading
+
+import pytest
+
+from consensuscruncher_tpu.utils import faults, sanitize
+from consensuscruncher_tpu.utils.sanitize import (
+    LockOrderError,
+    StageTransferError,
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh(monkeypatch):
+    monkeypatch.setattr(faults, "_cached", None)
+    sanitize.reset_lock_tracking()
+    yield
+    faults._cached = None
+    sanitize.reset_lock_tracking()
+
+
+@pytest.fixture(scope="module")
+def small_bam(tmp_path_factory):
+    from consensuscruncher_tpu.utils.simulate import SimConfig, simulate_bam
+
+    bam = str(tmp_path_factory.mktemp("sanitize_bam") / "in.sorted.bam")
+    simulate_bam(bam, SimConfig(n_fragments=60, read_len=40, seed=9))
+    return bam
+
+
+def _sha(path):
+    with open(path, "rb") as fh:
+        return hashlib.sha256(fh.read()).hexdigest()
+
+
+# ------------------------------------------------------------- stage guard
+
+
+def test_golden_pipeline_clean_and_bit_identical_under_sanitize(
+        small_bam, tmp_path, monkeypatch):
+    """SSCS (stream wire) + DCS run clean under the transfer guard — every
+    h2d in the hot loops is explicit — and outputs stay byte-identical."""
+    from consensuscruncher_tpu.stages import dcs_maker, sscs_maker
+
+    plain = sscs_maker.run_sscs(small_bam, str(tmp_path / "plain"),
+                                backend="tpu")
+    plain_dcs = dcs_maker.run_dcs(plain.sscs_bam, str(tmp_path / "plain_d"),
+                                  backend="tpu")
+
+    monkeypatch.setenv("CCT_SANITIZE", "1")
+    guarded = sscs_maker.run_sscs(small_bam, str(tmp_path / "guarded"),
+                                  backend="tpu")
+    guarded_dcs = dcs_maker.run_dcs(guarded.sscs_bam,
+                                    str(tmp_path / "guarded_d"),
+                                    backend="tpu")
+
+    assert _sha(guarded.sscs_bam) == _sha(plain.sscs_bam)
+    assert _sha(guarded.singleton_bam) == _sha(plain.singleton_bam)
+    assert _sha(guarded_dcs.dcs_bam) == _sha(plain_dcs.dcs_bam)
+
+
+def test_golden_dense_wire_clean_under_sanitize(small_bam, tmp_path,
+                                                monkeypatch):
+    from consensuscruncher_tpu.stages import sscs_maker
+
+    plain = sscs_maker.run_sscs(small_bam, str(tmp_path / "plain"),
+                                backend="tpu", wire="dense")
+    monkeypatch.setenv("CCT_SANITIZE", "1")
+    guarded = sscs_maker.run_sscs(small_bam, str(tmp_path / "guarded"),
+                                  backend="tpu", wire="dense")
+    assert _sha(guarded.sscs_bam) == _sha(plain.sscs_bam)
+
+
+def test_injected_midstage_device_get_is_caught(small_bam, tmp_path,
+                                                monkeypatch):
+    """Arm sscs.sync_probe: a real jax.device_get fires inside the guarded
+    SSCS loop and must surface as an actionable StageTransferError."""
+    from consensuscruncher_tpu.stages import sscs_maker
+
+    monkeypatch.setenv("CCT_SANITIZE", "1")
+    monkeypatch.setenv("CCT_FAULTS", "sscs.sync_probe=fail@1")
+    with pytest.raises(StageTransferError) as exc_info:
+        sscs_maker.run_sscs(small_bam, str(tmp_path / "boom"), backend="tpu")
+    msg = str(exc_info.value)
+    assert "CCT_SANITIZE" in msg
+    assert "'sscs'" in msg                 # names the guarded stage
+    assert "allow_transfer" in msg         # names the sanctioned escape hatch
+    # the abort path left no promoted outputs behind
+    paths = sscs_maker.output_paths(str(tmp_path / "boom"))
+    for key in ("sscs", "singleton", "bad"):
+        assert not os.path.exists(paths[key]), key
+
+
+def test_probe_is_inert_without_sanitize(small_bam, tmp_path, monkeypatch):
+    """CCT_FAULTS armed but CCT_SANITIZE unset: the probe's device_get is a
+    harmless sync and the run completes — the sanitizer is strictly opt-in."""
+    from consensuscruncher_tpu.stages import sscs_maker
+
+    monkeypatch.delenv("CCT_SANITIZE", raising=False)
+    monkeypatch.setenv("CCT_FAULTS", "sscs.sync_probe=fail@1")
+    res = sscs_maker.run_sscs(small_bam, str(tmp_path / "ok"), backend="tpu")
+    assert os.path.exists(res.sscs_bam)
+
+
+def test_guard_rejects_implicit_h2d_and_allows_sanctioned_region():
+    import jax
+    import numpy as np
+
+    os.environ["CCT_SANITIZE"] = "1"
+    try:
+        from consensuscruncher_tpu.ops.consensus_tpu import _compiled_batch_fn
+
+        fn = _compiled_batch_fn(3, 4, 0, 60)
+        bases = np.zeros((1, 2, 8), np.uint8)
+        quals = np.full((1, 2, 8), 30, np.uint8)
+        sizes = np.full(1, 2, np.int32)
+        with pytest.raises(StageTransferError, match="implicit host->device"):
+            with sanitize.guarded_stage("unit"):
+                fn(bases, quals, sizes)  # raw numpy into jit: implicit h2d
+
+        with pytest.raises(ValueError):
+            with sanitize.allow_transfer(""):  # reason is mandatory
+                pass
+
+        with sanitize.guarded_stage("unit"):
+            with sanitize.allow_transfer("unit-test sanctioned region"):
+                jax.device_get(jax.numpy.zeros(2))  # explicit AND sanctioned
+    finally:
+        os.environ.pop("CCT_SANITIZE", None)
+
+
+def test_shim_blocks_explicit_sync_only_inside_stage(monkeypatch):
+    import jax
+
+    monkeypatch.setenv("CCT_SANITIZE", "1")
+    with sanitize.guarded_stage("unit"):
+        with pytest.raises(StageTransferError, match="jax.device_get"):
+            jax.device_get(0)
+    # outside the stage the patched function passes through untouched
+    assert jax.device_get(0) == 0
+
+
+# ----------------------------------------------------------- lock tracking
+
+
+def test_lock_order_inversion_raises_only_when_enabled(monkeypatch):
+    a = sanitize.tracked_lock("unit.a")
+    b = sanitize.tracked_lock("unit.b")
+
+    monkeypatch.delenv("CCT_SANITIZE", raising=False)
+    with a:
+        with b:
+            pass
+    with b:
+        with a:  # inversion, but the sanitizer is off: no assertion
+            pass
+
+    sanitize.reset_lock_tracking()
+    monkeypatch.setenv("CCT_SANITIZE", "1")
+    with a:
+        with b:
+            pass
+    with pytest.raises(LockOrderError, match="lock order inversion"):
+        with b:
+            with a:
+                pass
+    assert not b._lock.locked(), "failed acquire must not leak the outer lock"
+
+
+def test_tracked_condition_wait_notify_roundtrip(monkeypatch):
+    monkeypatch.setenv("CCT_SANITIZE", "1")
+    cond = sanitize.tracked_condition("unit.cond")
+    state = {"ready": False}
+
+    def producer():
+        with cond:
+            state["ready"] = True
+            cond.notify_all()
+
+    t = threading.Thread(target=producer)
+    with cond:
+        t.start()
+        while not state["ready"]:
+            assert cond.wait(timeout=5.0)
+    t.join(timeout=5.0)
+    assert state["ready"]
+
+
+def test_scheduler_lock_order_consistent_under_sanitize(tmp_path, monkeypatch):
+    """submit() takes scheduler.cond then job.id_lock — the shim must see a
+    consistent order (and would raise here on a regression)."""
+    monkeypatch.setenv("CCT_SANITIZE", "1")
+    from consensuscruncher_tpu.serve.scheduler import Scheduler
+
+    sched = Scheduler(queue_bound=4, gang_size=1, backend="tpu",
+                      paused=True, start=False)
+    spec = {"input": "/dev/null", "output": str(tmp_path / "x"),
+            "name": "n"}
+    j1 = sched.submit(spec)
+    j2 = sched.submit(spec)
+    assert j2.id > j1.id
+    health = sched.healthz()
+    assert health["status"] == "serving"
+    assert health["queued"] == 2

@@ -164,6 +164,57 @@ def test_warmup_shapes():
     assert warm_shapes(shapes) == 2
 
 
+def test_chaos_accept_fault_is_clean_error_reply_then_recovers(
+        daemon, monkeypatch):
+    """Arm ``serve.accept=fail@1``: the first connection gets an ``ok:false``
+    reply (surfaced as ServeClientError), the daemon stays up, and the very
+    next request is served normally."""
+    sched, client = daemon
+    monkeypatch.setenv("CCT_FAULTS", "serve.accept=fail@1")
+    with pytest.raises(ServeClientError, match="serve.accept"):
+        client.healthz()
+    # budget spent: the daemon recovered without a restart
+    assert client.healthz()["status"] == "serving"
+    assert sched.healthz()["status"] == "serving"
+
+
+def test_chaos_worker_fault_retries_to_golden(tmp_path, monkeypatch, daemon):
+    """Arm ``serve.worker=fail@1``: the first attempt dies at the top of the
+    worker loop, the retry resumes, and the output still hits the goldens."""
+    sched, client = daemon
+    monkeypatch.setenv("CCT_FAULTS", "serve.worker=fail@1")
+    monkeypatch.setenv("CCT_RETRY_BASE_S", "0")
+    try:
+        job = client.run(_spec(tmp_path / "w"), timeout=600)
+    finally:
+        monkeypatch.delenv("CCT_FAULTS", raising=False)
+    assert job["state"] == "done"
+    assert job["attempts"] >= 2
+    assert sched.counters.snapshot()["retries_fired"] >= 1
+    _assert_matches_golden(tmp_path / "w" / "golden", "worker-fault job")
+
+
+@pytest.mark.slow
+def test_chaos_gang_dispatch_falls_back_to_solo(tmp_path, monkeypatch):
+    """Arm ``serve.dispatch=fail@1``: the merged gang dispatch dies, both
+    jobs fall back to solo resume runs, and both still match the goldens."""
+    monkeypatch.setenv("CCT_FAULTS", "serve.dispatch=fail@1")
+    monkeypatch.setenv("CCT_RETRY_BASE_S", "0")
+    sched = Scheduler(queue_bound=4, gang_size=4, backend="tpu", paused=True)
+    try:
+        j1 = sched.submit(_spec(tmp_path / "a"))
+        j2 = sched.submit(_spec(tmp_path / "b"))
+        sched.release()
+        sched.wait(j1.id, timeout=600)
+        sched.wait(j2.id, timeout=600)
+        assert (j1.state, j2.state) == ("done", "done"), (j1.error, j2.error)
+    finally:
+        monkeypatch.delenv("CCT_FAULTS", raising=False)
+        sched.close(timeout=120)
+    _assert_matches_golden(tmp_path / "a" / "golden", "solo-fallback job 1")
+    _assert_matches_golden(tmp_path / "b" / "golden", "solo-fallback job 2")
+
+
 @pytest.mark.slow
 def test_chaos_worker_death_retries_with_no_partial_output(
         tmp_path, monkeypatch, daemon):

@@ -1,0 +1,26 @@
+"""cctlint: repo-specific static analysis for the ConsensusCruncher rebuild.
+
+Five AST passes enforce the invariants that keep the pipeline bit-identical
+and the accelerator hot (see README "Static analysis & sanitizers"):
+
+  hostsync      CCT1xx  host<->device sync discipline (no syncs in device
+                        regions, no double host copies)
+  determinism   CCT2xx  no nondeterministic iteration / clocks / RNG on
+                        output-byte or manifest paths
+  faultcov      CCT3xx  every fault_point site registered AND chaos-tested
+  locks         CCT4xx  lock-ordering + no blocking calls while holding a lock
+  jitdisc       CCT5xx  jax.jit/pjit only inside the approved wrappers
+
+Run ``python -m tools.cctlint`` from the repo root (exit 1 on findings).
+Suppress a true-but-intended finding with a same-line or preceding-line
+pragma: ``# cct: allow-transfer(reason)`` / ``allow-nondet`` / ``allow-lock``
+/ ``allow-jit``.  The reason is mandatory — an empty one is itself a finding.
+
+The runtime companions (``CCT_SANITIZE=1`` stage transfer guards and the
+lock-order shim) live in ``consensuscruncher_tpu.utils.sanitize``; this
+package is pure stdlib and must never import jax.
+"""
+
+from .core import Finding, LintContext, SourceFile, collect_files, run_paths
+
+__all__ = ["Finding", "LintContext", "SourceFile", "collect_files", "run_paths"]

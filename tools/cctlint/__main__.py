@@ -1,0 +1,71 @@
+"""CLI: ``python -m tools.cctlint [paths...] [options]``.
+
+Exit status 0 = clean, 1 = findings, 2 = usage error.  Run from the repo
+root (the fault-coverage pass resolves chaos tests against ``--root``,
+default cwd).  ``--format json`` emits a machine-readable document for
+bench/CI scripts; ``--select`` / ``--ignore`` filter by code prefix, e.g.
+``--select CCT3`` or ``--ignore CCT402,CCT203``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .core import all_passes, run_paths
+
+DEFAULT_PATHS = ["consensuscruncher_tpu", "tools"]
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.cctlint",
+        description="Repo-specific static analysis for the "
+                    "ConsensusCruncher TPU rebuild (see tools/cctlint/).")
+    parser.add_argument("paths", nargs="*", default=None,
+                        help="files or directories to lint "
+                             f"(default: {' '.join(DEFAULT_PATHS)})")
+    parser.add_argument("--root", default=None,
+                        help="repo root for relative paths and chaos-test "
+                             "lookup (default: cwd)")
+    parser.add_argument("--format", choices=("text", "json"), default="text")
+    parser.add_argument("--select", default=None, metavar="CODES",
+                        help="comma-separated code prefixes to keep "
+                             "(e.g. CCT1,CCT203)")
+    parser.add_argument("--ignore", default=None, metavar="CODES",
+                        help="comma-separated code prefixes to drop")
+    parser.add_argument("--passes", default=None, metavar="NAMES",
+                        help="comma-separated pass names to run "
+                             f"(available: {','.join(all_passes())})")
+    args = parser.parse_args(argv)
+
+    passes = None
+    if args.passes:
+        passes = [p.strip() for p in args.passes.split(",") if p.strip()]
+        unknown = sorted(set(passes) - set(all_passes()))
+        if unknown:
+            parser.error(f"unknown pass(es): {', '.join(unknown)}")
+
+    split = lambda s: [c.strip() for c in s.split(",") if c.strip()] if s else None
+    findings = run_paths(
+        args.paths or DEFAULT_PATHS, root=args.root,
+        select=split(args.select), ignore=split(args.ignore), passes=passes)
+
+    if args.format == "json":
+        json.dump({"findings": [f.to_dict() for f in findings],
+                   "count": len(findings)},
+                  sys.stdout, indent=2, sort_keys=True)
+        sys.stdout.write("\n")
+    else:
+        for f in findings:
+            print(f.render())
+        if findings:
+            print(f"cctlint: {len(findings)} finding(s)")
+        else:
+            print("cctlint: clean")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

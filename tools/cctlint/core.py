@@ -1,0 +1,242 @@
+"""cctlint core: source model, pragma suppression, pass registry, runner.
+
+Everything here is stdlib-only.  Passes receive a :class:`LintContext` and
+return :class:`Finding` lists; suppression and select/ignore filtering
+happen centrally so individual passes stay oblivious to pragmas.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+
+#: ``# cct: allow-<name>(<reason>)`` — suppresses findings of the matching
+#: family on the same line or the line directly below the pragma.
+PRAGMA_RE = re.compile(r"#\s*cct:\s*allow-([a-z-]+)\s*\(([^)]*)\)")
+
+#: Finding-code family -> pragma name that suppresses it.
+PRAGMA_FAMILY = {
+    "CCT1": "transfer",
+    "CCT2": "nondet",
+    "CCT4": "lock",
+    "CCT5": "jit",
+    # CCT3 (fault coverage) has no pragma on purpose: an unregistered or
+    # untested site is fixed by registering/testing it, never by waiving it.
+}
+
+KNOWN_PRAGMAS = frozenset(PRAGMA_FAMILY.values())
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One diagnostic: ``path:line: CODE message`` (path repo-relative)."""
+
+    code: str
+    path: str
+    line: int
+    message: str
+    pass_name: str
+
+    def sort_key(self):
+        return (self.path, self.line, self.code)
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.code} {self.message}"
+
+    def to_dict(self) -> dict:
+        return {
+            "code": self.code,
+            "path": self.path,
+            "line": self.line,
+            "message": self.message,
+            "pass": self.pass_name,
+        }
+
+
+class SourceFile:
+    """A parsed python file: AST + per-line pragma map + path predicates."""
+
+    def __init__(self, path: str, rel: str, text: str):
+        self.path = path
+        self.rel = rel.replace(os.sep, "/")
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree: ast.AST | None = None
+        self.parse_error: SyntaxError | None = None
+        try:
+            self.tree = ast.parse(text, filename=rel)
+        except SyntaxError as exc:  # surfaced as CCT001 by the runner
+            self.parse_error = exc
+        # 1-based line -> (pragma name, reason)
+        self.pragmas: dict[int, tuple[str, str]] = {}
+        for lineno, line in enumerate(self.lines, start=1):
+            m = PRAGMA_RE.search(line)
+            if m:
+                self.pragmas[lineno] = (m.group(1), m.group(2).strip())
+
+    @property
+    def parts(self) -> tuple[str, ...]:
+        return tuple(self.rel.split("/"))
+
+    def in_dirs(self, *names: str) -> bool:
+        """True when any path component (not the filename) matches."""
+        return any(p in names for p in self.parts[:-1])
+
+    def suppressed(self, code: str, line: int) -> bool:
+        name = PRAGMA_FAMILY.get(code[:4])
+        if name is None:
+            return False
+        for candidate in (line, line - 1):
+            got = self.pragmas.get(candidate)
+            if got and got[0] == name and got[1]:
+                return True
+        return False
+
+
+@dataclasses.dataclass
+class LintContext:
+    """Shared input for every pass.
+
+    ``root`` anchors repo-level lookups (chaos test files for the coverage
+    pass).  ``overrides`` lets tests inject a fixture registry or chaos-file
+    list without touching the real ones.
+    """
+
+    files: list[SourceFile]
+    root: str
+    overrides: dict = dataclasses.field(default_factory=dict)
+
+    def parsed(self) -> list[SourceFile]:
+        return [f for f in self.files if f.tree is not None]
+
+
+def collect_files(paths: list[str], root: str) -> list[SourceFile]:
+    """Gather ``.py`` files under ``paths`` (files or directories), skipping
+    hidden and ``__pycache__`` directories.  Paths are resolved against
+    ``root``; rel paths in findings are relative to ``root``."""
+    out: list[SourceFile] = []
+    seen: set[str] = set()
+
+    def add(abspath: str) -> None:
+        abspath = os.path.abspath(abspath)
+        if abspath in seen or not abspath.endswith(".py"):
+            return
+        seen.add(abspath)
+        rel = os.path.relpath(abspath, root)
+        with open(abspath, "r", encoding="utf-8") as fh:
+            text = fh.read()
+        out.append(SourceFile(abspath, rel, text))
+
+    for p in paths:
+        p = p if os.path.isabs(p) else os.path.join(root, p)
+        if os.path.isfile(p):
+            add(p)
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = sorted(
+                d for d in dirnames
+                if d != "__pycache__" and not d.startswith(".")
+            )
+            for name in sorted(filenames):
+                add(os.path.join(dirpath, name))
+    out.sort(key=lambda f: f.rel)
+    return out
+
+
+def _pragma_findings(files: list[SourceFile]) -> list[Finding]:
+    found = []
+    for f in files:
+        if f.parse_error is not None:
+            found.append(Finding(
+                "CCT001", f.rel, f.parse_error.lineno or 1,
+                f"syntax error: {f.parse_error.msg}", "core"))
+        for lineno, (name, reason) in sorted(f.pragmas.items()):
+            if name not in KNOWN_PRAGMAS:
+                found.append(Finding(
+                    "CCT002", f.rel, lineno,
+                    f"unknown pragma 'allow-{name}' "
+                    f"(known: {', '.join(sorted(KNOWN_PRAGMAS))})", "core"))
+            elif not reason:
+                found.append(Finding(
+                    "CCT003", f.rel, lineno,
+                    f"pragma 'allow-{name}' needs a reason: "
+                    f"# cct: allow-{name}(why this is safe)", "core"))
+    return found
+
+
+def all_passes():
+    """Name -> pass callable.  Imported lazily so a syntax error in one pass
+    module doesn't take down the others during development."""
+    from . import determinism, faultcov, hostsync, jitdisc, locks
+
+    return {
+        "hostsync": hostsync.run,
+        "determinism": determinism.run,
+        "faultcov": faultcov.run,
+        "locks": locks.run,
+        "jitdisc": jitdisc.run,
+    }
+
+
+def _code_matches(code: str, patterns: list[str]) -> bool:
+    return any(code.startswith(p) for p in patterns)
+
+
+def run_paths(paths: list[str], root: str | None = None, *,
+              select: list[str] | None = None,
+              ignore: list[str] | None = None,
+              passes: list[str] | None = None,
+              overrides: dict | None = None) -> list[Finding]:
+    """Lint ``paths`` and return suppression/filter-applied findings.
+
+    ``select``/``ignore`` filter by code prefix (e.g. ``CCT2`` or ``CCT203``);
+    ``passes`` restricts which passes run (names from :func:`all_passes`).
+    """
+    root = os.path.abspath(root or os.getcwd())
+    files = collect_files(paths, root)
+    ctx = LintContext(files=files, root=root, overrides=overrides or {})
+
+    findings = _pragma_findings(files)
+    registry = all_passes()
+    for name, fn in registry.items():
+        if passes is not None and name not in passes:
+            continue
+        findings.extend(fn(ctx))
+
+    by_file = {f.rel: f for f in files}
+    kept = []
+    for f in findings:
+        src = by_file.get(f.path)
+        if src is not None and src.suppressed(f.code, f.line):
+            continue
+        if select and not _code_matches(f.code, select):
+            continue
+        if ignore and _code_matches(f.code, ignore):
+            continue
+        kept.append(f)
+    kept.sort(key=Finding.sort_key)
+    return kept
+
+
+def call_name(node: ast.AST) -> str:
+    """Dotted name of a call target: ``jax.device_get`` -> that string,
+    ``fn`` -> ``fn``; unresolvable shapes -> ''."""
+    if isinstance(node, ast.Call):
+        node = node.func
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def terminal_name(node: ast.AST) -> str:
+    """Last attribute segment of a call target (``faults.fault_point`` ->
+    ``fault_point``)."""
+    name = call_name(node)
+    return name.rsplit(".", 1)[-1] if name else ""

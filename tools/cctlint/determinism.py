@@ -1,0 +1,170 @@
+"""Pass 2 — determinism on output/manifest paths (CCT2xx).
+
+The golden-digest contract (bit-identical BAM/fastq bytes and manifest
+entries across runs, hosts, and parallelism settings) dies quietly the
+moment record ordering depends on filesystem enumeration order, set
+iteration order, wall clocks, or unseeded RNG.  This pass flags:
+
+CCT201  ``os.listdir`` / ``scandir`` / ``glob`` / ``iterdir`` results used
+        without an immediate order-insensitive wrapper (``sorted``, ``len``,
+        ``set``, ...) — filesystem order is arbitrary.
+CCT202  iteration over a set expression (literal, ``set()`` call, set-typed
+        local, or set algebra) in a ``for``/comprehension — hash order
+        varies across processes (PYTHONHASHSEED).
+CCT203  wall-clock value reads (``time.time``, ``datetime.now``, ...) in
+        ``io/`` / ``ops/`` or manifest code — clocks must never reach
+        output bytes.  (``time.sleep`` is fine: it delays, not decides.)
+CCT204  unseeded randomness (stdlib ``random.*``, legacy ``np.random.*``,
+        argument-less ``default_rng()``) in pipeline dirs.
+CCT205  ``json.dump(s)`` without ``sort_keys=True`` in manifest code —
+        manifest bytes must not depend on dict build order.
+
+Suppress intended uses with ``# cct: allow-nondet(reason)``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, LintContext, SourceFile, call_name, terminal_name
+
+FS_ENUM_TERMINALS = {"listdir", "scandir", "glob", "iglob", "iterdir", "rglob"}
+ORDER_INSENSITIVE_WRAPPERS = {
+    "sorted", "len", "set", "frozenset", "sum", "any", "all", "max", "min",
+}
+CLOCK_NAMES = {
+    "time.time", "time.time_ns", "time.monotonic", "time.monotonic_ns",
+    "time.perf_counter", "time.perf_counter_ns",
+    "datetime.now", "datetime.utcnow", "datetime.datetime.now",
+    "datetime.datetime.utcnow", "date.today", "datetime.date.today",
+}
+RNG_SCOPE_DIRS = ("io", "ops", "stages", "parallel", "serve")
+
+
+def _parents(tree: ast.AST) -> dict[ast.AST, ast.AST]:
+    out: dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            out[child] = node
+    return out
+
+
+def _is_set_expr(node: ast.AST, set_names: set[str]) -> bool:
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call) and terminal_name(node) in {"set", "frozenset"}:
+        return True
+    if isinstance(node, ast.Name) and node.id in set_names:
+        return True
+    if isinstance(node, ast.BinOp) and isinstance(
+            node.op, (ast.Sub, ast.BitOr, ast.BitAnd, ast.BitXor)):
+        return _is_set_expr(node.left, set_names) or \
+            _is_set_expr(node.right, set_names)
+    return False
+
+
+def _set_names(tree: ast.AST) -> set[str]:
+    """Names assigned a set expression anywhere in the module (coarse but
+    effective: shadowing across functions is rare in this codebase)."""
+    names: set[str] = set()
+    for node in ast.walk(tree):
+        target = None
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            target = node.targets[0].id
+        elif isinstance(node, ast.AnnAssign) and \
+                isinstance(node.target, ast.Name) and node.value is not None:
+            target = node.target.id
+        if target and _is_set_expr(node.value, set()):
+            names.add(target)
+    return names
+
+
+def _check_fs_enum(src: SourceFile, parents, findings) -> None:
+    for node in ast.walk(src.tree):
+        if not (isinstance(node, ast.Call) and
+                terminal_name(node) in FS_ENUM_TERMINALS):
+            continue
+        name = call_name(node)
+        # only filesystem enumerators, not e.g. re-named locals
+        if terminal_name(node) in {"glob", "iglob"} or name.startswith(
+                ("os.", "pathlib.")) or "." in name or name in FS_ENUM_TERMINALS:
+            parent = parents.get(node)
+            if isinstance(parent, ast.Call) and \
+                    terminal_name(parent) in ORDER_INSENSITIVE_WRAPPERS:
+                continue
+            findings.append(Finding(
+                "CCT201", src.rel, node.lineno,
+                f"filesystem enumeration '{name or terminal_name(node)}' "
+                "used without sorted() — directory order is arbitrary and "
+                "leaks into output/manifest ordering", "determinism"))
+
+
+def _check_set_iteration(src: SourceFile, findings) -> None:
+    set_names = _set_names(src.tree)
+    for node in ast.walk(src.tree):
+        iters: list[ast.AST] = []
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            iters = [node.iter]
+        elif isinstance(node, (ast.ListComp, ast.GeneratorExp)):
+            # SetComp/DictComp over a set stays order-insensitive; lists and
+            # generator feeds (join, writers) do not.
+            iters = [gen.iter for gen in node.generators]
+        for it in iters:
+            if _is_set_expr(it, set_names):
+                findings.append(Finding(
+                    "CCT202", src.rel, node.lineno,
+                    "iteration over a set — hash order varies per process; "
+                    "wrap in sorted(...) before it reaches ordered output",
+                    "determinism"))
+
+
+def run(ctx: LintContext) -> list[Finding]:
+    findings: list[Finding] = []
+    for src in ctx.parsed():
+        parents = _parents(src.tree)
+        _check_fs_enum(src, parents, findings)
+        _check_set_iteration(src, findings)
+
+        manifest_file = "manifest" in src.parts[-1]
+        clock_scope = src.in_dirs("io", "ops") or manifest_file
+        rng_scope = src.in_dirs(*RNG_SCOPE_DIRS) or manifest_file
+
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            if clock_scope and name in CLOCK_NAMES:
+                findings.append(Finding(
+                    "CCT203", src.rel, node.lineno,
+                    f"wall-clock read '{name}' on an output-producing path "
+                    "— clocks must not reach record/manifest bytes",
+                    "determinism"))
+            if rng_scope:
+                if name.startswith("random."):
+                    findings.append(Finding(
+                        "CCT204", src.rel, node.lineno,
+                        f"stdlib global RNG '{name}' — process-global and "
+                        "unseedable per-run; use np.random.default_rng(seed)",
+                        "determinism"))
+                elif name.startswith(("np.random.", "numpy.random.")) and \
+                        terminal_name(node) != "default_rng":
+                    findings.append(Finding(
+                        "CCT204", src.rel, node.lineno,
+                        f"legacy numpy RNG '{name}' shares global state — "
+                        "use np.random.default_rng(seed)", "determinism"))
+                elif terminal_name(node) == "default_rng" and \
+                        not node.args and not node.keywords:
+                    findings.append(Finding(
+                        "CCT204", src.rel, node.lineno,
+                        "default_rng() without a seed is entropy-seeded — "
+                        "pass an explicit seed", "determinism"))
+            if manifest_file and name in {"json.dump", "json.dumps"}:
+                kwargs = {kw.arg for kw in node.keywords}
+                if "sort_keys" not in kwargs:
+                    findings.append(Finding(
+                        "CCT205", src.rel, node.lineno,
+                        f"'{name}' without sort_keys=True in manifest code — "
+                        "manifest bytes must not depend on dict build order",
+                        "determinism"))
+    return findings

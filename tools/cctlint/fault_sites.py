@@ -1,0 +1,37 @@
+"""Registry of every fault-injection site wired into the pipeline.
+
+The faultcov pass (CCT3xx) cross-checks this dict against the package
+source and the chaos tests, so a site cannot exist without being listed
+here, and cannot be listed here without (a) existing in the code and
+(b) being exercised by at least one chaos test.
+
+To register a new site (see README "Static analysis & sanitizers"):
+
+  1. plant ``faults.fault_point("area.event")`` (or ``hook``/``fire``/
+     ``retrying(site=...)``) at the injection point;
+  2. add ``"area.event": "what failing here proves"`` below;
+  3. arm it from a chaos test (``tests/test_faults.py``,
+     ``tests/test_serve_e2e.py``, or any ``tests/test_*.py`` that sets
+     ``CCT_FAULTS``) so the recovery path actually runs.
+
+``python -m tools.cctlint --select CCT3`` fails until all three exist.
+"""
+
+from __future__ import annotations
+
+FAULT_SITES: dict[str, str] = {
+    "align.barrier": "prestart-barrier warm-up failure -> serial fallback",
+    "align.pool_worker": "fork-pool worker death -> re-fork once, then serial",
+    "subprocess.bwa": "external aligner failure -> bounded retry + backoff",
+    "bgzf.truncated_eof": "truncated BGZF block -> clear error / salvage",
+    "bgzf.read_stall": "slow input device (stall kind); correctness holds",
+    "mesh.unavailable": "device mesh creation fails -> single-device fallback",
+    "sscs.midstage": "crash/SIGTERM inside the SSCS loop (atomicity proof)",
+    "dcs.midstage": "crash/SIGTERM inside the DCS loop (atomicity proof)",
+    "watch.job": "TPU watcher row job nonzero rc -> retry + backoff",
+    "serve.accept": "daemon connection accept/handling -> error reply",
+    "serve.dispatch": "scheduler gang dispatch -> jobs retried solo",
+    "serve.worker": "per-job worker execution -> retry via --resume",
+    "sscs.sync_probe": "sanitizer self-test: mid-stage host sync is caught "
+                       "by CCT_SANITIZE=1 stage guards",
+}

@@ -1,0 +1,130 @@
+"""Pass 3 — fault-site coverage (CCT3xx).
+
+PR 1's whole fault-tolerance layer rests on named injection sites
+(``faults.fault_point("area.event")`` and friends); an unregistered site is
+invisible to operators, and an untested one is a recovery path that has
+never run.  This pass cross-checks three sources:
+
+  - **used** sites: every string-literal site passed to ``fault_point`` /
+    ``fire`` / ``hook`` / ``sync_probe`` / ``retrying(site=...)`` in the
+    scanned files;
+  - **registered** sites: ``tools/cctlint/fault_sites.py``;
+  - **tested** sites: site names appearing in the chaos tests
+    (``tests/test_faults.py``, ``tests/test_serve_e2e.py``, plus any
+    ``tests/test_*.py`` that mentions ``CCT_FAULTS``).
+
+CCT301  used but unregistered site (always checked).
+CCT302  registered site that no scanned code uses (stale registry entry).
+CCT303  registered site never named in a chaos test.
+
+CCT302/CCT303 need the whole package in view to be meaningful, so they only
+fire on full-repo runs — detected by ``utils/faults.py`` being in the
+scanned set.  There is deliberately no pragma for this family: fix coverage,
+don't waive it.
+"""
+
+from __future__ import annotations
+
+import ast
+import glob
+import os
+
+from .core import Finding, LintContext, terminal_name
+
+SITE_CALL_TERMINALS = {"fault_point", "fire", "hook", "sync_probe", "armed"}
+CHAOS_FILES = ("tests/test_faults.py", "tests/test_serve_e2e.py")
+
+
+def _used_sites(ctx: LintContext) -> dict[str, list[tuple[str, int]]]:
+    """site -> [(rel path, line), ...] across scanned files."""
+    used: dict[str, list[tuple[str, int]]] = {}
+
+    def note(site: str, rel: str, line: int) -> None:
+        used.setdefault(site, []).append((rel, line))
+
+    for src in ctx.parsed():
+        # faults.py itself defines the machinery; its calls take variables.
+        if src.parts[-1] == "faults.py" and "utils" in src.parts:
+            continue
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            term = terminal_name(node)
+            if term in SITE_CALL_TERMINALS and node.args and \
+                    isinstance(node.args[0], ast.Constant) and \
+                    isinstance(node.args[0].value, str):
+                note(node.args[0].value, src.rel, node.lineno)
+            elif term == "retrying":
+                for kw in node.keywords:
+                    if kw.arg == "site" and \
+                            isinstance(kw.value, ast.Constant) and \
+                            isinstance(kw.value.value, str):
+                        note(kw.value.value, src.rel, node.lineno)
+    return used
+
+
+def _chaos_text(ctx: LintContext) -> str:
+    override = ctx.overrides.get("chaos_files")
+    if override is not None:
+        paths = list(override)
+    else:
+        paths = [os.path.join(ctx.root, p) for p in CHAOS_FILES]
+        for p in sorted(glob.glob(os.path.join(ctx.root, "tests", "test_*.py"))):
+            if p in paths:
+                continue
+            try:
+                with open(p, "r", encoding="utf-8") as fh:
+                    text = fh.read()
+            except OSError:
+                continue
+            if "CCT_FAULTS" in text:
+                paths.append(p)
+    chunks = []
+    for p in paths:
+        try:
+            with open(p, "r", encoding="utf-8") as fh:
+                chunks.append(fh.read())
+        except OSError:
+            continue
+    return "\n".join(chunks)
+
+
+def run(ctx: LintContext) -> list[Finding]:
+    registry = ctx.overrides.get("fault_registry")
+    if registry is None:
+        from .fault_sites import FAULT_SITES as registry
+
+    used = _used_sites(ctx)
+    findings: list[Finding] = []
+
+    for site in sorted(used):
+        if site not in registry:
+            rel, line = used[site][0]
+            findings.append(Finding(
+                "CCT301", rel, line,
+                f"fault site '{site}' is not registered — add it to "
+                "tools/cctlint/fault_sites.py with a one-line description",
+                "faultcov"))
+
+    full_repo = any(
+        f.parts[-1] == "faults.py" and "utils" in f.parts for f in ctx.files)
+    if not full_repo:
+        return findings
+
+    registry_rel = "tools/cctlint/fault_sites.py"
+    chaos = _chaos_text(ctx)
+    for site in sorted(registry):
+        if site not in used:
+            findings.append(Finding(
+                "CCT302", registry_rel, 1,
+                f"registered fault site '{site}' is used nowhere in the "
+                "scanned code — remove the stale entry or wire the site",
+                "faultcov"))
+        elif site not in chaos:
+            findings.append(Finding(
+                "CCT303", registry_rel, 1,
+                f"fault site '{site}' is never exercised by a chaos test "
+                "(tests/test_faults.py / tests/test_serve_e2e.py / any "
+                "tests/test_*.py using CCT_FAULTS) — its recovery path has "
+                "never run", "faultcov"))
+    return findings

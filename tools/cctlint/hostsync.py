@@ -1,0 +1,164 @@
+"""Pass 1 — host<->device sync discipline (CCT1xx).
+
+CCT101  sync call (``device_get`` / ``block_until_ready`` / ``.item()`` /
+        ``np.asarray``) reachable from a jitted / vmapped / shard_map'd
+        region — a host sync inside device code either breaks tracing or
+        silently serialises the async dispatch pipeline.
+CCT102  ``device_get`` / ``block_until_ready`` / ``.item()`` in host code
+        under ``ops/`` / ``parallel/`` / ``stages/`` — stage-boundary syncs
+        are sometimes legitimate but must carry an explicit
+        ``# cct: allow-transfer(reason)`` pragma.
+CCT103  ``np.asarray(jax.device_get(...))`` — ``device_get`` already
+        returns host ndarrays, so the outer ``asarray`` is a second copy.
+
+Device regions are found statically: decorator forms (``@jax.jit``,
+``@partial(jax.jit, ...)``), names passed into ``jit``/``pjit``/``vmap``/
+``pmap``/``shard_map`` calls (through ``partial(...)`` and nested wrapper
+calls), and a fixpoint over module-local calls so helpers invoked from
+device code are device code too.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, LintContext, SourceFile, call_name, terminal_name
+
+DEVICE_WRAPPERS = {"jit", "pjit", "vmap", "pmap", "shard_map", "_shard_map"}
+SYNC_TERMINALS = {"device_get", "block_until_ready"}
+ASARRAY_NAMES = {"np.asarray", "numpy.asarray", "onp.asarray", "np.array",
+                 "numpy.array"}
+HOST_SCOPE_DIRS = ("ops", "parallel", "stages")
+
+
+def _functions(tree: ast.AST) -> dict[str, ast.AST]:
+    """Every named function in the module keyed by its bare name (methods
+    included; collisions keep the first — fine for lint purposes)."""
+    out: dict[str, ast.AST] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.setdefault(node.name, node)
+    return out
+
+
+def _mark_wrapped(node: ast.AST, marked: set[str], lambdas: list[ast.Lambda],
+                  aliases: dict[str, str]) -> None:
+    """Record function names reachable through a device-wrapper argument:
+    bare names, ``partial(fn, ...)``, nested wrapper calls, and lambdas."""
+    if isinstance(node, ast.Name):
+        marked.add(aliases.get(node.id, node.id))
+    elif isinstance(node, ast.Lambda):
+        lambdas.append(node)
+    elif isinstance(node, ast.Call):
+        term = terminal_name(node)
+        if term in DEVICE_WRAPPERS or term == "partial":
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                _mark_wrapped(arg, marked, lambdas, aliases)
+        elif isinstance(node.func, ast.Name):
+            # factory call jitted directly: jax.jit(_make_fn(...)) — the
+            # factory's nested defs are the device code.
+            marked.add(aliases.get(node.func.id, node.func.id))
+
+
+def _device_regions(src: SourceFile):
+    """(device function nodes, device lambdas) for one module."""
+    tree = src.tree
+    funcs = _functions(tree)
+    aliases: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            tgt = node.targets[0].id
+            if isinstance(node.value, ast.Name):
+                aliases[tgt] = node.value.id
+            elif isinstance(node.value, ast.Call) and \
+                    terminal_name(node.value) == "partial" and node.value.args \
+                    and isinstance(node.value.args[0], ast.Name):
+                aliases[tgt] = node.value.args[0].id
+
+    marked: set[str] = set()
+    lambdas: list[ast.Lambda] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and terminal_name(node) in DEVICE_WRAPPERS:
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                _mark_wrapped(arg, marked, lambdas, aliases)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                term = terminal_name(dec)
+                if term in DEVICE_WRAPPERS:
+                    marked.add(node.name)
+                elif isinstance(dec, ast.Call) and term == "partial" and \
+                        dec.args and terminal_name(dec.args[0]) in DEVICE_WRAPPERS:
+                    marked.add(node.name)
+
+    # Fixpoint: device code calling a module-local function makes that
+    # function device code too.
+    frontier = {n for n in marked if n in funcs}
+    device = set(frontier)
+    while frontier:
+        nxt: set[str] = set()
+        for name in sorted(frontier):
+            for node in ast.walk(funcs[name]):
+                if isinstance(node, ast.Call) and \
+                        isinstance(node.func, ast.Name):
+                    callee = aliases.get(node.func.id, node.func.id)
+                    if callee in funcs and callee not in device:
+                        nxt.add(callee)
+        device |= nxt
+        frontier = nxt
+    return [funcs[n] for n in sorted(device)], lambdas
+
+
+def _sync_call(node: ast.Call) -> str | None:
+    """Classify a call as a host sync; returns a description or None."""
+    term = terminal_name(node)
+    if term in SYNC_TERMINALS:
+        return call_name(node) or term
+    if term == "item" and not node.args and not node.keywords and \
+            isinstance(node.func, ast.Attribute):
+        return ".item()"
+    return None
+
+
+def run(ctx: LintContext) -> list[Finding]:
+    findings: list[Finding] = []
+    for src in ctx.parsed():
+        flagged_101: set[int] = set()
+        regions, lambdas = _device_regions(src)
+        for region in regions + lambdas:
+            for node in ast.walk(region):
+                if not isinstance(node, ast.Call):
+                    continue
+                desc = _sync_call(node)
+                if desc is None and call_name(node) in ASARRAY_NAMES:
+                    desc = call_name(node)
+                if desc is not None:
+                    flagged_101.add(node.lineno)
+                    findings.append(Finding(
+                        "CCT101", src.rel, node.lineno,
+                        f"host sync '{desc}' inside a jitted/shard_map'd "
+                        "region — hoist it out of the device function",
+                        "hostsync"))
+
+        scope_dir = next(
+            (p for p in src.parts[:-1] if p in HOST_SCOPE_DIRS), None)
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if call_name(node) in ASARRAY_NAMES and node.args and \
+                    isinstance(node.args[0], ast.Call) and \
+                    terminal_name(node.args[0]) == "device_get":
+                findings.append(Finding(
+                    "CCT103", src.rel, node.lineno,
+                    "np.asarray(jax.device_get(...)) copies the host array "
+                    "twice — device_get already returns ndarrays",
+                    "hostsync"))
+            if scope_dir is not None and node.lineno not in flagged_101:
+                desc = _sync_call(node)
+                if desc is not None:
+                    findings.append(Finding(
+                        "CCT102", src.rel, node.lineno,
+                        f"host sync '{desc}' in {scope_dir}/ — stage-"
+                        "boundary syncs need '# cct: allow-transfer(reason)'",
+                        "hostsync"))
+    return findings

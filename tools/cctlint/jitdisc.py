@@ -1,0 +1,51 @@
+"""Pass 5 — jit discipline (CCT5xx).
+
+``serve/warmup.py`` pre-compiles the bucketed kernel set once so the daemon
+never recompiles on the request path; a stray ``jax.jit`` outside the
+approved wrappers creates a second compilation cache entry the warmer
+doesn't know about — a recompile storm waiting for the first oddly-shaped
+batch.  Rule:
+
+CCT501  ``jax.jit`` / ``pjit`` call or decorator outside ``ops/`` and
+        ``parallel/mesh.py``.  Everything else must go through the
+        compiled wrappers those modules export.  Suppress a deliberate
+        exception with ``# cct: allow-jit(reason)``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, LintContext, SourceFile, call_name, terminal_name
+
+JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.pjit", "pjit.pjit"}
+
+
+def _approved(src: SourceFile) -> bool:
+    return "ops" in src.parts[:-1] or \
+        src.rel.endswith("parallel/mesh.py")
+
+
+def run(ctx: LintContext) -> list[Finding]:
+    findings: list[Finding] = []
+    for src in ctx.parsed():
+        if _approved(src):
+            continue
+        for node in ast.walk(src.tree):
+            targets: list[tuple[ast.AST, str]] = []
+            if isinstance(node, ast.Call):
+                name = call_name(node)
+                if name in JIT_NAMES or terminal_name(node) == "pjit":
+                    targets.append((node, name or terminal_name(node)))
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    name = call_name(dec)
+                    if name in JIT_NAMES or terminal_name(dec) == "pjit":
+                        targets.append((dec, name or terminal_name(dec)))
+            for tgt, name in targets:
+                findings.append(Finding(
+                    "CCT501", src.rel, tgt.lineno,
+                    f"direct '{name}' outside ops/ and parallel/mesh.py — "
+                    "use the compiled wrappers there so serve/warmup.py's "
+                    "pre-compilation covers every kernel", "jitdisc"))
+    return findings

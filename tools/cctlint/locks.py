@@ -1,0 +1,223 @@
+"""Pass 4 — lock discipline / static race checks (CCT4xx).
+
+Aimed at ``serve/scheduler.py`` (the one place the pipeline holds locks on
+a latency-critical path) but runs over every scanned file.  Two rules:
+
+CCT401  inconsistent lock ordering: the pass builds a lock-acquisition
+        graph from ``with <lock>:`` nesting — including one level of
+        cross-function/constructor resolution (``with self._cond: ...
+        Job(spec)`` sees the locks ``Job.__init__`` takes) — and rejects
+        any cycle, the static shape of an AB/BA deadlock.
+CCT402  blocking call while holding a lock: ``time.sleep``, subprocess
+        spawns, ``open()``, socket ``accept``/``recv``/``sendall``/
+        ``connect``, ``.join()``, and ``.wait()`` on anything that is not
+        the currently-held condition (``cond.wait()`` inside ``with cond:``
+        is the sanctioned pattern — it releases; ``event.wait()`` under a
+        different lock stalls every other thread).
+
+Lock objects are recognised by their constructors (``threading.Lock`` /
+``RLock`` / ``Condition`` / ``Semaphore`` and the sanitizer's
+``tracked_lock`` / ``tracked_condition``).  Suppress intended cases with
+``# cct: allow-lock(reason)``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, LintContext, SourceFile, call_name, terminal_name
+
+LOCK_CONSTRUCTORS = {
+    "Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore",
+    "tracked_lock", "tracked_condition",
+}
+BLOCKING_NAMES = {
+    "time.sleep", "subprocess.run", "subprocess.call",
+    "subprocess.check_call", "subprocess.check_output", "subprocess.Popen",
+    "open",
+}
+BLOCKING_SOCKET_TERMINALS = {"accept", "recv", "recv_into", "sendall",
+                             "connect"}
+
+
+def _is_lock_ctor(node: ast.AST) -> bool:
+    return isinstance(node, ast.Call) and \
+        terminal_name(node) in LOCK_CONSTRUCTORS
+
+
+class _FileLocks:
+    """Lock inventory for one module: attribute locks (``self._cond``,
+    class-level ``_id_lock``) and bare-name locks, plus the set of locks
+    each function/constructor acquires anywhere in its body."""
+
+    def __init__(self, src: SourceFile):
+        self.src = src
+        self.attr_locks: set[str] = set()
+        self.name_locks: set[str] = set()
+        tree = src.tree
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) and _is_lock_ctor(node.value):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Attribute):
+                        self.attr_locks.add(tgt.attr)
+                    elif isinstance(tgt, ast.Name):
+                        self.name_locks.add(tgt.id)
+            elif isinstance(node, ast.AnnAssign) and \
+                    _is_lock_ctor(node.value):
+                if isinstance(node.target, ast.Attribute):
+                    self.attr_locks.add(node.target.attr)
+                elif isinstance(node.target, ast.Name):
+                    self.name_locks.add(node.target.id)
+        # Class-level assignments are attribute locks (Job._id_lock).
+        for cls in ast.walk(tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            for stmt in cls.body:
+                if isinstance(stmt, ast.Assign) and _is_lock_ctor(stmt.value):
+                    for tgt in stmt.targets:
+                        if isinstance(tgt, ast.Name):
+                            self.attr_locks.add(tgt.id)
+                            self.name_locks.discard(tgt.id)
+
+        # function / class-constructor name -> locks acquired in its body
+        self.callee_locks: dict[str, set[str]] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                acquired = {
+                    lid for w in ast.walk(node)
+                    if isinstance(w, (ast.With, ast.AsyncWith))
+                    for item in w.items
+                    if (lid := self.lock_id(item.context_expr)) is not None
+                }
+                if acquired:
+                    self.callee_locks.setdefault(node.name, set()).update(
+                        acquired)
+        for cls in ast.walk(tree):
+            if isinstance(cls, ast.ClassDef):
+                for stmt in cls.body:
+                    if isinstance(stmt, ast.FunctionDef) and \
+                            stmt.name == "__init__" and \
+                            stmt.name in self.callee_locks:
+                        self.callee_locks.setdefault(cls.name, set()).update(
+                            self.callee_locks["__init__"])
+
+    def lock_id(self, expr: ast.AST) -> str | None:
+        if isinstance(expr, ast.Attribute) and expr.attr in self.attr_locks:
+            return expr.attr
+        if isinstance(expr, ast.Name) and expr.id in self.name_locks:
+            return expr.id
+        return None
+
+
+def _visit_function(src: SourceFile, inv: _FileLocks, fn: ast.AST,
+                    edges: dict[tuple[str, str], tuple[str, int]],
+                    findings: list[Finding]) -> None:
+    def walk(node: ast.AST, held: tuple[str, ...]) -> None:
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            new_held = held
+            for item in node.items:
+                lid = inv.lock_id(item.context_expr)
+                if lid is not None:
+                    for h in new_held:
+                        if h != lid:
+                            edges.setdefault((h, lid), (src.rel, node.lineno))
+                    new_held = new_held + (lid,)
+                else:
+                    walk(item.context_expr, held)
+            for child in node.body:
+                walk(child, new_held)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)) \
+                and node is not fn:
+            return  # nested defs execute later, outside this lock scope
+        if isinstance(node, ast.Call) and held:
+            _check_call(src, inv, node, held, edges, findings)
+        for child in ast.iter_child_nodes(node):
+            walk(child, held)
+
+    walk(fn, ())
+
+
+def _check_call(src: SourceFile, inv: _FileLocks, node: ast.Call,
+                held: tuple[str, ...],
+                edges: dict[tuple[str, str], tuple[str, int]],
+                findings: list[Finding]) -> None:
+    name = call_name(node)
+    term = terminal_name(node)
+    holding = "/".join(held)
+
+    # one-level cross-function edges: f() or Cls() acquiring locks inside
+    for lid in sorted(inv.callee_locks.get(term, ())):
+        for h in held:
+            if h != lid:
+                edges.setdefault((h, lid), (src.rel, node.lineno))
+
+    if name in BLOCKING_NAMES or term in BLOCKING_SOCKET_TERMINALS:
+        findings.append(Finding(
+            "CCT402", src.rel, node.lineno,
+            f"blocking call '{name or term}' while holding lock(s) "
+            f"'{holding}' — stalls every thread contending for them",
+            "locks"))
+    elif term == "join" and not node.args and all(
+            kw.arg == "timeout" for kw in node.keywords):
+        findings.append(Finding(
+            "CCT402", src.rel, node.lineno,
+            f"thread/process join while holding lock(s) '{holding}' — "
+            "the joined thread may need those locks to finish", "locks"))
+    elif term == "wait":
+        rid = None
+        if isinstance(node.func, ast.Attribute):
+            rid = inv.lock_id(node.func.value)
+        if rid is None or rid not in held:
+            findings.append(Finding(
+                "CCT402", src.rel, node.lineno,
+                f"wait() on a foreign object while holding lock(s) "
+                f"'{holding}' — only the held condition's own wait() "
+                "releases the lock", "locks"))
+
+
+def _report_cycles(edges: dict[tuple[str, str], tuple[str, int]],
+                   findings: list[Finding]) -> None:
+    graph: dict[str, set[str]] = {}
+    for (a, b) in edges:
+        graph.setdefault(a, set()).add(b)
+        graph.setdefault(b, set())
+
+    # DFS with colouring; report each back edge as one ordering violation.
+    colour: dict[str, int] = {}
+    stack: list[str] = []
+
+    def dfs(n: str) -> None:
+        colour[n] = 1
+        stack.append(n)
+        for m in sorted(graph[n]):
+            if colour.get(m, 0) == 0:
+                dfs(m)
+            elif colour.get(m) == 1:
+                cycle = stack[stack.index(m):] + [m]
+                rel, line = edges[(n, m)]
+                findings.append(Finding(
+                    "CCT401", rel, line,
+                    "inconsistent lock ordering: cycle "
+                    f"{' -> '.join(cycle)} — acquire these locks in one "
+                    "global order everywhere", "locks"))
+        stack.pop()
+        colour[n] = 2
+
+    for n in sorted(graph):
+        if colour.get(n, 0) == 0:
+            dfs(n)
+
+
+def run(ctx: LintContext) -> list[Finding]:
+    findings: list[Finding] = []
+    for src in ctx.parsed():
+        inv = _FileLocks(src)
+        if not (inv.attr_locks or inv.name_locks):
+            continue
+        edges: dict[tuple[str, str], tuple[str, int]] = {}
+        for node in ast.walk(src.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                _visit_function(src, inv, node, edges, findings)
+        _report_cycles(edges, findings)
+    return findings
