@@ -739,13 +739,47 @@ def _nonempty(path: str) -> bool:
 
 # ---------------------------------------------------------------------- serve
 
+def _serve_child_argv(args) -> list[str]:
+    """Rebuild the serve subcommand argv for the supervised daemon child —
+    the resolved values (flag > config > builtin), minus --supervise."""
+    argv = ["serve"]
+    for flag in ("socket", "host", "warmup_shapes", "compile_cache",
+                 "journal", "backend"):
+        value = getattr(args, flag, None)
+        if value:
+            argv += [f"--{flag}", str(value)]
+    for flag in ("port", "queue_bound", "gang_size", "max_batch"):
+        argv += [f"--{flag}", str(int(getattr(args, flag)))]
+    for flag in ("drain_s", "result_ttl_s", "warmup_budget_s"):
+        value = getattr(args, flag, None)
+        if value not in (None, ""):
+            argv += [f"--{flag}", str(value)]
+    return argv
+
+
 def serve_cmd(args) -> None:
     """Run the persistent consensus daemon (serve/ subsystem): warm the
     kernels once, then accept jobs over a unix socket or localhost TCP.
+    With --journal every accepted job is write-ahead journaled and a
+    restart replays unfinished work; with --supervise this process runs
+    the restart loop and the daemon runs as a child.
     Lazy imports: serve pulls in the scheduler/server only when used."""
+    if _bool(getattr(args, "supervise", "False")):
+        from consensuscruncher_tpu.serve.supervisor import (
+            child_command, run_supervised,
+        )
+
+        rc = run_supervised(child_command(_serve_child_argv(args)),
+                            max_restarts=int(args.max_restarts))
+        if rc:
+            raise SystemExit(rc)
+        return
+
     from consensuscruncher_tpu.serve import warmup
     from consensuscruncher_tpu.serve.scheduler import Scheduler
-    from consensuscruncher_tpu.serve.server import ServeServer
+    from consensuscruncher_tpu.serve.server import (
+        ServeServer, install_signal_handlers,
+    )
     from consensuscruncher_tpu.utils.backend_probe import ensure_backend
 
     backend = args.backend
@@ -758,26 +792,60 @@ def serve_cmd(args) -> None:
             print(f"serve: persistent compile cache at {args.compile_cache}")
     shapes = warmup.parse_shapes(args.warmup_shapes)
     if shapes:
-        n = warmup.warm_shapes(shapes)
+        budget = getattr(args, "warmup_budget_s", None)
+        budget = float(budget) if budget not in (None, "") else None
+        n = warmup.warm_shapes(shapes, budget_s=budget)
         print(f"serve: precompiled {n}/{len(shapes)} warmup shapes")
+
+    journal = None
+    if getattr(args, "journal", None):
+        from consensuscruncher_tpu.serve.journal import Journal
+
+        journal = Journal(args.journal, max_bytes=int(os.environ.get(
+            "CCT_SERVE_JOURNAL_MAX_BYTES", str(1 << 20))))
+    drain_s = getattr(args, "drain_s", None)
+    if drain_s in (None, ""):
+        drain_s = os.environ.get("CCT_SERVE_DRAIN_S", "30")
+    drain_s = float(drain_s)
+    result_ttl_s = getattr(args, "result_ttl_s", None)
+    result_ttl_s = float(result_ttl_s) if result_ttl_s not in (None, "") else None
 
     scheduler = Scheduler(
         queue_bound=int(args.queue_bound), gang_size=int(args.gang_size),
         backend=backend, max_batch=int(args.max_batch),
+        journal=journal, result_ttl_s=result_ttl_s,
     )
     server = ServeServer(
         scheduler, host=args.host, port=int(args.port),
         socket_path=args.socket or None,
     )
+    install_signal_handlers(server, scheduler, journal)
     print(f"serve: listening on {server.describe()} "
           f"(queue_bound={scheduler.queue_bound}, "
-          f"gang_size={scheduler.gang_size})", flush=True)
+          f"gang_size={scheduler.gang_size}"
+          + (f", journal={journal.path}" if journal else "")
+          + ")", flush=True)
     try:
         server.serve_forever()
     except KeyboardInterrupt:
-        print("serve: draining on interrupt", flush=True)
-        server.close()
-        scheduler.close()
+        pass  # pre-handler window only; handlers replace SIGINT
+    # SIGTERM/SIGINT landed (or the listener died): bounded graceful drain
+    print(f"serve: draining (up to {drain_s:g}s)", flush=True)
+    try:
+        scheduler.drain(timeout=drain_s)
+    except TimeoutError:
+        pending = scheduler.healthz()
+        print(f"WARNING: drain timed out after {drain_s:g}s "
+              f"({pending['queued']} queued, {pending['running']} running); "
+              + ("unfinished jobs stay journaled and replay on restart"
+                 if journal else
+                 "unfinished jobs are LOST (no --journal)"),
+              file=sys.stderr, flush=True)
+    server.close()
+    scheduler.shutdown()
+    if journal is not None:
+        journal.close()
+    print("serve: shutdown complete", flush=True)
 
 
 def submit_cmd(args) -> None:
@@ -798,11 +866,17 @@ def submit_cmd(args) -> None:
         "bdelim": args.bdelim,
         "compress_level": args.compress_level,
     }
-    job_id = client.submit(spec)
-    print(f"submit: job {job_id} queued on {address}")
+    if getattr(args, "deadline_s", None) not in (None, ""):
+        spec["deadline_s"] = float(args.deadline_s)
+    sub = client.submit_full(spec)
+    job_id = sub["job_id"]
+    print(f"submit: job {job_id} queued on {address} (key {sub['key']}"
+          + (", duplicate of an existing job" if sub.get("duplicate") else "")
+          + ")")
     if not _bool(getattr(args, "wait", "True")):
         return
-    job = client.result(job_id)
+    # poll by idempotency key: survives a daemon restart mid-wait
+    job = client.result(key=sub["key"])
     if job["state"] != "done":
         raise SystemExit(f"submit: job {job_id} {job['state']}: {job.get('error')}")
     base = (job.get("outputs") or {}).get("base")
@@ -946,12 +1020,37 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--compile_cache",
                    help="persistent JAX compilation cache directory "
                         "(survives daemon restarts); empty = in-process only")
+    s.add_argument("--journal",
+                   help="write-ahead job journal path: accepted jobs are "
+                        "fsync'd before the submit reply and replayed on "
+                        "restart (crash-safe, exactly-once outputs); "
+                        "empty = in-memory only")
+    s.add_argument("--drain_s",
+                   help="bounded graceful-shutdown window on SIGTERM/SIGINT "
+                        "(default $CCT_SERVE_DRAIN_S or 30); unfinished "
+                        "jobs stay journaled for replay")
+    s.add_argument("--result_ttl_s",
+                   help="evict done/failed job records from memory after "
+                        "this many seconds (default $CCT_SERVE_RESULT_TTL_S "
+                        "or 600); outputs stay on disk")
+    s.add_argument("--warmup_budget_s",
+                   help="cap total warmup-shape compile wall so a "
+                        "supervised restart serves again quickly; "
+                        "empty = no cap")
+    s.add_argument("--supervise",
+                   help="run the daemon as a supervised child restarted "
+                        "with capped backoff on crash (default False)")
+    s.add_argument("--max_restarts", type=int,
+                   help="supervised-restart budget before giving up "
+                        "(default 10)")
     s.set_defaults(func=serve_cmd, config_section="serve", required_args=(),
                    builtin_defaults={
                        "socket": "", "host": "127.0.0.1", "port": 7733,
                        "queue_bound": 16, "gang_size": 4, "max_batch": 1024,
                        "backend": "tpu", "warmup_shapes": "",
-                       "compile_cache": "",
+                       "compile_cache": "", "journal": "", "drain_s": "",
+                       "result_ttl_s": "", "warmup_budget_s": "",
+                       "supervise": "False", "max_restarts": 10,
                    })
 
     u = sub.add_parser(
@@ -971,6 +1070,10 @@ def build_parser() -> argparse.ArgumentParser:
     u.add_argument("--compress_level", type=int, choices=range(0, 10),
                    metavar="0-9")
     u.add_argument("--wait", help="block until the job finishes (default True)")
+    u.add_argument("--deadline_s", type=float,
+                   help="shed the job at admission (or dispatch) if it "
+                        "cannot finish within this many seconds at the "
+                        "daemon's observed service rate; unset = no deadline")
     u.set_defaults(func=submit_cmd, config_section="serve",
                    required_args=("input", "output"),
                    builtin_defaults={

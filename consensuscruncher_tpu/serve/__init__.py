@@ -19,7 +19,13 @@ subsystem is the standard inference-stack answer:
   persistent JAX compilation cache directory, so cold-compile is paid once
   per server lifetime, not per sample.
 - :mod:`.client`    — blocking client used by the ``submit`` subcommand
-  and the tests.
+  and the tests; reconnects with capped backoff and polls by idempotency
+  key, so a daemon restart is invisible to a waiting client.
+- :mod:`.journal`   — write-ahead job journal (fsync'd NDJSON, atomic
+  checkpoint rotation): every accepted job survives a daemon crash and
+  replays byte-identically through ``--resume`` on restart.
+- :mod:`.supervisor`— ``serve --supervise`` restart loop with capped
+  exponential backoff for crashed daemons.
 
 The subsystem composes with the fault-tolerance layer rather than
 duplicating it: outputs commit through ``utils.manifest.commit_file``
@@ -29,6 +35,8 @@ duplicating it: outputs commit through ``utils.manifest.commit_file``
 chaos-testable.
 """
 
-from consensuscruncher_tpu.serve.scheduler import AdmissionRefused, Job, Scheduler
+from consensuscruncher_tpu.serve.scheduler import (
+    AdmissionRefused, DeadlineShed, Job, Scheduler,
+)
 
-__all__ = ["AdmissionRefused", "Job", "Scheduler"]
+__all__ = ["AdmissionRefused", "DeadlineShed", "Job", "Scheduler"]

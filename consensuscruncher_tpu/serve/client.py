@@ -4,12 +4,27 @@ One connection per request keeps the client stateless and retry-friendly;
 the blocking ``result`` op simply holds its connection open until the
 daemon replies (the server waits on the scheduler's condition, not the
 socket, so a long job costs one idle descriptor, not a busy loop).
+
+Restart-invisible polling: every transport failure (connection refused
+while the supervisor restarts the daemon, connection reset by a crash,
+``shutdown: true`` replies during a drain) is retried with capped
+exponential backoff, and ``status``/``result`` can poll by the submit
+reply's **idempotency key** instead of the job id.  The key is derived
+from the spec, so it resolves against the restarted daemon's journal-
+replayed jobs; resubmitting the same spec is also safe (the daemon
+dedupes on the key).  A polling client therefore survives a daemon
+kill/restart without ever learning it happened.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import socket
+import sys
+import time
+
+from consensuscruncher_tpu.utils import faults
 
 
 class ServeClientError(RuntimeError):
@@ -21,13 +36,26 @@ class ServeClientError(RuntimeError):
 
 
 class ServeClient:
-    """``address`` is a unix socket path (str) or a ``(host, port)`` pair."""
+    """``address`` is a unix socket path (str) or a ``(host, port)`` pair.
 
-    def __init__(self, address, connect_timeout: float = 10.0):
+    ``retries`` transport-level reconnect attempts (default
+    ``CCT_SERVE_CLIENT_RETRIES`` or 5) with ``backoff_delay``-capped
+    sleeps between them; every op is idempotent so a blind resend is safe.
+    """
+
+    def __init__(self, address, connect_timeout: float = 10.0,
+                 retries: int | None = None,
+                 retry_base_s: float | None = None):
         self.address = address
         self.connect_timeout = connect_timeout
+        if retries is None:
+            retries = int(os.environ.get("CCT_SERVE_CLIENT_RETRIES", "5"))
+        self.retries = max(0, int(retries))
+        if retry_base_s is None:
+            retry_base_s = float(os.environ.get("CCT_RETRY_BASE_S", "0.5"))
+        self.retry_base_s = float(retry_base_s)
 
-    def _request(self, doc: dict, timeout: float | None = None) -> dict:
+    def _request_once(self, doc: dict, timeout: float | None = None) -> dict:
         if isinstance(self.address, str):
             sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         else:
@@ -43,7 +71,9 @@ class ServeClient:
             while b"\n" not in buf:
                 chunk = sock.recv(65536)
                 if not chunk:
-                    raise ServeClientError("daemon closed the connection")
+                    # a crash/restart mid-request: retryable transport loss
+                    raise ServeClientError("daemon closed the connection",
+                                           {"transport": True})
                 buf += chunk
             reply = json.loads(buf.split(b"\n", 1)[0])
         finally:
@@ -52,20 +82,57 @@ class ServeClient:
             raise ServeClientError(reply.get("error", "daemon error"), reply)
         return reply
 
+    @staticmethod
+    def _retryable(exc: Exception) -> bool:
+        if isinstance(exc, ServeClientError):
+            # connection died mid-exchange, or the daemon is drain-restarting
+            return bool(exc.reply.get("transport") or exc.reply.get("shutdown")
+                        or exc.reply.get("busy"))
+        # refused/reset while the supervisor restarts the daemon, read
+        # timeouts against a wedged process, missing unix socket, ...
+        return isinstance(exc, OSError)
+
+    def _request(self, doc: dict, timeout: float | None = None) -> dict:
+        attempts = self.retries + 1
+        for attempt in range(attempts):
+            try:
+                return self._request_once(doc, timeout)
+            except Exception as e:
+                if attempt + 1 >= attempts or not self._retryable(e):
+                    raise
+                delay = faults.backoff_delay(attempt + 1, self.retry_base_s, 5.0)
+                print(f"WARNING: serve client: {e}; reconnecting in "
+                      f"{delay:.1f}s (attempt {attempt + 2}/{attempts})",
+                      file=sys.stderr, flush=True)
+                time.sleep(delay)
+        raise AssertionError("unreachable")
+
     # ----------------------------------------------------------------- ops
 
+    @staticmethod
+    def _ref(job_id, key) -> dict:
+        if key is not None:
+            return {"key": key}
+        return {"job_id": job_id}
+
     def submit(self, spec: dict) -> int:
-        return int(self._request({"op": "submit", "spec": spec})["job_id"])
+        return int(self.submit_full(spec)["job_id"])
 
-    def status(self, job_id: int) -> dict:
-        return self._request({"op": "status", "job_id": job_id})["job"]
+    def submit_full(self, spec: dict) -> dict:
+        """Submit and return the full reply (``job_id``, ``key``,
+        ``duplicate``) — poll by ``key`` to survive daemon restarts."""
+        return self._request({"op": "submit", "spec": spec})
 
-    def result(self, job_id: int, timeout: float | None = None) -> dict:
+    def status(self, job_id: int | None = None, *, key: str | None = None) -> dict:
+        return self._request({"op": "status", **self._ref(job_id, key)})["job"]
+
+    def result(self, job_id: int | None = None, timeout: float | None = None,
+               *, key: str | None = None) -> dict:
         """Block until the job is done/failed; returns the job description.
         ``timeout`` bounds both the server-side wait and the socket read."""
         sock_timeout = None if timeout is None else timeout + 10.0
         return self._request(
-            {"op": "result", "job_id": job_id, "timeout": timeout},
+            {"op": "result", "timeout": timeout, **self._ref(job_id, key)},
             timeout=sock_timeout,
         )["job"]
 
@@ -80,8 +147,11 @@ class ServeClient:
         self._request({"op": "drain", "timeout": timeout}, timeout=sock_timeout)
 
     def run(self, spec: dict, timeout: float | None = None) -> dict:
-        """submit + blocking result; raises on a failed job."""
-        job = self.result(self.submit(spec), timeout=timeout)
+        """submit + blocking result; raises on a failed job.  Polls by the
+        idempotency key, so the job is found again even if the daemon
+        restarted between the submit and the result."""
+        sub = self.submit_full(spec)
+        job = self.result(timeout=timeout, key=sub["key"])
         if job["state"] != "done":
             raise ServeClientError(
                 f"job {job['job_id']} {job['state']}: {job.get('error')}", job)

@@ -1,0 +1,239 @@
+"""Write-ahead job journal: the daemon's crash-durability spine.
+
+Every accepted job appends one fsync'd NDJSON record BEFORE the submit
+reply leaves the daemon, and appends again on every lifecycle transition
+(``accepted -> dispatched -> done | failed``).  A crash — SIGKILL, OOM,
+power loss — therefore never loses an acknowledged job: on startup the
+scheduler replays the journal and re-enqueues every job not provably
+terminal.  Replay is **exactly-once at the output level** even though a
+job may *run* more than once, because each replayed job finishes through
+the per-job manifest ``--resume`` path: stages whose atomically-committed
+outputs are intact are skipped, and the rest re-run byte-identically
+(PR 1's commit_file discipline guarantees no partial output exists to
+resume over).
+
+Record format (one JSON object per line, ``sort_keys`` + compact
+separators so the bytes are deterministic):
+
+  {"deadline_s": null, "id": 3, "key": "9c0f...", "rec": "job",
+   "spec": {...}, "state": "accepted", "v": 1}
+  {"id": 3, "rec": "job", "state": "dispatched", "v": 1}
+  {"id": 3, "outputs": {...}, "rec": "job", "state": "done",
+   "v": 1, "wall_s": 4.21}
+  {"kind": "drain", "rec": "marker", "v": 1}
+
+Later records for an id merge over earlier ones, so transition records
+carry only the delta.  The ``drain`` marker distinguishes a clean
+SIGTERM shutdown from a crash in post-mortem reads (replay semantics are
+identical either way — only what the journal *proves* matters).
+
+Durability mechanics:
+
+- appends go through a single pre-opened ``O_APPEND`` fd with
+  ``os.fsync`` after every record — a submit is acknowledged only once
+  its record is on disk;
+- rotation (checkpointing) writes a compacted snapshot to a temp file
+  and swaps it in via ``manifest.commit_file`` (fsync + rename +
+  dir-fsync), the same atomic-commit primitive the stage writers use;
+- replay tolerates a torn final record (a crash mid-append leaves a
+  truncated last line): it is logged and skipped, never fatal.  A torn
+  *accepted* record means the submit reply cannot have been sent, so
+  dropping it is correct, not lossy.
+
+Fault sites: ``serve.journal_write`` (append path — an armed fault makes
+the submit refuse instead of acknowledging an unjournaled job) and
+``serve.journal_replay`` (per-record replay — a corrupt record is
+skipped and logged, the rest of the journal still recovers).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import sys
+import tempfile
+
+from consensuscruncher_tpu.utils import faults, sanitize
+from consensuscruncher_tpu.utils.manifest import commit_file
+
+#: Spec fields that define a job's identity for idempotent resubmit.
+#: ``deadline_s`` is deliberately excluded: resubmitting the same work
+#: with a different deadline must still dedupe onto the running job.
+KEY_FIELDS = ("input", "output", "name", "cutoff", "qualscore", "scorrect",
+              "max_mismatch", "bdelim", "compress_level")
+
+
+def idempotency_key(spec: dict) -> str:
+    """Stable identity of a job spec: sha256 over the sorted-keys compact
+    JSON of the normalized identity fields.  Two submits of the same work
+    hash identically regardless of field order or extra protocol keys."""
+    ident = {k: spec.get(k) for k in KEY_FIELDS if spec.get(k) is not None}
+    blob = json.dumps(ident, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+def job_record(job_id: int, state: str, *, key: str | None = None,
+               spec: dict | None = None, deadline_s: float | None = None,
+               outputs: dict | None = None, error: str | None = None,
+               wall_s: float | None = None) -> dict:
+    """One journal record; only non-None fields are written (transition
+    records carry just the delta, replay merges by id)."""
+    rec: dict = {"v": 1, "rec": "job", "id": int(job_id), "state": state}
+    for field, value in (("key", key), ("spec", spec),
+                         ("deadline_s", deadline_s), ("outputs", outputs),
+                         ("error", error), ("wall_s", wall_s)):
+        if value is not None:
+            rec[field] = value
+    return rec
+
+
+def _encode(doc: dict) -> bytes:
+    return json.dumps(doc, sort_keys=True, separators=(",", ":")).encode() + b"\n"
+
+
+class Journal:
+    """Append-only fsync'd NDJSON journal with atomic checkpoint rotation.
+
+    ``max_bytes`` is advisory: the owner checks :meth:`size` and calls
+    :meth:`rotate` with a compacted snapshot when the file outgrows it.
+    """
+
+    def __init__(self, path: str, max_bytes: int | None = None):
+        self.path = str(path)
+        self.max_bytes = max_bytes
+        parent = os.path.dirname(os.path.abspath(self.path))
+        os.makedirs(parent, exist_ok=True)
+        # lock-order asserted under CCT_SANITIZE=1; the fd + fsync happen
+        # under it so concurrent appends cannot interleave half-records
+        self._lock = sanitize.tracked_lock("journal.lock")
+        self._fd = os.open(self.path, os.O_APPEND | os.O_CREAT | os.O_WRONLY,
+                           0o644)
+        self._size = os.fstat(self._fd).st_size
+
+    # ------------------------------------------------------------- appends
+
+    def append(self, doc: dict) -> int:
+        """Append one record and fsync; returns bytes written.  Raises on
+        any write/fsync failure (the caller must NOT acknowledge work whose
+        record did not reach disk).  ``serve.journal_write`` fires here."""
+        faults.fault_point("serve.journal_write")
+        line = _encode(doc)
+        with self._lock:
+            if self._fd < 0:
+                raise OSError("journal is closed")
+            os.write(self._fd, line)
+            os.fsync(self._fd)
+            self._size += len(line)
+        return len(line)
+
+    def append_job(self, job_id: int, state: str, **fields) -> int:
+        return self.append(job_record(job_id, state, **fields))
+
+    def append_marker(self, kind: str) -> int:
+        return self.append({"v": 1, "rec": "marker", "kind": kind})
+
+    def size(self) -> int:
+        with self._lock:
+            return self._size
+
+    # ------------------------------------------------------------ rotation
+
+    def rotate(self, records: list[dict]) -> None:
+        """Checkpoint: replace the journal with a compacted snapshot (one
+        full-state record per live job), committed atomically via the same
+        fsync+rename+dir-fsync primitive as stage outputs.  A crash during
+        rotation leaves either the old journal or the new one — never a
+        mix, never a hole."""
+        with self._lock:
+            fd, tmp = tempfile.mkstemp(
+                prefix=os.path.basename(self.path) + ".rot.",
+                dir=os.path.dirname(os.path.abspath(self.path)))
+            try:
+                with os.fdopen(fd, "wb") as fh:
+                    for rec in records:
+                        fh.write(_encode(rec))
+                commit_file(tmp, self.path)
+            except BaseException:
+                if os.path.exists(tmp):
+                    os.unlink(tmp)
+                raise
+            # the O_APPEND fd still points at the renamed-away inode:
+            # reopen on the new file
+            os.close(self._fd)
+            self._fd = os.open(self.path,
+                               os.O_APPEND | os.O_CREAT | os.O_WRONLY, 0o644)
+            self._size = os.fstat(self._fd).st_size
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fd >= 0:
+                os.close(self._fd)
+                self._fd = -1
+
+
+# ------------------------------------------------------------------ replay
+
+def replay(path: str) -> tuple[dict[int, dict], dict]:
+    """Read a journal into per-job merged state.
+
+    Returns ``(jobs, info)``: ``jobs`` maps job id -> merged record (the
+    union of every record for that id, later fields winning), ``info``
+    carries ``{"records", "skipped", "torn_tail", "clean_drain"}``.
+
+    Tolerant by design: a torn final record (crash mid-append) is logged
+    and skipped; any other undecodable or fault-injected record is logged,
+    counted in ``skipped``, and the rest of the journal still replays.
+    ``serve.journal_replay`` fires per record.
+    """
+    jobs: dict[int, dict] = {}
+    info = {"records": 0, "skipped": 0, "torn_tail": False,
+            "clean_drain": False}
+    if not os.path.exists(path):
+        return jobs, info
+    with open(path, "rb") as fh:
+        raw = fh.read()
+    lines = raw.split(b"\n")
+    # a well-formed journal ends with a newline -> last split element empty;
+    # anything else is a torn tail from a crash mid-append
+    tail = lines.pop() if lines else b""
+    if tail.strip():
+        lines.append(tail)
+    for idx, line in enumerate(lines):
+        if not line.strip():
+            continue
+        last = idx == len(lines) - 1
+        try:
+            faults.fault_point("serve.journal_replay")
+            rec = json.loads(line)
+            if not isinstance(rec, dict):
+                raise ValueError("record is not an object")
+        except (ValueError, faults.FaultError) as e:
+            info["skipped"] += 1
+            if last and isinstance(e, ValueError) and line == tail:
+                info["torn_tail"] = True
+                print(f"WARNING: journal {path}: torn final record "
+                      f"({len(line)} bytes) — crash mid-append; dropping it "
+                      "(its submit was never acknowledged)",
+                      file=sys.stderr, flush=True)
+            else:
+                print(f"WARNING: journal {path}: skipping unreadable record "
+                      f"at line {idx + 1} ({e})", file=sys.stderr, flush=True)
+            continue
+        info["records"] += 1
+        if rec.get("rec") == "marker":
+            # markers only matter as the journal's last word: any job
+            # record after a drain marker belongs to a newer daemon life
+            info["clean_drain"] = rec.get("kind") == "drain"
+            continue
+        info["clean_drain"] = False
+        try:
+            job_id = int(rec["id"])
+        except (KeyError, TypeError, ValueError):
+            info["skipped"] += 1
+            print(f"WARNING: journal {path}: job record without id "
+                  f"at line {idx + 1}", file=sys.stderr, flush=True)
+            continue
+        merged = jobs.setdefault(job_id, {})
+        merged.update({k: v for k, v in rec.items() if k not in ("v", "rec")})
+    return jobs, info

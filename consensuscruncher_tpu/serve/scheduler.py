@@ -28,8 +28,27 @@ retries through the same resume path (bounded, ``CCT_SERVE_RETRIES``),
 which PR-1's atomic stage commits make safe: a death mid-stage never
 leaves a partial output to resume over.
 
+Durability (``serve.journal``): when constructed with a journal, every
+admission is acknowledged only after its ``accepted`` record is fsync'd,
+every transition is journaled, and ``__init__`` replays the journal before
+the dispatcher starts — any job not provably terminal is re-enqueued and
+finishes via ``--resume`` (exactly-once at the output level, byte-identical
+to an uninterrupted run).  Duplicate submits dedupe on the spec's
+idempotency key, so a client resubmitting across a daemon restart gets the
+existing job instead of double-running it.
+
+Overload robustness: a submit may carry ``deadline_s``.  Admission sheds
+jobs that cannot meet their deadline at the observed per-job service rate
+(EWMA), and dispatch sheds queued jobs whose deadline already expired
+while waiting — both counted in ``jobs_shed``.  Completed-job records are
+evicted after ``CCT_SERVE_RESULT_TTL_S`` (or beyond ``CCT_SERVE_RESULT_MAX``)
+so a long-lived daemon's memory stays bounded; an evicted job's result
+points at its on-disk outputs.
+
 Fault sites: ``serve.dispatch`` (gang dispatch — jobs fall back to solo
-runs) and ``serve.worker`` (per-job execution — retried via resume).
+runs), ``serve.worker`` (per-job execution — retried via resume),
+``serve.shed`` (admission shedding — forced refusal), plus
+``serve.journal_write`` / ``serve.journal_replay`` in :mod:`.journal`.
 """
 
 from __future__ import annotations
@@ -41,12 +60,17 @@ import threading
 import time
 from collections import deque
 
+from consensuscruncher_tpu.serve import journal as journal_mod
 from consensuscruncher_tpu.utils import faults, sanitize
 from consensuscruncher_tpu.utils.profiling import Counters, metrics_doc
 
 
 class AdmissionRefused(RuntimeError):
     """Queue full or server draining — the caller should retry later."""
+
+
+class DeadlineShed(AdmissionRefused):
+    """Admission refused because the job cannot meet its deadline."""
 
 
 _STATES = ("queued", "running", "done", "failed")
@@ -60,24 +84,37 @@ class Job:
     # threading.Lock semantics otherwise
     _id_lock = sanitize.tracked_lock("job.id_lock")
 
-    def __init__(self, spec: dict):
+    def __init__(self, spec: dict, job_id: int | None = None,
+                 key: str | None = None, deadline_s: float | None = None):
         with Job._id_lock:
-            Job._next_id += 1
-            self.id = Job._next_id
+            if job_id is None:
+                Job._next_id += 1
+                job_id = Job._next_id
+            else:
+                # journal replay preserves ids; fresh jobs continue after
+                # the highest replayed one so ids never collide
+                job_id = int(job_id)
+                Job._next_id = max(Job._next_id, job_id)
+            self.id = job_id
         self.spec = dict(spec)
+        self.key = key
+        self.deadline_s = deadline_s
         self.state = "queued"
         self.error: str | None = None
         self.outputs: dict | None = None
         self.wall_s: float | None = None
         self.attempts = 0
         self.gang_size = 1  # how many jobs shared this job's SSCS dispatch
+        self.submitted_t = time.monotonic()
+        self.finished_t: float | None = None
 
     def describe(self) -> dict:
         return {
             "job_id": self.id, "state": self.state, "error": self.error,
             "outputs": self.outputs, "wall_s": self.wall_s,
             "attempts": self.attempts, "gang_size": self.gang_size,
-            "input": self.spec.get("input"),
+            "input": self.spec.get("input"), "key": self.key,
+            "deadline_s": self.deadline_s,
         }
 
 
@@ -278,50 +315,144 @@ class Scheduler:
     the queue is full (backpressure to the client, never OOM).
     ``gang_size`` caps how many compatible jobs one dispatch round merges.
     ``paused`` holds dispatch so tests can pile up a gang deterministically.
+    ``journal`` (a :class:`.journal.Journal` or a path) makes admissions
+    durable: the journal is replayed before the dispatcher starts.
+    ``result_ttl_s`` / ``result_max`` bound completed-job retention.
     """
 
     def __init__(self, queue_bound: int = 16, gang_size: int = 4,
                  backend: str = "tpu", max_batch: int = 1024,
-                 start: bool = True, paused: bool = False):
+                 start: bool = True, paused: bool = False,
+                 journal: journal_mod.Journal | str | None = None,
+                 result_ttl_s: float | None = None,
+                 result_max: int | None = None):
         self.queue_bound = int(queue_bound)
         self.gang_size = max(1, int(gang_size))
         self.backend = backend
         self.max_batch = int(max_batch)
+        if result_ttl_s is None:
+            result_ttl_s = float(os.environ.get("CCT_SERVE_RESULT_TTL_S", "600"))
+        self.result_ttl_s = float(result_ttl_s)
+        if result_max is None:
+            result_max = int(os.environ.get("CCT_SERVE_RESULT_MAX", "512"))
+        self.result_max = max(1, int(result_max))
+        self._expired_cap = max(64, 4 * self.result_max)
+        if isinstance(journal, str):
+            journal = journal_mod.Journal(
+                journal, max_bytes=int(os.environ.get(
+                    "CCT_SERVE_JOURNAL_MAX_BYTES", str(1 << 20))))
+        self._journal = journal
         self.counters = Counters()
         self._cond = sanitize.tracked_condition("scheduler.cond")
         self._queue: deque[Job] = deque()
         self._jobs: dict[int, Job] = {}
+        self._by_key: dict[str, int] = {}
+        self._expired: dict[int, dict] = {}  # evicted-job tombstones (FIFO)
         self._running: list[Job] = []
         self._draining = False
         self._paused = bool(paused)
         self._stop = False
         self._started_at = time.time()
+        self._ewma_job_s: float | None = None
         self._thread = threading.Thread(
             target=self._loop, name="serve-dispatcher", daemon=True)
+        if self._journal is not None:
+            self._recover()
         if start:
             self._thread.start()
 
     # ----------------------------------------------------------- admission
 
     def submit(self, spec: dict) -> Job:
+        job, _created = self.submit_info(spec)
+        return job
+
+    def submit_info(self, spec: dict) -> tuple[Job, bool]:
+        """Admit a job; returns ``(job, created)``.  A duplicate submit
+        (same idempotency key, job still tracked) returns the existing job
+        with ``created=False`` instead of double-running the work."""
         for req in ("input", "output"):
             if not spec.get(req):
                 raise ValueError(f"job spec missing {req!r}")
+        key = journal_mod.idempotency_key(spec)
+        deadline_s = spec.get("deadline_s")
+        deadline_s = None if deadline_s is None else float(deadline_s)
         with self._cond:
+            existing = self._by_key.get(key)
+            if existing is not None and existing in self._jobs:
+                return self._jobs[existing], False
             if self._draining:
                 raise AdmissionRefused("server is draining; not accepting jobs")
+            self._shed_check_locked(deadline_s)
+            self._evict_locked(time.monotonic())
             if len(self._queue) >= self.queue_bound:
                 raise AdmissionRefused(
                     f"queue full ({len(self._queue)}/{self.queue_bound})")
-            job = Job(spec)
+            job = Job(spec, key=key, deadline_s=deadline_s)
+            if self._journal is not None:
+                # the accepted record must be on disk BEFORE the job is
+                # acknowledged: a refused-but-unjournaled submit is safe to
+                # retry, an acknowledged-but-unjournaled one would be lost
+                # by a crash
+                try:
+                    n = self._journal.append_job(
+                        job.id, "accepted", key=job.key, spec=job.spec,
+                        deadline_s=job.deadline_s)
+                except Exception as e:
+                    raise AdmissionRefused(
+                        f"journal write failed ({e}); job not accepted")
+                self.counters.add("journal_bytes", n)
             self._queue.append(job)
             self._jobs[job.id] = job
+            self._by_key[key] = job.id
             self.counters.high_water("queue_depth_hwm", len(self._queue))
             self._cond.notify_all()
-        return job
+        return job, True
+
+    def _shed_check_locked(self, deadline_s: float | None) -> None:
+        """Deadline-aware admission: refuse work that cannot finish in time
+        at the observed service rate (EWMA of per-job wall).  The
+        ``serve.shed`` fault site forces a shed for chaos tests."""
+        try:
+            faults.fault_point("serve.shed")
+        except faults.FaultError as e:
+            self.counters.add("jobs_shed")
+            raise DeadlineShed(f"shed: {e}")
+        if deadline_s is None or self._ewma_job_s is None:
+            return
+        backlog = len(self._queue) + len(self._running)
+        eta = (backlog + 1) * self._ewma_job_s / max(1, self.gang_size)
+        if eta > deadline_s:
+            self.counters.add("jobs_shed")
+            raise DeadlineShed(
+                f"shed: estimated completion {eta:.1f}s exceeds "
+                f"deadline_s={deadline_s:g} (backlog={backlog}, "
+                f"ewma_job_s={self._ewma_job_s:.2f})")
 
     def get(self, job_id: int) -> Job | None:
         return self._jobs.get(int(job_id))
+
+    def lookup(self, job_id=None, key: str | None = None):
+        """Resolve a job by id or idempotency key, including evicted ones.
+        Returns ``("job", Job)``, ``("expired", tombstone)`` or ``None``."""
+        with self._cond:
+            if job_id is None and key is not None:
+                job_id = self._by_key.get(str(key))
+                if job_id is None:
+                    for info in self._expired.values():
+                        if info["key"] == key:
+                            return ("expired", dict(info))
+                    return None
+            if job_id is None:
+                return None
+            job_id = int(job_id)
+            job = self._jobs.get(job_id)
+            if job is not None:
+                return ("job", job)
+            info = self._expired.get(job_id)
+            if info is not None:
+                return ("expired", dict(info))
+            return None
 
     def wait(self, job_id: int, timeout: float | None = None) -> Job:
         """Block until the job reaches a terminal state (or timeout)."""
@@ -337,6 +468,129 @@ class Scheduler:
                 self._cond.wait(timeout=remaining)
         return job
 
+    # --------------------------------------------------------------- journal
+
+    def _journal_update_locked(self, job: Job, state: str, **fields) -> None:
+        """Journal a lifecycle transition.  Post-admission journal failures
+        degrade durability, not availability: log and keep running (the
+        job's manifest still proves completed stages on replay)."""
+        if self._journal is None:
+            return
+        try:
+            n = self._journal.append_job(job.id, state, **fields)
+        except Exception as e:
+            print(f"WARNING: journal append ({state}, job {job.id}) "
+                  f"failed: {e}", file=sys.stderr, flush=True)
+            return
+        self.counters.add("journal_bytes", n)
+        self._maybe_rotate_locked()
+
+    def _snapshot_records_locked(self) -> list[dict]:
+        """One full-state record per tracked job, for checkpoint rotation."""
+        to_journal = {"queued": "accepted", "running": "dispatched"}
+        recs = []
+        for jid in sorted(self._jobs):
+            j = self._jobs[jid]
+            recs.append(journal_mod.job_record(
+                j.id, to_journal.get(j.state, j.state), key=j.key,
+                spec=j.spec, deadline_s=j.deadline_s, outputs=j.outputs,
+                error=j.error, wall_s=j.wall_s))
+        return recs
+
+    def _maybe_rotate_locked(self) -> None:
+        if self._journal is None or self._journal.max_bytes is None:
+            return
+        if self._journal.size() <= self._journal.max_bytes:
+            return
+        try:
+            self._journal.rotate(self._snapshot_records_locked())
+        except Exception as e:
+            print(f"WARNING: journal rotation failed ({e}); appends continue "
+                  "on the unrotated file", file=sys.stderr, flush=True)
+
+    def _recover(self) -> None:
+        """Replay the journal: re-enqueue every job not provably terminal.
+        Each replayed job re-runs through the per-job manifest ``--resume``
+        path, so completed stages are skipped and outputs stay
+        byte-identical — exactly-once at the output level."""
+        jobs, info = journal_mod.replay(self._journal.path)
+        requeued = finished = dropped = 0
+        with self._cond:
+            for jid in sorted(jobs):
+                rec = jobs[jid]
+                spec = rec.get("spec")
+                if not isinstance(spec, dict) or not spec.get("input") \
+                        or not spec.get("output"):
+                    dropped += 1
+                    print(f"WARNING: journal replay: job {jid} has no usable "
+                          "spec (rotated-away accepted record?); dropping",
+                          file=sys.stderr, flush=True)
+                    continue
+                job = Job(spec, job_id=jid,
+                          key=rec.get("key") or journal_mod.idempotency_key(spec),
+                          deadline_s=rec.get("deadline_s"))
+                self._jobs[job.id] = job
+                self._by_key[job.key] = job.id
+                if rec.get("state") in ("done", "failed"):
+                    job.state = rec["state"]
+                    job.outputs = rec.get("outputs")
+                    job.error = rec.get("error")
+                    job.wall_s = rec.get("wall_s")
+                    job.finished_t = time.monotonic()
+                    finished += 1
+                else:
+                    # accepted or dispatched: not provably done -> re-run.
+                    # The deadline clock restarts here — the daemon being
+                    # down must not shed every queued job on every restart.
+                    job.state = "queued"
+                    job.submitted_t = time.monotonic()
+                    self._queue.append(job)
+                    self.counters.add("jobs_replayed")
+                    requeued += 1
+            self.counters.high_water("queue_depth_hwm", len(self._queue))
+            self._cond.notify_all()
+        if requeued or finished or dropped or info["skipped"]:
+            print(f"serve: journal replay: {requeued} job(s) re-enqueued, "
+                  f"{finished} already terminal, "
+                  f"{dropped + info['skipped']} record(s) skipped"
+                  + (" (previous shutdown was a clean drain)"
+                     if info["clean_drain"] else ""),
+                  file=sys.stderr, flush=True)
+
+    # ------------------------------------------------------------- retention
+
+    def _evict_locked(self, now: float) -> int:
+        """Drop terminal jobs past the TTL or beyond ``result_max``; their
+        outputs stay on disk and a bounded tombstone keeps ``result``
+        replies informative."""
+        terminal = [j for j in self._jobs.values()
+                    if j.state in ("done", "failed")
+                    and j.finished_t is not None]
+        doomed = [j for j in terminal if now - j.finished_t > self.result_ttl_s]
+        doomed_ids = {j.id for j in doomed}
+        survivors = sorted((j for j in terminal if j.id not in doomed_ids),
+                           key=lambda j: j.finished_t)
+        over = len(survivors) - self.result_max
+        if over > 0:
+            doomed += survivors[:over]
+        for j in doomed:
+            del self._jobs[j.id]
+            base = (j.outputs or {}).get("base") or job_paths(j.spec)["base"]
+            self._expired[j.id] = {"job_id": j.id, "key": j.key,
+                                   "final_state": j.state, "base": base}
+            self.counters.add("evicted_jobs")
+        while len(self._expired) > self._expired_cap:
+            old_id = next(iter(self._expired))
+            old = self._expired.pop(old_id)
+            if self._by_key.get(old["key"]) == old_id:
+                del self._by_key[old["key"]]
+        return len(doomed)
+
+    def evict_now(self) -> int:
+        """Run one eviction pass immediately (tests, ops tooling)."""
+        with self._cond:
+            return self._evict_locked(time.monotonic())
+
     # ----------------------------------------------------- test/drain hooks
 
     def pause(self) -> None:
@@ -345,6 +599,15 @@ class Scheduler:
 
     def release(self) -> None:
         with self._cond:
+            self._paused = False
+            self._cond.notify_all()
+
+    def stop_admission(self) -> None:
+        """Signal-safe drain entry: stop accepting, wake the dispatcher,
+        return immediately (the bounded wait happens in the CLI's drain
+        step, never inside a signal handler)."""
+        with self._cond:
+            self._draining = True
             self._paused = False
             self._cond.notify_all()
 
@@ -363,15 +626,20 @@ class Scheduler:
                         raise TimeoutError("drain timed out")
                 self._cond.wait(timeout=remaining)
 
+    def shutdown(self, timeout: float | None = 5.0) -> None:
+        """Stop the dispatcher WITHOUT waiting for queued work — queued
+        jobs stay journaled and replay on the next start."""
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        if self._thread.is_alive():
+            self._thread.join(timeout=timeout)
+
     def close(self, timeout: float | None = 60.0) -> None:
         try:
             self.drain(timeout=timeout)
         finally:
-            with self._cond:
-                self._stop = True
-                self._cond.notify_all()
-            if self._thread.is_alive():
-                self._thread.join(timeout=5.0)
+            self.shutdown(timeout=5.0)
 
     # ------------------------------------------------------------- metrics
 
@@ -388,6 +656,9 @@ class Scheduler:
                 cumulative=self.counters.snapshot(),
             )
             doc["jobs"] = jobs
+            if self._journal is not None:
+                doc["journal"] = {"path": self._journal.path,
+                                  "size_bytes": self._journal.size()}
             return doc
 
     def healthz(self) -> dict:
@@ -396,6 +667,7 @@ class Scheduler:
                 "status": "draining" if self._draining else "serving",
                 "queued": len(self._queue), "running": len(self._running),
                 "uptime_s": round(time.time() - self._started_at, 3),
+                "pid": os.getpid(),
             }
 
     # ----------------------------------------------------------- dispatcher
@@ -426,13 +698,35 @@ class Scheduler:
                 if self._stop:
                     return
                 gang = self._pop_gang()
+                now = time.monotonic()
+                live = []
                 for job in gang:
+                    if job.deadline_s is not None and \
+                            now - job.submitted_t > job.deadline_s:
+                        # dispatch-time shed: the deadline expired while the
+                        # job sat in the queue; running it would waste device
+                        # time on an answer nobody is waiting for
+                        job.state = "failed"
+                        job.error = (f"shed: deadline_s={job.deadline_s:g} "
+                                     f"expired after "
+                                     f"{now - job.submitted_t:.1f}s in queue")
+                        job.finished_t = now
+                        self.counters.add("jobs_shed")
+                        self._journal_update_locked(job, "failed",
+                                                    error=job.error)
+                    else:
+                        live.append(job)
+                if not live:
+                    self._cond.notify_all()
+                    continue
+                for job in live:
                     job.state = "running"
-                    job.gang_size = len(gang)
-                self._running = list(gang)
+                    job.gang_size = len(live)
+                    self._journal_update_locked(job, "dispatched")
+                self._running = list(live)
                 self._cond.notify_all()
             try:
-                self._run_gang(gang)
+                self._run_gang(live)
             finally:
                 with self._cond:
                     self._running = []
@@ -466,6 +760,13 @@ class Scheduler:
                 # belongs to every member's end-to-end latency
                 job.wall_s = round(time.monotonic() - jt0, 6)
                 job.state = outcome
+                job.finished_t = time.monotonic()
+                self._ewma_job_s = job.wall_s if self._ewma_job_s is None \
+                    else 0.8 * self._ewma_job_s + 0.2 * job.wall_s
+                self._journal_update_locked(
+                    job, outcome, outputs=job.outputs, error=job.error,
+                    wall_s=job.wall_s)
+                self._evict_locked(time.monotonic())
                 self._cond.notify_all()
 
     def _argv(self, spec: dict, resume: bool) -> list[str]:

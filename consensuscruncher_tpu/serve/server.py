@@ -8,7 +8,8 @@ host* process, remote fan-in belongs to a reverse proxy.
 
 Ops (all replies carry ``"ok"``):
 
-  {"op": "submit", "spec": {...}}       -> {"ok": true, "job_id": N}
+  {"op": "submit", "spec": {...}}       -> {"ok": true, "job_id": N,
+                                            "key": "...", "duplicate": bool}
   {"op": "status", "job_id": N}         -> {"ok": true, "job": {...}}
   {"op": "result", "job_id": N,
    "timeout": seconds|null}             -> blocks; {"ok": true, "job": {...}}
@@ -16,21 +17,42 @@ Ops (all replies carry ``"ok"``):
   {"op": "metrics"}                     -> {"ok": true, "metrics": {...}}
   {"op": "drain", "timeout": s|null}    -> blocks; {"ok": true, "drained": true}
 
+``status``/``result`` accept ``"key"`` (the submit reply's idempotency
+key) in place of ``"job_id"`` — keys survive a daemon restart, ids are
+only as durable as the journal, so restart-invisible polling uses keys.
+A submit whose spec hashes to an already-tracked job returns that job
+with ``"duplicate": true``.  A job evicted from memory (result TTL)
+replies ``state: "expired"`` with the on-disk output path.  A submit shed
+for its deadline replies ``refused: true, shed: true``.
+
 Errors reply ``{"ok": false, "error": "..."}`` and keep the connection
 usable; a malformed line closes the connection.  The ``serve.accept``
 fault site fires per accepted connection (chaos tests turn accept-path
-failures into clean error replies, never daemon death).
+failures into clean error replies, never daemon death); ``serve.sigterm``
+fires inside the shutdown path (a fault there degrades to an immediate
+stop — journal replay makes even that lossless).
+
+Lifecycle: handler threads are tracked in a bounded registry and joined
+in :meth:`ServeServer.close`, so shutdown never leaks a socket mid-reply.
+:func:`install_signal_handlers` wires SIGTERM/SIGINT to
+:func:`request_shutdown`: stop admission, journal a ``drain`` marker,
+break the accept loop — the serve CLI then finishes in-flight work within
+``CCT_SERVE_DRAIN_S`` and exits 0.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import signal
 import socket
 import sys
 import threading
+import time
 
-from consensuscruncher_tpu.serve.scheduler import AdmissionRefused, Scheduler
+from consensuscruncher_tpu.serve.scheduler import (
+    AdmissionRefused, DeadlineShed, Scheduler,
+)
 from consensuscruncher_tpu.utils import faults
 
 MAX_LINE = 1 << 20  # 1 MiB per request line; specs are tiny
@@ -40,9 +62,13 @@ class ServeServer:
     """Accept loop + per-connection handler threads over a Scheduler."""
 
     def __init__(self, scheduler: Scheduler, host: str = "127.0.0.1",
-                 port: int = 0, socket_path: str | None = None):
+                 port: int = 0, socket_path: str | None = None,
+                 max_conns: int | None = None):
         self.scheduler = scheduler
         self.socket_path = socket_path
+        if max_conns is None:
+            max_conns = int(os.environ.get("CCT_SERVE_MAX_CONNS", "128"))
+        self.max_conns = max(1, int(max_conns))
         if socket_path:
             if os.path.exists(socket_path):
                 os.unlink(socket_path)  # stale socket from a dead daemon
@@ -57,6 +83,11 @@ class ServeServer:
         self._sock.listen(16)
         self._closed = False
         self._accept_thread: threading.Thread | None = None
+        # bounded registry of live connection handlers: close() joins them
+        # so shutdown cannot leak a socket mid-reply
+        self._conn_lock = threading.Lock()
+        self._conns: dict[int, tuple[socket.socket, threading.Thread]] = {}
+        self._next_conn = 0
 
     def describe(self) -> str:
         if self.socket_path:
@@ -78,14 +109,61 @@ class ServeServer:
                 conn, _addr = self._sock.accept()
             except OSError:
                 return  # socket closed under us: clean shutdown
-            t = threading.Thread(
-                target=self._handle_conn, args=(conn,), daemon=True)
+            busy = False
+            with self._conn_lock:
+                if len(self._conns) >= self.max_conns:
+                    busy = True
+                else:
+                    self._next_conn += 1
+                    cid = self._next_conn
+                    t = threading.Thread(
+                        target=self._handle_conn, args=(conn, cid),
+                        name=f"serve-conn-{cid}", daemon=True)
+                    self._conns[cid] = (conn, t)
+            if busy:
+                # reply outside the lock: sendall can block
+                self._reply(conn, {"ok": False, "busy": True,
+                                   "error": f"server busy "
+                                            f"({self.max_conns} connections)"})
+                conn.close()
+                continue
             t.start()
 
-    def close(self) -> None:
+    def shutdown(self) -> None:
+        """Break the accept loop without joining handlers — the signal-safe
+        half of close() (callable from a signal handler)."""
         self._closed = True
         try:
             self._sock.close()
+        except OSError:
+            pass
+
+    def close(self, timeout: float = 10.0) -> None:
+        self.shutdown()
+        try:
+            # half-close live connections: no new requests are read, but
+            # in-flight replies still flush before the join below
+            with self._conn_lock:
+                live = list(self._conns.values())
+            for conn, _t in live:
+                try:
+                    conn.shutdown(socket.SHUT_RD)
+                except OSError:
+                    pass
+            deadline = time.monotonic() + timeout
+            for _conn, t in live:
+                t.join(timeout=max(0.0, deadline - time.monotonic()))
+            # stragglers (e.g. a result waiter mid-poll): force the socket
+            # closed and give each thread a moment to unwind
+            with self._conn_lock:
+                stuck = list(self._conns.values())
+            for conn, _t in stuck:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+            for _conn, t in stuck:
+                t.join(timeout=1.0)
         finally:
             if self.socket_path and os.path.exists(self.socket_path):
                 try:
@@ -95,37 +173,40 @@ class ServeServer:
 
     # ----------------------------------------------------------- connection
 
-    def _handle_conn(self, conn: socket.socket) -> None:
+    def _handle_conn(self, conn: socket.socket, cid: int) -> None:
         try:
-            faults.fault_point("serve.accept")
-        except faults.FaultError as e:
-            self._reply(conn, {"ok": False, "error": str(e)})
-            conn.close()
-            return
-        try:
-            buf = b""
-            while True:
-                chunk = conn.recv(65536)
-                if not chunk:
-                    return
-                buf += chunk
-                if len(buf) > MAX_LINE:
-                    self._reply(conn, {"ok": False, "error": "request too large"})
-                    return
-                while b"\n" in buf:
-                    line, buf = buf.split(b"\n", 1)
-                    if not line.strip():
-                        continue
-                    try:
-                        req = json.loads(line)
-                    except ValueError:
-                        self._reply(conn, {"ok": False, "error": "bad JSON"})
+            try:
+                faults.fault_point("serve.accept")
+            except faults.FaultError as e:
+                self._reply(conn, {"ok": False, "error": str(e)})
+                return
+            try:
+                buf = b""
+                while True:
+                    chunk = conn.recv(65536)
+                    if not chunk:
                         return
-                    self._reply(conn, self._dispatch(req))
-        except (OSError, BrokenPipeError):
-            pass  # client went away mid-exchange; nothing to clean up
+                    buf += chunk
+                    if len(buf) > MAX_LINE:
+                        self._reply(conn, {"ok": False,
+                                           "error": "request too large"})
+                        return
+                    while b"\n" in buf:
+                        line, buf = buf.split(b"\n", 1)
+                        if not line.strip():
+                            continue
+                        try:
+                            req = json.loads(line)
+                        except ValueError:
+                            self._reply(conn, {"ok": False, "error": "bad JSON"})
+                            return
+                        self._reply(conn, self._dispatch(req))
+            except (OSError, BrokenPipeError):
+                pass  # client went away mid-exchange; nothing to clean up
         finally:
             conn.close()
+            with self._conn_lock:
+                self._conns.pop(cid, None)
 
     @staticmethod
     def _reply(conn: socket.socket, doc: dict) -> None:
@@ -136,24 +217,68 @@ class ServeServer:
 
     # ------------------------------------------------------------- dispatch
 
+    def _lookup(self, req: dict):
+        return self.scheduler.lookup(job_id=req.get("job_id"),
+                                     key=req.get("key"))
+
+    @staticmethod
+    def _expired_reply(info: dict) -> dict:
+        return {"ok": True, "job": {
+            "job_id": info["job_id"], "key": info["key"], "state": "expired",
+            "final_state": info["final_state"],
+            "outputs": {"base": info["base"]},
+            "error": f"result expired; outputs on disk at {info['base']}",
+        }}
+
+    def _wait_result(self, req: dict) -> dict:
+        """Blocking result with shutdown awareness: the scheduler wait runs
+        in bounded slices so a close() never wedges behind a parked waiter
+        — the client sees ``shutdown: true`` and retries after restart."""
+        found = self._lookup(req)
+        if found is None:
+            return {"ok": False, "error": "unknown job_id"}
+        kind, obj = found
+        if kind == "expired":
+            return self._expired_reply(obj)
+        job = obj
+        timeout = req.get("timeout")
+        deadline = None if timeout is None else time.monotonic() + float(timeout)
+        while job.state not in ("done", "failed"):
+            if self._closed:
+                return {"ok": False, "error": "server shutting down",
+                        "shutdown": True}
+            remaining = 0.5
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(f"job {job.id} still {job.state}")
+            try:
+                self.scheduler.wait(job.id, timeout=min(0.5, remaining))
+            except TimeoutError:
+                continue
+            except KeyError:
+                break  # evicted mid-wait: only terminal jobs evict
+        return {"ok": True, "job": job.describe()}
+
     def _dispatch(self, req: dict) -> dict:
         if not isinstance(req, dict):
             return {"ok": False, "error": "request must be a JSON object"}
         op = req.get("op")
         try:
             if op == "submit":
-                job = self.scheduler.submit(req.get("spec") or {})
-                return {"ok": True, "job_id": job.id, "state": job.state}
+                job, created = self.scheduler.submit_info(req.get("spec") or {})
+                return {"ok": True, "job_id": job.id, "state": job.state,
+                        "key": job.key, "duplicate": not created}
             if op == "status":
-                job = self.scheduler.get(req.get("job_id", -1))
-                if job is None:
+                found = self._lookup(req)
+                if found is None:
                     return {"ok": False, "error": "unknown job_id"}
-                return {"ok": True, "job": job.describe()}
+                kind, obj = found
+                if kind == "expired":
+                    return self._expired_reply(obj)
+                return {"ok": True, "job": obj.describe()}
             if op == "result":
-                if self.scheduler.get(req.get("job_id", -1)) is None:
-                    return {"ok": False, "error": "unknown job_id"}
-                job = self.scheduler.wait(req["job_id"], timeout=req.get("timeout"))
-                return {"ok": True, "job": job.describe()}
+                return self._wait_result(req)
             if op == "healthz":
                 return {"ok": True, "health": self.scheduler.healthz()}
             if op == "metrics":
@@ -162,6 +287,9 @@ class ServeServer:
                 self.scheduler.drain(timeout=req.get("timeout"))
                 return {"ok": True, "drained": True}
             return {"ok": False, "error": f"unknown op {op!r}"}
+        except DeadlineShed as e:
+            return {"ok": False, "error": str(e), "refused": True,
+                    "shed": True}
         except AdmissionRefused as e:
             return {"ok": False, "error": str(e), "refused": True}
         except TimeoutError as e:
@@ -170,3 +298,45 @@ class ServeServer:
             print(f"WARNING: serve op {op!r} failed: {e}",
                   file=sys.stderr, flush=True)
             return {"ok": False, "error": f"{type(e).__name__}: {e}"}
+
+
+# -------------------------------------------------------------- lifecycle
+
+def request_shutdown(server: ServeServer, scheduler: Scheduler,
+                     journal=None) -> None:
+    """Initiate a supervised shutdown: stop admission, journal a ``drain``
+    marker, break the accept loop.  The serve CLI then runs the bounded
+    drain and exits.  Unit-testable outside a real signal delivery; the
+    ``serve.sigterm`` fault site degrades it to an immediate stop (queued
+    jobs stay journaled, so even the degraded path loses nothing)."""
+    try:
+        faults.fault_point("serve.sigterm")
+    except faults.FaultError as e:
+        print(f"WARNING: serve shutdown handler fault ({e}); stopping "
+              "immediately — queued jobs stay journaled for replay",
+              file=sys.stderr, flush=True)
+        server.shutdown()
+        return
+    scheduler.stop_admission()
+    if journal is not None:
+        try:
+            n = journal.append_marker("drain")
+            scheduler.counters.add("journal_bytes", n)
+        except Exception as e:
+            print(f"WARNING: drain marker write failed ({e})",
+                  file=sys.stderr, flush=True)
+    server.shutdown()
+
+
+def install_signal_handlers(server: ServeServer, scheduler: Scheduler,
+                            journal=None) -> None:
+    """SIGTERM/SIGINT -> graceful drain.  Closing the listening socket
+    makes the (PEP 475 auto-retrying) ``accept`` call in serve_forever
+    return, handing control back to the CLI's drain/exit sequence."""
+    def _handler(signum, _frame):
+        print(f"serve: caught signal {signum}; draining",
+              file=sys.stderr, flush=True)
+        request_shutdown(server, scheduler, journal)
+
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, _handler)

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import os
 import sys
+import time
 
 import numpy as np
 
@@ -65,9 +66,12 @@ def parse_shapes(text: str) -> list[tuple[int, int, int]]:
     return shapes
 
 
-def warm_shapes(shapes, config=None) -> int:
+def warm_shapes(shapes, config=None, budget_s: float | None = None) -> int:
     """Force-compile the dense consensus vote for each (B, F, L) bucket.
-    Returns how many shapes compiled; a failed shape warns and continues."""
+    Returns how many shapes compiled; a failed shape warns and continues.
+    ``budget_s`` bounds the total warmup wall — a supervised restart must
+    get back to accepting (journal-replayed) jobs quickly, and skipped
+    shapes just compile lazily on first use."""
     from consensuscruncher_tpu.ops.consensus_tpu import (
         ConsensusConfig, consensus_batch,
     )
@@ -76,7 +80,13 @@ def warm_shapes(shapes, config=None) -> int:
     if config is None:
         config = ConsensusConfig()
     done = 0
-    for b, f, l in shapes:
+    t0 = time.monotonic()
+    for i, (b, f, l) in enumerate(shapes):
+        if budget_s is not None and time.monotonic() - t0 >= budget_s:
+            print(f"WARNING: warmup budget {budget_s:g}s spent after {done} "
+                  f"shape(s); skipping {len(shapes) - i} remaining (they "
+                  "compile lazily on first use)", file=sys.stderr, flush=True)
+            break
         try:
             bases = np.full((b, f, l), PAD, dtype=np.uint8)
             quals = np.zeros((b, f, l), dtype=np.uint8)

@@ -42,6 +42,10 @@ Sites wired in this codebase (grep for ``fault_point``/``faults.hook``):
   serve.accept         daemon connection accept/handling -> error reply
   serve.dispatch       scheduler gang dispatch -> jobs retried solo
   serve.worker         per-job worker execution -> retry via --resume
+  serve.journal_write  journal append -> submit refused, nothing half-acked
+  serve.journal_replay corrupt journal record -> skip + log, rest recovers
+  serve.sigterm        shutdown handler -> immediate stop, replay recovers
+  serve.shed           deadline admission check -> forced shed
 
 Everything here is stdlib-only and import-cheap: io/bgzf.py and the
 tools/ scripts (whose parents must never import jax) both import it.

@@ -29,6 +29,10 @@ CUMULATIVE_KEYS = (
     "batches_dispatched",  # device dispatches (bucketed batches)
     "retries_fired",      # job/stage retries triggered by faults
     "queue_depth_hwm",    # high-water mark of the job queue depth
+    "jobs_shed",          # submits refused / queued jobs dropped on deadline
+    "jobs_replayed",      # jobs re-enqueued from the journal at startup
+    "evicted_jobs",       # terminal job records evicted (TTL / max count)
+    "journal_bytes",      # bytes appended to the write-ahead journal
 )
 
 

@@ -1,0 +1,159 @@
+"""serve/journal.py unit tests: append/replay, torn tails, rotation.
+
+Pure host-side (no jax, no daemon): the journal is the durability spine
+of serve/, so its edge cases — a crash mid-append leaving a torn final
+record, checkpoint rotation racing a crash, replay of half-written
+lifecycles — get exhaustive cheap coverage here; the end-to-end crash
+proofs live in test_serve_durability.py.
+"""
+
+import json
+import os
+
+import pytest
+
+from consensuscruncher_tpu.serve.journal import (
+    Journal, idempotency_key, job_record, replay,
+)
+
+
+def _spec(output, **over):
+    spec = {"input": "/data/sample.bam", "output": str(output),
+            "name": "golden", "cutoff": 0.7, "qualscore": 0,
+            "scorrect": True, "max_mismatch": 0, "bdelim": "|",
+            "compress_level": 6}
+    spec.update(over)
+    return spec
+
+
+def test_idempotency_key_stable_and_field_order_free(tmp_path):
+    spec = _spec(tmp_path)
+    k = idempotency_key(spec)
+    assert len(k) == 16 and int(k, 16) >= 0
+    shuffled = dict(reversed(list(spec.items())))
+    assert idempotency_key(shuffled) == k
+    # protocol-only fields must not change identity: a resubmit with a
+    # different deadline is the SAME work
+    assert idempotency_key({**spec, "deadline_s": 5.0}) == k
+    assert idempotency_key(_spec(tmp_path, cutoff=0.8)) != k
+    assert idempotency_key(_spec(tmp_path / "other")) != k
+
+
+def test_append_replay_round_trip_merges_by_id(tmp_path):
+    jp = str(tmp_path / "wal")
+    j = Journal(jp)
+    spec = _spec(tmp_path / "a")
+    n = j.append_job(1, "accepted", key="k1", spec=spec, deadline_s=9.0)
+    assert n > 0 and j.size() == n
+    j.append_job(2, "accepted", key="k2", spec=_spec(tmp_path / "b"))
+    j.append_job(1, "dispatched")
+    j.append_job(1, "done", outputs={"base": "/out/a"}, wall_s=1.25)
+    j.close()
+
+    jobs, info = replay(jp)
+    assert sorted(jobs) == [1, 2]
+    assert info == {"records": 4, "skipped": 0, "torn_tail": False,
+                    "clean_drain": False}
+    # later records merged over earlier: state advanced, spec retained
+    assert jobs[1]["state"] == "done"
+    assert jobs[1]["spec"] == spec
+    assert jobs[1]["key"] == "k1" and jobs[1]["deadline_s"] == 9.0
+    assert jobs[1]["outputs"] == {"base": "/out/a"}
+    assert jobs[2]["state"] == "accepted"
+
+
+def test_records_are_deterministic_bytes(tmp_path):
+    """sort_keys + compact separators: the same lifecycle writes the same
+    bytes — journal diffs are meaningful and replay is reproducible."""
+    paths = [str(tmp_path / "w1"), str(tmp_path / "w2")]
+    for p in paths:
+        j = Journal(p)
+        j.append_job(1, "accepted", key="k", spec=_spec(tmp_path / "x"))
+        j.append_job(1, "done", wall_s=2.0)
+        j.close()
+    blobs = [open(p, "rb").read() for p in paths]
+    assert blobs[0] == blobs[1]
+    for line in blobs[0].splitlines():
+        doc = json.loads(line)
+        assert list(doc) == sorted(doc)
+
+
+def test_torn_final_record_tolerated_and_logged(tmp_path, capfd):
+    jp = str(tmp_path / "wal")
+    j = Journal(jp)
+    j.append_job(1, "accepted", key="k1", spec=_spec(tmp_path / "a"))
+    j.close()
+    # crash mid-append: a truncated record with no trailing newline
+    with open(jp, "ab") as fh:
+        fh.write(b'{"v":1,"rec":"job","id":2,"state":"acc')
+
+    jobs, info = replay(jp)
+    err = capfd.readouterr().err
+    assert "torn final record" in err
+    assert info["torn_tail"] is True and info["skipped"] == 1
+    # the intact prefix fully recovered; the torn submit was never acked
+    assert sorted(jobs) == [1]
+
+
+def test_corrupt_middle_record_skipped_rest_recovers(tmp_path, capfd):
+    jp = str(tmp_path / "wal")
+    j = Journal(jp)
+    j.append_job(1, "accepted", key="k1", spec=_spec(tmp_path / "a"))
+    j.append_job(2, "accepted", key="k2", spec=_spec(tmp_path / "b"))
+    j.close()
+    lines = open(jp, "rb").read().splitlines(keepends=True)
+    lines.insert(1, b"\x00garbage not json\n")
+    with open(jp, "wb") as fh:
+        fh.writelines(lines)
+
+    jobs, info = replay(jp)
+    assert "skipping unreadable record at line 2" in capfd.readouterr().err
+    assert info["skipped"] == 1 and info["torn_tail"] is False
+    assert sorted(jobs) == [1, 2]
+
+
+def test_drain_marker_semantics(tmp_path):
+    jp = str(tmp_path / "wal")
+    j = Journal(jp)
+    j.append_job(1, "accepted", key="k1", spec=_spec(tmp_path / "a"))
+    j.append_marker("drain")
+    assert replay(jp)[1]["clean_drain"] is True
+    # a job record after the marker belongs to a newer daemon life: the
+    # journal's last word is no longer a clean drain
+    j.append_job(2, "accepted", key="k2", spec=_spec(tmp_path / "b"))
+    j.close()
+    jobs, info = replay(jp)
+    assert info["clean_drain"] is False
+    assert sorted(jobs) == [1, 2]
+
+
+def test_rotation_compacts_atomically_and_appends_continue(tmp_path):
+    jp = str(tmp_path / "wal")
+    j = Journal(jp, max_bytes=64)
+    spec = _spec(tmp_path / "a")
+    for _ in range(20):
+        j.append_job(1, "dispatched")
+    big = j.size()
+    j.rotate([job_record(1, "done", key="k1", spec=spec,
+                         outputs={"base": "/out/a"})])
+    assert j.size() < big
+    # no rotation temp files left behind
+    assert sorted(os.listdir(tmp_path)) == ["wal"]
+    jobs, info = replay(jp)
+    assert info["records"] == 1 and jobs[1]["state"] == "done"
+    # the reopened fd appends to the NEW file
+    j.append_job(2, "accepted", key="k2", spec=_spec(tmp_path / "b"))
+    j.close()
+    assert sorted(replay(jp)[0]) == [1, 2]
+
+
+def test_closed_journal_refuses_appends(tmp_path):
+    j = Journal(str(tmp_path / "wal"))
+    j.close()
+    with pytest.raises(OSError, match="closed"):
+        j.append_marker("drain")
+
+
+def test_replay_missing_file_is_empty(tmp_path):
+    jobs, info = replay(str(tmp_path / "never-written"))
+    assert jobs == {} and info["records"] == 0

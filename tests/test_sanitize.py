@@ -208,7 +208,8 @@ def test_scheduler_lock_order_consistent_under_sanitize(tmp_path, monkeypatch):
     spec = {"input": "/dev/null", "output": str(tmp_path / "x"),
             "name": "n"}
     j1 = sched.submit(spec)
-    j2 = sched.submit(spec)
+    # distinct spec: a same-spec resubmit now dedupes onto j1
+    j2 = sched.submit({**spec, "name": "n2"})
     assert j2.id > j1.id
     health = sched.healthz()
     assert health["status"] == "serving"

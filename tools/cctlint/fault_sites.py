@@ -32,6 +32,13 @@ FAULT_SITES: dict[str, str] = {
     "serve.accept": "daemon connection accept/handling -> error reply",
     "serve.dispatch": "scheduler gang dispatch -> jobs retried solo",
     "serve.worker": "per-job worker execution -> retry via --resume",
+    "serve.journal_write": "journal append fails -> submit refused, never "
+                           "an acknowledged-but-unjournaled job",
+    "serve.journal_replay": "corrupt journal record -> skipped + logged, "
+                            "rest of the journal still recovers",
+    "serve.sigterm": "shutdown handler fault -> immediate stop; journal "
+                     "replay keeps even that lossless",
+    "serve.shed": "deadline admission check -> forced shed (refused reply)",
     "sscs.sync_probe": "sanitizer self-test: mid-stage host sync is caught "
                        "by CCT_SANITIZE=1 stage guards",
 }

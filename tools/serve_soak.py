@@ -1,0 +1,172 @@
+"""Soak the serve daemon's durability loop: submit, kill -9, recover.
+
+The harness drives the REAL crash-recovery stack end to end:
+
+  1. start a daemon child under :func:`supervisor.run_supervised` (the
+     ``serve --supervise`` loop) with a write-ahead ``--journal``;
+  2. submit ``--jobs`` consensus jobs against ``test/data/sample.bam``;
+  3. after a seeded random delay, ``kill -9`` the daemon (pid taken from
+     its own ``healthz`` reply) — the supervisor restarts it, the journal
+     replays, and every acknowledged job finishes via ``--resume``;
+  4. poll every job to completion BY IDEMPOTENCY KEY (ids don't survive a
+     restart, keys do) and verify each output tree against the frozen
+     ``test/golden.json`` digests — byte-identity, not just success;
+  5. SIGTERM the daemon: it drains, exits 0, and the supervisor returns 0.
+
+Exit status 0 means every accepted job completed byte-identical to an
+uninterrupted run.  Runs fully on CPU (the daemon child bootstraps
+through ``tools/_jax_cpu.force_cpu``); wired into the suite as the
+``slow``-marked test in ``tests/test_serve_durability.py``:
+
+  python tools/serve_soak.py --jobs 4 --workdir /tmp/soak --seed 7
+  pytest tests/test_serve_durability.py -m slow
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import signal
+import sys
+import threading
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+sys.path.insert(0, os.path.join(_REPO, "test"))
+
+from consensuscruncher_tpu.serve import supervisor  # noqa: E402
+from consensuscruncher_tpu.serve.client import ServeClient  # noqa: E402
+
+# the daemon child must drop the axon PJRT factory BEFORE first backend
+# touch (JAX_PLATFORMS=cpu alone still dials the tunnel) — same bootstrap
+# as the chaos tests' CLI subprocesses
+_BOOT = (
+    "import sys; "
+    f"sys.path.insert(0, {_REPO!r}); "
+    f"sys.path.insert(0, {os.path.join(_REPO, 'tools')!r}); "
+    "from _jax_cpu import force_cpu; force_cpu(); "
+    "from consensuscruncher_tpu.cli import main; "
+    "sys.exit(main(sys.argv[1:]))"
+)
+
+
+def _spec(output: str) -> dict:
+    return {"input": os.path.join(_REPO, "test", "data", "sample.bam"),
+            "output": output, "name": "golden", "cutoff": 0.7,
+            "qualscore": 0, "scorrect": True, "max_mismatch": 0,
+            "bdelim": "|", "compress_level": 6}
+
+
+def _check_golden(base: str, golden: dict) -> list[str]:
+    """Digest-compare one job's output tree; returns mismatch descriptions."""
+    from make_test_data import canonical_bam_digest, text_digest
+
+    problems = []
+    for rel, want in golden["consensus"].items():
+        path = os.path.join(base, rel)
+        if not os.path.exists(path):
+            problems.append(f"missing {rel}")
+            continue
+        got = (canonical_bam_digest(path) if rel.endswith(".bam")
+               else text_digest(path))
+        if got != want:
+            problems.append(f"{rel}: digest {got} != golden {want}")
+    return problems
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--jobs", type=int, default=4)
+    ap.add_argument("--workdir", required=True,
+                    help="scratch directory for socket/journal/outputs")
+    ap.add_argument("--seed", type=int, default=0,
+                    help="seeds the kill-point jitter (reproducible chaos)")
+    ap.add_argument("--kill-after", type=float, default=5.0,
+                    help="mean seconds between the submits and the kill -9")
+    ap.add_argument("--timeout", type=float, default=600.0,
+                    help="per-job completion deadline")
+    args = ap.parse_args(argv)
+
+    os.makedirs(args.workdir, exist_ok=True)
+    sock = os.path.join(args.workdir, "soak.sock")
+    journal = os.path.join(args.workdir, "soak.journal")
+    golden = json.load(open(os.path.join(_REPO, "test", "golden.json")))
+
+    daemon_cmd = [sys.executable, "-c", _BOOT] + [
+        "serve", "--socket", sock, "--journal", journal,
+        "--gang_size", "1", "--queue_bound", str(max(8, args.jobs)),
+        "--backend", "xla_cpu", "--drain_s", "120",
+    ]
+    sup: dict = {}
+
+    def _supervise():
+        # non-main thread: run_supervised skips signal forwarding; the
+        # harness delivers signals straight to the daemon pid instead
+        sup["rc"] = supervisor.run_supervised(
+            daemon_cmd, max_restarts=5, base_s=0.2, cap_s=2.0)
+
+    sup_thread = threading.Thread(target=_supervise, name="soak-supervisor")
+    sup_thread.start()
+    client = ServeClient(sock, retries=200, retry_base_s=0.25)
+
+    try:
+        pid = client.healthz()["pid"]
+        print(f"soak: daemon serving (pid {pid}); submitting "
+              f"{args.jobs} job(s)", flush=True)
+        subs = []
+        for i in range(args.jobs):
+            out = os.path.join(args.workdir, f"job{i}")
+            subs.append((i, out, client.submit_full(_spec(out))))
+
+        rng = random.Random(args.seed)
+        delay = args.kill_after * rng.uniform(0.5, 1.5)
+        print(f"soak: kill -9 in {delay:.1f}s (seed {args.seed})", flush=True)
+        time.sleep(delay)
+        pid = client.healthz()["pid"]
+        os.kill(pid, signal.SIGKILL)
+        print(f"soak: killed daemon pid {pid}; supervisor restarts, "
+              "journal replays", flush=True)
+
+        failures = []
+        for i, out, sub in subs:
+            job = client.result(key=sub["key"], timeout=args.timeout)
+            if job["state"] != "done":
+                failures.append(f"job{i}: {job['state']} ({job.get('error')})")
+                continue
+            failures += [f"job{i}: {p}"
+                         for p in _check_golden(os.path.join(out, "golden"),
+                                                golden)]
+        replayed = client.metrics()["cumulative"]["jobs_replayed"]
+        print(f"soak: {args.jobs} job(s) finished, {replayed} replayed "
+              "from the journal", flush=True)
+
+        # clean shutdown: the daemon drains, exits 0, supervisor follows
+        os.kill(client.healthz()["pid"], signal.SIGTERM)
+        sup_thread.join(timeout=180)
+        if sup_thread.is_alive():
+            failures.append("supervisor did not exit after SIGTERM")
+        elif sup.get("rc") != 0:
+            failures.append(f"supervisor exited rc={sup.get('rc')}")
+
+        if failures:
+            for f in failures:
+                print(f"soak: FAIL {f}", file=sys.stderr, flush=True)
+            return 1
+        print("soak: OK — every accepted job byte-identical to golden",
+              flush=True)
+        return 0
+    finally:
+        if sup_thread.is_alive():
+            # last-resort teardown so a failed run never leaks the daemon
+            try:
+                os.kill(client.healthz()["pid"], signal.SIGTERM)
+            except Exception:
+                pass
+            sup_thread.join(timeout=60)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
