@@ -130,6 +130,7 @@ def _worker_stage(backend: str, bam: str, outdir: str) -> dict:
     on a 1-core host carried ~8% drift between dress rehearsal and driver);
     loadavg is recorded per run so noisy numbers are self-explaining.
     """
+    from consensuscruncher_tpu.obs import metrics as obs_metrics
     from consensuscruncher_tpu.stages.dcs_maker import run_dcs
     from consensuscruncher_tpu.stages.sscs_maker import run_sscs
 
@@ -149,6 +150,7 @@ def _worker_stage(backend: str, bam: str, outdir: str) -> dict:
         prefix_dir = os.path.join(outdir, f"{backend}_{run_name}")
         os.makedirs(prefix_dir, exist_ok=True)
         prefix = os.path.join(prefix_dir, "bench")
+        rc_before = obs_metrics.recompiles()
         t0 = time.perf_counter()
         sscs = run_sscs(bam, prefix, backend=stage_backend)
         t1 = time.perf_counter()
@@ -159,10 +161,23 @@ def _worker_stage(backend: str, bam: str, outdir: str) -> dict:
             "dcs_s": round(t2 - t1, 3),
             "total_s": round(t2 - t0, 3),
             "loadavg": round(os.getloadavg()[0], 2),
+            # warm runs should show 0: a nonzero warm recompile count is
+            # the shape-churn smell the jit-cache design rules out
+            "recompiles": obs_metrics.recompiles() - rc_before,
         }
         n_families = sscs.stats.get("families")
         n_reads = sscs.stats.get("total_reads")
     warm = min(runs[r]["total_s"] for r in runs if r.startswith("warm"))
+    # Counter/histogram evidence rides along with the timings: the last warm
+    # run's cumulative block from its metrics sidecar, plus the process-wide
+    # histogram snapshot (dispatch latency, batch occupancy).
+    cumulative = None
+    try:
+        with open(os.path.join(outdir, f"{backend}_{run_names[-1]}",
+                               "bench.metrics.json")) as fh:
+            cumulative = json.load(fh).get("cumulative")
+    except (OSError, ValueError):
+        pass
     return {
         "ok": True,
         "backend": backend,
@@ -170,6 +185,8 @@ def _worker_stage(backend: str, bam: str, outdir: str) -> dict:
         "n_reads": n_reads,
         "families_per_sec": round(n_families / warm, 1) if warm > 0 else 0.0,
         "runs": runs,
+        "cumulative": cumulative,
+        "histograms": obs_metrics.histograms_snapshot(),
         "jax_backend": _jax_backend_name(),
     }
 
@@ -598,6 +615,8 @@ def _main_impl() -> dict:
                     n_families=result.get("n_families"),
                     n_reads=result.get("n_reads"),
                     runs=result.get("runs"),
+                    cumulative=result.get("cumulative"),
+                    histograms=result.get("histograms"),
                     # dense wire estimate for roofline talk: bases+quals uint8
                     # per member position, both directions dominated by h2d
                     bytes_h2d_est=int(result.get("n_reads", 0)) * READ_LEN * 2,

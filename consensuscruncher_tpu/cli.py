@@ -70,6 +70,28 @@ def _bool(v) -> bool:
     return str(v).lower() in ("1", "true", "yes", "on")
 
 
+# config.ini key -> env var consumed by consensuscruncher_tpu.obs
+_OBS_ENV = {
+    "trace": "CCT_TRACE",
+    "trace_dir": "CCT_TRACE_DIR",
+    "trace_ring": "CCT_TRACE_RING",
+    "flight_ring": "CCT_FLIGHT_RING",
+}
+
+
+def _apply_obs_config(path: str | None) -> None:
+    """Fold the ``[obs]`` config section into the observability env vars.
+
+    ``setdefault`` so a real environment variable always wins over
+    config.ini — the same precedence the flag layer uses, one level down.
+    Applied for every subcommand (tracing is cross-cutting).
+    """
+    for key, value in _config_defaults(path, "obs").items():
+        env = _OBS_ENV.get(key)
+        if env and str(value) != "":
+            os.environ.setdefault(env, str(value))
+
+
 def make_checkpointed(manifest: RunManifest, resume: bool, label: str):
     """The one checkpoint/resume protocol both subcommands speak
     (SURVEY.md §5): skip a stage when --resume can prove its recorded
@@ -810,6 +832,17 @@ def serve_cmd(args) -> None:
     result_ttl_s = getattr(args, "result_ttl_s", None)
     result_ttl_s = float(result_ttl_s) if result_ttl_s not in (None, "") else None
 
+    # Flight recorder: dumps land next to the journal (or CCT_TRACE_DIR);
+    # installed BEFORE the Scheduler so journal-replay anomalies in its
+    # _recover can already dump.  SIGQUIT = post-mortem on demand.
+    from consensuscruncher_tpu.obs import flight as obs_flight
+
+    dump_dir = os.environ.get("CCT_TRACE_DIR") or (
+        os.path.dirname(os.path.abspath(journal.path)) if journal else None)
+    if dump_dir:
+        obs_flight.set_dump_dir(dump_dir)
+    obs_flight.install_sigquit()
+
     scheduler = Scheduler(
         queue_bound=int(args.queue_bound), gang_size=int(args.gang_size),
         backend=backend, max_batch=int(args.max_batch),
@@ -882,6 +915,22 @@ def submit_cmd(args) -> None:
     base = (job.get("outputs") or {}).get("base")
     print(f"submit: job {job_id} done in {job['wall_s']}s"
           + (f" — outputs under {base}" if base else ""))
+
+
+def trace_cmd(args) -> None:
+    """``trace export``: merge the per-process ``trace-*.ndjson`` shards a
+    CCT_TRACE=1 run left under --dir into one Chrome-trace JSON (open it in
+    Perfetto / chrome://tracing)."""
+    from consensuscruncher_tpu.obs import trace as obs_trace
+
+    if args.action == "export":
+        trace_dir = args.trace_dir or os.environ.get("CCT_TRACE_DIR")
+        if not trace_dir:
+            raise SystemExit(
+                "trace export: no trace directory — pass --dir or set "
+                "CCT_TRACE_DIR to where the traced run wrote its shards")
+        n = obs_trace.export_chrome_trace(trace_dir, args.out)
+        print(f"trace: exported {n} events from {trace_dir} -> {args.out}")
 
 
 # ------------------------------------------------------------------- argparse
@@ -1053,6 +1102,18 @@ def build_parser() -> argparse.ArgumentParser:
                        "supervise": "False", "max_restarts": 10,
                    })
 
+    t = sub.add_parser(
+        "trace", help="work with CCT_TRACE observability traces")
+    t.add_argument("action", choices=("export",),
+                   help="export: merge trace-*.ndjson shards into one "
+                        "Chrome-trace JSON for Perfetto/chrome://tracing")
+    t.add_argument("-c", "--config", default=None)
+    t.add_argument("--dir", dest="trace_dir",
+                   help="trace shard directory (default $CCT_TRACE_DIR)")
+    t.add_argument("--out", help="output path (default trace.json)")
+    t.set_defaults(func=trace_cmd, config_section="obs", required_args=(),
+                   builtin_defaults={"trace_dir": "", "out": "trace.json"})
+
     u = sub.add_parser(
         "submit", help="submit a consensus job to a running serve daemon")
     u.add_argument("-c", "--config", default=None)
@@ -1144,7 +1205,18 @@ def main(argv=None) -> int:
                             args.host_workers, int(adv) // d))
                         break
 
-    args.func(args)
+    _apply_obs_config(args.config)
+    from consensuscruncher_tpu.obs import trace as obs_trace
+
+    # The root CLI span mints the run's trace_id (serve jobs re-entering
+    # main() in-process inherit their job span's id instead); the explicit
+    # flush makes one-shot runs leave complete shards without relying on
+    # atexit ordering.
+    try:
+        with obs_trace.span(f"cli.{args.command}"):
+            args.func(args)
+    finally:
+        obs_trace.flush()
     return 0
 
 

@@ -1,0 +1,26 @@
+"""Observability layer: spans, histogram metrics, flight recorder.
+
+Three stdlib-only, import-cheap modules (nothing here may import jax —
+``utils.faults`` notifies this package from inside fault firings, and
+faults is imported by ``io/bgzf.py`` and the ``tools/`` scripts):
+
+- :mod:`.registry` — the one canonical name registry for cumulative
+  counters and histogram metrics.  ``profiling.Counters`` validates
+  against it, the serve ``metrics`` endpoint publishes it, and the
+  ``obscov`` cctlint pass loads it standalone to catch name drift.
+- :mod:`.trace` — correlation-id spans buffered in per-thread rings,
+  flushed as NDJSON shards under ``$CCT_TRACE_DIR`` and exported as
+  Chrome-trace JSON by ``cct trace export``.  Zero-cost when
+  ``CCT_TRACE`` is unset.
+- :mod:`.metrics` — fixed-bucket histograms (always on; they are part
+  of the metrics endpoint contract) plus the process-global recompile
+  counter, with a Prometheus text-exposition renderer.
+- :mod:`.flight` — a bounded ring of recent fault/shed/span events
+  dumped atomically on SIGQUIT and on serve anomalies, so post-mortems
+  after a kill -9 soak are self-serve.
+
+Import submodules directly (``from consensuscruncher_tpu.obs import
+trace``); this package init stays empty so the lint's standalone load of
+``registry.py`` and the lazy notify path in ``utils.faults`` never pull
+in more than they need.
+"""

@@ -1,0 +1,186 @@
+"""Fixed-bucket histograms, recompile counter, Prometheus rendering.
+
+Histograms are ALWAYS on — unlike spans they are part of the serve
+``metrics`` endpoint contract, and a lock + bisect per device batch or
+journal append is noise next to the fsync/dispatch they measure.  Names
+must exist in :mod:`.registry`; observing an unknown name raises, the
+same contract ``profiling.Counters`` now enforces for counters (and the
+``obscov`` lint enforces statically).
+
+The recompile counter keys on the dispatch *shape signature* — the
+tuple of static jit arguments plus padded array dims that XLA's cache
+keys on — rather than hooking ``jax.monitoring`` (version-fragile) or
+timing compiles.  First sighting of a signature in this process is what
+a cache miss is, so warm benches report 0 and shape churn shows up as
+exactly the number of distinct paddings dispatched.
+
+This module must not import ``utils.profiling`` (profiling imports the
+registry too; keeping metrics independent kills the cycle risk) and must
+stay jax-free (``utils.faults`` reaches it from fault firings).
+"""
+
+from __future__ import annotations
+
+import threading
+from bisect import bisect_left
+
+from consensuscruncher_tpu.obs.registry import COUNTERS, HISTOGRAMS
+
+
+class Histogram:
+    """Thread-safe fixed-bucket histogram (Prometheus ``le`` semantics:
+    a value lands in the first bucket whose upper bound is >= it)."""
+
+    __slots__ = ("buckets", "_counts", "_sum", "_count", "_lock")
+
+    def __init__(self, buckets):
+        self.buckets = tuple(float(b) for b in buckets)
+        self._counts = [0] * (len(self.buckets) + 1)  # last = +Inf
+        self._sum = 0.0
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, value) -> None:
+        v = float(value)
+        i = bisect_left(self.buckets, v)
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += v
+            self._count += 1
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "buckets": list(self.buckets),
+                "counts": list(self._counts),
+                "sum": round(self._sum, 6),
+                "count": self._count,
+            }
+
+
+def _zero_snapshot(name: str) -> dict:
+    buckets = list(HISTOGRAMS[name]["buckets"])
+    return {"buckets": buckets, "counts": [0] * (len(buckets) + 1),
+            "sum": 0.0, "count": 0}
+
+
+_lock = threading.Lock()
+_hists: dict[str, Histogram] = {}
+_recompiles = 0
+_seen_signatures: set = set()
+
+
+def get_histogram(name: str) -> Histogram:
+    try:
+        spec = HISTOGRAMS[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown histogram {name!r}; register it in "
+            f"consensuscruncher_tpu/obs/registry.py HISTOGRAMS"
+        ) from None
+    h = _hists.get(name)
+    if h is None:
+        with _lock:
+            h = _hists.setdefault(name, Histogram(spec["buckets"]))
+    return h
+
+
+def observe(name: str, value) -> None:
+    get_histogram(name).observe(value)
+
+
+def histograms_snapshot() -> dict:
+    """All registered histograms, zero-filled when never observed, so
+    every metrics doc / bench sidecar carries an identical schema."""
+    out = {}
+    for name in HISTOGRAMS:
+        h = _hists.get(name)
+        out[name] = h.snapshot() if h is not None else _zero_snapshot(name)
+    return out
+
+
+def note_compile(signature) -> bool:
+    """Record one device-dispatch shape signature; True on first
+    sighting (i.e. this dispatch paid an XLA compile in this process)."""
+    global _recompiles
+    with _lock:
+        if signature in _seen_signatures:
+            return False
+        _seen_signatures.add(signature)
+        _recompiles += 1
+        return True
+
+
+def recompiles() -> int:
+    with _lock:
+        return _recompiles
+
+
+def reset_for_tests() -> None:
+    global _recompiles
+    with _lock:
+        _hists.clear()
+        _seen_signatures.clear()
+        _recompiles = 0
+
+
+# ------------------------------------------------------- Prometheus text
+
+def _fmt(v) -> str:
+    if isinstance(v, float):
+        return repr(round(v, 9))
+    return str(int(v))
+
+
+def render_prometheus(doc: dict) -> str:
+    """Render a serve ``metrics`` doc (the JSON the endpoint already
+    serves) as Prometheus text exposition format 0.0.4."""
+    lines: list[str] = []
+
+    cum = doc.get("cumulative") or {}
+    for name in sorted(cum):
+        metric = f"cct_{name}_total"
+        lines.append(f"# HELP {metric} {COUNTERS.get(name, name)}")
+        lines.append(f"# TYPE {metric} counter")
+        lines.append(f"{metric} {_fmt(cum[name])}")
+
+    states = doc.get("jobs_by_state") or {}
+    if states:
+        lines.append("# TYPE cct_jobs gauge")
+        for state in sorted(states):
+            lines.append(f'cct_jobs{{state="{state}"}} {_fmt(states[state])}')
+
+    for gauge in ("n_jobs", "queue_bound", "gang_size"):
+        if gauge in doc:
+            lines.append(f"# TYPE cct_{gauge} gauge")
+            lines.append(f"cct_{gauge} {_fmt(doc[gauge])}")
+    if "draining" in doc:
+        lines.append("# TYPE cct_draining gauge")
+        lines.append(f"cct_draining {1 if doc['draining'] else 0}")
+
+    phases = doc.get("phases_s") or {}
+    if "uptime" in phases:
+        lines.append("# TYPE cct_uptime_seconds gauge")
+        lines.append(f"cct_uptime_seconds {_fmt(float(phases['uptime']))}")
+
+    journal = doc.get("journal") or {}
+    if "size_bytes" in journal:
+        lines.append("# TYPE cct_journal_size_bytes gauge")
+        lines.append(f"cct_journal_size_bytes {_fmt(journal['size_bytes'])}")
+
+    for name in sorted(doc.get("histograms") or {}):
+        h = doc["histograms"][name]
+        metric = f"cct_{name}"
+        spec = HISTOGRAMS.get(name, {})
+        if spec.get("help"):
+            lines.append(f"# HELP {metric} {spec['help']}")
+        lines.append(f"# TYPE {metric} histogram")
+        acc = 0
+        for bound, n in zip(h["buckets"], h["counts"]):
+            acc += n
+            lines.append(f'{metric}_bucket{{le="{bound:g}"}} {acc}')
+        lines.append(f'{metric}_bucket{{le="+Inf"}} {h["count"]}')
+        lines.append(f"{metric}_sum {_fmt(float(h['sum']))}")
+        lines.append(f"{metric}_count {h['count']}")
+
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,78 @@
+"""Canonical metric-name registry: cumulative counters and histograms.
+
+This module is pure data with ZERO imports.  Three consumers depend on
+that property:
+
+- ``utils.profiling.Counters`` imports ``CUMULATIVE_KEYS`` to validate
+  ``add()``/``high_water()`` keys (unknown names raise instead of
+  silently minting a counter the metrics endpoint never publishes);
+- ``obs.metrics`` builds its histogram hub from ``HISTOGRAMS``;
+- the ``obscov`` cctlint pass (CCT602) loads this file *standalone*
+  via ``importlib.util.spec_from_file_location`` — without the package
+  or its dependencies on sys.path — to check that every metric name
+  used anywhere in the repo exists here.
+
+To add a counter or histogram, add it here first; using an unregistered
+name anywhere else is both a runtime ``KeyError`` and a lint error.
+"""
+
+# name -> help text.  Folded into every metrics doc by
+# ``Counters.snapshot`` (zero-filled), so the schema never varies with
+# which code paths happened to run.
+COUNTERS = {
+    "families_in": "read families consumed from the grouped stream",
+    "families_out": "consensus families emitted by the device stage",
+    "batches_dispatched": "device batches dispatched (padded gangs count once)",
+    "retries_fired": "worker attempts retried after an injected/real fault",
+    "queue_depth_hwm": "high-water mark of the serve admission queue",
+    "jobs_shed": "jobs refused or failed by deadline/overload shedding",
+    "jobs_replayed": "jobs re-enqueued from the journal at daemon start",
+    "evicted_jobs": "terminal jobs evicted from the in-memory registry",
+    "journal_bytes": "bytes appended to the write-ahead journal",
+    "recompiles": "distinct device-dispatch shapes compiled this process",
+}
+
+CUMULATIVE_KEYS = tuple(COUNTERS)
+
+# Latency buckets roughly log-spaced from 100 microseconds to 5 minutes;
+# chosen once here so every exported histogram is cross-comparable.
+_LATENCY_BUCKETS = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0,
+)
+
+# Occupancy is a ratio in (0, 1]; fine buckets near 1.0 because padding
+# waste is the quantity of interest.
+_RATIO_BUCKETS = (0.05, 0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7, 0.8, 0.9,
+                  0.95, 1.0)
+
+# name -> {"buckets": upper bounds (le), "unit": ..., "help": ...}.
+# ``obs.metrics`` zero-fills all of these in ``histograms_snapshot`` so
+# the serve endpoint and bench sidecars always carry the full set.
+HISTOGRAMS = {
+    "queue_wait_s": {
+        "buckets": _LATENCY_BUCKETS,
+        "unit": "seconds",
+        "help": "serve admission to gang dispatch wait per job",
+    },
+    "journal_fsync_s": {
+        "buckets": _LATENCY_BUCKETS,
+        "unit": "seconds",
+        "help": "write-ahead journal append+fsync latency per record",
+    },
+    "device_dispatch_s": {
+        "buckets": _LATENCY_BUCKETS,
+        "unit": "seconds",
+        "help": "device batch dispatch wall time (compile included)",
+    },
+    "batch_occupancy": {
+        "buckets": _RATIO_BUCKETS,
+        "unit": "ratio",
+        "help": "real rows / padded capacity per emitted device batch",
+    },
+    "job_wall_s": {
+        "buckets": _LATENCY_BUCKETS,
+        "unit": "seconds",
+        "help": "serve job wall time from dispatch to terminal state",
+    },
+}

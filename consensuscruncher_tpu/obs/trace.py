@@ -1,0 +1,306 @@
+"""Correlation-id spans buffered in per-thread rings (Chrome-trace out).
+
+Design constraints, in priority order:
+
+1. **Zero-cost when off.**  ``span()`` returns one shared no-op context
+   manager when ``CCT_TRACE`` is unset — no allocation, no clock read.
+   The enabled flag is cached against the raw env string and re-checked
+   only when the string changes (same trick as ``faults.get``), so tests
+   can flip it with ``monkeypatch.setenv``.
+2. **Lock-free-ish hot path.**  Each thread appends finished spans to
+   its own ring (a plain list owned by the thread); the only global lock
+   guards thread-state registration and span-id minting.  ``flush()``
+   swaps rings out and appends all lines with a single ``os.write`` on
+   an ``O_APPEND`` descriptor — atomic per write on POSIX, so shards
+   from concurrent flushes interleave at line granularity, never inside
+   a line.
+3. **Determinism firewall.**  Spans only ever land in sidecar files
+   (``trace-<pid>.ndjson`` under ``$CCT_TRACE_DIR``); nothing here
+   touches pipeline outputs, so golden digests cannot be perturbed.
+   Trace ids come from ``os.urandom`` (not ``random``) so enabling
+   tracing never advances any seeded RNG stream.
+
+Span parenting rides a per-thread stack: a span with no explicit
+``trace_id`` inherits the enclosing span's, and mints a fresh one at the
+root — so a one-shot CLI run gets its id at ``cli.<command>`` while a
+serve worker inherits the id minted at ``submit``.
+
+Wall/monotonic split: ``ts`` is epoch microseconds at span start (what
+Perfetto aligns across processes and against ``maybe_profile``'s XLA
+timeline) while ``dur`` is measured with ``perf_counter`` so NTP steps
+cannot produce negative spans.
+"""
+
+from __future__ import annotations
+
+import atexit
+import binascii
+import glob
+import json
+import os
+import threading
+import time
+
+from consensuscruncher_tpu.obs import metrics as _metrics
+
+_TRUE_WORDS = ("1", "true", "on", "yes")
+
+# (raw env string, parsed flag) — compare the raw string so setenv in
+# tests invalidates the cache without an explicit reset hook.
+_env_cache: tuple[str, bool] = ("\x00unset", False)
+
+
+def enabled() -> bool:
+    global _env_cache
+    raw = os.environ.get("CCT_TRACE", "")
+    if raw != _env_cache[0]:
+        _env_cache = (raw, raw.strip().lower() in _TRUE_WORDS)
+    return _env_cache[1]
+
+
+def _ring_cap() -> int:
+    try:
+        return max(64, int(os.environ.get("CCT_TRACE_RING", "4096")))
+    except ValueError:
+        return 4096
+
+
+def mint_trace_id() -> str:
+    return binascii.hexlify(os.urandom(8)).decode("ascii")
+
+
+class _ThreadState:
+    __slots__ = ("events", "stack")
+
+    def __init__(self):
+        self.events: list[dict] = []
+        # (trace_id, span_id) of each open span, innermost last
+        self.stack: list[tuple[str | None, int]] = []
+
+
+_tls = threading.local()
+_states: list[_ThreadState] = []
+_state_lock = threading.Lock()
+_next_span_id = 0
+
+
+def _state() -> _ThreadState:
+    st = getattr(_tls, "st", None)
+    if st is None:
+        st = _ThreadState()
+        _tls.st = st
+        with _state_lock:
+            _states.append(st)
+    return st
+
+
+def _mint_span_id() -> int:
+    global _next_span_id
+    with _state_lock:
+        _next_span_id += 1
+        return _next_span_id
+
+
+def _record(st: _ThreadState, ev: dict) -> None:
+    st.events.append(ev)
+    if len(st.events) >= _ring_cap():
+        if _shard_path() is not None:
+            flush()
+        else:
+            # no sink configured: bounded ring, drop the oldest half
+            del st.events[: len(st.events) // 2]
+
+
+def current_trace_id() -> str | None:
+    st = getattr(_tls, "st", None)
+    if st is None or not st.stack:
+        return None
+    return st.stack[-1][0]
+
+
+class _Noop:
+    """Shared do-nothing context manager for the disabled fast path."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        return False
+
+
+_NOOP = _Noop()
+
+
+class _Span:
+    __slots__ = ("name", "trace_id", "histogram", "args",
+                 "_recording", "_span_id", "_parent_id", "_t0", "_w0")
+
+    def __init__(self, name, trace_id, histogram, args):
+        self.name = name
+        self.trace_id = trace_id
+        self.histogram = histogram
+        self.args = args
+
+    def __enter__(self):
+        self._recording = enabled()
+        if self._recording:
+            st = _state()
+            parent = st.stack[-1] if st.stack else None
+            if self.trace_id is None:
+                self.trace_id = parent[0] if parent else mint_trace_id()
+            self._span_id = _mint_span_id()
+            self._parent_id = parent[1] if parent else None
+            st.stack.append((self.trace_id, self._span_id))
+        self._w0 = time.time()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        dur = time.perf_counter() - self._t0
+        if self.histogram is not None:
+            _metrics.observe(self.histogram, dur)
+        if self._recording:
+            st = _state()
+            if st.stack:
+                st.stack.pop()
+            args = {"trace_id": self.trace_id}
+            if self._parent_id is not None:
+                args["parent"] = self._parent_id
+            if exc_type is not None:
+                args["error"] = exc_type.__name__
+            args.update(self.args)
+            _record(st, {
+                "name": self.name, "cat": "cct", "ph": "X",
+                "ts": int(self._w0 * 1e6), "dur": max(1, int(dur * 1e6)),
+                "pid": os.getpid(), "tid": threading.get_ident(),
+                "id": self._span_id, "args": args,
+            })
+        return False
+
+
+def span(name: str, trace_id: str | None = None,
+         histogram: str | None = None, **args):
+    """Context manager timing ``name``.
+
+    ``histogram`` names a registered histogram that the duration is
+    always observed into, even with tracing disabled (histograms are
+    part of the metrics endpoint, not the trace).  Without one, the
+    disabled path returns a shared no-op object.
+    """
+    if not enabled() and histogram is None:
+        return _NOOP
+    return _Span(name, trace_id, histogram, args)
+
+
+def event(name: str, trace_id: str | None = None, **args) -> None:
+    """Record an instant event (Chrome-trace ``ph: i``), parented to the
+    innermost open span on this thread."""
+    if not enabled():
+        return
+    st = _state()
+    parent = st.stack[-1] if st.stack else None
+    a: dict = {}
+    tid = trace_id if trace_id is not None else (parent[0] if parent else None)
+    if tid is not None:
+        a["trace_id"] = tid
+    if parent is not None:
+        a["parent"] = parent[1]
+    a.update(args)
+    _record(st, {
+        "name": name, "cat": "cct", "ph": "i", "s": "t",
+        "ts": int(time.time() * 1e6),
+        "pid": os.getpid(), "tid": threading.get_ident(), "args": a,
+    })
+
+
+def _shard_path() -> str | None:
+    d = os.environ.get("CCT_TRACE_DIR", "")
+    if not d:
+        return None
+    return os.path.join(d, f"trace-{os.getpid()}.ndjson")
+
+
+def _grab_all() -> list[dict]:
+    grabbed: list[list[dict]] = []
+    with _state_lock:
+        for st in _states:
+            if st.events:
+                grabbed.append(st.events)
+                st.events = []
+    return [ev for ring in grabbed for ev in ring]
+
+
+def flush() -> int:
+    """Drain every thread ring into this process's NDJSON shard.
+
+    Returns the number of events written (0 when ``CCT_TRACE_DIR`` is
+    unset — events then stay in the bounded in-memory rings).  The write
+    happens outside all locks: a single ``os.write`` to an ``O_APPEND``
+    fd keeps whole lines atomic under concurrent flushers.
+    """
+    path = _shard_path()
+    if path is None:
+        return 0
+    events = _grab_all()
+    if not events:
+        return 0
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    data = "".join(
+        json.dumps(ev, sort_keys=True) + "\n" for ev in events
+    ).encode("utf-8")
+    fd = os.open(path, os.O_APPEND | os.O_CREAT | os.O_WRONLY, 0o644)
+    try:
+        os.write(fd, data)
+    finally:
+        os.close(fd)
+    return len(events)
+
+
+def drain_events() -> list[dict]:
+    """Remove and return all buffered events (test hook; no file IO)."""
+    return _grab_all()
+
+
+def recent_events(limit: int = 256) -> list[dict]:
+    """Non-destructive snapshot of the newest buffered events, oldest
+    first (feeds flight-recorder dumps without stealing the shard's)."""
+    with _state_lock:
+        snap = [ev for st in _states for ev in st.events]
+    snap.sort(key=lambda ev: ev.get("ts", 0))
+    return snap[-limit:]
+
+
+def export_chrome_trace(trace_dir: str, out_path: str) -> int:
+    """Merge ``trace-*.ndjson`` shards under ``trace_dir`` into a single
+    Chrome-trace JSON at ``out_path``; returns the event count.
+
+    The output loads directly in Perfetto / ``chrome://tracing`` and can
+    sit beside ``maybe_profile``'s XLA trace (both use epoch-µs ``ts``).
+    Corrupt lines (torn by a kill) are skipped, not fatal.
+    """
+    if _shard_path() is not None:
+        flush()
+    events: list[dict] = []
+    for path in sorted(glob.glob(os.path.join(trace_dir, "trace-*.ndjson"))):
+        with open(path, "r", encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    ev = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(ev, dict):
+                    events.append(ev)
+    events.sort(key=lambda ev: (ev.get("ts", 0), ev.get("pid", 0)))
+    doc = {"traceEvents": events, "displayTimeUnit": "ms"}
+    with open(out_path, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, sort_keys=True)
+        fh.write("\n")
+    return len(events)
+
+
+atexit.register(flush)

@@ -33,6 +33,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from consensuscruncher_tpu.obs import metrics as obs_metrics
+from consensuscruncher_tpu.obs import trace as obs_trace
 from consensuscruncher_tpu.ops.consensus_tpu import ConsensusConfig, _consensus_one_family
 from consensuscruncher_tpu.ops.duplex_tpu import duplex_vote
 from consensuscruncher_tpu.ops.packing import pack4, unpack4_device
@@ -537,14 +539,22 @@ def _run_member_batch_stream(batches, config: ConsensusConfig,
         # bounded (<=4 per 32-wide length bucket, not 32)
         out_len = int(batch.lengths.max(initial=0))
         out_len = -(-out_len // 8) * 8 or None
-        if mesh is not None:
-            from consensuscruncher_tpu.parallel.mesh import stream_vote_sharded
+        obs_metrics.note_compile(
+            ("stream", wire, num, den, qt, qc, member_cap, out_len)
+            + np.shape(a))
+        with obs_trace.span("device.dispatch", histogram="device_dispatch_s",
+                            wire=wire, n_real=batch.n_real):
+            if mesh is not None:
+                from consensuscruncher_tpu.parallel.mesh import stream_vote_sharded
 
-            return stream_vote_sharded(mesh, wire, a, b, batch.sizes, num, den,
-                                       qt, qc, member_cap, out_len)
-        fn = _compiled_stream_vote(wire, num, den, qt, qc, member_cap, out_len)
-        # explicit h2d at the dispatch boundary (CCT_SANITIZE transfer guard)
-        return fn(jnp.asarray(a), jnp.asarray(b), jnp.asarray(batch.sizes))
+                return stream_vote_sharded(mesh, wire, a, b, batch.sizes,
+                                           num, den, qt, qc, member_cap,
+                                           out_len)
+            fn = _compiled_stream_vote(wire, num, den, qt, qc, member_cap,
+                                       out_len)
+            # explicit h2d at the dispatch boundary (CCT_SANITIZE transfer
+            # guard)
+            return fn(jnp.asarray(a), jnp.asarray(b), jnp.asarray(batch.sizes))
 
     def fetch(item, handle):
         batch = item[0]

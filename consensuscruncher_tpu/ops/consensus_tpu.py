@@ -43,6 +43,8 @@ from consensuscruncher_tpu.core.consensus_cpu import (
     DEFAULT_QUAL_THRESHOLD,
     cutoff_fraction,
 )
+from consensuscruncher_tpu.obs import metrics as obs_metrics
+from consensuscruncher_tpu.obs import trace as obs_trace
 from consensuscruncher_tpu.utils.phred import N, NUM_BASES, PAD
 
 
@@ -128,8 +130,13 @@ def consensus_batch(
     """
     num, den = config.cutoff_rational
     fn = _compiled_batch_fn(num, den, int(config.qual_threshold), int(config.qual_cap))
+    b = np.asarray(bases)
+    # XLA's jit cache keys on (static config, padded shape): first sighting
+    # of this signature in the process is a compile
+    obs_metrics.note_compile(
+        (num, den, int(config.qual_threshold), int(config.qual_cap)) + b.shape)
     return fn(
-        jnp.asarray(bases, dtype=jnp.uint8),
+        jnp.asarray(b, dtype=jnp.uint8),
         jnp.asarray(quals, dtype=jnp.uint8),
         jnp.asarray(fam_sizes, dtype=jnp.int32),
     )
@@ -186,17 +193,29 @@ def consensus_families(
         def dispatch(batch):
             if on_batch is not None:
                 on_batch(batch)
-            return consensus_batch(batch.bases, batch.quals, batch.fam_sizes, config)
+            with obs_trace.span("device.dispatch",
+                                histogram="device_dispatch_s",
+                                n_real=batch.n_real):
+                return consensus_batch(batch.bases, batch.quals,
+                                       batch.fam_sizes, config)
     else:
         from consensuscruncher_tpu.parallel.mesh import pad_batch_to_mesh, sharded_vote_async
 
         def dispatch(batch):
             if on_batch is not None:
                 on_batch(batch)
-            bases, quals, sizes, _lengths, _n = pad_batch_to_mesh(
-                batch.bases, batch.quals, batch.fam_sizes, mesh, batch.lengths
-            )
-            return sharded_vote_async(bases, quals, sizes, mesh, config)
+            with obs_trace.span("device.dispatch",
+                                histogram="device_dispatch_s",
+                                n_real=batch.n_real):
+                bases, quals, sizes, _lengths, _n = pad_batch_to_mesh(
+                    batch.bases, batch.quals, batch.fam_sizes, mesh,
+                    batch.lengths
+                )
+                obs_metrics.note_compile(
+                    ("mesh",) + config.cutoff_rational
+                    + (int(config.qual_threshold), int(config.qual_cap))
+                    + np.shape(bases))
+                return sharded_vote_async(bases, quals, sizes, mesh, config)
 
     def fetch(batch, handle):
         out_b, out_q = (np.asarray(x) for x in handle)

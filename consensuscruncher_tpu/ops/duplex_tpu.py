@@ -15,6 +15,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from consensuscruncher_tpu.core.consensus_cpu import DEFAULT_QUAL_CAP
+from consensuscruncher_tpu.obs import metrics as obs_metrics
+from consensuscruncher_tpu.obs import trace as obs_trace
 from consensuscruncher_tpu.utils.phred import N
 
 
@@ -50,12 +52,15 @@ def duplex_batch(seq1, qual1, seq2, qual2, qual_cap: int = DEFAULT_QUAL_CAP):
     """Batched duplex vote: four ``(B, L)`` uint8 arrays -> two ``(B, L)``
     (returned as one stacked ``(2, B, L)`` device array)."""
     fn = _compiled(int(qual_cap))
-    return fn(
-        jnp.asarray(seq1, dtype=jnp.uint8),
-        jnp.asarray(qual1, dtype=jnp.uint8),
-        jnp.asarray(seq2, dtype=jnp.uint8),
-        jnp.asarray(qual2, dtype=jnp.uint8),
-    )
+    obs_metrics.note_compile(("duplex", int(qual_cap)) + np.shape(seq1))
+    with obs_trace.span("device.dispatch", histogram="device_dispatch_s",
+                        n_real=int(np.shape(seq1)[0]) if np.ndim(seq1) else 0):
+        return fn(
+            jnp.asarray(seq1, dtype=jnp.uint8),
+            jnp.asarray(qual1, dtype=jnp.uint8),
+            jnp.asarray(seq2, dtype=jnp.uint8),
+            jnp.asarray(qual2, dtype=jnp.uint8),
+        )
 
 
 def duplex_batch_host(seq1, qual1, seq2, qual2, qual_cap: int = DEFAULT_QUAL_CAP):

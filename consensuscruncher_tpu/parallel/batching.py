@@ -29,6 +29,7 @@ from typing import Iterable, Iterator, Sequence
 
 import numpy as np
 
+from consensuscruncher_tpu.obs import metrics as obs_metrics
 from consensuscruncher_tpu.utils.phred import N, PAD
 from consensuscruncher_tpu.utils.ragged import fill_runs, scatter_runs
 
@@ -212,6 +213,7 @@ def _emit_members(bucket: _MemberBucket, lb: int) -> MemberBatch:
     # keeps recompiles as bounded as a fixed cap would).
     n = len(bucket.keys)
     cap = max(MIN_BATCH, next_pow2(n))
+    obs_metrics.observe("batch_occupancy", n / cap)
     m = bucket.members
     m_pad = max(MEMBER_QUANTUM, -(-m // MEMBER_QUANTUM) * MEMBER_QUANTUM)
     rows = np.zeros((m_pad, lb), dtype=np.uint8)
@@ -235,6 +237,9 @@ def _emit_members(bucket: _MemberBucket, lb: int) -> MemberBatch:
 def _emit(bucket: _Bucket, fb: int, lb: int, pad_to: int | None) -> FamilyBatch:
     n = len(bucket.keys)
     cap = pad_to if pad_to is not None else max(MIN_BATCH, next_pow2(n))
+    # padding waste at the source: every emitted device batch observes its
+    # real/capacity ratio exactly once (here, not per dispatch wrapper)
+    obs_metrics.observe("batch_occupancy", n / cap)
     bases = np.full((cap, fb, lb), PAD, dtype=np.uint8)
     quals = np.zeros((cap, fb, lb), dtype=np.uint8)
     bases[:n] = np.stack(bucket.bases)
@@ -283,6 +288,7 @@ def bucket_member_blocks(
         bucket = buckets.pop(key)
         n = len(bucket.keys)
         cap = max(MIN_BATCH, next_pow2(n))
+        obs_metrics.observe("batch_occupancy", n / cap)
         m = bucket.members
         m_pad = max(MEMBER_QUANTUM, -(-m // MEMBER_QUANTUM) * MEMBER_QUANTUM)
         rows = np.zeros((m_pad, lb), dtype=np.uint8)

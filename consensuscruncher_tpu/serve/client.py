@@ -142,6 +142,11 @@ class ServeClient:
     def metrics(self) -> dict:
         return self._request({"op": "metrics"})["metrics"]
 
+    def metrics_prometheus(self) -> str:
+        """The same metrics doc rendered as Prometheus text exposition."""
+        return self._request(
+            {"op": "metrics", "format": "prometheus"})["prometheus"]
+
     def drain(self, timeout: float | None = None) -> None:
         sock_timeout = None if timeout is None else timeout + 10.0
         self._request({"op": "drain", "timeout": timeout}, timeout=sock_timeout)

@@ -54,6 +54,7 @@ import os
 import sys
 import tempfile
 
+from consensuscruncher_tpu.obs import trace as obs_trace
 from consensuscruncher_tpu.utils import faults, sanitize
 from consensuscruncher_tpu.utils.manifest import commit_file
 
@@ -76,13 +77,17 @@ def idempotency_key(spec: dict) -> str:
 def job_record(job_id: int, state: str, *, key: str | None = None,
                spec: dict | None = None, deadline_s: float | None = None,
                outputs: dict | None = None, error: str | None = None,
-               wall_s: float | None = None) -> dict:
+               wall_s: float | None = None,
+               trace_id: str | None = None) -> dict:
     """One journal record; only non-None fields are written (transition
-    records carry just the delta, replay merges by id)."""
+    records carry just the delta, replay merges by id).  ``trace_id`` is
+    the correlation id minted at submit — journaled so a replayed job's
+    spans stitch onto the pre-crash trace."""
     rec: dict = {"v": 1, "rec": "job", "id": int(job_id), "state": state}
     for field, value in (("key", key), ("spec", spec),
                          ("deadline_s", deadline_s), ("outputs", outputs),
-                         ("error", error), ("wall_s", wall_s)):
+                         ("error", error), ("wall_s", wall_s),
+                         ("trace_id", trace_id)):
         if value is not None:
             rec[field] = value
     return rec
@@ -116,15 +121,19 @@ class Journal:
     def append(self, doc: dict) -> int:
         """Append one record and fsync; returns bytes written.  Raises on
         any write/fsync failure (the caller must NOT acknowledge work whose
-        record did not reach disk).  ``serve.journal_write`` fires here."""
+        record did not reach disk).  ``serve.journal_write`` fires here.
+        The write+fsync is timed into the ``journal_fsync_s`` histogram —
+        fsync latency is the admission path's floor."""
         faults.fault_point("serve.journal_write")
         line = _encode(doc)
-        with self._lock:
-            if self._fd < 0:
-                raise OSError("journal is closed")
-            os.write(self._fd, line)
-            os.fsync(self._fd)
-            self._size += len(line)
+        with obs_trace.span("journal.append", histogram="journal_fsync_s",
+                            bytes=len(line)):
+            with self._lock:
+                if self._fd < 0:
+                    raise OSError("journal is closed")
+                os.write(self._fd, line)
+                os.fsync(self._fd)
+                self._size += len(line)
         return len(line)
 
     def append_job(self, job_id: int, state: str, **fields) -> int:

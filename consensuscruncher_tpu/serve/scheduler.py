@@ -60,6 +60,9 @@ import threading
 import time
 from collections import deque
 
+from consensuscruncher_tpu.obs import flight as obs_flight
+from consensuscruncher_tpu.obs import metrics as obs_metrics
+from consensuscruncher_tpu.obs import trace as obs_trace
 from consensuscruncher_tpu.serve import journal as journal_mod
 from consensuscruncher_tpu.utils import faults, sanitize
 from consensuscruncher_tpu.utils.profiling import Counters, metrics_doc
@@ -85,7 +88,8 @@ class Job:
     _id_lock = sanitize.tracked_lock("job.id_lock")
 
     def __init__(self, spec: dict, job_id: int | None = None,
-                 key: str | None = None, deadline_s: float | None = None):
+                 key: str | None = None, deadline_s: float | None = None,
+                 trace_id: str | None = None):
         with Job._id_lock:
             if job_id is None:
                 Job._next_id += 1
@@ -99,6 +103,11 @@ class Job:
         self.spec = dict(spec)
         self.key = key
         self.deadline_s = deadline_s
+        # correlation id minted at submit; every span this job produces —
+        # admission, journal append, gang dispatch, device batches, writer
+        # commit — carries it, so one grep of the exported trace follows
+        # the job end to end
+        self.trace_id = trace_id or obs_trace.mint_trace_id()
         self.state = "queued"
         self.error: str | None = None
         self.outputs: dict | None = None
@@ -114,7 +123,7 @@ class Job:
             "outputs": self.outputs, "wall_s": self.wall_s,
             "attempts": self.attempts, "gang_size": self.gang_size,
             "input": self.spec.get("input"), "key": self.key,
-            "deadline_s": self.deadline_s,
+            "deadline_s": self.deadline_s, "trace_id": self.trace_id,
         }
 
 
@@ -254,7 +263,8 @@ class _GangJobState:
 
 
 def gang_sscs(specs: list[dict], counters: Counters | None = None,
-              max_batch: int = 1024) -> None:
+              max_batch: int = 1024,
+              trace_ids: list[str] | None = None) -> None:
     """Run the SSCS stage for several jobs as ONE merged device stream.
 
     Families from every job are interleaved round-robin into a single
@@ -262,6 +272,10 @@ def gang_sscs(specs: list[dict], counters: Counters | None = None,
     results demux back to per-job writers.  Records each job's manifest
     entry on success; aborts every job's writers on failure (no partial
     outputs — the caller retries jobs solo via resume).
+
+    ``trace_ids`` (one per spec, positional) lets each shared device batch
+    be attributed: the per-batch trace event lists the trace_id of every
+    job whose families rode that dispatch.
     """
     from consensuscruncher_tpu.ops.consensus_tpu import (
         ConsensusConfig, consensus_families,
@@ -276,10 +290,19 @@ def gang_sscs(specs: list[dict], counters: Counters | None = None,
     cfg = ConsensusConfig(cutoff=cutoff, qual_threshold=qualscore)
 
     states = [_GangJobState(s) for s in specs]
+    tracing = obs_trace.enabled() and trace_ids is not None
 
     def on_batch(batch):
         if counters is not None:
             counters.add("batches_dispatched")
+        if tracing:
+            # which jobs' families share this device dispatch — distinct
+            # trace_ids on one batch span is the whole point of tracing a
+            # continuous-batching scheduler
+            owners = sorted({k[0] for k in batch.keys})
+            obs_trace.event(
+                "device.batch", n_real=batch.n_real,
+                trace_ids=[trace_ids[i] for i in owners])
 
     ok = False
     try:
@@ -303,9 +326,12 @@ def gang_sscs(specs: list[dict], counters: Counters | None = None,
         if not ok:
             for st in states:
                 st.abort()
-    for st in states:
-        st.close_outputs()
-        st.record(cutoff, qualscore, "tpu")
+    for i, st in enumerate(states):
+        with obs_trace.span(
+                "writer.commit",
+                trace_id=trace_ids[i] if trace_ids else None):
+            st.close_outputs()
+            st.record(cutoff, qualscore, "tpu")
 
 
 class Scheduler:
@@ -377,7 +403,12 @@ class Scheduler:
         key = journal_mod.idempotency_key(spec)
         deadline_s = spec.get("deadline_s")
         deadline_s = None if deadline_s is None else float(deadline_s)
-        with self._cond:
+        # the trace_id is minted HERE, before admission can refuse, so shed
+        # decisions and journal-write failures are traceable too; an
+        # admitted Job adopts it for life
+        trace_id = obs_trace.mint_trace_id()
+        with obs_trace.span("serve.submit", trace_id=trace_id,
+                            input=spec.get("input")), self._cond:
             existing = self._by_key.get(key)
             if existing is not None and existing in self._jobs:
                 return self._jobs[existing], False
@@ -388,7 +419,7 @@ class Scheduler:
             if len(self._queue) >= self.queue_bound:
                 raise AdmissionRefused(
                     f"queue full ({len(self._queue)}/{self.queue_bound})")
-            job = Job(spec, key=key, deadline_s=deadline_s)
+            job = Job(spec, key=key, deadline_s=deadline_s, trace_id=trace_id)
             if self._journal is not None:
                 # the accepted record must be on disk BEFORE the job is
                 # acknowledged: a refused-but-unjournaled submit is safe to
@@ -397,7 +428,7 @@ class Scheduler:
                 try:
                     n = self._journal.append_job(
                         job.id, "accepted", key=job.key, spec=job.spec,
-                        deadline_s=job.deadline_s)
+                        deadline_s=job.deadline_s, trace_id=job.trace_id)
                 except Exception as e:
                     raise AdmissionRefused(
                         f"journal write failed ({e}); job not accepted")
@@ -417,6 +448,7 @@ class Scheduler:
             faults.fault_point("serve.shed")
         except faults.FaultError as e:
             self.counters.add("jobs_shed")
+            self._flight_shed(f"injected: {e}")
             raise DeadlineShed(f"shed: {e}")
         if deadline_s is None or self._ewma_job_s is None:
             return
@@ -424,10 +456,19 @@ class Scheduler:
         eta = (backlog + 1) * self._ewma_job_s / max(1, self.gang_size)
         if eta > deadline_s:
             self.counters.add("jobs_shed")
+            self._flight_shed(f"eta {eta:.1f}s > deadline_s={deadline_s:g} "
+                              f"(backlog={backlog})")
             raise DeadlineShed(
                 f"shed: estimated completion {eta:.1f}s exceeds "
                 f"deadline_s={deadline_s:g} (backlog={backlog}, "
                 f"ewma_job_s={self._ewma_job_s:.2f})")
+
+    @staticmethod
+    def _flight_shed(why: str) -> None:
+        """A shed is an anomaly worth a post-mortem: record it and dump the
+        flight ring so the overload's lead-up survives the incident."""
+        obs_flight.record("shed", why=why)
+        obs_flight.dump(reason="shed")
 
     def get(self, job_id: int) -> Job | None:
         return self._jobs.get(int(job_id))
@@ -494,7 +535,7 @@ class Scheduler:
             recs.append(journal_mod.job_record(
                 j.id, to_journal.get(j.state, j.state), key=j.key,
                 spec=j.spec, deadline_s=j.deadline_s, outputs=j.outputs,
-                error=j.error, wall_s=j.wall_s))
+                error=j.error, wall_s=j.wall_s, trace_id=j.trace_id))
         return recs
 
     def _maybe_rotate_locked(self) -> None:
@@ -528,7 +569,8 @@ class Scheduler:
                     continue
                 job = Job(spec, job_id=jid,
                           key=rec.get("key") or journal_mod.idempotency_key(spec),
-                          deadline_s=rec.get("deadline_s"))
+                          deadline_s=rec.get("deadline_s"),
+                          trace_id=rec.get("trace_id"))
                 self._jobs[job.id] = job
                 self._by_key[job.key] = job.id
                 if rec.get("state") in ("done", "failed"):
@@ -556,6 +598,15 @@ class Scheduler:
                   + (" (previous shutdown was a clean drain)"
                      if info["clean_drain"] else ""),
                   file=sys.stderr, flush=True)
+        if (requeued or dropped or info["skipped"] or info["torn_tail"]) \
+                and not info["clean_drain"]:
+            # the previous daemon died uncleanly with work in flight: this
+            # dump is the post-mortem a kill -9 itself could never write
+            obs_flight.record(
+                "journal_replay", requeued=requeued, finished=finished,
+                skipped=dropped + info["skipped"],
+                torn_tail=info["torn_tail"])
+            obs_flight.dump(reason="journal-replay")
 
     # ------------------------------------------------------------- retention
 
@@ -648,14 +699,19 @@ class Scheduler:
             jobs = [j.describe() for j in self._jobs.values()]
             states = {s: sum(1 for j in self._jobs.values() if j.state == s)
                       for s in _STATES}
+            cumulative = self.counters.snapshot()
+            # recompiles live process-globally (the jit cache is per
+            # process, not per Counters instance): folded in at read time
+            cumulative["recompiles"] = obs_metrics.recompiles()
             doc = metrics_doc(
                 "serve", {"uptime": time.time() - self._started_at},
                 {"n_jobs": len(jobs), "queue_bound": self.queue_bound,
                  "gang_size": self.gang_size, "draining": self._draining,
                  "jobs_by_state": states},
-                cumulative=self.counters.snapshot(),
+                cumulative=cumulative,
             )
             doc["jobs"] = jobs
+            doc["histograms"] = obs_metrics.histograms_snapshot()
             if self._journal is not None:
                 doc["journal"] = {"path": self._journal.path,
                                   "size_bytes": self._journal.size()}
@@ -722,6 +778,7 @@ class Scheduler:
                 for job in live:
                     job.state = "running"
                     job.gang_size = len(live)
+                    obs_metrics.observe("queue_wait_s", now - job.submitted_t)
                     self._journal_update_locked(job, "dispatched")
                 self._running = list(live)
                 self._cond.notify_all()
@@ -737,8 +794,11 @@ class Scheduler:
         if len(gang) > 1:
             try:
                 faults.fault_point("serve.dispatch")
-                gang_sscs([j.spec for j in gang], self.counters,
-                          max_batch=self.max_batch)
+                with obs_trace.span("serve.gang", n_jobs=len(gang),
+                                    trace_id=gang[0].trace_id):
+                    gang_sscs([j.spec for j in gang], self.counters,
+                              max_batch=self.max_batch,
+                              trace_ids=[j.trace_id for j in gang])
             except Exception as e:
                 # Gang failure granularity is the gang: fall back to solo
                 # runs — each job's resume path re-runs whatever its own
@@ -748,17 +808,26 @@ class Scheduler:
         for job in gang:
             jt0 = t0 if len(gang) > 1 else time.monotonic()
             try:
-                self._run_job(job)
+                with obs_trace.span("serve.job", trace_id=job.trace_id,
+                                    job_id=job.id):
+                    self._run_job(job)
                 outcome = "done"
             except Exception as e:
                 job.error = f"{type(e).__name__}: {e}"
                 outcome = "failed"
+                # unhandled worker death (retries exhausted): dump the ring
+                # while the evidence — fault firings, retry lineage — is
+                # still in memory
+                obs_flight.record("worker_death", job_id=job.id,
+                                  trace_id=job.trace_id, error=job.error)
+                obs_flight.dump(reason="worker-death")
             if outcome == "done":
                 self.aggregate_job_metrics(job)
             with self._cond:
                 # gang jobs count from dispatch start: the shared SSCS wall
                 # belongs to every member's end-to-end latency
                 job.wall_s = round(time.monotonic() - jt0, 6)
+                obs_metrics.observe("job_wall_s", job.wall_s)
                 job.state = outcome
                 job.finished_t = time.monotonic()
                 self._ewma_job_s = job.wall_s if self._ewma_job_s is None \
@@ -810,6 +879,13 @@ class Scheduler:
                 if attempt + 1 >= attempts:
                     raise
                 self.counters.add("retries_fired")
+                # retry lineage: attempt ordinal + error on the job's trace
+                obs_trace.event("serve.retry", trace_id=job.trace_id,
+                                job_id=job.id, attempt=attempt + 1,
+                                error=f"{type(e).__name__}: {e}")
+                obs_flight.record("retry", job_id=job.id, attempt=attempt + 1,
+                                  trace_id=job.trace_id,
+                                  error=f"{type(e).__name__}: {e}")
                 delay = faults.backoff_delay(attempt + 1, base, 30.0)
                 print(f"WARNING: serve job {job.id} attempt "
                       f"{attempt + 1}/{attempts} failed ({e}); retrying via "

@@ -15,6 +15,10 @@ Ops (all replies carry ``"ok"``):
    "timeout": seconds|null}             -> blocks; {"ok": true, "job": {...}}
   {"op": "healthz"}                     -> {"ok": true, "health": {...}}
   {"op": "metrics"}                     -> {"ok": true, "metrics": {...}}
+  {"op": "metrics",
+   "format": "prometheus"}              -> {"ok": true, "prometheus": "..."}
+                                           (text exposition, histograms
+                                           with cumulative le buckets)
   {"op": "drain", "timeout": s|null}    -> blocks; {"ok": true, "drained": true}
 
 ``status``/``result`` accept ``"key"`` (the submit reply's idempotency
@@ -50,6 +54,7 @@ import sys
 import threading
 import time
 
+from consensuscruncher_tpu.obs.metrics import render_prometheus
 from consensuscruncher_tpu.serve.scheduler import (
     AdmissionRefused, DeadlineShed, Scheduler,
 )
@@ -282,7 +287,12 @@ class ServeServer:
             if op == "healthz":
                 return {"ok": True, "health": self.scheduler.healthz()}
             if op == "metrics":
-                return {"ok": True, "metrics": self.scheduler.metrics()}
+                doc = self.scheduler.metrics()
+                if req.get("format") == "prometheus":
+                    # text exposition for scrapers; same doc, rendered
+                    return {"ok": True,
+                            "prometheus": render_prometheus(doc)}
+                return {"ok": True, "metrics": doc}
             if op == "drain":
                 self.scheduler.drain(timeout=req.get("timeout"))
                 return {"ok": True, "drained": True}

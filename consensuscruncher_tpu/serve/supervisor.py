@@ -34,6 +34,7 @@ import subprocess
 import sys
 import time
 
+from consensuscruncher_tpu.obs import flight as obs_flight
 from consensuscruncher_tpu.utils import faults
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(
@@ -103,6 +104,10 @@ def run_supervised(cmd: list[str], max_restarts: int | None = None,
                 streak = 0  # a long healthy run restarts from the base delay
             restarts += 1
             streak += 1
+            # the supervisor outlives the crash, so its flight ring is the
+            # one place the restart history accumulates across child lives
+            obs_flight.record("child_crash", rc=int(rc),
+                              alive_s=round(alive_s, 3), restart=restarts)
             if restarts > max_restarts:
                 print(f"ERROR: daemon crashed rc={rc}; restart budget "
                       f"({max_restarts}) exhausted — giving up",

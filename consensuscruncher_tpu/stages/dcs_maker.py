@@ -31,6 +31,8 @@ import struct
 import sys
 
 from consensuscruncher_tpu.core import tags as tags_mod
+from consensuscruncher_tpu.obs import metrics as obs_metrics
+from consensuscruncher_tpu.obs import trace as obs_trace
 from consensuscruncher_tpu.utils import faults, sanitize
 from consensuscruncher_tpu.core.consensus_read import _KEEP_FLAGS
 from consensuscruncher_tpu.core.duplex_cpu import duplex_consensus
@@ -418,10 +420,12 @@ def run_dcs(
     unpaired_writer = SortingBamWriter(unpaired_path, reader.header, level=level)
     rec_writer = ConsensusRecordWriter(dcs_writer)
 
+    recompiles_before = obs_metrics.recompiles()
     ok = False
     try:
         try:
-            with sanitize.guarded_stage("dcs"):
+            with sanitize.guarded_stage("dcs"), \
+                    obs_trace.span("dcs.device_loop", wire="blocks"):
                 _consume_pair_blocks(
                     reader, stats, unpaired_writer, rec_writer, qual_cap, backend, mesh
                 )
@@ -439,7 +443,8 @@ def run_dcs(
             unpaired_writer = SortingBamWriter(unpaired_path, reader.header,
                                                level=level)
             rec_writer = ConsensusRecordWriter(dcs_writer)
-            with sanitize.guarded_stage("dcs"):
+            with sanitize.guarded_stage("dcs"), \
+                    obs_trace.span("dcs.device_loop", wire="windows"):
                 _run_dcs_windows(
                     reader, stats, unpaired_writer, rec_writer, qual_cap, backend, mesh,
                 )
@@ -452,8 +457,9 @@ def run_dcs(
             unpaired_writer.abort()
 
     tracker.mark("pairing")
-    dcs_writer.close()
-    unpaired_writer.close()
+    with obs_trace.span("writer.commit", stage="dcs"):
+        dcs_writer.close()
+        unpaired_writer.close()
     tracker.mark("sort")
     record_backend(stats, backend)
     stats.write(paths["stats_txt"])
@@ -463,7 +469,8 @@ def run_dcs(
     write_metrics(
         f"{out_prefix}.dcs.metrics.json", "DCS", tracker.as_phases(),
         {"backend": backend, "jax_backend": stats.get("jax_backend"),
-         "pairs": stats.get("pairs"), "sscs_total": stats.get("sscs_total")},
+         "pairs": stats.get("pairs"), "sscs_total": stats.get("sscs_total"),
+         "recompiles": obs_metrics.recompiles() - recompiles_before},
     )
     return DcsResult(dcs_path, unpaired_path, stats)
 

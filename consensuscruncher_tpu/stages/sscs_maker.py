@@ -32,6 +32,8 @@ from dataclasses import dataclass
 import numpy as np
 
 from consensuscruncher_tpu.core import tags as tags_mod
+from consensuscruncher_tpu.obs import metrics as obs_metrics
+from consensuscruncher_tpu.obs import trace as obs_trace
 from consensuscruncher_tpu.utils import faults, sanitize
 from consensuscruncher_tpu.core.consensus_cpu import consensus_maker_numpy
 from consensuscruncher_tpu.core.consensus_read import (
@@ -236,6 +238,7 @@ def run_sscs(
     stats = StageStats("SSCS")
     hist = FamilySizeHistogram()
     cum = Counters()
+    recompiles_before = obs_metrics.recompiles()
     cfg = ConsensusConfig(cutoff=cutoff, qual_threshold=qual_threshold, qual_cap=qual_cap)
 
     paths = output_paths(out_prefix)
@@ -429,11 +432,13 @@ def run_sscs(
                     block_items(), cfg, max_batch=4 * max_batch, mesh=mesh
                 )
                 try:
-                    with sanitize.guarded_stage("sscs"):
+                    with sanitize.guarded_stage("sscs"), \
+                            obs_trace.span("sscs.device_loop", wire="stream"):
                         for keys, lengths, out_b, out_q in stream:
                             sanitize.sync_probe("sscs.sync_probe")
                             cum.add("batches_dispatched")
                             cum.add("families_in", len(keys))
+                            obs_trace.event("device.batch", n_real=len(keys))
                             emit_batch(keys, lengths, out_b, out_q)
                 finally:
                     # Must run BEFORE the writers close below: closing the
@@ -447,12 +452,14 @@ def run_sscs(
                     sanitize.sync_probe("sscs.sync_probe")
                     cum.add("batches_dispatched")
                     cum.add("families_in", batch.n_real)
+                    obs_trace.event("device.batch", n_real=batch.n_real)
 
                 stream = consensus_families(
                     events(), cfg, max_batch=max_batch, mesh=mesh, on_batch=on_batch
                 )
                 try:
-                    with sanitize.guarded_stage("sscs"):
+                    with sanitize.guarded_stage("sscs"), \
+                            obs_trace.span("sscs.device_loop", wire="dense"):
                         for fid, codes, quals in stream:
                             emit(fid, codes, quals)
                 finally:
@@ -491,9 +498,10 @@ def run_sscs(
                 w.abort()
     tracker.mark("consensus")
     # sorting writers do their lexsort + final BGZF write inside close()
-    bad_writer.close()
-    sscs_writer.close()
-    singleton_writer.close()
+    with obs_trace.span("writer.commit", stage="sscs"):
+        bad_writer.close()
+        sscs_writer.close()
+        singleton_writer.close()
     tracker.mark("sort")
 
     record_backend(stats, backend)
@@ -503,6 +511,7 @@ def run_sscs(
     hist.write(paths["families"])
     tracker.write(paths["time_tracker"])
     cum.add("families_out", stats.get("sscs_written"))
+    cum.add("recompiles", obs_metrics.recompiles() - recompiles_before)
     write_metrics(
         f"{out_prefix}.metrics.json", "SSCS", tracker.as_phases(),
         {"backend": backend, "jax_backend": jax_backend,

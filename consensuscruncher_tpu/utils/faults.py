@@ -151,13 +151,41 @@ def _perform(site: str, d: dict) -> None:
     raise ValueError(f"unknown fault kind {kind!r} at site {site!r}")
 
 
+def _notify(site: str, d: dict) -> None:
+    """Every fault firing, whichever entry point consumed it, lands in the
+    observability layer: a trace event (the obscov lint's CCT601 contract)
+    and a flight-recorder entry.  Fatal kinds dump the ring before the
+    process disappears — the only post-mortem an ``exit``/``kill`` leaves.
+    Lazy import: faults must stay import-cheap for io/ and tools/ parents,
+    and obs must be free to import faults-adjacent utils."""
+    kind = d.get("kind", "?")
+    try:
+        from consensuscruncher_tpu.obs import flight, trace
+        trace.event("fault.fire", site=site, kind=kind)
+        flight.record("fault", site=site, fault=kind)
+        if kind in ("exit", "kill"):
+            flight.dump(reason=f"fault-{kind}:{site}")
+    except Exception as e:  # never let observability break the injection
+        print(f"WARNING: fault notify failed at {site}: {e}",
+              file=sys.stderr, flush=True)
+
+
+def _consume(site: str) -> dict | None:
+    """Shared budget-consume path for :func:`fault_point` and :func:`fire`:
+    returns the armed directive (after notifying observers) or None."""
+    inj = get()
+    if not inj._sites:
+        return None
+    d = inj.fire(site)
+    if d is not None:
+        _notify(site, d)
+    return d
+
+
 def fault_point(site: str) -> None:
     """The one call a subsystem plants at an injection point.  No-op (two
     dict lookups) unless CCT_FAULTS arms ``site``."""
-    inj = get()
-    if not inj._sites:
-        return
-    d = inj.fire(site)
+    d = _consume(site)
     if d is not None:
         _perform(site, d)
 
@@ -166,10 +194,7 @@ def fire(site: str) -> dict | None:
     """Like :func:`fault_point` but returns the directive instead of acting,
     for call sites that express the fault in their own vocabulary (e.g. the
     watcher swapping in a known-failing command)."""
-    inj = get()
-    if not inj._sites:
-        return None
-    return inj.fire(site)
+    return _consume(site)
 
 
 def hook(site: str):

@@ -23,17 +23,10 @@ from contextlib import contextmanager
 #: One shared counter schema for the one-shot CLI metrics sidecars and the
 #: serve daemon's ``metrics`` endpoint.  Missing keys default to 0 so readers
 #: can rely on the full set being present wherever ``cumulative`` appears.
-CUMULATIVE_KEYS = (
-    "families_in",        # families admitted to the vote kernels
-    "families_out",       # consensus results emitted back to writers
-    "batches_dispatched",  # device dispatches (bucketed batches)
-    "retries_fired",      # job/stage retries triggered by faults
-    "queue_depth_hwm",    # high-water mark of the job queue depth
-    "jobs_shed",          # submits refused / queued jobs dropped on deadline
-    "jobs_replayed",      # jobs re-enqueued from the journal at startup
-    "evicted_jobs",       # terminal job records evicted (TTL / max count)
-    "journal_bytes",      # bytes appended to the write-ahead journal
-)
+#: The canonical definition (names + help text) lives in
+#: ``obs.registry.COUNTERS`` next to the histogram registry; re-exported
+#: here so existing importers keep working.
+from consensuscruncher_tpu.obs.registry import CUMULATIVE_KEYS
 
 
 class Counters:
@@ -41,19 +34,31 @@ class Counters:
 
     ``add`` accumulates, ``high_water`` keeps a running max (for gauges like
     queue depth), ``snapshot`` returns a plain dict with every key present.
+    Keys outside the registry raise ``KeyError`` — an unregistered counter
+    would silently vanish from ``snapshot``'s normalised schema, which is
+    exactly the drift the registry exists to prevent.
     """
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._values = {k: 0 for k in CUMULATIVE_KEYS}
 
+    @staticmethod
+    def _check(key: str) -> None:
+        if key not in CUMULATIVE_KEYS:
+            raise KeyError(
+                f"unknown counter {key!r}; register it in "
+                f"consensuscruncher_tpu/obs/registry.py COUNTERS")
+
     def add(self, key: str, amount: int = 1) -> None:
+        self._check(key)
         with self._lock:
-            self._values[key] = self._values.get(key, 0) + int(amount)
+            self._values[key] += int(amount)
 
     def high_water(self, key: str, value: int) -> None:
+        self._check(key)
         with self._lock:
-            if int(value) > self._values.get(key, 0):
+            if int(value) > self._values[key]:
                 self._values[key] = int(value)
 
     def snapshot(self) -> dict[str, int]:

@@ -1,0 +1,27 @@
+"""Seeded CCT6xx violations for the obscov pass self-test.
+
+A faults-machinery lookalike whose entry points never notify the
+observability layer (CCT601), plus metric calls under names no registry
+knows (CCT602).
+"""
+
+
+def _perform(site, d):
+    raise RuntimeError(f"{site}: {d}")
+
+
+def fault_point(site):  # CCT601: never reaches _notify
+    d = {"kind": "fail"}
+    _perform(site, d)
+
+
+def fire(site):  # CCT601: never reaches _notify
+    return {"kind": "fail", "site": site}
+
+
+def bump(cum, obs_metrics, obs_trace):
+    cum.add("families_in_misspelled")  # CCT602: not in COUNTERS
+    cum.high_water("queue_depth_hwm_typo", 3)  # CCT602: not in COUNTERS
+    obs_metrics.observe("no_such_histogram", 0.5)  # CCT602: not in HISTOGRAMS
+    with obs_trace.span("x", histogram="also_not_registered"):  # CCT602
+        pass

@@ -1,0 +1,310 @@
+"""Observability layer (obs/): spans, histograms, flight recorder, wiring.
+
+The load-bearing assertions:
+
+- **Correlation**: two jobs gang-batched into ONE merged device stream
+  keep distinct trace_ids end to end — submit span, journal append,
+  shared ``device.batch`` events (listing BOTH owners), per-job worker
+  spans with correct parenting, writer commits.
+- **Endpoint**: the serve ``metrics`` op serves histograms in JSON and a
+  scrape-parseable Prometheus text exposition (cumulative buckets,
+  ``+Inf`` == count).
+- **Flight recorder**: SIGQUIT dumps an atomic, parseable ring.
+- **Determinism firewall**: the full golden pipeline under CCT_TRACE=1
+  still reproduces the frozen digests, and its exported Chrome trace
+  passes ``tools/trace_check.py``.
+"""
+
+import json
+import os
+import re
+import signal
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "test"))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from consensuscruncher_tpu.obs import flight as obs_flight
+from consensuscruncher_tpu.obs import metrics as obs_metrics
+from consensuscruncher_tpu.obs import trace as obs_trace
+from consensuscruncher_tpu.obs.registry import COUNTERS, HISTOGRAMS
+
+DATA = os.path.join(REPO, "test", "data")
+SAMPLE = os.path.join(DATA, "sample.bam")
+
+
+def _spec(output, name="golden"):
+    return {"input": SAMPLE, "output": str(output), "name": name,
+            "cutoff": 0.7, "qualscore": 0, "scorrect": True,
+            "max_mismatch": 0, "bdelim": "|", "compress_level": 6}
+
+
+# ------------------------------------------------------------ unit layer
+
+def test_span_is_shared_noop_when_disabled(monkeypatch):
+    monkeypatch.setenv("CCT_TRACE", "0")
+    obs_trace.drain_events()
+    a = obs_trace.span("x")
+    b = obs_trace.span("y", key="value")
+    assert a is b  # the shared no-op object: no allocation per call
+    with a:
+        obs_trace.event("ignored")
+    assert obs_trace.drain_events() == []
+
+
+def test_span_parenting_and_trace_id_inheritance(monkeypatch):
+    monkeypatch.setenv("CCT_TRACE", "1")
+    obs_trace.drain_events()
+    with obs_trace.span("outer", trace_id="t-abc"):
+        with obs_trace.span("inner"):
+            obs_trace.event("evt", n=1)
+    events = obs_trace.drain_events()
+    by_name = {e["name"]: e for e in events}
+    outer, inner, evt = by_name["outer"], by_name["inner"], by_name["evt"]
+    assert inner["args"]["trace_id"] == "t-abc"  # inherited
+    assert inner["args"]["parent"] == outer["id"]
+    assert evt["args"]["parent"] == inner["id"]
+    assert evt["ph"] == "i" and evt["s"] == "t"
+    assert outer["ph"] == "X" and outer["dur"] >= 1
+
+
+def test_histogram_buckets_and_unknown_names_raise():
+    with pytest.raises(KeyError, match="register it"):
+        obs_metrics.get_histogram("not_a_histogram")
+    with pytest.raises(KeyError):
+        obs_metrics.observe("also_not_one", 1.0)
+    h = obs_metrics.get_histogram("queue_wait_s")
+    before = h.snapshot()["count"]
+    obs_metrics.observe("queue_wait_s", 0.0004)
+    snap = h.snapshot()
+    assert snap["count"] == before + 1
+    assert len(snap["counts"]) == len(snap["buckets"]) + 1
+    # le semantics: 0.0004 lands at the first bound >= it
+    idx = next(i for i, b in enumerate(snap["buckets"]) if b >= 0.0004)
+    assert snap["counts"][idx] >= 1
+
+
+def test_registry_is_the_single_schema():
+    from consensuscruncher_tpu.utils.profiling import CUMULATIVE_KEYS
+
+    assert set(CUMULATIVE_KEYS) == set(COUNTERS)
+    assert "recompiles" in COUNTERS
+    for name, spec in HISTOGRAMS.items():
+        assert spec["buckets"] == tuple(sorted(spec["buckets"])), name
+        assert spec["help"], name
+
+
+def test_fault_fire_emits_trace_event_and_flight_record(monkeypatch):
+    from consensuscruncher_tpu.utils import faults
+
+    monkeypatch.setenv("CCT_TRACE", "1")
+    monkeypatch.setenv("CCT_FAULTS", "obs.test=fail")
+    obs_trace.drain_events()
+    with pytest.raises(faults.FaultError):
+        faults.fault_point("obs.test")
+    events = obs_trace.drain_events()
+    fired = [e for e in events if e["name"] == "fault.fire"]
+    assert fired and fired[0]["args"]["site"] == "obs.test"
+    assert any(ev["kind"] == "fault" and ev.get("site") == "obs.test"
+               for ev in obs_flight.RECORDER.snapshot())
+
+
+def test_flight_dump_is_atomic_and_parseable(tmp_path):
+    rec = obs_flight.FlightRecorder(capacity=16)
+    for i in range(20):  # overflow the ring: bounded, newest survive
+        rec.record("tick", i=i)
+    rec.set_dump_dir(str(tmp_path))
+    out = rec.dump(reason="unit")
+    doc = json.load(open(out))
+    assert doc["reason"] == "unit" and doc["v"] == 1
+    assert len(doc["events"]) == 16
+    assert doc["events"][-1]["i"] == 19
+    assert not [p for p in os.listdir(tmp_path) if p.startswith(".flight.")]
+
+
+def test_sigquit_dumps_flight_ring(tmp_path):
+    rec = obs_flight.FlightRecorder(capacity=32)
+    rec.set_dump_dir(str(tmp_path))
+    rec.record("before_signal", ok=True)
+    prev = obs_flight.install_sigquit(rec)
+    try:
+        os.kill(os.getpid(), signal.SIGQUIT)
+        deadline = time.monotonic() + 5
+        dumps = []
+        while time.monotonic() < deadline and not dumps:
+            time.sleep(0.01)  # let the pending signal deliver
+            dumps = sorted(p for p in os.listdir(tmp_path)
+                           if p.startswith("flight-"))
+    finally:
+        signal.signal(signal.SIGQUIT, prev)
+    assert dumps, "SIGQUIT produced no flight dump"
+    doc = json.load(open(os.path.join(tmp_path, dumps[0])))
+    assert doc["reason"] == "sigquit"
+    kinds = [e["kind"] for e in doc["events"]]
+    assert "before_signal" in kinds and "signal" in kinds
+
+
+# ------------------------------------------------------------ serve layer
+
+def test_gang_tracing_correlates_submit_to_shared_batches(
+        tmp_path, monkeypatch):
+    """Two jobs, one merged stream: distinct trace_ids must survive onto
+    the SHARED device-batch events and back apart onto per-job spans."""
+    from consensuscruncher_tpu.serve.scheduler import Scheduler
+
+    monkeypatch.setenv("CCT_TRACE", "1")
+    monkeypatch.delenv("CCT_TRACE_DIR", raising=False)
+    obs_trace.drain_events()
+    sched = Scheduler(queue_bound=4, gang_size=4, backend="tpu", paused=True,
+                      journal=str(tmp_path / "obs.journal"))
+    try:
+        j1 = sched.submit(_spec(tmp_path / "a"))
+        j2 = sched.submit(_spec(tmp_path / "b"))
+        assert j1.trace_id != j2.trace_id
+        sched.release()
+        sched.wait(j1.id, timeout=600)
+        sched.wait(j2.id, timeout=600)
+        assert (j1.state, j2.state) == ("done", "done"), (j1.error, j2.error)
+        assert j1.gang_size == 2  # the gang really merged
+    finally:
+        sched.close(timeout=120)
+
+    events = obs_trace.drain_events()
+    spans = [e for e in events if e["ph"] == "X"]
+    tids = {j1.trace_id, j2.trace_id}
+
+    submits = [e for e in spans if e["name"] == "serve.submit"]
+    assert {e["args"]["trace_id"] for e in submits} == tids
+
+    # admission was journaled inside the submit span
+    appends = [e for e in spans if e["name"] == "journal.append"]
+    assert appends and all(e["args"]["bytes"] > 0 for e in appends)
+
+    # the merged stream: batch events list their owners' trace ids, and at
+    # least one device batch carries families of BOTH jobs at once
+    batches = [e for e in events
+               if e["name"] == "device.batch" and "trace_ids" in e["args"]]
+    assert batches
+    assert tids <= set().union(*(set(e["args"]["trace_ids"]) for e in batches))
+    assert any(len(set(e["args"]["trace_ids"])) == 2 for e in batches)
+
+    # back apart: per-job worker spans, each parenting its CLI re-entry
+    job_spans = {e["args"]["trace_id"]: e for e in spans
+                 if e["name"] == "serve.job"}
+    assert set(job_spans) == tids
+    for tid, js in job_spans.items():
+        nested = [e for e in spans if e["name"] == "cli.consensus"
+                  and e["args"].get("parent") == js["id"]]
+        assert nested, "serve.job did not parent its CLI worker span"
+        assert all(e["args"]["trace_id"] == tid for e in nested)
+
+    commits = {e["args"]["trace_id"] for e in spans
+               if e["name"] == "writer.commit"}
+    assert tids <= commits
+
+    gang = [e for e in spans if e["name"] == "serve.gang"]
+    assert gang and gang[0]["args"]["n_jobs"] == 2
+
+    # device dispatches were timed into the endpoint histogram too
+    assert obs_metrics.histograms_snapshot()["device_dispatch_s"]["count"] > 0
+
+
+_PROM_LINE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? -?[0-9eE.+]+$")
+
+
+def test_metrics_endpoint_serves_json_and_prometheus(tmp_path):
+    from consensuscruncher_tpu.serve.client import ServeClient
+    from consensuscruncher_tpu.serve.scheduler import Scheduler
+    from consensuscruncher_tpu.serve.server import ServeServer
+
+    sched = Scheduler(queue_bound=2, gang_size=1, backend="tpu",
+                      paused=True, start=False,
+                      journal=str(tmp_path / "m.journal"))
+    obs_metrics.observe("queue_wait_s", 0.002)
+    obs_metrics.observe("queue_wait_s", 1.5)
+    obs_metrics.observe("batch_occupancy", 0.5)
+    server = ServeServer(sched, port=0)
+    server.start()
+    try:
+        client = ServeClient(tuple(server.address))
+        doc = client.metrics()
+        assert set(doc["histograms"]) == set(HISTOGRAMS)
+        qw = doc["histograms"]["queue_wait_s"]
+        assert qw["count"] >= 2 and len(qw["counts"]) == len(qw["buckets"]) + 1
+        assert set(doc["cumulative"]) == set(COUNTERS)
+        text = client.metrics_prometheus()
+    finally:
+        server.close()
+
+    # scrape-parse: every line is a comment or a well-formed sample
+    samples = {}
+    for line in text.splitlines():
+        if not line or line.startswith("# HELP ") or line.startswith("# TYPE "):
+            continue
+        assert _PROM_LINE.match(line), f"malformed exposition line: {line!r}"
+        name_labels, value = line.rsplit(" ", 1)
+        samples[name_labels] = float(value)
+
+    assert "# TYPE cct_queue_wait_s histogram" in text
+    assert "# TYPE cct_families_in_total counter" in text
+    assert samples["cct_journal_size_bytes"] >= 0
+
+    # histogram contract: cumulative buckets, +Inf equals _count
+    buckets = [(nl, v) for nl, v in samples.items()
+               if nl.startswith("cct_queue_wait_s_bucket")]
+    assert len(buckets) == len(HISTOGRAMS["queue_wait_s"]["buckets"]) + 1
+    counts = [v for _, v in buckets]
+    assert counts == sorted(counts), "bucket counts must be cumulative"
+    assert samples['cct_queue_wait_s_bucket{le="+Inf"}'] == \
+        samples["cct_queue_wait_s_count"]
+    assert samples["cct_queue_wait_s_count"] >= 2
+    assert samples["cct_queue_wait_s_sum"] > 0
+
+
+# --------------------------------------------- determinism + export
+
+def test_golden_parity_with_tracing_on_and_export_validates(
+        tmp_path, monkeypatch):
+    """CCT_TRACE=1 must not perturb a single output byte, and the trace
+    the run leaves behind must export to a valid Chrome-trace JSON."""
+    from test_golden import assert_outputs_match_golden
+
+    from consensuscruncher_tpu.cli import main as cli_main
+    from tools.trace_check import check_trace
+
+    trace_dir = tmp_path / "traces"
+    monkeypatch.setenv("CCT_TRACE", "1")
+    monkeypatch.setenv("CCT_TRACE_DIR", str(trace_dir))
+    rc = cli_main([
+        "consensus", "-i", SAMPLE, "-o", str(tmp_path), "-n", "golden",
+        "--backend", "tpu", "--scorrect", "True",
+    ])
+    assert rc == 0
+    assert_outputs_match_golden(
+        tmp_path / "golden", "consensus", "traced run")
+
+    out = tmp_path / "trace.json"
+    rc = cli_main(["trace", "export", "--dir", str(trace_dir),
+                   "--out", str(out)])
+    assert rc == 0
+    problems = check_trace(str(out))
+    assert not problems, "\n".join(problems)
+
+    doc = json.load(open(out))
+    names = {e["name"] for e in doc["traceEvents"]}
+    # the one-shot flow's backbone is all there, under one trace id
+    assert {"cli.consensus", "sscs.device_loop", "device.dispatch",
+            "writer.commit"} <= names
+    root = next(e for e in doc["traceEvents"]
+                if e["name"] == "cli.consensus")
+    tid = root["args"]["trace_id"]
+    dispatches = [e for e in doc["traceEvents"]
+                  if e["name"] == "device.dispatch"]
+    assert dispatches and all(
+        e["args"]["trace_id"] == tid for e in dispatches)

@@ -54,6 +54,20 @@ def test_counters_add_high_water_snapshot():
     assert c.snapshot()["families_in"] == 10
 
 
+def test_counters_reject_unknown_keys():
+    """A typo'd counter name must raise, not silently vanish from the
+    normalised snapshot schema (the registry-validation contract the
+    obscov lint checks statically)."""
+    import pytest
+
+    c = Counters()
+    with pytest.raises(KeyError, match="register it"):
+        c.add("familes_in")  # the classic typo
+    with pytest.raises(KeyError, match="register it"):
+        c.high_water("queue_hwm", 3)
+    assert c.snapshot()["families_in"] == 0  # nothing leaked in
+
+
 def test_cumulative_block_shared_schema(tmp_path):
     """Daemon and one-shot CLI share ONE cumulative schema: every key is
     present (zeroed when unreported) so aggregators never need .get()."""

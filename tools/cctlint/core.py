@@ -169,7 +169,7 @@ def _pragma_findings(files: list[SourceFile]) -> list[Finding]:
 def all_passes():
     """Name -> pass callable.  Imported lazily so a syntax error in one pass
     module doesn't take down the others during development."""
-    from . import determinism, faultcov, hostsync, jitdisc, locks
+    from . import determinism, faultcov, hostsync, jitdisc, locks, obscov
 
     return {
         "hostsync": hostsync.run,
@@ -177,6 +177,7 @@ def all_passes():
         "faultcov": faultcov.run,
         "locks": locks.run,
         "jitdisc": jitdisc.run,
+        "obscov": obscov.run,
     }
 
 

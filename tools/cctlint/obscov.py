@@ -1,0 +1,169 @@
+"""Pass 6 — observability coverage (CCT6xx).
+
+The obs/ layer only earns its keep if two contracts hold everywhere:
+
+CCT601  the fault-injection machinery must notify the observability layer
+        on every firing.  Any module that defines BOTH ``fault_point`` and
+        ``fire`` (the two injection entry points — in this repo,
+        ``utils/faults.py``) must reach ``_notify`` transitively from each
+        of them, so a fault can never fire without leaving a trace event
+        and a flight-recorder entry behind.
+CCT602  counter / histogram names are string keys: a typo'd name would
+        either raise at runtime in some rarely-hit branch or (worse, for
+        histogram names flowing into the metrics endpoint) silently create
+        a series nobody registered.  Every string-literal name passed to
+        ``<counters>.add`` / ``high_water`` / ``observe`` /
+        ``get_histogram`` or a ``histogram=`` keyword must exist in
+        ``consensuscruncher_tpu/obs/registry.py``.
+
+The registry is loaded standalone (``spec_from_file_location``) — it has
+zero imports by design, so the lint never imports the package under scan.
+Tests inject a fixture registry via ``overrides["metric_registry"]``.
+
+Like CCT3xx, this family has no pragma: an unregistered metric is fixed by
+registering it, a notification-free fault path by wiring ``_notify`` back
+in — never by waiving the finding.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from .core import Finding, LintContext, call_name, terminal_name
+
+#: receivers whose ``.add(...)`` takes a registry counter name (the shared
+#: ``profiling.Counters`` instances); bare ``x.add(...)`` on anything else
+#: (sets, accumulators) is ignored.
+COUNTER_RECEIVERS = {"cum", "counters", "cumulative"}
+
+REGISTRY_REL = os.path.join("consensuscruncher_tpu", "obs", "registry.py")
+
+
+def _load_registry(ctx: LintContext):
+    """(counter names, histogram names) — from overrides or the real
+    registry module, loaded standalone.  None when neither exists (scans of
+    foreign trees: CCT602 has nothing to check against)."""
+    override = ctx.overrides.get("metric_registry")
+    if override is not None:
+        return (frozenset(override.get("counters", ())),
+                frozenset(override.get("histograms", ())))
+    path = os.path.join(ctx.root, REGISTRY_REL)
+    if not os.path.isfile(path):
+        return None
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("_cct_obs_registry", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return (frozenset(mod.COUNTERS), frozenset(mod.HISTOGRAMS))
+
+
+def _module_functions(tree: ast.Module) -> dict[str, ast.FunctionDef]:
+    return {n.name: n for n in tree.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+
+
+def _reaches(funcs: dict[str, ast.FunctionDef], start: str,
+             target: str) -> bool:
+    """Transitive reachability over same-module function calls, by terminal
+    name (``inj.fire`` counts as ``fire`` — receiver types are beyond a
+    lint's reach, and a false edge only makes the check more lenient about
+    HOW _notify is reached, never about WHETHER)."""
+    seen: set[str] = set()
+    frontier = [start]
+    while frontier:
+        name = frontier.pop()
+        if name in seen:
+            continue
+        seen.add(name)
+        fn = funcs.get(name)
+        if fn is None:
+            continue
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            term = terminal_name(node)
+            if term == target:
+                return True
+            if term in funcs and term not in seen:
+                frontier.append(term)
+    return False
+
+
+def _check_fault_notify(ctx: LintContext) -> list[Finding]:
+    findings: list[Finding] = []
+    for src in ctx.parsed():
+        funcs = _module_functions(src.tree)
+        if "fault_point" not in funcs or "fire" not in funcs:
+            continue
+        for entry in ("fault_point", "fire"):
+            if not _reaches(funcs, entry, "_notify"):
+                findings.append(Finding(
+                    "CCT601", src.rel, funcs[entry].lineno,
+                    f"fault entry point '{entry}' never reaches _notify — "
+                    "a fault can fire without emitting its trace event / "
+                    "flight-recorder entry; route it through the shared "
+                    "_consume/_notify path", "obscov"))
+    return findings
+
+
+def _name_arg(node: ast.Call) -> str | None:
+    if node.args and isinstance(node.args[0], ast.Constant) and \
+            isinstance(node.args[0].value, str):
+        return node.args[0].value
+    return None
+
+
+def _check_metric_names(ctx: LintContext, counters, histograms):
+    findings: list[Finding] = []
+    for src in ctx.parsed():
+        # the registry and the metrics module define/validate these names;
+        # docstrings and error messages there would only self-reference
+        if src.rel.replace(os.sep, "/").startswith(
+                "consensuscruncher_tpu/obs/"):
+            continue
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            term = terminal_name(node)
+            dotted = call_name(node)
+            name = None
+            universe = None
+            where = None
+            if term == "add" and dotted:
+                parts = dotted.split(".")
+                if len(parts) >= 2 and parts[-2] in COUNTER_RECEIVERS:
+                    name, universe, where = _name_arg(node), counters, "COUNTERS"
+            elif term == "high_water":
+                name, universe, where = _name_arg(node), counters, "COUNTERS"
+            elif term in ("observe", "get_histogram"):
+                name, universe, where = _name_arg(node), histograms, "HISTOGRAMS"
+            if name is not None and universe is not None and \
+                    name not in universe:
+                findings.append(Finding(
+                    "CCT602", src.rel, node.lineno,
+                    f"metric name '{name}' is not registered — add it to "
+                    f"consensuscruncher_tpu/obs/registry.py {where}",
+                    "obscov"))
+            # span(..., histogram="name") times into a histogram too
+            for kw in node.keywords:
+                if kw.arg == "histogram" and \
+                        isinstance(kw.value, ast.Constant) and \
+                        isinstance(kw.value.value, str) and \
+                        kw.value.value not in histograms:
+                    findings.append(Finding(
+                        "CCT602", src.rel, node.lineno,
+                        f"histogram name '{kw.value.value}' is not "
+                        "registered — add it to "
+                        "consensuscruncher_tpu/obs/registry.py HISTOGRAMS",
+                        "obscov"))
+    return findings
+
+
+def run(ctx: LintContext) -> list[Finding]:
+    findings = _check_fault_notify(ctx)
+    registry = _load_registry(ctx)
+    if registry is not None:
+        findings.extend(_check_metric_names(ctx, *registry))
+    return findings

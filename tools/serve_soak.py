@@ -25,6 +25,7 @@ through ``tools/_jax_cpu.force_cpu``); wired into the suite as the
 from __future__ import annotations
 
 import argparse
+import glob
 import json
 import os
 import random
@@ -142,6 +143,29 @@ def main(argv=None) -> int:
         replayed = client.metrics()["cumulative"]["jobs_replayed"]
         print(f"soak: {args.jobs} job(s) finished, {replayed} replayed "
               "from the journal", flush=True)
+
+        # The kill-9 post-mortem contract: the restarted daemon's journal
+        # replay is an anomaly (requeued jobs, no clean drain marker), so it
+        # must have dumped the flight ring next to the journal — and every
+        # dump must be complete JSON (commit_file means no torn dumps).
+        dumps = sorted(glob.glob(os.path.join(args.workdir, "flight-*.json")))
+        reasons = []
+        for path in dumps:
+            try:
+                doc = json.load(open(path))
+            except ValueError as e:
+                failures.append(f"flight dump {path} unparseable: {e}")
+                continue
+            if not isinstance(doc.get("events"), list) or \
+                    not isinstance(doc.get("reason"), str):
+                failures.append(f"flight dump {path} missing events/reason")
+            else:
+                reasons.append(doc["reason"])
+        if replayed and "journal-replay" not in reasons:
+            failures.append(
+                f"{replayed} job(s) replayed but no journal-replay flight "
+                f"dump under {args.workdir} (found: {reasons or 'none'})")
+        print(f"soak: {len(dumps)} flight dump(s): {reasons}", flush=True)
 
         # clean shutdown: the daemon drains, exits 0, supervisor follows
         os.kill(client.healthz()["pid"], signal.SIGTERM)

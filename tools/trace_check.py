@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""Validate a Chrome-trace JSON export (``cct trace export`` output).
+
+Schema checks only — stdlib, no package imports — so the default test
+suite and CI can assert "the trace a run exported will actually load in
+Perfetto / chrome://tracing" without a browser:
+
+- top level: ``{"traceEvents": [...]}`` (displayTimeUnit optional);
+- every event: dict with string ``name``/``ph``, numeric ``ts`` and
+  ``pid``/``tid``; ``X`` (complete) events need a numeric ``dur >= 0``,
+  ``i`` (instant) events a scope ``s``;
+- span args carry the correlation ids the obs layer promises: an ``X``
+  event with an ``args`` dict must include a ``trace_id``.
+
+``check_trace(path)`` returns a list of human-readable problems (empty =
+valid) for test use; the CLI exits 0/1 accordingly.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+_REQUIRED_PHASES = {"X", "i", "B", "E", "M"}
+
+
+def _check_event(i: int, ev: object, problems: list[str]) -> None:
+    where = f"traceEvents[{i}]"
+    if not isinstance(ev, dict):
+        problems.append(f"{where}: not an object")
+        return
+    for key in ("name", "ph"):
+        if not isinstance(ev.get(key), str) or not ev.get(key):
+            problems.append(f"{where}: missing/non-string '{key}'")
+    for key in ("ts", "pid", "tid"):
+        if not isinstance(ev.get(key), (int, float)) or \
+                isinstance(ev.get(key), bool):
+            problems.append(f"{where}: missing/non-numeric '{key}'")
+    ph = ev.get("ph")
+    if isinstance(ph, str) and ph not in _REQUIRED_PHASES:
+        problems.append(f"{where}: unknown phase {ph!r}")
+    if ph == "X":
+        dur = ev.get("dur")
+        if not isinstance(dur, (int, float)) or isinstance(dur, bool) \
+                or dur < 0:
+            problems.append(f"{where}: 'X' event needs numeric dur >= 0")
+        args = ev.get("args")
+        if isinstance(args, dict) and "trace_id" not in args:
+            problems.append(f"{where}: span args carry no trace_id")
+    if ph == "i" and not isinstance(ev.get("s"), str):
+        problems.append(f"{where}: 'i' event needs a scope 's'")
+
+
+def check_trace(path: str) -> list[str]:
+    """Return a list of schema problems with the trace at ``path``
+    (empty list = loads fine in Perfetto/chrome://tracing)."""
+    problems: list[str] = []
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            doc = json.load(fh)
+    except OSError as e:
+        return [f"unreadable: {e}"]
+    except ValueError as e:
+        return [f"not JSON: {e}"]
+    if isinstance(doc, list):
+        events = doc  # the array form is legal Chrome-trace too
+    elif isinstance(doc, dict):
+        events = doc.get("traceEvents")
+        if not isinstance(events, list):
+            return ["top-level object has no 'traceEvents' array"]
+    else:
+        return ["top level is neither an object nor an event array"]
+    for i, ev in enumerate(events):
+        _check_event(i, ev, problems)
+        if len(problems) >= 50:
+            problems.append("... (truncated after 50 problems)")
+            break
+    return problems
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if not argv:
+        print("usage: trace_check.py TRACE.json [TRACE2.json ...]",
+              file=sys.stderr)
+        return 2
+    rc = 0
+    for path in argv:
+        problems = check_trace(path)
+        if problems:
+            rc = 1
+            for p in problems:
+                print(f"{path}: {p}")
+        else:
+            print(f"{path}: ok")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
