@@ -772,11 +772,33 @@ def _serve_child_argv(args) -> list[str]:
             argv += [f"--{flag}", str(value)]
     for flag in ("port", "queue_bound", "gang_size", "max_batch"):
         argv += [f"--{flag}", str(int(getattr(args, flag)))]
-    for flag in ("drain_s", "result_ttl_s", "warmup_budget_s"):
+    for flag in ("drain_s", "result_ttl_s", "warmup_budget_s",
+                 "class_weights", "slo_targets",
+                 "tenant_queue_cap", "tenant_inflight_cap"):
         value = getattr(args, flag, None)
         if value not in (None, ""):
             argv += [f"--{flag}", str(value)]
     return argv
+
+
+def _parse_class_map(text, what: str) -> dict:
+    """Parse ``'interactive=8,batch=3'`` style per-qos-class maps (the
+    --class_weights / --slo_targets wire format) into ``{class: float}``;
+    empty/None parses to ``{}`` (scheduler defaults apply)."""
+    out: dict = {}
+    for part in (text or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise SystemExit(
+                f"{what}: expected 'class=value' pairs, got {part!r}")
+        k, v = part.split("=", 1)
+        try:
+            out[k.strip()] = float(v)
+        except ValueError:
+            raise SystemExit(f"{what}: {v!r} is not a number") from None
+    return out
 
 
 def serve_cmd(args) -> None:
@@ -843,10 +865,20 @@ def serve_cmd(args) -> None:
         obs_flight.set_dump_dir(dump_dir)
     obs_flight.install_sigquit()
 
+    def _cap(name):
+        value = getattr(args, name, None)
+        return int(value) if value not in (None, "") else None
+
     scheduler = Scheduler(
         queue_bound=int(args.queue_bound), gang_size=int(args.gang_size),
         backend=backend, max_batch=int(args.max_batch),
         journal=journal, result_ttl_s=result_ttl_s,
+        class_weights=_parse_class_map(
+            getattr(args, "class_weights", ""), "--class_weights"),
+        slo_targets=_parse_class_map(
+            getattr(args, "slo_targets", ""), "--slo_targets"),
+        tenant_queue_cap=_cap("tenant_queue_cap"),
+        tenant_inflight_cap=_cap("tenant_inflight_cap"),
     )
     server = ServeServer(
         scheduler, host=args.host, port=int(args.port),
@@ -901,6 +933,12 @@ def submit_cmd(args) -> None:
     }
     if getattr(args, "deadline_s", None) not in (None, ""):
         spec["deadline_s"] = float(args.deadline_s)
+    # tenant/qos enter the spec only when set: a default submit keeps the
+    # exact pre-tenancy spec (and idempotency key)
+    if getattr(args, "tenant", None) not in (None, ""):
+        spec["tenant"] = str(args.tenant)
+    if getattr(args, "qos", None) not in (None, ""):
+        spec["qos"] = str(args.qos)
     sub = client.submit_full(spec)
     job_id = sub["job_id"]
     print(f"submit: job {job_id} queued on {address} (key {sub['key']}"
@@ -1092,6 +1130,25 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--max_restarts", type=int,
                    help="supervised-restart budget before giving up "
                         "(default 10)")
+    s.add_argument("--class_weights",
+                   help="weighted-fair dispatch shares per qos class as "
+                        "'class=weight' pairs (e.g. "
+                        "'interactive=8,batch=3,scavenger=1' — the "
+                        "default); a saturated daemon splits dispatch "
+                        "slots in this ratio")
+    s.add_argument("--slo_targets",
+                   help="per-class latency SLO targets in seconds as "
+                        "'class=seconds' pairs (e.g. 'interactive=30'); "
+                        "jobs without an explicit --deadline_s inherit "
+                        "their class target for shedding, and the SLO "
+                        "monitor reports burn rates against it; "
+                        "empty = no targets (no SLO shedding)")
+    s.add_argument("--tenant_queue_cap", type=int,
+                   help="max queue slots one tenant may hold (quota "
+                        "refusal past it); empty = unlimited")
+    s.add_argument("--tenant_inflight_cap", type=int,
+                   help="max queued+running jobs one tenant may hold; "
+                        "empty = unlimited")
     s.set_defaults(func=serve_cmd, config_section="serve", required_args=(),
                    builtin_defaults={
                        "socket": "", "host": "127.0.0.1", "port": 7733,
@@ -1100,6 +1157,8 @@ def build_parser() -> argparse.ArgumentParser:
                        "compile_cache": "", "journal": "", "drain_s": "",
                        "result_ttl_s": "", "warmup_budget_s": "",
                        "supervise": "False", "max_restarts": 10,
+                       "class_weights": "", "slo_targets": "",
+                       "tenant_queue_cap": "", "tenant_inflight_cap": "",
                    })
 
     t = sub.add_parser(
@@ -1135,6 +1194,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="shed the job at admission (or dispatch) if it "
                         "cannot finish within this many seconds at the "
                         "daemon's observed service rate; unset = no deadline")
+    u.add_argument("--tenant",
+                   help="tenant id for quota and per-tenant metrics "
+                        "attribution (default 'default')")
+    u.add_argument("--qos", choices=("interactive", "batch", "scavenger"),
+                   help="qos class for weighted-fair dispatch and SLO "
+                        "accounting (default 'interactive')")
     u.set_defaults(func=submit_cmd, config_section="serve",
                    required_args=("input", "output"),
                    builtin_defaults={
@@ -1142,6 +1207,7 @@ def build_parser() -> argparse.ArgumentParser:
                        "cutoff": 0.7, "qualscore": 0, "scorrect": "True",
                        "max_mismatch": 0, "bdelim": DEFAULT_BDELIM,
                        "compress_level": 6, "wait": "True",
+                       "tenant": "", "qos": "",
                    })
     return p
 

@@ -18,6 +18,9 @@ faults is imported by ``io/bgzf.py`` and the ``tools/`` scripts):
 - :mod:`.flight` — a bounded ring of recent fault/shed/span events
   dumped atomically on SIGQUIT and on serve anomalies, so post-mortems
   after a kill -9 soak are self-serve.
+- :mod:`.slo` — per-qos-class SLO monitor (p50/p99 from the shared
+  latency buckets, shed rate, multi-window error-budget burn rates)
+  fed by the serve scheduler and published on ``metrics``/``healthz``.
 
 Import submodules directly (``from consensuscruncher_tpu.obs import
 trace``); this package init stays empty so the lint's standalone load of

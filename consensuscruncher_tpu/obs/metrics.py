@@ -21,10 +21,18 @@ stay jax-free (``utils.faults`` reaches it from fault firings).
 
 from __future__ import annotations
 
+import os
 import threading
 from bisect import bisect_left
 
-from consensuscruncher_tpu.obs.registry import COUNTERS, HISTOGRAMS
+from consensuscruncher_tpu.obs.registry import (
+    COUNTERS,
+    HISTOGRAMS,
+    LABELED_COUNTERS,
+    LABELED_HISTOGRAMS,
+    LABELS,
+    OVERFLOW_TENANT,
+)
 
 
 class Histogram:
@@ -99,6 +107,106 @@ def histograms_snapshot() -> dict:
     return out
 
 
+# ------------------------------------------------------- labeled series
+#
+# Per-(tenant, qos) counters and histograms.  Label names per metric and
+# the qos value set are closed in the registry; tenant is open-valued
+# but capped at CCT_OBS_MAX_TENANTS live values per process — the first
+# observation past the cap folds into OVERFLOW_TENANT, so exposition
+# size is bounded no matter what tenant ids clients invent.
+
+_labeled_counts: dict[tuple, int] = {}
+_labeled_hists: dict[tuple, Histogram] = {}
+_seen_tenants: set = set()
+
+
+def _max_tenants() -> int:
+    try:
+        return int(os.environ.get("CCT_OBS_MAX_TENANTS", "64"))
+    except ValueError:
+        return 64
+
+
+def _check_labels(name: str, spec: dict, labels: dict) -> tuple:
+    """Validate a label dict against the registry spec and return the
+    canonical hashable series key ``(name, (v1, v2, ...))`` in the
+    spec's label order, with tenant cardinality capping applied."""
+    want = spec["labels"]
+    if set(labels) != set(want):
+        raise KeyError(
+            f"metric {name!r} takes labels {want}, got {tuple(sorted(labels))}"
+        )
+    values = []
+    for key in want:
+        val = str(labels[key])
+        reg = LABELS[key]
+        if reg["closed"] and val not in reg["values"]:
+            raise ValueError(
+                f"label {key}={val!r} not in closed set {reg['values']}"
+            )
+        if key == "tenant" and val not in _seen_tenants:
+            if len(_seen_tenants) >= _max_tenants():
+                val = OVERFLOW_TENANT
+            else:
+                _seen_tenants.add(val)
+        values.append(val)
+    return (name, tuple(values))
+
+
+def inc(name: str, value: int = 1, **labels) -> None:
+    """Increment a labeled counter, e.g.
+    ``inc("tenant_jobs_done", tenant="acme", qos="batch")``."""
+    try:
+        spec = LABELED_COUNTERS[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown labeled counter {name!r}; register it in "
+            f"consensuscruncher_tpu/obs/registry.py LABELED_COUNTERS"
+        ) from None
+    with _lock:
+        key = _check_labels(name, spec, labels)
+        _labeled_counts[key] = _labeled_counts.get(key, 0) + int(value)
+
+
+def observe_labeled(name: str, value, **labels) -> None:
+    """Observe into a labeled histogram, e.g.
+    ``observe_labeled("tenant_job_wall_s", 0.2, tenant="a", qos="batch")``."""
+    try:
+        spec = LABELED_HISTOGRAMS[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown labeled histogram {name!r}; register it in "
+            f"consensuscruncher_tpu/obs/registry.py LABELED_HISTOGRAMS"
+        ) from None
+    with _lock:
+        key = _check_labels(name, spec, labels)
+        h = _labeled_hists.get(key)
+        if h is None:
+            h = _labeled_hists.setdefault(key, Histogram(spec["buckets"]))
+    h.observe(value)
+
+
+def labeled_snapshot() -> dict:
+    """All live labeled series, as
+    ``{"counters": {name: [{"labels": {...}, "value": n}, ...]},
+       "histograms": {name: [{"labels": {...}, ...snapshot}, ...]}}``
+    with entries sorted by label values for a stable wire schema."""
+    with _lock:
+        counts = dict(_labeled_counts)
+        hists = dict(_labeled_hists)
+    out: dict = {"counters": {}, "histograms": {}}
+    for (name, values), n in sorted(counts.items()):
+        labels = dict(zip(LABELED_COUNTERS[name]["labels"], values))
+        out["counters"].setdefault(name, []).append(
+            {"labels": labels, "value": n})
+    for (name, values), h in sorted(hists.items()):
+        labels = dict(zip(LABELED_HISTOGRAMS[name]["labels"], values))
+        snap = h.snapshot()
+        snap["labels"] = labels
+        out["histograms"].setdefault(name, []).append(snap)
+    return out
+
+
 def note_compile(signature) -> bool:
     """Record one device-dispatch shape signature; True on first
     sighting (i.e. this dispatch paid an XLA compile in this process)."""
@@ -122,6 +230,9 @@ def reset_for_tests() -> None:
         _hists.clear()
         _seen_signatures.clear()
         _recompiles = 0
+        _labeled_counts.clear()
+        _labeled_hists.clear()
+        _seen_tenants.clear()
 
 
 # ------------------------------------------------------- Prometheus text
@@ -130,6 +241,21 @@ def _fmt(v) -> str:
     if isinstance(v, float):
         return repr(round(v, 9))
     return str(int(v))
+
+
+def _escape_label_value(v) -> str:
+    # Text exposition 0.0.4: backslash, double-quote and newline must be
+    # escaped inside label values; everything else passes through.
+    return (
+        str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+    )
+
+
+def _label_str(labels: dict) -> str:
+    inner = ",".join(
+        f'{k}="{_escape_label_value(v)}"' for k, v in sorted(labels.items())
+    )
+    return "{" + inner + "}"
 
 
 def render_prometheus(doc: dict) -> str:
@@ -182,5 +308,83 @@ def render_prometheus(doc: dict) -> str:
         lines.append(f'{metric}_bucket{{le="+Inf"}} {h["count"]}')
         lines.append(f"{metric}_sum {_fmt(float(h['sum']))}")
         lines.append(f"{metric}_count {h['count']}")
+
+    labeled = doc.get("labeled") or {}
+    for name in sorted(labeled.get("counters") or {}):
+        metric = f"cct_{name}_total"
+        spec = LABELED_COUNTERS.get(name, {})
+        if spec.get("help"):
+            lines.append(f"# HELP {metric} {spec['help']}")
+        lines.append(f"# TYPE {metric} counter")
+        for entry in labeled["counters"][name]:
+            lines.append(
+                f"{metric}{_label_str(entry['labels'])} {_fmt(entry['value'])}"
+            )
+    for name in sorted(labeled.get("histograms") or {}):
+        metric = f"cct_{name}"
+        spec = LABELED_HISTOGRAMS.get(name, {})
+        if spec.get("help"):
+            lines.append(f"# HELP {metric} {spec['help']}")
+        lines.append(f"# TYPE {metric} histogram")
+        for h in labeled["histograms"][name]:
+            labels = dict(h["labels"])
+            acc = 0
+            for bound, n in zip(h["buckets"], h["counts"]):
+                acc += n
+                lines.append(
+                    f"{metric}_bucket"
+                    f"{_label_str({**labels, 'le': f'{bound:g}'})} {acc}"
+                )
+            lines.append(
+                f"{metric}_bucket{_label_str({**labels, 'le': '+Inf'})} "
+                f"{h['count']}"
+            )
+            lines.append(f"{metric}_sum{_label_str(labels)} {_fmt(float(h['sum']))}")
+            lines.append(f"{metric}_count{_label_str(labels)} {h['count']}")
+
+    classes = (doc.get("slo") or {}).get("classes") or {}
+    if classes:
+        for metric, key, help_ in (
+            ("cct_slo_target_seconds", "target_s",
+             "configured per-class SLO latency target"),
+            ("cct_slo_p50_seconds", "p50_s",
+             "per-class p50 job latency (histogram estimate)"),
+            ("cct_slo_p99_seconds", "p99_s",
+             "per-class p99 job latency (histogram estimate)"),
+            ("cct_slo_shed_ratio", "shed_ratio",
+             "shed jobs over total submitted per class"),
+        ):
+            rows = [
+                (qos, classes[qos].get(key))
+                for qos in sorted(classes)
+                if classes[qos].get(key) is not None
+            ]
+            if not rows:
+                continue
+            lines.append(f"# HELP {metric} {help_}")
+            lines.append(f"# TYPE {metric} gauge")
+            for qos, v in rows:
+                lines.append(
+                    f"{metric}{_label_str({'qos': qos})} {_fmt(float(v))}"
+                )
+        burn_rows = []
+        for qos in sorted(classes):
+            for window, v in sorted(
+                (classes[qos].get("burn_rate") or {}).items()
+            ):
+                if v is not None:
+                    burn_rows.append((qos, window, v))
+        if burn_rows:
+            lines.append(
+                "# HELP cct_slo_burn_rate "
+                "multi-window SLO error-budget burn rate per class"
+            )
+            lines.append("# TYPE cct_slo_burn_rate gauge")
+            for qos, window, v in burn_rows:
+                lines.append(
+                    "cct_slo_burn_rate"
+                    f"{_label_str({'qos': qos, 'window': window})} "
+                    f"{_fmt(float(v))}"
+                )
 
     return "\n".join(lines) + "\n"

@@ -46,6 +46,73 @@ _LATENCY_BUCKETS = (
 _RATIO_BUCKETS = (0.05, 0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7, 0.8, 0.9,
                   0.95, 1.0)
 
+# ------------------------------------------------- tenancy / QoS labels
+#
+# The serve daemon is multi-tenant: every job carries a ``tenant`` id
+# and a ``qos`` class.  Label *names* and the qos value set are closed
+# here so exposition cardinality is bounded by construction; tenant is
+# the one open-valued label and ``obs.metrics`` caps its live
+# cardinality at runtime (CCT_OBS_MAX_TENANTS), folding overflow into
+# ``OVERFLOW_TENANT``.  The obscov lint (CCT603) loads this block
+# standalone to validate every labeled-metric call site.
+
+QOS_CLASSES = ("interactive", "batch", "scavenger")
+DEFAULT_TENANT = "default"
+DEFAULT_QOS = "interactive"
+# Sentinel tenant absorbing observations once the runtime tenant cap is
+# hit — keeps exposition size bounded under tenant-id abuse.
+OVERFLOW_TENANT = "__overflow__"
+
+# label name -> {"closed": bool, "values": closed value set or None}.
+LABELS = {
+    "tenant": {"closed": False, "values": None},
+    "qos": {"closed": True, "values": QOS_CLASSES},
+}
+
+# Labeled counters are a separate namespace from COUNTERS: the global
+# (unlabeled) series keep their exact names and byte layout, and the
+# per-tenant series never collide with them in Prometheus exposition.
+# name -> {"labels": label names (ordered), "help": ...}.
+LABELED_COUNTERS = {
+    "tenant_jobs_admitted": {
+        "labels": ("tenant", "qos"),
+        "help": "jobs accepted into the serve queue per tenant and class",
+    },
+    "tenant_jobs_done": {
+        "labels": ("tenant", "qos"),
+        "help": "jobs finished successfully per tenant and class",
+    },
+    "tenant_jobs_failed": {
+        "labels": ("tenant", "qos"),
+        "help": "jobs that reached the failed state per tenant and class",
+    },
+    "tenant_jobs_shed": {
+        "labels": ("tenant", "qos"),
+        "help": "jobs shed by deadline/SLO admission or dispatch expiry",
+    },
+    "tenant_jobs_quota_refused": {
+        "labels": ("tenant", "qos"),
+        "help": "submits refused by per-tenant queue or in-flight quotas",
+    },
+}
+
+# Labeled histograms: per-(tenant, qos) series sharing the global
+# latency buckets so the labeled and unlabeled views are comparable.
+LABELED_HISTOGRAMS = {
+    "tenant_job_wall_s": {
+        "buckets": _LATENCY_BUCKETS,
+        "unit": "seconds",
+        "labels": ("tenant", "qos"),
+        "help": "job wall time from submit to terminal state per tenant",
+    },
+    "tenant_queue_wait_s": {
+        "buckets": _LATENCY_BUCKETS,
+        "unit": "seconds",
+        "labels": ("tenant", "qos"),
+        "help": "admission to dispatch wait per tenant and class",
+    },
+}
+
 # name -> {"buckets": upper bounds (le), "unit": ..., "help": ...}.
 # ``obs.metrics`` zero-fills all of these in ``histograms_snapshot`` so
 # the serve endpoint and bench sidecars always carry the full set.

@@ -123,6 +123,19 @@ class ServeClient:
         ``duplicate``) — poll by ``key`` to survive daemon restarts."""
         return self._request({"op": "submit", "spec": spec})
 
+    def submit_nowait(self, spec: dict) -> dict:
+        """Submit without raising on admission refusal: a refused reply
+        (queue full, deadline shed, tenant quota) comes back as the raw
+        reply dict with ``ok: false`` plus ``refused``/``shed``/``quota``
+        flags.  Under deliberate overload — the loadgen's open-loop
+        traffic — refusal is data, not an error."""
+        try:
+            return self.submit_full(spec)
+        except ServeClientError as e:
+            if e.reply.get("refused"):
+                return dict(e.reply)
+            raise
+
     def status(self, job_id: int | None = None, *, key: str | None = None) -> dict:
         return self._request({"op": "status", **self._ref(job_id, key)})["job"]
 

@@ -61,8 +61,12 @@ from consensuscruncher_tpu.utils.manifest import commit_file
 #: Spec fields that define a job's identity for idempotent resubmit.
 #: ``deadline_s`` is deliberately excluded: resubmitting the same work
 #: with a different deadline must still dedupe onto the running job.
+#: ``tenant``/``qos`` ARE identity: two tenants submitting the same
+#: paths are distinct jobs (quotas and SLO accounting must not cross),
+#: but both fields are omitted when absent so pre-tenancy specs keep
+#: their historical keys.
 KEY_FIELDS = ("input", "output", "name", "cutoff", "qualscore", "scorrect",
-              "max_mismatch", "bdelim", "compress_level")
+              "max_mismatch", "bdelim", "compress_level", "tenant", "qos")
 
 
 def idempotency_key(spec: dict) -> str:

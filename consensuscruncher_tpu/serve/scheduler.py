@@ -45,6 +45,27 @@ evicted after ``CCT_SERVE_RESULT_TTL_S`` (or beyond ``CCT_SERVE_RESULT_MAX``)
 so a long-lived daemon's memory stays bounded; an evicted job's result
 points at its on-disk outputs.
 
+Multi-tenancy (``tenant``/``qos`` spec fields): every job belongs to a
+tenant (default ``"default"``) and a qos class (``interactive`` /
+``batch`` / ``scavenger``).  Each class has its own FIFO queue and the
+dispatcher picks the next class by **stride scheduling** — the class
+with the least accumulated virtual "pass", advanced by ``1/weight`` per
+dispatched job — which is deterministic weighted-fair sharing: with
+weights 8/3/1 a saturated daemon gives the classes 8:3:1 of its dispatch
+slots, an idle class costs nothing, and a class waking from idle cannot
+bank credit (its pass is clamped to the current leader).  Gangs never
+span classes, so fairness accounting stays exact.  Per-tenant admission
+quotas (``tenant_queue_cap`` queued slots, ``tenant_inflight_cap``
+queued+running) raise :class:`QuotaRefused` so one tenant cannot starve
+the rest of the queue.  Deadline shedding generalizes to per-class SLO
+targets: a job without an explicit ``deadline_s`` inherits its class
+target (when configured), and every terminal/shed event feeds the
+:class:`~consensuscruncher_tpu.obs.slo.SloMonitor` (p50/p99, shed rate,
+multi-window burn rates on ``metrics``/``healthz``).  The default path —
+no tenant/qos in the spec, no targets configured — is byte-identical to
+the single-tenant scheduler: one nonempty interactive queue is plain
+FIFO and the monitor only aggregates.
+
 Fault sites: ``serve.dispatch`` (gang dispatch — jobs fall back to solo
 runs), ``serve.worker`` (per-job execution — retried via resume),
 ``serve.shed`` (admission shedding — forced refusal), plus
@@ -63,6 +84,12 @@ from collections import deque
 from consensuscruncher_tpu.obs import flight as obs_flight
 from consensuscruncher_tpu.obs import metrics as obs_metrics
 from consensuscruncher_tpu.obs import trace as obs_trace
+from consensuscruncher_tpu.obs.registry import (
+    DEFAULT_QOS,
+    DEFAULT_TENANT,
+    QOS_CLASSES,
+)
+from consensuscruncher_tpu.obs.slo import SloMonitor
 from consensuscruncher_tpu.serve import journal as journal_mod
 from consensuscruncher_tpu.utils import faults, sanitize
 from consensuscruncher_tpu.utils.profiling import Counters, metrics_doc
@@ -74,6 +101,10 @@ class AdmissionRefused(RuntimeError):
 
 class DeadlineShed(AdmissionRefused):
     """Admission refused because the job cannot meet its deadline."""
+
+
+class QuotaRefused(AdmissionRefused):
+    """Per-tenant queue-slot or in-flight quota exceeded."""
 
 
 _STATES = ("queued", "running", "done", "failed")
@@ -101,6 +132,12 @@ class Job:
                 Job._next_id = max(Job._next_id, job_id)
             self.id = job_id
         self.spec = dict(spec)
+        self.tenant = str(spec.get("tenant") or DEFAULT_TENANT)
+        # submit_info validates qos before Job construction; folding an
+        # unknown class here (journal replay of a foreign record) keeps
+        # recovery from crashing on a single bad row
+        qos = str(spec.get("qos") or DEFAULT_QOS)
+        self.qos = qos if qos in QOS_CLASSES else DEFAULT_QOS
         self.key = key
         self.deadline_s = deadline_s
         # correlation id minted at submit; every span this job produces —
@@ -124,6 +161,7 @@ class Job:
             "attempts": self.attempts, "gang_size": self.gang_size,
             "input": self.spec.get("input"), "key": self.key,
             "deadline_s": self.deadline_s, "trace_id": self.trace_id,
+            "tenant": self.tenant, "qos": self.qos,
         }
 
 
@@ -344,14 +382,26 @@ class Scheduler:
     ``journal`` (a :class:`.journal.Journal` or a path) makes admissions
     durable: the journal is replayed before the dispatcher starts.
     ``result_ttl_s`` / ``result_max`` bound completed-job retention.
+    ``class_weights`` sets the stride-scheduling share per qos class;
+    ``slo_targets`` sets per-class latency targets (seconds, None = no
+    target) that double as implicit deadlines for shedding;
+    ``tenant_queue_cap`` / ``tenant_inflight_cap`` bound one tenant's
+    queued / queued+running jobs (None = unlimited).
     """
+
+    DEFAULT_CLASS_WEIGHTS = {"interactive": 8.0, "batch": 3.0,
+                             "scavenger": 1.0}
 
     def __init__(self, queue_bound: int = 16, gang_size: int = 4,
                  backend: str = "tpu", max_batch: int = 1024,
                  start: bool = True, paused: bool = False,
                  journal: journal_mod.Journal | str | None = None,
                  result_ttl_s: float | None = None,
-                 result_max: int | None = None):
+                 result_max: int | None = None,
+                 class_weights: dict | None = None,
+                 slo_targets: dict | None = None,
+                 tenant_queue_cap: int | None = None,
+                 tenant_inflight_cap: int | None = None):
         self.queue_bound = int(queue_bound)
         self.gang_size = max(1, int(gang_size))
         self.backend = backend
@@ -368,9 +418,32 @@ class Scheduler:
                 journal, max_bytes=int(os.environ.get(
                     "CCT_SERVE_JOURNAL_MAX_BYTES", str(1 << 20))))
         self._journal = journal
+        weights = dict(self.DEFAULT_CLASS_WEIGHTS)
+        for qos, w in (class_weights or {}).items():
+            if qos not in weights:
+                raise KeyError(f"unknown qos class {qos!r} in class_weights")
+            w = float(w)
+            if w <= 0:
+                raise ValueError(f"class weight for {qos!r} must be > 0")
+            weights[qos] = w
+        self.class_weights = weights
+        self.slo_targets = {qos: None for qos in QOS_CLASSES}
+        for qos, t in (slo_targets or {}).items():
+            if qos not in self.slo_targets:
+                raise KeyError(f"unknown qos class {qos!r} in slo_targets")
+            self.slo_targets[qos] = None if t is None else float(t)
+        self.tenant_queue_cap = \
+            None if tenant_queue_cap is None else max(1, int(tenant_queue_cap))
+        self.tenant_inflight_cap = None if tenant_inflight_cap is None \
+            else max(1, int(tenant_inflight_cap))
+        self.slo = SloMonitor(targets=self.slo_targets)
         self.counters = Counters()
         self._cond = sanitize.tracked_condition("scheduler.cond")
-        self._queue: deque[Job] = deque()
+        # one FIFO per qos class; stride state drives weighted-fair picks
+        self._queues: dict[str, deque[Job]] = \
+            {qos: deque() for qos in QOS_CLASSES}
+        self._stride = {qos: 1.0 / weights[qos] for qos in QOS_CLASSES}
+        self._pass = {qos: 0.0 for qos in QOS_CLASSES}
         self._jobs: dict[int, Job] = {}
         self._by_key: dict[str, int] = {}
         self._expired: dict[int, dict] = {}  # evicted-job tombstones (FIFO)
@@ -400,6 +473,11 @@ class Scheduler:
         for req in ("input", "output"):
             if not spec.get(req):
                 raise ValueError(f"job spec missing {req!r}")
+        qos = str(spec.get("qos") or DEFAULT_QOS)
+        if qos not in QOS_CLASSES:
+            raise ValueError(
+                f"unknown qos class {qos!r}; expected one of {QOS_CLASSES}")
+        tenant = str(spec.get("tenant") or DEFAULT_TENANT)
         key = journal_mod.idempotency_key(spec)
         deadline_s = spec.get("deadline_s")
         deadline_s = None if deadline_s is None else float(deadline_s)
@@ -408,17 +486,20 @@ class Scheduler:
         # admitted Job adopts it for life
         trace_id = obs_trace.mint_trace_id()
         with obs_trace.span("serve.submit", trace_id=trace_id,
-                            input=spec.get("input")), self._cond:
+                            input=spec.get("input"),
+                            tenant=tenant, qos=qos), self._cond:
             existing = self._by_key.get(key)
             if existing is not None and existing in self._jobs:
                 return self._jobs[existing], False
             if self._draining:
                 raise AdmissionRefused("server is draining; not accepting jobs")
-            self._shed_check_locked(deadline_s)
+            self._quota_check_locked(tenant, qos)
+            self._shed_check_locked(deadline_s, tenant, qos)
             self._evict_locked(time.monotonic())
-            if len(self._queue) >= self.queue_bound:
+            queued = self._queued_locked()
+            if queued >= self.queue_bound:
                 raise AdmissionRefused(
-                    f"queue full ({len(self._queue)}/{self.queue_bound})")
+                    f"queue full ({queued}/{self.queue_bound})")
             job = Job(spec, key=key, deadline_s=deadline_s, trace_id=trace_id)
             if self._journal is not None:
                 # the accepted record must be on disk BEFORE the job is
@@ -433,41 +514,98 @@ class Scheduler:
                     raise AdmissionRefused(
                         f"journal write failed ({e}); job not accepted")
                 self.counters.add("journal_bytes", n)
-            self._queue.append(job)
+            self._enqueue_locked(job)
             self._jobs[job.id] = job
             self._by_key[key] = job.id
-            self.counters.high_water("queue_depth_hwm", len(self._queue))
+            self.counters.high_water("queue_depth_hwm", self._queued_locked())
+            obs_metrics.inc("tenant_jobs_admitted",
+                            tenant=job.tenant, qos=job.qos)
             self._cond.notify_all()
         return job, True
 
-    def _shed_check_locked(self, deadline_s: float | None) -> None:
+    # -------------------------------------------------- per-class queues
+
+    def _queued_locked(self) -> int:
+        return sum(len(q) for q in self._queues.values())
+
+    def _any_queued_locked(self) -> bool:
+        return any(self._queues.values())
+
+    def _enqueue_locked(self, job: Job) -> None:
+        queue = self._queues[job.qos]
+        if not queue:
+            # a class waking from idle must not have banked credit while
+            # asleep — clamp its pass forward to the current leader so it
+            # gets its fair share from NOW, not a monopoly first
+            active = [self._pass[q] for q in QOS_CLASSES if self._queues[q]]
+            if active:
+                self._pass[job.qos] = max(self._pass[job.qos], min(active))
+        queue.append(job)
+
+    def _quota_check_locked(self, tenant: str, qos: str) -> None:
+        """Per-tenant admission quotas: a tenant may hold at most
+        ``tenant_queue_cap`` queue slots and ``tenant_inflight_cap``
+        queued+running jobs; past either the submit is refused (the
+        per-tenant analogue of ``queue_bound`` backpressure)."""
+        if self.tenant_queue_cap is None and self.tenant_inflight_cap is None:
+            return
+        queued = sum(1 for q in self._queues.values()
+                     for j in q if j.tenant == tenant)
+        if self.tenant_queue_cap is not None \
+                and queued >= self.tenant_queue_cap:
+            obs_metrics.inc("tenant_jobs_quota_refused",
+                            tenant=tenant, qos=qos)
+            raise QuotaRefused(
+                f"tenant {tenant!r} queue quota exhausted "
+                f"({queued}/{self.tenant_queue_cap})")
+        if self.tenant_inflight_cap is not None:
+            inflight = queued + sum(
+                1 for j in self._running if j.tenant == tenant)
+            if inflight >= self.tenant_inflight_cap:
+                obs_metrics.inc("tenant_jobs_quota_refused",
+                                tenant=tenant, qos=qos)
+                raise QuotaRefused(
+                    f"tenant {tenant!r} in-flight quota exhausted "
+                    f"({inflight}/{self.tenant_inflight_cap})")
+
+    def _shed_check_locked(self, deadline_s: float | None,
+                           tenant: str, qos: str) -> None:
         """Deadline-aware admission: refuse work that cannot finish in time
-        at the observed service rate (EWMA of per-job wall).  The
-        ``serve.shed`` fault site forces a shed for chaos tests."""
+        at the observed service rate (EWMA of per-job wall).  A job with no
+        explicit deadline inherits its qos class SLO target (when one is
+        configured).  The ``serve.shed`` fault site forces a shed for
+        chaos tests."""
         try:
             faults.fault_point("serve.shed")
         except faults.FaultError as e:
-            self.counters.add("jobs_shed")
-            self._flight_shed(f"injected: {e}")
+            self._count_shed_locked(tenant, qos)
+            self._flight_shed(f"injected: {e}", tenant, qos)
             raise DeadlineShed(f"shed: {e}")
-        if deadline_s is None or self._ewma_job_s is None:
+        effective = deadline_s if deadline_s is not None \
+            else self.slo_targets[qos]
+        if effective is None or self._ewma_job_s is None:
             return
-        backlog = len(self._queue) + len(self._running)
+        backlog = self._queued_locked() + len(self._running)
         eta = (backlog + 1) * self._ewma_job_s / max(1, self.gang_size)
-        if eta > deadline_s:
-            self.counters.add("jobs_shed")
-            self._flight_shed(f"eta {eta:.1f}s > deadline_s={deadline_s:g} "
-                              f"(backlog={backlog})")
+        if eta > effective:
+            self._count_shed_locked(tenant, qos)
+            self._flight_shed(f"eta {eta:.1f}s > deadline_s={effective:g} "
+                              f"(backlog={backlog})", tenant, qos)
             raise DeadlineShed(
                 f"shed: estimated completion {eta:.1f}s exceeds "
-                f"deadline_s={deadline_s:g} (backlog={backlog}, "
+                f"deadline_s={effective:g} (backlog={backlog}, "
                 f"ewma_job_s={self._ewma_job_s:.2f})")
 
+    def _count_shed_locked(self, tenant: str, qos: str) -> None:
+        self.counters.add("jobs_shed")
+        obs_metrics.inc("tenant_jobs_shed", tenant=tenant, qos=qos)
+        self.slo.note(qos, shed=True)
+
     @staticmethod
-    def _flight_shed(why: str) -> None:
+    def _flight_shed(why: str, tenant: str, qos: str) -> None:
         """A shed is an anomaly worth a post-mortem: record it and dump the
         flight ring so the overload's lead-up survives the incident."""
-        obs_flight.record("shed", why=why)
+        obs_flight.record("shed", why=why, tenant=tenant, qos=qos)
         obs_flight.dump(reason="shed")
 
     def get(self, job_id: int) -> Job | None:
@@ -586,10 +724,10 @@ class Scheduler:
                     # down must not shed every queued job on every restart.
                     job.state = "queued"
                     job.submitted_t = time.monotonic()
-                    self._queue.append(job)
+                    self._enqueue_locked(job)
                     self.counters.add("jobs_replayed")
                     requeued += 1
-            self.counters.high_water("queue_depth_hwm", len(self._queue))
+            self.counters.high_water("queue_depth_hwm", self._queued_locked())
             self._cond.notify_all()
         if requeued or finished or dropped or info["skipped"]:
             print(f"serve: journal replay: {requeued} job(s) re-enqueued, "
@@ -669,7 +807,7 @@ class Scheduler:
             self._draining = True
             self._paused = False
             self._cond.notify_all()
-            while self._queue or self._running:
+            while self._any_queued_locked() or self._running:
                 remaining = None
                 if deadline is not None:
                     remaining = deadline - time.monotonic()
@@ -712,6 +850,11 @@ class Scheduler:
             )
             doc["jobs"] = jobs
             doc["histograms"] = obs_metrics.histograms_snapshot()
+            doc["labeled"] = obs_metrics.labeled_snapshot()
+            doc["slo"] = self.slo.snapshot()
+            doc["queued_by_class"] = \
+                {qos: len(self._queues[qos]) for qos in QOS_CLASSES}
+            doc["class_weights"] = dict(self.class_weights)
             if self._journal is not None:
                 doc["journal"] = {"path": self._journal.path,
                                   "size_bytes": self._journal.size()}
@@ -721,35 +864,55 @@ class Scheduler:
         with self._cond:
             return {
                 "status": "draining" if self._draining else "serving",
-                "queued": len(self._queue), "running": len(self._running),
+                "queued": self._queued_locked(),
+                "queued_by_class":
+                    {qos: len(self._queues[qos]) for qos in QOS_CLASSES},
+                "running": len(self._running),
                 "uptime_s": round(time.time() - self._started_at, 3),
                 "pid": os.getpid(),
+                "slo": self.slo.health(),
             }
 
     # ----------------------------------------------------------- dispatcher
 
+    def _next_class_locked(self) -> str:
+        """Stride pick: the backlogged class with the least accumulated
+        virtual pass wins; registry class order breaks exact ties so the
+        schedule is fully deterministic."""
+        ready = [qos for qos in QOS_CLASSES if self._queues[qos]]
+        return min(ready,
+                   key=lambda qos: (self._pass[qos], QOS_CLASSES.index(qos)))
+
     def _pop_gang(self) -> list[Job]:
         """Pop up to ``gang_size`` queued jobs sharing the compile-time
-        consensus parameters (cutoff/qualscore).  Called under the lock."""
-        gang = [self._queue.popleft()]
+        consensus parameters (cutoff/qualscore) from the stride-chosen qos
+        class (gangs never span classes — fairness accounting stays
+        exact).  Called under the lock."""
+        qos = self._next_class_locked()
+        queue = self._queues[qos]
+        gang = [queue.popleft()]
         key = (float(gang[0].spec.get("cutoff", 0.7)),
                int(gang[0].spec.get("qualscore", 0)))
         kept = deque()
-        while self._queue and len(gang) < self.gang_size:
-            job = self._queue.popleft()
+        while queue and len(gang) < self.gang_size:
+            job = queue.popleft()
             jkey = (float(job.spec.get("cutoff", 0.7)),
                     int(job.spec.get("qualscore", 0)))
             if jkey == key:
                 gang.append(job)
             else:
                 kept.append(job)
-        self._queue.extendleft(reversed(kept))
+        queue.extendleft(reversed(kept))
+        # each dispatched job advances the class pass by one stride, so a
+        # weight-8 class earns 8 dispatch slots per weight-1 slot
+        self._pass[qos] += self._stride[qos] * len(gang)
         return gang
 
     def _loop(self) -> None:
         while True:
             with self._cond:
-                while not self._stop and (self._paused or not self._queue):
+                while not self._stop and \
+                        (self._paused or not self._any_queued_locked()):
                     self._cond.wait()
                 if self._stop:
                     return
@@ -757,17 +920,21 @@ class Scheduler:
                 now = time.monotonic()
                 live = []
                 for job in gang:
-                    if job.deadline_s is not None and \
-                            now - job.submitted_t > job.deadline_s:
+                    # explicit deadline wins; otherwise the class SLO
+                    # target acts as the implicit deadline (None = never)
+                    effective = job.deadline_s if job.deadline_s is not None \
+                        else self.slo_targets[job.qos]
+                    if effective is not None and \
+                            now - job.submitted_t > effective:
                         # dispatch-time shed: the deadline expired while the
                         # job sat in the queue; running it would waste device
                         # time on an answer nobody is waiting for
                         job.state = "failed"
-                        job.error = (f"shed: deadline_s={job.deadline_s:g} "
+                        job.error = (f"shed: deadline_s={effective:g} "
                                      f"expired after "
                                      f"{now - job.submitted_t:.1f}s in queue")
                         job.finished_t = now
-                        self.counters.add("jobs_shed")
+                        self._count_shed_locked(job.tenant, job.qos)
                         self._journal_update_locked(job, "failed",
                                                     error=job.error)
                     else:
@@ -779,6 +946,9 @@ class Scheduler:
                     job.state = "running"
                     job.gang_size = len(live)
                     obs_metrics.observe("queue_wait_s", now - job.submitted_t)
+                    obs_metrics.observe_labeled(
+                        "tenant_queue_wait_s", now - job.submitted_t,
+                        tenant=job.tenant, qos=job.qos)
                     self._journal_update_locked(job, "dispatched")
                 self._running = list(live)
                 self._cond.notify_all()
@@ -809,7 +979,8 @@ class Scheduler:
             jt0 = t0 if len(gang) > 1 else time.monotonic()
             try:
                 with obs_trace.span("serve.job", trace_id=job.trace_id,
-                                    job_id=job.id):
+                                    job_id=job.id, tenant=job.tenant,
+                                    qos=job.qos):
                     self._run_job(job)
                 outcome = "done"
             except Exception as e:
@@ -819,7 +990,8 @@ class Scheduler:
                 # while the evidence — fault firings, retry lineage — is
                 # still in memory
                 obs_flight.record("worker_death", job_id=job.id,
-                                  trace_id=job.trace_id, error=job.error)
+                                  trace_id=job.trace_id, error=job.error,
+                                  tenant=job.tenant, qos=job.qos)
                 obs_flight.dump(reason="worker-death")
             if outcome == "done":
                 self.aggregate_job_metrics(job)
@@ -828,6 +1000,17 @@ class Scheduler:
                 # belongs to every member's end-to-end latency
                 job.wall_s = round(time.monotonic() - jt0, 6)
                 obs_metrics.observe("job_wall_s", job.wall_s)
+                # the tenant-facing latency (and what SLO targets are
+                # judged against) includes queue wait: submit -> terminal
+                latency = time.monotonic() - job.submitted_t
+                obs_metrics.observe_labeled(
+                    "tenant_job_wall_s", latency,
+                    tenant=job.tenant, qos=job.qos)
+                obs_metrics.inc(
+                    "tenant_jobs_done" if outcome == "done"
+                    else "tenant_jobs_failed",
+                    tenant=job.tenant, qos=job.qos)
+                self.slo.note(job.qos, wall_s=latency)
                 job.state = outcome
                 job.finished_t = time.monotonic()
                 self._ewma_job_s = job.wall_s if self._ewma_job_s is None \

@@ -27,7 +27,8 @@ only as durable as the journal, so restart-invisible polling uses keys.
 A submit whose spec hashes to an already-tracked job returns that job
 with ``"duplicate": true``.  A job evicted from memory (result TTL)
 replies ``state: "expired"`` with the on-disk output path.  A submit shed
-for its deadline replies ``refused: true, shed: true``.
+for its deadline replies ``refused: true, shed: true``; one refused by a
+per-tenant quota replies ``refused: true, quota: true``.
 
 Errors reply ``{"ok": false, "error": "..."}`` and keep the connection
 usable; a malformed line closes the connection.  The ``serve.accept``
@@ -56,7 +57,7 @@ import time
 
 from consensuscruncher_tpu.obs.metrics import render_prometheus
 from consensuscruncher_tpu.serve.scheduler import (
-    AdmissionRefused, DeadlineShed, Scheduler,
+    AdmissionRefused, DeadlineShed, QuotaRefused, Scheduler,
 )
 from consensuscruncher_tpu.utils import faults
 
@@ -300,6 +301,9 @@ class ServeServer:
         except DeadlineShed as e:
             return {"ok": False, "error": str(e), "refused": True,
                     "shed": True}
+        except QuotaRefused as e:
+            return {"ok": False, "error": str(e), "refused": True,
+                    "quota": True}
         except AdmissionRefused as e:
             return {"ok": False, "error": str(e), "refused": True}
         except TimeoutError as e:

@@ -23,6 +23,7 @@ interface.
 
 from __future__ import annotations
 
+import os
 import sys
 from dataclasses import dataclass
 
@@ -422,10 +423,24 @@ _POOL_EMIT_LUT: np.ndarray | None = None
 _POOL_PRESTART_BARRIER = None
 
 
+def _barrier_timeout_s() -> float:
+    """Prestart-barrier wait budget.  120s absorbs a badly overloaded
+    host's fork storm; chaos tests shrink it via the environment (which
+    crosses the fork boundary) to exercise the REAL timeout path rather
+    than a parent-side injected stand-in."""
+    try:
+        return float(os.environ.get("CCT_ALIGN_BARRIER_TIMEOUT_S", "120"))
+    except ValueError:
+        return 120.0
+
+
 def _pool_prestart_wait():
     """Pin one pool worker until every worker has forked (see the prestart
     barrier in :func:`align_fastqs_columnar`)."""
-    _POOL_PRESTART_BARRIER.wait(timeout=120)
+    # chaos site in the CHILD: a stalled/dead worker here is what makes
+    # the parent's barrier wait time out for real
+    fault_point("align.barrier_worker")
+    _POOL_PRESTART_BARRIER.wait(timeout=_barrier_timeout_s())
 
 
 def _pool_bucket_blobs(task):
@@ -478,9 +493,10 @@ def _start_pool(workers: int, aligner, emit_lut):
         # and its async BGZF thread exist.
         warm = [pool.submit(_pool_prestart_wait) for _ in range(workers)]
         fault_point("align.barrier")
-        _POOL_PRESTART_BARRIER.wait(timeout=120)
+        timeout = _barrier_timeout_s()
+        _POOL_PRESTART_BARRIER.wait(timeout=timeout)
         for f in warm:
-            f.result(timeout=120)
+            f.result(timeout=timeout)
     except (threading.BrokenBarrierError, cf.TimeoutError, FaultError) as e:
         _shutdown_pool(pool, kill=True)
         _POOL_ALIGNER = _POOL_EMIT_LUT = _POOL_PRESTART_BARRIER = None

@@ -31,6 +31,7 @@ Kinds:
 Sites wired in this codebase (grep for ``fault_point``/``faults.hook``):
 
   align.barrier        prestart-barrier warm-up failure -> serial fallback
+  align.barrier_worker worker-side prestart stall -> real barrier timeout
   align.pool_worker    fork-pool worker death -> re-fork once, then serial
   subprocess.bwa       external aligner failure -> bounded retry + backoff
   bgzf.truncated_eof   reader sees a truncated block -> clear error/salvage

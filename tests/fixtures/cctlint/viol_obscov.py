@@ -1,9 +1,9 @@
 """Seeded CCT6xx violations for the obscov pass self-test.
 
 A faults-machinery lookalike whose entry points never notify the
-observability layer (CCT601), plus metric calls under names no registry
-knows (CCT602).
-"""
+observability layer (CCT601), metric calls under names no registry
+knows (CCT602), and labeled-series calls that break the closed label
+registry (CCT603)."""
 
 
 def _perform(site, d):
@@ -25,3 +25,14 @@ def bump(cum, obs_metrics, obs_trace):
     obs_metrics.observe("no_such_histogram", 0.5)  # CCT602: not in HISTOGRAMS
     with obs_trace.span("x", histogram="also_not_registered"):  # CCT602
         pass
+
+
+def labeled(obs_metrics):
+    # CCT603: metric not in LABELED_COUNTERS
+    obs_metrics.inc("no_such_labeled_counter", tenant="t", qos="batch")
+    # CCT603: qos literal outside the closed QOS_CLASSES set
+    obs_metrics.inc("tenant_jobs_done", tenant="t", qos="warp")
+    # CCT603: 'region' label never declared for this metric
+    obs_metrics.inc("tenant_jobs_done", tenant="t", qos="batch", region="us")
+    # CCT603: declared label 'qos' omitted (phantom partial series)
+    obs_metrics.observe_labeled("tenant_job_wall_s", 0.1, tenant="t")

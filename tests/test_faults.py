@@ -126,6 +126,26 @@ def test_align_barrier_fault_serial_fallback(aln_fixture, tmp_path,
     assert _sha(out) == golden  # degraded mode, identical bytes
 
 
+def test_align_barrier_real_timeout_serial_fallback(aln_fixture, tmp_path,
+                                                    monkeypatch, capfd):
+    """The REAL timeout path, not an injected stand-in: a forked worker
+    stalls past the (environment-shrunk) barrier budget, the parent's
+    ``Barrier.wait`` raises ``BrokenBarrierError`` on an actual clock
+    expiry, and the run degrades to serial with identical bytes."""
+    from consensuscruncher_tpu.stages.align import (
+        BuiltinAligner, align_fastqs_columnar)
+
+    fa, r1, r2, golden = aln_fixture
+    # every forked worker stalls 10s; the parent only waits 1.5s
+    monkeypatch.setenv("CCT_FAULTS", "align.barrier_worker=stall@8:10")
+    monkeypatch.setenv("CCT_ALIGN_BARRIER_TIMEOUT_S", "1.5")
+    out = str(tmp_path / "bt.bam")
+    align_fastqs_columnar(BuiltinAligner(fa), r1, r2, out,
+                          workers=2, pair_chunk=16)
+    assert "falling back to serial alignment" in capfd.readouterr().err
+    assert _sha(out) == golden
+
+
 def test_align_worker_death_recovers_with_parity(aln_fixture, tmp_path,
                                                  monkeypatch, capfd):
     """One forked worker os._exit()s mid-run (the OOM-kill shape).  The

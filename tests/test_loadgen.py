@@ -1,0 +1,113 @@
+"""tools/loadgen.py: fast unit coverage of the mix/PMF/knee machinery,
+plus the slow-marked live capacity sweep against a spawned daemon (the
+full proof behind the committed BENCH_LOADGEN artifact)."""
+
+import json
+import os
+import random
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import loadgen  # noqa: E402
+
+
+def test_parse_mix_validates():
+    mix = loadgen._parse_mix("a:interactive:6,b:batch:3")
+    assert mix == [("a", "interactive", 6.0), ("b", "batch", 3.0)]
+    with pytest.raises(SystemExit):
+        loadgen._parse_mix("a:warp:1")
+    with pytest.raises(SystemExit):
+        loadgen._parse_mix("a:interactive:0")
+    with pytest.raises(SystemExit):
+        loadgen._parse_mix("nonsense")
+
+
+def test_family_pmf_roundtrip(tmp_path):
+    path = tmp_path / "fam.txt"
+    path.write_text("family_size\tcount\n1\t60\n3\t30\n8\t10\n")
+    pmf = loadgen._load_family_pmf(str(path))
+    assert pmf == {1: 0.6, 3: 0.3, 8: 0.1}
+    rng = random.Random(7)
+    mean = loadgen._sample_mean_family(rng, pmf, draws=500)
+    assert 1.0 <= mean <= 8.0
+    # deterministic under a fixed seed (loadgen runs must reproduce)
+    assert mean == loadgen._sample_mean_family(random.Random(7), pmf,
+                                               draws=500)
+
+
+def test_metrics_delta_helpers_sum_tenants_per_qos():
+    doc = {"labeled": {"counters": {"tenant_jobs_done": [
+        {"labels": {"tenant": "a", "qos": "batch"}, "value": 3},
+        {"labels": {"tenant": "b", "qos": "batch"}, "value": 2},
+        {"labels": {"tenant": "a", "qos": "interactive"}, "value": 1},
+    ]}, "histograms": {"tenant_job_wall_s": [
+        {"labels": {"tenant": "a", "qos": "batch"},
+         "buckets": [1.0, 2.0], "counts": [1, 0, 0]},
+        {"labels": {"tenant": "b", "qos": "batch"},
+         "buckets": [1.0, 2.0], "counts": [0, 2, 1]},
+    ]}}}
+    by_qos = loadgen._counter_by_qos(doc, "tenant_jobs_done")
+    assert by_qos["batch"] == 5 and by_qos["interactive"] == 1
+    walls = loadgen._wall_hist_by_qos(doc)
+    assert walls["batch"]["counts"] == [1, 2, 1]
+    delta = loadgen._hist_delta({"buckets": [1.0, 2.0], "counts": [1, 0, 0]},
+                                walls["batch"])
+    assert delta["counts"] == [0, 2, 1]
+
+
+def test_knee_estimate_picks_last_unshed_level():
+    def lv(rate, shed_ratio, thru, lost=0):
+        return {"offered_jobs_per_s": rate,
+                "aggregate": {"shed_ratio": shed_ratio, "lost": lost,
+                              "throughput_jobs_per_s": thru}}
+
+    levels = [lv(1, 0.0, 0.9), lv(2, 0.02, 1.8), lv(4, 0.4, 2.1),
+              lv(8, 0.7, 1.9)]
+    knee = loadgen.knee_estimate(levels, shed_knee=0.05)
+    assert knee["knee_offered_jobs_per_s"] == 2
+    assert knee["max_throughput_jobs_per_s"] == 2.1
+    # a lost job disqualifies a level even with zero shed
+    knee = loadgen.knee_estimate([lv(1, 0.0, 0.9, lost=1)], 0.05)
+    assert knee["knee_offered_jobs_per_s"] is None
+
+
+def test_make_inputs_covers_every_class(tmp_path):
+    inputs = loadgen.make_inputs(str(tmp_path), loadgen.DEFAULT_FAMILY_PMF,
+                                 per_class=1, seed=3, smoke=True)
+    assert set(inputs) == set(loadgen.QOS_CLASSES)
+    for paths in inputs.values():
+        assert len(paths) == 1 and os.path.getsize(paths[0]) > 0
+
+
+@pytest.mark.slow
+def test_loadgen_capacity_sweep_live_daemon(tmp_path):
+    """The full proof: ≥3 offered-load levels of open-loop multi-tenant
+    traffic against a live daemon, per-class p50/p99/throughput/shed-rate
+    from the daemon's own labeled histograms, knee estimate in the
+    artifact."""
+    out = str(tmp_path / "BENCH_LOADGEN_test.json")
+    rc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "loadgen.py"),
+         "--workdir", str(tmp_path / "lg"), "--levels", "0.5,1.5,4",
+         "--duration", "8", "--settle", "240", "--seed", "11",
+         "--out", out],
+        cwd=REPO, timeout=1500).returncode
+    assert rc == 0
+    doc = json.load(open(out))
+    assert len(doc["levels"]) >= 3
+    for lv in doc["levels"]:
+        assert lv["aggregate"]["lost"] == 0
+        assert lv["aggregate"]["submitted"] > 0
+        served = [c for c in lv["classes"].values() if c["done"]]
+        assert served, "level finished no jobs at all"
+        for c in served:
+            assert c["p50_s"] is not None and c["p99_s"] >= c["p50_s"]
+    assert doc["knee"]["max_throughput_jobs_per_s"] > 0
+    assert set(doc["slo"]["classes"]) == set(loadgen.QOS_CLASSES)

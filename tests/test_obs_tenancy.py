@@ -1,0 +1,201 @@
+"""Tenant/qos-labeled telemetry: registry validation, cardinality caps,
+the SLO monitor's quantiles/burn rates, and the labeled Prometheus text
+exposition contract (escaping, +Inf, _sum/_count, stable ordering)."""
+
+import os
+import re
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from consensuscruncher_tpu.obs import metrics as obs_metrics  # noqa: E402
+from consensuscruncher_tpu.obs.registry import (  # noqa: E402
+    LABELED_COUNTERS,
+    LABELED_HISTOGRAMS,
+    OVERFLOW_TENANT,
+    QOS_CLASSES,
+)
+from consensuscruncher_tpu.obs.slo import (  # noqa: E402
+    SloMonitor,
+    quantile_from_histogram,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_metrics():
+    obs_metrics.reset_for_tests()
+    yield
+    obs_metrics.reset_for_tests()
+
+
+# ------------------------------------------------------ labeled registry
+
+def test_labeled_series_validate_names_labels_and_qos_values():
+    with pytest.raises(KeyError):
+        obs_metrics.inc("not_a_metric", tenant="a", qos="batch")
+    with pytest.raises(KeyError):  # missing label
+        obs_metrics.inc("tenant_jobs_done", tenant="a")
+    with pytest.raises(KeyError):  # undeclared label
+        obs_metrics.inc("tenant_jobs_done", tenant="a", qos="batch",
+                        region="us")
+    with pytest.raises(ValueError):  # closed qos set
+        obs_metrics.inc("tenant_jobs_done", tenant="a", qos="warp")
+    obs_metrics.inc("tenant_jobs_done", tenant="a", qos="batch")
+    snap = obs_metrics.labeled_snapshot()
+    assert snap["counters"]["tenant_jobs_done"] == [
+        {"labels": {"tenant": "a", "qos": "batch"}, "value": 1}]
+
+
+def test_tenant_cardinality_folds_to_overflow(monkeypatch):
+    monkeypatch.setenv("CCT_OBS_MAX_TENANTS", "2")
+    for i in range(5):
+        obs_metrics.inc("tenant_jobs_done", tenant=f"t{i}", qos="batch")
+    snap = obs_metrics.labeled_snapshot()
+    tenants = {e["labels"]["tenant"]: e["value"]
+               for e in snap["counters"]["tenant_jobs_done"]}
+    assert set(tenants) == {"t0", "t1", OVERFLOW_TENANT}
+    assert tenants[OVERFLOW_TENANT] == 3  # t2..t4 folded, nothing dropped
+
+
+def test_every_labeled_spec_is_well_formed():
+    for name, spec in {**LABELED_COUNTERS, **LABELED_HISTOGRAMS}.items():
+        assert spec["labels"] == ("tenant", "qos"), name
+        assert spec["help"], name
+    for spec in LABELED_HISTOGRAMS.values():
+        assert list(spec["buckets"]) == sorted(spec["buckets"])
+
+
+# ---------------------------------------------------------- SLO monitor
+
+def test_quantile_interpolation_and_inf_clamp():
+    buckets = [1.0, 2.0, 4.0]
+    assert quantile_from_histogram(buckets, [0, 0, 0, 0], 0.5) is None
+    # 4 values in (1, 2]: p50 interpolates halfway into that bucket
+    assert quantile_from_histogram(buckets, [0, 4, 0, 0], 0.5) == 1.5
+    # mass in +Inf clamps to the last finite bound
+    assert quantile_from_histogram(buckets, [0, 0, 0, 3], 0.99) == 4.0
+
+
+def test_slo_monitor_burn_rates_with_fake_clock():
+    clock = {"t": 0.0}
+    mon = SloMonitor(targets={"interactive": 1.0}, objective=0.99,
+                     windows=(10.0, 100.0), clock=lambda: clock["t"])
+    # 9 compliant + 1 violating job inside the fast window:
+    # burn = (1/10) / 0.01 = 10x budget
+    for _ in range(9):
+        mon.note("interactive", wall_s=0.5)
+        clock["t"] += 1.0
+    mon.note("interactive", wall_s=5.0)
+    snap = mon.snapshot()["classes"]["interactive"]
+    assert snap["total"] == 10 and snap["violations"] == 1
+    assert snap["burn_rate"]["10s"] == pytest.approx(10.0)
+    assert snap["burn_rate"]["100s"] == pytest.approx(10.0)
+    # 90 more compliant events age the violation out of the fast window
+    # (t advances to 27.0; the violation at t=9.0 leaves the 10s window)
+    for _ in range(90):
+        clock["t"] += 0.2
+        mon.note("interactive", wall_s=0.5)
+    snap = mon.snapshot()["classes"]["interactive"]
+    assert snap["burn_rate"]["10s"] == 0.0
+    assert snap["burn_rate"]["100s"] == pytest.approx(1.0)
+    health = mon.health()
+    assert health["worst_burn_class"] == "interactive"
+    assert health["worst_burn_rate"] == pytest.approx(1.0)
+
+
+def test_slo_monitor_counts_sheds_as_violations():
+    mon = SloMonitor(clock=lambda: 0.0)  # no targets: only sheds violate
+    mon.note("batch", wall_s=1e9)  # no target -> compliant
+    mon.note("batch", shed=True)
+    snap = mon.snapshot()["classes"]["batch"]
+    assert snap["violations"] == 1 and snap["shed"] == 1
+    assert snap["shed_ratio"] == 0.5
+    # stable schema: silent classes still present, all-zero
+    assert mon.snapshot()["classes"]["scavenger"]["total"] == 0
+
+
+# ------------------------------------- Prometheus exposition (satellite)
+
+_PROM_SAMPLE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? [^ ]+$')
+
+
+def _render(doc=None):
+    base = {"labeled": obs_metrics.labeled_snapshot()}
+    base.update(doc or {})
+    return obs_metrics.render_prometheus(base)
+
+
+def test_label_values_are_escaped():
+    evil = 'we"ird\\t\nx'
+    obs_metrics.inc("tenant_jobs_done", tenant=evil, qos="batch")
+    text = _render()
+    line = next(l for l in text.splitlines()
+                if l.startswith("cct_tenant_jobs_done_total{"))
+    # 0.0.4 escaping: backslash, double-quote, newline — and the raw
+    # newline must NOT survive into the exposition
+    assert 'tenant="we\\"ird\\\\t\\nx"' in line
+    assert "\n" not in line
+    for sample in text.splitlines():
+        if sample and not sample.startswith("#"):
+            assert _PROM_SAMPLE.match(sample), f"malformed: {sample!r}"
+
+
+def test_labeled_histogram_inf_bucket_and_sum_count_consistency():
+    walls = [0.004, 0.3, 7.0, 1e6]  # last one lands in +Inf
+    for w in walls:
+        obs_metrics.observe_labeled("tenant_job_wall_s", w,
+                                    tenant="acme", qos="interactive")
+    text = _render()
+    label = 'qos="interactive",tenant="acme"'
+    samples = {}
+    for line in text.splitlines():
+        if line.startswith("cct_tenant_job_wall_s"):
+            nl, v = line.rsplit(" ", 1)
+            samples[nl] = float(v)
+    inf = samples[f'cct_tenant_job_wall_s_bucket{{le="+Inf",{label}}}']
+    count = samples[f'cct_tenant_job_wall_s_count{{{label}}}']
+    total = samples[f'cct_tenant_job_wall_s_sum{{{label}}}']
+    assert inf == count == len(walls)
+    assert total == pytest.approx(sum(walls))
+    # buckets are cumulative and monotone
+    bucket_vals = [v for nl, v in samples.items() if "_bucket{" in nl]
+    assert bucket_vals == sorted(bucket_vals)
+    # every finite bucket <= +Inf
+    assert all(v <= inf for v in bucket_vals)
+
+
+def test_exposition_order_is_stable_under_insertion_order():
+    def load(order):
+        obs_metrics.reset_for_tests()
+        for tenant, qos in order:
+            obs_metrics.inc("tenant_jobs_done", tenant=tenant, qos=qos)
+            obs_metrics.observe_labeled("tenant_job_wall_s", 0.25,
+                                        tenant=tenant, qos=qos)
+        return _render()
+
+    pairs = [("beta", "batch"), ("alpha", "interactive"),
+             ("alpha", "batch"), ("beta", "scavenger")]
+    a = load(pairs)
+    b = load(list(reversed(pairs)))
+    assert a == b, "exposition must not encode observation order"
+    # and rendering is a pure function of the snapshot
+    assert _render() == _render()
+
+
+def test_slo_gauges_render_per_class_and_window():
+    mon = SloMonitor(targets={"interactive": 2.0}, clock=lambda: 0.0)
+    mon.note("interactive", wall_s=1.0)
+    mon.note("interactive", wall_s=5.0)  # violation
+    text = _render({"slo": mon.snapshot()})
+    assert 'cct_slo_target_seconds{qos="interactive"} 2.0' in text
+    assert 'cct_slo_p50_seconds{qos="interactive"}' in text
+    assert 'cct_slo_burn_rate{qos="interactive",window="300s"}' in text
+    # classes without targets still expose shed_ratio (stable schema)
+    assert 'cct_slo_shed_ratio{qos="batch"} 0.0' in text
+    for qos in QOS_CLASSES:
+        assert f'qos="{qos}"' in text

@@ -78,7 +78,7 @@ def test_idempotent_resubmit_returns_existing_job(tmp_path):
     j1, created1 = sched.submit_info(spec)
     j2, created2 = sched.submit_info(dict(spec))
     assert (created1, created2) == (True, False)
-    assert j1.id == j2.id and len(sched._queue) == 1
+    assert j1.id == j2.id and sched._queued_locked() == 1
     # the wire reply marks the duplicate so clients can tell
     server = ServeServer(sched, port=0)
     try:
@@ -166,7 +166,7 @@ def test_chaos_journal_write_fault_refuses_submit_then_recovers(
         sched.submit(_spec(tmp_path / "a"))
     job = sched.submit(_spec(tmp_path / "b"))
     monkeypatch.delenv("CCT_FAULTS")
-    assert len(sched._queue) == 1
+    assert sched._queued_locked() == 1
     jobs, _info = replay(str(tmp_path / "wal"))
     assert sorted(jobs) == [job.id]  # only the acknowledged job is on disk
     assert sched.counters.snapshot()["journal_bytes"] > 0
@@ -187,7 +187,7 @@ def test_chaos_journal_replay_fault_skips_record_rest_recovers(
     monkeypatch.delenv("CCT_FAULTS")
     assert "skipping unreadable record" in capfd.readouterr().err
     assert sched.counters.snapshot()["jobs_replayed"] == 1
-    assert len(sched._queue) == 1 and 2 in sched._jobs
+    assert sched._queued_locked() == 1 and 2 in sched._jobs
     sched._journal.close()
 
 

@@ -1,0 +1,194 @@
+"""Multi-tenant scheduler semantics: qos validation, weighted-fair stride
+dispatch, per-tenant quotas, SLO-target implicit deadlines, and the
+tenant/qos blocks on the metrics/healthz endpoints."""
+
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from consensuscruncher_tpu.obs import metrics as obs_metrics  # noqa: E402
+from consensuscruncher_tpu.obs.registry import QOS_CLASSES  # noqa: E402
+from consensuscruncher_tpu.serve.journal import idempotency_key  # noqa: E402
+from consensuscruncher_tpu.serve.scheduler import (  # noqa: E402
+    DeadlineShed,
+    QuotaRefused,
+    Scheduler,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_metrics():
+    obs_metrics.reset_for_tests()
+    yield
+    obs_metrics.reset_for_tests()
+
+
+def _spec(i: int, tenant="default", qos=None, **kw):
+    spec = {"input": f"/in/{i}.bam", "output": f"/out/{i}",
+            "name": f"j{i}", "tenant": tenant}
+    if qos is not None:
+        spec["qos"] = qos
+    spec.update(kw)
+    return spec
+
+
+def _sched(**kw):
+    kw.setdefault("queue_bound", 64)
+    kw.setdefault("gang_size", 1)
+    kw.setdefault("paused", True)
+    kw.setdefault("start", False)
+    return Scheduler(backend="tpu", **kw)
+
+
+def test_submit_validates_qos_and_defaults_tenant():
+    sched = _sched()
+    job = sched.submit(_spec(0))
+    assert job.tenant == "default" and job.qos == "interactive"
+    job = sched.submit(_spec(1, tenant="acme", qos="scavenger"))
+    assert job.tenant == "acme" and job.qos == "scavenger"
+    assert job.describe()["tenant"] == "acme"
+    assert job.describe()["qos"] == "scavenger"
+    with pytest.raises(ValueError, match="interactive"):
+        sched.submit(_spec(2, qos="warp"))
+
+
+def test_stride_dispatch_follows_class_weights():
+    """With weights 2:1:1 and deep per-class backlogs, the dispatch
+    sequence must interleave so the weight-2 class gets every other slot
+    — not drain FIFO by class or by arrival order."""
+    sched = _sched(class_weights={"interactive": 2.0, "batch": 1.0,
+                                  "scavenger": 1.0})
+    for i in range(4):
+        sched.submit(_spec(100 + i, qos="batch"))
+    for i in range(8):
+        sched.submit(_spec(200 + i, qos="interactive"))
+    for i in range(4):
+        sched.submit(_spec(300 + i, qos="scavenger"))
+    order = []
+    with sched._cond:
+        while sched._any_queued_locked():
+            order.append(sched._pop_gang()[0].qos)
+    assert len(order) == 16
+    # every class-weight window of 4 dispatches serves interactive twice
+    for w in range(0, 8, 4):
+        assert order[w:w + 4].count("interactive") == 2
+    # and nothing starves: all backlogs fully drain
+    assert order.count("batch") == 4 and order.count("scavenger") == 4
+
+
+def test_idle_class_gets_no_banked_credit():
+    """A class that was idle while others ran must not monopolize on
+    arrival: its pass is clamped to the live minimum, so it wins at most
+    its fair share going forward."""
+    sched = _sched(class_weights={"interactive": 1.0, "batch": 1.0,
+                                  "scavenger": 1.0})
+    for i in range(6):
+        sched.submit(_spec(i, qos="batch"))
+    with sched._cond:
+        for _ in range(4):
+            sched._pop_gang()
+    # interactive arrives late; equal weights -> alternate, not a burst
+    for i in range(10, 14):
+        sched.submit(_spec(i, qos="interactive"))
+    order = []
+    with sched._cond:
+        while sched._any_queued_locked():
+            order.append(sched._pop_gang()[0].qos)
+    assert order[:4] in (["interactive", "batch", "interactive", "batch"],
+                         ["batch", "interactive", "batch", "interactive"])
+
+
+def test_tenant_queue_quota_refuses_and_counts():
+    sched = _sched(tenant_queue_cap=2)
+    sched.submit(_spec(0, tenant="acme"))
+    sched.submit(_spec(1, tenant="acme"))
+    with pytest.raises(QuotaRefused, match="queue quota"):
+        sched.submit(_spec(2, tenant="acme"))
+    # other tenants are unaffected by acme's quota exhaustion
+    sched.submit(_spec(3, tenant="beta"))
+    snap = obs_metrics.labeled_snapshot()["counters"]
+    refused = {e["labels"]["tenant"]: e["value"]
+               for e in snap["tenant_jobs_quota_refused"]}
+    assert refused == {"acme": 1}
+    admitted = sum(e["value"] for e in snap["tenant_jobs_admitted"])
+    assert admitted == 3
+
+
+def test_slo_target_is_implicit_deadline():
+    """A job without --deadline_s inherits its class SLO target: once the
+    EWMA-projected completion exceeds it, admission sheds."""
+    sched = _sched(slo_targets={"interactive": 5.0})
+    sched._ewma_job_s = 10.0  # observed service rate: 10s/job
+    sched.submit(_spec(0, qos="batch"))  # no batch target -> no shed
+    with pytest.raises(DeadlineShed, match="deadline_s=5"):
+        sched.submit(_spec(1, qos="interactive"))
+    # an explicit deadline overrides the class target
+    sched.submit(_spec(2, qos="interactive", deadline_s=120.0))
+    assert sched.metrics()["cumulative"]["jobs_shed"] == 1
+    assert sched.slo.snapshot()["classes"]["interactive"]["shed"] == 1
+
+
+def test_metrics_and_healthz_carry_tenancy_blocks():
+    sched = _sched(slo_targets={"interactive": 30.0})
+    sched.submit(_spec(0, tenant="acme", qos="interactive"))
+    sched.submit(_spec(1, tenant="beta", qos="batch"))
+    doc = sched.metrics()
+    assert doc["queued_by_class"]["interactive"] == 1
+    assert doc["queued_by_class"]["batch"] == 1
+    assert doc["class_weights"]["interactive"] == 8.0
+    tenants = {e["labels"]["tenant"]
+               for e in doc["labeled"]["counters"]["tenant_jobs_admitted"]}
+    assert tenants == {"acme", "beta"}
+    assert set(doc["slo"]["classes"]) == set(QOS_CLASSES)
+    assert doc["slo"]["classes"]["interactive"]["target_s"] == 30.0
+    health = sched.healthz()
+    assert health["queued_by_class"]["interactive"] == 1
+    assert health["slo"]["worst_burn_rate"] == 0.0
+    # the rendered exposition carries the labeled series end to end
+    text = obs_metrics.render_prometheus(doc)
+    assert 'cct_tenant_jobs_admitted_total{qos="batch",tenant="beta"} 1' \
+        in text
+    assert 'cct_slo_target_seconds{qos="interactive"} 30.0' in text
+
+
+def test_idempotency_keys_tenant_scoped_but_backcompat():
+    """tenant/qos are job identity (two tenants submitting the same spec
+    must not dedupe into one job) — but specs WITHOUT the fields keep
+    their pre-tenancy keys, so journals written before this change still
+    replay onto the same identities."""
+    base = _spec(0)
+    base.pop("tenant")
+    with_default = dict(base, tenant="default")
+    other = dict(base, tenant="acme")
+    assert idempotency_key(base) != idempotency_key(other)
+    assert idempotency_key(other) != idempotency_key(with_default)
+    # omitted-when-absent: adding no tenant field changes nothing
+    legacy = {k: v for k, v in base.items()}
+    assert idempotency_key(legacy) == idempotency_key(base)
+
+
+def test_duplicate_submit_dedupes_within_tenant_only():
+    sched = _sched()
+    a1, created1 = sched.submit_info(_spec(0, tenant="acme"))
+    a2, created2 = sched.submit_info(_spec(0, tenant="acme"))
+    b, created3 = sched.submit_info(_spec(0, tenant="beta"))
+    assert created1 and not created2 and created3
+    assert a1.id == a2.id and b.id != a1.id
+
+
+def test_journal_replay_restores_tenant_and_qos(tmp_path):
+    path = str(tmp_path / "t.journal")
+    sched = _sched(journal=path)
+    sched.submit(_spec(0, tenant="acme", qos="scavenger"))
+    sched2 = _sched(journal=path)
+    jobs = list(sched2._jobs.values())
+    assert len(jobs) == 1
+    assert jobs[0].tenant == "acme" and jobs[0].qos == "scavenger"
+    # replayed jobs land in their class queue, not a generic one
+    with sched2._cond:
+        assert len(sched2._queues["scavenger"]) == 1

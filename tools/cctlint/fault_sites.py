@@ -21,6 +21,9 @@ from __future__ import annotations
 
 FAULT_SITES: dict[str, str] = {
     "align.barrier": "prestart-barrier warm-up failure -> serial fallback",
+    "align.barrier_worker": "forked worker stalls/dies before the prestart "
+                            "barrier -> parent's barrier wait times out "
+                            "for real -> serial fallback",
     "align.pool_worker": "fork-pool worker death -> re-fork once, then serial",
     "subprocess.bwa": "external aligner failure -> bounded retry + backoff",
     "bgzf.truncated_eof": "truncated BGZF block -> clear error / salvage",

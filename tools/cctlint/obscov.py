@@ -15,10 +15,20 @@ CCT602  counter / histogram names are string keys: a typo'd name would
         ``<counters>.add`` / ``high_water`` / ``observe`` /
         ``get_histogram`` or a ``histogram=`` keyword must exist in
         ``consensuscruncher_tpu/obs/registry.py``.
+CCT603  labeled series are how cardinality explosions happen: label
+        *names* and closed label *values* must come from the registry's
+        ``LABELED_COUNTERS`` / ``LABELED_HISTOGRAMS`` / ``LABELS``
+        declarations.  Every ``metrics.inc(name, **labels)`` /
+        ``observe_labeled(name, v, **labels)`` call site must use a
+        registered metric, pass exactly its declared labels (when no
+        ``**splat`` hides them), and any literal ``qos=`` value must be
+        one of ``QOS_CLASSES`` — so the exposition's label space is
+        closed at lint time, not discovered in production.
 
 The registry is loaded standalone (``spec_from_file_location``) — it has
 zero imports by design, so the lint never imports the package under scan.
-Tests inject a fixture registry via ``overrides["metric_registry"]``.
+Tests inject a fixture registry via ``overrides["metric_registry"]``
+(CCT603 activates only when the override carries the labeled blocks).
 
 Like CCT3xx, this family has no pragma: an unregistered metric is fixed by
 registering it, a notification-free fault path by wiring ``_notify`` back
@@ -40,14 +50,35 @@ COUNTER_RECEIVERS = {"cum", "counters", "cumulative"}
 REGISTRY_REL = os.path.join("consensuscruncher_tpu", "obs", "registry.py")
 
 
+def _labeled_decl(block) -> dict:
+    """``{metric: (label, ...)}`` from a LABELED_* registry block (either
+    the real module dict-of-specs or a test-override mapping)."""
+    out = {}
+    for name, spec in (block or {}).items():
+        out[name] = tuple(spec.get("labels", ())) \
+            if isinstance(spec, dict) else tuple(spec)
+    return out
+
+
 def _load_registry(ctx: LintContext):
-    """(counter names, histogram names) — from overrides or the real
-    registry module, loaded standalone.  None when neither exists (scans of
-    foreign trees: CCT602 has nothing to check against)."""
+    """Registry view for CCT602/CCT603 — from overrides or the real
+    registry module, loaded standalone.  None when neither exists (scans
+    of foreign trees: nothing to check against).  ``labeled_counters`` /
+    ``labeled_histograms`` are None (CCT603 inert) when the registry
+    predates tenancy or the override omits them."""
     override = ctx.overrides.get("metric_registry")
     if override is not None:
-        return (frozenset(override.get("counters", ())),
-                frozenset(override.get("histograms", ())))
+        return {
+            "counters": frozenset(override.get("counters", ())),
+            "histograms": frozenset(override.get("histograms", ())),
+            "labeled_counters": (
+                _labeled_decl(override["labeled_counters"])
+                if "labeled_counters" in override else None),
+            "labeled_histograms": (
+                _labeled_decl(override["labeled_histograms"])
+                if "labeled_histograms" in override else None),
+            "qos_classes": frozenset(override.get("qos_classes", ())),
+        }
     path = os.path.join(ctx.root, REGISTRY_REL)
     if not os.path.isfile(path):
         return None
@@ -56,7 +87,15 @@ def _load_registry(ctx: LintContext):
     spec = importlib.util.spec_from_file_location("_cct_obs_registry", path)
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
-    return (frozenset(mod.COUNTERS), frozenset(mod.HISTOGRAMS))
+    return {
+        "counters": frozenset(mod.COUNTERS),
+        "histograms": frozenset(mod.HISTOGRAMS),
+        "labeled_counters": _labeled_decl(
+            getattr(mod, "LABELED_COUNTERS", None)) or None,
+        "labeled_histograms": _labeled_decl(
+            getattr(mod, "LABELED_HISTOGRAMS", None)) or None,
+        "qos_classes": frozenset(getattr(mod, "QOS_CLASSES", ())),
+    }
 
 
 def _module_functions(tree: ast.Module) -> dict[str, ast.FunctionDef]:
@@ -161,9 +200,85 @@ def _check_metric_names(ctx: LintContext, counters, histograms):
     return findings
 
 
+def _check_labeled_call(node: ast.Call, src, universe: dict, what: str,
+                        qos_classes, findings: list[Finding]) -> None:
+    name = _name_arg(node)
+    if name is None:
+        return
+    if name not in universe:
+        findings.append(Finding(
+            "CCT603", src.rel, node.lineno,
+            f"labeled metric '{name}' is not registered — add it to "
+            f"consensuscruncher_tpu/obs/registry.py {what}", "obscov"))
+        return
+    declared = set(universe[name])
+    has_splat = any(kw.arg is None for kw in node.keywords)
+    passed = set()
+    for kw in node.keywords:
+        if kw.arg is None or kw.arg == "value":
+            continue
+        passed.add(kw.arg)
+        if kw.arg not in declared:
+            findings.append(Finding(
+                "CCT603", src.rel, node.lineno,
+                f"label '{kw.arg}' is not declared for metric '{name}' "
+                f"(declared: {sorted(declared)}) — labels are a closed "
+                "set; add it to the registry entry or drop it", "obscov"))
+        elif kw.arg == "qos" and isinstance(kw.value, ast.Constant) and \
+                isinstance(kw.value.value, str) and qos_classes and \
+                kw.value.value not in qos_classes:
+            findings.append(Finding(
+                "CCT603", src.rel, node.lineno,
+                f"qos value '{kw.value.value}' is not in the closed "
+                f"QOS_CLASSES set {sorted(qos_classes)}", "obscov"))
+    if not has_splat and passed < declared:
+        missing = sorted(declared - passed)
+        findings.append(Finding(
+            "CCT603", src.rel, node.lineno,
+            f"metric '{name}' requires labels {sorted(declared)}; call "
+            f"site omits {missing} (a partial label set would mint a "
+            "phantom series at runtime)", "obscov"))
+
+
+def _check_labeled_names(ctx: LintContext, reg: dict) -> list[Finding]:
+    """CCT603: labeled-series call sites vs the closed label registry."""
+    findings: list[Finding] = []
+    counters = reg["labeled_counters"]
+    histograms = reg["labeled_histograms"]
+    qos_classes = reg["qos_classes"]
+    for src in ctx.parsed():
+        if src.rel.replace(os.sep, "/").startswith(
+                "consensuscruncher_tpu/obs/"):
+            continue
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            term = terminal_name(node)
+            if term == "inc":
+                # house idiom is receiver-qualified (obs_metrics.inc /
+                # metrics.inc); bare .inc on arbitrary objects is out of
+                # scope, like bare .add for CCT602
+                dotted = call_name(node)
+                parts = (dotted or "").split(".")
+                if len(parts) < 2 or parts[-2] not in ("obs_metrics",
+                                                       "metrics"):
+                    continue
+                _check_labeled_call(node, src, counters, "LABELED_COUNTERS",
+                                    qos_classes, findings)
+            elif term == "observe_labeled":
+                _check_labeled_call(node, src, histograms,
+                                    "LABELED_HISTOGRAMS", qos_classes,
+                                    findings)
+    return findings
+
+
 def run(ctx: LintContext) -> list[Finding]:
     findings = _check_fault_notify(ctx)
-    registry = _load_registry(ctx)
-    if registry is not None:
-        findings.extend(_check_metric_names(ctx, *registry))
+    reg = _load_registry(ctx)
+    if reg is not None:
+        findings.extend(_check_metric_names(
+            ctx, reg["counters"], reg["histograms"]))
+        if reg["labeled_counters"] is not None and \
+                reg["labeled_histograms"] is not None:
+            findings.extend(_check_labeled_names(ctx, reg))
     return findings

@@ -1,0 +1,498 @@
+"""Multi-tenant open-loop load generator + capacity proof for `serve`.
+
+Drives a LIVE daemon (spawned throwaway child by default, or an existing
+address via ``--connect``) with sustained synthetic consensus traffic and
+measures where the service knee is:
+
+  1. pre-generate per-class input BAMs with ``utils.simulate`` — family
+     sizes follow the read_families PMF (``--families_hist`` loads a real
+     ``*_read_families.txt``; a built-in duplex-typical PMF otherwise);
+  2. for each offered-load level (jobs/second), submit on a fixed
+     open-loop arrival schedule — arrivals do NOT slow down when the
+     daemon backs up, which is the whole point: admission shedding and
+     quota refusals under overload are *data*, not errors
+     (``ServeClient.submit_nowait``);
+  3. let every accepted job reach a terminal state, then read the level's
+     per-class p50/p99 latency, throughput and shed rate from the
+     daemon's own tenant/qos-labeled histogram deltas (the same series
+     the Prometheus exposition carries — the benchmark exercises the
+     observability path it reports through);
+  4. emit ``BENCH_LOADGEN_*.json``: the shed-rate / latency / throughput
+     curves vs offered load, the daemon's final SLO snapshot, and a
+     knee-point capacity estimate (largest offered rate whose aggregate
+     shed ratio stayed under ``--shed_knee``).
+
+Runs fully on CPU; the daemon child bootstraps through
+``tools/_jax_cpu.force_cpu`` with ``--backend xla_cpu`` (same idiom as
+``serve_soak.py``).  ``--smoke`` shrinks everything to a few seconds for
+CI (``tools/ci_check.sh``); the full sweep is the ``slow``-marked test in
+``tests/test_loadgen.py``.
+
+  python tools/loadgen.py --workdir /tmp/lg --smoke
+  python tools/loadgen.py --workdir /tmp/lg --levels 0.5,1,2,4 \\
+      --duration 30 --out BENCH_LOADGEN_r06.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import signal
+import subprocess
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from consensuscruncher_tpu.obs.registry import QOS_CLASSES  # noqa: E402
+from consensuscruncher_tpu.obs.slo import quantile_from_histogram  # noqa: E402
+from consensuscruncher_tpu.serve.client import (  # noqa: E402
+    ServeClient,
+    ServeClientError,
+)
+from consensuscruncher_tpu.utils.simulate import (  # noqa: E402
+    SimConfig,
+    simulate_bam,
+)
+from consensuscruncher_tpu.utils.stats import FamilySizeHistogram  # noqa: E402
+
+# same bootstrap as serve_soak: the child must drop the axon PJRT factory
+# before first backend touch, then run the real CLI
+_BOOT = (
+    "import sys; "
+    f"sys.path.insert(0, {_REPO!r}); "
+    f"sys.path.insert(0, {os.path.join(_REPO, 'tools')!r}); "
+    "from _jax_cpu import force_cpu; force_cpu(); "
+    "from consensuscruncher_tpu.cli import main; "
+    "sys.exit(main(sys.argv[1:]))"
+)
+
+# Family-size PMF used when no --families_hist is given: the shape a
+# duplex library with mean family size ~3 actually produces (heavy
+# singleton mass, geometric-ish tail) — matches the simulate.py Poisson
+# model closely enough that bucket mixes exercise the same vote kernels.
+DEFAULT_FAMILY_PMF = {
+    1: 0.33, 2: 0.22, 3: 0.17, 4: 0.12, 5: 0.07,
+    6: 0.04, 8: 0.03, 12: 0.02,
+}
+
+# fragments per synthetic input, by class: interactive jobs are small
+# (latency-sensitive), batch jobs are the big ones, scavenger in between
+_CLASS_FRAGMENTS = {"interactive": 24, "batch": 96, "scavenger": 48}
+_CLASS_FRAGMENTS_SMOKE = {"interactive": 8, "batch": 20, "scavenger": 12}
+
+
+def _parse_mix(text: str) -> list[tuple[str, str, float]]:
+    """``tenant:qos:weight,...`` -> [(tenant, qos, weight), ...]."""
+    out = []
+    for part in text.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        try:
+            tenant, qos, weight = part.split(":")
+            w = float(weight)
+        except ValueError:
+            raise SystemExit(
+                f"loadgen: bad --mix entry {part!r} (want tenant:qos:weight)")
+        if qos not in QOS_CLASSES:
+            raise SystemExit(
+                f"loadgen: --mix qos {qos!r} not in {sorted(QOS_CLASSES)}")
+        if w <= 0:
+            raise SystemExit(f"loadgen: --mix weight must be > 0: {part!r}")
+        out.append((tenant, qos, w))
+    if not out:
+        raise SystemExit("loadgen: --mix is empty")
+    return out
+
+
+def _load_family_pmf(path: str) -> dict[int, float]:
+    counts = FamilySizeHistogram.read(path)
+    total = sum(counts.values())
+    if total <= 0:
+        raise SystemExit(f"loadgen: empty family histogram {path}")
+    return {int(s): c / total for s, c in sorted(counts.items())}
+
+
+def _sample_mean_family(rng: random.Random, pmf: dict[int, float],
+                        draws: int = 24) -> float:
+    """Mean of ``draws`` samples from the PMF — each synthetic input gets
+    its own mean family size, so the sweep covers a mix of family-size
+    regimes instead of one synthetic average."""
+    sizes = list(pmf)
+    weights = [pmf[s] for s in sizes]
+    picked = rng.choices(sizes, weights=weights, k=draws)
+    return max(1.0, sum(picked) / len(picked))
+
+
+def make_inputs(workdir: str, pmf: dict[int, float], per_class: int,
+                seed: int, smoke: bool) -> dict[str, list[str]]:
+    """Pre-generate ``per_class`` coordinate-sorted barcoded BAMs per qos
+    class (generation cost must not pollute the open-loop schedule)."""
+    frags = _CLASS_FRAGMENTS_SMOKE if smoke else _CLASS_FRAGMENTS
+    rng = random.Random(seed ^ 0x5EED)
+    inputs: dict[str, list[str]] = {}
+    base = os.path.join(workdir, "inputs")
+    os.makedirs(base, exist_ok=True)
+    for qos in QOS_CLASSES:
+        inputs[qos] = []
+        for i in range(per_class):
+            path = os.path.join(base, f"{qos}{i}.bam")
+            cfg = SimConfig(
+                n_fragments=frags[qos],
+                mean_family_size=_sample_mean_family(rng, pmf),
+                seed=seed * 1000 + len(inputs[qos]) * 100
+                + list(QOS_CLASSES).index(qos),
+            )
+            simulate_bam(path, cfg)
+            inputs[qos].append(path)
+    return inputs
+
+
+# ------------------------------------------------------- metrics deltas
+
+def _counter_by_qos(doc: dict, name: str) -> dict[str, int]:
+    out = {qos: 0 for qos in QOS_CLASSES}
+    for entry in (doc.get("labeled") or {}).get("counters", {}).get(name, []):
+        out[entry["labels"]["qos"]] += int(entry["value"])
+    return out
+
+
+def _wall_hist_by_qos(doc: dict) -> dict[str, dict]:
+    """tenant_job_wall_s series summed across tenants, keyed by qos."""
+    out: dict[str, dict] = {}
+    series = (doc.get("labeled") or {}).get("histograms", {}) \
+        .get("tenant_job_wall_s", [])
+    for h in series:
+        qos = h["labels"]["qos"]
+        agg = out.get(qos)
+        if agg is None:
+            out[qos] = {"buckets": list(h["buckets"]),
+                        "counts": list(h["counts"])}
+        else:
+            agg["counts"] = [a + b for a, b in zip(agg["counts"], h["counts"])]
+    return out
+
+
+def _hist_delta(before: dict | None, after: dict) -> dict:
+    if before is None:
+        return {"buckets": list(after["buckets"]),
+                "counts": list(after["counts"])}
+    return {"buckets": list(after["buckets"]),
+            "counts": [a - b for a, b in
+                       zip(after["counts"], before["counts"])]}
+
+
+def _delta(a: dict[str, int], b: dict[str, int]) -> dict[str, int]:
+    return {k: a[k] - b.get(k, 0) for k in a}
+
+
+# ------------------------------------------------------------ one level
+
+def _run_level(client: ServeClient, rng: random.Random, level_idx: int,
+               rate: float, duration: float, settle: float,
+               mix: list[tuple[str, str, float]],
+               inputs: dict[str, list[str]], outdir: str) -> dict:
+    n_jobs = max(1, int(round(rate * duration)))
+    weights = [w for _, _, w in mix]
+    before = client.metrics()
+
+    submitted: list[dict] = []
+    pending: list[dict] = []
+    t0 = time.monotonic()
+    max_slip = 0.0
+    for i in range(n_jobs):
+        due = t0 + i / rate
+        now = time.monotonic()
+        if due > now:
+            time.sleep(due - now)
+        else:
+            # open-loop contract check: if submission itself can't keep
+            # up, the offered rate was never actually offered
+            max_slip = max(max_slip, now - due)
+        tenant, qos, _ = rng.choices(mix, weights=weights, k=1)[0]
+        bam = rng.choice(inputs[qos])
+        spec = {
+            "input": bam,
+            "output": os.path.join(outdir, f"j{i}"),
+            "name": "lg",
+            "cutoff": 0.7, "qualscore": 0, "scorrect": True,
+            "max_mismatch": 0, "bdelim": "|", "compress_level": 1,
+            "tenant": tenant, "qos": qos,
+        }
+        t_sub = time.monotonic()
+        reply = client.submit_nowait(spec)
+        rec = {"tenant": tenant, "qos": qos, "t_submit": t_sub}
+        if reply.get("ok"):
+            rec["key"] = reply["key"]
+            pending.append(rec)
+        else:
+            rec["refused"] = ("quota" if reply.get("quota")
+                              else "shed" if reply.get("shed") else "queue")
+        submitted.append(rec)
+    submit_wall = time.monotonic() - t0
+
+    # settle: every accepted job must be terminal before the after-
+    # snapshot, or the histogram delta would bleed into the next level
+    deadline = time.monotonic() + duration + settle
+    lost = 0
+    while pending and time.monotonic() < deadline:
+        still = []
+        for rec in pending:
+            try:
+                job = client.status(key=rec["key"])
+            except ServeClientError:
+                rec["state"] = "lost"
+                lost += 1
+                continue
+            if job["state"] in ("done", "failed"):
+                rec["state"] = job["state"]
+            else:
+                still.append(rec)
+        pending = still
+        if pending:
+            time.sleep(0.25)
+    for rec in pending:
+        rec["state"] = "unsettled"
+    lost += len(pending)
+    level_wall = time.monotonic() - t0
+    after = client.metrics()
+
+    # per-class stats from the daemon's own labeled series
+    walls_b = _wall_hist_by_qos(before)
+    walls_a = _wall_hist_by_qos(after)
+    classes: dict[str, dict] = {}
+    agg_done = agg_shed = agg_submitted = 0
+    for qos in QOS_CLASSES:
+        done = _delta(_counter_by_qos(after, "tenant_jobs_done"),
+                      _counter_by_qos(before, "tenant_jobs_done"))[qos]
+        failed = _delta(_counter_by_qos(after, "tenant_jobs_failed"),
+                        _counter_by_qos(before, "tenant_jobs_failed"))[qos]
+        shed = _delta(_counter_by_qos(after, "tenant_jobs_shed"),
+                      _counter_by_qos(before, "tenant_jobs_shed"))[qos]
+        quota = _delta(
+            _counter_by_qos(after, "tenant_jobs_quota_refused"),
+            _counter_by_qos(before, "tenant_jobs_quota_refused"))[qos]
+        subs = sum(1 for r in submitted if r["qos"] == qos)
+        p50 = p99 = None
+        if qos in walls_a:
+            d = _hist_delta(walls_b.get(qos), walls_a[qos])
+            p50 = quantile_from_histogram(d["buckets"], d["counts"], 0.50)
+            p99 = quantile_from_histogram(d["buckets"], d["counts"], 0.99)
+        classes[qos] = {
+            "submitted": subs, "done": done, "failed": failed,
+            "shed": shed, "quota_refused": quota,
+            "shed_ratio": round(shed / subs, 6) if subs else 0.0,
+            "p50_s": None if p50 is None else round(p50, 6),
+            "p99_s": None if p99 is None else round(p99, 6),
+            "throughput_jobs_per_s": round(done / level_wall, 6),
+        }
+        agg_done += done
+        agg_shed += shed
+        agg_submitted += subs
+
+    return {
+        "level": level_idx,
+        "offered_jobs_per_s": rate,
+        "offered_jobs": n_jobs,
+        "duration_s": duration,
+        "submit_wall_s": round(submit_wall, 3),
+        "level_wall_s": round(level_wall, 3),
+        "max_schedule_slip_s": round(max_slip, 3),
+        "classes": classes,
+        "aggregate": {
+            "submitted": agg_submitted,
+            "done": agg_done,
+            "shed": agg_shed,
+            "lost": lost,
+            "shed_ratio": (round(agg_shed / agg_submitted, 6)
+                           if agg_submitted else 0.0),
+            "throughput_jobs_per_s": round(agg_done / level_wall, 6),
+        },
+    }
+
+
+def knee_estimate(levels: list[dict], shed_knee: float) -> dict:
+    """Largest offered rate whose aggregate shed ratio stayed under the
+    threshold (and nothing was lost), plus the best goodput seen anywhere
+    — the two numbers a capacity plan needs."""
+    ok = [lv for lv in levels
+          if lv["aggregate"]["shed_ratio"] <= shed_knee
+          and lv["aggregate"]["lost"] == 0]
+    knee = max((lv["offered_jobs_per_s"] for lv in ok), default=None)
+    peak = max((lv["aggregate"]["throughput_jobs_per_s"] for lv in levels),
+               default=0.0)
+    return {
+        "shed_knee_threshold": shed_knee,
+        "knee_offered_jobs_per_s": knee,
+        "max_throughput_jobs_per_s": peak,
+    }
+
+
+# ------------------------------------------------------------------ main
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--workdir", required=True,
+                    help="scratch dir: socket, inputs, job outputs, daemon log")
+    ap.add_argument("--connect", default="",
+                    help="existing daemon (unix socket path or host:port); "
+                         "empty = spawn a throwaway daemon in --workdir")
+    ap.add_argument("--levels", default="0.5,1,2,4",
+                    help="comma-separated offered-load levels, jobs/second")
+    ap.add_argument("--duration", type=float, default=30.0,
+                    help="seconds of sustained submission per level")
+    ap.add_argument("--settle", type=float, default=180.0,
+                    help="extra seconds to let accepted jobs finish per level")
+    ap.add_argument("--mix",
+                    default="alpha:interactive:6,beta:batch:3,"
+                            "gamma:scavenger:1",
+                    help="traffic mix as tenant:qos:weight,...")
+    ap.add_argument("--families_hist", default="",
+                    help="a *_read_families.txt to draw family sizes from "
+                         "(default: built-in duplex-typical PMF)")
+    ap.add_argument("--inputs_per_class", type=int, default=3)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--gang_size", type=int, default=2)
+    ap.add_argument("--queue_bound", type=int, default=64)
+    ap.add_argument("--class_weights",
+                    default="interactive=8,batch=3,scavenger=1")
+    ap.add_argument("--slo_targets",
+                    default="interactive=20,batch=90,scavenger=240",
+                    help="per-class SLO targets forwarded to the spawned "
+                         "daemon (they double as implicit deadlines, so "
+                         "overload sheds instead of queueing unboundedly)")
+    ap.add_argument("--tenant_queue_cap", type=int, default=0,
+                    help="per-tenant queue-slot quota for the spawned "
+                         "daemon (0 = unlimited)")
+    ap.add_argument("--shed_knee", type=float, default=0.05,
+                    help="max aggregate shed ratio still counted as "
+                         "'within capacity' for the knee estimate")
+    ap.add_argument("--out", default="",
+                    help="output JSON path (default: "
+                         "BENCH_LOADGEN_<utc-stamp>.json in the cwd)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="seconds-scale run for CI: tiny inputs, short "
+                         "levels, short settle")
+    args = ap.parse_args(argv)
+
+    if args.smoke:
+        args.levels = "1,3,8"
+        args.duration = 3.0
+        args.settle = 60.0
+        args.inputs_per_class = 1
+    rates = [float(r) for r in args.levels.split(",") if r.strip()]
+    if len(rates) < (1 if args.smoke else 3):
+        raise SystemExit("loadgen: need at least 3 --levels for a curve")
+    mix = _parse_mix(args.mix)
+    pmf = (_load_family_pmf(args.families_hist) if args.families_hist
+           else dict(DEFAULT_FAMILY_PMF))
+
+    os.makedirs(args.workdir, exist_ok=True)
+    print(f"loadgen: generating {args.inputs_per_class} input BAM(s) per "
+          f"class under {args.workdir}/inputs", flush=True)
+    inputs = make_inputs(args.workdir, pmf, args.inputs_per_class,
+                         args.seed, args.smoke)
+
+    daemon = None
+    log_fh = None
+    if args.connect:
+        address = (tuple(args.connect.rsplit(":", 1))
+                   if ":" in args.connect and os.sep not in args.connect
+                   else args.connect)
+        if isinstance(address, tuple):
+            address = (address[0], int(address[1]))
+    else:
+        address = os.path.join(args.workdir, "loadgen.sock")
+        daemon_cmd = [sys.executable, "-c", _BOOT] + [
+            "serve", "--socket", address,
+            "--gang_size", str(args.gang_size),
+            "--queue_bound", str(args.queue_bound),
+            "--backend", "xla_cpu", "--drain_s", "60",
+            "--class_weights", args.class_weights,
+            "--slo_targets", args.slo_targets,
+        ]
+        if args.tenant_queue_cap > 0:
+            daemon_cmd += ["--tenant_queue_cap", str(args.tenant_queue_cap)]
+        log_path = os.path.join(args.workdir, "daemon.log")
+        log_fh = open(log_path, "ab")
+        daemon = subprocess.Popen(daemon_cmd, stdout=log_fh, stderr=log_fh)
+        print(f"loadgen: spawned daemon pid {daemon.pid} on {address} "
+              f"(log: {log_path})", flush=True)
+
+    client = ServeClient(address, retries=60, retry_base_s=0.25)
+    rng = random.Random(args.seed)
+    levels: list[dict] = []
+    rc = 0
+    try:
+        health = client.healthz()
+        print(f"loadgen: daemon {health['status']} (pid {health['pid']}); "
+              f"mix={args.mix}", flush=True)
+        for idx, rate in enumerate(rates):
+            outdir = os.path.join(args.workdir, "out", f"L{idx}")
+            os.makedirs(outdir, exist_ok=True)
+            print(f"loadgen: level {idx}: {rate:g} jobs/s for "
+                  f"{args.duration:g}s ...", flush=True)
+            lv = _run_level(client, rng, idx, rate, args.duration,
+                            args.settle, mix, inputs, outdir)
+            agg = lv["aggregate"]
+            print(f"loadgen: level {idx}: submitted={agg['submitted']} "
+                  f"done={agg['done']} shed={agg['shed']} "
+                  f"lost={agg['lost']} "
+                  f"thru={agg['throughput_jobs_per_s']:g}/s "
+                  f"shed_ratio={agg['shed_ratio']:g}", flush=True)
+            if agg["lost"]:
+                rc = 1
+            levels.append(lv)
+        final = client.metrics()
+        doc = {
+            "bench": "loadgen",
+            "created_unix": time.time(),
+            "config": {
+                "levels_jobs_per_s": rates,
+                "duration_s": args.duration,
+                "mix": args.mix,
+                "class_weights": args.class_weights,
+                "slo_targets": args.slo_targets,
+                "tenant_queue_cap": args.tenant_queue_cap,
+                "gang_size": args.gang_size,
+                "queue_bound": args.queue_bound,
+                "families_hist": args.families_hist or "builtin",
+                "seed": args.seed,
+                "smoke": args.smoke,
+            },
+            "levels": levels,
+            "knee": knee_estimate(levels, args.shed_knee),
+            "slo": final.get("slo"),
+            "queued_by_class": final.get("queued_by_class"),
+        }
+        out = args.out or time.strftime("BENCH_LOADGEN_%Y%m%d-%H%M%SZ.json",
+                                        time.gmtime())
+        tmp = out + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(doc, fh, indent=2, sort_keys=True)
+            fh.write("\n")
+        os.replace(tmp, out)
+        knee = doc["knee"]
+        print(f"loadgen: wrote {out}", flush=True)
+        print(f"loadgen: knee={knee['knee_offered_jobs_per_s']} jobs/s "
+              f"(shed<= {args.shed_knee:g}), peak throughput="
+              f"{knee['max_throughput_jobs_per_s']:g} jobs/s", flush=True)
+        return rc
+    finally:
+        if daemon is not None:
+            try:
+                daemon.send_signal(signal.SIGTERM)
+                daemon.wait(timeout=90)
+            except Exception:
+                daemon.kill()
+                daemon.wait(timeout=10)
+            if log_fh is not None:
+                log_fh.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

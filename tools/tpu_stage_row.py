@@ -68,6 +68,7 @@ def main() -> int:
     batches = []
     total_reads = 0
     fams = 0  # nonzero-size family slots actually voted (dropout excluded)
+    dropout_slots = 0  # zeroed strand-B slots: padding, never voted
     for _ in range(N_BATCHES):
         # clipped at 16 = the dominant pow2 size-class bucket for mean-4
         # data (see tpu_mesh_row.py) — the shape the stage actually ships
@@ -75,6 +76,7 @@ def main() -> int:
         sizes_b = np.minimum(1 + rng.geometric(1.0 / MEAN_FAM, N_PAIRS), 16).astype(np.int32)
         sizes_b[:: 16] = 0  # duplex dropout, as real data has
         fams += int((sizes_a > 0).sum() + (sizes_b > 0).sum())
+        dropout_slots += int((sizes_b == 0).sum())
         _, _, seg_sizes = build_member_stream([sizes_a, sizes_b])
         m = int(seg_sizes.sum())
         total_reads += m
@@ -119,6 +121,11 @@ def main() -> int:
     hbm_bytes = wire_bytes + 2 * m_max * L * N_BATCHES + out_bytes
     emit({"row": "stage_device_loop", "n_batches": N_BATCHES,
           "pairs_per_batch": N_PAIRS, "reads_total": total_reads,
+          # denominator provenance: the *_per_sec_* rates divide by voted
+          # families only — zeroed duplex-dropout slots are padding, and
+          # counting them inflated throughput by ~3% before this row
+          # carried the split explicitly
+          "families_voted": fams, "dropout_slots": dropout_slots,
           "member_cap": cap, "wire_bytes_in": int(wire_bytes),
           "loop_s": round(loop_s, 4), "fetch_s": round(fetch_s, 4),
           "families_per_sec_loop": round(fams / loop_s, 1),
