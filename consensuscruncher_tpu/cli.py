@@ -613,6 +613,21 @@ def _consensus_impl(args) -> dict:
         from consensuscruncher_tpu.parallel.hostshard import parse_range_argv
 
         input_range = parse_range_argv(range_spec)
+
+    # Device-resident consensus planes (ROADMAP item 3): one store per job
+    # when the SSCS vote runs the single-device stream wire; rescue and DCS
+    # then vote by on-device gather instead of re-uploading SSCS planes.
+    # NOT a manifest param — outputs are byte-identical either way, so a
+    # --resume must not re-run stages over it.  A resume that skips SSCS
+    # leaves the store empty and downstream misses everything (staged path).
+    residency = None
+    if (args.backend == "tpu" and getattr(args, "wire", "stream") == "stream"
+            and getattr(args, "residency", True)
+            and (args.devices is None or args.devices <= 1)):
+        from consensuscruncher_tpu.ops import packing
+
+        residency = packing.resident_planes()
+
     sscs_res = checkpointed(
         "sscs",
         [args.input],
@@ -631,6 +646,7 @@ def _consensus_impl(args) -> dict:
             level=ilevel,
             input_range=input_range,
             prestaged=getattr(args, "_prestaged", None),
+            residency=residency,
         ),
         rebuild=lambda: SscsResult.from_prefix(sscs_prefix),
     )
@@ -657,6 +673,7 @@ def _consensus_impl(args) -> dict:
                 max_mismatch=args.max_mismatch,
                 backend=args.backend,
                 level=ilevel,
+                residency=residency,
             ),
             rebuild=lambda: SingletonResult.from_prefix(corr_prefix),
         )
@@ -688,7 +705,8 @@ def _consensus_impl(args) -> dict:
         list(dcs_paths.values()),
         {},
         run=lambda: run_dcs(dcs_input, dcs_prefix, backend=args.backend,
-                            devices=args.devices, level=ilevel),
+                            devices=args.devices, level=ilevel,
+                            residency=residency),
         rebuild=lambda: DcsResult.from_prefix(dcs_prefix),
     )
     stats_jsons.append(dcs_paths["stats_json"])
@@ -834,12 +852,43 @@ def serve_cmd(args) -> None:
     if args.compile_cache:
         if warmup.setup_compilation_cache(args.compile_cache):
             print(f"serve: persistent compile cache at {args.compile_cache}")
+    budget = getattr(args, "warmup_budget_s", None)
+    budget = float(budget) if budget not in (None, "") else None
+
+    # Occupancy-driven bucket autotuning: load the learned table (persisted
+    # next to the compile cache by default), install the per-shape kernel
+    # policy BEFORE warming so warm_shapes compiles the chosen kernels,
+    # then warm the most-seen live shapes and mark the recompile baseline —
+    # compiles after this point are unexpected under the learned table.
+    at_cfg = warmup.load_autotune_config(getattr(args, "config", None))
+    table_path = at_cfg["table_path"] or (
+        os.path.join(args.compile_cache, warmup.DEFAULT_TABLE_NAME)
+        if args.compile_cache else None)
+    autotuner = warmup.BucketAutotuner(
+        table_path=table_path, learn_window=at_cfg["learn_window"],
+        backend=at_cfg["backend"])
+    if autotuner.load():
+        print(f"serve: autotune table loaded from {table_path} "
+              f"({len(autotuner.table)} shapes, backend={autotuner.backend})")
+    autotuner.install()
+
     shapes = warmup.parse_shapes(args.warmup_shapes)
-    if shapes:
-        budget = getattr(args, "warmup_budget_s", None)
-        budget = float(budget) if budget not in (None, "") else None
-        n = warmup.warm_shapes(shapes, budget_s=budget)
-        print(f"serve: precompiled {n}/{len(shapes)} warmup shapes")
+    # warm the full pow2-B ladder of the learned buckets (not just the
+    # shapes seen verbatim): ganged rounds dispatch the same (F, L) bucket
+    # at any pow2 batch count, and "zero unexpected recompiles under the
+    # learned table" needs every rung warm
+    learned = [s for s in autotuner.ladder_shapes() if s not in set(shapes)]
+    if shapes or learned:
+        n = warmup.warm_shapes(shapes + learned, budget_s=budget)
+        print(f"serve: precompiled {n}/{len(shapes) + len(learned)} warmup "
+              f"shapes ({len(learned)} from the autotune table)")
+    if learned:
+        nd = warmup.warm_duplex_ladder(
+            max(b for b, _, _ in learned),
+            {l for _, _, l in learned})
+        print(f"serve: precompiled {nd} duplex-vote ladder shapes")
+    autotuner.snapshot_recompiles()
+    warmup.start_learn_loop(autotuner)
 
     journal = None
     if getattr(args, "journal", None):
@@ -880,6 +929,12 @@ def serve_cmd(args) -> None:
         tenant_queue_cap=_cap("tenant_queue_cap"),
         tenant_inflight_cap=_cap("tenant_inflight_cap"),
     )
+    scheduler.autotune_info = lambda: {
+        "shapes": len(autotuner.table),
+        "backend": autotuner.backend,
+        "table_path": autotuner.table_path,
+        "unexpected_recompiles": autotuner.unexpected_recompiles(),
+    }
     server = ServeServer(
         scheduler, host=args.host, port=int(args.port),
         socket_path=args.socket or None,
@@ -908,6 +963,15 @@ def serve_cmd(args) -> None:
               file=sys.stderr, flush=True)
     server.close()
     scheduler.shutdown()
+    # final learn pass: short-lived daemons (smoke runs, supervised
+    # restarts) persist their observed bucket mix even when the periodic
+    # learn loop never got a chance to fire
+    try:
+        autotuner.learn_from_live()
+        autotuner.save()
+    except Exception as e:
+        print(f"WARNING: final autotune save failed ({e})",
+              file=sys.stderr, flush=True)
     if journal is not None:
         journal.close()
     print("serve: shutdown complete", flush=True)
@@ -1074,6 +1138,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "(packed member stream — 8-16x fewer h2d bytes, the "
                         "production default) or 'dense' (padded (B,F,L) "
                         "batches; bake-off/debug). Bit-identical outputs")
+    c.add_argument("--residency",
+                   help="keep SSCS consensus planes device-resident so "
+                        "rescue and DCS vote by on-device gather instead of "
+                        "re-uploading them (default True; tpu stream wire, "
+                        "single device). Bit-identical outputs; 'False' "
+                        "forces the staged path")
     c.set_defaults(func=consensus, config_section="consensus",
                    required_args=("input", "output"),
                    builtin_defaults={
@@ -1081,7 +1151,7 @@ def build_parser() -> argparse.ArgumentParser:
                        "max_mismatch": 0, "backend": "tpu",
                        "bdelim": DEFAULT_BDELIM, "cleanup": "False",
                        "resume": "False", "compress_level": 6,
-                       "host_workers": 1,
+                       "host_workers": 1, "residency": "True",
                    })
 
     s = sub.add_parser(
@@ -1230,6 +1300,8 @@ def main(argv=None) -> int:
 
     args.scorrect = _bool(getattr(args, "scorrect", "True"))
     args.cleanup = _bool(getattr(args, "cleanup", "False"))
+    if hasattr(args, "residency"):
+        args.residency = _bool(args.residency)
     if hasattr(args, "resume"):
         args.resume = _bool(args.resume)
     if hasattr(args, "cutoff"):

@@ -22,6 +22,7 @@ stay jax-free (``utils.faults`` reaches it from fault firings).
 from __future__ import annotations
 
 import os
+import sys
 import threading
 from bisect import bisect_left
 
@@ -207,6 +208,33 @@ def labeled_snapshot() -> dict:
     return out
 
 
+# ------------------------------------------------------ transfer bytes
+#
+# Process-wide measured host<->device byte counters, fed by every ops/
+# dispatch site (stream vote, dense vote, duplex, hamming, residency).
+# These replace bench.py's n_reads*L*2 *estimate* with a measurement;
+# stages export per-stage deltas into their cumulative sidecars the same
+# way they already export recompile deltas.
+
+_transfer_bytes = {"h2d": 0, "d2h": 0}
+
+
+def note_transfer(direction: str, nbytes: int) -> None:
+    """Record ``nbytes`` moved host->device (``"h2d"``) or device->host
+    (``"d2h"``).  Callers pass the *wire* size of the arrays they hand to
+    ``jnp.asarray`` / receive from ``np.asarray``."""
+    if direction not in _transfer_bytes:
+        raise KeyError(f"transfer direction must be 'h2d' or 'd2h', got {direction!r}")
+    with _lock:
+        _transfer_bytes[direction] += int(nbytes)
+
+
+def transfer_bytes() -> dict:
+    """Snapshot ``{"h2d": total_bytes, "d2h": total_bytes}``."""
+    with _lock:
+        return dict(_transfer_bytes)
+
+
 def note_compile(signature) -> bool:
     """Record one device-dispatch shape signature; True on first
     sighting (i.e. this dispatch paid an XLA compile in this process)."""
@@ -216,7 +244,12 @@ def note_compile(signature) -> bool:
             return False
         _seen_signatures.add(signature)
         _recompiles += 1
-        return True
+    if os.environ.get("CCT_OBS_LOG_COMPILES"):
+        # recompile forensics (e.g. chasing a shape leak under the serve
+        # autotuner's learned table): every first-sighting, to stderr
+        print(f"obs: new dispatch signature {signature!r}",
+              file=sys.stderr, flush=True)
+    return True
 
 
 def recompiles() -> int:
@@ -233,6 +266,8 @@ def reset_for_tests() -> None:
         _labeled_counts.clear()
         _labeled_hists.clear()
         _seen_tenants.clear()
+        _transfer_bytes["h2d"] = 0
+        _transfer_bytes["d2h"] = 0
 
 
 # ------------------------------------------------------- Prometheus text

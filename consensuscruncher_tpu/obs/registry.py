@@ -30,6 +30,14 @@ COUNTERS = {
     "evicted_jobs": "terminal jobs evicted from the in-memory registry",
     "journal_bytes": "bytes appended to the write-ahead journal",
     "recompiles": "distinct device-dispatch shapes compiled this process",
+    "bytes_h2d": "host->device bytes actually dispatched (measured, not "
+                 "estimated; counted at every jnp.asarray upload site)",
+    "bytes_d2h": "device->host bytes actually fetched (measured at every "
+                 "np.asarray download site)",
+    "resident_pair_votes": "duplex votes served from the device-resident "
+                           "SSCS plane store (no plane re-upload)",
+    "staged_pair_votes": "duplex votes that re-uploaded planes from host "
+                         "BAM bytes (store miss, empty, or broken)",
 }
 
 CUMULATIVE_KEYS = tuple(COUNTERS)

@@ -77,19 +77,17 @@ from consensuscruncher_tpu.utils.phred import N, NUM_BASES
 _MAX_BT = 128  # batch rows per grid step (largest pow2 tile that divides B)
 
 
-def _vote_kernel(sizes_ref, bases_ref, quals_ref, out_b_ref, out_q_ref,
-                 counts_ref, firsts_ref, qsums_ref, *, fam_cap, num, den,
-                 qual_threshold, qual_cap):
-    j = pl.program_id(1)
-    bt = out_b_ref.shape[0]
+def _init_vote_state(counts_ref, firsts_ref, qsums_ref, fam_cap):
+    counts_ref[:] = jnp.zeros_like(counts_ref)
+    firsts_ref[:] = jnp.full_like(firsts_ref, fam_cap)
+    qsums_ref[:] = jnp.zeros_like(qsums_ref)
 
-    @pl.when(j == 0)
-    def _init():
-        counts_ref[:] = jnp.zeros_like(counts_ref)
-        firsts_ref[:] = jnp.full_like(firsts_ref, fam_cap)
-        qsums_ref[:] = jnp.zeros_like(qsums_ref)
 
-    fam_sizes = sizes_ref[:]  # (Bt, 1) int32
+def _accumulate_member(j, bt, fam_sizes, bases_ref, quals_ref,
+                       counts_ref, firsts_ref, qsums_ref, *,
+                       fam_cap, qual_threshold):
+    """Fold member ``j``'s (Bt, L) plane into the vote state (shared by the
+    plain and fused kernels — the state layout is the contract)."""
     # Widen uint8 -> int32 BEFORE any comparison: i1 vectors born from 8-bit
     # compares hit a Mosaic relayout bug on v5e ("Invalid relayout ... i1").
     base_j = bases_ref[0].astype(jnp.int32)  # (Bt, L) — member j of each family
@@ -108,31 +106,106 @@ def _vote_kernel(sizes_ref, bases_ref, quals_ref, out_b_ref, out_q_ref,
         agree = (base_j == b) & qual_ok & row_valid
         qsums_ref[sl] += jnp.where(agree, qual_j, 0)
 
+
+def _finalize_vote(bt, fam_sizes, counts_ref, firsts_ref, qsums_ref, *,
+                   fam_cap, num, den, qual_cap):
+    """Vote state -> (modal-or-N, capped qual) int32 planes."""
+    counts = [counts_ref[b * bt : (b + 1) * bt] for b in range(NUM_BASES)]
+    firsts = [firsts_ref[b * bt : (b + 1) * bt] for b in range(NUM_BASES)]
+    max_count = counts[0]
+    for b in range(1, NUM_BASES):
+        max_count = jnp.maximum(max_count, counts[b])
+    # Lexicographic tie-break: among bases hitting max_count, earliest
+    # first-seen wins (CPython Counter insertion order); unrolled 5-lane
+    # argmin (Mosaic only lowers float argmin).
+    best_first = jnp.where(counts[0] == max_count, firsts[0], fam_cap + 1)
+    modal = jnp.zeros_like(max_count)
+    for b in range(1, NUM_BASES):
+        cand = jnp.where(counts[b] == max_count, firsts[b], fam_cap + 1)
+        better = cand < best_first
+        best_first = jnp.where(better, cand, best_first)
+        modal = jnp.where(better, b, modal)
+
+    qsum = jnp.zeros_like(max_count)
+    for b in range(NUM_BASES):
+        qsum = jnp.where(modal == b, qsums_ref[b * bt : (b + 1) * bt], qsum)
+
+    passed = (modal != N) & (max_count * den >= num * fam_sizes) & (fam_sizes > 0)
+    vote_b = jnp.where(passed, modal, N)
+    vote_q = jnp.where(passed, jnp.minimum(qsum, qual_cap), 0)
+    return vote_b, vote_q
+
+
+def _vote_kernel(sizes_ref, bases_ref, quals_ref, out_b_ref, out_q_ref,
+                 counts_ref, firsts_ref, qsums_ref, *, fam_cap, num, den,
+                 qual_threshold, qual_cap):
+    j = pl.program_id(1)
+    bt = out_b_ref.shape[0]
+
+    @pl.when(j == 0)
+    def _init():
+        _init_vote_state(counts_ref, firsts_ref, qsums_ref, fam_cap)
+
+    fam_sizes = sizes_ref[:]  # (Bt, 1) int32
+    _accumulate_member(j, bt, fam_sizes, bases_ref, quals_ref,
+                       counts_ref, firsts_ref, qsums_ref,
+                       fam_cap=fam_cap, qual_threshold=qual_threshold)
+
     @pl.when(j == fam_cap - 1)
     def _finalize():
-        counts = [counts_ref[b * bt : (b + 1) * bt] for b in range(NUM_BASES)]
-        firsts = [firsts_ref[b * bt : (b + 1) * bt] for b in range(NUM_BASES)]
-        max_count = counts[0]
-        for b in range(1, NUM_BASES):
-            max_count = jnp.maximum(max_count, counts[b])
-        # Lexicographic tie-break: among bases hitting max_count, earliest
-        # first-seen wins (CPython Counter insertion order); unrolled 5-lane
-        # argmin (Mosaic only lowers float argmin).
-        best_first = jnp.where(counts[0] == max_count, firsts[0], fam_cap + 1)
-        modal = jnp.zeros_like(max_count)
-        for b in range(1, NUM_BASES):
-            cand = jnp.where(counts[b] == max_count, firsts[b], fam_cap + 1)
-            better = cand < best_first
-            best_first = jnp.where(better, cand, best_first)
-            modal = jnp.where(better, b, modal)
+        vote_b, vote_q = _finalize_vote(
+            bt, fam_sizes, counts_ref, firsts_ref, qsums_ref,
+            fam_cap=fam_cap, num=num, den=den, qual_cap=qual_cap)
+        out_b_ref[:] = vote_b.astype(jnp.uint8)
+        out_q_ref[:] = vote_q.astype(jnp.uint8)
 
-        qsum = jnp.zeros_like(max_count)
-        for b in range(NUM_BASES):
-            qsum = jnp.where(modal == b, qsums_ref[b * bt : (b + 1) * bt], qsum)
 
-        passed = (modal != N) & (max_count * den >= num * fam_sizes) & (fam_sizes > 0)
-        out_b_ref[:] = jnp.where(passed, modal, N).astype(jnp.uint8)
-        out_q_ref[:] = jnp.where(passed, jnp.minimum(qsum, qual_cap), 0).astype(jnp.uint8)
+def _fused_duplex_kernel(sizes_a_ref, sizes_b_ref,
+                         bases_a_ref, quals_a_ref, bases_b_ref, quals_b_ref,
+                         sscs_ab_ref, sscs_aq_ref, sscs_bb_ref, sscs_bq_ref,
+                         dcs_b_ref, dcs_q_ref,
+                         ca_ref, fa_ref, qa_ref, cb_ref, fb_ref, qb_ref, *,
+                         fam_cap, num, den, qual_threshold, qual_cap):
+    """Fused SSCS vote + duplex combine: both strands' member streams vote
+    in one grid sweep and the duplex agree-or-N combine happens at finalize
+    while all six planes are still in VMEM — one kernel launch where the
+    staged chain pays three (vote a, vote b, duplex), and the intermediate
+    SSCS planes never round-trip through HBM before the duplex read."""
+    j = pl.program_id(1)
+    bt = dcs_b_ref.shape[0]
+
+    @pl.when(j == 0)
+    def _init():
+        _init_vote_state(ca_ref, fa_ref, qa_ref, fam_cap)
+        _init_vote_state(cb_ref, fb_ref, qb_ref, fam_cap)
+
+    sizes_a = sizes_a_ref[:]  # (Bt, 1) int32
+    sizes_b = sizes_b_ref[:]
+    _accumulate_member(j, bt, sizes_a, bases_a_ref, quals_a_ref,
+                       ca_ref, fa_ref, qa_ref,
+                       fam_cap=fam_cap, qual_threshold=qual_threshold)
+    _accumulate_member(j, bt, sizes_b, bases_b_ref, quals_b_ref,
+                       cb_ref, fb_ref, qb_ref,
+                       fam_cap=fam_cap, qual_threshold=qual_threshold)
+
+    @pl.when(j == fam_cap - 1)
+    def _finalize():
+        va_b, va_q = _finalize_vote(bt, sizes_a, ca_ref, fa_ref, qa_ref,
+                                    fam_cap=fam_cap, num=num, den=den,
+                                    qual_cap=qual_cap)
+        vb_b, vb_q = _finalize_vote(bt, sizes_b, cb_ref, fb_ref, qb_ref,
+                                    fam_cap=fam_cap, num=num, den=den,
+                                    qual_cap=qual_cap)
+        sscs_ab_ref[:] = va_b.astype(jnp.uint8)
+        sscs_aq_ref[:] = va_q.astype(jnp.uint8)
+        sscs_bb_ref[:] = vb_b.astype(jnp.uint8)
+        sscs_bq_ref[:] = vb_q.astype(jnp.uint8)
+        # Pinned duplex formula (ops.duplex_tpu.duplex_vote): agreement on a
+        # real base keeps it with summed-capped quality, anything else is N.
+        agree = (va_b == vb_b) & (va_b < N)
+        dcs_b_ref[:] = jnp.where(agree, va_b, N).astype(jnp.uint8)
+        dcs_q_ref[:] = jnp.where(
+            agree, jnp.minimum(va_q + vb_q, qual_cap), 0).astype(jnp.uint8)
 
 
 def _pick_bt(batch: int) -> int:
@@ -177,6 +250,52 @@ def _compiled_pallas(batch, fam_cap, length, num, den, qual_threshold, qual_cap,
     return jax.jit(fn)
 
 
+@lru_cache(maxsize=None)
+def _compiled_fused(batch, fam_cap, length, num, den, qual_threshold,
+                    qual_cap, interpret):
+    bt = _pick_bt(batch)
+    kernel = partial(
+        _fused_duplex_kernel, fam_cap=fam_cap, num=num, den=den,
+        qual_threshold=qual_threshold, qual_cap=qual_cap,
+    )
+    fn = pl.pallas_call(
+        kernel,
+        grid=(batch // bt, fam_cap),
+        in_specs=[
+            pl.BlockSpec((bt, 1), lambda i, j: (i, 0)),
+            pl.BlockSpec((bt, 1), lambda i, j: (i, 0)),
+            pl.BlockSpec((1, bt, length), lambda i, j: (j, i, 0)),
+            pl.BlockSpec((1, bt, length), lambda i, j: (j, i, 0)),
+            pl.BlockSpec((1, bt, length), lambda i, j: (j, i, 0)),
+            pl.BlockSpec((1, bt, length), lambda i, j: (j, i, 0)),
+        ],
+        out_specs=[pl.BlockSpec((bt, length), lambda i, j: (i, 0))
+                   for _ in range(6)],
+        out_shape=[jax.ShapeDtypeStruct((batch, length), jnp.uint8)
+                   for _ in range(6)],
+        scratch_shapes=[
+            pltpu.VMEM((NUM_BASES * bt, length), jnp.int32)
+            for _ in range(6)  # counts/firsts/qsums per strand
+        ],
+        interpret=interpret,
+    )
+    return jax.jit(fn)
+
+
+def _prep_family_major(bases, quals, fam_sizes, pad, fam_cap, length):
+    """Pad the batch axis and transpose to the kernel's family-major layout."""
+    bases = np.asarray(bases, dtype=np.uint8)
+    quals = np.asarray(quals, dtype=np.uint8)
+    sizes = np.asarray(fam_sizes, dtype=np.int32)
+    if pad:
+        bases = np.concatenate([bases, np.zeros((pad, fam_cap, length), np.uint8)])
+        quals = np.concatenate([quals, np.zeros((pad, fam_cap, length), np.uint8)])
+        sizes = np.concatenate([sizes, np.zeros(pad, np.int32)])
+    fb = np.ascontiguousarray(bases.transpose(1, 0, 2))
+    fq = np.ascontiguousarray(quals.transpose(1, 0, 2))
+    return fb, fq, sizes
+
+
 def consensus_batch_pallas(
     bases,
     quals,
@@ -189,11 +308,12 @@ def consensus_batch_pallas(
     ``interpret=None`` auto-selects: real kernel on TPU backends, Pallas
     interpreter elsewhere (CPU test meshes), keeping call sites portable.
     """
+    from consensuscruncher_tpu.obs import metrics as obs_metrics
+
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     bases = np.asarray(bases, dtype=np.uint8)
     quals = np.asarray(quals, dtype=np.uint8)
-    sizes = np.asarray(fam_sizes, dtype=np.int32)
     batch, fam_cap, length = bases.shape
     num, den = config.cutoff_rational
     if fam_cap * max(num, den) >= 2**31:
@@ -204,17 +324,16 @@ def consensus_batch_pallas(
     # transpose would cost the extra HBM round trip the kernel exists to
     # avoid); np.ascontiguousarray pays one memcpy on the host instead.
     pad = (-batch) % 8 if batch >= 8 else 0
-    if pad:
-        bases = np.concatenate([bases, np.zeros((pad, fam_cap, length), np.uint8)])
-        quals = np.concatenate([quals, np.zeros((pad, fam_cap, length), np.uint8)])
-        sizes = np.concatenate([sizes, np.zeros(pad, np.int32)])
-    fb = np.ascontiguousarray(bases.transpose(1, 0, 2))
-    fq = np.ascontiguousarray(quals.transpose(1, 0, 2))
+    fb, fq, sizes = _prep_family_major(bases, quals, fam_sizes, pad, fam_cap, length)
 
     fn = _compiled_pallas(
         batch + pad, fam_cap, length, num, den,
         int(config.qual_threshold), int(config.qual_cap), bool(interpret),
     )
+    obs_metrics.note_compile(
+        ("pallas", batch + pad, fam_cap, length, num, den,
+         int(config.qual_threshold), int(config.qual_cap)))
+    obs_metrics.note_transfer("h2d", fb.nbytes + fq.nbytes + sizes.nbytes)
     out_b, out_q = fn(sizes.reshape(-1, 1), fb, fq)
     if pad:
         out_b, out_q = out_b[:batch], out_q[:batch]
@@ -224,5 +343,72 @@ def consensus_batch_pallas(
 def consensus_batch_pallas_host(bases, quals, fam_sizes,
                                 config: ConsensusConfig = ConsensusConfig(),
                                 interpret: bool | None = None):
+    from consensuscruncher_tpu.obs import metrics as obs_metrics
+
     b, q = consensus_batch_pallas(bases, quals, fam_sizes, config, interpret)
-    return np.asarray(b), np.asarray(q)
+    b, q = np.asarray(b), np.asarray(q)
+    obs_metrics.note_transfer("d2h", b.nbytes + q.nbytes)
+    return b, q
+
+
+def duplex_batch_pallas(
+    bases_a, quals_a, sizes_a,
+    bases_b, quals_b, sizes_b,
+    config: ConsensusConfig = ConsensusConfig(),
+    interpret: bool | None = None,
+):
+    """Fused SSCS vote + duplex combine over two strand member batches.
+
+    Inputs are two ``(B, F, L)`` member batches (the strand pairs aligned on
+    the batch axis).  Returns six still-on-device ``(B, L)`` uint8 planes:
+    ``(sscs_a_b, sscs_a_q, sscs_b_b, sscs_b_q, dcs_b, dcs_q)`` — the two
+    per-strand SSCS consensus planes (identical to
+    :func:`consensus_batch_pallas` of each strand) plus their duplex
+    combine (identical to ``ops.duplex_tpu.duplex_vote`` of those planes,
+    with ``qual_cap`` shared).  Parity pinned by tests/test_pallas.py.
+    """
+    from consensuscruncher_tpu.obs import metrics as obs_metrics
+
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    bases_a = np.asarray(bases_a, dtype=np.uint8)
+    bases_b = np.asarray(bases_b, dtype=np.uint8)
+    if bases_a.shape != bases_b.shape:
+        raise ValueError(
+            f"strand batches must share a shape, got {bases_a.shape} vs {bases_b.shape}")
+    batch, fam_cap, length = bases_a.shape
+    num, den = config.cutoff_rational
+    if fam_cap * max(num, den) >= 2**31:
+        raise ValueError("cutoff cross-multiply would overflow int32 — split the family bucket")
+
+    pad = (-batch) % 8 if batch >= 8 else 0
+    fba, fqa, sa = _prep_family_major(bases_a, quals_a, sizes_a, pad, fam_cap, length)
+    fbb, fqb, sb = _prep_family_major(bases_b, quals_b, sizes_b, pad, fam_cap, length)
+
+    fn = _compiled_fused(
+        batch + pad, fam_cap, length, num, den,
+        int(config.qual_threshold), int(config.qual_cap), bool(interpret),
+    )
+    obs_metrics.note_compile(
+        ("pallas_fused", batch + pad, fam_cap, length, num, den,
+         int(config.qual_threshold), int(config.qual_cap)))
+    obs_metrics.note_transfer(
+        "h2d", fba.nbytes + fqa.nbytes + sa.nbytes
+        + fbb.nbytes + fqb.nbytes + sb.nbytes)
+    outs = fn(sa.reshape(-1, 1), sb.reshape(-1, 1), fba, fqa, fbb, fqb)
+    if pad:
+        outs = tuple(o[:batch] for o in outs)
+    return tuple(outs)
+
+
+def duplex_batch_pallas_host(bases_a, quals_a, sizes_a,
+                             bases_b, quals_b, sizes_b,
+                             config: ConsensusConfig = ConsensusConfig(),
+                             interpret: bool | None = None):
+    from consensuscruncher_tpu.obs import metrics as obs_metrics
+
+    outs = duplex_batch_pallas(bases_a, quals_a, sizes_a,
+                               bases_b, quals_b, sizes_b, config, interpret)
+    outs = tuple(np.asarray(o) for o in outs)
+    obs_metrics.note_transfer("d2h", sum(o.nbytes for o in outs))
+    return outs

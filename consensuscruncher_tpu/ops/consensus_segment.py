@@ -335,6 +335,8 @@ def run_duplex_pipelined(rows, qrows, sizes_a, sizes_b, codebook4,
 
     def dispatch(batch):
         _i0, _i1, packed, sizes = batch
+        obs_metrics.note_transfer(
+            "h2d", packed.nbytes + sizes.nbytes + np.asarray(codebook4).nbytes)
         # explicit h2d at the dispatch boundary (CCT_SANITIZE transfer guard)
         return step(jnp.asarray(packed), jnp.asarray(sizes),
                     jnp.asarray(codebook4))
@@ -350,6 +352,7 @@ def run_duplex_pipelined(rows, qrows, sizes_a, sizes_b, codebook4,
     def fetch(batch, handle):
         i0, i1, _packed, _sizes = batch
         pk, qa, qb, st = (np.asarray(x) for x in handle)
+        obs_metrics.note_transfer("d2h", pk.nbytes + qa.nbytes + qb.nbytes + st.nbytes)
         k = i1 - i0
         sa, qa_, sb, qb_, dcs, dq = derive_host_outputs(
             pk[:k], qa[:k], qb[:k], sizes_a[i0:i1], sizes_b[i0:i1], config
@@ -401,6 +404,11 @@ def _stream_vote_fn(wire: str, num, den, qual_threshold, qual_cap,
             from consensuscruncher_tpu.ops.packing import unpack_device
 
             bases, quals = unpack_device(a, b)
+        elif wire == "pack6":
+            from consensuscruncher_tpu.ops.packing import unpack6_device
+
+            # split wire is 3/4 byte per position, buckets multiple of 4
+            bases, quals = unpack6_device(a, b, a.shape[-1] // 3 * 4)
         else:  # pack4 — length buckets are multiples of 32, so 2*packed width
             bases, quals = unpack4_device(a, b, 2 * a.shape[-1])
         if member_cap is not None:
@@ -446,7 +454,9 @@ def encode_member_batch(batch):
     """Host-side wire encode of a ``parallel.batching.MemberBatch``.
 
     Picks the densest wire the batch admits — pack4 (pure-ACGT live bases,
-    ≤4 distinct live quals), pack8 (≤16 distinct live quals), else raw —
+    ≤4 distinct live quals), pack6 (pure-ACGT, 5..16 distinct quals: 2-bit
+    bases + 4-bit qual indices, 0.75 B/position), pack8 (≤16 distinct
+    quals, Ns allowed), else raw —
     and rewrites dead cells (qual sentinel) to codebook-legal values (their
     content never reaches a live output; see MemberBatch docstring).
     Returns ``(wire, a, b, member_cap)`` ready for the jitted step.  Runs
@@ -496,6 +506,16 @@ def encode_member_batch(batch):
     if base_max < 4 and uniq.size <= CODEBOOK4_SIZE and uniq.size > 0:
         book = build_codebook4(uniq)
         return "pack4", packed_wire(book, True), book, member_cap
+    if base_max < 4 and uniq.size <= CODEBOOK_SIZE and uniq.size > 0:
+        # 6-bit split wire: ACGT-only but 5..16 distinct quals — 0.75 B per
+        # position where pack8 pays 1.0 (the measured-bytes_h2d win rides
+        # on this for unbinned-qual inputs)
+        from consensuscruncher_tpu.ops.packing import _qual_lut, pack6
+
+        book = build_codebook(uniq)
+        lut = _qual_lut(book)
+        lut[QUAL_FILL_SENTINEL] = 0  # dead cells -> slot 0, never read live
+        return "pack6", pack6(rows, qrows, book, qual_lut=lut), book, member_cap
     if uniq.size <= CODEBOOK_SIZE:
         book = build_codebook(uniq if uniq.size else np.zeros(1, np.uint8))
         return "pack8", packed_wire(book, False), book, member_cap
@@ -505,7 +525,7 @@ def encode_member_batch(batch):
 
 def _run_member_batch_stream(batches, config: ConsensusConfig,
                              prefetch_depth: int | None, batched: bool = False,
-                             mesh=None):
+                             mesh=None, on_device_batch=None):
     """Shared streaming harness: MemberBatch iterable -> consensus results.
 
     Wire-encodes each batch on the prefetch producer thread, keeps one batch
@@ -520,6 +540,14 @@ def _run_member_batch_stream(batches, config: ConsensusConfig,
     ``mesh``: a ``jax.sharding.Mesh`` to family-shard each batch over
     (``parallel.mesh`` stream sharding — same wire bytes, whole families
     per device, no collectives); None = single device.
+
+    ``on_device_batch``: optional ``(MemberBatch, device_handle)`` callback
+    fired at dispatch time with the still-on-device stacked ``(2, NF, L)``
+    result plane — the residency capture point (``ops.residency`` keeps the
+    handle so DCS/rescue can gather it without a host round trip).  Only
+    fired on the single-device path: the mesh path's rows come back in
+    per-device block order, not slot order, so its handles are not directly
+    addressable by row.
     """
     from consensuscruncher_tpu.parallel.prefetch import DEFAULT_DEPTH, pipelined, prefetch
 
@@ -552,13 +580,22 @@ def _run_member_batch_stream(batches, config: ConsensusConfig,
                                            out_len)
             fn = _compiled_stream_vote(wire, num, den, qt, qc, member_cap,
                                        out_len)
+            obs_metrics.note_transfer(
+                "h2d", np.asarray(a).nbytes + np.asarray(b).nbytes
+                + np.asarray(batch.sizes).nbytes)
             # explicit h2d at the dispatch boundary (CCT_SANITIZE transfer
             # guard)
             return fn(jnp.asarray(a), jnp.asarray(b), jnp.asarray(batch.sizes))
 
+    capture = None
+    if on_device_batch is not None and mesh is None:
+        def capture(item, handle):
+            on_device_batch(item[0], handle)
+
     def fetch(item, handle):
         batch = item[0]
         out = np.asarray(handle)
+        obs_metrics.note_transfer("d2h", out.nbytes)
         if mesh is not None:
             from consensuscruncher_tpu.parallel.mesh import plan_member_shards
 
@@ -577,12 +614,15 @@ def _run_member_batch_stream(batches, config: ConsensusConfig,
 
     if prefetch_depth <= 0:
         for item in encoded():
-            yield from fetch(item, dispatch(item))
+            handle = dispatch(item)
+            if capture is not None:
+                capture(item, handle)
+            yield from fetch(item, handle)
         return
 
     stream = prefetch(encoded(), depth=prefetch_depth)
     try:
-        yield from pipelined(stream, dispatch, fetch)
+        yield from pipelined(stream, dispatch, fetch, on_dispatch=capture)
     finally:
         stream.close()
 
@@ -637,18 +677,21 @@ def consensus_blocks_stream_batched(
     member_limit: int = 32768,
     prefetch_depth: int | None = None,
     mesh=None,
+    on_device_batch=None,
 ):
     """Batch-granular twin of :func:`consensus_blocks_stream`: yields one
     ``(keys, lengths, out_bases, out_quals)`` tuple per device batch so the
     consumer can emit records with array passes instead of a per-family
     loop.  Same vote program, bit-identical consensus bytes.  ``mesh``
     family-shards each device batch (``parallel.mesh``; wire bytes
-    unchanged, no collectives)."""
+    unchanged, no collectives).  ``on_device_batch`` is the residency
+    capture hook (see :func:`_run_member_batch_stream`)."""
     from consensuscruncher_tpu.parallel.batching import bucket_member_blocks
 
     yield from _run_member_batch_stream(
         bucket_member_blocks(items, max_batch=max_batch, member_limit=member_limit),
         config, prefetch_depth, batched=True, mesh=mesh,
+        on_device_batch=on_device_batch,
     )
 
 

@@ -101,6 +101,26 @@ def _consensus_one_family(bases, quals, fam_size, *, num, den, qual_threshold, q
     return out_base, out_qual
 
 
+# Per-shape kernel selection hook, installed by the occupancy autotuner
+# (``serve.warmup.BucketAutotuner.install``).  Receives the padded
+# ``(B, F, L)`` shape and returns "pallas" to route this bucket through
+# ``ops.consensus_pallas`` (bit-identical by the parity tests), anything
+# else (or None) to keep the dense XLA path.  Module-level because the
+# choice must apply to every call site (stages, serve gangs, bench)
+# without threading a parameter through all of them.
+_kernel_policy = None
+
+
+def set_kernel_policy(policy) -> None:
+    """Install (or clear, with ``None``) the per-shape kernel chooser."""
+    global _kernel_policy
+    _kernel_policy = policy
+
+
+def get_kernel_policy():
+    return _kernel_policy
+
+
 @lru_cache(maxsize=None)
 def _compiled_batch_fn(num: int, den: int, qual_threshold: int, qual_cap: int):
     """One jitted vmapped program per consensus config (shapes specialize
@@ -129,12 +149,18 @@ def consensus_batch(
     arrays; dummy slots come back all-N/0.
     """
     num, den = config.cutoff_rational
-    fn = _compiled_batch_fn(num, den, int(config.qual_threshold), int(config.qual_cap))
     b = np.asarray(bases)
+    if _kernel_policy is not None and _kernel_policy(b.shape) == "pallas":
+        from consensuscruncher_tpu.ops.consensus_pallas import consensus_batch_pallas
+
+        return consensus_batch_pallas(b, quals, fam_sizes, config)
+    fn = _compiled_batch_fn(num, den, int(config.qual_threshold), int(config.qual_cap))
     # XLA's jit cache keys on (static config, padded shape): first sighting
     # of this signature in the process is a compile
     obs_metrics.note_compile(
         (num, den, int(config.qual_threshold), int(config.qual_cap)) + b.shape)
+    obs_metrics.note_transfer(
+        "h2d", b.nbytes + np.asarray(quals).nbytes + np.asarray(fam_sizes, dtype=np.int32).nbytes)
     return fn(
         jnp.asarray(b, dtype=jnp.uint8),
         jnp.asarray(quals, dtype=jnp.uint8),
@@ -219,6 +245,7 @@ def consensus_families(
 
     def fetch(batch, handle):
         out_b, out_q = (np.asarray(x) for x in handle)
+        obs_metrics.note_transfer("d2h", out_b.nbytes + out_q.nbytes)
         for i, key in enumerate(batch.keys):
             length = int(batch.lengths[i])
             yield key, out_b[i, :length], out_q[i, :length]

@@ -48,21 +48,58 @@ def _compiled(qual_cap: int):
     return jax.jit(fn)
 
 
+def _next_pow2(n: int) -> int:
+    return 1 << max(0, (n - 1).bit_length())
+
+
 def duplex_batch(seq1, qual1, seq2, qual2, qual_cap: int = DEFAULT_QUAL_CAP):
-    """Batched duplex vote: four ``(B, L)`` uint8 arrays -> two ``(B, L)``
-    (returned as one stacked ``(2, B, L)`` device array)."""
+    """Batched duplex vote: four ``(B, L)`` uint8 arrays -> one stacked
+    ``(2, Bp, Lp)`` device array at the BUCKETED shape (padded cells zero;
+    callers slice ``[:, :B, :L]`` host-side — ``duplex_batch_host`` does).
+
+    The dispatch shape is bucketed before upload — batch axis padded to the
+    next power of two, length axis to the batching layer's 32-quantum — so
+    ragged flush sizes (DCS pair blocks, rescue rounds) share a handful of
+    jit specializations instead of minting one per count, and the shapes
+    line up with the autotune table's warmed buckets.  The same bound
+    ``singleton_tpu.pairwise_hamming`` applies, policed by the same obs
+    recompile counter.  The vote is elementwise, so live cells are
+    bit-identical either way; unpadding stays on the host because an eager
+    device slice would smuggle its start indices h2d past the sanitizer's
+    transfer guard.
+    """
+    from consensuscruncher_tpu.parallel.batching import len_bucket
+
+    b = int(np.shape(seq1)[0]) if np.ndim(seq1) else 0
+    l = int(np.shape(seq1)[1]) if np.ndim(seq1) > 1 else 0
+    bp, lp = _next_pow2(b), len_bucket(l)
+    if (bp, lp) != (b, l):
+        arrs = []
+        for x in (seq1, qual1, seq2, qual2):
+            x = np.asarray(x, dtype=np.uint8)
+            p = np.zeros((bp, lp) + x.shape[2:], np.uint8)
+            p[:b, :l] = x
+            arrs.append(p)
+        seq1, qual1, seq2, qual2 = arrs
     fn = _compiled(int(qual_cap))
     obs_metrics.note_compile(("duplex", int(qual_cap)) + np.shape(seq1))
+    obs_metrics.note_transfer(
+        "h2d", sum(int(np.prod(np.shape(x), dtype=np.int64)) for x in (seq1, qual1, seq2, qual2)))
     with obs_trace.span("device.dispatch", histogram="device_dispatch_s",
-                        n_real=int(np.shape(seq1)[0]) if np.ndim(seq1) else 0):
-        return fn(
+                        n_real=b):
+        out = fn(
             jnp.asarray(seq1, dtype=jnp.uint8),
             jnp.asarray(qual1, dtype=jnp.uint8),
             jnp.asarray(seq2, dtype=jnp.uint8),
             jnp.asarray(qual2, dtype=jnp.uint8),
         )
+    return out
 
 
 def duplex_batch_host(seq1, qual1, seq2, qual2, qual_cap: int = DEFAULT_QUAL_CAP):
+    b = int(np.shape(seq1)[0]) if np.ndim(seq1) else 0
+    l = int(np.shape(seq1)[1]) if np.ndim(seq1) > 1 else 0
     out = np.asarray(duplex_batch(seq1, qual1, seq2, qual2, qual_cap))
+    obs_metrics.note_transfer("d2h", out.nbytes)
+    out = out[:, :b, :l]
     return out[0], out[1]

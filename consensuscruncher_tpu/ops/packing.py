@@ -203,3 +203,99 @@ def unpack4_device(packed, codebook4, length: int):
     bases = nib & 3
     quals = jnp.take(codebook4.astype(jnp.uint8), (nib >> 2).astype(jnp.int32))
     return bases, quals
+
+
+# ---------------------------------------------------------------------------
+# 6-bit split mode: 2-bit bases (four positions per byte) next to 4-bit
+# qual-codebook indices (two positions per byte), concatenated on the last
+# axis into one (..., 3L/4) uint8 wire.
+#
+# Covers the gap between pack4 and pack8: ACGT-only reads whose quals need
+# more than 4 but at most 16 distinct values (unbinned HiSeq subsets,
+# simulator output) — 0.75 bytes per member-position where pack8 pays 1.0.
+# Same dead-slot contract as the other packed wires: encode dead cells as
+# (base 0, codebook slot 0); the vote masks by fam_size and callers slice
+# by true length, so their decoded value never reaches an output.
+# ---------------------------------------------------------------------------
+
+
+def pack6(bases: np.ndarray, quals: np.ndarray, codebook: np.ndarray,
+          qual_lut: np.ndarray | None = None) -> np.ndarray:
+    """Pack to the 6-bit split wire along the last axis.
+
+    Returns uint8 of shape ``(..., 3 * ceil(L/4))``: the 2-bit-packed bases
+    block followed by the 4-bit-packed qual-index block.  Lengths are padded
+    to a multiple of 4 with zero cells (decoded as base A / codebook slot 0
+    — callers slice by true length).  ``qual_lut`` overrides the
+    codebook-derived qual->index LUT (e.g. to fold a fill sentinel to slot
+    0 without a full-batch rewrite).
+    """
+    bases = np.asarray(bases, dtype=np.uint8)
+    quals = np.asarray(quals, dtype=np.uint8)
+    if bases.max(initial=0) > 3:
+        raise ValueError("6-bit mode requires pure-ACGT bases")
+    idx = (_qual_lut(codebook) if qual_lut is None else qual_lut)[quals]
+    if idx.max(initial=0) >= CODEBOOK_SIZE:
+        raise ValueError("quals not in codebook — rebuild with build_codebook")
+    pad = (-bases.shape[-1]) % 4
+    if pad:
+        zeros = np.zeros(bases.shape[:-1] + (pad,), np.uint8)
+        bases = np.concatenate([bases, zeros], axis=-1)
+        idx = np.concatenate([idx, zeros], axis=-1)
+    b2 = (bases[..., 0::4] | (bases[..., 1::4] << 2)
+          | (bases[..., 2::4] << 4) | (bases[..., 3::4] << 6))
+    q4 = (idx[..., 0::2] | (idx[..., 1::2] << 4)).astype(np.uint8)
+    return np.concatenate([b2.astype(np.uint8), q4], axis=-1)
+
+
+def unpack6_host(packed: np.ndarray, codebook: np.ndarray, length: int):
+    """Host-side inverse of :func:`pack6` (tests / debugging)."""
+    packed = np.asarray(packed, dtype=np.uint8)
+    w = packed.shape[-1] // 3
+    b2, q4 = packed[..., :w], packed[..., w:]
+    bases = np.empty(packed.shape[:-1] + (4 * w,), np.uint8)
+    for k in range(4):
+        bases[..., k::4] = (b2 >> (2 * k)) & 3
+    idx = np.empty(packed.shape[:-1] + (4 * w,), np.uint8)
+    idx[..., 0::2] = q4 & 0xF
+    idx[..., 1::2] = q4 >> 4
+    book = np.asarray(codebook, dtype=np.uint8)
+    return bases[..., :length], book[idx[..., :length]]
+
+
+def unpack6_device(packed, codebook, length: int):
+    """Traceable device-side inverse of :func:`pack6`.
+
+    ``length`` is static (the true position count before pad-to-4).
+    """
+    packed = packed.astype(jnp.uint8)
+    w = packed.shape[-1] // 3
+    b2, q4 = packed[..., :w], packed[..., w:]
+    bases = jnp.stack([(b2 >> (2 * k)) & 3 for k in range(4)], axis=-1)
+    bases = bases.reshape(packed.shape[:-1] + (4 * w,))[..., :length]
+    idx = jnp.stack([q4 & 0xF, q4 >> 4], axis=-1)
+    idx = idx.reshape(packed.shape[:-1] + (4 * w,))[..., :length]
+    quals = jnp.take(codebook.astype(jnp.uint8), idx.astype(jnp.int32))
+    return bases, quals
+
+
+# ---------------------------------------------------------------------------
+# Device residency: the packed family stream goes UP once per job; this is
+# the API that keeps the resulting consensus planes DOWN there for the rest
+# of the consensus phase (SSCS vote output -> rescue -> DCS without the
+# intermediate d2h/h2d round trips).  Implementation in ops.residency; this
+# factory is the wire-format module's entry point because what the store
+# holds is wire-layout consensus planes.
+# ---------------------------------------------------------------------------
+
+
+def resident_planes(qual_cap: int = 60):
+    """Create a per-job :class:`ops.residency.ResidentPlanes` store.
+
+    Thread it through ``run_sscs(residency=...)`` (capture),
+    ``run_singleton_correction(residency=...)`` and ``run_dcs(residency=...)``
+    (device-side gathers).  ``qual_cap`` must match the stage's duplex cap.
+    """
+    from consensuscruncher_tpu.ops.residency import ResidentPlanes
+
+    return ResidentPlanes(qual_cap=qual_cap)

@@ -1,0 +1,254 @@
+"""Device-resident consensus planes: keep SSCS vote output on device.
+
+ROADMAP item 3 / the h2d tentpole: the staged pipeline writes SSCS
+consensus to a BAM, then singleton rescue and DCS re-read those bytes and
+re-UPLOAD them for their duplex votes — so every consensus plane crosses
+the host<->device link three times.  This module keeps the still-on-device
+``(2, NF, L)`` result planes the SSCS stream vote produced (captured at
+dispatch time via ``parallel.prefetch.pipelined``'s ``on_dispatch`` hook,
+before anything is drained), indexes them by SSCS qname + record flag
+(R1/R2 records share the family qname), and serves the
+downstream duplex votes as device-side gathers:
+
+- DCS pairing uploads two int32 index vectors (~8 bytes/pair) instead of
+  four ``(k, L)`` uint8 planes (~4L bytes/pair);
+- singleton rescue uploads only the singleton half and gathers the SSCS
+  partner from the store — and registers its own (still-on-device) rescue
+  output under the singleton qname so the later DCS pass hits it too.
+
+Byte parity is by construction: the resident rows hold exactly the
+consensus codes/quals the SSCS BAM records were written from, and the
+gather+vote program is the same pinned ``ops.duplex_tpu.duplex_vote``
+formula the staged path jits — the parity suite pins it anyway.
+
+Failure contract (``ops.residency`` fault site): ANY device failure while
+appending/consolidating/gathering marks the store broken and clears it;
+every entry point then returns ``None``/misses and callers fall back to
+the staged path (re-upload from host BAM bytes) — degraded throughput,
+identical bytes.  A ``--resume`` that skips SSCS simply never fills the
+store, which is the same miss-everything fallback.
+
+CPU backend runs never construct a store (`stages/` gate on
+``backend == "tpu"``), so the numpy path is untouched.
+"""
+
+from __future__ import annotations
+
+import sys
+from functools import lru_cache
+
+import numpy as np
+
+from consensuscruncher_tpu.obs import metrics as obs_metrics
+from consensuscruncher_tpu.ops.duplex_tpu import duplex_vote
+from consensuscruncher_tpu.utils import faults
+
+
+def _next_pow2(n: int) -> int:
+    return 1 if n <= 1 else 1 << (n - 1).bit_length()
+
+
+@lru_cache(maxsize=None)
+def _compiled_pair_gather(qual_cap: int):
+    """planes (2, N, L), idx1/idx2 (k,) -> stacked (2, k, L) duplex vote."""
+    import jax
+    import jax.numpy as jnp
+
+    def fn(planes, idx1, idx2):
+        b1 = jnp.take(planes[0], idx1, axis=0)
+        q1 = jnp.take(planes[1], idx1, axis=0)
+        b2 = jnp.take(planes[0], idx2, axis=0)
+        q2 = jnp.take(planes[1], idx2, axis=0)
+        ob, oq = duplex_vote(b1, q1, b2, q2, qual_cap=qual_cap)
+        return jnp.stack([ob, oq])
+
+    return jax.jit(fn)
+
+
+@lru_cache(maxsize=None)
+def _compiled_against_gather(qual_cap: int):
+    """s1/q1 (k, L) uploaded halves + resident partner rows idx2 (k,)."""
+    import jax
+    import jax.numpy as jnp
+
+    def fn(planes, s1, q1, idx2):
+        b2 = jnp.take(planes[0], idx2, axis=0)
+        q2 = jnp.take(planes[1], idx2, axis=0)
+        ob, oq = duplex_vote(s1.astype(jnp.uint8), q1, b2, q2, qual_cap=qual_cap)
+        return jnp.stack([ob, oq])
+
+    return jax.jit(fn)
+
+
+class ResidentPlanes:
+    """Per-job device store of SSCS consensus planes keyed by qname.
+
+    Single-threaded use per pipeline run (the stage loops are serial);
+    captures happen on the stage loop thread via the dispatch hook.
+    """
+
+    def __init__(self, qual_cap: int = 60):
+        self.qual_cap = int(qual_cap)
+        self.broken = False
+        self._chunks: list = []          # device arrays, each (2, n, Lpad)
+        self._index: dict[bytes, tuple[int, int, int]] = {}  # qname -> (chunk, row, length)
+        self._planes = None              # consolidated (2, N, Lmax) device array
+        self._offsets: list[int] = []    # chunk -> row offset in _planes
+
+    # ------------------------------------------------------------ capture
+
+    def _fail(self, exc: BaseException) -> None:
+        print(f"WARNING: device-resident consensus store lost ({exc}); "
+              "falling back to the staged path", file=sys.stderr, flush=True)
+        self.broken = True
+        self._chunks = []
+        self._index = {}
+        self._planes = None
+        self._offsets = []
+
+    def append(self, qnames: list[bytes], lengths, handle, n_real: int) -> None:
+        """Register one device batch: ``handle`` is the still-on-device
+        stacked ``(2, NF_cap, Lpad)`` plane; rows ``0..n_real-1`` belong to
+        ``qnames``/``lengths`` in order (the dispatch FIFO contract)."""
+        if self.broken:
+            return
+        try:
+            faults.fault_point("ops.residency")
+            chunk_id = len(self._chunks)
+            self._chunks.append(handle[:, :n_real])  # lazy device slice
+            self._planes = None
+            for i, qn in enumerate(qnames):
+                self._index[bytes(qn)] = (chunk_id, i, int(lengths[i]))
+        except Exception as exc:
+            self._fail(exc)
+
+    @property
+    def families(self) -> int:
+        return len(self._index)
+
+    # ------------------------------------------------------------- lookup
+
+    def _consolidate(self):
+        """Pad all chunks to a common width and concat into one (2, N, Lmax)
+        device array (one-time per append epoch; gathers index into it)."""
+        import jax.numpy as jnp
+
+        if self._planes is None:
+            if not self._chunks:
+                return None
+            lmax = max(int(c.shape[2]) for c in self._chunks)
+            padded = [
+                c if int(c.shape[2]) == lmax
+                else jnp.pad(c, ((0, 0), (0, 0), (0, lmax - int(c.shape[2]))))
+                for c in self._chunks
+            ]
+            planes = jnp.concatenate(padded, axis=1)
+            # pow2-pad the row axis: the gather jits specialize on the
+            # store shape, and every family-count would otherwise mint its
+            # own compile (obs recompile counter polices this bound too)
+            rows = int(planes.shape[1])
+            rows_p = _next_pow2(rows)
+            if rows_p != rows:
+                planes = jnp.pad(planes, ((0, 0), (0, rows_p - rows), (0, 0)))
+            self._planes = planes
+            self._offsets = []
+            off = 0
+            for c in self._chunks:
+                self._offsets.append(off)
+                off += int(c.shape[1])
+        return self._planes
+
+    def rows_for(self, qnames, length: int) -> np.ndarray | None:
+        """Flat resident row index per qname, -1 on miss (absent or stored
+        at a different length — a length-L vote must read length-L rows).
+        None when the store is empty/broken (callers go fully staged)."""
+        if self.broken or not self._index:
+            return None
+        out = np.full(len(qnames), -1, dtype=np.int32)
+        if self._consolidate() is None:
+            return None
+        for i, qn in enumerate(qnames):
+            ent = self._index.get(bytes(qn))
+            if ent is not None and ent[2] == int(length):
+                out[i] = self._offsets[ent[0]] + ent[1]
+        return out
+
+    # -------------------------------------------------------------- votes
+
+    def duplex_pairs(self, idx1: np.ndarray, idx2: np.ndarray, length: int,
+                     qual_cap: int | None = None):
+        """Duplex vote of resident row pairs; h2d is the two index vectors
+        only.  Returns host ``(out_b, out_q)`` sliced to ``length``, or
+        None on device failure (store marked broken).  ``qual_cap``
+        overrides the store default so each caller votes with exactly the
+        cap its staged path would use."""
+        if self.broken:
+            return None
+        try:
+            import jax.numpy as jnp
+
+            qc = self.qual_cap if qual_cap is None else int(qual_cap)
+            planes = self._consolidate()
+            if planes is None:
+                return None
+            k = len(idx1)
+            kp = _next_pow2(k)  # bound jit specializations per pair count
+            i1 = np.zeros(kp, np.int32)
+            i2 = np.zeros(kp, np.int32)
+            i1[:k], i2[:k] = idx1, idx2
+            fn = _compiled_pair_gather(qc)
+            obs_metrics.note_compile(
+                ("resident_pairs", qc, kp) + tuple(planes.shape))
+            obs_metrics.note_transfer("h2d", i1.nbytes + i2.nbytes)
+            out = np.asarray(fn(planes, jnp.asarray(i1), jnp.asarray(i2)))
+            obs_metrics.note_transfer("d2h", out.nbytes)
+            return out[0, :k, :length], out[1, :k, :length]
+        except Exception as exc:
+            self._fail(exc)
+            return None
+
+    def duplex_against(self, s1: np.ndarray, q1: np.ndarray, idx2: np.ndarray,
+                       length: int, register_qnames=None,
+                       qual_cap: int | None = None):
+        """Duplex vote of uploaded halves against resident partner rows
+        (the rescue shape: singleton read vs resident SSCS).  Uploads only
+        the ``(k, L)`` singleton half.  ``register_qnames`` keeps the
+        still-on-device output planes resident under those qnames so the
+        later DCS pass can gather the rescued records too.  Returns host
+        ``(out_b, out_q)`` sliced to ``length`` or None on failure."""
+        if self.broken:
+            return None
+        try:
+            import jax.numpy as jnp
+
+            qc = self.qual_cap if qual_cap is None else int(qual_cap)
+            planes = self._consolidate()
+            if planes is None:
+                return None
+            lmax = int(planes.shape[2])
+            k = len(idx2)
+            kp = _next_pow2(k)
+            s1p = np.zeros((kp, lmax), np.uint8)
+            q1p = np.zeros((kp, lmax), np.uint8)
+            s1p[:k, :length] = s1[:, :length]
+            q1p[:k, :length] = q1[:, :length]
+            i2 = np.zeros(kp, np.int32)
+            i2[:k] = idx2
+            fn = _compiled_against_gather(qc)
+            obs_metrics.note_compile(
+                ("resident_against", qc, kp) + tuple(planes.shape))
+            obs_metrics.note_transfer("h2d", s1p.nbytes + q1p.nbytes + i2.nbytes)
+            handle = fn(planes, jnp.asarray(s1p), jnp.asarray(q1p), jnp.asarray(i2))
+            if register_qnames is not None:
+                self.append(register_qnames, [length] * k, handle, k)
+            out = np.asarray(handle)
+            obs_metrics.note_transfer("d2h", out.nbytes)
+            return out[0, :k, :length], out[1, :k, :length]
+        except Exception as exc:
+            self._fail(exc)
+            return None
+
+    @property
+    def nbytes_resident(self) -> int:
+        """Approximate device bytes held by the store (chunk planes)."""
+        return sum(2 * int(c.shape[1]) * int(c.shape[2]) for c in self._chunks)

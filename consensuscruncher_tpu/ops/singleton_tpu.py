@@ -23,6 +23,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from consensuscruncher_tpu.obs import metrics as obs_metrics
+
 
 def _next_pow2(n: int) -> int:
     return 1 if n <= 1 else 1 << (n - 1).bit_length()
@@ -78,8 +80,14 @@ def pairwise_hamming(
                     pb[: tb.shape[0]] = tb
                 else:
                     pb = tb
-                block = np.asarray(fn(jnp.asarray(pa), jnp.asarray(pb)))
-                block = block[: ta.shape[0], : tb.shape[0]]
+                # The pow2 padding above is what bounds the jit cache; the
+                # signature mirrors it so the obs recompile counter can
+                # assert the bound (ragged pool sizes must NOT mint shapes).
+                obs_metrics.note_compile(("hamming", pn, pm, ta.shape[1]))
+                obs_metrics.note_transfer("h2d", pa.nbytes + pb.nbytes)
+                raw = np.asarray(fn(jnp.asarray(pa), jnp.asarray(pb)))
+                obs_metrics.note_transfer("d2h", raw.nbytes)
+                block = raw[: ta.shape[0], : tb.shape[0]]
             else:
                 block = (ta[:, None, :] != tb[None, :, :]).sum(axis=-1, dtype=np.int32)
             out[i : i + tile, j : j + tile] = block

@@ -29,9 +29,36 @@ from typing import Iterable, Iterator, Sequence
 
 import numpy as np
 
+import threading
+
 from consensuscruncher_tpu.obs import metrics as obs_metrics
 from consensuscruncher_tpu.utils.phred import N, PAD
 from consensuscruncher_tpu.utils.ragged import fill_runs, scatter_runs
+
+# ------------------------------------------------- live bucket-shape mix
+#
+# Every emitted device batch records its padded (B, F, L) bucket here —
+# the raw material for the occupancy autotuner (``serve.warmup``): the
+# batching layer owns shape *data*, the serve layer owns shape *policy*.
+# Member-stream batches record F as the pow2 gather capacity their vote
+# would use, so one recorder serves both wires.
+
+_shape_lock = threading.Lock()
+_shape_counts: Counter = Counter()
+
+
+def record_bucket_shape(b: int, f: int, l: int) -> None:
+    with _shape_lock:
+        _shape_counts[(int(b), int(f), int(l))] += 1
+
+
+def bucket_shape_counts(reset: bool = False) -> dict[tuple[int, int, int], int]:
+    """Snapshot (optionally draining) the live ``{(B, F, L): count}`` mix."""
+    with _shape_lock:
+        out = dict(_shape_counts)
+        if reset:
+            _shape_counts.clear()
+    return out
 
 LEN_QUANTUM = 32
 MIN_BATCH = 8
@@ -214,6 +241,7 @@ def _emit_members(bucket: _MemberBucket, lb: int) -> MemberBatch:
     n = len(bucket.keys)
     cap = max(MIN_BATCH, next_pow2(n))
     obs_metrics.observe("batch_occupancy", n / cap)
+    record_bucket_shape(cap, next_pow2(max(bucket.sizes, default=1)), lb)
     m = bucket.members
     m_pad = max(MEMBER_QUANTUM, -(-m // MEMBER_QUANTUM) * MEMBER_QUANTUM)
     rows = np.zeros((m_pad, lb), dtype=np.uint8)
@@ -240,6 +268,7 @@ def _emit(bucket: _Bucket, fb: int, lb: int, pad_to: int | None) -> FamilyBatch:
     # padding waste at the source: every emitted device batch observes its
     # real/capacity ratio exactly once (here, not per dispatch wrapper)
     obs_metrics.observe("batch_occupancy", n / cap)
+    record_bucket_shape(cap, fb, lb)
     bases = np.full((cap, fb, lb), PAD, dtype=np.uint8)
     quals = np.zeros((cap, fb, lb), dtype=np.uint8)
     bases[:n] = np.stack(bucket.bases)
@@ -289,6 +318,8 @@ def bucket_member_blocks(
         n = len(bucket.keys)
         cap = max(MIN_BATCH, next_pow2(n))
         obs_metrics.observe("batch_occupancy", n / cap)
+        sz_max = max((int(s.max(initial=1)) for s in bucket.sizes), default=1)
+        record_bucket_shape(cap, next_pow2(sz_max), lb)
         m = bucket.members
         m_pad = max(MEMBER_QUANTUM, -(-m // MEMBER_QUANTUM) * MEMBER_QUANTUM)
         rows = np.zeros((m_pad, lb), dtype=np.uint8)

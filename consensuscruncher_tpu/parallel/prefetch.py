@@ -154,6 +154,7 @@ def pipelined(
     batches: Iterable[T],
     dispatch: Callable[[T], object],
     fetch: Callable[[T, object], Iterable],
+    on_dispatch: Callable[[T, object], None] | None = None,
 ) -> Iterator:
     """One-batch-in-flight software pipeline over ``batches``.
 
@@ -162,10 +163,19 @@ def pipelined(
     ``fetch(prev_batch, prev_handle)`` results are yielded — so the device
     is always working on one batch ahead of the host-side drain.  Ordering
     across batches is preserved.
+
+    ``on_dispatch(batch, handle)`` fires right after each dispatch, before
+    any fetch — the point where the device handle exists but nothing has
+    been drained.  ``ops.residency`` hooks here to keep a reference to the
+    still-on-device result plane (FIFO order = batch order, so the capture
+    sequence matches the yielded result sequence exactly).  Must be cheap
+    and must not block on device results.
     """
     inflight: tuple[T, object] | None = None
     for batch in batches:
         handle = dispatch(batch)
+        if on_dispatch is not None:
+            on_dispatch(batch, handle)
         if inflight is not None:
             yield from fetch(*inflight)
         inflight = (batch, handle)

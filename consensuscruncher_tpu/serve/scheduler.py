@@ -438,6 +438,9 @@ class Scheduler:
             else max(1, int(tenant_inflight_cap))
         self.slo = SloMonitor(targets=self.slo_targets)
         self.counters = Counters()
+        # optional callable set by serve_cmd: surfaces the bucket
+        # autotuner's state (table size, unexpected recompiles) in /metrics
+        self.autotune_info = None
         self._cond = sanitize.tracked_condition("scheduler.cond")
         # one FIFO per qos class; stride state drives weighted-fair picks
         self._queues: dict[str, deque[Job]] = \
@@ -852,6 +855,11 @@ class Scheduler:
             doc["histograms"] = obs_metrics.histograms_snapshot()
             doc["labeled"] = obs_metrics.labeled_snapshot()
             doc["slo"] = self.slo.snapshot()
+            if self.autotune_info is not None:
+                try:
+                    doc["autotune"] = self.autotune_info()
+                except Exception:
+                    pass  # telemetry must never take down /metrics
             doc["queued_by_class"] = \
                 {qos: len(self._queues[qos]) for qos in QOS_CLASSES}
             doc["class_weights"] = dict(self.class_weights)

@@ -99,3 +99,308 @@ def warm_shapes(shapes, config=None, budget_s: float | None = None) -> int:
             print(f"WARNING: warmup shape {b}x{f}x{l} failed ({e}); skipping",
                   file=sys.stderr, flush=True)
     return done
+
+
+# ---------------------------------------------------------------------------
+# Occupancy-driven bucket autotuning (ROADMAP item 3 follow-through).
+#
+# The warmup shape list above is static configuration; the autotuner makes
+# it EARNED: ``parallel.batching`` records every (B, F, L) bucket the live
+# job mix actually dispatches, the learn loop folds those counts into a
+# JSON table persisted next to the compile cache (atomic publish via
+# ``utils.manifest.commit_file``), and on the next daemon start the table
+# doubles as the warmup shape source — so a warmed daemon sees ZERO
+# unexpected recompiles under its steady-state mix (policed by the
+# ``recompiles`` obs counter; tools/ci_check.sh asserts it in the loadgen
+# smoke).  Per shape the tuner also decides dense-XLA vs the Pallas vote
+# kernel by measuring both on real silicon; off-TPU the Pallas interpreter
+# is not a meaningful timer, so the CPU-fallback row picks dense and says
+# why (the row is still emitted — CPU runs keep the full table schema).
+# ---------------------------------------------------------------------------
+
+DEFAULT_TABLE_NAME = "autotune_table.json"
+_TABLE_VERSION = 1
+
+
+def load_autotune_config(config_path) -> dict:
+    """Parse the ``[autotune]`` block of a config.ini (missing file or
+    section -> all defaults).  Keys: ``table`` (bucket table path),
+    ``learn_window`` (seconds between live learn passes), ``backend``
+    (``auto`` | ``dense`` | ``pallas`` override)."""
+    import configparser
+
+    out = {"table_path": None, "learn_window": 30.0, "backend": "auto"}
+    if not config_path or not os.path.exists(config_path):
+        return out
+    cp = configparser.ConfigParser()
+    try:
+        cp.read(config_path)
+    except configparser.Error as e:
+        print(f"WARNING: config {config_path} unreadable for [autotune] ({e}); "
+              "using defaults", file=sys.stderr, flush=True)
+        return out
+    if not cp.has_section("autotune"):
+        return out
+    sec = cp["autotune"]
+    out["table_path"] = sec.get("table", fallback=None) or None
+    out["learn_window"] = sec.getfloat("learn_window", fallback=30.0)
+    out["backend"] = (sec.get("backend", fallback="auto") or "auto").strip().lower()
+    return out
+
+
+class BucketAutotuner:
+    """Learned (B, F, L) bucket table: shape occupancy + per-shape kernel
+    choice, persisted as JSON and installable as the consensus kernel
+    policy (``ops.consensus_tpu.set_kernel_policy``)."""
+
+    def __init__(self, table_path: str | None = None,
+                 learn_window: float = 30.0, backend: str = "auto"):
+        if backend not in ("auto", "dense", "pallas"):
+            raise ValueError(
+                f"[autotune] backend must be auto|dense|pallas, got {backend!r}")
+        import threading
+
+        self.table_path = table_path
+        self.learn_window = max(1.0, float(learn_window))
+        self.backend = backend
+        self.table: dict[str, dict] = {}  # "BxFxL" -> entry
+        self._lock = threading.Lock()
+        self._recompiles_baseline: int | None = None
+
+    @staticmethod
+    def _key(shape) -> str:
+        return "x".join(str(int(d)) for d in shape)
+
+    @staticmethod
+    def _shape(key: str) -> tuple[int, int, int]:
+        b, f, l = (int(d) for d in key.split("x"))
+        return (b, f, l)
+
+    # ------------------------------------------------------------ persist
+
+    def load(self) -> bool:
+        if not self.table_path:
+            return False
+        try:
+            import json
+
+            with open(self.table_path) as fh:
+                doc = json.load(fh)
+            if doc.get("version") != _TABLE_VERSION:
+                return False
+            with self._lock:
+                self.table = dict(doc.get("shapes", {}))
+            return True
+        except (OSError, ValueError):
+            return False
+
+    def save(self) -> bool:
+        if not self.table_path:
+            return False
+        import json
+
+        from consensuscruncher_tpu.utils.manifest import commit_file
+
+        with self._lock:
+            doc = {"version": _TABLE_VERSION, "shapes": dict(self.table)}
+        tmp = self.table_path + ".tmp"
+        os.makedirs(os.path.dirname(os.path.abspath(self.table_path)),
+                    exist_ok=True)
+        with open(tmp, "w") as fh:
+            json.dump(doc, fh, indent=2, sort_keys=True)
+        commit_file(tmp, self.table_path)
+        return True
+
+    # -------------------------------------------------------------- learn
+
+    def learn_from_live(self) -> list[tuple[int, int, int]]:
+        """Fold the batching layer's live shape counts into the table.
+        Returns shapes seen live that have no kernel decision yet."""
+        from consensuscruncher_tpu.parallel import batching
+
+        counts = batching.bucket_shape_counts(reset=True)
+        fresh = []
+        with self._lock:
+            for shape, n in counts.items():
+                key = self._key(shape)
+                ent = self.table.setdefault(key, {"count": 0, "backend": None})
+                ent["count"] = int(ent.get("count", 0)) + int(n)
+                if ent.get("backend") is None:
+                    fresh.append(self._shape(key))
+        return fresh
+
+    # ------------------------------------------------------------ measure
+
+    def measure(self, shape, config=None, reps: int = 3) -> dict:
+        """Time dense-XLA vs Pallas at one (B, F, L) bucket and record the
+        winner.  Off-TPU the Pallas interpreter can't be timed meaningfully
+        -> dense with reason ``cpu_fallback`` (row still emitted)."""
+        import jax
+
+        from consensuscruncher_tpu.ops.consensus_tpu import (
+            ConsensusConfig, consensus_batch_host,
+        )
+
+        b, f, l = (int(d) for d in shape)
+        if config is None:
+            config = ConsensusConfig()
+        rng = np.random.default_rng(0)
+        bases = rng.integers(0, 5, (b, f, l), dtype=np.uint8)
+        quals = rng.integers(0, 41, (b, f, l), dtype=np.uint8)
+        sizes = rng.integers(1, f + 1, b).astype(np.int32)
+
+        def best_of(fn):
+            fn()  # compile + warm outside the timed reps
+            times = []
+            for _ in range(max(1, reps)):
+                t0 = time.perf_counter()
+                fn()
+                times.append(time.perf_counter() - t0)
+            return min(times)
+
+        entry: dict = {}
+        entry["dense_s"] = best_of(
+            lambda: consensus_batch_host(bases, quals, sizes, config))
+        if jax.default_backend() == "tpu":
+            from consensuscruncher_tpu.ops.consensus_pallas import (
+                consensus_batch_pallas_host,
+            )
+
+            entry["pallas_s"] = best_of(
+                lambda: consensus_batch_pallas_host(bases, quals, sizes, config))
+            entry["backend"] = (
+                "pallas" if entry["pallas_s"] < entry["dense_s"] else "dense")
+        else:
+            entry["pallas_s"] = None
+            entry["backend"] = "dense"
+            entry["reason"] = "cpu_fallback"
+        with self._lock:
+            ent = self.table.setdefault(self._key(shape), {"count": 0})
+            ent.update(entry)
+            return dict(ent)
+
+    def tune(self, shapes=None, budget_s: float | None = None,
+             config=None) -> int:
+        """Measure every undecided table shape (or ``shapes``); returns how
+        many were measured.  A failed measurement records a dense fallback
+        so the shape is never re-measured in a hot loop."""
+        if shapes is None:
+            with self._lock:
+                shapes = [self._shape(k) for k, e in self.table.items()
+                          if e.get("backend") is None]
+        done = 0
+        t0 = time.monotonic()
+        for shape in shapes:
+            if budget_s is not None and time.monotonic() - t0 >= budget_s:
+                break
+            try:
+                self.measure(shape, config=config)
+                done += 1
+            except Exception as e:
+                print(f"WARNING: autotune measure {shape} failed ({e}); "
+                      "recording dense fallback", file=sys.stderr, flush=True)
+                with self._lock:
+                    self.table.setdefault(
+                        self._key(shape), {"count": 0}).update(
+                        {"backend": "dense", "reason": f"measure_failed: {e}"})
+        return done
+
+    # -------------------------------------------------------------- apply
+
+    def choose_backend(self, shape) -> str:
+        if self.backend != "auto":
+            return self.backend
+        with self._lock:
+            ent = self.table.get(self._key(shape))
+        return (ent or {}).get("backend") or "dense"
+
+    def policy(self, shape) -> str:
+        """``ops.consensus_tpu`` kernel-policy callable (only "pallas"
+        reroutes; anything else keeps the dense-XLA path)."""
+        return self.choose_backend(shape)
+
+    def install(self) -> None:
+        from consensuscruncher_tpu.ops import consensus_tpu
+
+        consensus_tpu.set_kernel_policy(self.policy)
+
+    def warmup_shapes(self, top: int = 16) -> list[tuple[int, int, int]]:
+        """Most-seen learned shapes, for :func:`warm_shapes` at startup."""
+        with self._lock:
+            items = sorted(self.table.items(),
+                           key=lambda kv: -int(kv[1].get("count", 0)))
+        return [self._shape(k) for k, _ in items[:top]]
+
+    def ladder_shapes(self, min_b: int = 8) -> list[tuple[int, int, int]]:
+        """The pow2-B sub-ladder of the learned buckets: continuous
+        batching dispatches the same (F, L) bucket at ANY pow2 batch count
+        up to the largest learned B (gang composition decides which), so a
+        daemon that wants zero steady-state recompiles warms them all."""
+        with self._lock:
+            shapes = [self._shape(k) for k in self.table]
+        out = set()
+        for b, f, l in shapes:
+            bb = max(1, min_b)
+            while bb <= b:
+                out.add((bb, f, l))
+                bb *= 2
+            out.add((b, f, l))
+        return sorted(out)
+
+    # -------------------------------------------------------------- police
+
+    def snapshot_recompiles(self) -> None:
+        """Mark the end of warmup: compiles after this point are
+        unexpected under the learned table."""
+        from consensuscruncher_tpu.obs import metrics as obs_metrics
+
+        self._recompiles_baseline = obs_metrics.recompiles()
+
+    def unexpected_recompiles(self) -> int | None:
+        from consensuscruncher_tpu.obs import metrics as obs_metrics
+
+        if self._recompiles_baseline is None:
+            return None
+        return obs_metrics.recompiles() - self._recompiles_baseline
+
+
+def warm_duplex_ladder(b_max: int, lengths, qual_cap: int = 60) -> int:
+    """Force-compile the pow2 duplex-vote ladder at each table length.
+    The vote is elementwise (compiles are cheap); warming the ladder is
+    what lets a served DCS flush of ANY pair count hit a warm kernel."""
+    from consensuscruncher_tpu.ops.duplex_tpu import duplex_batch
+
+    done = 0
+    for l in sorted({int(x) for x in lengths}):
+        b = 1
+        while b <= max(1, int(b_max)):
+            z = np.zeros((b, l), np.uint8)
+            duplex_batch(z, z, z, z, qual_cap).block_until_ready()
+            done += 1
+            b *= 2
+    return done
+
+
+def start_learn_loop(autotuner: BucketAutotuner, interval_s: float | None = None):
+    """Run ``learn_from_live`` + ``save`` on a daemon thread every
+    ``interval_s`` (default: the tuner's learn_window).  Returns the
+    thread; set its ``stop_event`` to end it deterministically."""
+    import threading
+
+    stop = threading.Event()
+    period = float(interval_s if interval_s is not None
+                   else autotuner.learn_window)
+
+    def loop():
+        while not stop.wait(period):
+            try:
+                autotuner.learn_from_live()
+                autotuner.save()
+            except Exception as e:
+                print(f"WARNING: autotune learn pass failed ({e})",
+                      file=sys.stderr, flush=True)
+
+    thread = threading.Thread(target=loop, daemon=True, name="cct-autotune")
+    thread.stop_event = stop
+    thread.start()
+    return thread

@@ -244,11 +244,41 @@ def _run_dcs_windows(reader, stats, unpaired_writer, rec_writer,
     batcher.flush()
 
 
+def _qname_bytes(sources, src_arr, row_arr, ps):
+    """Store key per selected row: qname bytes (no trailing NUL) + NUL +
+    little-endian record flag — the keys ``ops.residency`` indexes SSCS
+    consensus planes by.  The flag disambiguates the R1/R2 records that
+    share a family qname in the SSCS BAM; the capture side
+    (``stages.sscs_maker``) builds the identical key from the grouping
+    block's qname and template flag."""
+    out = [b""] * len(ps)
+    for si, batch in enumerate(sources):
+        m = np.nonzero(src_arr[ps] == si)[0]
+        if m.size == 0:
+            continue
+        rows = row_arr[ps[m]]
+        starts = batch.qname_start[rows]
+        lens = batch.l_qname[rows] - 1
+        flags = batch.flag[rows]
+        buf = batch.buf
+        for j, s, ln, fl in zip(m, starts, lens, flags):
+            out[int(j)] = (bytes(buf[int(s):int(s) + int(ln)])
+                           + b"\x00" + int(fl).to_bytes(2, "little"))
+    return out
+
+
 def _consume_pair_blocks(reader, stats, unpaired_writer, rec_writer,
-                         qual_cap: int, backend: str, mesh=None) -> None:
+                         qual_cap: int, backend: str, mesh=None,
+                         resident=None, cum=None) -> None:
     """Vectorized pairing (grouping.duplex_pair_blocks): unpaired reads pass
     through as raw blobs, pairs vote in one device batch per length group,
-    and duplex records assemble through the columnar record writer."""
+    and duplex records assemble through the columnar record writer.
+
+    ``resident``: an ``ops.residency.ResidentPlanes`` store filled by the
+    SSCS stage.  Pairs whose BOTH members are resident vote as a device-side
+    gather (h2d = two index vectors); the rest — and everything, when the
+    store is empty or broken — take the staged re-upload path.  Identical
+    bytes either way (pinned by tests/test_residency.py)."""
     from consensuscruncher_tpu.stages.grouping import duplex_pair_blocks
     from consensuscruncher_tpu.utils.ragged import gather_runs
 
@@ -341,10 +371,47 @@ def _consume_pair_blocks(reader, stats, unpaired_writer, rec_writer,
         for L in np.unique(lseqc):
             L = int(L)
             sel = lseqc == L
-            s1, q1 = member_rows(blk.pair_canon_src, blk.pair_canon_row, sel, L)
-            s2, q2 = member_rows(blk.pair_other_src, blk.pair_other_row, sel, L)
-            out_b, out_q = _duplex_vote_batch(s1, q1, s2, q2, qual_cap, backend, mesh)
             ps = np.nonzero(sel)[0]
+            out_b = out_q = None
+            if resident is not None and not resident.broken:
+                qn1 = _qname_bytes(blk.sources, blk.pair_canon_src,
+                                   blk.pair_canon_row, ps)
+                qn2 = _qname_bytes(blk.sources, blk.pair_other_src,
+                                   blk.pair_other_row, ps)
+                idx1 = resident.rows_for(qn1, L)
+                idx2 = resident.rows_for(qn2, L)
+                if idx1 is not None and idx2 is not None:
+                    hit = (idx1 >= 0) & (idx2 >= 0)
+                    if hit.any():
+                        res = resident.duplex_pairs(idx1[hit], idx2[hit], L,
+                                                    qual_cap=qual_cap)
+                        if res is not None:
+                            out_b = np.empty((len(ps), L), np.uint8)
+                            out_q = np.empty_like(out_b)
+                            out_b[hit], out_q[hit] = res
+                            if cum is not None:
+                                cum.add("resident_pair_votes", int(hit.sum()))
+                            if not hit.all():
+                                sel_miss = np.zeros_like(sel)
+                                sel_miss[ps[~hit]] = True
+                                s1, q1 = member_rows(blk.pair_canon_src,
+                                                     blk.pair_canon_row,
+                                                     sel_miss, L)
+                                s2, q2 = member_rows(blk.pair_other_src,
+                                                     blk.pair_other_row,
+                                                     sel_miss, L)
+                                mb, mq = _duplex_vote_batch(
+                                    s1, q1, s2, q2, qual_cap, backend, mesh)
+                                out_b[~hit], out_q[~hit] = mb, mq
+                                if cum is not None:
+                                    cum.add("staged_pair_votes",
+                                            int((~hit).sum()))
+            if out_b is None:
+                s1, q1 = member_rows(blk.pair_canon_src, blk.pair_canon_row, sel, L)
+                s2, q2 = member_rows(blk.pair_other_src, blk.pair_other_row, sel, L)
+                out_b, out_q = _duplex_vote_batch(s1, q1, s2, q2, qual_cap, backend, mesh)
+                if cum is not None:
+                    cum.add("staged_pair_votes", len(ps))
             k = len(ps)
             # modal cigar bytes per pair, gathered per source batch
             cig_lens = ncigc[ps]
@@ -388,9 +455,15 @@ def run_dcs(
     backend: str = "tpu",
     devices: int | None = None,
     level: int = 6,
+    residency=None,
 ) -> DcsResult:
     """``devices``: shard the duplex vote's pair axis across this many chips
-    (``parallel.mesh``); None/1 = single device.  tpu backend only."""
+    (``parallel.mesh``); None/1 = single device.  tpu backend only.
+
+    ``residency``: the SSCS stage's ``ops.packing.resident_planes()`` store;
+    pairs found resident vote on device without re-uploading their planes
+    (tentpole h2d saving).  Ignored on the windows fallback path (foreign
+    BAMs were never produced by this pipeline's SSCS stage)."""
     mesh = None
     if devices is not None and devices > 1:
         if backend != "tpu":
@@ -420,14 +493,19 @@ def run_dcs(
     unpaired_writer = SortingBamWriter(unpaired_path, reader.header, level=level)
     rec_writer = ConsensusRecordWriter(dcs_writer)
 
+    from consensuscruncher_tpu.utils.profiling import Counters
+
+    cum = Counters()
     recompiles_before = obs_metrics.recompiles()
+    transfers_before = obs_metrics.transfer_bytes()
     ok = False
     try:
         try:
             with sanitize.guarded_stage("dcs"), \
                     obs_trace.span("dcs.device_loop", wire="blocks"):
                 _consume_pair_blocks(
-                    reader, stats, unpaired_writer, rec_writer, qual_cap, backend, mesh
+                    reader, stats, unpaired_writer, rec_writer, qual_cap, backend, mesh,
+                    resident=residency, cum=cum,
                 )
         except ValueError as e:
             if "foreign tag layout" not in str(e):
@@ -466,11 +544,16 @@ def run_dcs(
     tracker.write(f"{out_prefix}.dcs.time_tracker.txt")
     from consensuscruncher_tpu.utils.profiling import write_metrics
 
+    cum.add("recompiles", obs_metrics.recompiles() - recompiles_before)
+    transfers = obs_metrics.transfer_bytes()
+    cum.add("bytes_h2d", transfers["h2d"] - transfers_before["h2d"])
+    cum.add("bytes_d2h", transfers["d2h"] - transfers_before["d2h"])
     write_metrics(
         f"{out_prefix}.dcs.metrics.json", "DCS", tracker.as_phases(),
         {"backend": backend, "jax_backend": stats.get("jax_backend"),
          "pairs": stats.get("pairs"), "sscs_total": stats.get("sscs_total"),
          "recompiles": obs_metrics.recompiles() - recompiles_before},
+        cumulative=cum.snapshot(),
     )
     return DcsResult(dcs_path, unpaired_path, stats)
 

@@ -128,11 +128,19 @@ def _hamming_partner(tag, candidates: dict, max_mismatch: int, device: bool):
     return pool[idx] if idx >= 0 else None
 
 
-def _run_rescue_blocks(singleton_bam, sscs_bam, writers, stats, backend) -> None:
+def _run_rescue_blocks(singleton_bam, sscs_bam, writers, stats, backend,
+                       resident=None, cum=None) -> None:
     """Vectorized exact-match rescue: RescueBlock decisions -> batched duplex
     votes -> columnar record rebuild (original record + new seq/qual +
     appended XR tag).  Byte-parity with the object walk is pinned by
     tests/test_singleton_vec.py.
+
+    ``resident``: the SSCS stage's device-resident plane store.  On the
+    singleton-vs-SSCS route the partner half is gathered on device instead
+    of re-uploaded, and the rescue OUTPUT planes are registered back into
+    the store under the singleton's qname so the later DCS pass gathers
+    rescued records too.  Misses/broken store fall back to the staged vote
+    — identical bytes either way.
 
     Contract: consumes this pipeline's own SSCS-stage outputs (XT/XF-led tag
     blocks, no preexisting XR tag) — foreign layouts raise and the caller
@@ -140,7 +148,7 @@ def _run_rescue_blocks(singleton_bam, sscs_bam, writers, stats, backend) -> None
     from consensuscruncher_tpu.core.consensus_cpu import DEFAULT_QUAL_CAP
     from consensuscruncher_tpu.io.columnar import ColumnarReader
     from consensuscruncher_tpu.io.encode import encode_records
-    from consensuscruncher_tpu.stages.dcs_maker import _duplex_vote_batch
+    from consensuscruncher_tpu.stages.dcs_maker import _duplex_vote_batch, _qname_bytes
     from consensuscruncher_tpu.stages.grouping import singleton_rescue_blocks
     from consensuscruncher_tpu.utils.ragged import gather_runs
 
@@ -229,12 +237,52 @@ def _run_rescue_blocks(singleton_bam, sscs_bam, writers, stats, backend) -> None
                 for L in np.unique(lseqc[rmask]):
                     L = int(L)
                     sel = rmask & (lseqc == L)
-                    s1m, q1m = member_mat(blk.rescue_src, blk.rescue_row, sel, L)
-                    s2m, q2m = member_mat(blk.partner_src, blk.partner_row, sel, L)
-                    out_b, out_q = _duplex_vote_batch(
-                        s1m, q1m, s2m, q2m, DEFAULT_QUAL_CAP, backend
-                    )
                     ps = np.nonzero(sel)[0]
+                    s1m, q1m = member_mat(blk.rescue_src, blk.rescue_row, sel, L)
+                    out_b = out_q = None
+                    if route == 0 and resident is not None and not resident.broken:
+                        # singleton-vs-SSCS: the partner IS an SSCS record —
+                        # gather its plane from the resident store instead
+                        # of re-uploading it from BAM bytes
+                        qn2 = _qname_bytes(blk.sources, blk.partner_src,
+                                           blk.partner_row, ps)
+                        idx2 = resident.rows_for(qn2, L)
+                        if idx2 is not None:
+                            hit = idx2 >= 0
+                            if hit.any():
+                                qn1 = _qname_bytes(blk.sources, blk.rescue_src,
+                                                   blk.rescue_row, ps[hit])
+                                res = resident.duplex_against(
+                                    s1m[hit], q1m[hit], idx2[hit], L,
+                                    register_qnames=qn1,
+                                    qual_cap=DEFAULT_QUAL_CAP)
+                                if res is not None:
+                                    out_b = np.empty_like(s1m)
+                                    out_q = np.empty_like(q1m)
+                                    out_b[hit], out_q[hit] = res
+                                    if cum is not None:
+                                        cum.add("resident_pair_votes",
+                                                int(hit.sum()))
+                                    if not hit.all():
+                                        sel_miss = np.zeros_like(sel)
+                                        sel_miss[ps[~hit]] = True
+                                        s2m, q2m = member_mat(
+                                            blk.partner_src, blk.partner_row,
+                                            sel_miss, L)
+                                        mb, mq = _duplex_vote_batch(
+                                            s1m[~hit], q1m[~hit], s2m, q2m,
+                                            DEFAULT_QUAL_CAP, backend)
+                                        out_b[~hit], out_q[~hit] = mb, mq
+                                        if cum is not None:
+                                            cum.add("staged_pair_votes",
+                                                    int((~hit).sum()))
+                    if out_b is None:
+                        s2m, q2m = member_mat(blk.partner_src, blk.partner_row, sel, L)
+                        out_b, out_q = _duplex_vote_batch(
+                            s1m, q1m, s2m, q2m, DEFAULT_QUAL_CAP, backend
+                        )
+                        if cum is not None:
+                            cum.add("staged_pair_votes", len(ps))
                     kk = len(ps)
                     # original qname / cigar / tag bytes, gathered per source
                     qn_start = np.empty(kk, np.int64)
@@ -312,9 +360,14 @@ def run_singleton_correction(
     backend: str = "tpu",
     _force_object: bool = False,
     level: int = 6,
+    residency=None,
 ) -> SingletonResult:
     """``backend="cpu"`` keeps the Hamming matcher in numpy — a cpu run
     must never touch (or wait on) a device backend.
+
+    ``residency``: the SSCS stage's ``ops.packing.resident_planes()`` store
+    (vectorized path only — the object walk never sees self-produced BAMs
+    at device scale).
 
     ``max_mismatch == 0`` (exact complementary-tag matching, the default)
     runs the vectorized RescueBlock path; ``max_mismatch > 0`` (and foreign
@@ -331,6 +384,12 @@ def run_singleton_correction(
 
     from consensuscruncher_tpu.io.columnar import SortingBamWriter
 
+    from consensuscruncher_tpu.obs import metrics as obs_metrics
+    from consensuscruncher_tpu.utils.profiling import Counters
+
+    cum = Counters()
+    recompiles_before = obs_metrics.recompiles()
+    transfers_before = obs_metrics.transfer_bytes()
     if max_mismatch == 0 and not _force_object:
         hdr_reader = BamReader(singleton_bam)
         header = hdr_reader.header
@@ -339,7 +398,8 @@ def run_singleton_correction(
         ok = False
         try:
             try:
-                _run_rescue_blocks(singleton_bam, sscs_bam, writers, stats, backend)
+                _run_rescue_blocks(singleton_bam, sscs_bam, writers, stats,
+                                   backend, resident=residency, cum=cum)
                 ok = True
             except ValueError as e:
                 if "foreign tag layout" not in str(e):
@@ -356,11 +416,16 @@ def run_singleton_correction(
             stats.write(all_paths["stats_txt"])
             tracker.mark("rescue")
             tracker.write(f"{out_prefix}.singleton.time_tracker.txt")
+            cum.add("recompiles", obs_metrics.recompiles() - recompiles_before)
+            transfers = obs_metrics.transfer_bytes()
+            cum.add("bytes_h2d", transfers["h2d"] - transfers_before["h2d"])
+            cum.add("bytes_d2h", transfers["d2h"] - transfers_before["d2h"])
             write_metrics(
                 f"{out_prefix}.singleton.metrics.json", "singleton_correction",
                 tracker.as_phases(),
                 {"backend": backend, "jax_backend": stats.get("jax_backend"),
                  "singletons": stats.get("singletons_total")},
+                cumulative=cum.snapshot(),
             )
             return SingletonResult(
                 paths["sscs_rescue"], paths["singleton_rescue"],

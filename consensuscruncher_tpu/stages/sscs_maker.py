@@ -196,6 +196,7 @@ def run_sscs(
     level: int = 6,
     input_range=None,
     prestaged: "PrestagedBlocks | None" = None,
+    residency=None,
 ) -> SscsResult:
     """``devices``: shard each family batch across this many chips
     (``parallel.mesh`` family-data-parallel path); None/1 = single device.
@@ -211,7 +212,14 @@ def run_sscs(
     ``prestaged``: an eagerly-started decode of THIS input from
     :func:`prestage_blocks` — the multi-sample batch overlap (sample N+1's
     columnar decode runs behind sample N's device compute).  Requires the
-    block path (tpu backend + stream wire); byte-identical outputs."""
+    block path (tpu backend + stream wire); byte-identical outputs.
+
+    ``residency``: an ``ops.packing.resident_planes()`` store; the block
+    path registers each device batch's still-on-device consensus plane in
+    it (keyed by SSCS qname) so the downstream rescue/DCS stages can vote
+    by device gather instead of re-uploading these bytes.  Ignored on
+    non-block paths (cpu/reference/dense/mesh — those fall back to staged
+    duplex votes downstream, byte-identical)."""
     if backend not in ("cpu", "tpu", "reference"):
         raise ValueError(
             f"unknown backend {backend!r} (expected 'cpu', 'tpu', or 'reference')"
@@ -239,6 +247,7 @@ def run_sscs(
     hist = FamilySizeHistogram()
     cum = Counters()
     recompiles_before = obs_metrics.recompiles()
+    transfers_before = obs_metrics.transfer_bytes()
     cfg = ConsensusConfig(cutoff=cutoff, qual_threshold=qual_threshold, qual_cap=qual_cap)
 
     paths = output_paths(out_prefix)
@@ -425,11 +434,35 @@ def run_sscs(
                     consensus_blocks_stream_batched,
                 )
 
+                on_device_batch = None
+                if residency is not None and mesh is None:
+                    def on_device_batch(batch, handle):
+                        # FIFO contract: handle rows 0..n_real-1 are the
+                        # batch's keys in order; the store key per (block, j)
+                        # is the grouping layer's consensus qname PLUS the
+                        # record flag — each family qname appears twice in
+                        # the SSCS BAM (R1 and R2 records), so the qname
+                        # alone would collide and serve the wrong strand's
+                        # plane.  Rescue/DCS build the same key from the BAM
+                        # record's qname and flag (stages.dcs_maker.
+                        # _qname_bytes).
+                        n = batch.n_real
+                        qnames = [
+                            bytes(k[0].qname_data[
+                                k[0].qname_off[k[1]]:k[0].qname_off[k[1] + 1]])
+                            + b"\x00" + int(
+                                k[0].tmpl_flag[k[1]] & _KEEP_FLAGS
+                            ).to_bytes(2, "little")
+                            for k in batch.keys
+                        ]
+                        residency.append(qnames, batch.lengths[:n], handle, n)
+
                 # 4x the dense batch size: the packed wire makes bytes cheap,
                 # and on a tunneled device per-dispatch roundtrip latency is
                 # the cost that's left — fewer, larger batches win.
                 stream = consensus_blocks_stream_batched(
-                    block_items(), cfg, max_batch=4 * max_batch, mesh=mesh
+                    block_items(), cfg, max_batch=4 * max_batch, mesh=mesh,
+                    on_device_batch=on_device_batch,
                 )
                 try:
                     with sanitize.guarded_stage("sscs"), \
@@ -512,6 +545,9 @@ def run_sscs(
     tracker.write(paths["time_tracker"])
     cum.add("families_out", stats.get("sscs_written"))
     cum.add("recompiles", obs_metrics.recompiles() - recompiles_before)
+    transfers = obs_metrics.transfer_bytes()
+    cum.add("bytes_h2d", transfers["h2d"] - transfers_before["h2d"])
+    cum.add("bytes_d2h", transfers["d2h"] - transfers_before["d2h"])
     write_metrics(
         f"{out_prefix}.metrics.json", "SSCS", tracker.as_phases(),
         {"backend": backend, "jax_backend": jax_backend,

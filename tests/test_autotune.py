@@ -1,0 +1,156 @@
+"""Occupancy-driven bucket autotuner (``serve.warmup.BucketAutotuner``).
+
+Pins the tentpole's serve-side contract: live (B, F, L) bucket counts are
+learned from the batching layer, measured into per-shape kernel choices
+(dense-XLA vs Pallas — off-TPU the row is still emitted, marked
+``cpu_fallback``), persisted atomically next to the compile cache, and
+installed as the consensus kernel policy.  The obs recompile counter
+polices "zero unexpected recompiles under the learned table".
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from consensuscruncher_tpu.obs import metrics as obs_metrics
+from consensuscruncher_tpu.ops import consensus_tpu
+from consensuscruncher_tpu.parallel import batching
+from consensuscruncher_tpu.serve import warmup
+
+
+@pytest.fixture(autouse=True)
+def _clean_policy_and_counts():
+    batching.bucket_shape_counts(reset=True)
+    yield
+    consensus_tpu.set_kernel_policy(None)
+    batching.bucket_shape_counts(reset=True)
+
+
+def test_config_defaults_and_parse(tmp_path):
+    assert warmup.load_autotune_config(None) == {
+        "table_path": None, "learn_window": 30.0, "backend": "auto"}
+    ini = tmp_path / "config.ini"
+    ini.write_text("[autotune]\ntable = /x/t.json\nlearn_window = 5\n"
+                   "backend = Dense\n")
+    assert warmup.load_autotune_config(str(ini)) == {
+        "table_path": "/x/t.json", "learn_window": 5.0, "backend": "dense"}
+    # a config without the section is not an error
+    (tmp_path / "bare.ini").write_text("[obs]\nmetrics = 1\n")
+    assert warmup.load_autotune_config(
+        str(tmp_path / "bare.ini"))["backend"] == "auto"
+
+
+def test_bad_backend_rejected():
+    with pytest.raises(ValueError):
+        warmup.BucketAutotuner(backend="mosaic")
+
+
+def test_learn_tune_save_load_roundtrip(tmp_path):
+    import jax
+
+    path = str(tmp_path / "cache" / warmup.DEFAULT_TABLE_NAME)
+    at = warmup.BucketAutotuner(table_path=path)
+    batching.record_bucket_shape(32, 8, 64)
+    batching.record_bucket_shape(32, 8, 64)
+    fresh = at.learn_from_live()
+    assert fresh == [(32, 8, 64)]
+    assert at.tune(fresh, budget_s=60.0) == 1
+    ent = at.table["32x8x64"]
+    assert ent["count"] == 2
+    assert ent["dense_s"] > 0
+    if jax.default_backend() != "tpu":
+        # the CPU-fallback row is still emitted — the acceptance criterion
+        # "occupancy row always present" rides on this
+        assert ent["backend"] == "dense"
+        assert ent["reason"] == "cpu_fallback"
+        assert ent["pallas_s"] is None
+    else:
+        assert ent["backend"] in ("dense", "pallas")
+    assert at.save()
+    # atomic persist: no .tmp litter, loadable by a fresh tuner
+    assert not (tmp_path / "cache" / (warmup.DEFAULT_TABLE_NAME + ".tmp")).exists()
+    at2 = warmup.BucketAutotuner(table_path=path)
+    assert at2.load()
+    assert at2.table == at.table
+    # a decided shape is not re-measured
+    assert at2.tune(budget_s=60.0) == 0
+
+
+def test_load_rejects_wrong_version_and_garbage(tmp_path):
+    path = tmp_path / "t.json"
+    path.write_text(json.dumps({"version": 999, "shapes": {"1x1x32": {}}}))
+    at = warmup.BucketAutotuner(table_path=str(path))
+    assert not at.load() and at.table == {}
+    path.write_text("{not json")
+    assert not at.load()
+    assert not warmup.BucketAutotuner(table_path=None).load()
+
+
+def test_tune_records_dense_fallback_on_measure_failure():
+    at = warmup.BucketAutotuner()
+
+    def boom(shape, config=None):
+        raise RuntimeError("synthetic OOM")
+
+    at.measure = boom  # instance attr shadows the method: forces the except path
+    assert at.tune([(4, 2, 32)]) == 0
+    ent = at.table["4x2x32"]
+    assert ent["backend"] == "dense"
+    assert ent["reason"].startswith("measure_failed")
+
+
+def test_choose_backend_table_and_override():
+    at = warmup.BucketAutotuner()
+    at.table["8x4x32"] = {"count": 1, "backend": "pallas"}
+    assert at.choose_backend((8, 4, 32)) == "pallas"
+    assert at.choose_backend((9, 9, 9)) == "dense"  # unknown shape
+    forced = warmup.BucketAutotuner(backend="pallas")
+    assert forced.choose_backend((9, 9, 9)) == "pallas"
+
+
+def test_install_reroutes_with_byte_parity():
+    """Installing a table that says "pallas" for one bucket must change
+    the route, not the bytes: consensus_batch_host output is identical."""
+    rng = np.random.default_rng(0)
+    bases = rng.integers(0, 5, (8, 4, 32), dtype=np.uint8)
+    quals = rng.integers(0, 41, (8, 4, 32), dtype=np.uint8)
+    sizes = rng.integers(1, 5, 8).astype(np.int32)
+    from consensuscruncher_tpu.ops.consensus_tpu import consensus_batch_host
+
+    want = consensus_batch_host(bases, quals, sizes)
+    at = warmup.BucketAutotuner()
+    at.table["8x4x32"] = {"count": 1, "backend": "pallas"}
+    at.install()
+    pol = consensus_tpu.get_kernel_policy()
+    assert pol((8, 4, 32)) == "pallas"
+    assert pol((1, 1, 32)) == "dense"
+    got = consensus_batch_host(bases, quals, sizes)
+    np.testing.assert_array_equal(got[0], want[0])
+    np.testing.assert_array_equal(got[1], want[1])
+
+
+def test_warmup_shapes_ranked_by_count():
+    at = warmup.BucketAutotuner()
+    at.table["8x4x32"] = {"count": 3, "backend": "dense"}
+    at.table["16x4x32"] = {"count": 9, "backend": "dense"}
+    at.table["8x8x64"] = {"count": 1, "backend": "dense"}
+    assert at.warmup_shapes(top=2) == [(16, 4, 32), (8, 4, 32)]
+
+
+def test_unexpected_recompiles_counter():
+    at = warmup.BucketAutotuner()
+    assert at.unexpected_recompiles() is None  # no baseline yet
+    at.snapshot_recompiles()
+    assert at.unexpected_recompiles() == 0
+    obs_metrics.note_compile(("autotune-test-sentinel", 7, 7, 7))
+    assert at.unexpected_recompiles() == 1
+
+
+def test_learn_loop_thread_stops():
+    at = warmup.BucketAutotuner(learn_window=3600.0)
+    t = warmup.start_learn_loop(at, interval_s=0.05)
+    assert t.daemon and t.name == "cct-autotune"
+    t.stop_event.set()
+    t.join(timeout=5.0)
+    assert not t.is_alive()

@@ -3,7 +3,8 @@
 The streaming SSCS production path (``ops.consensus_segment.
 consensus_families_stream``) ships families as a packed flat member stream
 instead of dense padded batches; these tests pin that every wire mode
-(pack4 / pack8 / raw), the gather-dense vote, and the segment fallback all
+(pack4 / pack6 / pack8 / raw), the gather-dense vote, and the segment
+fallback all
 reproduce the oracle bit-for-bit, and that the stage emits byte-identical
 BAMs over either wire.
 """
@@ -59,7 +60,8 @@ def assert_stream_matches_oracle(fams, cfg, **kw):
 WIRE_CASES = {
     # wire mode -> (base_hi, quals_pool)
     "pack4": (4, np.array([2, 12, 23, 37], np.uint8)),
-    "pack8": (5, np.arange(25, 41, dtype=np.uint8)),
+    "pack6": (4, np.arange(25, 41, dtype=np.uint8)),  # ACGT-only, 16 quals
+    "pack8": (5, np.arange(25, 41, dtype=np.uint8)),  # Ns force the byte wire
     "raw": (5, None),  # 42 distinct quals -> no codebook fits
 }
 

@@ -200,3 +200,36 @@ def test_pack4_native_odd_length_rejects_bad_qual():
     bad = np.array([[12, 23, 12, 23, 0], [12, 12, 12, 12, 12]], np.uint8)
     with pytest.raises(ValueError):
         pack4(bases, bad, book)
+
+
+def test_pack6_roundtrip_even_and_odd_lengths():
+    """6-bit split wire: 2-bit bases (4/byte) + 4-bit qual indices (2/byte)
+    -> 0.75 B per position, lossless for ACGT with a 16-entry codebook."""
+    from consensuscruncher_tpu.ops.packing import pack6, unpack6_device, unpack6_host
+
+    rng = np.random.default_rng(13)
+    pool = np.arange(25, 41, dtype=np.uint8)  # 16 distinct quals
+    for L in (64, 33):
+        bases = rng.integers(0, 4, (4, 3, L)).astype(np.uint8)
+        quals = pool[rng.integers(0, len(pool), (4, 3, L))]
+        book = build_codebook(pool)
+        packed = pack6(bases, quals, book)
+        Lp = L + (-L) % 4  # padded to a multiple of 4
+        assert packed.shape == (4, 3, 3 * Lp // 4) and packed.dtype == np.uint8
+        ub, uq = unpack6_host(packed, book, L)
+        np.testing.assert_array_equal(ub, bases)
+        np.testing.assert_array_equal(uq, quals)
+        db, dq = unpack6_device(packed, book, L)
+        np.testing.assert_array_equal(np.asarray(db), bases)
+        np.testing.assert_array_equal(np.asarray(dq), quals)
+
+
+def test_pack6_rejects_n_bases_and_off_codebook_quals():
+    from consensuscruncher_tpu.ops.packing import pack6
+
+    book = build_codebook(np.arange(25, 41, dtype=np.uint8))
+    with pytest.raises(ValueError):
+        pack6(np.array([[4, 0, 0, 0]], np.uint8),
+              np.full((1, 4), 30, np.uint8), book)
+    with pytest.raises(ValueError):
+        pack6(np.zeros((1, 4), np.uint8), np.full((1, 4), 99, np.uint8), book)

@@ -9,8 +9,12 @@ import numpy as np
 import pytest
 
 from consensuscruncher_tpu.core.consensus_cpu import consensus_maker
-from consensuscruncher_tpu.ops.consensus_pallas import consensus_batch_pallas_host
+from consensuscruncher_tpu.ops.consensus_pallas import (
+    consensus_batch_pallas_host,
+    duplex_batch_pallas_host,
+)
 from consensuscruncher_tpu.ops.consensus_tpu import ConsensusConfig, consensus_batch_host
+from consensuscruncher_tpu.ops.duplex_tpu import duplex_batch_host
 from consensuscruncher_tpu.utils.phred import N, PAD
 
 
@@ -64,3 +68,88 @@ def test_pallas_dummy_slots():
     sizes = np.zeros(8, np.int32)
     out_b, out_q = consensus_batch_pallas_host(bases, quals, sizes)
     assert (out_b == N).all() and (out_q == 0).all()
+
+
+# ------------------------------------------------------ fused duplex kernel
+
+
+def _fused_oracle(ba, qa, sa, bb, qb, sb, cfg):
+    """CPU oracle for the fused kernel: two staged SSCS votes + the staged
+    duplex combine — the exact host pipeline the fusion replaces."""
+    ab, aq = consensus_batch_host(ba, qa, sa, cfg)
+    bb2, bq = consensus_batch_host(bb, qb, sb, cfg)
+    db, dq = duplex_batch_host(ab, aq, bb2, bq, cfg.qual_cap)
+    return ab, aq, bb2, bq, db, dq
+
+
+def _assert_fused_matches(ba, qa, sa, bb, qb, sb, cfg):
+    got = duplex_batch_pallas_host(ba, qa, sa, bb, qb, sb, cfg)
+    want = _fused_oracle(ba, qa, sa, bb, qb, sb, cfg)
+    names = ("sscs_a_b", "sscs_a_q", "sscs_b_b", "sscs_b_q", "dcs_b", "dcs_q")
+    for g, w, name in zip(got, want, names):
+        np.testing.assert_array_equal(g, w, err_msg=name)
+
+
+@pytest.mark.parametrize("batch,fam,length", [(8, 4, 32), (16, 8, 96), (3, 2, 64)])
+def test_fused_matches_staged_oracle(batch, fam, length):
+    rng = np.random.default_rng(batch + fam + length)
+    ba, qa, sa = _batch(rng, batch, fam, length)
+    bb, qb, sb = _batch(rng, batch, fam, length)
+    _assert_fused_matches(ba, qa, sa, bb, qb, sb, ConsensusConfig())
+
+
+def test_fused_singleton_families():
+    """Edge shape F=1: every family is a single member — the vote is a
+    copy, the duplex combine does all the work."""
+    rng = np.random.default_rng(41)
+    batch, length = 8, 32
+    ba = rng.integers(0, 4, (batch, 1, length)).astype(np.uint8)
+    qa = rng.integers(2, 41, (batch, 1, length)).astype(np.uint8)
+    bb = rng.integers(0, 4, (batch, 1, length)).astype(np.uint8)
+    qb = rng.integers(2, 41, (batch, 1, length)).astype(np.uint8)
+    ones = np.ones(batch, np.int32)
+    _assert_fused_matches(ba, qa, ones, bb, qb, ones, ConsensusConfig())
+
+
+def test_fused_all_pad_slots():
+    """Edge shape: dead batch rows (fam_size 0, all-PAD members) mixed with
+    live ones — dead rows must come back as pure N/0 on all six planes."""
+    rng = np.random.default_rng(43)
+    batch, fam, length = 8, 4, 32
+    ba, qa, sa = _batch(rng, batch, fam, length)
+    bb, qb, sb = _batch(rng, batch, fam, length)
+    for arrs, sizes in ((ba, sa), (bb, sb)):
+        sizes[::2] = 0
+        arrs[::2] = PAD
+    qa[::2] = 0
+    qb[::2] = 0
+    cfg = ConsensusConfig()
+    _assert_fused_matches(ba, qa, sa, bb, qb, sb, cfg)
+    got = duplex_batch_pallas_host(ba, qa, sa, bb, qb, sb, cfg)
+    for plane_b, plane_q in ((got[0], got[1]), (got[2], got[3]), (got[4], got[5])):
+        assert (plane_b[::2] == N).all()
+        assert (plane_q[::2] == 0).all()
+
+
+def test_fused_rational_cutoff_boundary():
+    """Edge case 7/10 @ 0.7: exactly-at-cutoff majorities must land on the
+    same side in the kernel's integer cross-multiply as in the oracle's
+    float compare (and 8/10 must clearly pass)."""
+    fam, length = 10, 16
+    for winners in (7, 8):
+        ba = np.zeros((1, fam, length), np.uint8)
+        ba[0, winners:] = 2  # losers vote a different base
+        qa = np.full((1, fam, length), 30, np.uint8)
+        bb, qb = ba.copy(), qa.copy()
+        sizes = np.full(1, fam, np.int32)
+        cfg = ConsensusConfig(cutoff=0.7)
+        _assert_fused_matches(ba, qa, sizes, bb, qb, sizes, cfg)
+
+
+def test_fused_strand_shape_mismatch_rejected():
+    ba = np.zeros((4, 2, 32), np.uint8)
+    bb = np.zeros((4, 3, 32), np.uint8)
+    q = np.zeros((4, 2, 32), np.uint8)
+    s = np.ones(4, np.int32)
+    with pytest.raises(ValueError):
+        duplex_batch_pallas_host(ba, q, s, bb, np.zeros_like(bb), s)

@@ -1,0 +1,223 @@
+"""Device-resident consensus plane store (``ops.residency``).
+
+Pins the tentpole contract: with a ResidentPlanes store threaded through
+SSCS -> singleton rescue -> DCS, every output BAM is BYTE-identical to the
+staged path, duplex votes are served from the store (counters prove it),
+and every failure mode — empty store (a ``--resume`` that skipped SSCS),
+device fault mid-chain, length mismatch — degrades to the staged path
+with identical bytes.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from consensuscruncher_tpu.obs import metrics as obs_metrics
+from consensuscruncher_tpu.ops import packing
+from consensuscruncher_tpu.ops.duplex_tpu import duplex_batch_host
+from consensuscruncher_tpu.ops.residency import ResidentPlanes
+from consensuscruncher_tpu.stages.dcs_maker import run_dcs
+from consensuscruncher_tpu.stages.singleton_correction import run_singleton_correction
+from consensuscruncher_tpu.stages.sscs_maker import run_sscs
+from consensuscruncher_tpu.utils.simulate import SimConfig, simulate_bam
+
+
+@pytest.fixture(scope="module")
+def sim(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("resident") / "in.bam")
+    truth = simulate_bam(path, SimConfig(n_fragments=70, seed=3,
+                                         mean_family_size=3.0, ref_len=4000))
+    return path, truth
+
+
+def _read(path):
+    with open(path, "rb") as fh:
+        return fh.read()
+
+
+def _cumulative(path):
+    with open(path) as fh:
+        return json.load(fh)["cumulative"]
+
+
+def _run_chain(in_bam, prefix_dir, residency):
+    """The CLI's consensus chain wiring at stage level: one store instance
+    shared by all three stages (or None = staged)."""
+    p = str(prefix_dir)
+    os.makedirs(p, exist_ok=True)
+    prefix = os.path.join(p, "x")
+    sscs = run_sscs(in_bam, prefix, backend="tpu", residency=residency)
+    sc = run_singleton_correction(sscs.singleton_bam, sscs.sscs_bam, prefix,
+                                  backend="tpu", residency=residency)
+    dcs = run_dcs(sscs.sscs_bam, prefix, backend="tpu", residency=residency)
+    return sscs, sc, dcs, prefix
+
+
+CHAIN_OUTPUTS = ("sscs_bam", "singleton_bam"), ("sscs_rescue_bam",
+                                                "singleton_rescue_bam",
+                                                "remaining_bam"), (
+                                                    "dcs_bam",
+                                                    "sscs_singleton_bam")
+
+
+def _assert_chain_bytes_equal(a, b):
+    for res_a, res_b, names in zip(a[:3], b[:3], CHAIN_OUTPUTS):
+        for name in names:
+            pa, pb = getattr(res_a, name), getattr(res_b, name)
+            assert _read(pa) == _read(pb), f"{name} differs"
+
+
+# ------------------------------------------------------------------ store
+
+
+def test_store_roundtrip_and_misses():
+    import jax.numpy as jnp
+
+    store = ResidentPlanes()
+    rng = np.random.default_rng(0)
+    planes = jnp.asarray(rng.integers(0, 5, (2, 6, 16), dtype=np.uint8))
+    store.append([b"a", b"b", b"c"], [16, 16, 12], planes[:, :4], 3)
+    assert store.families == 3
+    idx = store.rows_for([b"b", b"nope", b"c", b"a"], 16)
+    # "c" is stored at length 12 — a length-16 vote must miss it
+    assert idx.tolist() == [1, -1, -1, 0]
+    assert store.rows_for([b"a"], 12).tolist() == [-1]
+
+
+def test_store_empty_and_broken_return_none():
+    store = ResidentPlanes()
+    assert store.rows_for([b"a"], 10) is None
+    assert store.duplex_pairs(np.zeros(1, np.int32), np.zeros(1, np.int32),
+                              10) is None
+    store.broken = True
+    assert store.rows_for([b"a"], 10) is None
+
+
+def test_duplex_pairs_matches_staged_vote():
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(1)
+    n, L = 10, 24
+    b = rng.integers(0, 5, (n, L), dtype=np.uint8)
+    q = rng.integers(0, 41, (n, L), dtype=np.uint8)
+    store = ResidentPlanes(qual_cap=60)
+    store.append([f"q{i}".encode() for i in range(n)], [L] * n,
+                 jnp.asarray(np.stack([b, q])), n)
+    idx1 = store.rows_for([b"q0", b"q2", b"q4"], L)
+    idx2 = store.rows_for([b"q1", b"q3", b"q5"], L)
+    got_b, got_q = store.duplex_pairs(idx1, idx2, L)
+    want_b, want_q = duplex_batch_host(b[0::2][:3], q[0::2][:3],
+                                       b[1::2][:3], q[1::2][:3], 60)
+    np.testing.assert_array_equal(np.asarray(got_b), want_b)
+    np.testing.assert_array_equal(np.asarray(got_q), want_q)
+
+
+def test_duplex_against_registers_output():
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(2)
+    n, L = 4, 16
+    b = rng.integers(0, 4, (n, L), dtype=np.uint8)
+    q = rng.integers(10, 30, (n, L), dtype=np.uint8)
+    store = ResidentPlanes()
+    store.append([f"p{i}".encode() for i in range(n)], [L] * n,
+                 jnp.asarray(np.stack([b, q])), n)
+    s1 = rng.integers(0, 4, (2, L), dtype=np.uint8)
+    q1 = rng.integers(10, 30, (2, L), dtype=np.uint8)
+    idx2 = store.rows_for([b"p1", b"p3"], L)
+    out = store.duplex_against(s1, q1, idx2, L,
+                               register_qnames=[b"r0", b"r1"])
+    assert out is not None
+    want_b, want_q = duplex_batch_host(s1, q1, b[[1, 3]], q[[1, 3]], 60)
+    np.testing.assert_array_equal(np.asarray(out[0]), want_b)
+    # rescued planes are now resident under their own qnames for DCS
+    ridx = store.rows_for([b"r0", b"r1"], L)
+    assert (ridx >= 0).all()
+    rb, _ = store.duplex_pairs(ridx, ridx, L)
+    np.testing.assert_array_equal(np.asarray(rb)[0], want_b[0])
+
+
+def test_fault_marks_broken_and_clears(monkeypatch):
+    import jax.numpy as jnp
+
+    monkeypatch.setenv("CCT_FAULTS", "ops.residency=fail")
+    store = ResidentPlanes()
+    store.append([b"a"], [8], jnp.zeros((2, 1, 8), jnp.uint8), 1)
+    assert store.broken
+    assert store.families == 0
+    assert store.rows_for([b"a"], 8) is None
+    # broken is sticky: later appends are ignored
+    monkeypatch.setenv("CCT_FAULTS", "")
+    store.append([b"b"], [8], jnp.zeros((2, 1, 8), jnp.uint8), 1)
+    assert store.families == 0
+
+
+# ------------------------------------------------------------------ chain
+
+
+def test_resident_chain_byte_identical_and_hits(sim, tmp_path):
+    in_bam, _ = sim
+    staged = _run_chain(in_bam, tmp_path / "staged", None)
+    store = packing.resident_planes()
+    resident = _run_chain(in_bam, tmp_path / "resident", store)
+    _assert_chain_bytes_equal(staged, resident)
+    assert not store.broken
+    assert store.families > 0
+    # the win is measured, not asserted: the DCS sidecar proves votes came
+    # from the store, and its vote h2d is smaller than the staged run's
+    cum_res = _cumulative(resident[3] + ".dcs.metrics.json")
+    cum_sta = _cumulative(staged[3] + ".dcs.metrics.json")
+    assert cum_res["resident_pair_votes"] > 0
+    assert cum_sta["resident_pair_votes"] == 0
+    assert cum_sta["staged_pair_votes"] > 0
+    assert cum_res["bytes_h2d"] < cum_sta["bytes_h2d"]
+    # rescue leg: route-0 rescues vote against resident SSCS planes
+    sc_res = _cumulative(resident[3] + ".singleton.metrics.json")
+    assert sc_res["resident_pair_votes"] > 0
+
+
+def test_resume_mid_chain_empty_store_falls_back(sim, tmp_path):
+    """A --resume that skips SSCS leaves the store empty: rescue and DCS
+    must miss everything and still produce byte-identical outputs."""
+    in_bam, _ = sim
+    staged = _run_chain(in_bam, tmp_path / "staged", None)
+    sscs = staged[0]
+    store = packing.resident_planes()  # never filled: SSCS was "resumed"
+    prefix = str(tmp_path / "resumed" / "x")
+    os.makedirs(str(tmp_path / "resumed"), exist_ok=True)
+    sc = run_singleton_correction(sscs.singleton_bam, sscs.sscs_bam, prefix,
+                                  backend="tpu", residency=store)
+    dcs = run_dcs(sscs.sscs_bam, prefix, backend="tpu", residency=store)
+    for name in CHAIN_OUTPUTS[1]:
+        assert _read(getattr(sc, name)) == _read(getattr(staged[1], name))
+    for name in CHAIN_OUTPUTS[2]:
+        assert _read(getattr(dcs, name)) == _read(getattr(staged[2], name))
+    cum = _cumulative(prefix + ".dcs.metrics.json")
+    assert cum["resident_pair_votes"] == 0
+    assert cum["staged_pair_votes"] > 0
+
+
+def test_chaos_device_loss_mid_chain_falls_back(sim, tmp_path, monkeypatch):
+    """ops.residency fault site: the first store append dies -> broken
+    store, staged fallback, identical bytes (the 3-part fault contract)."""
+    in_bam, _ = sim
+    staged = _run_chain(in_bam, tmp_path / "staged", None)
+    monkeypatch.setenv("CCT_FAULTS", "ops.residency=fail")
+    store = packing.resident_planes()
+    chaos = _run_chain(in_bam, tmp_path / "chaos", store)
+    assert store.broken
+    _assert_chain_bytes_equal(staged, chaos)
+    cum = _cumulative(chaos[3] + ".dcs.metrics.json")
+    assert cum["resident_pair_votes"] == 0
+    assert cum["staged_pair_votes"] > 0
+
+
+def test_cpu_backend_never_builds_a_store(sim, tmp_path):
+    """The CPU path is untouched: run_sscs(backend="cpu") with a store
+    attached must not capture anything (stream wire never runs)."""
+    in_bam, _ = sim
+    store = packing.resident_planes()
+    run_sscs(in_bam, str(tmp_path / "c"), backend="cpu", residency=store)
+    assert store.families == 0

@@ -71,3 +71,28 @@ def test_best_matches_10k_pool_device_vs_numpy():
 def test_shape_mismatch_rejected():
     with pytest.raises(ValueError, match="barcode matrices"):
         pairwise_hamming(np.zeros((2, 4), np.uint8), np.zeros((2, 5), np.uint8))
+
+
+def test_pairwise_hamming_pow2_padding_bounds_recompiles():
+    """The jit cache is bounded by the pow2 tile padding: ragged pool sizes
+    inside one pow2 bucket must NOT mint new dispatch shapes.  Asserted via
+    the obs recompile counter (the production serve-loop guard)."""
+    from consensuscruncher_tpu.obs import metrics as obs_metrics
+
+    rng = np.random.default_rng(17)
+    L = 17  # distinctive: this test's signatures are fresh in the process
+    before = obs_metrics.recompiles()
+    reference = None
+    for n in (5, 6, 7, 8):          # all pad to 8
+        for m in (9, 12, 15, 16):   # all pad to 16
+            a = rng.integers(0, 4, (n, L), dtype=np.uint8)
+            b = rng.integers(0, 4, (m, L), dtype=np.uint8)
+            d = pairwise_hamming(a, b)
+            assert d.shape == (n, m)  # padded rows sliced off
+            if reference is None:
+                reference = (a, b, d)
+    # 16 ragged calls, ONE padded dispatch shape (8, 16, 17)
+    assert obs_metrics.recompiles() - before <= 1
+    # and padding never leaks into the values
+    a, b, d = reference
+    np.testing.assert_array_equal(d, pairwise_hamming(a, b, device=False))

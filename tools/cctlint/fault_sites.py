@@ -31,6 +31,10 @@ FAULT_SITES: dict[str, str] = {
     "mesh.unavailable": "device mesh creation fails -> single-device fallback",
     "sscs.midstage": "crash/SIGTERM inside the SSCS loop (atomicity proof)",
     "dcs.midstage": "crash/SIGTERM inside the DCS loop (atomicity proof)",
+    "ops.residency": "device loss mid-chain (resident SSCS plane store "
+                     "append/gather fails) -> store marked broken, rescue "
+                     "and DCS fall back to the staged re-upload path with "
+                     "byte-identical outputs",
     "watch.job": "TPU watcher row job nonzero rc -> retry + backoff",
     "serve.accept": "daemon connection accept/handling -> error reply",
     "serve.dispatch": "scheduler gang dispatch -> jobs retried solo",

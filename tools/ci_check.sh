@@ -35,20 +35,78 @@ if [ "$T1RC" -ne 0 ]; then
 fi
 rm -f "$T1LOG"
 
-echo "== loadgen smoke (throwaway daemon, ~10s of traffic) =="
+echo "== autotune + residency CPU smoke (byte parity off-silicon) =="
+JAX_PLATFORMS=cpu PYTHONPATH="$REPO" python - <<'PY'
+import os, tempfile
+import numpy as np
+from consensuscruncher_tpu.ops import packing
+from consensuscruncher_tpu.parallel import batching
+from consensuscruncher_tpu.serve import warmup
+from consensuscruncher_tpu.stages.dcs_maker import run_dcs
+from consensuscruncher_tpu.stages.sscs_maker import run_sscs
+from consensuscruncher_tpu.utils.simulate import SimConfig, simulate_bam
+
+with tempfile.TemporaryDirectory() as work:
+    # residency leg: resident SSCS->DCS chain == staged chain, byte for byte
+    bam = os.path.join(work, "in.bam")
+    simulate_bam(bam, SimConfig(n_fragments=40, seed=5, mean_family_size=3.0))
+    outs = {}
+    for name, store in (("staged", None), ("resident", packing.resident_planes())):
+        prefix = os.path.join(work, name)
+        s = run_sscs(bam, prefix, backend="tpu", residency=store)
+        d = run_dcs(s.sscs_bam, prefix, backend="tpu", residency=store)
+        outs[name] = [open(p, "rb").read()
+                      for p in (s.sscs_bam, d.dcs_bam, d.sscs_singleton_bam)]
+    assert outs["staged"] == outs["resident"], "resident chain bytes differ"
+    # autotune leg: learn -> tune (cpu_fallback row) -> persist -> reload
+    table = os.path.join(work, "autotune_table.json")
+    at = warmup.BucketAutotuner(table_path=table)
+    batching.bucket_shape_counts(reset=True)
+    batching.record_bucket_shape(16, 4, 64)
+    assert at.tune(at.learn_from_live(), budget_s=60.0) == 1
+    row = at.table["16x4x64"]
+    assert row["backend"] == "dense" and row["reason"] == "cpu_fallback"
+    assert at.save()
+    at2 = warmup.BucketAutotuner(table_path=table)
+    assert at2.load() and at2.table == at.table
+print("ci_check: autotune + residency CPU smoke OK")
+PY
+
+echo "== loadgen smoke x2 (throwaway daemon; pass 2 under the learned table) =="
 WORK="$(mktemp -d)"
 trap 'rm -rf "$WORK"' EXIT
-python tools/loadgen.py --workdir "$WORK" --smoke \
-  --out "$WORK/BENCH_LOADGEN_smoke.json"
-python - "$WORK/BENCH_LOADGEN_smoke.json" <<'PY'
+# pass 1 learns the (B, F, L) bucket mix into the autotune table (saved at
+# daemon shutdown, next to the compile cache); pass 2 starts from that
+# table + warm cache, so its steady-state levels must mint ZERO new
+# dispatch shapes (the obs recompile counter polices it).
+for PASS in 1 2; do
+  python tools/loadgen.py --workdir "$WORK/lg$PASS" --smoke \
+    --compile_cache "$WORK/cache" \
+    --out "$WORK/BENCH_LOADGEN_smoke$PASS.json"
+done
+python - "$WORK/BENCH_LOADGEN_smoke1.json" "$WORK/BENCH_LOADGEN_smoke2.json" <<'PY'
 import json, sys
-doc = json.load(open(sys.argv[1]))
-assert doc["levels"], "loadgen produced no levels"
-assert all(lv["aggregate"]["lost"] == 0 for lv in doc["levels"]), \
-    "loadgen lost jobs"
-assert doc["knee"]["max_throughput_jobs_per_s"] > 0, "no throughput measured"
-assert doc["slo"]["classes"], "daemon SLO snapshot missing"
-print("ci_check: loadgen smoke artifact OK")
+for path in sys.argv[1:3]:
+    doc = json.load(open(path))
+    assert doc["levels"], "loadgen produced no levels"
+    assert all(lv["aggregate"]["lost"] == 0 for lv in doc["levels"]), \
+        "loadgen lost jobs"
+    assert doc["knee"]["max_throughput_jobs_per_s"] > 0, "no throughput measured"
+    assert doc["slo"]["classes"], "daemon SLO snapshot missing"
+at = doc.get("autotune") or {}
+assert at.get("shapes", 0) > 0, \
+    "pass 2 daemon did not load the learned autotune table"
+# zero unexpected recompiles: after the deterministic preflight (and the
+# learned-table warmup), every measured level must add NOTHING to the
+# daemon's dispatch-shape counter
+pre = doc["preflight_recompiles_total"]
+recs = [lv["recompiles_total"] for lv in doc["levels"]]
+assert pre is not None and None not in recs, \
+    "daemon metrics missing the recompile counter"
+assert all(r == pre for r in recs), \
+    f"measured levels minted new dispatch shapes: preflight={pre}, levels={recs}"
+print(f"ci_check: loadgen artifacts OK (learned table: {at['shapes']} shapes, "
+      f"0 unexpected recompiles across {len(recs)} levels at {pre} total)")
 PY
 
 echo "ci_check: OK"

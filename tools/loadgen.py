@@ -374,6 +374,11 @@ def main(argv=None) -> int:
     ap.add_argument("--out", default="",
                     help="output JSON path (default: "
                          "BENCH_LOADGEN_<utc-stamp>.json in the cwd)")
+    ap.add_argument("--compile_cache", default="",
+                    help="forwarded to the throwaway daemon: persistent "
+                         "compile cache dir, which also holds the learned "
+                         "autotune bucket table (run twice with the same "
+                         "dir to exercise the warmed, learned-table path)")
     ap.add_argument("--smoke", action="store_true",
                     help="seconds-scale run for CI: tiny inputs, short "
                          "levels, short settle")
@@ -417,6 +422,8 @@ def main(argv=None) -> int:
         ]
         if args.tenant_queue_cap > 0:
             daemon_cmd += ["--tenant_queue_cap", str(args.tenant_queue_cap)]
+        if args.compile_cache:
+            daemon_cmd += ["--compile_cache", args.compile_cache]
         log_path = os.path.join(args.workdir, "daemon.log")
         log_fh = open(log_path, "ab")
         daemon = subprocess.Popen(daemon_cmd, stdout=log_fh, stderr=log_fh)
@@ -431,6 +438,50 @@ def main(argv=None) -> int:
         health = client.healthz()
         print(f"loadgen: daemon {health['status']} (pid {health['pid']}); "
               f"mix={args.mix}", flush=True)
+        # Deterministic preflight: a job's dispatch shapes are a function of
+        # its input + spec + gang composition (pow2-bucketed), so two rounds
+        # — every job solo, then a gang_size burst of everything — cover the
+        # shapes the measured levels can form.  The levels must then add
+        # ZERO to the daemon's recompile counter (the "no unexpected
+        # recompiles under the learned table" CI assertion).
+        pre_dir = os.path.join(args.workdir, "out", "preflight")
+        os.makedirs(pre_dir, exist_ok=True)
+        pre_jobs = [(qos, bam) for qos in sorted(inputs)
+                    for bam in inputs[qos]]
+        pre_seq = [0]
+
+        def _submit_pre(qos, bam):
+            spec = {
+                "input": bam,
+                "output": os.path.join(pre_dir, f"p{pre_seq[0]}"),
+                "name": "lg-preflight",
+                "cutoff": 0.7, "qualscore": 0, "scorrect": True,
+                "max_mismatch": 0, "bdelim": "|", "compress_level": 1,
+                "tenant": "preflight", "qos": qos,
+            }
+            pre_seq[0] += 1
+            reply = client.submit_nowait(spec)
+            return reply["key"] if reply.get("ok") else None
+
+        def _wait_pre(keys):
+            keys = [k for k in keys if k]
+            deadline = time.monotonic() + args.settle
+            while keys and time.monotonic() < deadline:
+                keys = [k for k in keys if client.status(key=k)["state"]
+                        not in ("done", "failed")]
+                if keys:
+                    time.sleep(0.25)
+
+        for qos, bam in pre_jobs:       # round 1: solo (single-job paths)
+            _wait_pre([_submit_pre(qos, bam)])
+        burst = []                      # round 2: ganged dispatch shapes
+        for _ in range(max(1, args.gang_size)):
+            burst.extend(_submit_pre(qos, bam) for qos, bam in pre_jobs)
+        _wait_pre(burst)
+        pre_recompiles = (client.metrics().get("cumulative") or
+                          {}).get("recompiles")
+        print(f"loadgen: preflight {pre_seq[0]} job(s) settled "
+              f"(recompiles_total={pre_recompiles})", flush=True)
         for idx, rate in enumerate(rates):
             outdir = os.path.join(args.workdir, "out", f"L{idx}")
             os.makedirs(outdir, exist_ok=True)
@@ -446,6 +497,11 @@ def main(argv=None) -> int:
                   f"shed_ratio={agg['shed_ratio']:g}", flush=True)
             if agg["lost"]:
                 rc = 1
+            # process-global jit-cache size after this level: under a
+            # learned table the steady-state levels must not mint shapes
+            # (tools/ci_check.sh asserts it's flat past level 0)
+            lv["recompiles_total"] = (client.metrics().get("cumulative") or
+                                      {}).get("recompiles")
             levels.append(lv)
         final = client.metrics()
         doc = {
@@ -464,10 +520,12 @@ def main(argv=None) -> int:
                 "seed": args.seed,
                 "smoke": args.smoke,
             },
+            "preflight_recompiles_total": pre_recompiles,
             "levels": levels,
             "knee": knee_estimate(levels, args.shed_knee),
             "slo": final.get("slo"),
             "queued_by_class": final.get("queued_by_class"),
+            "autotune": final.get("autotune"),
         }
         out = args.out or time.strftime("BENCH_LOADGEN_%Y%m%d-%H%M%SZ.json",
                                         time.gmtime())

@@ -193,10 +193,101 @@ def run_segment(B, F, L, tag):
     })
 
 
+def run_fused(B, F, L, tag):
+    """Fused duplex Pallas kernel: both strands' SSCS vote + the DCS
+    combine in ONE kernel launch (six output planes, one pass over the
+    member tensors).  Needs real silicon like the plain pallas row."""
+    cfg = ConsensusConfig()
+    if jax.default_backend() != "tpu":
+        return emit({"shape": tag, "kernel": "fused_pallas",
+                     "skipped": "fused pallas row needs real tpu"})
+    from consensuscruncher_tpu.ops.consensus_pallas import (
+        _compiled_fused, _prep_family_major,
+    )
+
+    num, den = cfg.cutoff_rational
+    bases, quals, sizes = _inputs(B, F, L, cfg)
+    rng = np.random.default_rng(11)
+    bases_b = rng.integers(0, 4, (B, F, L)).astype(np.uint8)
+    quals_b = rng.integers(20, 41, (B, F, L)).astype(np.uint8)
+    sizes_b = rng.integers(1, F + 1, (B,)).astype(np.int32)
+    pad = (-B) % 8
+    fa_b, fa_q, sa = _prep_family_major(bases, quals, sizes, pad, F, L)
+    fb_b, fb_q, sb = _prep_family_major(bases_b, quals_b, sizes_b, pad, F, L)
+    args = tuple(jax.device_put(jnp.asarray(x))
+                 for x in (sa.reshape(-1, 1), sb.reshape(-1, 1),
+                           fa_b, fa_q, fb_b, fb_q))
+    jax.block_until_ready(args)
+    # Traffic: both strands' member tensors in, six (B, L) planes out.
+    hbm_bytes = 2 * (bases.nbytes + quals.nbytes) + 6 * B * L
+    try:
+        pfn = _compiled_fused(B + pad, F, L, num, den,
+                              int(cfg.qual_threshold), int(cfg.qual_cap), False)
+        t, times = timed_device(pfn, *args)
+        return emit({
+            "shape": tag, "kernel": "fused_pallas", "device_s": round(t, 5),
+            "reps": REPS, "device_s_all": [round(x, 5) for x in times],
+            # a fused launch votes B families PER STRAND plus the combine;
+            # keep families/s comparable by counting the B duplex families
+            "families_per_sec": round((B + pad) / t, 1),
+            "hbm_gb_per_sec": round(hbm_bytes / t / 1e9, 1),
+            "hbm_frac_of_peak": round(hbm_bytes / t / 1e9 / HBM_PEAK_GBS, 3),
+        })
+    except Exception as e:
+        return emit({"shape": tag, "kernel": "fused_pallas",
+                     "error": repr(e)[:300]})
+
+
+def run_resident_chain(B, F, L, tag):
+    """The tentpole wire as one on-device program: SSCS vote on both
+    strands + the DCS duplex combine, with the SSCS planes never leaving
+    HBM (``ops.residency`` semantics, minus the host index bookkeeping).
+    Runs on ANY backend — the CPU-fallback row is still emitted, and
+    ``jax_backend`` marks which silicon produced it."""
+    cfg = ConsensusConfig()
+    num, den = cfg.cutoff_rational
+    from consensuscruncher_tpu.ops.duplex_tpu import duplex_vote
+
+    vote = _compiled_batch_fn(num, den, int(cfg.qual_threshold),
+                              int(cfg.qual_cap))
+    qual_cap = int(cfg.qual_cap)
+
+    @jax.jit  # cct: allow-jit(offline bench probe, never dispatched by serve)
+    def chain(ba, qa, sa, bb, qb, sb):
+        va_b, va_q = vote(ba, qa, sa)
+        vb_b, vb_q = vote(bb, qb, sb)
+        return duplex_vote(va_b, va_q, vb_b, vb_q, qual_cap=qual_cap)
+
+    bases, quals, sizes = _inputs(B, F, L, cfg)
+    rng = np.random.default_rng(11)
+    bases_b = rng.integers(0, 4, (B, F, L)).astype(np.uint8)
+    quals_b = rng.integers(20, 41, (B, F, L)).astype(np.uint8)
+    sizes_b = rng.integers(1, F + 1, (B,)).astype(np.int32)
+    args = tuple(jax.device_put(jnp.asarray(x))
+                 for x in (bases, quals, sizes, bases_b, quals_b, sizes_b))
+    jax.block_until_ready(args)
+    t, times = timed_device(chain, *args)
+    # Chain traffic: both strands' member tensors in, four resident SSCS
+    # planes written+read on chip, two final planes out.  The STAGED chain
+    # moves the four SSCS planes over the wire twice more; that delta is
+    # what the residency store deletes.
+    hbm_bytes = 2 * (bases.nbytes + quals.nbytes) + 2 * 4 * B * L + 2 * B * L
+    return emit({
+        "shape": tag, "kernel": "resident_chain", "device_s": round(t, 5),
+        "reps": REPS, "device_s_all": [round(x, 5) for x in times],
+        "families_per_sec": round(B / t, 1),
+        "resident_plane_bytes": int(4 * B * L),
+        "hbm_gb_per_sec": round(hbm_bytes / t / 1e9, 1),
+        "hbm_frac_of_peak": round(hbm_bytes / t / 1e9 / HBM_PEAK_GBS, 3),
+    })
+
+
 KERNELS = {
     "dense_xla": run_dense,
     "pallas": run_pallas,
+    "fused_pallas": run_fused,
     "segment_packed": run_segment,
+    "resident_chain": run_resident_chain,
 }
 
 
@@ -204,7 +295,9 @@ def bench_shape(B, F, L, tag, rows):
     rows.append(run_dense(B, F, L, tag))
     if jax.default_backend() == "tpu":
         rows.append(run_pallas(B, F, L, tag))
+        rows.append(run_fused(B, F, L, tag))
     rows.append(run_segment(B, F, L, tag))
+    rows.append(run_resident_chain(B, F, L, tag))
 
 
 def main():
