@@ -99,6 +99,10 @@ PROBE_TIMEOUT = _env_int("CCT_BENCH_PROBE_TIMEOUT", 120)
 PROBE_ATTEMPTS = _env_int("CCT_BENCH_PROBE_ATTEMPTS", 4)
 PROBE_BACKOFF = _env_int("CCT_BENCH_PROBE_BACKOFF", 60)
 CPU_TIMEOUT = _env_int("CCT_BENCH_CPU_TIMEOUT", 1_200)
+# Large enough that stage materialization (the cost streaming removes) is
+# a measurable slice of wall; below ~10k fragments the compare is
+# overhead-dominated and reads as noise.
+PIPELINE_FRAGMENTS = _env_int("CCT_BENCH_PIPELINE_FRAGMENTS", 40_000)
 METRIC = "sscs_dcs_stage_families_per_sec"
 
 
@@ -130,6 +134,7 @@ def _worker_stage(backend: str, bam: str, outdir: str) -> dict:
     on a 1-core host carried ~8% drift between dress rehearsal and driver);
     loadavg is recorded per run so noisy numbers are self-explaining.
     """
+    from consensuscruncher_tpu.io import bgzf
     from consensuscruncher_tpu.obs import metrics as obs_metrics
     from consensuscruncher_tpu.stages.dcs_maker import run_dcs
     from consensuscruncher_tpu.stages.sscs_maker import run_sscs
@@ -160,18 +165,31 @@ def _worker_stage(backend: str, bam: str, outdir: str) -> dict:
             from consensuscruncher_tpu.ops import packing
 
             residency = packing.resident_planes()
+        io0 = bgzf.write_stats()
         t0 = time.perf_counter()
         sscs = run_sscs(bam, prefix, backend=stage_backend,
                         residency=residency)
+        io1 = bgzf.write_stats()
         t1 = time.perf_counter()
         run_dcs(sscs.sscs_bam, prefix, backend=dcs_backend,
                 residency=residency)
         t2 = time.perf_counter()
+        io2 = bgzf.write_stats()
         xfer_after = obs_metrics.transfer_bytes()
         runs[run_name] = {
             "sscs_s": round(t1 - t0, 3),
             "dcs_s": round(t2 - t1, 3),
             "total_s": round(t2 - t0, 3),
+            # per-stage BGZF cost (process-wide write-stats deltas): how
+            # much of each stage's wall is deflate, and the BAM bytes it
+            # committed — the r08 streaming pipeline attacks exactly these
+            "sscs_deflate_s": round((io1["deflate_wall_us"]
+                                     - io0["deflate_wall_us"]) / 1e6, 4),
+            "dcs_deflate_s": round((io2["deflate_wall_us"]
+                                    - io1["deflate_wall_us"]) / 1e6, 4),
+            "deflate_wall_s": round((io2["deflate_wall_us"]
+                                     - io0["deflate_wall_us"]) / 1e6, 4),
+            "bytes_bam_written": io2["bytes_written"] - io0["bytes_written"],
             "loadavg": round(os.getloadavg()[0], 2),
             # warm runs should show 0: a nonzero warm recompile count is
             # the shape-churn smell the jit-cache design rules out
@@ -204,11 +222,123 @@ def _worker_stage(backend: str, bam: str, outdir: str) -> dict:
         "families_per_sec": round(n_families / warm, 1) if warm > 0 else 0.0,
         "bytes_h2d": runs[warm_name]["bytes_h2d"],
         "bytes_d2h": runs[warm_name]["bytes_d2h"],
+        "deflate_wall_s": runs[warm_name]["deflate_wall_s"],
+        "bytes_bam_written": runs[warm_name]["bytes_bam_written"],
         "runs": runs,
         "cumulative": cumulative,
         "histograms": obs_metrics.histograms_snapshot(),
         "jax_backend": _jax_backend_name(),
     }
+
+
+def _worker_pipeline(backend: str, _bam: str, outdir: str) -> dict:
+    """End-to-end consensus CLI wall: ``--pipeline staged`` vs ``streaming``.
+
+    ROADMAP item 2 evidence: the streaming dataflow collapses the
+    stage→BAM→stage materialization, so the streaming leg's
+    run.metrics.json shows ``intermediate_bam_bytes`` ≈ 0 (taps off), a
+    smaller deflate fraction of wall, and a reduced CLI wall vs the staged
+    leg on the identical workload.  Both modes run cold+warm inside this
+    one process (shared jit cache); the warm runs are the headline.  The
+    warm legs' all_unique finals are hashed against each other — byte
+    parity proven on this exact run, not assumed.
+    """
+    import hashlib
+
+    from consensuscruncher_tpu import cli
+
+    bam = os.path.join(outdir, "pipe.bam")
+    _simulate(bam, PIPELINE_FRAGMENTS, seed=44)
+    cli_backend = "tpu" if backend in ("tpu", "xla_cpu") else backend
+    legs: dict = {}
+    hashes: dict = {}
+    for mode in ("staged", "streaming"):
+        for rep in ("cold", "warm"):
+            out = os.path.join(outdir, f"pl_{mode}_{rep}")
+            t0 = time.perf_counter()
+            rc = cli.main(["consensus", "--input", bam, "--output", out,
+                           "--name", "bench", "--backend", cli_backend,
+                           "--pipeline", mode])
+            wall = round(time.perf_counter() - t0, 3)
+            if rc not in (0, None):
+                return {"ok": False, "backend": backend,
+                        "error": f"consensus ({mode}/{rep}) exited rc={rc}"}
+            with open(os.path.join(out, "bench", "run.metrics.json")) as fh:
+                m = json.load(fh)
+            m["cli_wall_s"] = wall
+            legs.setdefault(mode, {})[rep] = m
+        digest = hashlib.sha256()
+        for fn in ("bench.all.unique.sscs.bam", "bench.all.unique.dcs.bam"):
+            with open(os.path.join(outdir, f"pl_{mode}_warm", "bench",
+                                   "all_unique", fn), "rb") as fh:
+                digest.update(fh.read())
+        hashes[mode] = digest.hexdigest()
+    staged, streaming = legs["staged"]["warm"], legs["streaming"]["warm"]
+
+    def frac(m: dict) -> float:
+        return (round(m["deflate_wall_s"] / m["cli_wall_s"], 4)
+                if m["cli_wall_s"] > 0 else 0.0)
+
+    return {
+        "deflate_pool": _deflate_pool_compare(outdir),
+        "ok": True,
+        "backend": backend,
+        "n_fragments": PIPELINE_FRAGMENTS,
+        # "pipeline" inside each leg is what the run ACTUALLY took: a
+        # streaming leg that tripped its fault-fallback reports "staged"
+        "staged": staged,
+        "streaming": streaming,
+        "runs": legs,
+        "deflate_fraction": {"staged": frac(staged),
+                             "streaming": frac(streaming)},
+        "wall_speedup_streaming": (
+            round(staged["cli_wall_s"] / streaming["cli_wall_s"], 3)
+            if streaming["cli_wall_s"] > 0 else 0.0),
+        "final_bams_identical": hashes["staged"] == hashes["streaming"],
+        "jax_backend": _jax_backend_name(),
+    }
+
+
+def _deflate_pool_compare(outdir: str) -> dict:
+    """Serial vs pooled BGZF deflate wall on one fixed payload.
+
+    Per-block compression is order-independent and bit-reproducible, so
+    the pool is pure wall-clock leverage — this leg proves the parallel
+    deflate actually beats serial on this host (and that the bytes
+    match).  Uses the same writer path the pipeline uses.
+    """
+    import hashlib
+
+    import numpy as np
+
+    from consensuscruncher_tpu.io import bgzf
+
+    rng = np.random.default_rng(8)
+    payload = rng.integers(0, 64, 32_000_000).astype(np.uint8).tobytes()
+    threads = {"serial": 0, "parallel": bgzf.codec_threads() or 4}
+    out: dict = {"threads": threads["parallel"]}
+    digests = {}
+    prev = os.environ.get("CCT_BGZF_THREADS")
+    try:
+        for leg, n in threads.items():
+            os.environ["CCT_BGZF_THREADS"] = str(n)
+            path = os.path.join(outdir, f"deflate_{leg}.bgzf")
+            t0 = time.perf_counter()
+            with bgzf.BgzfWriter(path, level=6, async_write=False) as w:
+                w.write(payload)
+            out[f"{leg}_wall_s"] = round(time.perf_counter() - t0, 3)
+            digests[leg] = hashlib.sha256(
+                open(path, "rb").read()).hexdigest()
+            os.unlink(path)
+    finally:
+        if prev is None:
+            os.environ.pop("CCT_BGZF_THREADS", None)
+        else:
+            os.environ["CCT_BGZF_THREADS"] = prev
+    out["speedup"] = (round(out["serial_wall_s"] / out["parallel_wall_s"], 3)
+                      if out["parallel_wall_s"] > 0 else 0.0)
+    out["bytes_identical"] = digests["serial"] == digests["parallel"]
+    return out
 
 
 def _jax_backend_name() -> str:
@@ -330,6 +460,8 @@ def _worker_main(argv: list[str]) -> int:
             result = _worker_stage(backend, bam, outdir)
         elif mode == "kernels":
             result = _worker_kernels(backend, outdir)
+        elif mode == "pipeline":
+            result = _worker_pipeline(backend, bam, outdir)
         elif mode == "probe":
             import jax
 
@@ -624,6 +756,13 @@ def _main_impl() -> dict:
             else:
                 backend_used, result = _pick_headline(tpu_result, fallback, extras)
             extras["tpu_probe_attempts"] = attempts
+
+            # ROADMAP item 2 (r08): end-to-end CLI wall, --pipeline staged
+            # vs streaming, on the window-independent XLA-CPU leg (same
+            # jitted code path, deterministic silicon) — reports each leg's
+            # deflate fraction, intermediate-BAM bytes, and final-BAM parity.
+            extras["pipeline_compare"] = _run_worker(
+                "pipeline", "xla_cpu", "-", td, CPU_TIMEOUT)
 
             if result.get("ok"):
                 value = float(result["families_per_sec"])

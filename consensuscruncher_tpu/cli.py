@@ -34,6 +34,7 @@ import os
 import shlex
 import subprocess
 import sys
+import time
 
 from consensuscruncher_tpu import __version__
 from consensuscruncher_tpu.core.tags import DEFAULT_BDELIM
@@ -90,6 +91,28 @@ def _apply_obs_config(path: str | None) -> None:
         env = _OBS_ENV.get(key)
         if env and str(value) != "":
             os.environ.setdefault(env, str(value))
+
+
+def _apply_io_config(path: str | None) -> None:
+    """Fold the ``[io]`` config section into the BGZF codec knobs.
+
+    ``bgzf_threads`` sizes the parallel deflate pool (0 = serial);
+    ``async_writer`` toggles the writer's background deflate thread.
+    ``bgzf.configure`` sits below the environment check, so
+    CCT_BGZF_THREADS / CCT_ASYNC_WRITER still win — the same precedence
+    the ``[obs]`` fold uses.
+    """
+    io_cfg = _config_defaults(path, "io")
+    if not io_cfg:
+        return
+    from consensuscruncher_tpu.io import bgzf
+
+    threads = io_cfg.get("bgzf_threads")
+    async_write = io_cfg.get("async_writer")
+    bgzf.configure(
+        threads=int(threads) if threads not in (None, "") else None,
+        async_write=_bool(async_write) if async_write not in (None, "") else None,
+    )
 
 
 def make_checkpointed(manifest: RunManifest, resume: bool, label: str):
@@ -563,7 +586,10 @@ def _consensus_impl(args) -> dict:
     # initialize — a sick axon tunnel HANGS on first touch rather than
     # erroring, which without this probe meant an indefinite silent hang.
     from consensuscruncher_tpu.utils.backend_probe import ensure_backend
+    from consensuscruncher_tpu.io import bgzf
 
+    t0 = time.perf_counter()
+    io_before = bgzf.write_stats()
     ensure_backend(args.backend)
     if args.backend == "xla_cpu":
         # platform pinned by ensure_backend; the stages' device path is the
@@ -627,6 +653,29 @@ def _consensus_impl(args) -> dict:
         from consensuscruncher_tpu.ops import packing
 
         residency = packing.resident_planes()
+
+    # ROADMAP item 2: the streaming dataflow pipeline (opt-in).  Guarded to
+    # the cases whose hand-offs it can express: a fresh full-input run on
+    # the vectorized rescue path.  --resume, host-worker range slices and
+    # the object-walk rescue (max_mismatch > 0) always take the staged
+    # path, and ANY streaming failure — an injected stream.* fault, a sort
+    # buffer spill, a background write error — falls back to staged here
+    # rather than failing the run.
+    pipeline = str(getattr(args, "pipeline", "staged") or "staged")
+    if (pipeline == "streaming" and not resume and input_range is None
+            and (not args.scorrect or int(args.max_mismatch) == 0)):
+        try:
+            return _consensus_streaming(args, name, base, dirs, manifest,
+                                        ilevel, residency, t0, io_before)
+        except Exception as e:
+            print(f"consensus: streaming pipeline failed ({e}); "
+                  "falling back to the staged pipeline",
+                  file=sys.stderr, flush=True)
+            if residency is not None:
+                # drop any half-populated plane store from the aborted run
+                from consensuscruncher_tpu.ops import packing
+
+                residency = packing.resident_planes()
 
     sscs_res = checkpointed(
         "sscs",
@@ -767,7 +816,195 @@ def _consensus_impl(args) -> dict:
             if os.path.exists(path):
                 os.unlink(path)
 
+    _write_run_metrics(base, name, dirs, "staged", t0, io_before)
     print(f"consensus: outputs under {base}")
+    return {"all_sscs": all_sscs, "all_dcs": all_dcs, "dirs": dirs}
+
+
+def _write_run_metrics(base, name, dirs, pipeline, t0, io_before) -> None:
+    """``<base>/run.metrics.json``: the end-to-end numbers BENCH_r08
+    compares across --pipeline modes — total wall, deflate wall,
+    BGZF bytes written, and how many of those bytes were stage-to-stage
+    intermediates (≈0 in streaming mode with taps off)."""
+    from consensuscruncher_tpu.io import bgzf
+
+    now = bgzf.write_stats()
+    intermediates = [
+        os.path.join(dirs["sscs"], f"{name}.singleton.sorted.bam"),
+        os.path.join(dirs["singleton"], f"{name}.sscs.rescue.sorted.bam"),
+        os.path.join(dirs["singleton"], f"{name}.singleton.rescue.sorted.bam"),
+        os.path.join(dirs["dcs"], f"{name}.sscs.rescued.bam"),
+    ]
+    payload = {
+        "pipeline": pipeline,
+        "wall_s": round(time.perf_counter() - t0, 6),
+        "deflate_wall_s": round(
+            (now["deflate_wall_us"] - io_before["deflate_wall_us"]) / 1e6, 6),
+        "bytes_bam_written": now["bytes_written"] - io_before["bytes_written"],
+        "intermediate_bam_bytes": sum(
+            os.path.getsize(p) for p in intermediates if os.path.exists(p)),
+    }
+    with open(os.path.join(base, "run.metrics.json"), "w") as fh:
+        json.dump(payload, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+
+
+def _consensus_streaming(args, name, base, dirs, manifest, ilevel,
+                         residency, t0, io_before) -> dict:
+    """``--pipeline streaming``: the consensus chain as a dataflow graph.
+
+    Stage hand-offs move sorted record batches through bounded in-memory
+    channels (``core.streamgraph``) instead of BGZF-deflating, re-reading
+    and re-sorting an intermediate BAM at every boundary.  Final outputs
+    stay byte-identical to the staged path — the merges run the identical
+    sort/write construction over the identical records, just without the
+    file round-trip — while the stage-to-stage BAMs are skipped entirely
+    unless ``--intermediate_taps`` asks for them as debug taps.  File
+    materialization runs on a write-behind pool, overlapping deflate+IO
+    with the next stage's device compute.
+
+    Any failure in here propagates to ``_consensus_impl``'s fallback,
+    which re-runs the staged pipeline.  One whole-flow manifest entry is
+    recorded (per-stage hand-offs were never files, so a later --resume
+    cannot skip individual stages — it takes the staged path and re-runs
+    them; cheap correctness over a stale shortcut).
+    """
+    from consensuscruncher_tpu.core.streamgraph import BatchStream, StreamOut
+    from consensuscruncher_tpu.io.bam import merge_memory_bams
+
+    taps = bool(getattr(args, "intermediate_taps", False))
+    stream = StreamOut(taps=taps)
+    sscs_prefix = os.path.join(dirs["sscs"], name)
+    sscs_paths = sscs_maker.output_paths(sscs_prefix)
+    dcs_input = os.path.join(dirs["dcs"], f"{name}.sscs.rescued.bam")
+    try:
+        handoff = getattr(args, "_sscs_handoff", None)
+        if handoff is not None:
+            # serve gang continuation: the scheduler already ran this
+            # job's share of the gang SSCS dispatch and holds the sorted
+            # outputs in memory (files + stats are on disk already)
+            sscs_res = SscsResult.from_prefix(sscs_prefix)
+            stream.memory["sscs"] = handoff["sscs"]
+            stream.memory["singleton"] = handoff["singleton"]
+        else:
+            sscs_res = run_sscs(
+                args.input,
+                sscs_prefix,
+                cutoff=args.cutoff,
+                qual_threshold=args.qualscore,
+                backend=args.backend,
+                bdelim=args.bdelim,
+                devices=args.devices,
+                wire=getattr(args, "wire", "stream"),
+                level=ilevel,
+                prestaged=getattr(args, "_prestaged", None),
+                residency=residency,
+                stream_out=stream,
+            )
+        sscs_mem = stream.memory["sscs"]
+        singleton_mem = stream.memory["singleton"]
+        sscs_mem_parts = [sscs_mem]
+        stats_jsons = [sscs_paths["stats_json"]]
+
+        corr = None
+        if args.scorrect:
+            corr_prefix = os.path.join(dirs["singleton"], name)
+            corr_paths = singleton_correction.output_paths(corr_prefix)
+            corr = run_singleton_correction(
+                BatchStream(singleton_mem),
+                BatchStream(sscs_mem),
+                corr_prefix,
+                max_mismatch=args.max_mismatch,
+                backend=args.backend,
+                level=ilevel,
+                residency=residency,
+                stream_out=stream,
+            )
+            stats_jsons.append(corr_paths["stats_json"])
+            rescue_mems = [stream.memory["sscs_rescue"],
+                           stream.memory["singleton_rescue"]]
+            sscs_mem_parts += rescue_mems + [stream.memory["remaining"]]
+            # the DCS input merge stays in memory; as a tap it keeps the
+            # staged path's cheap-deflate policy (it exists only to feed
+            # DCS, and --cleanup deletes it at the end of the run)
+            dcs_in_mem = merge_memory_bams([sscs_mem] + rescue_mems)
+            if taps:
+                stream.submit(dcs_in_mem.write, dcs_input,
+                              level=0 if args.cleanup else min(1, ilevel),
+                              index=not args.cleanup)
+        else:
+            sscs_mem_parts.append(singleton_mem)
+            dcs_in_mem = sscs_mem
+
+        # the biggest final's merge + deflate runs on the write-behind
+        # pool, overlapping the DCS stage's device compute
+        all_sscs = os.path.join(dirs["all_unique"], f"{name}.all.unique.sscs.bam")
+        stream.submit(merge_memory_bams, sscs_mem_parts, all_sscs,
+                      level=args.compress_level)
+
+        dcs_prefix = os.path.join(dirs["dcs"], name)
+        dcs_paths = dcs_maker.output_paths(dcs_prefix)
+        dcs_res = run_dcs(
+            BatchStream(dcs_in_mem),
+            dcs_prefix,
+            backend=args.backend,
+            devices=args.devices,
+            level=ilevel,
+            residency=residency,
+            stream_out=stream,
+        )
+        stats_jsons.append(dcs_paths["stats_json"])
+
+        all_dcs = os.path.join(dirs["all_unique"], f"{name}.all.unique.dcs.bam")
+        merge_memory_bams([stream.memory["dcs"], stream.memory["unpaired"]],
+                          all_dcs, level=args.compress_level)
+        stream.drain()  # re-raises the first background write failure
+    except BaseException:
+        stream.abort()
+        raise
+
+    manifest.record(
+        "consensus_stream", [args.input], [all_sscs, all_dcs],
+        {"cutoff": args.cutoff, "qualscore": args.qualscore,
+         "bdelim": args.bdelim, "scorrect": args.scorrect,
+         "max_mismatch": args.max_mismatch, "pipeline": "streaming"})
+
+    # Same indexing policy as staged: every surviving coordinate-sorted
+    # BAM.  Files the stream materialized carry a fresh inline .bai, so
+    # skip_if_fresh makes this a stat() pass; taps that were never
+    # written fail the exists() check and are skipped.
+    index_parts = [all_sscs, all_dcs, dcs_res.dcs_bam,
+                   dcs_res.sscs_singleton_bam, sscs_res.sscs_bam,
+                   sscs_res.singleton_bam]
+    if args.scorrect:
+        index_parts += [corr.sscs_rescue_bam, corr.singleton_rescue_bam,
+                        corr.remaining_bam]
+        if taps and not args.cleanup:
+            index_parts.append(dcs_input)
+    for path in index_parts:
+        if os.path.exists(path):
+            index_bam(path, skip_if_fresh=True)
+
+    plot_family_size(
+        os.path.join(dirs["sscs"], f"{name}.read_families.txt"),
+        os.path.join(dirs["plots"], f"{name}.family_size.png"),
+    )
+    plot_read_recovery(stats_jsons, os.path.join(dirs["plots"], f"{name}.read_recovery.png"))
+    plot_stage_times(
+        [os.path.join(dirs["sscs"], f"{name}.metrics.json")],
+        os.path.join(dirs["plots"], f"{name}.stage_times.png"),
+    )
+
+    if args.cleanup:
+        doomed = [sscs_res.bad_bam]
+        if args.scorrect and taps:
+            doomed += [dcs_input, dcs_input + ".bai"]
+        for path in doomed:
+            if os.path.exists(path):
+                os.unlink(path)
+
+    _write_run_metrics(base, name, dirs, "streaming", t0, io_before)
+    print(f"consensus: outputs under {base} (streaming pipeline)")
     return {"all_sscs": all_sscs, "all_dcs": all_dcs, "dirs": dirs}
 
 
@@ -1144,6 +1381,21 @@ def build_parser() -> argparse.ArgumentParser:
                         "re-uploading them (default True; tpu stream wire, "
                         "single device). Bit-identical outputs; 'False' "
                         "forces the staged path")
+    c.add_argument("--pipeline", choices=("staged", "streaming"),
+                   help="'staged' (default): each stage writes its BAM, the "
+                        "next re-reads it. 'streaming': stages hand sorted "
+                        "record batches through bounded in-memory channels; "
+                        "intermediate BAMs are skipped (see "
+                        "--intermediate_taps), finals are byte-identical "
+                        "and deflate overlaps device compute. --resume, "
+                        "--input_range and max_mismatch>0 runs always take "
+                        "the staged path; any streaming fault falls back "
+                        "to staged automatically")
+    c.add_argument("--intermediate_taps",
+                   help="with --pipeline streaming: also materialize the "
+                        "stage-to-stage BAMs (singleton, rescue outputs, "
+                        "sscs.rescued) as debug taps, reproducing the full "
+                        "staged output tree (default False)")
     c.set_defaults(func=consensus, config_section="consensus",
                    required_args=("input", "output"),
                    builtin_defaults={
@@ -1152,6 +1404,7 @@ def build_parser() -> argparse.ArgumentParser:
                        "bdelim": DEFAULT_BDELIM, "cleanup": "False",
                        "resume": "False", "compress_level": 6,
                        "host_workers": 1, "residency": "True",
+                       "pipeline": "staged", "intermediate_taps": "False",
                    })
 
     s = sub.add_parser(
@@ -1282,9 +1535,12 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
-def main(argv=None) -> int:
+def main(argv=None, _sscs_handoff=None) -> int:
     parser = build_parser()
     args = parser.parse_args(argv)
+    # serve-internal: a gang-dispatched job continuing into the streaming
+    # pipeline hands its in-memory SSCS outputs through here
+    args._sscs_handoff = _sscs_handoff
 
     # precedence: CLI flag > config.ini value > built-in default
     config_values = _config_defaults(args.config, args.config_section)
@@ -1302,6 +1558,8 @@ def main(argv=None) -> int:
     args.cleanup = _bool(getattr(args, "cleanup", "False"))
     if hasattr(args, "residency"):
         args.residency = _bool(args.residency)
+    if hasattr(args, "intermediate_taps"):
+        args.intermediate_taps = _bool(args.intermediate_taps)
     if hasattr(args, "resume"):
         args.resume = _bool(args.resume)
     if hasattr(args, "cutoff"):
@@ -1344,6 +1602,7 @@ def main(argv=None) -> int:
                         break
 
     _apply_obs_config(args.config)
+    _apply_io_config(args.config)
     from consensuscruncher_tpu.obs import trace as obs_trace
 
     # The root CLI span mints the run's trace_id (serve jobs re-entering

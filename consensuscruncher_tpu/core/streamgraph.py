@@ -1,0 +1,232 @@
+"""Streaming dataflow primitives: bounded channels, operator threads, taps.
+
+ROADMAP item 2 (the stage→BAM→stage materialization collapse): in
+``--pipeline streaming`` mode the consensus chain moves sorted record
+batches between stages as bounded in-memory flows instead of writing,
+BGZF-deflating, re-reading and re-sorting an intermediate BAM at every
+stage boundary.  The pieces here are deliberately small:
+
+- :class:`Channel` — a bounded queue with backpressure.  ``put`` blocks
+  once ``capacity`` items are in flight; ``fail`` poisons the channel so
+  errors cross thread boundaries exactly once and promptly.
+- :class:`Operator` — a daemon producer thread pumping an iterable into
+  a channel, converting its exceptions (including injected faults) into
+  channel poison rather than silent thread death.
+- :class:`BatchStream` — bounded read-ahead over an in-memory BAM,
+  duck-compatible with ``ColumnarReader`` (``.header`` / ``.batches()``
+  / ``.close()``) so unchanged stage code consumes it transparently.
+- :class:`StreamOut` — the capture surface stages hand their sorted
+  outputs to: keeps the in-memory BAM for the next stage and schedules
+  any file materialization (finals always, intermediates only as debug
+  taps) on a bounded write-behind pool, overlapping deflate+IO with the
+  next stage's device compute.
+
+Fault sites: ``stream.channel_full`` fires at the moment backpressure
+engages (a wedged consumer must abort the run, not deadlock it) and
+``stream.operator_fail`` fires once per pumped item (a mid-stream
+producer fault must poison the channel and surface at the consumer).
+Both are the trip wires the CLI's fall-back-to-staged path is tested
+against.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Callable, Iterable, Iterator
+
+from consensuscruncher_tpu.parallel.prefetch import WriteBehind
+from consensuscruncher_tpu.utils import faults
+
+_SENTINEL = object()
+
+
+class ChannelClosed(RuntimeError):
+    """``put()`` on a channel whose consumer side has gone away."""
+
+
+class Channel:
+    """Bounded producer→consumer channel with backpressure.
+
+    Single-consumer, any number of producers.  ``close()`` ends iteration
+    once queued items drain; ``fail(exc)`` drops queued items and
+    re-raises ``exc`` at the consumer's next pull (fail-fast: a poisoned
+    stage must not keep feeding the stage downstream).
+    """
+
+    def __init__(self, capacity: int = 2):
+        self._cap = max(1, int(capacity))
+        self._q: deque = deque()
+        self._cond = threading.Condition()
+        self._closed = False
+        self._exc: BaseException | None = None
+
+    def _check_open(self) -> None:
+        if self._closed or self._exc is not None:
+            raise ChannelClosed("channel closed under the producer")
+
+    def put(self, item) -> None:
+        with self._cond:
+            self._check_open()
+            full = len(self._q) >= self._cap
+        if full:
+            # Backpressure engaged: visible to fault injection so chaos
+            # tests can prove the slow-consumer path aborts cleanly.
+            faults.fault_point("stream.channel_full")
+        with self._cond:
+            while len(self._q) >= self._cap:
+                self._check_open()
+                self._cond.wait(0.5)
+            self._check_open()
+            self._q.append(item)
+            self._cond.notify_all()
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+    def fail(self, exc: BaseException) -> None:
+        with self._cond:
+            if self._exc is None:
+                self._exc = exc
+            self._closed = True
+            self._q.clear()
+            self._cond.notify_all()
+
+    def get(self):
+        """Next item, ``_SENTINEL`` at clean end, raises on poison."""
+        with self._cond:
+            while True:
+                if self._exc is not None:
+                    raise self._exc
+                if self._q:
+                    item = self._q.popleft()
+                    self._cond.notify_all()
+                    return item
+                if self._closed:
+                    return _SENTINEL
+                self._cond.wait(0.5)
+
+    def __iter__(self) -> Iterator:
+        while True:
+            item = self.get()
+            if item is _SENTINEL:
+                return
+            yield item
+
+
+class Operator:
+    """Daemon thread pumping ``source`` into ``out``.
+
+    ``source`` is an iterable or a zero-arg callable returning one (use a
+    callable when building the iterator itself is expensive — it then
+    runs on the operator thread, not the caller's).  The thread starts
+    immediately, so read-ahead begins before the consumer's first pull.
+    Exceptions poison ``out``; a consumer that walks away (``fail`` on
+    the channel) just ends the pump quietly.
+    """
+
+    def __init__(self, name: str,
+                 source: Iterable | Callable[[], Iterable],
+                 out: Channel):
+        self.name = name
+        self._src = source
+        self._out = out
+        self._thread = threading.Thread(
+            target=self._run, name=f"cct-stream-{name}", daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        try:
+            it = self._src() if callable(self._src) else self._src
+            for item in it:
+                faults.fault_point("stream.operator_fail")
+                self._out.put(item)
+        except ChannelClosed:
+            pass  # consumer closed first: normal teardown
+        except BaseException as exc:
+            self._out.fail(exc)
+        else:
+            self._out.close()
+
+    def join(self, timeout: float | None = None) -> None:
+        self._thread.join(timeout)
+
+
+class BatchStream:
+    """Bounded read-ahead over an in-memory BAM's record batches.
+
+    Wraps anything exposing ``.header`` / ``.batches()`` / ``.close()``
+    (a :class:`~consensuscruncher_tpu.io.columnar.MemoryBam` between
+    stages) and serves the same interface, with an :class:`Operator`
+    slicing batches ``capacity`` ahead of the consumer — the host-side
+    gather/copy overlaps the consumer's device compute, mirroring
+    ``parallel.prefetch``'s double-buffering on the input side.
+    """
+
+    def __init__(self, source, capacity: int = 2,
+                 batch_bytes: int | None = None):
+        self._source = source
+        self.header = source.header
+        self._capacity = max(1, int(capacity))
+        self._batch_bytes = batch_bytes
+        self._chan: Channel | None = None
+        self._op: Operator | None = None
+
+    def batches(self) -> Iterator:
+        chan = Channel(self._capacity)
+        src = self._source
+        if self._batch_bytes is None:
+            op = Operator("batches", src.batches, chan)
+        else:
+            bb = self._batch_bytes
+            op = Operator("batches", lambda: src.batches(batch_bytes=bb), chan)
+        self._chan, self._op = chan, op
+        return iter(chan)
+
+    def close(self) -> None:
+        if self._chan is not None:
+            # Release a producer blocked on a full channel before closing
+            # the underlying source it is reading from.
+            self._chan.fail(ChannelClosed("stream consumer closed"))
+            if self._op is not None:
+                self._op.join(timeout=30.0)
+        self._source.close()
+
+
+class StreamOut:
+    """Capture surface for stage outputs in streaming mode.
+
+    Stages call ``capture(name, mem, file_path=...)`` with the sorted
+    in-memory BAM they would otherwise have committed to disk.  The
+    memory is kept for the next stage; when ``file_path`` is given (final
+    outputs always; intermediates only when the run asked for debug taps)
+    the BGZF materialization runs on a bounded write-behind pool so
+    deflate+IO overlaps downstream compute.  ``drain()`` re-raises the
+    first background write failure — the CLI treats that as a fault-site
+    trip and falls back to the staged pipeline (atomic tmp+rename writes
+    make half-written finals invisible).
+    """
+
+    def __init__(self, taps: bool = False, depth: int = 2):
+        self.taps = bool(taps)
+        self.memory: dict[str, object] = {}
+        self._wb = WriteBehind(depth=depth)
+
+    def capture(self, name: str, mem, file_path=None, level: int = 6,
+                index: bool = True) -> None:
+        self.memory[name] = mem
+        if file_path is not None:
+            self._wb.submit(mem.write, file_path, level=level, index=index)
+
+    def submit(self, fn, *args, **kwargs) -> None:
+        """Run ``fn`` on the write-behind pool (e.g. an all_unique merge
+        that can overlap the next stage's device compute)."""
+        self._wb.submit(fn, *args, **kwargs)
+
+    def drain(self) -> None:
+        self._wb.drain()
+
+    def abort(self) -> None:
+        self._wb.abort()

@@ -16,10 +16,13 @@ emits real blocks + EOF marker for htslib compatibility.
 
 from __future__ import annotations
 
+import functools
 import io
 import os
 import struct
 import sys
+import threading
+import time
 import zlib
 from typing import BinaryIO, Iterator
 
@@ -210,15 +213,70 @@ def scan_block_metas(buf: bytes, tolerant: bool = False) -> tuple[tuple, int]:
     return metas, pos
 
 
+# --------------------------------------------------------------- knobs
+#
+# config.ini ``[io]`` values land here via :func:`configure` (the CLI
+# folds them in before any writer is built).  Environment variables
+# still win so operators can override a config file per-invocation.
+_cfg: dict[str, object] = {"threads": None, "async_write": None}
+
+# ---------------------------------------------------------- write stats
+#
+# Process-wide accumulator for what the writer layer actually spent:
+# wall microseconds inside deflate+compressed-write and compressed bytes
+# emitted (EOF markers included).  Stages snapshot before/after their
+# commit sections and publish the DELTA through the registered
+# ``deflate_wall_us`` / ``bytes_bam_written`` counters — giving bench
+# the per-stage deflate fraction without threading a stats object
+# through every writer construction site.  Lock-protected because async
+# writers deflate on worker threads.
+_stats_lock = threading.Lock()
+_stats = {"deflate_wall_us": 0, "bytes_written": 0}
+
+
+def _stats_add(wall_us: int, nbytes: int) -> None:
+    with _stats_lock:
+        _stats["deflate_wall_us"] += int(wall_us)
+        _stats["bytes_written"] += int(nbytes)
+
+
+def write_stats() -> dict[str, int]:
+    """Snapshot of the process-wide writer stats (cumulative; callers
+    diff two snapshots to attribute cost to a code region)."""
+    with _stats_lock:
+        return dict(_stats)
+
+
+def configure(threads: int | None = None, async_write: bool | None = None) -> None:
+    """Fold config-file ``[io]`` knobs into the codec defaults.
+
+    ``threads``: deflate pool size (native pthread pool AND the pure-
+    Python block pool); ``async_write``: default for the writer's
+    background deflate thread.  CCT_BGZF_THREADS / CCT_ASYNC_WRITER
+    environment overrides still win over values set here.
+    """
+    global _python_pool_obj
+    with _python_pool_lock:
+        if threads is not None:
+            _cfg["threads"] = max(0, int(threads))
+            if _python_pool_obj is not None:
+                _python_pool_obj.shutdown(wait=False)
+                _python_pool_obj = None
+        if async_write is not None:
+            _cfg["async_write"] = bool(async_write)
+
+
 def codec_threads() -> int:
-    """Worker threads for the native codec's per-batch pthread pool.
+    """Worker threads for the deflate pools (native per-batch pthread
+    pool and the pure-Python per-block thread pool).
 
     Blocks within one batch compress/decompress independently, so output
     bytes are IDENTICAL at any pool size — threads are pure wall-clock
     leverage on multi-core hosts (the north-star v5e host has ~112 vCPUs;
     zlib is the single largest host cost after the columnar passes).
     Default: cpu_count-1 capped at 8; 0 (inline) on single-core hosts.
-    Override with CCT_BGZF_THREADS.
+    Override with CCT_BGZF_THREADS (wins) or config.ini ``[io]
+    bgzf_threads`` via :func:`configure`.
     """
     env = os.environ.get("CCT_BGZF_THREADS")
     if env:
@@ -226,8 +284,31 @@ def codec_threads() -> int:
             return max(0, int(env))
         except ValueError:
             pass
+    if _cfg["threads"] is not None:
+        return int(_cfg["threads"])  # type: ignore[arg-type]
     n = os.cpu_count() or 1
     return 0 if n <= 1 else min(8, n - 1)
+
+
+# Shared pure-Python deflate pool: per-block compression is order-
+# independent (writeback below is ordered), so one process-wide pool
+# serves every writer.  Created lazily; resized by dropping it when
+# :func:`configure` changes the thread count.
+_python_pool_lock = threading.Lock()
+_python_pool_obj = None
+
+
+def _python_pool():
+    n = codec_threads()
+    if n <= 1:
+        return None
+    global _python_pool_obj
+    with _python_pool_lock:
+        if _python_pool_obj is None:
+            from concurrent.futures import ThreadPoolExecutor
+            _python_pool_obj = ThreadPoolExecutor(
+                max_workers=n, thread_name_prefix="bgzf-deflate")
+        return _python_pool_obj
 
 
 _NATIVE_READ_CHUNK = 8 << 20  # compressed bytes per native inflate batch
@@ -393,11 +474,14 @@ def async_write_default() -> bool:
     dispatch/waits, native codec legs, numpy passes) — on the multi-core
     deployment target that is most of the pipeline (VERDICT r3 weak 6).  On
     a single-core host the deflate contends for the same core, so default
-    off there.  Override with CCT_ASYNC_WRITER=0/1.
+    off there.  Override with CCT_ASYNC_WRITER=0/1 (wins) or config.ini
+    ``[io] async_writer`` via :func:`configure`.
     """
     env = os.environ.get("CCT_ASYNC_WRITER")
     if env in ("0", "1"):
         return env == "1"
+    if _cfg["async_write"] is not None:
+        return bool(_cfg["async_write"])
     return (os.cpu_count() or 1) > 1
 
 
@@ -434,6 +518,7 @@ class BgzfWriter(io.RawIOBase):
         # all but the final block).  The inline BAI builder turns these into
         # virtual offsets without ever re-reading the file.
         self.block_sizes: list[int] | None = [] if collect_blocks else None
+        self._eof_written = False
         self._queue = None
         self._worker = None
         self._worker_err: BaseException | None = None
@@ -475,22 +560,40 @@ class BgzfWriter(io.RawIOBase):
 
     # -- deflate (runs on the worker thread when async, else inline) ------
     def _deflate_and_write(self, payload: bytes) -> None:
+        # cct: allow-nondet(deflate wall-clock feeds the write-stats counters only, never output bytes)
+        t0 = time.perf_counter_ns()
+        nbytes = 0
         if self._native:
             threads = codec_threads()
             if self.block_sizes is not None:
                 data, sizes = native.deflate_payload_sizes(payload, self._level,
                                                            threads)
                 self.block_sizes.extend(sizes)
-                self._fh.write(data)
             else:
-                self._fh.write(native.deflate_payload(payload, self._level, threads))
+                data = native.deflate_payload(payload, self._level, threads)
+            self._fh.write(data)
+            nbytes = len(data)
         else:
-            for off in range(0, len(payload), MAX_BLOCK_PAYLOAD):
-                block = compress_block(payload[off:off + MAX_BLOCK_PAYLOAD],
-                                       self._level)
+            # Per-block deflate is embarrassingly parallel AND bit-
+            # reproducible: each block is an independent zlib stream at a
+            # fixed level, and writeback below preserves enqueue order, so
+            # the output bytes are identical at any pool size (same
+            # guarantee the native batch codec makes).
+            chunks = [payload[off:off + MAX_BLOCK_PAYLOAD]
+                      for off in range(0, len(payload), MAX_BLOCK_PAYLOAD)]
+            pool = _python_pool() if len(chunks) > 1 else None
+            if pool is not None:
+                blocks = list(pool.map(
+                    functools.partial(compress_block, level=self._level), chunks))
+            else:
+                blocks = [compress_block(c, self._level) for c in chunks]
+            for block in blocks:
                 if self.block_sizes is not None:
                     self.block_sizes.append(len(block))
                 self._fh.write(block)
+                nbytes += len(block)
+        # cct: allow-nondet(elapsed wall goes to the write-stats counters only, never output bytes)
+        _stats_add((time.perf_counter_ns() - t0) // 1000, nbytes)
 
     def _emit(self, size: int) -> None:
         payload, self._buf = bytes(self._buf[:size]), self._buf[size:]
@@ -510,6 +613,12 @@ class BgzfWriter(io.RawIOBase):
         return len(data)
 
     def close(self) -> None:
+        # Idempotent by construction: ``super().close()`` is guaranteed to
+        # run on the FIRST attempt (nested finally below), so ``closed``
+        # sticks even when flushing or the fh close raises — a retry-close
+        # after a fault-site trip is a no-op instead of stamping a valid
+        # EOF marker onto a truncated stream, and a clean double close
+        # emits the marker exactly once.
         if self.closed:
             return
         try:
@@ -526,11 +635,16 @@ class BgzfWriter(io.RawIOBase):
             if self._worker_err is not None:
                 # Never stamp a valid EOF marker onto a truncated stream.
                 self._raise_worker_err()
-            self._fh.write(BGZF_EOF)
+            if not self._eof_written:
+                self._fh.write(BGZF_EOF)
+                self._eof_written = True
+                _stats_add(0, len(BGZF_EOF))
         finally:
-            if self._own:
-                self._fh.close()
-            super().close()
+            try:
+                if self._own:
+                    self._fh.close()
+            finally:
+                super().close()
 
 
 def total_isize(path) -> int:

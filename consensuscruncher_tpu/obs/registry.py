@@ -38,6 +38,12 @@ COUNTERS = {
                            "SSCS plane store (no plane re-upload)",
     "staged_pair_votes": "duplex votes that re-uploaded planes from host "
                          "BAM bytes (store miss, empty, or broken)",
+    "deflate_wall_us": "wall microseconds spent in BGZF deflate (block "
+                       "compression + compressed write), measured at the "
+                       "writer layer — the quantity the streaming pipeline "
+                       "exists to collapse",
+    "bytes_bam_written": "compressed BGZF bytes written to BAM outputs "
+                         "(headers, blocks and EOF markers included)",
 }
 
 CUMULATIVE_KEYS = tuple(COUNTERS)

@@ -150,6 +150,64 @@ class _Prefetched:
             ) from sys.exc_info()[1]
 
 
+class WriteBehind:
+    """Bounded background writer pool: overlap output serialization with
+    downstream compute.
+
+    The streaming pipeline's final/tap BAM writes are pure sinks — nothing
+    downstream reads them — so they can run behind the next stage's device
+    work instead of serializing it.  ``submit`` blocks once ``depth`` writes
+    are in flight (memory bound: each pending write pins its source arrays),
+    and the FIRST failure is sticky: later submits re-raise it immediately
+    and :meth:`drain` re-raises it after all workers stop, which is the
+    trigger for the CLI's fall-back-to-staged path.
+    """
+
+    def __init__(self, depth: int = 2):
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._pool = ThreadPoolExecutor(
+            max_workers=max(1, depth), thread_name_prefix="cct-writebehind")
+        self._depth = max(1, depth)
+        self._pending: list = []
+        self._error: BaseException | None = None
+
+    def _reap(self, block: bool) -> None:
+        while self._pending and (block or len(self._pending) >= self._depth):
+            fut = self._pending.pop(0)
+            try:
+                fut.result()
+            except BaseException as exc:
+                if self._error is None:
+                    self._error = exc
+
+    def submit(self, fn, *args, **kwargs) -> None:
+        if self._error is not None:
+            raise self._error
+        self._pending.append(self._pool.submit(fn, *args, **kwargs))
+        self._reap(block=False)
+        if self._error is not None:
+            raise self._error
+
+    def drain(self) -> None:
+        """Wait for every pending write; re-raise the first failure."""
+        self._reap(block=True)
+        self._pool.shutdown(wait=True)
+        if self._error is not None:
+            raise self._error
+
+    def abort(self) -> None:
+        """Best-effort teardown: wait out in-flight writes, swallow errors
+        (used on the fall-back path where the error is already being
+        handled)."""
+        try:
+            self._reap(block=True)
+        except BaseException:
+            pass
+        self._error = None
+        self._pool.shutdown(wait=True)
+
+
 def pipelined(
     batches: Iterable[T],
     dispatch: Callable[[T], object],

@@ -201,6 +201,8 @@ class _GangJobState:
         self.prefix = p["sscs_prefix"]
         self.paths = p["sscs"]
         level = int(spec.get("compress_level", 6))
+        self.level = level
+        self.stream_handoff: dict | None = None
         self.reader = ColumnarReader(spec["input"])
         header = self.reader.header
         self.bad_writer = BamWriter(self.paths["bad"], header, atomic=True)
@@ -263,8 +265,30 @@ class _GangJobState:
     def close_outputs(self) -> None:
         self.tracker.mark("consensus")
         self.bad_writer.close()
-        self.sscs_writer.close()
-        self.singleton_writer.close()
+        if str(self.spec.get("pipeline", "")) == "streaming":
+            # Streaming continuation: finish each sort in memory, then
+            # materialize the same file synchronously — durability and the
+            # manifest record are unchanged, but the sorted records also
+            # ride to ``_run_job`` in memory so the rest of the chain skips
+            # the BGZF re-read.  A spilled sort buffer just closes normally
+            # (no hand-off; the job's CLI run re-reads the files).
+            def commit(writer, path):
+                try:
+                    mem = writer.close_to_memory()
+                except RuntimeError:
+                    writer.close()
+                    return None
+                mem.write(path, level=self.level, index=True)
+                return mem
+
+            sscs_mem = commit(self.sscs_writer, self.paths["sscs"])
+            singleton_mem = commit(self.singleton_writer, self.paths["singleton"])
+            if sscs_mem is not None and singleton_mem is not None:
+                self.stream_handoff = {"sscs": sscs_mem,
+                                       "singleton": singleton_mem}
+        else:
+            self.sscs_writer.close()
+            self.singleton_writer.close()
         self.tracker.mark("sort")
 
     def record(self, cutoff: float, qual_threshold: int, backend: str) -> None:
@@ -302,7 +326,7 @@ class _GangJobState:
 
 def gang_sscs(specs: list[dict], counters: Counters | None = None,
               max_batch: int = 1024,
-              trace_ids: list[str] | None = None) -> None:
+              trace_ids: list[str] | None = None) -> list:
     """Run the SSCS stage for several jobs as ONE merged device stream.
 
     Families from every job are interleaved round-robin into a single
@@ -314,6 +338,11 @@ def gang_sscs(specs: list[dict], counters: Counters | None = None,
     ``trace_ids`` (one per spec, positional) lets each shared device batch
     be attributed: the per-batch trace event lists the trace_id of every
     job whose families rode that dispatch.
+
+    Returns one entry per spec: the in-memory SSCS/singleton hand-off for
+    jobs whose spec asks for ``pipeline: streaming`` (None for staged jobs
+    or when the sort spilled), so the caller can continue those jobs'
+    chains without re-reading the stage files.
     """
     from consensuscruncher_tpu.ops.consensus_tpu import (
         ConsensusConfig, consensus_families,
@@ -370,6 +399,7 @@ def gang_sscs(specs: list[dict], counters: Counters | None = None,
                 trace_id=trace_ids[i] if trace_ids else None):
             st.close_outputs()
             st.record(cutoff, qualscore, "tpu")
+    return [st.stream_handoff for st in states]
 
 
 class Scheduler:
@@ -974,9 +1004,11 @@ class Scheduler:
                 faults.fault_point("serve.dispatch")
                 with obs_trace.span("serve.gang", n_jobs=len(gang),
                                     trace_id=gang[0].trace_id):
-                    gang_sscs([j.spec for j in gang], self.counters,
-                              max_batch=self.max_batch,
-                              trace_ids=[j.trace_id for j in gang])
+                    handoffs = gang_sscs([j.spec for j in gang], self.counters,
+                                         max_batch=self.max_batch,
+                                         trace_ids=[j.trace_id for j in gang])
+                for j, h in zip(gang, handoffs):
+                    j._stream_handoff = h
             except Exception as e:
                 # Gang failure granularity is the gang: fall back to solo
                 # runs — each job's resume path re-runs whatever its own
@@ -1044,6 +1076,10 @@ class Scheduler:
         ]
         if spec.get("name"):
             argv += ["--name", spec["name"]]
+        if spec.get("pipeline"):
+            argv += ["--pipeline", str(spec["pipeline"])]
+        if "intermediate_taps" in spec:
+            argv += ["--intermediate_taps", str(bool(spec["intermediate_taps"]))]
         if resume:
             argv += ["--resume", "True"]
         return argv
@@ -1057,11 +1093,24 @@ class Scheduler:
         attempts = int(os.environ.get("CCT_SERVE_RETRIES", "1")) + 1
         base = float(os.environ.get("CCT_RETRY_BASE_S", "0.5"))
         argv = self._argv(job.spec, resume=True)
+        # Streaming jobs: the first attempt runs the streaming chain (with
+        # the gang's in-memory SSCS hand-off when the dispatch produced
+        # one); --resume retries always take the staged path — the CLI's
+        # own streaming guard enforces that, this loop just stops passing
+        # the hand-off, whose memory is released after the first use.
+        streaming = str(job.spec.get("pipeline", "")) == "streaming"
+        handoff = getattr(job, "_stream_handoff", None) if streaming else None
+        job._stream_handoff = None
         for attempt in range(attempts):
             job.attempts += 1
             try:
                 faults.fault_point("serve.worker")
-                rc = cli.main(argv)
+                if streaming and attempt == 0:
+                    rc = cli.main(self._argv(job.spec, resume=False),
+                                  _sscs_handoff=handoff)
+                    handoff = None
+                else:
+                    rc = cli.main(argv)
                 if rc not in (0, None):
                     raise RuntimeError(f"consensus exited rc={rc}")
                 job.outputs = {"base": job_paths(job.spec)["base"]}

@@ -36,6 +36,7 @@ from consensuscruncher_tpu.obs import trace as obs_trace
 from consensuscruncher_tpu.utils import faults, sanitize
 from consensuscruncher_tpu.core.consensus_read import _KEEP_FLAGS
 from consensuscruncher_tpu.core.duplex_cpu import duplex_consensus
+from consensuscruncher_tpu.io import bgzf
 from consensuscruncher_tpu.io.bam import BamWriter
 from consensuscruncher_tpu.io.encode import ConsensusRecordWriter
 from consensuscruncher_tpu.ops.duplex_tpu import duplex_batch_host
@@ -456,6 +457,7 @@ def run_dcs(
     devices: int | None = None,
     level: int = 6,
     residency=None,
+    stream_out=None,
 ) -> DcsResult:
     """``devices``: shard the duplex vote's pair axis across this many chips
     (``parallel.mesh``); None/1 = single device.  tpu backend only.
@@ -463,7 +465,12 @@ def run_dcs(
     ``residency``: the SSCS stage's ``ops.packing.resident_planes()`` store;
     pairs found resident vote on device without re-uploading their planes
     (tentpole h2d saving).  Ignored on the windows fallback path (foreign
-    BAMs were never produced by this pipeline's SSCS stage)."""
+    BAMs were never produced by this pipeline's SSCS stage).
+
+    ``stream_out``: a ``core.streamgraph.StreamOut``; the DCS and
+    unpaired-SSCS outputs (both finals) hand off in memory for the
+    all-unique merges while materializing on the write-behind pool.
+    ``sscs_bam`` may then be an in-memory batch source."""
     mesh = None
     if devices is not None and devices > 1:
         if backend != "tpu":
@@ -486,9 +493,10 @@ def run_dcs(
     paths = output_paths(out_prefix)
     dcs_path, unpaired_path = paths["dcs"], paths["unpaired"]
 
-    from consensuscruncher_tpu.io.columnar import ColumnarReader, SortingBamWriter
+    from consensuscruncher_tpu.io.columnar import (SortingBamWriter,
+                                                   open_batch_source)
 
-    reader = ColumnarReader(sscs_bam)
+    reader = open_batch_source(sscs_bam)
     dcs_writer = SortingBamWriter(dcs_path, reader.header, level=level)
     unpaired_writer = SortingBamWriter(unpaired_path, reader.header, level=level)
     rec_writer = ConsensusRecordWriter(dcs_writer)
@@ -498,6 +506,7 @@ def run_dcs(
     cum = Counters()
     recompiles_before = obs_metrics.recompiles()
     transfers_before = obs_metrics.transfer_bytes()
+    io_before = bgzf.write_stats()
     ok = False
     try:
         try:
@@ -516,7 +525,7 @@ def run_dcs(
             dcs_writer.abort()
             unpaired_writer.abort()
             stats = StageStats("DCS")
-            reader = ColumnarReader(sscs_bam)
+            reader = open_batch_source(sscs_bam)
             dcs_writer = SortingBamWriter(dcs_path, reader.header, level=level)
             unpaired_writer = SortingBamWriter(unpaired_path, reader.header,
                                                level=level)
@@ -536,8 +545,16 @@ def run_dcs(
 
     tracker.mark("pairing")
     with obs_trace.span("writer.commit", stage="dcs"):
-        dcs_writer.close()
-        unpaired_writer.close()
+        if stream_out is not None:
+            # Both outputs are finals: hand off for the all-unique merges
+            # while the write-behind pool materializes the files.
+            stream_out.capture("dcs", dcs_writer.close_to_memory(),
+                               file_path=dcs_path, level=level)
+            stream_out.capture("unpaired", unpaired_writer.close_to_memory(),
+                               file_path=unpaired_path, level=level)
+        else:
+            dcs_writer.close()
+            unpaired_writer.close()
     tracker.mark("sort")
     record_backend(stats, backend)
     stats.write(paths["stats_txt"])
@@ -548,6 +565,11 @@ def run_dcs(
     transfers = obs_metrics.transfer_bytes()
     cum.add("bytes_h2d", transfers["h2d"] - transfers_before["h2d"])
     cum.add("bytes_d2h", transfers["d2h"] - transfers_before["d2h"])
+    iostat = bgzf.write_stats()
+    cum.add("deflate_wall_us",
+            iostat["deflate_wall_us"] - io_before["deflate_wall_us"])
+    cum.add("bytes_bam_written",
+            iostat["bytes_written"] - io_before["bytes_written"])
     write_metrics(
         f"{out_prefix}.dcs.metrics.json", "DCS", tracker.as_phases(),
         {"backend": backend, "jax_backend": stats.get("jax_backend"),

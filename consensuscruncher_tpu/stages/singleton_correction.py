@@ -43,6 +43,7 @@ import numpy as np
 
 from consensuscruncher_tpu.core import tags as tags_mod
 from consensuscruncher_tpu.core.duplex_cpu import correct_singleton
+from consensuscruncher_tpu.io import bgzf
 from consensuscruncher_tpu.io.bam import BamReader, BamRead
 from consensuscruncher_tpu.ops.singleton_tpu import best_matches
 from consensuscruncher_tpu.stages.grouping import consensus_windows
@@ -146,7 +147,7 @@ def _run_rescue_blocks(singleton_bam, sscs_bam, writers, stats, backend,
     blocks, no preexisting XR tag) — foreign layouts raise and the caller
     falls back to the object walk."""
     from consensuscruncher_tpu.core.consensus_cpu import DEFAULT_QUAL_CAP
-    from consensuscruncher_tpu.io.columnar import ColumnarReader
+    from consensuscruncher_tpu.io.columnar import open_batch_source
     from consensuscruncher_tpu.io.encode import encode_records
     from consensuscruncher_tpu.stages.dcs_maker import _duplex_vote_batch, _qname_bytes
     from consensuscruncher_tpu.stages.grouping import singleton_rescue_blocks
@@ -154,8 +155,8 @@ def _run_rescue_blocks(singleton_bam, sscs_bam, writers, stats, backend,
 
     _XR_SSCS = np.frombuffer(b"XRZsscs\x00", np.uint8)
     _XR_SINGLE = np.frombuffer(b"XRZsingleton\x00", np.uint8)
-    s_reader = ColumnarReader(singleton_bam)
-    x_reader = ColumnarReader(sscs_bam)
+    s_reader = open_batch_source(singleton_bam)
+    x_reader = open_batch_source(sscs_bam)
     try:
         header = s_reader.header
         for blk in singleton_rescue_blocks(s_reader, x_reader, header):
@@ -361,6 +362,7 @@ def run_singleton_correction(
     _force_object: bool = False,
     level: int = 6,
     residency=None,
+    stream_out=None,
 ) -> SingletonResult:
     """``backend="cpu"`` keeps the Hamming matcher in numpy — a cpu run
     must never touch (or wait on) a device backend.
@@ -372,7 +374,14 @@ def run_singleton_correction(
     ``max_mismatch == 0`` (exact complementary-tag matching, the default)
     runs the vectorized RescueBlock path; ``max_mismatch > 0`` (and foreign
     tag layouts) use the object window walk.  ``_force_object`` exists for
-    the byte-parity test suite."""
+    the byte-parity test suite.
+
+    ``stream_out``: a ``core.streamgraph.StreamOut``; outputs hand off in
+    memory — remaining singletons stay a final output (write-behind
+    materialization), the two rescue BAMs become debug taps.  Requires
+    the vectorized path (``max_mismatch == 0``); ``singleton_bam`` /
+    ``sscs_bam`` may then be in-memory batch sources, and a foreign-
+    layout fallback (which needs file re-reads) raises instead."""
     from consensuscruncher_tpu.utils.profiling import write_metrics
     from consensuscruncher_tpu.utils.stats import TimeTracker
 
@@ -387,13 +396,20 @@ def run_singleton_correction(
     from consensuscruncher_tpu.obs import metrics as obs_metrics
     from consensuscruncher_tpu.utils.profiling import Counters
 
+    if stream_out is not None and (max_mismatch != 0 or _force_object):
+        raise RuntimeError(
+            "streaming hand-off requires the vectorized rescue path")
     cum = Counters()
     recompiles_before = obs_metrics.recompiles()
     transfers_before = obs_metrics.transfer_bytes()
+    io_before = bgzf.write_stats()
     if max_mismatch == 0 and not _force_object:
-        hdr_reader = BamReader(singleton_bam)
-        header = hdr_reader.header
-        hdr_reader.close()
+        if hasattr(singleton_bam, "header"):
+            header = singleton_bam.header
+        else:
+            hdr_reader = BamReader(singleton_bam)
+            header = hdr_reader.header
+            hdr_reader.close()
         writers = {k: SortingBamWriter(p, header, level=level) for k, p in paths.items()}
         ok = False
         try:
@@ -402,15 +418,27 @@ def run_singleton_correction(
                                    backend, resident=residency, cum=cum)
                 ok = True
             except ValueError as e:
-                if "foreign tag layout" not in str(e):
+                if "foreign tag layout" not in str(e) or stream_out is not None:
+                    # in-memory sources can't re-read as files for the
+                    # object walk — surface to the staged-fallback path
                     raise
         finally:
             if not ok:
                 for w in writers.values():
                     w.abort()
         if ok:
-            for w in writers.values():
-                w.close()
+            if stream_out is not None:
+                stream_out.capture(
+                    "remaining", writers["remaining"].close_to_memory(),
+                    file_path=paths["remaining"], level=level)
+                for k in ("sscs_rescue", "singleton_rescue"):
+                    stream_out.capture(
+                        k, writers[k].close_to_memory(),
+                        file_path=paths[k] if stream_out.taps else None,
+                        level=level)
+            else:
+                for w in writers.values():
+                    w.close()
             stats.set("max_mismatch", max_mismatch)
             record_backend(stats, backend)
             stats.write(all_paths["stats_txt"])
@@ -420,6 +448,11 @@ def run_singleton_correction(
             transfers = obs_metrics.transfer_bytes()
             cum.add("bytes_h2d", transfers["h2d"] - transfers_before["h2d"])
             cum.add("bytes_d2h", transfers["d2h"] - transfers_before["d2h"])
+            iostat = bgzf.write_stats()
+            cum.add("deflate_wall_us",
+                    iostat["deflate_wall_us"] - io_before["deflate_wall_us"])
+            cum.add("bytes_bam_written",
+                    iostat["bytes_written"] - io_before["bytes_written"])
             write_metrics(
                 f"{out_prefix}.singleton.metrics.json", "singleton_correction",
                 tracker.as_phases(),

@@ -41,6 +41,7 @@ from consensuscruncher_tpu.core.consensus_read import (
     build_consensus_read,
     modal_cigar,
 )
+from consensuscruncher_tpu.io import bgzf
 from consensuscruncher_tpu.io.bam import BamReader, BamWriter
 from consensuscruncher_tpu.io.encode import (
     ConsensusRecordWriter,
@@ -197,6 +198,7 @@ def run_sscs(
     input_range=None,
     prestaged: "PrestagedBlocks | None" = None,
     residency=None,
+    stream_out=None,
 ) -> SscsResult:
     """``devices``: shard each family batch across this many chips
     (``parallel.mesh`` family-data-parallel path); None/1 = single device.
@@ -219,7 +221,15 @@ def run_sscs(
     it (keyed by SSCS qname) so the downstream rescue/DCS stages can vote
     by device gather instead of re-uploading these bytes.  Ignored on
     non-block paths (cpu/reference/dense/mesh — those fall back to staged
-    duplex votes downstream, byte-identical)."""
+    duplex votes downstream, byte-identical).
+
+    ``stream_out``: a ``core.streamgraph.StreamOut``; when given, the
+    sorted SSCS/singleton outputs are handed off in memory
+    (``close_to_memory``) instead of committed to disk here — the SSCS
+    BAM still materializes (final output, via the write-behind pool) but
+    the singleton BAM becomes a debug tap, written only when the stream
+    asked for taps.  ``in_bam`` may then also be an in-memory batch
+    source instead of a path."""
     if backend not in ("cpu", "tpu", "reference"):
         raise ValueError(
             f"unknown backend {backend!r} (expected 'cpu', 'tpu', or 'reference')"
@@ -248,6 +258,7 @@ def run_sscs(
     cum = Counters()
     recompiles_before = obs_metrics.recompiles()
     transfers_before = obs_metrics.transfer_bytes()
+    io_before = bgzf.write_stats()
     cfg = ConsensusConfig(cutoff=cutoff, qual_threshold=qual_threshold, qual_cap=qual_cap)
 
     paths = output_paths(out_prefix)
@@ -276,9 +287,13 @@ def run_sscs(
         # (same events, same order — stage outputs are byte-identical).
         # ``input_range``: a BAI coordinate range of the shared input
         # (--host_workers reads ranges directly, no slice files).
-        from consensuscruncher_tpu.io.columnar import ColumnarReader
+        from consensuscruncher_tpu.io.columnar import (ColumnarReader,
+                                                       open_batch_source)
 
-        reader = ColumnarReader(in_bam, bam_range=input_range)
+        if input_range is not None:
+            reader = ColumnarReader(in_bam, bam_range=input_range)
+        else:
+            reader = open_batch_source(in_bam)
         header = reader.header
         source = None  # built below once the pipeline flavor is known
     use_blocks = backend == "tpu" and wire == "stream"
@@ -533,8 +548,20 @@ def run_sscs(
     # sorting writers do their lexsort + final BGZF write inside close()
     with obs_trace.span("writer.commit", stage="sscs"):
         bad_writer.close()
-        sscs_writer.close()
-        singleton_writer.close()
+        if stream_out is not None:
+            # Streaming hand-off: finish the sort in memory.  The SSCS BAM
+            # is a final output (write-behind materialization); the
+            # singleton BAM only exists to feed rescue, so it becomes a
+            # debug tap.
+            stream_out.capture("sscs", sscs_writer.close_to_memory(),
+                               file_path=sscs_path, level=level)
+            stream_out.capture(
+                "singleton", singleton_writer.close_to_memory(),
+                file_path=singleton_path if stream_out.taps else None,
+                level=level)
+        else:
+            sscs_writer.close()
+            singleton_writer.close()
     tracker.mark("sort")
 
     record_backend(stats, backend)
@@ -548,6 +575,11 @@ def run_sscs(
     transfers = obs_metrics.transfer_bytes()
     cum.add("bytes_h2d", transfers["h2d"] - transfers_before["h2d"])
     cum.add("bytes_d2h", transfers["d2h"] - transfers_before["d2h"])
+    iostat = bgzf.write_stats()
+    cum.add("deflate_wall_us",
+            iostat["deflate_wall_us"] - io_before["deflate_wall_us"])
+    cum.add("bytes_bam_written",
+            iostat["bytes_written"] - io_before["bytes_written"])
     write_metrics(
         f"{out_prefix}.metrics.json", "SSCS", tracker.as_phases(),
         {"backend": backend, "jax_backend": jax_backend,

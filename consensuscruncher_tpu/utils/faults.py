@@ -47,6 +47,10 @@ Sites wired in this codebase (grep for ``fault_point``/``faults.hook``):
   serve.journal_replay corrupt journal record -> skip + log, rest recovers
   serve.sigterm        shutdown handler -> immediate stop, replay recovers
   serve.shed           deadline admission check -> forced shed
+  stream.channel_full  streaming backpressure engaged -> clean abort, not
+                       deadlock (CLI falls back to the staged pipeline)
+  stream.operator_fail mid-stream producer fault -> channel poisoned ->
+                       staged-pipeline fallback, byte-identical outputs
 
 Everything here is stdlib-only and import-cheap: io/bgzf.py and the
 tools/ scripts (whose parents must never import jax) both import it.

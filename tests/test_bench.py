@@ -32,6 +32,7 @@ def test_bench_main_one_json_line_when_tpu_dead():
             "PALLAS_AXON_POOL_IPS": "",
             "CCT_BENCH_FRAGMENTS": "300",
             "CCT_BENCH_REF_FRAGMENTS": "60",
+            "CCT_BENCH_PIPELINE_FRAGMENTS": "800",
             "CCT_BENCH_PROBE_TIMEOUT": "3",
             "CCT_BENCH_PROBE_ATTEMPTS": "2",
             "CCT_BENCH_PROBE_BACKOFF": "1",
@@ -66,6 +67,7 @@ def test_bench_metric_line_is_final_stdout_line_even_with_merged_streams():
         "PALLAS_AXON_POOL_IPS": "",
         "CCT_BENCH_FRAGMENTS": "120",
         "CCT_BENCH_REF_FRAGMENTS": "30",
+        "CCT_BENCH_PIPELINE_FRAGMENTS": "800",
         "CCT_BENCH_PROBE_TIMEOUT": "3",
         "CCT_BENCH_PROBE_ATTEMPTS": "1",
         "CCT_BENCH_CPU_TIMEOUT": "300",

@@ -1,0 +1,184 @@
+"""Streaming dataflow primitives (core.streamgraph): channel backpressure,
+poison propagation, operator fault conversion — including chaos coverage of
+the two ``stream.*`` fault sites the CLI's staged fallback is tested
+against (tests/test_streaming_parity.py covers the CLI half)."""
+
+import threading
+import time
+
+import pytest
+
+from consensuscruncher_tpu.core.streamgraph import (
+    BatchStream,
+    Channel,
+    ChannelClosed,
+    Operator,
+    StreamOut,
+)
+from consensuscruncher_tpu.utils.faults import FaultError
+
+
+def test_channel_fifo_and_clean_close():
+    ch = Channel(capacity=4)
+    for i in range(3):
+        ch.put(i)
+    ch.close()
+    assert list(ch) == [0, 1, 2]
+
+
+def test_channel_backpressure_blocks_producer_until_drained():
+    ch = Channel(capacity=1)
+    ch.put(0)
+    done = []
+
+    def producer():
+        ch.put(1)  # at capacity: must block until the consumer pulls
+        done.append(True)
+        ch.close()
+
+    t = threading.Thread(target=producer, daemon=True)
+    t.start()
+    time.sleep(0.05)
+    assert not done, "producer ran through a full channel"
+    assert list(ch) == [0, 1]
+    t.join(5)
+    assert done
+
+
+def test_channel_fail_drops_queue_and_poisons_consumer():
+    ch = Channel(capacity=2)
+    ch.put("item")
+    ch.fail(RuntimeError("boom"))
+    # fail-fast: the poison outranks queued items
+    with pytest.raises(RuntimeError, match="boom"):
+        ch.get()
+
+
+def test_channel_put_after_close_raises():
+    ch = Channel()
+    ch.close()
+    with pytest.raises(ChannelClosed):
+        ch.put(1)
+
+
+def test_channel_fail_releases_blocked_producer():
+    ch = Channel(capacity=1)
+    ch.put(0)
+    errs = []
+
+    def producer():
+        try:
+            ch.put(1)
+        except ChannelClosed:
+            errs.append("closed")
+
+    t = threading.Thread(target=producer, daemon=True)
+    t.start()
+    time.sleep(0.05)
+    ch.fail(ChannelClosed("consumer walked away"))
+    t.join(5)
+    assert errs == ["closed"]
+
+
+def test_operator_pumps_and_closes():
+    ch = Channel(capacity=2)
+    Operator("t", iter(range(5)), ch)
+    assert list(ch) == list(range(5))
+
+
+def test_operator_callable_source_built_on_worker_thread():
+    built_on = []
+
+    def make():
+        built_on.append(threading.current_thread().name)
+        return iter([1, 2])
+
+    ch = Channel(capacity=2)
+    Operator("lazy", make, ch)
+    assert list(ch) == [1, 2]
+    assert built_on == ["cct-stream-lazy"]
+
+
+def test_operator_exception_poisons_channel():
+    def src():
+        yield 1
+        raise ValueError("mid-stream")
+
+    ch = Channel(capacity=2)
+    Operator("t", src(), ch)
+    with pytest.raises(ValueError, match="mid-stream"):
+        list(ch)
+
+
+class _FakeSource:
+    """Duck-typed batch source (MemoryBam shape: header/batches/close)."""
+
+    def __init__(self, items):
+        self.header = "hdr"
+        self.items = items
+        self.closed = 0
+
+    def batches(self, batch_bytes=None):
+        return iter(self.items)
+
+    def close(self):
+        self.closed += 1
+
+
+def test_batchstream_reads_ahead_and_closes_source():
+    src = _FakeSource([1, 2, 3])
+    bs = BatchStream(src, capacity=2)
+    assert bs.header == "hdr"
+    assert list(bs.batches()) == [1, 2, 3]
+    bs.close()
+    assert src.closed == 1
+
+
+def test_streamout_capture_keeps_memory_and_write_behind(tmp_path):
+    writes = []
+
+    class Mem:
+        def write(self, path, level=6, index=True):
+            writes.append((path, level, index))
+
+    out = StreamOut(taps=False)
+    m = Mem()
+    out.capture("sscs", m, file_path=str(tmp_path / "a.bam"), level=1)
+    out.capture("singleton", Mem(), file_path=None)  # tap off: memory only
+    out.drain()
+    assert out.memory["sscs"] is m
+    assert writes == [(str(tmp_path / "a.bam"), 1, True)]
+
+
+def test_streamout_drain_surfaces_background_error():
+    class Bad:
+        def write(self, path, level=6, index=True):
+            raise OSError("disk gone")
+
+    out = StreamOut()
+    out.capture("x", Bad(), file_path="/nonexistent/never-written.bam")
+    with pytest.raises(OSError, match="disk gone"):
+        out.drain()
+
+
+# ---- chaos: the stream.* fault sites (registered in tools/cctlint) ----
+
+def test_chaos_channel_full_fires_on_backpressure(monkeypatch):
+    """``stream.channel_full`` fires exactly when backpressure engages —
+    a wedged consumer aborts the run instead of deadlocking it."""
+    monkeypatch.setenv("CCT_FAULTS", "stream.channel_full=fail")
+    ch = Channel(capacity=1)
+    ch.put(0)  # below capacity: the site must stay silent
+    with pytest.raises(FaultError):
+        ch.put(1)  # at capacity -> armed site trips before the wait
+
+
+def test_chaos_operator_fail_poisons_channel(monkeypatch):
+    """``stream.operator_fail`` converts a mid-stream producer fault into
+    channel poison that surfaces at the consumer (the CLI treats this as
+    the cue to fall back to the staged pipeline)."""
+    monkeypatch.setenv("CCT_FAULTS", "stream.operator_fail=fail@1")
+    ch = Channel(capacity=2)
+    Operator("t", iter(range(3)), ch)
+    with pytest.raises(FaultError):
+        list(ch)

@@ -1,0 +1,148 @@
+"""Streaming pipeline acceptance: byte parity with the staged pipeline,
+taps-off intermediate elision, chaos fallback, and the serve gang handoff.
+
+The streaming dataflow (``--pipeline streaming``) replaces every
+stage→BAM→stage materialization with bounded in-memory record flows; the
+contract is that final outputs stay BYTE-identical to the staged pipeline
+(same records, same sort, same BGZF framing at the same level), that
+intermediates only exist when ``--intermediate_taps`` asks for them, and
+that any mid-stream fault lands the run back on the staged path with
+untouched outputs.
+"""
+
+import hashlib
+import json
+import os
+
+import pytest
+
+from consensuscruncher_tpu.cli import main
+from consensuscruncher_tpu.utils.simulate import SimConfig, simulate_bam
+
+NAME = "s"
+
+# The stage-boundary files the streaming pipeline stops writing unless
+# --intermediate_taps is on.  (dcs/s.sscs.singleton.sorted.bam is NOT one
+# of these: despite the name it is the unpaired-SSCS FINAL.)
+INTERMEDIATES = (
+    f"sscs/{NAME}.singleton.sorted.bam",
+    f"singleton/{NAME}.sscs.rescue.sorted.bam",
+    f"singleton/{NAME}.singleton.rescue.sorted.bam",
+    f"dcs/{NAME}.sscs.rescued.bam",
+)
+
+
+def _tree_digests(base) -> dict[str, str]:
+    """relpath -> sha256 for every .bam/.bai under ``base``."""
+    out = {}
+    for root, _dirs, files in os.walk(base):
+        for f in files:
+            if f.endswith((".bam", ".bai")):
+                p = os.path.join(root, f)
+                rel = os.path.relpath(p, base)
+                out[rel] = hashlib.sha256(open(p, "rb").read()).hexdigest()
+    return out
+
+
+def _run(bam, outdir, *extra) -> dict:
+    rc = main(["consensus", "-i", str(bam), "-o", str(outdir), "-n", NAME,
+               "--backend", "cpu", *extra])
+    assert rc == 0
+    return json.load(open(os.path.join(str(outdir), NAME, "run.metrics.json")))
+
+
+@pytest.fixture(scope="module")
+def staged(tmp_path_factory):
+    """One simulated input + one staged reference run, shared by the
+    parity tests (each streaming run gets its own output dir)."""
+    td = tmp_path_factory.mktemp("stream_parity")
+    bam = td / "in.bam"
+    simulate_bam(str(bam), SimConfig(n_fragments=60, seed=7,
+                                     mean_family_size=3.0))
+    metrics = _run(bam, td / "staged")
+    return {"bam": bam, "base": td / "staged" / NAME, "metrics": metrics}
+
+
+def test_staged_run_metrics_shape(staged):
+    m = staged["metrics"]
+    assert m["pipeline"] == "staged"
+    assert m["wall_s"] > 0
+    assert m["bytes_bam_written"] > 0
+    assert m["intermediate_bam_bytes"] > 0  # staged materializes them all
+
+
+def test_streaming_with_taps_is_byte_identical(staged, tmp_path):
+    m = _run(staged["bam"], tmp_path / "stream", "--pipeline", "streaming",
+             "--intermediate_taps", "True")
+    assert m["pipeline"] == "streaming"
+    ref = _tree_digests(staged["base"])
+    got = _tree_digests(tmp_path / "stream" / NAME)
+    assert got == ref  # every BAM and index, bit for bit — taps included
+
+
+def test_streaming_without_taps_finals_identical_no_intermediates(
+        staged, tmp_path):
+    m = _run(staged["bam"], tmp_path / "nt", "--pipeline", "streaming")
+    assert m["pipeline"] == "streaming"
+    assert m["intermediate_bam_bytes"] == 0
+    ref = _tree_digests(staged["base"])
+    got = _tree_digests(tmp_path / "nt" / NAME)
+    skipped = {r for r in ref if any(r.startswith(i) for i in INTERMEDIATES)}
+    assert skipped, "reference run produced no intermediates to elide"
+    assert set(got) == set(ref) - skipped
+    assert got == {r: ref[r] for r in got}  # finals still bit-identical
+
+
+def test_chaos_midstream_fault_falls_back_to_staged(staged, tmp_path,
+                                                    monkeypatch, capsys):
+    """``stream.operator_fail=fail@1`` poisons the first streaming channel
+    mid-run; the CLI must complete on the staged path with outputs
+    byte-identical to a never-streamed run."""
+    monkeypatch.setenv("CCT_FAULTS", "stream.operator_fail=fail@1")
+    m = _run(staged["bam"], tmp_path / "chaos", "--pipeline", "streaming")
+    assert m["pipeline"] == "staged"  # what the run ACTUALLY took
+    assert "falling back to the staged pipeline" in capsys.readouterr().err
+    assert _tree_digests(tmp_path / "chaos" / NAME) == \
+        _tree_digests(staged["base"])
+
+
+def test_serve_gang_handoff_streaming_matches_golden(tmp_path):
+    """A streaming-spec job through the serve scheduler: the gang's SSCS
+    leg hands its sorted outputs to the streaming chain in memory, and the
+    result must still hit the frozen one-shot goldens."""
+    import sys
+
+    REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, os.path.join(REPO, "test"))
+    from make_test_data import canonical_bam_digest, text_digest
+
+    from consensuscruncher_tpu.serve.scheduler import Scheduler
+
+    golden = json.load(open(os.path.join(REPO, "test", "golden.json")))
+    sample = os.path.join(REPO, "test", "data", "sample.bam")
+    spec = {
+        "input": sample, "output": str(tmp_path / "g"), "name": "golden",
+        "cutoff": 0.7, "qualscore": 0, "scorrect": True, "max_mismatch": 0,
+        "bdelim": "|", "compress_level": 6,
+        "pipeline": "streaming", "intermediate_taps": True,
+    }
+    sched = Scheduler(queue_bound=2, gang_size=2, backend="tpu", paused=True)
+    try:
+        job = sched.submit(spec)
+        sched.release()
+        sched.wait(job.id, timeout=600)
+        assert job.state == "done", job.error
+    finally:
+        sched.close(timeout=120)
+    base = tmp_path / "g" / "golden"
+    mismatches = []
+    for rel, expected in golden["consensus"].items():
+        p = os.path.join(str(base), rel)
+        assert os.path.exists(p), f"missing output {rel}"
+        got = (canonical_bam_digest(p) if rel.endswith(".bam")
+               else text_digest(p))
+        if got != expected:
+            mismatches.append(rel)
+    assert not mismatches, f"streaming gang diverges from golden: {mismatches}"
+    m = json.load(open(base / "run.metrics.json"))
+    assert m["pipeline"] == "streaming"

@@ -48,4 +48,10 @@ FAULT_SITES: dict[str, str] = {
     "serve.shed": "deadline admission check -> forced shed (refused reply)",
     "sscs.sync_probe": "sanitizer self-test: mid-stage host sync is caught "
                        "by CCT_SANITIZE=1 stage guards",
+    "stream.channel_full": "streaming backpressure engages (bounded channel "
+                           "at capacity) -> a wedged consumer aborts the "
+                           "run cleanly instead of deadlocking it",
+    "stream.operator_fail": "mid-stream producer fault -> channel poisoned, "
+                            "surfaces at the consumer -> CLI falls back to "
+                            "the staged pipeline, outputs byte-identical",
 }

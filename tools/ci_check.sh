@@ -72,6 +72,40 @@ with tempfile.TemporaryDirectory() as work:
 print("ci_check: autotune + residency CPU smoke OK")
 PY
 
+echo "== streaming pipeline parity (streaming == staged, byte for byte) =="
+JAX_PLATFORMS=cpu PYTHONPATH="$REPO" python - <<'PY'
+import hashlib, json, os, tempfile
+from consensuscruncher_tpu.cli import main
+from consensuscruncher_tpu.utils.simulate import SimConfig, simulate_bam
+
+def tree(base):
+    out = {}
+    for root, _dirs, files in os.walk(base):
+        for f in files:
+            if f.endswith((".bam", ".bai")):
+                p = os.path.join(root, f)
+                out[os.path.relpath(p, base)] = hashlib.sha256(
+                    open(p, "rb").read()).hexdigest()
+    return out
+
+with tempfile.TemporaryDirectory() as work:
+    bam = os.path.join(work, "in.bam")
+    simulate_bam(bam, SimConfig(n_fragments=80, seed=13, mean_family_size=3.0))
+    for mode, extra in (("staged", []),
+                        ("streaming", ["--pipeline", "streaming",
+                                       "--intermediate_taps", "True"])):
+        assert main(["consensus", "-i", bam, "-o", os.path.join(work, mode),
+                     "-n", "s", "--backend", "cpu", *extra]) == 0
+    ref = tree(os.path.join(work, "staged", "s"))
+    got = tree(os.path.join(work, "streaming", "s"))
+    assert ref and got == ref, "streaming output diverges from staged: " + str(
+        sorted(set(ref) ^ set(got)) or
+        sorted(k for k in ref if ref[k] != got.get(k)))
+    m = json.load(open(os.path.join(work, "streaming", "s", "run.metrics.json")))
+    assert m["pipeline"] == "streaming", m
+print("ci_check: streaming == staged byte parity OK")
+PY
+
 echo "== loadgen smoke x2 (throwaway daemon; pass 2 under the learned table) =="
 WORK="$(mktemp -d)"
 trap 'rm -rf "$WORK"' EXIT
