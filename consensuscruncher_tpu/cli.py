@@ -1021,7 +1021,7 @@ def _serve_child_argv(args) -> list[str]:
     the resolved values (flag > config > builtin), minus --supervise."""
     argv = ["serve"]
     for flag in ("socket", "host", "warmup_shapes", "compile_cache",
-                 "journal", "backend"):
+                 "journal", "backend", "node"):
         value = getattr(args, flag, None)
         if value:
             argv += [f"--{flag}", str(value)]
@@ -1165,6 +1165,7 @@ def serve_cmd(args) -> None:
             getattr(args, "slo_targets", ""), "--slo_targets"),
         tenant_queue_cap=_cap("tenant_queue_cap"),
         tenant_inflight_cap=_cap("tenant_inflight_cap"),
+        node=getattr(args, "node", None) or None,
     )
     scheduler.autotune_info = lambda: {
         "shapes": len(autotuner.table),
@@ -1254,6 +1255,138 @@ def submit_cmd(args) -> None:
     base = (job.get("outputs") or {}).get("base")
     print(f"submit: job {job_id} done in {job['wall_s']}s"
           + (f" — outputs under {base}" if base else ""))
+
+
+def _spawn_fleet(args, children: dict) -> list:
+    """``route --spawn N``: launch N worker daemons under ``--workdir``
+    (per-worker socket/journal/compile-cache/autotune table), each kept
+    alive by the :mod:`serve.supervisor` restart policy in its own
+    thread.  ``children`` collects the live Popen per member name so the
+    router's shutdown can SIGTERM them into a clean drain (rc 0 stops
+    the supervisor loop too).  Returns ``[(name, socket_path), ...]``."""
+    import threading
+
+    from consensuscruncher_tpu.serve.supervisor import (
+        child_command, run_supervised,
+    )
+
+    n = int(args.spawn)
+    workdir = os.path.abspath(args.workdir or "fleet")
+    os.makedirs(workdir, exist_ok=True)
+    members = []
+    for i in range(n):
+        name = f"w{i}"
+        sock = os.path.join(workdir, f"{name}.sock")
+        if os.path.exists(sock):
+            os.unlink(sock)  # stale socket from a previous fleet
+        serve_argv = [
+            "serve", "--socket", sock, "--node", name,
+            "--journal", os.path.join(workdir, f"{name}.journal"),
+            "--compile_cache",
+            args.compile_cache or os.path.join(workdir, f"{name}.cache"),
+            "--gang_size", str(int(args.gang_size)),
+            "--queue_bound", str(int(args.queue_bound)),
+            "--max_batch", str(int(args.max_batch)),
+            "--backend", args.backend,
+        ]
+        for flag in ("warmup_shapes", "class_weights", "slo_targets",
+                     "drain_s"):
+            value = getattr(args, flag, None)
+            if value not in (None, ""):
+                serve_argv += [f"--{flag}", str(value)]
+        cmd = child_command(serve_argv)
+
+        def _spawn(argv, _name=name):
+            child = subprocess.Popen(argv)
+            children[_name] = child
+            return child
+
+        threading.Thread(
+            target=run_supervised, args=(cmd,),
+            kwargs={"spawn": _spawn,
+                    "max_restarts": int(args.max_restarts)},
+            name=f"fleet-{name}", daemon=True).start()
+        members.append((name, sock))
+    # ready gate: a worker's socket appears only once it is accepting
+    deadline = time.monotonic() + float(
+        os.environ.get("CCT_ROUTE_SPAWN_WAIT_S", "180"))
+    for name, sock in members:
+        while not os.path.exists(sock):
+            if time.monotonic() > deadline:
+                raise SystemExit(
+                    f"route: worker {name} never came up ({sock} missing)")
+            time.sleep(0.2)
+        print(f"route: member {name} up at {sock}", flush=True)
+    return members
+
+
+def route_cmd(args) -> None:
+    """Run the fleet router (serve/router.py): a stateless front door
+    consistent-hashing submits by idempotency key onto N worker daemons,
+    with replay-aware failover and bounded cross-node work stealing.
+    ``--members`` points at externally managed daemons; ``--spawn N``
+    brings up a local fleet under the supervisor restart policy."""
+    from consensuscruncher_tpu.serve.router import (
+        Router, RouterServer, parse_members,
+    )
+    from consensuscruncher_tpu.serve.server import install_signal_handlers
+
+    children: dict = {}
+    if int(args.spawn or 0) > 0:
+        members = _spawn_fleet(args, children)
+    elif getattr(args, "members", None):
+        members = parse_members(args.members)
+    else:
+        raise SystemExit("route: pass --members 'n0=sock,...' for an "
+                         "existing fleet, or --spawn N to launch one")
+    router = Router(
+        members,
+        vnodes=int(args.vnodes),
+        steal_threshold=int(args.steal_threshold),
+        steal_margin=int(args.steal_margin),
+        health_interval_s=float(args.health_interval_s),
+        down_after=int(args.down_after),
+    )
+    server = RouterServer(router, host=args.host, port=int(args.port),
+                          socket_path=args.socket or None)
+    install_signal_handlers(server, router, None)
+    print(f"route: fleet front door on {server.describe()} over "
+          f"{len(members)} members "
+          f"({', '.join(name for name, _ in members)}); "
+          f"steal_threshold={router.steal_threshold}, "
+          f"steal_margin={router.steal_margin}", flush=True)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    drain_s = args.drain_s
+    if drain_s in (None, ""):
+        drain_s = os.environ.get("CCT_SERVE_DRAIN_S", "30")
+    drain_s = float(drain_s)
+    if children:
+        # our own fleet: SIGTERM each worker into its bounded drain (the
+        # supervisor sees rc 0 and stops restarting); external members
+        # (--members) are left serving — drain them via the drain op.
+        print(f"route: draining {len(children)} spawned workers "
+              f"(up to {drain_s:g}s)", flush=True)
+        for child in children.values():
+            if child.poll() is None:
+                try:
+                    child.terminate()
+                except OSError:
+                    pass
+        deadline = time.monotonic() + drain_s + 10.0
+        for name, child in children.items():
+            while child.poll() is None and time.monotonic() < deadline:
+                time.sleep(0.2)
+            if child.poll() is None:
+                print(f"WARNING: route: worker {name} ignored SIGTERM; "
+                      "killing (its journal replays on next start)",
+                      file=sys.stderr, flush=True)
+                child.kill()
+    server.close()
+    router.close()
+    print("route: shutdown complete", flush=True)
 
 
 def trace_cmd(args) -> None:
@@ -1472,6 +1605,10 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--tenant_inflight_cap", type=int,
                    help="max queued+running jobs one tenant may hold; "
                         "empty = unlimited")
+    s.add_argument("--node",
+                   help="fleet member name this daemon serves as (set by "
+                        "'cct route --spawn'; surfaced in healthz/metrics "
+                        "for node-labeled dashboards); empty = standalone")
     s.set_defaults(func=serve_cmd, config_section="serve", required_args=(),
                    builtin_defaults={
                        "socket": "", "host": "127.0.0.1", "port": 7733,
@@ -1482,6 +1619,82 @@ def build_parser() -> argparse.ArgumentParser:
                        "supervise": "False", "max_restarts": 10,
                        "class_weights": "", "slo_targets": "",
                        "tenant_queue_cap": "", "tenant_inflight_cap": "",
+                       "node": "",
+                   })
+
+    r = sub.add_parser(
+        "route",
+        help="run the fleet router: consistent-hash submits onto N "
+             "worker daemons with replay-aware failover + work stealing")
+    r.add_argument("-c", "--config", default=None)
+    r.add_argument("--members",
+                   help="comma-separated fleet members as 'name=address' "
+                        "(unix socket path or host:port), e.g. "
+                        "'w0=/run/cct/w0.sock,w1=10.0.0.2:7733'; bare "
+                        "addresses are auto-named n0..; mutually "
+                        "exclusive with --spawn")
+    r.add_argument("--spawn", type=int,
+                   help="launch this many local worker daemons under "
+                        "--workdir (per-worker journal/compile cache), "
+                        "each supervised with crash-restart backoff "
+                        "(default 0 = route to --members)")
+    r.add_argument("--workdir",
+                   help="directory for spawned workers' sockets, "
+                        "journals and caches (default ./fleet)")
+    r.add_argument("--socket", help="router unix socket path "
+                                    "(overrides host/port)")
+    r.add_argument("--host", help="router TCP bind host (default 127.0.0.1)")
+    r.add_argument("--port", type=int,
+                   help="router TCP port (default 7780; 0 = any free)")
+    r.add_argument("--vnodes", type=int,
+                   help="virtual ring points per member (default 64); "
+                        "more = smoother key spread, same stability")
+    r.add_argument("--steal_threshold", type=int,
+                   help="a batch/scavenger submit may leave its ring-home "
+                        "node once that node's queue is this deep "
+                        "(default 4); interactive jobs never move")
+    r.add_argument("--steal_margin", type=int,
+                   help="the thief must be at least this many queued jobs "
+                        "shallower than the home node (default 2)")
+    r.add_argument("--health_interval_s", type=float,
+                   help="seconds between fleet health sweeps (default 2)")
+    r.add_argument("--down_after", type=int,
+                   help="consecutive failed probes before a member is "
+                        "marked down (default 3); a failed forward marks "
+                        "it down immediately")
+    r.add_argument("--gang_size", type=int,
+                   help="spawned workers' --gang_size (default 4)")
+    r.add_argument("--queue_bound", type=int,
+                   help="spawned workers' --queue_bound (default 16)")
+    r.add_argument("--max_batch", type=int,
+                   help="spawned workers' --max_batch (default 1024)")
+    r.add_argument("--backend", choices=("cpu", "tpu", "xla_cpu"),
+                   help="spawned workers' device backend (default tpu)")
+    r.add_argument("--compile_cache",
+                   help="compile cache for spawned workers (default: a "
+                        "per-worker dir under --workdir)")
+    r.add_argument("--warmup_shapes",
+                   help="spawned workers' --warmup_shapes")
+    r.add_argument("--class_weights",
+                   help="spawned workers' --class_weights")
+    r.add_argument("--slo_targets", help="spawned workers' --slo_targets")
+    r.add_argument("--max_restarts", type=int,
+                   help="per-worker supervised-restart budget (default 10)")
+    r.add_argument("--drain_s",
+                   help="bounded drain window for spawned workers on "
+                        "router shutdown (default $CCT_SERVE_DRAIN_S "
+                        "or 30)")
+    r.set_defaults(func=route_cmd, config_section="route", required_args=(),
+                   builtin_defaults={
+                       "members": "", "spawn": 0, "workdir": "",
+                       "socket": "", "host": "127.0.0.1", "port": 7780,
+                       "vnodes": 64, "steal_threshold": 4,
+                       "steal_margin": 2, "health_interval_s": 2.0,
+                       "down_after": 3, "gang_size": 4, "queue_bound": 16,
+                       "max_batch": 1024, "backend": "tpu",
+                       "compile_cache": "", "warmup_shapes": "",
+                       "class_weights": "", "slo_targets": "",
+                       "max_restarts": 10, "drain_s": "",
                    })
 
     t = sub.add_parser(

@@ -423,3 +423,76 @@ def render_prometheus(doc: dict) -> str:
                 )
 
     return "\n".join(lines) + "\n"
+
+
+def render_fleet_prometheus(doc: dict) -> str:
+    """Render the fleet router's ``metrics`` doc as one exposition: the
+    router's own counters and the cross-node merged labeled series (via
+    :func:`render_prometheus`), ``cct_fleet_*`` gauges describing the
+    membership, and every member's counters/histograms re-emitted with a
+    ``node`` label — one scrape endpoint for the whole fleet."""
+    head = render_prometheus({
+        k: doc.get(k)
+        for k in ("cumulative", "labeled", "draining", "phases_s")
+    })
+    lines = [head.rstrip("\n")] if head.strip() else []
+
+    fleet = doc.get("fleet") or {}
+    members = fleet.get("members") or []
+    lines.append("# HELP cct_fleet_members configured fleet member count")
+    lines.append("# TYPE cct_fleet_members gauge")
+    lines.append(f"cct_fleet_members {_fmt(fleet.get('size', len(members)))}")
+    lines.append("# HELP cct_fleet_members_up members answering health "
+                 "probes")
+    lines.append("# TYPE cct_fleet_members_up gauge")
+    lines.append(f"cct_fleet_members_up {_fmt(fleet.get('up', 0))}")
+    for metric, key, help_ in (
+        ("cct_fleet_member_up", "up", "1 while the member is routable"),
+        ("cct_fleet_queue_depth", "queued",
+         "queued jobs on the member (router's last health probe)"),
+        ("cct_fleet_running", "running", "running jobs on the member"),
+        ("cct_fleet_draining", "draining", "1 while the member drains"),
+    ):
+        if not members:
+            break
+        lines.append(f"# HELP {metric} {help_}")
+        lines.append(f"# TYPE {metric} gauge")
+        for m in sorted(members, key=lambda m: m["name"]):
+            v = m.get(key)
+            v = (1 if v else 0) if isinstance(v, bool) else int(v or 0)
+            lines.append(
+                f"{metric}{_label_str({'node': m['name']})} {_fmt(v)}")
+
+    # per-member re-emission: the same series every daemon already
+    # exposes, node-labeled so one scrape shows the whole fleet
+    nodes = doc.get("nodes") or {}
+    for node in sorted(nodes):
+        ndoc = nodes[node]
+        if not ndoc:
+            continue  # down/unreachable member: gauges above cover it
+        cum = ndoc.get("cumulative") or {}
+        for name in sorted(cum):
+            metric = f"cct_{name}_total"
+            lines.append(f"# TYPE {metric} counter")
+            lines.append(
+                f"{metric}{_label_str({'node': node})} {_fmt(cum[name])}")
+        for name in sorted(ndoc.get("histograms") or {}):
+            h = ndoc["histograms"][name]
+            metric = f"cct_{name}"
+            lines.append(f"# TYPE {metric} histogram")
+            acc = 0
+            for bound, n in zip(h["buckets"], h["counts"]):
+                acc += n
+                lines.append(
+                    f"{metric}_bucket"
+                    f"{_label_str({'node': node, 'le': f'{bound:g}'})} {acc}")
+            lines.append(
+                f"{metric}_bucket{_label_str({'node': node, 'le': '+Inf'})} "
+                f"{h['count']}")
+            lines.append(
+                f"{metric}_sum{_label_str({'node': node})} "
+                f"{_fmt(float(h['sum']))}")
+            lines.append(
+                f"{metric}_count{_label_str({'node': node})} {h['count']}")
+
+    return "\n".join(lines) + "\n"

@@ -44,6 +44,15 @@ COUNTERS = {
                        "exists to collapse",
     "bytes_bam_written": "compressed BGZF bytes written to BAM outputs "
                          "(headers, blocks and EOF markers included)",
+    "jobs_routed": "submits the fleet router forwarded onto a worker "
+                   "daemon (stolen and failover resubmits included)",
+    "route_steals": "batch/scavenger submits the router steered away from "
+                    "their ring-home node to a less-loaded one",
+    "route_resubmits": "jobs the router resubmitted to a new ring owner "
+                       "after their node died (worker journal dedup makes "
+                       "each an exactly-once replay, not a double run)",
+    "member_down_events": "fleet members the router marked down (transport "
+                          "failure on a forward, or health-probe streak)",
 }
 
 CUMULATIVE_KEYS = tuple(COUNTERS)
@@ -78,9 +87,13 @@ DEFAULT_QOS = "interactive"
 OVERFLOW_TENANT = "__overflow__"
 
 # label name -> {"closed": bool, "values": closed value set or None}.
+# ``node`` is the fleet-router member name: open-valued like tenant, but
+# its cardinality is bounded by the router's configured member list (a
+# handful of daemons), so it needs no runtime cap.
 LABELS = {
     "tenant": {"closed": False, "values": None},
     "qos": {"closed": True, "values": QOS_CLASSES},
+    "node": {"closed": False, "values": None},
 }
 
 # Labeled counters are a separate namespace from COUNTERS: the global
@@ -107,6 +120,22 @@ LABELED_COUNTERS = {
     "tenant_jobs_quota_refused": {
         "labels": ("tenant", "qos"),
         "help": "submits refused by per-tenant queue or in-flight quotas",
+    },
+    # fleet-router series: counted in the ROUTER process (workers keep
+    # their own per-process series; the router's metrics endpoint merges
+    # both views into one node-labeled exposition)
+    "node_jobs_routed": {
+        "labels": ("node",),
+        "help": "submits forwarded to each fleet member by the router",
+    },
+    "node_steals": {
+        "labels": ("node",),
+        "help": "stolen submits landed on each member (the thief side)",
+    },
+    "node_resubmits": {
+        "labels": ("node",),
+        "help": "failover resubmits landed on each member after another "
+                "member died",
     },
 }
 

@@ -41,12 +41,21 @@ class ServeClient:
     ``retries`` transport-level reconnect attempts (default
     ``CCT_SERVE_CLIENT_RETRIES`` or 5) with ``backoff_delay``-capped
     sleeps between them; every op is idempotent so a blind resend is safe.
+
+    ``router`` (optional) is the fleet router's address: a client polling
+    a *worker* directly re-resolves the key's current owner through the
+    router when the worker stops answering — a mid-poll worker kill stays
+    restart-invisible even on the direct data path, because the router's
+    replay-aware failover has already resubmitted the job to the new ring
+    owner by the time ``locate`` answers.
     """
 
     def __init__(self, address, connect_timeout: float = 10.0,
                  retries: int | None = None,
-                 retry_base_s: float | None = None):
+                 retry_base_s: float | None = None,
+                 router=None):
         self.address = address
+        self.router = router
         self.connect_timeout = connect_timeout
         if retries is None:
             retries = int(os.environ.get("CCT_SERVE_CLIENT_RETRIES", "5"))
@@ -92,6 +101,30 @@ class ServeClient:
         # timeouts against a wedged process, missing unix socket, ...
         return isinstance(exc, OSError)
 
+    def _reresolve(self, doc: dict) -> None:
+        """Ask the router where this request's key lives *now* and repoint
+        ``self.address`` there.  Best-effort: an unreachable router (or a
+        keyless request) keeps the current address — the normal retry
+        loop still covers a same-address daemon restart."""
+        key = doc.get("key")
+        if not key:
+            return
+        try:
+            reply = ServeClient(self.router, retries=0).request(
+                {"op": "locate", "key": key}, timeout=10.0)
+        except Exception as e:
+            print(f"WARNING: serve client: router locate failed ({e}); "
+                  "keeping current address", file=sys.stderr, flush=True)
+            return
+        address = reply.get("address")
+        if isinstance(address, list):
+            address = (address[0], int(address[1]))
+        if address and address != self.address:
+            print(f"WARNING: serve client: key {key} now owned by "
+                  f"{reply.get('node')} at {address}; re-pointing",
+                  file=sys.stderr, flush=True)
+            self.address = address
+
     def _request(self, doc: dict, timeout: float | None = None) -> dict:
         attempts = self.retries + 1
         for attempt in range(attempts):
@@ -105,7 +138,15 @@ class ServeClient:
                       f"{delay:.1f}s (attempt {attempt + 2}/{attempts})",
                       file=sys.stderr, flush=True)
                 time.sleep(delay)
+                if self.router is not None:
+                    self._reresolve(doc)
         raise AssertionError("unreachable")
+
+    def request(self, doc: dict, timeout: float | None = None) -> dict:
+        """One raw NDJSON request/reply with the full retry + router
+        re-resolution discipline (the fleet router forwards through
+        this; ops below are typed conveniences over it)."""
+        return self._request(doc, timeout)
 
     # ----------------------------------------------------------------- ops
 

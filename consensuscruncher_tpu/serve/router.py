@@ -1,0 +1,645 @@
+"""Fleet router: one stateless front door over N serve daemons.
+
+``cct route`` turns the single-host daemon into a horizontally scaled
+fleet: submits are **consistent-hashed by idempotency key** onto worker
+daemons (each keeping its own journal, warm compile cache, autotune table
+and device set), and the router itself holds no durable state — every
+byte that matters for exactly-once recovery already lives in the workers'
+write-ahead journals and per-job manifests.  Kill the router and restart
+it with the same member list: keys hash to the same owners, keyed polls
+resolve against the workers' journal-replayed jobs, nothing is lost.
+
+Routing discipline:
+
+- **Sticky placement.** :class:`HashRing` maps ``idempotency_key(spec)``
+  to a member through ``vnodes`` virtual points per member, so a resubmit
+  of the same spec always lands on the same worker (whose journal dedup
+  collapses it onto the tracked job) and membership changes remap only
+  ~1/N of the key space (pinned by the ring unit tests).
+- **Replay-aware failover.** A member that fails a forward (or
+  ``down_after`` consecutive health probes) is marked down; requests walk
+  the ring to the next *up* owner.  For a job the router has seen, the
+  cached spec is **resubmitted by key** to the new owner — the workers
+  share a filesystem, so the new owner's ``--resume`` path completes the
+  dead node's partial work byte-identically, and the journal dedup makes
+  the whole dance exactly-once.  A recovered member rejoins the ring
+  automatically on its next healthy probe (rebalance: its keys simply
+  resolve home again; the stand-in owner's copy of any in-flight job is
+  a terminal no-op thanks to idempotent outputs).
+- **Bounded work stealing.** A batch/scavenger submit whose home node has
+  ``steal_threshold``-deep queues may be steered to the least-loaded up
+  member when that member is at least ``steal_margin`` jobs shallower —
+  interactive jobs never move (stickiness is their latency warranty), and
+  a steal is an optimization only: the ``route.steal`` fault site forces
+  the job home, never fails it.
+
+Fault sites (registered in ``tools/cctlint/fault_sites.py``, armed by the
+chaos tests): ``route.member_down`` (a forward hits a dead member),
+``route.steal`` (the steal decision itself), ``route.resubmit`` (the
+failover resubmission).
+
+Wire protocol: the same NDJSON ops as :mod:`serve.server`, plus
+``{"op": "locate", "key": ...}`` -> the member currently owning the key
+(clients use it to re-resolve a direct worker connection after a kill).
+``status``/``result`` through the router are **key-addressed** — worker
+job ids are per-daemon and collide across the fleet.
+
+Metrics: the router's ``metrics`` op merges every member's labeled
+series (so per-qos dashboards keep working unchanged), nests each
+member's full doc under ``nodes.<name>``, and the Prometheus rendering
+(:func:`obs.metrics.render_fleet_prometheus`) adds ``cct_fleet_*``
+gauges plus node-labeled per-member series.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import sys
+import threading
+import time
+from bisect import bisect_right
+from collections import OrderedDict
+
+from consensuscruncher_tpu.obs import metrics as obs_metrics
+from consensuscruncher_tpu.serve.client import ServeClient, ServeClientError
+from consensuscruncher_tpu.serve.journal import idempotency_key
+from consensuscruncher_tpu.serve.server import ServeServer
+from consensuscruncher_tpu.utils import faults
+from consensuscruncher_tpu.utils.profiling import Counters
+
+# qos classes eligible for cross-node stealing: latency-insensitive work
+# whose gang compatibility survives the move (gangs key on cutoff and
+# qualscore, which travel with the spec)
+STEALABLE_QOS = ("batch", "scavenger")
+
+
+class HashRing:
+    """Deterministic consistent-hash ring with virtual nodes.
+
+    ``vnodes`` points per member, positioned by sha256 of
+    ``"<member>#<i>"`` — no process seeding anywhere, so every router
+    (and every restart) builds the identical ring from the same member
+    list.  ``owner`` walks clockwise from the key's position to the
+    first member present in ``up`` (ring stability: a down member's keys
+    fall to its clockwise successors; everyone else's keys do not move).
+    """
+
+    def __init__(self, members, vnodes: int = 64):
+        self.vnodes = max(1, int(vnodes))
+        self.members = tuple(dict.fromkeys(members))  # ordered, unique
+        points = []
+        for m in self.members:
+            for i in range(self.vnodes):
+                h = hashlib.sha256(f"{m}#{i}".encode()).digest()
+                points.append((int.from_bytes(h[:8], "big"), m))
+        points.sort()
+        self._hashes = [p[0] for p in points]
+        self._owners = [p[1] for p in points]
+
+    @staticmethod
+    def key_position(key: str) -> int:
+        return int.from_bytes(
+            hashlib.sha256(str(key).encode()).digest()[:8], "big")
+
+    def owner(self, key: str, up=None) -> str | None:
+        """The member owning ``key`` among ``up`` (default: all members);
+        None when no candidate is up."""
+        if not self._hashes:
+            return None
+        allowed = set(self.members if up is None else up)
+        if not allowed:
+            return None
+        start = bisect_right(self._hashes, self.key_position(key))
+        n = len(self._owners)
+        for step in range(n):
+            m = self._owners[(start + step) % n]
+            if m in allowed:
+                return m
+        return None
+
+    def preference(self, key: str) -> list[str]:
+        """All members in ring-walk order from the key (first = owner,
+        rest = failover order) — handy for tests and debugging."""
+        out: list[str] = []
+        if not self._hashes:
+            return out
+        start = bisect_right(self._hashes, self.key_position(key))
+        n = len(self._owners)
+        for step in range(n):
+            m = self._owners[(start + step) % n]
+            if m not in out:
+                out.append(m)
+                if len(out) == len(self.members):
+                    break
+        return out
+
+
+class _Member:
+    """Router-side view of one worker daemon (soft state only)."""
+
+    def __init__(self, name: str, address, client):
+        self.name = name
+        self.address = address
+        self.client = client
+        self.up = True
+        self.fails = 0          # consecutive failed health probes
+        self.queued = 0
+        self.running = 0
+        self.draining = False
+        self.last_seen = 0.0
+
+    def describe(self) -> dict:
+        return {
+            "name": self.name,
+            "address": (list(self.address)
+                        if isinstance(self.address, tuple) else self.address),
+            "up": self.up,
+            "queued": self.queued,
+            "running": self.running,
+            "draining": self.draining,
+        }
+
+
+def parse_members(text: str) -> list[tuple[str, object]]:
+    """``'n0=/tmp/a.sock,n1=host:port'`` (or bare addresses, auto-named
+    ``n0..``) -> ``[(name, address), ...]`` with tuple TCP addresses."""
+    out: list[tuple[str, object]] = []
+    for i, part in enumerate(str(text or "").split(",")):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" in part and os.sep not in part.split("=", 1)[0]:
+            name, addr = part.split("=", 1)
+            name = name.strip()
+        else:
+            name, addr = f"n{i}", part
+        addr = addr.strip()
+        if ":" in addr and os.sep not in addr:
+            host, port = addr.rsplit(":", 1)
+            out.append((name, (host, int(port))))
+        else:
+            out.append((name, addr))
+    if not out:
+        raise ValueError("router: empty member list")
+    names = [n for n, _ in out]
+    if len(set(names)) != len(names):
+        raise ValueError(f"router: duplicate member names in {names}")
+    return out
+
+
+class Router:
+    """Stateless routing core (the :class:`RouterServer` wire shell and
+    the ``cct route`` CLI both drive this).
+
+    ``members``: ``[(name, address), ...]``.  ``client_factory`` is
+    dependency injection for the unit tests (anything with the
+    ``ServeClient.request`` shape works).
+    """
+
+    def __init__(self, members, *, vnodes: int = 64,
+                 steal_threshold: int = 4, steal_margin: int = 2,
+                 health_interval_s: float = 2.0, down_after: int = 3,
+                 spec_cache_max: int = 4096, client_factory=None,
+                 start_monitor: bool = True):
+        if client_factory is None:
+            def client_factory(address):
+                return ServeClient(address, connect_timeout=10.0,
+                                   retries=1, retry_base_s=0.1)
+        self._members: dict[str, _Member] = OrderedDict()
+        for name, address in members:
+            self._members[name] = _Member(name, address,
+                                          client_factory(address))
+        self.ring = HashRing(list(self._members), vnodes=vnodes)
+        self.steal_threshold = max(1, int(steal_threshold))
+        self.steal_margin = max(1, int(steal_margin))
+        self.health_interval_s = float(health_interval_s)
+        self.down_after = max(1, int(down_after))
+        self.counters = Counters()
+        self.closing = False
+        self._draining = False
+        self._started_at = time.time()
+        self._lock = threading.Lock()
+        # bounded key -> {"spec", "node"} soft state; the ONLY thing the
+        # failover resubmission needs, and it is reconstructible: a keyed
+        # poll for an unknown key still resolves to the ring owner, whose
+        # journal has the job if it was ever acknowledged anywhere
+        self._placed: OrderedDict[str, dict] = OrderedDict()
+        self._placed_max = max(16, int(spec_cache_max))
+        self._monitor: threading.Thread | None = None
+        if start_monitor:
+            self._monitor = threading.Thread(
+                target=self._monitor_loop, name="route-health", daemon=True)
+            self._monitor.start()
+
+    # ------------------------------------------------------------ members
+
+    def members(self) -> list[_Member]:
+        with self._lock:
+            return list(self._members.values())
+
+    def _up_names(self) -> list[str]:
+        with self._lock:
+            return [m.name for m in self._members.values() if m.up]
+
+    def _member(self, name: str) -> _Member:
+        return self._members[name]
+
+    def _mark_down(self, member: _Member, why: str) -> None:
+        with self._lock:
+            was_up = member.up
+            member.up = False
+        if was_up:
+            self.counters.add("member_down_events", 1)
+            print(f"route: member {member.name} DOWN ({why}); "
+                  "failing its keys over to the next ring owners",
+                  file=sys.stderr, flush=True)
+
+    def _mark_up(self, member: _Member, health: dict) -> None:
+        with self._lock:
+            was_down = not member.up
+            member.up = True
+            member.fails = 0
+            member.queued = int(health.get("queued", 0))
+            member.running = int(health.get("running", 0))
+            member.draining = health.get("status") == "draining"
+            member.last_seen = time.time()
+        if was_down:
+            print(f"route: member {member.name} UP again; its ring range "
+                  "rebalances home", file=sys.stderr, flush=True)
+
+    def _monitor_loop(self) -> None:
+        while not self.closing:
+            self.probe_members()
+            deadline = time.monotonic() + self.health_interval_s
+            while not self.closing and time.monotonic() < deadline:
+                time.sleep(min(0.2, self.health_interval_s))
+
+    def probe_members(self) -> None:
+        """One health sweep (the monitor loop calls this; tests call it
+        directly for deterministic timing)."""
+        for member in self.members():
+            try:
+                health = member.client.request({"op": "healthz"},
+                                               timeout=5.0)["health"]
+            except Exception as e:
+                member.fails += 1
+                if member.fails >= self.down_after and member.up:
+                    self._mark_down(member, f"{member.fails} failed probes: {e}")
+                continue
+            self._mark_up(member, health)
+
+    # ------------------------------------------------------------ routing
+
+    def _owner_for(self, key: str, exclude: set | None = None):
+        up = [n for n in self._up_names() if not exclude or n not in exclude]
+        name = self.ring.owner(key, up=up)
+        return None if name is None else self._member(name)
+
+    def _remember(self, key: str, spec: dict, node: str) -> None:
+        with self._lock:
+            self._placed[key] = {"spec": dict(spec), "node": node}
+            self._placed.move_to_end(key)
+            while len(self._placed) > self._placed_max:
+                self._placed.popitem(last=False)
+
+    def _placed_info(self, key: str) -> dict | None:
+        with self._lock:
+            info = self._placed.get(key)
+            return dict(info) if info else None
+
+    def _forward(self, member: _Member, doc: dict,
+                 timeout: float | None = None) -> dict:
+        """One member RPC; a transport-level loss (or an armed
+        ``route.member_down`` fault) marks the member down and raises
+        ``ServeClientError(transport=True)`` for the caller's failover."""
+        try:
+            faults.fault_point("route.member_down")
+        except faults.FaultError as e:
+            self._mark_down(member, f"injected: {e}")
+            raise ServeClientError(str(e), {"transport": True}) from e
+        try:
+            return member.client.request(doc, timeout=timeout)
+        except ServeClientError as e:
+            if e.reply.get("transport"):
+                self._mark_down(member, str(e))
+            raise
+        except OSError as e:
+            self._mark_down(member, str(e))
+            raise ServeClientError(str(e), {"transport": True}) from e
+
+    def _pick_target(self, key: str, qos: str) -> tuple[_Member, bool]:
+        """Home member for the key, or a steal target for deep-queued
+        batch/scavenger work.  Returns ``(member, stolen)``."""
+        home = self._owner_for(key)
+        if home is None:
+            raise ServeClientError("no fleet member is up", {"transport": True})
+        if qos not in STEALABLE_QOS:
+            return home, False
+        with self._lock:
+            candidates = [m for m in self._members.values()
+                          if m.up and not m.draining and m.name != home.name]
+            if (home.queued < self.steal_threshold) or not candidates:
+                return home, False
+            thief = min(candidates, key=lambda m: (m.queued, m.name))
+            if thief.queued + self.steal_margin > home.queued:
+                return home, False
+        try:
+            faults.fault_point("route.steal")
+        except faults.FaultError as e:
+            print(f"WARNING: route: steal fault ({e}); keeping job on "
+                  f"home node {home.name}", file=sys.stderr, flush=True)
+            return home, False
+        return thief, True
+
+    # ---------------------------------------------------------------- ops
+
+    def submit(self, spec: dict) -> dict:
+        """Route one submit; returns the member's wire reply annotated
+        with ``node``/``node_address`` (refusals pass through so the
+        client's shed/quota handling keeps working)."""
+        if self._draining:
+            return {"ok": False, "refused": True,
+                    "error": "router is draining; not accepting jobs"}
+        spec = dict(spec or {})
+        try:
+            key = idempotency_key(spec)
+        except Exception as e:
+            return {"ok": False, "error": f"bad spec: {e}"}
+        qos = str(spec.get("qos") or "interactive")
+        tried: set[str] = set()
+        stolen = False
+        while True:
+            if not tried:
+                try:
+                    member, stolen = self._pick_target(key, qos)
+                except ServeClientError as e:
+                    return {"ok": False, "error": str(e)}
+            else:
+                member = self._owner_for(key, exclude=tried)
+                if member is None:
+                    return {"ok": False,
+                            "error": "no fleet member is up",
+                            "transport": True}
+            try:
+                reply = self._forward(member, {"op": "submit", "spec": spec})
+            except ServeClientError as e:
+                if e.reply.get("transport"):
+                    # forward-time death: fail over around the ring
+                    tried.add(member.name)
+                    stolen = False
+                    continue
+                if e.reply.get("refused"):
+                    return dict(e.reply)
+                return {"ok": False, "error": str(e)}
+            with self._lock:
+                member.queued += 1  # soft estimate until the next probe
+            self._remember(key, spec, member.name)
+            self.counters.add("jobs_routed", 1)
+            obs_metrics.inc("node_jobs_routed", node=member.name)
+            if stolen:
+                self.counters.add("route_steals", 1)
+                obs_metrics.inc("node_steals", node=member.name)
+            reply = dict(reply)
+            reply["node"] = member.name
+            reply["node_address"] = member.describe()["address"]
+            reply["stolen"] = stolen
+            return reply
+
+    def resolve(self, key: str) -> _Member:
+        """The member a keyed poll should talk to *right now*: the cached
+        placement while that node is up, else the current ring owner —
+        resubmitting the cached spec there first, so the poll finds the
+        job (replay-aware failover).  Raises when no member is up."""
+        info = self._placed_info(key)
+        if info is not None:
+            member = self._members.get(info["node"])
+            if member is not None and member.up:
+                return member
+        member = self._owner_for(key)
+        if member is None:
+            raise ServeClientError("no fleet member is up", {"transport": True})
+        if info is not None and info["node"] != member.name:
+            self._failover_resubmit(key, info, member)
+        return member
+
+    def _failover_resubmit(self, key: str, info: dict,
+                           member: _Member) -> None:
+        """Resubmit a dead node's job to its new owner.  Exactly-once by
+        construction: the new owner's journal dedups on the key, and the
+        shared-filesystem ``--resume`` manifest skips any stage the dead
+        node already committed — outputs stay byte-identical."""
+        faults.fault_point("route.resubmit")
+        reply = self._forward(member, {"op": "submit",
+                                       "spec": info["spec"]})
+        self._remember(key, info["spec"], member.name)
+        self.counters.add("jobs_routed", 1)
+        self.counters.add("route_resubmits", 1)
+        obs_metrics.inc("node_jobs_routed", node=member.name)
+        obs_metrics.inc("node_resubmits", node=member.name)
+        print(f"route: resubmitted key {key} to {member.name} "
+              f"(job {reply.get('job_id')}, duplicate="
+              f"{reply.get('duplicate')})", file=sys.stderr, flush=True)
+
+    def locate(self, key: str) -> dict:
+        member = self.resolve(key)
+        return {"node": member.name,
+                "address": member.describe()["address"]}
+
+    def _keyed(self, req: dict) -> str:
+        key = req.get("key")
+        if not key:
+            raise ServeClientError(
+                "the router is key-addressed: poll with 'key' (worker "
+                "job ids are per-daemon)", {"bad_request": True})
+        return str(key)
+
+    def status(self, req: dict) -> dict:
+        key = self._keyed(req)
+        tried: set[str] = set()
+        while True:
+            member = self.resolve(key)
+            try:
+                return self._forward(member, {"op": "status", "key": key})
+            except ServeClientError as e:
+                if not e.reply.get("transport") or member.name in tried:
+                    raise
+                tried.add(member.name)  # one failover hop per member
+
+    def result(self, req: dict, slice_s: float = 5.0) -> dict:
+        """Blocking keyed result with failover: the member-side wait runs
+        in bounded slices so a node death mid-poll is noticed within
+        ``slice_s`` and the poll continues against the new owner."""
+        key = self._keyed(req)
+        timeout = req.get("timeout")
+        deadline = (None if timeout is None
+                    else time.monotonic() + float(timeout))
+        while True:
+            if self.closing:
+                return {"ok": False, "error": "router shutting down",
+                        "shutdown": True}
+            remaining = slice_s
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(f"job {key} still pending")
+            member = self.resolve(key)
+            try:
+                return self._forward(
+                    member,
+                    {"op": "result", "key": key,
+                     "timeout": min(slice_s, remaining)},
+                    timeout=min(slice_s, remaining) + 10.0)
+            except ServeClientError as e:
+                if e.reply.get("timeout") or e.reply.get("shutdown") \
+                        or e.reply.get("transport"):
+                    continue  # next slice (possibly on a new owner)
+                raise
+
+    # -------------------------------------------------- lifecycle / fleet
+
+    def stop_admission(self) -> None:
+        self._draining = True
+
+    def drain(self, timeout: float | None = None, node: str | None = None):
+        """Drain one member (``node``) or the whole fleet (admission off
+        everywhere first, then every member drains in parallel)."""
+        targets = ([self._members[node]] if node
+                   else list(self.members()))
+        if node is None:
+            self.stop_admission()
+        errors: dict[str, str] = {}
+
+        def _drain_one(member: _Member):
+            try:
+                member.client.drain(timeout=timeout)
+                with self._lock:
+                    member.draining = True
+            except Exception as e:
+                errors[member.name] = str(e)
+
+        threads = [threading.Thread(target=_drain_one, args=(m,), daemon=True)
+                   for m in targets]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return {"drained": sorted(m.name for m in targets
+                                  if m.name not in errors),
+                "errors": errors}
+
+    def close(self) -> None:
+        self.closing = True
+        if self._monitor is not None:
+            self._monitor.join(timeout=5.0)
+
+    def shutdown(self, timeout: float | None = None) -> None:
+        self.close()
+
+    def healthz(self) -> dict:
+        members = [m.describe() for m in self.members()]
+        up = [m for m in members if m["up"]]
+        return {
+            "status": "draining" if self._draining else
+                      ("serving" if up else "degraded"),
+            "role": "router",
+            "queued": sum(m["queued"] for m in up),
+            "running": sum(m["running"] for m in up),
+            "uptime_s": round(time.time() - self._started_at, 3),
+            "pid": os.getpid(),
+            "fleet": {"size": len(members), "up": len(up),
+                      "members": members},
+        }
+
+    def metrics(self) -> dict:
+        """Fleet metrics doc: the router's own counters/labeled series,
+        each reachable member's full doc under ``nodes.<name>``, and a
+        cross-node merge of the labeled series (so per-qos consumers of
+        the single-daemon doc keep working against the router)."""
+        nodes: dict[str, dict] = {}
+        for member in self.members():
+            if not member.up:
+                nodes[member.name] = None
+                continue
+            try:
+                nodes[member.name] = member.client.request(
+                    {"op": "metrics"}, timeout=15.0)["metrics"]
+            except Exception:
+                nodes[member.name] = None  # telemetry never fails routing
+        merged = obs_metrics.labeled_snapshot()  # router's own node_* series
+        for doc in nodes.values():
+            labeled = (doc or {}).get("labeled") or {}
+            for kind in ("counters", "histograms"):
+                for name, entries in (labeled.get(kind) or {}).items():
+                    merged.setdefault(kind, {}).setdefault(
+                        name, []).extend(entries)
+        return {
+            "stage": "route",
+            "phases_s": {"uptime": time.time() - self._started_at},
+            "draining": self._draining,
+            "cumulative": self.counters.snapshot(),
+            "labeled": merged,
+            "fleet": self.healthz()["fleet"],
+            "nodes": nodes,
+        }
+
+
+class RouterServer(ServeServer):
+    """The router's wire shell: :class:`serve.server.ServeServer`'s
+    socket/connection machinery with the dispatch table swapped for the
+    fleet ops (submit/status/result/locate/healthz/metrics/drain).  The
+    router object rides in the ``scheduler`` slot — ``request_shutdown``
+    and ``install_signal_handlers`` work unchanged because the router
+    speaks the same ``stop_admission``/``drain`` lifecycle."""
+
+    def __init__(self, router: Router, host: str = "127.0.0.1",
+                 port: int = 0, socket_path: str | None = None,
+                 max_conns: int | None = None):
+        super().__init__(router, host=host, port=port,
+                         socket_path=socket_path, max_conns=max_conns)
+        self.router = router
+
+    def shutdown(self) -> None:
+        self.router.closing = True  # unpark sliced result waiters
+        super().shutdown()
+
+    def _dispatch(self, req: dict) -> dict:
+        if not isinstance(req, dict):
+            return {"ok": False, "error": "request must be a JSON object"}
+        op = req.get("op")
+        try:
+            if op == "submit":
+                return self.router.submit(req.get("spec") or {})
+            if op == "status":
+                return self.router.status(req)
+            if op == "result":
+                return self.router.result(req)
+            if op == "locate":
+                loc = self.router.locate(str(req.get("key") or ""))
+                return {"ok": True, **loc}
+            if op == "healthz":
+                return {"ok": True, "health": self.router.healthz()}
+            if op == "metrics":
+                doc = self.router.metrics()
+                if req.get("format") == "prometheus":
+                    return {"ok": True,
+                            "prometheus": obs_metrics.render_fleet_prometheus(
+                                doc)}
+                return {"ok": True, "metrics": doc}
+            if op == "drain":
+                out = self.router.drain(timeout=req.get("timeout"),
+                                        node=req.get("node"))
+                return {"ok": True, "drained": True, **out}
+            return {"ok": False, "error": f"unknown op {op!r}"}
+        except ServeClientError as e:
+            # a member refusal / ``ok: false`` travels back verbatim
+            reply = dict(e.reply) if e.reply else {}
+            reply.setdefault("error", str(e))
+            reply["ok"] = False
+            return reply
+        except TimeoutError as e:
+            return {"ok": False, "error": str(e), "timeout": True}
+        except Exception as e:  # surface, never kill the router
+            print(f"WARNING: route op {op!r} failed: {e}",
+                  file=sys.stderr, flush=True)
+            return {"ok": False, "error": f"{type(e).__name__}: {e}"}

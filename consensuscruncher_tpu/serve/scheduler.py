@@ -431,7 +431,12 @@ class Scheduler:
                  class_weights: dict | None = None,
                  slo_targets: dict | None = None,
                  tenant_queue_cap: int | None = None,
-                 tenant_inflight_cap: int | None = None):
+                 tenant_inflight_cap: int | None = None,
+                 node: str | None = None):
+        # fleet identity: the member name a router knows this daemon by
+        # (empty for a standalone daemon); surfaced in healthz/metrics so
+        # node-labeled fleet dashboards can be cross-checked per worker
+        self.node = str(node or os.environ.get("CCT_SERVE_NODE") or "")
         self.queue_bound = int(queue_bound)
         self.gang_size = max(1, int(gang_size))
         self.backend = backend
@@ -881,6 +886,7 @@ class Scheduler:
                  "jobs_by_state": states},
                 cumulative=cumulative,
             )
+            doc["node"] = self.node
             doc["jobs"] = jobs
             doc["histograms"] = obs_metrics.histograms_snapshot()
             doc["labeled"] = obs_metrics.labeled_snapshot()
@@ -902,6 +908,7 @@ class Scheduler:
         with self._cond:
             return {
                 "status": "draining" if self._draining else "serving",
+                "node": self.node,
                 "queued": self._queued_locked(),
                 "queued_by_class":
                     {qos: len(self._queues[qos]) for qos in QOS_CLASSES},

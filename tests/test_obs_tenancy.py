@@ -16,6 +16,7 @@ from consensuscruncher_tpu.obs import metrics as obs_metrics  # noqa: E402
 from consensuscruncher_tpu.obs.registry import (  # noqa: E402
     LABELED_COUNTERS,
     LABELED_HISTOGRAMS,
+    LABELS,
     OVERFLOW_TENANT,
     QOS_CLASSES,
 )
@@ -63,7 +64,9 @@ def test_tenant_cardinality_folds_to_overflow(monkeypatch):
 
 def test_every_labeled_spec_is_well_formed():
     for name, spec in {**LABELED_COUNTERS, **LABELED_HISTOGRAMS}.items():
-        assert spec["labels"] == ("tenant", "qos"), name
+        assert isinstance(spec["labels"], tuple) and spec["labels"], name
+        # every label a series declares must come from the closed registry
+        assert all(lb in LABELS for lb in spec["labels"]), name
         assert spec["help"], name
     for spec in LABELED_HISTOGRAMS.values():
         assert list(spec["buckets"]) == sorted(spec["buckets"])

@@ -1,0 +1,450 @@
+"""Fleet router (serve/router.py): ring stability, stealing, failover.
+
+Unit coverage drives the Router through injected stub clients (sticky
+placement, ring-stable remapping, the steal policy's every gate, health
+probes, fleet metrics merging); the chaos tests arm the three route.*
+fault sites (CCT_FAULTS) so cctlint CCT301-303 stays green; and the
+acceptance test runs TWO real worker daemon subprocesses behind a
+router, kill -9s the one that owns an acknowledged job, and proves the
+replay-aware failover finishes every job byte-identical to the frozen
+goldens.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "test"))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+from make_test_data import canonical_bam_digest, text_digest  # noqa: E402
+
+from consensuscruncher_tpu.obs import metrics as obs_metrics
+from consensuscruncher_tpu.serve.client import ServeClient, ServeClientError
+from consensuscruncher_tpu.serve.journal import idempotency_key
+from consensuscruncher_tpu.serve.router import (
+    HashRing, Router, RouterServer, parse_members,
+)
+
+DATA = os.path.join(REPO, "test", "data")
+SAMPLE = os.path.join(DATA, "sample.bam")
+GOLDEN = json.load(open(os.path.join(REPO, "test", "golden.json")))
+
+
+def _spec(output, name="golden", **over):
+    spec = {
+        "input": SAMPLE, "output": str(output), "name": name,
+        "cutoff": 0.7, "qualscore": 0, "scorrect": True,
+        "max_mismatch": 0, "bdelim": "|", "compress_level": 6,
+    }
+    spec.update(over)
+    return spec
+
+
+def _assert_matches_golden(base, label):
+    for rel in GOLDEN["consensus"]:
+        path = os.path.join(str(base), rel)
+        assert os.path.exists(path), f"{label}: missing output {rel}"
+        got = (canonical_bam_digest(path) if rel.endswith(".bam")
+               else text_digest(path))
+        assert got == GOLDEN["consensus"][rel], \
+            f"{label} diverges from golden at {rel}"
+
+
+# ------------------------------------------------------------ hash ring
+
+def test_ring_deterministic_and_spread():
+    members = [f"n{i}" for i in range(4)]
+    r1, r2 = HashRing(members), HashRing(list(members))
+    keys = [f"key-{i}" for i in range(4000)]
+    owners = [r1.owner(k) for k in keys]
+    assert owners == [r2.owner(k) for k in keys]  # no process seeding
+    counts = {m: owners.count(m) for m in members}
+    # vnodes smooth the split: every member owns a substantial share
+    assert min(counts.values()) > len(keys) / len(members) / 2, counts
+
+
+def test_ring_add_member_remaps_about_one_over_n():
+    keys = [f"key-{i}" for i in range(4000)]
+    r3 = HashRing(["n0", "n1", "n2"])
+    r4 = HashRing(["n0", "n1", "n2", "n3"])
+    moved = [k for k in keys if r3.owner(k) != r4.owner(k)]
+    # ideal 1/4; vnodes keep it near that, nowhere near a full reshuffle
+    assert 0.15 < len(moved) / len(keys) < 0.40
+    # every moved key moved TO the new member, never between old ones
+    assert all(r4.owner(k) == "n3" for k in moved)
+
+
+def test_ring_down_member_keys_fall_to_successors_only():
+    members = ["n0", "n1", "n2", "n3"]
+    ring = HashRing(members)
+    keys = [f"key-{i}" for i in range(2000)]
+    up = [m for m in members if m != "n2"]
+    for k in keys:
+        home = ring.owner(k)
+        failed = ring.owner(k, up=up)
+        if home != "n2":
+            assert failed == home  # other members' keys do not move
+        else:
+            assert failed in up
+    # preference order starts at the owner and covers everyone once
+    pref = ring.preference("some-key")
+    assert pref[0] == ring.owner("some-key")
+    assert sorted(pref) == sorted(members)
+
+
+def test_parse_members_forms():
+    assert parse_members("a=/tmp/a.sock,b=host:7733") == [
+        ("a", "/tmp/a.sock"), ("b", ("host", 7733))]
+    assert parse_members("/tmp/a.sock,/tmp/b.sock") == [
+        ("n0", "/tmp/a.sock"), ("n1", "/tmp/b.sock")]
+    with pytest.raises(ValueError, match="empty member list"):
+        parse_members("")
+    with pytest.raises(ValueError, match="duplicate member names"):
+        parse_members("a=/tmp/a.sock,a=/tmp/b.sock")
+
+
+# ---------------------------------------------------- stub-driven router
+
+class _StubFleet:
+    """In-memory worker daemons keyed by member name."""
+
+    def __init__(self, names):
+        self.nodes = {n: {"dead": False, "queued": 0, "jobs": {}}
+                      for n in names}
+
+    def client(self, name):
+        fleet = self
+
+        class _Client:
+            address = name
+
+            def request(self, doc, timeout=None):
+                node = fleet.nodes[name]
+                if node["dead"]:
+                    raise OSError("connection refused")
+                op = doc["op"]
+                if op == "healthz":
+                    return {"ok": True,
+                            "health": {"queued": node["queued"],
+                                       "running": 0, "status": "serving"}}
+                if op == "submit":
+                    key = idempotency_key(doc["spec"])
+                    dup = key in node["jobs"]
+                    node["jobs"][key] = dict(doc["spec"])
+                    return {"ok": True, "job_id": len(node["jobs"]),
+                            "key": key, "duplicate": dup}
+                if op in ("status", "result"):
+                    if doc["key"] not in node["jobs"]:
+                        raise ServeClientError("no such job", {})
+                    return {"ok": True, "job": {"state": "done",
+                                                "key": doc["key"]}}
+                if op == "metrics":
+                    return {"ok": True, "metrics": {
+                        "node": name,
+                        "cumulative": {"families_in": 5},
+                        "histograms": {},
+                        "labeled": {"counters": {}, "histograms": {}}}}
+                raise AssertionError(op)
+
+            def drain(self, timeout=None):
+                fleet.nodes[name]["draining"] = True
+
+        return _Client()
+
+
+def _stub_router(n=3, **kw):
+    fleet = _StubFleet([f"n{i}" for i in range(n)])
+    router = Router([(name, name) for name in fleet.nodes],
+                    start_monitor=False,
+                    client_factory=fleet.client, **kw)
+    router.probe_members()
+    return fleet, router
+
+
+def test_submit_sticky_and_duplicate():
+    fleet, router = _stub_router()
+    spec = _spec("/tmp/routed-a")
+    r1 = router.submit(spec)
+    r2 = router.submit(dict(spec))
+    assert r1["ok"] and r2["ok"]
+    assert r1["node"] == r2["node"] == router.ring.owner(r1["key"])
+    assert (r1["duplicate"], r2["duplicate"]) == (False, True)
+    assert router.counters.snapshot()["jobs_routed"] == 2
+
+
+def test_submit_fails_over_when_owner_dies_at_forward():
+    fleet, router = _stub_router()
+    spec = _spec("/tmp/routed-b")
+    home = router.ring.owner(idempotency_key(spec))
+    fleet.nodes[home]["dead"] = True
+    reply = router.submit(spec)
+    assert reply["ok"] and reply["node"] != home
+    # the forward failure marked the member down immediately
+    assert not router._member(home).up
+    snap = router.counters.snapshot()
+    assert snap["member_down_events"] == 1
+    # keyed ops now resolve to the stand-in without touching the corpse
+    assert router.locate(reply["key"])["node"] == reply["node"]
+    assert router.status({"key": reply["key"]})["ok"]
+
+
+def test_no_member_up_is_clean_refusal():
+    fleet, router = _stub_router(n=2)
+    for node in fleet.nodes.values():
+        node["dead"] = True
+    router.down_after = 1
+    router.probe_members()
+    reply = router.submit(_spec("/tmp/routed-c"))
+    assert reply["ok"] is False and "no fleet member is up" in reply["error"]
+
+
+def test_steal_gates(tmp_path):
+    fleet, router = _stub_router(steal_threshold=4, steal_margin=2)
+    bspec = _spec(tmp_path / "steal", qos="batch")
+    home = router.ring.owner(idempotency_key(bspec))
+    others = [n for n in fleet.nodes if n != home]
+
+    # shallow home queue: no steal
+    fleet.nodes[home]["queued"] = 3
+    router.probe_members()
+    assert router.submit(dict(bspec))["node"] == home
+
+    # deep home queue but every thief is nearly as deep: no steal
+    fleet.nodes[home]["queued"] = 6
+    for n in others:
+        fleet.nodes[n]["queued"] = 5
+    router.probe_members()
+    assert router.submit(dict(bspec))["node"] == home
+
+    # deep home + shallow thief: batch moves to the least-loaded member
+    fleet.nodes[others[0]]["queued"] = 0
+    fleet.nodes[others[1]]["queued"] = 1
+    router.probe_members()
+    stolen = router.submit(dict(bspec))
+    assert stolen["stolen"] is True and stolen["node"] == others[0]
+    assert router.counters.snapshot()["route_steals"] == 1
+
+    # interactive work NEVER moves, whatever the queue depths
+    ispec = _spec(tmp_path / "steal", name="inter", qos="interactive")
+    ihome = router.ring.owner(idempotency_key(ispec))
+    for n in fleet.nodes:
+        fleet.nodes[n]["queued"] = 0 if n != ihome else 50
+    router.probe_members()
+    assert router.submit(ispec)["stolen"] is False
+
+
+def test_probe_streak_marks_down_then_recovers():
+    fleet, router = _stub_router(down_after=2)
+    fleet.nodes["n1"]["dead"] = True
+    router.probe_members()
+    assert router._member("n1").up  # one failed probe is a blip
+    router.probe_members()
+    assert not router._member("n1").up
+    assert router.healthz()["fleet"]["up"] == 2
+    fleet.nodes["n1"]["dead"] = False
+    router.probe_members()
+    assert router._member("n1").up  # rejoins on the next healthy probe
+
+
+def test_drain_whole_fleet_and_single_node():
+    fleet, router = _stub_router()
+    out = router.drain(timeout=5, node="n1")
+    assert out == {"drained": ["n1"], "errors": {}}
+    assert fleet.nodes["n1"].get("draining") and router._draining is False
+    out = router.drain(timeout=5)
+    assert sorted(out["drained"]) == ["n0", "n1", "n2"]
+    assert router.submit(_spec("/tmp/post-drain"))["refused"] is True
+
+
+def test_fleet_metrics_merge_and_prometheus():
+    fleet, router = _stub_router()
+    router.submit(_spec("/tmp/metrics-a"))
+    fleet.nodes["n2"]["dead"] = True
+    router.down_after = 1
+    router.probe_members()
+    doc = router.metrics()
+    assert doc["cumulative"]["jobs_routed"] == 1
+    assert doc["nodes"]["n0"]["cumulative"]["families_in"] == 5
+    assert doc["nodes"]["n2"] is None  # down member: no doc, gauge says so
+    assert doc["fleet"]["size"] == 3 and doc["fleet"]["up"] == 2
+    text = obs_metrics.render_fleet_prometheus(doc)
+    assert "cct_fleet_members 3" in text
+    assert "cct_fleet_members_up 2" in text
+    assert 'cct_fleet_member_up{node="n2"} 0' in text
+    assert 'cct_families_in_total{node="n0"} 5' in text
+    assert 'cct_families_in_total{node="n2"}' not in text
+
+
+def test_router_server_dispatch_is_key_addressed(tmp_path):
+    fleet, router = _stub_router()
+    server = RouterServer(router, port=0)
+    try:
+        r = server._dispatch({"op": "status", "job_id": 7})
+        assert r["ok"] is False and r["bad_request"] is True
+        sub = server._dispatch({"op": "submit",
+                                "spec": _spec(tmp_path / "wire")})
+        assert sub["ok"] and sub["node"]
+        loc = server._dispatch({"op": "locate", "key": sub["key"]})
+        assert loc["ok"] and loc["node"] == sub["node"]
+        res = server._dispatch({"op": "result", "key": sub["key"],
+                                "timeout": 5})
+        assert res["ok"] and res["job"]["state"] == "done"
+        health = server._dispatch({"op": "healthz"})
+        assert health["health"]["role"] == "router"
+        prom = server._dispatch({"op": "metrics", "format": "prometheus"})
+        assert "cct_fleet_members 3" in prom["prometheus"]
+    finally:
+        server.close(timeout=2)
+        router.close()
+
+
+# --------------------------------------------------- chaos: fault sites
+
+def test_chaos_steal_fault_keeps_job_home(tmp_path, monkeypatch, capfd):
+    """Arm ``route.steal=fail@1``: the steal decision dies mid-flight and
+    the job lands on its ring-home node anyway — stealing is an
+    optimization, never a correctness dependency."""
+    fleet, router = _stub_router(steal_threshold=2, steal_margin=1)
+    bspec = _spec(tmp_path / "chaos-steal", qos="scavenger")
+    home = router.ring.owner(idempotency_key(bspec))
+    fleet.nodes[home]["queued"] = 9
+    router.probe_members()
+    monkeypatch.setenv("CCT_FAULTS", "route.steal=fail@1")
+    reply = router.submit(bspec)
+    monkeypatch.delenv("CCT_FAULTS")
+    assert reply["ok"] and reply["node"] == home and not reply["stolen"]
+    assert "keeping job on home node" in capfd.readouterr().err
+    assert router.counters.snapshot()["route_steals"] == 0
+    # disarmed: the same overload condition steals again
+    reply2 = router.submit(dict(bspec))
+    assert reply2["stolen"] is True
+
+
+def test_chaos_member_down_fault_fails_over(tmp_path, monkeypatch):
+    """Arm ``route.member_down=fail@1``: the first forward is treated as
+    a dead member — marked down, submit fails over around the ring."""
+    fleet, router = _stub_router()
+    spec = _spec(tmp_path / "chaos-down")
+    home = router.ring.owner(idempotency_key(spec))
+    monkeypatch.setenv("CCT_FAULTS", "route.member_down=fail@1")
+    reply = router.submit(spec)
+    monkeypatch.delenv("CCT_FAULTS")
+    assert reply["ok"] and reply["node"] != home
+    assert not router._member(home).up
+    assert router.counters.snapshot()["member_down_events"] == 1
+
+
+def test_chaos_resubmit_fault_degrades_then_recovers(tmp_path, monkeypatch):
+    """Arm ``route.resubmit=fail@1``: the failover resubmission dies ->
+    the keyed op surfaces a clean error reply (never a hang or a crash),
+    and the NEXT resolve resubmits successfully (idempotent)."""
+    fleet, router = _stub_router()
+    spec = _spec(tmp_path / "chaos-resubmit")
+    sub = router.submit(spec)
+    fleet.nodes[sub["node"]]["dead"] = True
+    router.down_after = 1
+    router.probe_members()
+    server = RouterServer(router, port=0)
+    try:
+        monkeypatch.setenv("CCT_FAULTS", "route.resubmit=fail@1")
+        r = server._dispatch({"op": "status", "key": sub["key"]})
+        monkeypatch.delenv("CCT_FAULTS")
+        assert r["ok"] is False and "route.resubmit" in r["error"]
+        # disarmed: the retryable poll goes through the new owner
+        r2 = server._dispatch({"op": "status", "key": sub["key"]})
+        assert r2["ok"] and r2["job"]["state"] == "done"
+        assert router.counters.snapshot()["route_resubmits"] == 1
+    finally:
+        server.close(timeout=2)
+        router.close()
+
+
+# ------------------------------------- acceptance: kill -9 a fleet node
+
+_DAEMON = (
+    "import sys; "
+    f"sys.path.insert(0, {REPO!r}); "
+    f"sys.path.insert(0, {os.path.join(REPO, 'tools')!r}); "
+    "from _jax_cpu import force_cpu; force_cpu(); "
+    "from consensuscruncher_tpu.cli import main; "
+    "sys.exit(main(sys.argv[1:]))"
+)
+
+
+def _spawn_worker(name, sock, jp, log):
+    env = dict(os.environ)
+    env.pop("CCT_FAULTS", None)
+    argv = ["serve", "--socket", sock, "--node", name, "--journal", jp,
+            "--gang_size", "1", "--queue_bound", "8",
+            "--backend", "xla_cpu", "--drain_s", "60"]
+    return subprocess.Popen([sys.executable, "-c", _DAEMON] + argv,
+                            stdout=log, stderr=subprocess.STDOUT, env=env)
+
+
+def test_fleet_kill9_owner_failover_replays_to_golden(tmp_path):
+    """THE fleet acceptance chaos test: two real worker daemons behind a
+    router, three acknowledged jobs, kill -9 the worker that owns the
+    first key mid-run — the router marks it down on the failed forward,
+    resubmits the dead node's jobs to the survivor, and every job
+    completes byte-identical to the frozen goldens (zero acknowledged
+    jobs lost)."""
+    procs = {}
+    log = open(tmp_path / "fleet.log", "wb")
+    members = []
+    for name in ("w0", "w1"):
+        sock = str(tmp_path / f"{name}.sock")
+        procs[name] = _spawn_worker(name, sock,
+                                    str(tmp_path / f"{name}.journal"), log)
+        members.append((name, sock))
+    router = Router(members, start_monitor=False, down_after=1,
+                    client_factory=lambda a: ServeClient(
+                        a, retries=30, retry_base_s=0.25))
+    try:
+        for name, _ in members:  # wait for both daemons to bind
+            health = router._member(name).client.request(
+                {"op": "healthz"})["health"]
+            assert health["node"] == name  # --node identity on the wire
+        subs = [router.submit(_spec(tmp_path / f"job{i}"))
+                for i in range(3)]
+        assert all(s["ok"] for s in subs)
+        victim = subs[0]["node"]
+        os.kill(procs[victim].pid, signal.SIGKILL)
+        assert procs[victim].wait(timeout=30) != 0
+        # fast-retry clients for the polls: the victim's client would
+        # otherwise burn 30 retries against a corpse before failing over
+        for name, _ in members:
+            m = router._member(name)
+            m.client = ServeClient(m.address, retries=0)
+        for i, sub in enumerate(subs):
+            job = router.result({"key": sub["key"], "timeout": 600})["job"]
+            assert job["state"] == "done", job
+            _assert_matches_golden(tmp_path / f"job{i}" / "golden",
+                                   f"fleet job {i}")
+        snap = router.counters.snapshot()
+        assert snap["member_down_events"] >= 1
+        assert snap["route_resubmits"] >= 1
+        survivor = [n for n, _ in members if n != victim][0]
+        assert router._member(survivor).up
+    except BaseException:
+        log.flush()
+        sys.stderr.write(open(tmp_path / "fleet.log").read()[-8000:])
+        raise
+    finally:
+        log.close()
+        router.close()
+        for proc in procs.values():
+            if proc.poll() is None:
+                proc.send_signal(signal.SIGTERM)
+        for proc in procs.values():
+            if proc.poll() is None:
+                try:
+                    proc.wait(timeout=120)
+                except subprocess.TimeoutExpired:
+                    proc.kill()

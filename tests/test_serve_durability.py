@@ -315,6 +315,74 @@ def test_client_reconnect_survives_daemon_restart_mid_poll(
     _assert_matches_golden(tmp_path / "out" / "golden", "reconnect job")
 
 
+def test_client_reconnect_reresolves_via_router_mid_poll(
+        tmp_path, monkeypatch):
+    """Fleet chaos: a client polling a WORKER directly (the router handed
+    it the owner's address) is parked in a blocking ``result`` when that
+    worker dies for good.  With ``router=`` set, the client's retry loop
+    re-resolves the key through the router's ``locate`` op — whose
+    replay-aware failover has already resubmitted the job to the new ring
+    owner — re-points to the survivor, and completes with golden outputs.
+    The mid-poll worker kill stays restart-invisible even though the
+    worker never comes back."""
+    from consensuscruncher_tpu.serve.router import Router, RouterServer
+
+    monkeypatch.setenv("CCT_RETRY_BASE_S", "0.1")
+    socks = {n: str(tmp_path / f"{n}.sock") for n in ("a", "b")}
+    scheds = {n: Scheduler(queue_bound=8, gang_size=1, backend="tpu",
+                           paused=True)
+              for n in socks}
+    servers = {n: ServeServer(scheds[n], socket_path=socks[n])
+               for n in socks}
+    for srv in servers.values():
+        srv.start()
+    route_sock = str(tmp_path / "route.sock")
+    router = Router(list(socks.items()), start_monitor=False, down_after=1)
+    rserver = RouterServer(router, socket_path=route_sock)
+    rserver.start()
+    try:
+        sub = ServeClient(route_sock).submit_full(_spec(tmp_path / "out"))
+        owner = sub["node"]
+        survivor = [n for n in socks if n != owner][0]
+        # the direct-to-worker data path, router attached for re-resolution
+        client = ServeClient(socks[owner], retries=100, retry_base_s=0.1,
+                             router=route_sock)
+
+        got: dict = {}
+
+        def poll():
+            try:
+                got["job"] = client.result(key=sub["key"], timeout=600)
+            except Exception as e:
+                got["err"] = e
+
+        t = threading.Thread(target=poll)
+        t.start()
+        time.sleep(0.5)  # park the result op on the (paused) owner
+        servers[owner].close(timeout=5)  # kill -9 equivalent: never returns
+        scheds[owner].shutdown()
+        router.probe_members()  # health sweep notices the death
+        assert not router._member(owner).up
+        scheds[survivor].release()
+        t.join(timeout=600)
+        assert not t.is_alive(), "client poll never returned"
+        assert "err" not in got, got.get("err")
+        assert got["job"]["state"] == "done"
+        # the client followed the ring: it now points at the survivor
+        assert client.address == socks[survivor]
+        assert router.counters.snapshot()["route_resubmits"] == 1
+    finally:
+        rserver.close(timeout=5)
+        router.close()
+        for n in socks:
+            servers[n].close(timeout=5)
+            try:
+                scheds[n].close(timeout=120)
+            except TimeoutError:
+                pass
+    _assert_matches_golden(tmp_path / "out" / "golden", "router reresolve")
+
+
 # ------------------------------------------------- replay determinism
 
 def test_replay_determinism_two_replays_byte_identical(tmp_path):

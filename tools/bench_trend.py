@@ -1,0 +1,183 @@
+#!/usr/bin/env python3
+"""Cross-round performance trend: one table from the BENCH_r0*.json
+artifacts.
+
+Every PR round commits a ``BENCH_r<NN>.json`` capturing that round's
+``bench.py`` run, but the artifact shape has grown over the rounds (r02
+added the parsed headline, r08 added the obs cumulative counters) and
+some rounds only captured the stdout tail.  This tool tolerates all of
+them: it prefers the structured ``parsed`` doc, falls back to scraping
+the 2 KB stdout tail for whatever survived truncation, and marks
+rc != 0 rounds as failed instead of dropping them — so the trend table
+shows every round honestly rather than only the well-formed ones.
+
+    python tools/bench_trend.py [--out BASELINE_TREND.md]
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
+
+
+def discover(repo: str) -> list[tuple[int, str]]:
+    out = []
+    for path in sorted(glob.glob(os.path.join(repo, "BENCH_r*.json"))):
+        m = ROUND_RE.search(os.path.basename(path))
+        if m:
+            out.append((int(m.group(1)), path))
+    return sorted(out)
+
+
+def _scrape(tail: str, pattern: str) -> float | None:
+    hits = re.findall(pattern, tail)
+    if not hits:
+        return None
+    try:
+        return float(hits[-1])
+    except ValueError:
+        return None
+
+
+def extract(n: int, path: str) -> dict:
+    """One trend row; ``source`` records how much of it is trustworthy."""
+    doc = json.load(open(path))
+    parsed = doc.get("parsed") or {}
+    tail = doc.get("tail") or ""
+    row = {
+        "round": n,
+        "rc": doc.get("rc"),
+        "metric": None,
+        "families_per_s": None,
+        "vs_baseline": None,
+        "wall_s": None,
+        "bytes_h2d": None,
+        "deflate_frac": None,
+        "source": "parsed",
+    }
+    if parsed:
+        row["metric"] = parsed.get("metric")
+        if (parsed.get("unit") or "").startswith("families/"):
+            row["families_per_s"] = parsed.get("value")
+        row["vs_baseline"] = parsed.get("vs_baseline")
+        row["wall_s"] = parsed.get("wall_s")
+        row["bytes_h2d"] = parsed.get("bytes_h2d",
+                                      parsed.get("bytes_h2d_est"))
+        cum = parsed.get("cumulative") or {}
+        wall = row["wall_s"]
+        if cum.get("deflate_wall_us") and wall:
+            row["deflate_frac"] = round(
+                cum["deflate_wall_us"] / 1e6 / float(wall), 4)
+    elif doc.get("rc") == 0:
+        # headline doc truncated out of the stored tail; recover what the
+        # last 2 KB still hold (wall + H2D estimate), leave the rest blank
+        row["source"] = "tail-scrape"
+        row["wall_s"] = _scrape(tail, r'"wall_s": ([0-9.]+)')
+        row["bytes_h2d"] = _scrape(tail, r'"bytes_h2d_est": ([0-9.eE+]+)')
+    else:
+        row["source"] = "failed"
+    return row
+
+
+def _fmt(v, unit="") -> str:
+    if v is None:
+        return "—"
+    if isinstance(v, float) and v >= 1000:
+        return f"{v:,.1f}{unit}"
+    return f"{v:g}{unit}"
+
+
+def _fmt_bytes(v) -> str:
+    if v is None:
+        return "—"
+    return f"{float(v) / 1e6:,.1f} MB"
+
+
+def render(rows: list[dict]) -> str:
+    lines = [
+        "# Baseline performance trend",
+        "",
+        "Cross-round headline numbers from the committed `BENCH_r0*.json`",
+        "artifacts (regenerate with `python tools/bench_trend.py`).  The",
+        "headline metric is the SSCS→DCS consensus stage throughput in",
+        "families/s; `vs baseline` is the speedup over the r02 pure-host",
+        "baseline measured in the same artifact; `deflate frac` is the",
+        "share of bench wall spent in BGZF deflate (only exported since",
+        "the r08 obs counters).  Rows marked *tail-scrape* lost their",
+        "structured headline to stdout-tail truncation and show only the",
+        "fields recoverable from the last 2 KB; *failed* rounds kept the",
+        "artifact but the bench itself died (r01: no TPU backend in the",
+        "bench container).",
+        "",
+        "| round | headline (families/s) | vs baseline | wall (s) "
+        "| bytes H2D | deflate frac | source |",
+        "|------:|----------------------:|------------:|---------:"
+        "|----------:|-------------:|:-------|",
+    ]
+    for r in rows:
+        lines.append(
+            "| r{round:02d} | {fam} | {vsb} | {wall} | {h2d} | {defl} "
+            "| {src} |".format(
+                round=r["round"],
+                fam=_fmt(r["families_per_s"]),
+                vsb=_fmt(r["vs_baseline"], "x"),
+                wall=_fmt(r["wall_s"]),
+                h2d=_fmt_bytes(r["bytes_h2d"]),
+                defl=_fmt(r["deflate_frac"]),
+                src=r["source"]))
+    lines.append("")
+    ok = [r for r in rows if r["families_per_s"]]
+    if len(ok) >= 2:
+        first, last = ok[0], ok[-1]
+        gain = last["families_per_s"] / first["families_per_s"]
+        lines.append(
+            f"Headline trend across parseable rounds: "
+            f"{_fmt(first['families_per_s'])} families/s (r{first['round']:02d}) "
+            f"→ {_fmt(last['families_per_s'])} families/s "
+            f"(r{last['round']:02d}), {gain:.2f}x.")
+        lines.append("")
+        lines.append(
+            "Rounds are NOT strictly comparable: each measured whatever "
+            "leg its container could reach (`headline_leg`/`code_path` in "
+            "the artifact — r08 ran the cpu_fallback leg after its TPU "
+            "probe failed, r02/r03 measured the device leg), so read the "
+            "column as \"what that PR's bench observed\", not a single "
+            "controlled series.")
+        lines.append("")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--repo", default=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    ap.add_argument("--out", default="BASELINE_TREND.md",
+                    help="markdown output path, relative to --repo "
+                         "('-' = stdout only)")
+    args = ap.parse_args(argv)
+    found = discover(args.repo)
+    if not found:
+        print("bench_trend: no BENCH_r*.json artifacts found", file=sys.stderr)
+        return 1
+    rows = [extract(n, path) for n, path in found]
+    text = render(rows)
+    if args.out == "-":
+        print(text)
+        return 0
+    out = os.path.join(args.repo, args.out)
+    tmp = out + ".tmp"
+    with open(tmp, "w") as fh:
+        fh.write(text)
+    os.replace(tmp, out)
+    print(f"bench_trend: wrote {out} ({len(rows)} rounds)")
+    print(text)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

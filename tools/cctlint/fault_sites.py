@@ -54,4 +54,14 @@ FAULT_SITES: dict[str, str] = {
     "stream.operator_fail": "mid-stream producer fault -> channel poisoned, "
                             "surfaces at the consumer -> CLI falls back to "
                             "the staged pipeline, outputs byte-identical",
+    "route.member_down": "fleet member unreachable on a router forward -> "
+                         "member marked down, request fails over to the "
+                         "next ring owner (jobs replay exactly-once via "
+                         "the worker journal + --resume)",
+    "route.steal": "cross-node work-steal decision fails -> job stays on "
+                   "its ring-home node (stealing is an optimization, "
+                   "never a correctness dependency)",
+    "route.resubmit": "failover resubmission to the new ring owner fails "
+                      "-> clean error reply; the keyed poll retries and "
+                      "the next resolve resubmits again (idempotent)",
 }

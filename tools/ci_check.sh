@@ -16,7 +16,8 @@ echo "== tier-1 test suite =="
 T1LOG="$(mktemp)"
 set +e
 JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
-  --continue-on-collection-errors -p no:cacheprovider 2>&1 | tee "$T1LOG"
+  --continue-on-collection-errors -p no:cacheprovider \
+  -p no:xdist -p no:randomly 2>&1 | tee "$T1LOG"
 T1RC=${PIPESTATUS[0]}
 set -e
 if [ "$T1RC" -ne 0 ]; then
@@ -141,6 +142,72 @@ assert all(r == pre for r in recs), \
     f"measured levels minted new dispatch shapes: preflight={pre}, levels={recs}"
 print(f"ci_check: loadgen artifacts OK (learned table: {at['shapes']} shapes, "
       f"0 unexpected recompiles across {len(recs)} levels at {pre} total)")
+PY
+
+echo "== fleet failover smoke (router + 2 workers, kill -9 one mid-run) =="
+JAX_PLATFORMS=cpu PYTHONPATH="$REPO" python - "$WORK/fleet" <<'PY'
+import json, os, signal, subprocess, sys, time
+
+WORK = sys.argv[1]
+os.makedirs(WORK, exist_ok=True)
+REPO = os.getcwd()
+sys.path.insert(0, os.path.join(REPO, "test"))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+from make_test_data import canonical_bam_digest, text_digest
+from consensuscruncher_tpu.serve.client import ServeClient
+
+GOLDEN = json.load(open(os.path.join(REPO, "test", "golden.json")))
+SAMPLE = os.path.join(REPO, "test", "data", "sample.bam")
+sock = os.path.join(WORK, "route.sock")
+boot = ("import sys; sys.path.insert(0, %r); "
+        "from consensuscruncher_tpu.cli import main; "
+        "sys.exit(main(sys.argv[1:]))" % REPO)
+log = open(os.path.join(WORK, "router.log"), "wb")
+router = subprocess.Popen(
+    [sys.executable, "-c", boot, "route", "--spawn", "2",
+     "--workdir", WORK, "--socket", sock, "--backend", "xla_cpu",
+     "--gang_size", "1", "--queue_bound", "8", "--drain_s", "60"],
+    stdout=log, stderr=subprocess.STDOUT)
+ok = False
+try:
+    client = ServeClient(sock, retries=60, retry_base_s=0.25)
+    subs = [client.request({"op": "submit", "spec": {
+        "input": SAMPLE, "output": os.path.join(WORK, f"job{i}"),
+        "name": "golden", "cutoff": 0.7, "qualscore": 0,
+        "scorrect": True, "max_mismatch": 0, "bdelim": "|",
+        "compress_level": 6}}, timeout=180) for i in range(3)]
+    assert all(s.get("ok") for s in subs), subs
+    victim = subs[0]["node"]
+    # kill -9 the worker that owns an acknowledged job, mid-run; the
+    # pattern starts with '[' so pgrep doesn't eat it as an option
+    pid = int(subprocess.check_output(
+        ["pgrep", "-f", "[-]-node %s" % victim]).split()[0])
+    os.kill(pid, signal.SIGKILL)
+    for i, sub in enumerate(subs):
+        job = client.request({"op": "result", "key": sub["key"],
+                              "timeout": 600}, timeout=900)["job"]
+        assert job["state"] == "done", job
+        base = os.path.join(WORK, f"job{i}", "golden")
+        for rel, want in GOLDEN["consensus"].items():
+            path = os.path.join(base, rel)
+            got = (canonical_bam_digest(path) if rel.endswith(".bam")
+                   else text_digest(path))
+            assert got == want, f"fleet job {i} diverges at {rel}"
+    cum = client.request({"op": "metrics"}, timeout=60)["metrics"]["cumulative"]
+    assert cum["member_down_events"] >= 1, cum
+    assert cum["route_resubmits"] >= 1, cum
+    ok = True
+    print("ci_check: fleet smoke OK (killed %s; %d jobs byte-identical; "
+          "resubmits=%d)" % (victim, len(subs), cum["route_resubmits"]))
+finally:
+    router.send_signal(signal.SIGTERM)
+    try:
+        router.wait(timeout=120)
+    except subprocess.TimeoutExpired:
+        router.kill()
+    log.close()
+    if not ok:
+        sys.stderr.write(open(os.path.join(WORK, "router.log")).read()[-8000:])
 PY
 
 echo "ci_check: OK"

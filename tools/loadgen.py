@@ -190,6 +190,87 @@ def _delta(a: dict[str, int], b: dict[str, int]) -> dict[str, int]:
     return {k: a[k] - b.get(k, 0) for k in a}
 
 
+# ----------------------------------------------- fleet (router) metrics
+
+def _counter_by_node(doc: dict, name: str) -> dict[str, int]:
+    """A node-labeled router counter (node_jobs_routed/steals/resubmits)
+    as ``{node: value}``; empty against a plain daemon."""
+    out: dict[str, int] = {}
+    for entry in (doc.get("labeled") or {}).get("counters", {}).get(name, []):
+        node = entry["labels"].get("node")
+        if node:
+            out[node] = out.get(node, 0) + int(entry["value"])
+    return out
+
+
+def _wall_hist_by_node(doc: dict) -> dict[str, dict]:
+    """Each member's job-wall histogram (tenant_job_wall_s summed across
+    its tenant/qos series) from the router doc's ``nodes.<name>``."""
+    out: dict[str, dict] = {}
+    for node, ndoc in (doc.get("nodes") or {}).items():
+        series = ((ndoc or {}).get("labeled") or {}) \
+            .get("histograms", {}).get("tenant_job_wall_s", [])
+        agg = None
+        for h in series:
+            if agg is None:
+                agg = {"buckets": list(h["buckets"]),
+                       "counts": list(h["counts"])}
+            else:
+                agg["counts"] = [a + b
+                                 for a, b in zip(agg["counts"], h["counts"])]
+        if agg is not None:
+            out[node] = agg
+    return out
+
+
+def _recompiles_total(doc: dict) -> int | None:
+    """Process-global jit-cache size: the daemon's own counter, or the
+    sum over reachable fleet members when ``doc`` came from the router
+    (whose own process never compiles anything)."""
+    nodes = doc.get("nodes")
+    if nodes is None:
+        return (doc.get("cumulative") or {}).get("recompiles")
+    total = 0
+    for ndoc in nodes.values():
+        total += ((ndoc or {}).get("cumulative") or {}).get("recompiles", 0)
+    return total
+
+
+def _node_breakdown(before: dict, after: dict) -> dict[str, dict] | None:
+    """Per-node level stats from router metric deltas: jobs routed,
+    steals and failover resubmits landed on each member, plus the
+    member's own p50/p99 job wall — ``None`` against a plain daemon."""
+    if after.get("nodes") is None:
+        return None
+    routed = _delta(_counter_by_node(after, "node_jobs_routed"),
+                    _counter_by_node(before, "node_jobs_routed"))
+    steals = _delta(_counter_by_node(after, "node_steals"),
+                    _counter_by_node(before, "node_steals"))
+    resubmits = _delta(_counter_by_node(after, "node_resubmits"),
+                       _counter_by_node(before, "node_resubmits"))
+    walls_b = _wall_hist_by_node(before)
+    walls_a = _wall_hist_by_node(after)
+    out: dict[str, dict] = {}
+    for node in sorted(set(routed) | set(walls_a)):
+        p50 = p99 = None
+        done = 0
+        if node in walls_a:
+            d = _hist_delta(walls_b.get(node), walls_a[node])
+            done = sum(d["counts"])
+            if done:
+                p50 = quantile_from_histogram(d["buckets"], d["counts"], 0.50)
+                p99 = quantile_from_histogram(d["buckets"], d["counts"], 0.99)
+        out[node] = {
+            "jobs_routed": routed.get(node, 0),
+            "jobs_finished": done,
+            "steals": steals.get(node, 0),
+            "resubmits": resubmits.get(node, 0),
+            "p50_s": None if p50 is None else round(p50, 6),
+            "p99_s": None if p99 is None else round(p99, 6),
+        }
+    return out
+
+
 # ------------------------------------------------------------ one level
 
 def _run_level(client: ServeClient, rng: random.Random, level_idx: int,
@@ -260,6 +341,7 @@ def _run_level(client: ServeClient, rng: random.Random, level_idx: int,
     lost += len(pending)
     level_wall = time.monotonic() - t0
     after = client.metrics()
+    nodes = _node_breakdown(before, after)
 
     # per-class stats from the daemon's own labeled series
     walls_b = _wall_hist_by_qos(before)
@@ -303,6 +385,7 @@ def _run_level(client: ServeClient, rng: random.Random, level_idx: int,
         "level_wall_s": round(level_wall, 3),
         "max_schedule_slip_s": round(max_slip, 3),
         "classes": classes,
+        "nodes": nodes,
         "aggregate": {
             "submitted": agg_submitted,
             "done": agg_done,
@@ -332,6 +415,96 @@ def knee_estimate(levels: list[dict], shed_knee: float) -> dict:
     }
 
 
+# ---------------------------------------------------- fleet scale sweep
+
+def _sweep_workers(args) -> int:
+    """``--sweep_workers 1,2,4``: the full level sweep once per worker
+    count (fresh fleet each time, identical traffic seed/mix), combined
+    into one artifact with per-count knees, peak throughputs and
+    speedups vs the 1-worker run.  ``host_cpus`` is recorded because
+    fleet scaling is bounded by the silicon underneath: on a 1-CPU host
+    the workers time-slice one core and the sweep measures routing
+    overhead + failover correctness, not parallel speedup."""
+    counts = sorted({int(c) for c in args.sweep_workers.split(",")
+                     if c.strip()})
+    if not counts or counts[0] < 1:
+        raise SystemExit("loadgen: --sweep_workers wants counts >= 1")
+    try:
+        host_cpus = len(os.sched_getaffinity(0))
+    except (AttributeError, OSError):
+        host_cpus = os.cpu_count() or 1
+    runs: dict[str, dict] = {}
+    worst = 0
+    for n in counts:
+        workdir = os.path.join(args.workdir, f"sweep_w{n}")
+        out = os.path.join(args.workdir, f"sweep_w{n}.json")
+        argv = [
+            "--workdir", workdir, "--workers", str(n),
+            "--levels", args.levels, "--duration", str(args.duration),
+            "--settle", str(args.settle), "--mix", args.mix,
+            "--inputs_per_class", str(args.inputs_per_class),
+            "--seed", str(args.seed), "--gang_size", str(args.gang_size),
+            "--queue_bound", str(args.queue_bound),
+            "--class_weights", args.class_weights,
+            "--slo_targets", args.slo_targets,
+            "--shed_knee", str(args.shed_knee), "--out", out,
+        ]
+        if args.families_hist:
+            argv += ["--families_hist", args.families_hist]
+        if args.compile_cache:
+            argv += ["--compile_cache", args.compile_cache]
+        if args.tenant_queue_cap:
+            argv += ["--tenant_queue_cap", str(args.tenant_queue_cap)]
+        if args.smoke:
+            argv += ["--smoke"]
+        print(f"loadgen: ===== sweep: {n} worker(s) =====", flush=True)
+        worst = max(worst, main(argv))
+        runs[str(n)] = json.load(open(out))
+    base_peak = runs[str(counts[0])]["knee"]["max_throughput_jobs_per_s"]
+    scaling = {
+        str(n): {
+            "workers": n,
+            "knee_offered_jobs_per_s":
+                runs[str(n)]["knee"]["knee_offered_jobs_per_s"],
+            "max_throughput_jobs_per_s":
+                runs[str(n)]["knee"]["max_throughput_jobs_per_s"],
+            "speedup_vs_1_worker": (
+                round(runs[str(n)]["knee"]["max_throughput_jobs_per_s"]
+                      / base_peak, 4) if base_peak else None),
+        }
+        for n in counts
+    }
+    doc = {
+        "bench": "loadgen_fleet_sweep",
+        "created_unix": time.time(),
+        "host_cpus": host_cpus,
+        "cpu_bound_note": (
+            "worker daemons are CPU-bound on this host; throughput "
+            "scaling with worker count requires at least one core per "
+            "worker — with host_cpus <= worker count the fleet "
+            "time-slices and the sweep measures routing overhead and "
+            "correctness, not parallel speedup"),
+        "config": runs[str(counts[0])]["config"],
+        "scaling": scaling,
+        "runs": runs,
+    }
+    out = args.out or time.strftime(
+        "BENCH_LOADGEN_SWEEP_%Y%m%d-%H%M%SZ.json", time.gmtime())
+    tmp = out + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(doc, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    os.replace(tmp, out)
+    print(f"loadgen: wrote {out}", flush=True)
+    for n in counts:
+        s = scaling[str(n)]
+        print(f"loadgen: {n} worker(s): knee="
+              f"{s['knee_offered_jobs_per_s']} jobs/s, peak="
+              f"{s['max_throughput_jobs_per_s']:g} jobs/s, speedup="
+              f"{s['speedup_vs_1_worker']}", flush=True)
+    return worst
+
+
 # ------------------------------------------------------------------ main
 
 def main(argv=None) -> int:
@@ -339,8 +512,20 @@ def main(argv=None) -> int:
     ap.add_argument("--workdir", required=True,
                     help="scratch dir: socket, inputs, job outputs, daemon log")
     ap.add_argument("--connect", default="",
-                    help="existing daemon (unix socket path or host:port); "
-                         "empty = spawn a throwaway daemon in --workdir")
+                    help="existing daemon OR fleet router (unix socket "
+                         "path or host:port — the router speaks the same "
+                         "keyed protocol); empty = spawn a throwaway "
+                         "daemon in --workdir")
+    ap.add_argument("--workers", type=int, default=0,
+                    help="spawn a FLEET instead of one daemon: N worker "
+                         "daemons behind a router ('route --spawn N'); "
+                         "level reports gain a per-node breakdown "
+                         "(0 = single daemon; ignored with --connect)")
+    ap.add_argument("--sweep_workers", default="",
+                    help="capacity-scaling sweep: comma-separated worker "
+                         "counts (e.g. '1,2,4'); runs the FULL level "
+                         "sweep once per count and writes one combined "
+                         "artifact with per-count knees and speedups")
     ap.add_argument("--levels", default="0.5,1,2,4",
                     help="comma-separated offered-load levels, jobs/second")
     ap.add_argument("--duration", type=float, default=30.0,
@@ -384,6 +569,9 @@ def main(argv=None) -> int:
                          "levels, short settle")
     args = ap.parse_args(argv)
 
+    if args.sweep_workers:
+        return _sweep_workers(args)
+
     if args.smoke:
         args.levels = "1,3,8"
         args.duration = 3.0
@@ -410,6 +598,27 @@ def main(argv=None) -> int:
                    else args.connect)
         if isinstance(address, tuple):
             address = (address[0], int(address[1]))
+    elif args.workers > 0:
+        # fleet mode: the route CLI spawns the workers (per-worker
+        # journal + compile cache under workdir/fleet) and fronts them
+        address = os.path.join(args.workdir, "route.sock")
+        daemon_cmd = [sys.executable, "-c", _BOOT] + [
+            "route", "--spawn", str(args.workers),
+            "--workdir", os.path.join(args.workdir, "fleet"),
+            "--socket", address,
+            "--gang_size", str(args.gang_size),
+            "--queue_bound", str(args.queue_bound),
+            "--backend", "xla_cpu", "--drain_s", "60",
+            "--class_weights", args.class_weights,
+            "--slo_targets", args.slo_targets,
+        ]
+        if args.compile_cache:
+            daemon_cmd += ["--compile_cache", args.compile_cache]
+        log_path = os.path.join(args.workdir, "router.log")
+        log_fh = open(log_path, "ab")
+        daemon = subprocess.Popen(daemon_cmd, stdout=log_fh, stderr=log_fh)
+        print(f"loadgen: spawned router pid {daemon.pid} on {address} "
+              f"({args.workers} workers; log: {log_path})", flush=True)
     else:
         address = os.path.join(args.workdir, "loadgen.sock")
         daemon_cmd = [sys.executable, "-c", _BOOT] + [
@@ -478,8 +687,7 @@ def main(argv=None) -> int:
         for _ in range(max(1, args.gang_size)):
             burst.extend(_submit_pre(qos, bam) for qos, bam in pre_jobs)
         _wait_pre(burst)
-        pre_recompiles = (client.metrics().get("cumulative") or
-                          {}).get("recompiles")
+        pre_recompiles = _recompiles_total(client.metrics())
         print(f"loadgen: preflight {pre_seq[0]} job(s) settled "
               f"(recompiles_total={pre_recompiles})", flush=True)
         for idx, rate in enumerate(rates):
@@ -497,11 +705,19 @@ def main(argv=None) -> int:
                   f"shed_ratio={agg['shed_ratio']:g}", flush=True)
             if agg["lost"]:
                 rc = 1
+            if lv["nodes"]:
+                for node, st in sorted(lv["nodes"].items()):
+                    print(f"loadgen:   node {node}: "
+                          f"routed={st['jobs_routed']} "
+                          f"finished={st['jobs_finished']} "
+                          f"steals={st['steals']} "
+                          f"resubmits={st['resubmits']} "
+                          f"p50={st['p50_s']} p99={st['p99_s']}",
+                          flush=True)
             # process-global jit-cache size after this level: under a
             # learned table the steady-state levels must not mint shapes
             # (tools/ci_check.sh asserts it's flat past level 0)
-            lv["recompiles_total"] = (client.metrics().get("cumulative") or
-                                      {}).get("recompiles")
+            lv["recompiles_total"] = _recompiles_total(client.metrics())
             levels.append(lv)
         final = client.metrics()
         doc = {
@@ -519,6 +735,7 @@ def main(argv=None) -> int:
                 "families_hist": args.families_hist or "builtin",
                 "seed": args.seed,
                 "smoke": args.smoke,
+                "workers": args.workers,
             },
             "preflight_recompiles_total": pre_recompiles,
             "levels": levels,
@@ -527,6 +744,13 @@ def main(argv=None) -> int:
             "queued_by_class": final.get("queued_by_class"),
             "autotune": final.get("autotune"),
         }
+        if final.get("nodes") is not None:  # fleet run: router doc
+            doc["fleet"] = final.get("fleet")
+            doc["router_cumulative"] = final.get("cumulative")
+            doc["nodes_final"] = {
+                node: {k: (ndoc or {}).get(k)
+                       for k in ("slo", "autotune", "queued_by_class")}
+                for node, ndoc in final["nodes"].items()}
         out = args.out or time.strftime("BENCH_LOADGEN_%Y%m%d-%H%M%SZ.json",
                                         time.gmtime())
         tmp = out + ".tmp"
