@@ -1320,25 +1320,78 @@ def _spawn_fleet(args, children: dict) -> list:
     return members
 
 
+def _parse_journals(text: str) -> dict:
+    """``'w0=/path/w0.journal,w1=/path/w1.journal'`` -> ``{name: path}``
+    for the router's journal-adoption map."""
+    out: dict = {}
+    for part in str(text or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise SystemExit(f"route: --journals entry {part!r} is not "
+                             "'name=path'")
+        name, path = part.split("=", 1)
+        out[name.strip()] = os.path.abspath(path.strip())
+    return out
+
+
+def _route_adopt(args) -> None:
+    """``route --adopt NODE``: client mode — ask the running router (at
+    --socket / --host:--port) to adopt a dead member's journal now,
+    instead of waiting out ``adopt_after_s``."""
+    from consensuscruncher_tpu.serve.client import ServeClient
+
+    address = args.socket or (args.host, int(args.port))
+    reply = ServeClient(address).request(
+        {"op": "adopt", "node": str(args.adopt),
+         "force": _bool(getattr(args, "adopt_force", "False") or "False")},
+        timeout=600.0)
+    print(f"route: adopted {reply.get('node')} — "
+          f"{reply.get('jobs_adopted', 0)} jobs resubmitted "
+          f"({', '.join(reply.get('keys') or []) or 'none pending'})")
+
+
 def route_cmd(args) -> None:
     """Run the fleet router (serve/router.py): a stateless front door
     consistent-hashing submits by idempotency key onto N worker daemons,
     with replay-aware failover and bounded cross-node work stealing.
     ``--members`` points at externally managed daemons; ``--spawn N``
-    brings up a local fleet under the supervisor restart policy."""
+    brings up a local fleet under the supervisor restart policy.
+
+    HA: ``--ring_view PATH`` (shared, fsync'd epoch document) plus a
+    second ``route --standby True`` process against the same path gives
+    an active/standby pair — the standby health-probes the active and
+    takes over by bumping the epoch; workers fence the stale router.
+    ``--adopt_after_s`` arms journal adoption of permanently lost
+    members; ``--adopt NODE`` triggers it by hand."""
     from consensuscruncher_tpu.serve.router import (
         Router, RouterServer, parse_members,
     )
     from consensuscruncher_tpu.serve.server import install_signal_handlers
 
+    if getattr(args, "adopt", None):
+        _route_adopt(args)
+        return
+
     children: dict = {}
+    journals = _parse_journals(getattr(args, "journals", ""))
     if int(args.spawn or 0) > 0:
         members = _spawn_fleet(args, children)
+        # spawned workers journal under --workdir by construction: the
+        # adoption map needs no extra flags for the common case
+        workdir = os.path.abspath(args.workdir or "fleet")
+        for name, _ in members:
+            journals.setdefault(name, os.path.join(workdir,
+                                                   f"{name}.journal"))
     elif getattr(args, "members", None):
         members = parse_members(args.members)
     else:
         raise SystemExit("route: pass --members 'n0=sock,...' for an "
                          "existing fleet, or --spawn N to launch one")
+    standby = _bool(getattr(args, "standby", "False") or "False")
+    adopt_after_s = getattr(args, "adopt_after_s", "")
+    adopt_after_s = None if adopt_after_s in (None, "") else float(adopt_after_s)
     router = Router(
         members,
         vnodes=int(args.vnodes),
@@ -1346,15 +1399,30 @@ def route_cmd(args) -> None:
         steal_margin=int(args.steal_margin),
         health_interval_s=float(args.health_interval_s),
         down_after=int(args.down_after),
-    )
+        router_id=str(getattr(args, "router_id", "") or "r0"),
+        ring_view=getattr(args, "ring_view", "") or None,
+        standby=standby,
+        takeover_after=int(getattr(args, "takeover_after", 3) or 3),
+        adopt_after_s=adopt_after_s,
+        journals=journals or None,
+        start_monitor=False,  # started below, once the advertise
+    )                         # address is known
     server = RouterServer(router, host=args.host, port=int(args.port),
                           socket_path=args.socket or None)
+    advertise = getattr(args, "advertise", "") or None
+    if advertise and ":" in advertise and os.sep not in advertise:
+        host, port = advertise.rsplit(":", 1)
+        advertise = (host, int(port))
+    router.start(advertise=advertise or server.address)
     install_signal_handlers(server, router, None)
     print(f"route: fleet front door on {server.describe()} over "
           f"{len(members)} members "
           f"({', '.join(name for name, _ in members)}); "
           f"steal_threshold={router.steal_threshold}, "
-          f"steal_margin={router.steal_margin}", flush=True)
+          f"steal_margin={router.steal_margin}"
+          + (f"; ha={'standby' if router.standby else 'active'} "
+             f"epoch={router.epoch} ring_view={args.ring_view}"
+             if router.ring_view is not None else ""), flush=True)
     try:
         server.serve_forever()
     except KeyboardInterrupt:
@@ -1684,6 +1752,40 @@ def build_parser() -> argparse.ArgumentParser:
                    help="bounded drain window for spawned workers on "
                         "router shutdown (default $CCT_SERVE_DRAIN_S "
                         "or 30)")
+    r.add_argument("--router_id",
+                   help="this router's identity in the ring-view document "
+                        "(default r0); give the standby a distinct id")
+    r.add_argument("--ring_view",
+                   help="path to the shared epoch-numbered ring-view "
+                        "document; set on BOTH routers of an HA pair "
+                        "(default: unset = single-router mode)")
+    r.add_argument("--standby",
+                   help="start as the standby of an HA pair: health-probe "
+                        "the active router and take over by bumping the "
+                        "ring-view epoch when it stops answering "
+                        "(default False)")
+    r.add_argument("--takeover_after", type=int,
+                   help="consecutive failed probes of the active router "
+                        "before the standby takes over (default 3)")
+    r.add_argument("--adopt_after_s",
+                   help="adopt a dead member's journal (resubmit its "
+                        "non-terminal jobs to the ring successor, then "
+                        "tombstone) once it has been down this many "
+                        "seconds (default: unset = manual --adopt only)")
+    r.add_argument("--journals",
+                   help="journal paths for adoption as 'name=path,...'; "
+                        "auto-derived for --spawn fleets")
+    r.add_argument("--advertise",
+                   help="address other routers should probe this one at "
+                        "('host:port' or a unix socket path; default: "
+                        "the bound server address)")
+    r.add_argument("--adopt", metavar="NODE",
+                   help="client mode: ask the running router (--socket / "
+                        "--host:--port) to adopt NODE's journal now, "
+                        "then exit")
+    r.add_argument("--adopt_force",
+                   help="with --adopt: adopt even if the member still "
+                        "answers health probes (default False)")
     r.set_defaults(func=route_cmd, config_section="route", required_args=(),
                    builtin_defaults={
                        "members": "", "spawn": 0, "workdir": "",
@@ -1695,6 +1797,11 @@ def build_parser() -> argparse.ArgumentParser:
                        "compile_cache": "", "warmup_shapes": "",
                        "class_weights": "", "slo_targets": "",
                        "max_restarts": 10, "drain_s": "",
+                       "router_id": "r0", "ring_view": "",
+                       "standby": "False", "takeover_after": 3,
+                       "adopt_after_s": "", "journals": "",
+                       "advertise": "", "adopt": "",
+                       "adopt_force": "False",
                    })
 
     t = sub.add_parser(

@@ -437,6 +437,17 @@ def render_fleet_prometheus(doc: dict) -> str:
     })
     lines = [head.rstrip("\n")] if head.strip() else []
 
+    if doc.get("epoch") is not None:
+        lines.append("# HELP cct_router_epoch ring-view epoch this router "
+                     "is serving at (bumps on every takeover)")
+        lines.append("# TYPE cct_router_epoch gauge")
+        lines.append(f"cct_router_epoch {_fmt(int(doc.get('epoch') or 0))}")
+        lines.append("# HELP cct_router_active 1 while this router is the "
+                     "active (non-standby, non-fenced) front door")
+        lines.append("# TYPE cct_router_active gauge")
+        lines.append("cct_router_active "
+                     f"{1 if doc.get('ha_state') == 'active' else 0}")
+
     fleet = doc.get("fleet") or {}
     members = fleet.get("members") or []
     lines.append("# HELP cct_fleet_members configured fleet member count")

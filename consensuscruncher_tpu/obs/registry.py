@@ -53,6 +53,23 @@ COUNTERS = {
                        "each an exactly-once replay, not a double run)",
     "member_down_events": "fleet members the router marked down (transport "
                           "failure on a forward, or health-probe streak)",
+    "route_locate_sweeps": "keyed polls the router answered by sweeping the "
+                           "fleet after the ring owner said unknown-job (a "
+                           "failover emptied the placement cache, or a "
+                           "membership change moved the key's ring home "
+                           "away from the node that ran it)",
+    "router_failovers": "standby routers that promoted themselves to active "
+                        "after the live router stopped answering (each "
+                        "bumps the ring-view epoch)",
+    "journals_adopted": "dead members' journals replayed and tombstoned by "
+                        "the router after the eviction horizon",
+    "jobs_adopted": "non-terminal jobs resubmitted by key to a ring "
+                    "successor during journal adoption (worker journal "
+                    "dedup + --resume keep each exactly-once)",
+    "fencing_rejections": "requests rejected by epoch fencing: a worker "
+                          "refusing a stale router's forward, or a "
+                          "returning zombie dropping its adopted "
+                          "(tombstoned) jobs at replay",
 }
 
 CUMULATIVE_KEYS = tuple(COUNTERS)

@@ -36,26 +36,35 @@ class ServeClientError(RuntimeError):
 
 
 class ServeClient:
-    """``address`` is a unix socket path (str) or a ``(host, port)`` pair.
+    """``address`` is a unix socket path (str), a ``(host, port)`` pair,
+    or a *list* of such addresses — an HA router pair's front doors.  The
+    client talks to the first; on a retryable failure it rotates to the
+    next (a standby router answers ``standby: true, busy: true``, which
+    is retryable by design, so rotation finds the active automatically —
+    the client survives a router failover without configuration).
 
     ``retries`` transport-level reconnect attempts (default
     ``CCT_SERVE_CLIENT_RETRIES`` or 5) with ``backoff_delay``-capped
     sleeps between them; every op is idempotent so a blind resend is safe.
 
-    ``router`` (optional) is the fleet router's address: a client polling
-    a *worker* directly re-resolves the key's current owner through the
-    router when the worker stops answering — a mid-poll worker kill stays
-    restart-invisible even on the direct data path, because the router's
-    replay-aware failover has already resubmitted the job to the new ring
-    owner by the time ``locate`` answers.
+    ``router`` (optional) is the fleet router's address — or a list of
+    router addresses — for clients polling a *worker* directly: the key's
+    current owner is re-resolved through a router when the worker stops
+    answering, and the resolution itself walks the router list on
+    **every** reconnect attempt, so a router failover happening in the
+    middle of the client's retry loop is survived too (the responsive
+    router is promoted to the front of the list).
     """
 
     def __init__(self, address, connect_timeout: float = 10.0,
                  retries: int | None = None,
                  retry_base_s: float | None = None,
                  router=None):
-        self.address = address
-        self.router = router
+        self.addresses = self._address_list(address)
+        if not self.addresses:
+            raise ValueError("serve client: empty address")
+        self.address = self.addresses[0]
+        self.routers = self._address_list(router)
         self.connect_timeout = connect_timeout
         if retries is None:
             retries = int(os.environ.get("CCT_SERVE_CLIENT_RETRIES", "5"))
@@ -63,6 +72,48 @@ class ServeClient:
         if retry_base_s is None:
             retry_base_s = float(os.environ.get("CCT_RETRY_BASE_S", "0.5"))
         self.retry_base_s = float(retry_base_s)
+
+    @property
+    def router(self):
+        """First configured router address (back-compat accessor)."""
+        return self.routers[0] if self.routers else None
+
+    @staticmethod
+    def _address_list(value) -> list:
+        """Normalize an address argument into a list of addresses.  A
+        tuple, a string, or a 2-list ``[host, port]`` is ONE address;
+        any other list is many (each element normalized likewise)."""
+        if value is None:
+            return []
+        if isinstance(value, (str, tuple)):
+            return [value]
+        if isinstance(value, list):
+            if len(value) == 2 and isinstance(value[0], str) \
+                    and isinstance(value[1], int):
+                return [(value[0], int(value[1]))]
+            out = []
+            for v in value:
+                if isinstance(v, list) and len(v) == 2:
+                    out.append((v[0], int(v[1])))
+                else:
+                    out.append(v)
+            return out
+        return [value]
+
+    def _rotate_address(self) -> None:
+        """Point at the next configured address (wrapping); a re-resolved
+        off-list worker address simply falls back to the first router."""
+        if len(self.addresses) < 2 and self.address in self.addresses:
+            return
+        try:
+            i = self.addresses.index(self.address)
+        except ValueError:
+            i = -1
+        nxt = self.addresses[(i + 1) % len(self.addresses)]
+        if nxt != self.address:
+            print(f"WARNING: serve client: rotating to {nxt}",
+                  file=sys.stderr, flush=True)
+            self.address = nxt
 
     def _request_once(self, doc: dict, timeout: float | None = None) -> dict:
         if isinstance(self.address, str):
@@ -101,29 +152,39 @@ class ServeClient:
         # timeouts against a wedged process, missing unix socket, ...
         return isinstance(exc, OSError)
 
-    def _reresolve(self, doc: dict) -> None:
-        """Ask the router where this request's key lives *now* and repoint
-        ``self.address`` there.  Best-effort: an unreachable router (or a
-        keyless request) keeps the current address — the normal retry
-        loop still covers a same-address daemon restart."""
+    def _reresolve(self, doc: dict) -> bool:
+        """Ask a router where this request's key lives *now* and repoint
+        ``self.address`` there.  Walks the whole router list on EVERY
+        attempt — a failover mid-retry just means the standby-turned-
+        active answers instead; the responsive router is promoted to the
+        front so later attempts hit it first.  Best-effort: all routers
+        unreachable (or a keyless request) keeps the current address —
+        the normal retry loop still covers a same-address daemon restart.
+        Returns True when a router answered."""
         key = doc.get("key")
         if not key:
-            return
-        try:
-            reply = ServeClient(self.router, retries=0).request(
-                {"op": "locate", "key": key}, timeout=10.0)
-        except Exception as e:
-            print(f"WARNING: serve client: router locate failed ({e}); "
-                  "keeping current address", file=sys.stderr, flush=True)
-            return
-        address = reply.get("address")
-        if isinstance(address, list):
-            address = (address[0], int(address[1]))
-        if address and address != self.address:
-            print(f"WARNING: serve client: key {key} now owned by "
-                  f"{reply.get('node')} at {address}; re-pointing",
-                  file=sys.stderr, flush=True)
-            self.address = address
+            return False
+        for r in list(self.routers):
+            try:
+                reply = ServeClient(r, retries=0).request(
+                    {"op": "locate", "key": key}, timeout=10.0)
+            except Exception as e:
+                print(f"WARNING: serve client: router {r} locate failed "
+                      f"({e}); trying next", file=sys.stderr, flush=True)
+                continue
+            if r != self.routers[0]:
+                self.routers.remove(r)
+                self.routers.insert(0, r)
+            address = reply.get("address")
+            if isinstance(address, list):
+                address = (address[0], int(address[1]))
+            if address and address != self.address:
+                print(f"WARNING: serve client: key {key} now owned by "
+                      f"{reply.get('node')} at {address}; re-pointing",
+                      file=sys.stderr, flush=True)
+                self.address = address
+            return True
+        return False
 
     def _request(self, doc: dict, timeout: float | None = None) -> dict:
         attempts = self.retries + 1
@@ -138,8 +199,11 @@ class ServeClient:
                       f"{delay:.1f}s (attempt {attempt + 2}/{attempts})",
                       file=sys.stderr, flush=True)
                 time.sleep(delay)
-                if self.router is not None:
-                    self._reresolve(doc)
+                repointed = False
+                if self.routers:
+                    repointed = self._reresolve(doc)
+                if not repointed and len(self.addresses) > 1:
+                    self._rotate_address()
         raise AssertionError("unreachable")
 
     def request(self, doc: dict, timeout: float | None = None) -> dict:

@@ -143,8 +143,12 @@ class Journal:
     def append_job(self, job_id: int, state: str, **fields) -> int:
         return self.append(job_record(job_id, state, **fields))
 
-    def append_marker(self, kind: str) -> int:
-        return self.append({"v": 1, "rec": "marker", "kind": kind})
+    def append_marker(self, kind: str, **fields) -> int:
+        rec = {"v": 1, "rec": "marker", "kind": kind}
+        for k, v in fields.items():
+            if v is not None:
+                rec[k] = v
+        return self.append(rec)
 
     def size(self) -> int:
         with self._lock:
@@ -192,7 +196,19 @@ def replay(path: str) -> tuple[dict[int, dict], dict]:
 
     Returns ``(jobs, info)``: ``jobs`` maps job id -> merged record (the
     union of every record for that id, later fields winning), ``info``
-    carries ``{"records", "skipped", "torn_tail", "clean_drain"}``.
+    carries ``{"records", "skipped", "torn_tail", "clean_drain",
+    "adopted_by", "fence_epoch"}``.
+
+    Two marker kinds carry fleet-HA state through replay:
+
+    - an ``adopted`` tombstone (written by the router after it resubmits
+      a dead member's non-terminal jobs to their ring successors) tags
+      every job recorded *before* it with ``"adopted": True`` — a
+      returning zombie worker must not re-run work that now lives
+      elsewhere; ``info["adopted_by"]`` names the adopting router;
+    - a ``fence`` marker persists the highest router epoch this worker
+      has accepted, so a restart cannot be tricked into honoring a
+      demoted router's forwards (``info["fence_epoch"]``).
 
     Tolerant by design: a torn final record (crash mid-append) is logged
     and skipped; any other undecodable or fault-injected record is logged,
@@ -201,7 +217,7 @@ def replay(path: str) -> tuple[dict[int, dict], dict]:
     """
     jobs: dict[int, dict] = {}
     info = {"records": 0, "skipped": 0, "torn_tail": False,
-            "clean_drain": False}
+            "clean_drain": False, "adopted_by": None, "fence_epoch": None}
     if not os.path.exists(path):
         return jobs, info
     with open(path, "rb") as fh:
@@ -238,6 +254,20 @@ def replay(path: str) -> tuple[dict[int, dict], dict]:
             # markers only matter as the journal's last word: any job
             # record after a drain marker belongs to a newer daemon life
             info["clean_drain"] = rec.get("kind") == "drain"
+            if rec.get("kind") == "adopted":
+                # tombstone: every job recorded so far was handed to its
+                # ring successor; a replaying zombie must not re-run them
+                info["adopted_by"] = str(rec.get("router") or "?")
+                for merged in jobs.values():
+                    merged["adopted"] = True
+            elif rec.get("kind") == "fence":
+                try:
+                    epoch = int(rec.get("epoch"))
+                except (TypeError, ValueError):
+                    epoch = None
+                if epoch is not None:
+                    info["fence_epoch"] = max(
+                        info["fence_epoch"] or 0, epoch)
             continue
         info["clean_drain"] = False
         try:

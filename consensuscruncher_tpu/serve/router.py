@@ -33,10 +33,35 @@ Routing discipline:
   a steal is an optimization only: the ``route.steal`` fault site forces
   the job home, never fails it.
 
+Router HA (:class:`RingView`): the router is no longer a single point of
+failure.  An **epoch-numbered ring-view document** — NDJSON records
+appended with fsync and compacted through ``manifest.commit_file``, torn-
+write tolerant exactly like the job journal — is shared by an active
+router and any number of standbys.  A standby health-probes the active's
+advertised address; after ``takeover_after`` consecutive failed probes it
+takes over by bumping the epoch and publishing itself.  Every forward a
+router sends carries its ``(epoch, router_id)``; workers **fence** stale
+routers by rejecting forwards whose epoch is below the highest they have
+accepted (persisted via a journal ``fence`` marker), so a zombie router
+that wakes up after a takeover cannot double-dispatch — its first forward
+comes back ``fenced`` and it demotes itself to a refusing standby.
+
+Journal adoption: a member down past ``adopt_after_s`` is permanently
+lost as far as its journaled jobs are concerned — so the active router
+(or ``cct route --adopt NODE``) replays the dead member's journal,
+resubmits every non-terminal job **by idempotency key** to its ring
+successor (worker journal dedup + manifest ``--resume`` keep that
+exactly-once and byte-identical), and appends an ``adopted`` tombstone
+marker to the dead journal.  A returning zombie worker replays the
+tombstone, drops the adopted jobs instead of re-running them, and counts
+each drop in ``fencing_rejections``.
+
 Fault sites (registered in ``tools/cctlint/fault_sites.py``, armed by the
 chaos tests): ``route.member_down`` (a forward hits a dead member),
 ``route.steal`` (the steal decision itself), ``route.resubmit`` (the
-failover resubmission).
+failover resubmission), ``route.router_down`` (the standby's probe of the
+active router), ``route.adopt`` (the adoption sweep), ``route.fence``
+(worker-side epoch admission).
 
 Wire protocol: the same NDJSON ops as :mod:`serve.server`, plus
 ``{"op": "locate", "key": ...}`` -> the member currently owning the key
@@ -54,18 +79,23 @@ gauges plus node-labeled per-member series.
 from __future__ import annotations
 
 import hashlib
+import json
 import os
 import sys
+import tempfile
 import threading
 import time
 from bisect import bisect_right
 from collections import OrderedDict
 
+from consensuscruncher_tpu.obs import flight as obs_flight
 from consensuscruncher_tpu.obs import metrics as obs_metrics
+from consensuscruncher_tpu.serve import journal as journal_mod
 from consensuscruncher_tpu.serve.client import ServeClient, ServeClientError
 from consensuscruncher_tpu.serve.journal import idempotency_key
 from consensuscruncher_tpu.serve.server import ServeServer
-from consensuscruncher_tpu.utils import faults
+from consensuscruncher_tpu.utils import faults, sanitize
+from consensuscruncher_tpu.utils.manifest import commit_file
 from consensuscruncher_tpu.utils.profiling import Counters
 
 # qos classes eligible for cross-node stealing: latency-insensitive work
@@ -135,6 +165,115 @@ class HashRing:
         return out
 
 
+class RingView:
+    """Epoch-numbered ring-view document shared by the router pair.
+
+    NDJSON, one record per epoch publication::
+
+      {"address": ..., "epoch": 3, "members": [["w0", "/run/w0.sock"], ...],
+       "router": "r1", "t": 1722900000.0, "v": 1}
+
+    Durability mirrors the job journal: every :meth:`publish` appends one
+    fsync'd record (open/append/fsync/close — epoch changes are rare), and
+    once the file outgrows ``max_records`` it is compacted to just the
+    current record through ``manifest.commit_file`` (fsync + rename +
+    dir-fsync), so a crash mid-compaction leaves the old doc or the new
+    one, never a mix.  :meth:`load` is torn-write tolerant: a truncated
+    final record — a crash mid-append, or the byte-boundary truncations
+    the torn-doc test applies — is skipped and the highest *committed*
+    epoch wins.
+    """
+
+    def __init__(self, path: str, max_records: int = 256):
+        self.path = str(path)
+        self.max_records = max(2, int(max_records))
+        parent = os.path.dirname(os.path.abspath(self.path))
+        os.makedirs(parent, exist_ok=True)
+        self._lock = sanitize.tracked_lock("ringview.lock")
+
+    def scan(self) -> tuple[list[dict], dict]:
+        """All decodable records plus ``{"records", "skipped",
+        "torn_tail"}`` (the torn-doc test asserts on the info)."""
+        records: list[dict] = []
+        info = {"records": 0, "skipped": 0, "torn_tail": False}
+        if not os.path.exists(self.path):
+            return records, info
+        with open(self.path, "rb") as fh:
+            raw = fh.read()
+        lines = raw.split(b"\n")
+        tail = lines.pop() if lines else b""
+        if tail.strip():
+            lines.append(tail)
+        for idx, line in enumerate(lines):
+            if not line.strip():
+                continue
+            try:
+                rec = json.loads(line)
+                if not isinstance(rec, dict) or "epoch" not in rec:
+                    raise ValueError("not a ring-view record")
+                rec["epoch"] = int(rec["epoch"])
+            except (ValueError, TypeError):
+                info["skipped"] += 1
+                if idx == len(lines) - 1 and line == tail:
+                    info["torn_tail"] = True
+                continue
+            records.append(rec)
+            info["records"] += 1
+        return records, info
+
+    def load(self) -> dict | None:
+        """The committed record with the highest epoch, or None."""
+        records, _info = self.scan()
+        if not records:
+            return None
+        return max(records, key=lambda r: r["epoch"])
+
+    def publish(self, epoch: int, router: str, address,
+                members: list[tuple[str, object]],
+                journals: dict | None = None) -> dict:
+        """Append one fsync'd epoch record (compacting first when the doc
+        has grown past ``max_records``); returns the record."""
+        rec = {
+            "v": 1, "epoch": int(epoch), "router": str(router),
+            "address": (list(address)
+                        if isinstance(address, tuple) else address),
+            "members": [[name, (list(addr) if isinstance(addr, tuple)
+                                else addr)] for name, addr in members],
+            "t": round(time.time(), 3),
+        }
+        if journals:
+            rec["journals"] = dict(journals)
+        line = json.dumps(rec, sort_keys=True,
+                          separators=(",", ":")).encode() + b"\n"
+        with self._lock:
+            records, _info = self.scan()
+            if len(records) >= self.max_records:
+                self._compact(records)
+            fd = os.open(self.path,
+                         os.O_APPEND | os.O_CREAT | os.O_WRONLY, 0o644)
+            try:
+                os.write(fd, line)
+                os.fsync(fd)
+            finally:
+                os.close(fd)
+        return rec
+
+    def _compact(self, records: list[dict]) -> None:
+        keep = max(records, key=lambda r: r["epoch"])
+        fd, tmp = tempfile.mkstemp(
+            prefix=os.path.basename(self.path) + ".cmp.",
+            dir=os.path.dirname(os.path.abspath(self.path)))
+        try:
+            with os.fdopen(fd, "wb") as fh:
+                fh.write(json.dumps(keep, sort_keys=True,
+                                    separators=(",", ":")).encode() + b"\n")
+            commit_file(tmp, self.path)
+        except BaseException:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise
+
+
 class _Member:
     """Router-side view of one worker daemon (soft state only)."""
 
@@ -148,6 +287,8 @@ class _Member:
         self.running = 0
         self.draining = False
         self.last_seen = 0.0
+        self.down_since: float | None = None   # wall clock of the outage
+        self.adopted_at: float | None = None   # journal adopted this outage
 
     def describe(self) -> dict:
         return {
@@ -195,22 +336,45 @@ class Router:
     ``members``: ``[(name, address), ...]``.  ``client_factory`` is
     dependency injection for the unit tests (anything with the
     ``ServeClient.request`` shape works).
+
+    HA knobs (all optional; without ``ring_view`` the router behaves
+    exactly like the PR-9 single router — no epochs on forwards, so
+    pre-HA fleets keep working):
+
+    - ``router_id`` names this router in the ring-view doc and on every
+      forward;
+    - ``ring_view`` is the shared epoch document (path or
+      :class:`RingView`);
+    - ``standby=True`` starts in the refusing-standby role: ops are
+      rejected ``{"standby": true, "busy": true}`` (clients rotate to
+      the active) while the monitor probes the active's advertised
+      address and takes over after ``takeover_after`` failed probes;
+    - ``advertise`` is the address published in the ring view (what
+      standbys probe and what takeover replaces);
+    - ``adopt_after_s`` + ``journals`` (member name -> journal path)
+      arm the adoption sweep for permanently lost members.
     """
 
     def __init__(self, members, *, vnodes: int = 64,
                  steal_threshold: int = 4, steal_margin: int = 2,
                  health_interval_s: float = 2.0, down_after: int = 3,
                  spec_cache_max: int = 4096, client_factory=None,
-                 start_monitor: bool = True):
+                 start_monitor: bool = True,
+                 router_id: str = "r0", ring_view=None,
+                 standby: bool = False, takeover_after: int = 3,
+                 advertise=None, adopt_after_s: float | None = None,
+                 journals: dict | None = None):
         if client_factory is None:
             def client_factory(address):
                 return ServeClient(address, connect_timeout=10.0,
                                    retries=1, retry_base_s=0.1)
+        self._client_factory = client_factory
         self._members: dict[str, _Member] = OrderedDict()
         for name, address in members:
             self._members[name] = _Member(name, address,
                                           client_factory(address))
         self.ring = HashRing(list(self._members), vnodes=vnodes)
+        self.vnodes = max(1, int(vnodes))
         self.steal_threshold = max(1, int(steal_threshold))
         self.steal_margin = max(1, int(steal_margin))
         self.health_interval_s = float(health_interval_s)
@@ -220,6 +384,26 @@ class Router:
         self._draining = False
         self._started_at = time.time()
         self._lock = threading.Lock()
+        # ---------------------------------------------------------- HA role
+        self.router_id = str(router_id)
+        if isinstance(ring_view, str):
+            ring_view = RingView(ring_view)
+        self.ring_view: RingView | None = ring_view
+        self.standby = bool(standby)
+        self.takeover_after = max(1, int(takeover_after))
+        self.advertise = advertise
+        self.adopt_after_s = None if adopt_after_s is None \
+            else float(adopt_after_s)
+        self.journals = dict(journals or {})
+        self.fenced = False         # a worker rejected our epoch: demoted
+        self._active_fails = 0      # standby's failed probes of the active
+        if self.ring_view is not None:
+            doc = self.ring_view.load()
+            self.epoch = int((doc or {}).get("epoch") or 0)
+            if not self.standby:
+                self._claim_active()
+        else:
+            self.epoch = 0
         # bounded key -> {"spec", "node"} soft state; the ONLY thing the
         # failover resubmission needs, and it is reconstructible: a keyed
         # poll for an unknown key still resolves to the ring owner, whose
@@ -228,9 +412,14 @@ class Router:
         self._placed_max = max(16, int(spec_cache_max))
         self._monitor: threading.Thread | None = None
         if start_monitor:
-            self._monitor = threading.Thread(
-                target=self._monitor_loop, name="route-health", daemon=True)
-            self._monitor.start()
+            self.start_monitor()
+
+    def start_monitor(self) -> None:
+        if self._monitor is not None:
+            return
+        self._monitor = threading.Thread(
+            target=self._monitor_loop, name="route-health", daemon=True)
+        self._monitor.start()
 
     # ------------------------------------------------------------ members
 
@@ -249,6 +438,8 @@ class Router:
         with self._lock:
             was_up = member.up
             member.up = False
+            if member.down_since is None:
+                member.down_since = time.time()
         if was_up:
             self.counters.add("member_down_events", 1)
             print(f"route: member {member.name} DOWN ({why}); "
@@ -264,13 +455,19 @@ class Router:
             member.running = int(health.get("running", 0))
             member.draining = health.get("status") == "draining"
             member.last_seen = time.time()
+            member.down_since = None
+            member.adopted_at = None
         if was_down:
             print(f"route: member {member.name} UP again; its ring range "
                   "rebalances home", file=sys.stderr, flush=True)
 
     def _monitor_loop(self) -> None:
         while not self.closing:
-            self.probe_members()
+            if self.standby:
+                self.probe_active()
+            else:
+                self.probe_members()
+                self.adoption_sweep()
             deadline = time.monotonic() + self.health_interval_s
             while not self.closing and time.monotonic() < deadline:
                 time.sleep(min(0.2, self.health_interval_s))
@@ -288,6 +485,298 @@ class Router:
                     self._mark_down(member, f"{member.fails} failed probes: {e}")
                 continue
             self._mark_up(member, health)
+
+    # --------------------------------------------------------- HA: epochs
+
+    def _member_list(self) -> list[tuple[str, object]]:
+        with self._lock:
+            return [(m.name, m.address) for m in self._members.values()]
+
+    def _claim_active(self) -> None:
+        """Become (or confirm ourselves as) the active router: bump the
+        epoch past anything the ring view has seen and publish."""
+        doc = self.ring_view.load()
+        self.epoch = max(self.epoch, int((doc or {}).get("epoch") or 0)) + 1
+        self.ring_view.publish(self.epoch, self.router_id,
+                               self.advertise, self._member_list(),
+                               journals=self.journals)
+        self.standby = False
+        self.fenced = False
+        self._active_fails = 0
+
+    def _publish_view(self) -> None:
+        """Re-publish after a membership change.  Epoch bumps so every
+        observer (standby, workers via fencing) sees one total order of
+        ring views; a no-ring-view router is a silent no-op."""
+        if self.ring_view is None or self.standby:
+            return
+        self.epoch += 1
+        self.ring_view.publish(self.epoch, self.router_id,
+                               self.advertise, self._member_list(),
+                               journals=self.journals)
+
+    def start(self, advertise=None) -> None:
+        """Late activation for the CLI: the advertised address may only be
+        known once the server socket is bound.  Claims the active role
+        (unless standby), then starts the monitor."""
+        if advertise is not None:
+            self.advertise = advertise
+        if self.ring_view is not None and not self.standby:
+            self._claim_active()
+        self.start_monitor()
+
+    def probe_active(self) -> None:
+        """Standby's half of the monitor: health-probe the active router's
+        advertised address; ``takeover_after`` consecutive failures (or an
+        armed ``route.router_down`` fault) trigger :meth:`take_over`.  An
+        answering active with a *higher* epoch resets our view (we may
+        have been demoted while partitioned)."""
+        if self.ring_view is None:
+            return
+        doc = self.ring_view.load()
+        if doc is None:
+            # nobody has ever published: claim the fleet
+            self._active_fails += 1
+            if self._active_fails >= self.takeover_after:
+                self.take_over("ring view empty")
+            return
+        if doc.get("router") == self.router_id:
+            # the view says we are active (e.g. a restart after takeover)
+            self.epoch = max(self.epoch, int(doc.get("epoch") or 0))
+            self.standby = False
+            return
+        # mirror the active's published membership so a takeover inherits
+        # mid-life member_add/member_remove (the ring view is the one
+        # authority on who is in the fleet)
+        self._sync_members(doc)
+        address = doc.get("address")
+        if isinstance(address, list):
+            address = (address[0], int(address[1]))
+        try:
+            faults.fault_point("route.router_down")
+            health = ServeClient(address, connect_timeout=5.0,
+                                 retries=0).request(
+                {"op": "healthz"}, timeout=5.0)["health"]
+        except (faults.FaultError, ServeClientError, OSError, TypeError) as e:
+            self._active_fails += 1
+            print(f"route[{self.router_id}]: active router "
+                  f"{doc.get('router')} probe failed "
+                  f"({self._active_fails}/{self.takeover_after}): {e}",
+                  file=sys.stderr, flush=True)
+            if self._active_fails >= self.takeover_after:
+                self.take_over(f"{self._active_fails} failed probes: {e}")
+            return
+        self._active_fails = 0
+        self.epoch = max(self.epoch, int(doc.get("epoch") or 0),
+                         int(health.get("epoch") or 0))
+
+    def _sync_members(self, doc: dict) -> None:
+        """Standby's membership mirror: adopt the member list the active
+        last published.  Down/adoption bookkeeping for members we already
+        track is preserved — only the set of names changes."""
+        published = doc.get("members") or []
+        if not published:
+            return
+        want: dict[str, object] = {}
+        for name, address in published:
+            if isinstance(address, list):
+                address = (address[0], int(address[1]))
+            want[str(name)] = address
+        # journal paths ride along so a takeover can still adopt members
+        # that were member_add'ed after this standby was configured
+        for name, path in (doc.get("journals") or {}).items():
+            self.journals.setdefault(str(name), str(path))
+        with self._lock:
+            changed = False
+            for name, address in want.items():
+                if name not in self._members:
+                    self._members[name] = _Member(
+                        name, address, self._client_factory(address))
+                    changed = True
+            for name in [n for n in self._members if n not in want]:
+                del self._members[name]
+                changed = True
+            if changed:
+                self.ring = HashRing(list(self._members), vnodes=self.vnodes)
+
+    def take_over(self, why: str) -> None:
+        """Standby -> active: bump the epoch, publish, and immediately
+        probe the members so routing state is warm.  The old active is
+        fenced out by the workers the moment our first forward lands (its
+        lower epoch is rejected from then on)."""
+        old_epoch = self.epoch
+        self._claim_active()
+        self.counters.add("router_failovers", 1)
+        print(f"route[{self.router_id}]: TAKEOVER epoch {old_epoch} -> "
+              f"{self.epoch} ({why})", file=sys.stderr, flush=True)
+        # the takeover is the incident the flight ring exists for: what
+        # the standby observed leading up to it survives in the dump
+        obs_flight.record("router_takeover", router=self.router_id,
+                          epoch=self.epoch, why=why)
+        obs_flight.dump(reason="router-takeover")
+        self.probe_members()
+
+    def _standby_refusal(self) -> dict | None:
+        """Non-None when this router must not serve: standby role, or
+        demoted by a worker's fencing rejection.  ``busy`` makes the
+        client's retry loop rotate to its next router address."""
+        if self.standby:
+            return {"ok": False, "standby": True, "busy": True,
+                    "router": self.router_id, "epoch": self.epoch,
+                    "error": f"router {self.router_id} is standby"}
+        if self.fenced:
+            return {"ok": False, "standby": True, "busy": True,
+                    "fenced": True, "router": self.router_id,
+                    "epoch": self.epoch,
+                    "error": f"router {self.router_id} was fenced "
+                             f"(a newer epoch than {self.epoch} is live)"}
+        return None
+
+    def _check_active(self) -> None:
+        refusal = self._standby_refusal()
+        if refusal is not None:
+            raise ServeClientError(refusal["error"], refusal)
+
+    # ------------------------------------------------------- HA: adoption
+
+    def adoption_sweep(self) -> None:
+        """Adopt the journal of every member down past ``adopt_after_s``
+        (once per outage).  Failures are logged and retried next sweep —
+        adoption is idempotent end to end (resubmits dedup by key, the
+        tombstone is only written after every resubmit was acked)."""
+        if self.adopt_after_s is None or not self.journals:
+            return
+        now = time.time()
+        for member in self.members():
+            if member.up or member.down_since is None \
+                    or member.adopted_at is not None:
+                continue
+            if now - member.down_since < self.adopt_after_s:
+                continue
+            if member.name not in self.journals:
+                continue
+            try:
+                self.adopt(member.name)
+            except Exception as e:
+                print(f"WARNING: route[{self.router_id}]: adoption of "
+                      f"{member.name} failed ({e}); retrying next sweep",
+                      file=sys.stderr, flush=True)
+
+    def adopt(self, node: str, force: bool = False) -> dict:
+        """Replay a dead member's journal, resubmit every non-terminal job
+        by idempotency key to its ring successor, then tombstone the
+        journal with an ``adopted`` marker.
+
+        Exactly-once: resubmits dedup on the successor's journal, the
+        successor's ``--resume`` completes any partial stage outputs
+        byte-identically, and the tombstone is appended only after every
+        resubmit was acknowledged — a failure anywhere aborts without the
+        tombstone, so the next sweep (or a returning member) retries with
+        nothing lost and nothing doubled."""
+        self._check_active()
+        member = self._members.get(str(node))
+        if member is None:
+            raise ServeClientError(f"unknown member {node!r}",
+                                   {"bad_request": True})
+        path = self.journals.get(member.name)
+        if not path:
+            raise ServeClientError(
+                f"no journal path configured for member {node!r}",
+                {"bad_request": True})
+        if member.up and not force:
+            raise ServeClientError(
+                f"member {node!r} is up; refusing to adopt a live journal "
+                "(pass force to override)", {"bad_request": True})
+        faults.fault_point("route.adopt")
+        jobs, info = journal_mod.replay(path)
+        pending = []
+        for jid in sorted(jobs):
+            rec = jobs[jid]
+            if rec.get("state") in ("done", "failed"):
+                continue
+            if rec.get("adopted"):
+                continue  # an earlier adoption already moved it
+            spec = rec.get("spec")
+            if not isinstance(spec, dict) or not spec.get("input") \
+                    or not spec.get("output"):
+                continue  # rotated-away accepted record: nothing to move
+            pending.append((jid, spec))
+        adopted_keys = []
+        for jid, spec in pending:
+            reply = self.submit(spec)
+            if not reply.get("ok"):
+                raise ServeClientError(
+                    f"adoption resubmit of {member.name} job {jid} "
+                    f"refused: {reply.get('error')}", dict(reply))
+            adopted_keys.append(reply.get("key"))
+            print(f"route[{self.router_id}]: adopted {member.name} "
+                  f"job {jid} -> {reply.get('node')} "
+                  f"(key {reply.get('key')}, "
+                  f"duplicate={reply.get('duplicate')})",
+                  file=sys.stderr, flush=True)
+        # every non-terminal job is acked on a live successor: tombstone
+        # the dead journal so a returning zombie drops them at replay
+        tomb = journal_mod.Journal(path)
+        try:
+            tomb.append_marker("adopted", router=self.router_id,
+                               epoch=self.epoch or None)
+        finally:
+            tomb.close()
+        with self._lock:
+            member.adopted_at = time.time()
+        self.counters.add("journals_adopted", 1)
+        if adopted_keys:
+            self.counters.add("jobs_adopted", len(adopted_keys))
+        obs_flight.record("journal_adopted", node=member.name,
+                          jobs=len(adopted_keys), router=self.router_id)
+        print(f"route[{self.router_id}]: journal of {member.name} adopted "
+              f"({len(adopted_keys)} job(s) resubmitted, "
+              f"{info['records']} record(s) replayed)",
+              file=sys.stderr, flush=True)
+        return {"node": member.name, "jobs_adopted": len(adopted_keys),
+                "keys": adopted_keys}
+
+    # ---------------------------------------------------- HA: membership
+
+    def member_add(self, name: str, address, journal=None) -> dict:
+        """Grow the ring by one member (the chaos conductor's membership
+        events drive this).  ~1/N of the key space remaps to the new
+        member; everything else stays sticky.  ``journal`` registers the
+        member's journal path so a later decommission can still adopt
+        what it acknowledged."""
+        self._check_active()
+        name = str(name)
+        if isinstance(address, list):
+            address = (address[0], int(address[1]))
+        with self._lock:
+            if name in self._members:
+                raise ServeClientError(f"member {name!r} already exists",
+                                       {"bad_request": True})
+            self._members[name] = _Member(name, address,
+                                          self._client_factory(address))
+            self.ring = HashRing(list(self._members), vnodes=self.vnodes)
+        if journal:
+            self.journals[name] = str(journal)
+        self._publish_view()
+        return {"node": name, "fleet_size": len(self._members)}
+
+    def member_remove(self, name: str) -> dict:
+        """Shrink the ring: the member's keys fall to their ring
+        successors.  Its journal path is kept so a later adopt can still
+        drain what it had acknowledged."""
+        self._check_active()
+        name = str(name)
+        with self._lock:
+            if name not in self._members:
+                raise ServeClientError(f"unknown member {name!r}",
+                                       {"bad_request": True})
+            if len(self._members) == 1:
+                raise ServeClientError("refusing to remove the last member",
+                                       {"bad_request": True})
+            del self._members[name]
+            self.ring = HashRing(list(self._members), vnodes=self.vnodes)
+        self._publish_view()
+        return {"node": name, "fleet_size": len(self._members)}
 
     # ------------------------------------------------------------ routing
 
@@ -312,21 +801,47 @@ class Router:
                  timeout: float | None = None) -> dict:
         """One member RPC; a transport-level loss (or an armed
         ``route.member_down`` fault) marks the member down and raises
-        ``ServeClientError(transport=True)`` for the caller's failover."""
+        ``ServeClientError(transport=True)`` for the caller's failover.
+
+        With a ring view configured every forward is stamped with this
+        router's ``(epoch, router_id)``; a ``fenced`` rejection from the
+        worker means a newer epoch is live — we demote ourselves on the
+        spot (no zombie-router double-dispatch) and re-raise."""
         try:
             faults.fault_point("route.member_down")
         except faults.FaultError as e:
             self._mark_down(member, f"injected: {e}")
             raise ServeClientError(str(e), {"transport": True}) from e
+        if self.ring_view is not None:
+            doc = dict(doc)
+            doc["epoch"] = self.epoch
+            doc["router"] = self.router_id
         try:
             return member.client.request(doc, timeout=timeout)
         except ServeClientError as e:
+            if e.reply.get("fenced"):
+                self._demote(member.name, e.reply)
             if e.reply.get("transport"):
                 self._mark_down(member, str(e))
             raise
         except OSError as e:
             self._mark_down(member, str(e))
             raise ServeClientError(str(e), {"transport": True}) from e
+
+    def _demote(self, worker: str, reply: dict) -> None:
+        """A worker fenced us: a takeover happened while we thought we
+        were active.  Stop serving (clients rotate to the new active) —
+        the flight dump records what this zombie saw before it learned."""
+        if self.fenced:
+            return
+        self.fenced = True
+        newer = reply.get("epoch")
+        print(f"route[{self.router_id}]: FENCED by worker {worker} "
+              f"(our epoch {self.epoch} < live {newer}); demoting to "
+              "standby-refusal", file=sys.stderr, flush=True)
+        obs_flight.record("router_fenced", router=self.router_id,
+                          epoch=self.epoch, live_epoch=newer, worker=worker)
+        obs_flight.dump(reason="router-fenced")
 
     def _pick_target(self, key: str, qos: str) -> tuple[_Member, bool]:
         """Home member for the key, or a steal target for deep-queued
@@ -358,6 +873,9 @@ class Router:
         """Route one submit; returns the member's wire reply annotated
         with ``node``/``node_address`` (refusals pass through so the
         client's shed/quota handling keeps working)."""
+        refusal = self._standby_refusal()
+        if refusal is not None:
+            return refusal
         if self._draining:
             return {"ok": False, "refused": True,
                     "error": "router is draining; not accepting jobs"}
@@ -411,6 +929,7 @@ class Router:
         placement while that node is up, else the current ring owner —
         resubmitting the cached spec there first, so the poll finds the
         job (replay-aware failover).  Raises when no member is up."""
+        self._check_active()
         info = self._placed_info(key)
         if info is not None:
             member = self._members.get(info["node"])
@@ -419,7 +938,10 @@ class Router:
         member = self._owner_for(key)
         if member is None:
             raise ServeClientError("no fleet member is up", {"transport": True})
-        if info is not None and info["node"] != member.name:
+        if info is not None and info["node"] != member.name \
+                and info.get("spec"):
+            # spec-less entries (locate-sweep re-primes) can't resubmit;
+            # the poll falls through to the owner and sweeps again
             self._failover_resubmit(key, info, member)
         return member
 
@@ -446,6 +968,73 @@ class Router:
         return {"node": member.name,
                 "address": member.describe()["address"]}
 
+    def _locate_sweep(self, key: str, skip: str | None = None):
+        """A keyed poll hit ``unknown job`` at the ring owner.  Two HA
+        situations produce that without any job being lost: the placement
+        cache died with a failed-over active (this router never saw the
+        submit), or a membership change moved the key's ring home away
+        from the node that actually ran it.  Ask every other up member
+        before giving up; a hit re-primes the placement cache so
+        subsequent polls go straight there.  Returns the member or None."""
+        for member in self.members():
+            if not member.up or member.name == skip:
+                continue
+            try:
+                reply = self._forward(member, {"op": "status", "key": key})
+            except ServeClientError:
+                continue
+            if reply.get("ok"):
+                # no spec on hand (the submit predates this router), so
+                # the cache entry only pins placement; resolve() skips
+                # the spec-needing resubmit path for spec-less entries
+                self._remember(key, {}, member.name)
+                self.counters.add("route_locate_sweeps", 1)
+                print(f"route: located key {key} on {member.name} after "
+                      "an unknown-job miss; placement cache re-primed",
+                      file=sys.stderr, flush=True)
+                return member
+        return None
+
+    def _journal_resubmit(self, key: str) -> bool:
+        """Last resort after a locate-sweep miss: the job's node is down
+        and this router never saw the submit (post-takeover), so no live
+        member knows the key — but the configured journal of a down
+        member still holds the acked spec.  Recover it read-only and
+        resubmit to the live ring successor (journal dedup + manifest
+        ``--resume`` keep the eventual double replay exactly-once in its
+        effects, same as every failover resubmit)."""
+        spec = None
+        for name, path in (self.journals or {}).items():
+            member = self._members.get(name)
+            if member is not None and member.up:
+                continue  # live members already answered the sweep
+            try:
+                jobs, _info = journal_mod.replay(path)
+            except (OSError, ValueError):
+                continue
+            for rec in jobs.values():
+                if rec.get("key") == key and rec.get("spec") \
+                        and not rec.get("adopted"):
+                    spec = dict(rec["spec"])
+                    break
+            if spec is not None:
+                break
+        if spec is None:
+            return False
+        owner = self._owner_for(key)
+        if owner is None:
+            return False
+        try:
+            self._failover_resubmit(key, {"spec": spec}, owner)
+        except ServeClientError as e:
+            print(f"route: journal-recovered resubmit of key {key} "
+                  f"failed ({e}); next poll retries", file=sys.stderr,
+                  flush=True)
+            return False
+        print(f"route: recovered key {key} from a down member's journal; "
+              f"resubmitted to {owner.name}", file=sys.stderr, flush=True)
+        return True
+
     def _keyed(self, req: dict) -> str:
         key = req.get("key")
         if not key:
@@ -457,11 +1046,17 @@ class Router:
     def status(self, req: dict) -> dict:
         key = self._keyed(req)
         tried: set[str] = set()
+        swept = False
         while True:
             member = self.resolve(key)
             try:
                 return self._forward(member, {"op": "status", "key": key})
             except ServeClientError as e:
+                if e.reply.get("unknown") and not swept:
+                    swept = True  # one fleet sweep per call
+                    if self._locate_sweep(key, skip=member.name) is not None \
+                            or self._journal_resubmit(key):
+                        continue
                 if not e.reply.get("transport") or member.name in tried:
                     raise
                 tried.add(member.name)  # one failover hop per member
@@ -474,6 +1069,7 @@ class Router:
         timeout = req.get("timeout")
         deadline = (None if timeout is None
                     else time.monotonic() + float(timeout))
+        swept = False
         while True:
             if self.closing:
                 return {"ok": False, "error": "router shutting down",
@@ -491,6 +1087,11 @@ class Router:
                      "timeout": min(slice_s, remaining)},
                     timeout=min(slice_s, remaining) + 10.0)
             except ServeClientError as e:
+                if e.reply.get("unknown") and not swept:
+                    swept = True  # one fleet sweep per call
+                    if self._locate_sweep(key, skip=member.name) is not None \
+                            or self._journal_resubmit(key):
+                        continue
                 if e.reply.get("timeout") or e.reply.get("shutdown") \
                         or e.reply.get("transport"):
                     continue  # next slice (possibly on a new owner)
@@ -539,10 +1140,19 @@ class Router:
     def healthz(self) -> dict:
         members = [m.describe() for m in self.members()]
         up = [m for m in members if m["up"]]
+        if self.standby or self.fenced:
+            status = "standby"
+        elif self._draining:
+            status = "draining"
+        else:
+            status = "serving" if up else "degraded"
         return {
-            "status": "draining" if self._draining else
-                      ("serving" if up else "degraded"),
+            "status": status,
             "role": "router",
+            "router_id": self.router_id,
+            "epoch": self.epoch,
+            "ha_state": ("fenced" if self.fenced else
+                         ("standby" if self.standby else "active")),
             "queued": sum(m["queued"] for m in up),
             "running": sum(m["running"] for m in up),
             "uptime_s": round(time.time() - self._started_at, 3),
@@ -573,13 +1183,17 @@ class Router:
                 for name, entries in (labeled.get(kind) or {}).items():
                     merged.setdefault(kind, {}).setdefault(
                         name, []).extend(entries)
+        health = self.healthz()
         return {
             "stage": "route",
             "phases_s": {"uptime": time.time() - self._started_at},
             "draining": self._draining,
+            "router_id": self.router_id,
+            "epoch": self.epoch,
+            "ha_state": health["ha_state"],
             "cumulative": self.counters.snapshot(),
             "labeled": merged,
-            "fleet": self.healthz()["fleet"],
+            "fleet": health["fleet"],
             "nodes": nodes,
         }
 
@@ -630,6 +1244,18 @@ class RouterServer(ServeServer):
                 out = self.router.drain(timeout=req.get("timeout"),
                                         node=req.get("node"))
                 return {"ok": True, "drained": True, **out}
+            if op == "adopt":
+                out = self.router.adopt(str(req.get("node") or ""),
+                                        force=bool(req.get("force")))
+                return {"ok": True, "adopted": True, **out}
+            if op == "member_add":
+                out = self.router.member_add(req.get("name"),
+                                             req.get("address"),
+                                             journal=req.get("journal"))
+                return {"ok": True, **out}
+            if op == "member_remove":
+                out = self.router.member_remove(req.get("name"))
+                return {"ok": True, **out}
             return {"ok": False, "error": f"unknown op {op!r}"}
         except ServeClientError as e:
             # a member refusal / ``ok: false`` travels back verbatim

@@ -107,6 +107,17 @@ class QuotaRefused(AdmissionRefused):
     """Per-tenant queue-slot or in-flight quota exceeded."""
 
 
+class RouterFenced(RuntimeError):
+    """A forward carried a router epoch below the highest this worker has
+    accepted: the sender is a zombie router from before a takeover.  The
+    wire layer turns this into ``{"fenced": true, "epoch": <live>}`` so
+    the stale router demotes itself instead of double-dispatching."""
+
+    def __init__(self, live_epoch: int, message: str):
+        super().__init__(message)
+        self.epoch = int(live_epoch)
+
+
 _STATES = ("queued", "running", "done", "failed")
 
 
@@ -491,6 +502,10 @@ class Scheduler:
         self._stop = False
         self._started_at = time.time()
         self._ewma_job_s: float | None = None
+        # highest router epoch this worker has accepted; restored from the
+        # journal's fence marker in _recover so a restart cannot be talked
+        # into honoring a demoted router (0 = never fenced / no fleet HA)
+        self._fence_epoch = 0
         self._thread = threading.Thread(
             target=self._loop, name="serve-dispatcher", daemon=True)
         if self._journal is not None:
@@ -685,6 +700,53 @@ class Scheduler:
                 self._cond.wait(timeout=remaining)
         return job
 
+    # --------------------------------------------------------------- fencing
+
+    def fence(self, epoch, router=None) -> None:
+        """Epoch admission for router-forwarded requests.
+
+        A forward whose epoch is *below* the highest accepted one is a
+        zombie router's — reject it (``fencing_rejections``) by raising
+        :class:`RouterFenced`.  A *higher* epoch means a takeover
+        happened: adopt it and persist a journal ``fence`` marker so the
+        floor survives a worker restart.  The ``route.fence`` fault site
+        fires here (an armed fault is indistinguishable from a stale
+        forward — the router-side demotion path runs for real)."""
+        try:
+            faults.fault_point("route.fence")
+        except faults.FaultError as e:
+            self.counters.add("fencing_rejections")
+            raise RouterFenced(self._fence_epoch, f"injected: {e}")
+        try:
+            epoch = int(epoch)
+        except (TypeError, ValueError):
+            return  # epoch-less request: pre-HA router or direct client
+        with self._cond:
+            if epoch < self._fence_epoch:
+                self.counters.add("fencing_rejections")
+                who = f" from router {router!r}" if router else ""
+                raise RouterFenced(
+                    self._fence_epoch,
+                    f"stale forward{who}: epoch {epoch} < accepted "
+                    f"{self._fence_epoch}")
+            if epoch > self._fence_epoch:
+                self._fence_epoch = epoch
+                if self._journal is not None:
+                    try:
+                        n = self._journal.append_marker(
+                            "fence", epoch=epoch,
+                            router=None if router is None else str(router))
+                        self.counters.add("journal_bytes", n)
+                    except Exception as e:
+                        print(f"WARNING: fence marker write failed ({e}); "
+                              "the epoch floor will not survive a restart",
+                              file=sys.stderr, flush=True)
+
+    @property
+    def fence_epoch(self) -> int:
+        with self._cond:
+            return self._fence_epoch
+
     # --------------------------------------------------------------- journal
 
     def _journal_update_locked(self, job: Job, state: str, **fields) -> None:
@@ -731,8 +793,11 @@ class Scheduler:
         path, so completed stages are skipped and outputs stay
         byte-identical — exactly-once at the output level."""
         jobs, info = journal_mod.replay(self._journal.path)
-        requeued = finished = dropped = 0
+        requeued = finished = dropped = adopted = 0
         with self._cond:
+            if info.get("fence_epoch"):
+                self._fence_epoch = max(self._fence_epoch,
+                                        int(info["fence_epoch"]))
             for jid in sorted(jobs):
                 rec = jobs[jid]
                 spec = rec.get("spec")
@@ -742,6 +807,19 @@ class Scheduler:
                     print(f"WARNING: journal replay: job {jid} has no usable "
                           "spec (rotated-away accepted record?); dropping",
                           file=sys.stderr, flush=True)
+                    continue
+                if rec.get("adopted") \
+                        and rec.get("state") not in ("done", "failed"):
+                    # this journal was tombstoned while we were down: the
+                    # job now lives on its ring successor.  Re-running it
+                    # here is the zombie double-run the tombstone exists
+                    # to prevent — drop it and count the fencing
+                    adopted += 1
+                    self.counters.add("fencing_rejections")
+                    print(f"serve: journal replay: job {jid} was adopted by "
+                          f"router {info.get('adopted_by')!r} while this "
+                          "node was down; dropping (its ring successor "
+                          "owns it now)", file=sys.stderr, flush=True)
                     continue
                 job = Job(spec, job_id=jid,
                           key=rec.get("key") or journal_mod.idempotency_key(spec),
@@ -767,13 +845,17 @@ class Scheduler:
                     requeued += 1
             self.counters.high_water("queue_depth_hwm", self._queued_locked())
             self._cond.notify_all()
-        if requeued or finished or dropped or info["skipped"]:
+        if requeued or finished or dropped or adopted or info["skipped"]:
             print(f"serve: journal replay: {requeued} job(s) re-enqueued, "
                   f"{finished} already terminal, "
+                  f"{adopted} adopted elsewhere, "
                   f"{dropped + info['skipped']} record(s) skipped"
                   + (" (previous shutdown was a clean drain)"
                      if info["clean_drain"] else ""),
                   file=sys.stderr, flush=True)
+        if adopted:
+            obs_flight.record("zombie_fenced", adopted_jobs=adopted,
+                              adopted_by=info.get("adopted_by"))
         if (requeued or dropped or info["skipped"] or info["torn_tail"]) \
                 and not info["clean_drain"]:
             # the previous daemon died uncleanly with work in flight: this
@@ -915,6 +997,7 @@ class Scheduler:
                 "running": len(self._running),
                 "uptime_s": round(time.time() - self._started_at, 3),
                 "pid": os.getpid(),
+                "fence_epoch": self._fence_epoch,
                 "slo": self.slo.health(),
             }
 

@@ -28,7 +28,11 @@ A submit whose spec hashes to an already-tracked job returns that job
 with ``"duplicate": true``.  A job evicted from memory (result TTL)
 replies ``state: "expired"`` with the on-disk output path.  A submit shed
 for its deadline replies ``refused: true, shed: true``; one refused by a
-per-tenant quota replies ``refused: true, quota: true``.
+per-tenant quota replies ``refused: true, quota: true``.  A request
+carrying a fleet-router ``epoch`` below the highest this worker has
+accepted replies ``fenced: true, epoch: <live>`` (see
+:meth:`Scheduler.fence` — zombie-router protection after a standby
+takeover; epoch-less requests are never fenced).
 
 Errors reply ``{"ok": false, "error": "..."}`` and keep the connection
 usable; a malformed line closes the connection.  The ``serve.accept``
@@ -57,7 +61,7 @@ import time
 
 from consensuscruncher_tpu.obs.metrics import render_prometheus
 from consensuscruncher_tpu.serve.scheduler import (
-    AdmissionRefused, DeadlineShed, QuotaRefused, Scheduler,
+    AdmissionRefused, DeadlineShed, QuotaRefused, RouterFenced, Scheduler,
 )
 from consensuscruncher_tpu.utils import faults
 
@@ -242,7 +246,8 @@ class ServeServer:
         — the client sees ``shutdown: true`` and retries after restart."""
         found = self._lookup(req)
         if found is None:
-            return {"ok": False, "error": "unknown job_id"}
+            return {"ok": False, "error": "unknown job_id",
+                    "unknown": True}
         kind, obj = found
         if kind == "expired":
             return self._expired_reply(obj)
@@ -271,6 +276,14 @@ class ServeServer:
             return {"ok": False, "error": "request must be a JSON object"}
         op = req.get("op")
         try:
+            if "epoch" in req and op in ("submit", "status", "result",
+                                         "drain"):
+                # fleet-HA fencing: a router-forwarded request carries the
+                # sender's ring-view epoch; a stale (pre-takeover) epoch
+                # is rejected so a zombie router cannot double-dispatch.
+                # healthz/metrics stay unfenced — observability must keep
+                # answering even to a demoted router.
+                self.scheduler.fence(req.get("epoch"), req.get("router"))
             if op == "submit":
                 job, created = self.scheduler.submit_info(req.get("spec") or {})
                 return {"ok": True, "job_id": job.id, "state": job.state,
@@ -278,7 +291,8 @@ class ServeServer:
             if op == "status":
                 found = self._lookup(req)
                 if found is None:
-                    return {"ok": False, "error": "unknown job_id"}
+                    return {"ok": False, "error": "unknown job_id",
+                            "unknown": True}
                 kind, obj = found
                 if kind == "expired":
                     return self._expired_reply(obj)
@@ -298,6 +312,9 @@ class ServeServer:
                 self.scheduler.drain(timeout=req.get("timeout"))
                 return {"ok": True, "drained": True}
             return {"ok": False, "error": f"unknown op {op!r}"}
+        except RouterFenced as e:
+            return {"ok": False, "error": str(e), "fenced": True,
+                    "epoch": e.epoch}
         except DeadlineShed as e:
             return {"ok": False, "error": str(e), "refused": True,
                     "shed": True}

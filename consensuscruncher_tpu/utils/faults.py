@@ -51,6 +51,12 @@ Sites wired in this codebase (grep for ``fault_point``/``faults.hook``):
                        deadlock (CLI falls back to the staged pipeline)
   stream.operator_fail mid-stream producer fault -> channel poisoned ->
                        staged-pipeline fallback, byte-identical outputs
+  route.member_down    router forward hits a dead member -> ring failover
+  route.steal          steal decision fails -> job stays on its home node
+  route.resubmit       failover resubmission fails -> retried, idempotent
+  route.router_down    standby's probe of the active router -> takeover
+  route.adopt          journal adoption fails -> no tombstone, sweep retries
+  route.fence          worker epoch admission -> stale router demoted
 
 Everything here is stdlib-only and import-cheap: io/bgzf.py and the
 tools/ scripts (whose parents must never import jax) both import it.

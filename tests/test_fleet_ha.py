@@ -1,0 +1,678 @@
+"""Fleet HA: ring-view epochs, router failover, fencing, journal adoption.
+
+Unit coverage drives the RingView document (fsync'd appends, torn-write
+recovery at every byte boundary, compaction), the worker-side epoch
+fence (stale rejection + journal fence marker + restart persistence),
+the router-side demotion latch, and journal adoption end to end
+(exactly-once resubmission, tombstone, zombie replay dropping adopted
+jobs).  The chaos tests arm the three new ``route.*`` fault sites
+(CCT_FAULTS) so cctlint CCT301-303 stays green.  The acceptance test
+runs two real worker daemons behind a REAL active/standby router pair
+(both CLI subprocesses sharing a ring-view file), kill -9s the active
+router mid-job, and proves the standby's takeover finishes every
+acknowledged job byte-identical to the frozen goldens.
+"""
+
+import glob
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "test"))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+from make_test_data import canonical_bam_digest, text_digest  # noqa: E402
+
+from consensuscruncher_tpu.obs import flight as obs_flight
+from consensuscruncher_tpu.serve.client import ServeClient, ServeClientError
+from consensuscruncher_tpu.serve.journal import Journal, idempotency_key
+from consensuscruncher_tpu.serve.journal import replay as journal_replay
+from consensuscruncher_tpu.serve.router import RingView, Router
+from consensuscruncher_tpu.serve.scheduler import RouterFenced, Scheduler
+from consensuscruncher_tpu.serve.server import ServeServer
+from consensuscruncher_tpu.utils import faults
+
+DATA = os.path.join(REPO, "test", "data")
+SAMPLE = os.path.join(DATA, "sample.bam")
+GOLDEN = json.load(open(os.path.join(REPO, "test", "golden.json")))
+
+
+def _spec(output, name="golden", **over):
+    spec = {
+        "input": SAMPLE, "output": str(output), "name": name,
+        "cutoff": 0.7, "qualscore": 0, "scorrect": True,
+        "max_mismatch": 0, "bdelim": "|", "compress_level": 6,
+    }
+    spec.update(over)
+    return spec
+
+
+def _assert_matches_golden(base, label):
+    for rel in GOLDEN["consensus"]:
+        path = os.path.join(str(base), rel)
+        assert os.path.exists(path), f"{label}: missing output {rel}"
+        got = (canonical_bam_digest(path) if rel.endswith(".bam")
+               else text_digest(path))
+        assert got == GOLDEN["consensus"][rel], \
+            f"{label} diverges from golden at {rel}"
+
+
+# ------------------------------------------------------------- ring view
+
+def test_ring_view_publish_load_roundtrip(tmp_path):
+    rv = RingView(str(tmp_path / "ring.view"))
+    assert rv.load() is None
+    rv.publish(1, "r0", "/tmp/r0.sock", [("w0", "/tmp/w0.sock")])
+    rv.publish(2, "r1", ("10.0.0.2", 7780),
+               [("w0", "/tmp/w0.sock"), ("w1", ("10.0.0.3", 7733))],
+               journals={"w0": "/tmp/w0.journal"})
+    doc = rv.load()
+    assert doc["epoch"] == 2 and doc["router"] == "r1"
+    assert doc["address"] == ["10.0.0.2", 7780]
+    assert doc["members"] == [["w0", "/tmp/w0.sock"],
+                              ["w1", ["10.0.0.3", 7733]]]
+    assert doc["journals"] == {"w0": "/tmp/w0.journal"}
+    _, info = rv.scan()
+    assert info == {"records": 2, "skipped": 0, "torn_tail": False}
+
+
+def test_ring_view_compacts_to_highest_epoch(tmp_path):
+    rv = RingView(str(tmp_path / "ring.view"), max_records=4)
+    for e in range(1, 9):
+        rv.publish(e, "r0", None, [("w0", "w0")])
+    records, _ = rv.scan()
+    # compaction keeps the doc bounded while load() stays correct
+    assert len(records) <= 5
+    assert rv.load()["epoch"] == 8
+
+
+def test_ring_view_torn_write_recovers_at_every_byte(tmp_path):
+    """The ring-view doc carries the fleet's epoch authority, so it gets
+    the same torn-write proof as the job journal: truncate the file at
+    EVERY byte boundary and assert recovery to the last fully-committed
+    epoch — never a crash, never a half-parsed record winning."""
+    path = str(tmp_path / "ring.view")
+    rv = RingView(path)
+    for e in (1, 2, 3):
+        rv.publish(e, f"r{e % 2}", f"/tmp/r{e % 2}.sock",
+                   [("w0", "/tmp/w0.sock"), ("w1", "/tmp/w1.sock")])
+    raw = open(path, "rb").read()
+    # byte offsets at which a record ends (its newline is on disk)
+    ends = [i + 1 for i, b in enumerate(raw) if raw[i:i + 1] == b"\n"]
+    for cut in range(len(raw) + 1):
+        torn = str(tmp_path / "torn.view")
+        with open(torn, "wb") as fh:
+            fh.write(raw[:cut])
+        committed = sum(1 for e in ends if e <= cut)
+        # a cut exactly after a record's closing brace (newline lost but
+        # the JSON line complete) is indistinguishable from a committed
+        # record and MUST be recovered too
+        tail = raw[max([0] + [e for e in ends if e <= cut]):cut]
+        try:
+            tail_rec = json.loads(tail) if tail.strip() else None
+            tail_ok = isinstance(tail_rec, dict) and "epoch" in tail_rec
+        except ValueError:
+            tail_ok = False
+        expect = committed + (1 if tail_ok else 0)
+        doc = RingView(torn).load()
+        if expect == 0:
+            assert doc is None, f"cut={cut}: phantom record"
+        else:
+            assert doc is not None, f"cut={cut}: lost committed epochs"
+            # epochs were published in order 1..3, so the recovered max
+            # epoch equals the number of recoverable records
+            assert doc["epoch"] == expect, \
+                f"cut={cut}: recovered epoch {doc['epoch']} != {expect}"
+        _, info = RingView(torn).scan()
+        assert info["records"] == expect, f"cut={cut}"
+        # an half-written (non-empty, unparseable) tail is flagged,
+        # skipped, and never corrupts the earlier records
+        torn_tail = bool(tail.strip()) and not tail_ok
+        assert info["torn_tail"] == torn_tail, f"cut={cut}"
+        assert info["skipped"] == (1 if torn_tail else 0), f"cut={cut}"
+
+
+# ------------------------------------------------------- worker fencing
+
+def test_scheduler_fence_rejects_stale_and_persists_floor(tmp_path):
+    jp = str(tmp_path / "wal")
+    sched = Scheduler(start=False, paused=True, journal=Journal(jp))
+    try:
+        sched.fence(None)            # epoch-less: pre-HA request, no-op
+        sched.fence(5, router="r1")  # takeover observed: floor rises
+        assert sched.fence_epoch == 5
+        with pytest.raises(RouterFenced) as exc:
+            sched.fence(4, router="r0")  # the zombie's forward
+        assert exc.value.epoch == 5
+        assert sched.counters.snapshot()["fencing_rejections"] == 1
+        sched.fence(5)  # equal epoch: same active retrying is fine
+    finally:
+        sched.shutdown()
+        sched._journal.close()
+    # the floor survives a worker restart via the journal fence marker
+    sched2 = Scheduler(start=False, paused=True, journal=Journal(jp))
+    try:
+        assert sched2.fence_epoch == 5
+        with pytest.raises(RouterFenced):
+            sched2.fence(3)
+    finally:
+        sched2.shutdown()
+        sched2._journal.close()
+
+
+def test_server_wire_fence_reply(tmp_path):
+    """The wire layer turns RouterFenced into ``{"fenced": true, "epoch":
+    <live>}`` — the reply the stale router demotes itself on.  healthz
+    stays unfenced (a standby must be probeable by anyone)."""
+    sched = Scheduler(start=False, paused=True)
+    server = ServeServer(sched, port=0)
+    try:
+        ok = server._dispatch({"op": "submit", "epoch": 7,
+                               "spec": _spec("/tmp/fence-wire")})
+        assert ok["ok"] is True
+        stale = server._dispatch({"op": "status", "epoch": 3,
+                                  "router": "r0", "key": ok["key"]})
+        assert stale["ok"] is False and stale["fenced"] is True
+        assert stale["epoch"] == 7
+        assert server._dispatch({"op": "healthz"})["ok"] is True
+        assert sched.counters.snapshot()["fencing_rejections"] == 1
+    finally:
+        server.close(timeout=2)
+        sched.shutdown()
+
+
+def test_chaos_route_fence_fault_demotes_router(tmp_path, monkeypatch):
+    """Arm ``route.fence=fail@1``: the worker's epoch admission rejects a
+    live forward exactly as it would a zombie's — the sending router sees
+    ``fenced: true``, latches its demotion, and every subsequent op gets
+    the busy-flagged standby refusal that makes clients rotate."""
+    fleet = _FencingStubFleet(["n0", "n1"])
+    router = Router([(n, n) for n in fleet.nodes], start_monitor=False,
+                    ring_view=str(tmp_path / "rv"), router_id="rA",
+                    client_factory=fleet.client)
+    router.probe_members()
+    assert router.epoch >= 1 and not router.standby
+    fleet.fence_all(live_epoch=99)
+    reply = router.submit(_spec(tmp_path / "fenced"))
+    assert reply["ok"] is False  # the fencing forward itself errors out
+    assert router.fenced is True  # ... and the router latched the demote
+    # the latch holds without another worker round-trip: the standby-style
+    # busy refusal makes multi-router clients rotate to the new active
+    again = router.submit(_spec(tmp_path / "fenced2"))
+    assert again["ok"] is False and again["busy"] is True
+    assert again["fenced"] is True and again["standby"] is True
+    # ... and resolve-side ops refuse too (no zombie reads-after-demote)
+    with pytest.raises(ServeClientError):
+        router.resolve("whatever")
+    # the REAL worker-side site: armed fault fires inside Scheduler.fence
+    sched = Scheduler(start=False, paused=True)
+    try:
+        monkeypatch.setenv("CCT_FAULTS", "route.fence=fail@1")
+        with pytest.raises(RouterFenced):
+            sched.fence(12, router="rA")
+        monkeypatch.delenv("CCT_FAULTS")
+        assert sched.counters.snapshot()["fencing_rejections"] == 1
+        sched.fence(12)  # disarmed: the same epoch is admitted
+    finally:
+        sched.shutdown()
+
+
+class _FencingStubFleet:
+    """Stub workers that can start fencing every forward (simulating the
+    post-takeover worker state a zombie router runs into)."""
+
+    def __init__(self, names):
+        self.nodes = {n: {"fence_epoch": None} for n in names}
+
+    def fence_all(self, live_epoch):
+        for node in self.nodes.values():
+            node["fence_epoch"] = int(live_epoch)
+
+    def client(self, name):
+        fleet = self
+
+        class _Client:
+            address = name
+
+            def request(self, doc, timeout=None):
+                node = fleet.nodes[name]
+                if node["fence_epoch"] is not None and "epoch" in doc:
+                    raise ServeClientError(
+                        "stale forward", {"ok": False, "fenced": True,
+                                          "epoch": node["fence_epoch"]})
+                op = doc["op"]
+                if op == "healthz":
+                    return {"ok": True, "health": {"queued": 0,
+                                                   "running": 0,
+                                                   "status": "serving"}}
+                if op == "submit":
+                    key = idempotency_key(doc["spec"])
+                    return {"ok": True, "job_id": 1, "key": key,
+                            "duplicate": False}
+                raise AssertionError(op)
+
+        return _Client()
+
+
+# ------------------------------------------------- standby takeover unit
+
+def test_chaos_router_down_fault_triggers_takeover(tmp_path, monkeypatch):
+    """Arm ``route.router_down=fail@2`` on a standby whose active is
+    (per the ring view) alive: the injected probe failures hit the
+    takeover threshold, the standby bumps the epoch past the active's,
+    counts ``router_failovers``, and dumps the flight ring."""
+    rv_path = str(tmp_path / "ring.view")
+    RingView(rv_path).publish(5, "r0", str(tmp_path / "nosuch.sock"),
+                              [("n0", "n0")])
+    fleet = _FencingStubFleet(["n0"])
+    router = Router([("n0", "n0")], start_monitor=False, standby=True,
+                    ring_view=rv_path, router_id="r1", takeover_after=2,
+                    client_factory=fleet.client)
+    obs_flight.set_dump_dir(str(tmp_path))
+    try:
+        assert router.standby and router.epoch == 5
+        refusal = router.submit(_spec(tmp_path / "nope"))
+        assert refusal["ok"] is False and refusal["standby"] is True
+        monkeypatch.setenv("CCT_FAULTS", "route.router_down=fail@2")
+        router.probe_active()
+        assert router.standby  # one miss is a blip
+        router.probe_active()
+        monkeypatch.delenv("CCT_FAULTS")
+        assert not router.standby
+        assert router.epoch == 6  # strictly above everything published
+        assert RingView(rv_path).load()["router"] == "r1"
+        assert router.counters.snapshot()["router_failovers"] == 1
+        dumps = [json.load(open(p))
+                 for p in glob.glob(str(tmp_path / "flight-*.json"))]
+        assert any(d["reason"] == "router-takeover" for d in dumps)
+        # promoted: submits are served now
+        assert router.submit(_spec(tmp_path / "served"))["ok"] is True
+    finally:
+        obs_flight.set_dump_dir(None)
+        router.close()
+
+
+# ------------------------------------------------------ journal adoption
+
+class _AdoptStubFleet:
+    """Stub workers with real dedup-by-key submit bookkeeping."""
+
+    def __init__(self, names):
+        self.nodes = {n: {"dead": False, "jobs": {}} for n in names}
+
+    def client(self, name):
+        fleet = self
+
+        class _Client:
+            address = name
+
+            def request(self, doc, timeout=None):
+                node = fleet.nodes[name]
+                if node["dead"]:
+                    raise OSError("connection refused")
+                op = doc["op"]
+                if op == "healthz":
+                    return {"ok": True, "health": {"queued": 0,
+                                                   "running": 0,
+                                                   "status": "serving"}}
+                if op == "submit":
+                    key = idempotency_key(doc["spec"])
+                    dup = key in node["jobs"]
+                    node["jobs"][key] = dict(doc["spec"])
+                    return {"ok": True, "job_id": len(node["jobs"]),
+                            "key": key, "duplicate": dup}
+                raise AssertionError(op)
+
+        return _Client()
+
+
+def _adoption_rig(tmp_path, **router_kw):
+    """A 3-member stub fleet where n1 is dead with one acknowledged,
+    journaled, non-terminal job; returns (fleet, router, journal, key)."""
+    fleet = _AdoptStubFleet(["n0", "n1", "n2"])
+    jp = str(tmp_path / "n1.journal")
+    spec = _spec(tmp_path / "orphan")
+    key = idempotency_key(spec)
+    j = Journal(jp)
+    j.append_job(41, "accepted", key=key, spec=spec)
+    j.append_job(41, "running")
+    j.close()
+    router = Router([(n, n) for n in fleet.nodes], start_monitor=False,
+                    down_after=1, client_factory=fleet.client,
+                    journals={"n1": jp}, **router_kw)
+    fleet.nodes["n1"]["dead"] = True
+    router.probe_members()
+    assert not router._member("n1").up
+    return fleet, router, jp, key
+
+
+def test_adopt_exactly_once_and_zombie_replay_drops_jobs(tmp_path):
+    """The permanent-loss story end to end: adopt resubmits the dead
+    member's non-terminal job to a live successor (dedup by key),
+    tombstones the journal, is idempotent on a second call — and a
+    returning ZOMBIE's real Scheduler replay drops the adopted job,
+    counting ``fencing_rejections`` instead of double-running it."""
+    fleet, router, jp, key = _adoption_rig(tmp_path)
+    out = router.adopt("n1")
+    assert out["jobs_adopted"] == 1 and out["keys"] == [key]
+    # the job landed on a live member, keyed identically
+    landed = [n for n, node in fleet.nodes.items() if key in node["jobs"]]
+    assert landed and "n1" not in landed
+    snap = router.counters.snapshot()
+    assert snap["journals_adopted"] == 1 and snap["jobs_adopted"] == 1
+    # tombstone: replay flags every job as adopted
+    jobs, info = journal_replay(jp)
+    assert info["adopted_by"] == router.router_id
+    assert jobs[41]["adopted"] is True
+    # idempotent: a second adopt (force: the member is still down) moves
+    # nothing and the successor sees no duplicate execution
+    out2 = router.adopt("n1", force=True)
+    assert out2["jobs_adopted"] == 0
+    assert router.counters.snapshot()["jobs_adopted"] == 1
+
+    # the zombie returns: a REAL scheduler replaying the tombstoned
+    # journal must not requeue the adopted job
+    sched = Scheduler(start=False, paused=True, journal=Journal(jp))
+    try:
+        snap = sched.counters.snapshot()
+        assert snap["fencing_rejections"] == 1
+        assert snap["jobs_replayed"] == 0
+        health = sched.healthz()
+        assert health["queued"] == 0 and health["running"] == 0
+    finally:
+        sched.shutdown()
+        sched._journal.close()
+
+
+def test_chaos_adopt_fault_aborts_without_tombstone(tmp_path, monkeypatch):
+    """Arm ``route.adopt=fail@1``: adoption dies before moving anything —
+    no tombstone is written (a half-adoption must not fence the member's
+    jobs away from a retry), and the disarmed retry completes."""
+    fleet, router, jp, key = _adoption_rig(tmp_path)
+    monkeypatch.setenv("CCT_FAULTS", "route.adopt=fail@1")
+    with pytest.raises(faults.FaultError):
+        router.adopt("n1")
+    monkeypatch.delenv("CCT_FAULTS")
+    _, info = journal_replay(jp)
+    assert info["adopted_by"] is None  # nothing half-adopted
+    assert router.counters.snapshot()["journals_adopted"] == 0
+    # the sweep-style retry is exactly-once end to end
+    out = router.adopt("n1")
+    assert out["jobs_adopted"] == 1
+    assert journal_replay(jp)[1]["adopted_by"] == router.router_id
+
+
+def test_adoption_sweep_waits_for_horizon(tmp_path):
+    fleet, router, jp, key = _adoption_rig(tmp_path)
+    router.adopt_after_s = 3600.0  # down, but not long enough
+    router.adoption_sweep()
+    assert router.counters.snapshot()["journals_adopted"] == 0
+    router.adopt_after_s = 0.0     # horizon elapsed
+    router.adoption_sweep()
+    assert router.counters.snapshot()["journals_adopted"] == 1
+    router.adoption_sweep()        # once per outage
+    assert router.counters.snapshot()["journals_adopted"] == 1
+
+
+# ----------------------------------------- keyed-poll locate sweep
+
+class _LocateStubFleet:
+    """Stub workers where only specific nodes know specific keys —
+    the post-failover world where the router's placement cache is gone
+    but the jobs are alive on whatever node ran them."""
+
+    def __init__(self, names):
+        self.nodes = {n: {"jobs": set(), "dead": False} for n in names}
+
+    def client(self, name):
+        fleet = self
+
+        class _Client:
+            address = name
+
+            def request(self, doc, timeout=None):
+                node = fleet.nodes[name]
+                if node["dead"]:
+                    raise OSError("connection refused")
+                op = doc["op"]
+                if op == "healthz":
+                    return {"ok": True, "health": {"queued": 0,
+                                                   "running": 0,
+                                                   "status": "serving"}}
+                if op == "submit":
+                    key = idempotency_key(doc["spec"])
+                    dup = key in node["jobs"]
+                    node["jobs"].add(key)
+                    return {"ok": True, "job_id": 1, "key": key,
+                            "duplicate": dup}
+                if op in ("status", "result"):
+                    if doc["key"] in node["jobs"]:
+                        return {"ok": True,
+                                "job": {"job_id": 1, "key": doc["key"],
+                                        "state": "done"}}
+                    raise ServeClientError(
+                        "unknown job_id",
+                        {"ok": False, "error": "unknown job_id",
+                         "unknown": True})
+                raise AssertionError(op)
+
+        return _Client()
+
+
+def test_keyed_poll_sweeps_fleet_after_placement_loss():
+    """A freshly promoted router has no placement cache, and a
+    membership change can move a key's ring home away from the node
+    that ran the job.  The ring owner's unknown-job reply must trigger
+    a one-shot fleet sweep that finds the job and re-primes the cache —
+    an acked job must never read as lost just because routing state
+    died with the old active."""
+    fleet = _LocateStubFleet(["n0", "n1", "n2"])
+    router = Router([(n, n) for n in fleet.nodes], start_monitor=False,
+                    client_factory=fleet.client)
+    router.probe_members()
+    key = "feedfacecafebeef"
+    owner = router.resolve(key).name
+    holder = next(n for n in fleet.nodes if n != owner)
+    fleet.nodes[holder]["jobs"].add(key)
+    reply = router.status({"key": key})
+    assert reply["ok"] is True and reply["job"]["state"] == "done"
+    assert router.counters.snapshot()["route_locate_sweeps"] == 1
+    # the cache is re-primed: the next poll resolves straight there
+    assert router.resolve(key).name == holder
+    assert router.status({"key": key})["ok"] is True
+    assert router.counters.snapshot()["route_locate_sweeps"] == 1
+    # the blocking result path sweeps the same way
+    key2 = "beefbeefbeefbeef"
+    holder2 = next(n for n in fleet.nodes
+                   if n != router.resolve(key2).name)
+    fleet.nodes[holder2]["jobs"].add(key2)
+    assert router.result({"key": key2, "timeout": 5})["ok"] is True
+    assert router.counters.snapshot()["route_locate_sweeps"] == 2
+    # a key NO member knows still fails cleanly after one sweep
+    with pytest.raises(ServeClientError):
+        router.status({"key": "0000000000000000"})
+
+
+def test_unknown_key_recovers_spec_from_down_members_journal(tmp_path):
+    """The worst post-takeover case: the job's node is DOWN, no live
+    member knows the key, and the new active never saw the submit.  The
+    router recovers the acked spec read-only from the down member's
+    configured journal and resubmits it to the live ring successor —
+    the acked job stays resolvable through a member outage instead of
+    reading as lost until the node comes back."""
+    fleet = _LocateStubFleet(["n0", "n1", "n2"])
+    spec = _spec(tmp_path / "orphan")
+    key = idempotency_key(spec)
+    jp = str(tmp_path / "n1.journal")
+    j = Journal(jp)
+    j.append_job(7, "accepted", key=key, spec=spec)
+    j.close()
+    router = Router([(n, n) for n in fleet.nodes], start_monitor=False,
+                    down_after=1, journals={"n1": jp},
+                    client_factory=fleet.client)
+    fleet.nodes["n1"]["dead"] = True
+    router.probe_members()
+    assert not router._member("n1").up
+    reply = router.status({"key": key})
+    assert reply["ok"] is True and reply["job"]["state"] == "done"
+    assert router.counters.snapshot()["route_resubmits"] == 1
+    landed = [n for n, node in fleet.nodes.items() if key in node["jobs"]]
+    assert landed and "n1" not in landed
+    # resolvable from now on without another recovery
+    assert router.status({"key": key})["ok"] is True
+    assert router.counters.snapshot()["route_resubmits"] == 1
+
+
+# ------------------------------------------------------- client rotation
+
+def test_client_address_list_normalization_and_rotation():
+    # a 2-list [host, port] is ONE tcp address (wire back-compat) ...
+    c = ServeClient(["host", 7733], retries=0)
+    assert c.addresses == [("host", 7733)]
+    # ... while a list of addresses is a rotation set
+    c = ServeClient(["/tmp/a.sock", ["h", 1], ("h", 2)], retries=0)
+    assert c.addresses == ["/tmp/a.sock", ("h", 1), ("h", 2)]
+    assert c.address == "/tmp/a.sock"
+    c._rotate_address()
+    assert c.address == ("h", 1)
+    c._rotate_address()
+    c._rotate_address()
+    assert c.address == "/tmp/a.sock"  # wrapped
+    # an off-list address (router re-resolution pointed at a worker)
+    # falls back into the configured set
+    c.address = "/tmp/elsewhere.sock"
+    c._rotate_address()
+    assert c.address == "/tmp/a.sock"
+    # router kwarg accepts a list too; property keeps back-compat
+    c2 = ServeClient("/tmp/a.sock", retries=0,
+                     router=["/tmp/r0.sock", "/tmp/r1.sock"])
+    assert c2.routers == ["/tmp/r0.sock", "/tmp/r1.sock"]
+    assert c2.router == "/tmp/r0.sock"
+    with pytest.raises(ValueError):
+        ServeClient([], retries=0)
+
+
+# --------------------------- acceptance: kill -9 the ACTIVE router
+
+_ROUTER_BOOT = (
+    "import sys; "
+    f"sys.path.insert(0, {REPO!r}); "
+    f"sys.path.insert(0, {os.path.join(REPO, 'tools')!r}); "
+    "from _jax_cpu import force_cpu; force_cpu(); "
+    "from consensuscruncher_tpu.cli import main; "
+    "sys.exit(main(sys.argv[1:]))"
+)
+
+
+def _spawn_router(rid, sock, rv, members, journals, standby, log):
+    env = dict(os.environ)
+    env.pop("CCT_FAULTS", None)
+    argv = ["route", "--socket", sock, "--router_id", rid,
+            "--ring_view", rv, "--standby", str(standby),
+            "--takeover_after", "2", "--health_interval_s", "0.5",
+            "--down_after", "2",
+            "--members", ",".join(f"{n}={a}" for n, a in members),
+            "--journals", ",".join(f"{n}={p}" for n, p in journals)]
+    return subprocess.Popen([sys.executable, "-c", _ROUTER_BOOT] + argv,
+                            stdout=log, stderr=subprocess.STDOUT, env=env)
+
+
+def _spawn_worker(name, sock, journal, log):
+    # matplotlib (plot stage) is not thread-safe, so real workers must be
+    # processes — same shape as the production fleet and test_router
+    env = dict(os.environ)
+    env.pop("CCT_FAULTS", None)
+    argv = ["serve", "--socket", sock, "--node", name,
+            "--journal", journal, "--gang_size", "1",
+            "--queue_bound", "8", "--backend", "xla_cpu",
+            "--drain_s", "60"]
+    return subprocess.Popen([sys.executable, "-c", _ROUTER_BOOT] + argv,
+                            stdout=log, stderr=subprocess.STDOUT, env=env)
+
+
+def test_active_router_kill9_standby_finishes_jobs_to_golden(tmp_path):
+    """THE router-HA acceptance test: two real workers, a real
+    active/standby router pair sharing a ring-view file, two
+    acknowledged jobs, kill -9 the ACTIVE router — the standby
+    health-probes it dead, takes over by epoch bump (router_failovers),
+    the multi-address client rotates to it, and every acknowledged job
+    completes byte-identical to the frozen goldens.  Zero acked jobs
+    lost across the loss of the routing tier's active half."""
+    socks = {n: str(tmp_path / f"{n}.sock") for n in ("w0", "w1")}
+    jpaths = {n: str(tmp_path / f"{n}.journal") for n in socks}
+    rv = str(tmp_path / "ring.view")
+    rsocks = {"r0": str(tmp_path / "r0.sock"),
+              "r1": str(tmp_path / "r1.sock")}
+    log = open(tmp_path / "fleet.log", "wb")
+    members = list(socks.items())
+    journals = list(jpaths.items())
+    procs = {n: _spawn_worker(n, socks[n], jpaths[n], log) for n in socks}
+    try:
+        deadline = time.monotonic() + 180
+        while not all(os.path.exists(s) for s in socks.values()):
+            assert time.monotonic() < deadline, "workers never bound"
+            time.sleep(0.2)
+        procs["r0"] = _spawn_router("r0", rsocks["r0"], rv, members,
+                                    journals, False, log)
+        # r0 must CLAIM the view before the standby boots, so the standby
+        # can't mistake an empty doc for a dead active
+        while not (os.path.exists(rsocks["r0"])
+                   and (RingView(rv).load() or {}).get("router") == "r0"):
+            assert time.monotonic() < deadline, "r0 never became active"
+            time.sleep(0.2)
+        procs["r1"] = _spawn_router("r1", rsocks["r1"], rv, members,
+                                    journals, True, log)
+        while not os.path.exists(rsocks["r1"]):
+            assert time.monotonic() < deadline, "r1 never came up"
+            time.sleep(0.2)
+        epoch0 = RingView(rv).load()["epoch"]
+
+        client = ServeClient([rsocks["r0"], rsocks["r1"]],
+                             retries=60, retry_base_s=0.1)
+        subs = [client.submit_full(_spec(tmp_path / f"job{i}"))
+                for i in range(2)]
+        os.kill(procs["r0"].pid, signal.SIGKILL)
+        procs["r0"].wait(timeout=30)
+
+        for i, sub in enumerate(subs):
+            job = client.result(key=sub["key"], timeout=600)
+            assert job["state"] == "done", job
+            _assert_matches_golden(tmp_path / f"job{i}" / "golden",
+                                   f"ha job {i}")
+        doc = RingView(rv).load()
+        assert doc["router"] == "r1" and doc["epoch"] > epoch0
+        m = ServeClient(rsocks["r1"], retries=10,
+                        retry_base_s=0.1).metrics()
+        assert m["cumulative"]["router_failovers"] == 1
+        assert m["ha_state"] == "active" and m["epoch"] == doc["epoch"]
+        # the client rotated onto the survivor for good
+        assert client.address == rsocks["r1"]
+        # the fence floor rises lazily with the first post-takeover
+        # forward: every worker that served one now rejects a zombie r0,
+        # and no floor can ever exceed the published epoch
+        floors = {n: ServeClient(sock, retries=10,
+                                 retry_base_s=0.1).healthz()["fence_epoch"]
+                  for n, sock in socks.items()}
+        assert max(floors.values()) == doc["epoch"], (floors, doc)
+        assert all(f <= doc["epoch"] for f in floors.values()), floors
+    except BaseException:
+        log.flush()
+        sys.stderr.write(open(tmp_path / "fleet.log").read()[-8000:])
+        raise
+    finally:
+        log.close()
+        for proc in procs.values():
+            if proc.poll() is None:
+                proc.send_signal(signal.SIGTERM)
+        for proc in procs.values():
+            if proc.poll() is None:
+                try:
+                    proc.wait(timeout=120)
+                except subprocess.TimeoutExpired:
+                    proc.kill()

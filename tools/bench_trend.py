@@ -23,12 +23,23 @@ import re
 import sys
 
 ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
+LOADGEN_RE = re.compile(r"BENCH_LOADGEN_r(\d+)\.json$")
 
 
 def discover(repo: str) -> list[tuple[int, str]]:
     out = []
     for path in sorted(glob.glob(os.path.join(repo, "BENCH_r*.json"))):
         m = ROUND_RE.search(os.path.basename(path))
+        if m:
+            out.append((int(m.group(1)), path))
+    return sorted(out)
+
+
+def discover_loadgen(repo: str) -> list[tuple[int, str]]:
+    out = []
+    for path in sorted(glob.glob(os.path.join(repo,
+                                              "BENCH_LOADGEN_r*.json"))):
+        m = LOADGEN_RE.search(os.path.basename(path))
         if m:
             out.append((int(m.group(1)), path))
     return sorted(out)
@@ -82,6 +93,57 @@ def extract(n: int, path: str) -> dict:
     else:
         row["source"] = "failed"
     return row
+
+
+def _knee_fields(knee: dict | None, levels: list | None) -> dict:
+    """The three capacity numbers a loadgen run is committed for, plus
+    the worst shed ratio the sweep reached (how hard the levels pushed
+    past the knee)."""
+    out = {"knee_offered": None, "max_throughput": None,
+           "shed_threshold": None, "peak_shed": None}
+    if knee:
+        out["knee_offered"] = knee.get("knee_offered_jobs_per_s")
+        out["max_throughput"] = knee.get("max_throughput_jobs_per_s")
+        out["shed_threshold"] = knee.get("shed_knee_threshold")
+    sheds = [
+        (lv.get("aggregate") or {}).get("shed_ratio")
+        for lv in (levels or []) if isinstance(lv, dict)
+    ]
+    sheds = [s for s in sheds if s is not None]
+    if sheds:
+        out["peak_shed"] = max(sheds)
+    return out
+
+
+def extract_loadgen(n: int, path: str) -> list[dict]:
+    """Trend rows for one loadgen artifact.  Two shapes exist: r06 is a
+    single-scheduler capacity run (top-level ``knee``/``levels``), r09 is
+    a fleet sweep (``runs`` keyed by worker count plus ``scaling``).  A
+    sweep yields one row per worker count so the scaling column reads
+    straight down.  Unreadable artifacts become one *failed* row instead
+    of disappearing."""
+    base = {"round": n, "workers": None, "speedup": None,
+            "knee_offered": None, "max_throughput": None,
+            "shed_threshold": None, "peak_shed": None, "source": "parsed"}
+    try:
+        doc = json.load(open(path))
+    except (OSError, ValueError):
+        return [dict(base, source="failed")]
+    runs = doc.get("runs")
+    if not isinstance(runs, dict):
+        row = dict(base, workers=(doc.get("config") or {}).get("workers"))
+        row.update(_knee_fields(doc.get("knee"), doc.get("levels")))
+        return [row]
+    scaling = doc.get("scaling") or {}
+    rows = []
+    for key in sorted(runs, key=lambda k: int(k) if str(k).isdigit() else 0):
+        run = runs[key] or {}
+        row = dict(base, workers=int(key) if str(key).isdigit() else key)
+        row.update(_knee_fields(run.get("knee"), run.get("levels")))
+        row["speedup"] = (scaling.get(str(key)) or {}).get(
+            "speedup_vs_1_worker")
+        rows.append(row)
+    return rows or [dict(base, source="failed")]
 
 
 def _fmt(v, unit="") -> str:
@@ -152,6 +214,41 @@ def render(rows: list[dict]) -> str:
     return "\n".join(lines)
 
 
+def render_loadgen(rows: list[dict]) -> str:
+    """The serve-capacity half of the trend: knee + saturation + shed
+    from the BENCH_LOADGEN_r0*.json artifacts."""
+    lines = [
+        "## Serve capacity trend (loadgen)",
+        "",
+        "From the committed `BENCH_LOADGEN_r0*.json` artifacts.  `knee`",
+        "is the last offered rate the scheduler sustained below the shed",
+        "threshold; `max throughput` is the saturation plateau; `peak",
+        "shed` is the worst shed ratio the overload levels reached (the",
+        "admission control working, not a failure).  Sweep rounds list",
+        "one row per fleet size with the measured speedup over one",
+        "worker — on a single-core bench host the sweep time-slices, so",
+        "flat/sub-1x scaling measures routing overhead, not the router.",
+        "",
+        "| round | workers | knee (jobs/s) | max tput (jobs/s) "
+        "| peak shed | scaling vs 1w | source |",
+        "|------:|--------:|--------------:|------------------:"
+        "|----------:|--------------:|:-------|",
+    ]
+    for r in rows:
+        lines.append(
+            "| r{round:02d} | {w} | {knee} | {tput} | {shed} | {spd} "
+            "| {src} |".format(
+                round=r["round"],
+                w=_fmt(r["workers"]),
+                knee=_fmt(r["knee_offered"]),
+                tput=_fmt(r["max_throughput"]),
+                shed=_fmt(r["peak_shed"]),
+                spd=_fmt(r["speedup"], "x"),
+                src=r["source"]))
+    lines.append("")
+    return "\n".join(lines)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--repo", default=os.path.dirname(
@@ -166,6 +263,11 @@ def main(argv=None) -> int:
         return 1
     rows = [extract(n, path) for n, path in found]
     text = render(rows)
+    loadgen = discover_loadgen(args.repo)
+    if loadgen:
+        lg_rows = [row for n, path in loadgen
+                   for row in extract_loadgen(n, path)]
+        text += "\n" + render_loadgen(lg_rows)
     if args.out == "-":
         print(text)
         return 0

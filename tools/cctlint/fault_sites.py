@@ -64,4 +64,14 @@ FAULT_SITES: dict[str, str] = {
     "route.resubmit": "failover resubmission to the new ring owner fails "
                       "-> clean error reply; the keyed poll retries and "
                       "the next resolve resubmits again (idempotent)",
+    "route.router_down": "standby's health probe of the active router "
+                         "fails -> after takeover_after misses the "
+                         "standby bumps the ring-view epoch and takes "
+                         "over (router_failovers counter, flight dump)",
+    "route.adopt": "journal adoption of a dead member fails -> no "
+                   "tombstone written, sweep retries; resubmit dedup "
+                   "keeps the retry exactly-once",
+    "route.fence": "worker-side epoch admission rejects the forward -> "
+                   "the sending router sees fenced:true and demotes "
+                   "itself (no zombie-router double-dispatch)",
 }

@@ -1,0 +1,627 @@
+"""Seeded randomized fault-schedule soak for the HA serve fleet.
+
+The conductor boots a WHOLE fleet — N journaled workers, an active
+router and a standby sharing an epoch-numbered ring-view document — and
+drives it through a seeded random schedule of faults:
+
+  submit              a consensus job through the router pair
+  kill_worker         kill -9 a worker (its journal replays on restart)
+  restart_worker      bring a killed worker back on the same journal
+  kill_active_router  kill -9 whichever router the ring view says is
+                      active; the standby must take over by epoch bump
+  restart_router      bring the dead router back as the new standby
+  perm_kill_worker    kill -9 a worker FOR GOOD; the active router must
+                      adopt its journal (resubmit + tombstone)
+  zombie_return       restart the permanently-killed worker on its
+                      tombstoned journal; it must drop the adopted jobs
+  add_member          grow the ring via the member_add op
+  decommission_member kill + adopt + member_remove the grown member
+  arm_fault           arm a route.*/serve.* fault site (CCT_FAULTS) on
+                      the next respawned router/worker
+  status_sweep        poll a sample of acknowledged jobs by key
+
+After EVERY event the invariants are re-checked:
+
+  * no acknowledged job is lost (every key still resolves, none failed);
+  * the ring-view epoch is monotone (strictly increases across events
+    that change the view — takeover, membership);
+  * each live router's cumulative counters are monotone.
+
+At the end every dead-but-not-permanent worker is restarted, every
+acknowledged job is driven to ``done``, and every output tree is
+digest-compared against the frozen ``test/golden.json`` — byte
+identity, not just success.  Exit 0 means all invariants held.
+
+  python tools/chaos_conductor.py --workdir /tmp/chaos --seed 7 --events 30
+  python tools/chaos_conductor.py --workdir /tmp/chaos --smoke
+
+Deterministic given ``--seed`` (modulo OS scheduling).  ``--smoke`` is
+the fixed-seed short leg ``tools/ci_check.sh`` runs: fewer events, but
+the structural ones (failover, adoption, zombie, membership) are always
+in the schedule.  Shares :func:`serve_soak.job_spec` /
+:func:`serve_soak.check_golden` / :data:`serve_soak.BOOT` with the
+single-daemon soak so there is one source of truth for the golden
+contract.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import signal
+import subprocess
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+sys.path.insert(0, os.path.join(_REPO, "tools"))
+sys.path.insert(0, os.path.join(_REPO, "test"))
+
+from consensuscruncher_tpu.serve.client import ServeClient  # noqa: E402
+from serve_soak import BOOT, check_golden, job_spec  # noqa: E402
+
+WORKER_FAULTS = ("serve.worker=fail@1", "serve.dispatch=fail@1")
+ROUTER_FAULTS = ("route.member_down=fail@1", "route.resubmit=fail@1",
+                 "route.steal=fail@1", "route.adopt=fail@1")
+
+
+def read_ring_view(path: str) -> dict | None:
+    """Highest-epoch record of the ring-view doc (same torn-tail-tolerant
+    contract as serve.router.RingView.load, re-implemented here so the
+    conductor parent never imports the serve stack)."""
+    try:
+        raw = open(path, "rb").read()
+    except OSError:
+        return None
+    best = None
+    for line in raw.split(b"\n"):
+        if not line.strip():
+            continue
+        try:
+            doc = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(doc, dict) and "epoch" in doc:
+            if best is None or int(doc["epoch"]) > int(best["epoch"]):
+                best = doc
+    return best
+
+
+def journal_tombstoned(path: str) -> bool:
+    """True once the journal carries an ``adopted`` marker record."""
+    try:
+        raw = open(path, "rb").read()
+    except OSError:
+        return False
+    for line in raw.split(b"\n"):
+        if b'"adopted"' not in line:
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(rec, dict) and rec.get("rec") == "marker" \
+                and rec.get("kind") == "adopted":
+            return True
+    return False
+
+
+class Conductor:
+    def __init__(self, workdir: str, seed: int, workers: int = 3,
+                 max_unique_jobs: int = 6, job_timeout_s: float = 600.0):
+        self.workdir = os.path.abspath(workdir)
+        self.rng = random.Random(seed)
+        self.seed = seed
+        self.job_timeout_s = float(job_timeout_s)
+        self.max_unique_jobs = int(max_unique_jobs)
+        self.logdir = os.path.join(self.workdir, "logs")
+        os.makedirs(self.logdir, exist_ok=True)
+        self.ring_view = os.path.join(self.workdir, "ring.view")
+        self.golden = json.load(
+            open(os.path.join(_REPO, "test", "golden.json")))
+        self.workers: dict[str, dict] = {}
+        for i in range(workers):
+            name = f"w{i}"
+            self.workers[name] = {
+                "sock": os.path.join(self.workdir, f"{name}.sock"),
+                "journal": os.path.join(self.workdir, f"{name}.journal"),
+                "proc": None, "alive": False, "permanent": False,
+                "in_fleet": True, "original": True,
+            }
+        self.routers: dict[str, dict] = {
+            rid: {"sock": os.path.join(self.workdir, f"{rid}.sock"),
+                  "proc": None, "alive": False}
+            for rid in ("r0", "r1")
+        }
+        self.acked: list[dict] = []       # {"key", "out", "spec"}
+        self.last_epoch = 0
+        self.takeovers_seen = 0
+        self.adoptions_seen = 0
+        self.metrics_base: dict[str, dict] = {}
+        self.next_worker_fault: str | None = None
+        self.next_router_fault: str | None = None
+        self.violations: list[str] = []
+        # both front doors; a standby's busy refusal makes this rotate
+        self.client = ServeClient(
+            [r["sock"] for r in self.routers.values()],
+            retries=60, retry_base_s=0.1)
+        self.check_client = ServeClient(
+            [r["sock"] for r in self.routers.values()],
+            retries=6, retry_base_s=0.1)
+
+    # ------------------------------------------------------------ process
+
+    def _log(self, msg: str) -> None:
+        print(f"chaos: {msg}", flush=True)
+
+    def _violate(self, msg: str) -> None:
+        self.violations.append(msg)
+        print(f"chaos: VIOLATION {msg}", file=sys.stderr, flush=True)
+
+    def _popen(self, tag: str, argv: list, fault: str | None) -> subprocess.Popen:
+        env = dict(os.environ)
+        env.pop("CCT_FAULTS", None)
+        if fault:
+            env["CCT_FAULTS"] = fault
+            self._log(f"  (spawning {tag} with CCT_FAULTS={fault})")
+        log = open(os.path.join(self.logdir, f"{tag}.log"), "ab")
+        return subprocess.Popen(argv, env=env, stdout=log, stderr=log)
+
+    def _spawn_worker(self, name: str) -> None:
+        w = self.workers[name]
+        if os.path.exists(w["sock"]):
+            os.unlink(w["sock"])
+        argv = [sys.executable, "-c", BOOT, "serve",
+                "--socket", w["sock"], "--node", name,
+                "--journal", w["journal"], "--gang_size", "1",
+                "--queue_bound", "32", "--backend", "xla_cpu",
+                "--drain_s", "60"]
+        w["proc"] = self._popen(name, argv, self.next_worker_fault)
+        self.next_worker_fault = None
+        w["alive"] = True
+        w["permanent"] = False
+
+    def _member_flags(self) -> list:
+        members = ",".join(
+            f"{n}={w['sock']}" for n, w in self.workers.items()
+            if w["in_fleet"])
+        journals = ",".join(
+            f"{n}={w['journal']}" for n, w in self.workers.items())
+        return ["--members", members, "--journals", journals]
+
+    def _spawn_router(self, rid: str, standby: bool) -> None:
+        r = self.routers[rid]
+        if os.path.exists(r["sock"]):
+            os.unlink(r["sock"])
+        argv = [sys.executable, "-c", BOOT, "route",
+                "--socket", r["sock"], "--router_id", rid,
+                "--ring_view", self.ring_view,
+                "--standby", str(standby),
+                "--takeover_after", "2", "--health_interval_s", "0.5",
+                "--down_after", "2", "--adopt_after_s", "3",
+                ] + self._member_flags()
+        r["proc"] = self._popen(rid, argv, self.next_router_fault)
+        self.next_router_fault = None
+        r["alive"] = True
+        self.metrics_base.pop(rid, None)
+
+    def _wait_socket(self, path: str, what: str, timeout: float = 240.0) -> None:
+        deadline = time.monotonic() + timeout
+        while not os.path.exists(path):
+            if time.monotonic() > deadline:
+                raise RuntimeError(f"{what} never came up ({path} missing)")
+            time.sleep(0.2)
+
+    def _kill9(self, proc: subprocess.Popen, what: str) -> None:
+        try:
+            proc.send_signal(signal.SIGKILL)
+        except OSError:
+            pass
+        proc.wait(timeout=30)
+        self._log(f"kill -9 {what} (pid {proc.pid})")
+
+    def boot(self) -> None:
+        self._log(f"booting fleet under {self.workdir} (seed {self.seed})")
+        for name in self.workers:
+            self._spawn_worker(name)
+        for name, w in self.workers.items():
+            self._wait_socket(w["sock"], f"worker {name}")
+        self._spawn_router("r0", standby=False)
+        self._wait_socket(self.routers["r0"]["sock"], "router r0")
+        # active must have published before the standby starts probing,
+        # or the standby could win the empty-view takeover race at boot
+        deadline = time.monotonic() + 120.0
+        while True:
+            doc = read_ring_view(self.ring_view)
+            if doc and doc.get("router") == "r0":
+                break
+            if time.monotonic() > deadline:
+                raise RuntimeError("r0 never published the ring view")
+            time.sleep(0.2)
+        self._spawn_router("r1", standby=True)
+        self._wait_socket(self.routers["r1"]["sock"], "router r1")
+        self.last_epoch = int(doc["epoch"])
+        self._log(f"fleet up: {len(self.workers)} workers, r0 active "
+                  f"(epoch {self.last_epoch}), r1 standby")
+
+    # ------------------------------------------------------------- events
+
+    def ev_submit(self) -> None:
+        n = len({a["out"] for a in self.acked})
+        if n < self.max_unique_jobs:
+            out = os.path.join(self.workdir, "jobs", f"job{n}")
+        else:  # re-submit an existing spec: must dedup to the same key
+            out = self.rng.choice(self.acked)["out"]
+        spec = job_spec(out)
+        sub = self.client.submit_full(spec)
+        dup = [a for a in self.acked if a["out"] == out]
+        if dup and dup[0]["key"] != sub["key"]:
+            self._violate(f"resubmit of {out} got key {sub['key']} != "
+                          f"original {dup[0]['key']}")
+        self.acked.append({"key": sub["key"], "out": out, "spec": spec})
+        self._log(f"submit -> key {sub['key']} on {sub.get('node')}"
+                  + (" (duplicate)" if sub.get("duplicate") else ""))
+
+    def _poll_status(self, key: str, deadline_s: float = 90.0) -> dict | None:
+        deadline = time.monotonic() + deadline_s
+        last: Exception | None = None
+        while time.monotonic() < deadline:
+            try:
+                return self.check_client.status(key=key)
+            except Exception as e:
+                last = e
+                time.sleep(0.5)
+        self._violate(f"acked key {key} unresolvable after "
+                      f"{deadline_s:.0f}s: {last}")
+        return None
+
+    def ev_status_sweep(self, sample: int = 4) -> None:
+        picks = self.rng.sample(self.acked, min(sample, len(self.acked)))
+        for rec in picks:
+            job = self._poll_status(rec["key"])
+            if job is None:
+                continue
+            if job["state"] == "failed":
+                self._violate(f"acked key {rec['key']} FAILED: "
+                              f"{job.get('error')}")
+        if picks:
+            self._log(f"status sweep: {len(picks)} key(s) resolvable")
+
+    def _live_workers(self) -> list:
+        return [n for n, w in self.workers.items()
+                if w["alive"] and w["in_fleet"]]
+
+    def ev_kill_worker(self) -> None:
+        live = self._live_workers()
+        if len(live) < 2:
+            self._log("kill_worker skipped (only one worker alive)")
+            return
+        name = self.rng.choice(live)
+        self.workers[name]["alive"] = False
+        self._kill9(self.workers[name]["proc"], f"worker {name}")
+
+    def ev_restart_worker(self) -> None:
+        dead = [n for n, w in self.workers.items()
+                if not w["alive"] and not w["permanent"] and w["in_fleet"]]
+        if not dead:
+            self._log("restart_worker skipped (none dead)")
+            return
+        name = self.rng.choice(dead)
+        self._spawn_worker(name)
+        self._wait_socket(self.workers[name]["sock"], f"worker {name}")
+        self._log(f"worker {name} restarted (journal replays)")
+
+    def ev_kill_active_router(self) -> None:
+        doc = read_ring_view(self.ring_view)
+        if not doc:
+            self._violate("no ring view document at kill_active_router")
+            return
+        rid = str(doc.get("router"))
+        if rid not in self.routers or not self.routers[rid]["alive"]:
+            self._log(f"kill_active_router skipped ({rid} not alive)")
+            return
+        standby_alive = any(r["alive"] for k, r in self.routers.items()
+                            if k != rid)
+        if not standby_alive:
+            self._log("kill_active_router skipped (no standby to fail to)")
+            return
+        old_epoch = int(doc["epoch"])
+        self.routers[rid]["alive"] = False
+        self.metrics_base.pop(rid, None)
+        self._kill9(self.routers[rid]["proc"], f"active router {rid}")
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            doc = read_ring_view(self.ring_view)
+            if doc and doc.get("router") != rid \
+                    and int(doc["epoch"]) > old_epoch:
+                self.takeovers_seen += 1
+                self._log(f"takeover: {doc['router']} is active at epoch "
+                          f"{doc['epoch']} (was {rid}@{old_epoch})")
+                return
+            time.sleep(0.25)
+        self._violate(f"no takeover within 60s of killing active {rid}")
+
+    def ev_restart_router(self) -> None:
+        dead = [rid for rid, r in self.routers.items() if not r["alive"]]
+        if not dead:
+            self._log("restart_router skipped (both routers alive)")
+            return
+        rid = dead[0]
+        self._spawn_router(rid, standby=True)
+        self._wait_socket(self.routers[rid]["sock"], f"router {rid}")
+        self._log(f"router {rid} restarted as standby")
+
+    def ev_perm_kill_worker(self) -> None:
+        live = [n for n in self._live_workers()
+                if self.workers[n]["original"]]
+        if len(live) < 2:
+            self._log("perm_kill_worker skipped (too few workers alive)")
+            return
+        name = self.rng.choice(live)
+        w = self.workers[name]
+        w["alive"] = False
+        w["permanent"] = True
+        self._kill9(w["proc"], f"worker {name} (PERMANENT)")
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline:
+            if journal_tombstoned(w["journal"]):
+                self.adoptions_seen += 1
+                self._log(f"journal of {name} adopted (tombstone present)")
+                return
+            time.sleep(0.5)
+        self._violate(f"journal of {name} not adopted within 120s")
+
+    def ev_zombie_return(self) -> None:
+        perm = [n for n, w in self.workers.items()
+                if w["permanent"] and w["in_fleet"]]
+        if not perm:
+            self._log("zombie_return skipped (no permanently dead worker)")
+            return
+        name = perm[0]
+        w = self.workers[name]
+        if not journal_tombstoned(w["journal"]):
+            self._log(f"zombie_return skipped ({name} not yet adopted)")
+            return
+        self._spawn_worker(name)  # clears the permanent flag
+        self._wait_socket(w["sock"], f"zombie {name}")
+        try:
+            m = ServeClient(w["sock"], retries=30,
+                            retry_base_s=0.1).metrics()["cumulative"]
+            self._log(f"zombie {name} rejoined; dropped "
+                      f"{m.get('fencing_rejections', 0)} adopted job(s) "
+                      "at replay")
+        except Exception as e:
+            self._violate(f"zombie {name} unreachable after restart: {e}")
+
+    def ev_add_member(self) -> None:
+        name = f"w{len(self.workers)}"
+        self.workers[name] = {
+            "sock": os.path.join(self.workdir, f"{name}.sock"),
+            "journal": os.path.join(self.workdir, f"{name}.journal"),
+            "proc": None, "alive": False, "permanent": False,
+            "in_fleet": False, "original": False,
+        }
+        self._spawn_worker(name)
+        self._wait_socket(self.workers[name]["sock"], f"worker {name}")
+        self.client.request({"op": "member_add", "name": name,
+                             "address": self.workers[name]["sock"],
+                             "journal": self.workers[name]["journal"]},
+                            timeout=60.0)
+        self.workers[name]["in_fleet"] = True
+        self._log(f"member {name} added to the ring")
+
+    def ev_decommission_member(self) -> None:
+        added = [n for n, w in self.workers.items()
+                 if not w["original"] and w["in_fleet"]]
+        if not added:
+            self._log("decommission skipped (no added member)")
+            return
+        name = added[0]
+        w = self.workers[name]
+        if w["alive"]:
+            w["alive"] = False
+            self._kill9(w["proc"], f"member {name} (decommission)")
+        self.client.request({"op": "adopt", "node": name, "force": True},
+                            timeout=300.0)
+        self.client.request({"op": "member_remove", "name": name},
+                            timeout=60.0)
+        w["in_fleet"] = False
+        w["permanent"] = True
+        self._log(f"member {name} decommissioned (adopt + remove)")
+
+    def ev_arm_fault(self) -> None:
+        if self.rng.random() < 0.5:
+            self.next_worker_fault = self.rng.choice(WORKER_FAULTS)
+            self._log(f"armed {self.next_worker_fault} for the next "
+                      "worker spawn")
+        else:
+            self.next_router_fault = self.rng.choice(ROUTER_FAULTS)
+            self._log(f"armed {self.next_router_fault} for the next "
+                      "router spawn")
+
+    # --------------------------------------------------------- invariants
+
+    def check_invariants(self, where: str) -> None:
+        doc = read_ring_view(self.ring_view)
+        if doc is not None:
+            epoch = int(doc["epoch"])
+            if epoch < self.last_epoch:
+                self._violate(f"[{where}] ring-view epoch went BACKWARD: "
+                              f"{self.last_epoch} -> {epoch}")
+            self.last_epoch = max(self.last_epoch, epoch)
+        for rid, r in self.routers.items():
+            if not r["alive"]:
+                continue
+            try:
+                m = ServeClient(r["sock"], retries=2,
+                                retry_base_s=0.1).metrics()["cumulative"]
+            except Exception:
+                continue  # mid-restart/busy: monotonicity rechecked later
+            base = self.metrics_base.get(rid)
+            if base:
+                for k, v in base.items():
+                    if m.get(k, 0) < v:
+                        self._violate(f"[{where}] router {rid} counter "
+                                      f"{k} went backward: {v} -> "
+                                      f"{m.get(k, 0)}")
+            self.metrics_base[rid] = dict(m)
+
+    # ------------------------------------------------------------ drive
+
+    def build_schedule(self, events: int) -> list:
+        names = ["submit", "status_sweep", "kill_worker", "restart_worker",
+                 "arm_fault"]
+        weights = [3.0, 2.0, 1.5, 1.5, 1.0]
+        sched = self.rng.choices(names, weights=weights, k=max(1, events))
+        forced = [(0.20, "add_member"),
+                  (0.35, "kill_active_router"),
+                  (0.45, "restart_router"),
+                  (0.55, "perm_kill_worker"),
+                  (0.75, "decommission_member"),
+                  (0.85, "zombie_return")]
+        for frac, name in forced:
+            idx = int(frac * len(sched)) + self.rng.randint(-1, 1)
+            sched.insert(max(0, min(len(sched), idx)), name)
+        if sched[0] != "submit":  # something must be in flight from the start
+            sched.insert(0, "submit")
+        return sched
+
+    def run(self, events: int) -> int:
+        self.boot()
+        schedule = self.build_schedule(events)
+        self._log(f"schedule ({len(schedule)} events): "
+                  + " ".join(schedule))
+        handlers = {
+            "submit": self.ev_submit,
+            "status_sweep": self.ev_status_sweep,
+            "kill_worker": self.ev_kill_worker,
+            "restart_worker": self.ev_restart_worker,
+            "kill_active_router": self.ev_kill_active_router,
+            "restart_router": self.ev_restart_router,
+            "perm_kill_worker": self.ev_perm_kill_worker,
+            "zombie_return": self.ev_zombie_return,
+            "add_member": self.ev_add_member,
+            "decommission_member": self.ev_decommission_member,
+            "arm_fault": self.ev_arm_fault,
+        }
+        try:
+            for i, name in enumerate(schedule):
+                self._log(f"--- event {i + 1}/{len(schedule)}: {name}")
+                try:
+                    handlers[name]()
+                except Exception as e:
+                    self._violate(f"event {name} raised: {e!r}")
+                self.check_invariants(f"event {i + 1}:{name}")
+                time.sleep(self.rng.uniform(0.2, 1.0))
+            return self.finish()
+        finally:
+            self.teardown()
+
+    def finish(self) -> int:
+        self._log("schedule complete; draining every acknowledged job")
+        # revive every transiently-dead worker so its journal drains
+        for name, w in self.workers.items():
+            if not w["alive"] and not w["permanent"] and w["in_fleet"]:
+                self._spawn_worker(name)
+                self._wait_socket(w["sock"], f"worker {name}")
+        if not any(r["alive"] for r in self.routers.values()):
+            self._violate("no router alive at the end of the schedule")
+            return self.report()
+        outs = {}
+        for rec in self.acked:
+            outs.setdefault(rec["out"], rec["key"])
+        for out, key in outs.items():
+            deadline = time.monotonic() + self.job_timeout_s
+            state = None
+            while time.monotonic() < deadline:
+                try:
+                    job = self.check_client.status(key=key)
+                except Exception:
+                    time.sleep(1.0)
+                    continue
+                state = job["state"]
+                if state in ("done", "failed"):
+                    break
+                time.sleep(1.0)
+            if state != "done":
+                self._violate(f"acked job {key} ({out}) ended {state!r}")
+                continue
+            problems = check_golden(os.path.join(out, "golden"), self.golden)
+            for p in problems:
+                self._violate(f"golden mismatch for {key} ({out}): {p}")
+            if not problems:
+                self._log(f"job {key} done, byte-identical goldens")
+        if self.takeovers_seen < 1:
+            self._violate("schedule finished without a router takeover")
+        if self.adoptions_seen < 1:
+            self._violate("schedule finished without a journal adoption")
+        return self.report()
+
+    def report(self) -> int:
+        n_jobs = len({a['out'] for a in self.acked})
+        self._log(f"summary: {len(self.acked)} submits over {n_jobs} "
+                  f"unique job(s), {self.takeovers_seen} takeover(s), "
+                  f"{self.adoptions_seen} adoption(s), final epoch "
+                  f"{self.last_epoch}")
+        if self.violations:
+            for v in self.violations:
+                print(f"chaos: FAIL {v}", file=sys.stderr, flush=True)
+            return 1
+        self._log("OK — every invariant held through the schedule")
+        return 0
+
+    def teardown(self) -> None:
+        procs = [(rid, r["proc"]) for rid, r in self.routers.items()
+                 if r["proc"] is not None and r["proc"].poll() is None]
+        procs += [(n, w["proc"]) for n, w in self.workers.items()
+                  if w["proc"] is not None and w["proc"].poll() is None]
+        for _, proc in procs:
+            try:
+                proc.terminate()
+            except OSError:
+                pass
+        deadline = time.monotonic() + 120.0
+        for tag, proc in procs:
+            try:
+                proc.wait(timeout=max(1.0, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                print(f"chaos: {tag} ignored SIGTERM; killing",
+                      file=sys.stderr, flush=True)
+                proc.kill()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--workdir", required=True,
+                    help="scratch directory for sockets/journals/outputs")
+    ap.add_argument("--events", type=int, default=30,
+                    help="random events in the schedule (structural "
+                         "failover/adoption/membership events are always "
+                         "added on top; default 30)")
+    ap.add_argument("--seed", type=int, default=0,
+                    help="seeds the whole schedule (reproducible chaos)")
+    ap.add_argument("--workers", type=int, default=3,
+                    help="initial fleet size (default 3)")
+    ap.add_argument("--jobs", type=int, default=6,
+                    help="max unique consensus jobs (default 6)")
+    ap.add_argument("--timeout", type=float, default=600.0,
+                    help="per-job completion deadline at the end")
+    ap.add_argument("--smoke", action="store_true",
+                    help="fixed-seed short leg for CI: 8 random events, "
+                         "3 unique jobs, seed 7 unless --seed is given")
+    args = ap.parse_args(argv)
+    events, jobs, seed = args.events, args.jobs, args.seed
+    if args.smoke:
+        events, jobs = 8, 3
+        if seed == 0:
+            seed = 7
+    conductor = Conductor(args.workdir, seed, workers=args.workers,
+                          max_unique_jobs=jobs, job_timeout_s=args.timeout)
+    return conductor.run(events)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

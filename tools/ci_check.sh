@@ -210,4 +210,115 @@ finally:
         sys.stderr.write(open(os.path.join(WORK, "router.log")).read()[-8000:])
 PY
 
+echo "== router HA smoke (kill -9 the ACTIVE router; standby takes over) =="
+JAX_PLATFORMS=cpu PYTHONPATH="$REPO" python - "$WORK/ha" <<'PY'
+import json, os, signal, subprocess, sys, time
+
+WORK = sys.argv[1]
+os.makedirs(WORK, exist_ok=True)
+REPO = os.getcwd()
+sys.path.insert(0, os.path.join(REPO, "test"))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+from serve_soak import BOOT, check_golden, job_spec
+from consensuscruncher_tpu.serve.client import ServeClient
+
+GOLDEN = json.load(open(os.path.join(REPO, "test", "golden.json")))
+rv = os.path.join(WORK, "ring.view")
+socks = {n: os.path.join(WORK, n + ".sock") for n in ("w0", "w1")}
+jpaths = {n: os.path.join(WORK, n + ".journal") for n in socks}
+rsock = {r: os.path.join(WORK, r + ".sock") for r in ("r0", "r1")}
+log = open(os.path.join(WORK, "ha.log"), "wb")
+procs = {}
+for n, s in socks.items():
+    procs[n] = subprocess.Popen(
+        [sys.executable, "-c", BOOT, "serve", "--socket", s, "--node", n,
+         "--journal", jpaths[n], "--gang_size", "1", "--queue_bound", "8",
+         "--backend", "xla_cpu", "--drain_s", "60"],
+        stdout=log, stderr=subprocess.STDOUT)
+members = ",".join("%s=%s" % kv for kv in socks.items())
+journals = ",".join("%s=%s" % kv for kv in jpaths.items())
+
+def spawn_router(rid, standby):
+    return subprocess.Popen(
+        [sys.executable, "-c", BOOT, "route", "--socket", rsock[rid],
+         "--router_id", rid, "--ring_view", rv, "--standby", str(standby),
+         "--takeover_after", "2", "--health_interval_s", "0.5",
+         "--down_after", "2", "--members", members, "--journals", journals],
+        stdout=log, stderr=subprocess.STDOUT)
+
+def view():
+    best = None
+    try:
+        raw = open(rv, "rb").read()
+    except OSError:
+        return None
+    for ln in raw.split(b"\n"):
+        if not ln.strip():
+            continue
+        try:
+            rec = json.loads(ln)
+        except ValueError:
+            continue  # torn tail
+        if isinstance(rec, dict) and "epoch" in rec:
+            if best is None or rec["epoch"] > best["epoch"]:
+                best = rec
+    return best
+
+ok = False
+try:
+    deadline = time.monotonic() + 180
+    procs["r0"] = spawn_router("r0", False)
+    # wait for r0 to CLAIM the view before the standby boots, so the
+    # standby can't treat an empty doc as a dead active
+    while not ((view() or {}).get("router") == "r0"
+               and os.path.exists(rsock["r0"])):
+        assert time.monotonic() < deadline, "active router never published"
+        time.sleep(0.25)
+    procs["r1"] = spawn_router("r1", True)
+    epoch0 = view()["epoch"]
+    client = ServeClient([rsock["r0"], rsock["r1"]],
+                         retries=60, retry_base_s=0.25)
+    subs = [client.request(
+        {"op": "submit", "spec": job_spec(os.path.join(WORK, "job%d" % i))},
+        timeout=180) for i in range(2)]
+    assert all(s.get("ok") for s in subs), subs
+    # kill -9 the ACTIVE router with acknowledged jobs in flight: the
+    # standby must take over by epoch bump and finish them to golden
+    os.kill(procs["r0"].pid, signal.SIGKILL)
+    procs["r0"].wait(timeout=30)
+    for i, sub in enumerate(subs):
+        job = client.request({"op": "result", "key": sub["key"],
+                              "timeout": 600}, timeout=900)["job"]
+        assert job["state"] == "done", job
+        problems = check_golden(
+            os.path.join(WORK, "job%d" % i, "golden"), GOLDEN)
+        assert not problems, "ha job %d: %s" % (i, problems)
+    doc = view()
+    assert doc["router"] == "r1" and doc["epoch"] > epoch0, doc
+    m = ServeClient(rsock["r1"], retries=10, retry_base_s=0.25).request(
+        {"op": "metrics"}, timeout=60)["metrics"]
+    assert m["cumulative"]["router_failovers"] == 1, m["cumulative"]
+    assert m["ha_state"] == "active", m
+    ok = True
+    print("ci_check: router HA smoke OK (r0 killed at epoch %d; r1 active "
+          "at epoch %d; %d jobs byte-identical)"
+          % (epoch0, doc["epoch"], len(subs)))
+finally:
+    for p in procs.values():
+        if p.poll() is None:
+            p.send_signal(signal.SIGTERM)
+    for p in procs.values():
+        if p.poll() is None:
+            try:
+                p.wait(timeout=120)
+            except subprocess.TimeoutExpired:
+                p.kill()
+    log.close()
+    if not ok:
+        sys.stderr.write(open(os.path.join(WORK, "ha.log")).read()[-8000:])
+PY
+
+echo "== chaos conductor smoke (fixed-seed randomized fault schedule) =="
+python tools/chaos_conductor.py --workdir "$WORK/chaos" --smoke
+
 echo "ci_check: OK"

@@ -20,6 +20,13 @@ through ``tools/_jax_cpu.force_cpu``); wired into the suite as the
 
   python tools/serve_soak.py --jobs 4 --workdir /tmp/soak --seed 7
   pytest tests/test_serve_durability.py -m slow
+
+This harness soaks ONE supervised daemon.  The fleet-level randomized
+soak — router failover, journal adoption, membership churn — lives in
+``tools/chaos_conductor.py``, which drives a whole HA fleet through a
+seeded fault schedule and imports this module's :func:`job_spec` /
+:func:`check_golden` / :data:`BOOT` helpers (single source of truth
+for the golden contract).
 """
 
 from __future__ import annotations
@@ -44,7 +51,7 @@ from consensuscruncher_tpu.serve.client import ServeClient  # noqa: E402
 # the daemon child must drop the axon PJRT factory BEFORE first backend
 # touch (JAX_PLATFORMS=cpu alone still dials the tunnel) — same bootstrap
 # as the chaos tests' CLI subprocesses
-_BOOT = (
+BOOT = (
     "import sys; "
     f"sys.path.insert(0, {_REPO!r}); "
     f"sys.path.insert(0, {os.path.join(_REPO, 'tools')!r}); "
@@ -54,14 +61,14 @@ _BOOT = (
 )
 
 
-def _spec(output: str) -> dict:
+def job_spec(output: str) -> dict:
     return {"input": os.path.join(_REPO, "test", "data", "sample.bam"),
             "output": output, "name": "golden", "cutoff": 0.7,
             "qualscore": 0, "scorrect": True, "max_mismatch": 0,
             "bdelim": "|", "compress_level": 6}
 
 
-def _check_golden(base: str, golden: dict) -> list[str]:
+def check_golden(base: str, golden: dict) -> list[str]:
     """Digest-compare one job's output tree; returns mismatch descriptions."""
     from make_test_data import canonical_bam_digest, text_digest
 
@@ -96,7 +103,7 @@ def main(argv=None) -> int:
     journal = os.path.join(args.workdir, "soak.journal")
     golden = json.load(open(os.path.join(_REPO, "test", "golden.json")))
 
-    daemon_cmd = [sys.executable, "-c", _BOOT] + [
+    daemon_cmd = [sys.executable, "-c", BOOT] + [
         "serve", "--socket", sock, "--journal", journal,
         "--gang_size", "1", "--queue_bound", str(max(8, args.jobs)),
         "--backend", "xla_cpu", "--drain_s", "120",
@@ -120,7 +127,7 @@ def main(argv=None) -> int:
         subs = []
         for i in range(args.jobs):
             out = os.path.join(args.workdir, f"job{i}")
-            subs.append((i, out, client.submit_full(_spec(out))))
+            subs.append((i, out, client.submit_full(job_spec(out))))
 
         rng = random.Random(args.seed)
         delay = args.kill_after * rng.uniform(0.5, 1.5)
@@ -138,7 +145,7 @@ def main(argv=None) -> int:
                 failures.append(f"job{i}: {job['state']} ({job.get('error')})")
                 continue
             failures += [f"job{i}: {p}"
-                         for p in _check_golden(os.path.join(out, "golden"),
+                         for p in check_golden(os.path.join(out, "golden"),
                                                 golden)]
         replayed = client.metrics()["cumulative"]["jobs_replayed"]
         print(f"soak: {args.jobs} job(s) finished, {replayed} replayed "
