@@ -58,6 +58,11 @@ COUNTERS = {
                            "failover emptied the placement cache, or a "
                            "membership change moved the key's ring home "
                            "away from the node that ran it)",
+    "route_journal_answers": "keyed polls answered straight from a down "
+                             "member's journal: the job reached a terminal "
+                             "state before its node was adopted, so no live "
+                             "member knows the key but the journal record "
+                             "(and the outputs on disk) are authoritative",
     "router_failovers": "standby routers that promoted themselves to active "
                         "after the live router stopped answering (each "
                         "bumps the ring-view epoch)",
@@ -70,6 +75,12 @@ COUNTERS = {
                           "refusing a stale router's forward, or a "
                           "returning zombie dropping its adopted "
                           "(tombstoned) jobs at replay",
+    "mc_interleavings": "distinct schedules executed by the interleaving "
+                        "model checker (tools/model_check.py)",
+    "mc_violations": "schedules on which the model checker found a "
+                     "protocol-invariant violation or deadlock",
+    "mc_deadlocks": "explored schedules that ended with no runnable task "
+                    "(a real lock-ordering or lost-wakeup deadlock)",
 }
 
 CUMULATIVE_KEYS = tuple(COUNTERS)

@@ -218,6 +218,9 @@ def replay(path: str) -> tuple[dict[int, dict], dict]:
     jobs: dict[int, dict] = {}
     info = {"records": 0, "skipped": 0, "torn_tail": False,
             "clean_drain": False, "adopted_by": None, "fence_epoch": None}
+    # schedule point: a zombie's replay racing an adopter's tombstone
+    # append is exactly the interleaving the model checker explores here
+    sanitize.yield_point("journal.replay")
     if not os.path.exists(path):
         return jobs, info
     with open(path, "rb") as fh:

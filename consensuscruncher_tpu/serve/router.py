@@ -196,6 +196,7 @@ class RingView:
         "torn_tail"}`` (the torn-doc test asserts on the info)."""
         records: list[dict] = []
         info = {"records": 0, "skipped": 0, "torn_tail": False}
+        sanitize.yield_point("ringview.scan")
         if not os.path.exists(self.path):
             return records, info
         with open(self.path, "rb") as fh:
@@ -383,7 +384,7 @@ class Router:
         self.closing = False
         self._draining = False
         self._started_at = time.time()
-        self._lock = threading.Lock()
+        self._lock = sanitize.tracked_lock("router.lock")
         # ---------------------------------------------------------- HA role
         self.router_id = str(router_id)
         if isinstance(ring_view, str):
@@ -427,12 +428,9 @@ class Router:
         with self._lock:
             return list(self._members.values())
 
-    def _up_names(self) -> list[str]:
-        with self._lock:
-            return [m.name for m in self._members.values() if m.up]
-
     def _member(self, name: str) -> _Member:
-        return self._members[name]
+        with self._lock:
+            return self._members[name]
 
     def _mark_down(self, member: _Member, why: str) -> None:
         with self._lock:
@@ -511,9 +509,20 @@ class Router:
         if self.ring_view is None or self.standby:
             return
         self.epoch += 1
-        self.ring_view.publish(self.epoch, self.router_id,
-                               self.advertise, self._member_list(),
-                               journals=self.journals)
+        try:
+            faults.fault_point("route.view_publish")
+            self.ring_view.publish(self.epoch, self.router_id,
+                                   self.advertise, self._member_list(),
+                                   journals=self.journals)
+        except (faults.FaultError, OSError) as e:
+            # the in-memory membership change is already live and the
+            # epoch bump is kept: the view doc is advertisement state for
+            # standbys, and the NEXT successful publish (any membership
+            # change or takeover) carries this epoch forward — a failed
+            # write degrades standby visibility, never routing
+            print(f"WARNING: route[{self.router_id}]: ring-view publish "
+                  f"failed ({e}); epoch {self.epoch} will be advertised "
+                  "by the next publish", file=sys.stderr, flush=True)
 
     def start(self, advertise=None) -> None:
         """Late activation for the CLI: the advertised address may only be
@@ -674,7 +683,8 @@ class Router:
         tombstone, so the next sweep (or a returning member) retries with
         nothing lost and nothing doubled."""
         self._check_active()
-        member = self._members.get(str(node))
+        with self._lock:
+            member = self._members.get(str(node))
         if member is None:
             raise ServeClientError(f"unknown member {node!r}",
                                    {"bad_request": True})
@@ -755,10 +765,11 @@ class Router:
             self._members[name] = _Member(name, address,
                                           self._client_factory(address))
             self.ring = HashRing(list(self._members), vnodes=self.vnodes)
+            fleet_size = len(self._members)
         if journal:
             self.journals[name] = str(journal)
         self._publish_view()
-        return {"node": name, "fleet_size": len(self._members)}
+        return {"node": name, "fleet_size": fleet_size}
 
     def member_remove(self, name: str) -> dict:
         """Shrink the ring: the member's keys fall to their ring
@@ -775,15 +786,18 @@ class Router:
                                        {"bad_request": True})
             del self._members[name]
             self.ring = HashRing(list(self._members), vnodes=self.vnodes)
+            fleet_size = len(self._members)
         self._publish_view()
-        return {"node": name, "fleet_size": len(self._members)}
+        return {"node": name, "fleet_size": fleet_size}
 
     # ------------------------------------------------------------ routing
 
     def _owner_for(self, key: str, exclude: set | None = None):
-        up = [n for n in self._up_names() if not exclude or n not in exclude]
-        name = self.ring.owner(key, up=up)
-        return None if name is None else self._member(name)
+        with self._lock:
+            up = [m.name for m in self._members.values()
+                  if m.up and (not exclude or m.name not in exclude)]
+            name = self.ring.owner(key, up=up)
+            return None if name is None else self._members.get(name)
 
     def _remember(self, key: str, spec: dict, node: str) -> None:
         with self._lock:
@@ -932,7 +946,8 @@ class Router:
         self._check_active()
         info = self._placed_info(key)
         if info is not None:
-            member = self._members.get(info["node"])
+            with self._lock:
+                member = self._members.get(info["node"])
             if member is not None and member.up:
                 return member
         member = self._owner_for(key)
@@ -1005,7 +1020,8 @@ class Router:
         effects, same as every failover resubmit)."""
         spec = None
         for name, path in (self.journals or {}).items():
-            member = self._members.get(name)
+            with self._lock:
+                member = self._members.get(name)
             if member is not None and member.up:
                 continue  # live members already answered the sweep
             try:
@@ -1013,8 +1029,12 @@ class Router:
             except (OSError, ValueError):
                 continue
             for rec in jobs.values():
+                # terminal records are answered from the journal instead
+                # (resubmitting one would re-run a finished job just to
+                # satisfy a status poll)
                 if rec.get("key") == key and rec.get("spec") \
-                        and not rec.get("adopted"):
+                        and not rec.get("adopted") \
+                        and rec.get("state") not in ("done", "failed"):
                     spec = dict(rec["spec"])
                     break
             if spec is not None:
@@ -1034,6 +1054,48 @@ class Router:
         print(f"route: recovered key {key} from a down member's journal; "
               f"resubmitted to {owner.name}", file=sys.stderr, flush=True)
         return True
+
+    def _journal_answer(self, key: str) -> dict | None:
+        """Terminal fallback after both the sweep and the resubmit miss:
+        a ``done``/``failed`` record in a down member's journal is
+        authoritative — the outputs are already durable on the shared
+        filesystem — so answer the keyed poll from it.  Without this, a
+        job that finished *before* its node was perm-killed and adopted
+        is unresolvable until the zombie returns: adoption resubmits
+        only non-terminal jobs, and the tombstone makes the resubmit
+        path skip the record entirely."""
+        for name, path in (self.journals or {}).items():
+            with self._lock:
+                member = self._members.get(name)
+            if member is not None and member.up:
+                continue  # live members already answered the sweep
+            try:
+                jobs, _info = journal_mod.replay(path)
+            except (OSError, ValueError):
+                continue
+            for rec in jobs.values():
+                if rec.get("key") != key \
+                        or rec.get("state") not in ("done", "failed"):
+                    continue
+                spec = rec.get("spec") or {}
+                self.counters.add("route_journal_answers", 1)
+                print(f"route: answered keyed poll {key} from {name}'s "
+                      f"journal (terminal state '{rec['state']}', node "
+                      "down)", file=sys.stderr, flush=True)
+                return {"ok": True, "job": {
+                    "job_id": rec.get("id"), "key": key,
+                    "state": rec["state"], "error": rec.get("error"),
+                    "outputs": rec.get("outputs"),
+                    "wall_s": rec.get("wall_s"),
+                    "attempts": rec.get("attempts"),
+                    "gang_size": rec.get("gang_size"),
+                    "input": spec.get("input"),
+                    "deadline_s": rec.get("deadline_s"),
+                    "trace_id": rec.get("trace_id"),
+                    "tenant": spec.get("tenant"),
+                    "qos": spec.get("qos"),
+                }}
+        return None
 
     def _keyed(self, req: dict) -> str:
         key = req.get("key")
@@ -1057,6 +1119,9 @@ class Router:
                     if self._locate_sweep(key, skip=member.name) is not None \
                             or self._journal_resubmit(key):
                         continue
+                    answer = self._journal_answer(key)
+                    if answer is not None:
+                        return answer
                 if not e.reply.get("transport") or member.name in tried:
                     raise
                 tried.add(member.name)  # one failover hop per member
@@ -1092,6 +1157,9 @@ class Router:
                     if self._locate_sweep(key, skip=member.name) is not None \
                             or self._journal_resubmit(key):
                         continue
+                    answer = self._journal_answer(key)
+                    if answer is not None:
+                        return answer
                 if e.reply.get("timeout") or e.reply.get("shutdown") \
                         or e.reply.get("transport"):
                     continue  # next slice (possibly on a new owner)
@@ -1105,8 +1173,11 @@ class Router:
     def drain(self, timeout: float | None = None, node: str | None = None):
         """Drain one member (``node``) or the whole fleet (admission off
         everywhere first, then every member drains in parallel)."""
-        targets = ([self._members[node]] if node
-                   else list(self.members()))
+        if node:
+            with self._lock:
+                targets = [self._members[node]]
+        else:
+            targets = list(self.members())
         if node is None:
             self.stop_admission()
         errors: dict[str, str] = {}

@@ -574,6 +574,9 @@ class Scheduler:
             obs_metrics.inc("tenant_jobs_admitted",
                             tenant=job.tenant, qos=job.qos)
             self._cond.notify_all()
+        # schedule point at the ack boundary: everything durable happened
+        # under the lock above; the caller's acknowledgement is next
+        sanitize.yield_point("serve.ack")
         return job, True
 
     # -------------------------------------------------- per-class queues
@@ -662,7 +665,8 @@ class Scheduler:
         obs_flight.dump(reason="shed")
 
     def get(self, job_id: int) -> Job | None:
-        return self._jobs.get(int(job_id))
+        with self._cond:
+            return self._jobs.get(int(job_id))
 
     def lookup(self, job_id=None, key: str | None = None):
         """Resolve a job by id or idempotency key, including evicted ones.
@@ -688,9 +692,9 @@ class Scheduler:
 
     def wait(self, job_id: int, timeout: float | None = None) -> Job:
         """Block until the job reaches a terminal state (or timeout)."""
-        job = self._jobs[int(job_id)]
         deadline = None if timeout is None else time.monotonic() + timeout
         with self._cond:
+            job = self._jobs[int(job_id)]
             while job.state not in ("done", "failed"):
                 remaining = None
                 if deadline is not None:
@@ -716,7 +720,7 @@ class Scheduler:
             faults.fault_point("route.fence")
         except faults.FaultError as e:
             self.counters.add("fencing_rejections")
-            raise RouterFenced(self._fence_epoch, f"injected: {e}")
+            raise RouterFenced(self.fence_epoch, f"injected: {e}")
         try:
             epoch = int(epoch)
         except (TypeError, ValueError):
@@ -1011,7 +1015,7 @@ class Scheduler:
         return min(ready,
                    key=lambda qos: (self._pass[qos], QOS_CLASSES.index(qos)))
 
-    def _pop_gang(self) -> list[Job]:
+    def _pop_gang_locked(self) -> list[Job]:
         """Pop up to ``gang_size`` queued jobs sharing the compile-time
         consensus parameters (cutoff/qualscore) from the stride-chosen qos
         class (gangs never span classes — fairness accounting stays
@@ -1044,7 +1048,7 @@ class Scheduler:
                     self._cond.wait()
                 if self._stop:
                     return
-                gang = self._pop_gang()
+                gang = self._pop_gang_locked()
                 now = time.monotonic()
                 live = []
                 for job in gang:

@@ -63,7 +63,7 @@ from consensuscruncher_tpu.obs.metrics import render_prometheus
 from consensuscruncher_tpu.serve.scheduler import (
     AdmissionRefused, DeadlineShed, QuotaRefused, RouterFenced, Scheduler,
 )
-from consensuscruncher_tpu.utils import faults
+from consensuscruncher_tpu.utils import faults, sanitize
 
 MAX_LINE = 1 << 20  # 1 MiB per request line; specs are tiny
 
@@ -95,7 +95,7 @@ class ServeServer:
         self._accept_thread: threading.Thread | None = None
         # bounded registry of live connection handlers: close() joins them
         # so shutdown cannot leak a socket mid-reply
-        self._conn_lock = threading.Lock()
+        self._conn_lock = sanitize.tracked_lock("server.conns")
         self._conns: dict[int, tuple[socket.socket, threading.Thread]] = {}
         self._next_conn = 0
 

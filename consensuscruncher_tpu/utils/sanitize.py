@@ -163,6 +163,7 @@ def sync_probe(site: str) -> None:
     ``jax.device_get`` right here — under ``CCT_SANITIZE=1`` inside a
     guarded stage that raises :class:`StageTransferError`; otherwise it is
     a harmless no-op sync.  Unarmed cost: two dict lookups."""
+    yield_point(site)
     from . import faults
 
     if faults.fire(site) is None:
@@ -170,6 +171,38 @@ def sync_probe(site: str) -> None:
     import jax
 
     jax.device_get(0)
+
+
+# --------------------------------------------------------- interleave hooks
+#
+# The deterministic model checker (``utils/interleave.py``) drives real
+# threads through one-at-a-time cooperative scheduling.  Its yield points
+# are exactly the operations this module already wraps: TrackedLock /
+# TrackedCondition acquire+release, ``sync_probe`` sites, and explicit
+# ``yield_point`` calls on the serve plane's protocol boundaries.  The
+# hook is process-global but must ignore threads it does not manage —
+# that filtering is the hook object's job, so unmanaged production
+# threads pay only a None check.
+
+_interleave_hook = None
+
+
+def set_interleave_hook(hook) -> None:
+    """Install (or clear, with ``None``) the cooperative scheduler hook.
+    The hook sees ``before_acquire(name, lock)`` / ``after_release(name,
+    lock)`` around every tracked lock operation, ``on_wait(name, cond)``
+    before a condition wait, and ``yield_point(tag)`` at explicit sites."""
+    global _interleave_hook
+    _interleave_hook = hook
+
+
+def yield_point(tag: str) -> None:
+    """A schedule point for the model checker; no-op outside model runs.
+    Placed where the serve protocol's ordering matters but no lock edge
+    exists (journal replay reads, ack boundaries, view scans)."""
+    h = _interleave_hook
+    if h is not None:
+        h.yield_point(tag)
 
 
 # ------------------------------------------------------------ lock tracking
@@ -230,6 +263,9 @@ class TrackedLock:
         self._lock = factory()
 
     def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        h = _interleave_hook
+        if h is not None:
+            h.before_acquire(self._name, self)
         _note_acquire(self._name)
         ok = self._lock.acquire(blocking, timeout)
         if not ok:
@@ -239,6 +275,9 @@ class TrackedLock:
     def release(self) -> None:
         self._lock.release()
         _note_release(self._name)
+        h = _interleave_hook
+        if h is not None:
+            h.after_release(self._name, self)
 
     def locked(self) -> bool:
         return self._lock.locked()
@@ -262,14 +301,23 @@ class TrackedCondition:
         self._cond = threading.Condition()
 
     def acquire(self, *args) -> bool:
+        h = _interleave_hook
+        if h is not None:
+            h.before_acquire(self._name, self)
         _note_acquire(self._name)
         return self._cond.acquire(*args)
 
     def release(self) -> None:
         self._cond.release()
         _note_release(self._name)
+        h = _interleave_hook
+        if h is not None:
+            h.after_release(self._name, self)
 
     def wait(self, timeout: float | None = None) -> bool:
+        h = _interleave_hook
+        if h is not None:
+            h.on_wait(self._name, self)
         _note_release(self._name)
         try:
             return self._cond.wait(timeout)
@@ -277,6 +325,9 @@ class TrackedCondition:
             _note_acquire(self._name, check=False)
 
     def wait_for(self, predicate, timeout: float | None = None):
+        h = _interleave_hook
+        if h is not None:
+            h.on_wait(self._name, self)
         _note_release(self._name)
         try:
             return self._cond.wait_for(predicate, timeout)

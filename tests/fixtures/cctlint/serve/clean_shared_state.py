@@ -1,0 +1,33 @@
+"""Lock-disciplined twin of ``viol_shared_state.py``: zero CCT8xx findings.
+
+Not importable production code — a lint fixture exercised by
+``tests/test_lint_clean.py``.
+"""
+
+import threading
+
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._jobs = {}
+        self._epoch = 0
+
+    def admit_locked(self, jid, job):
+        self._jobs[jid] = job
+
+    def bump(self, epoch):
+        with self._lock:
+            self._epoch = epoch
+
+    def guarded_write(self, jid, job):
+        with self._lock:
+            self._jobs[jid] = job
+
+    def guarded_read(self):
+        with self._lock:
+            return self._epoch
+
+    def guarded_helper_call(self, jid, job):
+        with self._lock:
+            self.admit_locked(jid, job)

@@ -1,0 +1,45 @@
+"""Seeded protocol-typestate violations; every CCT7xx rule must fire here.
+
+Not importable production code — a lint fixture exercised by
+``tests/test_lint_clean.py``.
+"""
+
+import os
+
+
+def undeclared_job_state(journal, job):
+    # CCT701: "enqueued" is not a declared journal state
+    journal.append_job(job.id, "enqueued", key=job.key)
+
+
+def undeclared_runtime_state(job):
+    # CCT701: "zombie" is not a declared runtime state
+    job.state = "zombie"
+
+
+def undeclared_marker(journal):
+    # CCT702: "checkpointed" is not a declared marker kind
+    journal.append_marker("checkpointed", epoch=3)
+
+
+def undeclared_reply_key():
+    # CCT703: "debug_blob" is not part of the wire reply vocabulary
+    return {"ok": True, "debug_blob": {"internal": 1}}
+
+
+def terminal_state_rewrite(journal, jid):
+    journal.append_job(jid, "done", outputs={})
+    # CCT704: done is absorbing; rewriting it corrupts replay
+    journal.append_job(jid, "accepted")
+
+
+def write_without_fsync(fd, payload):
+    # CCT705: raw durable write with no fsync before returning
+    os.write(fd, payload)
+
+
+def ack_before_append(journal, cond, job):
+    with cond:
+        # CCT705: acknowledging waiters before the record is durable
+        cond.notify_all()
+        journal.append_job(job.id, "accepted", key=job.key)

@@ -1,0 +1,36 @@
+"""Seeded lock-domain violations; every CCT8xx rule must fire here.
+
+Not importable production code — a lint fixture exercised by
+``tests/test_lint_clean.py``.
+"""
+
+import threading
+
+
+class Registry:
+    """Owns ``_lock``; ``_jobs`` and ``_epoch`` are inferred into its
+    domain by the locked writes below."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._jobs = {}
+        self._epoch = 0
+
+    def admit_locked(self, jid, job):
+        self._jobs[jid] = job
+
+    def bump(self, epoch):
+        with self._lock:
+            self._epoch = epoch
+
+    def racy_write(self, jid, job):
+        # CCT801: domain write with the lock not held
+        self._jobs[jid] = job
+
+    def racy_read(self):
+        # CCT802: domain read with the lock not held
+        return self._epoch
+
+    def racy_helper_call(self, jid, job):
+        # CCT803: _locked helper invoked without owning the lock
+        self.admit_locked(jid, job)

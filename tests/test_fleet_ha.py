@@ -92,6 +92,31 @@ def test_ring_view_compacts_to_highest_epoch(tmp_path):
     assert rv.load()["epoch"] == 8
 
 
+def test_chaos_view_publish_fault_keeps_membership_live(tmp_path,
+                                                        monkeypatch):
+    """Arm ``route.view_publish=fail@1``: a membership change whose
+    ring-view publish dies stays live in-memory (routing never depends
+    on the doc), the epoch bump is kept, and the next successful publish
+    re-advertises the newest membership under that higher epoch."""
+    rv_path = str(tmp_path / "ring.view")
+    router = Router([("n0", "n0")], start_monitor=False, ring_view=rv_path,
+                    router_id="rA", client_factory=lambda addr: None)
+    base_epoch = router.epoch
+    assert RingView(rv_path).load()["epoch"] == base_epoch
+    monkeypatch.setenv("CCT_FAULTS", "route.view_publish=fail@1")
+    out = router.member_add("n1", "n1")
+    monkeypatch.delenv("CCT_FAULTS")
+    assert out["fleet_size"] == 2            # the change is live...
+    assert router._member("n1") is not None
+    assert router.epoch == base_epoch + 1    # ...and the epoch bump kept
+    assert RingView(rv_path).load()["epoch"] == base_epoch  # doc is stale
+    # the next (disarmed) publish carries the newest membership forward
+    router.member_add("n2", "n2")
+    doc = RingView(rv_path).load()
+    assert doc["epoch"] == router.epoch == base_epoch + 2
+    assert sorted(m[0] for m in doc["members"]) == ["n0", "n1", "n2"]
+
+
 def test_ring_view_torn_write_recovers_at_every_byte(tmp_path):
     """The ring-view doc carries the fleet's epoch authority, so it gets
     the same torn-write proof as the job journal: truncate the file at
@@ -527,6 +552,54 @@ def test_unknown_key_recovers_spec_from_down_members_journal(tmp_path):
     # resolvable from now on without another recovery
     assert router.status({"key": key})["ok"] is True
     assert router.counters.snapshot()["route_resubmits"] == 1
+
+
+def test_keyed_poll_answers_terminal_job_from_adopted_journal(tmp_path):
+    """A job that finished *before* its node was perm-killed and adopted
+    has nothing to resubmit (terminal records are skipped by adoption)
+    and, after the tombstone, nothing the spec-recovery path will touch
+    either — yet the key was acked and the outputs are durable on disk.
+    The keyed poll must answer from the down member's journal record
+    instead of raising unknown-job until the zombie returns (the chaos
+    conductor's status sweeps hit exactly this interleaving)."""
+    fleet = _LocateStubFleet(["n0", "n1", "n2"])
+    spec = _spec(tmp_path / "finished")
+    key = idempotency_key(spec)
+    jp = str(tmp_path / "n1.journal")
+    j = Journal(jp)
+    j.append_job(7, "accepted", key=key, spec=spec)
+    j.append_job(7, "dispatched")
+    j.append_job(7, "done", outputs={"base": str(tmp_path / "finished")},
+                 wall_s=1.5)
+    j.append_marker("adopted", router="rX", epoch=3)  # tombstoned
+    j.close()
+    router = Router([(n, n) for n in fleet.nodes], start_monitor=False,
+                    down_after=1, journals={"n1": jp},
+                    client_factory=fleet.client)
+    fleet.nodes["n1"]["dead"] = True
+    router.probe_members()
+    assert not router._member("n1").up
+    for op in (router.status, router.result):
+        reply = op({"key": key})
+        assert reply["ok"] is True
+        assert reply["job"]["state"] == "done"
+        assert reply["job"]["key"] == key
+        assert reply["job"]["outputs"] == {
+            "base": str(tmp_path / "finished")}
+    assert router.counters.snapshot()["route_journal_answers"] == 2
+    # nothing was resubmitted: terminal jobs never re-run on a successor
+    assert router.counters.snapshot()["route_resubmits"] == 0
+    assert all(key not in node["jobs"] for node in fleet.nodes.values())
+    # a failed job answers the same way (error surfaces to the poller)
+    spec2 = _spec(tmp_path / "crashed")
+    key2 = idempotency_key(spec2)
+    j = Journal(jp)
+    j.append_job(8, "accepted", key=key2, spec=spec2)
+    j.append_job(8, "failed", error="worker died")
+    j.close()
+    reply = router.status({"key": key2})
+    assert reply["ok"] is True and reply["job"]["state"] == "failed"
+    assert reply["job"]["error"] == "worker died"
 
 
 # ------------------------------------------------------- client rotation

@@ -72,7 +72,7 @@ def test_stride_dispatch_follows_class_weights():
     order = []
     with sched._cond:
         while sched._any_queued_locked():
-            order.append(sched._pop_gang()[0].qos)
+            order.append(sched._pop_gang_locked()[0].qos)
     assert len(order) == 16
     # every class-weight window of 4 dispatches serves interactive twice
     for w in range(0, 8, 4):
@@ -91,14 +91,14 @@ def test_idle_class_gets_no_banked_credit():
         sched.submit(_spec(i, qos="batch"))
     with sched._cond:
         for _ in range(4):
-            sched._pop_gang()
+            sched._pop_gang_locked()
     # interactive arrives late; equal weights -> alternate, not a burst
     for i in range(10, 14):
         sched.submit(_spec(i, qos="interactive"))
     order = []
     with sched._cond:
         while sched._any_queued_locked():
-            order.append(sched._pop_gang()[0].qos)
+            order.append(sched._pop_gang_locked()[0].qos)
     assert order[:4] in (["interactive", "batch", "interactive", "batch"],
                          ["batch", "interactive", "batch", "interactive"])
 

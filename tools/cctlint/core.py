@@ -22,8 +22,11 @@ PRAGMA_FAMILY = {
     "CCT2": "nondet",
     "CCT4": "lock",
     "CCT5": "jit",
-    # CCT3 (fault coverage) has no pragma on purpose: an unregistered or
-    # untested site is fixed by registering/testing it, never by waiving it.
+    "CCT7": "protocol",
+    "CCT8": "shared-state",
+    # CCT3 (fault coverage) and CCT6 (metric registry) have no pragma on
+    # purpose: an unregistered or untested site is fixed by registering/
+    # testing it, never by waiving it.
 }
 
 KNOWN_PRAGMAS = frozenset(PRAGMA_FAMILY.values())
@@ -169,7 +172,8 @@ def _pragma_findings(files: list[SourceFile]) -> list[Finding]:
 def all_passes():
     """Name -> pass callable.  Imported lazily so a syntax error in one pass
     module doesn't take down the others during development."""
-    from . import determinism, faultcov, hostsync, jitdisc, locks, obscov
+    from . import (determinism, faultcov, hostsync, jitdisc, locks, obscov,
+                   protocol, shared_state)
 
     return {
         "hostsync": hostsync.run,
@@ -178,6 +182,8 @@ def all_passes():
         "locks": locks.run,
         "jitdisc": jitdisc.run,
         "obscov": obscov.run,
+        "protocol": protocol.run,
+        "shared_state": shared_state.run,
     }
 
 

@@ -74,4 +74,9 @@ FAULT_SITES: dict[str, str] = {
     "route.fence": "worker-side epoch admission rejects the forward -> "
                    "the sending router sees fenced:true and demotes "
                    "itself (no zombie-router double-dispatch)",
+    "route.view_publish": "ring-view publish after a membership change "
+                          "fails -> the change stays live in-memory and "
+                          "the bumped epoch rides the next successful "
+                          "publish (standby visibility degrades, routing "
+                          "never does)",
 }

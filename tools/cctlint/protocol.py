@@ -1,0 +1,208 @@
+"""Pass 7 — protocol typestate verification over serve/ (CCT7xx).
+
+The serve plane's correctness story is a set of closed vocabularies and
+orderings declared in :mod:`tools.cctlint.protocols`: journal job states
+and their legal successions, marker kinds, the ring-view grammar, and
+the NDJSON wire reply key set.  Those contracts used to live only in
+docstrings and chaos tests; this pass makes every *literal* the code
+writes provably in-vocabulary:
+
+CCT701  a journal job state literal (``append_job``/``job_record``
+        argument) outside the declared ``JOURNAL_STATES``, or a
+        ``<obj>.state = "..."`` assignment outside ``RUNTIME_STATES`` —
+        an undeclared state silently poisons replay and fence recovery.
+CCT702  an ``append_marker`` kind literal outside ``MARKER_KINDS`` —
+        unknown markers are dropped by replay, so the event never
+        happened durably.
+CCT703  a reply-shaped dict literal (one carrying an ``"ok"`` key) with
+        a literal key outside ``WIRE_REPLY_KEYS`` — clients dispatch on
+        reply keys; an undeclared key is an untestable side channel.
+CCT704  two journal appends for the same target in one function whose
+        literal states form an illegal succession per
+        ``JOURNAL_TRANSITIONS`` (e.g. rewriting a terminal state).
+CCT705  durability ordering: a raw ``os.write`` with no later
+        ``os.fsync`` in the same function, or an acknowledgement call
+        (``notify_all``/``sendall``/``_reply``) lexically before the
+        first journal append in a function that does both — the journal
+        contract is *fsync before ack*, never the reverse.
+
+Scope: files under a ``serve/`` directory (the protocol only exists
+there).  Suppress intended deviations with
+``# cct: allow-protocol(reason)``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from . import protocols
+from .core import Finding, LintContext, SourceFile, call_name, terminal_name
+
+JOB_APPEND_TERMINALS = {"append_job", "job_record"}
+ACK_TERMINALS = {"notify_all", "sendall", "_reply"}
+
+
+def _literal_str(node: ast.AST | None) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _call_arg(node: ast.Call, index: int, keyword: str) -> ast.AST | None:
+    """Positional-or-keyword argument lookup on a call node."""
+    for kw in node.keywords:
+        if kw.arg == keyword:
+            return kw.value
+    if len(node.args) > index:
+        return node.args[index]
+    return None
+
+
+def _is_journal_append(node: ast.Call) -> bool:
+    term = terminal_name(node)
+    if term in JOB_APPEND_TERMINALS or term == "append_marker":
+        return True
+    # ``<...>journal.append(record)`` — the raw form; plain list.append
+    # everywhere else must not match.
+    return term == "append" and "journal" in call_name(node).lower()
+
+
+def _check_states_and_markers(src: SourceFile, findings: list[Finding]) -> None:
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.Call):
+            term = terminal_name(node)
+            if term in JOB_APPEND_TERMINALS:
+                state = _literal_str(_call_arg(node, 1, "state"))
+                if state is not None and state not in protocols.JOURNAL_STATES:
+                    findings.append(Finding(
+                        "CCT701", src.rel, node.lineno,
+                        f"journal job state {state!r} is not declared in "
+                        f"protocols.JOURNAL_STATES "
+                        f"{tuple(protocols.JOURNAL_STATES)} — replay and "
+                        "fence recovery drop unknown states", "protocol"))
+            elif term == "append_marker":
+                kind = _literal_str(_call_arg(node, 0, "kind"))
+                if kind is not None and kind not in protocols.MARKER_KINDS:
+                    findings.append(Finding(
+                        "CCT702", src.rel, node.lineno,
+                        f"journal marker kind {kind!r} is not declared in "
+                        f"protocols.MARKER_KINDS "
+                        f"{tuple(protocols.MARKER_KINDS)} — replay ignores "
+                        "unknown markers, so the event is not durable",
+                        "protocol"))
+        elif isinstance(node, ast.Assign):
+            for target in node.targets:
+                if isinstance(target, ast.Attribute) and \
+                        target.attr == "state":
+                    state = _literal_str(node.value)
+                    if state is not None and \
+                            state not in protocols.RUNTIME_STATES:
+                        findings.append(Finding(
+                            "CCT701", src.rel, node.lineno,
+                            f"runtime job state {state!r} is not declared "
+                            f"in protocols.RUNTIME_STATES "
+                            f"{tuple(protocols.RUNTIME_STATES)}",
+                            "protocol"))
+
+
+def _check_reply_dicts(src: SourceFile, findings: list[Finding]) -> None:
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.Dict):
+            continue
+        keys = [_literal_str(k) for k in node.keys if k is not None]
+        if "ok" not in keys:
+            continue
+        for key in keys:
+            if key is not None and key not in protocols.WIRE_REPLY_KEYS:
+                findings.append(Finding(
+                    "CCT703", src.rel, node.lineno,
+                    f"wire reply key {key!r} is not declared in "
+                    "protocols.WIRE_REPLY_KEYS — clients dispatch on reply "
+                    "keys, so every key must be a declared part of the "
+                    "protocol", "protocol"))
+
+
+def _function_nodes(tree: ast.AST):
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def _check_transitions(src: SourceFile, findings: list[Finding]) -> None:
+    """CCT704: within one function, consecutive literal-state journal
+    appends for the same target must be a legal succession."""
+    for fn in _function_nodes(src.tree):
+        appended: dict[str, tuple[str, int]] = {}
+        for node in ast.walk(fn):
+            if not (isinstance(node, ast.Call) and
+                    terminal_name(node) in JOB_APPEND_TERMINALS):
+                continue
+            target = _call_arg(node, 0, "job_id")
+            state = _literal_str(_call_arg(node, 1, "state"))
+            if target is None or state is None:
+                continue
+            tkey = ast.dump(target)
+            prev = appended.get(tkey)
+            if prev is not None:
+                err = protocols.validate_transition(prev[0], state)
+                if err:
+                    findings.append(Finding(
+                        "CCT704", src.rel, node.lineno,
+                        f"{err} (previous append at line {prev[1]}) — "
+                        "terminal journal states must never be rewritten",
+                        "protocol"))
+            appended[tkey] = (state, node.lineno)
+
+
+def _check_ordering(src: SourceFile, findings: list[Finding]) -> None:
+    """CCT705: fsync-before-ack.  Two lexical orderings per function:
+    every raw ``os.write`` needs a later ``os.fsync``, and no ack call
+    may precede the first journal append when a function does both."""
+    for fn in _function_nodes(src.tree):
+        writes: list[int] = []
+        fsyncs: list[int] = []
+        appends: list[int] = []
+        acks: list[tuple[int, str]] = []
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            if name == "os.write":
+                writes.append(node.lineno)
+            elif name == "os.fsync":
+                fsyncs.append(node.lineno)
+            if _is_journal_append(node):
+                appends.append(node.lineno)
+            elif terminal_name(node) in ACK_TERMINALS:
+                acks.append((node.lineno, terminal_name(node)))
+        for line in writes:
+            if not any(f >= line for f in fsyncs):
+                findings.append(Finding(
+                    "CCT705", src.rel, line,
+                    "os.write of a durable record with no following "
+                    "os.fsync in this function — an acknowledged record "
+                    "must be on disk before control leaves the append path",
+                    "protocol"))
+        if appends and acks:
+            first_append = min(appends)
+            for line, term in sorted(acks):
+                if line < first_append:
+                    findings.append(Finding(
+                        "CCT705", src.rel, line,
+                        f"acknowledgement call '{term}' precedes the first "
+                        f"journal append (line {first_append}) — the "
+                        "protocol is journal+fsync strictly before ack, "
+                        "or a crash acks work that never became durable",
+                        "protocol"))
+
+
+def run(ctx: LintContext) -> list[Finding]:
+    findings: list[Finding] = []
+    for src in ctx.parsed():
+        if not src.in_dirs("serve"):
+            continue
+        _check_states_and_markers(src, findings)
+        _check_reply_dicts(src, findings)
+        _check_transitions(src, findings)
+        _check_ordering(src, findings)
+    return findings

@@ -1,0 +1,168 @@
+"""Declared protocol invariants for the serve plane — the single source
+of truth shared by THREE consumers:
+
+- the ``protocol`` cctlint pass (CCT701-705) checks every literal the
+  serve/ code writes (journal states, marker kinds, wire reply keys)
+  against these tables *statically*;
+- ``tools/model_check.py`` asserts the same tables *dynamically* over
+  every explored interleaving (record grammar, transition legality,
+  epoch monotonicity, exactly-once ack);
+- tests import it so fixtures and assertions can never drift from the
+  checked vocabulary.
+
+Like ``obs/registry.py`` this module is pure data + tiny pure helpers
+with ZERO imports: the lint pass loads it standalone via
+``importlib.util.spec_from_file_location`` without the package on
+sys.path, and the model checker imports it from a live process.  To
+teach the daemon a new record type, state, or reply field, add it here
+first — an undeclared literal anywhere in serve/ is a lint error.
+"""
+
+# ---------------------------------------------------------- journal ----
+#
+# Every journal line is a JSON object with ``{"v": 1, "rec": ...}``.
+# ``rec: "job"`` records carry the durable job lifecycle; ``rec:
+# "marker"`` records carry whole-journal events (drain boundaries,
+# adoption tombstones, fence floors).
+
+JOURNAL_REC_TYPES = ("job", "marker")
+
+# States a *journal* job record may carry.  The in-memory Job object has
+# its own (finer) state set; the rotation snapshot maps queued->accepted
+# and running->dispatched so the durable vocabulary stays closed.
+JOURNAL_STATES = ("accepted", "dispatched", "done", "failed")
+
+# States the in-memory Job/scheduler layer may assign (``job.state = X``
+# or status replies).  ``expired`` only appears in replies for evicted
+# jobs, never in the journal.
+RUNTIME_STATES = ("queued", "running", "done", "failed", "expired")
+
+# runtime -> journal state mapping used by rotation snapshots + replay.
+RUNTIME_TO_JOURNAL = {"queued": "accepted", "running": "dispatched"}
+
+# Terminal journal states: once written for a job id, no later record
+# may move that id to a *different* state ("no terminal-state rewrite").
+TERMINAL_STATES = ("done", "failed")
+
+# Legal journal-state successions per job id.  Self-loops are legal
+# everywhere non-terminal (rotation snapshots and replay re-appends
+# rewrite the same state); ``dispatched -> accepted`` is legal because a
+# crash before the gang finished demotes the job back to the queue and
+# the next rotation snapshots it as accepted again.
+JOURNAL_TRANSITIONS = {
+    "accepted": ("accepted", "dispatched", "done", "failed"),
+    "dispatched": ("accepted", "dispatched", "done", "failed"),
+    "done": ("done",),
+    "failed": ("failed",),
+}
+
+# Marker kinds (``rec: "marker"``): drain boundary, adoption tombstone
+# (router resubmitted every non-terminal job elsewhere), fence floor.
+MARKER_KINDS = ("drain", "adopted", "fence")
+
+# ---------------------------------------------------------- ring view --
+#
+# The ring-view doc is an append-only NDJSON file of epoch-numbered
+# membership records; readers take the max epoch.  ``journals`` is
+# optional (members' journal paths for adoption).
+
+RING_VIEW_REQUIRED = ("v", "epoch", "router", "address", "members", "t")
+RING_VIEW_OPTIONAL = ("journals",)
+
+# ---------------------------------------------------------- wire -------
+#
+# Every NDJSON reply key either side of the serve protocol may emit.
+# CCT703 flags any literal key outside this set in a reply-shaped dict
+# (one that carries an ``ok`` key) anywhere under serve/.
+
+WIRE_REPLY_KEYS = frozenset({
+    # envelope
+    "ok", "error",
+    # admission / flow-control verdicts
+    "busy", "refused", "shed", "quota", "duplicate",
+    # fencing / fleet role
+    "fenced", "epoch", "standby", "router",
+    # transport / lifecycle verdicts
+    "unknown", "timeout", "shutdown", "transport", "bad_request",
+    # payloads
+    "job", "job_id", "state", "key", "health", "metrics", "prometheus",
+    # router ops
+    "drained", "errors", "adopted", "jobs_adopted", "keys",
+    "node", "address", "node_address", "stolen", "fleet_size",
+})
+
+# ---------------------------------------------------------- helpers ----
+#
+# Pure, import-free validators shared by the lint pass's standalone load
+# and the model checker's runtime assertions.  Each returns ``None`` on
+# success or a human-readable violation string.
+
+
+def validate_transition(old, new):
+    """Is ``old -> new`` a legal journal-state succession for one id?"""
+    if old not in JOURNAL_TRANSITIONS:
+        return f"unknown journal state {old!r}"
+    if new not in JOURNAL_TRANSITIONS:
+        return f"unknown journal state {new!r}"
+    if new not in JOURNAL_TRANSITIONS[old]:
+        return f"illegal journal transition {old!r} -> {new!r}"
+    return None
+
+
+def check_state_sequence(states):
+    """Validate a whole per-id record sequence; first violation or None."""
+    prev = None
+    for state in states:
+        if prev is None:
+            if state not in JOURNAL_TRANSITIONS:
+                return f"unknown journal state {state!r}"
+        else:
+            err = validate_transition(prev, state)
+            if err:
+                return err
+        prev = state
+    return None
+
+
+def validate_journal_record(rec):
+    """Grammar-check one parsed journal line (job or marker record)."""
+    if not isinstance(rec, dict):
+        return "journal record is not an object"
+    if rec.get("v") != 1:
+        return f"unknown journal record version {rec.get('v')!r}"
+    kind = rec.get("rec")
+    if kind not in JOURNAL_REC_TYPES:
+        return f"unknown journal record type {kind!r}"
+    if kind == "job":
+        if not isinstance(rec.get("id"), int):
+            return "job record without an integer id"
+        if rec.get("state") not in JOURNAL_STATES:
+            return f"job record with unknown state {rec.get('state')!r}"
+    else:
+        if rec.get("kind") not in MARKER_KINDS:
+            return f"marker record with unknown kind {rec.get('kind')!r}"
+    return None
+
+
+def validate_ring_record(rec):
+    """Grammar-check one parsed ring-view line."""
+    if not isinstance(rec, dict):
+        return "ring-view record is not an object"
+    for field in RING_VIEW_REQUIRED:
+        if field not in rec:
+            return f"ring-view record missing {field!r}"
+    extra = [k for k in rec
+             if k not in RING_VIEW_REQUIRED and k not in RING_VIEW_OPTIONAL]
+    if extra:
+        return f"ring-view record with undeclared fields {sorted(extra)!r}"
+    if not isinstance(rec.get("epoch"), int) or rec["epoch"] < 1:
+        return f"ring-view record with bad epoch {rec.get('epoch')!r}"
+    return None
+
+
+def validate_reply_keys(doc):
+    """Unknown top-level keys in a wire reply doc (empty list = clean)."""
+    if not isinstance(doc, dict):
+        return ["reply is not an object"]
+    return [f"undeclared wire reply key {k!r}"
+            for k in doc if k not in WIRE_REPLY_KEYS]

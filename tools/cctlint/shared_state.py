@@ -1,0 +1,204 @@
+"""Pass 8 — shared-state lock-domain inference over serve/ (CCT8xx).
+
+The locks pass (CCT4xx) proves acquisition *ordering*; this pass proves
+*coverage*: every attribute a class mutates under its TrackedLock is
+that lock's domain, and touching domain state outside the lock is a
+data race regardless of how benign the interleaving looks today.
+
+Inference, per class in a serve/ file that constructs a lock attribute:
+
+- the class's locks are its lock-constructor attributes
+  (``self._cond = tracked_condition(...)``, class-level ``_id_lock``);
+- the lock *domain* is every ``self.X`` / ``Cls.X`` attribute written
+  (assignment, augmented assignment, ``del``, or subscript store)
+  either inside a ``with <class lock>:`` region or anywhere in a
+  method whose name ends in ``_locked`` (the codebase's convention for
+  caller-holds-the-lock helpers).  ``__init__`` is exempt — objects
+  under construction are unpublished — and lock attributes themselves
+  are excluded.
+
+Rules (checked in every method except ``__init__`` and ``*_locked``):
+
+CCT801  write to a domain attribute with no class lock held
+CCT802  read of a domain attribute with no class lock held
+CCT803  call to a ``*_locked`` method with no class lock held — the
+        suffix is a contract that the caller already owns the lock
+
+Known limits, on purpose: one level of with-nesting analysis only
+(nested function bodies execute later, outside the lock scope, and are
+skipped exactly like the locks pass); classes with several locks pool
+their domains (every class here owns exactly one).  Suppress intended
+cases with ``# cct: allow-shared-state(reason)``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, LintContext, SourceFile, terminal_name
+from .locks import _FileLocks
+
+
+def _class_locks(cls: ast.ClassDef, inv: _FileLocks) -> set[str]:
+    """Lock attributes this class itself constructs."""
+    out: set[str] = set()
+    for node in ast.walk(cls):
+        targets: list[ast.AST] = []
+        if isinstance(node, ast.Assign):
+            targets = list(node.targets)
+        elif isinstance(node, ast.AnnAssign):
+            targets = [node.target]
+        else:
+            continue
+        for tgt in targets:
+            if isinstance(tgt, ast.Attribute) and tgt.attr in inv.attr_locks:
+                out.add(tgt.attr)
+            elif isinstance(tgt, ast.Name) and tgt.id in inv.attr_locks:
+                out.add(tgt.id)  # class-level, e.g. Job._id_lock
+    return out
+
+
+def _own_attr(node: ast.AST, cls_name: str) -> str | None:
+    """``self.X`` / ``<ClassName>.X`` -> ``X``; anything else -> None."""
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name) \
+            and node.value.id in ("self", "cls", cls_name):
+        return node.attr
+    return None
+
+
+def _write_targets(node: ast.AST, cls_name: str) -> list[tuple[str, ast.AST]]:
+    """Own-attribute names written by this statement, with the consumed
+    Attribute nodes (so the read scan can skip them).  Handles direct
+    stores (``self.X = ...``), augmented stores, deletes, and container
+    mutation through a subscript (``self.X[k] = ...``)."""
+    targets: list[ast.AST] = []
+    if isinstance(node, ast.Assign):
+        targets = list(node.targets)
+    elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+        targets = [node.target]
+    elif isinstance(node, ast.Delete):
+        targets = list(node.targets)
+    out: list[tuple[str, ast.AST]] = []
+    for tgt in targets:
+        base = tgt
+        while isinstance(base, ast.Subscript):
+            base = base.value
+        attr = _own_attr(base, cls_name)
+        if attr is not None:
+            out.append((attr, base))
+    return out
+
+
+class _ClassModel:
+    """Domain inference + check state for one class."""
+
+    def __init__(self, cls: ast.ClassDef, inv: _FileLocks):
+        self.cls = cls
+        self.inv = inv
+        self.locks = _class_locks(cls, inv)
+        self.methods = [n for n in cls.body
+                        if isinstance(n, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef))]
+        self.domain: set[str] = set()
+        for fn in self.methods:
+            if fn.name == "__init__":
+                continue
+            self._infer(fn, held=fn.name.endswith("_locked"))
+        self.domain -= self.inv.attr_locks
+
+    def _is_class_lock(self, expr: ast.AST) -> bool:
+        lid = self.inv.lock_id(expr)
+        return lid is not None and lid in self.locks
+
+    def _infer(self, node: ast.AST, held: bool) -> None:
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            inner = held or any(self._is_class_lock(i.context_expr)
+                                for i in node.items)
+            for child in node.body:
+                self._infer(child, inner)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)) and node not in self.methods:
+            return  # nested defs execute later, outside this lock scope
+        if held:
+            for attr, _ in _write_targets(node, self.cls.name):
+                self.domain.add(attr)
+        for child in ast.iter_child_nodes(node):
+            self._infer(child, held)
+
+
+def _check_method(src: SourceFile, model: _ClassModel, fn: ast.AST,
+                  findings: list[Finding]) -> None:
+    consumed: set[int] = set()  # Attribute node ids already counted
+
+    def walk(node: ast.AST, held: bool) -> None:
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            inner = held or any(model._is_class_lock(i.context_expr)
+                                for i in node.items)
+            for item in node.items:
+                walk(item.context_expr, held)
+            for child in node.body:
+                walk(child, inner)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)) and node is not fn:
+            return  # nested defs execute later, outside this lock scope
+
+        for attr, base in _write_targets(node, model.cls.name):
+            consumed.add(id(base))
+            if attr in model.domain and not held:
+                findings.append(Finding(
+                    "CCT801", src.rel, node.lineno,
+                    f"write to '{attr}' outside its owning lock "
+                    f"({'/'.join(sorted(model.locks))}) — every other "
+                    f"write to it in {model.cls.name} is lock-protected",
+                    "shared_state"))
+
+        if isinstance(node, ast.Call):
+            term = terminal_name(node)
+            if term.endswith("_locked") and not held and \
+                    isinstance(node.func, ast.Attribute) and \
+                    _own_attr(node.func, model.cls.name) is not None:
+                findings.append(Finding(
+                    "CCT803", src.rel, node.lineno,
+                    f"'{term}' called without holding "
+                    f"{'/'.join(sorted(model.locks))} — the _locked "
+                    "suffix is a caller-holds-the-lock contract",
+                    "shared_state"))
+
+        if isinstance(node, ast.Attribute) and id(node) not in consumed and \
+                isinstance(node.ctx, ast.Load) and not held:
+            attr = _own_attr(node, model.cls.name)
+            if attr is not None and attr in model.domain:
+                findings.append(Finding(
+                    "CCT802", src.rel, node.lineno,
+                    f"read of '{attr}' outside its owning lock "
+                    f"({'/'.join(sorted(model.locks))}) — it is mutated "
+                    "under the lock, so unlocked readers see torn state",
+                    "shared_state"))
+
+        for child in ast.iter_child_nodes(node):
+            walk(child, held)
+
+    walk(fn, False)
+
+
+def run(ctx: LintContext) -> list[Finding]:
+    findings: list[Finding] = []
+    for src in ctx.parsed():
+        if not src.in_dirs("serve"):
+            continue
+        inv = _FileLocks(src)
+        if not inv.attr_locks:
+            continue
+        for cls in ast.walk(src.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            model = _ClassModel(cls, inv)
+            if not model.locks or not model.domain:
+                continue
+            for fn in model.methods:
+                if fn.name == "__init__" or fn.name.endswith("_locked"):
+                    continue
+                _check_method(src, model, fn, findings)
+    return findings

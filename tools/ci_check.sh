@@ -12,6 +12,20 @@ cd "$REPO"
 echo "== cctlint (all passes, incl. obscov CCT601-603) =="
 PYTHONPATH="$REPO" python -m tools.cctlint consensuscruncher_tpu tools
 
+echo "== cctlint protocol typestate gate (CCT7xx/CCT8xx, serve plane) =="
+# redundant with the full run above but pinned separately: the serve
+# protocol contracts (journal states, wire vocabulary, fsync-before-ack,
+# lock domains) must stay green even if someone --ignores a family in
+# the line above
+PYTHONPATH="$REPO" python -m tools.cctlint consensuscruncher_tpu tools \
+  --select CCT7,CCT8
+
+echo "== interleaving model check (bounded smoke; protocol invariants) =="
+# enumerates serve-plane interleavings under utils/interleave.py and
+# runs the seeded-bug positive control; the full-budget run is
+# `python tools/model_check.py` (~1000 schedules, a few seconds)
+JAX_PLATFORMS=cpu PYTHONPATH="$REPO" python tools/model_check.py --smoke
+
 echo "== tier-1 test suite =="
 T1LOG="$(mktemp)"
 set +e

@@ -1,0 +1,513 @@
+"""Deterministic interleaving model check of the serve plane's protocol.
+
+Where ``chaos_conductor.py`` *samples* fault schedules against a live
+fleet, this tool *enumerates* thread interleavings of four small scripted
+scenarios built from the real serve primitives (Journal, replay,
+Scheduler admission/fencing) under ``utils/interleave.py``'s cooperative
+scheduler, and asserts the invariants declared in
+``tools/cctlint/protocols.py`` on every explored schedule:
+
+  submit_kill        two same-key submitters race a journal crash:
+                     an acknowledged submit is durable and exactly-once
+                     in the journal, a refused one left no orphan record
+  fence_race         a stale and a fresh router race the worker's epoch
+                     fence: the accepted-epoch floor never regresses,
+                     rejections always name a strictly higher live epoch
+  failover_resubmit  a zombie router (old epoch) races the takeover
+                     router resubmitting the same key to a new worker:
+                     per-journal exactly-once, fence floors end correct
+  adoption_zombie    a returning zombie worker replays its journal while
+                     the adopting router resubmits + tombstones it: the
+                     job is never lost and never double-owned
+
+A fifth leg, ``--demo-bug``, runs the fence race against a deliberately
+seeded check-then-act fence (the pre-fix shape: read the floor in one
+lock region, write it in another) and REQUIRES the checker to find the
+epoch regression — proof the harness can catch the bug class it exists
+for.  ``tests/test_model_check.py`` replays the discovered bad schedule.
+
+  python tools/model_check.py                  # full run (>= 500 schedules)
+  python tools/model_check.py --smoke          # bounded CI leg, fixed seed
+  python tools/model_check.py --scenario fence_race --budget 200
+  python tools/model_check.py --demo-bug       # exit 0 iff the bug is caught
+
+Exit 0: every explored schedule of every scenario held every invariant
+(and, when the demo leg runs, the seeded bug was caught).
+"""
+
+from __future__ import annotations
+
+import argparse
+import contextlib
+import io
+import json
+import os
+import shutil
+import sys
+import tempfile
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from consensuscruncher_tpu.serve import journal as journal_mod  # noqa: E402
+from consensuscruncher_tpu.serve.scheduler import (  # noqa: E402
+    AdmissionRefused, RouterFenced, Scheduler)
+from consensuscruncher_tpu.utils import interleave  # noqa: E402
+from consensuscruncher_tpu.utils.profiling import Counters  # noqa: E402
+from tools.cctlint import protocols  # noqa: E402
+
+
+def _journal_grammar_violations(path: str, label: str) -> list[str]:
+    """Every decodable record obeys the registry grammar and every job
+    id's state sequence is a legal succession (file order)."""
+    msgs: list[str] = []
+    if not os.path.exists(path):
+        return msgs
+    per_id: dict[int, list[str]] = {}
+    with open(path, "rb") as fh:
+        lines = fh.read().split(b"\n")
+    for line in lines:
+        if not line.strip():
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue  # torn tail: replay's tolerance, not a violation
+        err = protocols.validate_journal_record(rec)
+        if err:
+            msgs.append(f"{label}: {err}: {rec!r}")
+            continue
+        if rec.get("rec") == "job":
+            per_id.setdefault(int(rec["id"]), []).append(rec["state"])
+    for jid, states in sorted(per_id.items()):
+        err = protocols.check_state_sequence(states)
+        if err:
+            msgs.append(f"{label}: job {jid}: {err} (sequence {states})")
+    return msgs
+
+
+def _accepted_ids_for_key(path: str, key: str) -> set[int]:
+    ids: set[int] = set()
+    if not os.path.exists(path):
+        return ids
+    with open(path, "rb") as fh:
+        for line in fh.read().split(b"\n"):
+            if not line.strip():
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if rec.get("rec") == "job" and rec.get("key") == key:
+                ids.add(int(rec["id"]))
+    return ids
+
+
+def _scratch() -> str:
+    return tempfile.mkdtemp(prefix="mc_")
+
+
+def _close(sched) -> None:
+    """Close a scenario scheduler's journal fd (checks run hundreds of
+    schedules per process; leaked fds would hit the ulimit)."""
+    try:
+        if sched._journal is not None:
+            sched._journal.close()
+    except Exception:
+        pass
+
+
+# ------------------------------------------------------------- scenarios
+
+
+def build_submit_kill(runner):
+    """Two submitters race the same idempotent spec while a third task
+    kills the journal at an arbitrary point."""
+    tmp = _scratch()
+    path = os.path.join(tmp, "journal.ndjson")
+    sched = Scheduler(start=False, journal=path, queue_bound=8,
+                      result_ttl_s=600.0, result_max=8)
+    spec = {"input": "a.bam", "output": "out", "name": "mc-submit"}
+    key = journal_mod.idempotency_key(spec)
+    acked: list[tuple[str, int]] = []
+    refused: list[str] = []
+
+    def submitter(name):
+        def fn():
+            try:
+                job, _created = sched.submit_info(dict(spec))
+                acked.append((name, job.id))
+            except AdmissionRefused:
+                refused.append(name)
+        return fn
+
+    runner.spawn("submit-a", submitter("a"))
+    runner.spawn("submit-b", submitter("b"))
+    runner.spawn("killer", lambda: sched._journal.close())
+
+    def check():
+        _close(sched)
+        msgs = _journal_grammar_violations(path, "journal")
+        ids = _accepted_ids_for_key(path, key)
+        if acked and not ids:
+            msgs.append(f"exactly-once ack broken: {acked} acknowledged "
+                        "but no durable record exists")
+        if len(ids) > 1:
+            msgs.append(f"exactly-once broken: {len(ids)} journal ids for "
+                        f"one idempotency key ({sorted(ids)})")
+        if len(acked) + len(refused) != 2:
+            msgs.append(f"submitter outcome lost: acked={acked} "
+                        f"refused={refused}")
+        shutil.rmtree(tmp, ignore_errors=True)
+        return msgs
+
+    return check
+
+
+def _fence_scenario(fence_fn):
+    """Shared shape of the correct and seeded-buggy fence races: a stale
+    router (epoch 5) and a takeover router (epoch 6) race one worker's
+    epoch admission, each submitting after a successful fence."""
+
+    def build(runner):
+        tmp = _scratch()
+        path = os.path.join(tmp, "journal.ndjson")
+        sched = Scheduler(start=False, journal=path, queue_bound=8,
+                          result_ttl_s=600.0, result_max=8)
+        events: list[tuple] = []
+
+        def router(epoch):
+            def fn():
+                try:
+                    fence_fn(sched, epoch, router=f"r{epoch}")
+                    events.append(("accept", epoch))
+                    spec = {"input": f"e{epoch}.bam", "output": "out",
+                            "name": f"mc-fence-{epoch}"}
+                    sched.submit_info(spec)
+                    events.append(("submit", epoch))
+                except RouterFenced as e:
+                    events.append(("reject", epoch, e.epoch))
+                except AdmissionRefused:
+                    events.append(("refused", epoch))
+            return fn
+
+        runner.spawn("router-old", router(5))
+        runner.spawn("router-new", router(6))
+
+        def check():
+            _close(sched)
+            msgs = _journal_grammar_violations(path, "journal")
+            floor = sched.fence_epoch
+            # NOTE: the events list records task-side append order, which
+            # is NOT the lock-side linearization order — only order-free
+            # invariants (max accepted, per-event rejection facts) are
+            # judged here; the floor itself is the linearized witness
+            hi = 0
+            for ev in events:
+                if ev[0] == "accept":
+                    hi = max(hi, ev[1])
+                elif ev[0] == "reject" and ev[2] <= ev[1]:
+                    msgs.append(
+                        f"rejection without a higher live epoch: epoch "
+                        f"{ev[1]} rejected citing live {ev[2]}")
+            if hi and floor < hi:
+                msgs.append(f"epoch floor regressed: final fence floor "
+                            f"{floor} < highest accepted epoch {hi}")
+            shutil.rmtree(tmp, ignore_errors=True)
+            return msgs
+
+        return check
+
+    return build
+
+
+def _real_fence(sched, epoch, router=None):
+    sched.fence(epoch, router=router)
+
+
+def _buggy_fence(sched, epoch, router=None):
+    """The seeded bug: a pre-fix check-then-act fence.  The floor is read
+    in one lock region and written in another, so a stale router that
+    passed the check before a takeover can write the floor back DOWN —
+    the exact TOCTOU ``Scheduler.fence`` avoids by doing both under one
+    ``_cond`` region.  Kept here (not in shipping code) as the
+    model checker's positive control."""
+    floor = sched.fence_epoch  # lock region 1: read + check
+    if epoch < floor:
+        raise RouterFenced(floor, f"stale forward from {router!r}")
+    with sched._cond:  # lock region 2: act — too late, the world moved
+        sched._fence_epoch = epoch
+
+
+build_fence_race = _fence_scenario(_real_fence)
+build_fence_race_seeded_bug = _fence_scenario(_buggy_fence)
+
+
+def build_failover_resubmit(runner):
+    """A zombie router (epoch 1) and the takeover router (epoch 2) race
+    the same key onto two workers after a failover."""
+    tmp = _scratch()
+    paths = {n: os.path.join(tmp, f"w{n}.ndjson") for n in (1, 2)}
+    workers = {n: Scheduler(start=False, journal=paths[n], queue_bound=8,
+                            result_ttl_s=600.0, result_max=8)
+               for n in (1, 2)}
+    spec = {"input": "f.bam", "output": "out", "name": "mc-failover"}
+    key = journal_mod.idempotency_key(spec)
+    outcomes: list[tuple] = []
+
+    def old_router():
+        try:
+            workers[1].fence(1, router="r-old")
+            workers[1].submit_info(dict(spec))
+            outcomes.append(("old-acked", 1))
+        except RouterFenced as e:
+            outcomes.append(("old-fenced", e.epoch))
+        except AdmissionRefused:
+            outcomes.append(("old-refused",))
+
+    def new_router():
+        try:
+            # takeover: fence the surviving worker up, then resubmit the
+            # possibly-lost key to its new ring home
+            workers[1].fence(2, router="r-new")
+            workers[2].fence(2, router="r-new")
+            workers[2].submit_info(dict(spec))
+            outcomes.append(("new-acked", 2))
+        except RouterFenced as e:
+            outcomes.append(("new-fenced", e.epoch))
+        except AdmissionRefused:
+            outcomes.append(("new-refused",))
+
+    runner.spawn("router-old", old_router)
+    runner.spawn("router-new", new_router)
+
+    def check():
+        msgs = []
+        for n in (1, 2):
+            _close(workers[n])
+            msgs += _journal_grammar_violations(paths[n], f"w{n}")
+            ids = _accepted_ids_for_key(paths[n], key)
+            if len(ids) > 1:
+                msgs.append(f"w{n}: {len(ids)} journal ids for one key")
+        if ("new-acked", 2) in outcomes and workers[2].fence_epoch != 2:
+            msgs.append("w2 acked the takeover submit without having "
+                        f"accepted epoch 2 (floor {workers[2].fence_epoch})")
+        for tag, *rest in outcomes:
+            if tag == "old-fenced" and rest[0] <= 1:
+                msgs.append(f"old router fenced citing live epoch "
+                            f"{rest[0]} <= its own 1")
+        shutil.rmtree(tmp, ignore_errors=True)
+        return msgs
+
+    return check
+
+
+def build_adoption_zombie(runner):
+    """The PR-10 adoption contract under every interleaving: a dead
+    worker's journal holds an acked non-terminal job; the router adopts
+    it (resubmit to the successor, then tombstone) while the dead worker
+    returns as a zombie and replays.  The job must never be lost, and a
+    zombie that honours the tombstone must be able to rely on the
+    successor already having the job durably."""
+    tmp = _scratch()
+    dead_path = os.path.join(tmp, "dead.ndjson")
+    succ_path = os.path.join(tmp, "succ.ndjson")
+    spec = {"input": "z.bam", "output": "out", "name": "mc-adopt"}
+    key = journal_mod.idempotency_key(spec)
+    # prefill (un-scheduled: build runs before the hook installs): the
+    # dead worker acked the job, then died
+    dead = journal_mod.Journal(dead_path)
+    dead.append_job(9001, "accepted", key=key, spec=spec)
+    dead.close()
+    succ = Scheduler(start=False, journal=succ_path, queue_bound=8,
+                     result_ttl_s=600.0, result_max=8)
+    state: dict = {"zombie": None, "tombstoned": False}
+
+    def adopter():
+        jobs, _info = journal_mod.replay(dead_path)
+        for _jid, rec in sorted(jobs.items()):
+            if rec.get("state") in ("done", "failed") or rec.get("adopted"):
+                continue
+            succ.submit_info(dict(rec["spec"]))
+        tomb = journal_mod.Journal(dead_path)
+        try:
+            tomb.append_marker("adopted", router="r-new", epoch=2)
+        finally:
+            tomb.close()
+        state["tombstoned"] = True
+
+    def zombie():
+        z = Scheduler(start=False, journal=dead_path, queue_bound=8,
+                      result_ttl_s=600.0, result_max=8)
+        with z._cond:
+            queued = sum(len(q) for q in z._queues.values())
+        state["zombie"] = {
+            "queued": queued,
+            "dropped": z.counters.snapshot()["fencing_rejections"],
+        }
+        z._journal.close()
+
+    runner.spawn("adopter", adopter)
+    runner.spawn("zombie", zombie)
+
+    def check():
+        _close(succ)
+        msgs = _journal_grammar_violations(dead_path, "dead")
+        msgs += _journal_grammar_violations(succ_path, "succ")
+        succ_ids = _accepted_ids_for_key(succ_path, key)
+        if len(succ_ids) > 1:
+            msgs.append(f"succ: {len(succ_ids)} journal ids for one key")
+        z = state["zombie"]
+        if z is None:
+            msgs.append("zombie task never completed its replay")
+        else:
+            if z["dropped"] and not succ_ids:
+                msgs.append(
+                    "lost job: the zombie honoured an adoption tombstone "
+                    "but the successor journal has no durable record — "
+                    "the tombstone was appended before the resubmit ack")
+            if not z["dropped"] and z["queued"] == 0 and not succ_ids:
+                msgs.append("lost job: neither the zombie nor the "
+                            "successor owns the acked job")
+        shutil.rmtree(tmp, ignore_errors=True)
+        return msgs
+
+    return check
+
+
+SCENARIOS = {
+    "submit_kill": build_submit_kill,
+    "fence_race": build_fence_race,
+    "failover_resubmit": build_failover_resubmit,
+    "adoption_zombie": build_adoption_zombie,
+}
+
+
+# ------------------------------------------------------------------ main
+
+
+def _explore_quiet(ex, verbose: bool):
+    """Scenario schedulers narrate replay/adoption to stderr on every
+    schedule; hundreds of runs would drown the verdict, so mute it."""
+    if verbose:
+        return ex.explore()
+    with contextlib.redirect_stderr(io.StringIO()):
+        return ex.explore()
+
+
+def run_scenarios(names, *, seed: int, budget: int, dpor: bool = True,
+                  verbose: bool = False):
+    """Explore each named scenario; returns the summary doc."""
+    counters = Counters()
+    doc = {"scenarios": {}, "schedules": 0, "violations": 0, "deadlocks": 0}
+    for name in names:
+        ex = interleave.Explorer(SCENARIOS[name], seed=seed,
+                                 max_schedules=budget, dpor=dpor)
+        res = _explore_quiet(ex, verbose)
+        doc["scenarios"][name] = {
+            "schedules": res["schedules"],
+            "max_depth": res["max_depth"],
+            "deadlocks": res["deadlocks"],
+            "violations": [
+                {"schedule": sched, "messages": msgs}
+                for sched, msgs in res["violations"]
+            ],
+        }
+        doc["schedules"] += res["schedules"]
+        doc["violations"] += len(res["violations"])
+        doc["deadlocks"] += res["deadlocks"]
+        counters.add("mc_interleavings", res["schedules"])
+        counters.add("mc_violations", len(res["violations"]))
+        counters.add("mc_deadlocks", res["deadlocks"])
+        status = "OK" if not res["violations"] else "VIOLATIONS"
+        print(f"model_check: {name}: {res['schedules']} schedules, "
+              f"max depth {res['max_depth']}, {res['deadlocks']} deadlocks, "
+              f"{len(res['violations'])} violations [{status}]", flush=True)
+        for sched, msgs in res["violations"][:5]:
+            print(f"  schedule {sched}:", flush=True)
+            for m in msgs:
+                print(f"    - {m}", flush=True)
+    doc["counters"] = {k: v for k, v in counters.snapshot().items()
+                       if k.startswith("mc_")}
+    return doc
+
+
+def run_demo_bug(*, seed: int, budget: int,
+                 verbose: bool = False) -> tuple[bool, list[int] | None]:
+    """Positive control: the checker must find the seeded fence TOCTOU.
+    Returns (caught, first violating schedule)."""
+    ex = interleave.Explorer(build_fence_race_seeded_bug, seed=seed,
+                             max_schedules=budget)
+    res = _explore_quiet(ex, verbose)
+    if res["violations"]:
+        sched, msgs = res["violations"][0]
+        print(f"model_check: demo-bug: CAUGHT in {res['schedules']} "
+              f"schedules; first bad schedule {sched}:", flush=True)
+        for m in msgs:
+            print(f"    - {m}", flush=True)
+        return True, sched
+    print(f"model_check: demo-bug: NOT caught in {res['schedules']} "
+          "schedules — the checker lost its positive control", flush=True)
+    return False, None
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--scenario", choices=sorted(SCENARIOS),
+                    help="run one scenario instead of all four")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--budget", type=int, default=250,
+                    help="max schedules per scenario (default 250)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="bounded CI leg: fixed seed, small budget")
+    ap.add_argument("--no-dpor", action="store_true",
+                    help="disable pruning (full enumeration up to budget)")
+    ap.add_argument("--demo-bug", action="store_true",
+                    help="only run the seeded-bug positive control")
+    ap.add_argument("--replay", type=str, default=None,
+                    help="JSON schedule to replay (with --scenario or "
+                         "--demo-bug); prints the verdict for that one "
+                         "interleaving")
+    ap.add_argument("--json", action="store_true",
+                    help="print the summary doc as JSON on stdout")
+    ap.add_argument("--verbose", action="store_true",
+                    help="let scenario schedulers narrate to stderr")
+    args = ap.parse_args(argv)
+
+    if args.smoke:
+        args.seed, args.budget = 0, 60
+
+    if args.replay is not None:
+        schedule = [int(x) for x in json.loads(args.replay)]
+        build = (build_fence_race_seeded_bug if args.demo_bug
+                 else SCENARIOS[args.scenario or "fence_race"])
+        _runner, msgs = interleave.run_schedule(build, schedule)
+        for m in msgs:
+            print(f"  - {m}", flush=True)
+        print(f"model_check: replay {schedule}: "
+              f"{'VIOLATION' if msgs else 'clean'}", flush=True)
+        return 1 if msgs else 0
+
+    if args.demo_bug:
+        caught, _sched = run_demo_bug(seed=args.seed, budget=args.budget,
+                                      verbose=args.verbose)
+        return 0 if caught else 1
+
+    names = [args.scenario] if args.scenario else sorted(SCENARIOS)
+    doc = run_scenarios(names, seed=args.seed, budget=args.budget,
+                        dpor=not args.no_dpor, verbose=args.verbose)
+    caught, _sched = run_demo_bug(seed=args.seed, budget=args.budget,
+                                  verbose=args.verbose)
+    doc["demo_bug_caught"] = caught
+    if args.json:
+        print(json.dumps(doc, sort_keys=True), flush=True)
+    ok = doc["violations"] == 0 and caught
+    print(f"model_check: total {doc['schedules']} schedules, "
+          f"{doc['violations']} violations, demo bug "
+          f"{'caught' if caught else 'MISSED'} -> "
+          f"{'OK' if ok else 'FAIL'}", flush=True)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
