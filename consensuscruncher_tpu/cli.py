@@ -1150,6 +1150,15 @@ def serve_cmd(args) -> None:
     if dump_dir:
         obs_flight.set_dump_dir(dump_dir)
     obs_flight.install_sigquit()
+    node_name = getattr(args, "node", None) or None
+    if node_name:
+        # fleet identity on every observability artifact this process
+        # writes: trace events get a "node" stamp (named lanes in the
+        # merged fleet trace, even for processes that died), flight
+        # dumps carry node + the last honored router epoch
+        from consensuscruncher_tpu.obs import trace as obs_trace
+        obs_trace.set_identity(node_name)
+        obs_flight.set_identity(node=node_name)
 
     def _cap(name):
         value = getattr(args, name, None)
@@ -1407,6 +1416,13 @@ def route_cmd(args) -> None:
         journals=journals or None,
         start_monitor=False,  # started below, once the advertise
     )                         # address is known
+    from consensuscruncher_tpu.obs import flight as obs_flight
+    from consensuscruncher_tpu.obs import trace as obs_trace
+
+    obs_trace.set_identity(router.router_id)
+    obs_flight.set_identity(node=router.router_id, epoch=router.epoch)
+    if os.environ.get("CCT_TRACE_DIR"):
+        obs_flight.set_dump_dir(os.environ["CCT_TRACE_DIR"])
     server = RouterServer(router, host=args.host, port=int(args.port),
                           socket_path=args.socket or None)
     advertise = getattr(args, "advertise", "") or None
@@ -1460,7 +1476,14 @@ def route_cmd(args) -> None:
 def trace_cmd(args) -> None:
     """``trace export``: merge the per-process ``trace-*.ndjson`` shards a
     CCT_TRACE=1 run left under --dir into one Chrome-trace JSON (open it in
-    Perfetto / chrome://tracing)."""
+    Perfetto / chrome://tracing).
+
+    ``trace fleet``: pull every live process's span buffer through the
+    router's ``trace`` wire op (router + each up member), union it with
+    any on-disk shards under --dir (dead processes' flushed spans), and
+    merge the lot into ONE Chrome-trace timeline — per-node process
+    lanes, ``follows_from`` flow arrows across the kill/steal/adoption
+    hops."""
     from consensuscruncher_tpu.obs import trace as obs_trace
 
     if args.action == "export":
@@ -1471,6 +1494,54 @@ def trace_cmd(args) -> None:
                 "CCT_TRACE_DIR to where the traced run wrote its shards")
         n = obs_trace.export_chrome_trace(trace_dir, args.out)
         print(f"trace: exported {n} events from {trace_dir} -> {args.out}")
+        return
+    if args.action == "fleet":
+        from consensuscruncher_tpu.serve.client import ServeClient
+
+        groups: list[list[dict]] = []
+        address = args.socket or (args.host, int(args.port))
+        try:
+            buffers = ServeClient(address).request(
+                {"op": "trace", "fleet": True}, timeout=60.0)["trace"]
+        except Exception as e:
+            print(f"WARNING: trace fleet: wire collection failed ({e}); "
+                  "merging on-disk shards only", file=sys.stderr, flush=True)
+            buffers = []
+        if isinstance(buffers, dict):  # a lone daemon answered directly
+            buffers = [buffers]
+        for buf in buffers or []:
+            events = (buf or {}).get("events") or []
+            node = (buf or {}).get("node")
+            if node:
+                for ev in events:
+                    ev.setdefault("node", node)
+            groups.append(events)
+        trace_dir = args.trace_dir or os.environ.get("CCT_TRACE_DIR")
+        if trace_dir and os.path.isdir(trace_dir):
+            import glob as _glob
+            for shard in sorted(_glob.glob(
+                    os.path.join(trace_dir, "trace-*.ndjson"))):
+                groups.append(obs_trace._read_shard(shard))
+        if not any(groups):
+            raise SystemExit(
+                "trace fleet: nothing collected — is the router up "
+                "(--socket/--host/--port) or --dir pointing at a "
+                "CCT_TRACE_DIR with shards?")
+        n = obs_trace.merge_fleet_trace(groups, args.out)
+        print(f"trace: merged {n} fleet events "
+              f"({len(groups)} buffer(s)) -> {args.out}")
+
+
+def top_cmd(args) -> None:
+    """``cct top``: live terminal observatory over a router (or lone
+    daemon) — per-node queue depth, QoS latency percentiles and burn
+    rates, steal/resubmit/adoption/fence counters, router epoch."""
+    from consensuscruncher_tpu.obs import top as obs_top
+
+    address = args.socket or (args.host, int(args.port))
+    raise SystemExit(obs_top.run_top(
+        address, interval_s=float(args.interval_s),
+        once=_bool(getattr(args, "once", "False") or "False")))
 
 
 # ------------------------------------------------------------------- argparse
@@ -1806,15 +1877,38 @@ def build_parser() -> argparse.ArgumentParser:
 
     t = sub.add_parser(
         "trace", help="work with CCT_TRACE observability traces")
-    t.add_argument("action", choices=("export",),
+    t.add_argument("action", choices=("export", "fleet"),
                    help="export: merge trace-*.ndjson shards into one "
-                        "Chrome-trace JSON for Perfetto/chrome://tracing")
+                        "Chrome-trace JSON for Perfetto/chrome://tracing; "
+                        "fleet: pull live span buffers through the "
+                        "router's trace op, union with --dir shards, and "
+                        "merge into one cross-node timeline")
     t.add_argument("-c", "--config", default=None)
     t.add_argument("--dir", dest="trace_dir",
                    help="trace shard directory (default $CCT_TRACE_DIR)")
     t.add_argument("--out", help="output path (default trace.json)")
+    t.add_argument("--socket", help="router/daemon unix socket (fleet)")
+    t.add_argument("--host", help="router TCP host (default 127.0.0.1)")
+    t.add_argument("--port", type=int, help="router TCP port (default 7733)")
     t.set_defaults(func=trace_cmd, config_section="obs", required_args=(),
-                   builtin_defaults={"trace_dir": "", "out": "trace.json"})
+                   builtin_defaults={"trace_dir": "", "out": "trace.json",
+                                     "socket": "", "host": "127.0.0.1",
+                                     "port": 7733})
+
+    w = sub.add_parser(
+        "top", help="live terminal observatory over a router or daemon")
+    w.add_argument("-c", "--config", default=None)
+    w.add_argument("--socket", help="router/daemon unix socket path")
+    w.add_argument("--host", help="router TCP host (default 127.0.0.1)")
+    w.add_argument("--port", type=int, help="router TCP port (default 7733)")
+    w.add_argument("--interval_s", type=float,
+                   help="poll interval in seconds (default 2.0)")
+    w.add_argument("--once", help="render one frame and exit (no tty "
+                                  "needed; for scripts and tests)")
+    w.set_defaults(func=top_cmd, config_section="serve", required_args=(),
+                   builtin_defaults={"socket": "", "host": "127.0.0.1",
+                                     "port": 7733, "interval_s": 2.0,
+                                     "once": "False"})
 
     u = sub.add_parser(
         "submit", help="submit a consensus job to a running serve daemon")

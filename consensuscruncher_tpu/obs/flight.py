@@ -48,10 +48,24 @@ class FlightRecorder:
         self._events: deque = deque(maxlen=capacity or _capacity())
         self._dump_dir: str | None = None
         self._seq = 0
+        self._node: str | None = None
+        self._epoch: int | None = None
 
     def set_dump_dir(self, path: str | None) -> None:
         with self._lock:
             self._dump_dir = path
+
+    def set_identity(self, node: str | None = None,
+                     epoch: int | None = None) -> None:
+        """Stamp fleet identity onto future dumps: the serve ``--node``
+        name (or router id) and the highest router epoch this process
+        has seen.  A dump found on a shared filesystem after a chaos
+        run is attributable without guessing from pids."""
+        with self._lock:
+            if node is not None:
+                self._node = str(node)
+            if epoch is not None:
+                self._epoch = int(epoch)
 
     def record(self, kind: str, **fields) -> None:
         ev = {"t": round(time.time(), 6), "kind": kind}
@@ -68,6 +82,7 @@ class FlightRecorder:
         try:
             events = list(self._events)
             dump_dir = self._dump_dir
+            node, epoch = self._node, self._epoch
             self._seq += 1
             seq = self._seq
         finally:
@@ -85,6 +100,10 @@ class FlightRecorder:
             "events": events,
             "trace_events": _trace.recent_events(limit=256),
         }
+        if node is not None:
+            doc["node"] = node
+        if epoch is not None:
+            doc["router_epoch"] = epoch
         final_dir = os.path.dirname(os.path.abspath(path)) or "."
         os.makedirs(final_dir, exist_ok=True)
         fd, tmp = tempfile.mkstemp(prefix=".flight.", dir=final_dir)
@@ -113,6 +132,10 @@ def dump(path: str | None = None, reason: str = "manual") -> str | None:
 
 def set_dump_dir(path: str | None) -> None:
     RECORDER.set_dump_dir(path)
+
+
+def set_identity(node: str | None = None, epoch: int | None = None) -> None:
+    RECORDER.set_identity(node=node, epoch=epoch)
 
 
 def install_sigquit(recorder: FlightRecorder | None = None):

@@ -505,5 +505,27 @@ def render_fleet_prometheus(doc: dict) -> str:
                 f"{_fmt(float(h['sum']))}")
             lines.append(
                 f"{metric}_count{_label_str({'node': node})} {h['count']}")
+        # node-labeled SLO gauges: per-class latency percentiles and
+        # error-budget burn rates fleet-wide in one scrape (``cct top``
+        # reads these for its per-qos panel)
+        classes = (ndoc.get("slo") or {}).get("classes") or {}
+        for qos in sorted(classes):
+            c = classes[qos]
+            for metric, key in (("cct_slo_target_seconds", "target_s"),
+                                ("cct_slo_p50_seconds", "p50_s"),
+                                ("cct_slo_p99_seconds", "p99_s"),
+                                ("cct_slo_shed_ratio", "shed_ratio")):
+                if c.get(key) is not None:
+                    lines.append(f"# TYPE {metric} gauge")
+                    lines.append(
+                        f"{metric}{_label_str({'node': node, 'qos': qos})} "
+                        f"{_fmt(float(c[key]))}")
+            for window, v in sorted((c.get("burn_rate") or {}).items()):
+                if v is not None:
+                    lines.append("# TYPE cct_slo_burn_rate gauge")
+                    lines.append(
+                        "cct_slo_burn_rate"
+                        f"{_label_str({'node': node, 'qos': qos, 'window': window})} "
+                        f"{_fmt(float(v))}")
 
     return "\n".join(lines) + "\n"

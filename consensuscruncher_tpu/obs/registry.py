@@ -75,6 +75,18 @@ COUNTERS = {
                           "refusing a stale router's forward, or a "
                           "returning zombie dropping its adopted "
                           "(tombstoned) jobs at replay",
+    "trace_spans_emitted": "trace events recorded by this process's span "
+                           "machinery (spans, instants and wire-context "
+                           "links; 0 unless CCT_TRACE is on)",
+    "trace_links": "cross-process follows_from links recorded — a span "
+                   "that adopted an inbound wire trace context (router "
+                   "forward, failover resubmit, steal, adoption) instead "
+                   "of rooting a fresh trace",
+    "trace_orphans": "HA continuation points (failover resubmit, journal "
+                     "resubmit, adoption) that found NO stored trace "
+                     "context to link from — each is a causal chain "
+                     "severed at a hop and a trace_check --fleet failure "
+                     "waiting to happen",
     "mc_interleavings": "distinct schedules executed by the interleaving "
                         "model checker (tools/model_check.py)",
     "mc_violations": "schedules on which the model checker found a "

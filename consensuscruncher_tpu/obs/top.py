@@ -1,0 +1,323 @@
+"""``cct top``: a live terminal observatory over the serve fleet.
+
+Polls the router's (or a lone daemon's) ``metrics`` wire op in
+Prometheus text form — the SAME exposition a scraper would read, so what
+the operator watches and what the dashboards alert on can never drift —
+and renders one compact frame per interval: router epoch and HA state,
+a per-node table (up / queue depth / running / routed / steals /
+resubmits / trace spans / orphans), a per-qos SLO panel (p50/p99 latency,
+shed ratio, multi-window burn rates) and the fleet-wide HA counters
+(failovers, adoptions, fencing rejections, trace links).
+
+Everything below the socket read is PURE: :func:`parse_prometheus` turns
+exposition text into ``{metric: [(labels, value), ...]}`` and
+:func:`render_frame` turns that into the frame string — both are unit-
+tested without a terminal or a daemon.  ``run_top`` owns the only state:
+the poll loop, the cbreak keyboard (q quit, p pause, r refresh now) and
+the ANSI clear between frames.  ``--once`` renders a single frame to
+stdout and exits — scripts and tests use it; no tty required.
+"""
+
+from __future__ import annotations
+
+import select
+import sys
+import time
+
+# ------------------------------------------------------------- parsing
+
+
+def parse_prometheus(text: str) -> dict[str, list[tuple[dict, float]]]:
+    """Exposition text -> ``{metric: [(labels, value), ...]}``.
+
+    Tolerant by design: comment/HELP/TYPE lines are skipped, a malformed
+    line is dropped (never fatal — the observatory must keep rendering
+    through a half-written scrape), repeated series accumulate as
+    separate entries (the caller decides whether to sum or max them).
+    """
+    series: dict[str, list[tuple[dict, float]]] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        try:
+            if "{" in line:
+                name, rest = line.split("{", 1)
+                labelblob, value = rest.rsplit("}", 1)
+                labels = _parse_labels(labelblob)
+            else:
+                name, value = line.rsplit(None, 1)
+                labels = {}
+            series.setdefault(name.strip(), []).append(
+                (labels, float(value)))
+        except ValueError:
+            continue
+    return series
+
+
+def _parse_labels(blob: str) -> dict:
+    """``k1="v1",k2="v2"`` -> dict.  Our exposition never emits escaped
+    quotes inside values, so a quote-boundary scan suffices."""
+    labels: dict[str, str] = {}
+    i, n = 0, len(blob)
+    while i < n:
+        eq = blob.find("=", i)
+        if eq < 0:
+            break
+        key = blob[i:eq].strip().strip(",")
+        q1 = blob.find('"', eq)
+        q2 = blob.find('"', q1 + 1)
+        if q1 < 0 or q2 < 0:
+            break
+        labels[key] = blob[q1 + 1:q2]
+        i = q2 + 1
+    return labels
+
+
+def _sum(series: dict, metric: str, **match) -> float:
+    return sum(v for labels, v in series.get(metric, [])
+               if all(labels.get(k) == w for k, w in match.items()))
+
+
+def _by_label(series: dict, metric: str, label: str) -> dict[str, float]:
+    """Sum a metric's entries grouped by one label's value."""
+    out: dict[str, float] = {}
+    for labels, v in series.get(metric, []):
+        who = labels.get(label)
+        if who is not None:
+            out[who] = out.get(who, 0.0) + v
+    return out
+
+
+def _quantile(buckets: list[tuple[float, float]], q: float) -> float | None:
+    """Histogram-estimate quantile from cumulative ``(le, count)`` rows
+    (the exposition's ``_bucket`` lines); None when the histogram is
+    empty.  Returns the upper bound of the first bucket covering q —
+    the same estimate the SLO monitor reports."""
+    if not buckets:
+        return None
+    buckets = sorted(buckets)
+    total = buckets[-1][1]
+    if total <= 0:
+        return None
+    want = q * total
+    for le, acc in buckets:
+        if acc >= want:
+            return le
+    return buckets[-1][0]
+
+
+def qos_latency(series: dict) -> dict[str, dict]:
+    """Per-qos p50/p99 estimates from the fleet-merged labeled
+    ``tenant_job_wall_s`` histograms (summed across tenants and nodes;
+    +Inf rows are kept for totals, excluded from the estimate)."""
+    per_qos: dict[str, dict[float, float]] = {}
+    for labels, v in series.get("cct_tenant_job_wall_s_bucket", []):
+        qos, le = labels.get("qos"), labels.get("le")
+        if qos is None or le is None:
+            continue
+        bound = float("inf") if le == "+Inf" else float(le)
+        acc = per_qos.setdefault(qos, {})
+        acc[bound] = acc.get(bound, 0.0) + v
+    out: dict[str, dict] = {}
+    for qos, acc in per_qos.items():
+        finite = [(le, n) for le, n in acc.items() if le != float("inf")]
+        out[qos] = {
+            "count": acc.get(float("inf"), 0.0),
+            "p50": _quantile(finite, 0.50),
+            "p99": _quantile(finite, 0.99),
+        }
+    return out
+
+
+# ------------------------------------------------------------ rendering
+
+def _fmt_n(v: float | None) -> str:
+    if v is None:
+        return "-"
+    if v != v:  # NaN
+        return "-"
+    if v == int(v):
+        return str(int(v))
+    return f"{v:.2f}"
+
+
+def _fmt_s(v: float | None) -> str:
+    return "-" if v is None else f"{v:g}s"
+
+
+def render_frame(series: dict, source: str,
+                 paused: bool = False, now: float | None = None) -> str:
+    """One observatory frame from parsed exposition series.  Pure: the
+    clock is injectable and absent fleet metrics degrade to the lone-
+    daemon layout instead of failing."""
+    stamp = time.strftime("%H:%M:%S",
+                          time.localtime(now if now is not None
+                                         else time.time()))
+    lines = [f"cct top — {source} — {stamp}"
+             + ("  [paused]" if paused else "")]
+
+    epoch = series.get("cct_router_epoch")
+    if epoch:
+        active = _sum(series, "cct_router_active")
+        lines.append(
+            f"router: epoch {_fmt_n(epoch[0][1])} "
+            f"({'active' if active else 'standby/fenced'})  "
+            f"fleet {_fmt_n(_sum(series, 'cct_fleet_members_up'))}"
+            f"/{_fmt_n(_sum(series, 'cct_fleet_members'))} up")
+
+    nodes = sorted(set(_by_label(series, "cct_fleet_member_up", "node"))
+                   | set(_by_label(series, "cct_trace_spans_emitted_total",
+                                   "node")))
+    if nodes:
+        up = _by_label(series, "cct_fleet_member_up", "node")
+        cols = {
+            "queue": _by_label(series, "cct_fleet_queue_depth", "node"),
+            "run": _by_label(series, "cct_fleet_running", "node"),
+            "routed": _by_label(series, "cct_node_jobs_routed_total", "node"),
+            "steals": _by_label(series, "cct_node_steals_total", "node"),
+            "resub": _by_label(series, "cct_node_resubmits_total", "node"),
+            "spans": _by_label(series, "cct_trace_spans_emitted_total",
+                               "node"),
+            "orphans": _by_label(series, "cct_trace_orphans_total", "node"),
+        }
+        header = (f"{'NODE':<10} {'UP':<4} {'QUEUE':>5} {'RUN':>4} "
+                  f"{'ROUTED':>7} {'STEALS':>6} {'RESUB':>5} "
+                  f"{'SPANS':>7} {'ORPH':>4}")
+        lines.append(header)
+        for node in nodes:
+            lines.append(
+                f"{node:<10} {'up' if up.get(node) else 'DOWN':<4} "
+                f"{_fmt_n(cols['queue'].get(node)):>5} "
+                f"{_fmt_n(cols['run'].get(node)):>4} "
+                f"{_fmt_n(cols['routed'].get(node)):>7} "
+                f"{_fmt_n(cols['steals'].get(node)):>6} "
+                f"{_fmt_n(cols['resub'].get(node)):>5} "
+                f"{_fmt_n(cols['spans'].get(node)):>7} "
+                f"{_fmt_n(cols['orphans'].get(node)):>4}")
+
+    lat = qos_latency(series)
+    burn: dict[str, dict[str, float]] = {}
+    for labels, v in series.get("cct_slo_burn_rate", []):
+        qos, window = labels.get("qos"), labels.get("window")
+        if qos and window:
+            w = burn.setdefault(qos, {})
+            w[window] = max(w.get(window, 0.0), v)  # worst node wins
+    if lat or burn:
+        lines.append(f"{'QOS':<12} {'JOBS':>6} {'P50':>8} {'P99':>8}  BURN")
+        for qos in sorted(set(lat) | set(burn)):
+            row = lat.get(qos) or {}
+            burns = "  ".join(
+                f"{w}={b:.2f}" for w, b in sorted(
+                    (burn.get(qos) or {}).items())) or "-"
+            lines.append(f"{qos:<12} {_fmt_n(row.get('count')):>6} "
+                         f"{_fmt_s(row.get('p50')):>8} "
+                         f"{_fmt_s(row.get('p99')):>8}  {burns}")
+
+    totals = [
+        ("routed", "cct_jobs_routed_total"),
+        ("steals", "cct_route_steals_total"),
+        ("resubmits", "cct_route_resubmits_total"),
+        ("adoptions", "cct_jobs_adopted_total"),
+        ("failovers", "cct_router_failovers_total"),
+        ("fenced", "cct_fencing_rejections_total"),
+        ("spans", "cct_trace_spans_emitted_total"),
+        ("links", "cct_trace_links_total"),
+        ("orphans", "cct_trace_orphans_total"),
+    ]
+    shown = [(label, _sum(series, metric)) for label, metric in totals
+             if metric in series]
+    if shown:
+        lines.append("totals: " + "  ".join(f"{label}={_fmt_n(v)}"
+                                            for label, v in shown))
+    lines.append("keys: q quit  p pause  r refresh")
+    return "\n".join(lines) + "\n"
+
+
+# ------------------------------------------------------------ poll loop
+
+def _describe(address) -> str:
+    if isinstance(address, str):
+        return f"unix:{address}"
+    host, port = address
+    return f"tcp:{host}:{port}"
+
+
+def _scrape(client) -> dict:
+    text = client.request({"op": "metrics", "format": "prometheus"},
+                          timeout=15.0)["prometheus"]
+    return parse_prometheus(text)
+
+
+def run_top(address, interval_s: float = 2.0, once: bool = False) -> int:
+    """Poll + render loop.  Returns a process exit code.  ``once`` prints
+    a single frame and exits (non-tty safe); otherwise the terminal is
+    put in cbreak so single keypresses land without Enter: ``q`` quits,
+    ``p`` toggles pause (polling stops, the frame freezes), ``r`` forces
+    an immediate refresh."""
+    from consensuscruncher_tpu.serve.client import ServeClient
+
+    client = ServeClient(address, retries=1)
+    source = _describe(client.address)
+    if once:
+        sys.stdout.write(render_frame(_scrape(client), source))
+        sys.stdout.flush()
+        return 0
+
+    tty_state = None
+    fd = None
+    if sys.stdin.isatty():
+        import termios
+        import tty as _tty
+
+        fd = sys.stdin.fileno()
+        tty_state = termios.tcgetattr(fd)
+        _tty.setcbreak(fd)
+    paused = False
+    frame = ""
+    next_poll = 0.0
+    try:
+        while True:
+            now = time.monotonic()
+            if not paused and now >= next_poll:
+                try:
+                    frame = render_frame(_scrape(client), source,
+                                         paused=paused)
+                except Exception as e:
+                    frame = (f"cct top — {source} — scrape failed: {e}\n"
+                             "keys: q quit  p pause  r refresh\n")
+                next_poll = now + max(0.2, float(interval_s))
+                sys.stdout.write("\x1b[2J\x1b[H" + frame)
+                sys.stdout.flush()
+            wait = 0.25 if paused else max(0.05, next_poll - now)
+            try:
+                ready, _, _ = select.select([sys.stdin], [], [],
+                                            min(0.25, wait))
+            except (OSError, ValueError):
+                ready = []
+            if not ready:
+                continue
+            ch = sys.stdin.read(1)
+            if ch in ("q", "Q", "\x03"):
+                return 0
+            if ch in ("p", "P"):
+                paused = not paused
+                sys.stdout.write(
+                    "\x1b[2J\x1b[H"
+                    + frame.replace(" — ", " — ", 1)
+                    + ("[paused]\n" if paused else ""))
+                sys.stdout.flush()
+                if not paused:
+                    next_poll = 0.0  # resume refreshes immediately
+            if ch in ("r", "R"):
+                next_poll = 0.0
+                paused = False
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        if tty_state is not None:
+            import termios
+
+            termios.tcsetattr(fd, termios.TCSADRAIN, tty_state)
+        sys.stdout.write("\n")
+        sys.stdout.flush()

@@ -24,6 +24,7 @@ import socket
 import sys
 import time
 
+from consensuscruncher_tpu.obs import trace as obs_trace
 from consensuscruncher_tpu.utils import faults
 
 
@@ -187,6 +188,15 @@ class ServeClient:
         return False
 
     def _request(self, doc: dict, timeout: float | None = None) -> dict:
+        if "trace" not in doc:
+            # wire trace propagation: stamp the caller's open span as the
+            # message's causal context (client -> router -> worker).  The
+            # router's forward path flows through here too, so its route
+            # span rides to the worker with no extra plumbing.  Computed
+            # once: a retried resend continues the same causal chain.
+            ctx = obs_trace.wire_context()
+            if ctx is not None:
+                doc = dict(doc, trace=ctx)
         attempts = self.retries + 1
         for attempt in range(attempts):
             try:
@@ -223,10 +233,17 @@ class ServeClient:
     def submit(self, spec: dict) -> int:
         return int(self.submit_full(spec)["job_id"])
 
-    def submit_full(self, spec: dict) -> dict:
+    def submit_full(self, spec: dict, trace: dict | None = None) -> dict:
         """Submit and return the full reply (``job_id``, ``key``,
-        ``duplicate``) — poll by ``key`` to survive daemon restarts."""
-        return self._request({"op": "submit", "spec": spec})
+        ``duplicate``) — poll by ``key`` to survive daemon restarts.
+        ``trace`` is the wire trace context a *logical* re-submit should
+        continue (the ``trace`` field of the original ack): the dedup key
+        makes the job the same job, and passing its context back keeps
+        the causal timeline one tree instead of minting a fresh trace."""
+        doc = {"op": "submit", "spec": spec}
+        if isinstance(trace, dict):
+            doc["trace"] = trace
+        return self._request(doc)
 
     def submit_nowait(self, spec: dict) -> dict:
         """Submit without raising on admission refusal: a refused reply

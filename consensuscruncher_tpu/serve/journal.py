@@ -82,16 +82,21 @@ def job_record(job_id: int, state: str, *, key: str | None = None,
                spec: dict | None = None, deadline_s: float | None = None,
                outputs: dict | None = None, error: str | None = None,
                wall_s: float | None = None,
-               trace_id: str | None = None) -> dict:
+               trace_id: str | None = None,
+               trace: dict | None = None) -> dict:
     """One journal record; only non-None fields are written (transition
     records carry just the delta, replay merges by id).  ``trace_id`` is
     the correlation id minted at submit — journaled so a replayed job's
-    spans stitch onto the pre-crash trace."""
+    spans stitch onto the pre-crash trace.  ``trace`` is the full wire
+    trace context of the submit-ack span ({"trace_id", "span", "pid",
+    "hop"}): persisted on the accepted record so a failover resubmit or
+    journal adoption can emit a ``follows_from`` edge back to the dead
+    owner's durable ack span — the trace survives kill -9 and replay."""
     rec: dict = {"v": 1, "rec": "job", "id": int(job_id), "state": state}
     for field, value in (("key", key), ("spec", spec),
                          ("deadline_s", deadline_s), ("outputs", outputs),
                          ("error", error), ("wall_s", wall_s),
-                         ("trace_id", trace_id)):
+                         ("trace_id", trace_id), ("trace", trace)):
         if value is not None:
             rec[field] = value
     return rec

@@ -90,6 +90,7 @@ from collections import OrderedDict
 
 from consensuscruncher_tpu.obs import flight as obs_flight
 from consensuscruncher_tpu.obs import metrics as obs_metrics
+from consensuscruncher_tpu.obs import trace as obs_trace
 from consensuscruncher_tpu.serve import journal as journal_mod
 from consensuscruncher_tpu.serve.client import ServeClient, ServeClientError
 from consensuscruncher_tpu.serve.journal import idempotency_key
@@ -473,16 +474,22 @@ class Router:
     def probe_members(self) -> None:
         """One health sweep (the monitor loop calls this; tests call it
         directly for deterministic timing)."""
-        for member in self.members():
-            try:
-                health = member.client.request({"op": "healthz"},
-                                               timeout=5.0)["health"]
-            except Exception as e:
-                member.fails += 1
-                if member.fails >= self.down_after and member.up:
-                    self._mark_down(member, f"{member.fails} failed probes: {e}")
-                continue
-            self._mark_up(member, health)
+        with obs_trace.span("route.probe",
+                            members=len(self.members())) as sp:
+            down = 0
+            for member in self.members():
+                try:
+                    health = member.client.request({"op": "healthz"},
+                                                   timeout=5.0)["health"]
+                except Exception as e:
+                    member.fails += 1
+                    down += 1
+                    if member.fails >= self.down_after and member.up:
+                        self._mark_down(
+                            member, f"{member.fails} failed probes: {e}")
+                    continue
+                self._mark_up(member, health)
+            sp.note(failed_probes=down)
 
     # --------------------------------------------------------- HA: epochs
 
@@ -616,6 +623,10 @@ class Router:
         old_epoch = self.epoch
         self._claim_active()
         self.counters.add("router_failovers", 1)
+        obs_trace.event("route.takeover", router=self.router_id,
+                        old_epoch=old_epoch, epoch=self.epoch, why=why)
+        obs_trace.flush()  # durable before the first fencing forward
+        obs_flight.set_identity(epoch=self.epoch)
         print(f"route[{self.router_id}]: TAKEOVER epoch {old_epoch} -> "
               f"{self.epoch} ({why})", file=sys.stderr, flush=True)
         # the takeover is the incident the flight ring exists for: what
@@ -710,10 +721,21 @@ class Router:
             if not isinstance(spec, dict) or not spec.get("input") \
                     or not spec.get("output"):
                 continue  # rotated-away accepted record: nothing to move
-            pending.append((jid, spec))
+            pending.append((jid, spec, rec))
         adopted_keys = []
-        for jid, spec in pending:
-            reply = self.submit(spec)
+        for jid, spec, rec in pending:
+            # the adoption span continues the DEAD member's trace: it
+            # links to the ack context persisted on the journal record,
+            # and the nested route.submit span inherits that trace_id —
+            # so the successor's spans land on the original timeline
+            ctx = rec.get("trace") if isinstance(rec.get("trace"), dict) \
+                else None
+            if ctx is None and obs_trace.enabled():
+                obs_trace.note_orphan()
+            with obs_trace.span("route.adopt_job", link=ctx,
+                                trace_id=rec.get("trace_id"),
+                                node=member.name, job_id=jid):
+                reply = self.submit(spec)
             if not reply.get("ok"):
                 raise ServeClientError(
                     f"adoption resubmit of {member.name} job {jid} "
@@ -799,9 +821,16 @@ class Router:
             name = self.ring.owner(key, up=up)
             return None if name is None else self._members.get(name)
 
-    def _remember(self, key: str, spec: dict, node: str) -> None:
+    def _remember(self, key: str, spec: dict, node: str,
+                  trace: dict | None = None) -> None:
+        """Placement cache entry; ``trace`` is the owning worker's ack
+        span wire context (from its submit reply) so a later failover
+        resubmit can ``follows_from`` the span the dead owner durably
+        recorded."""
         with self._lock:
-            self._placed[key] = {"spec": dict(spec), "node": node}
+            self._placed[key] = {"spec": dict(spec), "node": node,
+                                 "trace": trace if isinstance(trace, dict)
+                                 else None}
             self._placed.move_to_end(key)
             while len(self._placed) > self._placed_max:
                 self._placed.popitem(last=False)
@@ -831,7 +860,11 @@ class Router:
             doc["epoch"] = self.epoch
             doc["router"] = self.router_id
         try:
-            return member.client.request(doc, timeout=timeout)
+            # the forward span is the wire context the worker links to:
+            # ServeClient stamps the innermost open span onto the doc
+            with obs_trace.span("route.forward", op=doc.get("op"),
+                                node=member.name):
+                return member.client.request(doc, timeout=timeout)
         except ServeClientError as e:
             if e.reply.get("fenced"):
                 self._demote(member.name, e.reply)
@@ -883,10 +916,13 @@ class Router:
 
     # ---------------------------------------------------------------- ops
 
-    def submit(self, spec: dict) -> dict:
+    def submit(self, spec: dict, trace: dict | None = None) -> dict:
         """Route one submit; returns the member's wire reply annotated
         with ``node``/``node_address`` (refusals pass through so the
-        client's shed/quota handling keeps working)."""
+        client's shed/quota handling keeps working).  ``trace`` is the
+        submitter's wire trace context: the route-decision span links to
+        it, and the span itself rides the forward to the worker, so the
+        client -> router -> worker timeline is one connected tree."""
         refusal = self._standby_refusal()
         if refusal is not None:
             return refusal
@@ -899,44 +935,63 @@ class Router:
         except Exception as e:
             return {"ok": False, "error": f"bad spec: {e}"}
         qos = str(spec.get("qos") or "interactive")
+        if not isinstance(trace, dict):
+            # a trace-less re-submit of a key this router already placed
+            # (client retry after a crash, the chaos conductor's dedup
+            # probes) continues the placed job's timeline: the dedup key
+            # makes it the same job, so minting a fresh trace here would
+            # split one causal tree into two
+            info = self._placed_info(key)
+            if info is not None and isinstance(info.get("trace"), dict):
+                trace = info["trace"]
         tried: set[str] = set()
         stolen = False
-        while True:
-            if not tried:
+        with obs_trace.span("route.submit",
+                            link=trace if isinstance(trace, dict) else None,
+                            key=key, qos=qos) as sp:
+            while True:
+                if not tried:
+                    try:
+                        member, stolen = self._pick_target(key, qos)
+                    except ServeClientError as e:
+                        return {"ok": False, "error": str(e)}
+                else:
+                    member = self._owner_for(key, exclude=tried)
+                    if member is None:
+                        return {"ok": False,
+                                "error": "no fleet member is up",
+                                "transport": True}
                 try:
-                    member, stolen = self._pick_target(key, qos)
+                    reply = self._forward(member,
+                                          {"op": "submit", "spec": spec})
                 except ServeClientError as e:
+                    if e.reply.get("transport"):
+                        # forward-time death: fail over around the ring
+                        tried.add(member.name)
+                        stolen = False
+                        continue
+                    if e.reply.get("refused"):
+                        return dict(e.reply)
                     return {"ok": False, "error": str(e)}
-            else:
-                member = self._owner_for(key, exclude=tried)
-                if member is None:
-                    return {"ok": False,
-                            "error": "no fleet member is up",
-                            "transport": True}
-            try:
-                reply = self._forward(member, {"op": "submit", "spec": spec})
-            except ServeClientError as e:
-                if e.reply.get("transport"):
-                    # forward-time death: fail over around the ring
-                    tried.add(member.name)
-                    stolen = False
-                    continue
-                if e.reply.get("refused"):
-                    return dict(e.reply)
-                return {"ok": False, "error": str(e)}
-            with self._lock:
-                member.queued += 1  # soft estimate until the next probe
-            self._remember(key, spec, member.name)
-            self.counters.add("jobs_routed", 1)
-            obs_metrics.inc("node_jobs_routed", node=member.name)
-            if stolen:
-                self.counters.add("route_steals", 1)
-                obs_metrics.inc("node_steals", node=member.name)
-            reply = dict(reply)
-            reply["node"] = member.name
-            reply["node_address"] = member.describe()["address"]
-            reply["stolen"] = stolen
-            return reply
+                with self._lock:
+                    member.queued += 1  # soft estimate until the next probe
+                self._remember(key, spec, member.name,
+                               trace=reply.get("trace"))
+                self.counters.add("jobs_routed", 1)
+                obs_metrics.inc("node_jobs_routed", node=member.name)
+                if stolen:
+                    self.counters.add("route_steals", 1)
+                    obs_metrics.inc("node_steals", node=member.name)
+                # route decision, recorded late (the target is only final
+                # once a forward actually landed)
+                sp.note(node=member.name, stolen=stolen,
+                        trace_id=reply.get("trace") and
+                        reply["trace"].get("trace_id"))
+                reply = dict(reply)
+                reply["node"] = member.name
+                reply["node_address"] = member.describe()["address"]
+                reply["stolen"] = stolen
+                return reply
 
     def resolve(self, key: str) -> _Member:
         """The member a keyed poll should talk to *right now*: the cached
@@ -965,11 +1020,26 @@ class Router:
         """Resubmit a dead node's job to its new owner.  Exactly-once by
         construction: the new owner's journal dedups on the key, and the
         shared-filesystem ``--resume`` manifest skips any stage the dead
-        node already committed — outputs stay byte-identical."""
+        node already committed — outputs stay byte-identical.
+
+        The resubmit span ``follows_from`` the dead owner's ack span
+        (its wire context was cached at placement, or recovered from its
+        journal's accepted record), so the job's trace stays one
+        connected tree across the kill.  No stored context — e.g. a
+        placement inherited from a pre-tracing router — counts a
+        ``trace_orphans`` tally instead of fabricating a link."""
         faults.fault_point("route.resubmit")
-        reply = self._forward(member, {"op": "submit",
-                                       "spec": info["spec"]})
-        self._remember(key, info["spec"], member.name)
+        ctx = info.get("trace") if isinstance(info.get("trace"), dict) \
+            else None
+        if ctx is None and obs_trace.enabled():
+            obs_trace.note_orphan()
+        with obs_trace.span("route.resubmit", link=ctx, key=key,
+                            node=member.name,
+                            trace_id=(ctx or {}).get("trace_id")):
+            reply = self._forward(member, {"op": "submit",
+                                           "spec": info["spec"]})
+        self._remember(key, info["spec"], member.name,
+                       trace=reply.get("trace"))
         self.counters.add("jobs_routed", 1)
         self.counters.add("route_resubmits", 1)
         obs_metrics.inc("node_jobs_routed", node=member.name)
@@ -1002,8 +1072,13 @@ class Router:
                 # no spec on hand (the submit predates this router), so
                 # the cache entry only pins placement; resolve() skips
                 # the spec-needing resubmit path for spec-less entries
-                self._remember(key, {}, member.name)
+                self._remember(key, {}, member.name,
+                               trace=(reply.get("job") or {}).get("trace"))
                 self.counters.add("route_locate_sweeps", 1)
+                obs_trace.event("route.locate_sweep", key=key,
+                                node=member.name,
+                                trace_id=(reply.get("job") or {})
+                                .get("trace_id"))
                 print(f"route: located key {key} on {member.name} after "
                       "an unknown-job miss; placement cache re-primed",
                       file=sys.stderr, flush=True)
@@ -1018,7 +1093,7 @@ class Router:
         resubmit to the live ring successor (journal dedup + manifest
         ``--resume`` keep the eventual double replay exactly-once in its
         effects, same as every failover resubmit)."""
-        spec = None
+        spec = ctx = None
         for name, path in (self.journals or {}).items():
             with self._lock:
                 member = self._members.get(name)
@@ -1036,6 +1111,10 @@ class Router:
                         and not rec.get("adopted") \
                         and rec.get("state") not in ("done", "failed"):
                     spec = dict(rec["spec"])
+                    # the accepted record's persisted ack-span context:
+                    # the resubmit links to the dead node's trace even
+                    # though this router never saw the original submit
+                    ctx = rec.get("trace")
                     break
             if spec is not None:
                 break
@@ -1045,7 +1124,7 @@ class Router:
         if owner is None:
             return False
         try:
-            self._failover_resubmit(key, {"spec": spec}, owner)
+            self._failover_resubmit(key, {"spec": spec, "trace": ctx}, owner)
         except ServeClientError as e:
             print(f"route: journal-recovered resubmit of key {key} "
                   f"failed ({e}); next poll retries", file=sys.stderr,
@@ -1079,10 +1158,21 @@ class Router:
                     continue
                 spec = rec.get("spec") or {}
                 self.counters.add("route_journal_answers", 1)
+                # the answer joins the job's timeline: a span linked to
+                # the dead node's persisted ack context, carrying the
+                # ORIGINAL trace_id (not a fresh one) so the poll reply
+                # and the job's spans correlate
+                rctx = rec.get("trace") if isinstance(rec.get("trace"),
+                                                      dict) else None
+                with obs_trace.span("route.journal_answer", link=rctx,
+                                    trace_id=rec.get("trace_id"),
+                                    key=key, node=name,
+                                    state=rec["state"]):
+                    pass
                 print(f"route: answered keyed poll {key} from {name}'s "
                       f"journal (terminal state '{rec['state']}', node "
                       "down)", file=sys.stderr, flush=True)
-                return {"ok": True, "job": {
+                return {"ok": True, "trace": rctx, "job": {
                     "job_id": rec.get("id"), "key": key,
                     "state": rec["state"], "error": rec.get("error"),
                     "outputs": rec.get("outputs"),
@@ -1255,6 +1345,9 @@ class Router:
                     merged.setdefault(kind, {}).setdefault(
                         name, []).extend(entries)
         health = self.healthz()
+        cumulative = self.counters.snapshot()
+        # the router's own trace-plane tallies (spans / links / orphans)
+        cumulative.update(obs_trace.counter_snapshot())
         return {
             "stage": "route",
             "phases_s": {"uptime": time.time() - self._started_at},
@@ -1262,11 +1355,31 @@ class Router:
             "router_id": self.router_id,
             "epoch": self.epoch,
             "ha_state": health["ha_state"],
-            "cumulative": self.counters.snapshot(),
+            "cumulative": cumulative,
             "labeled": merged,
             "fleet": health["fleet"],
             "nodes": nodes,
         }
+
+    def trace_fleet(self) -> list[dict]:
+        """Every process's span buffer, for ``cct trace fleet``: the
+        router's own events plus each up member's ``trace`` op reply.
+        Down members are skipped (their flushed shards are still
+        collectable from ``CCT_TRACE_DIR`` — that is the point of the
+        on-disk shards); collection never fails routing."""
+        groups: list[dict] = [{"node": self.router_id, "pid": os.getpid(),
+                               "events": obs_trace.collect_events()}]
+        for member in self.members():
+            if not member.up:
+                continue
+            try:
+                reply = member.client.request({"op": "trace"}, timeout=15.0)
+            except Exception:
+                continue
+            buf = reply.get("trace")
+            if isinstance(buf, dict):
+                groups.append(buf)
+        return groups
 
 
 class RouterServer(ServeServer):
@@ -1294,7 +1407,8 @@ class RouterServer(ServeServer):
         op = req.get("op")
         try:
             if op == "submit":
-                return self.router.submit(req.get("spec") or {})
+                return self.router.submit(req.get("spec") or {},
+                                          trace=req.get("trace"))
             if op == "status":
                 return self.router.status(req)
             if op == "result":
@@ -1327,6 +1441,14 @@ class RouterServer(ServeServer):
             if op == "member_remove":
                 out = self.router.member_remove(req.get("name"))
                 return {"ok": True, **out}
+            if op == "trace":
+                # fleet trace collection; works from standbys and fenced
+                # zombies too (post-mortems outlive the HA role)
+                if req.get("fleet"):
+                    return {"ok": True, "trace": self.router.trace_fleet()}
+                return {"ok": True, "trace": {
+                    "node": self.router.router_id, "pid": os.getpid(),
+                    "events": obs_trace.collect_events()}}
             return {"ok": False, "error": f"unknown op {op!r}"}
         except ServeClientError as e:
             # a member refusal / ``ok: false`` travels back verbatim

@@ -156,6 +156,11 @@ class Job:
         # commit — carries it, so one grep of the exported trace follows
         # the job end to end
         self.trace_id = trace_id or obs_trace.mint_trace_id()
+        # wire trace context of the submit-ack span ({"trace_id", "span",
+        # "pid", "hop"}): echoed on the submit reply so the router can
+        # link failover resubmits back to this ack, and journaled on the
+        # accepted record so adoption can do the same after a kill -9
+        self.trace_ctx: dict | None = None
         self.state = "queued"
         self.error: str | None = None
         self.outputs: dict | None = None
@@ -519,10 +524,16 @@ class Scheduler:
         job, _created = self.submit_info(spec)
         return job
 
-    def submit_info(self, spec: dict) -> tuple[Job, bool]:
+    def submit_info(self, spec: dict,
+                    trace: dict | None = None) -> tuple[Job, bool]:
         """Admit a job; returns ``(job, created)``.  A duplicate submit
         (same idempotency key, job still tracked) returns the existing job
-        with ``created=False`` instead of double-running the work."""
+        with ``created=False`` instead of double-running the work.
+
+        ``trace`` is the inbound wire trace context (client or router
+        hop): the job adopts its trace id instead of minting, and the
+        submit span records a ``follows_from`` edge to the sender — the
+        causal chain survives the router hop instead of dying at it."""
         for req in ("input", "output"):
             if not spec.get(req):
                 raise ValueError(f"job spec missing {req!r}")
@@ -534,12 +545,13 @@ class Scheduler:
         key = journal_mod.idempotency_key(spec)
         deadline_s = spec.get("deadline_s")
         deadline_s = None if deadline_s is None else float(deadline_s)
-        # the trace_id is minted HERE, before admission can refuse, so shed
-        # decisions and journal-write failures are traceable too; an
-        # admitted Job adopts it for life
-        trace_id = obs_trace.mint_trace_id()
-        with obs_trace.span("serve.submit", trace_id=trace_id,
-                            input=spec.get("input"),
+        # the trace_id rides in on the wire context (or is minted HERE,
+        # before admission can refuse, so shed decisions and journal-write
+        # failures are traceable too); an admitted Job adopts it for life
+        ctx = trace if isinstance(trace, dict) else None
+        trace_id = (ctx or {}).get("trace_id") or obs_trace.mint_trace_id()
+        with obs_trace.span("serve.submit", trace_id=trace_id, link=ctx,
+                            input=spec.get("input"), key=key,
                             tenant=tenant, qos=qos), self._cond:
             existing = self._by_key.get(key)
             if existing is not None and existing in self._jobs:
@@ -554,6 +566,10 @@ class Scheduler:
                 raise AdmissionRefused(
                     f"queue full ({queued}/{self.queue_bound})")
             job = Job(spec, key=key, deadline_s=deadline_s, trace_id=trace_id)
+            # the ack span's own wire context: echoed on the reply and
+            # journaled below, so every later continuation (failover
+            # resubmit, adoption) can follows_from this durable anchor
+            job.trace_ctx = obs_trace.wire_context()
             if self._journal is not None:
                 # the accepted record must be on disk BEFORE the job is
                 # acknowledged: a refused-but-unjournaled submit is safe to
@@ -562,7 +578,8 @@ class Scheduler:
                 try:
                     n = self._journal.append_job(
                         job.id, "accepted", key=job.key, spec=job.spec,
-                        deadline_s=job.deadline_s, trace_id=job.trace_id)
+                        deadline_s=job.deadline_s, trace_id=job.trace_id,
+                        trace=job.trace_ctx)
                 except Exception as e:
                     raise AdmissionRefused(
                         f"journal write failed ({e}); job not accepted")
@@ -574,6 +591,10 @@ class Scheduler:
             obs_metrics.inc("tenant_jobs_admitted",
                             tenant=job.tenant, qos=job.qos)
             self._cond.notify_all()
+        # flush the ack span to the trace shard before acknowledging: an
+        # acked job's submit span must survive a kill -9 exactly like its
+        # journal record does (the trace-completeness invariant's anchor)
+        obs_trace.flush()
         # schedule point at the ack boundary: everything durable happened
         # under the lock above; the caller's acknowledgement is next
         sanitize.yield_point("serve.ack")
@@ -735,6 +756,8 @@ class Scheduler:
                     f"{self._fence_epoch}")
             if epoch > self._fence_epoch:
                 self._fence_epoch = epoch
+                # flight dumps carry the epoch this worker last honored
+                obs_flight.set_identity(epoch=epoch)
                 if self._journal is not None:
                     try:
                         n = self._journal.append_marker(
@@ -756,9 +779,21 @@ class Scheduler:
     def _journal_update_locked(self, job: Job, state: str, **fields) -> None:
         """Journal a lifecycle transition.  Post-admission journal failures
         degrade durability, not availability: log and keep running (the
-        job's manifest still proves completed stages on replay)."""
+        job's manifest still proves completed stages on replay).
+
+        Trace-completeness ordering: every transition record carries the
+        job's ``trace_id``, and a *terminal* transition emits (and
+        flushes) a ``serve.terminal`` trace event BEFORE the journal
+        append — so "the journal proves the job terminal" implies "the
+        trace has a durable terminal span", even under kill -9 right
+        after the fsync."""
         if self._journal is None:
             return
+        fields.setdefault("trace_id", job.trace_id)
+        if state in ("done", "failed"):
+            obs_trace.event("serve.terminal", trace_id=job.trace_id,
+                            job_id=job.id, key=job.key, state=state)
+            obs_trace.flush()
         try:
             n = self._journal.append_job(job.id, state, **fields)
         except Exception as e:
@@ -777,7 +812,8 @@ class Scheduler:
             recs.append(journal_mod.job_record(
                 j.id, to_journal.get(j.state, j.state), key=j.key,
                 spec=j.spec, deadline_s=j.deadline_s, outputs=j.outputs,
-                error=j.error, wall_s=j.wall_s, trace_id=j.trace_id))
+                error=j.error, wall_s=j.wall_s, trace_id=j.trace_id,
+                trace=j.trace_ctx))
         return recs
 
     def _maybe_rotate_locked(self) -> None:
@@ -829,6 +865,8 @@ class Scheduler:
                           key=rec.get("key") or journal_mod.idempotency_key(spec),
                           deadline_s=rec.get("deadline_s"),
                           trace_id=rec.get("trace_id"))
+                ctx = rec.get("trace")
+                job.trace_ctx = ctx if isinstance(ctx, dict) else None
                 self._jobs[job.id] = job
                 self._by_key[job.key] = job.id
                 if rec.get("state") in ("done", "failed"):
@@ -846,6 +884,16 @@ class Scheduler:
                     job.submitted_t = time.monotonic()
                     self._enqueue_locked(job)
                     self.counters.add("jobs_replayed")
+                    # stitch the restarted process onto the pre-crash
+                    # trace: a follows_from edge back to the dead
+                    # incarnation's durable ack span (persisted on the
+                    # accepted record) keeps the job's span tree
+                    # connected across kill -9 + replay
+                    with obs_trace.span("serve.replay", trace_id=job.trace_id,
+                                        link=job.trace_ctx, key=job.key,
+                                        job_id=job.id):
+                        job.trace_ctx = obs_trace.wire_context() \
+                            or job.trace_ctx
                     requeued += 1
             self.counters.high_water("queue_depth_hwm", self._queued_locked())
             self._cond.notify_all()
@@ -965,6 +1013,10 @@ class Scheduler:
             # recompiles live process-globally (the jit cache is per
             # process, not per Counters instance): folded in at read time
             cumulative["recompiles"] = obs_metrics.recompiles()
+            # the trace plane owns its own tallies (spans/links/orphans
+            # recorded by any thread, not just the scheduler): overlay
+            # them so one metrics doc carries the whole process
+            cumulative.update(obs_trace.counter_snapshot())
             doc = metrics_doc(
                 "serve", {"uptime": time.time() - self._started_at},
                 {"n_jobs": len(jobs), "queue_bound": self.queue_bound,

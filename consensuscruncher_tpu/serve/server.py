@@ -20,6 +20,15 @@ Ops (all replies carry ``"ok"``):
                                            (text exposition, histograms
                                            with cumulative le buckets)
   {"op": "drain", "timeout": s|null}    -> blocks; {"ok": true, "drained": true}
+  {"op": "trace"}                       -> {"ok": true, "trace": {"node",
+                                            "pid", "events": [...]}}
+                                           (this process's span buffer,
+                                           for ``cct trace fleet``)
+
+Causal tracing: any request may carry a ``"trace"`` context
+(``{"trace_id", "span", "pid", "hop"}`` — stamped automatically by
+``ServeClient``); the submit path links the accepted job's span tree to
+it and the ack reply echoes the job's own durable context back.
 
 ``status``/``result`` accept ``"key"`` (the submit reply's idempotency
 key) in place of ``"job_id"`` — keys survive a daemon restart, ids are
@@ -59,6 +68,7 @@ import sys
 import threading
 import time
 
+from consensuscruncher_tpu.obs import trace as obs_trace
 from consensuscruncher_tpu.obs.metrics import render_prometheus
 from consensuscruncher_tpu.serve.scheduler import (
     AdmissionRefused, DeadlineShed, QuotaRefused, RouterFenced, Scheduler,
@@ -285,9 +295,14 @@ class ServeServer:
                 # answering even to a demoted router.
                 self.scheduler.fence(req.get("epoch"), req.get("router"))
             if op == "submit":
-                job, created = self.scheduler.submit_info(req.get("spec") or {})
+                job, created = self.scheduler.submit_info(
+                    req.get("spec") or {}, trace=req.get("trace"))
+                # the ack echoes the accepted job's durable wire trace
+                # context so the submitter (client or router) can link
+                # follow-up spans to the ack span it just caused
                 return {"ok": True, "job_id": job.id, "state": job.state,
-                        "key": job.key, "duplicate": not created}
+                        "key": job.key, "duplicate": not created,
+                        "trace": job.trace_ctx}
             if op == "status":
                 found = self._lookup(req)
                 if found is None:
@@ -311,6 +326,15 @@ class ServeServer:
             if op == "drain":
                 self.scheduler.drain(timeout=req.get("timeout"))
                 return {"ok": True, "drained": True}
+            if op == "trace":
+                # fleet trace collection: hand over this process's span
+                # buffer (flushed shard when CCT_TRACE_DIR is set, else
+                # the in-memory ring).  Unfenced like healthz/metrics —
+                # a post-mortem must be collectable through a demoted
+                # router too.
+                return {"ok": True, "trace": {
+                    "node": self.scheduler.node, "pid": os.getpid(),
+                    "events": obs_trace.collect_events()}}
             return {"ok": False, "error": f"unknown op {op!r}"}
         except RouterFenced as e:
             return {"ok": False, "error": str(e), "fenced": True,

@@ -8,7 +8,8 @@ import os
 
 
 def declared_job_state(journal, job):
-    journal.append_job(job.id, "accepted", key=job.key)
+    journal.append_job(job.id, "accepted", key=job.key,
+                       trace_id=job.trace_id, trace=job.trace_ctx)
 
 
 def declared_runtime_state(job):
@@ -20,13 +21,14 @@ def declared_marker(journal):
 
 
 def declared_reply_keys(job):
-    return {"ok": True, "job_id": job.id, "state": job.state}
+    return {"ok": True, "job_id": job.id, "state": job.state,
+            "trace": job.trace_ctx}
 
 
-def legal_succession(journal, jid):
-    journal.append_job(jid, "accepted")
-    journal.append_job(jid, "dispatched")
-    journal.append_job(jid, "done", outputs={})
+def legal_succession(journal, jid, ctx):
+    journal.append_job(jid, "accepted", trace_id=ctx["trace_id"], trace=ctx)
+    journal.append_job(jid, "dispatched", trace_id=ctx["trace_id"])
+    journal.append_job(jid, "done", outputs={}, trace_id=ctx["trace_id"])
 
 
 def write_then_fsync(fd, payload):
@@ -36,5 +38,6 @@ def write_then_fsync(fd, payload):
 
 def append_before_ack(journal, cond, job):
     with cond:
-        journal.append_job(job.id, "accepted", key=job.key)
+        journal.append_job(job.id, "accepted", key=job.key,
+                           trace_id=job.trace_id, trace=job.trace_ctx)
         cond.notify_all()

@@ -1,0 +1,28 @@
+"""Trace-propagation-conformant twin of ``viol_trace_prop.py``: zero
+CCT604 findings — proves the rule keys on the missing context, not on
+the mere shape of ack replies and journal writes.
+
+Not importable production code — a lint fixture exercised by
+``tests/test_lint_clean.py``.
+"""
+
+
+def ack_with_trace(job):
+    return {"ok": True, "job_id": job.id, "state": job.state,
+            "trace": job.trace_ctx}
+
+
+def journal_with_trace_id(journal, job):
+    journal.append_job(job.id, "dispatched", attempts=1,
+                       trace_id=job.trace_id)
+
+
+def accepted_with_context(journal, job):
+    journal.append_job(job.id, "accepted", key=job.key,
+                       trace_id=job.trace_id, trace=job.trace_ctx)
+
+
+def splat_carries_fields(journal, job, fields):
+    # a **splat may hide trace_id/trace — the rule stays quiet rather
+    # than second-guess dynamic field sets
+    journal.append_job(job.id, "done", **fields)

@@ -1,0 +1,22 @@
+"""Seeded trace-propagation violations; CCT604 must fire on each.
+
+Not importable production code — a lint fixture exercised by
+``tests/test_lint_clean.py``.
+"""
+
+
+def ack_without_trace(job):
+    # CCT604: ok+job_id ack reply with no trace context — the submitter
+    # cannot link its next span to the ack span
+    return {"ok": True, "job_id": job.id, "state": job.state}
+
+
+def journal_without_trace_id(journal, job):
+    # CCT604: record written without trace_id= — replay loses correlation
+    journal.append_job(job.id, "dispatched", attempts=1)
+
+
+def accepted_without_context(journal, job):
+    # CCT604 (twice): no trace_id=, and the accepted anchor record
+    # persists no trace= for HA continuations to follows_from
+    journal.append_job(job.id, "accepted", key=job.key)

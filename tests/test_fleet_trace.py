@@ -1,0 +1,618 @@
+"""Fleet-wide causal tracing: wire propagation, HA span linking, and the
+trace-completeness checker.
+
+The contract under test, end to end:
+
+- the submit ack's wire ``trace`` context is journaled on the accepted
+  record and echoed to the submitter, so every later continuation —
+  journal replay after kill -9, router failover resubmit, work steal —
+  can ``follows_from`` the durable ack span instead of minting a fresh
+  trace;
+- the scheduler emits (and flushes) exactly one ``serve.terminal``
+  instant event BEFORE the terminal journal append, so journal-terminal
+  implies trace-terminal even when the process dies right after the
+  fsync;
+- ``tools/trace_check.py --fleet`` proves the invariant offline: per-key
+  journal trace_id agreement, one connected pid-group component per
+  trace (virtual-pid union for processes whose rings died unflushed),
+  anchor and terminal presence;
+- ``merge_fleet_trace`` turns follows_from edges into Chrome-trace flow
+  arrows with per-node process lanes, and ``cct top``'s parser/renderer
+  stay pure over the merged Prometheus exposition.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import trace_check  # noqa: E402
+
+from consensuscruncher_tpu.obs import flight as obs_flight
+from consensuscruncher_tpu.obs import top as obs_top
+from consensuscruncher_tpu.obs import trace as obs_trace
+from consensuscruncher_tpu.serve.client import ServeClientError
+from consensuscruncher_tpu.serve.journal import Journal, idempotency_key
+from consensuscruncher_tpu.serve.journal import replay as journal_replay
+from consensuscruncher_tpu.serve.router import Router
+from consensuscruncher_tpu.serve.scheduler import Scheduler
+from consensuscruncher_tpu.serve.server import ServeServer
+
+DATA = os.path.join(REPO, "test", "data")
+SAMPLE = os.path.join(DATA, "sample.bam")
+
+
+def _spec(output, **over):
+    spec = {
+        "input": SAMPLE, "output": str(output), "name": "golden",
+        "cutoff": 0.7, "qualscore": 0, "scorrect": True,
+        "max_mismatch": 0, "bdelim": "|", "compress_level": 6,
+    }
+    spec.update(over)
+    return spec
+
+
+@pytest.fixture
+def traced(monkeypatch):
+    monkeypatch.setenv("CCT_TRACE", "1")
+    monkeypatch.delenv("CCT_TRACE_DIR", raising=False)
+    obs_trace.drain_events()
+    yield
+    obs_trace.drain_events()
+
+
+def _spans(events, name):
+    return [e for e in events if e.get("ph") == "X" and e["name"] == name]
+
+
+# ----------------------------------------------------- wire propagation
+
+def test_wire_context_snapshots_innermost_span(traced):
+    assert obs_trace.wire_context() is None  # no open span
+    with obs_trace.span("outer", trace_id="t-wire"):
+        ctx = obs_trace.wire_context()
+    assert ctx["trace_id"] == "t-wire"
+    assert ctx["pid"] == os.getpid()
+    assert ctx["hop"] == 1  # pre-incremented for the crossing
+    assert isinstance(ctx["span"], int)
+
+
+def test_linked_span_adopts_trace_and_records_follows_from(traced):
+    base = obs_trace.counter_snapshot()
+    ctx = {"trace_id": "t-sender", "span": 77, "pid": 4242, "hop": 3}
+    with obs_trace.span("receiver", link=ctx):
+        inner_ctx = obs_trace.wire_context()
+    events = obs_trace.drain_events()
+    (sp,) = _spans(events, "receiver")
+    assert sp["args"]["trace_id"] == "t-sender"
+    assert sp["args"]["hop"] == 3
+    assert sp["args"]["follows_from"] == {"span": 77, "pid": 4242}
+    # the next crossing continues the adopted trace, one hop further
+    assert inner_ctx["trace_id"] == "t-sender" and inner_ctx["hop"] == 4
+    now = obs_trace.counter_snapshot()
+    assert now["trace_links"] == base["trace_links"] + 1
+    assert now["trace_spans_emitted"] > base["trace_spans_emitted"]
+
+
+def test_submit_ack_echoes_and_journals_wire_context(traced, tmp_path):
+    jp = str(tmp_path / "wal")
+    sched = Scheduler(start=False, paused=True, journal=Journal(jp))
+    server = ServeServer(sched, port=0)
+    ctx = {"trace_id": "t-client", "span": 5, "pid": 999, "hop": 2}
+    try:
+        reply = server._dispatch({"op": "submit", "trace": ctx,
+                                  "spec": _spec(tmp_path / "out")})
+        assert reply["ok"] is True
+        # the ack echoes the ACCEPTING span's context, same trace
+        assert reply["trace"]["trace_id"] == "t-client"
+        assert reply["trace"]["pid"] == os.getpid()
+        assert reply["trace"]["hop"] >= 3
+    finally:
+        server.close(timeout=2)
+        sched.shutdown()
+        sched._journal.close()
+    events = obs_trace.drain_events()
+    (sub,) = _spans(events, "serve.submit")
+    assert sub["args"]["trace_id"] == "t-client"
+    assert sub["args"]["follows_from"] == {"span": 5, "pid": 999}
+    # the accepted record persists both the id and the full context —
+    # the durable anchor every HA continuation links from
+    jobs, _ = journal_replay(jp)
+    (rec,) = [r for r in jobs.values() if r.get("key") == reply["key"]]
+    assert rec["trace_id"] == "t-client"
+    assert rec["trace"]["trace_id"] == "t-client"
+    assert rec["trace"]["span"] == sub["id"]
+
+
+def test_trace_context_survives_journal_replay(traced, tmp_path):
+    jp = str(tmp_path / "wal")
+    sched = Scheduler(start=False, paused=True, journal=Journal(jp))
+    ctx = {"trace_id": "t-replay", "span": 9, "pid": 123, "hop": 1}
+    try:
+        job, created = sched.submit_info(_spec(tmp_path / "out"), trace=ctx)
+        assert created and job.trace_id == "t-replay"
+        old_ctx = job.trace_ctx
+        assert old_ctx["trace_id"] == "t-replay"
+    finally:
+        sched.shutdown()
+        sched._journal.close()
+    obs_trace.drain_events()  # isolate the restart's events
+    sched2 = Scheduler(start=False, paused=True, journal=Journal(jp))
+    try:
+        found = sched2.lookup(key=job.key)
+        assert found is not None
+        job2 = found[1]
+        assert job2.trace_id == "t-replay"
+        # the restarted process re-anchored: its replay span linked the
+        # dead incarnation's ack span, and the job carries a LIVE ctx
+        assert job2.trace_ctx["trace_id"] == "t-replay"
+        assert job2.trace_ctx["pid"] == os.getpid()
+    finally:
+        sched2.shutdown()
+        sched2._journal.close()
+    events = obs_trace.drain_events()
+    (rp,) = _spans(events, "serve.replay")
+    assert rp["args"]["trace_id"] == "t-replay"
+    assert rp["args"]["follows_from"] == {"span": old_ctx["span"],
+                                          "pid": old_ctx["pid"]}
+
+
+def test_terminal_event_flushed_before_terminal_append(traced, tmp_path,
+                                                       monkeypatch):
+    shards = tmp_path / "traces"
+    shards.mkdir()
+    monkeypatch.setenv("CCT_TRACE_DIR", str(shards))
+    jp = str(tmp_path / "wal")
+    sched = Scheduler(start=False, paused=True, journal=Journal(jp))
+    try:
+        job, _ = sched.submit_info(_spec(tmp_path / "out"))
+        shard = shards / f"trace-{os.getpid()}.ndjson"
+        # the ack flush already persisted the submit span (kill -9 safe)
+        assert "serve.submit" in shard.read_text()
+        with sched._cond:
+            sched._journal_update_locked(job, "dispatched", attempts=1)
+        assert "serve.terminal" not in shard.read_text()
+        with sched._cond:
+            sched._journal_update_locked(job, "done", outputs={})
+        # the terminal event is durable the instant the journal says
+        # terminal — no flush call in between for a kill to race
+        lines = [json.loads(ln) for ln in
+                 shard.read_text().splitlines() if ln.strip()]
+        terms = [e for e in lines if e["name"] == "serve.terminal"]
+        assert len(terms) == 1
+        assert terms[0]["args"]["trace_id"] == job.trace_id
+        jobs, _ = journal_replay(jp)
+        (rec,) = [r for r in jobs.values() if r.get("key") == job.key]
+        assert rec["state"] == "done" and rec["trace_id"] == job.trace_id
+    finally:
+        sched.shutdown()
+        sched._journal.close()
+    obs_trace.drain_events()
+
+
+# ------------------------------------------------------ router HA links
+
+class _TracingStubFleet:
+    """Stub workers whose submit acks carry per-node wire trace
+    contexts, with configurable health queue depths (steal steering) and
+    a record of the last trace context each node RECEIVED."""
+
+    def __init__(self, names, ack_trace=True):
+        self.ack_trace = ack_trace
+        self.nodes = {n: {"dead": False, "jobs": set(), "queued": 0,
+                          "seen_trace": None, "pid": 1000 + i}
+                      for i, n in enumerate(names)}
+
+    def client(self, name):
+        fleet = self
+
+        class _Client:
+            address = name
+
+            def request(self, doc, timeout=None):
+                if "trace" not in doc:
+                    # mimic ServeClient._request's wire stamping
+                    ctx = obs_trace.wire_context()
+                    if ctx is not None:
+                        doc = dict(doc, trace=ctx)
+                node = fleet.nodes[name]
+                if node["dead"]:
+                    raise OSError("connection refused")
+                op = doc["op"]
+                if op == "healthz":
+                    return {"ok": True,
+                            "health": {"queued": node["queued"],
+                                       "running": 0,
+                                       "status": "serving"}}
+                if op == "submit":
+                    node["seen_trace"] = doc.get("trace")
+                    key = idempotency_key(doc["spec"])
+                    dup = key in node["jobs"]
+                    node["jobs"].add(key)
+                    reply = {"ok": True, "job_id": 1, "key": key,
+                             "duplicate": dup, "trace": None}
+                    if fleet.ack_trace:
+                        # a real worker ADOPTS the incoming wire trace;
+                        # only a trace-less submit mints a node-local one
+                        tid = (doc.get("trace") or {}).get("trace_id") \
+                            or f"t-{name}"
+                        reply["trace"] = {"trace_id": tid, "span": 7,
+                                          "pid": node["pid"], "hop": 2}
+                    return reply
+                if op in ("status", "result"):
+                    if doc["key"] in node["jobs"]:
+                        return {"ok": True,
+                                "job": {"job_id": 1, "key": doc["key"],
+                                        "state": "done"}}
+                    raise ServeClientError(
+                        "unknown job_id",
+                        {"ok": False, "error": "unknown job_id",
+                         "unknown": True})
+                raise AssertionError(op)
+
+        return _Client()
+
+
+def test_failover_resubmit_follows_from_dead_owner_ack(traced, tmp_path):
+    fleet = _TracingStubFleet(["n0", "n1", "n2"])
+    router = Router([(n, n) for n in fleet.nodes], start_monitor=False,
+                    down_after=1, client_factory=fleet.client)
+    try:
+        spec = _spec(tmp_path / "job")
+        reply = router.submit(spec)
+        assert reply["ok"] is True
+        home = reply["node"]
+        # the placement cache holds the OWNER's ack context
+        owner_ctx = router._placed_info(reply["key"])["trace"]
+        assert owner_ctx["pid"] == fleet.nodes[home]["pid"]
+        obs_trace.drain_events()
+        fleet.nodes[home]["dead"] = True
+        router.probe_members()
+        assert not router._member(home).up
+        out = router.status({"key": reply["key"]})
+        assert out["ok"] is True
+        assert router.counters.snapshot()["route_resubmits"] == 1
+        events = obs_trace.drain_events()
+        (rs,) = _spans(events, "route.resubmit")
+        # the resubmit span continues the DEAD owner's trace and
+        # follows_from its ack span — the kill does not split the tree
+        assert rs["args"]["trace_id"] == owner_ctx["trace_id"]
+        assert rs["args"]["follows_from"] == {
+            "span": 7, "pid": fleet.nodes[home]["pid"]}
+        landed = [n for n, node in fleet.nodes.items()
+                  if reply["key"] in node["jobs"] and n != home]
+        assert landed
+        # the new owner received the resubmit's wire context in-trace
+        seen = fleet.nodes[landed[0]]["seen_trace"]
+        assert seen["trace_id"] == owner_ctx["trace_id"]
+    finally:
+        router.close()
+
+
+def test_resubmit_without_stored_context_counts_orphan(traced, tmp_path):
+    fleet = _TracingStubFleet(["n0", "n1", "n2"], ack_trace=False)
+    router = Router([(n, n) for n in fleet.nodes], start_monitor=False,
+                    down_after=1, client_factory=fleet.client)
+    try:
+        reply = router.submit(_spec(tmp_path / "job"))
+        assert router._placed_info(reply["key"])["trace"] is None
+        base = obs_trace.counter_snapshot()["trace_orphans"]
+        obs_trace.drain_events()
+        fleet.nodes[reply["node"]]["dead"] = True
+        router.probe_members()
+        assert router.status({"key": reply["key"]})["ok"] is True
+        # the severed causal chain is COUNTED, never papered over with a
+        # fabricated link
+        assert obs_trace.counter_snapshot()["trace_orphans"] == base + 1
+        (rs,) = _spans(obs_trace.drain_events(), "route.resubmit")
+        assert "follows_from" not in rs["args"]
+    finally:
+        router.close()
+
+
+def test_steal_keeps_one_trace_end_to_end(traced, tmp_path):
+    fleet = _TracingStubFleet(["n0", "n1"])
+    router = Router([(n, n) for n in fleet.nodes], start_monitor=False,
+                    client_factory=fleet.client)
+    try:
+        spec = _spec(tmp_path / "batchjob", qos="batch")
+        key = idempotency_key(spec)
+        home = router._owner_for(key).name
+        thief = [n for n in fleet.nodes if n != home][0]
+        fleet.nodes[home]["queued"] = 10
+        fleet.nodes[thief]["queued"] = 0
+        router.probe_members()  # learn the queue depths
+        ctx = {"trace_id": "t-client", "span": 1, "pid": 111, "hop": 0}
+        reply = router.submit(spec, trace=ctx)
+        assert reply["ok"] is True and reply["stolen"] is True
+        assert reply["node"] == thief
+        events = obs_trace.drain_events()
+        (sub,) = _spans(events, "route.submit")
+        # the steal decision changes the NODE, never the trace: the
+        # routed span carries the client's trace id and the thief
+        # received a wire context continuing it
+        assert sub["args"]["trace_id"] == "t-client"
+        assert sub["args"]["stolen"] is True
+        assert sub["args"]["follows_from"] == {"span": 1, "pid": 111}
+        assert fleet.nodes[thief]["seen_trace"]["trace_id"] == "t-client"
+    finally:
+        router.close()
+
+
+def test_journal_answer_reply_carries_original_trace(traced, tmp_path):
+    fleet = _TracingStubFleet(["n0", "n1", "n2"])
+    spec = _spec(tmp_path / "finished")
+    key = idempotency_key(spec)
+    jp = str(tmp_path / "n1.journal")
+    ctx = {"trace_id": "t-orig", "span": 31, "pid": 7777, "hop": 1}
+    j = Journal(jp)
+    j.append_job(7, "accepted", key=key, spec=spec, trace_id="t-orig",
+                 trace=ctx)
+    j.append_job(7, "done", outputs={"base": str(tmp_path / "finished")})
+    j.append_marker("adopted", router="rX", epoch=3)
+    j.close()
+    router = Router([(n, n) for n in fleet.nodes], start_monitor=False,
+                    down_after=1, journals={"n1": jp},
+                    client_factory=fleet.client)
+    try:
+        fleet.nodes["n1"]["dead"] = True
+        router.probe_members()
+        obs_trace.drain_events()
+        reply = router.status({"key": key})
+        assert reply["ok"] is True and reply["job"]["state"] == "done"
+        # the poll answer correlates: original trace_id on the job AND
+        # the dead node's ack context echoed at top level
+        assert reply["job"]["trace_id"] == "t-orig"
+        assert reply["trace"] == ctx
+        (ja,) = _spans(obs_trace.drain_events(), "route.journal_answer")
+        assert ja["args"]["trace_id"] == "t-orig"
+        assert ja["args"]["follows_from"] == {"span": 31, "pid": 7777}
+    finally:
+        router.close()
+
+
+# ------------------------------------------------- merge + flow arrows
+
+def _xspan(name, pid, span_id, trace="t1", hop=0, ff=None, ts=1000,
+           node=None, **args):
+    a = {"trace_id": trace, "hop": hop}
+    if ff is not None:
+        a["follows_from"] = ff
+    a.update(args)
+    ev = {"name": name, "cat": "cct", "ph": "X", "ts": ts, "dur": 10,
+          "pid": pid, "tid": 1, "id": span_id, "args": a}
+    if node is not None:
+        ev["node"] = node
+    return ev
+
+
+def _ievent(name, pid, trace="t1", ts=1500):
+    return {"name": name, "cat": "cct", "ph": "i", "s": "t", "ts": ts,
+            "pid": pid, "tid": 1, "args": {"trace_id": trace}}
+
+
+def test_merge_fleet_trace_flows_lanes_and_dedup(tmp_path):
+    ack = _xspan("serve.submit", 100, 5, node="w0", ts=1000)
+    resub = _xspan("route.resubmit", 200, 9, node="r0", ts=2000,
+                   ff={"span": 5, "pid": 100})
+    out = str(tmp_path / "merged.json")
+    # the ack appears in BOTH groups (wire buffer + shard): merged once
+    n = obs_trace.merge_fleet_trace([[ack, resub], [ack]], out)
+    doc = json.load(open(out))
+    evs = doc["traceEvents"]
+    assert n == len(evs)
+    xs = [e for e in evs if e["ph"] == "X"]
+    assert len(xs) == 2  # dedup collapsed the duplicated ack
+    flows = [e for e in evs if e["ph"] in ("s", "f")]
+    assert {f["ph"] for f in flows} == {"s", "f"}
+    assert all(f["name"] == "trace_link" for f in flows)
+    start = next(f for f in flows if f["ph"] == "s")
+    fin = next(f for f in flows if f["ph"] == "f")
+    assert start["pid"] == 100 and fin["pid"] == 200  # arrow w0 -> r0
+    assert start["id"] == fin["id"] and fin["bp"] == "e"
+    lanes = {e["pid"]: e["args"]["name"] for e in evs
+             if e["ph"] == "M" and e["name"] == "process_name"}
+    assert lanes == {100: "w0", 200: "r0"}
+    assert trace_check.check_trace(out) == []  # schema-valid for Perfetto
+
+
+# ------------------------------------------------- trace_check --fleet
+
+def _write_trace(tmp_path, events, name="fleet.json"):
+    path = str(tmp_path / name)
+    json.dump({"traceEvents": events}, open(path, "w"))
+    return path
+
+
+def _accepted_journal(path, key, trace_id, ctx=None, terminal=None):
+    j = Journal(path)
+    j.append_job(41, "accepted", key=key, spec={"x": 1},
+                 trace_id=trace_id, trace=ctx)
+    if terminal:
+        j.append_job(41, terminal, outputs={})
+    j.close()
+
+
+def test_fleet_check_connected_tree_passes(tmp_path):
+    key = "k" * 16
+    events = [
+        _xspan("route.submit", 50, 1, hop=0, ts=900),
+        _xspan("serve.submit", 100, 5, hop=2, ts=1000,
+               ff={"span": 2, "pid": 50}),
+        _xspan("route.resubmit", 50, 9, hop=1, ts=2000,
+               ff={"span": 5, "pid": 100}),
+        _xspan("serve.submit", 300, 12, hop=3, ts=2100,
+               ff={"span": 10, "pid": 50}),
+        _ievent("serve.terminal", 300, ts=2500),
+    ]
+    trace = _write_trace(tmp_path, events)
+    j1 = str(tmp_path / "w0.journal")
+    j2 = str(tmp_path / "w1.journal")
+    _accepted_journal(j1, key, "t1")
+    _accepted_journal(j2, key, "t1", terminal="done")
+    assert trace_check.check_fleet(trace, [j1, j2]) == []
+    summary = trace_check.fleet_summary(trace, [j1, j2])
+    assert summary["orphans"] == 0 and summary["terminal_keys"] == 1
+    # CLI form, as ci_check.sh runs it
+    assert trace_check.main(["--fleet", trace, "--journals", j1, j2]) == 0
+
+
+def test_fleet_check_virtual_pid_unions_killed_process(tmp_path):
+    # pid 100 died with its ring unflushed: NO events survive from it,
+    # but two other processes durably cite it — they must form ONE
+    # component through the virtual pid, not two orphaned halves
+    events = [
+        _xspan("serve.submit", 50, 1, hop=0, ts=900),
+        _xspan("route.resubmit", 200, 9, hop=1, ts=2000,
+               ff={"span": 5, "pid": 100}),
+        _xspan("serve.replay", 300, 12, hop=2, ts=2100,
+               ff={"span": 5, "pid": 100}),
+    ]
+    # make pid 50's span the root of a DIFFERENT trace so the virtual
+    # union is what connects 200 and 300 in t1
+    events[0]["args"]["trace_id"] = "t0"
+    trace = _write_trace(tmp_path, events)
+    problems = trace_check.check_fleet(trace, [])
+    assert problems == [], problems
+
+
+def test_fleet_check_flags_orphans_and_missing_anchor(tmp_path):
+    events = [
+        _xspan("serve.submit", 100, 5, hop=0, ts=1000),
+        _xspan("serve.job", 999, 20, hop=5, ts=3000),  # no link anywhere
+    ]
+    trace = _write_trace(tmp_path, events)
+    problems = trace_check.check_fleet(trace, [])
+    assert any("ORPHANED" in p and "serve.job" in p for p in problems)
+    # a JOB trace (serve-side activity) with no causal anchor is flagged;
+    # a background singleton (health probe) is legitimately anchorless
+    bad = _write_trace(tmp_path, [_xspan("serve.job", 50, 1, ts=100)],
+                       name="anchorless.json")
+    assert any("no causal anchor" in p
+               for p in trace_check.check_fleet(bad, []))
+    bg = _write_trace(tmp_path, [_xspan("route.probe", 50, 1, ts=100)],
+                      name="background.json")
+    assert trace_check.check_fleet(bg, []) == []
+
+
+def test_fleet_check_journal_disagreement_and_lost_terminal(tmp_path):
+    key = "k" * 16
+    events = [_xspan("serve.submit", 100, 5, ts=1000)]
+    trace = _write_trace(tmp_path, events)
+    j1 = str(tmp_path / "w0.journal")
+    j2 = str(tmp_path / "w1.journal")
+    _accepted_journal(j1, key, "t1")
+    _accepted_journal(j2, key, "t2", terminal="done")  # fresh trace: BUG
+    problems = trace_check.check_fleet(trace, [j1, j2])
+    assert any("disagree on trace_id" in p for p in problems)
+    # journal proves terminal but the trace has no serve.terminal event
+    j3 = str(tmp_path / "w3.journal")
+    _accepted_journal(j3, key, "t1", terminal="done")
+    problems = trace_check.check_fleet(trace, [j3])
+    assert any("no serve.terminal" in p for p in problems)
+
+
+def test_fleet_check_reads_shard_directory(tmp_path):
+    shard_dir = tmp_path / "shards"
+    shard_dir.mkdir()
+    with open(shard_dir / "trace-100.ndjson", "w") as fh:
+        fh.write(json.dumps(_xspan("serve.submit", 100, 5)) + "\n")
+        fh.write('{"torn line\n')  # kill -9 mid-write: skipped, not fatal
+    with open(shard_dir / "trace-200.ndjson", "w") as fh:
+        fh.write(json.dumps(_xspan("route.submit", 200, 9, hop=1,
+                                   ff={"span": 5, "pid": 100})) + "\n")
+    assert trace_check.check_fleet(str(shard_dir), []) == []
+    assert trace_check.fleet_summary(str(shard_dir), [])["spans"] == 2
+
+
+def test_fleet_check_empty_trace_is_a_problem(tmp_path):
+    trace = _write_trace(tmp_path, [])
+    assert any("no spans" in p for p in trace_check.check_fleet(trace, []))
+
+
+# ------------------------------------------------------------- cct top
+
+_EXPO = """\
+# HELP cct_router_epoch current ring-view epoch
+cct_router_epoch 3
+cct_router_active 1
+cct_fleet_members 2
+cct_fleet_members_up 2
+cct_fleet_member_up{node="w0"} 1
+cct_fleet_member_up{node="w1"} 0
+cct_fleet_queue_depth{node="w0"} 4
+cct_node_jobs_routed_total{node="w0"} 7
+cct_node_steals_total{node="w0"} 2
+cct_trace_spans_emitted_total{node="w0"} 42
+cct_trace_orphans_total{node="w0"} 0
+cct_tenant_job_wall_s_bucket{tenant="a",qos="batch",le="0.5"} 3
+cct_tenant_job_wall_s_bucket{tenant="a",qos="batch",le="1"} 9
+cct_tenant_job_wall_s_bucket{tenant="a",qos="batch",le="+Inf"} 10
+cct_slo_burn_rate{node="w0",qos="batch",window="5m"} 1.25
+cct_slo_burn_rate{node="w1",qos="batch",window="5m"} 0.5
+malformed{ 12
+"""
+
+
+def test_parse_prometheus_labels_and_tolerance():
+    series = obs_top.parse_prometheus(_EXPO)
+    assert ({"node": "w0"}, 1.0) in series["cct_fleet_member_up"]
+    assert len(series["cct_tenant_job_wall_s_bucket"]) == 3
+    assert "malformed{" not in series  # dropped, never fatal
+    assert obs_top._sum(series, "cct_fleet_members_up") == 2.0
+    assert obs_top._by_label(series, "cct_fleet_member_up", "node") == {
+        "w0": 1.0, "w1": 0.0}
+
+
+def test_qos_latency_quantiles_from_buckets():
+    lat = obs_top.qos_latency(obs_top.parse_prometheus(_EXPO))
+    assert lat["batch"]["count"] == 10.0
+    assert lat["batch"]["p50"] == 1.0   # first bucket covering 4.5/9
+    assert lat["batch"]["p99"] == 1.0
+
+
+def test_render_frame_layout():
+    series = obs_top.parse_prometheus(_EXPO)
+    frame = obs_top.render_frame(series, "unix:/tmp/x.sock", now=0.0)
+    assert "cct top" in frame and "unix:/tmp/x.sock" in frame
+    assert "epoch 3" in frame and "2/2 up" in frame
+    lines = frame.splitlines()
+    (w0,) = [ln for ln in lines if ln.startswith("w0")]
+    assert " up " in w0 and " 42" in w0
+    (w1,) = [ln for ln in lines if ln.startswith("w1")]
+    assert "DOWN" in w1
+    # burn shows the WORST node per window, never an average
+    (qos,) = [ln for ln in lines if ln.startswith("batch")]
+    assert "5m=1.25" in qos
+    assert any(ln.startswith("totals:") and "spans=42" in ln
+               for ln in lines)
+    assert lines[-1].startswith("keys: q quit")
+    assert "[paused]" in obs_top.render_frame(series, "x", paused=True,
+                                              now=0.0)
+
+
+# ------------------------------------------------------ flight identity
+
+def test_flight_dump_stamps_node_and_router_epoch(tmp_path):
+    rec = obs_flight.FlightRecorder(capacity=16)
+    rec.set_dump_dir(str(tmp_path))
+    rec.record("probe", ok=True)
+    plain = json.load(open(rec.dump(reason="pre-identity")))
+    assert "node" not in plain and "router_epoch" not in plain
+    rec.set_identity(node="w7")
+    rec.set_identity(epoch=9)  # partial updates compose
+    doc = json.load(open(rec.dump(reason="chaos")))
+    assert doc["node"] == "w7" and doc["router_epoch"] == 9
+    assert doc["reason"] == "chaos"
+    # the module-level helper drives the shared recorder the same way
+    old = (obs_flight.RECORDER._node, obs_flight.RECORDER._epoch)
+    try:
+        obs_flight.set_identity(node="r1", epoch=4)
+        assert obs_flight.RECORDER._node == "r1"
+        assert obs_flight.RECORDER._epoch == 4
+    finally:
+        obs_flight.RECORDER._node, obs_flight.RECORDER._epoch = old

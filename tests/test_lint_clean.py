@@ -41,6 +41,7 @@ def test_repo_is_lint_clean():
     ("serve/viol_locks.py", {"CCT401", "CCT402"}),
     ("serve/viol_jit.py", {"CCT501"}),
     ("viol_obscov.py", {"CCT601", "CCT602", "CCT603"}),
+    ("serve/viol_trace_prop.py", {"CCT604"}),
     ("serve/viol_protocol.py",
      {"CCT701", "CCT702", "CCT703", "CCT704", "CCT705"}),
     ("serve/viol_shared_state.py", {"CCT801", "CCT802", "CCT803"}),
@@ -55,6 +56,7 @@ def test_each_pass_detects_its_seeded_violation(rel, expected):
 @pytest.mark.parametrize("rel", [
     "serve/clean_protocol.py",
     "serve/clean_shared_state.py",
+    "serve/clean_trace_prop.py",
 ])
 def test_protocol_twin_fixtures_are_clean(rel):
     """The conformant twins prove the CCT7/CCT8 rules key on the actual

@@ -24,6 +24,16 @@ CCT603  labeled series are how cardinality explosions happen: label
         ``**splat`` hides them), and any literal ``qos=`` value must be
         one of ``QOS_CLASSES`` — so the exposition's label space is
         closed at lint time, not discovered in production.
+CCT604  fleet tracing only survives kills and failovers if the trace
+        context rides EVERY hand-off.  In serve/ code: (a) a wire ack
+        reply — a dict literal carrying both ``"ok"`` and ``"job_id"``
+        — must also carry ``"trace"`` (the submitter links its next
+        span to the ack span via that context); (b) every
+        ``append_job`` / ``job_record`` call must pass ``trace_id=``
+        (or hide it in a ``**splat``), and one writing a literal
+        ``"accepted"`` state must also persist ``trace=`` — the
+        accepted record is the durable anchor failover resubmits and
+        adoptions link ``follows_from`` after the owner dies.
 
 The registry is loaded standalone (``spec_from_file_location``) — it has
 zero imports by design, so the lint never imports the package under scan.
@@ -272,8 +282,55 @@ def _check_labeled_names(ctx: LintContext, reg: dict) -> list[Finding]:
     return findings
 
 
+def _check_trace_propagation(ctx: LintContext) -> list[Finding]:
+    """CCT604: trace context must ride every serve-layer hand-off — ack
+    replies and journal records are the two durable carriers."""
+    findings: list[Finding] = []
+    for src in ctx.parsed():
+        if not src.in_dirs("serve"):
+            continue
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Dict):
+                keys = {k.value for k in node.keys
+                        if isinstance(k, ast.Constant)
+                        and isinstance(k.value, str)}
+                has_splat = any(k is None for k in node.keys)
+                if {"ok", "job_id"} <= keys and "trace" not in keys \
+                        and not has_splat:
+                    findings.append(Finding(
+                        "CCT604", src.rel, node.lineno,
+                        "ack reply carries 'ok' + 'job_id' but no 'trace' "
+                        "— the submitter cannot link follow-up spans to "
+                        "the ack span; echo the job's wire trace context",
+                        "obscov"))
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            if terminal_name(node) not in ("append_job", "job_record"):
+                continue
+            has_splat = any(kw.arg is None for kw in node.keywords)
+            kwargs = {kw.arg for kw in node.keywords}
+            if "trace_id" not in kwargs and not has_splat:
+                findings.append(Finding(
+                    "CCT604", src.rel, node.lineno,
+                    "journal record written without trace_id= — replay "
+                    "and fleet trace collection lose the job's timeline "
+                    "correlation", "obscov"))
+            state = node.args[1] if len(node.args) > 1 else None
+            if isinstance(state, ast.Constant) and state.value == "accepted" \
+                    and "trace" not in kwargs and not has_splat:
+                findings.append(Finding(
+                    "CCT604", src.rel, node.lineno,
+                    "accepted record persisted without trace= — it is the "
+                    "durable anchor HA continuations (failover resubmit, "
+                    "adoption) must follows_from once the owner is dead",
+                    "obscov"))
+    return findings
+
+
 def run(ctx: LintContext) -> list[Finding]:
     findings = _check_fault_notify(ctx)
+    findings.extend(_check_trace_propagation(ctx))
     reg = _load_registry(ctx)
     if reg is not None:
         findings.extend(_check_metric_names(

@@ -86,6 +86,10 @@ WIRE_REPLY_KEYS = frozenset({
     "unknown", "timeout", "shutdown", "transport", "bad_request",
     # payloads
     "job", "job_id", "state", "key", "health", "metrics", "prometheus",
+    # causal tracing: submit acks echo the accepted job's wire trace
+    # context, keyed polls answered from a dead member's journal carry
+    # the original context, and the ``trace`` op returns event buffers
+    "trace",
     # router ops
     "drained", "errors", "adopted", "jobs_adopted", "keys",
     "node", "address", "node_address", "stolen", "fleet_size",

@@ -25,7 +25,12 @@ After EVERY event the invariants are re-checked:
   * no acknowledged job is lost (every key still resolves, none failed);
   * the ring-view epoch is monotone (strictly increases across events
     that change the view — takeover, membership);
-  * each live router's cumulative counters are monotone.
+  * each live router's cumulative counters are monotone;
+  * trace completeness (``tools/trace_check.py``): every journal agrees
+    on each key's trace_id, every trace's span tree is one connected
+    component across the kill/steal/adoption hops, and a journal-proven
+    terminal job has a durable trace-terminal event — the fleet runs
+    with ``CCT_TRACE=1`` and shards under ``<workdir>/traces``.
 
 At the end every dead-but-not-permanent worker is restarted, every
 acknowledged job is driven to ``done``, and every output tree is
@@ -60,6 +65,8 @@ sys.path.insert(0, _REPO)
 sys.path.insert(0, os.path.join(_REPO, "tools"))
 sys.path.insert(0, os.path.join(_REPO, "test"))
 
+import trace_check  # noqa: E402
+from consensuscruncher_tpu.obs import trace as obs_trace  # noqa: E402
 from consensuscruncher_tpu.serve.client import ServeClient  # noqa: E402
 from serve_soak import BOOT, check_golden, job_spec  # noqa: E402
 
@@ -119,6 +126,11 @@ class Conductor:
         self.max_unique_jobs = int(max_unique_jobs)
         self.logdir = os.path.join(self.workdir, "logs")
         os.makedirs(self.logdir, exist_ok=True)
+        # every spawned process flushes spans here; the shards are what
+        # the per-event trace-completeness check reads — kill -9 victims
+        # included, since the ack/terminal flush points precede the acks
+        self.trace_dir = os.path.join(self.workdir, "traces")
+        os.makedirs(self.trace_dir, exist_ok=True)
         self.ring_view = os.path.join(self.workdir, "ring.view")
         self.golden = json.load(
             open(os.path.join(_REPO, "test", "golden.json")))
@@ -164,6 +176,8 @@ class Conductor:
     def _popen(self, tag: str, argv: list, fault: str | None) -> subprocess.Popen:
         env = dict(os.environ)
         env.pop("CCT_FAULTS", None)
+        env["CCT_TRACE"] = "1"
+        env["CCT_TRACE_DIR"] = self.trace_dir
         if fault:
             env["CCT_FAULTS"] = fault
             self._log(f"  (spawning {tag} with CCT_FAULTS={fault})")
@@ -256,12 +270,19 @@ class Conductor:
         else:  # re-submit an existing spec: must dedup to the same key
             out = self.rng.choice(self.acked)["out"]
         spec = job_spec(out)
-        sub = self.client.submit_full(spec)
         dup = [a for a in self.acked if a["out"] == out]
+        # a logical re-submit continues the original ack's trace context
+        # (the wire-propagation contract for clients that retry a known
+        # job) — otherwise a router that lost its placement cache in a
+        # takeover would mint a fresh trace for the same dedup key
+        sub = self.client.submit_full(
+            spec, trace=dup[0].get("trace") if dup else None)
         if dup and dup[0]["key"] != sub["key"]:
             self._violate(f"resubmit of {out} got key {sub['key']} != "
                           f"original {dup[0]['key']}")
-        self.acked.append({"key": sub["key"], "out": out, "spec": spec})
+        self.acked.append({"key": sub["key"], "out": out, "spec": spec,
+                           "trace": sub.get("trace")
+                           or (dup[0].get("trace") if dup else None)})
         self._log(f"submit -> key {sub['key']} on {sub.get('node')}"
                   + (" (duplicate)" if sub.get("duplicate") else ""))
 
@@ -444,6 +465,51 @@ class Conductor:
 
     # --------------------------------------------------------- invariants
 
+    def _journal_paths(self) -> list:
+        return [w["journal"] for w in self.workers.values()
+                if os.path.exists(w["journal"])]
+
+    def _live_trace_groups(self) -> list:
+        """Best-effort pull of every LIVE process's in-memory span ring
+        over the wire (the ``{"op": "trace", "fleet": true}`` fan-out).
+        Live rings matter: a surviving router's linking span may not have
+        hit its on-disk shard yet, and checking shards alone would
+        misread that unflushed edge as a disconnected component."""
+        try:
+            buffers = self.check_client.request(
+                {"op": "trace", "fleet": True}, timeout=30.0)["trace"]
+        except Exception:
+            return []
+        if isinstance(buffers, dict):
+            buffers = [buffers]
+        groups = []
+        for buf in buffers or []:
+            events = (buf or {}).get("events") or []
+            node = (buf or {}).get("node")
+            if node:
+                for ev in events:
+                    ev.setdefault("node", node)
+            groups.append(events)
+        return groups
+
+    def check_trace(self, where: str) -> dict:
+        """The fleet trace-completeness invariant, re-asserted after every
+        event: all journals agree on each key's trace_id, every trace's
+        span tree is one connected component (follows_from links stitch
+        across kills/steals/adoptions), and journal-terminal implies a
+        durable trace-terminal event.  Merges the flushed shards off
+        ``CCT_TRACE_DIR`` (what a post-mortem would have) with the live
+        fleet's in-memory rings (what a kill -9 would lose), deduped —
+        the same merge ``cct trace fleet`` ships."""
+        shard_events, _ = trace_check._load_events(self.trace_dir)
+        groups = [shard_events] + self._live_trace_groups()
+        merged = os.path.join(self.workdir, "trace_merged.json")
+        obs_trace.merge_fleet_trace(groups, merged)
+        summary = trace_check.fleet_summary(merged, self._journal_paths())
+        for p in summary["problems"]:
+            self._violate(f"[{where}] trace: {p}")
+        return summary
+
     def check_invariants(self, where: str) -> None:
         doc = read_ring_view(self.ring_view)
         if doc is not None:
@@ -515,6 +581,7 @@ class Conductor:
                 except Exception as e:
                     self._violate(f"event {name} raised: {e!r}")
                 self.check_invariants(f"event {i + 1}:{name}")
+                self.check_trace(f"event {i + 1}:{name}")
                 time.sleep(self.rng.uniform(0.2, 1.0))
             return self.finish()
         finally:
@@ -558,14 +625,21 @@ class Conductor:
             self._violate("schedule finished without a router takeover")
         if self.adoptions_seen < 1:
             self._violate("schedule finished without a journal adoption")
+        self.trace_summary = self.check_trace("finish")
+        if self.trace_summary["spans"] <= 0:
+            self._violate("no trace spans survived the schedule (fleet "
+                          "was spawned with CCT_TRACE=1; shards missing)")
         return self.report()
 
     def report(self) -> int:
         n_jobs = len({a['out'] for a in self.acked})
+        tr = getattr(self, "trace_summary", None) or {}
         self._log(f"summary: {len(self.acked)} submits over {n_jobs} "
                   f"unique job(s), {self.takeovers_seen} takeover(s), "
                   f"{self.adoptions_seen} adoption(s), final epoch "
-                  f"{self.last_epoch}")
+                  f"{self.last_epoch}, {tr.get('spans', 0)} trace "
+                  f"span(s) in {tr.get('traces', 0)} trace(s), "
+                  f"{tr.get('orphans', 0)} orphan(s)")
         if self.violations:
             for v in self.violations:
                 print(f"chaos: FAIL {v}", file=sys.stderr, flush=True)

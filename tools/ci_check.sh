@@ -173,15 +173,20 @@ from consensuscruncher_tpu.serve.client import ServeClient
 GOLDEN = json.load(open(os.path.join(REPO, "test", "golden.json")))
 SAMPLE = os.path.join(REPO, "test", "data", "sample.bam")
 sock = os.path.join(WORK, "route.sock")
+TRACES = os.path.join(WORK, "traces")
 boot = ("import sys; sys.path.insert(0, %r); "
         "from consensuscruncher_tpu.cli import main; "
         "sys.exit(main(sys.argv[1:]))" % REPO)
 log = open(os.path.join(WORK, "router.log"), "wb")
+# CCT_TRACE_DIR makes every process (router + spawned workers inherit the
+# env) flush spans to per-pid shards, so the kill -9 victim's ack span
+# survives for the fleet trace-completeness check below
 router = subprocess.Popen(
     [sys.executable, "-c", boot, "route", "--spawn", "2",
      "--workdir", WORK, "--socket", sock, "--backend", "xla_cpu",
      "--gang_size", "1", "--queue_bound", "8", "--drain_s", "60"],
-    stdout=log, stderr=subprocess.STDOUT)
+    stdout=log, stderr=subprocess.STDOUT,
+    env=dict(os.environ, CCT_TRACE="1", CCT_TRACE_DIR=TRACES))
 ok = False
 try:
     client = ServeClient(sock, retries=60, retry_base_s=0.25)
@@ -210,9 +215,19 @@ try:
     cum = client.request({"op": "metrics"}, timeout=60)["metrics"]["cumulative"]
     assert cum["member_down_events"] >= 1, cum
     assert cum["route_resubmits"] >= 1, cum
+    assert cum.get("trace_spans_emitted", 0) > 0, cum
+    # merge the fleet timeline while the router is still up: live buffers
+    # over the wire + the dead victim's flushed shards from CCT_TRACE_DIR
+    from consensuscruncher_tpu.cli import main as cct_main
+    merged = os.path.join(WORK, "trace_fleet.json")
+    cct_main(["trace", "fleet", "--socket", sock, "--dir", TRACES,
+              "--out", merged])
+    n_events = len(json.load(open(merged))["traceEvents"])
+    assert n_events > 0, "fleet trace merge produced no events"
     ok = True
     print("ci_check: fleet smoke OK (killed %s; %d jobs byte-identical; "
-          "resubmits=%d)" % (victim, len(subs), cum["route_resubmits"]))
+          "resubmits=%d; %d trace events merged)"
+          % (victim, len(subs), cum["route_resubmits"], n_events))
 finally:
     router.send_signal(signal.SIGTERM)
     try:
@@ -223,6 +238,14 @@ finally:
     if not ok:
         sys.stderr.write(open(os.path.join(WORK, "router.log")).read()[-8000:])
 PY
+
+echo "== fleet trace completeness (killed-owner span tree connected) =="
+# the invariant the tracing layer exists to uphold: every acked job —
+# including the one whose owner took a kill -9 mid-run — yields ONE
+# connected span tree from submit ack to terminal record, stitched
+# across both workers and the router by follows_from links
+PYTHONPATH="$REPO" python tools/trace_check.py --fleet \
+  "$WORK/fleet/trace_fleet.json" --journals "$WORK"/fleet/*.journal
 
 echo "== router HA smoke (kill -9 the ACTIVE router; standby takes over) =="
 JAX_PLATFORMS=cpu PYTHONPATH="$REPO" python - "$WORK/ha" <<'PY'
