@@ -1021,7 +1021,7 @@ def _serve_child_argv(args) -> list[str]:
     the resolved values (flag > config > builtin), minus --supervise."""
     argv = ["serve"]
     for flag in ("socket", "host", "warmup_shapes", "compile_cache",
-                 "journal", "backend", "node"):
+                 "journal", "backend", "node", "result_cache", "warm_from"):
         value = getattr(args, flag, None)
         if value:
             argv += [f"--{flag}", str(value)]
@@ -1086,6 +1086,27 @@ def serve_cmd(args) -> None:
     if backend == "xla_cpu":
         backend = "tpu"  # same jitted path pinned to the CPU platform
 
+    # Warm-join: a late-spawned member reads the fleet's warm state (XLA
+    # compile cache dir, autotune table, result-cache plane) off the
+    # epoch-numbered ring-view document and joins hot — the ladder warm
+    # below compiles against the SHARED caches, so post-join traffic
+    # shows unexpected_recompiles() == 0 instead of re-learning.
+    warm: dict = {}
+    warm_from = getattr(args, "warm_from", None)
+    if warm_from:
+        from consensuscruncher_tpu.serve.router import RingView
+
+        doc = RingView(warm_from).load() or {}
+        warm = dict(doc.get("warm") or {})
+        if warm:
+            print(f"serve: warm-join state from {warm_from} "
+                  f"(epoch {doc.get('epoch')}): {', '.join(sorted(warm))}")
+        else:
+            print(f"WARNING: serve: --warm_from {warm_from} carries no "
+                  "warm state; joining cold", file=sys.stderr, flush=True)
+    if not args.compile_cache and warm.get("compile_cache"):
+        args.compile_cache = str(warm["compile_cache"])
+
     if args.compile_cache:
         if warmup.setup_compilation_cache(args.compile_cache):
             print(f"serve: persistent compile cache at {args.compile_cache}")
@@ -1098,7 +1119,7 @@ def serve_cmd(args) -> None:
     # then warm the most-seen live shapes and mark the recompile baseline —
     # compiles after this point are unexpected under the learned table.
     at_cfg = warmup.load_autotune_config(getattr(args, "config", None))
-    table_path = at_cfg["table_path"] or (
+    table_path = at_cfg["table_path"] or warm.get("autotune_table") or (
         os.path.join(args.compile_cache, warmup.DEFAULT_TABLE_NAME)
         if args.compile_cache else None)
     autotuner = warmup.BucketAutotuner(
@@ -1175,6 +1196,8 @@ def serve_cmd(args) -> None:
         tenant_queue_cap=_cap("tenant_queue_cap"),
         tenant_inflight_cap=_cap("tenant_inflight_cap"),
         node=getattr(args, "node", None) or None,
+        result_cache=(getattr(args, "result_cache", None)
+                      or warm.get("result_cache") or None),
     )
     scheduler.autotune_info = lambda: {
         "shapes": len(autotuner.table),
@@ -1299,7 +1322,7 @@ def _spawn_fleet(args, children: dict) -> list:
             "--backend", args.backend,
         ]
         for flag in ("warmup_shapes", "class_weights", "slo_targets",
-                     "drain_s"):
+                     "drain_s", "result_cache"):
             value = getattr(args, flag, None)
             if value not in (None, ""):
                 serve_argv += [f"--{flag}", str(value)]
@@ -1401,6 +1424,24 @@ def route_cmd(args) -> None:
     standby = _bool(getattr(args, "standby", "False") or "False")
     adopt_after_s = getattr(args, "adopt_after_s", "")
     adopt_after_s = None if adopt_after_s in (None, "") else float(adopt_after_s)
+    # content-addressed cache plane + the warm-join state published in
+    # every ring-view epoch record (what `serve --warm_from` reads)
+    result_cache = getattr(args, "result_cache", "") or None
+    if result_cache:
+        result_cache = os.path.abspath(result_cache)
+    cache_journal = getattr(args, "cache_journal", "") or None
+    if not cache_journal and result_cache:
+        cache_journal = os.path.join(result_cache, "cache_answers.journal")
+    from consensuscruncher_tpu.serve.warmup import DEFAULT_TABLE_NAME
+
+    warm_state = {
+        "compile_cache": (os.path.abspath(args.compile_cache)
+                          if getattr(args, "compile_cache", "") else None),
+        "autotune_table": (os.path.join(os.path.abspath(args.compile_cache),
+                                        DEFAULT_TABLE_NAME)
+                           if getattr(args, "compile_cache", "") else None),
+        "result_cache": result_cache,
+    }
     router = Router(
         members,
         vnodes=int(args.vnodes),
@@ -1414,6 +1455,9 @@ def route_cmd(args) -> None:
         takeover_after=int(getattr(args, "takeover_after", 3) or 3),
         adopt_after_s=adopt_after_s,
         journals=journals or None,
+        result_cache=result_cache,
+        cache_journal=cache_journal,
+        warm_state=warm_state,
         start_monitor=False,  # started below, once the advertise
     )                         # address is known
     from consensuscruncher_tpu.obs import flight as obs_flight
@@ -1748,6 +1792,18 @@ def build_parser() -> argparse.ArgumentParser:
                    help="fleet member name this daemon serves as (set by "
                         "'cct route --spawn'; surfaced in healthz/metrics "
                         "for node-labeled dashboards); empty = standalone")
+    s.add_argument("--result_cache",
+                   help="root of the fleet content-addressed result-cache "
+                        "plane: finished jobs are committed by content "
+                        "digest and identical jobs (any tenant) are "
+                        "answered byte-identically without recomputing; "
+                        "empty = caching off")
+    s.add_argument("--warm_from",
+                   help="ring-view document path to warm-join from: adopt "
+                        "the fleet's shared compile cache, autotune table "
+                        "and result-cache plane published in the epoch "
+                        "record, so this member joins hot "
+                        "(unexpected_recompiles stays 0); empty = cold")
     s.set_defaults(func=serve_cmd, config_section="serve", required_args=(),
                    builtin_defaults={
                        "socket": "", "host": "127.0.0.1", "port": 7733,
@@ -1758,7 +1814,7 @@ def build_parser() -> argparse.ArgumentParser:
                        "supervise": "False", "max_restarts": 10,
                        "class_weights": "", "slo_targets": "",
                        "tenant_queue_cap": "", "tenant_inflight_cap": "",
-                       "node": "",
+                       "node": "", "result_cache": "", "warm_from": "",
                    })
 
     r = sub.add_parser(
@@ -1857,6 +1913,18 @@ def build_parser() -> argparse.ArgumentParser:
     r.add_argument("--adopt_force",
                    help="with --adopt: adopt even if the member still "
                         "answers health probes (default False)")
+    r.add_argument("--result_cache",
+                   help="root of the fleet content-addressed result-cache "
+                        "plane: the router consults it BEFORE dispatch "
+                        "(a committed entry answers the submit without "
+                        "touching a worker), spawned workers insert into "
+                        "it, and its path is published as warm-join "
+                        "state in the ring view; empty = caching off")
+    r.add_argument("--cache_journal",
+                   help="path of the router's cache-answer journal "
+                        "(fsync'd before each cached reply so keyed "
+                        "polls survive a router kill -9; default: "
+                        "cache_answers.journal under --result_cache)")
     r.set_defaults(func=route_cmd, config_section="route", required_args=(),
                    builtin_defaults={
                        "members": "", "spawn": 0, "workdir": "",
@@ -1873,6 +1941,7 @@ def build_parser() -> argparse.ArgumentParser:
                        "adopt_after_s": "", "journals": "",
                        "advertise": "", "adopt": "",
                        "adopt_force": "False",
+                       "result_cache": "", "cache_journal": "",
                    })
 
     t = sub.add_parser(

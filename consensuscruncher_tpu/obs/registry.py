@@ -93,6 +93,24 @@ COUNTERS = {
                      "protocol-invariant violation or deadlock",
     "mc_deadlocks": "explored schedules that ended with no runnable task "
                     "(a real lock-ordering or lost-wakeup deadlock)",
+    "cache_hits": "jobs answered from the content-addressed result cache "
+                  "(router consult-before-dispatch and worker-side lookups "
+                  "both count here; the job never reran)",
+    "cache_misses": "cacheable jobs that found no committed entry and ran "
+                    "the full pipeline",
+    "cache_negative_hits": "cache hits on negative entries (a run that "
+                           "provably produced zero consensus families, "
+                           "e.g. an empty --input_range slice)",
+    "cache_inserts": "result-cache entries committed after a successful "
+                     "run (payload + entry doc, all via commit_file)",
+    "cache_evictions": "result-cache entries evicted to stay under the "
+                       "configured byte budget (oldest first)",
+    "cache_bytes": "payload bytes currently resident in this process's "
+                   "result-cache shard (recounted at insert/evict)",
+    "route_cache_answers": "router submits answered straight from the "
+                           "result cache without dispatching to a worker "
+                           "(journaled like a terminal journal-answer so "
+                           "keyed polls survive a router kill -9)",
 }
 
 CUMULATIVE_KEYS = tuple(COUNTERS)

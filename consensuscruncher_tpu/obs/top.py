@@ -214,8 +214,24 @@ def render_frame(series: dict, source: str,
                          f"{_fmt_s(row.get('p50')):>8} "
                          f"{_fmt_s(row.get('p99')):>8}  {burns}")
 
+    # cache panel: fleet-wide content-addressed result-cache health.
+    # hit% is hits/(hits+misses) over every process's cumulative series
+    # (router consult-before-dispatch + worker-side lookups).
+    hits = _sum(series, "cct_cache_hits_total")
+    misses = _sum(series, "cct_cache_misses_total")
+    if hits or misses or "cct_cache_inserts_total" in series:
+        rate = 100.0 * hits / (hits + misses) if (hits + misses) else 0.0
+        lines.append(
+            f"cache: hits={_fmt_n(hits)}  misses={_fmt_n(misses)}  "
+            f"hit%={rate:.1f}  "
+            f"neg={_fmt_n(_sum(series, 'cct_cache_negative_hits_total'))}  "
+            f"inserts={_fmt_n(_sum(series, 'cct_cache_inserts_total'))}  "
+            f"evicted={_fmt_n(_sum(series, 'cct_cache_evictions_total'))}  "
+            f"bytes={_fmt_n(_sum(series, 'cct_cache_bytes_total'))}")
+
     totals = [
         ("routed", "cct_jobs_routed_total"),
+        ("cache_answers", "cct_route_cache_answers_total"),
         ("steals", "cct_route_steals_total"),
         ("resubmits", "cct_route_resubmits_total"),
         ("adoptions", "cct_jobs_adopted_total"),

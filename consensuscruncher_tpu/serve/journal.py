@@ -54,6 +54,7 @@ import os
 import sys
 import tempfile
 
+from consensuscruncher_tpu import __version__
 from consensuscruncher_tpu.obs import trace as obs_trace
 from consensuscruncher_tpu.utils import faults, sanitize
 from consensuscruncher_tpu.utils.manifest import commit_file
@@ -64,18 +65,48 @@ from consensuscruncher_tpu.utils.manifest import commit_file
 #: ``tenant``/``qos`` ARE identity: two tenants submitting the same
 #: paths are distinct jobs (quotas and SLO accounting must not cross),
 #: but both fields are omitted when absent so pre-tenancy specs keep
-#: their historical keys.
+#: their historical keys.  ``input_range`` is identity too: two shards
+#: of the same input are different jobs with different outputs.
 KEY_FIELDS = ("input", "output", "name", "cutoff", "qualscore", "scorrect",
-              "max_mismatch", "bdelim", "compress_level", "tenant", "qos")
+              "max_mismatch", "bdelim", "compress_level", "tenant", "qos",
+              "input_range")
+
+#: The pre-v2 field set (no ``input_range``, no version pin) — kept so
+#: :func:`legacy_idempotency_key` can resolve keys written by journals
+#: from before the cache plane landed.
+_LEGACY_KEY_FIELDS = ("input", "output", "name", "cutoff", "qualscore",
+                      "scorrect", "max_mismatch", "bdelim", "compress_level",
+                      "tenant", "qos")
+
+
+def _key_over(spec: dict, fields, version: str | None) -> str:
+    ident = {k: spec.get(k) for k in fields if spec.get(k) is not None}
+    if version is not None:
+        ident["__v"] = version
+    blob = json.dumps(ident, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
 
 
 def idempotency_key(spec: dict) -> str:
     """Stable identity of a job spec: sha256 over the sorted-keys compact
     JSON of the normalized identity fields.  Two submits of the same work
-    hash identically regardless of field order or extra protocol keys."""
-    ident = {k: spec.get(k) for k in KEY_FIELDS if spec.get(k) is not None}
-    blob = json.dumps(ident, sort_keys=True, separators=(",", ":"))
-    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+    hash identically regardless of field order or extra protocol keys.
+
+    v2: the digest pins the package ``__version__`` (a code upgrade can
+    change output bytes, so a stale pre-upgrade key must not claim the
+    post-upgrade job) and includes ``input_range`` (shards of one input
+    are distinct jobs).  Journals written under v1 keys still replay:
+    the scheduler's recovery path registers replayed jobs under BOTH the
+    journaled key and the recomputed one (see ``Scheduler._recover``),
+    and :func:`legacy_idempotency_key` reproduces the v1 digest."""
+    return _key_over(spec, KEY_FIELDS, __version__)
+
+
+def legacy_idempotency_key(spec: dict) -> str:
+    """The pre-cache-plane (v1) key of a spec: no version pin, no
+    ``input_range``.  Migration shim only — used at journal replay so a
+    client still polling a v1 key resolves against the replayed job."""
+    return _key_over(spec, _LEGACY_KEY_FIELDS, None)
 
 
 def job_record(job_id: int, state: str, *, key: str | None = None,

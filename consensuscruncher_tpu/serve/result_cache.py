@@ -1,0 +1,334 @@
+"""Fleet-wide content-addressed result cache (the serve plane's hit-rate
+lever).
+
+At fleet scale the traffic is dominated by overlapping questions: the
+same BAM, the same consensus policy, submitted by many tenants.  The
+per-worker journal already dedupes *exact resubmits within one journal*
+(``journal.idempotency_key``), but identity there includes ``tenant``/
+``qos``/``output`` — correct for quota accounting, useless for sharing
+work.  This module keys results by what actually determines the bytes:
+
+  content digest = sha256 over the sorted-keys compact JSON of
+    {input fingerprint (``manifest.fingerprint``: size + head/tail
+     hashes), derived job name, consensus policy fields (cutoff,
+     qualscore, scorrect, max_mismatch, bdelim, compress_level),
+     input_range (when sharded), package ``__version__``}
+
+``tenant``, ``qos``, ``output`` and ``deadline_s`` are deliberately
+EXCLUDED: two tenants asking the same question hit the same entry (the
+whole point), and the payload is materialized into *their* output tree.
+``__version__`` is INCLUDED: a code upgrade invalidates every entry by
+construction — no epoch bookkeeping, no stale-result window.
+
+Store layout (``<root>`` is the cache plane dir, shared or per-member)::
+
+    <root>/<shard>/<digest[:2]>/<digest>/payload/<relpath...>
+    <root>/<shard>/<digest[:2]>/<digest>/entry.json
+
+``shard`` is the owning member's name — placement rides the same
+consistent-hash ring as job routing (the router passes the digest's
+ring owner as ``preferred_shard``), so a cache entry lives where the
+job that produced it ran, and lookups check the ring home first before
+sweeping peers.
+
+Durability discipline (enforced by cctlint's cache-store pass, CCT9xx):
+every byte that lands under ``<root>`` goes through
+``manifest.commit_file`` (fsync + rename + dir-fsync).  ``entry.json``
+is committed LAST — it is the linearization point.  A reader that finds
+``entry.json`` is guaranteed every payload file is durable and complete;
+a crash mid-insert leaves at worst an invisible partial payload that a
+later insert of the same digest simply overwrites.  There is no
+read-repair and no locking between processes: inserts of the same
+digest are idempotent byte-identical writes.
+
+Negative entries: a run that provably produced zero consensus families
+(an empty ``--input_range`` slice, a filtered-out input) is cached with
+``negative: true``.  The payload (empty outputs) still materializes
+byte-identically; the flag exists so hits on known-empty work are
+counted separately (``cache_negative_hits``) and so range planners can
+skip slices that are known-empty without reading BAM bytes.
+
+Fault site ``serve.cache``: fired on every lookup and insert.  The
+cache is an optimization, never a correctness dependency — callers
+catch the fault (and any real IO error) and degrade to recomputing.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import sys
+import tempfile
+import time
+
+from consensuscruncher_tpu import __version__
+from consensuscruncher_tpu.utils import faults, sanitize
+from consensuscruncher_tpu.utils.manifest import commit_file, fingerprint
+
+#: Policy fields folded into the content digest.  Together with the
+#: input fingerprint and ``__version__`` these determine the output
+#: bytes; nothing else does (tenant/qos/output/deadline are routing and
+#: accounting concerns, not identity).
+DIGEST_FIELDS = ("cutoff", "qualscore", "scorrect", "max_mismatch",
+                 "bdelim", "compress_level", "input_range")
+
+ENTRY_NAME = "entry.json"
+LOCAL_SHARD = "local"
+
+
+def content_digest(spec: dict) -> str | None:
+    """Content digest of a job spec, or ``None`` when the input cannot be
+    fingerprinted (missing/unreadable file -> not cacheable; the submit
+    path will surface the real error).  The derived job *name* is part
+    of the digest because output filenames embed it — two names produce
+    byte-identical content under different paths, which is not the
+    byte-identical contract the cache promises."""
+    path = spec.get("input")
+    if not path:
+        return None
+    fp = fingerprint(str(path))
+    if fp is None:
+        return None
+    name = spec.get("name") or os.path.basename(str(path)).split(".")[0]
+    ident: dict = {"fp": fp, "name": name, "v": __version__}
+    for k in DIGEST_FIELDS:
+        if spec.get(k) is not None:
+            ident[k] = spec.get(k)
+    blob = json.dumps(ident, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(blob.encode()).hexdigest()[:32]
+
+
+def _walk_files(base: str) -> list[str]:
+    """Relative paths of every regular file under ``base``, sorted for a
+    deterministic entry doc (symlinks and special files are skipped —
+    the pipeline never writes them)."""
+    out = []
+    for dirpath, _dirnames, filenames in os.walk(base):
+        for fn in filenames:
+            full = os.path.join(dirpath, fn)
+            if os.path.isfile(full) and not os.path.islink(full):
+                out.append(os.path.relpath(full, base))
+    return sorted(out)
+
+
+def _copy_committed(src: str, dest: str) -> int:
+    """Copy one file into place via tmp + ``commit_file``; returns bytes.
+    The tmp file lives in the destination directory so the final rename
+    is same-filesystem atomic."""
+    dest_dir = os.path.dirname(os.path.abspath(dest))
+    os.makedirs(dest_dir, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(prefix=".cache.", dir=dest_dir)
+    try:
+        n = 0
+        with os.fdopen(fd, "wb") as out, open(src, "rb") as inp:
+            while True:
+                chunk = inp.read(1 << 20)
+                if not chunk:
+                    break
+                out.write(chunk)
+                n += len(chunk)
+        commit_file(tmp, dest)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+    return n
+
+
+class ResultCache:
+    """One process's handle on the cache plane rooted at ``root``.
+
+    ``node`` names this process's shard (where its inserts land);
+    lookups read every shard, preferring ``preferred_shard`` (the ring
+    owner) so the common case is one directory probe.  ``max_bytes``
+    bounds THIS shard's payload bytes; eviction is oldest-entry-first
+    and only ever touches the local shard (peers own theirs).
+    """
+
+    def __init__(self, root: str, node: str | None = None,
+                 max_bytes: int | None = None):
+        self.root = str(root)
+        self.node = str(node or LOCAL_SHARD)
+        self.max_bytes = int(max_bytes) if max_bytes else None
+        os.makedirs(os.path.join(self.root, self.node), exist_ok=True)
+        self._lock = sanitize.tracked_lock("result_cache.lock")
+
+    # ------------------------------------------------------------ layout
+
+    def entry_dir(self, digest: str, shard: str | None = None) -> str:
+        return os.path.join(self.root, shard or self.node,
+                            digest[:2], digest)
+
+    def _shards(self) -> list[str]:
+        try:
+            names = [d for d in sorted(os.listdir(self.root))
+                     if os.path.isdir(os.path.join(self.root, d))]
+        except OSError:
+            return [self.node]
+        return names
+
+    # ------------------------------------------------------------ lookup
+
+    def lookup(self, digest: str,
+               preferred_shard: str | None = None) -> dict | None:
+        """Find a committed entry for ``digest`` anywhere in the plane.
+        Returns the entry doc (with ``shard`` and ``dir`` annotated) or
+        ``None``.  ``serve.cache`` fires here: an armed fault makes the
+        lookup miss, never fail the caller."""
+        try:
+            faults.fault_point("serve.cache")
+        except faults.FaultError as e:
+            print(f"WARNING: result cache: lookup degraded to miss ({e})",
+                  file=sys.stderr, flush=True)
+            return None
+        shards = self._shards()
+        if preferred_shard and preferred_shard in shards:
+            shards.remove(preferred_shard)
+            shards.insert(0, preferred_shard)
+        elif self.node in shards:
+            shards.remove(self.node)
+            shards.insert(0, self.node)
+        for shard in shards:
+            entry = self._read_entry(digest, shard)
+            if entry is not None:
+                return entry
+        return None
+
+    def _read_entry(self, digest: str, shard: str) -> dict | None:
+        edir = self.entry_dir(digest, shard)
+        path = os.path.join(edir, ENTRY_NAME)
+        try:
+            with open(path) as fh:
+                entry = json.load(fh)
+        except (OSError, ValueError):
+            return None
+        if not isinstance(entry, dict) or entry.get("digest") != digest:
+            return None
+        entry["shard"] = shard
+        entry["dir"] = edir
+        return entry
+
+    # ------------------------------------------------------------ insert
+
+    def insert(self, digest: str, base_dir: str, *,
+               negative: bool = False, meta: dict | None = None) -> dict | None:
+        """Commit the finished job's output tree under ``base_dir`` as a
+        cache entry in this process's shard.  Payload files first (each
+        via ``commit_file``), ``entry.json`` last — the entry is visible
+        only once every payload byte is durable.  Idempotent: an entry
+        that already exists is left alone (same digest -> same bytes).
+        Returns the committed entry doc, or ``None`` when the insert was
+        skipped or degraded (armed fault / IO error)."""
+        try:
+            faults.fault_point("serve.cache")
+        except faults.FaultError as e:
+            print(f"WARNING: result cache: insert skipped ({e})",
+                  file=sys.stderr, flush=True)
+            return None
+        existing = self._read_entry(digest, self.node)
+        if existing is not None:
+            return existing
+        if not os.path.isdir(base_dir):
+            return None
+        edir = self.entry_dir(digest, self.node)
+        payload_dir = os.path.join(edir, "payload")
+        files = []
+        total = 0
+        try:
+            for rel in _walk_files(base_dir):
+                n = _copy_committed(os.path.join(base_dir, rel),
+                                    os.path.join(payload_dir, rel))
+                files.append({"path": rel, "size": n})
+                total += n
+            entry = {"v": 1, "digest": digest, "negative": bool(negative),
+                     "bytes": total, "files": files, "node": self.node,
+                     "t": time.time()}
+            if meta:
+                entry["meta"] = dict(meta)
+            fd, tmp = tempfile.mkstemp(prefix=".entry.", dir=edir)
+            try:
+                with os.fdopen(fd, "w") as fh:
+                    json.dump(entry, fh, sort_keys=True)
+                commit_file(tmp, os.path.join(edir, ENTRY_NAME))
+            except BaseException:
+                if os.path.exists(tmp):
+                    os.unlink(tmp)
+                raise
+        except OSError as e:
+            print(f"WARNING: result cache: insert of {digest} failed ({e}); "
+                  "recompute path unaffected", file=sys.stderr, flush=True)
+            return None
+        entry["shard"] = self.node
+        entry["dir"] = edir
+        return entry
+
+    # -------------------------------------------------------- materialize
+
+    def materialize(self, entry: dict, dest_base: str) -> int:
+        """Copy a committed entry's payload into ``dest_base`` (the
+        requesting job's own output tree), each file via ``commit_file``
+        so a crash mid-materialize never leaves a partial output a
+        ``--resume`` run would trust.  Returns bytes written."""
+        payload_dir = os.path.join(entry["dir"], "payload")
+        total = 0
+        for f in entry.get("files", []):
+            rel = f["path"]
+            total += _copy_committed(os.path.join(payload_dir, rel),
+                                     os.path.join(dest_base, rel))
+        return total
+
+    # ----------------------------------------------------------- eviction
+
+    def shard_stats(self) -> dict:
+        """``{"entries", "bytes"}`` for THIS shard (committed entries
+        only — invisible partial payloads don't count)."""
+        entries = 0
+        total = 0
+        shard_dir = os.path.join(self.root, self.node)
+        for dirpath, _dirnames, filenames in os.walk(shard_dir):
+            if ENTRY_NAME not in filenames:
+                continue
+            entry = self._read_entry(os.path.basename(dirpath), self.node)
+            if entry is None:
+                continue
+            entries += 1
+            total += int(entry.get("bytes", 0))
+        return {"entries": entries, "bytes": total}
+
+    def evict_to_budget(self) -> list[dict]:
+        """Drop oldest committed entries from the local shard until its
+        payload bytes fit ``max_bytes``.  The entry doc is unlinked
+        FIRST (the entry disappears atomically for readers), payload
+        files after — the reverse of insert order, so no reader ever
+        sees a visible entry with missing payload.  Returns the evicted
+        entry docs."""
+        if not self.max_bytes:
+            return []
+        with self._lock:
+            live = []
+            shard_dir = os.path.join(self.root, self.node)
+            for dirpath, _dirnames, filenames in os.walk(shard_dir):
+                if ENTRY_NAME not in filenames:
+                    continue
+                entry = self._read_entry(os.path.basename(dirpath), self.node)
+                if entry is not None:
+                    live.append(entry)
+            total = sum(int(e.get("bytes", 0)) for e in live)
+            live.sort(key=lambda e: e.get("t", 0.0))
+            evicted = []
+            while live and total > self.max_bytes:
+                entry = live.pop(0)
+                try:
+                    os.unlink(os.path.join(entry["dir"], ENTRY_NAME))
+                except OSError:
+                    continue
+                for f in entry.get("files", []):
+                    try:
+                        os.unlink(os.path.join(entry["dir"], "payload",
+                                               f["path"]))
+                    except OSError:
+                        pass
+                total -= int(entry.get("bytes", 0))
+                evicted.append(entry)
+            return evicted

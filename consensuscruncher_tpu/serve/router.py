@@ -232,9 +232,13 @@ class RingView:
 
     def publish(self, epoch: int, router: str, address,
                 members: list[tuple[str, object]],
-                journals: dict | None = None) -> dict:
+                journals: dict | None = None,
+                warm: dict | None = None) -> dict:
         """Append one fsync'd epoch record (compacting first when the doc
-        has grown past ``max_records``); returns the record."""
+        has grown past ``max_records``); returns the record.  ``warm`` is
+        the fleet's warm-join state — paths to the shared XLA compile
+        cache dir, the autotune table and the result-cache plane — so a
+        member spawned later reads ONE document and joins hot."""
         rec = {
             "v": 1, "epoch": int(epoch), "router": str(router),
             "address": (list(address)
@@ -245,6 +249,8 @@ class RingView:
         }
         if journals:
             rec["journals"] = dict(journals)
+        if warm:
+            rec["warm"] = {k: v for k, v in warm.items() if v}
         line = json.dumps(rec, sort_keys=True,
                           separators=(",", ":")).encode() + b"\n"
         with self._lock:
@@ -365,7 +371,9 @@ class Router:
                  router_id: str = "r0", ring_view=None,
                  standby: bool = False, takeover_after: int = 3,
                  advertise=None, adopt_after_s: float | None = None,
-                 journals: dict | None = None):
+                 journals: dict | None = None,
+                 result_cache=None, cache_journal: str | None = None,
+                 warm_state: dict | None = None):
         if client_factory is None:
             def client_factory(address):
                 return ServeClient(address, connect_timeout=10.0,
@@ -397,6 +405,28 @@ class Router:
         self.adopt_after_s = None if adopt_after_s is None \
             else float(adopt_after_s)
         self.journals = dict(journals or {})
+        # ------------------------------------- content-addressed cache
+        # consult-before-dispatch: a committed entry for a submit's
+        # content digest answers the submit without touching a worker.
+        # ``warm_state`` (compile cache dir, autotune table, cache root)
+        # rides every ring-view publish so late joiners start hot.
+        if isinstance(result_cache, str):
+            from consensuscruncher_tpu.serve.result_cache import ResultCache
+            result_cache = ResultCache(result_cache,
+                                       node=f"router-{router_id}")
+        self.result_cache = result_cache
+        self.warm_state = dict(warm_state or {})
+        # key -> terminal job doc for answers already served from the
+        # cache; journaled (append-fsync'd, like a terminal journal
+        # answer) BEFORE the reply leaves, so a keyed poll arriving
+        # after a router kill -9 still resolves against the replayed map
+        self._cache_answers: dict[str, dict] = {}
+        self._cache_journal: journal_mod.Journal | None = None
+        if cache_journal:
+            self._load_cache_journal(cache_journal)
+            self._cache_journal = journal_mod.Journal(
+                cache_journal, max_bytes=int(os.environ.get(
+                    "CCT_ROUTE_CACHE_JOURNAL_MAX_BYTES", str(1 << 20))))
         self.fenced = False         # a worker rejected our epoch: demoted
         self._active_fails = 0      # standby's failed probes of the active
         if self.ring_view is not None:
@@ -504,7 +534,8 @@ class Router:
         self.epoch = max(self.epoch, int((doc or {}).get("epoch") or 0)) + 1
         self.ring_view.publish(self.epoch, self.router_id,
                                self.advertise, self._member_list(),
-                               journals=self.journals)
+                               journals=self.journals,
+                               warm=self.warm_state)
         self.standby = False
         self.fenced = False
         self._active_fails = 0
@@ -520,7 +551,8 @@ class Router:
             faults.fault_point("route.view_publish")
             self.ring_view.publish(self.epoch, self.router_id,
                                    self.advertise, self._member_list(),
-                                   journals=self.journals)
+                                   journals=self.journals,
+                                   warm=self.warm_state)
         except (faults.FaultError, OSError) as e:
             # the in-memory membership change is already live and the
             # epoch bump is kept: the view doc is advertisement state for
@@ -944,6 +976,9 @@ class Router:
             info = self._placed_info(key)
             if info is not None and isinstance(info.get("trace"), dict):
                 trace = info["trace"]
+        cached = self._cache_answer(key, spec, trace)
+        if cached is not None:
+            return cached
         tried: set[str] = set()
         stolen = False
         with obs_trace.span("route.submit",
@@ -1187,6 +1222,123 @@ class Router:
                 }}
         return None
 
+    # ------------------------------------------ content-addressed cache
+
+    def _load_cache_journal(self, path: str) -> None:
+        """Replay the cache-answer journal into ``_cache_answers``.
+        Same NDJSON + torn-tail discipline as the job journal: a torn
+        final record is an answer whose reply never left, dropping it is
+        correct.  Runs before the append fd opens (router construction)."""
+        if not os.path.exists(path):
+            return
+        with open(path, "rb") as fh:
+            raw = fh.read()
+        lines = raw.split(b"\n")
+        tail = lines.pop() if lines else b""
+        if tail.strip():
+            lines.append(tail)
+        loaded = 0
+        for line in lines:
+            if not line.strip():
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue  # torn tail / unreadable: that reply never left
+            if not isinstance(rec, dict) or rec.get("kind") != "cache_answer":
+                continue
+            key, job = rec.get("key"), rec.get("job")
+            if isinstance(key, str) and isinstance(job, dict):
+                self._cache_answers[key] = job
+                loaded += 1
+        if loaded:
+            print(f"route: cache-answer journal replay: {loaded} keyed "
+                  "answer(s) restored", file=sys.stderr, flush=True)
+
+    def _cache_answer(self, key: str, spec: dict,
+                      trace: dict | None) -> dict | None:
+        """Consult the result cache before dispatch.  On a hit the
+        payload is materialized into the submitter's own output tree,
+        the answer is journaled (fsync'd) BEFORE the reply leaves — the
+        exactly-once discipline a terminal journal-answer gets — and a
+        submit-ack-shaped reply comes back with ``cached: true``.
+        Returns None on a miss or any degradation (the normal dispatch
+        path is always correct)."""
+        if self.result_cache is None:
+            return None
+        prior = self._cache_answers.get(key)
+        if prior is not None:
+            # an idempotent re-submit of an already-answered key: the
+            # payload is already materialized and journaled
+            return {"ok": True, "job_id": prior.get("job_id", 0),
+                    "state": prior.get("state", "done"), "key": key,
+                    "duplicate": True, "cached": True, "node": "cache",
+                    "trace": prior.get("trace")}
+        from consensuscruncher_tpu.serve import result_cache as rc_mod
+        try:
+            digest = rc_mod.content_digest(spec)
+            if digest is None:
+                return None
+            # placement rides the job ring: the digest's ring owner is
+            # where the producing job ran, so probe that shard first
+            with self._lock:
+                shard = self.ring.owner(digest)
+            entry = self.result_cache.lookup(digest, preferred_shard=shard)
+        except Exception as e:
+            print(f"WARNING: route: cache lookup failed ({e}); "
+                  "dispatching normally", file=sys.stderr, flush=True)
+            return None
+        if entry is None:
+            self.counters.add("cache_misses")
+            return None
+        name = spec.get("name") \
+            or os.path.basename(str(spec.get("input"))).split(".")[0]
+        base = os.path.join(str(spec.get("output")), name)
+        trace_id = (trace or {}).get("trace_id") or obs_trace.mint_trace_id()
+        try:
+            with obs_trace.span("route.cache_answer", link=trace,
+                                trace_id=trace_id, key=key,
+                                digest=digest, shard=entry.get("shard"),
+                                negative=bool(entry.get("negative"))):
+                n = self.result_cache.materialize(entry, base)
+                # the answer span's wire context: echoed on the ack (and
+                # on duplicate re-submits of the same key) so the
+                # submitter links follow-up spans to the cache answer
+                ctx = obs_trace.wire_context()
+        except Exception as e:
+            print(f"WARNING: route: cache materialize of {digest} failed "
+                  f"({e}); dispatching normally", file=sys.stderr, flush=True)
+            return None
+        job = {"job_id": 0, "key": key, "state": "done", "error": None,
+               "outputs": {"base": base}, "wall_s": 0.0, "attempts": 0,
+               "gang_size": 0, "input": spec.get("input"),
+               "deadline_s": None, "trace_id": trace_id, "trace": ctx,
+               "tenant": spec.get("tenant"), "qos": spec.get("qos"),
+               "cached": True}
+        if self._cache_journal is not None:
+            try:
+                # journaled-before-ack, exactly like a submit: a crash
+                # after this line replays the answer, a crash before it
+                # means the reply never left and the cache re-answers
+                self._cache_journal.append_marker(
+                    "cache_answer", key=key, digest=digest, job=job)
+            except Exception as e:
+                print(f"WARNING: route: cache-answer journal write failed "
+                      f"({e}); dispatching normally", file=sys.stderr,
+                      flush=True)
+                return None
+        self._cache_answers[key] = job
+        self.counters.add("route_cache_answers", 1)
+        self.counters.add("cache_hits", 1)
+        if entry.get("negative"):
+            self.counters.add("cache_negative_hits", 1)
+        print(f"route: answered submit {key} from the result cache "
+              f"(digest {digest[:12]}, {n} bytes materialized)",
+              file=sys.stderr, flush=True)
+        return {"ok": True, "job_id": 0, "state": "done", "key": key,
+                "duplicate": False, "cached": True, "node": "cache",
+                "trace": ctx}
+
     def _keyed(self, req: dict) -> str:
         key = req.get("key")
         if not key:
@@ -1197,6 +1349,9 @@ class Router:
 
     def status(self, req: dict) -> dict:
         key = self._keyed(req)
+        answered = self._cache_answers.get(key)
+        if answered is not None:
+            return {"ok": True, "job": dict(answered)}
         tried: set[str] = set()
         swept = False
         while True:
@@ -1221,6 +1376,9 @@ class Router:
         in bounded slices so a node death mid-poll is noticed within
         ``slice_s`` and the poll continues against the new owner."""
         key = self._keyed(req)
+        answered = self._cache_answers.get(key)
+        if answered is not None:
+            return {"ok": True, "job": dict(answered)}
         timeout = req.get("timeout")
         deadline = (None if timeout is None
                     else time.monotonic() + float(timeout))
@@ -1294,6 +1452,8 @@ class Router:
         self.closing = True
         if self._monitor is not None:
             self._monitor.join(timeout=5.0)
+        if self._cache_journal is not None:
+            self._cache_journal.close()
 
     def shutdown(self, timeout: float | None = None) -> None:
         self.close()

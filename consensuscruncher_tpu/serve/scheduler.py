@@ -167,6 +167,10 @@ class Job:
         self.wall_s: float | None = None
         self.attempts = 0
         self.gang_size = 1  # how many jobs shared this job's SSCS dispatch
+        # True when the content-addressed result cache answered this job
+        # (materialized bytes, no pipeline run) — surfaced in describe()
+        # so clients can split hit/miss latency
+        self.cached = False
         self.submitted_t = time.monotonic()
         self.finished_t: float | None = None
 
@@ -177,7 +181,7 @@ class Job:
             "attempts": self.attempts, "gang_size": self.gang_size,
             "input": self.spec.get("input"), "key": self.key,
             "deadline_s": self.deadline_s, "trace_id": self.trace_id,
-            "tenant": self.tenant, "qos": self.qos,
+            "tenant": self.tenant, "qos": self.qos, "cached": self.cached,
         }
 
 
@@ -448,7 +452,8 @@ class Scheduler:
                  slo_targets: dict | None = None,
                  tenant_queue_cap: int | None = None,
                  tenant_inflight_cap: int | None = None,
-                 node: str | None = None):
+                 node: str | None = None,
+                 result_cache=None):
         # fleet identity: the member name a router knows this daemon by
         # (empty for a standalone daemon); surfaced in healthz/metrics so
         # node-labeled fleet dashboards can be cross-checked per worker
@@ -469,6 +474,15 @@ class Scheduler:
                 journal, max_bytes=int(os.environ.get(
                     "CCT_SERVE_JOURNAL_MAX_BYTES", str(1 << 20))))
         self._journal = journal
+        # fleet content-addressed result cache: a ResultCache instance or
+        # a cache-plane root dir (str); None disables caching entirely
+        if isinstance(result_cache, str):
+            from consensuscruncher_tpu.serve.result_cache import ResultCache
+            result_cache = ResultCache(
+                result_cache, node=self.node or None,
+                max_bytes=int(os.environ.get(
+                    "CCT_SERVE_CACHE_MAX_BYTES", "0")) or None)
+        self.result_cache = result_cache
         weights = dict(self.DEFAULT_CLASS_WEIGHTS)
         for qos, w in (class_weights or {}).items():
             if qos not in weights:
@@ -869,6 +883,16 @@ class Scheduler:
                 job.trace_ctx = ctx if isinstance(ctx, dict) else None
                 self._jobs[job.id] = job
                 self._by_key[job.key] = job.id
+                # migration shim: journals written before the v2 key
+                # (version-pinned, input_range-aware) carry v1 keys.
+                # Register the replayed job under every identity it has
+                # ever had, so a client still polling the journaled key
+                # AND a fresh dedupe on the recomputed key both resolve
+                # to this job (setdefault: a live key never loses to an
+                # alias).
+                for alias in (journal_mod.idempotency_key(spec),
+                              journal_mod.legacy_idempotency_key(spec)):
+                    self._by_key.setdefault(alias, job.id)
                 if rec.get("state") in ("done", "failed"):
                     job.state = rec["state"]
                     job.outputs = rec.get("outputs")
@@ -1145,15 +1169,28 @@ class Scheduler:
 
     def _run_gang(self, gang: list[Job]) -> None:
         t0 = time.monotonic()
-        if len(gang) > 1:
+        # consult the content-addressed result cache BEFORE gang dispatch:
+        # a hit job must not cost a single device batch.  The lookup is
+        # purely an optimization — any failure degrades to recomputing.
+        hits: dict[int, dict] = {}
+        for job in gang:
+            entry = self._cache_lookup(job)
+            if entry is not None:
+                hits[job.id] = entry
+        # range-sharded sub-jobs run solo through the CLI (the gang reader
+        # consumes whole inputs; ``--input_range`` only exists down the
+        # one-shot path), and cache hits must not cost a device batch
+        live = [j for j in gang
+                if j.id not in hits and not j.spec.get("input_range")]
+        if len(live) > 1:
             try:
                 faults.fault_point("serve.dispatch")
-                with obs_trace.span("serve.gang", n_jobs=len(gang),
-                                    trace_id=gang[0].trace_id):
-                    handoffs = gang_sscs([j.spec for j in gang], self.counters,
+                with obs_trace.span("serve.gang", n_jobs=len(live),
+                                    trace_id=live[0].trace_id):
+                    handoffs = gang_sscs([j.spec for j in live], self.counters,
                                          max_batch=self.max_batch,
-                                         trace_ids=[j.trace_id for j in gang])
-                for j, h in zip(gang, handoffs):
+                                         trace_ids=[j.trace_id for j in live])
+                for j, h in zip(live, handoffs):
                     j._stream_handoff = h
             except Exception as e:
                 # Gang failure granularity is the gang: fall back to solo
@@ -1166,8 +1203,11 @@ class Scheduler:
             try:
                 with obs_trace.span("serve.job", trace_id=job.trace_id,
                                     job_id=job.id, tenant=job.tenant,
-                                    qos=job.qos):
-                    self._run_job(job)
+                                    qos=job.qos, cached=job.id in hits):
+                    if job.id in hits:
+                        self._cache_materialize(job, hits[job.id])
+                    else:
+                        self._run_job(job)
                 outcome = "done"
             except Exception as e:
                 job.error = f"{type(e).__name__}: {e}"
@@ -1179,8 +1219,9 @@ class Scheduler:
                                   trace_id=job.trace_id, error=job.error,
                                   tenant=job.tenant, qos=job.qos)
                 obs_flight.dump(reason="worker-death")
-            if outcome == "done":
+            if outcome == "done" and job.id not in hits:
                 self.aggregate_job_metrics(job)
+                self._cache_insert(job)
             with self._cond:
                 # gang jobs count from dispatch start: the shared SSCS wall
                 # belongs to every member's end-to-end latency
@@ -1207,6 +1248,88 @@ class Scheduler:
                 self._evict_locked(time.monotonic())
                 self._cond.notify_all()
 
+    # ------------------------------------------- content-addressed cache
+
+    def _cache_lookup(self, job: Job):
+        """Find a committed cache entry for this job's content digest.
+        Counts hits/misses (misses only for cacheable jobs — an
+        unfingerprintable input is not a miss, it is about to be a real
+        error).  Never raises: the cache is an optimization."""
+        if self.result_cache is None:
+            return None
+        from consensuscruncher_tpu.serve import result_cache as rc_mod
+        try:
+            digest = rc_mod.content_digest(job.spec)
+            if digest is None:
+                return None
+            entry = self.result_cache.lookup(digest)
+        except Exception as e:
+            print(f"WARNING: serve: cache lookup failed ({e}); recomputing",
+                  file=sys.stderr, flush=True)
+            return None
+        if entry is None:
+            self.counters.add("cache_misses")
+            return None
+        return entry
+
+    def _cache_materialize(self, job: Job, entry: dict) -> None:
+        """Serve a job straight from a committed cache entry: copy the
+        payload into the job's own output tree (every file through
+        ``commit_file``) and mark it done.  Raises on failure — the
+        caller's normal failed-job path applies (the entry's payload is
+        immutable, so a partial materialize never corrupts the store)."""
+        base = job_paths(job.spec)["base"]
+        n = self.result_cache.materialize(entry, base)
+        job.outputs = {"base": base}
+        job.cached = True
+        self.counters.add("cache_hits")
+        if entry.get("negative"):
+            self.counters.add("cache_negative_hits")
+        obs_trace.event("serve.cache_hit", trace_id=job.trace_id,
+                        job_id=job.id, digest=entry.get("digest"),
+                        bytes=n, negative=bool(entry.get("negative")))
+
+    def _cache_insert(self, job: Job) -> None:
+        """Commit a finished job's outputs as a cache entry (idempotent;
+        best-effort — a failed insert costs a future hit, nothing else).
+        A run that produced zero consensus families is flagged negative
+        so known-empty work (an empty ``--input_range`` slice) is counted
+        as such on later hits."""
+        if self.result_cache is None:
+            return
+        from consensuscruncher_tpu.serve import result_cache as rc_mod
+        try:
+            digest = rc_mod.content_digest(job.spec)
+            if digest is None or not job.outputs:
+                return
+            entry = self.result_cache.insert(
+                digest, job.outputs["base"],
+                negative=self._job_is_negative(job),
+                meta={"key": job.key, "node": self.node or None})
+        except Exception as e:
+            print(f"WARNING: serve: cache insert failed ({e}); "
+                  "result still served from the job's own outputs",
+                  file=sys.stderr, flush=True)
+            return
+        if entry is None:
+            return
+        self.counters.add("cache_inserts")
+        self.counters.add("cache_bytes", int(entry.get("bytes", 0)))
+        for ev in self.result_cache.evict_to_budget():
+            self.counters.add("cache_evictions")
+            self.counters.add("cache_bytes", -int(ev.get("bytes", 0)))
+
+    def _job_is_negative(self, job: Job) -> bool:
+        """True when the job's own metrics sidecar proves zero consensus
+        families came out — the cacheable-negative condition."""
+        sidecar = f"{job_paths(job.spec)['sscs_prefix']}.metrics.json"
+        try:
+            with open(sidecar) as fh:
+                cum = json.load(fh).get("cumulative", {})
+        except (OSError, ValueError):
+            return False
+        return int(cum.get("families_out", -1)) == 0
+
     def _argv(self, spec: dict, resume: bool) -> list[str]:
         argv = [
             "consensus",
@@ -1222,6 +1345,11 @@ class Scheduler:
         ]
         if spec.get("name"):
             argv += ["--name", spec["name"]]
+        if spec.get("input_range"):
+            # sub-job sharding: the range string rides the spec verbatim;
+            # the CLI's manifest records it per stage, so overlapping
+            # resubmits reuse committed outputs via RunManifest.can_skip
+            argv += ["--input_range", str(spec["input_range"])]
         if spec.get("pipeline"):
             argv += ["--pipeline", str(spec["pipeline"])]
         if "intermediate_taps" in spec:

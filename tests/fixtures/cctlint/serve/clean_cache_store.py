@@ -1,0 +1,38 @@
+"""Conformant twin of ``viol_cache_store.py``: the same work done through
+the sanctioned pattern — tmp file in the destination directory, published
+with ``manifest.commit_file`` in the SAME function as the write.  Proves
+the CCT9xx rules key on the commit discipline, not on forbidding writes.
+"""
+
+import json
+import os
+import tempfile
+
+from consensuscruncher_tpu.utils.manifest import commit_file
+
+
+def write_entry_committed(edir, entry):
+    fd, tmp = tempfile.mkstemp(prefix=".entry.", dir=edir)
+    try:
+        with os.fdopen(fd, "w") as fh:
+            json.dump(entry, fh, sort_keys=True)
+        commit_file(tmp, os.path.join(edir, "entry.json"))
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+
+def copy_payload_committed(src, dest):
+    dest_dir = os.path.dirname(os.path.abspath(dest))
+    os.makedirs(dest_dir, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(prefix=".cache.", dir=dest_dir)
+    with os.fdopen(fd, "wb") as out, open(src, "rb") as inp:
+        out.write(inp.read())
+    commit_file(tmp, dest)
+
+
+def read_entry(edir):
+    # read-mode open is always fine
+    with open(os.path.join(edir, "entry.json")) as fh:
+        return json.load(fh)

@@ -1,0 +1,38 @@
+"""Seeded violations for the cache-store pass (CCT901/CCT902).
+
+The filename contains ``cache_store``, so the pass treats this file as a
+cache-store module; every write here bypasses the commit_file publish
+discipline in a different way.
+"""
+
+import json
+import os
+import shutil
+import tempfile
+
+
+def write_entry_bare(edir, entry):
+    # CCT901: write-mode open with no commit_file anywhere in this
+    # function — the entry doc can become visible half-written
+    with open(os.path.join(edir, "entry.json"), "w") as fh:
+        json.dump(entry, fh)
+
+
+def write_payload_fdopen(dest, data):
+    # CCT901 via os.fdopen: a mkstemp handle is fine, but this function
+    # never commits the tmp file into place
+    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(dest))
+    with os.fdopen(fd, "wb") as fh:
+        fh.write(data)
+    return tmp
+
+
+def publish_by_rename(tmp, dest):
+    # CCT902: a bare rename skips the fsync-before and dir-fsync-after
+    # that commit_file performs
+    os.replace(tmp, dest)
+
+
+def copy_payload(src, dest):
+    # CCT902: shutil.copyfile neither fsyncs nor renames atomically
+    shutil.copyfile(src, dest)

@@ -45,6 +45,7 @@ def test_repo_is_lint_clean():
     ("serve/viol_protocol.py",
      {"CCT701", "CCT702", "CCT703", "CCT704", "CCT705"}),
     ("serve/viol_shared_state.py", {"CCT801", "CCT802", "CCT803"}),
+    ("serve/viol_cache_store.py", {"CCT901", "CCT902"}),
 ])
 def test_each_pass_detects_its_seeded_violation(rel, expected):
     findings = run_paths([os.path.join(FIXTURES, rel)], root=REPO)
@@ -57,6 +58,7 @@ def test_each_pass_detects_its_seeded_violation(rel, expected):
     "serve/clean_protocol.py",
     "serve/clean_shared_state.py",
     "serve/clean_trace_prop.py",
+    "serve/clean_cache_store.py",
 ])
 def test_protocol_twin_fixtures_are_clean(rel):
     """The conformant twins prove the CCT7/CCT8 rules key on the actual

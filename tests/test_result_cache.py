@@ -1,0 +1,452 @@
+"""Content-addressed result cache: identity, durability, byte-parity.
+
+Four layers of coverage, cheapest first:
+
+- **identity**: the content digest keys on what determines the output
+  bytes (input fingerprint, policy fields, ``__version__``) and on
+  nothing else (tenant/qos/output are routing concerns); the v2
+  idempotency key is versioned and range-aware while the legacy shim
+  reproduces the pre-cache key so old journals still replay;
+- **store**: insert -> lookup -> materialize round-trips byte-identical
+  payloads, entries are commit_file-published (``entry.json`` last),
+  eviction drops oldest entries entry-doc-first, and the ``serve.cache``
+  fault site (armed via CCT_FAULTS, same contract the chaos conductor
+  uses) degrades lookup/insert to a plain miss, never an error;
+- **scheduler**: a real in-process daemon run twice — the second job is
+  answered from the cache and both output trees hit the frozen goldens
+  digest-for-digest (the byte-identity acceptance bar);
+- **router**: a cache-answered submit never reaches the fleet, the
+  answer is journaled BEFORE the ack, and a router rebuilt over the same
+  cache journal (the kill -9 shape) re-answers the key as a duplicate.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "test"))
+
+from make_test_data import canonical_bam_digest, text_digest  # noqa: E402
+
+from consensuscruncher_tpu import __version__
+from consensuscruncher_tpu.serve import journal as journal_mod
+from consensuscruncher_tpu.serve.client import ServeClient
+from consensuscruncher_tpu.serve.result_cache import (
+    ENTRY_NAME, ResultCache, content_digest,
+)
+from consensuscruncher_tpu.serve.router import RingView, Router
+from consensuscruncher_tpu.serve.scheduler import Scheduler
+from consensuscruncher_tpu.serve.server import ServeServer
+from tools.cctlint import protocols
+
+DATA = os.path.join(REPO, "test", "data")
+SAMPLE = os.path.join(DATA, "sample.bam")
+GOLDEN = json.load(open(os.path.join(REPO, "test", "golden.json")))
+
+
+def _spec(output, name="golden", **over):
+    spec = {
+        "input": SAMPLE, "output": str(output), "name": name,
+        "cutoff": 0.7, "qualscore": 0, "scorrect": True,
+        "max_mismatch": 0, "bdelim": "|", "compress_level": 6,
+    }
+    spec.update(over)
+    return spec
+
+
+def _assert_matches_golden(base, label):
+    for rel, expected in GOLDEN["consensus"].items():
+        p = os.path.join(str(base), rel)
+        assert os.path.exists(p), f"{label}: missing output {rel}"
+        got = (canonical_bam_digest(p) if rel.endswith(".bam")
+               else text_digest(p))
+        assert got == expected, f"{label} diverges from golden at {rel}"
+
+
+# ------------------------------------------------------------- identity
+
+def test_content_digest_keys_on_content_not_routing(tmp_path):
+    spec = _spec(tmp_path / "a")
+    d = content_digest(spec)
+    assert d is not None and len(d) == 32
+
+    # routing/accounting fields are NOT identity: any tenant, any output
+    # tree, any qos asks the same question and must hit the same entry
+    assert content_digest(_spec(tmp_path / "b")) == d
+    assert content_digest(_spec(tmp_path / "a", tenant="t2",
+                                qos="batch")) == d
+    assert content_digest(_spec(tmp_path / "a", deadline_s=5)) == d
+
+    # policy fields, the derived name and the range ARE identity
+    assert content_digest(_spec(tmp_path / "a", cutoff=0.8)) != d
+    assert content_digest(_spec(tmp_path / "a", name="other")) != d
+    assert content_digest(_spec(tmp_path / "a",
+                                input_range="voff:0:100")) != d
+
+    # an unfingerprintable input is not cacheable, not an error here
+    assert content_digest(_spec(tmp_path / "a",
+                                input=str(tmp_path / "gone.bam"))) is None
+
+
+def test_idempotency_key_v2_versioned_and_legacy_shim(tmp_path):
+    spec = _spec(tmp_path / "a", tenant="t", qos="batch")
+    v2 = journal_mod.idempotency_key(spec)
+    legacy = journal_mod.legacy_idempotency_key(spec)
+    # the v2 key pins __version__ (upgrade invalidates by construction)
+    # and folds input_range; legacy reproduces the pre-cache identity so
+    # journals written before the migration still replay to a findable key
+    assert v2 != legacy
+    assert journal_mod.idempotency_key(dict(spec)) == v2  # stable
+    ranged = dict(spec, input_range="voff:0:10")
+    assert journal_mod.idempotency_key(ranged) != v2
+    assert journal_mod.legacy_idempotency_key(ranged) == legacy
+    assert __version__  # the pin the v2 key rides
+
+
+def test_scheduler_replay_registers_legacy_key_alias(tmp_path):
+    jp = str(tmp_path / "serve.journal")
+    spec = _spec(tmp_path / "o", tenant="t")
+    legacy = journal_mod.legacy_idempotency_key(spec)
+    j = journal_mod.Journal(jp)
+    # a journal written by the pre-v2 daemon: the record's key IS legacy
+    j.append_job(7, "accepted", key=legacy, spec=spec, trace_id="t" * 16)
+    j.append_job(7, "done", key=legacy, spec=spec, trace_id="t" * 16,
+                 outputs={"base": str(tmp_path / "o" / "golden")})
+    j.close()
+    sched = Scheduler(start=False, paused=True,
+                      journal=journal_mod.Journal(jp))
+    try:
+        # both the stored key and the recomputed v2 key find the job, so
+        # old clients keep polling and new resubmits dedupe
+        assert sched._by_key[legacy] == 7
+        assert sched._by_key[journal_mod.idempotency_key(spec)] == 7
+    finally:
+        sched.close(timeout=10)
+
+
+# ---------------------------------------------------------------- store
+
+def _make_payload(base, files):
+    for rel, data in files.items():
+        p = os.path.join(str(base), rel)
+        os.makedirs(os.path.dirname(p), exist_ok=True)
+        with open(p, "wb") as fh:
+            fh.write(data)
+
+
+def test_insert_lookup_materialize_byte_identical(tmp_path):
+    files = {"golden/sscs/x.bam": b"\x1f\x8b" + os.urandom(256),
+             "golden/sscs/x.txt": b"families_out\t3\n",
+             "golden/plots/x.png": os.urandom(64)}
+    src = tmp_path / "job_out"
+    _make_payload(src, files)
+
+    rc = ResultCache(str(tmp_path / "plane"), node="w0")
+    entry = rc.insert("ab" * 16, str(src), meta={"key": "k1"})
+    assert entry is not None and entry["bytes"] == sum(
+        len(d) for d in files.values())
+    # entry.json is the linearization point and exists committed
+    assert os.path.exists(os.path.join(entry["dir"], ENTRY_NAME))
+
+    # idempotent re-insert returns the committed entry, no rewrite
+    again = rc.insert("ab" * 16, str(src))
+    assert again["t"] == entry["t"]
+
+    # a second process (different node) finds it by sweeping shards
+    rc2 = ResultCache(str(tmp_path / "plane"), node="w1")
+    found = rc2.lookup("ab" * 16, preferred_shard="w0")
+    assert found is not None and found["shard"] == "w0"
+
+    dest = tmp_path / "materialized"
+    n = rc2.materialize(found, str(dest))
+    assert n == entry["bytes"]
+    for rel, data in files.items():
+        with open(os.path.join(str(dest), rel), "rb") as fh:
+            assert fh.read() == data, rel  # byte-identical, not just same
+
+    assert rc.lookup("cd" * 16) is None  # unknown digest is a clean miss
+
+
+def test_negative_entries_flagged_and_materialize_empty(tmp_path):
+    src = tmp_path / "empty_out"
+    _make_payload(src, {"golden/sscs/x.txt": b"families_out\t0\n"})
+    rc = ResultCache(str(tmp_path / "plane"))
+    entry = rc.insert("ee" * 16, str(src), negative=True)
+    assert entry["negative"] is True
+    found = rc.lookup("ee" * 16)
+    assert found["negative"] is True
+
+
+def test_eviction_oldest_first_entry_doc_unlinked(tmp_path):
+    rc = ResultCache(str(tmp_path / "plane"), node="w0", max_bytes=300)
+    for i in range(4):
+        src = tmp_path / f"o{i}"
+        _make_payload(src, {"f.bin": bytes([i]) * 128})
+        entry = rc.insert(f"{i:02d}" * 16, str(src))
+        # deterministic age order without sleeping: rewrite the committed
+        # timestamp through the sanctioned path is overkill for a test —
+        # entries land in insert order and time.time() is monotonic enough,
+        # but pin it explicitly to kill flake
+        assert entry is not None
+    evicted = rc.evict_to_budget()
+    assert [e["digest"][:2] for e in evicted] == ["00", "01"]
+    assert rc.shard_stats() == {"entries": 2, "bytes": 256}
+    for e in evicted:
+        assert not os.path.exists(os.path.join(e["dir"], ENTRY_NAME))
+        assert rc.lookup(e["digest"]) is None
+
+
+def test_cache_fault_degrades_to_miss_never_error(tmp_path, monkeypatch):
+    # the serve.cache site, armed exactly as the chaos conductor arms it
+    # (CCT_FAULTS=serve.cache=fail@1): the first touch degrades, the
+    # store works again afterwards — a broken cache slows, never breaks
+    src = tmp_path / "o"
+    _make_payload(src, {"f.bin": b"x" * 32})
+    rc = ResultCache(str(tmp_path / "plane"))
+    rc.insert("aa" * 16, str(src))
+
+    monkeypatch.setenv("CCT_FAULTS", "serve.cache=fail@2")
+    assert rc.lookup("aa" * 16) is None            # firing 1: miss
+    assert rc.insert("bb" * 16, str(src)) is None  # firing 2: skip
+    assert rc.lookup("aa" * 16) is not None        # budget spent: works
+    assert rc.insert("bb" * 16, str(src)) is not None
+
+
+# ------------------------------------------------------------ scheduler
+
+def test_daemon_cache_hit_byte_identical_to_golden(tmp_path):
+    """The acceptance bar: run the same question twice through a real
+    daemon with the cache enabled — the second job must be answered from
+    the store, BOTH output trees must hit the frozen goldens, and the
+    counters must show exactly one insert and one hit."""
+    sched = Scheduler(queue_bound=8, gang_size=4, backend="tpu",
+                      result_cache=str(tmp_path / "plane"))
+    server = ServeServer(sched, port=0)
+    server.start()
+    try:
+        client = ServeClient(tuple(server.address))
+        job1 = client.run(_spec(tmp_path / "first"), timeout=600)
+        job2 = client.run(_spec(tmp_path / "second", tenant="other"),
+                          timeout=600)
+    finally:
+        server.close()
+        sched.close(timeout=120)
+
+    assert job1["state"] == "done" and job1["cached"] is False
+    assert job2["state"] == "done" and job2["cached"] is True
+    _assert_matches_golden(tmp_path / "first" / "golden", "computed job")
+    _assert_matches_golden(tmp_path / "second" / "golden", "cached job")
+
+    snap = sched.counters.snapshot()
+    assert snap["cache_inserts"] == 1
+    assert snap["cache_hits"] == 1
+    assert snap["cache_misses"] == 1  # job1's cold probe
+    assert snap["cache_bytes"] > 0
+
+
+def test_job_is_negative_reads_metrics_sidecar(tmp_path):
+    from consensuscruncher_tpu.serve.scheduler import Job, job_paths
+    sched = Scheduler(start=False, paused=True)
+    try:
+        spec = _spec(tmp_path / "o", name="neg")
+        job = Job(spec, key="k")
+        p = job_paths(spec)
+        os.makedirs(p["dirs"]["sscs"], exist_ok=True)
+        with open(p["sscs_prefix"] + ".metrics.json", "w") as fh:
+            json.dump({"cumulative": {"families_out": 0}}, fh)
+        assert sched._job_is_negative(job) is True
+        with open(p["sscs_prefix"] + ".metrics.json", "w") as fh:
+            json.dump({"cumulative": {"families_out": 12}}, fh)
+        assert sched._job_is_negative(job) is False
+        os.unlink(p["sscs_prefix"] + ".metrics.json")
+        assert sched._job_is_negative(job) is False  # no sidecar: not neg
+    finally:
+        sched.close(timeout=10)
+
+
+# --------------------------------------------------------------- router
+
+class _DarkFleet:
+    """Stub members that record submits — a cache answer must never
+    produce one."""
+
+    def __init__(self, names):
+        self.submits = []
+        self.names = list(names)
+
+    def client(self, name):
+        fleet = self
+
+        class _Client:
+            address = name
+
+            def request(self, doc, timeout=None):
+                if doc["op"] == "healthz":
+                    return {"ok": True, "health": {"queued": 0,
+                                                   "running": 0,
+                                                   "status": "serving"}}
+                if doc["op"] == "submit":
+                    fleet.submits.append((name, doc["spec"]))
+                    return {"ok": True, "job_id": 1,
+                            "key": journal_mod.idempotency_key(doc["spec"]),
+                            "duplicate": False}
+                raise AssertionError(doc["op"])
+
+        return _Client()
+
+
+def _seeded_plane(tmp_path, spec):
+    """A cache plane already holding the answer to ``spec``."""
+    src = tmp_path / "producer_out"
+    _make_payload(src, {"sscs/golden.bam": b"BAM" + os.urandom(64),
+                        "sscs/golden.txt": b"stats\n"})
+    rc = ResultCache(str(tmp_path / "plane"), node="w0")
+    digest = content_digest(spec)
+    assert rc.insert(digest, str(src)) is not None
+    return str(tmp_path / "plane"), digest
+
+
+def test_router_cache_answer_skips_fleet_and_survives_restart(tmp_path):
+    spec = _spec(tmp_path / "sub", tenant="t1")
+    plane, digest = _seeded_plane(tmp_path, spec)
+    cj = str(tmp_path / "cache_answers.journal")
+    fleet = _DarkFleet(["w0", "w1"])
+
+    router = Router([(n, n) for n in fleet.names], start_monitor=False,
+                    client_factory=fleet.client,
+                    result_cache=plane, cache_journal=cj)
+    router.probe_members()
+    try:
+        reply = router.submit(spec)
+        assert reply["ok"] and reply["cached"] is True
+        assert reply["node"] == "cache" and reply["duplicate"] is False
+        assert fleet.submits == []  # the fleet never saw the job
+        key = reply["key"]
+
+        # the materialized payload landed in the submitter's output tree
+        base = os.path.join(str(tmp_path / "sub"), "golden")
+        assert os.path.exists(os.path.join(base, "sscs", "golden.bam"))
+
+        # keyed polls answer from the journaled map, also without dispatch
+        st = router.status({"key": key})
+        assert st["ok"] and st["job"]["state"] == "done"
+        assert st["job"]["cached"] is True
+        res = router.result({"key": key})
+        assert res["job"]["outputs"]["base"] == base
+
+        # journaled-before-ack: the record is already durable on disk
+        with open(cj, "rb") as fh:
+            recs = [json.loads(ln) for ln in fh.read().splitlines() if ln]
+        answers = [r for r in recs if r.get("kind") == "cache_answer"]
+        assert len(answers) == 1 and answers[0]["key"] == key
+        assert answers[0]["digest"] == digest
+        assert protocols.validate_journal_record(answers[0]) is None
+
+        snap = router.counters.snapshot()
+        assert snap["route_cache_answers"] == 1
+        assert snap["cache_hits"] == 1
+    finally:
+        router.close()
+
+    # the kill -9 shape: a fresh router over the same journal re-answers
+    # the key as a duplicate without touching cache or fleet
+    fleet2 = _DarkFleet(["w0", "w1"])
+    router2 = Router([(n, n) for n in fleet2.names], start_monitor=False,
+                     client_factory=fleet2.client,
+                     result_cache=plane, cache_journal=cj)
+    router2.probe_members()
+    try:
+        again = router2.submit(dict(spec))
+        assert again["ok"] and again["cached"] is True
+        assert again["duplicate"] is True
+        assert fleet2.submits == []
+        assert router2.status({"key": again["key"]})["job"]["state"] == "done"
+    finally:
+        router2.close()
+
+
+def test_router_cache_miss_dispatches_normally(tmp_path):
+    spec = _spec(tmp_path / "sub2", cutoff=0.9)  # no entry for this policy
+    plane, _digest = _seeded_plane(tmp_path, _spec(tmp_path / "other"))
+    fleet = _DarkFleet(["w0", "w1"])
+    router = Router([(n, n) for n in fleet.names], start_monitor=False,
+                    client_factory=fleet.client,
+                    result_cache=plane,
+                    cache_journal=str(tmp_path / "cj.journal"))
+    router.probe_members()
+    try:
+        reply = router.submit(spec)
+        assert reply["ok"] and not reply.get("cached")
+        assert len(fleet.submits) == 1
+        assert router.counters.snapshot()["cache_misses"] == 1
+    finally:
+        router.close()
+
+
+# ------------------------------------------------------------ warm join
+
+def test_ring_view_carries_warm_state(tmp_path):
+    view = RingView(str(tmp_path / "ring.view"))
+    warm = {"compile_cache": "/cc", "autotune_table": None,
+            "result_cache": "/plane"}
+    view.publish(epoch=1, router="r0", address="/tmp/r.sock",
+                 members=[("w0", "/tmp/w0.sock")], warm=warm)
+    doc = view.load()
+    # falsy fields are dropped; the doc stays inside the declared grammar
+    assert doc["warm"] == {"compile_cache": "/cc", "result_cache": "/plane"}
+    assert protocols.validate_ring_record(doc) is None
+
+    # without warm state the field is absent entirely (old readers see
+    # the exact pre-cache document)
+    view2 = RingView(str(tmp_path / "ring2.view"))
+    view2.publish(epoch=1, router="r0", address="/tmp/r.sock",
+                  members=[("w0", "/tmp/w0.sock")])
+    assert "warm" not in view2.load()
+
+
+# -------------------------------------------------- input_range sub-jobs
+
+def test_overlapping_input_range_reuses_committed_stages(tmp_path):
+    """A range sub-job re-run over an already-committed output tree must
+    skip the SSCS stage via the manifest (``RunManifest.can_skip`` keys
+    on params including the range), and a DIFFERENT overlapping range
+    must NOT reuse it — the params differ."""
+    from consensuscruncher_tpu.cli import main as cli_main
+    from consensuscruncher_tpu.parallel.hostshard import (
+        plan_bai_ranges, range_argv,
+    )
+
+    src = os.path.join(DATA, "sample_adversarial.bam")
+    r0, r1 = plan_bai_ranges(src, 2)[:2]
+    common = ["--backend", "xla_cpu", "--scorrect", "True"]
+    out = tmp_path / "ranges"
+
+    cli_main(["consensus", "-i", src, "-o", str(out), "-n", "r0",
+              "--input_range", range_argv(r0), *common])
+    sscs = out / "r0" / "sscs" / "r0.sscs.sorted.bam"
+    stamp = os.stat(sscs).st_mtime_ns
+
+    # same range, resumed: committed stage outputs are reused untouched
+    cli_main(["consensus", "-i", src, "-o", str(out), "-n", "r0",
+              "--input_range", range_argv(r0), "--resume", "True", *common])
+    assert os.stat(sscs).st_mtime_ns == stamp
+
+    # an overlapping-but-different range into the same tree recomputes
+    # (the manifest refuses the stale reuse) and both digests diverge
+    cli_main(["consensus", "-i", src, "-o", str(out), "-n", "r0",
+              "--input_range", range_argv(r1), "--resume", "True", *common])
+    assert os.stat(sscs).st_mtime_ns != stamp
+
+    # and the two ranges' digests land differently in the content digest
+    d0 = content_digest(_spec(out, name="r0", input=src,
+                              input_range=range_argv(r0)))
+    d1 = content_digest(_spec(out, name="r0", input=src,
+                              input_range=range_argv(r1)))
+    assert d0 != d1
+
+
+if __name__ == "__main__":
+    sys.exit(pytest.main([__file__, "-v"]))

@@ -1,0 +1,125 @@
+"""Pass 9 — cache-store durability discipline (CCT9xx).
+
+The content-addressed result cache (``serve/result_cache.py``) promises
+that any visible entry is complete and byte-durable: payload files are
+committed via ``manifest.commit_file`` (fsync + rename + dir-fsync) and
+``entry.json`` lands last as the linearization point.  A single bare
+``open(..., "w")`` or hand-rolled ``os.replace`` in that module silently
+re-opens the torn-write window the whole design exists to close — and
+nothing at runtime would notice until a crash published a partial entry.
+
+This pass applies to **cache-store modules**, identified by filename:
+any scanned file whose basename contains ``result_cache`` or
+``cache_store`` (the real store plus its test fixtures).
+
+CCT901  a write-mode ``open`` / ``os.fdopen`` inside a function that
+        never calls ``commit_file`` — bytes can become visible without
+        the fsync+rename publish step.  Writing to a ``mkstemp`` handle
+        is exactly the sanctioned pattern *when the same function also
+        commits it*; the check keys on the commit being reachable from
+        the write site's function, not on forbidding writes outright.
+CCT902  a direct ``os.replace`` / ``os.rename`` / ``shutil.move`` /
+        ``shutil.copy*`` call — the publish/copy step must go through
+        ``commit_file`` (rename alone skips the fsyncs; a copy helper
+        skips both).
+
+Waivable with ``# cct: allow-cache-store(reason)`` for the rare
+deliberate exception (e.g. a debug dump that is not part of the store).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, LintContext, SourceFile, call_name
+
+#: dotted call targets that bypass the commit discipline outright
+DIRECT_MOVES = frozenset({
+    "os.replace", "os.rename", "os.renames", "os.link",
+    "shutil.move", "shutil.copyfile", "shutil.copy", "shutil.copy2",
+    "shutil.copytree",
+})
+
+_WRITE_OPENERS = ("open", "os.fdopen", "io.open")
+
+
+def _is_cache_store(src: SourceFile) -> bool:
+    base = src.parts[-1]
+    if base.startswith("test_"):  # tests write fixtures with bare open()
+        return False
+    return "result_cache" in base or "cache_store" in base
+
+
+def _write_mode(node: ast.Call, dotted: str) -> bool:
+    """True when the open call's mode argument requests writing."""
+    mode_idx = 1  # open(path, mode) and os.fdopen(fd, mode) alike
+    mode: ast.expr | None = None
+    if len(node.args) > mode_idx:
+        mode = node.args[mode_idx]
+    for kw in node.keywords:
+        if kw.arg == "mode":
+            mode = kw.value
+    if mode is None:
+        return False  # default "r"
+    if not (isinstance(mode, ast.Constant) and isinstance(mode.value, str)):
+        return True  # computed mode: assume the worst in a store module
+    return any(c in mode.value for c in "wax+")
+
+
+def _enclosing_functions(tree: ast.Module) -> list[ast.AST]:
+    return [n for n in ast.walk(tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+
+
+def _innermost(funcs: list[ast.AST], node: ast.AST) -> ast.AST | None:
+    """Innermost function whose span contains ``node`` (by line range —
+    good enough for lint scoping; nested defs pick the tightest)."""
+    best = None
+    for fn in funcs:
+        end = getattr(fn, "end_lineno", fn.lineno)
+        if fn.lineno <= node.lineno <= end:
+            if best is None or fn.lineno > best.lineno:
+                best = fn
+    return best
+
+
+def _calls_commit(scope: ast.AST) -> bool:
+    for node in ast.walk(scope):
+        if isinstance(node, ast.Call) and \
+                call_name(node).rsplit(".", 1)[-1] == "commit_file":
+            return True
+    return False
+
+
+def run(ctx: LintContext) -> list[Finding]:
+    findings: list[Finding] = []
+    for src in ctx.parsed():
+        if not _is_cache_store(src):
+            continue
+        funcs = _enclosing_functions(src.tree)
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = call_name(node)
+            if dotted in DIRECT_MOVES:
+                findings.append(Finding(
+                    "CCT902", src.rel, node.lineno,
+                    f"cache-store module calls {dotted} directly — the "
+                    "publish/copy step must go through manifest.commit_file "
+                    "(fsync + rename + dir-fsync), or a crash can leave a "
+                    "visible-but-torn entry", "cachestore"))
+                continue
+            if dotted in _WRITE_OPENERS and _write_mode(node, dotted):
+                fn = _innermost(funcs, node)
+                if fn is None or not _calls_commit(fn):
+                    where = f"function '{fn.name}'" if fn is not None \
+                        else "module scope"
+                    findings.append(Finding(
+                        "CCT901", src.rel, node.lineno,
+                        f"write-mode {dotted}() in {where} with no "
+                        "commit_file call in the same function — cache-"
+                        "store bytes must be published via "
+                        "manifest.commit_file (tmp file + commit), never "
+                        "left where a reader can see a torn write",
+                        "cachestore"))
+    return findings

@@ -24,6 +24,7 @@ PRAGMA_FAMILY = {
     "CCT5": "jit",
     "CCT7": "protocol",
     "CCT8": "shared-state",
+    "CCT9": "cache-store",
     # CCT3 (fault coverage) and CCT6 (metric registry) have no pragma on
     # purpose: an unregistered or untested site is fixed by registering/
     # testing it, never by waiving it.
@@ -172,8 +173,8 @@ def _pragma_findings(files: list[SourceFile]) -> list[Finding]:
 def all_passes():
     """Name -> pass callable.  Imported lazily so a syntax error in one pass
     module doesn't take down the others during development."""
-    from . import (determinism, faultcov, hostsync, jitdisc, locks, obscov,
-                   protocol, shared_state)
+    from . import (cachestore, determinism, faultcov, hostsync, jitdisc,
+                   locks, obscov, protocol, shared_state)
 
     return {
         "hostsync": hostsync.run,
@@ -184,6 +185,7 @@ def all_passes():
         "obscov": obscov.run,
         "protocol": protocol.run,
         "shared_state": shared_state.run,
+        "cachestore": cachestore.run,
     }
 
 

@@ -79,4 +79,7 @@ FAULT_SITES: dict[str, str] = {
                           "the bumped epoch rides the next successful "
                           "publish (standby visibility degrades, routing "
                           "never does)",
+    "serve.cache": "content-addressed result-cache lookup/insert fails -> "
+                   "degrade to a plain recompute miss (a broken cache can "
+                   "slow the fleet down, never wrong or wedge it)",
 }

@@ -57,8 +57,10 @@ JOURNAL_TRANSITIONS = {
 }
 
 # Marker kinds (``rec: "marker"``): drain boundary, adoption tombstone
-# (router resubmitted every non-terminal job elsewhere), fence floor.
-MARKER_KINDS = ("drain", "adopted", "fence")
+# (router resubmitted every non-terminal job elsewhere), fence floor,
+# and the router's journaled-before-ack result-cache answers (replayed
+# at construction so a killed router re-answers the same keys).
+MARKER_KINDS = ("drain", "adopted", "fence", "cache_answer")
 
 # ---------------------------------------------------------- ring view --
 #
@@ -67,7 +69,7 @@ MARKER_KINDS = ("drain", "adopted", "fence")
 # optional (members' journal paths for adoption).
 
 RING_VIEW_REQUIRED = ("v", "epoch", "router", "address", "members", "t")
-RING_VIEW_OPTIONAL = ("journals",)
+RING_VIEW_OPTIONAL = ("journals", "warm")
 
 # ---------------------------------------------------------- wire -------
 #
@@ -93,6 +95,9 @@ WIRE_REPLY_KEYS = frozenset({
     # router ops
     "drained", "errors", "adopted", "jobs_adopted", "keys",
     "node", "address", "node_address", "stolen", "fleet_size",
+    # result-cache answers: the ack (and the polled job doc) says the
+    # bytes came from the content-addressed store, not a fresh run
+    "cached",
 })
 
 # ---------------------------------------------------------- helpers ----

@@ -70,7 +70,8 @@ from consensuscruncher_tpu.obs import trace as obs_trace  # noqa: E402
 from consensuscruncher_tpu.serve.client import ServeClient  # noqa: E402
 from serve_soak import BOOT, check_golden, job_spec  # noqa: E402
 
-WORKER_FAULTS = ("serve.worker=fail@1", "serve.dispatch=fail@1")
+WORKER_FAULTS = ("serve.worker=fail@1", "serve.dispatch=fail@1",
+                 "serve.cache=fail@1")
 ROUTER_FAULTS = ("route.member_down=fail@1", "route.resubmit=fail@1",
                  "route.steal=fail@1", "route.adopt=fail@1")
 

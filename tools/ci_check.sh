@@ -158,6 +158,78 @@ print(f"ci_check: loadgen artifacts OK (learned table: {at['shapes']} shapes, "
       f"0 unexpected recompiles across {len(recs)} levels at {pre} total)")
 PY
 
+echo "== result-cache parity smoke (cached answer == fresh recompute, byte-for-byte) =="
+JAX_PLATFORMS=cpu PYTHONPATH="$REPO" python - "$WORK/cachepar" <<'PY'
+import hashlib, os, sys
+
+WORK = sys.argv[1]
+os.makedirs(WORK, exist_ok=True)
+REPO = os.getcwd()
+from consensuscruncher_tpu.serve.client import ServeClient
+from consensuscruncher_tpu.serve.scheduler import Scheduler
+from consensuscruncher_tpu.serve.server import ServeServer
+
+SPEC = {
+    "input": os.path.join(REPO, "test", "data", "sample.bam"),
+    "name": "par", "cutoff": 0.7, "qualscore": 0, "scorrect": True,
+    "max_mismatch": 0, "bdelim": "|", "compress_level": 6,
+}
+
+def tree(base):
+    out = {}
+    for root, _, files in os.walk(base):
+        for f in files:
+            if f.endswith((".bam", ".bai")):
+                p = os.path.join(root, f)
+                out[os.path.relpath(p, base)] = hashlib.sha256(
+                    open(p, "rb").read()).hexdigest()
+    return out
+
+def run(sched, output, tenant):
+    server = ServeServer(sched, port=0)
+    server.start()
+    try:
+        client = ServeClient(tuple(server.address))
+        return client.run(dict(SPEC, output=output, tenant=tenant),
+                          timeout=600)
+    finally:
+        server.close()
+        sched.close(timeout=120)
+
+# one daemon with the cache plane: tenant alice computes (cold insert),
+# tenant bob asks the same content question and must be answered from
+# the store; a separate cache-less daemon recomputes from scratch as
+# the parity reference
+sched = Scheduler(queue_bound=8, gang_size=4, backend="tpu",
+                  result_cache=os.path.join(WORK, "plane"))
+server = ServeServer(sched, port=0)
+server.start()
+try:
+    client = ServeClient(tuple(server.address))
+    cold = client.run(dict(SPEC, output=os.path.join(WORK, "cold"),
+                           tenant="alice"), timeout=600)
+    warm = client.run(dict(SPEC, output=os.path.join(WORK, "warm"),
+                           tenant="bob"), timeout=600)
+finally:
+    server.close()
+    sched.close(timeout=120)
+snap = sched.counters.snapshot()
+fresh = run(Scheduler(queue_bound=8, gang_size=4, backend="tpu"),
+            os.path.join(WORK, "fresh"), "carol")
+
+assert cold["state"] == "done" and cold["cached"] is False, cold
+assert warm["state"] == "done" and warm["cached"] is True, warm
+assert fresh["state"] == "done" and fresh["cached"] is False, fresh
+ref = tree(os.path.join(WORK, "fresh", "par"))
+got = tree(os.path.join(WORK, "warm", "par"))
+assert ref and got == ref, "cached bytes diverge from recompute: " + str(
+    sorted(set(ref) ^ set(got)) or
+    sorted(k for k in ref if ref[k] != got.get(k)))
+assert snap["cache_inserts"] == 1 and snap["cache_hits"] == 1, snap
+print(f"ci_check: cache parity OK ({len(ref)} files byte-identical to a "
+      f"fresh recompute, {snap['cache_bytes']} bytes in the plane)")
+PY
+
 echo "== fleet failover smoke (router + 2 workers, kill -9 one mid-run) =="
 JAX_PLATFORMS=cpu PYTHONPATH="$REPO" python - "$WORK/fleet" <<'PY'
 import json, os, signal, subprocess, sys, time

@@ -109,6 +109,37 @@ def _parse_mix(text: str) -> list[tuple[str, str, float]]:
     return out
 
 
+def _parse_popularity(text: str) -> float | None:
+    """``uniform`` -> None, ``zipf:<s>`` -> s (the rank exponent)."""
+    text = (text or "uniform").strip()
+    if text == "uniform":
+        return None
+    if text.startswith("zipf:"):
+        try:
+            s = float(text.split(":", 1)[1])
+        except ValueError:
+            raise SystemExit(f"loadgen: bad --popularity {text!r}")
+        if s <= 0:
+            raise SystemExit("loadgen: zipf exponent must be > 0")
+        return s
+    raise SystemExit(
+        f"loadgen: --popularity wants 'uniform' or 'zipf:<s>', got {text!r}")
+
+
+_ZIPF_WEIGHTS: dict[tuple[int, float], list[float]] = {}
+
+
+def _zipf_pick(rng: random.Random, n: int, s: float) -> int:
+    """Rank drawn from a finite zipf law: P(rank r) ~ 1/(r+1)^s.  The
+    weights are memoised per (corpus size, exponent) — every level and
+    class reuses the same table."""
+    weights = _ZIPF_WEIGHTS.get((n, s))
+    if weights is None:
+        weights = [1.0 / float(r + 1) ** s for r in range(n)]
+        _ZIPF_WEIGHTS[(n, s)] = weights
+    return rng.choices(range(n), weights=weights, k=1)[0]
+
+
 def _load_family_pmf(path: str) -> dict[int, float]:
     counts = FamilySizeHistogram.read(path)
     total = sum(counts.values())
@@ -276,7 +307,8 @@ def _node_breakdown(before: dict, after: dict) -> dict[str, dict] | None:
 def _run_level(client: ServeClient, rng: random.Random, level_idx: int,
                rate: float, duration: float, settle: float,
                mix: list[tuple[str, str, float]],
-               inputs: dict[str, list[str]], outdir: str) -> dict:
+               inputs: dict[str, list[str]], outdir: str,
+               zipf_s: float | None = None) -> dict:
     n_jobs = max(1, int(round(rate * duration)))
     weights = [w for _, _, w in mix]
     before = client.metrics()
@@ -295,7 +327,14 @@ def _run_level(client: ServeClient, rng: random.Random, level_idx: int,
             # up, the offered rate was never actually offered
             max_slip = max(max_slip, now - due)
         tenant, qos, _ = rng.choices(mix, weights=weights, k=1)[0]
-        bam = rng.choice(inputs[qos])
+        pool = inputs[qos]
+        if zipf_s is not None:
+            # finite-corpus popularity: repeated draws of a hot input
+            # re-submit the SAME spec params (only the output dir moves),
+            # so a fleet result cache can answer them without recompute
+            bam = pool[_zipf_pick(rng, len(pool), zipf_s)]
+        else:
+            bam = rng.choice(pool)
         spec = {
             "input": bam,
             "output": os.path.join(outdir, f"j{i}"),
@@ -331,6 +370,8 @@ def _run_level(client: ServeClient, rng: random.Random, level_idx: int,
                 continue
             if job["state"] in ("done", "failed"):
                 rec["state"] = job["state"]
+                rec["cached"] = bool(job.get("cached"))
+                rec["latency_s"] = time.monotonic() - rec["t_submit"]
             else:
                 still.append(rec)
         pending = still
@@ -376,6 +417,29 @@ def _run_level(client: ServeClient, rng: random.Random, level_idx: int,
         agg_shed += shed
         agg_submitted += subs
 
+    # result-cache split: ``cached`` rides the job doc (scheduler
+    # describe() / router cache answers), latency is client-observed
+    # submit->terminal wall — so the hit-vs-miss gap is what a caller
+    # actually feels, not a server-side accounting artifact
+    finished = [r for r in submitted if r.get("state") == "done"]
+    hits = sorted(r["latency_s"] for r in finished if r.get("cached"))
+    misses = sorted(r["latency_s"] for r in finished if not r.get("cached"))
+
+    def _lat(lats: list[float]) -> dict:
+        if not lats:
+            return {"p50_s": None, "mean_s": None}
+        return {"p50_s": round(lats[len(lats) // 2], 6),
+                "mean_s": round(sum(lats) / len(lats), 6)}
+
+    cache = {
+        "hits": len(hits),
+        "misses": len(misses),
+        "hit_rate": (round(len(hits) / len(finished), 6)
+                     if finished else None),
+        "hit_latency": _lat(hits),
+        "miss_latency": _lat(misses),
+    }
+
     return {
         "level": level_idx,
         "offered_jobs_per_s": rate,
@@ -385,6 +449,7 @@ def _run_level(client: ServeClient, rng: random.Random, level_idx: int,
         "level_wall_s": round(level_wall, 3),
         "max_schedule_slip_s": round(max_slip, 3),
         "classes": classes,
+        "cache": cache,
         "nodes": nodes,
         "aggregate": {
             "submitted": agg_submitted,
@@ -453,6 +518,10 @@ def _sweep_workers(args) -> int:
             argv += ["--families_hist", args.families_hist]
         if args.compile_cache:
             argv += ["--compile_cache", args.compile_cache]
+        if args.popularity and args.popularity != "uniform":
+            argv += ["--popularity", args.popularity]
+        if args.result_cache:
+            argv += ["--result_cache", args.result_cache]
         if args.tenant_queue_cap:
             argv += ["--tenant_queue_cap", str(args.tenant_queue_cap)]
         if args.smoke:
@@ -539,6 +608,18 @@ def main(argv=None) -> int:
     ap.add_argument("--families_hist", default="",
                     help="a *_read_families.txt to draw family sizes from "
                          "(default: built-in duplex-typical PMF)")
+    ap.add_argument("--popularity", default="uniform",
+                    help="input popularity over the finite per-class "
+                         "corpus: 'uniform' (default) or 'zipf:<s>' — "
+                         "zipf re-draws hot inputs with identical spec "
+                         "params, so a --result_cache fleet answers the "
+                         "repeats from the content-addressed store; the "
+                         "level report gains a hit-rate and hit-vs-miss "
+                         "latency split either way")
+    ap.add_argument("--result_cache", default="",
+                    help="forwarded to the spawned daemon/router: "
+                         "content-addressed result store root (hits skip "
+                         "recompute and return byte-identical outputs)")
     ap.add_argument("--inputs_per_class", type=int, default=3)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--gang_size", type=int, default=2)
@@ -581,6 +662,7 @@ def main(argv=None) -> int:
     if len(rates) < (1 if args.smoke else 3):
         raise SystemExit("loadgen: need at least 3 --levels for a curve")
     mix = _parse_mix(args.mix)
+    zipf_s = _parse_popularity(args.popularity)
     pmf = (_load_family_pmf(args.families_hist) if args.families_hist
            else dict(DEFAULT_FAMILY_PMF))
 
@@ -614,6 +696,8 @@ def main(argv=None) -> int:
         ]
         if args.compile_cache:
             daemon_cmd += ["--compile_cache", args.compile_cache]
+        if args.result_cache:
+            daemon_cmd += ["--result_cache", args.result_cache]
         log_path = os.path.join(args.workdir, "router.log")
         log_fh = open(log_path, "ab")
         daemon = subprocess.Popen(daemon_cmd, stdout=log_fh, stderr=log_fh)
@@ -633,6 +717,8 @@ def main(argv=None) -> int:
             daemon_cmd += ["--tenant_queue_cap", str(args.tenant_queue_cap)]
         if args.compile_cache:
             daemon_cmd += ["--compile_cache", args.compile_cache]
+        if args.result_cache:
+            daemon_cmd += ["--result_cache", args.result_cache]
         log_path = os.path.join(args.workdir, "daemon.log")
         log_fh = open(log_path, "ab")
         daemon = subprocess.Popen(daemon_cmd, stdout=log_fh, stderr=log_fh)
@@ -696,13 +782,21 @@ def main(argv=None) -> int:
             print(f"loadgen: level {idx}: {rate:g} jobs/s for "
                   f"{args.duration:g}s ...", flush=True)
             lv = _run_level(client, rng, idx, rate, args.duration,
-                            args.settle, mix, inputs, outdir)
+                            args.settle, mix, inputs, outdir,
+                            zipf_s=zipf_s)
             agg = lv["aggregate"]
             print(f"loadgen: level {idx}: submitted={agg['submitted']} "
                   f"done={agg['done']} shed={agg['shed']} "
                   f"lost={agg['lost']} "
                   f"thru={agg['throughput_jobs_per_s']:g}/s "
                   f"shed_ratio={agg['shed_ratio']:g}", flush=True)
+            cc = lv["cache"]
+            if cc["hits"]:
+                print(f"loadgen: level {idx}: cache hits={cc['hits']} "
+                      f"misses={cc['misses']} "
+                      f"hit_rate={cc['hit_rate']} "
+                      f"hit_p50={cc['hit_latency']['p50_s']} "
+                      f"miss_p50={cc['miss_latency']['p50_s']}", flush=True)
             if agg["lost"]:
                 rc = 1
             if lv["nodes"]:
@@ -720,6 +814,12 @@ def main(argv=None) -> int:
             lv["recompiles_total"] = _recompiles_total(client.metrics())
             levels.append(lv)
         final = client.metrics()
+        ch = sum(lv["cache"]["hits"] for lv in levels)
+        cm = sum(lv["cache"]["misses"] for lv in levels)
+        cache_total = {
+            "hits": ch, "misses": cm,
+            "hit_rate": round(ch / (ch + cm), 6) if ch + cm else None,
+        }
         doc = {
             "bench": "loadgen",
             "created_unix": time.time(),
@@ -733,6 +833,9 @@ def main(argv=None) -> int:
                 "gang_size": args.gang_size,
                 "queue_bound": args.queue_bound,
                 "families_hist": args.families_hist or "builtin",
+                "popularity": args.popularity,
+                "corpus_size": sum(len(v) for v in inputs.values()),
+                "result_cache": args.result_cache or None,
                 "seed": args.seed,
                 "smoke": args.smoke,
                 "workers": args.workers,
@@ -740,6 +843,7 @@ def main(argv=None) -> int:
             "preflight_recompiles_total": pre_recompiles,
             "levels": levels,
             "knee": knee_estimate(levels, args.shed_knee),
+            "cache": cache_total,
             "slo": final.get("slo"),
             "queued_by_class": final.get("queued_by_class"),
             "autotune": final.get("autotune"),
@@ -763,6 +867,10 @@ def main(argv=None) -> int:
         print(f"loadgen: knee={knee['knee_offered_jobs_per_s']} jobs/s "
               f"(shed<= {args.shed_knee:g}), peak throughput="
               f"{knee['max_throughput_jobs_per_s']:g} jobs/s", flush=True)
+        if cache_total["hits"] or cache_total["misses"]:
+            print(f"loadgen: cache hit_rate={cache_total['hit_rate']} "
+                  f"({cache_total['hits']} hit / "
+                  f"{cache_total['misses']} miss)", flush=True)
         return rc
     finally:
         if daemon is not None:
