@@ -77,6 +77,9 @@ _OBS_ENV = {
     "trace_dir": "CCT_TRACE_DIR",
     "trace_ring": "CCT_TRACE_RING",
     "flight_ring": "CCT_FLIGHT_RING",
+    "prof": "CCT_PROF",
+    "prof_hz": "CCT_PROF_HZ",
+    "prof_dir": "CCT_PROF_DIR",
 }
 
 
@@ -1588,6 +1591,61 @@ def top_cmd(args) -> None:
         once=_bool(getattr(args, "once", "False") or "False")))
 
 
+def prof_cmd(args) -> None:
+    """``prof report``: merge every live process's profile (router's
+    ``prof`` wire op, fleet-wide) with any on-disk ``prof-*.ndjson``
+    shards under --dir (dead processes' flushed samples) into per-node
+    hottest-function tables and the wall-attribution report splitting
+    each node's wall into {queue, routing, host compute, device
+    dispatch, deflate, io}.
+
+    ``prof flame``: same merge, written as standard collapsed-stack
+    lines (``frame;frame count``) for any flamegraph renderer."""
+    from consensuscruncher_tpu.obs import prof as obs_prof
+
+    docs: list[dict] = []
+    address = args.socket or (args.host, int(args.port))
+    try:
+        from consensuscruncher_tpu.serve.client import ServeClient
+
+        reply = ServeClient(address).request(
+            {"op": "prof", "fleet": True}, timeout=60.0)["prof"]
+    except Exception as e:
+        print(f"WARNING: prof: wire collection failed ({e}); "
+              "merging on-disk shards only", file=sys.stderr, flush=True)
+        reply = []
+    if isinstance(reply, dict):  # a lone daemon answered directly
+        reply = [reply]
+    docs.extend(d for d in reply or [] if isinstance(d, dict))
+    prof_dir = args.prof_dir or os.environ.get("CCT_PROF_DIR")
+    if prof_dir and os.path.isdir(prof_dir):
+        import glob as _glob
+        for shard in sorted(_glob.glob(
+                os.path.join(prof_dir, "prof-*.ndjson"))):
+            docs.append({"lines": obs_prof.read_shard(shard)})
+    merged = obs_prof.merge_profiles(docs)
+    if not merged["samples"] and not merged["by_node"]:
+        raise SystemExit(
+            "prof: nothing collected — is the router up "
+            "(--socket/--host/--port) or --dir pointing at a "
+            "CCT_PROF_DIR with prof-*.ndjson shards?")
+    if args.action == "flame":
+        out = args.out or "prof.collapsed"
+        with open(out, "w", encoding="utf-8") as fh:
+            fh.write("\n".join(
+                obs_prof.collapsed_lines(merged["samples"])) + "\n")
+        print(f"prof: wrote {len(merged['samples'])} collapsed stacks "
+              f"({sum(merged['samples'].values())} samples) -> {out}")
+        return
+    sys.stdout.write(obs_prof.render_report(merged, top_n=int(args.top)))
+    if getattr(args, "json", None):
+        with open(args.json, "w", encoding="utf-8") as fh:
+            json.dump(obs_prof.attribution_doc(merged), fh,
+                      indent=1, sort_keys=True)
+            fh.write("\n")
+        print(f"prof: attribution -> {args.json}")
+
+
 # ------------------------------------------------------------------- argparse
 
 def build_parser() -> argparse.ArgumentParser:
@@ -1964,6 +2022,33 @@ def build_parser() -> argparse.ArgumentParser:
                                      "socket": "", "host": "127.0.0.1",
                                      "port": 7733})
 
+    pr = sub.add_parser(
+        "prof", help="work with CCT_PROF sampling-profiler data")
+    pr.add_argument("action", choices=("report", "flame"),
+                    help="report: per-node hottest-function tables + the "
+                         "wall-attribution report (queue/routing/host/"
+                         "device/deflate/io); flame: export merged "
+                         "collapsed-stack lines for a flamegraph "
+                         "renderer")
+    pr.add_argument("-c", "--config", default=None)
+    pr.add_argument("--dir", dest="prof_dir",
+                    help="profile shard directory (default $CCT_PROF_DIR)")
+    pr.add_argument("--out", help="flame output path "
+                                  "(default prof.collapsed)")
+    pr.add_argument("--json", help="also write the attribution doc as "
+                                   "JSON to this path (report only)")
+    pr.add_argument("--top", type=int, help="rows per node in the "
+                                            "report tables (default 15)")
+    pr.add_argument("--socket", help="router/daemon unix socket (fleet)")
+    pr.add_argument("--host", help="router TCP host (default 127.0.0.1)")
+    pr.add_argument("--port", type=int, help="router TCP port "
+                                             "(default 7733)")
+    pr.set_defaults(func=prof_cmd, config_section="obs", required_args=(),
+                    builtin_defaults={"prof_dir": "", "out": "",
+                                      "json": "", "top": 15,
+                                      "socket": "", "host": "127.0.0.1",
+                                      "port": 7733})
+
     w = sub.add_parser(
         "top", help="live terminal observatory over a router or daemon")
     w.add_argument("-c", "--config", default=None)
@@ -2086,7 +2171,12 @@ def main(argv=None, _sscs_handoff=None) -> int:
 
     _apply_obs_config(args.config)
     _apply_io_config(args.config)
+    from consensuscruncher_tpu.obs import prof as obs_prof
     from consensuscruncher_tpu.obs import trace as obs_trace
+
+    # Always-on profiler: one idempotent call covers every subcommand
+    # (serve/route daemons, one-shot consensus runs, loadgen re-entry).
+    obs_prof.maybe_start()
 
     # The root CLI span mints the run's trace_id (serve jobs re-entering
     # main() in-process inherit their job span's id instead); the explicit
@@ -2097,6 +2187,7 @@ def main(argv=None, _sscs_handoff=None) -> int:
             args.func(args)
     finally:
         obs_trace.flush()
+        obs_prof.flush()
     return 0
 
 

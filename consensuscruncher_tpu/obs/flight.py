@@ -100,6 +100,15 @@ class FlightRecorder:
             "events": events,
             "trace_events": _trace.recent_events(limit=256),
         }
+        # "what was it DOING": last-30s collapsed stacks from the
+        # CCT_PROF sampler (empty when profiling is off).  Late import:
+        # prof pulls in metrics machinery the recorder itself never
+        # needs, and a dump must survive any partial-import state.
+        try:
+            from consensuscruncher_tpu.obs import prof as _prof
+            doc["prof"] = _prof.flight_snapshot(last_s=30.0)
+        except Exception:
+            pass
         if node is not None:
             doc["node"] = node
         if epoch is not None:

@@ -98,6 +98,17 @@ def observe(name: str, value) -> None:
     get_histogram(name).observe(value)
 
 
+def histogram_sum(name: str) -> float:
+    """Cumulative observed sum of one registered histogram (0.0 when it
+    was never observed).  The profiler's span-delta attribution reads
+    ``device_dispatch_s`` through this between job-span enter/exit."""
+    h = _hists.get(name)
+    if h is None:
+        return 0.0
+    with h._lock:
+        return h._sum
+
+
 def histograms_snapshot() -> dict:
     """All registered histograms, zero-filled when never observed, so
     every metrics doc / bench sidecar carries an identical schema."""

@@ -87,6 +87,15 @@ COUNTERS = {
                      "context to link from — each is a causal chain "
                      "severed at a hop and a trace_check --fleet failure "
                      "waiting to happen",
+    "prof_samples": "stack samples taken by the CCT_PROF sampling "
+                    "profiler (one per sampled thread per tick at "
+                    "CCT_PROF_HZ; 0 unless CCT_PROF is on)",
+    "prof_drops": "samples whose collapsed stack was dropped because the "
+                  "bounded aggregate already held CCT_PROF_MAX_STACKS "
+                  "distinct keys — counted, never silently absorbed",
+    "prof_shards": "profile shard lines flushed to prof-<pid>.ndjson "
+                   "under CCT_PROF_DIR (one line per flush interval "
+                   "with pending samples)",
     "mc_interleavings": "distinct schedules executed by the interleaving "
                         "model checker (tools/model_check.py)",
     "mc_violations": "schedules on which the model checker found a "

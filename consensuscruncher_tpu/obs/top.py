@@ -147,10 +147,13 @@ def _fmt_s(v: float | None) -> str:
 
 
 def render_frame(series: dict, source: str,
-                 paused: bool = False, now: float | None = None) -> str:
+                 paused: bool = False, now: float | None = None,
+                 prof: dict | None = None) -> str:
     """One observatory frame from parsed exposition series.  Pure: the
     clock is injectable and absent fleet metrics degrade to the lone-
-    daemon layout instead of failing."""
+    daemon layout instead of failing.  ``prof`` is an optional per-node
+    profiler panel (``obs.prof.top_panel`` shape), rendered when the
+    operator toggled it on with the ``f`` key."""
     stamp = time.strftime("%H:%M:%S",
                           time.localtime(now if now is not None
                                          else time.time()))
@@ -246,7 +249,24 @@ def render_frame(series: dict, source: str,
     if shown:
         lines.append("totals: " + "  ".join(f"{label}={_fmt_n(v)}"
                                             for label, v in shown))
-    lines.append("keys: q quit  p pause  r refresh")
+
+    # prof panel (f key): per-node hottest function by self samples and
+    # queue wait as a share of job wall — the live "where is the time
+    # going" view over the same data ``cct prof report`` merges.
+    if prof:
+        lines.append(f"{'PROF':<10} {'SAMP':>6} {'QWAIT%':>6}  "
+                     f"HOT (self%)")
+        for node in sorted(prof):
+            row = prof[node] or {}
+            hot = row.get("hot") or "-"
+            share = row.get("hot_share") or 0.0
+            lines.append(
+                f"{node:<10} {_fmt_n(row.get('samples')):>6} "
+                f"{100.0 * (row.get('queue_share') or 0.0):>5.1f}%  "
+                f"{hot} ({100.0 * share:.0f}%)")
+    elif prof is not None:
+        lines.append("prof: no samples yet (is CCT_PROF=1 on the fleet?)")
+    lines.append("keys: q quit  p pause  r refresh  f prof")
     return "\n".join(lines) + "\n"
 
 
@@ -263,6 +283,24 @@ def _scrape(client) -> dict:
     text = client.request({"op": "metrics", "format": "prometheus"},
                           timeout=15.0)["prometheus"]
     return parse_prometheus(text)
+
+
+def _scrape_prof(client) -> dict:
+    """Best-effort prof-panel scrape: pull the fleet's profiles through
+    the ``prof`` wire op and reduce to the per-node panel.  Any failure
+    (old daemon, profiling off) degrades to an empty panel — the
+    observatory keeps rendering."""
+    from consensuscruncher_tpu.obs import prof as obs_prof
+
+    try:
+        reply = client.request({"op": "prof", "fleet": True},
+                               timeout=15.0)["prof"]
+    except Exception:
+        return {}
+    if isinstance(reply, dict):
+        reply = [reply]
+    docs = [d for d in reply or [] if isinstance(d, dict)]
+    return obs_prof.top_panel(obs_prof.merge_profiles(docs))
 
 
 def run_top(address, interval_s: float = 2.0, once: bool = False) -> int:
@@ -290,6 +328,7 @@ def run_top(address, interval_s: float = 2.0, once: bool = False) -> int:
         tty_state = termios.tcgetattr(fd)
         _tty.setcbreak(fd)
     paused = False
+    show_prof = False
     frame = ""
     next_poll = 0.0
     try:
@@ -297,11 +336,12 @@ def run_top(address, interval_s: float = 2.0, once: bool = False) -> int:
             now = time.monotonic()
             if not paused and now >= next_poll:
                 try:
-                    frame = render_frame(_scrape(client), source,
-                                         paused=paused)
+                    frame = render_frame(
+                        _scrape(client), source, paused=paused,
+                        prof=_scrape_prof(client) if show_prof else None)
                 except Exception as e:
                     frame = (f"cct top — {source} — scrape failed: {e}\n"
-                             "keys: q quit  p pause  r refresh\n")
+                             "keys: q quit  p pause  r refresh  f prof\n")
                 next_poll = now + max(0.2, float(interval_s))
                 sys.stdout.write("\x1b[2J\x1b[H" + frame)
                 sys.stdout.flush()
@@ -326,6 +366,10 @@ def run_top(address, interval_s: float = 2.0, once: bool = False) -> int:
                 if not paused:
                     next_poll = 0.0  # resume refreshes immediately
             if ch in ("r", "R"):
+                next_poll = 0.0
+                paused = False
+            if ch in ("f", "F"):
+                show_prof = not show_prof
                 next_poll = 0.0
                 paused = False
     except KeyboardInterrupt:

@@ -98,6 +98,12 @@ _next_span_id = 0
 # shard-only lane; None outside a fleet daemon.
 _identity: str | None = None
 
+# span observer installed by obs.prof: rides every _Span enter/exit so
+# the sampler can attribute stacks to the innermost open span and job
+# spans can self-report wall attribution — even with CCT_TRACE off
+# (span() constructs a real _Span whenever an observer is live).
+_observer = None
+
 # process-wide trace-plane tallies, folded into the scheduler/router
 # metrics docs (names registered in obs/registry.py COUNTERS).  Plain
 # ints under _state_lock: the span hot path already takes that lock to
@@ -109,6 +115,21 @@ def set_identity(node: str | None) -> None:
     """Stamp ``node`` onto every event this process records from now on."""
     global _identity
     _identity = str(node) if node else None
+
+
+def identity() -> str | None:
+    """The fleet node identity this process stamps (None outside a
+    daemon) — shared with the profiler's shard lines."""
+    return _identity
+
+
+def set_observer(obs) -> None:
+    """Install (or with None, remove) the span observer — an object with
+    ``span_enter(name) -> token`` and ``span_exit(name, token, args,
+    dur_s)``.  Observer failures are swallowed at the call sites: the
+    profiler must never take down a job."""
+    global _observer
+    _observer = obs
 
 
 def counter_snapshot() -> dict:
@@ -200,7 +221,7 @@ _NOOP = _Noop()
 class _Span:
     __slots__ = ("name", "trace_id", "histogram", "args", "link",
                  "_recording", "_span_id", "_parent_id", "_hop",
-                 "_t0", "_w0")
+                 "_t0", "_w0", "_prof")
 
     def __init__(self, name, trace_id, histogram, args, link=None):
         self.name = name
@@ -232,6 +253,13 @@ class _Span:
             self._span_id = _mint_span_id()
             self._parent_id = parent[1] if parent else None
             st.stack.append((self.trace_id, self._span_id, self._hop))
+        obs = _observer
+        self._prof = None
+        if obs is not None:
+            try:
+                self._prof = obs.span_enter(self.name)
+            except Exception:
+                pass  # the profiler must never take down a job
         self._w0 = time.time()
         self._t0 = time.perf_counter()
         return self
@@ -240,6 +268,14 @@ class _Span:
         dur = time.perf_counter() - self._t0
         if self.histogram is not None:
             _metrics.observe(self.histogram, dur)
+        obs = _observer
+        if obs is not None:
+            # before the event is recorded, so observer-computed span
+            # args (host_cpu_ms & friends on serve.job) land in it
+            try:
+                obs.span_exit(self.name, self._prof, self.args, dur)
+            except Exception:
+                pass
         if self._recording:
             st = _state()
             if st.stack:
@@ -280,7 +316,7 @@ def span(name: str, trace_id: str | None = None,
     count and records a ``follows_from`` edge back to the sender's span —
     the cross-process continuation primitive every HA hand-off uses.
     """
-    if not enabled() and histogram is None:
+    if not enabled() and histogram is None and _observer is None:
         return _NOOP
     return _Span(name, trace_id, histogram, args, link=link)
 

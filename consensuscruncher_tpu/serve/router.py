@@ -90,6 +90,7 @@ from collections import OrderedDict
 
 from consensuscruncher_tpu.obs import flight as obs_flight
 from consensuscruncher_tpu.obs import metrics as obs_metrics
+from consensuscruncher_tpu.obs import prof as obs_prof
 from consensuscruncher_tpu.obs import trace as obs_trace
 from consensuscruncher_tpu.serve import journal as journal_mod
 from consensuscruncher_tpu.serve.client import ServeClient, ServeClientError
@@ -1507,7 +1508,9 @@ class Router:
         health = self.healthz()
         cumulative = self.counters.snapshot()
         # the router's own trace-plane tallies (spans / links / orphans)
+        # and profiler tallies (samples / drops / shards)
         cumulative.update(obs_trace.counter_snapshot())
+        cumulative.update(obs_prof.counter_snapshot())
         return {
             "stage": "route",
             "phases_s": {"uptime": time.time() - self._started_at},
@@ -1540,6 +1543,25 @@ class Router:
             if isinstance(buf, dict):
                 groups.append(buf)
         return groups
+
+    def prof_fleet(self) -> list[dict]:
+        """Every process's profile, for ``cct prof``: the router's own
+        shard lines + wall attribution plus each up member's ``prof``
+        op reply.  Down members' flushed ``prof-*.ndjson`` shards stay
+        collectable from ``CCT_PROF_DIR`` — same discipline as traces;
+        collection never fails routing."""
+        docs: list[dict] = [obs_prof.collect(node=self.router_id)]
+        for member in self.members():
+            if not member.up:
+                continue
+            try:
+                reply = member.client.request({"op": "prof"}, timeout=15.0)
+            except Exception:
+                continue
+            doc = reply.get("prof")
+            if isinstance(doc, dict):
+                docs.append(doc)
+        return docs
 
 
 class RouterServer(ServeServer):
@@ -1609,6 +1631,13 @@ class RouterServer(ServeServer):
                 return {"ok": True, "trace": {
                     "node": self.router.router_id, "pid": os.getpid(),
                     "events": obs_trace.collect_events()}}
+            if op == "prof":
+                # fleet profile collection; unfenced for the same
+                # reason as trace — perf postmortems outlive HA roles
+                if req.get("fleet"):
+                    return {"ok": True, "prof": self.router.prof_fleet()}
+                return {"ok": True,
+                        "prof": obs_prof.collect(node=self.router.router_id)}
             return {"ok": False, "error": f"unknown op {op!r}"}
         except ServeClientError as e:
             # a member refusal / ``ok: false`` travels back verbatim

@@ -83,6 +83,7 @@ from collections import deque
 
 from consensuscruncher_tpu.obs import flight as obs_flight
 from consensuscruncher_tpu.obs import metrics as obs_metrics
+from consensuscruncher_tpu.obs import prof as obs_prof
 from consensuscruncher_tpu.obs import trace as obs_trace
 from consensuscruncher_tpu.obs.registry import (
     DEFAULT_QOS,
@@ -165,6 +166,10 @@ class Job:
         self.error: str | None = None
         self.outputs: dict | None = None
         self.wall_s: float | None = None
+        # admission -> dispatch wait, fixed at dispatch time; the job
+        # span reports it (queue_wait_ms) so the profiler's attribution
+        # can split wall into queue vs run without re-deriving it
+        self.queue_wait_s: float | None = None
         self.attempts = 0
         self.gang_size = 1  # how many jobs shared this job's SSCS dispatch
         # True when the content-addressed result cache answered this job
@@ -1039,8 +1044,10 @@ class Scheduler:
             cumulative["recompiles"] = obs_metrics.recompiles()
             # the trace plane owns its own tallies (spans/links/orphans
             # recorded by any thread, not just the scheduler): overlay
-            # them so one metrics doc carries the whole process
+            # them so one metrics doc carries the whole process; same
+            # for the profiler's sample/drop/shard tallies
             cumulative.update(obs_trace.counter_snapshot())
+            cumulative.update(obs_prof.counter_snapshot())
             doc = metrics_doc(
                 "serve", {"uptime": time.time() - self._started_at},
                 {"n_jobs": len(jobs), "queue_bound": self.queue_bound,
@@ -1153,6 +1160,7 @@ class Scheduler:
                 for job in live:
                     job.state = "running"
                     job.gang_size = len(live)
+                    job.queue_wait_s = now - job.submitted_t
                     obs_metrics.observe("queue_wait_s", now - job.submitted_t)
                     obs_metrics.observe_labeled(
                         "tenant_queue_wait_s", now - job.submitted_t,
@@ -1203,7 +1211,9 @@ class Scheduler:
             try:
                 with obs_trace.span("serve.job", trace_id=job.trace_id,
                                     job_id=job.id, tenant=job.tenant,
-                                    qos=job.qos, cached=job.id in hits):
+                                    qos=job.qos, cached=job.id in hits,
+                                    queue_wait_ms=round(
+                                        (job.queue_wait_s or 0.0) * 1e3, 3)):
                     if job.id in hits:
                         self._cache_materialize(job, hits[job.id])
                     else:

@@ -68,6 +68,7 @@ import sys
 import threading
 import time
 
+from consensuscruncher_tpu.obs import prof as obs_prof
 from consensuscruncher_tpu.obs import trace as obs_trace
 from consensuscruncher_tpu.obs.metrics import render_prometheus
 from consensuscruncher_tpu.serve.scheduler import (
@@ -335,6 +336,13 @@ class ServeServer:
                 return {"ok": True, "trace": {
                     "node": self.scheduler.node, "pid": os.getpid(),
                     "events": obs_trace.collect_events()}}
+            if op == "prof":
+                # profiler collection: this process's sampled-stack
+                # shard lines + wall attribution.  Unfenced like
+                # healthz/metrics/trace — perf postmortems must stay
+                # collectable through a demoted router.
+                return {"ok": True,
+                        "prof": obs_prof.collect(node=self.scheduler.node)}
             return {"ok": False, "error": f"unknown op {op!r}"}
         except RouterFenced as e:
             return {"ok": False, "error": str(e), "fenced": True,

@@ -1,0 +1,408 @@
+"""Always-on sampling profiler: determinism, attribution, shard merges,
+and the perf-regression gate.
+
+The contract under test, end to end:
+
+- the profiler is a pure *sidecar*: running the full consensus pipeline
+  (staged AND streaming wires) under ``CCT_PROF=1`` reproduces the
+  frozen goldens exactly;
+- the sampler starts/stops idempotently, counts every sample, and
+  counts (never grows past) overflow beyond ``CCT_PROF_MAX_STACKS``;
+- ``merge_profiles`` dedups the wire-buffer/shard overlap by
+  ``(pid, seq)`` — max-sample version wins — then sums, so fleet
+  reports never double-count a live ring that later flushed;
+- the ``serve.job`` span observer decomposes job wall into the six
+  attribution buckets in milliseconds, with io as the clamped
+  remainder (worker coverage 1.0 by construction);
+- ``tools/perf_gate.py`` passes a no-change artifact, fails a
+  regressed one, tolerates drift inside the tolerance, and keeps
+  structural checks strict under ``--smoke``.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "test"))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import perf_gate  # noqa: E402
+from make_test_data import canonical_bam_digest, text_digest  # noqa: E402
+
+from consensuscruncher_tpu.obs import flight as obs_flight
+from consensuscruncher_tpu.obs import prof as obs_prof
+from consensuscruncher_tpu.obs import top as obs_top
+from consensuscruncher_tpu.obs import trace as obs_trace
+
+DATA = os.path.join(REPO, "test", "data")
+GOLDEN = json.load(open(os.path.join(REPO, "test", "golden.json")))
+
+
+@pytest.fixture
+def prof_reset(monkeypatch):
+    """Pristine profiler state before AND after: no sampler, no observer,
+    zeroed aggregates/tallies, seq rewound."""
+    monkeypatch.delenv("CCT_PROF", raising=False)
+    monkeypatch.delenv("CCT_PROF_DIR", raising=False)
+    obs_prof.reset_for_tests()
+    yield
+    obs_prof.reset_for_tests()
+
+
+def _busy(ms: float = 30.0) -> float:
+    deadline = time.monotonic() + ms / 1e3
+    x = 0
+    while time.monotonic() < deadline:
+        x += sum(i * i for i in range(200))
+    return x
+
+
+# --------------------------------------------------- determinism firewall
+
+def test_goldens_byte_identical_under_prof_both_wires(tmp_path, monkeypatch,
+                                                      prof_reset):
+    """The acceptance bar: a hot sampler (199 Hz) + the span observer on
+    the full pipeline, staged and streaming, must not move a single
+    output byte off the frozen goldens."""
+    from consensuscruncher_tpu.cli import main as cli_main
+
+    monkeypatch.setenv("CCT_PROF", "1")
+    monkeypatch.setenv("CCT_PROF_HZ", "199")
+    for mode, extra in (("staged", []),
+                        ("streaming", ["--pipeline", "streaming",
+                                       "--intermediate_taps", "True"])):
+        rc = cli_main(["consensus", "-i", os.path.join(DATA, "sample.bam"),
+                       "-o", str(tmp_path / mode), "-n", "golden",
+                       "--backend", "cpu", "--scorrect", "True", *extra])
+        assert rc == 0
+        base = tmp_path / mode / "golden"
+        bad = []
+        for rel, want in GOLDEN["consensus"].items():
+            p = base / rel
+            assert p.exists(), f"{mode}: missing {rel}"
+            got = (canonical_bam_digest(str(p)) if rel.endswith(".bam")
+                   else text_digest(str(p)))
+            if got != want:
+                bad.append(rel)
+        assert not bad, f"{mode} wire diverges under CCT_PROF=1: {bad}"
+    # the run actually profiled: the boot path started the sampler and
+    # real samples landed while the pipeline was doing real work
+    assert obs_prof.counter_snapshot()["prof_samples"] > 0
+
+
+# ------------------------------------------------------ sampler lifecycle
+
+def test_maybe_start_respects_env_and_is_idempotent(monkeypatch, prof_reset):
+    assert obs_prof.maybe_start() is False          # CCT_PROF unset
+    assert not obs_prof.running()
+    monkeypatch.setenv("CCT_PROF", "1")
+    assert obs_prof.maybe_start() is True
+    assert obs_prof.running()
+    assert obs_prof.maybe_start() is False          # already running
+    obs_prof.stop()
+    assert not obs_prof.running()
+    # stop uninstalled the observer: with tracing off too, span() is free
+    assert obs_trace.span("anything") is obs_trace._NOOP
+
+
+def test_sampler_attributes_samples_to_open_span(prof_reset):
+    assert obs_prof.start(hz=200.0)
+    done = threading.Event()
+
+    def work():
+        with obs_trace.span("serve.job"):
+            while not done.is_set():
+                _busy(5.0)
+
+    t = threading.Thread(target=work, daemon=True)
+    t.start()
+    time.sleep(0.25)
+    done.set()
+    t.join(5.0)
+    obs_prof.stop()
+    tally = obs_prof.counter_snapshot()
+    assert tally["prof_samples"] > 0
+    doc = obs_prof.collect(node="n0")
+    spanned = [k for ln in doc["lines"]
+               for k in (ln.get("samples") or {})
+               if k.startswith("span:serve.job;")]
+    assert spanned, "no sample attributed to the open serve.job span"
+
+
+def test_ingest_bounds_distinct_stacks_and_counts_drops(monkeypatch,
+                                                        prof_reset):
+    monkeypatch.setenv("CCT_PROF_MAX_STACKS", "16")
+    obs_prof._ingest([f"a;b;k{i}" for i in range(20)])
+    tally = obs_prof.counter_snapshot()
+    assert tally["prof_samples"] == 20
+    assert tally["prof_drops"] == 4                 # 16 kept, 4 counted
+    with obs_prof._lock:
+        assert len(obs_prof._agg) == 16
+    # known keys keep counting at the cap; only NEW keys drop
+    obs_prof._ingest(["a;b;k0", "a;b;k999"])
+    tally = obs_prof.counter_snapshot()
+    assert tally["prof_samples"] == 22
+    assert tally["prof_drops"] == 5
+    with obs_prof._lock:
+        assert obs_prof._agg["a;b;k0"] == 2
+
+
+# ------------------------------------------------------ shards + merging
+
+def test_flush_shard_roundtrip_and_drop_draining(tmp_path, monkeypatch,
+                                                 prof_reset):
+    monkeypatch.setenv("CCT_PROF_DIR", str(tmp_path))
+    monkeypatch.setenv("CCT_PROF_MAX_STACKS", "16")
+    obs_prof._ingest([f"x;k{i}" for i in range(18)])
+    assert obs_prof.flush() == 16                   # samples written
+    assert obs_prof.flush() == 0                    # nothing pending
+    shard = tmp_path / f"prof-{os.getpid()}.ndjson"
+    (line,) = obs_prof.read_shard(str(shard))
+    assert line["seq"] == 1 and line["pid"] == os.getpid()
+    assert sum(line["samples"].values()) == 16
+    assert line["drops"] == 2                       # drained ONCE per line
+    obs_prof._ingest(["x;k0"])
+    obs_prof.flush()
+    lines = obs_prof.read_shard(str(shard))
+    assert [ln["seq"] for ln in lines] == [1, 2]
+    assert lines[1]["drops"] == 0
+    # torn tail (kill -9 mid-write) is skipped, earlier lines survive
+    with open(shard, "a") as fh:
+        fh.write('{"v": 1, "pid": 1, "seq"')
+    assert len(obs_prof.read_shard(str(shard))) == 2
+    assert obs_prof.counter_snapshot()["prof_shards"] == 2
+
+
+def test_merge_dedups_by_pid_seq_max_samples_wins(prof_reset):
+    live = {"v": 1, "pid": 7, "node": "w0", "seq": 3,
+            "samples": {"a;b": 5}, "attr": {"jobs": 1}, "drops": 0}
+    flushed = dict(live, samples={"a;b": 9})        # same line, later flush
+    other = {"v": 1, "pid": 7, "node": "w0", "seq": 2,
+             "samples": {"a;b": 2, "c;d": 1},
+             "attr": {"jobs": 2, "job_wall_ms": 10.0}, "drops": 3}
+    merged = obs_prof.merge_profiles([
+        {"lines": [live, other]},                   # wire reply
+        {"lines": [flushed, other]},                # shard read-back
+    ])
+    assert merged["lines"] == 2                     # (7,2) and (7,3)
+    assert merged["samples"] == {"a;b": 11, "c;d": 1}
+    assert merged["drops"] == 3                     # other counted once
+    w0 = merged["by_node"]["w0"]
+    assert w0["attr"]["jobs"] == 3
+    assert w0["attr"]["job_wall_ms"] == 10.0
+
+
+def test_collect_without_dir_is_nondestructive_and_dedupable(prof_reset):
+    obs_prof._ingest(["m;n"] * 4)
+    one = obs_prof.collect(node="solo")
+    two = obs_prof.collect(node="solo")
+    assert one["lines"] and two["lines"]            # repeated polls answer
+    # the synthetic line carries the seq the NEXT real flush will get, so
+    # merging a poll with that later flush cannot double-count
+    merged = obs_prof.merge_profiles([one, two])
+    assert merged["samples"] == {"m;n": 4}
+
+
+# -------------------------------------------------- span-delta attribution
+
+def test_serve_job_span_self_reports_buckets_in_ms(monkeypatch, prof_reset):
+    monkeypatch.setenv("CCT_TRACE", "1")
+    obs_trace.drain_events()
+    obs_trace.set_observer(obs_prof._OBSERVER)
+    try:
+        with obs_trace.span("route.submit"):
+            time.sleep(0.02)
+        with obs_trace.span("serve.job", queue_wait_ms=7.5):
+            _busy(40.0)
+            time.sleep(0.03)                        # blocked time -> io
+    finally:
+        obs_trace.set_observer(None)
+    events = obs_trace.drain_events()
+    (job,) = [e for e in events
+              if e.get("ph") == "X" and e["name"] == "serve.job"]
+    args = job["args"]
+    wall_ms = job["dur"] / 1e3                      # trace dur is us
+    assert args["queue_wait_ms"] == 7.5
+    assert 10.0 <= args["host_cpu_ms"] <= wall_ms + 5.0
+    assert args["device_dispatch_ms"] >= 0.0
+    assert args["deflate_ms"] >= 0.0
+    doc = obs_prof.collect(node="w0")
+    (line,) = doc["lines"]
+    attr = line["attr"]
+    assert attr["jobs"] == 1
+    assert attr["queue_ms"] == 7.5
+    assert attr["routing_ms"] >= 15.0               # the route span's wall
+    assert attr["job_wall_ms"] == pytest.approx(wall_ms, rel=0.1)
+    # io is the remainder: sleep-heavy job must land a visible io bucket,
+    # and the identity host+device+deflate+io == job wall must hold
+    parts = (attr["host_cpu_ms"] + attr["device_dispatch_ms"]
+             + attr["deflate_ms"] + attr["io_ms"])
+    assert parts == pytest.approx(attr["job_wall_ms"], rel=0.01)
+    assert attr["io_ms"] >= 15.0
+    ad = obs_prof.attribution_doc(obs_prof.merge_profiles([doc]))
+    node = ad["nodes"]["w0"]
+    assert node["jobs"] == 1
+    assert node["coverage"] == 1.0                  # by construction
+    assert abs(sum(node["shares"].values()) - 1.0) < 0.01
+    assert ad["fleet"]["coverage"] >= 0.95          # the acceptance bar
+
+
+def test_report_panel_and_flight_snapshot(tmp_path, prof_reset):
+    obs_prof._ingest(["span:serve.job;m.outer;m.inner"] * 6
+                     + ["m.outer;m.other"] * 2)
+    with obs_prof._lock:
+        obs_prof._attr.update(queue_ms=30.0, host_cpu_ms=50.0,
+                              io_ms=20.0, job_wall_ms=70.0, jobs=2.0)
+    doc = obs_prof.collect(node="w0")
+    merged = obs_prof.merge_profiles([doc])
+    rows = obs_prof.top_functions(merged["samples"], n=3)
+    assert rows[0][0] == "m.inner" and rows[0][1] == 6
+    (outer,) = [r for r in rows if r[0] == "m.outer"]
+    assert outer[1] == 0 and outer[2] == 8          # never a leaf; on all 8
+    report = obs_prof.render_report(merged)
+    assert "w0: 8 samples" in report
+    assert "attribution (% of attributed wall):" in report
+    assert obs_prof.collapsed_lines(merged["samples"])[0] == \
+        "span:serve.job;m.outer;m.inner 6"
+    panel = obs_prof.top_panel(merged)
+    assert panel["w0"]["hot"] == "m.inner"
+    assert panel["w0"]["queue_share"] == pytest.approx(0.3)
+    # cct top renders the panel; the keys line (asserted by the existing
+    # top tests) stays the last line
+    frame = obs_top.render_frame({}, "unix:/x", prof=panel)
+    assert "PROF" in frame and "m.inner" in frame
+    assert frame.splitlines()[-1].startswith("keys: q quit")
+    empty = obs_top.render_frame({}, "unix:/x", prof={})
+    assert "no samples yet" in empty
+    # flight dumps embed the last-N-seconds window ("what was it DOING")
+    snap = obs_prof.flight_snapshot(last_s=30.0)
+    assert snap["samples"]["m.outer;m.other"] == 2
+    rec = obs_flight.FlightRecorder(capacity=16)
+    out = rec.dump(path=str(tmp_path / "f.json"), reason="test")
+    dumped = json.load(open(out))
+    assert dumped["prof"]["samples"]["m.outer;m.other"] == 2
+
+
+# ----------------------------------------------------------- perf gate
+
+def _artifact(tmp_path, name, tput=2.0, knee=2.0, lost=0, recs=(5, 5, 5),
+              attr_shares=None, coverage=1.0):
+    doc = {
+        "bench": "loadgen",
+        "config": {"workers": 0},
+        "levels": [
+            {"aggregate": {"lost": lost, "shed_ratio": 0.0,
+                           "throughput_jobs_per_s": tput},
+             "recompiles_total": r} for r in recs],
+        "knee": {"knee_offered_jobs_per_s": knee,
+                 "max_throughput_jobs_per_s": tput,
+                 "shed_knee_threshold": 0.05},
+    }
+    if attr_shares is not None:
+        buckets = {k: attr_shares.get(k, 0.0) * 1000 for k in
+                   perf_gate.ATTR_BUCKETS}
+        doc["attribution"] = {
+            "nodes": {"n0": {"buckets_ms": buckets, "shares": attr_shares,
+                             "wall_ms": 1000.0, "jobs": 3,
+                             "coverage": coverage}},
+            "fleet": {"buckets_ms": buckets, "shares": attr_shares,
+                      "wall_ms": 1000.0, "jobs": 3, "coverage": coverage},
+        }
+    path = tmp_path / name
+    path.write_text(json.dumps(doc))
+    return str(path)
+
+
+SHARES = {"queue_ms": 0.2, "routing_ms": 0.0, "host_cpu_ms": 0.5,
+          "device_dispatch_ms": 0.1, "deflate_ms": 0.1, "io_ms": 0.1}
+
+
+def test_perf_gate_passes_unchanged_run(tmp_path, capsys):
+    base = _artifact(tmp_path, "base.json", attr_shares=SHARES)
+    fresh = _artifact(tmp_path, "fresh.json", attr_shares=SHARES)
+    assert perf_gate.main(["--fresh", fresh, "--baseline", base]) == 0
+    verdict = json.loads(capsys.readouterr().out)
+    assert verdict["ok"] is True
+    names = {c["name"] for c in verdict["checks"]}
+    assert {"lost_jobs", "recompiles_flat", "attribution_coverage",
+            "max_throughput_jobs_per_s"} <= names
+
+
+def test_perf_gate_fails_regression_and_emits_verdict(tmp_path, capsys):
+    base = _artifact(tmp_path, "base.json", tput=2.0)
+    fresh = _artifact(tmp_path, "fresh.json", tput=1.0)  # -50% > 25% tol
+    out = tmp_path / "verdict.json"
+    assert perf_gate.main(["--fresh", fresh, "--baseline", base,
+                           "--out", str(out)]) == 1
+    verdict = json.loads(out.read_text())
+    assert verdict["ok"] is False
+    (bad,) = [c for c in verdict["checks"]
+              if c["name"] == "max_throughput_jobs_per_s"]
+    assert bad["ok"] is False and bad["got"] == 1.0
+    capsys.readouterr()
+
+
+def test_perf_gate_tolerance_and_smoke_strictness(tmp_path, capsys):
+    base = _artifact(tmp_path, "base.json", tput=2.0, attr_shares=SHARES)
+    # within default tolerances: -20% throughput, +0.1 share drift
+    drift = dict(SHARES, queue_ms=0.3, host_cpu_ms=0.4)
+    near = _artifact(tmp_path, "near.json", tput=1.6, attr_shares=drift)
+    assert perf_gate.main(["--fresh", near, "--baseline", base]) == 0
+    # a big throughput drop passes under --smoke (shared-box weather)...
+    slow = _artifact(tmp_path, "slow.json", tput=0.8)
+    assert perf_gate.main(["--fresh", slow, "--baseline", base]) == 1
+    assert perf_gate.main(["--fresh", slow, "--baseline", base,
+                           "--smoke"]) == 0
+    # ...but structural checks stay strict under --smoke
+    lossy = _artifact(tmp_path, "lossy.json", lost=1)
+    assert perf_gate.main(["--fresh", lossy, "--baseline", base,
+                           "--smoke"]) == 1
+    uncovered = _artifact(tmp_path, "uncov.json", attr_shares=SHARES,
+                          coverage=0.5)
+    assert perf_gate.main(["--fresh", uncovered, "--baseline", base,
+                           "--smoke"]) == 1
+    capsys.readouterr()
+
+
+def test_perf_gate_tolerates_attribution_less_baseline(tmp_path, capsys):
+    """Older committed artifacts predate the profiler: the gate compares
+    throughput, skips drift, and still enforces fresh coverage."""
+    base = _artifact(tmp_path, "base.json")                 # no attribution
+    fresh = _artifact(tmp_path, "fresh.json", attr_shares=SHARES)
+    assert perf_gate.main(["--fresh", fresh, "--baseline", base]) == 0
+    verdict = json.loads(capsys.readouterr().out)
+    names = {c["name"] for c in verdict["checks"]}
+    assert "attribution_coverage" in names
+    assert not any(n.startswith("attr_share:") for n in names)
+
+
+# ------------------------------------------------------------- overhead
+
+@pytest.mark.parametrize("hz", [67.0])
+def test_sampler_overhead_is_small(prof_reset, hz):
+    """Measured, not assumed: the same fixed busy workload with and
+    without the sampler.  The acceptance target is <2% on a quiet host;
+    the assertion bound is generous (25%) because shared CI boxes
+    time-slice, but the measured number is printed for the record."""
+    def workload():
+        t0 = time.perf_counter()
+        for _ in range(30):
+            sum(i * i for i in range(20_000))
+        return time.perf_counter() - t0
+
+    workload()                                      # warm caches
+    cold = min(workload() for _ in range(3))
+    assert obs_prof.start(hz=hz)
+    try:
+        hot = min(workload() for _ in range(3))
+    finally:
+        obs_prof.stop()
+    overhead = hot / cold - 1.0
+    print(f"sampler overhead at {hz:g} Hz: {100.0 * overhead:.2f}% "
+          f"(cold {cold * 1e3:.1f} ms, hot {hot * 1e3:.1f} ms)")
+    assert overhead < 0.25

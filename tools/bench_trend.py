@@ -115,6 +115,26 @@ def _knee_fields(knee: dict | None, levels: list | None) -> dict:
     return out
 
 
+def _attr_fields(attribution: dict | None) -> dict:
+    """Where the fleet's wall went, from the CCT_PROF attribution doc a
+    profiled loadgen run embeds (r12+).  Older artifacts simply lack the
+    key and render as em-dashes — the columns must never make a
+    pre-profiler round unparseable.  ``compute`` folds host CPU, device
+    dispatch and BGZF deflate into one "doing the work" share so the
+    table reads queue vs route vs work at a glance."""
+    out = {"queue_share": None, "route_share": None, "compute_share": None}
+    shares = ((attribution or {}).get("fleet") or {}).get("shares")
+    if not isinstance(shares, dict):
+        return out
+    out["queue_share"] = shares.get("queue_ms")
+    out["route_share"] = shares.get("routing_ms")
+    parts = [shares.get(k) for k in ("host_cpu_ms", "device_dispatch_ms",
+                                     "deflate_ms")]
+    if any(p is not None for p in parts):
+        out["compute_share"] = round(sum(p or 0.0 for p in parts), 4)
+    return out
+
+
 def extract_loadgen(n: int, path: str) -> list[dict]:
     """Trend rows for one loadgen artifact.  Two shapes exist: r06 is a
     single-scheduler capacity run (top-level ``knee``/``levels``), r09 is
@@ -124,7 +144,9 @@ def extract_loadgen(n: int, path: str) -> list[dict]:
     of disappearing."""
     base = {"round": n, "workers": None, "speedup": None,
             "knee_offered": None, "max_throughput": None,
-            "shed_threshold": None, "peak_shed": None, "source": "parsed"}
+            "shed_threshold": None, "peak_shed": None,
+            "queue_share": None, "route_share": None,
+            "compute_share": None, "source": "parsed"}
     try:
         doc = json.load(open(path))
     except (OSError, ValueError):
@@ -133,6 +155,7 @@ def extract_loadgen(n: int, path: str) -> list[dict]:
     if not isinstance(runs, dict):
         row = dict(base, workers=(doc.get("config") or {}).get("workers"))
         row.update(_knee_fields(doc.get("knee"), doc.get("levels")))
+        row.update(_attr_fields(doc.get("attribution")))
         return [row]
     scaling = doc.get("scaling") or {}
     rows = []
@@ -140,6 +163,7 @@ def extract_loadgen(n: int, path: str) -> list[dict]:
         run = runs[key] or {}
         row = dict(base, workers=int(key) if str(key).isdigit() else key)
         row.update(_knee_fields(run.get("knee"), run.get("levels")))
+        row.update(_attr_fields(run.get("attribution")))
         row["speedup"] = (scaling.get(str(key)) or {}).get(
             "speedup_vs_1_worker")
         rows.append(row)
@@ -152,6 +176,12 @@ def _fmt(v, unit="") -> str:
     if isinstance(v, float) and v >= 1000:
         return f"{v:,.1f}{unit}"
     return f"{v:g}{unit}"
+
+
+def _fmt_share(v) -> str:
+    if v is None:
+        return "—"
+    return f"{100.0 * float(v):.1f}%"
 
 
 def _fmt_bytes(v) -> str:
@@ -228,21 +258,28 @@ def render_loadgen(rows: list[dict]) -> str:
         "one row per fleet size with the measured speedup over one",
         "worker — on a single-core bench host the sweep time-slices, so",
         "flat/sub-1x scaling measures routing overhead, not the router.",
+        "The queue/route/compute columns are CCT_PROF wall-attribution",
+        "shares (r12+: where the run's wall actually went — compute",
+        "folds host CPU + device dispatch + deflate); pre-profiler",
+        "rounds show em-dashes.",
         "",
         "| round | workers | knee (jobs/s) | max tput (jobs/s) "
-        "| peak shed | scaling vs 1w | source |",
+        "| peak shed | queue | route | compute | scaling vs 1w | source |",
         "|------:|--------:|--------------:|------------------:"
-        "|----------:|--------------:|:-------|",
+        "|----------:|------:|------:|--------:|--------------:|:-------|",
     ]
     for r in rows:
         lines.append(
-            "| r{round:02d} | {w} | {knee} | {tput} | {shed} | {spd} "
-            "| {src} |".format(
+            "| r{round:02d} | {w} | {knee} | {tput} | {shed} | {q} | {rt} "
+            "| {comp} | {spd} | {src} |".format(
                 round=r["round"],
                 w=_fmt(r["workers"]),
                 knee=_fmt(r["knee_offered"]),
                 tput=_fmt(r["max_throughput"]),
                 shed=_fmt(r["peak_shed"]),
+                q=_fmt_share(r["queue_share"]),
+                rt=_fmt_share(r["route_share"]),
+                comp=_fmt_share(r["compute_share"]),
                 spd=_fmt(r["speedup"], "x"),
                 src=r["source"]))
     lines.append("")

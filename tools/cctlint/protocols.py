@@ -92,6 +92,9 @@ WIRE_REPLY_KEYS = frozenset({
     # context, keyed polls answered from a dead member's journal carry
     # the original context, and the ``trace`` op returns event buffers
     "trace",
+    # profiling: the ``prof`` op returns sampled-stack shard lines and
+    # wall attribution (one process's, or the fleet's via the router)
+    "prof",
     # router ops
     "drained", "errors", "adopted", "jobs_adopted", "keys",
     "node", "address", "node_address", "stolen", "fleet_size",

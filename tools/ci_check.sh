@@ -127,12 +127,18 @@ trap 'rm -rf "$WORK"' EXIT
 # pass 1 learns the (B, F, L) bucket mix into the autotune table (saved at
 # daemon shutdown, next to the compile cache); pass 2 starts from that
 # table + warm cache, so its steady-state levels must mint ZERO new
-# dispatch shapes (the obs recompile counter polices it).
-for PASS in 1 2; do
-  python tools/loadgen.py --workdir "$WORK/lg$PASS" --smoke \
+# dispatch shapes (the obs recompile counter polices it).  Pass 2 runs
+# under the always-on sampling profiler (CCT_PROF=1 rides the inherited
+# env into the throwaway daemon) so the artifact carries the wall
+# attribution the perf gate below compares; pass 1 stays unprofiled to
+# exercise the tolerant no-attribution path.
+python tools/loadgen.py --workdir "$WORK/lg1" --smoke \
+  --compile_cache "$WORK/cache" \
+  --out "$WORK/BENCH_LOADGEN_smoke1.json"
+CCT_PROF=1 CCT_PROF_HZ=199 CCT_PROF_DIR="$WORK/profs" \
+  python tools/loadgen.py --workdir "$WORK/lg2" --smoke \
     --compile_cache "$WORK/cache" \
-    --out "$WORK/BENCH_LOADGEN_smoke$PASS.json"
-done
+    --out "$WORK/BENCH_LOADGEN_smoke2.json"
 python - "$WORK/BENCH_LOADGEN_smoke1.json" "$WORK/BENCH_LOADGEN_smoke2.json" <<'PY'
 import json, sys
 for path in sys.argv[1:3]:
@@ -154,9 +160,22 @@ assert pre is not None and None not in recs, \
     "daemon metrics missing the recompile counter"
 assert all(r == pre for r in recs), \
     f"measured levels minted new dispatch shapes: preflight={pre}, levels={recs}"
+# the profiled pass must explain where the daemon's wall went: >=95% of
+# each node's serve.job wall attributed across the six buckets
+attr = doc.get("attribution")
+assert attr and attr["nodes"], "profiled pass 2 artifact carries no attribution"
+for node, nd in attr["nodes"].items():
+    if nd["coverage"] is not None:
+        assert nd["coverage"] >= 0.95, f"node {node} coverage {nd['coverage']}"
 print(f"ci_check: loadgen artifacts OK (learned table: {at['shapes']} shapes, "
-      f"0 unexpected recompiles across {len(recs)} levels at {pre} total)")
+      f"0 unexpected recompiles across {len(recs)} levels at {pre} total; "
+      f"attribution covers {len(attr['nodes'])} node(s))")
 PY
+
+echo "== perf gate (pass 2 vs pass 1, smoke tolerances; structural strict) =="
+python tools/perf_gate.py --fresh "$WORK/BENCH_LOADGEN_smoke2.json" \
+  --baseline "$WORK/BENCH_LOADGEN_smoke1.json" --smoke \
+  --out "$WORK/perf_gate_verdict.json" > /dev/null
 
 echo "== result-cache parity smoke (cached answer == fresh recompute, byte-for-byte) =="
 JAX_PLATFORMS=cpu PYTHONPATH="$REPO" python - "$WORK/cachepar" <<'PY'
@@ -246,19 +265,24 @@ GOLDEN = json.load(open(os.path.join(REPO, "test", "golden.json")))
 SAMPLE = os.path.join(REPO, "test", "data", "sample.bam")
 sock = os.path.join(WORK, "route.sock")
 TRACES = os.path.join(WORK, "traces")
+PROFS = os.path.join(WORK, "profs")
 boot = ("import sys; sys.path.insert(0, %r); "
         "from consensuscruncher_tpu.cli import main; "
         "sys.exit(main(sys.argv[1:]))" % REPO)
 log = open(os.path.join(WORK, "router.log"), "wb")
 # CCT_TRACE_DIR makes every process (router + spawned workers inherit the
 # env) flush spans to per-pid shards, so the kill -9 victim's ack span
-# survives for the fleet trace-completeness check below
+# survives for the fleet trace-completeness check below; CCT_PROF adds
+# the always-on sampling profiler on every process — the golden-digest
+# asserts below double as the "profiling never touches output bytes"
+# parity check
 router = subprocess.Popen(
     [sys.executable, "-c", boot, "route", "--spawn", "2",
      "--workdir", WORK, "--socket", sock, "--backend", "xla_cpu",
      "--gang_size", "1", "--queue_bound", "8", "--drain_s", "60"],
     stdout=log, stderr=subprocess.STDOUT,
-    env=dict(os.environ, CCT_TRACE="1", CCT_TRACE_DIR=TRACES))
+    env=dict(os.environ, CCT_TRACE="1", CCT_TRACE_DIR=TRACES,
+             CCT_PROF="1", CCT_PROF_HZ="199", CCT_PROF_DIR=PROFS))
 ok = False
 try:
     client = ServeClient(sock, retries=60, retry_base_s=0.25)
@@ -296,10 +320,28 @@ try:
               "--out", merged])
     n_events = len(json.load(open(merged))["traceEvents"])
     assert n_events > 0, "fleet trace merge produced no events"
+    # same discipline for the profiler: merge live rings (prof wire op,
+    # fleet-wide) + the victim's flushed prof-*.ndjson shards, and the
+    # survivors' attribution must explain >=95% of their job wall
+    assert cum.get("prof_samples", 0) > 0, cum
+    flame = os.path.join(WORK, "prof.collapsed")
+    assert cct_main(["prof", "flame", "--socket", sock, "--dir", PROFS,
+                     "--out", flame]) in (0, None)
+    attr_json = os.path.join(WORK, "prof_attr.json")
+    assert cct_main(["prof", "report", "--socket", sock, "--dir", PROFS,
+                     "--json", attr_json]) in (0, None)
+    attr = json.load(open(attr_json))
+    assert attr["nodes"], "fleet prof merge attributed no nodes"
+    for node, nd in attr["nodes"].items():
+        if nd["coverage"] is not None:
+            assert nd["coverage"] >= 0.95, (node, nd)
+    n_stacks = sum(1 for ln in open(flame) if ln.strip())
     ok = True
     print("ci_check: fleet smoke OK (killed %s; %d jobs byte-identical; "
-          "resubmits=%d; %d trace events merged)"
-          % (victim, len(subs), cum["route_resubmits"], n_events))
+          "resubmits=%d; %d trace events merged; %d collapsed stacks, "
+          "%d node(s) wall-attributed)"
+          % (victim, len(subs), cum["route_resubmits"], n_events,
+             n_stacks, len(attr["nodes"])))
 finally:
     router.send_signal(signal.SIGTERM)
     try:

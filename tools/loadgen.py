@@ -47,6 +47,7 @@ import time
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
 
+from consensuscruncher_tpu.obs import prof as obs_prof  # noqa: E402
 from consensuscruncher_tpu.obs.registry import QOS_CLASSES  # noqa: E402
 from consensuscruncher_tpu.obs.slo import quantile_from_histogram  # noqa: E402
 from consensuscruncher_tpu.serve.client import (  # noqa: E402
@@ -265,6 +266,30 @@ def _recompiles_total(doc: dict) -> int | None:
     for ndoc in nodes.values():
         total += ((ndoc or {}).get("cumulative") or {}).get("recompiles", 0)
     return total
+
+
+def _pull_attribution(client: ServeClient) -> dict | None:
+    """Fold the fleet's CCT_PROF wall attribution into the artifact.
+
+    One ``prof`` op at the end of the run: against a router this fans
+    out to every up member (``fleet: true``); against a single daemon it
+    returns that process's profile.  Returns None when profiling is off
+    (no samples and no attributed jobs) or the op is unsupported — older
+    daemons and prof-less artifacts stay comparable."""
+    try:
+        reply = client.request({"op": "prof", "fleet": True}, timeout=30.0)
+    except Exception:
+        return None
+    if not reply.get("ok") or not reply.get("prof"):
+        return None
+    docs = reply["prof"]
+    if isinstance(docs, dict):
+        docs = [docs]
+    merged = obs_prof.merge_profiles(docs)
+    if not merged["samples"] and not any(
+            n.get("attr", {}).get("jobs") for n in merged["by_node"].values()):
+        return None
+    return obs_prof.attribution_doc(merged)
 
 
 def _node_breakdown(before: dict, after: dict) -> dict[str, dict] | None:
@@ -814,6 +839,7 @@ def main(argv=None) -> int:
             lv["recompiles_total"] = _recompiles_total(client.metrics())
             levels.append(lv)
         final = client.metrics()
+        attribution = _pull_attribution(client)
         ch = sum(lv["cache"]["hits"] for lv in levels)
         cm = sum(lv["cache"]["misses"] for lv in levels)
         cache_total = {
@@ -848,6 +874,8 @@ def main(argv=None) -> int:
             "queued_by_class": final.get("queued_by_class"),
             "autotune": final.get("autotune"),
         }
+        if attribution is not None:
+            doc["attribution"] = attribution
         if final.get("nodes") is not None:  # fleet run: router doc
             doc["fleet"] = final.get("fleet")
             doc["router_cumulative"] = final.get("cumulative")
