@@ -96,6 +96,15 @@ def _apply_obs_config(path: str | None) -> None:
             os.environ.setdefault(env, str(value))
 
 
+def _apply_qc_config(path: str | None) -> None:
+    """Fold the ``[qc]`` config section into the QC env flag:
+    ``enabled`` maps onto ``CCT_QC`` (setdefault — a real environment
+    variable wins, same precedence as the ``[obs]`` fold)."""
+    enabled = _config_defaults(path, "qc").get("enabled")
+    if enabled not in (None, ""):
+        os.environ.setdefault("CCT_QC", "1" if _bool(enabled) else "0")
+
+
 def _apply_io_config(path: str | None) -> None:
     """Fold the ``[io]`` config section into the BGZF codec knobs.
 
@@ -464,8 +473,49 @@ def _consensus_host_sharded(args) -> dict:
                         f"needs {n * chips_per_worker} chips but the host "
                         f"advertises {adv} ({var}); reduce workers or devices")
                 break
+    # Result-cache negative-entry planning (ISSUE 15 satellite): a range
+    # whose exact worker sub-spec is cached ``negative: true`` provably
+    # produces zero consensus families — materialize the committed empty
+    # outputs instead of decoding BAM bytes for it.  Positive entries are
+    # deliberately NOT taken here (workers have their own --resume path);
+    # the cache stays an optimization, never a correctness dependency.
+    skipped_neg: set[int] = set()
+    cache_root = str(getattr(args, "result_cache", "") or "")
+    if cache_root and os.path.isdir(cache_root):
+        from consensuscruncher_tpu.serve import result_cache as rc_mod
+        from consensuscruncher_tpu.utils.profiling import Counters
+
+        cum = Counters()
+        cache = rc_mod.ResultCache(cache_root)
+        for i, rng in enumerate(ranges):
+            spec = {"input": args.input, "name": f"r{i}",
+                    "cutoff": args.cutoff, "qualscore": args.qualscore,
+                    "scorrect": args.scorrect,
+                    "max_mismatch": args.max_mismatch, "bdelim": args.bdelim,
+                    "compress_level": args.compress_level,
+                    "input_range": hostshard.range_argv(rng)}
+            digest = rc_mod.content_digest(spec)
+            entry = cache.lookup(digest) if digest else None
+            if entry is None or not entry.get("negative"):
+                continue
+            try:
+                cache.materialize(entry, os.path.join(ranges_dir, f"r{i}"))
+            except OSError as e:
+                print(f"WARNING: cached-negative range r{i} failed to "
+                      f"materialize ({e}); running the worker instead",
+                      file=sys.stderr, flush=True)
+                continue
+            skipped_neg.add(i)
+            cum.add("qc_ranges_skipped")
+        if skipped_neg:
+            print(f"consensus: {len(skipped_neg)}/{n} ranges known-empty in "
+                  "the result cache; workers skipped "
+                  f"({sorted(skipped_neg)})", file=sys.stderr, flush=True)
+
     workers = []
     for i, rng in enumerate(ranges):
+        if i in skipped_neg:
+            continue
         argv = hostshard.worker_argv(
             args.input, ranges_dir, f"r{i}", args,
             range_spec=hostshard.range_argv(rng), resume=resume)
@@ -562,6 +612,27 @@ def _consensus_host_sharded(args) -> dict:
     hostshard.aggregate_histograms(rpaths("sscs/{n}.read_families.txt"), families_txt)
     tracker.mark("merge")
     tracker.write(os.path.join(dirs["sscs"], f"{name}.time_tracker.txt"))
+
+    # Merge the workers' per-range qc.json shards into the run-level doc
+    # (must happen before the .ranges tree is dropped below).  Spectrum and
+    # yield counts sum exactly across disjoint ranges; vote planes pad-add.
+    from consensuscruncher_tpu.obs import qc as obs_qc
+
+    if obs_qc.enabled():
+        try:
+            docs = [obs_qc.read_qc(p) for p in
+                    [os.path.join(ranges_dir, f"r{i}", "qc.json")
+                     for i in range(n)] if os.path.exists(p)]
+            if docs:
+                doc = obs_qc.merge_docs(docs)
+                doc["run"] = name
+                doc["pipeline"] = f"host_sharded[{n}]"
+                if skipped_neg:
+                    doc["ranges_skipped_negative"] = len(skipped_neg)
+                obs_qc.write_qc(os.path.join(base, "qc.json"), doc)
+        except Exception as e:
+            print(f"WARNING: qc.json not merged ({e}); run outputs "
+                  "unaffected", file=sys.stderr, flush=True)
 
     plot_family_size(families_txt,
                      os.path.join(dirs["plots"], f"{name}.family_size.png"))
@@ -680,6 +751,15 @@ def _consensus_impl(args) -> dict:
 
                 residency = packing.resident_planes()
 
+    # QC rider (ISSUE 15): the vote kernels fold per-position vote/
+    # disagreement planes into this accumulator as a pure reduction of
+    # operands they already upload; yields/spectrum come from the stats
+    # sidecars either way, so a --resume that skips SSCS still gets a
+    # qc.json (with ``plane: null``).
+    from consensuscruncher_tpu.obs import qc as obs_qc
+
+    qc_acc = obs_qc.QcAccumulator() if obs_qc.enabled() else None
+
     sscs_res = checkpointed(
         "sscs",
         [args.input],
@@ -699,6 +779,7 @@ def _consensus_impl(args) -> dict:
             input_range=input_range,
             prestaged=getattr(args, "_prestaged", None),
             residency=residency,
+            qc=qc_acc,
         ),
         rebuild=lambda: SscsResult.from_prefix(sscs_prefix),
     )
@@ -820,8 +901,26 @@ def _consensus_impl(args) -> dict:
                 os.unlink(path)
 
     _write_run_metrics(base, name, dirs, "staged", t0, io_before)
+    _write_run_qc(base, name, "staged", qc_acc)
     print(f"consensus: outputs under {base}")
     return {"all_sscs": all_sscs, "all_dcs": all_dcs, "dirs": dirs}
+
+
+def _write_run_qc(base, name, pipeline, acc) -> None:
+    """``<base>/qc.json``: the per-run consensus-quality document (ISSUE
+    15) — family-size spectrum + yields from the stage stats sidecars,
+    vote-plane summaries from the device accumulator when one ran.
+    Best-effort: QC must never fail a run that produced good outputs."""
+    from consensuscruncher_tpu.obs import qc as obs_qc
+
+    if not obs_qc.enabled():
+        return
+    try:
+        doc = obs_qc.collect_run(base, name, pipeline=pipeline, acc=acc)
+        obs_qc.write_qc(os.path.join(base, "qc.json"), doc)
+    except Exception as e:
+        print(f"WARNING: qc.json not written ({e}); run outputs unaffected",
+              file=sys.stderr, flush=True)
 
 
 def _write_run_metrics(base, name, dirs, pipeline, t0, io_before) -> None:
@@ -874,7 +973,9 @@ def _consensus_streaming(args, name, base, dirs, manifest, ilevel,
     """
     from consensuscruncher_tpu.core.streamgraph import BatchStream, StreamOut
     from consensuscruncher_tpu.io.bam import merge_memory_bams
+    from consensuscruncher_tpu.obs import qc as obs_qc
 
+    qc_acc = obs_qc.QcAccumulator() if obs_qc.enabled() else None
     taps = bool(getattr(args, "intermediate_taps", False))
     stream = StreamOut(taps=taps)
     sscs_prefix = os.path.join(dirs["sscs"], name)
@@ -903,6 +1004,7 @@ def _consensus_streaming(args, name, base, dirs, manifest, ilevel,
                 prestaged=getattr(args, "_prestaged", None),
                 residency=residency,
                 stream_out=stream,
+                qc=qc_acc,
             )
         sscs_mem = stream.memory["sscs"]
         singleton_mem = stream.memory["singleton"]
@@ -1007,6 +1109,7 @@ def _consensus_streaming(args, name, base, dirs, manifest, ilevel,
                 os.unlink(path)
 
     _write_run_metrics(base, name, dirs, "streaming", t0, io_before)
+    _write_run_qc(base, name, "streaming", qc_acc)
     print(f"consensus: outputs under {base} (streaming pipeline)")
     return {"all_sscs": all_sscs, "all_dcs": all_dcs, "dirs": dirs}
 
@@ -1591,6 +1694,76 @@ def top_cmd(args) -> None:
         once=_bool(getattr(args, "once", "False") or "False")))
 
 
+def _qc_docs_from_paths(paths) -> list:
+    """Resolve ``cct qc`` path operands into ``(label, doc)`` pairs.
+    A file operand is a qc.json; a directory is scanned recursively for
+    ``qc.json`` docs (a run tree, a fleet output root, a host-shard
+    ``.ranges`` tree)."""
+    import glob as _glob
+
+    from consensuscruncher_tpu.obs import qc as obs_qc
+
+    out = []
+    for p in paths:
+        if os.path.isdir(p):
+            found = sorted(_glob.glob(os.path.join(p, "**", "qc.json"),
+                                      recursive=True))
+            if not found:
+                print(f"WARNING: qc: no qc.json under {p}",
+                      file=sys.stderr, flush=True)
+            for f in found:
+                doc = obs_qc.read_qc(f)
+                label = (doc.get("run")
+                         or os.path.basename(os.path.dirname(f)) or f)
+                out.append((label, doc))
+        elif os.path.exists(p):
+            doc = obs_qc.read_qc(p)
+            out.append((doc.get("run") or p, doc))
+        else:
+            print(f"WARNING: qc: {p} does not exist; skipped",
+                  file=sys.stderr, flush=True)
+    return out
+
+
+def qc_cmd(args) -> None:
+    """``cct qc report``: per-run consensus-quality tables (+ a merged ALL
+    row and family-size spectrum) over one or many qc.json docs — run
+    trees, fleet shards, host-shard ranges.  ``cct qc diff``: rate deltas
+    and spectrum drift between two runs (each side may itself be a
+    directory of shards, merged first)."""
+    from consensuscruncher_tpu.obs import qc as obs_qc
+
+    if args.action == "report":
+        docs = _qc_docs_from_paths(args.paths)
+        if not docs:
+            raise SystemExit("qc report: no qc.json docs found")
+        print(obs_qc.render_report(docs))
+        if args.json:
+            merged = obs_qc.merge_docs([d for _l, d in docs])
+            obs_qc.write_qc(args.json, merged)
+        return
+    # diff: exactly two sides, each merged from whatever it resolves to
+    if len(args.paths) != 2:
+        raise SystemExit("qc diff: need exactly two paths (run dirs or "
+                         "qc.json files)")
+    sides = []
+    for p in args.paths:
+        docs = _qc_docs_from_paths([p])
+        if not docs:
+            raise SystemExit(f"qc diff: no qc.json docs under {p}")
+        sides.append(obs_qc.merge_docs([d for _l, d in docs]))
+    label_a = sides[0].get("run") or "A"
+    label_b = sides[1].get("run") or "B"
+    print(obs_qc.render_diff(sides[0], sides[1],
+                             label_a=label_a[:12], label_b=label_b[:12]))
+    if args.json:
+        obs_qc.write_qc(args.json, {
+            "a": sides[0], "b": sides[1],
+            "spectrum_tv": obs_qc.spectrum_distance(
+                sides[0].get("spectrum") or {},
+                sides[1].get("spectrum") or {})})
+
+
 def prof_cmd(args) -> None:
     """``prof report``: merge every live process's profile (router's
     ``prof`` wire op, fleet-wide) with any on-disk ``prof-*.ndjson``
@@ -1770,6 +1943,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "stage-to-stage BAMs (singleton, rescue outputs, "
                         "sscs.rescued) as debug taps, reproducing the full "
                         "staged output tree (default False)")
+    c.add_argument("--result_cache",
+                   help="content-addressed result cache root (the serve "
+                        "plane's --result_cache dir). With --host_workers, "
+                        "the range planner consults it before launching "
+                        "workers: a range whose exact sub-spec is cached "
+                        "negative (known-empty) is materialized from the "
+                        "cache instead of decoded (counted "
+                        "qc_ranges_skipped)")
     c.set_defaults(func=consensus, config_section="consensus",
                    required_args=("input", "output"),
                    builtin_defaults={
@@ -1779,6 +1960,7 @@ def build_parser() -> argparse.ArgumentParser:
                        "resume": "False", "compress_level": 6,
                        "host_workers": 1, "residency": "True",
                        "pipeline": "staged", "intermediate_taps": "False",
+                       "result_cache": "",
                    })
 
     s = sub.add_parser(
@@ -2049,6 +2231,22 @@ def build_parser() -> argparse.ArgumentParser:
                                       "socket": "", "host": "127.0.0.1",
                                       "port": 7733})
 
+    qp = sub.add_parser(
+        "qc", help="consensus-quality reports over per-run qc.json docs")
+    qp.add_argument("action", choices=("report", "diff"),
+                    help="report: per-run quality tables + merged spectrum "
+                         "over every doc found; diff: rate deltas and "
+                         "spectrum drift (total-variation) between two "
+                         "runs/shard sets")
+    qp.add_argument("paths", nargs="+",
+                    help="qc.json files or directories scanned recursively "
+                         "(run trees, fleet output roots)")
+    qp.add_argument("-c", "--config", default=None)
+    qp.add_argument("--json", help="also write the merged doc (report) / "
+                                   "the A-B comparison doc (diff) here")
+    qp.set_defaults(func=qc_cmd, config_section="qc", required_args=(),
+                    builtin_defaults={"json": ""})
+
     w = sub.add_parser(
         "top", help="live terminal observatory over a router or daemon")
     w.add_argument("-c", "--config", default=None)
@@ -2171,6 +2369,7 @@ def main(argv=None, _sscs_handoff=None) -> int:
 
     _apply_obs_config(args.config)
     _apply_io_config(args.config)
+    _apply_qc_config(args.config)
     from consensuscruncher_tpu.obs import prof as obs_prof
     from consensuscruncher_tpu.obs import trace as obs_trace
 

@@ -120,6 +120,18 @@ COUNTERS = {
                            "result cache without dispatching to a worker "
                            "(journaled like a terminal journal-answer so "
                            "keyed polls survive a router kill -9)",
+    "cache_shed_bypass": "submits the deadline/SLO shed path admitted "
+                         "anyway because their content_digest was already "
+                         "committed in the result cache (the answer is a "
+                         "materialize, never a rerun — shedding it would "
+                         "refuse free work)",
+    "qc_docs_committed": "per-run qc.json documents committed via "
+                         "manifest.commit_file (one per consensus run "
+                         "with QC accumulation enabled)",
+    "qc_ranges_skipped": "--input_range slices skipped at plan time "
+                         "because the result cache held a negative entry "
+                         "for the exact sub-spec (known-empty range, "
+                         "nothing to run)",
 }
 
 CUMULATIVE_KEYS = tuple(COUNTERS)
@@ -204,6 +216,33 @@ LABELED_COUNTERS = {
         "help": "failover resubmits landed on each member after another "
                 "member died",
     },
+    # consensus-quality (QC) series: folded in by the serve daemon from
+    # each finished job's qc.json, so per-tenant data-plane quality rides
+    # the same exposition as the system-plane series.  The full name set
+    # is mirrored in QC_SERIES below for the CCT605 lint (registered <=>
+    # emitted).
+    "tenant_qc_families": {
+        "labels": ("tenant", "qos"),
+        "help": "read families observed by finished jobs per tenant/class",
+    },
+    "tenant_qc_sscs_written": {
+        "labels": ("tenant", "qos"),
+        "help": "single-strand consensus reads emitted per tenant/class",
+    },
+    "tenant_qc_singletons": {
+        "labels": ("tenant", "qos"),
+        "help": "size-1 families routed to singleton handling per "
+                "tenant/class",
+    },
+    "tenant_qc_dcs_written": {
+        "labels": ("tenant", "qos"),
+        "help": "duplex consensus reads emitted per tenant/class",
+    },
+    "tenant_qc_rescued": {
+        "labels": ("tenant", "qos"),
+        "help": "singletons rescued by SSCS/singleton correction per "
+                "tenant/class",
+    },
 }
 
 # Labeled histograms: per-(tenant, qos) series sharing the global
@@ -221,7 +260,29 @@ LABELED_HISTOGRAMS = {
         "labels": ("tenant", "qos"),
         "help": "admission to dispatch wait per tenant and class",
     },
+    "tenant_qc_disagreement": {
+        "buckets": _RATIO_BUCKETS,
+        "unit": "ratio",
+        "labels": ("tenant", "qos"),
+        "help": "per-job mean vote-plane disagreement rate (votes that "
+                "differed from the modal base / total votes), observed "
+                "once per finished job carrying a qc doc",
+    },
 }
+
+# The closed set of per-tenant QC series above: the CCT605 obscov pass
+# checks registered <=> emitted over this tuple (a QC series declared
+# here but never inc'd/observed anywhere is dead telemetry; a qc-named
+# emission not listed here is an unregistered series).  Loaded standalone
+# by the lint, so keep it a pure literal.
+QC_SERIES = (
+    "tenant_qc_families",
+    "tenant_qc_sscs_written",
+    "tenant_qc_singletons",
+    "tenant_qc_dcs_written",
+    "tenant_qc_rescued",
+    "tenant_qc_disagreement",
+)
 
 # name -> {"buckets": upper bounds (le), "unit": ..., "help": ...}.
 # ``obs.metrics`` zero-fills all of these in ``histograms_snapshot`` so

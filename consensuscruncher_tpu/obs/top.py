@@ -232,6 +232,33 @@ def render_frame(series: dict, source: str,
             f"evicted={_fmt_n(_sum(series, 'cct_cache_evictions_total'))}  "
             f"bytes={_fmt_n(_sum(series, 'cct_cache_bytes_total'))}")
 
+    # qc panel: consensus-quality yield counters picked up from per-run
+    # qc.json docs at job completion.  Pre-QC daemons never emit these
+    # series, so each cell degrades to a dash — a dash means "daemon
+    # predates QC", a zero means "measured and empty".
+    def _opt(metric: str) -> float | None:
+        return _sum(series, metric) if metric in series else None
+
+    qc_cols = [
+        ("fam", "cct_tenant_qc_families_total"),
+        ("sscs", "cct_tenant_qc_sscs_written_total"),
+        ("single", "cct_tenant_qc_singletons_total"),
+        ("dcs", "cct_tenant_qc_dcs_written_total"),
+        ("rescued", "cct_tenant_qc_rescued_total"),
+        ("docs", "cct_qc_docs_committed_total"),
+        ("shed_bypass", "cct_cache_shed_bypass_total"),
+        ("skipped", "cct_qc_ranges_skipped_total"),
+    ]
+    if any(metric in series for _, metric in qc_cols):
+        dis_sum = _opt("cct_tenant_qc_disagreement_sum")
+        dis_count = _opt("cct_tenant_qc_disagreement_count")
+        disagree = (f"{100.0 * dis_sum / dis_count:.2f}%"
+                    if dis_sum is not None and dis_count else "-")
+        lines.append(
+            "qc: " + "  ".join(f"{label}={_fmt_n(_opt(metric))}"
+                               for label, metric in qc_cols)
+            + f"  disagree={disagree}")
+
     totals = [
         ("routed", "cct_jobs_routed_total"),
         ("cache_answers", "cct_route_cache_answers_total"),

@@ -282,6 +282,18 @@ def _compiled_fused(batch, fam_cap, length, num, den, qual_threshold,
     return jax.jit(fn)
 
 
+@lru_cache(maxsize=None)
+def _compiled_pallas_qc(qual_threshold):
+    """QC rider twin for the Pallas path.
+
+    The kernel keeps its vote counts in VMEM scratch, so the QC planes are
+    recomputed by a plain-XLA reduction over the same on-device operands
+    (compute only — no extra h2d pass)."""
+    from consensuscruncher_tpu.ops.consensus_tpu import qc_member_reduction
+
+    return jax.jit(partial(qc_member_reduction, qual_threshold=qual_threshold))
+
+
 def _prep_family_major(bases, quals, fam_sizes, pad, fam_cap, length):
     """Pad the batch axis and transpose to the kernel's family-major layout."""
     bases = np.asarray(bases, dtype=np.uint8)
@@ -309,7 +321,9 @@ def consensus_batch_pallas(
     interpreter elsewhere (CPU test meshes), keeping call sites portable.
     """
     from consensuscruncher_tpu.obs import metrics as obs_metrics
+    from consensuscruncher_tpu.obs import qc as obs_qc
 
+    qc_sink = obs_qc.plane_sink()
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     bases = np.asarray(bases, dtype=np.uint8)
@@ -334,7 +348,14 @@ def consensus_batch_pallas(
         ("pallas", batch + pad, fam_cap, length, num, den,
          int(config.qual_threshold), int(config.qual_cap)))
     obs_metrics.note_transfer("h2d", fb.nbytes + fq.nbytes + sizes.nbytes)
-    out_b, out_q = fn(sizes.reshape(-1, 1), fb, fq)
+    dfb, dfq, dsizes = jnp.asarray(fb), jnp.asarray(fq), jnp.asarray(sizes)
+    out_b, out_q = fn(dsizes.reshape(-1, 1), dfb, dfq)
+    if qc_sink is not None:
+        qc_fn = _compiled_pallas_qc(int(config.qual_threshold))
+        obs_metrics.note_compile(
+            ("pallas_qc", batch + pad, fam_cap, length,
+             int(config.qual_threshold)))
+        qc_sink.add_plane_handle(qc_fn(dfb, dfq, dsizes))
     if pad:
         out_b, out_q = out_b[:batch], out_q[:batch]
     return out_b, out_q

@@ -57,7 +57,7 @@ def derive_ids_device(sizes, total_members: int):
 
 
 def _gather_dense_vote(bases, quals, sizes, *, cap, num, den,
-                       qual_threshold, qual_cap):
+                       qual_threshold, qual_cap, with_qc=False):
     """(M, L) sorted member stream -> (NF, L) consensus via gather + reduce.
 
     Same semantics as :func:`_segment_vote`, different device program: the
@@ -81,13 +81,18 @@ def _gather_dense_vote(bases, quals, sizes, *, cap, num, den,
     # masks them out by fam_size, so the one dense-family kernel is the
     # single source of the modal/tie-break/cutoff/quality semantics here.
     vote = partial(_consensus_one_family, num=num, den=den,
-                   qual_threshold=qual_threshold, qual_cap=qual_cap)
+                   qual_threshold=qual_threshold, qual_cap=qual_cap,
+                   with_qc=with_qc)
     return jax.vmap(vote, in_axes=(0, 0, 0))(db, dq, sizes)
 
 
 def _segment_vote(bases, quals, fam_ids, ranks, sizes, *, num_families, num, den,
-                  qual_threshold, qual_cap):
-    """(M, L) member stream -> (NF, L) consensus via segment reductions."""
+                  qual_threshold, qual_cap, with_qc=False):
+    """(M, L) member stream -> (NF, L) consensus via segment reductions.
+
+    ``with_qc`` additionally returns per-family ``(NF, L)`` total-vote and
+    disagree-with-modal planes (obs.qc rider — pure reductions of the
+    segment counts already built; consensus outputs bit-identical)."""
     m, length = bases.shape
     bases = bases.astype(jnp.int32)  # widen before compares (cheap, VPU)
     quals = quals.astype(jnp.int32)
@@ -125,6 +130,11 @@ def _segment_vote(bases, quals, fam_ids, ranks, sizes, *, num_families, num, den
     passed = (modal != N) & (max_count * den >= num * fam) & (fam > 0)
     out_b = jnp.where(passed, modal, N).astype(jnp.uint8)
     out_q = jnp.where(passed, jnp.minimum(qsum, qual_cap), 0).astype(jnp.uint8)
+    if with_qc:
+        votes = counts[0]
+        for b in range(1, NUM_BASES):
+            votes = votes + counts[b]
+        return out_b, out_q, votes, votes - max_count
     return out_b, out_q
 
 
@@ -382,9 +392,19 @@ def run_duplex_pipelined(rows, qrows, sizes_a, sizes_b, codebook4,
 # family padding instead of 2 bytes at ~4x padding redundancy.
 
 def _stream_vote_fn(wire: str, num, den, qual_threshold, qual_cap,
-                    member_cap: int | None, out_len: int | None = None):
+                    member_cap: int | None, out_len: int | None = None,
+                    with_qc: bool = False):
     """Un-jitted wire-decode + vote program: (a, b, sizes) -> stacked
     (2, NF, L) consensus planes.
+
+    ``with_qc``: the program takes a fourth ``lengths`` operand (per-family
+    true consensus lengths, a few KB riding the same dispatch) and returns
+    ``(planes, qc)`` where ``qc`` is a ``(2, L)`` int32 stack of
+    batch-summed total-vote / disagree-with-modal vectors (the obs.qc
+    rider).  Dead wire cells past each family's true length are masked by
+    ``lengths`` so they never pollute the QC sums (their decoded content
+    is codebook-legal garbage by the MemberBatch contract).  The consensus
+    planes are bit-identical with or without the rider.
 
     ``(a, b)`` by wire mode — raw: (bases, quals) both (M, L); pack8:
     (packed (M, L), 16-entry codebook); pack4: (packed (M, L/2), 4-entry
@@ -395,7 +415,7 @@ def _stream_vote_fn(wire: str, num, den, qual_threshold, qual_cap,
     so sharding whole families needs no collective at all).
     """
 
-    def fn(a, b, sizes):
+    def fn(a, b, sizes, lengths=None):
         sizes = sizes.astype(jnp.int32)
         nf = sizes.shape[0]
         if wire == "raw":
@@ -412,9 +432,10 @@ def _stream_vote_fn(wire: str, num, den, qual_threshold, qual_cap,
         else:  # pack4 — length buckets are multiples of 32, so 2*packed width
             bases, quals = unpack4_device(a, b, 2 * a.shape[-1])
         if member_cap is not None:
-            out_b, out_q = _gather_dense_vote(
+            voted = _gather_dense_vote(
                 bases, quals, sizes, cap=member_cap, num=num, den=den,
                 qual_threshold=qual_threshold, qual_cap=qual_cap,
+                with_qc=with_qc,
             )
         else:
             m = bases.shape[0]
@@ -427,27 +448,39 @@ def _stream_vote_fn(wire: str, num, den, qual_threshold, qual_cap,
             total = sizes.sum()
             fam_ids = jnp.where(jnp.arange(m, dtype=jnp.int32) < total, fam_ids, nf)
             sizes_ov = jnp.concatenate([sizes, jnp.zeros(1, jnp.int32)])
-            out_b, out_q = _segment_vote(
+            voted = _segment_vote(
                 bases, quals, fam_ids, ranks, sizes_ov, num_families=nf + 1,
                 num=num, den=den, qual_threshold=qual_threshold, qual_cap=qual_cap,
+                with_qc=with_qc,
             )
-            out_b, out_q = out_b[:nf], out_q[:nf]
+            voted = tuple(x[:nf] for x in voted)
+        out_b, out_q = voted[0], voted[1]
         # One stacked output plane -> one d2h transfer per batch (tunnel
         # roundtrips, not bytes, are the remaining device-side cost).
         out = jnp.stack([out_b, out_q])
-        return out if out_len is None else out[:, :, :out_len]
+        out = out if out_len is None else out[:, :, :out_len]
+        if not with_qc:
+            return out
+        votes_f, disagree_f = voted[2], voted[3]
+        width = votes_f.shape[1]
+        live = (jnp.arange(width, dtype=jnp.int32)[None, :]
+                < lengths.astype(jnp.int32)[:, None])  # (NF, L)
+        qc = jnp.stack([jnp.where(live, votes_f, 0).sum(axis=0),
+                        jnp.where(live, disagree_f, 0).sum(axis=0)])
+        return out, (qc if out_len is None else qc[:, :out_len])
 
     return fn
 
 
 @lru_cache(maxsize=None)
 def _compiled_stream_vote(wire: str, num, den, qual_threshold, qual_cap,
-                          member_cap: int | None, out_len: int | None = None):
+                          member_cap: int | None, out_len: int | None = None,
+                          with_qc: bool = False):
     """Jitted single-device :func:`_stream_vote_fn`.  Shapes specialize
     inside jit's own cache; the lru key is only the semantics + wire +
-    gather capacity + d2h slice length."""
+    gather capacity + d2h slice length + QC-rider flag."""
     return jax.jit(_stream_vote_fn(wire, num, den, qual_threshold, qual_cap,
-                                   member_cap, out_len))
+                                   member_cap, out_len, with_qc))
 
 
 def encode_member_batch(batch):
@@ -549,12 +582,18 @@ def _run_member_batch_stream(batches, config: ConsensusConfig,
     per-device block order, not slot order, so its handles are not directly
     addressable by row.
     """
+    from consensuscruncher_tpu.obs import qc as obs_qc
     from consensuscruncher_tpu.parallel.prefetch import DEFAULT_DEPTH, pipelined, prefetch
 
     if prefetch_depth is None:
         prefetch_depth = DEFAULT_DEPTH
     num, den = config.cutoff_rational
     qt, qc = int(config.qual_threshold), int(config.qual_cap)
+    # QC rider: armed by the stage around its device loop (obs.qc plane
+    # sink); single-device only — the mesh path's rows come back in
+    # per-device block order, so its per-family masks don't line up here.
+    qc_sink = obs_qc.plane_sink() if mesh is None else None
+    with_qc = qc_sink is not None
 
     def encoded():
         for batch in batches:
@@ -568,7 +607,7 @@ def _run_member_batch_stream(batches, config: ConsensusConfig,
         out_len = int(batch.lengths.max(initial=0))
         out_len = -(-out_len // 8) * 8 or None
         obs_metrics.note_compile(
-            ("stream", wire, num, den, qt, qc, member_cap, out_len)
+            ("stream", wire, num, den, qt, qc, member_cap, out_len, with_qc)
             + np.shape(a))
         with obs_trace.span("device.dispatch", histogram="device_dispatch_s",
                             wire=wire, n_real=batch.n_real):
@@ -579,21 +618,33 @@ def _run_member_batch_stream(batches, config: ConsensusConfig,
                                            num, den, qt, qc, member_cap,
                                            out_len)
             fn = _compiled_stream_vote(wire, num, den, qt, qc, member_cap,
-                                       out_len)
+                                       out_len, with_qc)
+            lengths = (np.asarray(batch.lengths, dtype=np.int32)
+                       if with_qc else None)
             obs_metrics.note_transfer(
                 "h2d", np.asarray(a).nbytes + np.asarray(b).nbytes
-                + np.asarray(batch.sizes).nbytes)
+                + np.asarray(batch.sizes).nbytes
+                + (lengths.nbytes if lengths is not None else 0))
             # explicit h2d at the dispatch boundary (CCT_SANITIZE transfer
             # guard)
+            if with_qc:
+                return fn(jnp.asarray(a), jnp.asarray(b),
+                          jnp.asarray(batch.sizes), jnp.asarray(lengths))
             return fn(jnp.asarray(a), jnp.asarray(b), jnp.asarray(batch.sizes))
 
     capture = None
     if on_device_batch is not None and mesh is None:
         def capture(item, handle):
-            on_device_batch(item[0], handle)
+            # residency wants the stacked consensus plane, not the QC rider
+            on_device_batch(item[0], handle[0] if with_qc else handle)
 
     def fetch(item, handle):
         batch = item[0]
+        if with_qc:
+            handle, qc_handle = handle
+            qc_planes = np.asarray(qc_handle)
+            obs_metrics.note_transfer("d2h", qc_planes.nbytes)
+            qc_sink.add_plane(qc_planes[0], qc_planes[1])
         out = np.asarray(handle)
         obs_metrics.note_transfer("d2h", out.nbytes)
         if mesh is not None:

@@ -61,8 +61,16 @@ class ConsensusConfig:
         return cutoff_fraction(self.cutoff)
 
 
-def _consensus_one_family(bases, quals, fam_size, *, num, den, qual_threshold, qual_cap):
-    """Consensus of one padded family: (F, L) uint8 -> (L,) uint8 pair."""
+def _consensus_one_family(bases, quals, fam_size, *, num, den, qual_threshold,
+                          qual_cap, with_qc=False):
+    """Consensus of one padded family: (F, L) uint8 -> (L,) uint8 pair.
+
+    ``with_qc``: additionally return the QC rider — per-position total
+    votes and votes disagreeing with the modal base, both pure
+    reductions of the ``counts`` plane the vote already built (obs.qc;
+    zero extra operands, zero extra h2d).  The consensus outputs are
+    bit-identical either way.
+    """
     fam_cap, _length = bases.shape
     member = (jnp.arange(fam_cap, dtype=jnp.int32) < fam_size)[:, None]  # (F, 1)
 
@@ -98,6 +106,9 @@ def _consensus_one_family(bases, quals, fam_size, *, num, den, qual_threshold, q
 
     out_base = jnp.where(passed, modal, N).astype(jnp.uint8)
     out_qual = jnp.where(passed, jnp.minimum(qsum, qual_cap), 0).astype(jnp.uint8)
+    if with_qc:
+        votes = counts.sum(axis=1)  # (L,) valid member votes (PAD never a lane)
+        return out_base, out_qual, votes, votes - max_count
     return out_base, out_qual
 
 
@@ -122,13 +133,47 @@ def get_kernel_policy():
 
 
 @lru_cache(maxsize=None)
-def _compiled_batch_fn(num: int, den: int, qual_threshold: int, qual_cap: int):
+def _compiled_batch_fn(num: int, den: int, qual_threshold: int, qual_cap: int,
+                       with_qc: bool = False):
     """One jitted vmapped program per consensus config (shapes specialize
-    further inside jit's own cache, bounded by the bucketing policy)."""
+    further inside jit's own cache, bounded by the bucketing policy).
+
+    ``with_qc``: the program also returns the batch-summed ``(L,)`` QC
+    vote/disagree vectors (obs.qc rider) — consensus planes unchanged."""
     fn = partial(
-        _consensus_one_family, num=num, den=den, qual_threshold=qual_threshold, qual_cap=qual_cap
+        _consensus_one_family, num=num, den=den, qual_threshold=qual_threshold,
+        qual_cap=qual_cap, with_qc=with_qc
     )
-    return jax.jit(jax.vmap(fn, in_axes=(0, 0, 0)))
+    vm = jax.vmap(fn, in_axes=(0, 0, 0))
+    if not with_qc:
+        return jax.jit(vm)
+
+    def with_rider(bases, quals, fam_sizes):
+        out_b, out_q, votes, disagree = vm(bases, quals, fam_sizes)
+        return out_b, out_q, votes.sum(axis=0), disagree.sum(axis=0)
+
+    return jax.jit(with_rider)
+
+
+def qc_member_reduction(bases, quals, fam_sizes, *, qual_threshold):
+    """Standalone QC reduction over family-major ``(F, B, L)`` member
+    planes + ``(B,)`` sizes -> batch-summed ``(L,)`` (votes, disagree).
+
+    Same vote-validity semantics as :func:`_consensus_one_family` (PAD
+    never a lane; low-qual members vote N; member slots masked by family
+    size); used by the Pallas wrapper, whose kernel keeps its counts in
+    VMEM scratch and so cannot hand them back — the operands are already
+    on device, so this costs compute only, never an h2d pass."""
+    fam_cap = bases.shape[0]
+    member = (jnp.arange(fam_cap, dtype=jnp.int32)[:, None]
+              < fam_sizes[None, :])[:, :, None]  # (F, B, 1)
+    eff = jnp.where(quals >= qual_threshold, bases, jnp.uint8(N))
+    eff = jnp.where(member, eff, jnp.uint8(PAD))
+    lanes = jnp.arange(NUM_BASES, dtype=jnp.uint8)
+    counts = (eff[:, :, :, None] == lanes).sum(axis=0, dtype=jnp.int32)
+    votes = counts.sum(axis=-1)  # (B, L)
+    disagree = votes - counts.max(axis=-1)
+    return votes.sum(axis=0), disagree.sum(axis=0)
 
 
 def consensus_batch(
@@ -148,24 +193,37 @@ def consensus_batch(
     Returns ``(consensus_bases, consensus_quals)`` as ``(B, L)`` uint8 device
     arrays; dummy slots come back all-N/0.
     """
+    from consensuscruncher_tpu.obs import qc as obs_qc
+
     num, den = config.cutoff_rational
     b = np.asarray(bases)
     if _kernel_policy is not None and _kernel_policy(b.shape) == "pallas":
         from consensuscruncher_tpu.ops.consensus_pallas import consensus_batch_pallas
 
         return consensus_batch_pallas(b, quals, fam_sizes, config)
-    fn = _compiled_batch_fn(num, den, int(config.qual_threshold), int(config.qual_cap))
+    sink = obs_qc.plane_sink()
+    with_qc = sink is not None
+    fn = _compiled_batch_fn(num, den, int(config.qual_threshold),
+                            int(config.qual_cap), with_qc)
     # XLA's jit cache keys on (static config, padded shape): first sighting
     # of this signature in the process is a compile
     obs_metrics.note_compile(
-        (num, den, int(config.qual_threshold), int(config.qual_cap)) + b.shape)
+        (num, den, int(config.qual_threshold), int(config.qual_cap), with_qc)
+        + b.shape)
     obs_metrics.note_transfer(
         "h2d", b.nbytes + np.asarray(quals).nbytes + np.asarray(fam_sizes, dtype=np.int32).nbytes)
-    return fn(
+    out = fn(
         jnp.asarray(b, dtype=jnp.uint8),
         jnp.asarray(quals, dtype=jnp.uint8),
         jnp.asarray(fam_sizes, dtype=jnp.int32),
     )
+    if with_qc:
+        out_b, out_q, votes, disagree = out
+        # Deferred handle: the (L,) rider drains at stage finalize, so the
+        # async dispatch pipeline never blocks on QC.
+        sink.add_plane_handle((votes, disagree))
+        return out_b, out_q
+    return out
 
 
 def consensus_batch_host(bases, quals, fam_sizes, config: ConsensusConfig = ConsensusConfig()):

@@ -1007,6 +1007,16 @@ class Router:
                         stolen = False
                         continue
                     if e.reply.get("refused"):
+                        if e.reply.get("shed"):
+                            # Digest-keyed shed bypass, router half: a
+                            # member shed this key under load, but the
+                            # answer journal may hold a committed answer
+                            # (landed after the pre-forward check) — a
+                            # cached submit is answered, never shed.
+                            cached = self._cache_answer(key, spec, trace)
+                            if cached is not None:
+                                self.counters.add("cache_shed_bypass")
+                                return cached
                         return dict(e.reply)
                     return {"ok": False, "error": str(e)}
                 with self._lock:

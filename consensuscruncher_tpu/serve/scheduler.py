@@ -176,6 +176,11 @@ class Job:
         # (materialized bytes, no pipeline run) — surfaced in describe()
         # so clients can split hit/miss latency
         self.cached = False
+        # compact consensus-quality summary from the run's qc.json (yields
+        # + rates + disagree_rate, never the full plane vectors) — rides
+        # describe() and the journal's done record (replay tolerates
+        # absence: pre-QC journals simply leave it None)
+        self.qc: dict | None = None
         self.submitted_t = time.monotonic()
         self.finished_t: float | None = None
 
@@ -187,6 +192,7 @@ class Job:
             "input": self.spec.get("input"), "key": self.key,
             "deadline_s": self.deadline_s, "trace_id": self.trace_id,
             "tenant": self.tenant, "qos": self.qos, "cached": self.cached,
+            "qc": self.qc,
         }
 
 
@@ -578,7 +584,7 @@ class Scheduler:
             if self._draining:
                 raise AdmissionRefused("server is draining; not accepting jobs")
             self._quota_check_locked(tenant, qos)
-            self._shed_check_locked(deadline_s, tenant, qos)
+            self._shed_check_locked(deadline_s, tenant, qos, spec=spec)
             self._evict_locked(time.monotonic())
             queued = self._queued_locked()
             if queued >= self.queue_bound:
@@ -665,15 +671,26 @@ class Scheduler:
                     f"({inflight}/{self.tenant_inflight_cap})")
 
     def _shed_check_locked(self, deadline_s: float | None,
-                           tenant: str, qos: str) -> None:
+                           tenant: str, qos: str,
+                           spec: dict | None = None) -> None:
         """Deadline-aware admission: refuse work that cannot finish in time
         at the observed service rate (EWMA of per-job wall).  A job with no
         explicit deadline inherits its qos class SLO target (when one is
         configured).  The ``serve.shed`` fault site forces a shed for
-        chaos tests."""
+        chaos tests.
+
+        Digest-keyed bypass (ROADMAP item 5 follow-through): a submit
+        whose ``content_digest`` is already committed in the result cache
+        costs a file copy, not a pipeline run — the EWMA that justified
+        the shed does not apply, so it is admitted instead of refused
+        (counted ``cache_shed_bypass``).  The cache probe happens only
+        when a shed WOULD fire, so the unloaded admission path never pays
+        a lookup."""
         try:
             faults.fault_point("serve.shed")
         except faults.FaultError as e:
+            if self._cache_shed_bypass_locked(spec, tenant, qos):
+                return
             self._count_shed_locked(tenant, qos)
             self._flight_shed(f"injected: {e}", tenant, qos)
             raise DeadlineShed(f"shed: {e}")
@@ -684,6 +701,8 @@ class Scheduler:
         backlog = self._queued_locked() + len(self._running)
         eta = (backlog + 1) * self._ewma_job_s / max(1, self.gang_size)
         if eta > effective:
+            if self._cache_shed_bypass_locked(spec, tenant, qos):
+                return
             self._count_shed_locked(tenant, qos)
             self._flight_shed(f"eta {eta:.1f}s > deadline_s={effective:g} "
                               f"(backlog={backlog})", tenant, qos)
@@ -691,6 +710,26 @@ class Scheduler:
                 f"shed: estimated completion {eta:.1f}s exceeds "
                 f"deadline_s={effective:g} (backlog={backlog}, "
                 f"ewma_job_s={self._ewma_job_s:.2f})")
+
+    def _cache_shed_bypass_locked(self, spec: dict | None,
+                                  tenant: str, qos: str) -> bool:
+        """True when ``spec``'s content digest has a committed result-cache
+        entry — the admission bypass for would-be sheds.  Returns False
+        fast with no cache configured (existing shed behavior is
+        untouched); any probe failure also answers False (the cache is an
+        optimization, never an admission authority)."""
+        if self.result_cache is None or not spec:
+            return False
+        from consensuscruncher_tpu.serve import result_cache as rc_mod
+        try:
+            digest = rc_mod.content_digest(spec)
+            if digest is None or self.result_cache.lookup(digest) is None:
+                return False
+        except Exception:
+            return False
+        self.counters.add("cache_shed_bypass")
+        obs_trace.event("serve.cache_shed_bypass", tenant=tenant, qos=qos)
+        return True
 
     def _count_shed_locked(self, tenant: str, qos: str) -> None:
         self.counters.add("jobs_shed")
@@ -903,6 +942,8 @@ class Scheduler:
                     job.outputs = rec.get("outputs")
                     job.error = rec.get("error")
                     job.wall_s = rec.get("wall_s")
+                    qc = rec.get("qc")
+                    job.qc = qc if isinstance(qc, dict) else None
                     job.finished_t = time.monotonic()
                     finished += 1
                 else:
@@ -1232,6 +1273,11 @@ class Scheduler:
             if outcome == "done" and job.id not in hits:
                 self.aggregate_job_metrics(job)
                 self._cache_insert(job)
+            if outcome == "done":
+                # cache hits carry a qc.json too (it is part of the
+                # materialized payload) — quality attribution must not
+                # have a hit-shaped hole
+                self.aggregate_job_qc(job)
             with self._cond:
                 # gang jobs count from dispatch start: the shared SSCS wall
                 # belongs to every member's end-to-end latency
@@ -1254,7 +1300,7 @@ class Scheduler:
                     else 0.8 * self._ewma_job_s + 0.2 * job.wall_s
                 self._journal_update_locked(
                     job, outcome, outputs=job.outputs, error=job.error,
-                    wall_s=job.wall_s)
+                    wall_s=job.wall_s, qc=job.qc)
                 self._evict_locked(time.monotonic())
                 self._cond.notify_all()
 
@@ -1428,3 +1474,54 @@ class Scheduler:
             return
         for key in ("families_in", "families_out", "batches_dispatched"):
             self.counters.add(key, int(cum.get(key, 0)))
+
+    #: qc.json yield key -> per-tenant labeled series (registry QC_SERIES;
+    #: cctlint CCT605 checks registration <-> emission both ways)
+    _QC_YIELD_SERIES = (
+        ("families", "tenant_qc_families"),
+        ("sscs_written", "tenant_qc_sscs_written"),
+        ("singletons", "tenant_qc_singletons"),
+        ("dcs_written", "tenant_qc_dcs_written"),
+    )
+
+    def aggregate_job_qc(self, job: Job) -> None:
+        """Fold a finished job's ``qc.json`` into the daemon's per-tenant
+        quality series, attach a compact summary to the job (describe() +
+        journal done record), and mark the ``serve.job`` trace.  Best-
+        effort: a pre-QC or CCT_QC=0 run simply has no doc."""
+        if not job.outputs:
+            return
+        doc_path = os.path.join(job.outputs.get("base") or "", "qc.json")
+        try:
+            with open(doc_path) as fh:
+                doc = json.load(fh)
+        except (OSError, ValueError):
+            return
+        if not isinstance(doc, dict):
+            return
+        yields = doc.get("yields") or {}
+        rates = doc.get("rates") or {}
+        plane = doc.get("plane") or {}
+        rescued = (int(yields.get("rescued_by_sscs", 0))
+                   + int(yields.get("rescued_by_singleton", 0)))
+        for key, series in self._QC_YIELD_SERIES:
+            obs_metrics.inc(series, int(yields.get(key, 0)),
+                            tenant=job.tenant, qos=job.qos)
+        obs_metrics.inc("tenant_qc_rescued", rescued,
+                        tenant=job.tenant, qos=job.qos)
+        disagree = plane.get("disagree_rate")
+        if disagree is not None:
+            obs_metrics.observe_labeled("tenant_qc_disagreement",
+                                        float(disagree),
+                                        tenant=job.tenant, qos=job.qos)
+        job.qc = {"yields": {k: int(v) for k, v in yields.items()},
+                  "rates": rates,
+                  "disagree_rate": disagree,
+                  "spectrum": doc.get("spectrum") or {}}
+        self.counters.add("qc_docs_committed")
+        obs_trace.event("serve.qc", trace_id=job.trace_id, job_id=job.id,
+                        tenant=job.tenant, qos=job.qos,
+                        families=int(yields.get("families", 0)),
+                        sscs_written=int(yields.get("sscs_written", 0)),
+                        dcs_written=int(yields.get("dcs_written", 0)),
+                        disagree_rate=disagree)

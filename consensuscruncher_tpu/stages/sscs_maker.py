@@ -199,6 +199,7 @@ def run_sscs(
     prestaged: "PrestagedBlocks | None" = None,
     residency=None,
     stream_out=None,
+    qc=None,
 ) -> SscsResult:
     """``devices``: shard each family batch across this many chips
     (``parallel.mesh`` family-data-parallel path); None/1 = single device.
@@ -229,7 +230,15 @@ def run_sscs(
     BAM still materializes (final output, via the write-behind pool) but
     the singleton BAM becomes a debug tap, written only when the stream
     asked for taps.  ``in_bam`` may then also be an in-memory batch
-    source instead of a path."""
+    source instead of a path.
+
+    ``qc``: an ``obs.qc.QcAccumulator``; when given, the tpu vote kernels
+    accumulate per-position vote/disagreement planes into it as a rider on
+    the operands they already upload (zero extra h2d passes, bit-identical
+    consensus outputs).  The sink is armed only around this stage's device
+    loop so concurrent gang jobs never mix batches into a foreign
+    accumulator.  Ignored on cpu/reference backends and mesh runs (the
+    per-run yields/spectrum still come from the stats sidecars)."""
     if backend not in ("cpu", "tpu", "reference"):
         raise ValueError(
             f"unknown backend {backend!r} (expected 'cpu', 'tpu', or 'reference')"
@@ -441,7 +450,12 @@ def run_sscs(
         emit_consensus(rec_writer, sscs_writer, tag, members, codes, quals)
         stats.incr("sscs_written")
 
+    from consensuscruncher_tpu.obs import qc as obs_qc
+
     ok = False
+    qc_armed = qc is not None and backend == "tpu"
+    if qc_armed:
+        obs_qc.set_plane_sink(qc)
     try:
         if backend == "tpu":
             if use_blocks:
@@ -536,6 +550,8 @@ def run_sscs(
         single_surgery.flush()
         ok = True
     finally:
+        if qc_armed:
+            obs_qc.set_plane_sink(None)
         if prestaged is not None:
             # join the prestage producer BEFORE closing the reader it decodes
             prestaged.close()

@@ -1,0 +1,17 @@
+"""Conformant twin of viol_qc_series.py: same emission shape, but every
+QC series named here is a registry QC_SERIES member — so the CCT605 rule
+demonstrably keys on the declaration tuple, not on the call shape."""
+
+from consensuscruncher_tpu.obs import metrics as obs_metrics
+
+_YIELD_TABLE = (
+    ("families", "tenant_qc_families"),
+    ("sscs_written", "tenant_qc_sscs_written"),
+)
+
+
+def record_job_quality(job):
+    obs_metrics.inc("tenant_qc_rescued", 1, tenant=job.tenant, qos=job.qos)
+    for key, series in _YIELD_TABLE:
+        obs_metrics.inc(series, int(job.yields.get(key, 0)),
+                        tenant=job.tenant, qos=job.qos)

@@ -1,0 +1,23 @@
+"""Seeded CCT605 violation: a QC-named series emitted without a
+QC_SERIES declaration.
+
+``tenant_qc_bogus`` looks exactly like a QC series — it would flow into
+the per-tenant exposition — but the registry's QC_SERIES tuple does not
+name it, so ``cct qc`` reports and the ``cct top`` QC panel would never
+show it: emitted yet invisible.  The lint must flag both the direct
+call-site literal and the name-table form (the house idiom emits QC
+series from tables like scheduler's ``_QC_YIELD_SERIES``).
+"""
+
+from consensuscruncher_tpu.obs import metrics as obs_metrics
+
+_BOGUS_TABLE = (
+    ("families", "tenant_qc_bogus_table"),
+)
+
+
+def record_job_quality(job):
+    obs_metrics.inc("tenant_qc_bogus", 1, tenant=job.tenant, qos=job.qos)
+    for key, series in _BOGUS_TABLE:
+        obs_metrics.inc(series, int(job.yields.get(key, 0)),
+                        tenant=job.tenant, qos=job.qos)

@@ -41,6 +41,7 @@ def test_repo_is_lint_clean():
     ("serve/viol_locks.py", {"CCT401", "CCT402"}),
     ("serve/viol_jit.py", {"CCT501"}),
     ("viol_obscov.py", {"CCT601", "CCT602", "CCT603"}),
+    ("viol_qc_series.py", {"CCT605"}),
     ("serve/viol_trace_prop.py", {"CCT604"}),
     ("serve/viol_protocol.py",
      {"CCT701", "CCT702", "CCT703", "CCT704", "CCT705"}),
@@ -59,6 +60,7 @@ def test_each_pass_detects_its_seeded_violation(rel, expected):
     "serve/clean_shared_state.py",
     "serve/clean_trace_prop.py",
     "serve/clean_cache_store.py",
+    "clean_qc_series.py",
 ])
 def test_protocol_twin_fixtures_are_clean(rel):
     """The conformant twins prove the CCT7/CCT8 rules key on the actual
@@ -118,6 +120,36 @@ def test_faultcov_overrides_for_registry_and_chaos(tmp_path):
     codes = _codes(findings)
     assert codes == {"CCT301", "CCT302"}, findings
     # area.known is used + registered + chaos-mentioned -> clean of CCT303
+
+
+def test_qc_series_registered_must_be_emitted(tmp_path):
+    """CCT605's registered=>emitted half engages only when the scan
+    covers the QC emission home (serve/scheduler.py): a declared series
+    nobody emits is a dead panel column."""
+    home = tmp_path / "serve"
+    home.mkdir()
+    sched = home / "scheduler.py"
+    sched.write_text(
+        "def pick(job):\n"
+        "    return ('tenant_qc_families', job)\n")
+    findings = run_paths(
+        [str(sched)], root=str(tmp_path), passes=["obscov"],
+        overrides={"metric_registry": {
+            "counters": [], "histograms": [],
+            "qc_series": ["tenant_qc_families", "tenant_qc_rescued"]}})
+    assert any(f.code == "CCT605" and "tenant_qc_rescued" in f.message
+               for f in findings), findings
+    assert not any("tenant_qc_families" in f.message for f in findings), (
+        "the emitted member must not be flagged")
+    # a scan WITHOUT the emission home proves nothing about absence
+    other = tmp_path / "other.py"
+    other.write_text("X = 1\n")
+    findings = run_paths(
+        [str(other)], root=str(tmp_path), passes=["obscov"],
+        overrides={"metric_registry": {
+            "counters": [], "histograms": [],
+            "qc_series": ["tenant_qc_rescued"]}})
+    assert findings == [], findings
 
 
 def test_cli_json_select_ignore_and_exit_codes():

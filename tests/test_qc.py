@@ -1,0 +1,376 @@
+"""Consensus-quality observatory (tier-1): accumulator, doc assembly,
+rendering, the drift gate's verdict logic, the scheduler's QC fold +
+digest-keyed shed bypass, and the ``cct top`` QC panel's tolerance of
+pre-QC daemons.
+
+Everything here is unit-level and device-free on purpose: the e2e
+byte-identity and overhead claims are covered by the accuracy harness
+leg in tools/ci_check.sh; this file pins the contracts each layer
+exposes to the next one.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from consensuscruncher_tpu.obs import metrics as obs_metrics  # noqa: E402
+from consensuscruncher_tpu.obs import qc as obs_qc  # noqa: E402
+from consensuscruncher_tpu.obs import top as obs_top  # noqa: E402
+from consensuscruncher_tpu.serve.result_cache import (  # noqa: E402
+    ResultCache, content_digest,
+)
+from consensuscruncher_tpu.serve.scheduler import (  # noqa: E402
+    DeadlineShed, Job, Scheduler,
+)
+from tools import qc_gate  # noqa: E402
+
+DATA = os.path.join(REPO, "test", "data")
+SAMPLE = os.path.join(DATA, "sample.bam")
+
+
+@pytest.fixture(autouse=True)
+def _clean_metrics():
+    obs_metrics.reset_for_tests()
+    yield
+    obs_metrics.reset_for_tests()
+    obs_qc.set_plane_sink(None)
+
+
+# --------------------------------------------------------- accumulator
+
+def test_accumulator_pads_and_sums_planes():
+    acc = obs_qc.QcAccumulator(run="r")
+    acc.add_plane([3, 3, 3], [1, 0, 2])
+    acc.add_plane([2, 2, 2, 2, 2], [0, 1, 0, 0, 1])  # longer L grows
+    doc = acc.plane_doc()
+    assert doc["positions"] == 5
+    assert doc["votes"] == [5, 5, 5, 2, 2]
+    assert doc["disagree"] == [1, 1, 2, 0, 1]
+    assert doc["total_votes"] == 19 and doc["total_disagree"] == 5
+    assert doc["disagree_rate"] == pytest.approx(5 / 19)
+
+
+def test_accumulator_defers_handles_until_finalize():
+    acc = obs_qc.QcAccumulator()
+    acc.add_plane_handle((np.array([4, 4], np.int32),
+                          np.array([1, 0], np.int32)))
+    assert acc.has_planes  # pending handle counts as data...
+    assert not acc._votes.any()  # ...but nothing drained yet
+    before = obs_metrics.transfer_bytes()["d2h"]
+    doc = acc.plane_doc()  # finalize() drains
+    assert doc["votes"] == [4, 4] and doc["disagree"] == [1, 0]
+    # the deferred fetch is accounted as a (tiny) measured d2h transfer
+    assert obs_metrics.transfer_bytes()["d2h"] > before
+
+
+def test_empty_accumulator_has_no_plane_doc():
+    assert obs_qc.QcAccumulator().plane_doc() is None
+
+
+def test_plane_sink_install_and_clear():
+    acc = obs_qc.QcAccumulator()
+    obs_qc.set_plane_sink(acc)
+    assert obs_qc.plane_sink() is acc
+    obs_qc.set_plane_sink(None)
+    assert obs_qc.plane_sink() is None
+
+
+# ------------------------------------------------------- doc assembly
+
+def _fake_run(base, name="s", spectrum=((1, 5), (3, 2)), sscs=None,
+              corr=None, dcs=None):
+    """A run tree holding only the sidecars collect_run reads."""
+    for sub in ("sscs", "singleton", "dcs"):
+        os.makedirs(os.path.join(str(base), sub), exist_ok=True)
+    with open(os.path.join(str(base), "sscs",
+                           f"{name}.read_families.txt"), "w") as fh:
+        fh.write("family_size\tcount\n")
+        for size, count in spectrum:
+            fh.write(f"{size}\t{count}\n")
+    defaults = {
+        "sscs": sscs if sscs is not None else
+        {"total_reads": 20, "families": 7, "singletons": 5,
+         "sscs_written": 2, "bad_reads": 0},
+        "singleton": corr if corr is not None else
+        {"rescued_by_sscs": 2, "rescued_by_singleton": 1,
+         "remaining": 2, "singletons_total": 5},
+        "dcs": dcs if dcs is not None else
+        {"pairs": 1, "sscs_total": 2, "sscs_unpaired": 0,
+         "dcs_written": 1},
+    }
+    suffix = {"sscs": "sscs_stats", "singleton": "singleton_stats",
+              "dcs": "dcs_stats"}
+    for sub, doc in defaults.items():
+        if doc:
+            with open(os.path.join(str(base), sub,
+                                   f"{name}.{suffix[sub]}.json"),
+                      "w") as fh:
+                json.dump(doc, fh)
+
+
+def test_collect_run_assembles_sidecars_and_rates(tmp_path):
+    _fake_run(tmp_path)
+    acc = obs_qc.QcAccumulator()
+    acc.add_plane([10, 10], [1, 0])
+    doc = obs_qc.collect_run(str(tmp_path), "s", pipeline="staged", acc=acc)
+    assert doc["version"] == obs_qc.QC_VERSION
+    assert doc["sources"] == ["sscs", "singleton_correction", "dcs"]
+    assert doc["spectrum"] == {"1": 5, "3": 2}
+    assert doc["yields"]["families"] == 7
+    r = doc["rates"]
+    assert r["sscs_yield"] == pytest.approx(2 / 7)
+    assert r["rescue_rate"] == pytest.approx(3 / 5)
+    assert r["dropout_rate"] == pytest.approx(2 / 5)
+    assert r["duplex_rate"] == pytest.approx(1.0)
+    assert doc["plane"]["disagree_rate"] == pytest.approx(1 / 20)
+
+
+def test_collect_run_tolerates_missing_sidecars(tmp_path):
+    # a bare directory (pre-QC artifact, stage skipped) -> honest doc
+    doc = obs_qc.collect_run(str(tmp_path), "ghost")
+    assert doc["sources"] == [] and doc["spectrum"] == {}
+    assert doc["yields"] == {}
+    # every rate None, never a ZeroDivisionError or fake zero
+    assert all(v is None for v in doc["rates"].values())
+    assert doc["plane"] is None
+
+
+def test_merge_docs_sums_and_recomputes(tmp_path):
+    a = tmp_path / "a"
+    b = tmp_path / "b"
+    _fake_run(a, name="a")
+    _fake_run(b, name="b", spectrum=((1, 5), (2, 4)))
+    acc = obs_qc.QcAccumulator()
+    acc.add_plane([8], [2])
+    da = obs_qc.collect_run(str(a), "a", acc=acc)
+    db = obs_qc.collect_run(str(b), "b")  # no plane on this shard
+    merged = obs_qc.merge_docs([da, db, {}])  # empty shard tolerated
+    assert merged["run"] == "a+b" and merged["merged_from"] == 2
+    assert merged["spectrum"] == {"1": 10, "2": 4, "3": 2}
+    assert merged["yields"]["families"] == 14
+    assert merged["rates"]["sscs_yield"] == pytest.approx(4 / 14)
+    assert merged["plane"]["disagree_rate"] == pytest.approx(2 / 8)
+
+
+def test_write_qc_round_trips_atomically(tmp_path):
+    doc = obs_qc.collect_run(str(tmp_path), "x")
+    path = str(tmp_path / "qc.json")
+    obs_qc.write_qc(path, doc)
+    assert obs_qc.read_qc(path) == doc
+    # no tmp litter next to the committed doc
+    assert [f for f in os.listdir(str(tmp_path))
+            if f.startswith(".qc.")] == []
+
+
+# ----------------------------------------------------------- rendering
+
+def test_spectrum_distance_bounds():
+    assert obs_qc.spectrum_distance({"1": 5}, {"1": 50}) == 0.0
+    assert obs_qc.spectrum_distance({"1": 5}, {"2": 5}) == 1.0
+    assert obs_qc.spectrum_distance({}, {}) == 0.0
+    assert obs_qc.spectrum_distance({}, {"1": 1}) == 1.0
+    mid = obs_qc.spectrum_distance({"1": 1, "2": 1}, {"1": 1})
+    assert mid == pytest.approx(0.5)
+
+
+def test_render_report_and_diff(tmp_path):
+    _fake_run(tmp_path)
+    doc = obs_qc.collect_run(str(tmp_path), "s")
+    out = obs_qc.render_report([("s", doc), ("s2", doc)])
+    assert "ALL" in out and "family-size spectrum" in out
+    single = obs_qc.render_report([("s", doc)])
+    assert "ALL" not in single  # no merged row for one doc
+    diff = obs_qc.render_diff(doc, doc, "x", "y")
+    assert "+0.00pp" in diff and "spectrum_tv" in diff
+    assert "0.0000" in diff
+    # plane absent on both sides: disagree delta degrades to a dash
+    assert [ln for ln in diff.splitlines()
+            if ln.startswith("disagree_rate")][0].rstrip().endswith("-")
+
+
+# ------------------------------------------------------------ qc_gate
+
+def _artifact(err_sscs=0.0, err_dcs=0.0, recall=0.95, fp_mb=0.0,
+              sscs_written=100, sscs_yield=0.8):
+    return {
+        "version": 1, "kind": "qc_accuracy",
+        "qc": {
+            "spectrum": {"1": 50, "2": 30, "3": 20},
+            "yields": {"families": 120, "sscs_written": sscs_written},
+            "rates": {"sscs_yield": sscs_yield, "singleton_rate": 0.1,
+                      "rescue_rate": 0.5, "dropout_rate": 0.1,
+                      "duplex_rate": 0.9, "dcs_yield": 0.8},
+            "plane": {"disagree_rate": 0.004},
+        },
+        "accuracy": {"policies": {"default": {
+            "per_base_error": {"raw": 0.005, "sscs": err_sscs,
+                               "dcs": err_dcs},
+            "variants": {
+                "sscs": {"recall": recall, "fp_per_mb": fp_mb},
+                "dcs": {"recall": recall, "fp_per_mb": fp_mb},
+            },
+        }}},
+    }
+
+
+def _gate(fresh, base, **tol):
+    kw = dict(spectrum_tol=0.10, rate_tol=0.05, err_tol=0.5,
+              err_floor=2e-4, recall_tol=0.05, fp_tol_mb=200.0)
+    kw.update(tol)
+    return qc_gate.gate(fresh, base, **kw)
+
+
+def test_qc_gate_honest_rerun_passes():
+    checks = _gate(_artifact(), _artifact())
+    assert checks and all(c["ok"] for c in checks)
+
+
+def test_qc_gate_catches_error_inversion_structurally():
+    # consensus WORSE than raw trips the always-strict structural check
+    checks = _gate(_artifact(err_sscs=0.02), _artifact())
+    bad = [c["name"] for c in checks if not c["ok"]]
+    assert "default:error_ordering:sscs" in bad
+
+
+def test_qc_gate_catches_recall_and_rate_drift():
+    checks = _gate(_artifact(recall=0.5), _artifact())
+    bad = [c["name"] for c in checks if not c["ok"]]
+    assert "default:variant_recall:sscs" in bad
+    checks = _gate(_artifact(sscs_yield=0.5), _artifact())
+    assert any(not c["ok"] and c["name"] == "rate:sscs_yield"
+               for c in checks)
+
+
+def test_qc_gate_structural_refuses_empty_sscs():
+    checks = _gate(_artifact(sscs_written=0), _artifact())
+    assert any(not c["ok"] and c["name"] == "sscs_written"
+               for c in checks)
+
+
+def test_qc_gate_find_baseline_prefers_newest(tmp_path):
+    for n in (3, 13, 7):
+        (tmp_path / f"BENCH_QC_r{n}.json").write_text("{}")
+    got = qc_gate.find_baseline(str(tmp_path))
+    assert os.path.basename(got) == "BENCH_QC_r13.json"
+    assert qc_gate.find_baseline(str(tmp_path / "empty")) is None
+
+
+# ---------------------------------------------- scheduler: fold + shed
+
+def _spec(output, name="golden", **over):
+    spec = {"input": SAMPLE, "output": str(output), "name": name,
+            "cutoff": 0.7, "qualscore": 0, "scorrect": True,
+            "max_mismatch": 0, "bdelim": "|", "compress_level": 6}
+    spec.update(over)
+    return spec
+
+
+def test_scheduler_aggregates_job_qc_doc(tmp_path):
+    _fake_run(tmp_path / "run")
+    doc = obs_qc.collect_run(str(tmp_path / "run"), "s")
+    doc["plane"] = {"disagree_rate": 0.01}
+    obs_qc.write_qc(str(tmp_path / "run" / "qc.json"), doc)
+    sched = Scheduler(start=False, paused=True)
+    try:
+        job = Job(_spec(tmp_path, tenant="acme", qos="batch"))
+        job.outputs = {"base": str(tmp_path / "run")}
+        sched.aggregate_job_qc(job)
+        assert job.qc["yields"]["families"] == 7
+        assert job.qc["disagree_rate"] == pytest.approx(0.01)
+        assert sched.counters.snapshot()["qc_docs_committed"] == 1
+        snap = obs_metrics.labeled_snapshot()["counters"]
+        fam = snap["tenant_qc_families"][0]
+        assert fam["labels"] == {"tenant": "acme", "qos": "batch"}
+        assert fam["value"] == 7
+        assert snap["tenant_qc_rescued"][0]["value"] == 3
+        dis = obs_metrics.labeled_snapshot()["histograms"]
+        assert dis["tenant_qc_disagreement"][0]["count"] == 1
+        # a job with no doc (pre-QC run) is a silent no-op
+        bare = Job(_spec(tmp_path, name="bare"))
+        bare.outputs = {"base": str(tmp_path / "nowhere")}
+        sched.aggregate_job_qc(bare)
+        assert sched.counters.snapshot()["qc_docs_committed"] == 1
+    finally:
+        sched.close(timeout=10)
+
+
+def test_shed_bypass_admits_cached_digest(tmp_path):
+    plane = str(tmp_path / "plane")
+    spec = _spec(tmp_path / "out")
+    digest = content_digest(spec)
+    src = tmp_path / "payload" / "golden"
+    os.makedirs(str(src))
+    (src / "x.txt").write_text("cached result\n")
+    ResultCache(plane, node="w0").insert(digest, str(tmp_path / "payload"))
+
+    sched = Scheduler(start=False, paused=True, result_cache=plane)
+    try:
+        # force the overload arm: huge EWMA, tiny deadline => shed fires
+        sched._ewma_job_s = 1000.0
+        with sched._cond:
+            with pytest.raises(DeadlineShed):
+                sched._shed_check_locked(0.01, "t", "batch",
+                                         _spec(tmp_path / "out",
+                                               name="uncached"))
+            # same overload, but the digest is committed: admitted
+            sched._shed_check_locked(0.01, "t", "batch", spec)
+        snap = sched.counters.snapshot()
+        assert snap["cache_shed_bypass"] == 1
+        assert snap["jobs_shed"] == 1  # only the uncached submit shed
+    finally:
+        sched.close(timeout=10)
+
+
+def test_shed_bypass_is_inert_without_cache(tmp_path):
+    sched = Scheduler(start=False, paused=True)
+    try:
+        assert not sched._cache_shed_bypass_locked(
+            _spec(tmp_path / "o"), "t", "batch")
+        assert not sched._cache_shed_bypass_locked(None, "t", "batch")
+        assert sched.counters.snapshot().get("cache_shed_bypass", 0) == 0
+    finally:
+        sched.close(timeout=10)
+
+
+# ------------------------------------------------------- cct top panel
+
+_EXPO_NO_QC = """\
+cct_fleet_members 1
+cct_fleet_members_up 1
+cct_fleet_member_up{node="w0"} 1
+"""
+
+_EXPO_PARTIAL_QC = _EXPO_NO_QC + """\
+cct_tenant_qc_families_total{tenant="a",qos="batch"} 12
+cct_tenant_qc_sscs_written_total{tenant="a",qos="batch"} 9
+cct_qc_docs_committed_total 2
+cct_tenant_qc_disagreement_sum{tenant="a",qos="batch"} 0.02
+cct_tenant_qc_disagreement_count{tenant="a",qos="batch"} 4
+"""
+
+
+def test_top_omits_qc_panel_for_pre_qc_daemon():
+    frame = obs_top.render_frame(
+        obs_top.parse_prometheus(_EXPO_NO_QC), "x", now=0.0)
+    assert not any(ln.startswith("qc:") for ln in frame.splitlines())
+
+
+def test_top_qc_panel_dashes_for_absent_counters():
+    # a daemon exporting SOME qc series (mid-upgrade fleet): present
+    # counters render, absent ones are dashes — never a KeyError
+    frame = obs_top.render_frame(
+        obs_top.parse_prometheus(_EXPO_PARTIAL_QC), "x", now=0.0)
+    (qc_line,) = [ln for ln in frame.splitlines() if ln.startswith("qc:")]
+    assert "fam=12" in qc_line and "sscs=9" in qc_line
+    assert "docs=2" in qc_line
+    assert "single=-" in qc_line and "dcs=-" in qc_line
+    assert "rescued=-" in qc_line and "shed_bypass=-" in qc_line
+    assert "skipped=-" in qc_line
+    assert "disagree=0.50%" in qc_line  # 0.02/4

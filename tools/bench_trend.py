@@ -24,6 +24,7 @@ import sys
 
 ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
 LOADGEN_RE = re.compile(r"BENCH_LOADGEN_r(\d+)\.json$")
+QC_RE = re.compile(r"BENCH_QC_r(\d+)\.json$")
 
 
 def discover(repo: str) -> list[tuple[int, str]]:
@@ -40,6 +41,15 @@ def discover_loadgen(repo: str) -> list[tuple[int, str]]:
     for path in sorted(glob.glob(os.path.join(repo,
                                               "BENCH_LOADGEN_r*.json"))):
         m = LOADGEN_RE.search(os.path.basename(path))
+        if m:
+            out.append((int(m.group(1)), path))
+    return sorted(out)
+
+
+def discover_qc(repo: str) -> list[tuple[int, str]]:
+    out = []
+    for path in sorted(glob.glob(os.path.join(repo, "BENCH_QC_r*.json"))):
+        m = QC_RE.search(os.path.basename(path))
         if m:
             out.append((int(m.group(1)), path))
     return sorted(out)
@@ -170,6 +180,38 @@ def extract_loadgen(n: int, path: str) -> list[dict]:
     return rows or [dict(base, source="failed")]
 
 
+def extract_qc(n: int, path: str) -> dict:
+    """One consensus-quality trend row per BENCH_QC artifact (r13+).
+    Pre-QC rounds have no artifact at all; artifacts from future shape
+    changes may lack individual keys — every field degrades to None and
+    renders as an em-dash, the row never disappears and never raises."""
+    row = {"round": n, "overhead_pct": None, "err_raw": None,
+           "err_sscs": None, "err_dcs": None, "recall_sscs": None,
+           "recall_dcs": None, "sscs_yield": None, "duplex_rate": None,
+           "disagree_rate": None, "source": "parsed"}
+    try:
+        doc = json.load(open(path))
+    except (OSError, ValueError):
+        return dict(row, source="failed")
+    row["overhead_pct"] = doc.get("qc_overhead_pct")
+    qc = doc.get("qc") or {}
+    rates = qc.get("rates") or {}
+    row["sscs_yield"] = rates.get("sscs_yield")
+    row["duplex_rate"] = rates.get("duplex_rate")
+    row["disagree_rate"] = (qc.get("plane") or {}).get("disagree_rate")
+    policies = ((doc.get("accuracy") or {}).get("policies")) or {}
+    pol = policies.get("default") or next(
+        (policies[k] for k in sorted(policies)), {})
+    err = pol.get("per_base_error") or {}
+    row["err_raw"] = err.get("raw")
+    row["err_sscs"] = err.get("sscs")
+    row["err_dcs"] = err.get("dcs")
+    variants = pol.get("variants") or {}
+    row["recall_sscs"] = (variants.get("sscs") or {}).get("recall")
+    row["recall_dcs"] = (variants.get("dcs") or {}).get("recall")
+    return row
+
+
 def _fmt(v, unit="") -> str:
     if v is None:
         return "—"
@@ -286,6 +328,47 @@ def render_loadgen(rows: list[dict]) -> str:
     return "\n".join(lines)
 
 
+def render_qc(rows: list[dict]) -> str:
+    """The consensus-quality half of the trend: truth-set accuracy and
+    yield from the BENCH_QC_r1*.json artifacts."""
+    lines = [
+        "## Consensus quality trend (QC)",
+        "",
+        "From the committed `BENCH_QC_r1*.json` artifacts (r13+,",
+        "regenerate one with `python tools/accuracy_harness.py`).  Error",
+        "columns are truth-set per-base error rates at each consensus",
+        "level — sscs/dcs at or below raw is the whole point of the",
+        "pipeline; recall columns score injected variants; `overhead` is",
+        "the measured wall cost of leaving QC accumulation on.  Rounds",
+        "before the QC observatory have no artifact and no row; missing",
+        "fields in any round render as em-dashes.",
+        "",
+        "| round | err raw | err sscs | err dcs | recall sscs "
+        "| recall dcs | sscs yield | duplex | disagree | qc overhead "
+        "| source |",
+        "|------:|--------:|---------:|--------:|------------:"
+        "|-----------:|-----------:|-------:|---------:|------------:"
+        "|:-------|",
+    ]
+    for r in rows:
+        lines.append(
+            "| r{round:02d} | {eraw} | {esscs} | {edcs} | {rsscs} "
+            "| {rdcs} | {sy} | {dup} | {dis} | {ovh} | {src} |".format(
+                round=r["round"],
+                eraw=_fmt(r["err_raw"]),
+                esscs=_fmt(r["err_sscs"]),
+                edcs=_fmt(r["err_dcs"]),
+                rsscs=_fmt_share(r["recall_sscs"]),
+                rdcs=_fmt_share(r["recall_dcs"]),
+                sy=_fmt_share(r["sscs_yield"]),
+                dup=_fmt_share(r["duplex_rate"]),
+                dis=_fmt_share(r["disagree_rate"]),
+                ovh=_fmt(r["overhead_pct"], "%"),
+                src=r["source"]))
+    lines.append("")
+    return "\n".join(lines)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--repo", default=os.path.dirname(
@@ -305,6 +388,9 @@ def main(argv=None) -> int:
         lg_rows = [row for n, path in loadgen
                    for row in extract_loadgen(n, path)]
         text += "\n" + render_loadgen(lg_rows)
+    qc = discover_qc(args.repo)
+    if qc:
+        text += "\n" + render_qc([extract_qc(n, path) for n, path in qc])
     if args.out == "-":
         print(text)
         return 0

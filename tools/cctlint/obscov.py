@@ -24,6 +24,19 @@ CCT603  labeled series are how cardinality explosions happen: label
         ``**splat`` hides them), and any literal ``qos=`` value must be
         one of ``QOS_CLASSES`` — so the exposition's label space is
         closed at lint time, not discovered in production.
+CCT605  QC series are discovered through the registry's ``QC_SERIES``
+        tuple — ``cct qc`` reports and the ``cct top`` QC panel render
+        whatever that tuple names, nothing else.  Both drift directions
+        are bugs: a ``tenant_qc_*`` name referenced anywhere outside the
+        registry but missing from ``QC_SERIES`` would be emitted yet
+        invisible to every QC surface; a ``QC_SERIES`` member no scanned
+        file references would render as a permanently-dead panel column.
+        The emitted side scans ALL string literals (the house idiom
+        emits from name tables like ``_QC_YIELD_SERIES``, not only from
+        literal call arguments); the registered-side check engages only
+        when the scan includes the QC emission home
+        (``serve/scheduler.py``) — partial scans prove nothing about
+        absence.
 CCT604  fleet tracing only survives kills and failovers if the trace
         context rides EVERY hand-off.  In serve/ code: (a) a wire ack
         reply — a dict literal carrying both ``"ok"`` and ``"job_id"``
@@ -88,6 +101,7 @@ def _load_registry(ctx: LintContext):
                 _labeled_decl(override["labeled_histograms"])
                 if "labeled_histograms" in override else None),
             "qos_classes": frozenset(override.get("qos_classes", ())),
+            "qc_series": tuple(override.get("qc_series", ())),
         }
     path = os.path.join(ctx.root, REGISTRY_REL)
     if not os.path.isfile(path):
@@ -105,6 +119,7 @@ def _load_registry(ctx: LintContext):
         "labeled_histograms": _labeled_decl(
             getattr(mod, "LABELED_HISTOGRAMS", None)) or None,
         "qos_classes": frozenset(getattr(mod, "QOS_CLASSES", ())),
+        "qc_series": tuple(getattr(mod, "QC_SERIES", ())),
     }
 
 
@@ -282,6 +297,60 @@ def _check_labeled_names(ctx: LintContext, reg: dict) -> list[Finding]:
     return findings
 
 
+# built by concatenation so this module's own source never matches the
+# prefix scan below (the lint scans tools/ too)
+QC_PREFIX = "tenant_qc" + "_"
+
+#: the module whose presence in the scan set proves the QC emission home
+#: was covered — only then can "registered but never emitted" be judged
+QC_EMISSION_HOME = "serve/scheduler.py"
+
+
+def _check_qc_series(ctx: LintContext, qc_series: tuple) -> list[Finding]:
+    """CCT605: QC series registered <=> emitted.
+
+    Emitted side: every ``tenant_qc_*`` string literal outside obs/ must
+    be a ``QC_SERIES`` member (all literals, not just call arguments —
+    the house idiom emits from name tables like ``_QC_YIELD_SERIES``).
+    Registered side: when the scan covers the QC emission home, every
+    ``QC_SERIES`` member must be referenced somewhere in the scan."""
+    findings: list[Finding] = []
+    members = frozenset(qc_series)
+    referenced: set[str] = set()
+    has_home = False
+    for src in ctx.parsed():
+        rel = src.rel.replace(os.sep, "/")
+        if rel.startswith("consensuscruncher_tpu/obs/"):
+            continue
+        if rel.endswith(QC_EMISSION_HOME):
+            has_home = True
+        for node in ast.walk(src.tree):
+            if not (isinstance(node, ast.Constant)
+                    and isinstance(node.value, str)
+                    and node.value.startswith(QC_PREFIX)):
+                continue
+            referenced.add(node.value)
+            if node.value not in members:
+                findings.append(Finding(
+                    "CCT605", src.rel, node.lineno,
+                    f"QC series '{node.value}' is not declared in "
+                    "consensuscruncher_tpu/obs/registry.py QC_SERIES — "
+                    "cct qc and the cct top QC panel discover series "
+                    "through that tuple; an undeclared series would be "
+                    "emitted but invisible to every QC surface", "obscov"))
+    if has_home:
+        for name in qc_series:
+            if name not in referenced:
+                findings.append(Finding(
+                    "CCT605", REGISTRY_REL, 1,
+                    f"QC series '{name}' is declared in QC_SERIES but "
+                    "never referenced by the scanned emission code — a "
+                    "dead declaration renders as a permanently-empty "
+                    "column in cct qc / cct top; emit it or drop it",
+                    "obscov"))
+    return findings
+
+
 def _check_trace_propagation(ctx: LintContext) -> list[Finding]:
     """CCT604: trace context must ride every serve-layer hand-off — ack
     replies and journal records are the two durable carriers."""
@@ -338,4 +407,6 @@ def run(ctx: LintContext) -> list[Finding]:
         if reg["labeled_counters"] is not None and \
                 reg["labeled_histograms"] is not None:
             findings.extend(_check_labeled_names(ctx, reg))
+        if reg.get("qc_series"):
+            findings.extend(_check_qc_series(ctx, reg["qc_series"]))
     return findings

@@ -177,6 +177,32 @@ python tools/perf_gate.py --fresh "$WORK/BENCH_LOADGEN_smoke2.json" \
   --baseline "$WORK/BENCH_LOADGEN_smoke1.json" --smoke \
   --out "$WORK/perf_gate_verdict.json" > /dev/null
 
+echo "== consensus QC leg (truth-set accuracy; drift gate vs committed baseline) =="
+# honest re-run scored against the newest committed BENCH_QC_r*.json:
+# same harness config as the baseline, --smoke tolerances for shared CI
+# boxes (structural checks — error ordering, non-empty consensus — stay
+# strict).  The report render doubles as the cct qc surface smoke.
+JAX_PLATFORMS=cpu PYTHONPATH="$REPO" python tools/accuracy_harness.py \
+  --workdir "$WORK/qc_honest" --repeats 1 \
+  --out "$WORK/BENCH_QC_fresh.json" > /dev/null
+JAX_PLATFORMS=cpu PYTHONPATH="$REPO" python -m consensuscruncher_tpu.cli \
+  qc report "$WORK/qc_honest/on/acc"
+python tools/qc_gate.py --fresh "$WORK/BENCH_QC_fresh.json" --smoke \
+  --out "$WORK/qc_gate_verdict.json" > /dev/null
+
+echo "== qc gate positive control (seeded corruption MUST be caught) =="
+# same run, consensus bases flipped at 2% before scoring: if the gate
+# passes this artifact its tolerances are decorative — fail CI
+JAX_PLATFORMS=cpu PYTHONPATH="$REPO" python tools/accuracy_harness.py \
+  --workdir "$WORK/qc_corrupt" --repeats 1 --corrupt 0.02 \
+  --out "$WORK/BENCH_QC_corrupt.json" > /dev/null
+if python tools/qc_gate.py --fresh "$WORK/BENCH_QC_corrupt.json" \
+    --smoke > /dev/null 2>&1; then
+  echo "ci_check: qc_gate FAILED to catch the seeded-corruption control" >&2
+  exit 1
+fi
+echo "ci_check: qc gate OK (honest run passes, seeded corruption caught)"
+
 echo "== result-cache parity smoke (cached answer == fresh recompute, byte-for-byte) =="
 JAX_PLATFORMS=cpu PYTHONPATH="$REPO" python - "$WORK/cachepar" <<'PY'
 import hashlib, os, sys
