@@ -1355,8 +1355,13 @@ def serve_cmd(args) -> None:
 
 def submit_cmd(args) -> None:
     """Submit one consensus job to a running daemon and (by default) block
-    for its result — the thin client leg of the serve/ subsystem."""
-    from consensuscruncher_tpu.serve.client import ServeClient
+    for its result — the thin client leg of the serve/ subsystem.
+
+    A quarantined verdict (the fleet's poison-job containment: the key
+    exhausted its fleet-wide retry budget) exits non-zero with the reason;
+    it is an operator decision, not a retry candidate — lift it with
+    ``cct route --release KEY``."""
+    from consensuscruncher_tpu.serve.client import JobQuarantined, ServeClient
 
     address = args.socket or (args.host, int(args.port))
     client = ServeClient(address)
@@ -1379,7 +1384,12 @@ def submit_cmd(args) -> None:
         spec["tenant"] = str(args.tenant)
     if getattr(args, "qos", None) not in (None, ""):
         spec["qos"] = str(args.qos)
-    sub = client.submit_full(spec)
+    try:
+        sub = client.submit_full(spec)
+    except JobQuarantined as e:
+        raise SystemExit(
+            f"submit: quarantined ({e.reason}); "
+            f"lift with: cct route --release {e.key or '<key>'}")
     job_id = sub["job_id"]
     print(f"submit: job {job_id} queued on {address} (key {sub['key']}"
           + (", duplicate of an existing job" if sub.get("duplicate") else "")
@@ -1387,7 +1397,12 @@ def submit_cmd(args) -> None:
     if not _bool(getattr(args, "wait", "True")):
         return
     # poll by idempotency key: survives a daemon restart mid-wait
-    job = client.result(key=sub["key"])
+    try:
+        job = client.result(key=sub["key"])
+    except JobQuarantined as e:
+        raise SystemExit(
+            f"submit: job {job_id} quarantined ({e.reason}); "
+            f"lift with: cct route --release {sub['key']}")
     if job["state"] != "done":
         raise SystemExit(f"submit: job {job_id} {job['state']}: {job.get('error')}")
     base = (job.get("outputs") or {}).get("base")
@@ -1490,6 +1505,25 @@ def _route_adopt(args) -> None:
           f"({', '.join(reply.get('keys') or []) or 'none pending'})")
 
 
+def _route_release(args) -> None:
+    """``route --release KEY``: client mode — lift a poison-job quarantine.
+    The router resets the key's fleet attempt lineage and fans the release
+    out to every up member (the quarantine marker may live on any node the
+    job was failed over to); the journaled ``released`` marker makes the
+    lift durable across worker restarts."""
+    from consensuscruncher_tpu.serve.client import ServeClient
+
+    address = args.socket or (args.host, int(args.port))
+    reply = ServeClient(address).request(
+        {"op": "release", "key": str(args.release)}, timeout=60.0)
+    if reply.get("released"):
+        print(f"route: released {reply.get('key')} on {reply.get('node')} — "
+              "next submit retries with a fresh fleet attempt budget")
+    else:
+        raise SystemExit(
+            f"route: key {args.release} is not quarantined on any up member")
+
+
 def route_cmd(args) -> None:
     """Run the fleet router (serve/router.py): a stateless front door
     consistent-hashing submits by idempotency key onto N worker daemons,
@@ -1510,6 +1544,9 @@ def route_cmd(args) -> None:
 
     if getattr(args, "adopt", None):
         _route_adopt(args)
+        return
+    if getattr(args, "release", None):
+        _route_release(args)
         return
 
     children: dict = {}
@@ -2153,6 +2190,10 @@ def build_parser() -> argparse.ArgumentParser:
     r.add_argument("--adopt_force",
                    help="with --adopt: adopt even if the member still "
                         "answers health probes (default False)")
+    r.add_argument("--release", metavar="KEY",
+                   help="client mode: lift the quarantine on KEY via the "
+                        "running router (resets the fleet retry budget "
+                        "and requeues the parked job), then exit")
     r.add_argument("--result_cache",
                    help="root of the fleet content-addressed result-cache "
                         "plane: the router consults it BEFORE dispatch "
@@ -2180,7 +2221,7 @@ def build_parser() -> argparse.ArgumentParser:
                        "standby": "False", "takeover_after": 3,
                        "adopt_after_s": "", "journals": "",
                        "advertise": "", "adopt": "",
-                       "adopt_force": "False",
+                       "adopt_force": "False", "release": "",
                        "result_cache": "", "cache_journal": "",
                    })
 

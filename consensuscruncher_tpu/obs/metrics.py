@@ -473,6 +473,9 @@ def render_fleet_prometheus(doc: dict) -> str:
         ("cct_fleet_queue_depth", "queued",
          "queued jobs on the member (router's last health probe)"),
         ("cct_fleet_running", "running", "running jobs on the member"),
+        ("cct_fleet_quarantined", "quarantined",
+         "quarantined poison keys parked on the member (healthz-"
+         "reported; absent for pre-quarantine daemons)"),
         ("cct_fleet_draining", "draining", "1 while the member drains"),
     ):
         if not members:

@@ -128,6 +128,31 @@ COUNTERS = {
     "qc_docs_committed": "per-run qc.json documents committed via "
                          "manifest.commit_file (one per consensus run "
                          "with QC accumulation enabled)",
+    "jobs_quarantined": "jobs parked in the quarantined state (fleet "
+                        "retry budget exhausted, or blamed by replay "
+                        "crash attribution) — durable via the journal's "
+                        "quarantined marker until released",
+    "fleet_attempts_exhausted": "redispatch attempts (failover resubmit, "
+                                "adoption, journal recovery, steal, or a "
+                                "worker predispatch) refused because the "
+                                "key's fleet-wide attempt lineage hit "
+                                "CCT_SERVE_MAX_FLEET_ATTEMPTS",
+    "suspect_blames": "journal replays that blamed a key for the crash "
+                      "via its pre-dispatch suspect marker (the job was "
+                      "in flight when the process died)",
+    "quarantine_released": "quarantined keys re-opened by an operator "
+                           "release (cct route --release KEY)",
+    "breaker_open": "fault-domain circuit-breaker trips: N quarantines "
+                    "inside the window from one tenant/input "
+                    "fingerprint made admission refuse that "
+                    "fingerprint early",
+    "brownout_refusals": "admissions refused because the daemon is in "
+                         "resource-exhaustion brownout (journal appends "
+                         "failing ENOSPC; polls and cache hits still "
+                         "served)",
+    "watermark_sheds": "admissions shed by the RSS/queue-byte resource "
+                       "watermark (scavenger first, then batch, then "
+                       "interactive)",
     "qc_ranges_skipped": "--input_range slices skipped at plan time "
                          "because the result cache held a negative entry "
                          "for the exact sub-spec (known-empty range, "

@@ -5,9 +5,11 @@ Prometheus text form — the SAME exposition a scraper would read, so what
 the operator watches and what the dashboards alert on can never drift —
 and renders one compact frame per interval: router epoch and HA state,
 a per-node table (up / queue depth / running / routed / steals /
-resubmits / trace spans / orphans), a per-qos SLO panel (p50/p99 latency,
-shed ratio, multi-window burn rates) and the fleet-wide HA counters
-(failovers, adoptions, fencing rejections, trace links).
+resubmits / quarantined / trace spans / orphans), a per-qos SLO panel
+(p50/p99 latency, shed ratio, multi-window burn rates) and the
+fleet-wide HA counters (failovers, adoptions, fencing rejections,
+quarantines, breaker trips, brownout refusals, trace links).  Columns a
+pre-quarantine daemon never exports render as dashes, not errors.
 
 Everything below the socket read is PURE: :func:`parse_prometheus` turns
 exposition text into ``{metric: [(labels, value), ...]}`` and
@@ -180,13 +182,16 @@ def render_frame(series: dict, source: str,
             "routed": _by_label(series, "cct_node_jobs_routed_total", "node"),
             "steals": _by_label(series, "cct_node_steals_total", "node"),
             "resub": _by_label(series, "cct_node_resubmits_total", "node"),
+            # quarantined poison keys per member: absent entirely on
+            # pre-quarantine daemons, so the cell dash-degrades
+            "quar": _by_label(series, "cct_fleet_quarantined", "node"),
             "spans": _by_label(series, "cct_trace_spans_emitted_total",
                                "node"),
             "orphans": _by_label(series, "cct_trace_orphans_total", "node"),
         }
         header = (f"{'NODE':<10} {'UP':<4} {'QUEUE':>5} {'RUN':>4} "
                   f"{'ROUTED':>7} {'STEALS':>6} {'RESUB':>5} "
-                  f"{'SPANS':>7} {'ORPH':>4}")
+                  f"{'QUAR':>4} {'SPANS':>7} {'ORPH':>4}")
         lines.append(header)
         for node in nodes:
             lines.append(
@@ -196,6 +201,7 @@ def render_frame(series: dict, source: str,
                 f"{_fmt_n(cols['routed'].get(node)):>7} "
                 f"{_fmt_n(cols['steals'].get(node)):>6} "
                 f"{_fmt_n(cols['resub'].get(node)):>5} "
+                f"{_fmt_n(cols['quar'].get(node)):>4} "
                 f"{_fmt_n(cols['spans'].get(node)):>7} "
                 f"{_fmt_n(cols['orphans'].get(node)):>4}")
 
@@ -267,6 +273,13 @@ def render_frame(series: dict, source: str,
         ("adoptions", "cct_jobs_adopted_total"),
         ("failovers", "cct_router_failovers_total"),
         ("fenced", "cct_fencing_rejections_total"),
+        # poison-containment tallies (absent on pre-quarantine fleets:
+        # the cells simply don't render, nothing breaks)
+        ("quarantined", "cct_jobs_quarantined_total"),
+        ("budget_out", "cct_fleet_attempts_exhausted_total"),
+        ("breaker", "cct_breaker_open_total"),
+        ("released", "cct_quarantine_released_total"),
+        ("brownouts", "cct_brownout_refusals_total"),
         ("spans", "cct_trace_spans_emitted_total"),
         ("links", "cct_trace_links_total"),
         ("orphans", "cct_trace_orphans_total"),

@@ -36,6 +36,19 @@ class ServeClientError(RuntimeError):
         self.reply = reply or {}
 
 
+class JobQuarantined(ServeClientError):
+    """The fleet quarantined this job's key (poison containment: fleet
+    retry budget exhausted, or the fault-domain breaker is open).  This
+    is a *verdict*, not a transient — it is never retried (retry loops
+    are exactly what poison jobs weaponize); an operator lifts it with
+    ``cct route --release KEY``."""
+
+    def __init__(self, message: str, reply: dict | None = None):
+        super().__init__(message, reply)
+        self.reason = str((reply or {}).get("reason") or message)
+        self.key = (reply or {}).get("key")
+
+
 class ServeClient:
     """``address`` is a unix socket path (str), a ``(host, port)`` pair,
     or a *list* of such addresses — an HA router pair's front doors.  The
@@ -140,6 +153,9 @@ class ServeClient:
         finally:
             sock.close()
         if not reply.get("ok"):
+            if reply.get("quarantined"):
+                raise JobQuarantined(
+                    reply.get("error", "job quarantined"), reply)
             raise ServeClientError(reply.get("error", "daemon error"), reply)
         return reply
 
@@ -292,6 +308,11 @@ class ServeClient:
         restarted between the submit and the result."""
         sub = self.submit_full(spec)
         job = self.result(timeout=timeout, key=sub["key"])
+        if job["state"] == "quarantined":
+            raise JobQuarantined(
+                f"job {job['job_id']} quarantined: {job.get('error')}",
+                {"quarantined": True, "reason": job.get("error"),
+                 "key": job.get("key")})
         if job["state"] != "done":
             raise ServeClientError(
                 f"job {job['job_id']} {job['state']}: {job.get('error')}", job)

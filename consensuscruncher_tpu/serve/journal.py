@@ -114,7 +114,8 @@ def job_record(job_id: int, state: str, *, key: str | None = None,
                outputs: dict | None = None, error: str | None = None,
                wall_s: float | None = None,
                trace_id: str | None = None,
-               trace: dict | None = None) -> dict:
+               trace: dict | None = None,
+               qc: dict | None = None) -> dict:
     """One journal record; only non-None fields are written (transition
     records carry just the delta, replay merges by id).  ``trace_id`` is
     the correlation id minted at submit — journaled so a replayed job's
@@ -122,12 +123,15 @@ def job_record(job_id: int, state: str, *, key: str | None = None,
     trace context of the submit-ack span ({"trace_id", "span", "pid",
     "hop"}): persisted on the accepted record so a failover resubmit or
     journal adoption can emit a ``follows_from`` edge back to the dead
-    owner's durable ack span — the trace survives kill -9 and replay."""
+    owner's durable ack span — the trace survives kill -9 and replay.
+    ``qc`` is the job's consensus-quality doc, journaled on the terminal
+    record so QC attribution survives a restart too."""
     rec: dict = {"v": 1, "rec": "job", "id": int(job_id), "state": state}
     for field, value in (("key", key), ("spec", spec),
                          ("deadline_s", deadline_s), ("outputs", outputs),
                          ("error", error), ("wall_s", wall_s),
-                         ("trace_id", trace_id), ("trace", trace)):
+                         ("trace_id", trace_id), ("trace", trace),
+                         ("qc", qc)):
         if value is not None:
             rec[field] = value
     return rec
@@ -235,7 +239,7 @@ def replay(path: str) -> tuple[dict[int, dict], dict]:
     carries ``{"records", "skipped", "torn_tail", "clean_drain",
     "adopted_by", "fence_epoch"}``.
 
-    Two marker kinds carry fleet-HA state through replay:
+    Four marker kinds carry fleet-HA state through replay:
 
     - an ``adopted`` tombstone (written by the router after it resubmits
       a dead member's non-terminal jobs to their ring successors) tags
@@ -244,7 +248,15 @@ def replay(path: str) -> tuple[dict[int, dict], dict]:
       elsewhere; ``info["adopted_by"]`` names the adopting router;
     - a ``fence`` marker persists the highest router epoch this worker
       has accepted, so a restart cannot be tricked into honoring a
-      demoted router's forwards (``info["fence_epoch"]``).
+      demoted router's forwards (``info["fence_epoch"]``);
+    - a ``suspect`` marker (written BEFORE each dispatch) attributes an
+      in-flight job to this node: ``info["suspects"]`` maps key -> the
+      highest attempt ordinal journaled, so replay after kill -9 can
+      blame the job that was running when the process died;
+    - a ``quarantined`` marker folds last-wins per key into
+      ``info["quarantined"]`` (key -> reason) — duplicates are
+      idempotent, and a later ``released: true`` marker for the key
+      removes it (the release re-opens the key for dispatch).
 
     Tolerant by design: a torn final record (crash mid-append) is logged
     and skipped; any other undecodable or fault-injected record is logged,
@@ -253,7 +265,8 @@ def replay(path: str) -> tuple[dict[int, dict], dict]:
     """
     jobs: dict[int, dict] = {}
     info = {"records": 0, "skipped": 0, "torn_tail": False,
-            "clean_drain": False, "adopted_by": None, "fence_epoch": None}
+            "clean_drain": False, "adopted_by": None, "fence_epoch": None,
+            "suspects": {}, "quarantined": {}}
     # schedule point: a zombie's replay racing an adopter's tombstone
     # append is exactly the interleaving the model checker explores here
     sanitize.yield_point("journal.replay")
@@ -307,6 +320,24 @@ def replay(path: str) -> tuple[dict[int, dict], dict]:
                 if epoch is not None:
                     info["fence_epoch"] = max(
                         info["fence_epoch"] or 0, epoch)
+            elif rec.get("kind") == "suspect":
+                key = rec.get("key")
+                try:
+                    attempt = int(rec.get("attempt"))
+                except (TypeError, ValueError):
+                    attempt = None
+                if isinstance(key, str) and attempt is not None:
+                    info["suspects"][key] = max(
+                        info["suspects"].get(key, 0), attempt)
+            elif rec.get("kind") == "quarantined":
+                key = rec.get("key")
+                if isinstance(key, str):
+                    if rec.get("released"):
+                        # release re-opens the key; last marker wins
+                        info["quarantined"].pop(key, None)
+                    else:
+                        info["quarantined"][key] = \
+                            str(rec.get("reason") or "quarantined")
             continue
         info["clean_drain"] = False
         try:

@@ -296,14 +296,21 @@ class ResultCache:
             total += int(entry.get("bytes", 0))
         return {"entries": entries, "bytes": total}
 
-    def evict_to_budget(self) -> list[dict]:
+    def evict_to_budget(self, emergency: bool = False) -> list[dict]:
         """Drop oldest committed entries from the local shard until its
         payload bytes fit ``max_bytes``.  The entry doc is unlinked
         FIRST (the entry disappears atomically for readers), payload
         files after — the reverse of insert order, so no reader ever
         sees a visible entry with missing payload.  Returns the evicted
-        entry docs."""
-        if not self.max_bytes:
+        entry docs.
+
+        ``emergency=True`` is the ENOSPC first responder: the disk the
+        journal fsyncs to is full, and cache bytes are the cheapest on
+        the box (every entry is re-computable by construction) — evict
+        the oldest half of the shard (at least one entry) regardless of
+        ``max_bytes`` so the brownout path gets one append's worth of
+        space back."""
+        if not self.max_bytes and not emergency:
             return []
         with self._lock:
             live = []
@@ -317,7 +324,11 @@ class ResultCache:
             total = sum(int(e.get("bytes", 0)) for e in live)
             live.sort(key=lambda e: e.get("t", 0.0))
             evicted = []
-            while live and total > self.max_bytes:
+            budget = self.max_bytes or float("inf")
+            keep = len(live)
+            if emergency:
+                keep = len(live) // 2
+            while live and (total > budget or len(live) > keep):
                 entry = live.pop(0)
                 try:
                     os.unlink(os.path.join(entry["dir"], ENTRY_NAME))

@@ -56,6 +56,20 @@ marker to the dead journal.  A returning zombie worker replays the
 tombstone, drops the adopted jobs instead of re-running them, and counts
 each drop in ``fencing_rejections``.
 
+Poison containment: every failover/adoption/steal path above *re-runs*
+a job somewhere else, which is exactly how a deterministic crasher
+becomes a fleet-wide crash loop.  The ring view therefore carries a
+per-key **fleet attempt lineage** (``attempts``): failover resubmit,
+adoption, journal recovery and work stealing all consult and increment
+it, and every submit forward hands the count to the worker (whose
+scheduler journals a ``suspect`` marker before each dispatch), so
+``CCT_SERVE_MAX_FLEET_ATTEMPTS`` caps a key's total attempts across
+routers — including a standby that takes over mid-crash-loop, which
+inherits the lineage from the view doc.  Past the cap the key comes
+back ``{"quarantined": true}`` and the owning worker journals a durable
+``quarantined`` marker; ``cct route --release KEY`` (the ``release``
+op) lifts it fleet-wide and resets the lineage.
+
 Fault sites (registered in ``tools/cctlint/fault_sites.py``, armed by the
 chaos tests): ``route.member_down`` (a forward hits a dead member),
 ``route.steal`` (the steal decision itself), ``route.resubmit`` (the
@@ -234,12 +248,17 @@ class RingView:
     def publish(self, epoch: int, router: str, address,
                 members: list[tuple[str, object]],
                 journals: dict | None = None,
-                warm: dict | None = None) -> dict:
+                warm: dict | None = None,
+                attempts: dict | None = None) -> dict:
         """Append one fsync'd epoch record (compacting first when the doc
         has grown past ``max_records``); returns the record.  ``warm`` is
         the fleet's warm-join state — paths to the shared XLA compile
         cache dir, the autotune table and the result-cache plane — so a
-        member spawned later reads ONE document and joins hot."""
+        member spawned later reads ONE document and joins hot.
+        ``attempts`` is the fleet-wide per-key attempt lineage (key ->
+        count): riding the epoch doc makes the retry budget survive a
+        router takeover — the standby inherits exactly the counts the
+        dead active had spent."""
         rec = {
             "v": 1, "epoch": int(epoch), "router": str(router),
             "address": (list(address)
@@ -252,6 +271,8 @@ class RingView:
             rec["journals"] = dict(journals)
         if warm:
             rec["warm"] = {k: v for k, v in warm.items() if v}
+        if attempts:
+            rec["attempts"] = {str(k): int(v) for k, v in attempts.items()}
         line = json.dumps(rec, sort_keys=True,
                           separators=(",", ":")).encode() + b"\n"
         with self._lock:
@@ -294,6 +315,7 @@ class _Member:
         self.fails = 0          # consecutive failed health probes
         self.queued = 0
         self.running = 0
+        self.quarantined = 0    # parked poison keys (healthz-reported)
         self.draining = False
         self.last_seen = 0.0
         self.down_since: float | None = None   # wall clock of the outage
@@ -307,6 +329,7 @@ class _Member:
             "up": self.up,
             "queued": self.queued,
             "running": self.running,
+            "quarantined": self.quarantined,
             "draining": self.draining,
         }
 
@@ -430,9 +453,16 @@ class Router:
                     "CCT_ROUTE_CACHE_JOURNAL_MAX_BYTES", str(1 << 20))))
         self.fenced = False         # a worker rejected our epoch: demoted
         self._active_fails = 0      # standby's failed probes of the active
+        # fleet retry budget: per-key attempt lineage spent by failover
+        # resubmit / adoption / journal recovery / stealing, carried in
+        # the ring view so a takeover (or restart) inherits the spend
+        self.max_fleet_attempts = int(os.environ.get(
+            "CCT_SERVE_MAX_FLEET_ATTEMPTS", "3"))
+        self._attempts: dict[str, int] = {}
         if self.ring_view is not None:
             doc = self.ring_view.load()
             self.epoch = int((doc or {}).get("epoch") or 0)
+            self._merge_attempts(doc)
             if not self.standby:
                 self._claim_active()
         else:
@@ -483,6 +513,7 @@ class Router:
             member.fails = 0
             member.queued = int(health.get("queued", 0))
             member.running = int(health.get("running", 0))
+            member.quarantined = int(health.get("quarantined", 0) or 0)
             member.draining = health.get("status") == "draining"
             member.last_seen = time.time()
             member.down_since = None
@@ -533,10 +564,12 @@ class Router:
         epoch past anything the ring view has seen and publish."""
         doc = self.ring_view.load()
         self.epoch = max(self.epoch, int((doc or {}).get("epoch") or 0)) + 1
+        self._merge_attempts(doc)
         self.ring_view.publish(self.epoch, self.router_id,
                                self.advertise, self._member_list(),
                                journals=self.journals,
-                               warm=self.warm_state)
+                               warm=self.warm_state,
+                               attempts=self._attempts_snapshot())
         self.standby = False
         self.fenced = False
         self._active_fails = 0
@@ -553,7 +586,8 @@ class Router:
             self.ring_view.publish(self.epoch, self.router_id,
                                    self.advertise, self._member_list(),
                                    journals=self.journals,
-                                   warm=self.warm_state)
+                                   warm=self.warm_state,
+                                   attempts=self._attempts_snapshot())
         except (faults.FaultError, OSError) as e:
             # the in-memory membership change is already live and the
             # epoch bump is kept: the view doc is advertisement state for
@@ -635,6 +669,9 @@ class Router:
         # that were member_add'ed after this standby was configured
         for name, path in (doc.get("journals") or {}).items():
             self.journals.setdefault(str(name), str(path))
+        # the fleet attempt lineage rides along too: a takeover must not
+        # grant a crash-looping key a fresh retry budget
+        self._merge_attempts(doc)
         with self._lock:
             changed = False
             for name, address in want.items():
@@ -690,6 +727,108 @@ class Router:
         if refusal is not None:
             raise ServeClientError(refusal["error"], refusal)
 
+    # --------------------------------------------- fleet retry budget
+
+    def _merge_attempts(self, doc: dict | None) -> None:
+        """Max-merge a ring-view doc's ``attempts`` lineage into ours
+        (counts only grow: two routers that each saw part of a key's
+        history converge on the larger spend, never a reset)."""
+        if not doc:
+            return
+        published = doc.get("attempts") or {}
+        if not isinstance(published, dict):
+            return
+        with self._lock:
+            for key, n in published.items():
+                try:
+                    n = int(n)
+                except (TypeError, ValueError):
+                    continue
+                if n > self._attempts.get(str(key), 0):
+                    self._attempts[str(key)] = n
+
+    def _attempts_snapshot(self) -> dict:
+        with self._lock:
+            return dict(self._attempts)
+
+    def _budget_spend(self, key: str, what: str, strict: bool = True) -> bool:
+        """Spend one fleet attempt for ``key``; the redispatch paths
+        (failover resubmit, adoption, journal recovery, steal) all come
+        through here.  Past ``CCT_SERVE_MAX_FLEET_ATTEMPTS`` nothing is
+        spent: ``strict`` raises the quarantined refusal (polls and
+        resubmits answer it to the client), non-strict returns False so
+        the caller degrades (a steal goes home, an adoption forwards the
+        exhausted lineage for the worker to quarantine durably)."""
+        if self.max_fleet_attempts <= 0 or not key:
+            return True
+        with self._lock:
+            n = self._attempts.get(key, 0) + 1
+            if n <= self.max_fleet_attempts:
+                self._attempts[key] = n
+                return True
+            spent = n - 1
+        self.counters.add("fleet_attempts_exhausted", 1)
+        reason = (f"fleet retry budget exhausted for key {key} "
+                  f"({spent}/{self.max_fleet_attempts} attempts across "
+                  f"the fleet; {what} refused)")
+        obs_flight.record("fleet_budget_exhausted", key=key, what=what,
+                          attempts=spent, router=self.router_id)
+        if strict:
+            raise ServeClientError(reason, {
+                "ok": False, "error": reason, "refused": True,
+                "quarantined": True, "reason": reason, "key": key})
+        print(f"route[{self.router_id}]: {reason}",
+              file=sys.stderr, flush=True)
+        return False
+
+    def _prune_attempts(self, key: str, reply: dict) -> None:
+        """A key observed ``done`` no longer needs its lineage — the
+        dedup cache answers any re-submit, so the budget entry is dead
+        weight (and the map must not grow with every honest steal)."""
+        if (reply.get("job") or {}).get("state") == "done":
+            with self._lock:
+                self._attempts.pop(key, None)
+
+    def _submit_doc(self, spec: dict, key: str) -> dict:
+        """Submit forward doc with the key's fleet lineage riding along:
+        the worker max-merges it, so its own budget gate (and the
+        ``suspect`` ordinals it journals) continue the fleet-wide count
+        instead of restarting from zero on every node."""
+        doc = {"op": "submit", "spec": spec}
+        with self._lock:
+            n = self._attempts.get(key, 0)
+        if n:
+            doc["attempts"] = n
+        return doc
+
+    def release(self, key: str) -> dict:
+        """Lift a quarantine fleet-wide (``cct route --release KEY``):
+        reset the ring-carried attempt lineage, then ask every up member
+        to release the key — the durable marker usually lives on the
+        ring owner, but a failover may have left it on a previous
+        incarnation's node, so all of them are asked.  The reset lineage
+        is published immediately: a router restart must not resurrect
+        the spent budget and re-quarantine the key on its next attempt."""
+        self._check_active()
+        key = str(key)
+        with self._lock:
+            self._attempts.pop(key, None)
+        released = []
+        for member in self.members():
+            if not member.up:
+                continue
+            try:
+                reply = self._forward(member, {"op": "release", "key": key})
+            except ServeClientError:
+                continue
+            if reply.get("released"):
+                released.append(member.name)
+        if released:
+            self.counters.add("quarantine_released", 1)
+        self._publish_view()
+        return {"key": key, "released": bool(released),
+                "node": released[0] if released else None}
+
     # ------------------------------------------------------- HA: adoption
 
     def adoption_sweep(self) -> None:
@@ -743,20 +882,40 @@ class Router:
                 "(pass force to override)", {"bad_request": True})
         faults.fault_point("route.adopt")
         jobs, info = journal_mod.replay(path)
+        quarantined = info.get("quarantined") or {}
         pending = []
+        skipped_quarantined = 0
         for jid in sorted(jobs):
             rec = jobs[jid]
             if rec.get("state") in ("done", "failed"):
                 continue
             if rec.get("adopted"):
                 continue  # an earlier adoption already moved it
+            if rec.get("key") in quarantined:
+                # the dead member had already condemned this key: moving
+                # it to a successor would restart the crash loop the
+                # quarantine exists to stop — it stays parked until an
+                # operator releases it
+                skipped_quarantined += 1
+                continue
             spec = rec.get("spec")
             if not isinstance(spec, dict) or not spec.get("input") \
                     or not spec.get("output"):
                 continue  # rotated-away accepted record: nothing to move
             pending.append((jid, spec, rec))
+        if skipped_quarantined:
+            print(f"route[{self.router_id}]: adoption of {member.name}: "
+                  f"{skipped_quarantined} quarantined job(s) left parked "
+                  "(release to retry)", file=sys.stderr, flush=True)
         adopted_keys = []
         for jid, spec, rec in pending:
+            # fleet budget: an adoption resubmit is one more attempt on
+            # this key's lineage.  Non-strict past the cap — the job is
+            # still forwarded (carrying the exhausted count) so the
+            # successor's scheduler quarantines it DURABLY instead of
+            # the router silently dropping it
+            self._budget_spend(str(rec.get("key") or ""),
+                               "adoption resubmit", strict=False)
             # the adoption span continues the DEAD member's trace: it
             # links to the ack context persisted on the journal record,
             # and the nested route.submit span inherits that trace_id —
@@ -770,6 +929,11 @@ class Router:
                                 node=member.name, job_id=jid):
                 reply = self.submit(spec)
             if not reply.get("ok"):
+                if reply.get("quarantined"):
+                    # the successor already holds a quarantine for this
+                    # key: containment won, the job stays parked there
+                    skipped_quarantined += 1
+                    continue
                 raise ServeClientError(
                     f"adoption resubmit of {member.name} job {jid} "
                     f"refused: {reply.get('error')}", dict(reply))
@@ -945,6 +1109,12 @@ class Router:
             print(f"WARNING: route: steal fault ({e}); keeping job on "
                   f"home node {home.name}", file=sys.stderr, flush=True)
             return home, False
+        # a steal re-homes the key, which is one more place a poison job
+        # can take a worker down: it spends from the same fleet lineage.
+        # Past the budget the job simply goes home (no amplification;
+        # the home scheduler's own gate quarantines it durably there)
+        if not self._budget_spend(key, "steal", strict=False):
+            return home, False
         return thief, True
 
     # ---------------------------------------------------------------- ops
@@ -998,11 +1168,19 @@ class Router:
                                 "error": "no fleet member is up",
                                 "transport": True}
                 try:
-                    reply = self._forward(member,
-                                          {"op": "submit", "spec": spec})
+                    reply = self._forward(member, self._submit_doc(spec, key))
                 except ServeClientError as e:
                     if e.reply.get("transport"):
-                        # forward-time death: fail over around the ring
+                        # forward-time death: fail over around the ring.
+                        # The hop to the next owner is a redispatch — it
+                        # spends from the key's fleet lineage, so a
+                        # crash-looping key stops walking the ring and
+                        # the submitter gets the quarantined refusal
+                        # (as a reply dict: submit's refusal contract)
+                        try:
+                            self._budget_spend(key, "ring failover")
+                        except ServeClientError as qe:
+                            return dict(qe.reply)
                         tried.add(member.name)
                         stolen = False
                         continue
@@ -1075,6 +1253,10 @@ class Router:
         placement inherited from a pre-tracing router — counts a
         ``trace_orphans`` tally instead of fabricating a link."""
         faults.fault_point("route.resubmit")
+        # fleet budget, strict: a failover resubmit past the cap raises
+        # the quarantined refusal instead of re-running the job — the
+        # keyed poll that triggered us answers it to the client
+        self._budget_spend(key, "failover resubmit")
         ctx = info.get("trace") if isinstance(info.get("trace"), dict) \
             else None
         if ctx is None and obs_trace.enabled():
@@ -1082,8 +1264,7 @@ class Router:
         with obs_trace.span("route.resubmit", link=ctx, key=key,
                             node=member.name,
                             trace_id=(ctx or {}).get("trace_id")):
-            reply = self._forward(member, {"op": "submit",
-                                           "spec": info["spec"]})
+            reply = self._forward(member, self._submit_doc(info["spec"], key))
         self._remember(key, info["spec"], member.name,
                        trace=reply.get("trace"))
         self.counters.add("jobs_routed", 1)
@@ -1146,9 +1327,19 @@ class Router:
             if member is not None and member.up:
                 continue  # live members already answered the sweep
             try:
-                jobs, _info = journal_mod.replay(path)
+                jobs, jinfo = journal_mod.replay(path)
             except (OSError, ValueError):
                 continue
+            qreason = (jinfo.get("quarantined") or {}).get(key)
+            if qreason is not None:
+                # the down member had condemned this key: the poll gets
+                # the quarantine verdict, never a restarted crash loop
+                reason = (f"key {key} is quarantined on down node "
+                          f"{name}: {qreason}")
+                raise ServeClientError(reason, {
+                    "ok": False, "error": reason, "refused": True,
+                    "quarantined": True, "reason": str(qreason),
+                    "key": key})
             for rec in jobs.values():
                 # terminal records are answered from the journal instead
                 # (resubmitting one would re-run a finished job just to
@@ -1172,6 +1363,8 @@ class Router:
         try:
             self._failover_resubmit(key, {"spec": spec, "trace": ctx}, owner)
         except ServeClientError as e:
+            if e.reply.get("quarantined"):
+                raise  # the poll answers the quarantine, not "unknown"
             print(f"route: journal-recovered resubmit of key {key} "
                   f"failed ({e}); next poll retries", file=sys.stderr,
                   flush=True)
@@ -1368,7 +1561,9 @@ class Router:
         while True:
             member = self.resolve(key)
             try:
-                return self._forward(member, {"op": "status", "key": key})
+                reply = self._forward(member, {"op": "status", "key": key})
+                self._prune_attempts(key, reply)
+                return reply
             except ServeClientError as e:
                 if e.reply.get("unknown") and not swept:
                     swept = True  # one fleet sweep per call
@@ -1405,11 +1600,13 @@ class Router:
                     raise TimeoutError(f"job {key} still pending")
             member = self.resolve(key)
             try:
-                return self._forward(
+                reply = self._forward(
                     member,
                     {"op": "result", "key": key,
                      "timeout": min(slice_s, remaining)},
                     timeout=min(slice_s, remaining) + 10.0)
+                self._prune_attempts(key, reply)
+                return reply
             except ServeClientError as e:
                 if e.reply.get("unknown") and not swept:
                     swept = True  # one fleet sweep per call
@@ -1487,6 +1684,7 @@ class Router:
                          ("standby" if self.standby else "active")),
             "queued": sum(m["queued"] for m in up),
             "running": sum(m["running"] for m in up),
+            "quarantined": sum(m.get("quarantined", 0) for m in up),
             "uptime_s": round(time.time() - self._started_at, 3),
             "pid": os.getpid(),
             "fleet": {"size": len(members), "up": len(up),
@@ -1625,6 +1823,9 @@ class RouterServer(ServeServer):
                 out = self.router.adopt(str(req.get("node") or ""),
                                         force=bool(req.get("force")))
                 return {"ok": True, "adopted": True, **out}
+            if op == "release":
+                out = self.router.release(str(req.get("key") or ""))
+                return {"ok": True, **out}
             if op == "member_add":
                 out = self.router.member_add(req.get("name"),
                                              req.get("address"),

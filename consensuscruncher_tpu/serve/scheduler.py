@@ -66,9 +66,38 @@ no tenant/qos in the spec, no targets configured — is byte-identical to
 the single-tenant scheduler: one nonempty interactive queue is plain
 FIFO and the monitor only aggregates.
 
+Failure containment (defense in depth against poison jobs and resource
+exhaustion):
+
+- **fleet retry budget** — a ``suspect`` journal marker (key, attempt
+  ordinal, node) is fsync'd BEFORE each dispatch, so replay after a
+  kill -9 can blame the job that was in flight; the per-key attempt
+  lineage is capped by ``CCT_SERVE_MAX_FLEET_ATTEMPTS`` and a job whose
+  budget is spent is **quarantined** (near-terminal, durable via a
+  ``quarantined`` marker, releasable with ``cct route --release KEY``)
+  instead of crash-looping the fleet;
+- **circuit breaker** — ``CCT_SERVE_BREAKER_QUARANTINES`` quarantines
+  within ``CCT_SERVE_BREAKER_WINDOW_S`` from one input fingerprint open
+  the breaker for that fault domain: admission refuses the fingerprint
+  early (``breaker_open`` counter + flight dump);
+- **brownout** — an OSError (ENOSPC) on the admission journal append
+  first triggers the result cache's emergency ``evict_to_budget`` sweep
+  and one retry; if the disk is still full the daemon flips into
+  read-only brownout: polls and committed cache hits are still served,
+  new admissions are refused with ``{"brownout": true}`` until an
+  append succeeds again;
+- **watermark shedding** — when queued spec bytes or process RSS
+  approach ``CCT_SERVE_QUEUE_BYTES_WATERMARK`` /
+  ``CCT_SERVE_RSS_WATERMARK_MB``, admissions shed lowest class first
+  (scavenger at 80%, batch at 90%, interactive at 100%).
+
 Fault sites: ``serve.dispatch`` (gang dispatch — jobs fall back to solo
 runs), ``serve.worker`` (per-job execution — retried via resume),
-``serve.shed`` (admission shedding — forced refusal), plus
+``serve.shed`` (admission shedding — forced refusal), ``serve.poison``
+(fires only for poison-labeled jobs — a deterministically crashing
+input without touching honest jobs), ``serve.enospc`` (disk-full on the
+journal append — brownout path), ``serve.oom`` (forces the resource
+watermark to 100% — class-ordered shedding), plus
 ``serve.journal_write`` / ``serve.journal_replay`` in :mod:`.journal`.
 """
 
@@ -108,6 +137,24 @@ class QuotaRefused(AdmissionRefused):
     """Per-tenant queue-slot or in-flight quota exceeded."""
 
 
+class BrownoutRefused(AdmissionRefused):
+    """Journal appends are failing (disk full) — the daemon is in
+    read-only brownout: polls and cache hits still served, admissions
+    refused with ``{"brownout": true}`` until an append succeeds."""
+
+
+class QuarantineRefused(AdmissionRefused):
+    """The key (or its whole fault domain, via the circuit breaker) is
+    quarantined as a poison job — the wire layer answers
+    ``{"quarantined": true, "reason": ...}`` instead of retrying."""
+
+    def __init__(self, message: str, reason: str | None = None,
+                 key: str | None = None):
+        super().__init__(message)
+        self.reason = reason or message
+        self.key = key
+
+
 class RouterFenced(RuntimeError):
     """A forward carried a router epoch below the highest this worker has
     accepted: the sender is a zombie router from before a takeover.  The
@@ -119,7 +166,7 @@ class RouterFenced(RuntimeError):
         self.epoch = int(live_epoch)
 
 
-_STATES = ("queued", "running", "done", "failed")
+_STATES = ("queued", "running", "done", "failed", "quarantined")
 
 
 class Job:
@@ -181,6 +228,13 @@ class Job:
         # describe() and the journal's done record (replay tolerates
         # absence: pre-QC journals simply leave it None)
         self.qc: dict | None = None
+        # compact-JSON size of the spec: the unit the queue-byte
+        # watermark meters (cheap, computed once at admission)
+        try:
+            self.spec_bytes = len(json.dumps(
+                self.spec, sort_keys=True, separators=(",", ":")))
+        except (TypeError, ValueError):
+            self.spec_bytes = 0
         self.submitted_t = time.monotonic()
         self.finished_t: float | None = None
 
@@ -194,6 +248,17 @@ class Job:
             "tenant": self.tenant, "qos": self.qos, "cached": self.cached,
             "qc": self.qc,
         }
+
+
+def _rss_mb() -> float | None:
+    """Process resident-set size in MB via /proc/self/statm (None where
+    procfs is unavailable — the RSS watermark simply never engages)."""
+    try:
+        with open("/proc/self/statm") as fh:
+            rss_pages = int(fh.read().split()[1])
+        return rss_pages * os.sysconf("SC_PAGE_SIZE") / 1e6
+    except (OSError, ValueError, IndexError):
+        return None
 
 
 def job_paths(spec: dict) -> dict:
@@ -536,6 +601,28 @@ class Scheduler:
         # journal's fence marker in _recover so a restart cannot be talked
         # into honoring a demoted router (0 = never fenced / no fleet HA)
         self._fence_epoch = 0
+        # ---- failure containment (poison quarantine / brownout) knobs --
+        # fleet-wide retry budget: max dispatch attempts for one key
+        # across crashes, restarts, and (via the ring view) every
+        # failover/adoption/steal path; 0 disables the budget
+        self.max_fleet_attempts = int(
+            os.environ.get("CCT_SERVE_MAX_FLEET_ATTEMPTS", "3"))
+        # circuit breaker: this many quarantines inside the window from
+        # one input fingerprint refuse that fault domain at admission
+        self.breaker_quarantines = int(
+            os.environ.get("CCT_SERVE_BREAKER_QUARANTINES", "3"))
+        self.breaker_window_s = float(
+            os.environ.get("CCT_SERVE_BREAKER_WINDOW_S", "300"))
+        # resource watermarks (0 disables): queued spec bytes, process RSS
+        self.queue_bytes_watermark = int(
+            os.environ.get("CCT_SERVE_QUEUE_BYTES_WATERMARK", "0"))
+        self.rss_watermark_mb = float(
+            os.environ.get("CCT_SERVE_RSS_WATERMARK_MB", "0"))
+        self._fleet_attempts: dict[str, int] = {}  # key -> dispatch count
+        self._quarantined: dict[str, str] = {}     # key -> reason
+        self._breaker_hits: dict[str, deque] = {}  # fingerprint -> times
+        self._breaker_open_t: dict[str, float] = {}
+        self._brownout = False
         self._thread = threading.Thread(
             target=self._loop, name="serve-dispatcher", daemon=True)
         if self._journal is not None:
@@ -550,7 +637,8 @@ class Scheduler:
         return job
 
     def submit_info(self, spec: dict,
-                    trace: dict | None = None) -> tuple[Job, bool]:
+                    trace: dict | None = None,
+                    fleet_attempts: int | None = None) -> tuple[Job, bool]:
         """Admit a job; returns ``(job, created)``.  A duplicate submit
         (same idempotency key, job still tracked) returns the existing job
         with ``created=False`` instead of double-running the work.
@@ -558,7 +646,13 @@ class Scheduler:
         ``trace`` is the inbound wire trace context (client or router
         hop): the job adopts its trace id instead of minting, and the
         submit span records a ``follows_from`` edge to the sender — the
-        causal chain survives the router hop instead of dying at it."""
+        causal chain survives the router hop instead of dying at it.
+
+        ``fleet_attempts`` is the router-carried attempt lineage for the
+        key (the ``attempts`` rider on a forwarded submit): max-merged
+        into the local count BEFORE admission, so this node's budget
+        gate — and the ``suspect`` ordinals it journals — continue the
+        fleet-wide lineage instead of granting a fresh budget."""
         for req in ("input", "output"):
             if not spec.get(req):
                 raise ValueError(f"job spec missing {req!r}")
@@ -578,13 +672,23 @@ class Scheduler:
         with obs_trace.span("serve.submit", trace_id=trace_id, link=ctx,
                             input=spec.get("input"), key=key,
                             tenant=tenant, qos=qos), self._cond:
+            if fleet_attempts:
+                self._fleet_attempts[key] = max(
+                    self._fleet_attempts.get(key, 0), int(fleet_attempts))
+            qreason = self._quarantined.get(key)
+            if qreason is not None:
+                raise QuarantineRefused(
+                    f"key {key} is quarantined: {qreason}",
+                    reason=qreason, key=key)
             existing = self._by_key.get(key)
             if existing is not None and existing in self._jobs:
                 return self._jobs[existing], False
             if self._draining:
                 raise AdmissionRefused("server is draining; not accepting jobs")
+            self._breaker_check_locked(spec, tenant, key)
             self._quota_check_locked(tenant, qos)
             self._shed_check_locked(deadline_s, tenant, qos, spec=spec)
+            self._watermark_check_locked(tenant, qos)
             self._evict_locked(time.monotonic())
             queued = self._queued_locked()
             if queued >= self.queue_bound:
@@ -601,13 +705,39 @@ class Scheduler:
                 # retry, an acknowledged-but-unjournaled one would be lost
                 # by a crash
                 try:
-                    n = self._journal.append_job(
-                        job.id, "accepted", key=job.key, spec=job.spec,
-                        deadline_s=job.deadline_s, trace_id=job.trace_id,
-                        trace=job.trace_ctx)
+                    n = self._journal_append_guarded(
+                        journal_mod.job_record(
+                            job.id, "accepted", key=job.key, spec=job.spec,
+                            deadline_s=job.deadline_s, trace_id=job.trace_id,
+                            trace=job.trace_ctx))
+                except OSError as e:
+                    # disk full (or any filesystem failure) even after the
+                    # cache's emergency eviction: flip into read-only
+                    # brownout.  A committed cache entry IS durable —
+                    # admitting a hit costs a file copy, not journal disk
+                    # — so cache hits are the one admission class a
+                    # brownout keeps serving (journal-less; their bytes
+                    # already survive a crash in the store).
+                    self._trip_brownout_locked(e)
+                    if not self._cache_shed_bypass_locked(spec, tenant, qos):
+                        self.counters.add("brownout_refusals")
+                        raise BrownoutRefused(
+                            f"journal write failed ({e}); daemon is in "
+                            "read-only brownout (polls and cache hits "
+                            "still served; admissions refused until "
+                            "appends succeed)")
+                    n = 0
                 except Exception as e:
                     raise AdmissionRefused(
                         f"journal write failed ({e}); job not accepted")
+                else:
+                    if self._brownout:
+                        # the probe append above succeeded: disk pressure
+                        # is gone, leave brownout
+                        self._brownout = False
+                        obs_flight.record("brownout_cleared")
+                        print("serve: journal append succeeded again; "
+                              "leaving brownout", file=sys.stderr, flush=True)
                 self.counters.add("journal_bytes", n)
             self._enqueue_locked(job)
             self._jobs[job.id] = job
@@ -743,6 +873,247 @@ class Scheduler:
         obs_flight.record("shed", why=why, tenant=tenant, qos=qos)
         obs_flight.dump(reason="shed")
 
+    # ------------------------------------- poison quarantine / brownout
+
+    #: watermark pressure at which each qos class starts shedding:
+    #: scavenger first, interactive only when the watermark is breached
+    _WATERMARK_SHED_AT = {"scavenger": 0.8, "batch": 0.9, "interactive": 1.0}
+
+    @staticmethod
+    def _fault_domain(spec: dict, tenant: str) -> str:
+        """Breaker fingerprint: one crashing input must trip the breaker
+        for every submit of that input regardless of output path — the
+        content digest when computable, else tenant + input path."""
+        from consensuscruncher_tpu.serve import result_cache as rc_mod
+        try:
+            digest = rc_mod.content_digest(spec or {})
+        except Exception:
+            digest = None
+        return digest or f"{tenant}:{(spec or {}).get('input')}"
+
+    def _breaker_check_locked(self, spec: dict, tenant: str,
+                              key: str) -> None:
+        """Per-fault-domain circuit breaker: a fingerprint that produced
+        ``breaker_quarantines`` quarantines inside the window is refused
+        at admission — the poison input cannot even enter the queue.  An
+        open breaker half-closes after one quiet window."""
+        if not self._breaker_open_t:
+            return
+        fp = self._fault_domain(spec, tenant)
+        opened = self._breaker_open_t.get(fp)
+        if opened is None:
+            return
+        if time.monotonic() - opened > self.breaker_window_s:
+            del self._breaker_open_t[fp]
+            return
+        reason = (f"circuit breaker open for fault domain {fp!r}: "
+                  f"{self.breaker_quarantines} quarantine(s) within "
+                  f"{self.breaker_window_s:g}s")
+        raise QuarantineRefused(reason, reason=reason, key=key)
+
+    def _breaker_note_locked(self, job: Job) -> None:
+        """Record one quarantine against the job's fault domain; open the
+        breaker when the window fills (``breaker_open`` + flight dump)."""
+        if self.breaker_quarantines <= 0:
+            return
+        fp = self._fault_domain(job.spec, job.tenant)
+        now = time.monotonic()
+        hits = self._breaker_hits.setdefault(fp, deque())
+        hits.append(now)
+        while hits and now - hits[0] > self.breaker_window_s:
+            hits.popleft()
+        if len(hits) >= self.breaker_quarantines \
+                and fp not in self._breaker_open_t:
+            self._breaker_open_t[fp] = now
+            self.counters.add("breaker_open")
+            obs_flight.record("breaker_open", fingerprint=fp,
+                              quarantines=len(hits),
+                              window_s=self.breaker_window_s)
+            obs_flight.dump(reason="breaker-open")
+
+    def _watermark_check_locked(self, tenant: str, qos: str) -> None:
+        """Resource-exhaustion shedding: when queued spec bytes or
+        process RSS approach their watermark, shed admissions lowest
+        class first (scavenger at 80%, batch at 90%, interactive only at
+        100%) so memory pressure degrades throughput before the OOM
+        killer picks for us.  ``serve.oom`` forces 100% pressure."""
+        pressure = 0.0
+        try:
+            faults.fault_point("serve.oom")
+        except faults.FaultError:
+            pressure = 1.0
+        if self.queue_bytes_watermark > 0 and pressure < 1.0:
+            qbytes = sum(j.spec_bytes
+                         for q in self._queues.values() for j in q)
+            pressure = max(pressure, qbytes / self.queue_bytes_watermark)
+        if self.rss_watermark_mb > 0 and pressure < 1.0:
+            rss = _rss_mb()
+            if rss is not None:
+                pressure = max(pressure, rss / self.rss_watermark_mb)
+        if pressure >= self._WATERMARK_SHED_AT[qos]:
+            self.counters.add("watermark_sheds")
+            self.slo.note(qos, shed=True)
+            obs_flight.record("watermark_shed", qos=qos, tenant=tenant,
+                              pressure=round(pressure, 3))
+            obs_flight.dump(reason="watermark-shed")
+            raise DeadlineShed(
+                f"shed: resource watermark at {pressure:.0%} "
+                f"(class {qos!r} sheds at "
+                f"{self._WATERMARK_SHED_AT[qos]:.0%})")
+
+    def _journal_append_guarded(self, rec: dict) -> int:
+        """Append with the ENOSPC first responder: a failed append
+        triggers one emergency result-cache eviction sweep (reclaiming
+        cache bytes is the cheapest disk on the box) and one retry
+        before the failure propagates.  ``serve.enospc`` injects the
+        disk-full OSError chaos tests arm."""
+        try:
+            faults.fault_point("serve.enospc")
+        except faults.FaultError as e:
+            raise OSError(28, f"No space left on device (injected: {e})")
+        try:
+            return self._journal.append(rec)
+        except OSError:
+            if self.result_cache is None:
+                raise
+            try:
+                evicted = self.result_cache.evict_to_budget(emergency=True)
+            except Exception:
+                evicted = []
+            for ev in evicted:
+                self.counters.add("cache_evictions")
+                self.counters.add("cache_bytes", -int(ev.get("bytes", 0)))
+            if not evicted:
+                raise
+            return self._journal.append(rec)
+
+    def _trip_brownout_locked(self, err: Exception) -> None:
+        if not self._brownout:
+            self._brownout = True
+            obs_flight.record("brownout", error=str(err))
+            obs_flight.dump(reason="brownout")
+            print(f"WARNING: serve: journal append failing ({err}); "
+                  "entering read-only brownout (polls + cache hits only)",
+                  file=sys.stderr, flush=True)
+
+    def _predispatch_locked(self, job: Job) -> bool:
+        """Budget gate + crash attribution, run just before a job's
+        dispatch record.  Quarantines the job (returns True = do NOT
+        dispatch) when its key is already quarantined or its fleet
+        attempt budget is spent; otherwise fsyncs the ``suspect`` marker
+        (key, attempt ordinal, node) FIRST, so a kill -9 during the run
+        is attributable on replay."""
+        key = job.key or ""
+        reason = self._quarantined.get(key)
+        if reason is not None:
+            job.state = "quarantined"
+            job.error = reason
+            job.finished_t = time.monotonic()
+            return True
+        attempt = self._fleet_attempts.get(key, 0) + 1
+        if self.max_fleet_attempts and attempt > self.max_fleet_attempts:
+            self.counters.add("fleet_attempts_exhausted")
+            self._quarantine_locked(
+                job, f"fleet retry budget exhausted "
+                     f"({attempt - 1}/{self.max_fleet_attempts} attempts)")
+            return True
+        self._fleet_attempts[key] = attempt
+        if self._journal is not None:
+            try:
+                n = self._journal.append_marker(
+                    "suspect", key=key, attempt=attempt,
+                    node=self.node or None)
+                self.counters.add("journal_bytes", n)
+            except Exception as e:
+                print(f"WARNING: suspect marker write failed ({e}); a "
+                      "crash during this run will not be attributable",
+                      file=sys.stderr, flush=True)
+        return False
+
+    def _quarantine_locked(self, job: Job, reason: str) -> None:
+        """Poison containment: park the job in the near-terminal
+        ``quarantined`` state — durable via a journal marker so replay
+        and zombie restarts honor it — instead of letting another
+        dispatch amplify a deterministic crasher.  Feeds the
+        per-fingerprint circuit breaker."""
+        key = job.key or ""
+        job.state = "quarantined"
+        job.error = reason
+        job.finished_t = time.monotonic()
+        self._quarantined[key] = reason
+        self.counters.add("jobs_quarantined")
+        if self._journal is not None:
+            try:
+                n = self._journal.append_marker(
+                    "quarantined", key=key, reason=reason,
+                    node=self.node or None)
+                self.counters.add("journal_bytes", n)
+            except Exception as e:
+                print(f"WARNING: quarantine marker write failed ({e}); "
+                      "the quarantine will not survive a restart",
+                      file=sys.stderr, flush=True)
+        obs_trace.event("serve.quarantine", trace_id=job.trace_id,
+                        job_id=job.id, key=key, reason=reason)
+        obs_flight.record("quarantine", job_id=job.id, key=key,
+                          reason=reason, tenant=job.tenant, qos=job.qos)
+        obs_flight.dump(reason="quarantine")
+        self._breaker_note_locked(job)
+        self._cond.notify_all()
+
+    def release_quarantine(self, key: str) -> dict:
+        """``cct route --release KEY``: lift a key's quarantine, zero its
+        fleet attempt lineage, and re-queue the parked job (if still
+        tracked).  Journaled (``quarantined`` marker with ``released``)
+        so the release survives restarts."""
+        key = str(key)
+        with self._cond:
+            reason = self._quarantined.pop(key, None)
+            if reason is None:
+                return {"released": False, "key": key}
+            self._fleet_attempts.pop(key, None)
+            if self._journal is not None:
+                try:
+                    n = self._journal.append_marker(
+                        "quarantined", key=key, released=True,
+                        node=self.node or None)
+                    self.counters.add("journal_bytes", n)
+                except Exception as e:
+                    print(f"WARNING: release marker write failed ({e}); "
+                          "the release will not survive a restart",
+                          file=sys.stderr, flush=True)
+            self.counters.add("quarantine_released")
+            job_id = self._by_key.get(key)
+            job = self._jobs.get(job_id) if job_id is not None else None
+            requeued = False
+            if job is not None and job.state == "quarantined":
+                job.state = "queued"
+                job.error = None
+                job.finished_t = None
+                job.submitted_t = time.monotonic()
+                self._enqueue_locked(job)
+                requeued = True
+                self._cond.notify_all()
+            obs_flight.record("quarantine_released", key=key,
+                              requeued=requeued)
+            return {"released": True, "key": key, "requeued": requeued}
+
+    def quarantined_keys(self) -> dict[str, str]:
+        with self._cond:
+            return dict(self._quarantined)
+
+    def fleet_attempts(self, key: str) -> int:
+        with self._cond:
+            return self._fleet_attempts.get(str(key), 0)
+
+    def note_fleet_attempts(self, key: str, attempts: int) -> None:
+        """Fold a ring-view-carried attempt count for ``key`` into the
+        local lineage (max-merge: lineages only ever grow) — how a
+        router's failover resubmit hands the budget across nodes."""
+        with self._cond:
+            key = str(key)
+            self._fleet_attempts[key] = max(
+                self._fleet_attempts.get(key, 0), int(attempts))
+
     def get(self, job_id: int) -> Job | None:
         with self._cond:
             return self._jobs.get(int(job_id))
@@ -774,7 +1145,7 @@ class Scheduler:
         deadline = None if timeout is None else time.monotonic() + timeout
         with self._cond:
             job = self._jobs[int(job_id)]
-            while job.state not in ("done", "failed"):
+            while job.state not in ("done", "failed", "quarantined"):
                 remaining = None
                 if deadline is not None:
                     remaining = deadline - time.monotonic()
@@ -853,8 +1224,13 @@ class Scheduler:
                             job_id=job.id, key=job.key, state=state)
             obs_trace.flush()
         try:
-            n = self._journal.append_job(job.id, state, **fields)
+            n = self._journal_append_guarded(
+                journal_mod.job_record(job.id, state, **fields))
         except Exception as e:
+            if isinstance(e, OSError):
+                # post-admission disk-full: durability degrades AND the
+                # admission path must stop promising it — brownout
+                self._trip_brownout_locked(e)
             print(f"WARNING: journal append ({state}, job {job.id}) "
                   f"failed: {e}", file=sys.stderr, flush=True)
             return
@@ -862,8 +1238,12 @@ class Scheduler:
         self._maybe_rotate_locked()
 
     def _snapshot_records_locked(self) -> list[dict]:
-        """One full-state record per tracked job, for checkpoint rotation."""
-        to_journal = {"queued": "accepted", "running": "dispatched"}
+        """One full-state record per tracked job, for checkpoint rotation,
+        plus the marker state rotation must not lose: the fence floor,
+        the per-key suspect lineage, and every quarantined key (a rotated
+        journal that forgot a quarantine would re-dispatch the poison)."""
+        to_journal = {"queued": "accepted", "running": "dispatched",
+                      "quarantined": "accepted"}
         recs = []
         for jid in sorted(self._jobs):
             j = self._jobs[jid]
@@ -872,6 +1252,18 @@ class Scheduler:
                 spec=j.spec, deadline_s=j.deadline_s, outputs=j.outputs,
                 error=j.error, wall_s=j.wall_s, trace_id=j.trace_id,
                 trace=j.trace_ctx))
+        if self._fence_epoch:
+            recs.append({"v": 1, "rec": "marker", "kind": "fence",
+                         "epoch": self._fence_epoch})
+        for key in sorted(self._fleet_attempts):
+            recs.append({"v": 1, "rec": "marker", "kind": "suspect",
+                         "key": key,
+                         "attempt": self._fleet_attempts[key],
+                         **({"node": self.node} if self.node else {})})
+        for key in sorted(self._quarantined):
+            recs.append({"v": 1, "rec": "marker", "kind": "quarantined",
+                         "key": key, "reason": self._quarantined[key],
+                         **({"node": self.node} if self.node else {})})
         return recs
 
     def _maybe_rotate_locked(self) -> None:
@@ -891,11 +1283,18 @@ class Scheduler:
         path, so completed stages are skipped and outputs stay
         byte-identical — exactly-once at the output level."""
         jobs, info = journal_mod.replay(self._journal.path)
-        requeued = finished = dropped = adopted = 0
+        requeued = finished = dropped = adopted = quarantined = 0
         with self._cond:
             if info.get("fence_epoch"):
                 self._fence_epoch = max(self._fence_epoch,
                                         int(info["fence_epoch"]))
+            # crash attribution survives the crash: suspect markers carry
+            # the per-key attempt lineage, quarantined markers the parked
+            # keys (max-merge / last-wins — both replay-idempotent)
+            for k, n in (info.get("suspects") or {}).items():
+                self._fleet_attempts[k] = max(
+                    self._fleet_attempts.get(k, 0), int(n))
+            self._quarantined.update(info.get("quarantined") or {})
             for jid in sorted(jobs):
                 rec = jobs[jid]
                 spec = rec.get("spec")
@@ -946,7 +1345,39 @@ class Scheduler:
                     job.qc = qc if isinstance(qc, dict) else None
                     job.finished_t = time.monotonic()
                     finished += 1
+                elif job.key in self._quarantined:
+                    # the marker said it all: the job stays parked, polls
+                    # keep answering, no dispatch until a release
+                    job.state = "quarantined"
+                    job.error = self._quarantined[job.key]
+                    job.finished_t = time.monotonic()
+                    quarantined += 1
+                elif self.max_fleet_attempts and \
+                        self._fleet_attempts.get(job.key, 0) \
+                        >= self.max_fleet_attempts:
+                    # suspect blame: this key was in flight at every one
+                    # of its budgeted attempts and the process still died
+                    # — quarantine NOW, before replay re-dispatches it
+                    self.counters.add("suspect_blames")
+                    self.counters.add("fleet_attempts_exhausted")
+                    obs_flight.record(
+                        "suspect_blamed", key=job.key, job_id=job.id,
+                        attempts=self._fleet_attempts.get(job.key, 0))
+                    self._quarantine_locked(
+                        job, f"fleet retry budget exhausted "
+                             f"({self._fleet_attempts.get(job.key, 0)}/"
+                             f"{self.max_fleet_attempts} attempts; blamed "
+                             "by replay crash attribution)")
+                    quarantined += 1
                 else:
+                    if rec.get("state") == "dispatched" \
+                            and self._fleet_attempts.get(job.key):
+                        # crash attribution: the suspect marker proves
+                        # this job was in flight when the process died
+                        self.counters.add("suspect_blames")
+                        obs_flight.record(
+                            "suspect_blamed", key=job.key, job_id=job.id,
+                            attempts=self._fleet_attempts[job.key])
                     # accepted or dispatched: not provably done -> re-run.
                     # The deadline clock restarts here — the daemon being
                     # down must not shed every queued job on every restart.
@@ -967,9 +1398,11 @@ class Scheduler:
                     requeued += 1
             self.counters.high_water("queue_depth_hwm", self._queued_locked())
             self._cond.notify_all()
-        if requeued or finished or dropped or adopted or info["skipped"]:
+        if requeued or finished or dropped or adopted or quarantined \
+                or info["skipped"]:
             print(f"serve: journal replay: {requeued} job(s) re-enqueued, "
                   f"{finished} already terminal, "
+                  f"{quarantined} quarantined, "
                   f"{adopted} adopted elsewhere, "
                   f"{dropped + info['skipped']} record(s) skipped"
                   + (" (previous shutdown was a clean drain)"
@@ -1093,6 +1526,9 @@ class Scheduler:
                 "serve", {"uptime": time.time() - self._started_at},
                 {"n_jobs": len(jobs), "queue_bound": self.queue_bound,
                  "gang_size": self.gang_size, "draining": self._draining,
+                 "brownout": self._brownout,
+                 "quarantined_keys": len(self._quarantined),
+                 "breakers_open": len(self._breaker_open_t),
                  "jobs_by_state": states},
                 cumulative=cumulative,
             )
@@ -1117,8 +1553,11 @@ class Scheduler:
     def healthz(self) -> dict:
         with self._cond:
             return {
-                "status": "draining" if self._draining else "serving",
+                "status": ("draining" if self._draining
+                           else "brownout" if self._brownout
+                           else "serving"),
                 "node": self.node,
+                "quarantined": len(self._quarantined),
                 "queued": self._queued_locked(),
                 "queued_by_class":
                     {qos: len(self._queues[qos]) for qos in QOS_CLASSES},
@@ -1195,6 +1634,11 @@ class Scheduler:
                                                     error=job.error)
                     else:
                         live.append(job)
+                # budget gate: a quarantined (or budget-exhausted) job
+                # must not reach another dispatch; survivors get their
+                # suspect marker fsync'd before any work starts
+                live = [job for job in live
+                        if not self._predispatch_locked(job)]
                 if not live:
                     self._cond.notify_all()
                     continue
@@ -1295,6 +1739,10 @@ class Scheduler:
                     tenant=job.tenant, qos=job.qos)
                 self.slo.note(job.qos, wall_s=latency)
                 job.state = outcome
+                if outcome == "done":
+                    # a finished key's attempt lineage is dead weight —
+                    # only still-failing keys keep consuming budget
+                    self._fleet_attempts.pop(job.key or "", None)
                 job.finished_t = time.monotonic()
                 self._ewma_job_s = job.wall_s if self._ewma_job_s is None \
                     else 0.8 * self._ewma_job_s + 0.2 * job.wall_s
@@ -1435,6 +1883,12 @@ class Scheduler:
             job.attempts += 1
             try:
                 faults.fault_point("serve.worker")
+                if "poison" in str(job.spec.get("name") or ""):
+                    # poison-labeled jobs only: a fleet-wide armed
+                    # ``serve.poison`` (kill/exit kinds) simulates one
+                    # deterministically crashing input without touching
+                    # honest jobs sharing the daemon
+                    faults.fault_point("serve.poison")
                 if streaming and attempt == 0:
                     rc = cli.main(self._argv(job.spec, resume=False),
                                   _sscs_handoff=handoff)

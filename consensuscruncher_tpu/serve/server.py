@@ -30,6 +30,17 @@ Causal tracing: any request may carry a ``"trace"`` context
 ``ServeClient``); the submit path links the accepted job's span tree to
 it and the ack reply echoes the job's own durable context back.
 
+Failure containment replies: a submit for a quarantined key (poison
+containment — fleet retry budget exhausted or breaker open) comes back
+``refused: true, quarantined: true`` with a human ``reason``; during a
+resource-exhaustion brownout (journal appends failing ENOSPC) fresh
+admissions reply ``refused: true, brownout: true`` while polls and
+cache-hit submits keep working.  ``{"op": "release", "key": ...}``
+lifts a key's quarantine (``cct route --release``) and re-queues the
+parked job.  A forwarded submit may carry ``"attempts"`` — the router's
+fleet attempt lineage for the key, max-merged into the scheduler's
+budget gate before admission.
+
 ``status``/``result`` accept ``"key"`` (the submit reply's idempotency
 key) in place of ``"job_id"`` — keys survive a daemon restart, ids are
 only as durable as the journal, so restart-invisible polling uses keys.
@@ -72,7 +83,8 @@ from consensuscruncher_tpu.obs import prof as obs_prof
 from consensuscruncher_tpu.obs import trace as obs_trace
 from consensuscruncher_tpu.obs.metrics import render_prometheus
 from consensuscruncher_tpu.serve.scheduler import (
-    AdmissionRefused, DeadlineShed, QuotaRefused, RouterFenced, Scheduler,
+    AdmissionRefused, BrownoutRefused, DeadlineShed, QuarantineRefused,
+    QuotaRefused, RouterFenced, Scheduler,
 )
 from consensuscruncher_tpu.utils import faults, sanitize
 
@@ -265,7 +277,7 @@ class ServeServer:
         job = obj
         timeout = req.get("timeout")
         deadline = None if timeout is None else time.monotonic() + float(timeout)
-        while job.state not in ("done", "failed"):
+        while job.state not in ("done", "failed", "quarantined"):
             if self._closed:
                 return {"ok": False, "error": "server shutting down",
                         "shutdown": True}
@@ -288,7 +300,7 @@ class ServeServer:
         op = req.get("op")
         try:
             if "epoch" in req and op in ("submit", "status", "result",
-                                         "drain"):
+                                         "drain", "release"):
                 # fleet-HA fencing: a router-forwarded request carries the
                 # sender's ring-view epoch; a stale (pre-takeover) epoch
                 # is rejected so a zombie router cannot double-dispatch.
@@ -296,8 +308,10 @@ class ServeServer:
                 # answering even to a demoted router.
                 self.scheduler.fence(req.get("epoch"), req.get("router"))
             if op == "submit":
+                attempts = req.get("attempts")
                 job, created = self.scheduler.submit_info(
-                    req.get("spec") or {}, trace=req.get("trace"))
+                    req.get("spec") or {}, trace=req.get("trace"),
+                    fleet_attempts=int(attempts) if attempts else None)
                 # the ack echoes the accepted job's durable wire trace
                 # context so the submitter (client or router) can link
                 # follow-up spans to the ack span it just caused
@@ -327,6 +341,13 @@ class ServeServer:
             if op == "drain":
                 self.scheduler.drain(timeout=req.get("timeout"))
                 return {"ok": True, "drained": True}
+            if op == "release":
+                # lift a key's quarantine (``cct route --release`` lands
+                # here through the router); fenced like submit — only
+                # the live epoch's router may re-open a poison key
+                out = self.scheduler.release_quarantine(
+                    str(req.get("key") or ""))
+                return {"ok": True, **out}
             if op == "trace":
                 # fleet trace collection: hand over this process's span
                 # buffer (flushed shard when CCT_TRACE_DIR is set, else
@@ -353,6 +374,18 @@ class ServeServer:
         except QuotaRefused as e:
             return {"ok": False, "error": str(e), "refused": True,
                     "quota": True}
+        except QuarantineRefused as e:
+            # poison containment: the key is quarantined (budget
+            # exhausted / breaker open) — a typed refusal the client
+            # must NOT retry (retrying is what poison jobs weaponize)
+            return {"ok": False, "error": str(e), "refused": True,
+                    "quarantined": True, "reason": e.reason or str(e),
+                    "key": e.key}
+        except BrownoutRefused as e:
+            # resource exhaustion, not load: admissions refuse while the
+            # daemon stays up for polls and cache hits
+            return {"ok": False, "error": str(e), "refused": True,
+                    "brownout": True}
         except AdmissionRefused as e:
             return {"ok": False, "error": str(e), "refused": True}
         except TimeoutError as e:

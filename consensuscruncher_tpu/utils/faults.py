@@ -57,6 +57,12 @@ Sites wired in this codebase (grep for ``fault_point``/``faults.hook``):
   route.router_down    standby's probe of the active router -> takeover
   route.adopt          journal adoption fails -> no tombstone, sweep retries
   route.fence          worker epoch admission -> stale router demoted
+  serve.poison         deterministic poison job -> budget-capped re-runs,
+                       then durable quarantine; honest jobs unharmed
+  serve.enospc         journal append ENOSPC -> cache evicts, retry once,
+                       then read-only brownout (polls still served)
+  serve.oom            memory watermark breach -> shed scavenger -> batch
+                       -> interactive; running jobs never killed
 
 Everything here is stdlib-only and import-cheap: io/bgzf.py and the
 tools/ scripts (whose parents must never import jax) both import it.

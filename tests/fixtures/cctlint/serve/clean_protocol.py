@@ -41,3 +41,25 @@ def append_before_ack(journal, cond, job):
         journal.append_job(job.id, "accepted", key=job.key,
                            trace_id=job.trace_id, trace=job.trace_ctx)
         cond.notify_all()
+
+
+def declared_poison_markers(journal, job):
+    # crash attribution + containment: both marker kinds are declared
+    journal.append_marker("suspect", key=job.key, attempt=2, node="w0")
+    journal.append_marker("quarantined", key=job.key,
+                          reason="fleet retry budget exhausted")
+    journal.append_marker("quarantined", key=job.key, released=True)
+
+
+def declared_quarantined_state(job):
+    job.state = "quarantined"
+
+
+def declared_containment_replies(job):
+    quarantine = {"ok": False, "refused": True, "quarantined": True,
+                  "reason": job.error, "key": job.key}
+    brownout = {"ok": False, "refused": True, "brownout": True,
+                "error": "journal append failing; read-only brownout"}
+    release = {"ok": True, "released": True, "requeued": 1,
+               "key": job.key}
+    return quarantine, brownout, release

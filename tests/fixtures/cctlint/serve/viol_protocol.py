@@ -43,3 +43,16 @@ def ack_before_append(journal, cond, job):
         # CCT705: acknowledging waiters before the record is durable
         cond.notify_all()
         journal.append_job(job.id, "accepted", key=job.key)
+
+
+def undeclared_suspect_spelling(journal, job):
+    # CCT702: "suspected" is a near-miss of the declared ``suspect``
+    # marker kind — the crash-attribution vocabulary is closed
+    journal.append_marker("suspected", key=job.key, attempt=1)
+
+
+def undeclared_quarantine_reply_key(job):
+    # CCT703: "quarantine" (wrong singular) is not a wire reply key;
+    # the poison verdict travels as ``quarantined`` + ``reason``
+    return {"ok": False, "refused": True, "quarantine": True,
+            "why": job.error}

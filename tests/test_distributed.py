@@ -14,7 +14,16 @@ import socket
 import subprocess
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Some jaxlib builds (including the CPU wheel baked into the CI image)
+# ship without cross-process collectives on the CPU backend: the worker
+# dies with this exact XlaRuntimeError at the first psum.  That is a
+# missing platform capability, not a regression in the mesh code — skip
+# with the reason instead of failing; any OTHER worker error still fails.
+_UNSUPPORTED = "Multiprocess computations aren't implemented on the CPU backend"
 
 
 def _free_port() -> int:
@@ -56,6 +65,8 @@ def test_two_process_global_mesh_psum():
     try:
         for p in procs:
             out, err = p.communicate(timeout=240)
+            if p.returncode != 0 and _UNSUPPORTED in err:
+                pytest.skip(f"jaxlib on this image: {_UNSUPPORTED}")
             assert p.returncode == 0, f"worker failed:\nstdout={out}\nstderr={err[-2000:]}"
             results.append(json.loads(out.strip().splitlines()[-1]))
     finally:

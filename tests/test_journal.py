@@ -54,7 +54,7 @@ def test_append_replay_round_trip_merges_by_id(tmp_path):
     assert sorted(jobs) == [1, 2]
     assert info == {"records": 4, "skipped": 0, "torn_tail": False,
                     "clean_drain": False, "adopted_by": None,
-                    "fence_epoch": None}
+                    "fence_epoch": None, "suspects": {}, "quarantined": {}}
     # later records merged over earlier: state advanced, spec retained
     assert jobs[1]["state"] == "done"
     assert jobs[1]["spec"] == spec

@@ -1,0 +1,518 @@
+"""Poison-job containment: fleet retry budgets, quarantine, brownout.
+
+The robustness story under test: a deterministically crashing ("poison")
+submission must not take the fleet down or starve honest jobs.  A
+``suspect`` journal marker written BEFORE each dispatch makes a kill -9
+attributable on replay; a fleet-wide per-key attempt lineage (carried in
+the ring view and on forwarded submits) caps the re-runs at
+``CCT_SERVE_MAX_FLEET_ATTEMPTS``; past the budget the key is parked in a
+durable, releasable ``quarantined`` state; a per-fingerprint circuit
+breaker refuses a crashing fault domain at admission; and resource
+exhaustion (disk-full journal, memory watermarks) degrades to read-only
+brownout / class-ordered shedding instead of an OOM-killed daemon.
+
+Chaos sites armed here (cctlint CCT301-303): ``serve.poison``,
+``serve.enospc``, ``serve.oom``.
+"""
+
+import json
+import os
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "test"))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+from make_test_data import canonical_bam_digest, text_digest  # noqa: E402
+
+from consensuscruncher_tpu.serve.client import (
+    JobQuarantined, ServeClient, ServeClientError,
+)
+from consensuscruncher_tpu.serve.journal import (
+    Journal, idempotency_key, replay,
+)
+from consensuscruncher_tpu.serve.result_cache import ResultCache
+from consensuscruncher_tpu.serve.scheduler import (
+    BrownoutRefused, DeadlineShed, QuarantineRefused, Scheduler,
+)
+from consensuscruncher_tpu.serve.server import ServeServer
+
+DATA = os.path.join(REPO, "test", "data")
+SAMPLE = os.path.join(DATA, "sample.bam")
+GOLDEN = json.load(open(os.path.join(REPO, "test", "golden.json")))
+
+
+def _spec(output, name="golden", **over):
+    spec = {
+        "input": SAMPLE, "output": str(output), "name": name,
+        "cutoff": 0.7, "qualscore": 0, "scorrect": True,
+        "max_mismatch": 0, "bdelim": "|", "compress_level": 6,
+    }
+    spec.update(over)
+    return spec
+
+
+def _digests(base):
+    return {rel: (canonical_bam_digest(os.path.join(str(base), rel))
+                  if rel.endswith(".bam")
+                  else text_digest(os.path.join(str(base), rel)))
+            for rel in GOLDEN["consensus"]}
+
+
+# ------------------------------------------- budget gate + suspect markers
+
+def test_predispatch_budget_journals_suspects_then_quarantines(
+        tmp_path, monkeypatch):
+    """Every dispatch fsyncs a ``suspect`` marker (key, attempt ordinal,
+    node) FIRST; the attempt past the fleet budget never dispatches —
+    it quarantines, durably."""
+    monkeypatch.setenv("CCT_SERVE_MAX_FLEET_ATTEMPTS", "2")
+    jp = str(tmp_path / "wal")
+    sched = Scheduler(start=False, paused=True, journal=Journal(jp),
+                      node="w0")
+    job = sched.submit(_spec(tmp_path / "a", name="poison-input"))
+    with sched._cond:
+        assert sched._predispatch_locked(job) is False  # attempt 1
+        assert sched._predispatch_locked(job) is False  # attempt 2
+        assert sched._predispatch_locked(job) is True   # budget spent
+    assert job.state == "quarantined"
+    assert "fleet retry budget exhausted" in job.error
+    snap = sched.counters.snapshot()
+    assert snap["jobs_quarantined"] == 1
+    assert snap["fleet_attempts_exhausted"] == 1
+    # an already-quarantined key is parked again without a new marker
+    job2 = object.__new__(type(job))
+    job2.__dict__.update(job.__dict__)
+    job2.state = "queued"
+    with sched._cond:
+        assert sched._predispatch_locked(job2) is True
+    assert job2.state == "quarantined"
+    sched._journal.close()
+    jobs, info = replay(jp)
+    # the max journaled suspect ordinal never exceeds the budget
+    assert info["suspects"] == {job.key: 2}
+    assert list(info["quarantined"]) == [job.key]
+    assert "fleet retry budget exhausted" in info["quarantined"][job.key]
+
+
+def test_quarantine_refused_on_wire_and_answered_by_polls(tmp_path):
+    """A quarantined key refuses new submits with ``{"quarantined":
+    true, "reason": ...}`` and answers status/result polls with the
+    near-terminal state (no blocking wait)."""
+    sched = Scheduler(start=False, paused=True)
+    job = sched.submit(_spec(tmp_path / "a"))
+    with sched._cond:
+        sched._quarantine_locked(job, "test poison verdict")
+    server = ServeServer(sched, port=0)
+    try:
+        r = server._dispatch({"op": "submit",
+                              "spec": _spec(tmp_path / "a")})
+        assert r["ok"] is False and r["refused"] is True
+        assert r["quarantined"] is True
+        assert r["reason"] == "test poison verdict"
+        assert r["key"] == job.key
+        for op in ("status", "result"):
+            p = server._dispatch({"op": op, "key": job.key})
+            assert p["ok"] is True
+            assert p["job"]["state"] == "quarantined"
+            assert p["job"]["error"] == "test poison verdict"
+    finally:
+        server.close(timeout=2)
+
+
+def test_client_raises_typed_job_quarantined_never_retries(tmp_path):
+    """ServeClient surfaces the verdict as :class:`JobQuarantined` — a
+    subclass of ServeClientError that the retry loop treats as final
+    (a quarantine is an operator decision, not a transient)."""
+    sched = Scheduler(start=False, paused=True)
+    job = sched.submit(_spec(tmp_path / "a"))
+    with sched._cond:
+        sched._quarantine_locked(job, "poisoned input")
+    server = ServeServer(sched, port=0)
+    server.start()
+    try:
+        client = ServeClient(server.address, retries=50, retry_base_s=5.0)
+        t0 = time.monotonic()
+        with pytest.raises(JobQuarantined) as ei:
+            client.submit_full(_spec(tmp_path / "a"))
+        # 50 retries at 5 s base would take minutes: the immediate raise
+        # proves the verdict was not treated as retryable
+        assert time.monotonic() - t0 < 2.0
+        assert ei.value.reason == "poisoned input"
+        assert ei.value.key == job.key
+        assert isinstance(ei.value, ServeClientError)
+    finally:
+        server.close(timeout=2)
+
+
+def test_release_quarantine_requeues_and_is_durable(tmp_path):
+    """``release_quarantine`` lifts the verdict, zeroes the fleet
+    lineage, requeues the parked job, and journals the release so a
+    restart does not resurrect the quarantine."""
+    jp = str(tmp_path / "wal")
+    sched = Scheduler(start=False, paused=True, journal=Journal(jp))
+    job = sched.submit(_spec(tmp_path / "a"))
+    with sched._cond:
+        sched._fleet_attempts[job.key] = 3
+        sched._quarantine_locked(job, "poison verdict")
+    out = sched.release_quarantine(job.key)
+    assert out == {"released": True, "key": job.key, "requeued": 1}
+    assert job.state == "queued"
+    assert sched.fleet_attempts(job.key) == 0
+    assert sched.quarantined_keys() == {}
+    assert sched.counters.snapshot()["quarantine_released"] == 1
+    # releasing a non-quarantined key is a clean no-op
+    assert sched.release_quarantine("nope")["released"] is False
+    sched._journal.close()
+    _, info = replay(jp)
+    assert info["quarantined"] == {}  # the released marker won
+    # a fresh scheduler on the same journal starts unquarantined
+    sched2 = Scheduler(start=False, paused=True, journal=Journal(jp))
+    assert sched2.quarantined_keys() == {}
+    sched2._journal.close()
+
+
+def test_replay_blames_suspect_and_quarantines_repeat_offender(
+        tmp_path, monkeypatch):
+    """Crash attribution: a key whose suspect lineage already reached
+    the budget is quarantined DURING recovery, before replay can hand
+    the poison another dispatch."""
+    monkeypatch.setenv("CCT_SERVE_MAX_FLEET_ATTEMPTS", "2")
+    jp = str(tmp_path / "wal")
+    spec = _spec(tmp_path / "a", name="poison-input")
+    key = idempotency_key(spec)
+    j = Journal(jp)
+    j.append_job(7, "accepted", key=key, spec=spec)
+    j.append_marker("suspect", key=key, attempt=1, node="w0")
+    j.append_job(7, "dispatched", key=key)
+    j.append_marker("suspect", key=key, attempt=2, node="w0")
+    j.close()
+    sched = Scheduler(start=False, paused=True, journal=Journal(jp))
+    job = sched._jobs[7]
+    assert job.state == "quarantined"
+    assert "blamed" in job.error
+    snap = sched.counters.snapshot()
+    assert snap["suspect_blames"] == 1
+    assert snap["jobs_quarantined"] == 1
+    # nothing queued: the poison never reaches another dispatch
+    assert sched._queued_locked() == 0
+    with pytest.raises(QuarantineRefused):
+        sched.submit(dict(spec))
+    sched._journal.close()
+
+
+# --------------------------------------- torn / duplicate marker replay
+
+def test_marker_torn_write_replay_recovers_at_every_byte(tmp_path):
+    """The suspect/quarantined markers get the same torn-write proof as
+    the ring view: truncate the journal at EVERY byte boundary and
+    assert replay recovers exactly the fully-committed marker fold —
+    never a crash, never a half-parsed marker winning."""
+    jp = str(tmp_path / "wal")
+    spec = _spec(tmp_path / "a")
+    key = idempotency_key(spec)
+    j = Journal(jp)
+    j.append_job(1, "accepted", key=key, spec=spec)
+    j.append_marker("suspect", key=key, attempt=1, node="w0")
+    j.append_marker("suspect", key=key, attempt=2, node="w1")
+    j.append_marker("quarantined", key=key, reason="poison", node="w1")
+    j.append_marker("quarantined", key=key, released=True, node="w1")
+    j.close()
+    raw = open(jp, "rb").read()
+
+    def fold(records):
+        suspects: dict = {}
+        quarantined: dict = {}
+        for rec in records:
+            if rec.get("rec") != "marker" or not rec.get("key"):
+                continue
+            if rec.get("kind") == "suspect":
+                suspects[rec["key"]] = max(suspects.get(rec["key"], 0),
+                                           int(rec.get("attempt") or 0))
+            elif rec.get("kind") == "quarantined":
+                if rec.get("released"):
+                    quarantined.pop(rec["key"], None)
+                else:
+                    quarantined[rec["key"]] = str(rec.get("reason")
+                                                  or "quarantined")
+        return suspects, quarantined
+
+    for cut in range(len(raw) + 1):
+        torn = str(tmp_path / "torn")
+        with open(torn, "wb") as fh:
+            fh.write(raw[:cut])
+        committed = []
+        for line in raw[:cut].split(b"\n"):
+            if not line.strip():
+                continue
+            try:
+                committed.append(json.loads(line))
+            except ValueError:
+                pass  # the torn tail: replay must skip it, not crash
+        want_suspects, want_quarantined = fold(committed)
+        _, info = replay(torn)
+        assert info["suspects"] == want_suspects, f"cut={cut}"
+        assert info["quarantined"] == want_quarantined, f"cut={cut}"
+
+
+def test_duplicate_markers_fold_idempotently(tmp_path):
+    """Replay of duplicated markers (a crash between append and ack can
+    produce them) folds last-wins per key: double-quarantine is one
+    quarantine, re-quarantine after a release sticks, and suspect
+    ordinals max-merge instead of summing."""
+    jp = str(tmp_path / "wal")
+    j = Journal(jp)
+    for _ in range(2):  # duplicated suspect: max-merge, not a sum
+        j.append_marker("suspect", key="k", attempt=2, node="w0")
+    j.append_marker("suspect", key="k", attempt=1, node="w1")  # stale
+    for _ in range(3):  # duplicated quarantine folds to one entry
+        j.append_marker("quarantined", key="k", reason="poison")
+    j.close()
+    _, info = replay(jp)
+    assert info["suspects"] == {"k": 2}
+    assert info["quarantined"] == {"k": "poison"}
+    j = Journal(jp)
+    j.append_marker("quarantined", key="k", released=True)
+    j.append_marker("quarantined", key="k", reason="again")
+    j.close()
+    _, info = replay(jp)
+    assert info["quarantined"] == {"k": "again"}  # re-quarantine sticks
+
+
+# --------------------------------------------------- circuit breaker
+
+def test_breaker_opens_after_quarantines_in_window(tmp_path, monkeypatch):
+    """N quarantines inside the window from one input fingerprint open
+    the breaker: the fault domain is refused AT ADMISSION, and the
+    breaker half-closes after a quiet window."""
+    monkeypatch.setenv("CCT_SERVE_BREAKER_QUARANTINES", "2")
+    monkeypatch.setenv("CCT_SERVE_BREAKER_WINDOW_S", "60")
+    sched = Scheduler(start=False, paused=True)
+    # distinct output paths = distinct idempotency keys, but one shared
+    # fault domain (the content digest ignores the output path)
+    for i in range(2):
+        job = sched.submit(_spec(tmp_path / f"v{i}"))
+        with sched._cond:
+            sched._quarantine_locked(job, f"poison {i}")
+    assert sched.counters.snapshot()["breaker_open"] == 1
+    # same input fingerprint, fresh key: refused before entering the queue
+    queued_before = sched._queued_locked()
+    with pytest.raises(QuarantineRefused, match="circuit breaker open"):
+        sched.submit(_spec(tmp_path / "v9"))
+    assert sched._queued_locked() == queued_before
+    # a different input fingerprint is NOT collateral damage
+    other = sched.submit(_spec(tmp_path / "v11", name="other"))
+    assert other.state == "queued"
+    # a quiet window half-closes the breaker
+    fp = next(iter(sched._breaker_open_t))
+    sched._breaker_open_t[fp] = time.monotonic() - 120.0
+    job = sched.submit(_spec(tmp_path / "v10"))
+    assert job.state == "queued"
+
+
+# ------------------------------------------ chaos: serve.enospc brownout
+
+def test_chaos_enospc_trips_read_only_brownout_then_clears(
+        tmp_path, monkeypatch, capfd):
+    """Arm ``serve.enospc=fail@1``: the disk-full journal append flips
+    the daemon into read-only brownout — the admission is refused with
+    ``{"brownout": true}``, polls still answer — and the next
+    successful append clears it."""
+    sched = Scheduler(start=False, paused=True,
+                      journal=Journal(str(tmp_path / "wal")))
+    ok = sched.submit(_spec(tmp_path / "pre"))  # journaled before the fault
+    server = ServeServer(sched, port=0)
+    monkeypatch.setenv("CCT_FAULTS", "serve.enospc=fail@1")
+    r = server._dispatch({"op": "submit", "spec": _spec(tmp_path / "a")})
+    monkeypatch.delenv("CCT_FAULTS")
+    assert r["ok"] is False and r["refused"] is True
+    assert r["brownout"] is True
+    assert "read-only brownout" in capfd.readouterr().err
+    assert sched._brownout is True
+    assert sched.counters.snapshot()["brownout_refusals"] == 1
+    assert sched.healthz()["status"] == "brownout"
+    assert sched.metrics()["brownout"] is True
+    # read path stays up through the brownout
+    p = server._dispatch({"op": "status", "key": ok.key})
+    assert p["ok"] is True and p["job"]["state"] == "queued"
+    # disk pressure gone: the next append succeeds and clears the brownout
+    r2 = server._dispatch({"op": "submit", "spec": _spec(tmp_path / "b")})
+    assert r2["ok"] is True
+    assert sched._brownout is False
+    assert sched.healthz()["status"] == "serving"
+    server.close(timeout=2)
+    sched._journal.close()
+
+
+def test_enospc_first_responder_evicts_cache_then_retries(tmp_path):
+    """The ENOSPC first responder: a failed journal append triggers one
+    emergency result-cache eviction (cache bytes are re-computable, so
+    they are the cheapest disk on the box) and one retry before the
+    failure propagates.  ``emergency=True`` evicts the oldest half even
+    with no byte budget configured."""
+    cache = ResultCache(str(tmp_path / "cache"), node="w0")
+    for i in range(4):
+        base = tmp_path / f"out{i}"
+        base.mkdir()
+        (base / "payload.txt").write_text(f"entry {i}\n")
+        assert cache.insert(f"{i:02d}cafe{i}", str(base)) is not None
+        time.sleep(0.02)  # distinct mtimes: eviction order is oldest-first
+    assert cache.evict_to_budget() == []  # no budget, no emergency: no-op
+    evicted = cache.evict_to_budget(emergency=True)
+    assert len(evicted) == 2  # oldest half
+    assert [e["digest"] for e in evicted] == ["00cafe0", "01cafe1"]
+    assert cache.lookup("00cafe0") is None
+    assert cache.lookup("03cafe3") is not None
+    # at least one entry goes even when "half" rounds to zero
+    cache.evict_to_budget(emergency=True)
+    evicted = cache.evict_to_budget(emergency=True)
+    assert len(evicted) == 1
+
+
+# ----------------------------------------- chaos: serve.oom watermarks
+
+def test_watermark_sheds_lowest_class_first(tmp_path, monkeypatch):
+    """Between the scavenger (80%) and batch (90%) shed points only the
+    scavenger class is refused — resource pressure degrades throughput
+    class by class, not all at once."""
+    sched = Scheduler(start=False, paused=True)
+    filler = sched.submit(_spec(tmp_path / "fill"))
+    with sched._cond:
+        qbytes = sum(j.spec_bytes for q in sched._queues.values()
+                     for j in q)
+    assert filler.spec_bytes > 0 and qbytes >= filler.spec_bytes
+    sched.queue_bytes_watermark = int(qbytes / 0.85)  # pressure ~= 85%
+    with pytest.raises(DeadlineShed, match="resource watermark"):
+        sched.submit(_spec(tmp_path / "s", name="s", qos="scavenger"))
+    assert sched.counters.snapshot()["watermark_sheds"] == 1
+    job = sched.submit(_spec(tmp_path / "b", name="b", qos="batch"))
+    assert job.state == "queued"
+
+
+def test_chaos_oom_fault_sheds_even_interactive(tmp_path, monkeypatch):
+    """Arm ``serve.oom=fail@1``: forced 100% pressure sheds even the
+    interactive class once, then admission recovers."""
+    sched = Scheduler(start=False, paused=True)
+    monkeypatch.setenv("CCT_FAULTS", "serve.oom=fail@1")
+    with pytest.raises(DeadlineShed, match="resource watermark at 100%"):
+        sched.submit(_spec(tmp_path / "a"))
+    monkeypatch.delenv("CCT_FAULTS")
+    assert sched.counters.snapshot()["watermark_sheds"] == 1
+    assert sched.submit(_spec(tmp_path / "a")).state == "queued"
+
+
+# -------------------------------------------- chaos: serve.poison e2e
+
+def test_chaos_poison_job_quarantined_honest_job_golden(
+        tmp_path, monkeypatch):
+    """Arm ``serve.poison=fail@99`` with a 2-attempt fleet budget: the
+    poison-named submission burns its budget (each dispatch journals a
+    suspect marker first), lands in durable quarantine, and further
+    submits of the key are refused — while an honest job admitted
+    alongside completes with outputs byte-identical to the goldens."""
+    monkeypatch.setenv("CCT_SERVE_MAX_FLEET_ATTEMPTS", "2")
+    monkeypatch.setenv("CCT_FAULTS", "serve.poison=fail@99")
+    jp = str(tmp_path / "wal")
+    sched = Scheduler(queue_bound=8, gang_size=1, backend="tpu",
+                      result_ttl_s=0.0, journal=Journal(jp), node="w0")
+    try:
+        poison_spec = _spec(tmp_path / "bad", name="poison")
+        honest = sched.submit(_spec(tmp_path / "good"))
+        # the honest job is untouched by the poison churn behind it
+        assert sched.wait(honest.id, timeout=600).state == "done", \
+            honest.error
+        failures = 0
+        for _ in range(4):  # resubmit loop = the fleet's redispatch paths
+            try:
+                job = sched.submit(dict(poison_spec))
+            except QuarantineRefused:
+                break
+            sched.wait(job.id, timeout=120)
+            if job.state == "quarantined":
+                break
+            assert job.state == "failed" and "FaultError" in job.error
+            failures += 1
+            sched.evict_now()  # retire the failed attempt so resubmit
+        else:                  # creates a fresh job (router redispatch)
+            raise AssertionError("poison key never quarantined")
+        assert failures == 2  # exactly the budget, not one run more
+        key = idempotency_key(poison_spec)
+        assert "fleet retry budget exhausted" in \
+            sched.quarantined_keys()[key]
+        with pytest.raises(QuarantineRefused):
+            sched.submit(dict(poison_spec))
+    finally:
+        monkeypatch.delenv("CCT_FAULTS")
+        sched.close(timeout=120)
+        sched._journal.close()
+    got = _digests(tmp_path / "good" / "golden")
+    assert got == GOLDEN["consensus"]
+    _, info = replay(jp)
+    assert info["suspects"][idempotency_key(poison_spec)] <= 2
+    assert idempotency_key(poison_spec) in info["quarantined"]
+
+
+# ------------------------------------------------- router fleet budget
+
+def test_router_budget_lineage_release_and_wire(tmp_path, monkeypatch):
+    """The router side of the lineage: forwarded submits carry the
+    ``attempts`` rider (max-merged by the worker), redispatch paths
+    spend against one fleet-wide budget, the spent-out refusal is a
+    quarantined reply, and ``release`` fans out to the members and
+    resets the ring-carried lineage."""
+    from consensuscruncher_tpu.serve.router import Router, RouterServer
+
+    monkeypatch.setenv("CCT_SERVE_MAX_FLEET_ATTEMPTS", "2")
+    socks = {n: str(tmp_path / f"{n}.sock") for n in ("a", "b")}
+    scheds = {n: Scheduler(start=False, paused=True) for n in socks}
+    servers = {n: ServeServer(scheds[n], socket_path=socks[n])
+               for n in socks}
+    for srv in servers.values():
+        srv.start()
+    router = Router(list(socks.items()), start_monitor=False)
+    rserver = RouterServer(router, socket_path=str(tmp_path / "r.sock"))
+    try:
+        spec = _spec(tmp_path / "out")
+        key = idempotency_key(spec)
+        # prior fleet history rides the submit: the worker's gate
+        # continues the count instead of granting a fresh budget
+        with router._lock:
+            router._attempts[key] = 1
+        sub = router.submit(spec)
+        assert sub["ok"] is True
+        owner = scheds[sub["node"]]
+        assert owner.fleet_attempts(key) == 1
+        # spending past the budget refuses with the quarantined verdict
+        assert router._budget_spend(key, "steal", strict=False) is True
+        assert router._budget_spend(key, "steal", strict=False) is False
+        with pytest.raises(ServeClientError) as ei:
+            router._budget_spend(key, "failover resubmit")
+        assert ei.value.reply["quarantined"] is True
+        assert ei.value.reply["key"] == key
+        assert router.counters.snapshot()["fleet_attempts_exhausted"] == 2
+        # quarantine on the owner; release fans out and resets lineage
+        job = owner._jobs[sub["job_id"]]
+        with owner._cond:
+            owner._quarantine_locked(job, "poison")
+        out = rserver._dispatch({"op": "release", "key": key})
+        assert out["ok"] is True and out["released"] is True
+        assert out["node"] == sub["node"]
+        assert owner.quarantined_keys() == {}
+        assert router._attempts_snapshot() == {}
+        assert router.counters.snapshot()["quarantine_released"] == 1
+        # a key nobody quarantined reports released: false
+        miss = rserver._dispatch({"op": "release", "key": "nope"})
+        assert miss["ok"] is True and miss["released"] is False
+        # an honest key observed done drops its lineage (no unbounded map)
+        with router._lock:
+            router._attempts["done-key"] = 1
+        router._prune_attempts("done-key", {"job": {"state": "done"}})
+        assert "done-key" not in router._attempts_snapshot()
+    finally:
+        rserver.close(timeout=5)
+        router.close()
+        for n in socks:
+            servers[n].close(timeout=5)

@@ -82,4 +82,18 @@ FAULT_SITES: dict[str, str] = {
     "serve.cache": "content-addressed result-cache lookup/insert fails -> "
                    "degrade to a plain recompute miss (a broken cache can "
                    "slow the fleet down, never wrong or wedge it)",
+    "serve.poison": "deterministic poison job (fires on specs named "
+                    "*poison*) -> crash attribution via pre-dispatch "
+                    "suspect markers, fleet retry budget caps the "
+                    "re-runs, then the key is durably quarantined while "
+                    "honest jobs complete byte-identical",
+    "serve.enospc": "journal append hits disk-full (injected OSError "
+                    "ENOSPC) -> result cache evicts as first responder, "
+                    "one retry, then read-only brownout: polls and "
+                    "cache hits served, admissions refused with "
+                    "brownout:true, auto-cleared when appends succeed",
+    "serve.oom": "resource watermark probe reports memory exhaustion -> "
+                 "admission sheds scavenger, then batch, then "
+                 "interactive (watermark_sheds counter + flight dump); "
+                 "running jobs are never killed",
 }

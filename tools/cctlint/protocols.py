@@ -34,11 +34,18 @@ JOURNAL_STATES = ("accepted", "dispatched", "done", "failed")
 
 # States the in-memory Job/scheduler layer may assign (``job.state = X``
 # or status replies).  ``expired`` only appears in replies for evicted
-# jobs, never in the journal.
-RUNTIME_STATES = ("queued", "running", "done", "failed", "expired")
+# jobs, never in the journal.  ``quarantined`` is the near-terminal
+# poison-job state: durable via the ``quarantined`` *marker* (the job
+# record itself stays non-terminal so a release can re-queue it).
+RUNTIME_STATES = ("queued", "running", "done", "failed", "expired",
+                  "quarantined")
 
 # runtime -> journal state mapping used by rotation snapshots + replay.
-RUNTIME_TO_JOURNAL = {"queued": "accepted", "running": "dispatched"}
+# ``quarantined`` snapshots as ``accepted``: durability of the poison
+# verdict lives in the ``quarantined`` marker, so a released key replays
+# straight back into the queue without a journal-state rewrite.
+RUNTIME_TO_JOURNAL = {"queued": "accepted", "running": "dispatched",
+                      "quarantined": "accepted"}
 
 # Terminal journal states: once written for a job id, no later record
 # may move that id to a *different* state ("no terminal-state rewrite").
@@ -58,9 +65,15 @@ JOURNAL_TRANSITIONS = {
 
 # Marker kinds (``rec: "marker"``): drain boundary, adoption tombstone
 # (router resubmitted every non-terminal job elsewhere), fence floor,
-# and the router's journaled-before-ack result-cache answers (replayed
-# at construction so a killed router re-answers the same keys).
-MARKER_KINDS = ("drain", "adopted", "fence", "cache_answer")
+# the router's journaled-before-ack result-cache answers (replayed
+# at construction so a killed router re-answers the same keys),
+# ``suspect`` (crash attribution: journaled BEFORE each dispatch with
+# key + fleet attempt ordinal + node, so replay after kill -9 can blame
+# the in-flight job), and ``quarantined`` (poison-job containment:
+# key + reason; ``released: true`` re-opens the key — replay folds
+# last-wins per key, so duplicates are idempotent).
+MARKER_KINDS = ("drain", "adopted", "fence", "cache_answer",
+                "suspect", "quarantined")
 
 # ---------------------------------------------------------- ring view --
 #
@@ -69,7 +82,11 @@ MARKER_KINDS = ("drain", "adopted", "fence", "cache_answer")
 # optional (members' journal paths for adoption).
 
 RING_VIEW_REQUIRED = ("v", "epoch", "router", "address", "members", "t")
-RING_VIEW_OPTIONAL = ("journals", "warm")
+# ``attempts`` is the fleet-wide per-key attempt lineage (key -> count):
+# failover resubmit, adoption, and work stealing on ANY router consult
+# and re-publish it, so the CCT_SERVE_MAX_FLEET_ATTEMPTS budget holds
+# across zombie routers, not just within one process.
+RING_VIEW_OPTIONAL = ("journals", "warm", "attempts")
 
 # ---------------------------------------------------------- wire -------
 #
@@ -101,6 +118,11 @@ WIRE_REPLY_KEYS = frozenset({
     # result-cache answers: the ack (and the polled job doc) says the
     # bytes came from the content-addressed store, not a fresh run
     "cached",
+    # poison containment: ``quarantined`` (+ human ``reason``) marks a
+    # key whose fleet retry budget is exhausted or whose fault domain
+    # tripped the breaker; ``brownout`` marks a refusal caused by
+    # resource exhaustion (disk-full journal) rather than load
+    "quarantined", "reason", "brownout", "released", "requeued",
 })
 
 # ---------------------------------------------------------- helpers ----

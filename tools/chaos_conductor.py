@@ -18,6 +18,18 @@ drives it through a seeded random schedule of faults:
   decommission_member kill + adopt + member_remove the grown member
   arm_fault           arm a route.*/serve.* fault site (CCT_FAULTS) on
                       the next respawned router/worker
+  poison_submit       submit a deterministically crashing job (the fleet
+                      is armed with ``serve.poison=exit`` and a fleet
+                      retry budget of 3): every dispatch kills its
+                      worker, the conductor plays supervisor restarting
+                      it on the same journal, and crash attribution must
+                      blame + QUARANTINE the key within the budget while
+                      honest jobs sharing the fleet stay unharmed
+  disk_full           restart a worker with ``serve.enospc`` armed: its
+                      journal appends raise ENOSPC, the daemon must
+                      answer ``brownout`` refusals (read-only, polls
+                      still served) instead of dying, then clear the
+                      brownout and serve again once appends succeed
   status_sweep        poll a sample of acknowledged jobs by key
 
 After EVERY event the invariants are re-checked:
@@ -35,15 +47,17 @@ After EVERY event the invariants are re-checked:
 At the end every dead-but-not-permanent worker is restarted, every
 acknowledged job is driven to ``done``, and every output tree is
 digest-compared against the frozen ``test/golden.json`` — byte
-identity, not just success.  Exit 0 means all invariants held.
+identity, not just success.  The poison key must end ``quarantined``
+with its journaled suspect lineage never exceeding the fleet retry
+budget.  Exit 0 means all invariants held.
 
   python tools/chaos_conductor.py --workdir /tmp/chaos --seed 7 --events 30
   python tools/chaos_conductor.py --workdir /tmp/chaos --smoke
 
 Deterministic given ``--seed`` (modulo OS scheduling).  ``--smoke`` is
 the fixed-seed short leg ``tools/ci_check.sh`` runs: fewer events, but
-the structural ones (failover, adoption, zombie, membership) are always
-in the schedule.  Shares :func:`serve_soak.job_spec` /
+the structural ones (failover, adoption, zombie, membership, poison,
+disk-full) are always in the schedule.  Shares :func:`serve_soak.job_spec` /
 :func:`serve_soak.check_golden` / :data:`serve_soak.BOOT` with the
 single-daemon soak so there is one source of truth for the golden
 contract.
@@ -67,13 +81,23 @@ sys.path.insert(0, os.path.join(_REPO, "test"))
 
 import trace_check  # noqa: E402
 from consensuscruncher_tpu.obs import trace as obs_trace  # noqa: E402
-from consensuscruncher_tpu.serve.client import ServeClient  # noqa: E402
+from consensuscruncher_tpu.serve.client import (  # noqa: E402
+    JobQuarantined, ServeClient, ServeClientError)
 from serve_soak import BOOT, check_golden, job_spec  # noqa: E402
 
 WORKER_FAULTS = ("serve.worker=fail@1", "serve.dispatch=fail@1",
                  "serve.cache=fail@1")
 ROUTER_FAULTS = ("route.member_down=fail@1", "route.resubmit=fail@1",
                  "route.steal=fail@1", "route.adopt=fail@1")
+# every worker spawn arms the poison site (it only fires for jobs whose
+# NAME contains "poison", so honest jobs never see it) and the whole
+# fleet runs under one small retry budget so the poison_submit event's
+# kill/restart loop is bounded.  3 (the production default) rather than
+# 2: honest jobs share the budget, and this schedule kill -9s workers on
+# purpose — a tighter cap could blame an honest job the conductor itself
+# crashed twice mid-flight.
+POISON_FAULT = "serve.poison=exit@99"
+FLEET_ATTEMPT_BUDGET = 3
 
 
 def read_ring_view(path: str) -> dict | None:
@@ -150,6 +174,9 @@ class Conductor:
             for rid in ("r0", "r1")
         }
         self.acked: list[dict] = []       # {"key", "out", "spec"}
+        self.poison: dict | None = None   # {"key", "out"} once submitted
+        self.brownouts_seen = 0
+        self.quarantines_seen = 0
         self.last_epoch = 0
         self.takeovers_seen = 0
         self.adoptions_seen = 0
@@ -179,6 +206,9 @@ class Conductor:
         env.pop("CCT_FAULTS", None)
         env["CCT_TRACE"] = "1"
         env["CCT_TRACE_DIR"] = self.trace_dir
+        # one fleet-wide retry budget (workers gate dispatches, routers
+        # gate resubmits) so the poison event converges to quarantine
+        env["CCT_SERVE_MAX_FLEET_ATTEMPTS"] = str(FLEET_ATTEMPT_BUDGET)
         if fault:
             env["CCT_FAULTS"] = fault
             self._log(f"  (spawning {tag} with CCT_FAULTS={fault})")
@@ -194,7 +224,10 @@ class Conductor:
                 "--journal", w["journal"], "--gang_size", "1",
                 "--queue_bound", "32", "--backend", "xla_cpu",
                 "--drain_s", "60"]
-        w["proc"] = self._popen(name, argv, self.next_worker_fault)
+        fault = POISON_FAULT
+        if self.next_worker_fault:
+            fault = f"{fault},{self.next_worker_fault}"
+        w["proc"] = self._popen(name, argv, fault)
         self.next_worker_fault = None
         w["alive"] = True
         w["permanent"] = False
@@ -293,6 +326,10 @@ class Conductor:
         while time.monotonic() < deadline:
             try:
                 return self.check_client.status(key=key)
+            except JobQuarantined as e:
+                # a poll that answers the quarantine IS a resolution
+                return {"state": "quarantined",
+                        "error": e.reply.get("reason") or str(e)}
             except Exception as e:
                 last = e
                 time.sleep(0.5)
@@ -464,6 +501,111 @@ class Conductor:
             self._log(f"armed {self.next_router_fault} for the next "
                       "router spawn")
 
+    def _reap_poison_victims(self) -> None:
+        """The conductor IS the fleet's supervisor: any worker that died
+        without the conductor killing it (the armed ``serve.poison`` exit)
+        is restarted on its own journal, which is exactly what makes the
+        suspect lineage grow toward the quarantine verdict."""
+        for name, w in self.workers.items():
+            if w["alive"] and not w["permanent"] and w["in_fleet"] \
+                    and w["proc"] is not None and w["proc"].poll() is not None:
+                self._log(f"worker {name} died on its own "
+                          f"(rc {w['proc'].returncode}, poison victim); "
+                          "restarting on its journal")
+                w["alive"] = False
+                self._spawn_worker(name)
+                self._wait_socket(w["sock"], f"worker {name}")
+
+    def ev_poison_submit(self) -> None:
+        if self.poison is not None:
+            self._log("poison_submit skipped (poison key already placed)")
+            return
+        out = os.path.join(self.workdir, "jobs", "poison")
+        spec = dict(job_spec(out), name="poison-pill")
+        try:
+            sub = self.client.submit_full(spec)
+        except ServeClientError as e:
+            self._violate(f"poison submit was not even acknowledged: {e}")
+            return
+        self.poison = {"key": sub["key"], "out": out}
+        self._log(f"poison submit -> key {sub['key']} on {sub.get('node')} "
+                  f"(budget {FLEET_ATTEMPT_BUDGET}); every dispatch will "
+                  "kill its worker")
+        deadline = time.monotonic() + 300.0
+        state = None
+        while time.monotonic() < deadline:
+            self._reap_poison_victims()
+            try:
+                state = self.check_client.status(key=sub["key"])["state"]
+            except JobQuarantined:
+                state = "quarantined"
+            except Exception:
+                state = None
+            if state == "quarantined":
+                break
+            time.sleep(0.5)
+        self._reap_poison_victims()
+        if state != "quarantined":
+            self._violate(f"poison key {sub['key']} not quarantined within "
+                          f"300s (last state {state!r})")
+            return
+        self.quarantines_seen += 1
+        self._log(f"poison key {sub['key']} QUARANTINED; fleet lives on")
+
+    def ev_disk_full(self) -> None:
+        live = [n for n in self._live_workers()
+                if self.workers[n]["original"]] or self._live_workers()
+        if len(self._live_workers()) < 2:
+            self._log("disk_full skipped (too few workers alive)")
+            return
+        name = self.rng.choice(live)
+        w = self.workers[name]
+        w["alive"] = False
+        self._kill9(w["proc"], f"worker {name} (disk about to fill)")
+        self.next_worker_fault = "serve.enospc=fail@2"
+        self._spawn_worker(name)
+        self._wait_socket(w["sock"], f"worker {name}")
+        # talk to the browning-out worker directly with a non-retrying
+        # client: each refusal must carry the brownout flag, and the
+        # daemon must survive to accept the same spec once appends work
+        probe = ServeClient(w["sock"], retries=0, retry_base_s=0.1)
+        out = os.path.join(self.workdir, "jobs",
+                           f"brownout{self.brownouts_seen}")
+        refusals = 0
+        sub = None
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline:
+            try:
+                sub = probe.submit_full(job_spec(out))
+                break
+            except ServeClientError as e:
+                if e.reply.get("brownout"):
+                    refusals += 1
+                elif not (e.reply.get("busy") or e.reply.get("transport")
+                          or e.reply.get("shutdown")):
+                    self._violate(f"disk_full: worker {name} answered a "
+                                  f"non-brownout error: {e}")
+                    return
+            except OSError:
+                pass  # still booting
+            time.sleep(0.5)
+        if sub is None:
+            self._violate(f"worker {name} never recovered from the "
+                          "ENOSPC brownout within 120s")
+            return
+        self.brownouts_seen += 1
+        self.acked.append({"key": sub["key"], "out": out,
+                           "spec": job_spec(out),
+                           "trace": sub.get("trace")})
+        # the 2 armed append failures may be consumed by replayed-job
+        # dispatch records instead (post-admission failures brown out
+        # silently: availability over durability), so the refusal count
+        # is reported, not asserted — the hard invariant is that the
+        # daemon LIVED through ENOSPC and serves again
+        self._log(f"worker {name} refused {refusals} submit(s) in "
+                  f"brownout, then accepted key {sub['key']} — disk "
+                  "recovered, daemon never died")
+
     # --------------------------------------------------------- invariants
 
     def _journal_paths(self) -> list:
@@ -544,9 +686,11 @@ class Conductor:
         weights = [3.0, 2.0, 1.5, 1.5, 1.0]
         sched = self.rng.choices(names, weights=weights, k=max(1, events))
         forced = [(0.20, "add_member"),
+                  (0.30, "poison_submit"),
                   (0.35, "kill_active_router"),
                   (0.45, "restart_router"),
                   (0.55, "perm_kill_worker"),
+                  (0.65, "disk_full"),
                   (0.75, "decommission_member"),
                   (0.85, "zombie_return")]
         for frac, name in forced:
@@ -573,6 +717,8 @@ class Conductor:
             "add_member": self.ev_add_member,
             "decommission_member": self.ev_decommission_member,
             "arm_fault": self.ev_arm_fault,
+            "poison_submit": self.ev_poison_submit,
+            "disk_full": self.ev_disk_full,
         }
         try:
             for i, name in enumerate(schedule):
@@ -588,8 +734,41 @@ class Conductor:
         finally:
             self.teardown()
 
+    def check_poison(self) -> None:
+        """The poison key must have ended quarantined — and the journals
+        must prove its suspect lineage never exceeded the fleet retry
+        budget, on ANY worker the routers may have resubmitted it to."""
+        if self.poison is None:
+            return
+        key = self.poison["key"]
+        job = self._poll_status(key)
+        if job is not None and job["state"] != "quarantined":
+            self._violate(f"poison key {key} ended {job['state']!r}, "
+                          "not 'quarantined'")
+        worst = 0
+        for path in self._journal_paths():
+            for line in open(path, "rb").read().split(b"\n"):
+                if b'"suspect"' not in line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(rec, dict) and rec.get("rec") == "marker" \
+                        and rec.get("kind") == "suspect" \
+                        and rec.get("key") == key:
+                    worst = max(worst, int(rec.get("attempt") or 0))
+        if worst > FLEET_ATTEMPT_BUDGET:
+            self._violate(f"poison key {key} reached journaled attempt "
+                          f"{worst} > fleet budget {FLEET_ATTEMPT_BUDGET}")
+        else:
+            self._log(f"poison key {key}: worst journaled attempt {worst} "
+                      f"<= budget {FLEET_ATTEMPT_BUDGET}, verdict "
+                      "quarantined")
+
     def finish(self) -> int:
         self._log("schedule complete; draining every acknowledged job")
+        self._reap_poison_victims()
         # revive every transiently-dead worker so its journal drains
         for name, w in self.workers.items():
             if not w["alive"] and not w["permanent"] and w["in_fleet"]:
@@ -626,6 +805,13 @@ class Conductor:
             self._violate("schedule finished without a router takeover")
         if self.adoptions_seen < 1:
             self._violate("schedule finished without a journal adoption")
+        self.check_poison()
+        if self.quarantines_seen < 1:
+            self._violate("schedule finished without the poison "
+                          "quarantine landing")
+        if self.brownouts_seen < 1:
+            self._violate("schedule finished without an ENOSPC brownout "
+                          "recovery")
         self.trace_summary = self.check_trace("finish")
         if self.trace_summary["spans"] <= 0:
             self._violate("no trace spans survived the schedule (fleet "
@@ -637,8 +823,11 @@ class Conductor:
         tr = getattr(self, "trace_summary", None) or {}
         self._log(f"summary: {len(self.acked)} submits over {n_jobs} "
                   f"unique job(s), {self.takeovers_seen} takeover(s), "
-                  f"{self.adoptions_seen} adoption(s), final epoch "
-                  f"{self.last_epoch}, {tr.get('spans', 0)} trace "
+                  f"{self.adoptions_seen} adoption(s), "
+                  f"{self.quarantines_seen} quarantine(s), "
+                  f"{self.brownouts_seen} brownout recovery(ies), "
+                  f"final epoch {self.last_epoch}, "
+                  f"{tr.get('spans', 0)} trace "
                   f"span(s) in {tr.get('traces', 0)} trace(s), "
                   f"{tr.get('orphans', 0)} orphan(s)")
         if self.violations:

@@ -26,29 +26,24 @@ echo "== interleaving model check (bounded smoke; protocol invariants) =="
 # `python tools/model_check.py` (~1000 schedules, a few seconds)
 JAX_PLATFORMS=cpu PYTHONPATH="$REPO" python tools/model_check.py --smoke
 
+echo "== interleaving model check (poison quarantine, full budget) =="
+# the quarantine/budget invariants get the full 1000-schedule budget
+# (exit-enforced): suspect ordinals never exceed the fleet budget, no
+# dispatch after the quarantined marker, replay never requeues a
+# quarantined key — plus the budgets-off positive control, which MUST
+# be caught (a checker that can't see the runaway proves nothing)
+JAX_PLATFORMS=cpu PYTHONPATH="$REPO" python tools/model_check.py \
+  --scenario poison_quarantine --budget 1000
+JAX_PLATFORMS=cpu PYTHONPATH="$REPO" python tools/model_check.py \
+  --poison-control --budget 40
+
 echo "== tier-1 test suite =="
-T1LOG="$(mktemp)"
-set +e
+# (test_two_process_global_mesh_psum self-skips with a reason when this
+# jaxlib ships without CPU-backend multiprocess collectives, so the
+# suite is expected fully green — no tolerated failures)
 JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
   --continue-on-collection-errors -p no:cacheprovider \
-  -p no:xdist -p no:randomly 2>&1 | tee "$T1LOG"
-T1RC=${PIPESTATUS[0]}
-set -e
-if [ "$T1RC" -ne 0 ]; then
-  # Tolerate ONLY the known container-environment flake: the two-process
-  # global-mesh test needs real multi-host networking and fails in
-  # sandboxed CI (it fails on the seed tree too).  Anything else is red.
-  OTHER="$(grep -a '^FAILED' "$T1LOG" \
-    | grep -vc 'test_two_process_global_mesh_psum' || true)"
-  if [ "$OTHER" -ne 0 ]; then
-    echo "ci_check: tier-1 failures beyond the known flake:" >&2
-    grep -a '^FAILED' "$T1LOG" >&2
-    rm -f "$T1LOG"
-    exit 1
-  fi
-  echo "ci_check: tolerating known-flaky test_two_process_global_mesh_psum"
-fi
-rm -f "$T1LOG"
+  -p no:xdist -p no:randomly
 
 echo "== autotune + residency CPU smoke (byte parity off-silicon) =="
 JAX_PLATFORMS=cpu PYTHONPATH="$REPO" python - <<'PY'
@@ -495,7 +490,221 @@ finally:
         sys.stderr.write(open(os.path.join(WORK, "ha.log")).read()[-8000:])
 PY
 
-echo "== chaos conductor smoke (fixed-seed randomized fault schedule) =="
+echo "== poison-control smoke (fleet quarantines a crashing job; honest jobs unharmed) =="
+JAX_PLATFORMS=cpu PYTHONPATH="$REPO" python - "$WORK/poison" <<'PY'
+import glob, json, os, signal, subprocess, sys, time
+
+WORK = sys.argv[1]
+os.makedirs(WORK, exist_ok=True)
+REPO = os.getcwd()
+sys.path.insert(0, os.path.join(REPO, "test"))
+from make_test_data import canonical_bam_digest, text_digest
+from consensuscruncher_tpu.serve.client import JobQuarantined, ServeClient
+
+GOLDEN = json.load(open(os.path.join(REPO, "test", "golden.json")))
+SAMPLE = os.path.join(REPO, "test", "data", "sample.bam")
+sock = os.path.join(WORK, "route.sock")
+boot = ("import sys; sys.path.insert(0, %r); "
+        "from consensuscruncher_tpu.cli import main; "
+        "sys.exit(main(sys.argv[1:]))" % REPO)
+log = open(os.path.join(WORK, "router.log"), "wb")
+# serve.poison only fires for jobs whose NAME contains "poison", so the
+# honest jobs sharing the fleet never see it; every poison dispatch
+# os._exit()s its worker, the spawn supervisor restarts it on the same
+# journal, and replay crash-attribution must quarantine the key within
+# the 2-attempt fleet budget
+env = dict(os.environ, CCT_FAULTS="serve.poison=exit@99",
+           CCT_SERVE_MAX_FLEET_ATTEMPTS="2",
+           CCT_SERVE_BREAKER_QUARANTINES="1")
+router = subprocess.Popen(
+    [sys.executable, "-c", boot, "route", "--spawn", "2",
+     "--workdir", WORK, "--socket", sock, "--backend", "xla_cpu",
+     "--gang_size", "1", "--queue_bound", "8", "--drain_s", "60"],
+    stdout=log, stderr=subprocess.STDOUT, env=env)
+ok = False
+try:
+    client = ServeClient(sock, retries=60, retry_base_s=0.25)
+    def spec(out, name="golden"):
+        return {"input": SAMPLE, "output": os.path.join(WORK, out),
+                "name": name, "cutoff": 0.7, "qualscore": 0,
+                "scorrect": True, "max_mismatch": 0, "bdelim": "|",
+                "compress_level": 6}
+    honest = [client.submit_full(spec(f"job{i}")) for i in range(2)]
+    pkey = client.submit_full(spec("pjob", name="poison-pill"))["key"]
+    state, deadline = None, time.monotonic() + 420
+    while time.monotonic() < deadline:
+        try:
+            state = client.request({"op": "status", "key": pkey},
+                                   timeout=60)["job"]["state"]
+        except JobQuarantined:
+            state = "quarantined"
+        except Exception:
+            state = None
+        if state == "quarantined":
+            break
+        time.sleep(1.0)
+    assert state == "quarantined", f"poison never quarantined ({state!r})"
+    # honest jobs rode the same fleet to byte-identical goldens
+    for i, sub in enumerate(honest):
+        job = client.request({"op": "result", "key": sub["key"],
+                              "timeout": 600}, timeout=900)["job"]
+        assert job["state"] == "done", job
+        base = os.path.join(WORK, f"job{i}", "golden")
+        for rel, want in GOLDEN["consensus"].items():
+            p = os.path.join(base, rel)
+            got = (canonical_bam_digest(p) if rel.endswith(".bam")
+                   else text_digest(p))
+            assert got == want, f"honest job {i} diverges at {rel}"
+    # journals: at least one live quarantine verdict (the router's
+    # failover rider carries lineage, so BOTH workers may legitimately
+    # journal their own verdict), suspect lineage capped by the fleet
+    # budget on every worker it ever touched
+    live_q, worst = 0, 0
+    for path in glob.glob(os.path.join(WORK, "*.journal")):
+        q = None
+        for line in open(path, "rb").read().split(b"\n"):
+            if b'"marker"' not in line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if rec.get("rec") != "marker" or rec.get("key") != pkey:
+                continue
+            if rec.get("kind") == "suspect":
+                worst = max(worst, int(rec.get("attempt") or 0))
+            elif rec.get("kind") == "quarantined":
+                q = not rec.get("released")
+        live_q += bool(q)
+    assert 1 <= live_q <= 2, f"{live_q} journals hold a live quarantine"
+    assert 1 <= worst <= 2, f"journaled attempt {worst} vs budget 2"
+    # the supervisor healed every poison victim: whole fleet back up,
+    # exactly one key parked in quarantine
+    h, deadline = {}, time.monotonic() + 120
+    while time.monotonic() < deadline:
+        h = client.request({"op": "healthz"}, timeout=30)["health"]
+        if h.get("fleet", {}).get("up") == 2:
+            break
+        time.sleep(1.0)
+    assert h.get("fleet", {}).get("up") == 2, h
+    assert 1 <= h.get("quarantined", 0) <= 2, h
+    # the counters prove WHY it parked: the fleet budget was spent and
+    # the per-fingerprint breaker opened (threshold 1 in this leg).
+    # Summed across the router and every member — the verdict may land
+    # on either worker, and the router spends budget on failover too.
+    m = client.request({"op": "metrics"}, timeout=30)["metrics"]
+    docs = [m] + [d for d in (m.get("nodes") or {}).values() if d]
+    tally = {}
+    for doc in docs:
+        for name, val in (doc.get("cumulative") or {}).items():
+            if isinstance(val, (int, float)):
+                tally[name] = tally.get(name, 0) + val
+    assert tally.get("fleet_attempts_exhausted", 0) >= 1, tally
+    assert tally.get("breaker_open", 0) >= 1, tally
+    # `cct submit` of the quarantined key: non-zero exit naming the cure
+    r = subprocess.run(
+        [sys.executable, "-c", boot, "submit", "--socket", sock,
+         "--input", SAMPLE, "--output", os.path.join(WORK, "pjob"),
+         "--name", "poison-pill"],
+        capture_output=True, text=True, env=env, timeout=300)
+    assert r.returncode != 0, "submit of a quarantined key exited 0"
+    assert "quarantined" in (r.stderr + r.stdout), (r.stdout, r.stderr)
+    assert "route --release" in (r.stderr + r.stdout), (r.stdout, r.stderr)
+    # `cct route --release` lifts it fleet-wide (operator decision)
+    r = subprocess.run(
+        [sys.executable, "-c", boot, "route", "--socket", sock,
+         "--release", pkey],
+        capture_output=True, text=True, env=env, timeout=300)
+    assert r.returncode == 0, (r.returncode, r.stdout, r.stderr)
+    assert "released" in (r.stdout + r.stderr), (r.stdout, r.stderr)
+    ok = True
+    print("ci_check: poison-control smoke OK (key %s quarantined at "
+          "attempt %d <= budget 2; %d honest jobs byte-identical; fleet "
+          "healed; submit refused non-zero; release accepted)"
+          % (pkey, worst, len(honest)))
+finally:
+    router.send_signal(signal.SIGTERM)
+    try:
+        router.wait(timeout=120)
+    except subprocess.TimeoutExpired:
+        router.kill()
+    log.close()
+    if not ok:
+        sys.stderr.write(open(os.path.join(WORK, "router.log")).read()[-8000:])
+PY
+
+echo "== poison positive control (budgets DISABLED must crash-loop until the supervisor gives up) =="
+JAX_PLATFORMS=cpu PYTHONPATH="$REPO" python - "$WORK/poison_off" <<'PY'
+import glob, json, os, subprocess, sys, time
+
+WORK = sys.argv[1]
+os.makedirs(WORK, exist_ok=True)
+REPO = os.getcwd()
+SAMPLE = os.path.join(REPO, "test", "data", "sample.bam")
+sock = os.path.join(WORK, "serve.sock")
+journal = os.path.join(WORK, "serve.journal")
+boot = ("import sys; sys.path.insert(0, %r); "
+        "from consensuscruncher_tpu.cli import main; "
+        "sys.exit(main(sys.argv[1:]))" % REPO)
+log = open(os.path.join(WORK, "serve.log"), "wb")
+# the inverse experiment: same always-crashing job, but the fleet
+# budget is DISABLED — the supervised daemon must crash-loop until
+# max_restarts is exhausted and DIE, proving the budget (not luck) is
+# what kept the fleet alive in the leg above
+env = dict(os.environ, CCT_FAULTS="serve.poison=exit@99",
+           CCT_SERVE_MAX_FLEET_ATTEMPTS="0")
+daemon = subprocess.Popen(
+    [sys.executable, "-c", boot, "serve", "--socket", sock,
+     "--journal", journal, "--supervise", "True", "--max_restarts", "2",
+     "--backend", "xla_cpu", "--gang_size", "1", "--queue_bound", "8",
+     "--drain_s", "60"],
+    stdout=log, stderr=subprocess.STDOUT, env=env)
+ok = False
+try:
+    from consensuscruncher_tpu.serve.client import ServeClient
+    client = ServeClient(sock, retries=60, retry_base_s=0.25)
+    client.submit_full({
+        "input": SAMPLE, "output": os.path.join(WORK, "pjob"),
+        "name": "poison-pill", "cutoff": 0.7, "qualscore": 0,
+        "scorrect": True, "max_mismatch": 0, "bdelim": "|",
+        "compress_level": 6})
+    deadline = time.monotonic() + 420
+    while daemon.poll() is None and time.monotonic() < deadline:
+        time.sleep(1.0)
+    assert daemon.poll() is not None, \
+        "budgets-off daemon still alive (it should have crash-looped out)"
+    assert daemon.returncode != 0, daemon.returncode
+    worst, quarantined = 0, False
+    for line in open(journal, "rb").read().split(b"\n"):
+        if b'"marker"' not in line:
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if rec.get("rec") != "marker":
+            continue
+        if rec.get("kind") == "suspect":
+            worst = max(worst, int(rec.get("attempt") or 0))
+        elif rec.get("kind") == "quarantined" and not rec.get("released"):
+            quarantined = True
+    assert not quarantined, "budgets-off run quarantined anyway"
+    assert worst >= 3, f"journaled attempt only reached {worst}"
+    ok = True
+    print("ci_check: poison positive control OK (budgets off: daemon "
+          "crash-looped to attempt %d, supervisor gave up rc=%d, no "
+          "quarantine — budgets are what contain the poison)"
+          % (worst, daemon.returncode))
+finally:
+    if daemon.poll() is None:
+        daemon.kill()
+        daemon.wait(timeout=60)
+    log.close()
+    if not ok:
+        sys.stderr.write(open(os.path.join(WORK, "serve.log")).read()[-8000:])
+PY
+
+echo "== chaos conductor smoke (fixed-seed randomized fault schedule, incl. poison + disk-full) =="
 python tools/chaos_conductor.py --workdir "$WORK/chaos" --smoke
 
 echo "ci_check: OK"

@@ -1,7 +1,7 @@
 """Deterministic interleaving model check of the serve plane's protocol.
 
 Where ``chaos_conductor.py`` *samples* fault schedules against a live
-fleet, this tool *enumerates* thread interleavings of four small scripted
+fleet, this tool *enumerates* thread interleavings of five small scripted
 scenarios built from the real serve primitives (Journal, replay,
 Scheduler admission/fencing) under ``utils/interleave.py``'s cooperative
 scheduler, and asserts the invariants declared in
@@ -19,17 +19,27 @@ scheduler, and asserts the invariants declared in
   adoption_zombie    a returning zombie worker replays its journal while
                      the adopting router resubmits + tombstones it: the
                      job is never lost and never double-owned
+  poison_quarantine  an active router and a zombie router (stale lineage
+                     rider) race redispatches of one always-crashing key:
+                     journaled suspect ordinals never exceed the fleet
+                     retry budget, nothing dispatches after the
+                     quarantined marker, and replay of a quarantined
+                     journal never requeues the key
 
-A fifth leg, ``--demo-bug``, runs the fence race against a deliberately
-seeded check-then-act fence (the pre-fix shape: read the floor in one
-lock region, write it in another) and REQUIRES the checker to find the
-epoch regression — proof the harness can catch the bug class it exists
-for.  ``tests/test_model_check.py`` replays the discovered bad schedule.
+Two positive-control legs REQUIRE the checker to find seeded bugs —
+proof the harness can catch the bug classes it exists for.
+``--demo-bug`` runs the fence race against a deliberately seeded
+check-then-act fence (the pre-fix shape: read the floor in one lock
+region, write it in another) and must find the epoch regression;
+``--poison-control`` runs the poison race with fleet budgets DISABLED
+(``max_fleet_attempts = 0``) and must find the runaway dispatches.
+``tests/test_model_check.py`` replays the discovered bad schedule.
 
   python tools/model_check.py                  # full run (>= 500 schedules)
   python tools/model_check.py --smoke          # bounded CI leg, fixed seed
   python tools/model_check.py --scenario fence_race --budget 200
   python tools/model_check.py --demo-bug       # exit 0 iff the bug is caught
+  python tools/model_check.py --poison-control # exit 0 iff budgets-off is caught
 
 Exit 0: every explored schedule of every scenario held every invariant
 (and, when the demo leg runs, the seeded bug was caught).
@@ -51,7 +61,7 @@ sys.path.insert(0, _REPO)
 
 from consensuscruncher_tpu.serve import journal as journal_mod  # noqa: E402
 from consensuscruncher_tpu.serve.scheduler import (  # noqa: E402
-    AdmissionRefused, RouterFenced, Scheduler)
+    AdmissionRefused, QuarantineRefused, RouterFenced, Scheduler)
 from consensuscruncher_tpu.utils import interleave  # noqa: E402
 from consensuscruncher_tpu.utils.profiling import Counters  # noqa: E402
 from tools.cctlint import protocols  # noqa: E402
@@ -375,11 +385,144 @@ def build_adoption_zombie(runner):
     return check
 
 
+def _poison_scenario(budget: int):
+    """Shared shape of the correct and budget-off poison races: an active
+    router and a zombie router (stale lineage rider) race redispatches of
+    one always-crashing key onto two workers.  The active router fails
+    over to w2 carrying the merged lineage; the zombie hammers w1 with a
+    stale rider of 0 — the exact shape a partitioned HA pair produces.
+    ``budget`` is the per-key fleet attempt cap (0 = the seeded control:
+    budgets disabled, the checker must catch the runaway)."""
+
+    def build(runner):
+        tmp = _scratch()
+        paths = {n: os.path.join(tmp, f"w{n}.ndjson") for n in (1, 2)}
+        workers = {}
+        for n in (1, 2):
+            w = Scheduler(start=False, journal=paths[n], queue_bound=8,
+                          result_ttl_s=600.0, result_max=8, node=f"w{n}")
+            w.max_fleet_attempts = budget
+            workers[n] = w
+        spec = {"input": "p.bam", "output": "out", "name": "mc-poison"}
+        key = journal_mod.idempotency_key(spec)
+        view = {"attempts": 0}  # the ring-view lineage both routers share
+        events: list[tuple] = []
+
+        def dispatch_once(w, rider):
+            """One router redispatch: forward the submit with the lineage
+            rider (the worker max-merges it), then run the worker's
+            pre-dispatch budget gate — suspect marker or quarantine."""
+            job, _created = w.submit_info(dict(spec), fleet_attempts=rider)
+            with w._cond:
+                parked = w._predispatch_locked(job)
+            return parked
+
+        def active_router():
+            # dispatch on the home node, then fail over to w2 forwarding
+            # the merged lineage (what _failover_resubmit does)
+            for n in (1, 2, 2):
+                try:
+                    if dispatch_once(workers[n], view["attempts"]):
+                        events.append(("quarantined", "active", n))
+                        return
+                    events.append(("dispatched", "active", n))
+                except QuarantineRefused:
+                    events.append(("refused", "active", n))
+                    return
+                except AdmissionRefused:
+                    events.append(("admission", "active", n))
+                view["attempts"] = max(view["attempts"],
+                                       workers[n].fleet_attempts(key))
+
+        def zombie_router():
+            # a zombie never refreshed its view: rider 0, home node only
+            for _ in range(4):
+                try:
+                    if dispatch_once(workers[1], 0):
+                        events.append(("quarantined", "zombie", 1))
+                        return
+                    events.append(("dispatched", "zombie", 1))
+                except QuarantineRefused:
+                    events.append(("refused", "zombie", 1))
+                    return
+                except AdmissionRefused:
+                    events.append(("admission", "zombie", 1))
+
+        runner.spawn("router-active", active_router)
+        runner.spawn("router-zombie", zombie_router)
+
+        def check():
+            msgs = []
+            cap = budget or 2  # the control judges against the real cap
+            for n in (1, 2):
+                _close(workers[n])
+                msgs += _journal_grammar_violations(paths[n], f"w{n}")
+                # order-sensitive marker walk: suspect ordinals never
+                # exceed the fleet budget, and nothing dispatches after
+                # the quarantined marker (quarantine is near-terminal)
+                suspects = 0
+                quarantined_at = None
+                with open(paths[n], "rb") as fh:
+                    lines = fh.read().split(b"\n")
+                for line in lines:
+                    if not line.strip():
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue
+                    if rec.get("rec") != "marker" or rec.get("key") != key:
+                        continue
+                    if rec.get("kind") == "suspect":
+                        suspects += 1
+                        if int(rec.get("attempt") or 0) > cap:
+                            msgs.append(
+                                f"w{n}: suspect ordinal "
+                                f"{rec.get('attempt')} exceeds the fleet "
+                                f"budget {cap}")
+                        if quarantined_at is not None:
+                            msgs.append(
+                                f"w{n}: dispatch (suspect marker) AFTER "
+                                "the quarantined marker — quarantine did "
+                                "not stop the poison")
+                    elif rec.get("kind") == "quarantined" \
+                            and not rec.get("released"):
+                        quarantined_at = suspects
+                if suspects > cap:
+                    msgs.append(f"w{n}: {suspects} dispatches for one key "
+                                f"exceed the fleet budget {cap}")
+                # replay honours the verdict: a quarantined journal must
+                # not hand the key another dispatch on recovery
+                _jobs, info = journal_mod.replay(paths[n])
+                if key in info["quarantined"]:
+                    z = Scheduler(start=False, journal=paths[n],
+                                  queue_bound=8, result_ttl_s=600.0,
+                                  result_max=8)
+                    with z._cond:
+                        queued = sum(len(q) for q in z._queues.values())
+                    _close(z)
+                    if queued:
+                        msgs.append(
+                            f"w{n}: replay requeued a quarantined key "
+                            f"({queued} queued)")
+            shutil.rmtree(tmp, ignore_errors=True)
+            return msgs
+
+        return check
+
+    return build
+
+
+build_poison_quarantine = _poison_scenario(budget=2)
+build_poison_quarantine_budget_off = _poison_scenario(budget=0)
+
+
 SCENARIOS = {
     "submit_kill": build_submit_kill,
     "fence_race": build_fence_race,
     "failover_resubmit": build_failover_resubmit,
     "adoption_zombie": build_adoption_zombie,
+    "poison_quarantine": build_poison_quarantine,
 }
 
 
@@ -451,6 +594,27 @@ def run_demo_bug(*, seed: int, budget: int,
     return False, None
 
 
+def run_poison_control(*, seed: int, budget: int,
+                       verbose: bool = False) -> tuple[bool, list[int] | None]:
+    """Positive control: with fleet budgets disabled the poison race MUST
+    produce runaway dispatches the invariants flag.  Returns (caught,
+    first violating schedule)."""
+    ex = interleave.Explorer(build_poison_quarantine_budget_off, seed=seed,
+                             max_schedules=budget)
+    res = _explore_quiet(ex, verbose)
+    if res["violations"]:
+        sched, msgs = res["violations"][0]
+        print(f"model_check: poison-control: CAUGHT in {res['schedules']} "
+              f"schedules; first bad schedule {sched}:", flush=True)
+        for m in msgs[:5]:
+            print(f"    - {m}", flush=True)
+        return True, sched
+    print(f"model_check: poison-control: NOT caught in {res['schedules']} "
+          "schedules — budgets-off ran clean; the checker lost its "
+          "positive control", flush=True)
+    return False, None
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--scenario", choices=sorted(SCENARIOS),
@@ -463,7 +627,9 @@ def main(argv=None) -> int:
     ap.add_argument("--no-dpor", action="store_true",
                     help="disable pruning (full enumeration up to budget)")
     ap.add_argument("--demo-bug", action="store_true",
-                    help="only run the seeded-bug positive control")
+                    help="only run the seeded fence-bug positive control")
+    ap.add_argument("--poison-control", action="store_true",
+                    help="only run the budgets-off poison positive control")
     ap.add_argument("--replay", type=str, default=None,
                     help="JSON schedule to replay (with --scenario or "
                          "--demo-bug); prints the verdict for that one "
@@ -480,6 +646,7 @@ def main(argv=None) -> int:
     if args.replay is not None:
         schedule = [int(x) for x in json.loads(args.replay)]
         build = (build_fence_race_seeded_bug if args.demo_bug
+                 else build_poison_quarantine_budget_off if args.poison_control
                  else SCENARIOS[args.scenario or "fence_race"])
         _runner, msgs = interleave.run_schedule(build, schedule)
         for m in msgs:
@@ -493,18 +660,33 @@ def main(argv=None) -> int:
                                       verbose=args.verbose)
         return 0 if caught else 1
 
+    if args.poison_control:
+        caught, _sched = run_poison_control(seed=args.seed,
+                                            budget=args.budget,
+                                            verbose=args.verbose)
+        return 0 if caught else 1
+
     names = [args.scenario] if args.scenario else sorted(SCENARIOS)
     doc = run_scenarios(names, seed=args.seed, budget=args.budget,
                         dpor=not args.no_dpor, verbose=args.verbose)
     caught, _sched = run_demo_bug(seed=args.seed, budget=args.budget,
                                   verbose=args.verbose)
     doc["demo_bug_caught"] = caught
+    # the poison control only needs a handful of schedules: with budgets
+    # off EVERY schedule dispatches past the cap, so cap the leg's cost
+    pcaught = True
+    if args.scenario in (None, "poison_quarantine"):
+        pcaught, _psched = run_poison_control(
+            seed=args.seed, budget=min(args.budget, 40),
+            verbose=args.verbose)
+        doc["poison_control_caught"] = pcaught
     if args.json:
         print(json.dumps(doc, sort_keys=True), flush=True)
-    ok = doc["violations"] == 0 and caught
+    ok = doc["violations"] == 0 and caught and pcaught
     print(f"model_check: total {doc['schedules']} schedules, "
           f"{doc['violations']} violations, demo bug "
-          f"{'caught' if caught else 'MISSED'} -> "
+          f"{'caught' if caught else 'MISSED'}, poison control "
+          f"{'caught' if pcaught else 'MISSED'} -> "
           f"{'OK' if ok else 'FAIL'}", flush=True)
     return 0 if ok else 1
 
