@@ -700,6 +700,13 @@ def _consensus_impl(args) -> dict:
               if getattr(args, "intermediate_level", None) is None
               else args.intermediate_level)
 
+    # Consensus vote policy (ISSUE 17).  Resolved/validated here so an
+    # unknown name fails before any stage output exists.
+    from consensuscruncher_tpu.policies.base import get_policy
+
+    policy = str(getattr(args, "policy", None) or "majority")
+    get_policy(policy)
+
     sscs_prefix = os.path.join(dirs["sscs"], name)
     sscs_paths = sscs_maker.output_paths(sscs_prefix)
     # badReads.bam is excluded from the manifest: --cleanup may delete it,
@@ -764,8 +771,11 @@ def _consensus_impl(args) -> dict:
         "sscs",
         [args.input],
         [sscs_paths[k] for k in ("sscs", "singleton", "stats_txt", "stats_json", "families")],
+        # "policy" joins the fingerprint only when non-default, so
+        # pre-policy manifests still match a default --resume.
         {"cutoff": args.cutoff, "qualscore": args.qualscore,
-         "bdelim": args.bdelim, "input_range": range_spec},
+         "bdelim": args.bdelim, "input_range": range_spec,
+         **({"policy": policy} if policy != "majority" else {})},
         run=lambda: run_sscs(
             args.input,
             sscs_prefix,
@@ -780,6 +790,7 @@ def _consensus_impl(args) -> dict:
             prestaged=getattr(args, "_prestaged", None),
             residency=residency,
             qc=qc_acc,
+            policy=policy,
         ),
         rebuild=lambda: SscsResult.from_prefix(sscs_prefix),
     )
@@ -901,12 +912,12 @@ def _consensus_impl(args) -> dict:
                 os.unlink(path)
 
     _write_run_metrics(base, name, dirs, "staged", t0, io_before)
-    _write_run_qc(base, name, "staged", qc_acc)
+    _write_run_qc(base, name, "staged", qc_acc, policy=policy)
     print(f"consensus: outputs under {base}")
     return {"all_sscs": all_sscs, "all_dcs": all_dcs, "dirs": dirs}
 
 
-def _write_run_qc(base, name, pipeline, acc) -> None:
+def _write_run_qc(base, name, pipeline, acc, policy="majority") -> None:
     """``<base>/qc.json``: the per-run consensus-quality document (ISSUE
     15) — family-size spectrum + yields from the stage stats sidecars,
     vote-plane summaries from the device accumulator when one ran.
@@ -916,7 +927,8 @@ def _write_run_qc(base, name, pipeline, acc) -> None:
     if not obs_qc.enabled():
         return
     try:
-        doc = obs_qc.collect_run(base, name, pipeline=pipeline, acc=acc)
+        doc = obs_qc.collect_run(base, name, pipeline=pipeline, acc=acc,
+                                 policy=policy)
         obs_qc.write_qc(os.path.join(base, "qc.json"), doc)
     except Exception as e:
         print(f"WARNING: qc.json not written ({e}); run outputs unaffected",
@@ -1005,6 +1017,7 @@ def _consensus_streaming(args, name, base, dirs, manifest, ilevel,
                 residency=residency,
                 stream_out=stream,
                 qc=qc_acc,
+                policy=str(getattr(args, "policy", None) or "majority"),
             )
         sscs_mem = stream.memory["sscs"]
         singleton_mem = stream.memory["singleton"]
@@ -1109,7 +1122,8 @@ def _consensus_streaming(args, name, base, dirs, manifest, ilevel,
                 os.unlink(path)
 
     _write_run_metrics(base, name, dirs, "streaming", t0, io_before)
-    _write_run_qc(base, name, "streaming", qc_acc)
+    _write_run_qc(base, name, "streaming", qc_acc,
+                  policy=str(getattr(args, "policy", None) or "majority"))
     print(f"consensus: outputs under {base} (streaming pipeline)")
     return {"all_sscs": all_sscs, "all_dcs": all_dcs, "dirs": dirs}
 
@@ -1127,7 +1141,8 @@ def _serve_child_argv(args) -> list[str]:
     the resolved values (flag > config > builtin), minus --supervise."""
     argv = ["serve"]
     for flag in ("socket", "host", "warmup_shapes", "compile_cache",
-                 "journal", "backend", "node", "result_cache", "warm_from"):
+                 "journal", "backend", "node", "result_cache", "warm_from",
+                 "policy"):
         value = getattr(args, flag, None)
         if value:
             argv += [f"--{flag}", str(value)]
@@ -1235,6 +1250,19 @@ def serve_cmd(args) -> None:
         print(f"serve: autotune table loaded from {table_path} "
               f"({len(autotuner.table)} shapes, backend={autotuner.backend})")
     autotuner.install()
+
+    # Vote-policy warmup (ISSUE 17): install the selected consensus
+    # policy before the ladder warm so warm_shapes compiles that policy's
+    # kernel variants.  Dispatch installs each job's own spec policy
+    # (absent = majority) around every gang run, so this flag only
+    # decides which kernels are warm at startup — an unknown name still
+    # fails fast here, before the daemon binds its socket.
+    from consensuscruncher_tpu.policies import base as policies_mod
+
+    warm_policy = str(getattr(args, "policy", None) or "majority")
+    policies_mod.set_vote_policy(policies_mod.get_policy(warm_policy))
+    if warm_policy != "majority":
+        print(f"serve: warmup compiles vote policy '{warm_policy}' kernels")
 
     shapes = warmup.parse_shapes(args.warmup_shapes)
     # warm the full pow2-B ladder of the learned buckets (not just the
@@ -1384,6 +1412,11 @@ def submit_cmd(args) -> None:
         spec["tenant"] = str(args.tenant)
     if getattr(args, "qos", None) not in (None, ""):
         spec["qos"] = str(args.qos)
+    # policy enters the spec only when set AND non-default: a default
+    # submit keeps the exact pre-policy spec, idempotency key and cache
+    # digest (absent == majority everywhere on the serve plane)
+    if getattr(args, "policy", None) not in (None, "", "majority"):
+        spec["policy"] = str(args.policy)
     try:
         sub = client.submit_full(spec)
     except JobQuarantined as e:
@@ -1988,6 +2021,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "negative (known-empty) is materialized from the "
                         "cache instead of decoded (counted "
                         "qc_ranges_skipped)")
+    c.add_argument("--policy",
+                   help="consensus vote policy for the SSCS family vote: "
+                        "'majority' (the reference rational-cutoff vote, "
+                        "golden-pinned default), 'delegation' (members "
+                        "below the delegation quality threshold hand their "
+                        "vote weight to high-quality family mates), or "
+                        "'distilled' (small pure-JAX MLP head trained by "
+                        "tools/distill_train.py against simulated truth "
+                        "sets). Non-majority policies require --backend "
+                        "tpu on a single device")
     c.set_defaults(func=consensus, config_section="consensus",
                    required_args=("input", "output"),
                    builtin_defaults={
@@ -1997,7 +2040,7 @@ def build_parser() -> argparse.ArgumentParser:
                        "resume": "False", "compress_level": 6,
                        "host_workers": 1, "residency": "True",
                        "pipeline": "staged", "intermediate_taps": "False",
-                       "result_cache": "",
+                       "result_cache": "", "policy": "majority",
                    })
 
     s = sub.add_parser(
@@ -2081,6 +2124,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "and result-cache plane published in the epoch "
                         "record, so this member joins hot "
                         "(unexpected_recompiles stays 0); empty = cold")
+    s.add_argument("--policy",
+                   help="consensus vote policy whose kernels the warmup "
+                        "ladder precompiles (default 'majority'). Jobs "
+                        "always run under their own spec policy — this "
+                        "flag only decides which kernels are warm at "
+                        "startup")
     s.set_defaults(func=serve_cmd, config_section="serve", required_args=(),
                    builtin_defaults={
                        "socket": "", "host": "127.0.0.1", "port": 7733,
@@ -2092,6 +2141,7 @@ def build_parser() -> argparse.ArgumentParser:
                        "class_weights": "", "slo_targets": "",
                        "tenant_queue_cap": "", "tenant_inflight_cap": "",
                        "node": "", "result_cache": "", "warm_from": "",
+                       "policy": "majority",
                    })
 
     r = sub.add_parser(
@@ -2330,6 +2380,10 @@ def build_parser() -> argparse.ArgumentParser:
     u.add_argument("--qos", choices=("interactive", "batch", "scavenger"),
                    help="qos class for weighted-fair dispatch and SLO "
                         "accounting (default 'interactive')")
+    u.add_argument("--policy",
+                   help="consensus vote policy for this job (default "
+                        "'majority'); unknown names are refused at "
+                        "admission with a typed bad_request reply")
     u.set_defaults(func=submit_cmd, config_section="serve",
                    required_args=("input", "output"),
                    builtin_defaults={
@@ -2337,7 +2391,7 @@ def build_parser() -> argparse.ArgumentParser:
                        "cutoff": 0.7, "qualscore": 0, "scorrect": "True",
                        "max_mismatch": 0, "bdelim": DEFAULT_BDELIM,
                        "compress_level": 6, "wait": "True",
-                       "tenant": "", "qos": "",
+                       "tenant": "", "qos": "", "policy": "",
                    })
     return p
 

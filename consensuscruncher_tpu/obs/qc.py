@@ -195,7 +195,8 @@ def _rates(y: dict) -> dict:
 
 
 def collect_run(base: str, name: str, pipeline: str = "",
-                acc: QcAccumulator | None = None) -> dict:
+                acc: QcAccumulator | None = None,
+                policy: str = "majority") -> dict:
     """Assemble one run's qc doc from its stage sidecars + accumulator.
 
     ``base`` is the run directory (``<output>/<name>``) with the standard
@@ -223,6 +224,7 @@ def collect_run(base: str, name: str, pipeline: str = "",
         "version": QC_VERSION,
         "run": name,
         "pipeline": pipeline,
+        "policy": policy,
         "sources": sources,
         "spectrum": spectrum,
         "yields": yields,
@@ -239,6 +241,7 @@ def merge_docs(docs: list[dict]) -> dict:
     sources: list[str] = []
     runs: list[str] = []
     pipeline = ""
+    policy = ""
     acc = QcAccumulator()
     any_plane = False
     for doc in docs:
@@ -246,6 +249,8 @@ def merge_docs(docs: list[dict]) -> dict:
             continue
         runs.append(doc.get("run") or "?")
         pipeline = pipeline or doc.get("pipeline", "")
+        # pre-policy shard docs lack the key; report renders those as "-"
+        policy = policy or doc.get("policy", "")
         for s in doc.get("sources") or []:
             if s not in sources:
                 sources.append(s)
@@ -262,6 +267,7 @@ def merge_docs(docs: list[dict]) -> dict:
         "version": QC_VERSION,
         "run": "+".join(runs) if len(runs) > 1 else (runs[0] if runs else ""),
         "pipeline": pipeline,
+        "policy": policy,
         "sources": sources,
         "merged_from": len(runs),
         "spectrum": spectrum,
@@ -315,7 +321,7 @@ def _pct(x) -> str:
 
 
 _REPORT_COLS = (
-    ("run", 20), ("families", 9), ("sscs", 8), ("dcs", 8),
+    ("run", 20), ("policy", 10), ("families", 9), ("sscs", 8), ("dcs", 8),
     ("yield", 8), ("duplex", 8), ("rescue", 8), ("dropout", 8),
     ("disagree", 9),
 )
@@ -326,7 +332,10 @@ def _report_row(label: str, doc: dict) -> str:
     r = doc.get("rates") or {}
     plane = doc.get("plane") or {}
     cells = (
-        label[:20], str(y.get("families", 0)), str(y.get("sscs_written", 0)),
+        label[:20],
+        # pre-policy qc docs carry no "policy" key: dash, not an error
+        (doc.get("policy") or "-")[:10],
+        str(y.get("families", 0)), str(y.get("sscs_written", 0)),
         str(y.get("dcs_written", 0)), _pct(r.get("sscs_yield")),
         _pct(r.get("duplex_rate")), _pct(r.get("rescue_rate")),
         _pct(r.get("dropout_rate")),

@@ -184,6 +184,11 @@ _RATIO_BUCKETS = (0.05, 0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7, 0.8, 0.9,
 # standalone to validate every labeled-metric call site.
 
 QOS_CLASSES = ("interactive", "batch", "scavenger")
+# Closed set of consensus vote-policy names (ISSUE 17) — the registered
+# ``policies/`` built-ins.  Pure literal (the lint loads this module
+# standalone); ``tests/test_policies.py`` pins it equal to
+# ``policies.base.available_policies()`` so the two cannot drift.
+POLICY_NAMES = ("delegation", "distilled", "majority")
 DEFAULT_TENANT = "default"
 DEFAULT_QOS = "interactive"
 # Sentinel tenant absorbing observations once the runtime tenant cap is
@@ -198,6 +203,7 @@ LABELS = {
     "tenant": {"closed": False, "values": None},
     "qos": {"closed": True, "values": QOS_CLASSES},
     "node": {"closed": False, "values": None},
+    "policy": {"closed": True, "values": POLICY_NAMES},
 }
 
 # Labeled counters are a separate namespace from COUNTERS: the global
@@ -268,6 +274,19 @@ LABELED_COUNTERS = {
         "help": "singletons rescued by SSCS/singleton correction per "
                 "tenant/class",
     },
+    # per-policy QC series (ISSUE 17): quality attribution by consensus
+    # vote policy.  ``policy`` is a CLOSED label (POLICY_NAMES above), so
+    # the per-policy exposition cardinality is bounded by construction.
+    "tenant_qc_policy_jobs": {
+        "labels": ("tenant", "qos", "policy"),
+        "help": "finished jobs carrying a qc doc per tenant/class and "
+                "consensus vote policy",
+    },
+    "tenant_qc_policy_sscs_written": {
+        "labels": ("tenant", "qos", "policy"),
+        "help": "single-strand consensus reads emitted per tenant/class "
+                "and consensus vote policy",
+    },
 }
 
 # Labeled histograms: per-(tenant, qos) series sharing the global
@@ -307,6 +326,8 @@ QC_SERIES = (
     "tenant_qc_dcs_written",
     "tenant_qc_rescued",
     "tenant_qc_disagreement",
+    "tenant_qc_policy_jobs",
+    "tenant_qc_policy_sscs_written",
 )
 
 # name -> {"buckets": upper bounds (le), "unit": ..., "help": ...}.

@@ -265,6 +265,19 @@ def render_frame(series: dict, source: str,
                                for label, metric in qc_cols)
             + f"  disagree={disagree}")
 
+    # per-policy qc breakdown (ISSUE 17): jobs + consensus yield by
+    # consensus vote policy.  Pre-policy daemons never export these
+    # series so the whole panel degrades to absence; a policy column
+    # with jobs but no sscs renders the sscs cell as 0 (measured).
+    pol_jobs = _by_label(series, "cct_tenant_qc_policy_jobs_total", "policy")
+    pol_sscs = _by_label(series, "cct_tenant_qc_policy_sscs_written_total",
+                         "policy")
+    if pol_jobs or pol_sscs:
+        lines.append(f"{'POLICY':<12} {'JOBS':>6} {'SSCS':>9}")
+        for name in sorted(set(pol_jobs) | set(pol_sscs)):
+            lines.append(f"{name:<12} {_fmt_n(pol_jobs.get(name, 0.0)):>6} "
+                         f"{_fmt_n(pol_sscs.get(name, 0.0)):>9}")
+
     totals = [
         ("routed", "cct_jobs_routed_total"),
         ("cache_answers", "cct_route_cache_answers_total"),

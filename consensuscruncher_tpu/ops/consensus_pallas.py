@@ -322,6 +322,16 @@ def consensus_batch_pallas(
     """
     from consensuscruncher_tpu.obs import metrics as obs_metrics
     from consensuscruncher_tpu.obs import qc as obs_qc
+    from consensuscruncher_tpu.policies.base import get_vote_policy
+
+    if get_vote_policy().name != "majority":
+        # The kernel's VMEM vote state hard-codes the majority count/
+        # first-seen/cutoff program; other policies run the dense XLA
+        # path (consensus_batch never reroutes here for them, so this
+        # cannot recurse).
+        from consensuscruncher_tpu.ops.consensus_tpu import consensus_batch
+
+        return consensus_batch(bases, quals, fam_sizes, config)
 
     qc_sink = obs_qc.plane_sink()
     if interpret is None:
@@ -389,6 +399,22 @@ def duplex_batch_pallas(
     with ``qual_cap`` shared).  Parity pinned by tests/test_pallas.py.
     """
     from consensuscruncher_tpu.obs import metrics as obs_metrics
+    from consensuscruncher_tpu.policies.base import get_vote_policy
+
+    if get_vote_policy().name != "majority":
+        # Fused kernel is majority-only; compose the policy-aware dense
+        # SSCS votes with the (policy-independent) duplex combine.
+        from consensuscruncher_tpu.ops.consensus_tpu import consensus_batch
+        from consensuscruncher_tpu.ops.duplex_tpu import duplex_vote
+
+        sa_b, sa_q = consensus_batch(bases_a, quals_a, sizes_a, config)
+        sb_b, sb_q = consensus_batch(bases_b, quals_b, sizes_b, config)
+        both = ((jnp.asarray(sizes_a, dtype=jnp.int32) > 0)
+                & (jnp.asarray(sizes_b, dtype=jnp.int32) > 0))[:, None]
+        dcs_b, dcs_q = duplex_vote(sa_b, sa_q, sb_b, sb_q,
+                                   qual_cap=int(config.qual_cap),
+                                   agree_mask=both)
+        return sa_b, sa_q, sb_b, sb_q, dcs_b, dcs_q
 
     if interpret is None:
         interpret = jax.default_backend() != "tpu"

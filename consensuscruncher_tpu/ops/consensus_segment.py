@@ -27,7 +27,7 @@ semantics identical to ops/consensus_tpu.py.
 
 from __future__ import annotations
 
-from functools import lru_cache, partial
+from functools import lru_cache
 
 import jax
 import jax.numpy as jnp
@@ -35,7 +35,8 @@ import numpy as np
 
 from consensuscruncher_tpu.obs import metrics as obs_metrics
 from consensuscruncher_tpu.obs import trace as obs_trace
-from consensuscruncher_tpu.ops.consensus_tpu import ConsensusConfig, _consensus_one_family
+from consensuscruncher_tpu.ops.consensus_tpu import ConsensusConfig
+from consensuscruncher_tpu.policies.base import get_policy, get_vote_policy
 from consensuscruncher_tpu.ops.duplex_tpu import duplex_vote
 from consensuscruncher_tpu.ops.packing import pack4, unpack4_device
 from consensuscruncher_tpu.utils.phred import N, NUM_BASES
@@ -57,7 +58,8 @@ def derive_ids_device(sizes, total_members: int):
 
 
 def _gather_dense_vote(bases, quals, sizes, *, cap, num, den,
-                       qual_threshold, qual_cap, with_qc=False):
+                       qual_threshold, qual_cap, with_qc=False,
+                       policy: str = "majority"):
     """(M, L) sorted member stream -> (NF, L) consensus via gather + reduce.
 
     Same semantics as :func:`_segment_vote`, different device program: the
@@ -77,12 +79,14 @@ def _gather_dense_vote(bases, quals, sizes, *, cap, num, den,
     safe = jnp.where(valid, starts[:, None] + r[None, :], 0)  # (NF, cap)
     db = jnp.take(bases.astype(jnp.uint8), safe, axis=0)      # (NF, cap, L)
     dq = jnp.take(quals.astype(jnp.uint8), safe, axis=0)
-    # Dead slots (r >= size) gather row 0's content; _consensus_one_family
-    # masks them out by fam_size, so the one dense-family kernel is the
-    # single source of the modal/tie-break/cutoff/quality semantics here.
-    vote = partial(_consensus_one_family, num=num, den=den,
-                   qual_threshold=qual_threshold, qual_cap=qual_cap,
-                   with_qc=with_qc)
+    # Dead slots (r >= size) gather row 0's content; the per-family vote
+    # masks them out by fam_size.  The policy's family_vote_fn is the
+    # single source of the vote semantics here; the majority default
+    # hands back the reference _consensus_one_family program verbatim,
+    # so the default path's jaxpr is unchanged.
+    vote = get_policy(policy).family_vote_fn(
+        num=num, den=den, qual_threshold=qual_threshold, qual_cap=qual_cap,
+        with_qc=with_qc)
     return jax.vmap(vote, in_axes=(0, 0, 0))(db, dq, sizes)
 
 
@@ -393,7 +397,7 @@ def run_duplex_pipelined(rows, qrows, sizes_a, sizes_b, codebook4,
 
 def _stream_vote_fn(wire: str, num, den, qual_threshold, qual_cap,
                     member_cap: int | None, out_len: int | None = None,
-                    with_qc: bool = False):
+                    with_qc: bool = False, policy: str = "majority"):
     """Un-jitted wire-decode + vote program: (a, b, sizes) -> stacked
     (2, NF, L) consensus planes.
 
@@ -413,7 +417,18 @@ def _stream_vote_fn(wire: str, num, den, qual_threshold, qual_cap,
     (``parallel.mesh`` wraps it in ``shard_map``, where ``sizes.shape[0]``
     and the member axis are the per-shard locals — the vote is per-family,
     so sharding whole families needs no collective at all).
+
+    ``policy``: registered vote-policy name, applied on the gather path
+    (``member_cap`` set).  The segment-scatter fallback hand-unrolls the
+    majority vote into lane-wise reductions, so non-majority policies
+    must stay on the gather path — a batch whose max family size exceeds
+    ``MAX_DENSE_CAP`` (cap None) refuses at build time.
     """
+    if policy != "majority" and member_cap is None:
+        raise ValueError(
+            f"vote policy {policy!r} requires the gather path (a family "
+            f"exceeded MAX_DENSE_CAP={MAX_DENSE_CAP} members); only the "
+            "majority default supports the segment-scatter fallback")
 
     def fn(a, b, sizes, lengths=None):
         sizes = sizes.astype(jnp.int32)
@@ -435,7 +450,7 @@ def _stream_vote_fn(wire: str, num, den, qual_threshold, qual_cap,
             voted = _gather_dense_vote(
                 bases, quals, sizes, cap=member_cap, num=num, den=den,
                 qual_threshold=qual_threshold, qual_cap=qual_cap,
-                with_qc=with_qc,
+                with_qc=with_qc, policy=policy,
             )
         else:
             m = bases.shape[0]
@@ -475,12 +490,12 @@ def _stream_vote_fn(wire: str, num, den, qual_threshold, qual_cap,
 @lru_cache(maxsize=None)
 def _compiled_stream_vote(wire: str, num, den, qual_threshold, qual_cap,
                           member_cap: int | None, out_len: int | None = None,
-                          with_qc: bool = False):
+                          with_qc: bool = False, policy: str = "majority"):
     """Jitted single-device :func:`_stream_vote_fn`.  Shapes specialize
     inside jit's own cache; the lru key is only the semantics + wire +
-    gather capacity + d2h slice length + QC-rider flag."""
+    gather capacity + d2h slice length + QC-rider flag + vote policy."""
     return jax.jit(_stream_vote_fn(wire, num, den, qual_threshold, qual_cap,
-                                   member_cap, out_len, with_qc))
+                                   member_cap, out_len, with_qc, policy))
 
 
 def encode_member_batch(batch):
@@ -589,6 +604,13 @@ def _run_member_batch_stream(batches, config: ConsensusConfig,
         prefetch_depth = DEFAULT_DEPTH
     num, den = config.cutoff_rational
     qt, qc = int(config.qual_threshold), int(config.qual_cap)
+    # Resolved once per stream: the policy is installed for the stage's
+    # whole run (set_vote_policy), and one stream must not mix programs.
+    policy = get_vote_policy().name
+    if policy != "majority" and mesh is not None:
+        raise ValueError(
+            f"vote policy {policy!r} is single-device only — the mesh "
+            "stream wire shards the hand-unrolled majority program")
     # QC rider: armed by the stage around its device loop (obs.qc plane
     # sink); single-device only — the mesh path's rows come back in
     # per-device block order, so its per-family masks don't line up here.
@@ -607,7 +629,8 @@ def _run_member_batch_stream(batches, config: ConsensusConfig,
         out_len = int(batch.lengths.max(initial=0))
         out_len = -(-out_len // 8) * 8 or None
         obs_metrics.note_compile(
-            ("stream", wire, num, den, qt, qc, member_cap, out_len, with_qc)
+            ("stream", wire, num, den, qt, qc, member_cap, out_len, with_qc,
+             policy)
             + np.shape(a))
         with obs_trace.span("device.dispatch", histogram="device_dispatch_s",
                             wire=wire, n_real=batch.n_real):
@@ -618,7 +641,7 @@ def _run_member_batch_stream(batches, config: ConsensusConfig,
                                            num, den, qt, qc, member_cap,
                                            out_len)
             fn = _compiled_stream_vote(wire, num, den, qt, qc, member_cap,
-                                       out_len, with_qc)
+                                       out_len, with_qc, policy)
             lengths = (np.asarray(batch.lengths, dtype=np.int32)
                        if with_qc else None)
             obs_metrics.note_transfer(

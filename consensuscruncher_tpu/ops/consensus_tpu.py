@@ -31,7 +31,7 @@ bucketing policy in ``parallel.batching``).
 from __future__ import annotations
 
 from dataclasses import dataclass
-from functools import lru_cache, partial
+from functools import lru_cache
 
 import jax
 import jax.numpy as jnp
@@ -45,6 +45,8 @@ from consensuscruncher_tpu.core.consensus_cpu import (
 )
 from consensuscruncher_tpu.obs import metrics as obs_metrics
 from consensuscruncher_tpu.obs import trace as obs_trace
+from consensuscruncher_tpu.policies.base import get_policy, get_vote_policy
+from consensuscruncher_tpu.policies.majority import majority_family_vote
 from consensuscruncher_tpu.utils.phred import N, NUM_BASES, PAD
 
 
@@ -61,55 +63,11 @@ class ConsensusConfig:
         return cutoff_fraction(self.cutoff)
 
 
-def _consensus_one_family(bases, quals, fam_size, *, num, den, qual_threshold,
-                          qual_cap, with_qc=False):
-    """Consensus of one padded family: (F, L) uint8 -> (L,) uint8 pair.
-
-    ``with_qc``: additionally return the QC rider — per-position total
-    votes and votes disagreeing with the modal base, both pure
-    reductions of the ``counts`` plane the vote already built (obs.qc;
-    zero extra operands, zero extra h2d).  The consensus outputs are
-    bit-identical either way.
-    """
-    fam_cap, _length = bases.shape
-    member = (jnp.arange(fam_cap, dtype=jnp.int32) < fam_size)[:, None]  # (F, 1)
-
-    eff = jnp.where(quals >= qual_threshold, bases, jnp.uint8(N))
-    eff = jnp.where(member, eff, jnp.uint8(PAD))  # padded slots never vote
-
-    lanes = jnp.arange(NUM_BASES, dtype=jnp.uint8)
-    onehot = eff[:, :, None] == lanes  # (F, L, 5) bool
-    counts = onehot.sum(axis=0, dtype=jnp.int32)  # (L, 5)
-    member_idx = jnp.arange(fam_cap, dtype=jnp.int32)[:, None, None]
-    first_seen = jnp.where(onehot, member_idx, fam_cap).min(axis=0)  # (L, 5)
-
-    # Lexicographic (count desc, first_seen asc) WITHOUT a combined score
-    # product (which would overflow int32 for huge family buckets; JAX
-    # silently downcasts int64 when x64 is off, so int32-safe algebra is the
-    # only reliable form): take the max count, then argmin first-seen among
-    # the bases achieving it.
-    max_count = counts.max(axis=1)  # (L,)
-    cand_first = jnp.where(counts == max_count[:, None], first_seen, fam_cap + 1)
-    modal = cand_first.argmin(axis=1).astype(jnp.int32)  # (L,)
-
-    # Static trace-time guard: the rational-cutoff cross-multiply must fit
-    # int32 (den <= 1000 from cutoff_fraction, so this allows fam_cap ~2M).
-    if fam_cap * max(den, num) >= 2**31:
-        raise ValueError(
-            f"family bucket {fam_cap} with cutoff {num}/{den} would overflow "
-            "the int32 cutoff compare — split the family or coarsen the cutoff"
-        )
-    passed = (modal != N) & (max_count * den >= num * fam_size) & (fam_size > 0)
-
-    agree = (bases == modal[None, :].astype(jnp.uint8)) & (quals >= qual_threshold) & member
-    qsum = jnp.where(agree, quals.astype(jnp.int32), 0).sum(axis=0)  # (L,)
-
-    out_base = jnp.where(passed, modal, N).astype(jnp.uint8)
-    out_qual = jnp.where(passed, jnp.minimum(qsum, qual_cap), 0).astype(jnp.uint8)
-    if with_qc:
-        votes = counts.sum(axis=1)  # (L,) valid member votes (PAD never a lane)
-        return out_base, out_qual, votes, votes - max_count
-    return out_base, out_qual
+# The reference per-family vote now lives in ``policies.majority`` (the
+# golden-pinned default of the pluggable policy subsystem); re-exported
+# under the old name for the segment/mesh kernels that compose with it
+# directly and for external callers.
+_consensus_one_family = majority_family_vote
 
 
 # Per-shape kernel selection hook, installed by the occupancy autotuner
@@ -134,14 +92,18 @@ def get_kernel_policy():
 
 @lru_cache(maxsize=None)
 def _compiled_batch_fn(num: int, den: int, qual_threshold: int, qual_cap: int,
-                       with_qc: bool = False):
-    """One jitted vmapped program per consensus config (shapes specialize
-    further inside jit's own cache, bounded by the bucketing policy).
+                       with_qc: bool = False, policy: str = "majority"):
+    """One jitted vmapped program per (consensus config, vote policy)
+    pair (shapes specialize further inside jit's own cache, bounded by
+    the bucketing policy).
 
     ``with_qc``: the program also returns the batch-summed ``(L,)`` QC
-    vote/disagree vectors (obs.qc rider) — consensus planes unchanged."""
-    fn = partial(
-        _consensus_one_family, num=num, den=den, qual_threshold=qual_threshold,
+    vote/disagree vectors (obs.qc rider) — consensus planes unchanged.
+    ``policy``: registered vote-policy name; the majority default's
+    ``family_vote_fn`` is the verbatim reference program, so the default
+    cache entries trace the identical jaxpr they always did."""
+    fn = get_policy(policy).family_vote_fn(
+        num=num, den=den, qual_threshold=qual_threshold,
         qual_cap=qual_cap, with_qc=with_qc
     )
     vm = jax.vmap(fn, in_axes=(0, 0, 0))
@@ -197,18 +159,24 @@ def consensus_batch(
 
     num, den = config.cutoff_rational
     b = np.asarray(bases)
-    if _kernel_policy is not None and _kernel_policy(b.shape) == "pallas":
+    vote_policy = get_vote_policy()
+    if (vote_policy.name == "majority" and _kernel_policy is not None
+            and _kernel_policy(b.shape) == "pallas"):
+        # The Pallas kernel hard-codes the majority vote in its VMEM
+        # accumulator; other policies stay on the dense XLA path (which
+        # is also where consensus_batch_pallas falls back to for them).
         from consensuscruncher_tpu.ops.consensus_pallas import consensus_batch_pallas
 
         return consensus_batch_pallas(b, quals, fam_sizes, config)
     sink = obs_qc.plane_sink()
     with_qc = sink is not None
     fn = _compiled_batch_fn(num, den, int(config.qual_threshold),
-                            int(config.qual_cap), with_qc)
+                            int(config.qual_cap), with_qc, vote_policy.name)
     # XLA's jit cache keys on (static config, padded shape): first sighting
     # of this signature in the process is a compile
     obs_metrics.note_compile(
-        (num, den, int(config.qual_threshold), int(config.qual_cap), with_qc)
+        (num, den, int(config.qual_threshold), int(config.qual_cap), with_qc,
+         vote_policy.name)
         + b.shape)
     obs_metrics.note_transfer(
         "h2d", b.nbytes + np.asarray(quals).nbytes + np.asarray(fam_sizes, dtype=np.int32).nbytes)

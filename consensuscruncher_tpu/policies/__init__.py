@@ -1,0 +1,55 @@
+"""Pluggable consensus vote policies.
+
+Importing this package registers the three built-ins (majority,
+delegation, distilled) and exposes the registry + selection hook the
+kernel wires dispatch through.  See ``policies/base.py`` for the plane
+protocol and README "Consensus policies" for when each policy wins.
+"""
+
+from consensuscruncher_tpu.policies.base import (
+    DEFAULT_POLICY,
+    VotePolicy,
+    available_policies,
+    family_planes,
+    get_policy,
+    get_vote_policy,
+    modal_with_tiebreak,
+    register_policy,
+    set_vote_policy,
+)
+from consensuscruncher_tpu.policies.majority import (
+    MajorityPolicy,
+    majority_family_vote,
+)
+from consensuscruncher_tpu.policies.delegation import (
+    DELEGATE_THRESHOLD,
+    DelegationPolicy,
+    delegated_weights,
+)
+from consensuscruncher_tpu.policies.distilled import (
+    CHECKPOINT_ENV,
+    DistilledPolicy,
+    checkpoint_path,
+    load_checkpoint,
+)
+
+__all__ = [
+    "DEFAULT_POLICY",
+    "DELEGATE_THRESHOLD",
+    "CHECKPOINT_ENV",
+    "VotePolicy",
+    "MajorityPolicy",
+    "DelegationPolicy",
+    "DistilledPolicy",
+    "available_policies",
+    "checkpoint_path",
+    "delegated_weights",
+    "family_planes",
+    "get_policy",
+    "get_vote_policy",
+    "load_checkpoint",
+    "majority_family_vote",
+    "modal_with_tiebreak",
+    "register_policy",
+    "set_vote_policy",
+]

@@ -1,0 +1,196 @@
+"""Pluggable consensus vote policies — the registry and the wire contract.
+
+The vote step of every kernel wire (dense XLA ``ops.consensus_tpu``,
+Pallas ``ops.consensus_pallas``, member-stream ``ops.consensus_segment``)
+reduces a padded ``(family, position)`` pair of member planes into one
+consensus ``(position,)`` base/quality pair.  This module turns the
+*rule* applied to those planes into a pluggable :class:`VotePolicy`:
+
+- ``decide(counts, quals, lengths) -> (bases, phreds, fail_mask)`` is the
+  plane-level protocol: ``counts`` is the effective one-hot vote plane
+  ``(F, L, NUM_BASES)`` (bool; quality-demoted members vote the N lane,
+  padded member slots vote no lane), ``quals`` the member-masked Phred
+  plane ``(F, L)`` int32, ``lengths`` the family's true member count.
+  ``fail_mask`` marks positions the policy abstains on (emitted as N/0).
+- :meth:`VotePolicy.family_vote_fn` adapts ``decide`` to the per-family
+  callable signature the kernels ``vmap``/gather over — the single
+  entry point behind the dense-XLA, Pallas-fallback, and stream wires.
+
+Selection mirrors ``ops.consensus_tpu.set_kernel_policy``: a module
+global installed once per stage/gang (``set_vote_policy``) and read by
+every kernel call site (``get_vote_policy``), so the choice applies to
+stages, serve gangs, and bench without threading a parameter through
+every signature.  The default is always ``majority`` — the reference
+rational-cutoff vote, byte-identical to the committed goldens.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from consensuscruncher_tpu.utils.phred import N, NUM_BASES, PAD
+
+#: The policy every wire runs when nothing was installed — the reference
+#: rational-cutoff majority vote (golden-pinned).
+DEFAULT_POLICY = "majority"
+
+
+def family_planes(bases, quals, fam_size, *, qual_threshold):
+    """Member planes -> the plane-level ``decide`` operands.
+
+    Reproduces exactly the effective-vote construction of the reference
+    kernel (``policies.majority.majority_family_vote``): members below
+    the quality threshold vote N, padded member slots vote nothing (PAD
+    matches no lane), and the qual plane is masked to real members.
+    """
+    fam_cap, _length = bases.shape
+    member = (jnp.arange(fam_cap, dtype=jnp.int32) < fam_size)[:, None]  # (F, 1)
+    eff = jnp.where(quals >= qual_threshold, bases, jnp.uint8(N))
+    eff = jnp.where(member, eff, jnp.uint8(PAD))
+    lanes = jnp.arange(NUM_BASES, dtype=jnp.uint8)
+    onehot = eff[:, :, None] == lanes  # (F, L, NUM_BASES) bool
+    mq = jnp.where(member, quals.astype(jnp.int32), 0)  # (F, L)
+    return onehot, mq
+
+
+def modal_with_tiebreak(votes):
+    """Shared lexicographic (count desc, first-seen asc) modal pick over a
+    ``(F, L, NUM_BASES)`` bool vote plane -> ``(modal, max_count)``.
+
+    Same tie-break as the reference (CPython ``Counter.most_common``
+    insertion order): among bases at the max count, the one first voted
+    by the earliest member wins.  Int32-safe (no combined score product).
+    """
+    fam_cap = votes.shape[0]
+    counts = votes.sum(axis=0, dtype=jnp.int32)  # (L, NUM_BASES)
+    member_idx = jnp.arange(fam_cap, dtype=jnp.int32)[:, None, None]
+    first_seen = jnp.where(votes, member_idx, fam_cap).min(axis=0)
+    max_count = counts.max(axis=1)  # (L,)
+    cand_first = jnp.where(counts == max_count[:, None], first_seen, fam_cap + 1)
+    modal = cand_first.argmin(axis=1).astype(jnp.int32)  # (L,)
+    return modal, max_count
+
+
+class VotePolicy:
+    """One consensus vote rule over the family count/qual planes.
+
+    Subclasses set :attr:`name` and implement :meth:`decide`.  Policies
+    must be pure jnp (they run inside the kernels' jitted programs) and
+    deterministic — the serve plane's result cache and journal key on
+    the policy *name*, so a name must always produce the same bytes.
+    """
+
+    #: registry key; also the ``--policy`` CLI value and the closed obs
+    #: label value (``obs.registry.POLICY_NAMES``)
+    name: str = "?"
+
+    def decide(self, counts, quals, lengths, *, num, den, qual_threshold,
+               qual_cap):
+        """Plane-level vote: ``(F, L, B)`` one-hot counts + ``(F, L)``
+        masked quals + family size -> ``(bases, phreds, fail_mask)``
+        (each ``(L,)``; fail positions are masked to N/0 by the wire
+        adapters)."""
+        raise NotImplementedError
+
+    def family_vote_fn(self, *, num, den, qual_threshold, qual_cap,
+                       with_qc=False):
+        """Per-family kernel callable ``(bases, quals, fam_size) ->
+        (out_base, out_qual[, votes, disagree])`` — the signature every
+        wire (dense vmap, stream gather, Pallas fallback) consumes.
+
+        The QC rider (total votes / disagree-with-modal per position) is
+        a property of the member planes, not of the policy's choice, so
+        it stays policy-independent — per-policy QC spectra remain
+        comparable in ``cct qc report``.
+        """
+
+        def fn(bases, quals, fam_size):
+            onehot, mq = family_planes(bases, quals, fam_size,
+                                       qual_threshold=qual_threshold)
+            out_b, out_q, fail = self.decide(
+                onehot, mq, fam_size, num=num, den=den,
+                qual_threshold=qual_threshold, qual_cap=qual_cap)
+            out_b = jnp.where(fail, jnp.uint8(N), out_b).astype(jnp.uint8)
+            out_q = jnp.where(fail, 0, out_q).astype(jnp.uint8)
+            if with_qc:
+                counts = onehot.sum(axis=0, dtype=jnp.int32)
+                votes = counts.sum(axis=1)
+                return out_b, out_q, votes, votes - counts.max(axis=1)
+            return out_b, out_q
+
+        return fn
+
+
+# ------------------------------------------------------------- registry
+
+_REGISTRY: dict[str, VotePolicy] = {}
+
+
+def register_policy(policy: VotePolicy) -> VotePolicy:
+    """Register a policy instance under its name (import-time; the three
+    built-ins register when ``consensuscruncher_tpu.policies`` loads)."""
+    if not policy.name or policy.name == "?":
+        raise ValueError("vote policy must set a name")
+    _REGISTRY[policy.name] = policy
+    return policy
+
+
+def _ensure_builtins() -> None:
+    """Load the built-in policy modules for their registration side
+    effects — kernels import only this module, so resolution by name
+    must not depend on who imported the package first."""
+    from consensuscruncher_tpu.policies import (  # noqa: F401
+        delegation,
+        distilled,
+        majority,
+    )
+
+
+def available_policies() -> tuple[str, ...]:
+    """Registered policy names, sorted (the ``--policy`` vocabulary)."""
+    _ensure_builtins()
+    return tuple(sorted(_REGISTRY))
+
+
+def get_policy(name: str) -> VotePolicy:
+    """Resolve a policy by name; unknown names raise the ValueError the
+    serve admission path surfaces as a typed ``bad_request`` refusal."""
+    _ensure_builtins()
+    policy = _REGISTRY.get(str(name))
+    if policy is None:
+        raise ValueError(
+            f"unknown vote policy {name!r}; expected one of "
+            f"{available_policies()}")
+    return policy
+
+
+# ------------------------------------------- module-global selection hook
+#
+# Same shape as ``ops.consensus_tpu.set_kernel_policy``: installed once
+# (stage entry, serve gang dispatch) and read by every kernel call site.
+# ``None`` means the golden-pinned default.
+
+_vote_policy: VotePolicy | None = None
+
+
+def set_vote_policy(policy) -> None:
+    """Install the active vote policy: a name, a :class:`VotePolicy`, or
+    ``None`` to restore the majority default."""
+    global _vote_policy
+    if policy is None or isinstance(policy, VotePolicy):
+        _vote_policy = policy
+    else:
+        _vote_policy = get_policy(str(policy))
+
+
+def get_vote_policy() -> VotePolicy:
+    """The active policy (the majority default when none installed)."""
+    if _vote_policy is not None:
+        return _vote_policy
+    return get_policy(DEFAULT_POLICY)
+
+
+def installed_vote_policy() -> VotePolicy | None:
+    """The raw installed hook value (``None`` = default) — for callers
+    that install temporarily and must restore the prior state exactly."""
+    return _vote_policy

@@ -1,0 +1,107 @@
+"""Delegation policy: low-quality reads delegate their vote weight to
+high-quality same-family reads.
+
+Motivation ("When Does Delegation Beat Majority?"): when a family mixes
+a few trustworthy reads with many degraded ones, plain majority either
+drops the position (the noisy votes dilute the modal fraction below the
+cutoff) or — worse — passes a coordinated noise base.  Delegation keeps
+every member's unit of vote *weight* (so the cutoff denominator still
+reflects the whole family) but lets members below a quality floor hand
+their weight to the members above it:
+
+- each member holds weight 1;
+- members with Phred >= ``delegate_threshold`` ("high") keep their
+  weight and vote their own base;
+- members below it ("low") split their weight equally across the high
+  members, voting whatever those delegates vote;
+- when a position has NO high member, nobody can receive weight, so
+  every member keeps its own vote — exact majority semantics (the
+  documented all-low fallback).
+
+**Weight conservation invariant**: total weight per position is always
+exactly ``fam_size`` (delegation moves weight, never creates or drops
+it) — :func:`delegated_weights` exposes the per-member weights so tests
+pin the invariant directly.
+
+**Exact integer form**: with equal splitting, every high member's weight
+is the same ``1 + n_low / n_high``, so base ``b``'s weighted count is
+``count_high[b] * fam_size / n_high`` and the cutoff compare
+``weighted >= (num/den) * fam_size`` reduces to
+
+    ``count_high[b] * den >= num * n_high``
+
+— majority among the high members with the rational cutoff applied to
+``n_high``.  The decide path computes that integer form (same exactness
+discipline as the majority kernel: no float compare anywhere), and the
+float weights exist only for the invariant/tests.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from consensuscruncher_tpu.policies.base import (
+    VotePolicy,
+    modal_with_tiebreak,
+    register_policy,
+)
+from consensuscruncher_tpu.utils.phred import N
+
+#: Phred floor for a member to keep its own vote.  Chosen between the
+#: simulator's degraded-read band (<= 15) and its healthy band (>= 25);
+#: part of the policy's identity — changing it changes output bytes, so
+#: it is a class constant, not a tunable.
+DELEGATE_THRESHOLD = 20
+
+
+def delegated_weights(quals, member, fam_size, threshold=DELEGATE_THRESHOLD):
+    """Per-member, per-position float vote weights ``(F, L)``.
+
+    Documents (and lets tests pin) the conservation invariant:
+    ``weights.sum(axis=0) == member.sum(axis=0)`` everywhere — the total
+    weight is the member count, delegated or not.
+    """
+    quals = jnp.asarray(quals)
+    member = jnp.asarray(member)
+    high = member & (quals >= threshold)
+    n_high = high.sum(axis=0)
+    n_low = member.sum(axis=0) - n_high
+    w_high = 1.0 + n_low / jnp.maximum(n_high, 1)
+    weights = jnp.where(high, w_high[None, :], 0.0)
+    # all-low fallback: no delegate exists, everyone keeps their weight
+    return jnp.where((n_high == 0)[None, :], member * 1.0, weights)
+
+
+class DelegationPolicy(VotePolicy):
+    """Quality-threshold delegation with weight conservation (see module
+    docstring for the exact integer reformulation)."""
+
+    name = "delegation"
+    delegate_threshold = DELEGATE_THRESHOLD
+
+    def decide(self, counts, quals, lengths, *, num, den, qual_threshold,
+               qual_cap):
+        fam_cap = counts.shape[0]
+        if fam_cap * max(den, num) >= 2**31:
+            raise ValueError(
+                f"family bucket {fam_cap} with cutoff {num}/{den} would "
+                "overflow the int32 cutoff compare")
+        member = counts.any(axis=-1)  # (F, L) — padded slots vote no lane
+        high = member & (quals >= self.delegate_threshold)
+        n_high = high.sum(axis=0, dtype=jnp.int32)  # (L,)
+        use_all = n_high == 0
+        active = jnp.where(use_all[None, :], member, high)  # (F, L)
+        votes = counts & active[:, :, None]  # (F, L, 5)
+        modal, max_count = modal_with_tiebreak(votes)
+        # exact integer cutoff over the active voter count (== weighted
+        # compare over the conserved fam_size total; module docstring)
+        n_active = jnp.where(use_all, lengths, n_high)
+        passed = (modal != N) & (max_count * den >= num * n_active) & (lengths > 0)
+        qsums = (votes * quals[:, :, None]).sum(axis=0)  # (L, 5)
+        qsum = jnp.take_along_axis(qsums, modal[:, None], axis=1)[:, 0]
+        return (modal.astype(jnp.uint8),
+                jnp.minimum(qsum, qual_cap).astype(jnp.uint8),
+                ~passed)
+
+
+register_policy(DelegationPolicy())

@@ -1,0 +1,161 @@
+"""Distilled policy: a small pure-JAX error-correction head over the
+per-position count/qual features.
+
+Motivation (knowledge distillation for DNA sequence correction): the
+count/qual planes the kernels already assemble carry more signal than
+the one rational-cutoff compare uses — how the quality mass is split
+across bases, how large the family is.  A tiny per-position MLP
+(``features -> tanh hidden -> 5 base logits``) is trained offline by
+``tools/distill_train.py`` against ``utils.simulate`` truth sets (clean
+and degraded-read regimes mixed), and its weights ship as a versioned,
+committed checkpoint — the policy is a frozen artifact, not a runtime
+learner, so a checkpoint version always produces the same bytes.
+
+Features per position (11): the 5 lane count fractions, the 5 lane
+quality-mass fractions (each lane's Phred sum over ``fam_size *
+qual_cap``), and the clipped family size.  The head votes the argmax
+lane and abstains (fail mask -> N/0) when the softmax confidence falls
+below :data:`CONFIDENCE_FLOOR` or the argmax is the N lane — abstention
+is what keeps the distilled head's called-base error at or below raw
+reads even on families it cannot rescue.
+
+Checkpoint resolution: ``CCT_DISTILLED_CHECKPOINT`` (environment) wins,
+else the committed ``policies/checkpoints/distilled_v1.json``.  The
+file records its training provenance under ``meta`` (tool, seed,
+regime mix, held-out accuracy) — see README "Consensus policies".
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from consensuscruncher_tpu.policies.base import VotePolicy, register_policy
+from consensuscruncher_tpu.utils.phred import N, NUM_BASES
+
+#: Committed checkpoint (see tools/distill_train.py for provenance).
+CHECKPOINT_NAME = "distilled_v1.json"
+CHECKPOINT_ENV = "CCT_DISTILLED_CHECKPOINT"
+
+#: Softmax confidence below which the head abstains (votes N).  Part of
+#: the policy's identity, like the delegation threshold.
+CONFIDENCE_FLOOR = 0.5
+
+#: Family-size feature clip (sizes past this carry no extra signal).
+FAM_CLIP = 32.0
+
+N_FEATURES = 2 * NUM_BASES + 1
+
+
+def checkpoint_path() -> str:
+    env = os.environ.get(CHECKPOINT_ENV)
+    if env:
+        return env
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "checkpoints", CHECKPOINT_NAME)
+
+
+def load_checkpoint(path: str | None = None) -> dict:
+    """Parse + validate a checkpoint file into float32 weight arrays.
+    Raises ValueError on a structurally unusable file (wrong version or
+    shapes) — weight *values* are not attested here; a silently
+    corrupted checkpoint is caught downstream by tools/qc_gate.py's
+    per-policy accuracy gate (the CI positive control)."""
+    path = path or checkpoint_path()
+    with open(path) as fh:
+        doc = json.load(fh)
+    if doc.get("version") != 1 or doc.get("policy") != "distilled":
+        raise ValueError(f"not a distilled-policy checkpoint: {path}")
+    params = {}
+    for key in ("w1", "b1", "w2", "b2"):
+        params[key] = np.asarray(doc[key], dtype=np.float32)
+    hidden = params["b1"].shape[0]
+    want = {"w1": (N_FEATURES, hidden), "b1": (hidden,),
+            "w2": (hidden, NUM_BASES), "b2": (NUM_BASES,)}
+    for key, shape in want.items():
+        if params[key].shape != shape:
+            raise ValueError(
+                f"checkpoint {path}: {key} has shape {params[key].shape}, "
+                f"want {shape}")
+    params["meta"] = doc.get("meta") or {}
+    return params
+
+
+def features(counts, qsums, lengths, *, qual_cap):
+    """Per-position feature plane ``(L, 11)`` from the ``(L, 5)`` lane
+    counts and Phred sums plus the family size (normalized, clipped).
+
+    ``lengths`` is the family size — a scalar on the kernel path (one
+    family per call), or ``(L,)`` when the training tool scores a batch
+    of independent positions drawn from different families.
+    """
+    length = counts.shape[0]
+    fam = jnp.broadcast_to(
+        jnp.maximum(jnp.asarray(lengths, jnp.float32), 1.0), (length,))
+    f_counts = counts.astype(jnp.float32) / fam[:, None]
+    f_quals = qsums.astype(jnp.float32) / (fam[:, None] * float(qual_cap))
+    f_fam = (jnp.minimum(fam, FAM_CLIP) / FAM_CLIP)[:, None]
+    return jnp.concatenate([f_counts, f_quals, f_fam], axis=1)
+
+
+def forward(params, feats):
+    """The head itself: ``(L, 11)`` features -> ``(L, 5)`` base logits."""
+    h = jnp.tanh(feats @ jnp.asarray(params["w1"]) + jnp.asarray(params["b1"]))
+    return h @ jnp.asarray(params["w2"]) + jnp.asarray(params["b2"])
+
+
+@lru_cache(maxsize=4)
+def _jitted_forward(ckpt_path: str):
+    """Standalone jitted forward for host-side callers (the training
+    tool's eval loop, determinism tests); the kernel wires instead trace
+    :func:`forward` inside their own jitted programs."""
+    params = load_checkpoint(ckpt_path)
+    return jax.jit(lambda feats: forward(params, feats))
+
+
+def checkpoint_forward(feats, path: str | None = None):
+    return _jitted_forward(path or checkpoint_path())(jnp.asarray(feats))
+
+
+class DistilledPolicy(VotePolicy):
+    """Frozen distilled-NN head (see module docstring)."""
+
+    name = "distilled"
+
+    def __init__(self, checkpoint: str | None = None):
+        self._checkpoint = checkpoint
+        self._params = None
+        self._params_path = None
+
+    def params(self) -> dict:
+        # Re-resolve per call-path entry: the env override must win even
+        # when it changes after first use (each kernel program is keyed
+        # by policy name + config, compiled once per process).
+        path = self._checkpoint or checkpoint_path()
+        if self._params is None or self._params_path != path:
+            self._params = load_checkpoint(path)
+            self._params_path = path
+        return self._params
+
+    def decide(self, counts, quals, lengths, *, num, den, qual_threshold,
+               qual_cap):
+        params = self.params()
+        c = counts.sum(axis=0, dtype=jnp.int32)  # (L, 5)
+        qsums = (counts * quals[:, :, None]).sum(axis=0)  # (L, 5)
+        logits = forward(params, features(c, qsums, lengths, qual_cap=qual_cap))
+        base = jnp.argmax(logits, axis=1).astype(jnp.int32)  # (L,)
+        probs = jax.nn.softmax(logits, axis=1)
+        conf = jnp.max(probs, axis=1)
+        fail = (base == N) | (conf < CONFIDENCE_FLOOR) | (lengths <= 0)
+        qsum = jnp.take_along_axis(qsums, base[:, None], axis=1)[:, 0]
+        return (base.astype(jnp.uint8),
+                jnp.minimum(qsum, qual_cap).astype(jnp.uint8),
+                fail)
+
+
+register_policy(DistilledPolicy())

@@ -1,0 +1,118 @@
+"""Majority policy: the reference rational-cutoff vote, extracted intact.
+
+This is the default everywhere and the only policy pinned byte-identical
+to the committed goldens on the staged, streaming, and serve wires.  The
+math is the untouched body of the original
+``ops.consensus_tpu._consensus_one_family`` (reference parity:
+``ConsensusCruncher/consensus_helper.py:consensus_maker``, SURVEY.md
+§3.3) — moved here so every policy lives in one subsystem;
+``ops.consensus_tpu`` re-exports it under the old name for the segment
+and mesh kernels that compose with it directly.
+
+:class:`MajorityPolicy` overrides :meth:`~VotePolicy.family_vote_fn` to
+return this exact function rather than routing through the generic
+plane adapter, so the default path's program is the same traced jaxpr
+as before the policy subsystem existed — golden parity by construction,
+not by equivalence argument.  ``decide`` implements the identical rule
+over the plane protocol for callers (tests, the distillation teacher)
+that work at that level.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax.numpy as jnp
+
+from consensuscruncher_tpu.policies.base import (
+    VotePolicy,
+    modal_with_tiebreak,
+    register_policy,
+)
+from consensuscruncher_tpu.utils.phred import N, NUM_BASES, PAD
+
+
+def majority_family_vote(bases, quals, fam_size, *, num, den, qual_threshold,
+                         qual_cap, with_qc=False):
+    """Consensus of one padded family: (F, L) uint8 -> (L,) uint8 pair.
+
+    ``with_qc``: additionally return the QC rider — per-position total
+    votes and votes disagreeing with the modal base, both pure
+    reductions of the ``counts`` plane the vote already built (obs.qc;
+    zero extra operands, zero extra h2d).  The consensus outputs are
+    bit-identical either way.
+    """
+    fam_cap, _length = bases.shape
+    member = (jnp.arange(fam_cap, dtype=jnp.int32) < fam_size)[:, None]  # (F, 1)
+
+    eff = jnp.where(quals >= qual_threshold, bases, jnp.uint8(N))
+    eff = jnp.where(member, eff, jnp.uint8(PAD))  # padded slots never vote
+
+    lanes = jnp.arange(NUM_BASES, dtype=jnp.uint8)
+    onehot = eff[:, :, None] == lanes  # (F, L, 5) bool
+    counts = onehot.sum(axis=0, dtype=jnp.int32)  # (L, 5)
+    member_idx = jnp.arange(fam_cap, dtype=jnp.int32)[:, None, None]
+    first_seen = jnp.where(onehot, member_idx, fam_cap).min(axis=0)  # (L, 5)
+
+    # Lexicographic (count desc, first_seen asc) WITHOUT a combined score
+    # product (which would overflow int32 for huge family buckets; JAX
+    # silently downcasts int64 when x64 is off, so int32-safe algebra is the
+    # only reliable form): take the max count, then argmin first-seen among
+    # the bases achieving it.
+    max_count = counts.max(axis=1)  # (L,)
+    cand_first = jnp.where(counts == max_count[:, None], first_seen, fam_cap + 1)
+    modal = cand_first.argmin(axis=1).astype(jnp.int32)  # (L,)
+
+    # Static trace-time guard: the rational-cutoff cross-multiply must fit
+    # int32 (den <= 1000 from cutoff_fraction, so this allows fam_cap ~2M).
+    if fam_cap * max(den, num) >= 2**31:
+        raise ValueError(
+            f"family bucket {fam_cap} with cutoff {num}/{den} would overflow "
+            "the int32 cutoff compare — split the family or coarsen the cutoff"
+        )
+    passed = (modal != N) & (max_count * den >= num * fam_size) & (fam_size > 0)
+
+    agree = (bases == modal[None, :].astype(jnp.uint8)) & (quals >= qual_threshold) & member
+    qsum = jnp.where(agree, quals.astype(jnp.int32), 0).sum(axis=0)  # (L,)
+
+    out_base = jnp.where(passed, modal, N).astype(jnp.uint8)
+    out_qual = jnp.where(passed, jnp.minimum(qsum, qual_cap), 0).astype(jnp.uint8)
+    if with_qc:
+        votes = counts.sum(axis=1)  # (L,) valid member votes (PAD never a lane)
+        return out_base, out_qual, votes, votes - max_count
+    return out_base, out_qual
+
+
+class MajorityPolicy(VotePolicy):
+    """Exact rational-cutoff majority: modal base with first-seen
+    tie-break passes iff ``count * den >= num * fam_size`` (exact integer
+    compare, immune to float boundary wobble)."""
+
+    name = "majority"
+
+    def decide(self, counts, quals, lengths, *, num, den, qual_threshold,
+               qual_cap):
+        fam_cap = counts.shape[0]
+        if fam_cap * max(den, num) >= 2**31:
+            raise ValueError(
+                f"family bucket {fam_cap} with cutoff {num}/{den} would "
+                "overflow the int32 cutoff compare")
+        modal, max_count = modal_with_tiebreak(counts)
+        passed = (modal != N) & (max_count * den >= num * lengths) & (lengths > 0)
+        qsums = (counts * quals[:, :, None]).sum(axis=0)  # (L, 5)
+        qsum = jnp.take_along_axis(qsums, modal[:, None], axis=1)[:, 0]
+        return (modal.astype(jnp.uint8),
+                jnp.minimum(qsum, qual_cap).astype(jnp.uint8),
+                ~passed)
+
+    def family_vote_fn(self, *, num, den, qual_threshold, qual_cap,
+                       with_qc=False):
+        # The untouched reference program — identical jaxpr to the
+        # pre-policy kernels, so goldens stay byte-identical by
+        # construction on every wire.
+        return partial(majority_family_vote, num=num, den=den,
+                       qual_threshold=qual_threshold, qual_cap=qual_cap,
+                       with_qc=with_qc)
+
+
+register_policy(MajorityPolicy())

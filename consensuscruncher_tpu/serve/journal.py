@@ -67,9 +67,12 @@ from consensuscruncher_tpu.utils.manifest import commit_file
 #: but both fields are omitted when absent so pre-tenancy specs keep
 #: their historical keys.  ``input_range`` is identity too: two shards
 #: of the same input are different jobs with different outputs.
+#: ``policy`` (the consensus vote policy, ISSUE 17) is identity — it
+#: changes the output bytes — and is likewise omitted when absent, so a
+#: default (majority) submit keeps its pre-policy key.
 KEY_FIELDS = ("input", "output", "name", "cutoff", "qualscore", "scorrect",
               "max_mismatch", "bdelim", "compress_level", "tenant", "qos",
-              "input_range")
+              "input_range", "policy")
 
 #: The pre-v2 field set (no ``input_range``, no version pin) — kept so
 #: :func:`legacy_idempotency_key` can resolve keys written by journals

@@ -10,8 +10,9 @@ work.  This module keys results by what actually determines the bytes:
 
   content digest = sha256 over the sorted-keys compact JSON of
     {input fingerprint (``manifest.fingerprint``: size + head/tail
-     hashes), derived job name, consensus policy fields (cutoff,
-     qualscore, scorrect, max_mismatch, bdelim, compress_level),
+     hashes), derived job name, the consensus vote *parameters*
+     (cutoff, qualscore, scorrect, max_mismatch, bdelim,
+     compress_level), the vote *policy* name (when non-default),
      input_range (when sharded), package ``__version__``}
 
 ``tenant``, ``qos``, ``output`` and ``deadline_s`` are deliberately
@@ -66,12 +67,16 @@ from consensuscruncher_tpu import __version__
 from consensuscruncher_tpu.utils import faults, sanitize
 from consensuscruncher_tpu.utils.manifest import commit_file, fingerprint
 
-#: Policy fields folded into the content digest.  Together with the
-#: input fingerprint and ``__version__`` these determine the output
-#: bytes; nothing else does (tenant/qos/output/deadline are routing and
-#: accounting concerns, not identity).
+#: Spec fields folded into the content digest.  Together with the input
+#: fingerprint and ``__version__`` these determine the output bytes;
+#: nothing else does (tenant/qos/output/deadline are routing and
+#: accounting concerns, not identity).  ``policy`` — the consensus vote
+#: policy (ISSUE 17) — changes the bytes and so is identity, but like
+#: every field here it folds in only when present: a default (majority)
+#: spec keeps its pre-policy digest, so entries written before the
+#: policy subsystem still hit.
 DIGEST_FIELDS = ("cutoff", "qualscore", "scorrect", "max_mismatch",
-                 "bdelim", "compress_level", "input_range")
+                 "bdelim", "compress_level", "input_range", "policy")
 
 ENTRY_NAME = "entry.json"
 LOCAL_SHARD = "local"

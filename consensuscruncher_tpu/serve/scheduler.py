@@ -2,7 +2,8 @@
 
 The scheduler owns a bounded queue of consensus jobs and a single
 dispatcher thread.  Each dispatch round pops a *gang* of compatible queued
-jobs (same cutoff/qualscore — the compile-time consensus parameters) and
+jobs (same cutoff/qualscore/vote policy — the compile-time consensus
+parameters) and
 runs their SSCS stage as ONE merged device stream: every job's family
 events are interleaved round-robin (``parallel.batching.interleave_sources``)
 into a single ``ops.consensus_tpu.consensus_families`` call, so one bucket
@@ -117,6 +118,7 @@ from consensuscruncher_tpu.obs import trace as obs_trace
 from consensuscruncher_tpu.obs.registry import (
     DEFAULT_QOS,
     DEFAULT_TENANT,
+    POLICY_NAMES,
     QOS_CLASSES,
 )
 from consensuscruncher_tpu.obs.slo import SloMonitor
@@ -387,7 +389,8 @@ class _GangJobState:
             self.singleton_writer.close()
         self.tracker.mark("sort")
 
-    def record(self, cutoff: float, qual_threshold: int, backend: str) -> None:
+    def record(self, cutoff: float, qual_threshold: int, backend: str,
+               policy: str = "majority") -> None:
         """Stats sidecars + the manifest "sscs" entry, mirroring the
         one-shot CLI byte-for-byte so ``--resume`` skips the stage."""
         from consensuscruncher_tpu.utils.backend_probe import record_backend
@@ -397,6 +400,10 @@ class _GangJobState:
         record_backend(self.stats, backend)
         jax_backend = self.stats.get("jax_backend")
         self.stats.set("cutoff", cutoff)
+        if policy != "majority":
+            # non-default only, mirroring run_sscs: default-run stats
+            # sidecars stay byte-identical to the pre-policy goldens
+            self.stats.set("policy", policy)
         self.stats.write(self.paths["stats_txt"])
         self.hist.write(self.paths["families"])
         self.tracker.write(self.paths["time_tracker"])
@@ -416,7 +423,8 @@ class _GangJobState:
             {"cutoff": float(self.spec.get("cutoff", 0.7)),
              "qualscore": int(self.spec.get("qualscore", 0)),
              "bdelim": self.spec.get("bdelim", "|"),
-             "input_range": None},
+             "input_range": None,
+             **({"policy": policy} if policy != "majority" else {})},
         )
 
 
@@ -445,12 +453,18 @@ def gang_sscs(specs: list[dict], counters: Counters | None = None,
     )
     from consensuscruncher_tpu.parallel.batching import interleave_sources
 
+    from consensuscruncher_tpu.policies import base as policies_mod
+
     cutoff = float(specs[0].get("cutoff", 0.7))
     qualscore = int(specs[0].get("qualscore", 0))
+    policy = str(specs[0].get("policy") or "majority")
     for s in specs[1:]:
         if (float(s.get("cutoff", 0.7)), int(s.get("qualscore", 0))) != (cutoff, qualscore):
             raise ValueError("gang jobs must share cutoff/qualscore")
+        if str(s.get("policy") or "majority") != policy:
+            raise ValueError("gang jobs must share a vote policy")
     cfg = ConsensusConfig(cutoff=cutoff, qual_threshold=qualscore)
+    vote_policy = policies_mod.get_policy(policy)
 
     states = [_GangJobState(s) for s in specs]
     tracing = obs_trace.enabled() and trace_ids is not None
@@ -468,6 +482,11 @@ def gang_sscs(specs: list[dict], counters: Counters | None = None,
                 trace_ids=[trace_ids[i] for i in owners])
 
     ok = False
+    # the gang's shared device dispatch runs under the gang's (validated-
+    # shared) vote policy; restore the prior install afterwards so the
+    # daemon's warmup choice survives dispatch rounds
+    prev_policy = policies_mod.installed_vote_policy()
+    policies_mod.set_vote_policy(vote_policy)
     try:
         stream = consensus_families(
             interleave_sources([st.events(i) for i, st in enumerate(states)]),
@@ -484,6 +503,7 @@ def gang_sscs(specs: list[dict], counters: Counters | None = None,
             st.seal()
         ok = True
     finally:
+        policies_mod.set_vote_policy(prev_policy)
         for st in states:
             st.reader.close()
         if not ok:
@@ -494,7 +514,7 @@ def gang_sscs(specs: list[dict], counters: Counters | None = None,
                 "writer.commit",
                 trace_id=trace_ids[i] if trace_ids else None):
             st.close_outputs()
-            st.record(cutoff, qualscore, "tpu")
+            st.record(cutoff, qualscore, "tpu", policy=vote_policy.name)
     return [st.stream_handoff for st in states]
 
 
@@ -660,6 +680,18 @@ class Scheduler:
         if qos not in QOS_CLASSES:
             raise ValueError(
                 f"unknown qos class {qos!r}; expected one of {QOS_CLASSES}")
+        # vote-policy admission (ISSUE 17): normalize BEFORE the key is
+        # computed — an explicit default ("majority") is stripped so it
+        # hashes identically to an absent field (legacy-stable keys and
+        # cache digests) — and unknown names are refused here with the
+        # registry's ValueError (the server's typed bad_request reply)
+        # rather than failing on a warm device mid-dispatch.
+        if spec.get("policy") in ("", "majority"):
+            spec.pop("policy", None)
+        elif spec.get("policy") is not None:
+            from consensuscruncher_tpu.policies.base import get_policy
+
+            get_policy(str(spec["policy"]))
         tenant = str(spec.get("tenant") or DEFAULT_TENANT)
         key = journal_mod.idempotency_key(spec)
         deadline_s = spec.get("deadline_s")
@@ -1580,19 +1612,21 @@ class Scheduler:
 
     def _pop_gang_locked(self) -> list[Job]:
         """Pop up to ``gang_size`` queued jobs sharing the compile-time
-        consensus parameters (cutoff/qualscore) from the stride-chosen qos
-        class (gangs never span classes — fairness accounting stays
-        exact).  Called under the lock."""
+        consensus parameters (cutoff/qualscore/vote policy) from the
+        stride-chosen qos class (gangs never span classes — fairness
+        accounting stays exact).  Called under the lock."""
         qos = self._next_class_locked()
         queue = self._queues[qos]
         gang = [queue.popleft()]
         key = (float(gang[0].spec.get("cutoff", 0.7)),
-               int(gang[0].spec.get("qualscore", 0)))
+               int(gang[0].spec.get("qualscore", 0)),
+               str(gang[0].spec.get("policy") or "majority"))
         kept = deque()
         while queue and len(gang) < self.gang_size:
             job = queue.popleft()
             jkey = (float(job.spec.get("cutoff", 0.7)),
-                    int(job.spec.get("qualscore", 0)))
+                    int(job.spec.get("qualscore", 0)),
+                    str(job.spec.get("policy") or "majority"))
             if jkey == key:
                 gang.append(job)
             else:
@@ -1856,6 +1890,9 @@ class Scheduler:
             argv += ["--input_range", str(spec["input_range"])]
         if spec.get("pipeline"):
             argv += ["--pipeline", str(spec["pipeline"])]
+        if spec.get("policy"):
+            # absent == majority (admission normalized the default away)
+            argv += ["--policy", str(spec["policy"])]
         if "intermediate_taps" in spec:
             argv += ["--intermediate_taps", str(bool(spec["intermediate_taps"]))]
         if resume:
@@ -1963,6 +2000,17 @@ class Scheduler:
                             tenant=job.tenant, qos=job.qos)
         obs_metrics.inc("tenant_qc_rescued", rescued,
                         tenant=job.tenant, qos=job.qos)
+        # per-policy quality attribution (ISSUE 17): ``policy`` is a
+        # CLOSED label — docs stamped with a name outside POLICY_NAMES
+        # (a foreign plugin, a corrupt doc) skip the per-policy series
+        # rather than widening the exposition or failing the job
+        policy = str(doc.get("policy") or "majority")
+        if policy in POLICY_NAMES:
+            obs_metrics.inc("tenant_qc_policy_jobs", 1,
+                            tenant=job.tenant, qos=job.qos, policy=policy)
+            obs_metrics.inc("tenant_qc_policy_sscs_written",
+                            int(yields.get("sscs_written", 0)),
+                            tenant=job.tenant, qos=job.qos, policy=policy)
         disagree = plane.get("disagree_rate")
         if disagree is not None:
             obs_metrics.observe_labeled("tenant_qc_disagreement",

@@ -388,6 +388,12 @@ class ServeServer:
                     "brownout": True}
         except AdmissionRefused as e:
             return {"ok": False, "error": str(e), "refused": True}
+        except ValueError as e:
+            # malformed spec — unknown qos class, unknown vote policy,
+            # missing required fields: a typed bad_request the client
+            # must fix, never retry (the spec hashes identically again)
+            return {"ok": False, "error": str(e), "refused": True,
+                    "bad_request": True}
         except TimeoutError as e:
             return {"ok": False, "error": str(e), "timeout": True}
         except Exception as e:  # surface, never kill the daemon

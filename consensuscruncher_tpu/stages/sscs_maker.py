@@ -48,6 +48,7 @@ from consensuscruncher_tpu.io.encode import (
     RenameRetagWriter,
     cigar_string_to_words,
 )
+from consensuscruncher_tpu.policies import base as policies_mod
 from consensuscruncher_tpu.stages.grouping import MemberView
 from consensuscruncher_tpu.ops.consensus_tpu import ConsensusConfig, consensus_families
 from consensuscruncher_tpu.parallel.batching import rectangularize
@@ -200,6 +201,7 @@ def run_sscs(
     residency=None,
     stream_out=None,
     qc=None,
+    policy: str = "majority",
 ) -> SscsResult:
     """``devices``: shard each family batch across this many chips
     (``parallel.mesh`` family-data-parallel path); None/1 = single device.
@@ -238,11 +240,25 @@ def run_sscs(
     consensus outputs).  The sink is armed only around this stage's device
     loop so concurrent gang jobs never mix batches into a foreign
     accumulator.  Ignored on cpu/reference backends and mesh runs (the
-    per-run yields/spectrum still come from the stats sidecars)."""
+    per-run yields/spectrum still come from the stats sidecars).
+
+    ``policy``: registered consensus vote policy (``policies/``);
+    installed for this stage's device loop and restored on exit.  The
+    ``majority`` default is the golden-pinned reference vote; other
+    policies require the tpu backend (the cpu/reference twins implement
+    only the reference rule) and run single-device."""
     if backend not in ("cpu", "tpu", "reference"):
         raise ValueError(
             f"unknown backend {backend!r} (expected 'cpu', 'tpu', or 'reference')"
         )
+    vote_policy = policies_mod.get_policy(policy)
+    if vote_policy.name != "majority":
+        if backend != "tpu":
+            raise ValueError(
+                f"vote policy {vote_policy.name!r} requires the tpu backend")
+        if devices is not None and devices > 1:
+            raise ValueError(
+                f"vote policy {vote_policy.name!r} is single-device only")
     if wire not in ("stream", "dense"):
         raise ValueError(f"unknown wire {wire!r} (expected 'stream' or 'dense')")
     mesh = None
@@ -456,6 +472,11 @@ def run_sscs(
     qc_armed = qc is not None and backend == "tpu"
     if qc_armed:
         obs_qc.set_plane_sink(qc)
+    # Install the vote policy for this stage's device loop only (same
+    # arm/disarm discipline as the QC sink: concurrent gang jobs must
+    # never inherit a foreign policy).
+    prev_policy = policies_mod.installed_vote_policy()
+    policies_mod.set_vote_policy(vote_policy)
     try:
         if backend == "tpu":
             if use_blocks:
@@ -550,6 +571,7 @@ def run_sscs(
         single_surgery.flush()
         ok = True
     finally:
+        policies_mod.set_vote_policy(prev_policy)
         if qc_armed:
             obs_qc.set_plane_sink(None)
         if prestaged is not None:
@@ -583,6 +605,10 @@ def run_sscs(
     record_backend(stats, backend)
     jax_backend = stats.get("jax_backend")
     stats.set("cutoff", cutoff)
+    if vote_policy.name != "majority":
+        # non-default only: default-run stats sidecars stay byte-stable
+        # against the committed goldens
+        stats.set("policy", vote_policy.name)
     stats.write(paths["stats_txt"])
     hist.write(paths["families"])
     tracker.write(paths["time_tracker"])

@@ -35,6 +35,16 @@ class SimConfig:
     # Hamming-1 from the true family's, the exact population
     # --max_mismatch rescue exists to reclaim.
     barcode_error_rate: float = 0.0
+    # Low-quality regime (ISSUE 17): each read independently degrades
+    # with this probability — its Phred scores drop into ``degraded_qual``
+    # (below the delegation policy's Phred-20 floor; the healthy band at
+    # 25-40 stays above it) and its bases pick up extra substitutions at
+    # ``degraded_error_rate`` on top of ``error_rate``.  All draws
+    # short-circuit at rate 0 so the rng stream — and every committed
+    # golden — is untouched by default, exactly like barcode_error_rate.
+    degraded_read_rate: float = 0.0
+    degraded_error_rate: float = 0.08
+    degraded_qual: tuple = (3, 16)
     seed: int = 0
     bdelim: str = DEFAULT_BDELIM
 
@@ -99,6 +109,13 @@ def simulate_bam(path: str, cfg: SimConfig) -> SimTruth:
                     s2 = _mutate(rng, r2_seq, cfg.error_rate)
                     q1 = rng.integers(25, 41, cfg.read_len).astype(np.uint8)
                     q2 = rng.integers(25, 41, cfg.read_len).astype(np.uint8)
+                    if (cfg.degraded_read_rate > 0
+                            and rng.random() < cfg.degraded_read_rate):
+                        qlo, qhi = cfg.degraded_qual
+                        s1 = _mutate(rng, s1, cfg.degraded_error_rate)
+                        s2 = _mutate(rng, s2, cfg.degraded_error_rate)
+                        q1 = rng.integers(qlo, qhi, cfg.read_len).astype(np.uint8)
+                        q2 = rng.integers(qlo, qhi, cfg.read_len).astype(np.uint8)
                     # strand A: R1 fwd@lo / R2 rev@hi ; strand B mirrored
                     r1_read1 = strand == "A"
                     w.write(BamRead(

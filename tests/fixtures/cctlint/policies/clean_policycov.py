@@ -1,0 +1,15 @@
+"""Conformant twin of ``viol_policycov.py``: the policy's literal
+``name`` is a member of the closed ``POLICY_NAMES`` set, so CCT611 has
+nothing to flag.  (CCT610/CCT612 are full-repo checks — they only
+engage when ``policies/base.py`` is in the scanned set, never on this
+single-file fixture scan.)
+"""
+
+
+class MajorityLikePolicy:
+    """Same shape as the violation twin, but with a declared name."""
+
+    name = "majority"
+
+    def decide(self, counts, quals, lengths, **kw):
+        raise NotImplementedError

@@ -1,0 +1,16 @@
+"""Seeded CCT611: a vote-policy class whose literal ``name`` is not in
+the closed ``POLICY_NAMES`` set (``obs/registry.py``).  Such a policy
+would be selectable by ``--policy`` yet invisible to every per-policy QC
+series — emission guards on the closed label set and skips it silently.
+The twin ``clean_policycov.py`` declares a registered name and must lint
+clean.
+"""
+
+
+class BogusWeightedPolicy:
+    """A plausible-looking policy nobody declared in the registry."""
+
+    name = "weighted_bogus"
+
+    def decide(self, counts, quals, lengths, **kw):
+        raise NotImplementedError

@@ -47,6 +47,7 @@ def test_repo_is_lint_clean():
      {"CCT701", "CCT702", "CCT703", "CCT704", "CCT705"}),
     ("serve/viol_shared_state.py", {"CCT801", "CCT802", "CCT803"}),
     ("serve/viol_cache_store.py", {"CCT901", "CCT902"}),
+    ("policies/viol_policycov.py", {"CCT611"}),
 ])
 def test_each_pass_detects_its_seeded_violation(rel, expected):
     findings = run_paths([os.path.join(FIXTURES, rel)], root=REPO)
@@ -61,6 +62,7 @@ def test_each_pass_detects_its_seeded_violation(rel, expected):
     "serve/clean_trace_prop.py",
     "serve/clean_cache_store.py",
     "clean_qc_series.py",
+    "policies/clean_policycov.py",
 ])
 def test_protocol_twin_fixtures_are_clean(rel):
     """The conformant twins prove the CCT7/CCT8 rules key on the actual
@@ -149,6 +151,41 @@ def test_qc_series_registered_must_be_emitted(tmp_path):
         overrides={"metric_registry": {
             "counters": [], "histograms": [],
             "qc_series": ["tenant_qc_rescued"]}})
+    assert findings == [], findings
+
+
+def test_policycov_full_repo_checks_gate_on_base(tmp_path):
+    """CCT610 (no fixture) and CCT612 (stale label) engage only when
+    ``policies/base.py`` is in the scanned set — a partial scan proves
+    nothing about coverage absence, mirroring CCT302/CCT605."""
+    pkg = tmp_path / "policies"
+    pkg.mkdir()
+    base = pkg / "base.py"
+    base.write_text("class VotePolicy:\n    name: str = '?'\n")
+    mod = pkg / "majority.py"
+    mod.write_text("class MajorityPolicy:\n    name = 'majority'\n")
+    fixture = tmp_path / "test_policies.py"
+    fixture.write_text("def test_majority():\n    assert 'majority'\n")
+    findings = run_paths(
+        [str(base), str(mod)], root=str(tmp_path), passes=["policycov"],
+        overrides={"policy_names": ("majority", "delegation"),
+                   "policy_fixture_files": [str(fixture)]})
+    codes = _codes(findings)
+    # 'delegation' is declared-but-unimplemented -> CCT612; 'majority'
+    # is implemented AND fixture-referenced -> clean of CCT610
+    assert codes == {"CCT612"}, findings
+    # drop the fixture reference: majority now trips CCT610
+    fixture.write_text("def test_nothing():\n    pass\n")
+    findings = run_paths(
+        [str(base), str(mod)], root=str(tmp_path), passes=["policycov"],
+        overrides={"policy_names": ("majority",),
+                   "policy_fixture_files": [str(fixture)]})
+    assert _codes(findings) == {"CCT610"}, findings
+    # a scan WITHOUT base.py stays silent on the full-repo checks
+    findings = run_paths(
+        [str(mod)], root=str(tmp_path), passes=["policycov"],
+        overrides={"policy_names": ("majority", "delegation"),
+                   "policy_fixture_files": [str(fixture)]})
     assert findings == [], findings
 
 

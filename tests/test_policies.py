@@ -1,0 +1,480 @@
+"""Pluggable consensus vote policies (ISSUE 17).
+
+Four contracts pinned here:
+
+- **majority parity**: the default policy's program is the verbatim
+  reference vote — byte-identical to the CPU oracle (and hence to the
+  committed goldens, which pin that oracle end-to-end in
+  ``test_golden.py``) on all three kernel wires: dense XLA, Pallas, and
+  the member stream.  The default path must not even change jaxpr:
+  ``MajorityPolicy.family_vote_fn`` returns the untouched reference
+  function.
+- **delegation invariants**: weight conservation (delegation moves vote
+  weight, never creates or drops it), the all-low-quality fallback to
+  exact majority, and the rescue case delegation exists for.
+- **distilled determinism**: the frozen committed checkpoint always
+  produces the same bytes; structural corruption is refused at load.
+- **serve identity**: ``--policy`` folds into the journal key and the
+  result-cache digest only when non-default, so cross-policy submits
+  never share entries while every pre-policy journal/cache entry (and
+  an explicit ``--policy majority``) keeps its legacy identity.
+  Unknown names are refused at admission with a typed ``bad_request``.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+import jax.numpy as jnp  # noqa: E402
+
+from consensuscruncher_tpu.core import consensus_cpu as cc  # noqa: E402
+from consensuscruncher_tpu.obs.registry import POLICY_NAMES  # noqa: E402
+from consensuscruncher_tpu.ops.consensus_pallas import (  # noqa: E402
+    consensus_batch_pallas_host,
+)
+from consensuscruncher_tpu.ops.consensus_segment import (  # noqa: E402
+    consensus_families_stream,
+)
+from consensuscruncher_tpu.ops.consensus_tpu import (  # noqa: E402
+    ConsensusConfig,
+    consensus_batch_host,
+)
+from consensuscruncher_tpu.policies import base as policies  # noqa: E402
+from consensuscruncher_tpu.policies.delegation import (  # noqa: E402
+    DELEGATE_THRESHOLD,
+    DelegationPolicy,
+    delegated_weights,
+)
+from consensuscruncher_tpu.policies.distilled import (  # noqa: E402
+    DistilledPolicy,
+    checkpoint_path,
+    load_checkpoint,
+)
+from consensuscruncher_tpu.policies.majority import (  # noqa: E402
+    MajorityPolicy,
+    majority_family_vote,
+)
+from consensuscruncher_tpu.serve import journal as journal_mod  # noqa: E402
+from consensuscruncher_tpu.serve import (  # noqa: E402
+    result_cache as cache_mod,
+)
+from consensuscruncher_tpu.serve.scheduler import Scheduler  # noqa: E402
+from consensuscruncher_tpu.serve.server import ServeServer  # noqa: E402
+from consensuscruncher_tpu.utils.phred import N, PAD  # noqa: E402
+
+DATA = os.path.join(REPO, "test", "data")
+SAMPLE = os.path.join(DATA, "sample.bam")
+
+
+@pytest.fixture(autouse=True)
+def _restore_vote_policy():
+    """Every test leaves the module-global selection hook as it found it
+    (the kernels read it; a leaked install would skew other suites)."""
+    prev = policies.installed_vote_policy()
+    yield
+    policies.set_vote_policy(prev)
+
+
+def _family(rng, fam, length, lo=0, hi=42):
+    s = rng.integers(0, 5, size=(fam, length)).astype(np.uint8)
+    q = rng.integers(lo, hi, size=(fam, length)).astype(np.uint8)
+    return s, q
+
+
+def _pad_batch(families, fam_cap, len_cap):
+    B = len(families)
+    bases = np.full((B, fam_cap, len_cap), PAD, dtype=np.uint8)
+    quals = np.zeros((B, fam_cap, len_cap), dtype=np.uint8)
+    sizes = np.zeros(B, dtype=np.int32)
+    for i, (s, q) in enumerate(families):
+        bases[i, : s.shape[0], : s.shape[1]] = s
+        quals[i, : q.shape[0], : q.shape[1]] = q
+        sizes[i] = s.shape[0]
+    return bases, quals, sizes
+
+
+def _planes(s, q, fam_cap, *, qual_threshold=0):
+    """Member arrays -> padded plane-protocol operands for ``decide``."""
+    bases = np.full((fam_cap, s.shape[1]), PAD, dtype=np.uint8)
+    quals = np.zeros((fam_cap, s.shape[1]), dtype=np.uint8)
+    bases[: s.shape[0]] = s
+    quals[: q.shape[0]] = q
+    onehot, mq = policies.family_planes(
+        jnp.asarray(bases), jnp.asarray(quals),
+        jnp.int32(s.shape[0]), qual_threshold=qual_threshold)
+    return onehot, mq, jnp.int32(s.shape[0])
+
+
+def _decide(policy, s, q, *, cutoff=0.7, qual_threshold=0, qual_cap=60,
+            fam_cap=None):
+    num, den = cc.cutoff_fraction(cutoff)
+    onehot, mq, size = _planes(s, q, fam_cap or s.shape[0],
+                               qual_threshold=qual_threshold)
+    b, p, fail = policy.decide(onehot, mq, size, num=num, den=den,
+                               qual_threshold=qual_threshold,
+                               qual_cap=qual_cap)
+    b = np.where(np.asarray(fail), N, np.asarray(b)).astype(np.uint8)
+    p = np.where(np.asarray(fail), 0, np.asarray(p)).astype(np.uint8)
+    return b, p
+
+
+# ------------------------------------------------------------ registry --
+
+
+def test_policy_names_is_the_registry():
+    """The closed obs label set and the actual registry cannot drift —
+    this is the pin the ``policycov`` lint pass leans on."""
+    assert policies.available_policies() == tuple(sorted(POLICY_NAMES))
+    assert set(POLICY_NAMES) == {"majority", "delegation", "distilled"}
+
+
+def test_unknown_policy_is_a_value_error():
+    with pytest.raises(ValueError, match="unknown vote policy 'bogus'"):
+        policies.get_policy("bogus")
+
+
+def test_default_path_is_the_reference_function():
+    """Golden parity by construction: the default policy's per-family
+    callable IS the reference program, not an equivalent one."""
+    fn = MajorityPolicy().family_vote_fn(num=7, den=10, qual_threshold=0,
+                                         qual_cap=60)
+    assert getattr(fn, "func", None) is majority_family_vote
+    assert policies.get_vote_policy().name == "majority"
+
+
+# ----------------------------------------------- majority wire parity --
+
+
+@pytest.mark.parametrize("cutoff,qual_threshold", [(0.7, 0), (0.5, 13)])
+def test_majority_dense_wire_matches_oracle(cutoff, qual_threshold):
+    rng = np.random.default_rng(171)
+    fams = [_family(rng, int(rng.integers(1, 9)), 23) for _ in range(24)]
+    bases, quals, sizes = _pad_batch(fams, fam_cap=8, len_cap=23)
+    cfg = ConsensusConfig(cutoff=cutoff, qual_threshold=qual_threshold)
+    # explicit install must be byte-identical to the nothing-installed
+    # default — same bytes whether the subsystem was touched or not
+    got_default = consensus_batch_host(bases, quals, sizes, cfg)
+    policies.set_vote_policy("majority")
+    got_installed = consensus_batch_host(bases, quals, sizes, cfg)
+    np.testing.assert_array_equal(got_default[0], got_installed[0])
+    np.testing.assert_array_equal(got_default[1], got_installed[1])
+    for i, (s, q) in enumerate(fams):
+        exp_b, exp_q = cc.consensus_maker(
+            s, q, cutoff=cutoff, qual_threshold=qual_threshold)
+        np.testing.assert_array_equal(got_default[0][i, : s.shape[1]], exp_b)
+        np.testing.assert_array_equal(got_default[1][i, : s.shape[1]], exp_q)
+
+
+def test_majority_pallas_wire_matches_dense():
+    rng = np.random.default_rng(172)
+    fams = [_family(rng, 6, 33) for _ in range(16)]
+    bases, quals, sizes = _pad_batch(fams, fam_cap=8, len_cap=33)
+    policies.set_vote_policy("majority")
+    pb, pq = consensus_batch_pallas_host(bases, quals, sizes)
+    xb, xq = consensus_batch_host(bases, quals, sizes)
+    np.testing.assert_array_equal(pb, xb)
+    np.testing.assert_array_equal(pq, xq)
+
+
+def test_majority_stream_wire_matches_oracle():
+    rng = np.random.default_rng(173)
+    fams = {f"fam{k}": _family(rng, int(rng.integers(1, 12)), 41)
+            for k in range(40)}
+
+    def gen():
+        for key, (s, q) in fams.items():
+            yield key, list(s), list(q)
+
+    policies.set_vote_policy("majority")
+    got = {key: (b, q) for key, b, q
+           in consensus_families_stream(gen(), ConsensusConfig(),
+                                        max_batch=16)}
+    assert set(got) == set(fams)
+    for key, (s, q) in fams.items():
+        exp_b, exp_q = cc.consensus_maker(s, q)
+        np.testing.assert_array_equal(got[key][0], exp_b, err_msg=key)
+        np.testing.assert_array_equal(got[key][1], exp_q, err_msg=key)
+
+
+def test_majority_decide_matches_reference_vote():
+    """The plane-protocol ``decide`` implements the same rule as the
+    reference per-family function (the distillation teacher relies on
+    this equivalence)."""
+    rng = np.random.default_rng(174)
+    for _ in range(20):
+        s, q = _family(rng, int(rng.integers(1, 10)), 17)
+        got_b, got_q = _decide(MajorityPolicy(), s, q, fam_cap=12)
+        exp_b, exp_q = cc.consensus_maker(s, q)
+        np.testing.assert_array_equal(got_b, exp_b)
+        np.testing.assert_array_equal(got_q, exp_q)
+
+
+# ----------------------------------------------------------- delegation --
+
+
+def test_delegation_weight_conservation():
+    """Total vote weight per position is exactly the member count —
+    delegation moves weight, never creates or drops it."""
+    rng = np.random.default_rng(175)
+    for _ in range(25):
+        fam_cap, length = int(rng.integers(1, 24)), 13
+        size = int(rng.integers(0, fam_cap + 1))
+        quals = rng.integers(0, 41, size=(fam_cap, length))
+        member = np.zeros((fam_cap, length), dtype=bool)
+        member[:size] = True
+        w = np.asarray(delegated_weights(
+            jnp.asarray(quals), jnp.asarray(member), size))
+        np.testing.assert_allclose(w.sum(axis=0), member.sum(axis=0),
+                                   rtol=0, atol=1e-5)
+
+
+def test_delegation_all_low_quality_falls_back_to_majority():
+    """No delegate exists -> everyone keeps their own vote: exact
+    majority bytes, including the tie-break."""
+    rng = np.random.default_rng(176)
+    for _ in range(15):
+        s, _ = _family(rng, int(rng.integers(1, 9)), 19)
+        q = rng.integers(0, DELEGATE_THRESHOLD,
+                         size=s.shape).astype(np.uint8)
+        got = _decide(DelegationPolicy(), s, q, fam_cap=10)
+        exp = _decide(MajorityPolicy(), s, q, fam_cap=10)
+        np.testing.assert_array_equal(got[0], exp[0])
+        np.testing.assert_array_equal(got[1], exp[1])
+
+
+def test_delegation_all_high_quality_is_exact_majority():
+    rng = np.random.default_rng(177)
+    s, _ = _family(rng, 7, 29)
+    q = rng.integers(DELEGATE_THRESHOLD, 41, size=s.shape).astype(np.uint8)
+    got = _decide(DelegationPolicy(), s, q, fam_cap=8)
+    exp_b, exp_q = cc.consensus_maker(s, q)
+    np.testing.assert_array_equal(got[0], exp_b)
+    np.testing.assert_array_equal(got[1], exp_q)
+
+
+def test_delegation_rescues_noise_diluted_position():
+    """The motivating case: two trustworthy reads agree, six degraded
+    reads split across other bases.  Majority drops the position (2/8
+    < 0.7); delegation passes it (2/2 among the delegates)."""
+    L = 4
+    s = np.array([[0] * L, [0] * L,
+                  [1] * L, [1] * L, [2] * L, [2] * L, [3] * L, [3] * L],
+                 dtype=np.uint8)
+    q = np.array([[30] * L, [30] * L] + [[10] * L] * 6, dtype=np.uint8)
+    maj_b, _ = _decide(MajorityPolicy(), s, q)
+    del_b, del_q = _decide(DelegationPolicy(), s, q)
+    assert (maj_b == N).all(), "majority must fail this position"
+    assert (del_b == 0).all(), "delegation must rescue base A"
+    assert (del_q == 60).all()  # 30 + 30 from the two delegates
+
+
+def test_delegation_empty_family_abstains():
+    s = np.zeros((0, 5), dtype=np.uint8)
+    q = np.zeros((0, 5), dtype=np.uint8)
+    b, p = _decide(DelegationPolicy(), s, q, fam_cap=4)
+    assert (b == N).all() and (p == 0).all()
+
+
+# ------------------------------------------------------------ distilled --
+
+
+def test_distilled_checkpoint_is_committed_and_valid():
+    path = checkpoint_path()
+    assert os.path.isfile(path), "versioned checkpoint must be committed"
+    params = load_checkpoint(path)
+    meta = params["meta"]
+    assert meta.get("tool") == "tools/distill_train.py"
+    assert meta.get("seed") == 17 and "regimes" in meta
+    acc = meta["holdout_accuracy"]
+    # the provenance the BENCH_QC accuracy artifact re-verifies: on at
+    # least one degraded regime the head strictly beats majority
+    assert acc["mixed"]["distilled"] > acc["mixed"]["majority"]
+    assert acc["degraded"]["distilled"] > acc["degraded"]["majority"]
+
+
+def test_distilled_is_deterministic_from_frozen_checkpoint():
+    rng = np.random.default_rng(178)
+    s, q = _family(rng, 9, 31)
+    first = _decide(DistilledPolicy(), s, q, fam_cap=12)
+    for _ in range(2):
+        again = _decide(DistilledPolicy(), s, q, fam_cap=12)
+        np.testing.assert_array_equal(first[0], again[0])
+        np.testing.assert_array_equal(first[1], again[1])
+    # a fresh instance resolves the same committed checkpoint: same bytes
+    fresh = _decide(DistilledPolicy(), s, q, fam_cap=12)
+    np.testing.assert_array_equal(first[0], fresh[0])
+
+
+def test_distilled_rejects_structurally_corrupt_checkpoint(tmp_path,
+                                                           monkeypatch):
+    committed = checkpoint_path()
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"version": 2, "policy": "distilled"}))
+    monkeypatch.setenv("CCT_DISTILLED_CHECKPOINT", str(bad))
+    with pytest.raises(ValueError, match="not a distilled-policy"):
+        _decide(DistilledPolicy(), np.zeros((1, 3), dtype=np.uint8),
+                np.full((1, 3), 30, dtype=np.uint8), fam_cap=2)
+    doc = json.load(open(committed))
+    doc["w1"] = [row[:-1] for row in doc["w1"]]  # wrong feature width
+    (tmp_path / "shape.json").write_text(json.dumps(doc))
+    monkeypatch.setenv("CCT_DISTILLED_CHECKPOINT",
+                       str(tmp_path / "shape.json"))
+    with pytest.raises(ValueError, match="shape"):
+        _decide(DistilledPolicy(), np.zeros((1, 3), dtype=np.uint8),
+                np.full((1, 3), 30, dtype=np.uint8), fam_cap=2)
+
+
+def test_distilled_abstains_rather_than_guessing():
+    """An empty family (and an all-N family) must come back N/0 — the
+    confidence floor and the N-lane abstention are the safety rail."""
+    s = np.full((3, 6), N, dtype=np.uint8)
+    q = np.full((3, 6), 30, dtype=np.uint8)
+    b, p = _decide(DistilledPolicy(), s, q, fam_cap=4)
+    assert (b == N).all() and (p == 0).all()
+    b, p = _decide(DistilledPolicy(), np.zeros((0, 6), dtype=np.uint8),
+                   np.zeros((0, 6), dtype=np.uint8), fam_cap=4)
+    assert (b == N).all() and (p == 0).all()
+
+
+# --------------------------------------------------- non-default wires --
+
+
+def test_non_majority_policy_runs_on_dense_wire():
+    """Installing delegation changes the compiled program — and on an
+    all-high-quality batch its bytes equal majority's (the documented
+    reduction), proving the dispatch actually routes through it."""
+    rng = np.random.default_rng(179)
+    fams = [_family(rng, 5, 21, lo=DELEGATE_THRESHOLD) for _ in range(8)]
+    bases, quals, sizes = _pad_batch(fams, fam_cap=8, len_cap=21)
+    policies.set_vote_policy("delegation")
+    got_b, got_q = consensus_batch_host(bases, quals, sizes)
+    policies.set_vote_policy(None)
+    exp_b, exp_q = consensus_batch_host(bases, quals, sizes)
+    np.testing.assert_array_equal(got_b, exp_b)
+    np.testing.assert_array_equal(got_q, exp_q)
+
+
+def test_non_majority_policy_runs_on_stream_wire():
+    rng = np.random.default_rng(180)
+    fams = {f"f{k}": _family(rng, 4, 18, lo=DELEGATE_THRESHOLD)
+            for k in range(12)}
+
+    def gen():
+        for key, (s, q) in fams.items():
+            yield key, list(s), list(q)
+
+    policies.set_vote_policy("delegation")
+    got = {key: (b, q) for key, b, q
+           in consensus_families_stream(gen(), ConsensusConfig(),
+                                        max_batch=4)}
+    for key, (s, q) in fams.items():
+        exp_b, exp_q = cc.consensus_maker(s, q)
+        np.testing.assert_array_equal(got[key][0], exp_b, err_msg=key)
+
+
+def test_pallas_wire_reroutes_non_majority_to_dense():
+    """The Pallas kernel hard-codes the majority vote; other policies
+    must transparently take the dense XLA path with the policy applied."""
+    s = np.array([[0, 0], [0, 0], [1, 1], [2, 2], [3, 3], [1, 1]],
+                 dtype=np.uint8)
+    q = np.array([[30, 30], [30, 30]] + [[10, 10]] * 4, dtype=np.uint8)
+    bases, quals, sizes = _pad_batch([(s, q)], fam_cap=8, len_cap=2)
+    policies.set_vote_policy("delegation")
+    pb, pq = consensus_batch_pallas_host(bases, quals, sizes)
+    assert (pb[0] == 0).all(), "delegation result expected through pallas"
+    assert (pq[0] == 60).all()
+
+
+# ------------------------------------------------------- serve identity --
+
+
+def _spec(output, **over):
+    spec = {
+        "input": SAMPLE, "output": str(output), "name": "golden",
+        "cutoff": 0.7, "qualscore": 0, "scorrect": True,
+        "max_mismatch": 0, "bdelim": "|", "compress_level": 6,
+    }
+    spec.update(over)
+    return spec
+
+
+def test_policy_changes_journal_key_and_cache_digest(tmp_path):
+    plain = _spec(tmp_path / "o")
+    keys = {journal_mod.idempotency_key(plain)}
+    digests = {cache_mod.content_digest(plain)}
+    for name in ("delegation", "distilled"):
+        keys.add(journal_mod.idempotency_key(
+            _spec(tmp_path / "o", policy=name)))
+        digests.add(cache_mod.content_digest(
+            _spec(tmp_path / "o", policy=name)))
+    assert len(keys) == 3, "cross-policy submits must never share a key"
+    assert len(digests) == 3, "cross-policy results must never share cache"
+
+
+def test_absent_policy_keeps_legacy_identity(tmp_path):
+    """The legacy shim: a pre-policy spec (no ``policy`` key) hashes
+    exactly as it always did, and a ``None`` field is identical to an
+    absent one — pre-policy journals replay and cache entries still hit."""
+    plain = _spec(tmp_path / "o")
+    with_none = _spec(tmp_path / "o", policy=None)
+    assert journal_mod.idempotency_key(plain) == \
+        journal_mod.idempotency_key(with_none)
+    assert cache_mod.content_digest(plain) == \
+        cache_mod.content_digest(with_none)
+    assert journal_mod.legacy_idempotency_key(plain) == \
+        journal_mod.legacy_idempotency_key(with_none)
+
+
+def test_explicit_majority_normalizes_to_default_at_admission(tmp_path):
+    """``--policy majority`` must be the same job as no ``--policy`` at
+    all: admission strips the default before the key is computed."""
+    sched = Scheduler(start=False, paused=True)
+    a, created_a = sched.submit_info(_spec(tmp_path / "o"))
+    b, created_b = sched.submit_info(
+        _spec(tmp_path / "o", policy="majority"))
+    c, created_c = sched.submit_info(_spec(tmp_path / "o", policy=""))
+    assert created_a and not created_b and not created_c
+    assert a.key == b.key == c.key
+    assert "policy" not in a.spec
+    d, created_d = sched.submit_info(
+        _spec(tmp_path / "o", policy="delegation"))
+    assert created_d and d.key != a.key
+
+
+def test_unknown_policy_refused_with_typed_bad_request(tmp_path):
+    sched = Scheduler(start=False, paused=True)
+    server = ServeServer(sched, port=0)
+    try:
+        r = server._dispatch({"op": "submit",
+                              "spec": _spec(tmp_path / "o",
+                                            policy="bogus")})
+        assert r["ok"] is False
+        assert r["refused"] is True and r["bad_request"] is True
+        assert "unknown vote policy 'bogus'" in r["error"]
+        # nothing was admitted: the same spec with a valid policy is new
+        job, created = sched.submit_info(
+            _spec(tmp_path / "o", policy="delegation"))
+        assert created
+    finally:
+        server.close()
+        sched.shutdown()
+
+
+def test_qc_report_policy_column_dash_degrades():
+    from consensuscruncher_tpu.obs.qc import render_report
+
+    stamped = {"yields": {"families": 3, "sscs_written": 2},
+               "rates": {}, "policy": "delegation"}
+    legacy = {"yields": {"families": 1, "sscs_written": 1}, "rates": {}}
+    out = render_report([("new", stamped), ("old", legacy)])
+    header, row_new, row_old = out.splitlines()[:3]
+    assert header.split()[1] == "policy"
+    assert row_new.split()[1] == "delegation"
+    assert row_old.split()[1] == "-", "pre-policy docs must render a dash"

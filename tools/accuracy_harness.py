@@ -18,9 +18,15 @@ wall-clock delta is the measured QC overhead, printed as
   mismatch (the errors a caller would mistake for variants), reported
   per megabase.
 
-Results are keyed by ``--policy`` (one policy today — the field exists
-so future consensus policies land as new rows, and qc_gate compares
-per-policy).  The emitted artifact embeds the run's ``qc.json`` doc, so
+Results are keyed by consensus policy: ``--policies
+majority,delegation,distilled`` sweeps every named policy over the SAME
+simulated truth BAM (one accuracy row each; tools/qc_gate.py compares
+per-policy), while ``--policy`` keeps the single-row behavior.  A
+``--degraded_rate`` fraction of reads can be pushed into a low-quality
+regime (qual 3-15, ``--degraded_error`` per-base errors) — the regime
+where delegation/distilled voting must beat plain majority, visible in
+the ``recovered`` rate (an emitted N counts as a miss there).  The
+emitted artifact embeds the run's ``qc.json`` doc, so
 one file carries both the QC spectrum and the accuracy table — this is
 the ``BENCH_QC_r*.json`` format tools/qc_gate.py gates against.
 
@@ -53,11 +59,20 @@ def _score_reads(reads, truth, by_pos, corrupt_rng=None, corrupt_rate=0.0):
     map back through their coordinate; a rare position collision is
     resolved by scoring against every candidate and keeping the best —
     the true fragment wins unless error rates are absurd).
-    Returns (mismatches, bases, coverage) where coverage maps
-    frag -> [(start_offset, seq), ...] for variant-site lookup.
+    Returns (mismatches, bases, recovered, truth_bases, coverage):
+    ``bases`` counts positions where BOTH sides are called (the per-base
+    error denominator — an N is neither right nor wrong there), while
+    ``recovered``/``truth_bases`` count an emitted N as a MISS: of every
+    truth base a read covers, how many did the consensus actually call
+    correctly?  A policy that abstains its way to a low error rate
+    cannot hide from the recovered rate — the axis delegation/distilled
+    exist to win on degraded families.  ``coverage`` maps frag ->
+    [(start_offset, seq), ...] for variant-site lookup.
     """
     mism = 0
     bases = 0
+    recovered = 0
+    truth_bases = 0
     coverage: dict[int, list[tuple[int, str]]] = {}
     for _qname, pos, seq in reads:
         if corrupt_rng is not None and corrupt_rate > 0:
@@ -76,15 +91,19 @@ def _score_reads(reads, truth, by_pos, corrupt_rng=None, corrupt_rate=0.0):
                     if a != b and a in BASES and b in BASES)
             n = sum(1 for a, b in zip(seq, expect)
                     if a in BASES and b in BASES)
+            rec = sum(1 for a, b in zip(seq, expect) if a == b and b in BASES)
+            tb = sum(1 for b in expect if b in BASES)
             if best is None or m < best[0]:
-                best = (m, n, frag, off, seq)
+                best = (m, n, rec, tb, frag, off, seq)
         if best is None:
             continue
-        m, n, frag, off, seq = best
+        m, n, rec, tb, frag, off, seq = best
         mism += m
         bases += n
+        recovered += rec
+        truth_bases += tb
         coverage.setdefault(frag, []).append((off, seq))
-    return mism, bases, coverage
+    return mism, bases, recovered, truth_bases, coverage
 
 
 def _read_level(path):
@@ -138,66 +157,29 @@ def _score_variants(sites, coverage):
     return tp, fn_wrong, fn_dropped
 
 
-def _run_pipeline(bam, out, name, backend, qc_on):
+def _run_pipeline(bam, out, name, backend, qc_on, policy="majority"):
     """One staged consensus run; returns wall seconds."""
     from consensuscruncher_tpu.cli import main as cli_main
 
     os.environ["CCT_QC"] = "1" if qc_on else "0"
+    argv = ["consensus", "-i", bam, "-o", out, "-n", name,
+            "--backend", backend]
+    if policy != "majority":
+        # absent == majority everywhere; only non-default runs name it
+        argv += ["--policy", policy]
     t0 = time.monotonic()
-    rc = cli_main(["consensus", "-i", bam, "-o", out, "-n", name,
-                   "--backend", backend])
+    rc = cli_main(argv)
     wall = time.monotonic() - t0
     if rc != 0:
         raise RuntimeError(f"consensus run failed (rc={rc})")
     return wall
 
 
-def run(args) -> dict:
-    import numpy as np
+def _score_policy_run(base, name, bam, truth, by_pos, args, corrupt_rng):
+    """Score one pipeline output tree (raw + sscs + dcs) against truth.
 
-    from consensuscruncher_tpu.utils.simulate import SimConfig, simulate_bam
-
-    work = args.workdir
-    os.makedirs(work, exist_ok=True)
-    cfg = SimConfig(n_fragments=args.fragments, read_len=args.read_len,
-                    mean_family_size=args.mean_family,
-                    duplex_fraction=args.duplex_fraction,
-                    error_rate=args.error_rate, seed=args.seed)
-    bam = os.path.join(work, "truth.bam")
-    truth = simulate_bam(bam, cfg)
-
-    name = "acc"
-    # Warmup pass per QC variant first (compile caches are keyed on the
-    # with_qc flag, so each variant pays its own first-run jit cost),
-    # then min-of-N timed runs per variant — shared CI boxes jitter
-    # 10-15% run to run, and min is the standard de-noiser.
-    _run_pipeline(bam, os.path.join(work, "warm_off"), name,
-                  args.backend, qc_on=False)
-    _run_pipeline(bam, os.path.join(work, "warm_on"), name,
-                  args.backend, qc_on=True)
-    wall_off = min(_run_pipeline(bam, os.path.join(work, f"off{i}"), name,
-                                 args.backend, qc_on=False)
-                   for i in range(args.repeats))
-    wall_on = min(_run_pipeline(bam, os.path.join(work, "on")
-                                if i == 0 else
-                                os.path.join(work, f"on{i}"), name,
-                                args.backend, qc_on=True)
-                  for i in range(args.repeats))
-    overhead_pct = (100.0 * (wall_on - wall_off) / wall_off
-                    if wall_off > 0 else 0.0)
-    print(f"accuracy_harness: stage wall qc_off={wall_off:.3f}s "
-          f"qc_on={wall_on:.3f}s qc_overhead_pct={overhead_pct:.2f}",
-          file=sys.stderr, flush=True)
-
-    base = os.path.join(work, "on", name)
-    by_pos: dict[int, list[int]] = {}
-    for frag, (lo, mol) in truth.molecules.items():
-        hi = lo + len(mol) - cfg.read_len
-        by_pos.setdefault(lo, []).append(frag)
-        by_pos.setdefault(hi, []).append(frag)
-
-    corrupt_rng = (np.random.default_rng(args.seed + 777)
-                   if args.corrupt > 0 else None)
+    Returns the accuracy row for ``accuracy.policies.<name>``.
+    """
     levels = {}
     coverage_by_level = {}
     for level, path in (
@@ -207,17 +189,18 @@ def run(args) -> dict:
     ):
         reads = _read_level(path)
         # corruption is the consensus-gone-wrong control: raw stays honest
-        mism, total, cov = _score_reads(
+        mism, total, rec, tb, cov = _score_reads(
             reads, truth, by_pos,
             corrupt_rng=None if level == "raw" else corrupt_rng,
             corrupt_rate=0.0 if level == "raw" else args.corrupt)
         levels[level] = {"mismatches": mism, "bases": total,
                          "error_rate": (mism / total) if total else None,
+                         "recovered_rate": (rec / tb) if tb else None,
                          "reads": len(reads)}
         coverage_by_level[level] = cov
 
     sites = _variant_sites(truth, args.variants, args.seed + 1,
-                           cfg.read_len)
+                           args.read_len)
     variants = {}
     for level in ("sscs", "dcs"):
         tp, fn_wrong, fn_dropped = _score_variants(
@@ -232,9 +215,84 @@ def run(args) -> dict:
             "fp_per_mb": (1e6 * fp / err["bases"]) if err["bases"] else None,
         }
 
+    return {
+        "per_base_error": {lv: levels[lv]["error_rate"] for lv in levels},
+        "recovered": {lv: levels[lv]["recovered_rate"] for lv in levels},
+        "bases": {lv: levels[lv]["bases"] for lv in levels},
+        "reads": {lv: levels[lv]["reads"] for lv in levels},
+        "variants": variants,
+    }
+
+
+def run(args) -> dict:
+    import numpy as np
+
+    from consensuscruncher_tpu.utils.simulate import SimConfig, simulate_bam
+
+    work = args.workdir
+    os.makedirs(work, exist_ok=True)
+    cfg = SimConfig(n_fragments=args.fragments, read_len=args.read_len,
+                    mean_family_size=args.mean_family,
+                    duplex_fraction=args.duplex_fraction,
+                    error_rate=args.error_rate, seed=args.seed,
+                    degraded_read_rate=args.degraded_rate,
+                    degraded_error_rate=args.degraded_error)
+    bam = os.path.join(work, "truth.bam")
+    truth = simulate_bam(bam, cfg)
+
+    policies = ([p.strip() for p in args.policies.split(",") if p.strip()]
+                if args.policies else [args.policy])
+
+    name = "acc"
+    # QC-overhead timing runs only for the FIRST policy: warmup pass per
+    # QC variant (compile caches are keyed on the with_qc flag, so each
+    # variant pays its own first-run jit cost), then min-of-N timed runs
+    # per variant — shared CI boxes jitter 10-15% run to run, and min is
+    # the standard de-noiser.  Extra policies are scored for accuracy
+    # only (one qc_on run each) so a three-policy sweep doesn't triple
+    # the harness wall-clock.
+    first = policies[0]
+    _run_pipeline(bam, os.path.join(work, "warm_off"), name,
+                  args.backend, qc_on=False, policy=first)
+    _run_pipeline(bam, os.path.join(work, "warm_on"), name,
+                  args.backend, qc_on=True, policy=first)
+    wall_off = min(_run_pipeline(bam, os.path.join(work, f"off{i}"), name,
+                                 args.backend, qc_on=False, policy=first)
+                   for i in range(args.repeats))
+    wall_on = min(_run_pipeline(bam, os.path.join(work, "on")
+                                if i == 0 else
+                                os.path.join(work, f"on{i}"), name,
+                                args.backend, qc_on=True, policy=first)
+                  for i in range(args.repeats))
+    overhead_pct = (100.0 * (wall_on - wall_off) / wall_off
+                    if wall_off > 0 else 0.0)
+    print(f"accuracy_harness: stage wall qc_off={wall_off:.3f}s "
+          f"qc_on={wall_on:.3f}s qc_overhead_pct={overhead_pct:.2f}",
+          file=sys.stderr, flush=True)
+
+    run_base = {first: os.path.join(work, "on", name)}
+    for policy in policies[1:]:
+        out = os.path.join(work, f"on_{policy}")
+        _run_pipeline(bam, out, name, args.backend, qc_on=True,
+                      policy=policy)
+        run_base[policy] = os.path.join(out, name)
+
+    by_pos: dict[int, list[int]] = {}
+    for frag, (lo, mol) in truth.molecules.items():
+        hi = lo + len(mol) - cfg.read_len
+        by_pos.setdefault(lo, []).append(frag)
+        by_pos.setdefault(hi, []).append(frag)
+
+    corrupt_rng = (np.random.default_rng(args.seed + 777)
+                   if args.corrupt > 0 else None)
+    policy_rows = {}
+    for policy in policies:
+        policy_rows[policy] = _score_policy_run(
+            run_base[policy], name, bam, truth, by_pos, args, corrupt_rng)
+
     qc_doc = None
     try:
-        with open(os.path.join(base, "qc.json")) as fh:
+        with open(os.path.join(run_base[first], "qc.json")) as fh:
             qc_doc = json.load(fh)
     except (OSError, ValueError):
         pass
@@ -246,19 +304,15 @@ def run(args) -> dict:
                    "mean_family": args.mean_family,
                    "duplex_fraction": args.duplex_fraction,
                    "error_rate": args.error_rate, "seed": args.seed,
+                   "degraded_rate": args.degraded_rate,
+                   "degraded_error": args.degraded_error,
                    "variants": args.variants, "backend": args.backend},
         "corrupt": args.corrupt,
         "qc_overhead_pct": round(overhead_pct, 3),
         "stage_wall_s": {"qc_off": round(wall_off, 4),
                          "qc_on": round(wall_on, 4)},
         "qc": qc_doc,
-        "accuracy": {"policies": {args.policy: {
-            "per_base_error": {lv: levels[lv]["error_rate"]
-                               for lv in levels},
-            "bases": {lv: levels[lv]["bases"] for lv in levels},
-            "reads": {lv: levels[lv]["reads"] for lv in levels},
-            "variants": variants,
-        }}},
+        "accuracy": {"policies": policy_rows},
     }
 
 
@@ -269,9 +323,14 @@ def main(argv=None) -> int:
     ap.add_argument("--workdir", default="",
                     help="scratch dir for the simulated BAM + runs "
                          "(default: a fresh temp dir)")
-    ap.add_argument("--policy", default="default",
-                    help="consensus-policy key for the accuracy table "
-                         "(future policies land as new rows)")
+    ap.add_argument("--policy", default="majority",
+                    help="consensus policy to run and score (one row in "
+                         "the accuracy table)")
+    ap.add_argument("--policies", default="",
+                    help="comma-separated policy sweep over the SAME "
+                         "simulated truth BAM — one accuracy row per "
+                         "policy (overrides --policy; timing is measured "
+                         "on the first entry only)")
     ap.add_argument("--backend", default="tpu",
                     help="consensus backend to exercise (default tpu; "
                          "runs under JAX_PLATFORMS=cpu in CI)")
@@ -283,6 +342,12 @@ def main(argv=None) -> int:
     ap.add_argument("--mean_family", type=float, default=3.0)
     ap.add_argument("--duplex_fraction", type=float, default=0.8)
     ap.add_argument("--error_rate", type=float, default=0.005)
+    ap.add_argument("--degraded_rate", type=float, default=0.0,
+                    help="fraction of reads degraded to the low-quality "
+                         "regime (qual 3-15, elevated errors) — the "
+                         "regime delegation/distilled exist to win on")
+    ap.add_argument("--degraded_error", type=float, default=0.08,
+                    help="per-base error rate inside degraded reads")
     ap.add_argument("--seed", type=int, default=7)
     ap.add_argument("--variants", type=int, default=40,
                     help="seeded truth sites scored for FP/FN")

@@ -174,7 +174,7 @@ def all_passes():
     """Name -> pass callable.  Imported lazily so a syntax error in one pass
     module doesn't take down the others during development."""
     from . import (cachestore, determinism, faultcov, hostsync, jitdisc,
-                   locks, obscov, protocol, shared_state)
+                   locks, obscov, policycov, protocol, shared_state)
 
     return {
         "hostsync": hostsync.run,
@@ -186,6 +186,7 @@ def all_passes():
         "protocol": protocol.run,
         "shared_state": shared_state.run,
         "cachestore": cachestore.run,
+        "policycov": policycov.run,
     }
 
 

@@ -6,10 +6,15 @@ approved wrappers creates a second compilation cache entry the warmer
 doesn't know about — a recompile storm waiting for the first oddly-shaped
 batch.  Rule:
 
-CCT501  ``jax.jit`` / ``pjit`` call or decorator outside ``ops/`` and
-        ``parallel/mesh.py``.  Everything else must go through the
-        compiled wrappers those modules export.  Suppress a deliberate
-        exception with ``# cct: allow-jit(reason)``.
+CCT501  ``jax.jit`` / ``pjit`` call or decorator outside ``ops/``,
+        ``policies/``, ``parallel/mesh.py`` and
+        ``tools/distill_train.py``.  Everything else must go through
+        the compiled wrappers those modules export.  (``policies/``
+        holds the pluggable vote policies whose jitted programs the
+        kernels trace — ISSUE 17 — and the distillation trainer jits
+        its own training step offline, never on the serve path.)
+        Suppress a deliberate exception with
+        ``# cct: allow-jit(reason)``.
 """
 
 from __future__ import annotations
@@ -23,7 +28,9 @@ JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.pjit", "pjit.pjit"}
 
 def _approved(src: SourceFile) -> bool:
     return "ops" in src.parts[:-1] or \
-        src.rel.endswith("parallel/mesh.py")
+        "policies" in src.parts[:-1] or \
+        src.rel.endswith("parallel/mesh.py") or \
+        src.rel.endswith("tools/distill_train.py")
 
 
 def run(ctx: LintContext) -> list[Finding]:
@@ -45,7 +52,8 @@ def run(ctx: LintContext) -> list[Finding]:
             for tgt, name in targets:
                 findings.append(Finding(
                     "CCT501", src.rel, tgt.lineno,
-                    f"direct '{name}' outside ops/ and parallel/mesh.py — "
-                    "use the compiled wrappers there so serve/warmup.py's "
+                    f"direct '{name}' outside ops/, policies/, "
+                    "parallel/mesh.py and tools/distill_train.py — use "
+                    "the compiled wrappers there so serve/warmup.py's "
                     "pre-compilation covers every kernel", "jitdisc"))
     return findings

@@ -1,0 +1,143 @@
+"""Pass 10 — vote-policy coverage (CCT61x).
+
+The pluggable consensus-policy subsystem (ISSUE 17) keeps three
+vocabularies that must agree: the policy classes registered under
+``consensuscruncher_tpu/policies/`` (each sets a literal ``name``), the
+closed ``POLICY_NAMES`` label set in ``obs/registry.py`` (bounds the
+per-policy QC exposition), and the per-policy parity/accuracy fixtures
+in ``tests/test_policies.py`` (every selectable policy must have its
+bytes or accuracy pinned).  Drift in any direction is a bug:
+
+CCT611  a policy class under ``policies/`` declares a literal ``name``
+        that ``POLICY_NAMES`` does not list (always checked): the
+        policy would be selectable by ``--policy`` yet invisible to the
+        per-policy QC series — its label value is outside the closed
+        set, so emission skips it silently.
+CCT610  a ``POLICY_NAMES`` member never referenced by the policy test
+        module: a selectable policy with no parity/accuracy fixture has
+        never had its bytes (majority) or its accuracy contract
+        (delegation/distilled) pinned.
+CCT612  a ``POLICY_NAMES`` member no scanned ``policies/`` module
+        declares: a stale label value that can never be emitted.
+
+CCT610/CCT612 need the policy package in view to be meaningful, so they
+only fire when ``policies/base.py`` is in the scanned set (full-repo
+runs) — mirroring the partial-scan discipline of CCT302/CCT605.  Like
+CCT3xx/CCT6xx there is deliberately no pragma: fix coverage, don't
+waive it.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from .core import Finding, LintContext
+
+REGISTRY_REL = os.path.join("consensuscruncher_tpu", "obs", "registry.py")
+#: where the per-policy parity/accuracy fixtures live
+FIXTURE_FILES = ("tests/test_policies.py",)
+
+
+def _policy_names(ctx: LintContext):
+    """The closed POLICY_NAMES set — from overrides or the real registry
+    module loaded standalone (zero-import by design).  None when neither
+    exists (scans of foreign trees: nothing to check against)."""
+    override = ctx.overrides.get("policy_names")
+    if override is not None:
+        return tuple(override)
+    path = os.path.join(ctx.root, REGISTRY_REL)
+    if not os.path.isfile(path):
+        return None
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("_cct_obs_registry", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    names = getattr(mod, "POLICY_NAMES", None)
+    return tuple(names) if names else None
+
+
+def _declared_names(src) -> list[tuple[str, int]]:
+    """Literal ``name = "..."`` class attributes in one policies/ file.
+    The ``"?"`` placeholder on the :class:`VotePolicy` base is skipped —
+    it is the "no name set" sentinel, not a registrable policy."""
+    out: list[tuple[str, int]] = []
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        for stmt in node.body:
+            targets = []
+            value = None
+            if isinstance(stmt, ast.Assign):
+                targets, value = stmt.targets, stmt.value
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                targets, value = [stmt.target], stmt.value
+            if not (isinstance(value, ast.Constant)
+                    and isinstance(value.value, str)
+                    and value.value != "?"):
+                continue
+            for tgt in targets:
+                if isinstance(tgt, ast.Name) and tgt.id == "name":
+                    out.append((value.value, stmt.lineno))
+    return out
+
+
+def _fixture_text(ctx: LintContext) -> str:
+    override = ctx.overrides.get("policy_fixture_files")
+    paths = list(override) if override is not None else [
+        os.path.join(ctx.root, p) for p in FIXTURE_FILES]
+    chunks = []
+    for p in paths:
+        try:
+            with open(p, "r", encoding="utf-8") as fh:
+                chunks.append(fh.read())
+        except OSError:
+            continue
+    return "\n".join(chunks)
+
+
+def run(ctx: LintContext) -> list[Finding]:
+    names = _policy_names(ctx)
+    if names is None:
+        return []
+    declared: dict[str, tuple[str, int]] = {}
+    findings: list[Finding] = []
+    full_repo = False
+    for src in ctx.parsed():
+        if "policies" not in src.parts[:-1]:
+            continue
+        if src.parts[-1] == "base.py":
+            full_repo = True
+        for name, line in _declared_names(src):
+            declared.setdefault(name, (src.rel, line))
+            if name not in names:
+                findings.append(Finding(
+                    "CCT611", src.rel, line,
+                    f"policy name '{name}' is not in the closed "
+                    "POLICY_NAMES set (consensuscruncher_tpu/obs/"
+                    "registry.py) — it would be selectable by --policy "
+                    "yet invisible to every per-policy QC series; "
+                    "declare it there (and give it a fixture) or drop "
+                    "the policy", "policycov"))
+    if not full_repo:
+        return findings
+
+    registry_rel = REGISTRY_REL.replace(os.sep, "/")
+    fixtures = _fixture_text(ctx)
+    for name in names:
+        if name not in declared:
+            findings.append(Finding(
+                "CCT612", registry_rel, 1,
+                f"POLICY_NAMES declares '{name}' but no scanned "
+                "policies/ module defines a policy with that name — a "
+                "stale label value that can never be emitted; remove it "
+                "or implement the policy", "policycov"))
+        elif name not in fixtures:
+            findings.append(Finding(
+                "CCT610", registry_rel, 1,
+                f"policy '{name}' has no parity/accuracy fixture — "
+                "tests/test_policies.py never references it, so its "
+                "bytes/accuracy contract is unpinned; add a fixture "
+                "before shipping the policy", "policycov"))
+    return findings

@@ -123,6 +123,10 @@ WIRE_REPLY_KEYS = frozenset({
     # tripped the breaker; ``brownout`` marks a refusal caused by
     # resource exhaustion (disk-full journal) rather than load
     "quarantined", "reason", "brownout", "released", "requeued",
+    # consensus vote policy (ISSUE 17): job specs may carry a ``policy``
+    # name (absent == majority; unknown names are refused at admission
+    # with ``bad_request``), and replies/job docs may echo it
+    "policy",
 })
 
 # ---------------------------------------------------------- helpers ----

@@ -179,6 +179,7 @@ echo "== consensus QC leg (truth-set accuracy; drift gate vs committed baseline)
 # strict).  The report render doubles as the cct qc surface smoke.
 JAX_PLATFORMS=cpu PYTHONPATH="$REPO" python tools/accuracy_harness.py \
   --workdir "$WORK/qc_honest" --repeats 1 \
+  --policies majority,delegation,distilled --degraded_rate 0.5 \
   --out "$WORK/BENCH_QC_fresh.json" > /dev/null
 JAX_PLATFORMS=cpu PYTHONPATH="$REPO" python -m consensuscruncher_tpu.cli \
   qc report "$WORK/qc_honest/on/acc"
@@ -197,6 +198,39 @@ if python tools/qc_gate.py --fresh "$WORK/BENCH_QC_corrupt.json" \
   exit 1
 fi
 echo "ci_check: qc gate OK (honest run passes, seeded corruption caught)"
+
+echo "== consensus policy legs (delegation honest; rigged distilled checkpoint MUST be caught) =="
+# delegation end-to-end through the real pipeline: its artifact embeds
+# the delegation run's own qc.json, gated against the committed
+# baseline's delegation row under --smoke tolerances
+JAX_PLATFORMS=cpu PYTHONPATH="$REPO" python tools/accuracy_harness.py \
+  --workdir "$WORK/qc_deleg" --repeats 1 --policy delegation \
+  --degraded_rate 0.5 --out "$WORK/BENCH_QC_delegation.json" > /dev/null
+python tools/qc_gate.py --fresh "$WORK/BENCH_QC_delegation.json" --smoke \
+  --out "$WORK/qc_gate_delegation.json" > /dev/null
+# positive control: the distilled checkpoint's values are attested by
+# accuracy, not by load-time validation — so a structurally-valid
+# checkpoint with a rigged output bias (always calls C, full
+# confidence) loads fine and votes garbage.  The error-ordering
+# structural check stays strict under --smoke and MUST catch it.
+python - "$WORK/distilled_rigged.json" <<'PY'
+import json, sys
+ckpt = json.load(open(
+    "consensuscruncher_tpu/policies/checkpoints/distilled_v1.json"))
+ckpt["b2"] = [0.0, 50.0, 0.0, 0.0, 0.0]
+json.dump(ckpt, open(sys.argv[1], "w"))
+PY
+JAX_PLATFORMS=cpu PYTHONPATH="$REPO" \
+  CCT_DISTILLED_CHECKPOINT="$WORK/distilled_rigged.json" \
+  python tools/accuracy_harness.py \
+  --workdir "$WORK/qc_rigged" --repeats 1 --policy distilled \
+  --out "$WORK/BENCH_QC_rigged.json" > /dev/null
+if python tools/qc_gate.py --fresh "$WORK/BENCH_QC_rigged.json" \
+    --smoke > /dev/null 2>&1; then
+  echo "ci_check: qc_gate FAILED to catch the rigged distilled checkpoint" >&2
+  exit 1
+fi
+echo "ci_check: policy legs OK (delegation honest run passes, rigged checkpoint caught)"
 
 echo "== result-cache parity smoke (cached answer == fresh recompute, byte-for-byte) =="
 JAX_PLATFORMS=cpu PYTHONPATH="$REPO" python - "$WORK/cachepar" <<'PY'
@@ -239,7 +273,10 @@ def run(sched, output, tenant):
 # one daemon with the cache plane: tenant alice computes (cold insert),
 # tenant bob asks the same content question and must be answered from
 # the store; a separate cache-less daemon recomputes from scratch as
-# the parity reference
+# the parity reference.  Policy identity rides the same leg: an
+# EXPLICIT --policy majority is the default spelled out, so it must hit
+# alice's entry (and match her bytes), while delegation is a different
+# answer and must never share a cache entry with the default.
 sched = Scheduler(queue_bound=8, gang_size=4, backend="tpu",
                   result_cache=os.path.join(WORK, "plane"))
 server = ServeServer(sched, port=0)
@@ -250,6 +287,11 @@ try:
                            tenant="alice"), timeout=600)
     warm = client.run(dict(SPEC, output=os.path.join(WORK, "warm"),
                            tenant="bob"), timeout=600)
+    maj = client.run(dict(SPEC, output=os.path.join(WORK, "maj"),
+                          tenant="dana", policy="majority"), timeout=600)
+    deleg = client.run(dict(SPEC, output=os.path.join(WORK, "deleg"),
+                            tenant="erin", policy="delegation"),
+                       timeout=600)
 finally:
     server.close()
     sched.close(timeout=120)
@@ -259,15 +301,20 @@ fresh = run(Scheduler(queue_bound=8, gang_size=4, backend="tpu"),
 
 assert cold["state"] == "done" and cold["cached"] is False, cold
 assert warm["state"] == "done" and warm["cached"] is True, warm
+assert maj["state"] == "done" and maj["cached"] is True, maj
+assert deleg["state"] == "done" and deleg["cached"] is False, deleg
 assert fresh["state"] == "done" and fresh["cached"] is False, fresh
 ref = tree(os.path.join(WORK, "fresh", "par"))
 got = tree(os.path.join(WORK, "warm", "par"))
 assert ref and got == ref, "cached bytes diverge from recompute: " + str(
     sorted(set(ref) ^ set(got)) or
     sorted(k for k in ref if ref[k] != got.get(k)))
-assert snap["cache_inserts"] == 1 and snap["cache_hits"] == 1, snap
+assert tree(os.path.join(WORK, "maj", "par")) == ref, \
+    "explicit --policy majority diverges from the default's bytes"
+assert snap["cache_inserts"] == 2 and snap["cache_hits"] == 2, snap
 print(f"ci_check: cache parity OK ({len(ref)} files byte-identical to a "
-      f"fresh recompute, {snap['cache_bytes']} bytes in the plane)")
+      f"fresh recompute; explicit majority shares the default's entry, "
+      f"delegation does not; {snap['cache_bytes']} bytes in the plane)")
 PY
 
 echo "== fleet failover smoke (router + 2 workers, kill -9 one mid-run) =="

@@ -1,0 +1,230 @@
+#!/usr/bin/env python
+"""Train the distilled consensus-policy head and emit its checkpoint.
+
+Knowledge-distillation setup (PAPERS.md, arxiv 2211.09862 applied to
+consensus calling): the teacher is the simulator's ground truth — every
+synthetic family position has a known molecule base — and the student is
+the tiny per-position MLP ``policies/distilled.py`` runs inside the
+kernels.  Training data is per-position count/qual planes fabricated
+with the same error model ``utils.simulate`` uses (per-base substitution
+probability follows the member's Phred, with a per-regime miscalibration
+factor for degraded reads), mixed across clean, mixed-quality, and
+heavily degraded regimes so the head sees both the easy mass and the
+low-quality families where majority loses positions.
+
+Everything is seeded and the data/optimizer streams are pure functions
+of the config, so re-running this tool with the committed defaults
+reproduces the committed checkpoint byte-for-byte:
+
+    python tools/distill_train.py \
+        --out consensuscruncher_tpu/policies/checkpoints/distilled_v1.json
+
+The checkpoint's ``meta`` records the training provenance (tool, seed,
+regime mix, held-out accuracy per regime vs the majority baseline).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from consensuscruncher_tpu.policies import distilled  # noqa: E402
+
+#: Training regimes: fraction of degraded members, their Phred band, and
+#: how much worse than their Phred claims they really are (degraded
+#: basecalls are systematically miscalibrated — the regime delegation
+#: and the distilled head exist for).
+REGIMES = (
+    {"name": "clean", "lowq_fraction": 0.0, "lowq_band": (5, 16),
+     "healthy_band": (25, 41), "miscal": 1.0},
+    {"name": "mixed", "lowq_fraction": 0.5, "lowq_band": (5, 16),
+     "healthy_band": (25, 41), "miscal": 3.0},
+    {"name": "degraded", "lowq_fraction": 0.8, "lowq_band": (5, 16),
+     "healthy_band": (25, 41), "miscal": 5.0},
+)
+
+QUAL_CAP = 60
+MAX_FAM = 16
+
+
+def synth_positions(rng, n, regime):
+    """Fabricate ``n`` independent family positions under one regime.
+
+    Returns ``(counts (n,5) int32, qsums (n,5) int32, fam (n,) int32,
+    labels (n,) int32)`` — the same planes the kernels hand ``decide``,
+    one position per row, with the truth base as the label.
+    """
+    fam = np.maximum(1, rng.poisson(3.0, n)).astype(np.int32)
+    fam = np.minimum(fam, MAX_FAM)
+    truth = rng.integers(0, 4, n).astype(np.int32)
+    counts = np.zeros((n, 5), np.int32)
+    qsums = np.zeros((n, 5), np.int32)
+    member = np.arange(MAX_FAM)[None, :] < fam[:, None]  # (n, F)
+    degraded = member & (rng.random((n, MAX_FAM)) < regime["lowq_fraction"])
+    lo, hi = regime["lowq_band"]
+    hlo, hhi = regime["healthy_band"]
+    quals = np.where(degraded,
+                     rng.integers(lo, hi, (n, MAX_FAM)),
+                     rng.integers(hlo, hhi, (n, MAX_FAM))).astype(np.int32)
+    # substitution probability from the member's own Phred, inflated by
+    # the regime's miscalibration factor for degraded members
+    p_err = np.power(10.0, -quals / 10.0)
+    p_err = np.minimum(0.75, np.where(degraded, p_err * regime["miscal"], p_err))
+    err = member & (rng.random((n, MAX_FAM)) < p_err)
+    delta = rng.integers(1, 4, (n, MAX_FAM)).astype(np.int32)
+    bases = np.where(err, (truth[:, None] + delta) % 4, truth[:, None])
+    bases = np.where(member, bases, 4)  # non-members park on a dead lane
+    for lane in range(4):
+        hit = member & (bases == lane)
+        counts[:, lane] = hit.sum(axis=1)
+        qsums[:, lane] = np.where(hit, quals, 0).sum(axis=1)
+    return counts, qsums, fam, truth
+
+
+def majority_accuracy(counts, labels):
+    """Baseline: fraction of positions where the plain modal base is the
+    truth (ties broken toward the lower lane — close enough for a
+    reference number; the exact kernel tie-break needs member order,
+    which per-position planes do not carry)."""
+    modal = counts[:, :4].argmax(axis=1)
+    return float((modal == labels).mean())
+
+
+def init_params(rng, hidden):
+    def glorot(shape):
+        scale = np.sqrt(2.0 / sum(shape))
+        return (rng.standard_normal(shape) * scale).astype(np.float32)
+
+    return {
+        "w1": glorot((distilled.N_FEATURES, hidden)),
+        "b1": np.zeros(hidden, np.float32),
+        "w2": glorot((hidden, 5)),
+        "b2": np.zeros(5, np.float32),
+    }
+
+
+def train(args):
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(args.seed)
+    per = args.samples // len(REGIMES)
+    planes = [synth_positions(rng, per, reg) for reg in REGIMES]
+    counts = np.concatenate([p[0] for p in planes])
+    qsums = np.concatenate([p[1] for p in planes])
+    fam = np.concatenate([p[2] for p in planes])
+    labels = np.concatenate([p[3] for p in planes])
+    feats = np.asarray(distilled.features(
+        jnp.asarray(counts), jnp.asarray(qsums), jnp.asarray(fam),
+        qual_cap=QUAL_CAP))
+
+    # shuffled train/holdout split (holdout keeps regime provenance via
+    # the pre-shuffle index so accuracy reports stay per-regime)
+    order = rng.permutation(len(feats))
+    n_hold = len(feats) // 5
+    hold, tr = order[:n_hold], order[n_hold:]
+    x_tr = jnp.asarray(feats[tr])
+    y_tr = jnp.asarray(labels[tr])
+
+    params = {k: jnp.asarray(v)
+              for k, v in init_params(rng, args.hidden).items()}
+
+    def loss_fn(p, x, y):
+        logits = distilled.forward(p, x)
+        logp = jax.nn.log_softmax(logits, axis=1)
+        return -jnp.take_along_axis(logp, y[:, None], axis=1).mean()
+
+    grad_fn = jax.jit(jax.value_and_grad(loss_fn))
+
+    # plain Adam, full batch (the model is ~300 params; fancier batching
+    # buys nothing but a longer rng story)
+    m = {k: jnp.zeros_like(v) for k, v in params.items()}
+    v = {k: jnp.zeros_like(v) for k, v in params.items()}
+    b1, b2, eps = 0.9, 0.999, 1e-8
+    for step in range(1, args.steps + 1):
+        loss, grads = grad_fn(params, x_tr, y_tr)
+        for k in params:
+            m[k] = b1 * m[k] + (1 - b1) * grads[k]
+            v[k] = b2 * v[k] + (1 - b2) * grads[k] ** 2
+            mh = m[k] / (1 - b1 ** step)
+            vh = v[k] / (1 - b2 ** step)
+            params[k] = params[k] - args.lr * mh / (jnp.sqrt(vh) + eps)
+        if step % 100 == 0 or step == 1:
+            print(f"distill_train: step {step} loss {float(loss):.4f}",
+                  file=sys.stderr, flush=True)
+
+    # held-out accuracy per regime, distilled vs the majority baseline
+    np_params = {k: np.asarray(vv) for k, vv in params.items()}
+    logits_hold = np.asarray(distilled.forward(
+        {k: jnp.asarray(vv) for k, vv in np_params.items()},
+        jnp.asarray(feats[hold])))
+    pred = logits_hold.argmax(axis=1)
+    accuracy = {}
+    for i, reg in enumerate(REGIMES):
+        in_reg = (hold >= i * per) & (hold < (i + 1) * per)
+        idx = hold[in_reg]
+        accuracy[reg["name"]] = {
+            "distilled": float((pred[in_reg] == labels[idx]).mean()),
+            "majority": majority_accuracy(counts[idx], labels[idx]),
+            "n": int(in_reg.sum()),
+        }
+        print(f"distill_train: holdout[{reg['name']}] "
+              f"distilled={accuracy[reg['name']]['distilled']:.4f} "
+              f"majority={accuracy[reg['name']]['majority']:.4f}",
+              file=sys.stderr, flush=True)
+    return np_params, accuracy
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--out", default=os.path.join(
+        _REPO, "consensuscruncher_tpu", "policies", "checkpoints",
+        distilled.CHECKPOINT_NAME))
+    ap.add_argument("--seed", type=int, default=17)
+    ap.add_argument("--samples", type=int, default=120_000,
+                    help="total positions across the regime mix")
+    ap.add_argument("--hidden", type=int, default=16)
+    ap.add_argument("--steps", type=int, default=400)
+    ap.add_argument("--lr", type=float, default=0.01)
+    args = ap.parse_args(argv)
+
+    params, accuracy = train(args)
+    doc = {
+        "version": 1,
+        "policy": "distilled",
+        "w1": [[round(float(x), 6) for x in row] for row in params["w1"]],
+        "b1": [round(float(x), 6) for x in params["b1"]],
+        "w2": [[round(float(x), 6) for x in row] for row in params["w2"]],
+        "b2": [round(float(x), 6) for x in params["b2"]],
+        "meta": {
+            "tool": "tools/distill_train.py",
+            "seed": args.seed,
+            "samples": args.samples,
+            "hidden": args.hidden,
+            "steps": args.steps,
+            "lr": args.lr,
+            "qual_cap": QUAL_CAP,
+            "regimes": [r["name"] for r in REGIMES],
+            "holdout_accuracy": {
+                name: {k: (round(v, 4) if isinstance(v, float) else v)
+                       for k, v in row.items()}
+                for name, row in accuracy.items()},
+        },
+    }
+    os.makedirs(os.path.dirname(os.path.abspath(args.out)), exist_ok=True)
+    with open(args.out, "w") as fh:
+        json.dump(doc, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    print(f"distill_train: wrote {args.out}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
