@@ -67,6 +67,7 @@ def load_checkpoint(path: str | None = None) -> dict:
     corrupted checkpoint is caught downstream by tools/qc_gate.py's
     per-policy accuracy gate (the CI positive control)."""
     path = path or checkpoint_path()
+    # cct: allow-effect(checkpoint weights load once at trace time and are baked into the jitted program as constants — deliberate)
     with open(path) as fh:
         doc = json.load(fh)
     if doc.get("version") != 1 or doc.get("policy") != "distilled":

@@ -168,13 +168,26 @@ class BucketAutotuner:
         self._recompiles_baseline: int | None = None
 
     @staticmethod
-    def _key(shape) -> str:
-        return "x".join(str(int(d)) for d in shape)
+    def _key(shape, policy: str = "majority") -> str:
+        """Table row key: ``BxFxL`` under the majority default (the
+        committed-table back-compat form), ``BxFxL@policy`` otherwise —
+        a kernel choice measured under one vote policy must never apply
+        to a job running another (Pallas only exists for majority; a
+        majority-learned "pallas" row would silently reroute to dense
+        for delegation/distilled jobs)."""
+        base = "x".join(str(int(d)) for d in shape)
+        return base if policy == "majority" else f"{base}@{policy}"
 
     @staticmethod
     def _shape(key: str) -> tuple[int, int, int]:
-        b, f, l = (int(d) for d in key.split("x"))
+        b, f, l = (int(d) for d in key.split("@", 1)[0].split("x"))
         return (b, f, l)
+
+    @staticmethod
+    def _active_policy() -> str:
+        from consensuscruncher_tpu.policies.base import get_vote_policy
+
+        return get_vote_policy().name
 
     # ------------------------------------------------------------ persist
 
@@ -219,10 +232,11 @@ class BucketAutotuner:
         from consensuscruncher_tpu.parallel import batching
 
         counts = batching.bucket_shape_counts(reset=True)
+        policy = self._active_policy()
         fresh = []
         with self._lock:
             for shape, n in counts.items():
-                key = self._key(shape)
+                key = self._key(shape, policy)
                 ent = self.table.setdefault(key, {"count": 0, "backend": None})
                 ent["count"] = int(ent.get("count", 0)) + int(n)
                 if ent.get("backend") is None:
@@ -258,10 +272,18 @@ class BucketAutotuner:
                 times.append(time.perf_counter() - t0)
             return min(times)
 
+        policy = self._active_policy()
         entry: dict = {}
         entry["dense_s"] = best_of(
             lambda: consensus_batch_host(bases, quals, sizes, config))
-        if jax.default_backend() == "tpu":
+        if policy != "majority":
+            # Pallas hard-codes the majority vote program; under any
+            # other policy the pallas wrapper reroutes to dense, so
+            # there is nothing to race — record the only legal choice.
+            entry["pallas_s"] = None
+            entry["backend"] = "dense"
+            entry["reason"] = "non_majority_policy"
+        elif jax.default_backend() == "tpu":
             from consensuscruncher_tpu.ops.consensus_pallas import (
                 consensus_batch_pallas_host,
             )
@@ -275,7 +297,8 @@ class BucketAutotuner:
             entry["backend"] = "dense"
             entry["reason"] = "cpu_fallback"
         with self._lock:
-            ent = self.table.setdefault(self._key(shape), {"count": 0})
+            ent = self.table.setdefault(self._key(shape, policy),
+                                        {"count": 0})
             ent.update(entry)
             return dict(ent)
 
@@ -301,18 +324,33 @@ class BucketAutotuner:
                       "recording dense fallback", file=sys.stderr, flush=True)
                 with self._lock:
                     self.table.setdefault(
-                        self._key(shape), {"count": 0}).update(
+                        self._key(shape, self._active_policy()),
+                        {"count": 0}).update(
                         {"backend": "dense", "reason": f"measure_failed: {e}"})
         return done
 
     # -------------------------------------------------------------- apply
 
     def choose_backend(self, shape) -> str:
+        """Backend for one padded shape under the ACTIVE vote policy.
+
+        The policy is part of the decision, not just the row key: Pallas
+        implements only the majority program (``consensus_pallas``
+        reroutes everything else back to dense), so any other policy
+        pins dense — even under an explicit ``backend = pallas``
+        override, and even when a majority-learned table row says
+        pallas for the same shape."""
+        policy = self._active_policy()
         if self.backend != "auto":
+            if self.backend == "pallas" and policy != "majority":
+                return "dense"
             return self.backend
         with self._lock:
-            ent = self.table.get(self._key(shape))
-        return (ent or {}).get("backend") or "dense"
+            ent = self.table.get(self._key(shape, policy))
+        backend = (ent or {}).get("backend") or "dense"
+        if backend == "pallas" and policy != "majority":
+            return "dense"  # stale pre-policy table row
+        return backend
 
     def policy(self, shape) -> str:
         """``ops.consensus_tpu`` kernel-policy callable (only "pallas"

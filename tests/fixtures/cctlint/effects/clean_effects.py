@@ -1,0 +1,43 @@
+"""Clean twin of ``viol_effects.py`` — same program shape, zero findings.
+
+The helpers are pure (progress/counting happen in host code *around* the
+compiled call, not inside it), and the policy's wire-contract methods are
+pure jnp.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def _scale(x):
+    return x * 2
+
+
+def vote_kernel(bases):
+    return _scale(bases.astype(jnp.int32)).sum(axis=-1)
+
+
+# cct: allow-jit(fixture needs a device region for the effects pass)
+compiled_vote = jax.jit(vote_kernel)
+
+
+def run_batch(bases, stats):
+    # host effects live here, outside the traced region
+    out = compiled_vote(bases)
+    stats["batches"] = stats.get("batches", 0) + 1
+    return out
+
+
+class QuietPolicy:
+    """A vote policy whose device-side contract methods stay pure jnp."""
+
+    name = "quiet"
+
+    def decide(self, counts, quals, lengths):
+        return counts.argmax(axis=-1)
+
+    def family_vote_fn(self):
+        def fn(bases, quals, fam_size):
+            return self.decide(bases, quals, fam_size)
+
+        return fn

@@ -1,0 +1,60 @@
+"""Seeded violations for the effects pass — every CCT100x must fire here.
+
+Mirrors the shape of real kernel code: a jitted entry point whose helpers
+(one hop deep, so only the interprocedural fixpoint can see them) print,
+mutate a module global, and take a lock; plus a vote policy whose
+``decide``/``family_vote_fn`` carry host effects.  The clean twin
+(``clean_effects.py``) is the same program with the effects removed.
+"""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+
+_TRACE_COUNT = 0
+_STATS_LOCK = threading.Lock()
+
+
+def _log_progress(x):
+    print("voting on", x.shape)  # CCT1001: IO under a jit region
+    return x
+
+
+def _bump_counter(x):
+    global _TRACE_COUNT
+    _TRACE_COUNT += 1  # CCT1002: global mutation under a jit region
+    return x
+
+
+def _guarded_scale(x):
+    with _STATS_LOCK:  # CCT1003: lock taken at trace time only
+        return x * 2
+
+
+def vote_kernel(bases):
+    bases = _log_progress(bases)
+    bases = _bump_counter(bases)
+    return _guarded_scale(bases.astype(jnp.int32)).sum(axis=-1)
+
+
+# cct: allow-jit(fixture needs a device region for the effects pass)
+compiled_vote = jax.jit(vote_kernel)
+
+
+class ChattyPolicy:
+    """A vote policy whose device-side contract methods touch the host."""
+
+    name = "chatty"
+
+    def decide(self, counts, quals, lengths):
+        print("decide", lengths)  # CCT1004: IO inside the wire contract
+        return counts.argmax(axis=-1)
+
+    def family_vote_fn(self):
+        def fn(bases, quals, fam_size):
+            with open("/tmp/votes.log", "a") as fh:  # CCT1004: file IO
+                fh.write("vote\n")
+            return self.decide(bases, quals, fam_size)
+
+        return fn

@@ -154,3 +154,59 @@ def test_learn_loop_thread_stops():
     t.stop_event.set()
     t.join(timeout=5.0)
     assert not t.is_alive()
+
+
+def test_choose_backend_consults_active_vote_policy():
+    """A majority-learned "pallas" row must not apply to a job running a
+    different vote policy: the Pallas kernel hard-codes the majority
+    program and would silently reroute to dense.  The policy is part of
+    the decision AND the table row key."""
+    from consensuscruncher_tpu.policies.base import (
+        installed_vote_policy, set_vote_policy,
+    )
+
+    at = warmup.BucketAutotuner()
+    at.table["8x4x32"] = {"count": 9, "backend": "pallas"}  # learned @ majority
+    prior = installed_vote_policy()
+    try:
+        assert at.choose_backend((8, 4, 32)) == "pallas"  # default policy
+        set_vote_policy("delegation")
+        # stale majority row must not leak through, even with override
+        assert at.choose_backend((8, 4, 32)) == "dense"
+        assert warmup.BucketAutotuner(
+            backend="pallas").choose_backend((8, 4, 32)) == "dense"
+        # a delegation-keyed row is honoured independently
+        at.table["8x4x32@delegation"] = {"count": 1, "backend": "dense",
+                                         "reason": "non_majority_policy"}
+        assert at.choose_backend((8, 4, 32)) == "dense"
+        set_vote_policy("majority")
+        assert at.choose_backend((8, 4, 32)) == "pallas"
+    finally:
+        set_vote_policy(prior)
+
+
+def test_learn_and_measure_key_rows_by_policy():
+    """Live learning and measurement under a non-majority policy land in
+    policy-suffixed rows (never clobbering the majority table), and the
+    measured row pins dense with the non_majority_policy reason."""
+    from consensuscruncher_tpu.policies.base import (
+        installed_vote_policy, set_vote_policy,
+    )
+
+    at = warmup.BucketAutotuner()
+    prior = installed_vote_policy()
+    try:
+        set_vote_policy("delegation")
+        batching.record_bucket_shape(8, 4, 32)
+        fresh = at.learn_from_live()
+        assert fresh == [(8, 4, 32)]
+        assert "8x4x32@delegation" in at.table
+        assert "8x4x32" not in at.table
+        ent = at.measure((8, 4, 32))
+        assert ent["backend"] == "dense"
+        assert ent["reason"] == "non_majority_policy"
+        assert at.table["8x4x32@delegation"]["backend"] == "dense"
+    finally:
+        set_vote_policy(prior)
+    # _shape round-trips the policy-suffixed key back to the bucket
+    assert warmup.BucketAutotuner._shape("8x4x32@delegation") == (8, 4, 32)

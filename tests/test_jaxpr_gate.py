@@ -1,0 +1,215 @@
+"""Compiled-graph contract auditor (``tools.jaxpr_gate``), tier-1.
+
+Three layers: (1) canonicalization invariance units — the digest must be
+blind to var names, param-dict insertion order, and repeated tracing in
+one process, but sensitive to a single extra primitive; (2) edge-shape
+contracts mirroring ``tests/test_pallas.py`` (F=1 singleton families,
+the all-PAD dead-row bucket shape, the 7-of-10 @ 0.7 rational-cutoff
+boundary) — at every one of them the majority policy must trace the
+byte-identical program to the partial-applied reference; (3) the
+committed ``tools/jaxpr_contracts.json`` is green against the working
+tree, including the cross-entry equality, stream-length-invariance, and
+pow2 specialization-count contracts.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import pytest  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools import jaxpr_gate as gate  # noqa: E402
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _scoped_reference_policy():
+    """The gate registers its gate-local ``reference`` policy in the
+    process-wide registry (kernel entry points resolve policies by name
+    at trace time).  Drop it on module teardown so the registry pin in
+    ``test_policies.py`` still sees exactly the production set."""
+    yield
+    from consensuscruncher_tpu.policies import base
+
+    base._REGISTRY.pop("reference", None)
+
+
+def _digest(fn, *args):
+    return gate.trace_entry(fn, args)["digest"]
+
+
+# ------------------------------------------------ canonicalization units
+
+def test_alpha_rename_invariance():
+    """Var/arg/local names never reach the canonical text — two alpha-
+    equivalent programs share one digest."""
+    def f(x, y):
+        z = x * 2
+        return z + y
+
+    def g(alpha, beta):
+        gamma = alpha * 2
+        return gamma + beta
+
+    a = jnp.zeros((4,), jnp.float32)
+    assert _digest(f, a, a) == _digest(g, a, a)
+
+
+def test_param_dict_ordering_invariance():
+    one = gate._param_str({"b": 1, "a": (2, 3)}, [])
+    other = {}
+    other["a"] = (2, 3)
+    other["b"] = 1
+    assert one == gate._param_str(other, [])
+    assert one == "{a=(2, 3), b=1}"
+
+
+def test_repeated_trace_same_digest():
+    """Two traces in one process allocate fresh Var objects — the alpha
+    rename must make the digests identical anyway (jit-wrapped, so the
+    nested pjit jaxpr is canonicalized too)."""
+    fn = jax.jit(lambda x: (x.astype(jnp.int32) * 3).sum(axis=-1))
+    a = jnp.zeros((8, 16), jnp.uint8)
+    assert _digest(fn, a) == _digest(fn, a)
+
+
+def test_address_and_callable_scrubbing():
+    assert gate._scrub("<function foo at 0x7fab01>") == "<function foo>"
+
+    def named(x):
+        return x
+
+    assert "named" in gate._param_str(named, [])
+
+
+def test_single_primitive_change_is_caught():
+    def f(x):
+        return x * 2
+
+    def mutated(x):
+        return x * 2 + 1
+
+    a = jnp.zeros((4,), jnp.int32)
+    assert _digest(f, a) != _digest(mutated, a)
+
+
+def test_facts_sheet_counts_primitives_and_dtypes():
+    rec = gate.trace_entry(
+        lambda x: (x * 2).astype(jnp.float32), (jnp.zeros((4,), jnp.int32),))
+    facts = rec["facts"]
+    assert facts["primitives"].get("mul") == 1
+    assert facts["primitives"].get("convert_element_type") == 1
+    assert not facts["f64_upcast"]
+    assert facts["callbacks"] == []
+
+
+# ----------------------------------------- edge-shape equality contracts
+
+def _vote_digests(policy_pair, shape, num, den, qt=13, qc=60):
+    gate._register_reference_policy()
+    from consensuscruncher_tpu.policies.base import get_policy
+
+    b, f, l = shape
+    bases = jnp.zeros((b, f, l), jnp.uint8)
+    quals = jnp.zeros((b, f, l), jnp.uint8)
+    sizes = jnp.zeros((b,), jnp.int32)
+    out = []
+    for policy in policy_pair:
+        fn = get_policy(policy).family_vote_fn(
+            num=num, den=den, qual_threshold=qt, qual_cap=qc)
+        rec = gate.trace_entry(jax.vmap(fn, in_axes=(0, 0, 0)),
+                               (bases, quals, sizes))
+        out.append(rec)
+    return out
+
+
+@pytest.mark.parametrize("shape,num,den", [
+    ((8, 1, 32), 7, 10),    # F=1 singleton families (test_pallas mirror)
+    ((8, 4, 32), 7, 10),    # the all-PAD dead-row bucket shape
+    ((1, 10, 16), 7, 10),   # 7-of-10 @ cutoff 0.7 boundary bucket
+])
+def test_majority_equals_reference_at_edge_shapes(shape, num, den):
+    maj, ref = _vote_digests(("majority", "reference"), shape, num, den)
+    assert maj["digest"] == ref["digest"], (
+        "majority policy no longer traces the reference program at "
+        f"{shape}: {maj['digest'][:12]} vs {ref['digest'][:12]}")
+    assert maj["facts"]["callbacks"] == []
+    assert not maj["facts"]["f64_upcast"]
+
+
+def test_trace_is_data_independent():
+    """All-PAD vs live member planes are a *data* difference — abstract
+    eval must pin one program per shape regardless (no input folding)."""
+    import numpy as np
+
+    from consensuscruncher_tpu.policies.base import get_policy
+    from consensuscruncher_tpu.utils.phred import PAD
+
+    fn = jax.vmap(get_policy("majority").family_vote_fn(
+        num=7, den=10, qual_threshold=13, qual_cap=60), in_axes=(0, 0, 0))
+    dead = (jnp.full((8, 4, 32), PAD, jnp.uint8),
+            jnp.zeros((8, 4, 32), jnp.uint8), jnp.zeros((8,), jnp.int32))
+    rng = np.random.default_rng(43)
+    live = (jnp.asarray(rng.integers(0, 5, (8, 4, 32)), jnp.uint8),
+            jnp.asarray(rng.integers(0, 41, (8, 4, 32)), jnp.uint8),
+            jnp.asarray(rng.integers(1, 5, (8,)), jnp.int32))
+    assert _digest(fn, *dead) == _digest(fn, *live)
+
+
+# -------------------------------------------- committed contract health
+
+def test_committed_contracts_are_green():
+    """The acceptance-criterion run: every pinned entry re-traces to its
+    digest, equality/invariance/specialization contracts hold."""
+    assert gate.check() == 0
+
+
+def test_stream_length_invariance_direct():
+    ok, detail = gate.stream_len_invariance()
+    assert ok, detail
+
+
+def test_specialization_counts_match_pinned():
+    import json
+
+    with open(gate.CONTRACTS_PATH) as fh:
+        pinned = json.load(fh)
+    assert gate.specialization_counts() == pinned["specializations"]
+
+
+def test_contract_file_covers_kernel_policy_matrix():
+    import json
+
+    with open(gate.CONTRACTS_PATH) as fh:
+        entries = set(json.load(fh)["entries"])
+    for policy in gate.POLICIES:
+        assert f"dense_vote/{policy}" in entries
+        assert f"stream_gather_raw/{policy}" in entries
+    for name in ("stream_segment/majority", "stream_pack8/majority",
+                 "stream_pack4/majority", "stream_pack6/majority",
+                 "pallas_vote/majority", "pallas_fused_duplex/majority",
+                 "duplex_vote", "singleton_hamming", "rescue_pair_gather",
+                 "rescue_against_gather"):
+        assert name in entries
+
+
+def test_explain_and_diff_rendering(capsys):
+    assert gate.explain("duplex_vote") == 0
+    out = capsys.readouterr().out
+    assert "digest:" in out and "canonical program:" in out
+    assert gate.explain("no_such_entry") == 2
+
+    pinned = {"digest": "a" * 64, "lines": ["in (v0)", "mul[] v0 -> v1"],
+              "facts": {"primitives": {"mul": 1}}}
+    current = {"digest": "b" * 64, "lines": ["in (v0)", "add[] v0 -> v1"],
+               "facts": {"primitives": {"add": 1}}}
+    msgs = gate._diff_entry("x", pinned, current)
+    text = "\n".join(msgs)
+    assert "first divergent eqn" in text
+    assert "mul 1 -> 0" in text and "add 0 -> 1" in text

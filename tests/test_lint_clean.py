@@ -48,6 +48,8 @@ def test_repo_is_lint_clean():
     ("serve/viol_shared_state.py", {"CCT801", "CCT802", "CCT803"}),
     ("serve/viol_cache_store.py", {"CCT901", "CCT902"}),
     ("policies/viol_policycov.py", {"CCT611"}),
+    ("effects/viol_effects.py",
+     {"CCT1001", "CCT1002", "CCT1003", "CCT1004"}),
 ])
 def test_each_pass_detects_its_seeded_violation(rel, expected):
     findings = run_paths([os.path.join(FIXTURES, rel)], root=REPO)
@@ -63,6 +65,7 @@ def test_each_pass_detects_its_seeded_violation(rel, expected):
     "serve/clean_cache_store.py",
     "clean_qc_series.py",
     "policies/clean_policycov.py",
+    "effects/clean_effects.py",
 ])
 def test_protocol_twin_fixtures_are_clean(rel):
     """The conformant twins prove the CCT7/CCT8 rules key on the actual
@@ -219,3 +222,102 @@ def test_cli_repo_wide_exits_zero():
          "tools"], cwd=REPO, env=dict(os.environ, PYTHONPATH=REPO),
         capture_output=True, text=True)
     assert out.returncode == 0, out.stdout + out.stderr
+
+
+def test_effect_pragma_family_is_distinct_from_transfer(tmp_path):
+    """CCT1001 (effects) must key on the 'effect' pragma, never be
+    waivable by 'allow-transfer' (the CCT1xx hostsync family) — the
+    4-digit codes use the 5-char family prefix."""
+    src = (
+        "import jax\n"
+        "def helper(x):\n"
+        "    print(x)  # cct: allow-transfer(wrong family)\n"
+        "    return x\n"
+        "def kern(x):\n"
+        "    return helper(x)\n"
+        "compiled = jax.jit(kern)\n"
+    )
+    p = tmp_path / "mod.py"
+    p.write_text(src)
+    findings = run_paths([str(p)], root=str(tmp_path), passes=["effects"])
+    assert {f.code for f in findings} == {"CCT1001"}
+
+    p.write_text(src.replace("allow-transfer(wrong family)",
+                             "allow-effect(trace-time banner, one-shot)"))
+    findings = run_paths([str(p)], root=str(tmp_path), passes=["effects"])
+    assert findings == []
+
+
+def test_baseline_suppresses_and_refuses_stale(tmp_path):
+    from tools.cctlint.core import (
+        BaselineError, apply_baseline, load_baseline,
+    )
+
+    viol = os.path.join(FIXTURES, "effects", "viol_effects.py")
+    findings = run_paths([viol], root=REPO, select=["CCT1001"])
+    assert findings, "fixture must trip CCT1001 for this test to mean anything"
+    rel = findings[0].path
+
+    ok = tmp_path / "baseline.json"
+    ok.write_text(json.dumps({"version": 1, "entries": [
+        {"code": "CCT1001", "path": rel, "expires": "2099-01-01",
+         "reason": "landing the effects pass ahead of fixture cleanup"}]}))
+    assert apply_baseline(findings, load_baseline(str(ok))) == []
+
+    pinned_line = tmp_path / "pinned.json"
+    pinned_line.write_text(json.dumps({"version": 1, "entries": [
+        {"code": "CCT1001", "path": rel, "line": findings[0].line,
+         "expires": "2099-01-01", "reason": "one specific site"}]}))
+    assert apply_baseline(findings, load_baseline(str(pinned_line))) == []
+
+    wrong_line = tmp_path / "wrong_line.json"
+    wrong_line.write_text(json.dumps({"version": 1, "entries": [
+        {"code": "CCT1001", "path": rel, "line": findings[0].line + 500,
+         "expires": "2099-01-01", "reason": "misses"}]}))
+    assert apply_baseline(findings,
+                          load_baseline(str(wrong_line))) == findings
+
+    stale = tmp_path / "stale.json"
+    stale.write_text(json.dumps({"version": 1, "entries": [
+        {"code": "CCT1001", "path": rel, "expires": "2020-01-01",
+         "reason": "long gone"}]}))
+    with pytest.raises(BaselineError, match="expired"):
+        load_baseline(str(stale))
+
+    no_expiry = tmp_path / "no_expiry.json"
+    no_expiry.write_text(json.dumps({"version": 1, "entries": [
+        {"code": "CCT1001", "path": rel, "reason": "forever"}]}))
+    with pytest.raises(BaselineError, match="expires"):
+        load_baseline(str(no_expiry))
+
+
+def test_cli_baseline_flag_and_stale_exit():
+    env = dict(os.environ, PYTHONPATH=REPO)
+    viol = os.path.join(FIXTURES, "effects", "viol_effects.py")
+    rel = os.path.relpath(viol, REPO).replace(os.sep, "/")
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        ok = os.path.join(td, "ok.json")
+        with open(ok, "w") as fh:
+            json.dump({"version": 1, "entries": [
+                {"code": code, "path": rel, "expires": "2099-01-01",
+                 "reason": "effects pass landing"}
+                for code in ("CCT1001", "CCT1002", "CCT1003", "CCT1004")]},
+                fh)
+        out = subprocess.run(
+            [sys.executable, "-m", "tools.cctlint", viol, "--select",
+             "CCT10", "--baseline", ok],
+            cwd=REPO, env=env, capture_output=True, text=True)
+        assert out.returncode == 0, out.stdout + out.stderr
+
+        stale = os.path.join(td, "stale.json")
+        with open(stale, "w") as fh:
+            json.dump({"version": 1, "entries": [
+                {"code": "CCT1001", "path": rel, "expires": "2000-01-01",
+                 "reason": "ancient"}]}, fh)
+        out = subprocess.run(
+            [sys.executable, "-m", "tools.cctlint", viol, "--baseline",
+             stale], cwd=REPO, env=env, capture_output=True, text=True)
+        assert out.returncode == 2
+        assert "expired" in out.stderr

@@ -13,7 +13,8 @@ import argparse
 import json
 import sys
 
-from .core import all_passes, run_paths
+from .core import BaselineError, all_passes, apply_baseline, load_baseline, \
+    run_paths
 
 DEFAULT_PATHS = ["consensuscruncher_tpu", "tools"]
 
@@ -38,7 +39,17 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--passes", default=None, metavar="NAMES",
                         help="comma-separated pass names to run "
                              f"(available: {','.join(all_passes())})")
+    parser.add_argument("--baseline", default=None, metavar="FILE",
+                        help="JSON suppression file (every entry needs an "
+                             "'expires' date; stale entries abort the run)")
     args = parser.parse_args(argv)
+
+    baseline = None
+    if args.baseline:
+        try:
+            baseline = load_baseline(args.baseline)
+        except BaselineError as exc:
+            parser.error(str(exc))
 
     passes = None
     if args.passes:
@@ -51,6 +62,8 @@ def main(argv: list[str] | None = None) -> int:
     findings = run_paths(
         args.paths or DEFAULT_PATHS, root=args.root,
         select=split(args.select), ignore=split(args.ignore), passes=passes)
+    if baseline:
+        findings = apply_baseline(findings, baseline)
 
     if args.format == "json":
         json.dump({"findings": [f.to_dict() for f in findings],

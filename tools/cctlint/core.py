@@ -16,7 +16,9 @@ import re
 #: family on the same line or the line directly below the pragma.
 PRAGMA_RE = re.compile(r"#\s*cct:\s*allow-([a-z-]+)\s*\(([^)]*)\)")
 
-#: Finding-code family -> pragma name that suppresses it.
+#: Finding-code family -> pragma name that suppresses it.  Three-digit
+#: codes key on their ``CCT<d>`` prefix, four-digit codes on ``CCT<dd>``
+#: — so CCT101 (transfer) and CCT1001 (effect) stay distinct families.
 PRAGMA_FAMILY = {
     "CCT1": "transfer",
     "CCT2": "nondet",
@@ -25,6 +27,7 @@ PRAGMA_FAMILY = {
     "CCT7": "protocol",
     "CCT8": "shared-state",
     "CCT9": "cache-store",
+    "CCT10": "effect",
     # CCT3 (fault coverage) and CCT6 (metric registry) have no pragma on
     # purpose: an unregistered or untested site is fixed by registering/
     # testing it, never by waiving it.
@@ -89,7 +92,9 @@ class SourceFile:
         return any(p in names for p in self.parts[:-1])
 
     def suppressed(self, code: str, line: int) -> bool:
-        name = PRAGMA_FAMILY.get(code[:4])
+        # CCT### -> 4-char family prefix; CCT#### -> 5-char (CCT10xx).
+        prefix = code[:5] if len(code) >= 7 else code[:4]
+        name = PRAGMA_FAMILY.get(prefix)
         if name is None:
             return False
         for candidate in (line, line - 1):
@@ -173,8 +178,8 @@ def _pragma_findings(files: list[SourceFile]) -> list[Finding]:
 def all_passes():
     """Name -> pass callable.  Imported lazily so a syntax error in one pass
     module doesn't take down the others during development."""
-    from . import (cachestore, determinism, faultcov, hostsync, jitdisc,
-                   locks, obscov, policycov, protocol, shared_state)
+    from . import (cachestore, determinism, effects, faultcov, hostsync,
+                   jitdisc, locks, obscov, policycov, protocol, shared_state)
 
     return {
         "hostsync": hostsync.run,
@@ -187,6 +192,7 @@ def all_passes():
         "shared_state": shared_state.run,
         "cachestore": cachestore.run,
         "policycov": policycov.run,
+        "effects": effects.run,
     }
 
 
@@ -228,6 +234,73 @@ def run_paths(paths: list[str], root: str | None = None, *,
         kept.append(f)
     kept.sort(key=Finding.sort_key)
     return kept
+
+
+class BaselineError(ValueError):
+    """A baseline file that must not be honoured: malformed, or holding a
+    stale (expired) entry — stale suppressions are refused, not ignored,
+    so an expiry date is a real deadline and not a comment."""
+
+
+def load_baseline(path: str) -> list[dict]:
+    """Parse and validate a ``--baseline`` suppression file.
+
+    Format: ``{"version": 1, "entries": [{"code", "path", "line"?,
+    "expires": "YYYY-MM-DD", "reason"}, ...]}``.  Every entry MUST carry
+    an expiry date and a reason; ``line`` is optional (omit to suppress
+    the code anywhere in the file).  Entries past their expiry raise
+    :class:`BaselineError` — the run refuses until the entry is fixed or
+    consciously re-dated in review.
+    """
+    import datetime
+    import json
+
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            doc = json.load(fh)
+    except (OSError, ValueError) as exc:
+        raise BaselineError(f"baseline {path}: unreadable ({exc})")
+    if not isinstance(doc, dict) or doc.get("version") != 1:
+        raise BaselineError(f"baseline {path}: want {{'version': 1, ...}}")
+    entries = doc.get("entries")
+    if not isinstance(entries, list):
+        raise BaselineError(f"baseline {path}: 'entries' must be a list")
+    today = datetime.date.today()
+    out: list[dict] = []
+    for i, ent in enumerate(entries):
+        where = f"baseline {path} entry {i}"
+        if not isinstance(ent, dict):
+            raise BaselineError(f"{where}: must be an object")
+        for field in ("code", "path", "expires", "reason"):
+            if not isinstance(ent.get(field), str) or not ent[field].strip():
+                raise BaselineError(f"{where}: missing/empty field {field!r}")
+        if "line" in ent and not isinstance(ent["line"], int):
+            raise BaselineError(f"{where}: 'line' must be an integer")
+        try:
+            expires = datetime.date.fromisoformat(ent["expires"])
+        except ValueError:
+            raise BaselineError(
+                f"{where}: bad expiry {ent['expires']!r} (want YYYY-MM-DD)")
+        if expires < today:
+            raise BaselineError(
+                f"{where}: expired {ent['expires']} ({ent['code']} at "
+                f"{ent['path']}) — fix the finding or re-date the entry")
+        out.append(dict(ent))
+    return out
+
+
+def apply_baseline(findings: list[Finding],
+                   entries: list[dict]) -> list[Finding]:
+    """Drop findings matched by a (validated) baseline entry.  A match is
+    exact code + repo-relative path, plus line when the entry pins one."""
+    def matches(f: Finding) -> bool:
+        for ent in entries:
+            if f.code == ent["code"] and f.path == ent["path"] and \
+                    ("line" not in ent or f.line == ent["line"]):
+                return True
+        return False
+
+    return [f for f in findings if not matches(f)]
 
 
 def call_name(node: ast.AST) -> str:

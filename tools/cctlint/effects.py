@@ -1,0 +1,246 @@
+"""Pass 11 — interprocedural purity / effect analysis (CCT10xx).
+
+The vote-policy subsystem widened the device-code surface: any module can
+register a :class:`VotePolicy` whose ``decide`` runs *inside* the jitted
+kernels of three different wires.  The jit-discipline pass checks call
+*sites*; this pass checks call *graphs* — it infers an effect summary per
+function and follows module-local calls to a fixpoint, so a ``print``
+three helpers deep under a jitted kernel is found at its own line.
+
+Effect lattice (per function, joined over callees):
+
+  pure < reads-global < {mutates-global, IO, locks}
+
+``reads-global`` (reading a name some function in the module declares
+``global``) is tracked but never flagged — config reads are normal host
+code.  The three impure levels each have a device-region rule, plus one
+rule for the policy/adapter surface:
+
+CCT1001  IO effect (``print`` / ``open`` / file writes / sleeps / env
+         mutation) reachable from a jitted / vmapped / shard_map'd
+         region — side effects inside traced code run once at trace
+         time, then silently never again.
+CCT1002  module-global mutation (``global`` + assignment) reachable from
+         a device region — trace-time-once, and a data race against the
+         host threads that read the global.
+CCT1003  lock acquire/release or ``with <lock>`` reachable from a device
+         region — the lock is taken at trace time and the traced program
+         retains no trace of it: the "critical section" is unprotected
+         on every real call.
+CCT1004  a ``decide`` / ``family_vote_fn`` implementation (the
+         :class:`VotePolicy` wire contract) or a vote-kernel adapter
+         (``*vote*`` in ``ops``/``policies``) with any host effect —
+         these run inside kernels jitted in *other* modules, so the
+         device-region inference above cannot see them; the name is the
+         contract.
+
+Device regions and their fixpoint come from ``hostsync._device_regions``
+(one inference, two passes).  Analysis is module-local like every other
+pass: cross-module calls are treated as effect-free, which keeps the
+pass quiet on obs counters and fault probes by construction.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+
+from .core import Finding, LintContext, SourceFile, call_name, terminal_name
+from .hostsync import _device_regions, _functions
+
+#: Exact dotted call names that are IO no matter the receiver.
+IO_CALLS = {
+    "print", "input", "breakpoint", "open",
+    "time.sleep", "os.system", "os.urandom", "os.remove", "os.rename",
+    "os.replace", "os.makedirs", "os.unlink",
+    "subprocess.run", "subprocess.Popen", "subprocess.check_call",
+    "subprocess.check_output",
+    "sys.stdout.write", "sys.stderr.write", "sys.stdout.flush",
+    "sys.stderr.flush",
+}
+
+#: Terminal attribute calls that are IO on any receiver (``fh.write(...)``)
+#: — device code has no business holding a writable handle at all.
+IO_ATTR_TERMINALS = {"write", "writelines", "flush", "fsync"}
+
+#: Dotted-prefix IO namespaces (``logging.info``, ``shutil.copy``, ...).
+IO_PREFIXES = ("logging.", "shutil.", "socket.", "subprocess.")
+
+#: Terminal calls that take/release a mutex.
+LOCK_TERMINALS = {"acquire", "release"}
+
+_EFFECT_LABEL = {"io": "IO", "mutate": "global mutation", "lock": "locking"}
+
+
+@dataclasses.dataclass
+class _Summary:
+    """Per-function effect summary: direct effect sites + local call edges."""
+
+    node: ast.AST
+    direct: list[tuple[str, int, str]]  # (kind, line, description)
+    calls: set[str]                     # module-local callee names
+
+
+def _is_lockish(node: ast.AST) -> bool:
+    """A name/attribute that smells like a mutex (``self._lock``, ``LOCK``)."""
+    if isinstance(node, ast.Attribute):
+        return "lock" in node.attr.lower()
+    if isinstance(node, ast.Name):
+        return "lock" in node.id.lower()
+    return False
+
+
+def _io_effect(node: ast.Call) -> str | None:
+    name = call_name(node)
+    if name in IO_CALLS:
+        return name
+    if name and any(name.startswith(p) for p in IO_PREFIXES):
+        return name
+    if isinstance(node.func, ast.Attribute) and \
+            node.func.attr in IO_ATTR_TERMINALS:
+        return f".{node.func.attr}()"
+    return None
+
+
+def _direct_effects(fn: ast.AST, mutable_globals: set[str]):
+    """Effect sites syntactically inside ``fn`` (nested defs included —
+    same subtree semantics as the hostsync device-region walk)."""
+    out: list[tuple[str, int, str]] = []
+    declared: set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Global):
+            declared.update(node.names)
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            desc = _io_effect(node)
+            if desc is not None:
+                out.append(("io", node.lineno, desc))
+            elif terminal_name(node) in LOCK_TERMINALS and \
+                    isinstance(node.func, ast.Attribute) and \
+                    _is_lockish(node.func.value):
+                out.append(("lock", node.lineno,
+                            f".{terminal_name(node)}()"))
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                ctx = item.context_expr
+                tgt = ctx.func if isinstance(ctx, ast.Call) else ctx
+                if _is_lockish(tgt):
+                    out.append(("lock", node.lineno,
+                                f"with {call_name(tgt) or '<lock>'}"))
+        elif isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                if isinstance(t, ast.Name) and t.id in declared:
+                    out.append(("mutate", node.lineno, f"global {t.id}"))
+    return out
+
+
+def _summaries(src: SourceFile) -> dict[str, _Summary]:
+    tree = src.tree
+    funcs = _functions(tree)
+    mutable_globals: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Global):
+            mutable_globals.update(node.names)
+
+    aliases: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            tgt = node.targets[0].id
+            if isinstance(node.value, ast.Name):
+                aliases[tgt] = node.value.id
+            elif isinstance(node.value, ast.Call) and \
+                    terminal_name(node.value) == "partial" and \
+                    node.value.args and \
+                    isinstance(node.value.args[0], ast.Name):
+                aliases[tgt] = node.value.args[0].id
+
+    out: dict[str, _Summary] = {}
+    for name, fn in funcs.items():
+        calls: set[str] = set()
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            if isinstance(node.func, ast.Name):
+                callee = aliases.get(node.func.id, node.func.id)
+            elif isinstance(node.func, ast.Attribute) and \
+                    isinstance(node.func.value, ast.Name) and \
+                    node.func.value.id in ("self", "cls"):
+                callee = node.func.attr  # method call on this class
+            else:
+                continue
+            if callee in funcs and callee != name:
+                calls.add(callee)
+        out[name] = _Summary(fn, _direct_effects(fn, mutable_globals), calls)
+    return out
+
+
+def _reachable(roots: set[str], summaries: dict[str, _Summary]) -> set[str]:
+    seen = set(r for r in sorted(roots) if r in summaries)
+    frontier = set(seen)
+    while frontier:
+        nxt: set[str] = set()
+        for name in sorted(frontier):
+            for callee in sorted(summaries[name].calls):
+                if callee not in seen:
+                    seen.add(callee)
+                    nxt.add(callee)
+        frontier = nxt
+    return seen
+
+
+def _adapter_roots(src: SourceFile, summaries: dict[str, _Summary]) -> set[str]:
+    """The policy/adapter surface: the VotePolicy wire-contract method
+    names anywhere, plus ``*vote*`` functions under ops/ or policies/."""
+    roots = {n for n in summaries if n in ("decide", "family_vote_fn")}
+    if src.in_dirs("ops", "policies"):
+        # kernel-side vote programs, not host plumbing around them
+        # (set_vote_policy & co end in "_vote_policy", not "_vote")
+        roots |= {n for n in summaries
+                  if n.endswith(("_vote", "_vote_fn"))
+                  or "family_vote" in n}
+    return roots
+
+
+def run(ctx: LintContext) -> list[Finding]:
+    findings: list[Finding] = []
+    for src in ctx.parsed():
+        summaries = _summaries(src)
+        name_of = {id(s.node): n for n, s in summaries.items()}
+
+        regions, lambdas = _device_regions(src)
+        device_roots = {name_of[id(r)] for r in regions if id(r) in name_of}
+        emitted: set[tuple[str, int, str]] = set()
+
+        def emit(code: str, line: int, msg: str) -> None:
+            key = (code, line, src.rel)
+            if key not in emitted:
+                emitted.add(key)
+                findings.append(Finding(code, src.rel, line, msg, "effects"))
+
+        device_code = {"io": "CCT1001", "mutate": "CCT1002", "lock": "CCT1003"}
+        for name in sorted(_reachable(device_roots, summaries)):
+            for kind, line, desc in summaries[name].direct:
+                emit(device_code[kind], line,
+                     f"{_EFFECT_LABEL[kind]} effect '{desc}' in '{name}', "
+                     "reachable from a jitted/shard_map'd region — traced "
+                     "code runs host effects once at trace time, then "
+                     "never again")
+        for lam in lambdas:
+            for kind, line, desc in _direct_effects(lam, set()):
+                emit(device_code[kind], line,
+                     f"{_EFFECT_LABEL[kind]} effect '{desc}' in a device "
+                     "lambda — traced code runs host effects once at "
+                     "trace time, then never again")
+
+        for name in sorted(_reachable(_adapter_roots(src, summaries),
+                                      summaries)):
+            for kind, line, desc in summaries[name].direct:
+                emit("CCT1004", line,
+                     f"{_EFFECT_LABEL[kind]} effect '{desc}' in '{name}', "
+                     "reachable from a vote-policy/kernel adapter — "
+                     "decide/family_vote_fn run inside kernels jitted in "
+                     "other modules and must stay pure jnp")
+    return findings

@@ -20,6 +20,41 @@ echo "== cctlint protocol typestate gate (CCT7xx/CCT8xx, serve plane) =="
 PYTHONPATH="$REPO" python -m tools.cctlint consensuscruncher_tpu tools \
   --select CCT7,CCT8
 
+echo "== cctlint effect-purity gate (CCT10xx) + fixture positive controls =="
+# pinned separately like the protocol gate above: the interprocedural
+# purity contracts on device regions and the vote-policy surface must
+# stay green on their own.  The fixture twins are the positive control
+# for the pass itself — the seeded-violation file MUST fail (a pass that
+# can't see its own fixtures proves nothing) and the clean twin MUST
+# stay silent under the full pass set.
+PYTHONPATH="$REPO" python -m tools.cctlint consensuscruncher_tpu tools \
+  --select CCT10
+if PYTHONPATH="$REPO" python -m tools.cctlint \
+    tests/fixtures/cctlint/effects/viol_effects.py \
+    --select CCT10 > /dev/null 2>&1; then
+  echo "ci_check: effects pass FAILED to catch the seeded-violation fixture" >&2
+  exit 1
+fi
+PYTHONPATH="$REPO" python -m tools.cctlint \
+  tests/fixtures/cctlint/effects/clean_effects.py
+echo "ci_check: effects gate OK (repo clean, seeded fixture caught, twin silent)"
+
+echo "== compiled-graph contract gate (jaxpr pins + seeded-mutation control) =="
+# every kernel x policy x wire entry must re-trace to its committed
+# digest in tools/jaxpr_contracts.json, the majority==reference and
+# stream-length-invariance equalities must hold, and the pow2
+# specialization counts must match the pins.  Then the positive
+# control: --control seeds a one-primitive mutation into the dense
+# majority vote in a throwaway process and MUST fail — a gate that
+# can't see a single added primitive is decorative.
+JAX_PLATFORMS=cpu PYTHONPATH="$REPO" python -m tools.jaxpr_gate
+if JAX_PLATFORMS=cpu PYTHONPATH="$REPO" python -m tools.jaxpr_gate \
+    --control > /dev/null 2>&1; then
+  echo "ci_check: jaxpr gate FAILED to catch the seeded-mutation control" >&2
+  exit 1
+fi
+echo "ci_check: jaxpr contract gate OK (pins green, seeded mutation caught)"
+
 echo "== interleaving model check (bounded smoke; protocol invariants) =="
 # enumerates serve-plane interleavings under utils/interleave.py and
 # runs the seeded-bug positive control; the full-budget run is

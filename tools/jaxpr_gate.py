@@ -1,0 +1,563 @@
+"""Compiled-graph contract auditor: pin every kernel's jaxpr in CI.
+
+The repo's central perf/correctness claims live in the *compiled program*,
+not the Python that builds it: "the majority default traces the identical
+jaxpr the pre-policy kernels did", "stream jit specializations are bounded
+by the pow2/8-quantum bucketing", "no host callback ever rides inside a
+kernel".  This tool makes each of those a machine-checked contract:
+
+- every registered kernel x vote-policy x representative bucket shape is
+  abstract-evaluated (``jax.make_jaxpr`` — no device work, forced onto
+  the CPU backend),
+- the jaxpr is canonicalized (alpha-renamed vars, sorted param dicts,
+  memory addresses and debug metadata stripped) into a line-per-equation
+  text whose sha256 is the entry's digest,
+- a fact sheet is extracted per entry point: primitive histogram, dtypes
+  (with an f64-upcast flag), host callbacks, donation/aliasing, dynamic
+  slice/gather/scatter counts,
+- digests + facts + canonical lines are pinned in the committed
+  ``tools/jaxpr_contracts.json``; any drift fails CI with a structural
+  diff (first divergent equation + primitive-count delta) instead of a
+  byte-golden shrug,
+- cross-entry equality contracts are enforced directly: the majority
+  policy's jaxpr must equal the reference program's per wire, the stream
+  program must be invariant across raw lengths that quantize into one
+  d2h bucket, and the pow2 bucketing helpers must yield exactly the
+  pinned specialization counts.
+
+Workflow: ``python -m tools.jaxpr_gate`` checks (CI leg), ``--update``
+refreshes the contract file after a *reviewed* kernel change,
+``--explain ENTRY`` prints one entry's canonical program + facts, and
+``--control`` seeds a one-primitive mutation into the dense majority
+vote to prove the gate still catches drift (CI positive control).
+
+Exit status: 0 green, 1 drift/contract violation, 2 usage error.
+"""
+
+from __future__ import annotations
+
+import os
+
+# Abstract eval only — never grab a TPU from a CI box or a serving host.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import argparse  # noqa: E402
+import hashlib  # noqa: E402
+import json  # noqa: E402
+import re  # noqa: E402
+import sys  # noqa: E402
+
+import numpy as np  # noqa: E402
+
+CONTRACTS_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "jaxpr_contracts.json")
+
+#: Representative bucket shapes (small on purpose: abstract eval scales
+#: with program size, not data size, and the jaxpr *structure* is shape-
+#: polymorphic across each bucketing family — the invariance contracts
+#: below check exactly that).
+B, F, L = 8, 16, 96          # dense vote batch/family-cap/length bucket
+M, NF = 64, 8                # member-stream rows / families per batch
+MEMBER_CAP = 16              # gather-path capacity bucket
+KR, NRES = 16, 128           # rescue gather rows / resident plane rows
+
+#: Policies traced per wire.  ``reference`` is a gate-local registration
+#: of the *original* reference program (``majority_family_vote`` applied
+#: via ``functools.partial``) — the majority==reference digest equality
+#: is the machine check of the "default path jaxpr unchanged" claim.
+POLICIES = ("majority", "delegation", "distilled", "reference")
+
+#: Per-wire digest-equality contracts (see module docstring).
+EQUALITIES = (
+    ("dense_vote/majority", "dense_vote/reference"),
+    ("stream_gather_raw/majority", "stream_gather_raw/reference"),
+)
+
+#: Param keys that carry trace provenance (source lines, name stacks),
+#: not program semantics — kept out of the canonical text so editing a
+#: docstring above a kernel doesn't "change" its contract.
+DROP_PARAMS = frozenset({"debug_info", "debug", "name_stack"})
+
+_ADDR_RE = re.compile(r" at 0x[0-9a-fA-F]+")
+_CALLBACK_RE = re.compile(r"callback")
+DYNAMIC_PRIMS = ("dynamic_slice", "dynamic_update_slice", "gather",
+                 "scatter", "scatter-add", "scatter_add")
+
+
+# --------------------------------------------------------- canonicalizer
+
+def _scrub(text: str) -> str:
+    return _ADDR_RE.sub("", text)
+
+
+def _param_str(value, subs: list) -> str:
+    """Deterministic rendering of one eqn param; nested jaxprs are pulled
+    out into ``subs`` and rendered inline below their equation."""
+    if hasattr(value, "jaxpr") or hasattr(value, "eqns"):
+        subs.append(value)
+        return f"jaxpr#{len(subs) - 1}"
+    if isinstance(value, dict):
+        inner = ", ".join(
+            f"{k}={_param_str(value[k], subs)}" for k in sorted(value))
+        return "{" + inner + "}"
+    if isinstance(value, (tuple, list)):
+        inner = ", ".join(_param_str(v, subs) for v in value)
+        return ("(" if isinstance(value, tuple) else "[") + inner + \
+            (")" if isinstance(value, tuple) else "]")
+    if callable(value) and not isinstance(value, type):
+        name = getattr(value, "__qualname__", None) or \
+            getattr(value, "__name__", None) or "callable"
+        return f"<fn {name}>"
+    return _scrub(repr(value))
+
+
+def _render(closed, lines: list[str], names: dict, depth: int,
+            facts: dict) -> None:
+    """Append the canonical line-per-equation text of one (closed) jaxpr."""
+    jaxpr = closed.jaxpr if hasattr(closed, "jaxpr") else closed
+    pad = "  " * depth
+
+    def vname(v) -> str:
+        if hasattr(v, "val"):  # Literal
+            return f"lit({_scrub(repr(v.val))})"
+        if v not in names:
+            names[v] = f"v{len(names)}"
+        return names[v]
+
+    def note_aval(v) -> str:
+        aval = getattr(v, "aval", None)
+        s = str(aval) if aval is not None else "?"
+        m = re.match(r"[a-z_0-9]+", s)
+        if m:
+            facts["dtypes"].add(m.group(0))
+        return s
+
+    header = ", ".join(f"{vname(v)}:{note_aval(v)}"
+                       for v in list(jaxpr.constvars) + list(jaxpr.invars))
+    lines.append(f"{pad}in ({header})")
+    for eqn in jaxpr.eqns:
+        prim = eqn.primitive.name
+        facts["primitives"][prim] = facts["primitives"].get(prim, 0) + 1
+        if _CALLBACK_RE.search(prim) and prim not in facts["callbacks"]:
+            facts["callbacks"].append(prim)
+        if prim in DYNAMIC_PRIMS:
+            facts["dynamic_ops"] += 1
+        subs: list = []
+        parts = []
+        for key in sorted(eqn.params):
+            if key in DROP_PARAMS:
+                continue
+            value = eqn.params[key]
+            if key == "donated_invars" and any(value):
+                facts["donation"] = True
+            if key == "input_output_aliases" and value:
+                facts["aliasing"] = True
+            parts.append(f"{key}={_param_str(value, subs)}")
+        ins = " ".join(vname(v) for v in eqn.invars)
+        outs = " ".join(f"{vname(v)}:{note_aval(v)}" for v in eqn.outvars)
+        lines.append(f"{pad}{prim}[{', '.join(parts)}] {ins} -> {outs}")
+        for sub in subs:
+            _render(sub, lines, names, depth + 1, facts)
+    lines.append(f"{pad}out ({' '.join(vname(v) for v in jaxpr.outvars)})")
+
+
+def canonicalize(closed) -> tuple[list[str], dict]:
+    """(canonical lines, fact sheet) for one closed jaxpr."""
+    facts = {"primitives": {}, "dtypes": set(), "callbacks": [],
+             "dynamic_ops": 0, "donation": False, "aliasing": False}
+    lines: list[str] = []
+    _render(closed, lines, {}, 0, facts)
+    facts["dtypes"] = sorted(facts["dtypes"])
+    facts["f64_upcast"] = any("64" in d and d.startswith("float")
+                              or d in ("f64", "float64")
+                              for d in facts["dtypes"])
+    facts["num_eqns"] = sum(facts["primitives"].values())
+    return lines, facts
+
+
+def digest_of(lines: list[str]) -> str:
+    return hashlib.sha256("\n".join(lines).encode("utf-8")).hexdigest()
+
+
+def trace_entry(fn, args) -> dict:
+    """Abstract-eval ``fn(*args)`` and return the contract record."""
+    import jax
+
+    closed = jax.make_jaxpr(fn)(*args)
+    lines, facts = canonicalize(closed)
+    return {"digest": digest_of(lines), "facts": facts, "lines": lines}
+
+
+# ------------------------------------------------------- entry registry
+
+def _register_reference_policy() -> None:
+    """Register the *original* reference program under ``reference`` —
+    the partial-applied ``majority_family_vote``, built here so the
+    contract does not depend on ``MajorityPolicy`` keeping its alias.
+    If the majority policy ever stops returning the same program, the
+    per-wire equality digests diverge and the gate localizes the drift."""
+    from functools import partial
+
+    from consensuscruncher_tpu.policies.base import (
+        VotePolicy, _REGISTRY, register_policy,
+    )
+    from consensuscruncher_tpu.policies.majority import majority_family_vote
+
+    if "reference" in _REGISTRY:
+        return
+
+    class _ReferencePolicy(VotePolicy):
+        name = "reference"
+
+        def family_vote_fn(self, *, num, den, qual_threshold, qual_cap,
+                           with_qc=False):
+            return partial(majority_family_vote, num=num, den=den,
+                           qual_threshold=qual_threshold, qual_cap=qual_cap,
+                           with_qc=with_qc)
+
+    register_policy(_ReferencePolicy())
+
+
+def _config():
+    from consensuscruncher_tpu.ops.consensus_tpu import ConsensusConfig
+
+    cfg = ConsensusConfig()
+    num, den = cfg.cutoff_rational
+    return num, den, int(cfg.qual_threshold), int(cfg.qual_cap)
+
+
+def build_entries() -> dict[str, dict]:
+    """Trace every kernel x policy x wire entry point at its
+    representative bucket shape -> {name: contract record}."""
+    import jax.numpy as jnp
+
+    from consensuscruncher_tpu.ops import (
+        consensus_pallas,
+        consensus_segment,
+        consensus_tpu,
+        duplex_tpu,
+        residency,
+        singleton_tpu,
+    )
+
+    _register_reference_policy()
+    num, den, qt, qc = _config()
+
+    u8 = jnp.uint8
+    bases = jnp.zeros((B, F, L), u8)
+    quals = jnp.zeros((B, F, L), u8)
+    sizes = jnp.zeros((B,), jnp.int32)
+    st_b = jnp.zeros((M, L), u8)
+    st_q = jnp.zeros((M, L), u8)
+    st_sizes = jnp.zeros((NF,), jnp.int32)
+    book16 = jnp.zeros((16,), u8)
+    book4 = jnp.zeros((4,), u8)
+
+    out: dict[str, dict] = {}
+
+    for policy in POLICIES:
+        fn = consensus_tpu._compiled_batch_fn(num, den, qt, qc, False, policy)
+        out[f"dense_vote/{policy}"] = trace_entry(fn, (bases, quals, sizes))
+        sfn = consensus_segment._stream_vote_fn(
+            "raw", num, den, qt, qc, MEMBER_CAP, out_len=L, policy=policy)
+        out[f"stream_gather_raw/{policy}"] = trace_entry(
+            sfn, (st_b, st_q, st_sizes))
+
+    # segment-scatter fallback (majority-only by wire contract)
+    seg = consensus_segment._stream_vote_fn(
+        "raw", num, den, qt, qc, None, out_len=L, policy="majority")
+    out["stream_segment/majority"] = trace_entry(seg, (st_b, st_q, st_sizes))
+
+    # packed wires ride the gather path (majority default)
+    for wire, a, b in (
+        ("pack8", jnp.zeros((M, L), u8), book16),
+        ("pack4", jnp.zeros((M, L // 2), u8), book4),
+        ("pack6", jnp.zeros((M, L * 3 // 4), u8), book16),
+    ):
+        wfn = consensus_segment._stream_vote_fn(
+            wire, num, den, qt, qc, MEMBER_CAP, out_len=L, policy="majority")
+        out[f"stream_{wire}/majority"] = trace_entry(wfn, (a, b, st_sizes))
+
+    # Pallas vote + fused duplex (majority-only kernels; interpret=False
+    # pins the TPU-path program — abstract eval never runs it)
+    pfn = consensus_pallas._compiled_pallas(B, F, L, num, den, qt, qc, False)
+    out["pallas_vote/majority"] = trace_entry(
+        pfn, (jnp.zeros((B, 1), jnp.int32),
+              jnp.zeros((F, B, L), u8), jnp.zeros((F, B, L), u8)))
+    ffn = consensus_pallas._compiled_fused(B, F, L, num, den, qt, qc, False)
+    out["pallas_fused_duplex/majority"] = trace_entry(
+        ffn, (jnp.zeros((B, 1), jnp.int32), jnp.zeros((B, 1), jnp.int32),
+              jnp.zeros((F, B, L), u8), jnp.zeros((F, B, L), u8),
+              jnp.zeros((F, B, L), u8), jnp.zeros((F, B, L), u8)))
+
+    plane = jnp.zeros((B, L), u8)
+    out["duplex_vote"] = trace_entry(
+        duplex_tpu._compiled(qc), (plane, plane, plane, plane))
+    out["singleton_hamming"] = trace_entry(
+        singleton_tpu._compiled_tile(),
+        (jnp.zeros((B, L), u8), jnp.zeros((2 * B, L), u8)))
+
+    planes = jnp.zeros((2, NRES, L), u8)
+    idx = jnp.zeros((KR,), jnp.int32)
+    out["rescue_pair_gather"] = trace_entry(
+        residency._compiled_pair_gather(qc), (planes, idx, idx))
+    out["rescue_against_gather"] = trace_entry(
+        residency._compiled_against_gather(qc),
+        (planes, jnp.zeros((KR, L), u8), jnp.zeros((KR, L), u8), idx))
+    return out
+
+
+# ------------------------------------------------- invariance contracts
+
+def specialization_counts() -> dict[str, int]:
+    """Distinct compiled-program counts the pow2 bucketing admits — the
+    recompile-bounding claims, pinned as numbers."""
+    from consensuscruncher_tpu.ops.consensus_pallas import _pick_bt
+    from consensuscruncher_tpu.ops.consensus_segment import (
+        MAX_DENSE_CAP, pick_member_cap,
+    )
+    from consensuscruncher_tpu.ops.duplex_tpu import _next_pow2
+
+    member_caps = {pick_member_cap(np.asarray([s]))
+                   for s in range(1, MAX_DENSE_CAP + 1)}
+    duplex_batches = {_next_pow2(n) for n in range(1, 4097)}
+    pallas_tiles = {_pick_bt(b) for b in range(8, 1025, 8)}
+    return {
+        "stream_member_caps": len(member_caps),
+        "duplex_batch_pow2": len(duplex_batches),
+        "pallas_bt_tiles": len(pallas_tiles),
+    }
+
+
+def stream_len_invariance() -> tuple[bool, str]:
+    """Raw consensus lengths that quantize into one 8-wide d2h bucket
+    must produce byte-identical stream programs (the dispatch-side claim
+    that specializations are bounded by the bucket count)."""
+    import jax.numpy as jnp
+
+    from consensuscruncher_tpu.ops import consensus_segment
+
+    num, den, qt, qc = _config()
+    digests = []
+    for raw_len in (L - 5, L - 3, L):  # 91, 93, 96 -> one out_len bucket
+        out_len = -(-raw_len // 8) * 8
+        fn = consensus_segment._stream_vote_fn(
+            "raw", num, den, qt, qc, MEMBER_CAP, out_len=out_len,
+            policy="majority")
+        rec = trace_entry(fn, (jnp.zeros((M, L), jnp.uint8),
+                               jnp.zeros((M, L), jnp.uint8),
+                               jnp.zeros((NF,), jnp.int32)))
+        digests.append((raw_len, out_len, rec["digest"]))
+    ok = len({d for _, _, d in digests}) == 1
+    detail = "; ".join(f"raw_len={r} -> out_len={o}: {d[:12]}"
+                       for r, o, d in digests)
+    return ok, detail
+
+
+# ------------------------------------------------------ check / update
+
+def _facts_public(record: dict) -> dict:
+    return {k: v for k, v in record["facts"].items()}
+
+
+def _diff_entry(name: str, pinned: dict, current: dict) -> list[str]:
+    """Human-readable structural diff: first divergent canonical line +
+    primitive-count delta."""
+    msgs = [f"{name}: digest drift "
+            f"{pinned['digest'][:12]} -> {current['digest'][:12]}"]
+    p_lines, c_lines = pinned.get("lines", []), current["lines"]
+    for i in range(max(len(p_lines), len(c_lines))):
+        pl = p_lines[i] if i < len(p_lines) else "<end of pinned program>"
+        cl = c_lines[i] if i < len(c_lines) else "<end of current program>"
+        if pl != cl:
+            msgs.append(f"  first divergent eqn (line {i}):")
+            msgs.append(f"    pinned : {pl.strip()}")
+            msgs.append(f"    current: {cl.strip()}")
+            break
+    p_hist = pinned.get("facts", {}).get("primitives", {})
+    c_hist = current["facts"]["primitives"]
+    for prim in sorted(set(p_hist) | set(c_hist)):
+        was, now = p_hist.get(prim, 0), c_hist.get(prim, 0)
+        if was != now:
+            msgs.append(f"  primitive-count delta: {prim} {was} -> {now}")
+    return msgs
+
+
+def _serialize(entries: dict[str, dict]) -> dict:
+    import jax
+
+    return {
+        "version": 1,
+        "jax_version": jax.__version__,  # informational, not enforced
+        "config": dict(zip(("num", "den", "qual_threshold", "qual_cap"),
+                           _config())),
+        "shapes": {"dense": [B, F, L], "stream": [M, NF, MEMBER_CAP],
+                   "rescue": [KR, NRES, L]},
+        "equalities": [list(pair) for pair in EQUALITIES],
+        "specializations": specialization_counts(),
+        "entries": {name: {"digest": rec["digest"],
+                           "facts": _facts_public(rec),
+                           "lines": rec["lines"]}
+                    for name, rec in sorted(entries.items())},
+    }
+
+
+def update(path: str = CONTRACTS_PATH) -> int:
+    doc = _serialize(build_entries())
+    ok, detail = stream_len_invariance()
+    if not ok:
+        print(f"jaxpr_gate: REFUSING update — stream programs diverge "
+              f"within one length bucket ({detail})", file=sys.stderr)
+        return 1
+    failures = _check_cross_entry(doc["entries"])
+    if failures:
+        for msg in failures:
+            print(f"jaxpr_gate: REFUSING update — {msg}", file=sys.stderr)
+        return 1
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    print(f"jaxpr_gate: pinned {len(doc['entries'])} entries -> {path}")
+    return 0
+
+
+def _check_cross_entry(entries: dict[str, dict]) -> list[str]:
+    failures = []
+    for a, b in EQUALITIES:
+        da, db = entries[a]["digest"], entries[b]["digest"]
+        if da != db:
+            failures.append(
+                f"equality contract violated: {a} != {b} "
+                f"({da[:12]} vs {db[:12]})")
+            failures.extend(
+                "  " + line for line in
+                _diff_entry(f"{a} vs {b}", entries[b], {
+                    "digest": da, "lines": entries[a].get("lines", []),
+                    "facts": entries[a].get("facts",
+                                            {"primitives": {}})})[1:])
+    return failures
+
+
+def check(path: str = CONTRACTS_PATH) -> int:
+    if not os.path.exists(path):
+        print(f"jaxpr_gate: no contract file at {path} — run "
+              "'python -m tools.jaxpr_gate --update' and commit it",
+              file=sys.stderr)
+        return 1
+    with open(path, "r", encoding="utf-8") as fh:
+        pinned = json.load(fh)
+    current = build_entries()
+
+    failures: list[str] = []
+    pinned_entries = pinned.get("entries", {})
+    for name in sorted(set(pinned_entries) - set(current)):
+        failures.append(f"pinned entry {name} no longer traceable — if the "
+                        "kernel was removed on purpose, --update")
+    for name in sorted(set(current) - set(pinned_entries)):
+        failures.append(f"new entry point {name} has no pinned contract — "
+                        "--update and commit the diff")
+    for name in sorted(set(current) & set(pinned_entries)):
+        if current[name]["digest"] != pinned_entries[name]["digest"]:
+            failures.extend(_diff_entry(name, pinned_entries[name],
+                                        current[name]))
+
+    cur = {name: {"digest": rec["digest"], "lines": rec["lines"],
+                  "facts": rec["facts"]} for name, rec in current.items()}
+    failures.extend(_check_cross_entry(cur))
+
+    ok, detail = stream_len_invariance()
+    if not ok:
+        failures.append("stream programs diverge within one length bucket "
+                        f"({detail})")
+    pinned_spec = pinned.get("specializations", {})
+    for key, count in sorted(specialization_counts().items()):
+        want = pinned_spec.get(key)
+        if want != count:
+            failures.append(f"specialization count drift: {key} pinned "
+                            f"{want}, bucketing now yields {count}")
+
+    if failures:
+        for msg in failures:
+            print(f"jaxpr_gate: {msg}", file=sys.stderr)
+        print(f"jaxpr_gate: {len(failures)} contract failure(s); if the "
+              "change is intended, run --update and commit the reviewed "
+              "diff", file=sys.stderr)
+        return 1
+    print(f"jaxpr_gate: OK ({len(current)} entries, "
+          f"{len(EQUALITIES)} equality contracts, stream-length "
+          "invariance, specialization counts)")
+    return 0
+
+
+def explain(name: str) -> int:
+    current = build_entries()
+    if name not in current:
+        print(f"jaxpr_gate: unknown entry {name!r}; known: "
+              f"{', '.join(sorted(current))}", file=sys.stderr)
+        return 2
+    rec = current[name]
+    print(f"entry: {name}")
+    print(f"digest: {rec['digest']}")
+    print("facts:")
+    print(json.dumps(_facts_public(rec), indent=2, sort_keys=True))
+    print("canonical program:")
+    for line in rec["lines"]:
+        print("  " + line)
+    return 0
+
+
+def seed_control_mutation() -> None:
+    """Positive control: change ONE primitive in the dense majority vote
+    (an extra +1 on the consensus qual plane).  The gate MUST localize
+    and fail on this — CI runs ``--control`` and asserts nonzero exit."""
+    import jax.numpy as jnp
+
+    from consensuscruncher_tpu.policies import majority as mj
+
+    orig = mj.MajorityPolicy.family_vote_fn
+
+    def mutated(self, **kwargs):
+        fn = orig(self, **kwargs)
+
+        def wrapped(bases, quals, fam_size):
+            out = fn(bases, quals, fam_size)
+            bumped = (out[1] + jnp.uint8(1)).astype(jnp.uint8)
+            return (out[0], bumped) + tuple(out[2:])
+
+        return wrapped
+
+    mj.MajorityPolicy.family_vote_fn = mutated
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.jaxpr_gate",
+        description="Pin and audit the compiled-graph contracts of every "
+                    "kernel x policy x wire entry point.")
+    parser.add_argument("--update", action="store_true",
+                        help="re-trace everything and rewrite the contract "
+                             "file (commit + review the diff)")
+    parser.add_argument("--explain", metavar="ENTRY", default=None,
+                        help="print one entry's canonical program + facts")
+    parser.add_argument("--control", action="store_true",
+                        help="seed a one-primitive mutation into the dense "
+                             "vote, then check — MUST exit nonzero "
+                             "(CI positive control)")
+    parser.add_argument("--contracts", default=CONTRACTS_PATH,
+                        help="contract file path (default: %(default)s)")
+    args = parser.parse_args(argv)
+
+    if args.control and args.update:
+        parser.error("--control cannot be combined with --update")
+    if args.control:
+        seed_control_mutation()
+        return check(args.contracts)
+    if args.explain:
+        return explain(args.explain)
+    if args.update:
+        return update(args.contracts)
+    return check(args.contracts)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
