@@ -1764,6 +1764,35 @@ def top_cmd(args) -> None:
         once=_bool(getattr(args, "once", "False") or "False")))
 
 
+def cache_cmd(args) -> None:
+    """``cct cache scrub``: offline integrity sweep of the result-cache
+    plane.  Every committed entry's payload is re-hashed against the
+    sha256 pinned in its ``entry.json`` at insert; a mismatch means the
+    bytes on disk are no longer the bytes the job produced — the entry
+    is quarantined (moved out of the shard tree, never served again)
+    and reported.  Exits 1 when any corruption was found so cron/CI
+    wiring notices."""
+    from consensuscruncher_tpu.serve.result_cache import ResultCache
+
+    root = str(getattr(args, "result_cache", "") or "")
+    if not root or not os.path.isdir(root):
+        raise SystemExit(f"cache: result-cache root {root!r} is not a "
+                         "directory (pass --result_cache)")
+    report = ResultCache(root).scrub()
+    if getattr(args, "json", ""):
+        with open(args.json, "w") as fh:
+            json.dump(report, fh, indent=2, sort_keys=True)
+    print(f"cache scrub: {report['entries']} entries — "
+          f"{report['intact']} intact, "
+          f"{report['legacy']} legacy (no pinned digest), "
+          f"{report['corrupt']} corrupt")
+    for q in report["quarantined"]:
+        where = f" -> {q['moved_to']}" if q.get("moved_to") else ""
+        print(f"  quarantined {q['shard']}/{q['digest']}: "
+              f"{q['error']}{where}")
+    raise SystemExit(1 if report["corrupt"] else 0)
+
+
 def _qc_docs_from_paths(paths) -> list:
     """Resolve ``cct qc`` path operands into ``(label, doc)`` pairs.
     A file operand is a qc.json; a directory is scanned recursively for
@@ -2336,6 +2365,23 @@ def build_parser() -> argparse.ArgumentParser:
     qp.add_argument("--json", help="also write the merged doc (report) / "
                                    "the A-B comparison doc (diff) here")
     qp.set_defaults(func=qc_cmd, config_section="qc", required_args=(),
+                    builtin_defaults={"json": ""})
+
+    ca = sub.add_parser(
+        "cache", help="operate on the fleet result-cache plane")
+    ca.add_argument("action", choices=("scrub",),
+                    help="scrub: offline integrity sweep — re-hash every "
+                         "committed entry's payload against the sha256 "
+                         "pinned at insert; corrupt entries are "
+                         "quarantined and the command exits 1")
+    ca.add_argument("-c", "--config", default=None)
+    ca.add_argument("--result_cache",
+                    help="cache-plane root directory (the [serve]/"
+                         "[route] result_cache knob)")
+    ca.add_argument("--json", help="also write the scrub report as JSON "
+                                   "to this path")
+    ca.set_defaults(func=cache_cmd, config_section="serve",
+                    required_args=("result_cache",),
                     builtin_defaults={"json": ""})
 
     w = sub.add_parser(

@@ -157,6 +157,26 @@ COUNTERS = {
                          "because the result cache held a negative entry "
                          "for the exact sub-spec (known-empty range, "
                          "nothing to run)",
+    "wire_crc_errors": "wire frames dropped because their crc envelope "
+                       "did not match the payload (corrupted in flight; "
+                       "the peer re-requests instead of parsing garbage)",
+    "wire_dup_dropped": "duplicated wire frames answered from the "
+                        "per-connection seq replay cache instead of being "
+                        "re-dispatched (duplicate delivery absorbed below "
+                        "the idempotency layer)",
+    "wire_timeouts": "wire requests that hit a read/forward deadline "
+                     "(slow or blackholed peer) and were abandoned",
+    "conns_reaped": "server connections reaped by the read/idle deadline "
+                    "(half-open, slowloris, or silent peers; their "
+                    "max_conns slot is recovered)",
+    "journal_crc_skipped": "journal records skipped at replay because "
+                           "their crc32 field did not match the record "
+                           "bytes (mid-file corruption; torn tails are "
+                           "counted separately)",
+    "cache_integrity_misses": "result-cache lookups degraded to a miss "
+                              "because a payload file failed its stored "
+                              "sha256 (the corrupt entry dir is "
+                              "quarantined, never served)",
 }
 
 CUMULATIVE_KEYS = tuple(COUNTERS)

@@ -6,7 +6,9 @@ the operator watches and what the dashboards alert on can never drift —
 and renders one compact frame per interval: router epoch and HA state,
 a per-node table (up / queue depth / running / routed / steals /
 resubmits / quarantined / trace spans / orphans), a per-qos SLO panel
-(p50/p99 latency, shed ratio, multi-window burn rates) and the
+(p50/p99 latency, shed ratio, multi-window burn rates), a ``net:`` row
+(wire crc errors, duplicate frames absorbed, wire timeouts, reaped
+connections, journal crc skips, cache integrity misses) and the
 fleet-wide HA counters (failovers, adoptions, fencing rejections,
 quarantines, breaker trips, brownout refusals, trace links).  Columns a
 pre-quarantine daemon never exports render as dashes, not errors.
@@ -223,6 +225,9 @@ def render_frame(series: dict, source: str,
                          f"{_fmt_s(row.get('p50')):>8} "
                          f"{_fmt_s(row.get('p99')):>8}  {burns}")
 
+    def _opt(metric: str) -> float | None:
+        return _sum(series, metric) if metric in series else None
+
     # cache panel: fleet-wide content-addressed result-cache health.
     # hit% is hits/(hits+misses) over every process's cumulative series
     # (router consult-before-dispatch + worker-side lookups).
@@ -238,13 +243,27 @@ def render_frame(series: dict, source: str,
             f"evicted={_fmt_n(_sum(series, 'cct_cache_evictions_total'))}  "
             f"bytes={_fmt_n(_sum(series, 'cct_cache_bytes_total'))}")
 
+    # net panel: wire/at-rest integrity and deadline-reaper health.
+    # Every cell dash-degrades on pre-envelope daemons (series absent
+    # entirely); a zero means "measured and clean", a dash means "this
+    # daemon predates the wire envelope".
+    net_cols = [
+        ("crc_err", "cct_wire_crc_errors_total"),
+        ("dup_drop", "cct_wire_dup_dropped_total"),
+        ("timeouts", "cct_wire_timeouts_total"),
+        ("reaped", "cct_conns_reaped_total"),
+        ("jrnl_skip", "cct_journal_crc_skipped_total"),
+        ("cache_int", "cct_cache_integrity_misses_total"),
+    ]
+    if any(metric in series for _, metric in net_cols):
+        lines.append(
+            "net: " + "  ".join(f"{label}={_fmt_n(_opt(metric))}"
+                                for label, metric in net_cols))
+
     # qc panel: consensus-quality yield counters picked up from per-run
     # qc.json docs at job completion.  Pre-QC daemons never emit these
     # series, so each cell degrades to a dash — a dash means "daemon
     # predates QC", a zero means "measured and empty".
-    def _opt(metric: str) -> float | None:
-        return _sum(series, metric) if metric in series else None
-
     qc_cols = [
         ("fam", "cct_tenant_qc_families_total"),
         ("sscs", "cct_tenant_qc_sscs_written_total"),

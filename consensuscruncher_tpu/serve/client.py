@@ -18,6 +18,7 @@ kill/restart without ever learning it happened.
 
 from __future__ import annotations
 
+import itertools
 import json
 import os
 import socket
@@ -25,7 +26,8 @@ import sys
 import time
 
 from consensuscruncher_tpu.obs import trace as obs_trace
-from consensuscruncher_tpu.utils import faults
+from consensuscruncher_tpu.serve import wire
+from consensuscruncher_tpu.utils import faults, netchaos
 
 
 class ServeClientError(RuntimeError):
@@ -73,7 +75,7 @@ class ServeClient:
     def __init__(self, address, connect_timeout: float = 10.0,
                  retries: int | None = None,
                  retry_base_s: float | None = None,
-                 router=None):
+                 router=None, counters=None):
         self.addresses = self._address_list(address)
         if not self.addresses:
             raise ValueError("serve client: empty address")
@@ -86,6 +88,12 @@ class ServeClient:
         if retry_base_s is None:
             retry_base_s = float(os.environ.get("CCT_RETRY_BASE_S", "0.5"))
         self.retry_base_s = float(retry_base_s)
+        # optional Counters sink (the router passes its own) for wire
+        # health: crc mismatches on replies, request deadline hits
+        self.counters = counters
+        # per-client monotone seq for the wire envelope; next() is atomic,
+        # so a client shared across handler threads stays collision-free
+        self._seq = itertools.count(1)
 
     @property
     def router(self):
@@ -134,13 +142,18 @@ class ServeClient:
             sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         else:
             sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        # every fleet socket is opened here (client->router, router->worker,
+        # standby probes), so this one wrap point puts the whole fleet's
+        # traffic behind the netchaos fault layer when a spec is armed
+        sock = netchaos.maybe_wrap(sock, self.address)
         try:
             sock.settimeout(self.connect_timeout)
             sock.connect(self.address if isinstance(self.address, str)
                          else tuple(self.address))
             # after connect, the read deadline is the op's own timeout
             sock.settimeout(timeout)
-            sock.sendall(json.dumps(doc).encode() + b"\n")
+            sealed = wire.seal(doc, next(self._seq))
+            sock.sendall(json.dumps(sealed).encode() + b"\n")
             buf = b""
             while b"\n" not in buf:
                 chunk = sock.recv(65536)
@@ -152,6 +165,14 @@ class ServeClient:
             reply = json.loads(buf.split(b"\n", 1)[0])
         finally:
             sock.close()
+        if not wire.verify(reply):
+            # a corrupted reply is transport loss, not data: drop it and
+            # let the retry loop re-fetch (every op is idempotent by key)
+            if self.counters is not None:
+                self.counters.add("wire_crc_errors")
+            raise ServeClientError("reply failed its crc (corrupted in "
+                                   "flight)", {"transport": True,
+                                               "crc_error": True})
         if not reply.get("ok"):
             if reply.get("quarantined"):
                 raise JobQuarantined(
@@ -218,6 +239,9 @@ class ServeClient:
             try:
                 return self._request_once(doc, timeout)
             except Exception as e:
+                if isinstance(e, (socket.timeout, TimeoutError)) \
+                        and self.counters is not None:
+                    self.counters.add("wire_timeouts")
                 if attempt + 1 >= attempts or not self._retryable(e):
                     raise
                 delay = faults.backoff_delay(attempt + 1, self.retry_base_s, 5.0)

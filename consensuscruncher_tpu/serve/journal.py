@@ -56,6 +56,7 @@ import tempfile
 
 from consensuscruncher_tpu import __version__
 from consensuscruncher_tpu.obs import trace as obs_trace
+from consensuscruncher_tpu.serve import wire
 from consensuscruncher_tpu.utils import faults, sanitize
 from consensuscruncher_tpu.utils.manifest import commit_file
 
@@ -141,7 +142,18 @@ def job_record(job_id: int, state: str, *, key: str | None = None,
 
 
 def _encode(doc: dict) -> bytes:
-    return json.dumps(doc, sort_keys=True, separators=(",", ":")).encode() + b"\n"
+    """One journal line: sorted-keys compact JSON plus a ``crc`` field
+    (CRC32 over the record minus the crc itself — the wire envelope's
+    canonical digest).  Replay verifies it, so a mid-file bit flip is
+    skipped-and-counted instead of silently mis-replaying a job; legacy
+    (v1) records without the field replay unchanged.  The record version
+    bumps to 2 *because* of the crc: a v2 record missing the field means
+    the crc itself was corrupted away, so replay must not mistake it for
+    legacy (the crc cannot protect its own key name)."""
+    out = {k: v for k, v in doc.items() if k != "crc"}
+    out["v"] = 2
+    out["crc"] = wire.crc_of(out)
+    return json.dumps(out, sort_keys=True, separators=(",", ":")).encode() + b"\n"
 
 
 class Journal:
@@ -267,7 +279,7 @@ def replay(path: str) -> tuple[dict[int, dict], dict]:
     ``serve.journal_replay`` fires per record.
     """
     jobs: dict[int, dict] = {}
-    info = {"records": 0, "skipped": 0, "torn_tail": False,
+    info = {"records": 0, "skipped": 0, "crc_skipped": 0, "torn_tail": False,
             "clean_drain": False, "adopted_by": None, "fence_epoch": None,
             "suspects": {}, "quarantined": {}}
     # schedule point: a zombie's replay racing an adopter's tombstone
@@ -303,6 +315,24 @@ def replay(path: str) -> tuple[dict[int, dict], dict]:
             else:
                 print(f"WARNING: journal {path}: skipping unreadable record "
                       f"at line {idx + 1} ({e})", file=sys.stderr, flush=True)
+            continue
+        crc_bad = not wire.verify(rec)
+        if not crc_bad and isinstance(rec.get("v"), int) and rec["v"] >= 2 \
+                and "crc" not in rec:
+            # v2 records ALWAYS carry a crc; one without it had the crc
+            # (or its key name) corrupted away and must not pass as legacy
+            crc_bad = True
+        if crc_bad:
+            # the line parses but its integrity check fails: a mid-file
+            # bit flip that happened to keep the JSON well-formed.  Acting
+            # on it could resurrect a different job state than was acked —
+            # skip it, count it, keep replaying the rest.  (Records from
+            # pre-crc v1 journals carry no ``crc`` and verify trivially.)
+            info["skipped"] += 1
+            info["crc_skipped"] += 1
+            print(f"WARNING: journal {path}: record at line {idx + 1} "
+                  "failed its crc (mid-file corruption); skipping it",
+                  file=sys.stderr, flush=True)
             continue
         info["records"] += 1
         if rec.get("rec") == "marker":
@@ -351,5 +381,6 @@ def replay(path: str) -> tuple[dict[int, dict], dict]:
                   f"at line {idx + 1}", file=sys.stderr, flush=True)
             continue
         merged = jobs.setdefault(job_id, {})
-        merged.update({k: v for k, v in rec.items() if k not in ("v", "rec")})
+        merged.update({k: v for k, v in rec.items()
+                       if k not in ("v", "rec", "crc")})
     return jobs, info

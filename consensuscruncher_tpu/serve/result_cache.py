@@ -81,6 +81,25 @@ DIGEST_FIELDS = ("cutoff", "qualscore", "scorrect", "max_mismatch",
 ENTRY_NAME = "entry.json"
 LOCAL_SHARD = "local"
 
+#: corrupt entries are moved here (never served, kept for post-mortem);
+#: excluded from the shard walk so lookups can't wander into it
+QUARANTINE_DIR = "quarantine"
+
+
+def _sha256_file(path: str) -> str | None:
+    """Streaming sha256 of a file, or ``None`` when unreadable."""
+    h = hashlib.sha256()
+    try:
+        with open(path, "rb") as fh:
+            while True:
+                chunk = fh.read(1 << 20)
+                if not chunk:
+                    break
+                h.update(chunk)
+    except OSError:
+        return None
+    return h.hexdigest()
+
 
 def content_digest(spec: dict) -> str | None:
     """Content digest of a job spec, or ``None`` when the input cannot be
@@ -117,8 +136,10 @@ def _walk_files(base: str) -> list[str]:
     return sorted(out)
 
 
-def _copy_committed(src: str, dest: str) -> int:
-    """Copy one file into place via tmp + ``commit_file``; returns bytes.
+def _copy_committed(src: str, dest: str) -> tuple[int, str]:
+    """Copy one file into place via tmp + ``commit_file``; returns
+    ``(bytes, sha256)`` — the digest is computed over the same bytes the
+    commit made durable, so the entry doc can pin the payload's identity.
     The tmp file lives in the destination directory so the final rename
     is same-filesystem atomic."""
     dest_dir = os.path.dirname(os.path.abspath(dest))
@@ -126,19 +147,21 @@ def _copy_committed(src: str, dest: str) -> int:
     fd, tmp = tempfile.mkstemp(prefix=".cache.", dir=dest_dir)
     try:
         n = 0
+        h = hashlib.sha256()
         with os.fdopen(fd, "wb") as out, open(src, "rb") as inp:
             while True:
                 chunk = inp.read(1 << 20)
                 if not chunk:
                     break
                 out.write(chunk)
+                h.update(chunk)
                 n += len(chunk)
         commit_file(tmp, dest)
     except BaseException:
         if os.path.exists(tmp):
             os.unlink(tmp)
         raise
-    return n
+    return n, h.hexdigest()
 
 
 class ResultCache:
@@ -152,10 +175,13 @@ class ResultCache:
     """
 
     def __init__(self, root: str, node: str | None = None,
-                 max_bytes: int | None = None):
+                 max_bytes: int | None = None, counters=None):
         self.root = str(root)
         self.node = str(node or LOCAL_SHARD)
         self.max_bytes = int(max_bytes) if max_bytes else None
+        # optional Counters sink: integrity-degraded hits are counted
+        # (``cache_integrity_misses``) when the owner wires one in
+        self.counters = counters
         os.makedirs(os.path.join(self.root, self.node), exist_ok=True)
         self._lock = sanitize.tracked_lock("result_cache.lock")
 
@@ -168,7 +194,8 @@ class ResultCache:
     def _shards(self) -> list[str]:
         try:
             names = [d for d in sorted(os.listdir(self.root))
-                     if os.path.isdir(os.path.join(self.root, d))]
+                     if os.path.isdir(os.path.join(self.root, d))
+                     and d != QUARANTINE_DIR]
         except OSError:
             return [self.node]
         return names
@@ -196,9 +223,105 @@ class ResultCache:
             shards.insert(0, self.node)
         for shard in shards:
             entry = self._read_entry(digest, shard)
-            if entry is not None:
-                return entry
+            if entry is None:
+                continue
+            err = self._integrity_error(entry)
+            if err is not None:
+                # the payload no longer matches the sha256 the insert
+                # pinned: NEVER serve it.  Degrade to a counted miss,
+                # move the corpse aside for post-mortem, keep probing
+                # the other shards (a peer may hold a good copy).
+                if self.counters is not None:
+                    self.counters.add("cache_integrity_misses")
+                moved = self.quarantine(entry)
+                print(f"WARNING: result cache: entry {digest} in shard "
+                      f"{shard} failed integrity ({err}); quarantined to "
+                      f"{moved or '<unmovable>'} and degraded to a miss",
+                      file=sys.stderr, flush=True)
+                continue
+            return entry
         return None
+
+    def _integrity_error(self, entry: dict) -> str | None:
+        """Re-hash every payload file against the sha256 the entry doc
+        pinned at insert.  ``None`` means clean; entries from before the
+        integrity field (no ``sha256`` on any file) have nothing to
+        check and pass unchanged."""
+        payload_dir = os.path.join(entry["dir"], "payload")
+        for f in entry.get("files", []):
+            want = f.get("sha256")
+            if want is None:
+                continue
+            got = _sha256_file(os.path.join(payload_dir, f["path"]))
+            if got != want:
+                return (f"{f['path']}: sha256 "
+                        f"{got or 'unreadable'} != {want}")
+        return None
+
+    def quarantine(self, entry: dict) -> str | None:
+        """Move a corrupt entry's directory to ``<root>/quarantine/``.
+        ``entry.json`` is unlinked FIRST — the entry disappears for every
+        reader before anything else moves (the exact reverse of insert's
+        entry-last commit order), so no lookup can race into a half-moved
+        dir.  Returns the quarantine path, or ``None`` if the move
+        failed (the entry is still invisible: its doc is gone)."""
+        edir = entry["dir"]
+        try:
+            os.unlink(os.path.join(edir, ENTRY_NAME))
+        except OSError:
+            pass
+        qroot = os.path.join(self.root, QUARANTINE_DIR)
+        try:
+            os.makedirs(qroot, exist_ok=True)
+            dest = os.path.join(
+                qroot, f"{entry.get('shard') or self.node}-{entry['digest']}")
+            n = 0
+            while os.path.exists(dest):
+                n += 1
+                dest = os.path.join(
+                    qroot, f"{entry.get('shard') or self.node}-"
+                           f"{entry['digest']}.{n}")
+            # not a cache-plane write: the entry doc is already gone, so
+            # no reader can observe this dir; the move only relocates a
+            # corpse out of the shard tree for post-mortem
+            os.rename(edir, dest)  # cct: allow-cache-store(quarantine move of an already-invisible entry)
+        except OSError:
+            return None
+        return dest
+
+    def scrub(self) -> dict:
+        """Offline integrity sweep (``cct cache scrub``): re-hash every
+        committed entry's payload across every shard; corrupt entries
+        are quarantined.  Returns ``{"entries", "intact", "legacy",
+        "corrupt", "quarantined": [...]}`` (``legacy`` counts entries
+        from before the sha256 field — nothing to verify)."""
+        out: dict = {"entries": 0, "intact": 0, "legacy": 0, "corrupt": 0,
+                     "quarantined": []}
+        for shard in self._shards():
+            shard_dir = os.path.join(self.root, shard)
+            for dirpath, _dirnames, filenames in os.walk(shard_dir):
+                if ENTRY_NAME not in filenames:
+                    continue
+                entry = self._read_entry(os.path.basename(dirpath), shard)
+                if entry is None:
+                    continue
+                out["entries"] += 1
+                if not any(f.get("sha256")
+                           for f in entry.get("files", [])):
+                    out["legacy"] += 1
+                    continue
+                err = self._integrity_error(entry)
+                if err is None:
+                    out["intact"] += 1
+                    continue
+                out["corrupt"] += 1
+                if self.counters is not None:
+                    self.counters.add("cache_integrity_misses")
+                moved = self.quarantine(entry)
+                out["quarantined"].append({
+                    "digest": entry["digest"], "shard": shard,
+                    "error": err, "moved_to": moved})
+        return out
 
     def _read_entry(self, digest: str, shard: str) -> dict | None:
         edir = self.entry_dir(digest, shard)
@@ -242,9 +365,9 @@ class ResultCache:
         total = 0
         try:
             for rel in _walk_files(base_dir):
-                n = _copy_committed(os.path.join(base_dir, rel),
-                                    os.path.join(payload_dir, rel))
-                files.append({"path": rel, "size": n})
+                n, sha = _copy_committed(os.path.join(base_dir, rel),
+                                         os.path.join(payload_dir, rel))
+                files.append({"path": rel, "size": n, "sha256": sha})
                 total += n
             entry = {"v": 1, "digest": digest, "negative": bool(negative),
                      "bytes": total, "files": files, "node": self.node,
@@ -279,8 +402,9 @@ class ResultCache:
         total = 0
         for f in entry.get("files", []):
             rel = f["path"]
-            total += _copy_committed(os.path.join(payload_dir, rel),
-                                     os.path.join(dest_base, rel))
+            n, _sha = _copy_committed(os.path.join(payload_dir, rel),
+                                      os.path.join(dest_base, rel))
+            total += n
         return total
 
     # ----------------------------------------------------------- eviction

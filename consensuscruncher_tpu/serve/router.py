@@ -120,6 +120,19 @@ from consensuscruncher_tpu.utils.profiling import Counters
 STEALABLE_QOS = ("batch", "scavenger")
 
 
+def _forward_timeout_s() -> float | None:
+    """Default deadline for a member forward that did not bring its own:
+    a blackholed worker must cost a bounded wait, never a wedged router
+    thread.  0 restores the legacy unbounded behavior."""
+    v = float(os.environ.get("CCT_ROUTE_FORWARD_TIMEOUT_S", "60"))
+    return None if v <= 0 else v
+
+
+def _probe_timeout_s() -> float:
+    """Deadline for health probes (member sweeps, standby->active)."""
+    return float(os.environ.get("CCT_ROUTE_PROBE_TIMEOUT_S", "5"))
+
+
 class HashRing:
     """Deterministic consistent-hash ring with virtual nodes.
 
@@ -398,10 +411,17 @@ class Router:
                  journals: dict | None = None,
                  result_cache=None, cache_journal: str | None = None,
                  warm_state: dict | None = None):
+        self.counters = Counters()
         if client_factory is None:
+            counters = self.counters
+
             def client_factory(address):
+                # the router's own counters ride every member client so
+                # forward timeouts / corrupted replies are visible in
+                # this router's metrics (``wire_timeouts`` etc.)
                 return ServeClient(address, connect_timeout=10.0,
-                                   retries=1, retry_base_s=0.1)
+                                   retries=1, retry_base_s=0.1,
+                                   counters=counters)
         self._client_factory = client_factory
         self._members: dict[str, _Member] = OrderedDict()
         for name, address in members:
@@ -413,7 +433,6 @@ class Router:
         self.steal_margin = max(1, int(steal_margin))
         self.health_interval_s = float(health_interval_s)
         self.down_after = max(1, int(down_after))
-        self.counters = Counters()
         self.closing = False
         self._draining = False
         self._started_at = time.time()
@@ -437,7 +456,8 @@ class Router:
         if isinstance(result_cache, str):
             from consensuscruncher_tpu.serve.result_cache import ResultCache
             result_cache = ResultCache(result_cache,
-                                       node=f"router-{router_id}")
+                                       node=f"router-{router_id}",
+                                       counters=self.counters)
         self.result_cache = result_cache
         self.warm_state = dict(warm_state or {})
         # key -> terminal job doc for answers already served from the
@@ -541,8 +561,9 @@ class Router:
             down = 0
             for member in self.members():
                 try:
-                    health = member.client.request({"op": "healthz"},
-                                                   timeout=5.0)["health"]
+                    health = member.client.request(
+                        {"op": "healthz"},
+                        timeout=_probe_timeout_s())["health"]
                 except Exception as e:
                     member.fails += 1
                     down += 1
@@ -637,9 +658,10 @@ class Router:
             address = (address[0], int(address[1]))
         try:
             faults.fault_point("route.router_down")
-            health = ServeClient(address, connect_timeout=5.0,
-                                 retries=0).request(
-                {"op": "healthz"}, timeout=5.0)["health"]
+            health = ServeClient(address,
+                                 connect_timeout=_probe_timeout_s(),
+                                 retries=0, counters=self.counters).request(
+                {"op": "healthz"}, timeout=_probe_timeout_s())["health"]
         except (faults.FaultError, ServeClientError, OSError, TypeError) as e:
             self._active_fails += 1
             print(f"route[{self.router_id}]: active router "
@@ -1056,6 +1078,8 @@ class Router:
             doc = dict(doc)
             doc["epoch"] = self.epoch
             doc["router"] = self.router_id
+        if timeout is None:
+            timeout = _forward_timeout_s()
         try:
             # the forward span is the wire context the worker links to:
             # ServeClient stamps the innermost open span onto the doc

@@ -572,12 +572,14 @@ class Scheduler:
         self._journal = journal
         # fleet content-addressed result cache: a ResultCache instance or
         # a cache-plane root dir (str); None disables caching entirely
+        self.counters = Counters()
         if isinstance(result_cache, str):
             from consensuscruncher_tpu.serve.result_cache import ResultCache
             result_cache = ResultCache(
                 result_cache, node=self.node or None,
                 max_bytes=int(os.environ.get(
-                    "CCT_SERVE_CACHE_MAX_BYTES", "0")) or None)
+                    "CCT_SERVE_CACHE_MAX_BYTES", "0")) or None,
+                counters=self.counters)
         self.result_cache = result_cache
         weights = dict(self.DEFAULT_CLASS_WEIGHTS)
         for qos, w in (class_weights or {}).items():
@@ -598,7 +600,6 @@ class Scheduler:
         self.tenant_inflight_cap = None if tenant_inflight_cap is None \
             else max(1, int(tenant_inflight_cap))
         self.slo = SloMonitor(targets=self.slo_targets)
-        self.counters = Counters()
         # optional callable set by serve_cmd: surfaces the bucket
         # autotuner's state (table size, unexpected recompiles) in /metrics
         self.autotune_info = None
@@ -1315,6 +1316,11 @@ class Scheduler:
         path, so completed stages are skipped and outputs stay
         byte-identical — exactly-once at the output level."""
         jobs, info = journal_mod.replay(self._journal.path)
+        if info.get("crc_skipped"):
+            # mid-file bit flips the replay refused to act on — surfaced
+            # as a counter so a corrupted disk shows up in metrics, not
+            # just a startup warning line
+            self.counters.add("journal_crc_skipped", int(info["crc_skipped"]))
         requeued = finished = dropped = adopted = quarantined = 0
         with self._cond:
             if info.get("fence_epoch"):

@@ -82,6 +82,7 @@ import time
 from consensuscruncher_tpu.obs import prof as obs_prof
 from consensuscruncher_tpu.obs import trace as obs_trace
 from consensuscruncher_tpu.obs.metrics import render_prometheus
+from consensuscruncher_tpu.serve import wire
 from consensuscruncher_tpu.serve.scheduler import (
     AdmissionRefused, BrownoutRefused, DeadlineShed, QuarantineRefused,
     QuotaRefused, RouterFenced, Scheduler,
@@ -96,12 +97,27 @@ class ServeServer:
 
     def __init__(self, scheduler: Scheduler, host: str = "127.0.0.1",
                  port: int = 0, socket_path: str | None = None,
-                 max_conns: int | None = None):
+                 max_conns: int | None = None,
+                 read_timeout_s: float | None = None,
+                 idle_timeout_s: float | None = None):
         self.scheduler = scheduler
         self.socket_path = socket_path
         if max_conns is None:
             max_conns = int(os.environ.get("CCT_SERVE_MAX_CONNS", "128"))
         self.max_conns = max(1, int(max_conns))
+        # per-connection deadlines: read_timeout bounds a *half-frame*
+        # stall (bytes buffered, rest never arrives), idle_timeout bounds
+        # a connected-but-silent peer.  Either expiring reaps the
+        # connection and recovers its max_conns slot (``conns_reaped``).
+        # 0 disables a deadline (the legacy unbounded behavior).
+        if read_timeout_s is None:
+            read_timeout_s = float(
+                os.environ.get("CCT_SERVE_READ_TIMEOUT_S", "30"))
+        if idle_timeout_s is None:
+            idle_timeout_s = float(
+                os.environ.get("CCT_SERVE_IDLE_TIMEOUT_S", "300"))
+        self.read_timeout_s = max(0.0, float(read_timeout_s))
+        self.idle_timeout_s = max(0.0, float(idle_timeout_s))
         if socket_path:
             if os.path.exists(socket_path):
                 os.unlink(socket_path)  # stale socket from a dead daemon
@@ -139,6 +155,7 @@ class ServeServer:
     def serve_forever(self) -> None:
         while not self._closed:
             try:
+                # cct: allow-wire(shutdown closes the listener to break accept; per-connection deadlines start in _handle_conn)
                 conn, _addr = self._sock.accept()
             except OSError:
                 return  # socket closed under us: clean shutdown
@@ -207,6 +224,8 @@ class ServeServer:
     # ----------------------------------------------------------- connection
 
     def _handle_conn(self, conn: socket.socket, cid: int) -> None:
+        counters = getattr(self.scheduler, "counters", None)
+        replay = wire.ReplayCache()
         try:
             try:
                 faults.fault_point("serve.accept")
@@ -215,10 +234,35 @@ class ServeServer:
                 return
             try:
                 buf = b""
+                last_activity = time.monotonic()
                 while True:
-                    chunk = conn.recv(65536)
+                    # a partial frame in the buffer puts the connection on
+                    # the (short) read deadline — a half-frame-then-stall
+                    # peer must finish its line or lose the slot; an empty
+                    # buffer is merely idle and gets the longer deadline
+                    limit = self.read_timeout_s if buf else self.idle_timeout_s
+                    if limit > 0:
+                        remaining = (last_activity + limit) - time.monotonic()
+                        if remaining <= 0:
+                            if counters is not None:
+                                counters.add("conns_reaped")
+                            self._reply(conn, {
+                                "ok": False, "transport": True,
+                                "reaped": True,
+                                "error": "connection reaped "
+                                         f"({'read' if buf else 'idle'} "
+                                         "deadline exceeded)"})
+                            return
+                        conn.settimeout(remaining)
+                    else:
+                        conn.settimeout(None)  # deadline disabled
+                    try:
+                        chunk = conn.recv(65536)
+                    except socket.timeout:
+                        continue  # loop re-checks the deadline and reaps
                     if not chunk:
                         return
+                    last_activity = time.monotonic()
                     buf += chunk
                     if len(buf) > MAX_LINE:
                         self._reply(conn, {"ok": False,
@@ -231,15 +275,56 @@ class ServeServer:
                         try:
                             req = json.loads(line)
                         except ValueError:
-                            self._reply(conn, {"ok": False, "error": "bad JSON"})
+                            # an unparseable line IS a corrupted frame —
+                            # the crc gate never got a chance.  Answer as
+                            # retryable transport loss so the sender
+                            # re-sends instead of giving up, then close
+                            # (the stream offset can no longer be trusted)
+                            if counters is not None:
+                                counters.add("wire_crc_errors")
+                            self._reply(conn, {
+                                "ok": False, "transport": True,
+                                "crc_error": True,
+                                "error": "bad JSON (corrupted frame)"})
                             return
-                        self._reply(conn, self._dispatch(req))
+                        self._reply(conn, self._respond(req, replay, counters))
+                        last_activity = time.monotonic()
             except (OSError, BrokenPipeError):
                 pass  # client went away mid-exchange; nothing to clean up
         finally:
             conn.close()
             with self._conn_lock:
                 self._conns.pop(cid, None)
+
+    def _respond(self, req, replay: wire.ReplayCache, counters) -> dict:
+        """Envelope gate around :meth:`_dispatch`: verify the crc of an
+        enveloped request (a mismatch is answered as retryable transport
+        loss, never dispatched), absorb duplicated frames from the
+        per-connection seq replay cache, and seal replies to enveloped
+        requests with their own seq echo + crc.  Legacy requests carry
+        neither field and pass straight through untouched."""
+        if not isinstance(req, dict):
+            return {"ok": False, "error": "request must be a JSON object"}
+        if not wire.verify(req):
+            if counters is not None:
+                counters.add("wire_crc_errors")
+            return {"ok": False, "transport": True, "crc_error": True,
+                    "error": "request failed its crc (corrupted in flight)"}
+        seq = req.get("seq")
+        if seq is not None:
+            cached = replay.check(seq)
+            if cached is not None:
+                # a duplicated delivery of a frame already answered on
+                # this connection: re-answer, never re-dispatch
+                if counters is not None:
+                    counters.add("wire_dup_dropped")
+                return cached
+            req = {k: v for k, v in req.items() if k not in ("seq", "crc")}
+        reply = self._dispatch(req)
+        if seq is not None:
+            reply = wire.seal(reply, seq)
+            replay.remember(seq, reply)
+        return reply
 
     @staticmethod
     def _reply(conn: socket.socket, doc: dict) -> None:

@@ -1,0 +1,43 @@
+"""Conformant twin of viol_wire.py: every socket operation is bounded
+by a deadline in the same function, or deliberately waived with the
+allow-wire pragma (the listener pattern: accept is broken by closing
+the socket on shutdown, not by a timeout).
+"""
+
+import socket
+
+READ_TIMEOUT_S = 30.0
+CONNECT_TIMEOUT_S = 5.0
+
+
+def read_reply(sock):
+    sock.settimeout(READ_TIMEOUT_S)
+    buf = b""
+    while b"\n" not in buf:
+        chunk = sock.recv(65536)
+        if not chunk:
+            break
+        buf += chunk
+    return buf
+
+
+def accept_loop(listener):
+    while True:
+        try:
+            # cct: allow-wire(shutdown closes the listener to break accept)
+            conn, _addr = listener.accept()
+        except OSError:
+            return
+        conn.close()
+
+
+def dial(path):
+    s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    s.settimeout(CONNECT_TIMEOUT_S)
+    s.connect(path)
+    return s
+
+
+def dial_tcp(host, port):
+    return socket.create_connection((host, port),
+                                    timeout=CONNECT_TIMEOUT_S)

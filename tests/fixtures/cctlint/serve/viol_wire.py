@@ -1,0 +1,29 @@
+"""Seeded CCT11xx violations: unbounded serve-plane socket operations.
+
+Every site here blocks forever on a silent peer — the exact slowloris
+shape the per-connection deadlines exist to reap.
+"""
+
+import socket
+
+
+def read_reply(sock):
+    buf = b""
+    while b"\n" not in buf:
+        chunk = sock.recv(65536)  # CCT1101: no deadline in this function
+        if not chunk:
+            break
+        buf += chunk
+    return buf
+
+
+def accept_loop(listener):
+    while True:
+        conn, _addr = listener.accept()  # CCT1101: unbounded accept
+        conn.close()
+
+
+def dial(path):
+    s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    s.connect(path)  # CCT1102: a blackholed address hangs this forever
+    return s

@@ -595,6 +595,25 @@ def test_render_frame_layout():
                                               now=0.0)
 
 
+def test_render_frame_net_row_dash_degrades():
+    # a pre-envelope fleet exports NO wire series: the row is absent
+    base = obs_top.parse_prometheus(_EXPO)
+    assert not any(ln.startswith("net:")
+                   for ln in obs_top.render_frame(base, "x",
+                                                  now=0.0).splitlines())
+    # one wire series present: the row renders, measured cells as
+    # numbers, absent cells as dashes (a dash means "daemon predates
+    # the envelope", a zero means "measured and clean")
+    series = obs_top.parse_prometheus(
+        _EXPO + "cct_wire_crc_errors_total 3\n"
+                "cct_conns_reaped_total 0\n")
+    (net,) = [ln for ln in obs_top.render_frame(series, "x",
+                                                now=0.0).splitlines()
+              if ln.startswith("net:")]
+    assert "crc_err=3" in net and "reaped=0" in net
+    assert "timeouts=-" in net and "jrnl_skip=-" in net
+
+
 # ------------------------------------------------------ flight identity
 
 def test_flight_dump_stamps_node_and_router_epoch(tmp_path):

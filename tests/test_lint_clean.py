@@ -50,6 +50,7 @@ def test_repo_is_lint_clean():
     ("policies/viol_policycov.py", {"CCT611"}),
     ("effects/viol_effects.py",
      {"CCT1001", "CCT1002", "CCT1003", "CCT1004"}),
+    ("serve/viol_wire.py", {"CCT1101", "CCT1102"}),
 ])
 def test_each_pass_detects_its_seeded_violation(rel, expected):
     findings = run_paths([os.path.join(FIXTURES, rel)], root=REPO)
@@ -66,6 +67,7 @@ def test_each_pass_detects_its_seeded_violation(rel, expected):
     "clean_qc_series.py",
     "policies/clean_policycov.py",
     "effects/clean_effects.py",
+    "serve/clean_wire.py",
 ])
 def test_protocol_twin_fixtures_are_clean(rel):
     """The conformant twins prove the CCT7/CCT8 rules key on the actual

@@ -215,6 +215,92 @@ def test_cache_fault_degrades_to_miss_never_error(tmp_path, monkeypatch):
     assert rc.insert("bb" * 16, str(src)) is not None
 
 
+# ------------------------------------------------------------ integrity
+
+def test_corrupt_payload_degrades_to_counted_miss_and_quarantines(
+        tmp_path, capfd):
+    """A flipped payload byte must NEVER be served: the lookup re-hashes
+    against the sha256 pinned at insert, degrades to a counted miss and
+    moves the corpse aside for post-mortem."""
+    from consensuscruncher_tpu.serve.result_cache import QUARANTINE_DIR
+    from consensuscruncher_tpu.utils.profiling import Counters
+
+    src = tmp_path / "o"
+    _make_payload(src, {"golden/x.bam": b"\x1f\x8b" + b"A" * 64})
+    counters = Counters()
+    rc = ResultCache(str(tmp_path / "plane"), node="w0", counters=counters)
+    entry = rc.insert("ab" * 16, str(src))
+    assert all(f["sha256"] for f in entry["files"])  # integrity pinned
+
+    victim = os.path.join(entry["dir"], "payload", "golden", "x.bam")
+    blob = bytearray(open(victim, "rb").read())
+    blob[10] ^= 0xFF
+    with open(victim, "wb") as fh:
+        fh.write(bytes(blob))
+
+    assert rc.lookup("ab" * 16) is None
+    assert "failed integrity" in capfd.readouterr().err
+    assert counters.snapshot()["cache_integrity_misses"] == 1
+    # the corpse moved under quarantine/, invisible to every reader
+    qroot = os.path.join(str(tmp_path / "plane"), QUARANTINE_DIR)
+    assert os.path.isdir(qroot) and os.listdir(qroot)
+    assert rc.lookup("ab" * 16) is None  # and STAYS a miss
+    # quarantine/ is not a shard: a fresh re-insert works cleanly
+    assert rc.insert("ab" * 16, str(src)) is not None
+    assert rc.lookup("ab" * 16) is not None
+
+
+def test_peer_shard_still_answers_past_a_corrupt_copy(tmp_path, capfd):
+    """Integrity failure on one shard keeps probing the others — a peer
+    may hold a good copy of the same digest."""
+    src = tmp_path / "o"
+    _make_payload(src, {"f.bin": b"y" * 48})
+    rc0 = ResultCache(str(tmp_path / "plane"), node="w0")
+    rc1 = ResultCache(str(tmp_path / "plane"), node="w1")
+    e0 = rc0.insert("cd" * 16, str(src))
+    rc1.insert("cd" * 16, str(src))
+
+    with open(os.path.join(e0["dir"], "payload", "f.bin"), "wb") as fh:
+        fh.write(b"z" * 48)
+    found = rc1.lookup("cd" * 16, preferred_shard="w0")
+    capfd.readouterr()
+    assert found is not None and found["shard"] == "w1"
+
+
+def test_scrub_classifies_intact_legacy_corrupt(tmp_path, capfd):
+    """``cct cache scrub``'s engine: every committed entry re-hashed,
+    corrupt ones quarantined, pre-integrity entries counted as legacy
+    (nothing to verify), and no ``ok`` key in the report (it is not a
+    wire reply)."""
+    src = tmp_path / "o"
+    _make_payload(src, {"f.bin": b"k" * 32})
+    rc = ResultCache(str(tmp_path / "plane"), node="w0")
+    intact = rc.insert("aa" * 16, str(src))
+    corrupt = rc.insert("bb" * 16, str(src))
+    legacy = rc.insert("cc" * 16, str(src))
+
+    with open(os.path.join(corrupt["dir"], "payload", "f.bin"), "wb") as fh:
+        fh.write(b"x" * 32)
+    # age a pre-integrity entry: strip the pinned hashes from its doc
+    epath = os.path.join(legacy["dir"], ENTRY_NAME)
+    doc = json.load(open(epath))
+    for f in doc["files"]:
+        del f["sha256"]
+    with open(epath, "w") as fh:
+        json.dump(doc, fh)
+
+    report = rc.scrub()
+    capfd.readouterr()
+    assert "ok" not in report
+    assert (report["entries"], report["intact"], report["legacy"],
+            report["corrupt"]) == (3, 1, 1, 1)
+    assert report["quarantined"][0]["digest"] == "bb" * 16
+    assert rc.lookup("aa" * 16) is not None
+    assert rc.lookup("cc" * 16) is not None  # legacy still served
+    assert rc.lookup("bb" * 16) is None
+    assert rc.scrub()["entries"] == 2  # the corpse left the plane
+
+
 # ------------------------------------------------------------ scheduler
 
 def test_daemon_cache_hit_byte_identical_to_golden(tmp_path):

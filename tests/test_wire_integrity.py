@@ -1,0 +1,376 @@
+"""Wire/at-rest integrity: envelope, netchaos fault layer, deadlines.
+
+Pure host-side units for the ``{"seq", "crc"}`` wire envelope
+(serve/wire.py) and the deterministic netchaos fault injector
+(utils/netchaos.py), plus live-socket regressions for the server's
+read/idle deadline reaper: a silent peer and a half-frame-then-stall
+peer must both be reaped (their max_conns slot recovered) while a
+well-behaved request on another connection completes untouched.  The
+fleet-scale proofs live in tools/chaos_conductor.py --netchaos and the
+ci_check.sh positive control (deadlines off => the slowloris wins).
+"""
+
+import json
+import socket
+import time
+
+import pytest
+
+from consensuscruncher_tpu.serve import wire
+from consensuscruncher_tpu.serve.scheduler import Scheduler
+from consensuscruncher_tpu.serve.server import ServeServer
+from consensuscruncher_tpu.utils import netchaos
+
+# ------------------------------------------------------------- envelope
+
+
+def test_crc_is_canonical_and_ignores_key_order():
+    a = {"op": "status", "job_id": 7, "seq": 1}
+    b = {"seq": 1, "job_id": 7, "op": "status"}
+    assert wire.crc_of(a) == wire.crc_of(b)
+    # the crc field itself never feeds the crc
+    assert wire.crc_of({**a, "crc": 123}) == wire.crc_of(a)
+    assert wire.crc_of({**a, "job_id": 8}) != wire.crc_of(a)
+
+
+def test_seal_verify_round_trip_and_tamper_detection():
+    sealed = wire.seal({"op": "healthz"}, seq=3)
+    assert sealed["seq"] == 3 and wire.verify(sealed)
+    tampered = dict(sealed, op="drain")
+    assert not wire.verify(tampered)
+    # legacy peer: no crc => nothing to check, never an error
+    assert wire.verify({"op": "healthz"})
+    assert not wire.verify({"op": "healthz", "crc": "garbage"})
+
+
+def test_seal_degrades_to_seq_only_on_unencodable_doc():
+    sealed = wire.seal({"op": "x", "blob": object()}, seq=9)
+    assert sealed["seq"] == 9 and "crc" not in sealed
+    # the peer treats the missing crc as legacy: still deliverable
+    assert wire.verify({k: v for k, v in sealed.items() if k != "blob"})
+
+
+def test_replay_cache_absorbs_duplicates_and_stays_bounded():
+    cache = wire.ReplayCache(max_entries=4)
+    assert cache.check(1) is None
+    cache.remember(1, {"ok": True, "seq": 1})
+    assert cache.check(1) == {"ok": True, "seq": 1}
+    assert cache.check("1") == {"ok": True, "seq": 1}  # wire ints arrive as str
+    for seq in range(2, 7):
+        cache.remember(seq, {"ok": True, "seq": seq})
+    assert cache.check(1) is None  # oldest evicted first
+    assert cache.check(6) is not None
+    cache.remember("not-a-seq", {"ok": True})  # tolerated, never raises
+    assert cache.check("not-a-seq") is None
+
+
+# ------------------------------------------------------- netchaos: spec
+
+def test_parse_spec_grammar():
+    seed, rules = netchaos.parse_spec(
+        "seed=7; client->r0=corrupt@3 ; r0<->r1=partition; *->w1=latency:50")
+    assert seed == 7
+    links = [(r.src, r.dst, r.kind, r.times, r.arg) for r in rules]
+    assert ("client", "r0", "corrupt", 3, None) in links
+    # <-> arms BOTH directions as two independent rules
+    assert ("r0", "r1", "partition", None, None) in links
+    assert ("r1", "r0", "partition", None, None) in links
+    assert ("*", "w1", "latency", None, 50.0) in links
+    assert netchaos.parse_spec("")[1] == []
+
+
+@pytest.mark.parametrize("bad", [
+    "client->r0=warp",            # unknown kind
+    "client->r0=latency",         # kind needs an argument
+    "client-r0=corrupt",          # bad link arrow
+    "->r0=corrupt",               # empty endpoint
+    "justtext",                   # not link=kind
+])
+def test_parse_spec_refuses_malformed_entries(bad):
+    with pytest.raises(netchaos.NetChaosSpecError):
+        netchaos.parse_spec(bad)
+
+
+def test_decide_is_pure_function_of_seed_link_kind_ordinal():
+    l1 = netchaos.ChaosLayer("seed=7;client->r0=corrupt")
+    l2 = netchaos.ChaosLayer("seed=7;client->r0=corrupt")
+    r1, r2 = l1.rules[0], l2.rules[0]
+    assert [l1.decide(r1, n) for n in range(8)] == \
+        [l2.decide(r2, n) for n in range(8)]
+    l3 = netchaos.ChaosLayer("seed=8;client->r0=corrupt")
+    assert [l1.decide(r1, n) for n in range(8)] != \
+        [l3.decide(l3.rules[0], n) for n in range(8)]
+
+
+def test_peer_name_fleet_conventions():
+    assert netchaos.peer_name("/run/cct/w0.sock") == "w0"
+    assert netchaos.peer_name(("10.0.0.2", 7733)) == "10.0.0.2:7733"
+    assert netchaos.peer_name("/tmp/route.socket") == "route.socket"
+
+
+def test_wrap_is_identity_off_the_named_links(monkeypatch):
+    monkeypatch.setenv("CCT_NETCHAOS_NODE", "client")
+    layer = netchaos.ChaosLayer("seed=1;client->r0=corrupt")
+    a, b = socket.socketpair()
+    try:
+        assert layer.wrap(a, "w0") is a          # link not named
+        assert layer.wrap(a, "r0") is not a      # out-rule matches
+        wrapped = netchaos.ChaosLayer(
+            "seed=1;r0->client=dup").wrap(a, "r0")
+        assert isinstance(wrapped, netchaos.ChaosSocket)  # in-rule matches
+    finally:
+        a.close()
+        b.close()
+
+
+# --------------------------------------------------- netchaos: the wire
+
+def _pair(spec: str, peer: str = "r0", monkeypatch=None):
+    layer = netchaos.ChaosLayer(spec)
+    a, b = socket.socketpair()
+    return layer.wrap(a, peer), a, b
+
+
+def test_corrupt_flips_exactly_one_byte_deterministically(monkeypatch):
+    monkeypatch.setenv("CCT_NETCHAOS_NODE", "client")
+    frame = b'{"op":"status","job_id":7}\n'
+    seen = []
+    for _ in range(2):
+        chaotic, a, b = _pair("seed=7;client->r0=corrupt")
+        try:
+            chaotic.sendall(frame)
+            got = b.recv(4096)
+        finally:
+            a.close()
+            b.close()
+        assert len(got) == len(frame) and got != frame
+        assert sum(x != y for x, y in zip(got, frame)) == 1
+        assert b"\n" in got  # the frame boundary itself is never flipped
+        seen.append(got)
+    assert seen[0] == seen[1]  # same seed => same flipped byte
+
+
+def test_times_budget_exhausts_then_link_heals(monkeypatch):
+    monkeypatch.setenv("CCT_NETCHAOS_NODE", "client")
+    frame = b'{"op":"healthz"}\n'
+    chaotic, a, b = _pair("seed=7;client->r0=corrupt@1")
+    try:
+        chaotic.sendall(frame)
+        assert b.recv(4096) != frame   # firing 1: corrupted
+        chaotic.sendall(frame)
+        assert b.recv(4096) == frame   # budget spent: clean
+    finally:
+        a.close()
+        b.close()
+
+
+def test_dup_delivers_the_frame_twice(monkeypatch):
+    monkeypatch.setenv("CCT_NETCHAOS_NODE", "client")
+    frame = b'{"op":"healthz","seq":1}\n'
+    chaotic, a, b = _pair("seed=7;client->r0=dup@1")
+    try:
+        chaotic.sendall(frame)
+        b.settimeout(5)
+        got = b""
+        while got.count(b"\n") < 2:
+            got += b.recv(4096)
+    finally:
+        a.close()
+        b.close()
+    assert got == frame * 2
+
+
+def test_partition_refuses_connect_and_swallows_sends(monkeypatch):
+    monkeypatch.setenv("CCT_NETCHAOS_NODE", "client")
+    chaotic, a, b = _pair("seed=7;client->r0=partition")
+    try:
+        with pytest.raises(ConnectionRefusedError):
+            chaotic.connect("/nonexistent.sock")
+        chaotic.sendall(b"vanishes\n")  # swallowed, not delivered
+        b.settimeout(0.2)
+        with pytest.raises(socket.timeout):
+            b.recv(4096)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_inbound_blackhole_starves_reads(monkeypatch):
+    monkeypatch.setenv("CCT_NETCHAOS_NODE", "client")
+    chaotic, a, b = _pair("seed=7;r0->client=blackhole")
+    try:
+        b.sendall(b"never seen\n")
+        with pytest.raises(socket.timeout):
+            chaotic.recv(4096)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_spec_file_is_relived_on_rewrite(monkeypatch, tmp_path):
+    spec = tmp_path / "netchaos.spec"
+    spec.write_text("seed=7;client->r0=partition\n")
+    monkeypatch.setenv("CCT_NETCHAOS", f"@{spec}")
+    monkeypatch.setenv("CCT_NETCHAOS_NODE", "client")
+    netchaos.reset()
+    try:
+        layer = netchaos.get()
+        assert [r.kind for r in layer.rules] == ["partition"]
+        assert netchaos.get() is layer  # cached while the file is unchanged
+
+        # conductor heals the link by rewriting the file: next access
+        # re-parses (and @times budgets restart — the documented contract)
+        tmp = tmp_path / "netchaos.spec.tmp"
+        tmp.write_text("seed=7\n")
+        tmp.replace(spec)
+        healed = netchaos.get()
+        assert healed is not layer and healed.rules == []
+
+        monkeypatch.delenv("CCT_NETCHAOS")
+        assert netchaos.get() is None
+        assert netchaos.maybe_wrap("raw", "/x/r0.sock") == "raw"
+    finally:
+        netchaos.reset()
+
+
+# ------------------------------------------- server deadlines (the reap)
+
+@pytest.fixture
+def quick_server():
+    """In-process server with aggressive deadlines and 2 conn slots; the
+    scheduler never starts a worker thread (healthz needs none)."""
+    sched = Scheduler(queue_bound=8, gang_size=4, backend="tpu",
+                      paused=True, start=False)
+    server = ServeServer(sched, port=0, max_conns=2,
+                         read_timeout_s=0.4, idle_timeout_s=0.8)
+    server.start()
+    try:
+        yield sched, server
+    finally:
+        server.close()
+
+
+def _read_reply(sock, timeout=10.0):
+    sock.settimeout(timeout)
+    buf = b""
+    while b"\n" not in buf:
+        chunk = sock.recv(65536)
+        if not chunk:
+            break
+        buf += chunk
+    return json.loads(buf) if buf else None
+
+
+def test_silent_client_is_reaped_and_told(quick_server):
+    sched, server = quick_server
+    with socket.create_connection(tuple(server.address), timeout=10) as sock:
+        reply = _read_reply(sock)  # send NOTHING: the idle deadline reaps
+    assert reply["ok"] is False and reply["reaped"] is True
+    assert reply["transport"] is True and "idle" in reply["error"]
+    assert sched.counters.snapshot()["conns_reaped"] == 1
+
+
+def test_half_frame_then_stall_is_reaped_on_the_read_deadline(quick_server):
+    sched, server = quick_server
+    with socket.create_connection(tuple(server.address), timeout=10) as sock:
+        sock.sendall(b'{"op": "healthz"')  # half a frame, then silence
+        t0 = time.monotonic()
+        reply = _read_reply(sock)
+    # the SHORT read deadline fired, not the longer idle one
+    assert time.monotonic() - t0 < server.idle_timeout_s + 2.0
+    assert reply["reaped"] is True and "read" in reply["error"]
+    assert sched.counters.snapshot()["conns_reaped"] == 1
+
+
+def _wait_conns_drained(server, deadline_s=10.0):
+    """Block until the server has noticed every client-side close and
+    recycled its conn slots — a fresh connect is then guaranteed a slot
+    rather than the max_conns busy reply."""
+    t0 = time.monotonic()
+    while server._conns and time.monotonic() - t0 < deadline_s:
+        time.sleep(0.02)
+    assert not server._conns
+
+
+def test_reaped_slot_is_recovered_and_legit_requests_survive(quick_server):
+    sched, server = quick_server
+    addr = tuple(server.address)
+    # fill BOTH conn slots with slowloris peers
+    loris = [socket.create_connection(addr, timeout=10) for _ in range(2)]
+    try:
+        for sock in loris:
+            assert _read_reply(sock)["reaped"] is True
+        _wait_conns_drained(server)
+        # both slots recovered: a well-behaved request gets a real answer
+        with socket.create_connection(addr, timeout=10) as sock:
+            sock.sendall(b'{"op": "healthz"}\n')
+            reply = _read_reply(sock)
+        assert reply["ok"] is True and "health" in reply
+        # ... while ANOTHER slowloris on the second slot is reaped in
+        # parallel with it, never disturbing the legit exchange
+        _wait_conns_drained(server)
+        with socket.create_connection(addr, timeout=10) as legit, \
+                socket.create_connection(addr, timeout=10) as quiet:
+            legit.sendall(b'{"op": "healthz"}\n')
+            assert _read_reply(legit)["ok"] is True
+            legit.close()  # hang up before idling into a reap of our own
+            assert _read_reply(quiet)["reaped"] is True
+    finally:
+        for sock in loris:
+            sock.close()
+    assert sched.counters.snapshot()["conns_reaped"] == 3
+
+
+def test_zero_timeouts_restore_legacy_unbounded_reads():
+    sched = Scheduler(queue_bound=8, gang_size=4, backend="tpu",
+                      paused=True, start=False)
+    server = ServeServer(sched, port=0, max_conns=2,
+                         read_timeout_s=0, idle_timeout_s=0)
+    server.start()
+    try:
+        with socket.create_connection(tuple(server.address),
+                                      timeout=10) as sock:
+            sock.settimeout(1.5)  # would have been reaped by quick_server
+            with pytest.raises(socket.timeout):
+                sock.recv(65536)
+        assert sched.counters.snapshot()["conns_reaped"] == 0
+    finally:
+        server.close()
+
+
+# ------------------------------------------------- server envelope gate
+
+def test_enveloped_request_echoes_seq_and_absorbs_duplicates(quick_server):
+    sched, server = quick_server
+    req = wire.seal({"op": "healthz"}, seq=5)
+    frame = json.dumps(req).encode() + b"\n"
+    with socket.create_connection(tuple(server.address), timeout=10) as sock:
+        sock.sendall(frame)
+        first = _read_reply(sock)
+        sock.sendall(frame)  # duplicated delivery of the SAME frame
+        second = _read_reply(sock)
+    assert first["ok"] is True and first["seq"] == 5
+    assert wire.verify(first)
+    assert second == first  # answered from the replay cache, not re-run
+    assert sched.counters.snapshot()["wire_dup_dropped"] == 1
+
+
+def test_corrupted_envelope_is_retryable_never_dispatched(quick_server):
+    sched, server = quick_server
+    req = wire.seal({"op": "drain"}, seq=1)
+    req["op"] = "healthz"  # flipped in flight after sealing
+    with socket.create_connection(tuple(server.address), timeout=10) as sock:
+        sock.sendall(json.dumps(req).encode() + b"\n")
+        reply = _read_reply(sock)
+    assert reply["ok"] is False and reply["crc_error"] is True
+    assert reply["transport"] is True  # the client re-sends, never gives up
+    assert sched.counters.snapshot()["wire_crc_errors"] == 1
+
+
+def test_unparseable_line_counts_as_wire_corruption(quick_server):
+    sched, server = quick_server
+    with socket.create_connection(tuple(server.address), timeout=10) as sock:
+        sock.sendall(b'{"op": "healthz"\x00, garbage}\n')
+        reply = _read_reply(sock)
+    assert reply["crc_error"] is True and reply["transport"] is True
+    assert sched.counters.snapshot()["wire_crc_errors"] == 1

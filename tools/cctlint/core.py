@@ -28,6 +28,7 @@ PRAGMA_FAMILY = {
     "CCT8": "shared-state",
     "CCT9": "cache-store",
     "CCT10": "effect",
+    "CCT11": "wire",
     # CCT3 (fault coverage) and CCT6 (metric registry) have no pragma on
     # purpose: an unregistered or untested site is fixed by registering/
     # testing it, never by waiving it.
@@ -179,7 +180,8 @@ def all_passes():
     """Name -> pass callable.  Imported lazily so a syntax error in one pass
     module doesn't take down the others during development."""
     from . import (cachestore, determinism, effects, faultcov, hostsync,
-                   jitdisc, locks, obscov, policycov, protocol, shared_state)
+                   jitdisc, locks, obscov, policycov, protocol,
+                   shared_state, wire)
 
     return {
         "hostsync": hostsync.run,
@@ -193,6 +195,7 @@ def all_passes():
         "cachestore": cachestore.run,
         "policycov": policycov.run,
         "effects": effects.run,
+        "wire": wire.run,
     }
 
 

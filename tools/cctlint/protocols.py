@@ -127,6 +127,13 @@ WIRE_REPLY_KEYS = frozenset({
     # name (absent == majority; unknown names are refused at admission
     # with ``bad_request``), and replies/job docs may echo it
     "policy",
+    # wire integrity envelope (ISSUE 19): enveloped requests carry a
+    # per-connection ``seq`` and a payload ``crc``; replies echo the seq
+    # and carry their own crc, a corrupted frame is answered
+    # ``crc_error`` (retryable transport), and a reaped connection's
+    # courtesy reply says ``reaped``.  Legacy peers never send or
+    # receive any of these.
+    "seq", "crc", "crc_error", "reaped",
 })
 
 # ---------------------------------------------------------- helpers ----
@@ -166,8 +173,12 @@ def validate_journal_record(rec):
     """Grammar-check one parsed journal line (job or marker record)."""
     if not isinstance(rec, dict):
         return "journal record is not an object"
-    if rec.get("v") != 1:
+    if rec.get("v") not in (1, 2):
         return f"unknown journal record version {rec.get('v')!r}"
+    if rec.get("v") == 2 and not isinstance(rec.get("crc"), int):
+        # v2 IS the crc generation: a v2 record without the field means
+        # the crc (or its key) was corrupted away — never legacy
+        return "v2 journal record without an integer crc"
     kind = rec.get("rec")
     if kind not in JOURNAL_REC_TYPES:
         return f"unknown journal record type {kind!r}"

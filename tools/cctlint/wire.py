@@ -1,0 +1,117 @@
+"""Pass 11 — wire deadline discipline (CCT11xx).
+
+The hostile-network work (netchaos, slowloris reaping) rests on one
+rule: **no serve-plane socket operation blocks forever**.  A single
+bare ``recv``/``accept``/``connect`` with no enclosing deadline is a
+slot a silent peer can hold until the fleet is wedged — exactly the
+half-open stall the per-connection read/idle deadlines exist to reap,
+re-opened by one careless call site.
+
+This pass applies to files under a ``serve/`` directory (the protocol
+plane: server, client, router — plus their lint fixtures); test files
+are skipped (tests drive sockets under pytest's own timeout).
+
+CCT1101  a ``.recv``/``.recv_into``/``.recvfrom``/``.accept`` call in a
+         function that never sets a socket deadline — nothing bounds
+         how long a silent or half-framing peer can hold the thread.
+CCT1102  a ``.connect`` call in a function that never sets a socket
+         deadline — a blackholed address (SYN into the void) can hang
+         the dial forever.
+
+"Sets a deadline" means the same function calls ``settimeout`` /
+``setdefaulttimeout``, or dials via ``socket.create_connection`` with a
+``timeout`` argument.  The scope is the innermost enclosing function:
+a deadline configured in a *different* function is invisible to the
+reader of this one, and to this lint.
+
+Waivable with ``# cct: allow-wire(reason)`` for the rare deliberately
+unbounded site (e.g. a listener whose ``accept`` is broken by closing
+the socket on shutdown).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, LintContext, SourceFile, call_name, terminal_name
+
+#: receive-side calls that park the thread until the peer sends
+_RECV_CALLS = frozenset({"recv", "recv_into", "recvfrom", "accept"})
+
+#: deadline-establishing terminal names
+_DEADLINE_CALLS = frozenset({"settimeout", "setdefaulttimeout"})
+
+
+def _in_scope(src: SourceFile) -> bool:
+    if src.parts[-1].startswith("test_"):
+        return False
+    return src.in_dirs("serve")
+
+
+def _enclosing_functions(tree: ast.AST) -> list[ast.AST]:
+    return [n for n in ast.walk(tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+
+
+def _innermost(funcs: list[ast.AST], node: ast.AST) -> ast.AST | None:
+    """Innermost function whose span contains ``node`` (by line range —
+    good enough for lint scoping; nested defs pick the tightest)."""
+    best = None
+    for fn in funcs:
+        end = getattr(fn, "end_lineno", fn.lineno)
+        if fn.lineno <= node.lineno <= end:
+            if best is None or fn.lineno > best.lineno:
+                best = fn
+    return best
+
+
+def _has_deadline(scope: ast.AST) -> bool:
+    for node in ast.walk(scope):
+        if not isinstance(node, ast.Call):
+            continue
+        if terminal_name(node) in _DEADLINE_CALLS:
+            return True
+        if call_name(node).endswith("create_connection"):
+            if len(node.args) >= 2 or \
+                    any(kw.arg == "timeout" for kw in node.keywords):
+                return True
+    return False
+
+
+def run(ctx: LintContext) -> list[Finding]:
+    findings: list[Finding] = []
+    for src in ctx.parsed():
+        if not _in_scope(src):
+            continue
+        funcs = _enclosing_functions(src.tree)
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = call_name(node)
+            if "." not in dotted:  # bare recv()/connect(): not a socket op
+                continue
+            last = dotted.rsplit(".", 1)[-1]
+            if last in _RECV_CALLS:
+                fn = _innermost(funcs, node)
+                if fn is None or not _has_deadline(fn):
+                    where = f"function '{fn.name}'" if fn is not None \
+                        else "module scope"
+                    findings.append(Finding(
+                        "CCT1101", src.rel, node.lineno,
+                        f"{dotted}() in {where} with no enclosing deadline "
+                        "(no settimeout in the same function) — a silent "
+                        "or half-framing peer holds this thread forever; "
+                        "bound it or waive with allow-wire(reason)",
+                        "wire"))
+            elif last == "connect":
+                fn = _innermost(funcs, node)
+                if fn is None or not _has_deadline(fn):
+                    where = f"function '{fn.name}'" if fn is not None \
+                        else "module scope"
+                    findings.append(Finding(
+                        "CCT1102", src.rel, node.lineno,
+                        f"{dotted}() in {where} with no enclosing deadline "
+                        "(no settimeout in the same function) — a "
+                        "blackholed address hangs the dial forever; bound "
+                        "it or waive with allow-wire(reason)", "wire"))
+    return findings

@@ -32,6 +32,29 @@ drives it through a seeded random schedule of faults:
                       brownout and serve again once appends succeed
   status_sweep        poll a sample of acknowledged jobs by key
 
+With ``--netchaos`` the whole fleet is additionally spawned under the
+deterministic wire-fault layer (``utils/netchaos.py``): every process
+watches one spec file the conductor rewrites live, and four more events
+enter the schedule:
+
+  partition_worker        drop a worker off the network both ways (the
+                          process stays up); the routers must ride out
+                          the dark member and the ring must serve again
+                          once the link heals
+  asym_partition_routers  partition standby->active ONLY: the standby
+                          cannot see the active and must take over by
+                          epoch bump while the active is still alive —
+                          the fence protocol has to keep the zombie
+                          harmless (epochs monotone, no acked job lost)
+  flap_link               partition/heal the active-router->worker link
+                          3-5 times in quick succession (timeout/retry
+                          churn, no stable failure for health to latch)
+  corrupt_frames          flip a seeded byte in the next N frames from
+                          the conductor's client to each router; the crc
+                          envelope must catch every one (router
+                          ``wire_crc_errors`` grows), the client resends,
+                          and no corrupted frame is ever acted on
+
 After EVERY event the invariants are re-checked:
 
   * no acknowledged job is lost (every key still resolves, none failed);
@@ -143,7 +166,8 @@ def journal_tombstoned(path: str) -> bool:
 
 class Conductor:
     def __init__(self, workdir: str, seed: int, workers: int = 3,
-                 max_unique_jobs: int = 6, job_timeout_s: float = 600.0):
+                 max_unique_jobs: int = 6, job_timeout_s: float = 600.0,
+                 netchaos: bool = False):
         self.workdir = os.path.abspath(workdir)
         self.rng = random.Random(seed)
         self.seed = seed
@@ -184,6 +208,19 @@ class Conductor:
         self.next_worker_fault: str | None = None
         self.next_router_fault: str | None = None
         self.violations: list[str] = []
+        self.netchaos = bool(netchaos)
+        self.netchaos_spec = os.path.join(self.workdir, "netchaos.spec")
+        self.net_rules: list[str] = []
+        self.partitions_seen = 0
+        self.asym_partitions_seen = 0
+        self.flaps_seen = 0
+        self.wire_crc_seen = 0
+        if self.netchaos:
+            # the whole fleet — this process's clients included — watches
+            # one spec file; events partition/heal links by rewriting it
+            self._write_netchaos([])
+            os.environ["CCT_NETCHAOS"] = "@" + self.netchaos_spec
+            os.environ["CCT_NETCHAOS_NODE"] = "client"
         # both front doors; a standby's busy refusal makes this rotate
         self.client = ServeClient(
             [r["sock"] for r in self.routers.values()],
@@ -201,11 +238,30 @@ class Conductor:
         self.violations.append(msg)
         print(f"chaos: VIOLATION {msg}", file=sys.stderr, flush=True)
 
+    def _write_netchaos(self, rules: list) -> None:
+        """Atomically rewrite the fleet-wide netchaos spec (the @file the
+        whole fleet re-reads per connection).  An empty list heals every
+        link."""
+        self.net_rules = list(rules)
+        text = ";".join([f"seed={self.seed}"] + self.net_rules) + "\n"
+        tmp = self.netchaos_spec + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            fh.write(text)
+        os.replace(tmp, self.netchaos_spec)
+        if self.netchaos:
+            self._log("netchaos: "
+                      + ("; ".join(self.net_rules) or "all links healed"))
+
     def _popen(self, tag: str, argv: list, fault: str | None) -> subprocess.Popen:
         env = dict(os.environ)
         env.pop("CCT_FAULTS", None)
+        env.pop("CCT_NETCHAOS", None)
+        env.pop("CCT_NETCHAOS_NODE", None)
         env["CCT_TRACE"] = "1"
         env["CCT_TRACE_DIR"] = self.trace_dir
+        if self.netchaos:
+            env["CCT_NETCHAOS"] = "@" + self.netchaos_spec
+            env["CCT_NETCHAOS_NODE"] = tag
         # one fleet-wide retry budget (workers gate dispatches, routers
         # gate resubmits) so the poison event converges to quarantine
         env["CCT_SERVE_MAX_FLEET_ATTEMPTS"] = str(FLEET_ATTEMPT_BUDGET)
@@ -480,6 +536,13 @@ class Conductor:
             return
         name = added[0]
         w = self.workers[name]
+        # decommission's adopt step resubmits the member's jobs to ring
+        # successors — there must BE one, and the fleet must keep at
+        # least one live member for the rest of the schedule's submits
+        if not [n for n in self._live_workers() if n != name]:
+            self._log(f"decommission skipped ({name} is the last live "
+                      "member; nobody could adopt its jobs)")
+            return
         if w["alive"]:
             w["alive"] = False
             self._kill9(w["proc"], f"member {name} (decommission)")
@@ -606,6 +669,123 @@ class Conductor:
                   f"brownout, then accepted key {sub['key']} — disk "
                   "recovered, daemon never died")
 
+    # ------------------------------------------------------ netchaos events
+
+    def _router_wire_crc_errors(self) -> int:
+        """Sum of ``wire_crc_errors`` over every reachable live router."""
+        total = 0
+        for rid, r in self.routers.items():
+            if not r["alive"]:
+                continue
+            try:
+                m = ServeClient(r["sock"], retries=2,
+                                retry_base_s=0.1).metrics()["cumulative"]
+            except Exception:
+                continue
+            total += int(m.get("wire_crc_errors", 0))
+        return total
+
+    def ev_partition_worker(self) -> None:
+        live = self._live_workers()
+        if len(live) < 2:
+            self._log("partition_worker skipped (too few workers alive)")
+            return
+        name = self.rng.choice(live)
+        self._write_netchaos([f"*<->{name}=partition"])
+        # the fleet must keep answering while the member is dark; kept
+        # short of the adoption timer — a partitioned worker is NOT dead,
+        # and this event is about riding out the outage, not adopting
+        try:
+            self.check_client.request({"op": "healthz"}, timeout=30.0)
+        except Exception as e:
+            self._violate(f"fleet unhealthy while {name} partitioned: {e}")
+        time.sleep(1.2)
+        self._write_netchaos([])
+        self.partitions_seen += 1
+        self.ev_submit()  # the healed ring must place and ack again
+        self._log(f"worker {name} partitioned both ways and healed; "
+                  "fleet answered throughout")
+
+    def ev_asym_partition_routers(self) -> None:
+        doc = read_ring_view(self.ring_view)
+        if not doc:
+            self._violate("no ring view document at asym_partition_routers")
+            return
+        active = str(doc.get("router"))
+        standby = "r1" if active == "r0" else "r0"
+        if not (self.routers.get(active, {}).get("alive")
+                and self.routers.get(standby, {}).get("alive")):
+            self._log("asym_partition_routers skipped (need both routers "
+                      "alive)")
+            return
+        old_epoch = int(doc["epoch"])
+        # standby cannot see the active; the active (and the file-based
+        # ring view) are otherwise untouched — the classic split-brain
+        # trigger where the "dead" node is alive the whole time
+        self._write_netchaos([f"{standby}->{active}=partition"])
+        deadline = time.monotonic() + 60.0
+        took = False
+        while time.monotonic() < deadline:
+            doc = read_ring_view(self.ring_view)
+            if doc and doc.get("router") == standby \
+                    and int(doc["epoch"]) > old_epoch:
+                took = True
+                break
+            time.sleep(0.25)
+        self._write_netchaos([])
+        if not took:
+            self._violate(f"standby {standby} did not take over within 60s "
+                          f"of its asymmetric partition from {active}")
+            return
+        self.takeovers_seen += 1
+        self.asym_partitions_seen += 1
+        self._log(f"asym partition: {standby} took over at epoch "
+                  f"{doc['epoch']} while {active} stayed alive (zombie "
+                  "must now be fenced)")
+        self.ev_submit()  # the pair must still ack with a zombie around
+
+    def ev_flap_link(self) -> None:
+        doc = read_ring_view(self.ring_view)
+        rid = str(doc.get("router")) if doc else "r0"
+        if rid not in self.routers or not self.routers[rid]["alive"]:
+            self._log("flap_link skipped (no live active router)")
+            return
+        live = self._live_workers()
+        if not live:
+            self._log("flap_link skipped (no live worker)")
+            return
+        name = self.rng.choice(live)
+        cycles = self.rng.randint(3, 5)
+        for _ in range(cycles):
+            self._write_netchaos([f"{rid}->{name}=partition"])
+            time.sleep(self.rng.uniform(0.15, 0.35))
+            self._write_netchaos([])
+            time.sleep(self.rng.uniform(0.1, 0.25))
+        self.flaps_seen += 1
+        self._log(f"link {rid}->{name} flapped {cycles}x and healed")
+        self.ev_status_sweep(sample=2)
+
+    def ev_corrupt_frames(self) -> None:
+        n = self.rng.randint(2, 5)
+        before = self._router_wire_crc_errors()
+        self._write_netchaos([f"client->r0=corrupt@{n}",
+                              f"client->r1=corrupt@{n}"])
+        try:
+            # the corrupted submits must be caught by the crc envelope,
+            # answered retryable, and resent clean — never acted on
+            self.ev_submit()
+        finally:
+            self._write_netchaos([])
+        after = self._router_wire_crc_errors()
+        caught = after - before
+        if caught > 0:
+            self.wire_crc_seen += caught
+            self._log(f"corrupt_frames: {caught} corrupted frame(s) caught "
+                      f"by the wire crc (cumulative {after})")
+        else:
+            self._log("corrupt_frames: no crc catch observed this round "
+                      "(frames may have fallen on a dead connection)")
+
     # --------------------------------------------------------- invariants
 
     def _journal_paths(self) -> list:
@@ -693,6 +873,15 @@ class Conductor:
                   (0.65, "disk_full"),
                   (0.75, "decommission_member"),
                   (0.85, "zombie_return")]
+        if self.netchaos:
+            # wire faults ride the same schedule: the worker partition
+            # early (full fleet), the router-pair split after the pair is
+            # whole again, frame corruption and flapping in between
+            forced += [(0.10, "partition_worker"),
+                       (0.25, "corrupt_frames"),
+                       (0.50, "asym_partition_routers"),
+                       (0.70, "flap_link"),
+                       (0.90, "corrupt_frames")]
         for frac, name in forced:
             idx = int(frac * len(sched)) + self.rng.randint(-1, 1)
             sched.insert(max(0, min(len(sched), idx)), name)
@@ -719,6 +908,10 @@ class Conductor:
             "arm_fault": self.ev_arm_fault,
             "poison_submit": self.ev_poison_submit,
             "disk_full": self.ev_disk_full,
+            "partition_worker": self.ev_partition_worker,
+            "asym_partition_routers": self.ev_asym_partition_routers,
+            "flap_link": self.ev_flap_link,
+            "corrupt_frames": self.ev_corrupt_frames,
         }
         try:
             for i, name in enumerate(schedule):
@@ -768,6 +961,8 @@ class Conductor:
 
     def finish(self) -> int:
         self._log("schedule complete; draining every acknowledged job")
+        if self.netchaos:
+            self._write_netchaos([])  # every link healed before the drain
         self._reap_poison_victims()
         # revive every transiently-dead worker so its journal drains
         for name, w in self.workers.items():
@@ -812,6 +1007,17 @@ class Conductor:
         if self.brownouts_seen < 1:
             self._violate("schedule finished without an ENOSPC brownout "
                           "recovery")
+        if self.netchaos:
+            if self.partitions_seen < 1:
+                self._violate("netchaos schedule finished without a worker "
+                              "partition")
+            if self.asym_partitions_seen < 1:
+                self._violate("netchaos schedule finished without an "
+                              "asymmetric router-pair partition takeover")
+            if self.wire_crc_seen < 1:
+                self._violate("netchaos schedule finished without a single "
+                              "wire_crc_errors catch — the corrupt frames "
+                              "were never seen by the crc envelope")
         self.trace_summary = self.check_trace("finish")
         if self.trace_summary["spans"] <= 0:
             self._violate("no trace spans survived the schedule (fleet "
@@ -830,6 +1036,12 @@ class Conductor:
                   f"{tr.get('spans', 0)} trace "
                   f"span(s) in {tr.get('traces', 0)} trace(s), "
                   f"{tr.get('orphans', 0)} orphan(s)")
+        if self.netchaos:
+            self._log(f"netchaos summary: {self.partitions_seen} worker "
+                      f"partition(s), {self.asym_partitions_seen} "
+                      f"asymmetric router split(s), {self.flaps_seen} "
+                      f"link flap(s), {self.wire_crc_seen} corrupted "
+                      "frame(s) caught by the wire crc")
         if self.violations:
             for v in self.violations:
                 print(f"chaos: FAIL {v}", file=sys.stderr, flush=True)
@@ -876,6 +1088,10 @@ def main(argv=None) -> int:
     ap.add_argument("--smoke", action="store_true",
                     help="fixed-seed short leg for CI: 8 random events, "
                          "3 unique jobs, seed 7 unless --seed is given")
+    ap.add_argument("--netchaos", action="store_true",
+                    help="run the fleet under the deterministic wire-fault "
+                         "layer and add the partition/corruption events "
+                         "to the schedule")
     args = ap.parse_args(argv)
     events, jobs, seed = args.events, args.jobs, args.seed
     if args.smoke:
@@ -883,7 +1099,8 @@ def main(argv=None) -> int:
         if seed == 0:
             seed = 7
     conductor = Conductor(args.workdir, seed, workers=args.workers,
-                          max_unique_jobs=jobs, job_timeout_s=args.timeout)
+                          max_unique_jobs=jobs, job_timeout_s=args.timeout,
+                          netchaos=args.netchaos)
     return conductor.run(events)
 
 

@@ -39,6 +39,24 @@ PYTHONPATH="$REPO" python -m tools.cctlint \
   tests/fixtures/cctlint/effects/clean_effects.py
 echo "ci_check: effects gate OK (repo clean, seeded fixture caught, twin silent)"
 
+echo "== cctlint wire deadline gate (CCT11xx) + fixture positive controls =="
+# every socket recv/accept/connect in serve/ must sit under an enclosing
+# deadline (or carry an explicit allow-wire waiver) — the discipline the
+# slowloris/half-open reaper depends on.  Same twin-fixture contract as
+# the effects gate: the seeded-violation file MUST fail, the clean twin
+# MUST stay silent.
+PYTHONPATH="$REPO" python -m tools.cctlint consensuscruncher_tpu tools \
+  --select CCT11
+if PYTHONPATH="$REPO" python -m tools.cctlint \
+    tests/fixtures/cctlint/serve/viol_wire.py \
+    --select CCT11 > /dev/null 2>&1; then
+  echo "ci_check: wire pass FAILED to catch the seeded-violation fixture" >&2
+  exit 1
+fi
+PYTHONPATH="$REPO" python -m tools.cctlint \
+  tests/fixtures/cctlint/serve/clean_wire.py
+echo "ci_check: wire gate OK (repo clean, seeded fixture caught, twin silent)"
+
 echo "== compiled-graph contract gate (jaxpr pins + seeded-mutation control) =="
 # every kernel x policy x wire entry must re-trace to its committed
 # digest in tools/jaxpr_contracts.json, the majority==reference and
@@ -71,6 +89,18 @@ JAX_PLATFORMS=cpu PYTHONPATH="$REPO" python tools/model_check.py \
   --scenario poison_quarantine --budget 1000
 JAX_PLATFORMS=cpu PYTHONPATH="$REPO" python tools/model_check.py \
   --poison-control --budget 40
+
+echo "== interleaving model check (partition takeover, full budget) =="
+# the split-brain invariants get the full 1000-schedule budget
+# (exit-enforced): a partitioned-away active router's submit is never
+# acked after the standby's takeover fence committed, fencing rejections
+# cite an epoch above the zombie's, the floor never regresses — plus the
+# fencing-off positive control, which MUST be caught (a checker that
+# can't see the zombie ack proves nothing)
+JAX_PLATFORMS=cpu PYTHONPATH="$REPO" python tools/model_check.py \
+  --scenario partition_takeover --budget 1000
+JAX_PLATFORMS=cpu PYTHONPATH="$REPO" python tools/model_check.py \
+  --partition-control --budget 60
 
 echo "== tier-1 test suite =="
 # (test_two_process_global_mesh_psum self-skips with a reason when this
@@ -786,7 +816,77 @@ finally:
         sys.stderr.write(open(os.path.join(WORK, "serve.log")).read()[-8000:])
 PY
 
+echo "== slowloris positive control (deadlines OFF must wedge; ON must reap) =="
+# the read/idle deadline reaper, proven from the attacker's side: two
+# half-frame-then-stall peers fill BOTH conn slots of a 2-slot daemon.
+# With deadlines armed the reaper frees the slots and a legit request
+# gets answered; with CCT_SERVE_*_TIMEOUT_S=0 (the legacy unbounded
+# behavior) the same attack wedges the daemon and the probe must FAIL —
+# exit-enforced, because a control that can't reproduce the wedge
+# proves the deadlines do nothing.
+JAX_PLATFORMS=cpu PYTHONPATH="$REPO" python - <<'PY'
+import json, socket, time
+from consensuscruncher_tpu.serve.scheduler import Scheduler
+from consensuscruncher_tpu.serve.server import ServeServer
+
+def attack(read_s, idle_s):
+    """True when a legit healthz gets through while 2 slowloris peers
+    hold half-frames on every conn slot."""
+    sched = Scheduler(queue_bound=8, gang_size=4, backend="tpu",
+                      paused=True, start=False)
+    server = ServeServer(sched, port=0, max_conns=2,
+                         read_timeout_s=read_s, idle_timeout_s=idle_s)
+    server.start()
+    addr = tuple(server.address)
+    loris = []
+    try:
+        for _ in range(2):
+            s = socket.create_connection(addr, timeout=10)
+            s.sendall(b'{"op": "healthz"')  # half a frame, then stall
+            loris.append(s)
+        deadline = time.monotonic() + 8.0
+        while time.monotonic() < deadline:
+            probe = socket.create_connection(addr, timeout=10)
+            probe.settimeout(3.0)
+            try:
+                probe.sendall(b'{"op": "healthz"}\n')
+                buf = b""
+                while b"\n" not in buf:
+                    chunk = probe.recv(65536)
+                    if not chunk:
+                        break
+                    buf += chunk
+                if buf and json.loads(buf).get("ok") is True:
+                    return True
+            except (OSError, ValueError):
+                pass
+            finally:
+                probe.close()
+            time.sleep(0.5)
+        return False
+    finally:
+        for s in loris:
+            s.close()
+        server.close()
+
+assert attack(0.5, 0.5), "deadlines ON: the reaper never freed a slot"
+assert not attack(0, 0), ("deadlines OFF survived the slowloris — the "
+                          "positive control proves nothing")
+print("ci_check: slowloris control OK (deadlines reap the attack; "
+      "disabling them reproduces the wedge)")
+PY
+
 echo "== chaos conductor smoke (fixed-seed randomized fault schedule, incl. poison + disk-full) =="
 python tools/chaos_conductor.py --workdir "$WORK/chaos" --smoke
+
+echo "== chaos conductor netchaos smoke (seeded wire faults: partitions, asymmetric router split, corrupted frames) =="
+# the same conductor under the deterministic wire-fault layer: a
+# 2-worker fleet survives a both-ways worker partition, an asymmetric
+# standby->active split (fenced takeover), link flaps and seeded frame
+# corruption.  Its finish() invariants are exit-enforced: no acked job
+# lost, goldens byte-identical, epochs monotone, wire_crc_errors > 0
+# (the corrupted frames were CAUGHT, not absorbed by luck).
+python tools/chaos_conductor.py --workdir "$WORK/netchaos" --netchaos \
+  --smoke --workers 2
 
 echo "ci_check: OK"

@@ -1,7 +1,7 @@
 """Deterministic interleaving model check of the serve plane's protocol.
 
 Where ``chaos_conductor.py`` *samples* fault schedules against a live
-fleet, this tool *enumerates* thread interleavings of five small scripted
+fleet, this tool *enumerates* thread interleavings of six small scripted
 scenarios built from the real serve primitives (Journal, replay,
 Scheduler admission/fencing) under ``utils/interleave.py``'s cooperative
 scheduler, and asserts the invariants declared in
@@ -25,21 +25,31 @@ scheduler, and asserts the invariants declared in
                      retry budget, nothing dispatches after the
                      quarantined marker, and replay of a quarantined
                      journal never requeues the key
+  partition_takeover a network partition splits the HA pair: the standby
+                     takes the worker over (fence epoch 2) while the
+                     zombie active router keeps dispatching with epoch 1:
+                     no zombie submit is ever acked after the takeover
+                     fence committed, and every zombie rejection names
+                     the strictly higher live epoch
 
-Two positive-control legs REQUIRE the checker to find seeded bugs —
+Three positive-control legs REQUIRE the checker to find seeded bugs —
 proof the harness can catch the bug classes it exists for.
 ``--demo-bug`` runs the fence race against a deliberately seeded
 check-then-act fence (the pre-fix shape: read the floor in one lock
 region, write it in another) and must find the epoch regression;
 ``--poison-control`` runs the poison race with fleet budgets DISABLED
-(``max_fleet_attempts = 0``) and must find the runaway dispatches.
-``tests/test_model_check.py`` replays the discovered bad schedule.
+(``max_fleet_attempts = 0``) and must find the runaway dispatches;
+``--partition-control`` runs the partition race with the per-forward
+fence guard REMOVED (the router trusts the ownership check it did at
+session start, across the partition) and must find the zombie ack.
+``tests/test_model_check.py`` replays the discovered bad schedules.
 
   python tools/model_check.py                  # full run (>= 500 schedules)
   python tools/model_check.py --smoke          # bounded CI leg, fixed seed
   python tools/model_check.py --scenario fence_race --budget 200
   python tools/model_check.py --demo-bug       # exit 0 iff the bug is caught
   python tools/model_check.py --poison-control # exit 0 iff budgets-off is caught
+  python tools/model_check.py --partition-control  # exit 0 iff zombie ack caught
 
 Exit 0: every explored schedule of every scenario held every invariant
 (and, when the demo leg runs, the seeded bug was caught).
@@ -517,12 +527,109 @@ build_poison_quarantine = _poison_scenario(budget=2)
 build_poison_quarantine_budget_off = _poison_scenario(budget=0)
 
 
+def _partition_scenario(guarded: bool):
+    """Shared shape of the correct and seeded-buggy partition takeovers:
+    a partition splits the HA pair, the standby (r1) fences the worker
+    to epoch 2 and resubmits while the zombie active router (r0, epoch
+    1) keeps dispatching across the partition.
+
+    ``guarded=True`` models the shipping router: every forward
+    re-asserts its epoch against the worker's fence immediately before
+    the submit (the per-request epoch stamp ``Router._forward`` sends,
+    checked atomically under the scheduler lock).  The seeded control
+    (``guarded=False``) models a router that fenced once at session
+    start and never again — dispatches ride a cached ownership check
+    across the partition, so a zombie ack after the takeover committed
+    is reachable and MUST be caught.
+
+    The split-brain witness is linearized at the fence: ``took_over`` is
+    read BEFORE the guard fence, and the standby sets it only AFTER its
+    takeover fence returned.  So ``took_over`` observed True at dispatch
+    time proves the floor was already 2, and a guarded forward would
+    have been rejected — any ack carrying that witness is a zombie ack."""
+
+    def build(runner):
+        tmp = _scratch()
+        path = os.path.join(tmp, "journal.ndjson")
+        sched = Scheduler(start=False, journal=path, queue_bound=8,
+                          result_ttl_s=600.0, result_max=8)
+        state = {"took_over": False}
+        events: list[tuple] = []
+
+        def zombie_active():
+            # session handshake: r0 owned the worker before the partition
+            try:
+                sched.fence(1, router="r0")
+            except RouterFenced as e:
+                events.append(("r0-fenced", e.epoch))
+                return
+            for n in (1, 2):  # two dispatch rounds across the partition
+                took = state["took_over"]  # the dispatch-time witness
+                try:
+                    if guarded:
+                        sched.fence(1, router="r0")  # per-forward stamp
+                    sched.submit_info({"input": f"r0-{n}.bam",
+                                       "output": "out",
+                                       "name": f"mc-part-r0-{n}"})
+                    events.append(("r0-acked", took))
+                except RouterFenced as e:
+                    events.append(("r0-fenced", e.epoch))
+                    return
+                except AdmissionRefused:
+                    events.append(("r0-refused",))
+
+        def standby_takeover():
+            try:
+                sched.fence(2, router="r1")
+            except RouterFenced as e:
+                events.append(("r1-fenced", e.epoch))
+                return
+            state["took_over"] = True
+            try:
+                sched.submit_info({"input": "r1.bam", "output": "out",
+                                   "name": "mc-part-r1"})
+                events.append(("r1-acked",))
+            except AdmissionRefused:
+                events.append(("r1-refused",))
+
+        runner.spawn("router-active", zombie_active)
+        runner.spawn("router-standby", standby_takeover)
+
+        def check():
+            _close(sched)
+            msgs = _journal_grammar_violations(path, "journal")
+            for ev in events:
+                if ev[0] == "r0-acked" and ev[1]:
+                    msgs.append(
+                        "split-brain: the zombie active router's submit "
+                        "was acked AFTER the standby's takeover fence "
+                        "committed (dispatch-time takeover witness set) — "
+                        "a fence-guarded forward would have been rejected")
+                elif ev[0] == "r0-fenced" and ev[1] <= 1:
+                    msgs.append(f"r0 fenced citing live epoch {ev[1]} <= "
+                                "its own 1")
+            if ("r1-acked",) in events and sched.fence_epoch < 2:
+                msgs.append("standby acked its takeover submit but the "
+                            f"fence floor is {sched.fence_epoch} < 2")
+            shutil.rmtree(tmp, ignore_errors=True)
+            return msgs
+
+        return check
+
+    return build
+
+
+build_partition_takeover = _partition_scenario(guarded=True)
+build_partition_takeover_unguarded = _partition_scenario(guarded=False)
+
+
 SCENARIOS = {
     "submit_kill": build_submit_kill,
     "fence_race": build_fence_race,
     "failover_resubmit": build_failover_resubmit,
     "adoption_zombie": build_adoption_zombie,
     "poison_quarantine": build_poison_quarantine,
+    "partition_takeover": build_partition_takeover,
 }
 
 
@@ -615,6 +722,30 @@ def run_poison_control(*, seed: int, budget: int,
     return False, None
 
 
+def run_partition_control(*, seed: int, budget: int,
+                          verbose: bool = False
+                          ) -> tuple[bool, list[int] | None]:
+    """Positive control: with the per-forward fence guard removed the
+    partitioned zombie router MUST produce an ack after the standby's
+    takeover fence committed.  Returns (caught, first violating
+    schedule)."""
+    ex = interleave.Explorer(build_partition_takeover_unguarded, seed=seed,
+                             max_schedules=budget)
+    res = _explore_quiet(ex, verbose)
+    if res["violations"]:
+        sched, msgs = res["violations"][0]
+        print(f"model_check: partition-control: CAUGHT in "
+              f"{res['schedules']} schedules; first bad schedule {sched}:",
+              flush=True)
+        for m in msgs[:5]:
+            print(f"    - {m}", flush=True)
+        return True, sched
+    print(f"model_check: partition-control: NOT caught in "
+          f"{res['schedules']} schedules — the unguarded zombie ran "
+          "clean; the checker lost its positive control", flush=True)
+    return False, None
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--scenario", choices=sorted(SCENARIOS),
@@ -630,6 +761,9 @@ def main(argv=None) -> int:
                     help="only run the seeded fence-bug positive control")
     ap.add_argument("--poison-control", action="store_true",
                     help="only run the budgets-off poison positive control")
+    ap.add_argument("--partition-control", action="store_true",
+                    help="only run the unguarded-zombie partition "
+                         "positive control")
     ap.add_argument("--replay", type=str, default=None,
                     help="JSON schedule to replay (with --scenario or "
                          "--demo-bug); prints the verdict for that one "
@@ -647,6 +781,8 @@ def main(argv=None) -> int:
         schedule = [int(x) for x in json.loads(args.replay)]
         build = (build_fence_race_seeded_bug if args.demo_bug
                  else build_poison_quarantine_budget_off if args.poison_control
+                 else build_partition_takeover_unguarded
+                 if args.partition_control
                  else SCENARIOS[args.scenario or "fence_race"])
         _runner, msgs = interleave.run_schedule(build, schedule)
         for m in msgs:
@@ -666,6 +802,12 @@ def main(argv=None) -> int:
                                             verbose=args.verbose)
         return 0 if caught else 1
 
+    if args.partition_control:
+        caught, _sched = run_partition_control(seed=args.seed,
+                                               budget=args.budget,
+                                               verbose=args.verbose)
+        return 0 if caught else 1
+
     names = [args.scenario] if args.scenario else sorted(SCENARIOS)
     doc = run_scenarios(names, seed=args.seed, budget=args.budget,
                         dpor=not args.no_dpor, verbose=args.verbose)
@@ -680,13 +822,19 @@ def main(argv=None) -> int:
             seed=args.seed, budget=min(args.budget, 40),
             verbose=args.verbose)
         doc["poison_control_caught"] = pcaught
+    partcaught = True
+    if args.scenario in (None, "partition_takeover"):
+        partcaught, _zsched = run_partition_control(
+            seed=args.seed, budget=args.budget, verbose=args.verbose)
+        doc["partition_control_caught"] = partcaught
     if args.json:
         print(json.dumps(doc, sort_keys=True), flush=True)
-    ok = doc["violations"] == 0 and caught and pcaught
+    ok = doc["violations"] == 0 and caught and pcaught and partcaught
     print(f"model_check: total {doc['schedules']} schedules, "
           f"{doc['violations']} violations, demo bug "
           f"{'caught' if caught else 'MISSED'}, poison control "
-          f"{'caught' if pcaught else 'MISSED'} -> "
+          f"{'caught' if pcaught else 'MISSED'}, partition control "
+          f"{'caught' if partcaught else 'MISSED'} -> "
           f"{'OK' if ok else 'FAIL'}", flush=True)
     return 0 if ok else 1
 
