@@ -80,6 +80,15 @@ _OBS_ENV = {
     "prof": "CCT_PROF",
     "prof_hz": "CCT_PROF_HZ",
     "prof_dir": "CCT_PROF_DIR",
+    "history_dir": "CCT_HISTORY_DIR",
+    "history_interval_s": "CCT_HISTORY_INTERVAL_S",
+    "history_max_bytes": "CCT_HISTORY_MAX_BYTES",
+    "lock_ledger": "CCT_LOCK_LEDGER",
+    "canary": "CCT_CANARY",
+    "canary_interval_s": "CCT_CANARY_INTERVAL_S",
+    "canary_latency_s": "CCT_CANARY_LATENCY_S",
+    "canary_golden": "CCT_CANARY_GOLDEN",
+    "canary_dir": "CCT_CANARY_DIR",
 }
 
 
@@ -1344,6 +1353,19 @@ def serve_cmd(args) -> None:
         socket_path=args.socket or None,
     )
     install_signal_handlers(server, scheduler, journal)
+    # env-armed observability sidecars: the durable telemetry-history
+    # recorder (CCT_HISTORY_DIR) and the golden canary prober
+    # (CCT_CANARY=1).  Neither touches pipeline outputs or RNG —
+    # goldens stay byte-identical with both running.
+    from consensuscruncher_tpu.obs import history as obs_history
+    from consensuscruncher_tpu.serve import canary as serve_canary
+
+    obs_history.maybe_start(scheduler.history_doc)
+    import tempfile
+
+    canary_dir = os.environ.get("CCT_CANARY_DIR") or os.path.join(
+        dump_dir or tempfile.gettempdir(), f"cct-canary-{os.getpid()}")
+    prober = serve_canary.maybe_start(scheduler, canary_dir)
     print(f"serve: listening on {server.describe()} "
           f"(queue_bound={scheduler.queue_bound}, "
           f"gang_size={scheduler.gang_size}"
@@ -1366,6 +1388,9 @@ def serve_cmd(args) -> None:
                  "unfinished jobs are LOST (no --journal)"),
               file=sys.stderr, flush=True)
     server.close()
+    if prober is not None:
+        prober.stop()
+    obs_history.stop()  # final interval stamp lands before shutdown
     scheduler.shutdown()
     # final learn pass: short-lived daemons (smoke runs, supervised
     # restarts) persist their observed bucket mix even when the periodic
@@ -1651,6 +1676,18 @@ def route_cmd(args) -> None:
         advertise = (host, int(port))
     router.start(advertise=advertise or server.address)
     install_signal_handlers(server, router, None)
+    # router-side telemetry history: same env-armed recorder the worker
+    # daemons run, stamping the router's own cumulative counters plus a
+    # fleet-up gauge per interval
+    from consensuscruncher_tpu.obs import history as obs_history
+
+    def _router_history_doc():
+        health = router.healthz()
+        return {"cum": router.counters.snapshot(),
+                "gauges": {"fleet_up":
+                           (health.get("fleet") or {}).get("up", 0)}}
+
+    obs_history.maybe_start(_router_history_doc)
     print(f"route: fleet front door on {server.describe()} over "
           f"{len(members)} members "
           f"({', '.join(name for name, _ in members)}); "
@@ -1689,6 +1726,7 @@ def route_cmd(args) -> None:
                       file=sys.stderr, flush=True)
                 child.kill()
     server.close()
+    obs_history.stop()  # final interval stamp lands before shutdown
     router.close()
     print("route: shutdown complete", flush=True)
 
@@ -1916,6 +1954,121 @@ def prof_cmd(args) -> None:
                       indent=1, sort_keys=True)
             fh.write("\n")
         print(f"prof: attribution -> {args.json}")
+
+
+def critpath_cmd(args) -> None:
+    """``critpath report``: decompose every finished job's wall into its
+    ordered causal segment chain (admit -> journal-ack -> queue ->
+    gang-form -> handoff -> run) from the fleet's ``serve.critpath``
+    trace events — live buffers through the router's ``trace`` wire op,
+    unioned with on-disk ``trace-*.ndjson`` shards — and render the
+    fleet-level "where does p99 queue time actually go" table plus the
+    queue-antagonist attribution (which lock / dispatcher-busy window /
+    admission idle made jobs wait).
+
+    ``critpath job KEY``: one job's chain (key or numeric id)."""
+    from consensuscruncher_tpu.obs import critpath as obs_critpath
+    from consensuscruncher_tpu.obs import trace as obs_trace
+
+    events: list[dict] = []
+    address = args.socket or (args.host, int(args.port))
+    try:
+        from consensuscruncher_tpu.serve.client import ServeClient
+
+        buffers = ServeClient(address).request(
+            {"op": "trace", "fleet": True}, timeout=60.0)["trace"]
+    except Exception as e:
+        print(f"WARNING: critpath: wire collection failed ({e}); "
+              "reading on-disk shards only", file=sys.stderr, flush=True)
+        buffers = []
+    if isinstance(buffers, dict):  # a lone daemon answered directly
+        buffers = [buffers]
+    for buf in buffers or []:
+        node = (buf or {}).get("node")
+        for ev in (buf or {}).get("events") or []:
+            if node and isinstance(ev, dict):
+                ev.setdefault("node", node)
+            events.append(ev)
+    trace_dir = args.trace_dir or os.environ.get("CCT_TRACE_DIR")
+    if trace_dir and os.path.isdir(trace_dir):
+        import glob as _glob
+        for shard in sorted(_glob.glob(
+                os.path.join(trace_dir, "trace-*.ndjson"))):
+            events.extend(obs_trace._read_shard(shard))
+    doc = obs_critpath.report_doc(events)
+    if not doc["jobs"]:
+        raise SystemExit(
+            "critpath: no serve.critpath events collected — is the "
+            "fleet up with CCT_TRACE=1 (or --dir pointing at its "
+            "CCT_TRACE_DIR shards)?")
+    if args.action == "job":
+        key = str(args.key or "")
+        if not key:
+            raise SystemExit("critpath job: pass the job KEY (or id)")
+        hits = [j for j in doc["jobs"]
+                if str(j.get("key")) == key or str(j.get("job_id")) == key]
+        if not hits:
+            raise SystemExit(
+                f"critpath: no finished job with key/id {key!r}")
+        for job in hits:
+            sys.stdout.write(obs_critpath.render_job(job))
+        return
+    if args.json:
+        payload = obs_critpath.to_json(doc)
+        if args.json == "-":
+            sys.stdout.write(payload)
+            return
+        with open(args.json, "w", encoding="utf-8") as fh:
+            fh.write(payload)
+        print(f"critpath: report doc -> {args.json}")
+    sys.stdout.write(obs_critpath.render_report(doc))
+
+
+def history_cmd(args) -> None:
+    """``history query``: merged durable telemetry-history lines (live
+    processes through the router's ``history`` wire op, unioned with
+    on-disk ``history-*.ndjson`` shards, deduped by (pid, seq)) printed
+    as NDJSON, optionally filtered by --metric/--node/--last.
+
+    ``history trend``: per-interval delta + rate table for one metric."""
+    from consensuscruncher_tpu.obs import history as obs_history
+
+    docs: list[dict] = []
+    address = args.socket or (args.host, int(args.port))
+    try:
+        from consensuscruncher_tpu.serve.client import ServeClient
+
+        reply = ServeClient(address).request(
+            {"op": "history", "fleet": True}, timeout=60.0)["history"]
+    except Exception as e:
+        print(f"WARNING: history: wire collection failed ({e}); "
+              "merging on-disk shards only", file=sys.stderr, flush=True)
+        reply = []
+    if isinstance(reply, dict):  # a lone daemon answered directly
+        reply = [reply]
+    docs.extend(d for d in reply or [] if isinstance(d, dict))
+    hist_dir = args.history_dir or os.environ.get("CCT_HISTORY_DIR")
+    if hist_dir and os.path.isdir(hist_dir):
+        docs.append({"lines": obs_history.read_dir(hist_dir)})
+    lines = obs_history.merge_history(docs)
+    if not lines:
+        raise SystemExit(
+            "history: nothing collected — is the fleet up with "
+            "CCT_HISTORY_DIR set (or --dir pointing at its "
+            "history-*.ndjson shards)?")
+    metric = getattr(args, "metric", "") or None
+    if args.action == "trend":
+        if not metric:
+            raise SystemExit("history trend: pass --metric NAME")
+        sys.stdout.write(obs_history.render_trend(
+            obs_history.trend(lines, metric), metric))
+        return
+    last = getattr(args, "last", None)
+    out = obs_history.query(
+        lines, metric=metric, node=getattr(args, "node", "") or None,
+        last=int(last) if last not in (None, "") else None)
+    for ln in out:
+        sys.stdout.write(json.dumps(ln, sort_keys=True) + "\n")
 
 
 # ------------------------------------------------------------------- argparse
@@ -2348,6 +2501,59 @@ def build_parser() -> argparse.ArgumentParser:
     pr.set_defaults(func=prof_cmd, config_section="obs", required_args=(),
                     builtin_defaults={"prof_dir": "", "out": "",
                                       "json": "", "top": 15,
+                                      "socket": "", "host": "127.0.0.1",
+                                      "port": 7733})
+
+    cp = sub.add_parser(
+        "critpath", help="per-job dispatch critical-path decomposition")
+    cp.add_argument("action", choices=("report", "job"),
+                    help="report: fleet-level segment table (where p99 "
+                         "queue time goes) + queue-antagonist "
+                         "attribution; job: one job's ordered causal "
+                         "segment chain by KEY/id")
+    cp.add_argument("key", nargs="?",
+                    help="job key or numeric id (job action)")
+    cp.add_argument("-c", "--config", default=None)
+    cp.add_argument("--dir", dest="trace_dir",
+                    help="trace shard directory (default $CCT_TRACE_DIR)")
+    cp.add_argument("--json",
+                    help="write the full report doc as JSON here "
+                         "('-' prints to stdout instead of the table)")
+    cp.add_argument("--socket", help="router/daemon unix socket")
+    cp.add_argument("--host", help="router TCP host (default 127.0.0.1)")
+    cp.add_argument("--port", type=int,
+                    help="router TCP port (default 7733)")
+    cp.set_defaults(func=critpath_cmd, config_section="obs",
+                    required_args=(),
+                    builtin_defaults={"key": "", "trace_dir": "",
+                                      "json": "", "socket": "",
+                                      "host": "127.0.0.1", "port": 7733})
+
+    hp = sub.add_parser(
+        "history", help="query durable telemetry-history shards")
+    hp.add_argument("action", choices=("query", "trend"),
+                    help="query: merged history lines as NDJSON "
+                         "(--metric/--node/--last filters); trend: "
+                         "per-interval delta + rate table for one "
+                         "metric")
+    hp.add_argument("-c", "--config", default=None)
+    hp.add_argument("--dir", dest="history_dir",
+                    help="history shard directory "
+                         "(default $CCT_HISTORY_DIR)")
+    hp.add_argument("--metric",
+                    help="counter/gauge name to project (required for "
+                         "trend)")
+    hp.add_argument("--node", help="filter to one node's lines (query)")
+    hp.add_argument("--last", type=int,
+                    help="keep only the most recent N lines (query)")
+    hp.add_argument("--socket", help="router/daemon unix socket")
+    hp.add_argument("--host", help="router TCP host (default 127.0.0.1)")
+    hp.add_argument("--port", type=int,
+                    help="router TCP port (default 7733)")
+    hp.set_defaults(func=history_cmd, config_section="obs",
+                    required_args=(),
+                    builtin_defaults={"history_dir": "", "metric": "",
+                                      "node": "", "last": "",
                                       "socket": "", "host": "127.0.0.1",
                                       "port": 7733})
 

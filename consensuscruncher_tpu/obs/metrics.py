@@ -28,6 +28,7 @@ from bisect import bisect_left
 
 from consensuscruncher_tpu.obs.registry import (
     COUNTERS,
+    GAUGES,
     HISTOGRAMS,
     LABELED_COUNTERS,
     LABELED_HISTOGRAMS,
@@ -297,6 +298,27 @@ def _escape_label_value(v) -> str:
     )
 
 
+def _canary_lines(canary: dict, labels: dict | None = None) -> list[str]:
+    """The ``cct_canary_ok`` / ``cct_canary_age_s`` gauge lines from a
+    metrics doc's ``canary`` status (absent when no prober runs);
+    ``labels`` adds a node label for the fleet exposition."""
+    if not isinstance(canary, dict) or "ok" not in canary:
+        return []
+    suffix = _label_str(labels) if labels else ""
+    lines = []
+    if not labels:
+        lines.append(f"# HELP cct_canary_ok {GAUGES['canary_ok']}")
+    lines.append("# TYPE cct_canary_ok gauge")
+    lines.append(f"cct_canary_ok{suffix} {1 if canary['ok'] else 0}")
+    age = canary.get("age_s")
+    if age is not None:
+        if not labels:
+            lines.append(f"# HELP cct_canary_age_s {GAUGES['canary_age_s']}")
+        lines.append("# TYPE cct_canary_age_s gauge")
+        lines.append(f"cct_canary_age_s{suffix} {_fmt(float(age))}")
+    return lines
+
+
 def _label_str(labels: dict) -> str:
     inner = ",".join(
         f'{k}="{_escape_label_value(v)}"' for k, v in sorted(labels.items())
@@ -339,6 +361,8 @@ def render_prometheus(doc: dict) -> str:
     if "size_bytes" in journal:
         lines.append("# TYPE cct_journal_size_bytes gauge")
         lines.append(f"cct_journal_size_bytes {_fmt(journal['size_bytes'])}")
+
+    lines.extend(_canary_lines(doc.get("canary") or {}))
 
     for name in sorted(doc.get("histograms") or {}):
         h = doc["histograms"][name]
@@ -519,6 +543,10 @@ def render_fleet_prometheus(doc: dict) -> str:
                 f"{_fmt(float(h['sum']))}")
             lines.append(
                 f"{metric}_count{_label_str({'node': node})} {h['count']}")
+        # node-labeled canary gauges: one scrape answers "is every
+        # member still producing byte-correct answers"
+        lines.extend(_canary_lines(ndoc.get("canary") or {},
+                                   labels={"node": node}))
         # node-labeled SLO gauges: per-class latency percentiles and
         # error-budget burn rates fleet-wide in one scrape (``cct top``
         # reads these for its per-qos panel)

@@ -177,6 +177,31 @@ COUNTERS = {
                               "because a payload file failed its stored "
                               "sha256 (the corrupt entry dir is "
                               "quarantined, never served)",
+    "history_snapshots": "labeled-snapshot delta lines appended to "
+                         "history-<pid>.ndjson shards under "
+                         "CCT_HISTORY_DIR (one per recorder interval "
+                         "with pending deltas)",
+    "history_bytes": "bytes appended to this process's history shard "
+                     "(the quantity the retention budget meters)",
+    "history_evictions": "whole history shards unlinked by the "
+                         "CCT_HISTORY_MAX_BYTES retention budget "
+                         "(oldest shard first, never the live one)",
+    "canary_runs": "synthetic golden canary probes submitted by the "
+                   "serve-side prober (scavenger qos, excluded from "
+                   "tenant quotas and QC series)",
+    "canary_pass": "canary probes whose outputs matched the pinned "
+                   "golden digests byte-for-byte within the latency "
+                   "bound",
+    "canary_fail": "canary probes that failed: digest mismatch, "
+                   "latency-bound breach, or a probe error — each flips "
+                   "cct_canary_ok to 0 and dumps the flight ring",
+    "dispatcher_busy_us": "microseconds the serve dispatcher thread "
+                          "spent running gangs (the denominator's busy "
+                          "half of the critpath dispatcher-idle ratio)",
+    "dispatcher_idle_us": "microseconds the serve dispatcher thread "
+                          "spent parked in cond.wait with no runnable "
+                          "work (admission idle, from critpath's "
+                          "antagonist view)",
 }
 
 CUMULATIVE_KEYS = tuple(COUNTERS)
@@ -224,6 +249,10 @@ LABELS = {
     "qos": {"closed": True, "values": QOS_CLASSES},
     "node": {"closed": False, "values": None},
     "policy": {"closed": True, "values": POLICY_NAMES},
+    # lock names come from utils.sanitize's tracked_lock/tracked_condition
+    # call sites — open-valued like node, but bounded by the handful of
+    # named locks the codebase declares (each is a source literal)
+    "lock": {"closed": False, "values": None},
 }
 
 # Labeled counters are a separate namespace from COUNTERS: the global
@@ -307,6 +336,24 @@ LABELED_COUNTERS = {
         "help": "single-strand consensus reads emitted per tenant/class "
                 "and consensus vote policy",
     },
+    # lock-contention ledger (critpath): per-named-lock wait/hold totals
+    # from the TrackedLock/TrackedCondition timing in utils.sanitize,
+    # composed into the metrics doc at read time (CCT_LOCK_LEDGER=1)
+    "lock_wait_us": {
+        "labels": ("lock",),
+        "help": "microseconds threads spent blocked acquiring each "
+                "named lock (contended acquires only pay the clock)",
+    },
+    "lock_hold_us": {
+        "labels": ("lock",),
+        "help": "microseconds each named lock was held between acquire "
+                "and release (condition waits excluded from the hold)",
+    },
+    "lock_waits": {
+        "labels": ("lock",),
+        "help": "contended acquires per named lock (the fast-path "
+                "uncontended acquire never counts here)",
+    },
 }
 
 # Labeled histograms: per-(tenant, qos) series sharing the global
@@ -349,6 +396,19 @@ QC_SERIES = (
     "tenant_qc_policy_jobs",
     "tenant_qc_policy_sscs_written",
 )
+
+# Gauges: point-in-time values the metrics endpoint exposes outside the
+# cumulative/histogram namespaces.  Declared here so the CCT606 obscov
+# pass can hold canary_*/history_*/lock_* emissions to one registry,
+# exactly like counters.  Pure literal (the lint loads this standalone).
+GAUGES = {
+    "canary_ok": "1 while the last golden canary probe passed (digest "
+                 "match + latency bound), 0 after a failure — the "
+                 "fleet's end-to-end correctness heartbeat",
+    "canary_age_s": "seconds since the last canary probe finished "
+                    "(staleness guard: a green gauge nobody refreshed "
+                    "is as alarming as a red one)",
+}
 
 # name -> {"buckets": upper bounds (le), "unit": ..., "help": ...}.
 # ``obs.metrics`` zero-fills all of these in ``histograms_snapshot`` so

@@ -284,6 +284,40 @@ def render_frame(series: dict, source: str,
                                for label, metric in qc_cols)
             + f"  disagree={disagree}")
 
+    # crit panel: dispatch critical-path health — the top contended
+    # lock from the CCT_LOCK_LEDGER series, the dispatcher's idle share,
+    # and the golden canary verdict.  Pre-critpath daemons never export
+    # any of these series, so the whole row dash-degrades like net:/qc:.
+    crit_metrics = ("cct_lock_wait_us_total", "cct_dispatcher_idle_us_total",
+                    "cct_dispatcher_busy_us_total", "cct_canary_ok",
+                    "cct_canary_runs_total")
+    if any(metric in series for metric in crit_metrics):
+        by_lock = _by_label(series, "cct_lock_wait_us_total", "lock")
+        if by_lock:
+            name, waited = max(by_lock.items(), key=lambda kv: kv[1])
+            top_lock = f"{name} ({waited / 1e3:.1f}ms waited)"
+        else:
+            top_lock = "-"
+        idle = _opt("cct_dispatcher_idle_us_total")
+        busy = _opt("cct_dispatcher_busy_us_total")
+        if idle is not None and (idle + (busy or 0.0)) > 0:
+            idle_pct = f"{100.0 * idle / (idle + (busy or 0.0)):.1f}%"
+        else:
+            idle_pct = "-"
+        if "cct_canary_ok" in series:
+            ok_vals = [v for _labels, v in series["cct_canary_ok"]]
+            canary = "OK" if all(ok_vals) else "FAIL"
+            ages = [v for _labels, v in series.get("cct_canary_age_s", [])]
+            if ages:
+                canary += f" ({max(ages):.0f}s ago)"
+        else:
+            canary = "-"
+        lines.append(
+            f"crit: lock={top_lock}  disp_idle={idle_pct}  "
+            f"canary={canary}  "
+            f"probes={_fmt_n(_opt('cct_canary_runs_total'))}"
+            f"/fail={_fmt_n(_opt('cct_canary_fail_total'))}")
+
     # per-policy qc breakdown (ISSUE 17): jobs + consensus yield by
     # consensus vote policy.  Pre-policy daemons never export these
     # series so the whole panel degrades to absence; a policy column

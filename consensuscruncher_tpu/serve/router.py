@@ -103,6 +103,7 @@ from bisect import bisect_right
 from collections import OrderedDict
 
 from consensuscruncher_tpu.obs import flight as obs_flight
+from consensuscruncher_tpu.obs import history as obs_history
 from consensuscruncher_tpu.obs import metrics as obs_metrics
 from consensuscruncher_tpu.obs import prof as obs_prof
 from consensuscruncher_tpu.obs import trace as obs_trace
@@ -1743,6 +1744,7 @@ class Router:
         # and profiler tallies (samples / drops / shards)
         cumulative.update(obs_trace.counter_snapshot())
         cumulative.update(obs_prof.counter_snapshot())
+        cumulative.update(obs_history.counter_snapshot())
         return {
             "stage": "route",
             "phases_s": {"uptime": time.time() - self._started_at},
@@ -1791,6 +1793,26 @@ class Router:
             except Exception:
                 continue
             doc = reply.get("prof")
+            if isinstance(doc, dict):
+                docs.append(doc)
+        return docs
+
+    def history_fleet(self) -> list[dict]:
+        """Every process's telemetry history, for ``cct history``: the
+        router's own shard lines plus each up member's ``history`` op
+        reply.  Down members' flushed ``history-*.ndjson`` shards stay
+        collectable from ``CCT_HISTORY_DIR`` — same discipline as
+        trace/prof; collection never fails routing."""
+        docs: list[dict] = [obs_history.collect(node=self.router_id)]
+        for member in self.members():
+            if not member.up:
+                continue
+            try:
+                reply = member.client.request({"op": "history"},
+                                              timeout=15.0)
+            except Exception:
+                continue
+            doc = reply.get("history")
             if isinstance(doc, dict):
                 docs.append(doc)
         return docs
@@ -1873,6 +1895,16 @@ class RouterServer(ServeServer):
                     return {"ok": True, "prof": self.router.prof_fleet()}
                 return {"ok": True,
                         "prof": obs_prof.collect(node=self.router.router_id)}
+            if op == "history":
+                # fleet history collection; unfenced for the same
+                # reason as trace/prof — "what changed over the last
+                # hour" outlives HA roles
+                if req.get("fleet"):
+                    return {"ok": True,
+                            "history": self.router.history_fleet()}
+                return {"ok": True,
+                        "history": obs_history.collect(
+                            node=self.router.router_id)}
             return {"ok": False, "error": f"unknown op {op!r}"}
         except ServeClientError as e:
             # a member refusal / ``ok: false`` travels back verbatim

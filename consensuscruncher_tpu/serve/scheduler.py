@@ -112,6 +112,7 @@ import time
 from collections import deque
 
 from consensuscruncher_tpu.obs import flight as obs_flight
+from consensuscruncher_tpu.obs import history as obs_history
 from consensuscruncher_tpu.obs import metrics as obs_metrics
 from consensuscruncher_tpu.obs import prof as obs_prof
 from consensuscruncher_tpu.obs import trace as obs_trace
@@ -169,6 +170,11 @@ class RouterFenced(RuntimeError):
 
 
 _STATES = ("queued", "running", "done", "failed", "quarantined")
+
+#: reserved tenant for the serve-side golden canary prober: excluded from
+#: per-tenant admission quotas and the tenant QC series so synthetic
+#: heartbeat probes can never distort real-tenant accounting
+CANARY_TENANT = "_canary"
 
 
 class Job:
@@ -239,6 +245,17 @@ class Job:
             self.spec_bytes = 0
         self.submitted_t = time.monotonic()
         self.finished_t: float | None = None
+        # critpath boundary stamps (absolute monotonic): admit / journal /
+        # ack / gang / dispatch / run.  Emitted as ms-from-submit offsets
+        # on the terminal ``serve.critpath`` event; obs/critpath.py owns
+        # the segment math, the scheduler only records evidence.
+        self.stamps: dict[str, float] = {}
+        # per-lock wait_us totals at admission (CCT_LOCK_LEDGER=1 only):
+        # the baseline the antagonist view deltas against at terminal
+        self._lock_wait0: dict[str, int] | None = None
+
+    def stamp(self, name: str) -> None:
+        self.stamps[name] = time.monotonic()
 
     def describe(self) -> dict:
         return {
@@ -248,7 +265,7 @@ class Job:
             "input": self.spec.get("input"), "key": self.key,
             "deadline_s": self.deadline_s, "trace_id": self.trace_id,
             "tenant": self.tenant, "qos": self.qos, "cached": self.cached,
-            "qc": self.qc,
+            "qc": self.qc, "queue_wait_s": self.queue_wait_s,
         }
 
 
@@ -603,6 +620,15 @@ class Scheduler:
         # optional callable set by serve_cmd: surfaces the bucket
         # autotuner's state (table size, unexpected recompiles) in /metrics
         self.autotune_info = None
+        # optional callable set by the canary prober: {"ok", "age_s", ...}
+        # surfaced in /metrics as the cct_canary_ok / cct_canary_age_s
+        # gauges (same read-time attachment idiom as autotune_info)
+        self.canary_info = None
+        # recent gang-run intervals ({"t0", "t1", "jobs"}) for the
+        # critpath antagonist view: "who was the dispatcher busy on while
+        # this job sat queued".  Bounded; appended outside the lock's hot
+        # path (once per gang)
+        self._gang_log: deque = deque(maxlen=64)
         self._cond = sanitize.tracked_condition("scheduler.cond")
         # one FIFO per qos class; stride state drives weighted-fair picks
         self._queues: dict[str, deque[Job]] = \
@@ -728,6 +754,9 @@ class Scheduler:
                 raise AdmissionRefused(
                     f"queue full ({queued}/{self.queue_bound})")
             job = Job(spec, key=key, deadline_s=deadline_s, trace_id=trace_id)
+            # admission checks all passed: everything before this stamp is
+            # the critpath "admit" segment
+            job.stamp("admit")
             # the ack span's own wire context: echoed on the reply and
             # journaled below, so every later continuation (failover
             # resubmit, adoption) can follows_from this durable anchor
@@ -772,6 +801,12 @@ class Scheduler:
                         print("serve: journal append succeeded again; "
                               "leaving brownout", file=sys.stderr, flush=True)
                 self.counters.add("journal_bytes", n)
+            # journal-ack fsync done (or no journal: zero-width segment)
+            job.stamp("journal")
+            if sanitize.ledger_enabled():
+                job._lock_wait0 = {
+                    name: row["wait_us"]
+                    for name, row in sanitize.ledger_snapshot().items()}
             self._enqueue_locked(job)
             self._jobs[job.id] = job
             self._by_key[key] = job.id
@@ -782,6 +817,7 @@ class Scheduler:
         # flush the ack span to the trace shard before acknowledging: an
         # acked job's submit span must survive a kill -9 exactly like its
         # journal record does (the trace-completeness invariant's anchor)
+        job.stamp("ack")
         obs_trace.flush()
         # schedule point at the ack boundary: everything durable happened
         # under the lock above; the caller's acknowledgement is next
@@ -813,6 +849,11 @@ class Scheduler:
         queued+running jobs; past either the submit is refused (the
         per-tenant analogue of ``queue_bound`` backpressure)."""
         if self.tenant_queue_cap is None and self.tenant_inflight_cap is None:
+            return
+        if tenant == CANARY_TENANT:
+            # the heartbeat probe must not consume (or be refused by) a
+            # real tenant's quota — it rides scavenger qos and the queue
+            # bound only
             return
         queued = sum(1 for q in self._queues.values()
                      for j in q if j.tenant == tenant)
@@ -1042,6 +1083,10 @@ class Scheduler:
             job.state = "quarantined"
             job.error = reason
             job.finished_t = time.monotonic()
+            # rejected work still spent real queue time — critpath must
+            # account for it, not just for dispatched jobs
+            job.queue_wait_s = job.finished_t - job.submitted_t
+            self._critpath_emit_locked(job)
             return True
         attempt = self._fleet_attempts.get(key, 0) + 1
         if self.max_fleet_attempts and attempt > self.max_fleet_attempts:
@@ -1073,6 +1118,7 @@ class Scheduler:
         job.state = "quarantined"
         job.error = reason
         job.finished_t = time.monotonic()
+        job.queue_wait_s = job.finished_t - job.submitted_t
         self._quarantined[key] = reason
         self.counters.add("jobs_quarantined")
         if self._journal is not None:
@@ -1087,6 +1133,7 @@ class Scheduler:
                       file=sys.stderr, flush=True)
         obs_trace.event("serve.quarantine", trace_id=job.trace_id,
                         job_id=job.id, key=key, reason=reason)
+        self._critpath_emit_locked(job)
         obs_flight.record("quarantine", job_id=job.id, key=key,
                           reason=reason, tenant=job.tenant, qos=job.qos)
         obs_flight.dump(reason="quarantine")
@@ -1123,6 +1170,10 @@ class Scheduler:
                 job.error = None
                 job.finished_t = None
                 job.submitted_t = time.monotonic()
+                # the release restarts the job's clock; stale boundary
+                # stamps from the quarantined life would corrupt critpath
+                job.stamps = {}
+                job.queue_wait_s = None
                 self._enqueue_locked(job)
                 requeued = True
                 self._cond.notify_all()
@@ -1543,6 +1594,87 @@ class Scheduler:
         finally:
             self.shutdown(timeout=5.0)
 
+    # ------------------------------------------------------------ critpath
+
+    #: canonical boundary order; consecutive present stamps telescope into
+    #: the segment chain obs/critpath.py renders
+    _CRITPATH_ORDER = ("admit", "journal", "ack", "gang", "dispatch", "run")
+
+    def _critpath_emit_locked(self, job: Job) -> None:
+        """Emit the job's terminal ``serve.critpath`` event: boundary
+        stamps as ms-from-submit offsets plus the queue-segment
+        antagonist.  Raw evidence only — obs/critpath.py owns the
+        decomposition math, so the event schema stays small and stable."""
+        end = job.finished_t if job.finished_t is not None \
+            else time.monotonic()
+        stamps = {"submit": 0.0}
+        for name in self._CRITPATH_ORDER:
+            t = job.stamps.get(name)
+            if t is not None:
+                stamps[name] = round((t - job.submitted_t) * 1e3, 3)
+        obs_trace.event(
+            "serve.critpath", trace_id=job.trace_id, job_id=job.id,
+            key=job.key, state=job.state, tenant=job.tenant, qos=job.qos,
+            gang_size=job.gang_size, cached=job.cached,
+            wall_ms=round((end - job.submitted_t) * 1e3, 3),
+            queue_wait_ms=round((job.queue_wait_s or 0.0) * 1e3, 3),
+            stamps=stamps, antagonist=self._antagonist_locked(job))
+
+    def _antagonist_locked(self, job: Job) -> dict:
+        """Who made this job wait: overlap of its queue window (ack ->
+        gang pop) with recent gang runs names the dispatcher's victim
+        jobs; the contention ledger's per-lock wait growth over the
+        job's lifetime names the hottest lock (CCT_LOCK_LEDGER=1); the
+        unexplained remainder is admission idle — the dispatcher was
+        parked, nothing to blame but arrival order."""
+        q0 = job.stamps.get("ack", job.submitted_t)
+        q1 = job.stamps.get("gang")
+        if q1 is None:
+            q1 = job.finished_t if job.finished_t is not None \
+                else time.monotonic()
+        span = max(0.0, q1 - q0)
+        busy = 0.0
+        busiest_jobs: list[int] = []
+        busiest_ov = 0.0
+        for g in self._gang_log:
+            ov = min(q1, g["t1"]) - max(q0, g["t0"])
+            if ov <= 0:
+                continue
+            busy += ov
+            if ov > busiest_ov:
+                busiest_ov = ov
+                busiest_jobs = list(g["jobs"])
+        busy = min(busy, span)
+        lock_name = None
+        lock_wait_us = 0
+        if job._lock_wait0 is not None:
+            for name, row in sanitize.ledger_snapshot().items():
+                d = row["wait_us"] - job._lock_wait0.get(name, 0)
+                if d > lock_wait_us:
+                    lock_wait_us = d
+                    lock_name = name
+        out = {"queue_ms": round(span * 1e3, 3),
+               "dispatcher_busy_ms": round(busy * 1e3, 3),
+               "idle_ms": round((span - busy) * 1e3, 3)}
+        if busiest_jobs:
+            out["busy_on_jobs"] = busiest_jobs[:8]
+        if lock_name:
+            out["lock"] = lock_name
+            out["lock_wait_ms"] = round(lock_wait_us / 1e3, 3)
+            holder = sanitize.current_holders().get(lock_name)
+            if holder:
+                out["lock_holder"] = holder
+        # the dominant cause — what the fleet antagonist table keys on
+        if span <= 0:
+            out["kind"] = "none"
+        elif busy >= span / 2:
+            out["kind"] = "dispatcher"
+        elif lock_wait_us / 1e6 >= span / 2:
+            out["kind"] = "lock"
+        else:
+            out["kind"] = "idle"
+        return out
+
     # ------------------------------------------------------------- metrics
 
     def metrics(self) -> dict:
@@ -1560,6 +1692,7 @@ class Scheduler:
             # for the profiler's sample/drop/shard tallies
             cumulative.update(obs_trace.counter_snapshot())
             cumulative.update(obs_prof.counter_snapshot())
+            cumulative.update(obs_history.counter_snapshot())
             doc = metrics_doc(
                 "serve", {"uptime": time.time() - self._started_at},
                 {"n_jobs": len(jobs), "queue_bound": self.queue_bound,
@@ -1574,12 +1707,30 @@ class Scheduler:
             doc["jobs"] = jobs
             doc["histograms"] = obs_metrics.histograms_snapshot()
             doc["labeled"] = obs_metrics.labeled_snapshot()
+            # the lock-contention ledger composes in at READ time (never
+            # via obs_metrics.inc — incrementing on every acquire would
+            # put a metrics call on the hottest path in the process)
+            if sanitize.ledger_enabled():
+                led = sanitize.ledger_snapshot()
+                if led:
+                    lc = doc["labeled"].setdefault("counters", {})
+                    for metric, field in (("lock_wait_us", "wait_us"),
+                                          ("lock_hold_us", "hold_us"),
+                                          ("lock_waits", "waits")):
+                        lc[metric] = [
+                            {"labels": {"lock": name}, "value": row[field]}
+                            for name, row in led.items()]
             doc["slo"] = self.slo.snapshot()
             if self.autotune_info is not None:
                 try:
                     doc["autotune"] = self.autotune_info()
                 except Exception:
                     pass  # telemetry must never take down /metrics
+            if self.canary_info is not None:
+                try:
+                    doc["canary"] = self.canary_info()
+                except Exception:
+                    pass
             doc["queued_by_class"] = \
                 {qos: len(self._queues[qos]) for qos in QOS_CLASSES}
             doc["class_weights"] = dict(self.class_weights)
@@ -1587,6 +1738,22 @@ class Scheduler:
                 doc["journal"] = {"path": self._journal.path,
                                   "size_bytes": self._journal.size()}
             return doc
+
+    def history_doc(self) -> dict:
+        """Supplier for the :mod:`obs.history` recorder: the cumulative
+        counters (deltas are taken on the history side) plus the gauges a
+        delta cannot express."""
+        m = self.metrics()
+        gauges: dict = {
+            "queued": sum((m.get("queued_by_class") or {}).values()),
+            "n_jobs": m.get("n_jobs"),
+        }
+        canary = m.get("canary")
+        if isinstance(canary, dict):
+            gauges["canary_ok"] = 1 if canary.get("ok") else 0
+            if canary.get("age_s") is not None:
+                gauges["canary_age_s"] = canary["age_s"]
+        return {"cum": m.get("cumulative") or {}, "gauges": gauges}
 
     def healthz(self) -> dict:
         with self._cond:
@@ -1648,7 +1815,15 @@ class Scheduler:
             with self._cond:
                 while not self._stop and \
                         (self._paused or not self._any_queued_locked()):
+                    # parked time is the critpath "admission idle"
+                    # denominator: queue waits that overlap neither a
+                    # gang run nor a lock hold happened while the
+                    # dispatcher had nothing to do
+                    t_idle = time.monotonic()
                     self._cond.wait()
+                    self.counters.add(
+                        "dispatcher_idle_us",
+                        int((time.monotonic() - t_idle) * 1e6))
                 if self._stop:
                     return
                 gang = self._pop_gang_locked()
@@ -1669,10 +1844,19 @@ class Scheduler:
                                      f"expired after "
                                      f"{now - job.submitted_t:.1f}s in queue")
                         job.finished_t = now
+                        # shed work carries its queue wait too — the whole
+                        # point of critpath is accounting for the waits
+                        # that did NOT end in a dispatch
+                        job.queue_wait_s = now - job.submitted_t
                         self._count_shed_locked(job.tenant, job.qos)
+                        self._critpath_emit_locked(job)
                         self._journal_update_locked(job, "failed",
                                                     error=job.error)
                     else:
+                        # only survivors crossed the gang boundary: a shed
+                        # job's critpath tail stays "queue" — it died
+                        # waiting, it never joined a gang
+                        job.stamps["gang"] = now
                         live.append(job)
                 # budget gate: a quarantined (or budget-exhausted) job
                 # must not reach another dispatch; survivors get their
@@ -1691,12 +1875,19 @@ class Scheduler:
                         "tenant_queue_wait_s", now - job.submitted_t,
                         tenant=job.tenant, qos=job.qos)
                     self._journal_update_locked(job, "dispatched")
+                    job.stamp("dispatch")
                 self._running = list(live)
                 self._cond.notify_all()
+            t_busy = time.monotonic()
             try:
                 self._run_gang(live)
             finally:
+                t_end = time.monotonic()
+                self.counters.add("dispatcher_busy_us",
+                                  int((t_end - t_busy) * 1e6))
                 with self._cond:
+                    self._gang_log.append({"t0": t_busy, "t1": t_end,
+                                           "jobs": [j.id for j in live]})
                     self._running = []
                     self._cond.notify_all()
 
@@ -1733,6 +1924,7 @@ class Scheduler:
                       "running jobs solo", file=sys.stderr, flush=True)
         for job in gang:
             jt0 = t0 if len(gang) > 1 else time.monotonic()
+            job.stamps["run"] = jt0
             try:
                 with obs_trace.span("serve.job", trace_id=job.trace_id,
                                     job_id=job.id, tenant=job.tenant,
@@ -1786,6 +1978,9 @@ class Scheduler:
                 job.finished_t = time.monotonic()
                 self._ewma_job_s = job.wall_s if self._ewma_job_s is None \
                     else 0.8 * self._ewma_job_s + 0.2 * job.wall_s
+                # the critpath event rides the terminal flush below: a
+                # journaled-terminal job always has durable stamps
+                self._critpath_emit_locked(job)
                 self._journal_update_locked(
                     job, outcome, outputs=job.outputs, error=job.error,
                     wall_s=job.wall_s, qc=job.qc)
@@ -1999,29 +2194,35 @@ class Scheduler:
         yields = doc.get("yields") or {}
         rates = doc.get("rates") or {}
         plane = doc.get("plane") or {}
-        rescued = (int(yields.get("rescued_by_sscs", 0))
-                   + int(yields.get("rescued_by_singleton", 0)))
-        for key, series in self._QC_YIELD_SERIES:
-            obs_metrics.inc(series, int(yields.get(key, 0)),
-                            tenant=job.tenant, qos=job.qos)
-        obs_metrics.inc("tenant_qc_rescued", rescued,
-                        tenant=job.tenant, qos=job.qos)
-        # per-policy quality attribution (ISSUE 17): ``policy`` is a
-        # CLOSED label — docs stamped with a name outside POLICY_NAMES
-        # (a foreign plugin, a corrupt doc) skip the per-policy series
-        # rather than widening the exposition or failing the job
-        policy = str(doc.get("policy") or "majority")
-        if policy in POLICY_NAMES:
-            obs_metrics.inc("tenant_qc_policy_jobs", 1,
-                            tenant=job.tenant, qos=job.qos, policy=policy)
-            obs_metrics.inc("tenant_qc_policy_sscs_written",
-                            int(yields.get("sscs_written", 0)),
-                            tenant=job.tenant, qos=job.qos, policy=policy)
         disagree = plane.get("disagree_rate")
-        if disagree is not None:
-            obs_metrics.observe_labeled("tenant_qc_disagreement",
-                                        float(disagree),
-                                        tenant=job.tenant, qos=job.qos)
+        # synthetic canary probes keep their qc summary (describe() +
+        # prober verification) but never touch the per-tenant QC series —
+        # a heartbeat must not move real quality attribution
+        if job.tenant != CANARY_TENANT:
+            rescued = (int(yields.get("rescued_by_sscs", 0))
+                       + int(yields.get("rescued_by_singleton", 0)))
+            for key, series in self._QC_YIELD_SERIES:
+                obs_metrics.inc(series, int(yields.get(key, 0)),
+                                tenant=job.tenant, qos=job.qos)
+            obs_metrics.inc("tenant_qc_rescued", rescued,
+                            tenant=job.tenant, qos=job.qos)
+            # per-policy quality attribution (ISSUE 17): ``policy`` is a
+            # CLOSED label — docs stamped with a name outside POLICY_NAMES
+            # (a foreign plugin, a corrupt doc) skip the per-policy series
+            # rather than widening the exposition or failing the job
+            policy = str(doc.get("policy") or "majority")
+            if policy in POLICY_NAMES:
+                obs_metrics.inc("tenant_qc_policy_jobs", 1,
+                                tenant=job.tenant, qos=job.qos,
+                                policy=policy)
+                obs_metrics.inc("tenant_qc_policy_sscs_written",
+                                int(yields.get("sscs_written", 0)),
+                                tenant=job.tenant, qos=job.qos,
+                                policy=policy)
+            if disagree is not None:
+                obs_metrics.observe_labeled("tenant_qc_disagreement",
+                                            float(disagree),
+                                            tenant=job.tenant, qos=job.qos)
         job.qc = {"yields": {k: int(v) for k, v in yields.items()},
                   "rates": rates,
                   "disagree_rate": disagree,

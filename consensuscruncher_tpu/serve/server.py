@@ -79,6 +79,7 @@ import sys
 import threading
 import time
 
+from consensuscruncher_tpu.obs import history as obs_history
 from consensuscruncher_tpu.obs import prof as obs_prof
 from consensuscruncher_tpu.obs import trace as obs_trace
 from consensuscruncher_tpu.obs.metrics import render_prometheus
@@ -449,6 +450,14 @@ class ServeServer:
                 # collectable through a demoted router.
                 return {"ok": True,
                         "prof": obs_prof.collect(node=self.scheduler.node)}
+            if op == "history":
+                # telemetry-history collection: this process's durable
+                # NDJSON shard read back.  Unfenced like trace/prof —
+                # "what changed over the last hour" must stay
+                # answerable through a demoted router.
+                return {"ok": True,
+                        "history": obs_history.collect(
+                            node=self.scheduler.node)}
             return {"ok": False, "error": f"unknown op {op!r}"}
         except RouterFenced as e:
             return {"ok": False, "error": str(e), "fenced": True,

@@ -30,6 +30,7 @@ from __future__ import annotations
 import contextlib
 import os
 import threading
+import time
 
 
 class StageTransferError(RuntimeError):
@@ -255,24 +256,117 @@ def reset_lock_tracking() -> None:
         _edges.clear()
 
 
+# -------------------------------------------------------- contention ledger
+#
+# Opt-in (``CCT_LOCK_LEDGER=1``) hold/wait timing per named lock, feeding
+# the critpath antagonist view and the ``lock_wait_us`` / ``lock_hold_us``
+# / ``lock_waits`` labeled counters the scheduler composes into its metrics
+# doc at read time.  Off by default: the production fast path pays one
+# cached env check per acquire.  Contention is detected with a free
+# non-blocking acquire first — only the acquires that actually block pay
+# the clock, so uncontended hot paths stay unmeasured and cheap.
+
+#: name -> [wait_us, hold_us, waits, acquires]; guarded by _ledger_lock.
+_ledger: dict[str, list[int]] = {}
+#: name -> thread name currently holding the lock (antagonist attribution).
+_holders: dict[str, str] = {}
+_ledger_lock = threading.Lock()
+_ledger_env: tuple[str, bool] = ("\x00", False)
+
+
+def ledger_enabled() -> bool:
+    """Cached on the raw env string so monkeypatch.setenv invalidates."""
+    global _ledger_env
+    raw = os.environ.get("CCT_LOCK_LEDGER", "")
+    if raw != _ledger_env[0]:
+        _ledger_env = (raw, raw == "1")
+    return _ledger_env[1]
+
+
+def _ledger_note(name: str, wait_us: int = 0, hold_us: int = 0,
+                 contended: bool = False, acquired: bool = False) -> None:
+    with _ledger_lock:
+        row = _ledger.get(name)
+        if row is None:
+            row = _ledger[name] = [0, 0, 0, 0]
+        row[0] += wait_us
+        row[1] += hold_us
+        if contended:
+            row[2] += 1
+        if acquired:
+            row[3] += 1
+
+
+def _holder_set(name: str) -> None:
+    with _ledger_lock:
+        _holders[name] = threading.current_thread().name
+
+
+def _holder_clear(name: str) -> None:
+    with _ledger_lock:
+        _holders.pop(name, None)
+
+
+def ledger_snapshot() -> dict[str, dict[str, int]]:
+    """Totals per lock name since process start (or :func:`reset_ledger`)."""
+    with _ledger_lock:
+        return {
+            name: {"wait_us": row[0], "hold_us": row[1],
+                   "waits": row[2], "acquires": row[3]}
+            for name, row in sorted(_ledger.items())
+        }
+
+
+def current_holders() -> dict[str, str]:
+    """lock name -> holder thread name, for the antagonist view."""
+    with _ledger_lock:
+        return dict(_holders)
+
+
+def reset_ledger() -> None:
+    """Test hook: zero every ledger row and forget holders."""
+    with _ledger_lock:
+        _ledger.clear()
+        _holders.clear()
+
+
 class TrackedLock:
     """Drop-in ``threading.Lock`` recording acquisition order per thread."""
 
     def __init__(self, name: str, factory=threading.Lock):
         self._name = name
         self._lock = factory()
+        self._acq_t = 0
 
     def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
         h = _interleave_hook
         if h is not None:
             h.before_acquire(self._name, self)
         _note_acquire(self._name)
-        ok = self._lock.acquire(blocking, timeout)
+        if ledger_enabled():
+            ok = self._lock.acquire(False)
+            if not ok and blocking:
+                t0 = time.monotonic_ns()
+                ok = self._lock.acquire(True, timeout)
+                _ledger_note(self._name, contended=True, acquired=ok,
+                             wait_us=(time.monotonic_ns() - t0) // 1000)
+            elif ok:
+                _ledger_note(self._name, acquired=True)
+            if ok:
+                self._acq_t = time.monotonic_ns()
+                _holder_set(self._name)
+        else:
+            ok = self._lock.acquire(blocking, timeout)
         if not ok:
             _note_release(self._name)
         return ok
 
     def release(self) -> None:
+        if self._acq_t:
+            _ledger_note(self._name,
+                         hold_us=(time.monotonic_ns() - self._acq_t) // 1000)
+            self._acq_t = 0
+            _holder_clear(self._name)
         self._lock.release()
         _note_release(self._name)
         h = _interleave_hook
@@ -299,40 +393,73 @@ class TrackedCondition:
     def __init__(self, name: str):
         self._name = name
         self._cond = threading.Condition()
+        self._acq_t = 0
 
     def acquire(self, *args) -> bool:
         h = _interleave_hook
         if h is not None:
             h.before_acquire(self._name, self)
         _note_acquire(self._name)
+        if ledger_enabled():
+            ok = self._cond.acquire(False)
+            if not ok and (not args or args[0]):
+                t0 = time.monotonic_ns()
+                ok = self._cond.acquire(*args)
+                _ledger_note(self._name, contended=True, acquired=ok,
+                             wait_us=(time.monotonic_ns() - t0) // 1000)
+            elif ok:
+                _ledger_note(self._name, acquired=True)
+            if ok:
+                self._acq_t = time.monotonic_ns()
+                _holder_set(self._name)
+            return ok
         return self._cond.acquire(*args)
 
     def release(self) -> None:
+        self._close_hold()
         self._cond.release()
         _note_release(self._name)
         h = _interleave_hook
         if h is not None:
             h.after_release(self._name, self)
 
+    def _close_hold(self) -> None:
+        if self._acq_t:
+            _ledger_note(self._name,
+                         hold_us=(time.monotonic_ns() - self._acq_t) // 1000)
+            self._acq_t = 0
+            _holder_clear(self._name)
+
+    def _reopen_hold(self) -> None:
+        # Woken from cond.wait holding the lock again; the parked interval
+        # was idle, not contention, so it lands in neither wait nor hold.
+        if ledger_enabled():
+            self._acq_t = time.monotonic_ns()
+            _holder_set(self._name)
+
     def wait(self, timeout: float | None = None) -> bool:
         h = _interleave_hook
         if h is not None:
             h.on_wait(self._name, self)
         _note_release(self._name)
+        self._close_hold()
         try:
             return self._cond.wait(timeout)
         finally:
             _note_acquire(self._name, check=False)
+            self._reopen_hold()
 
     def wait_for(self, predicate, timeout: float | None = None):
         h = _interleave_hook
         if h is not None:
             h.on_wait(self._name, self)
         _note_release(self._name)
+        self._close_hold()
         try:
             return self._cond.wait_for(predicate, timeout)
         finally:
             _note_acquire(self._name, check=False)
+            self._reopen_hold()
 
     def notify(self, n: int = 1) -> None:
         self._cond.notify(n)

@@ -1,0 +1,10 @@
+"""Conformant twin of viol_critpath_series.py: same emission shape, but
+every lock_*/canary_*/history_* name here is declared in the registry
+(LABELED_COUNTERS / COUNTERS) — so the CCT606 rule demonstrably keys on
+the declaration, not on the prefix or the call shape."""
+
+
+def stamp(counters, ledger):
+    ledger.note("lock_wait_us", 12)
+    counters.bump("canary_runs")
+    counters.bump("history_snapshots")

@@ -1,0 +1,16 @@
+"""Seeded CCT606 violations for the obscov pass self-test.
+
+Critical-path observatory series (lock_*/canary_*/history_* prefixes)
+emitted under names the registry never declared: the crit surfaces
+(cct top's crit row, cct history, the Prometheus exposition) discover
+these families by name through the registry, so each call below would
+write telemetry no surface can ever render."""
+
+
+def stamp(counters, ledger):
+    # CCT606: undeclared lock_* contention series
+    ledger.note("lock_spin_ns_bogus", 12)
+    # CCT606: undeclared canary_* prober tally
+    counters.bump("canary_flaps_unregistered")
+    # CCT606: undeclared history_* recorder tally
+    counters.bump("history_rotations_unknown")

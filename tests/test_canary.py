@@ -1,0 +1,194 @@
+"""Golden canary prober (serve/canary.py): active end-to-end
+correctness + latency watch.
+
+The load-bearing assertions:
+
+- **Honest pass**: a real probe through a real scheduler self-mints the
+  golden digest and re-verifies it on the next probe (the pipeline is
+  byte-deterministic, so the digest is a constant).
+- **Positive control**: a corrupted pinned golden MUST flip ok to
+  False, count canary_fail, and dump the flight ring — this is the
+  exact failure ci seeds to prove the canary can see.
+- **Skip is not failure**: an admission refusal (the scavenger probe is
+  shed first under real overload, by design) leaves ok untouched.
+- **Quarantine from tenancy**: the ``_canary`` tenant bypasses tenant
+  quotas and never moves the per-tenant QC series.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from consensuscruncher_tpu.obs import flight as obs_flight  # noqa: E402
+from consensuscruncher_tpu.obs import metrics as obs_metrics  # noqa: E402
+from consensuscruncher_tpu.serve import canary  # noqa: E402
+from consensuscruncher_tpu.serve.scheduler import (  # noqa: E402
+    CANARY_TENANT,
+    AdmissionRefused,
+    Scheduler,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_metrics():
+    obs_metrics.reset_for_tests()
+    yield
+    obs_metrics.reset_for_tests()
+
+
+@pytest.fixture
+def sched():
+    s = Scheduler(backend="tpu", queue_bound=16, gang_size=1,
+                  tenant_queue_cap=1, tenant_inflight_cap=1)
+    yield s
+    s.shutdown()
+
+
+def _prober(sched, tmp_path, **kw):
+    kw.setdefault("interval_s", 3600.0)
+    kw.setdefault("latency_s", 120.0)
+    return canary.CanaryProber(sched, str(tmp_path / "canary"), **kw)
+
+
+# --------------------------------------------------------------- digest
+
+def test_output_digest_covers_bams_only(tmp_path):
+    base = tmp_path / "out"
+    (base / "sub").mkdir(parents=True)
+    (base / "a.bam").write_bytes(b"bam-bytes")
+    (base / "sub" / "b.bam").write_bytes(b"more")
+    (base / "metrics.json").write_text('{"wall_s": 1.23}')
+    d1 = canary.output_digest(str(base))
+    # sidecars carry walls: changing one must not move the digest
+    (base / "metrics.json").write_text('{"wall_s": 9.99}')
+    assert canary.output_digest(str(base)) == d1
+    # output bytes are what the canary exists to watch
+    (base / "a.bam").write_bytes(b"rot")
+    assert canary.output_digest(str(base)) != d1
+
+
+# ---------------------------------------------------------- real probes
+
+def test_probe_self_mints_then_reverifies_golden(sched, tmp_path):
+    """First honest probe mints the golden; the second (a result-cache
+    hit for the same content digest) must reproduce it byte-identically.
+    Quota caps of 1 don't apply: the canary tenant is quota-exempt."""
+    prober = _prober(sched, tmp_path)
+    assert prober.golden is None
+    assert prober.probe_once() is True
+    minted = prober.golden
+    assert minted and len(minted) == 64
+    assert prober.probe_once() is True
+    assert prober.golden == minted
+    doc = prober.status()
+    assert doc["ok"] is True and doc["pass"] == 2 and doc["fail"] == 0
+    assert doc["runs"] == 2 and doc["last_error"] is None
+    assert sched.counters.snapshot().get("canary_pass") == 2
+    # the heartbeat never moved the per-tenant QC series
+    labeled = (sched.metrics().get("labeled") or {}).get("counters") or {}
+    for metric, rows in labeled.items():
+        if metric.startswith("tenant_qc"):
+            assert all(r["labels"].get("tenant") != CANARY_TENANT
+                       for r in rows), metric
+
+
+def test_corrupted_golden_flips_ok_and_dumps_flight(sched, tmp_path):
+    """The ci positive control: a pinned golden that cannot match MUST
+    flip the gauge, count the failure, and leave a flight dump."""
+    dump_dir = tmp_path / "dumps"
+    obs_flight.set_dump_dir(str(dump_dir))
+    try:
+        prober = _prober(sched, tmp_path, golden="deadbeef" * 8)
+        assert prober.probe_once() is False
+        doc = prober.status()
+        assert doc["ok"] is False and doc["fail"] == 1
+        assert "mismatch" in doc["last_error"]
+        assert sched.counters.snapshot().get("canary_fail") == 1
+        dumps = [n for n in sorted(os.listdir(dump_dir))
+                 if n.startswith("flight-")]
+        assert dumps, "canary failure must dump the flight ring"
+        dumped = json.load(open(dump_dir / dumps[-1]))
+        assert dumped["reason"] == "canary-fail"
+        assert any(ev.get("kind") == "canary_fail"
+                   for ev in dumped["events"])
+    finally:
+        obs_flight.set_dump_dir(None)
+
+
+# ------------------------------------------------------- failure modes
+
+def test_admission_refusal_is_skip_not_failure(sched, tmp_path,
+                                               monkeypatch):
+    prober = _prober(sched, tmp_path)
+
+    def refuse(spec):
+        raise AdmissionRefused("queue full")
+
+    monkeypatch.setattr(sched, "submit_info", refuse)
+    assert prober.probe_once() is None
+    doc = prober.status()
+    assert doc["ok"] is True and doc["fail"] == 0
+    assert "skipped" in doc["last_error"]
+
+
+def test_submit_error_is_failure(sched, tmp_path, monkeypatch):
+    prober = _prober(sched, tmp_path)
+
+    def boom(spec):
+        raise RuntimeError("wiring broke")
+
+    monkeypatch.setattr(sched, "submit_info", boom)
+    assert prober.probe_once() is False
+    assert prober.status()["ok"] is False
+    assert "wiring broke" in prober.status()["last_error"]
+
+
+def test_latency_bound_breach_is_failure(tmp_path):
+    """A parked scheduler never finishes the probe: the wait times out
+    and the prober reports a latency breach, not a hang."""
+    sched = Scheduler(backend="tpu", queue_bound=16, gang_size=1,
+                      paused=True)
+    try:
+        prober = _prober(sched, tmp_path, latency_s=1.0)
+        assert prober.probe_once() is False
+        assert "latency bound" in prober.status()["last_error"]
+    finally:
+        sched.shutdown()
+
+
+# --------------------------------------------------------------- wiring
+
+def test_maybe_start_gates_on_env(sched, tmp_path, monkeypatch):
+    monkeypatch.delenv("CCT_CANARY", raising=False)
+    assert canary.maybe_start(sched, str(tmp_path)) is None
+    monkeypatch.setenv("CCT_CANARY", "1")
+    monkeypatch.setenv("CCT_CANARY_INTERVAL_S", "3600")
+    prober = canary.maybe_start(sched, str(tmp_path))
+    try:
+        assert prober is not None and prober.is_alive()
+        assert sched.canary_info == prober.status
+        # the scheduler's metrics doc now carries the canary verdict
+        assert sched.metrics()["canary"]["ok"] is True
+    finally:
+        prober.stop()
+
+
+def test_canary_tenant_bypasses_quota(sched):
+    """tenant caps of 1: a real tenant's second submit refuses, the
+    canary tenant's never does."""
+    spec = {"input": "/in/a.bam", "output": "/o/a", "name": "a",
+            "tenant": "acme"}
+    sched.pause()
+    sched.submit(dict(spec))
+    with pytest.raises(AdmissionRefused):
+        sched.submit(dict(spec, name="b", output="/o/b"))
+    for i in range(3):  # quota-exempt: any number of canary probes admit
+        sched.submit({"input": "/in/c.bam", "output": f"/o/c{i}",
+                      "name": f"c{i}", "tenant": CANARY_TENANT,
+                      "qos": "scavenger"})

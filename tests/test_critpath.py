@@ -1,0 +1,282 @@
+"""Critical-path decomposition (obs/critpath.py) + the scheduler's
+boundary stamps feeding it.
+
+The load-bearing assertions:
+
+- **Telescoping**: consecutive boundary stamps partition the wall, so
+  segment-sum coverage is 1.0 by construction — the ci gate's >=95%
+  floor is a real invariant, not a tuned threshold.
+- **Tail naming**: a job shed while queued reports its wait as "queue"
+  (the segment the NEXT boundary would have opened), never "run".
+- **Antagonists are concrete**: the fleet table names the lock / the
+  dispatcher's victim jobs / admission idle — never just "a lock".
+- **Live scheduler**: a real dispatcher (stubbed job body) emits a
+  decomposable serve.critpath event for every terminal job, including
+  the dispatch-time shed path (satellite regression: shed work carries
+  its queue_wait_ms too).
+"""
+
+import json
+import os
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from consensuscruncher_tpu.obs import critpath  # noqa: E402
+from consensuscruncher_tpu.obs import metrics as obs_metrics  # noqa: E402
+from consensuscruncher_tpu.obs import trace as obs_trace  # noqa: E402
+from consensuscruncher_tpu.serve.scheduler import Scheduler  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _clean_metrics():
+    obs_metrics.reset_for_tests()
+    yield
+    obs_metrics.reset_for_tests()
+
+
+def _ev(stamps, wall_ms, state="done", job_id=7, pid=100, ts=1.0,
+        antagonist=None, queue_wait_ms=0.0, **extra):
+    args = {"job_id": job_id, "key": f"k{job_id}", "state": state,
+            "tenant": "default", "qos": "interactive", "gang_size": 1,
+            "cached": False, "wall_ms": wall_ms,
+            "queue_wait_ms": queue_wait_ms, "stamps": stamps,
+            "antagonist": antagonist or {}}
+    args.update(extra)
+    return {"name": "serve.critpath", "ph": "i", "pid": pid, "ts": ts,
+            "node": "n0", "args": args}
+
+
+# ------------------------------------------------------- decomposition
+
+def test_decompose_telescopes_to_full_coverage():
+    """All six boundaries present: the chain is the canonical seven
+    segments in order and the segment sum equals the wall exactly."""
+    stamps = {"submit": 0.0, "admit": 1.0, "journal": 3.0, "ack": 4.0,
+              "gang": 10.0, "dispatch": 11.0, "run": 12.0}
+    job = critpath.decompose(_ev(stamps, wall_ms=20.0))
+    names = [s["name"] for s in job["segments"]]
+    assert names == ["admit", "journal", "ack", "queue", "gang_form",
+                     "handoff", "run"]
+    assert sum(s["ms"] for s in job["segments"]) == pytest.approx(20.0)
+    assert job["coverage"] == 1.0
+    # queue segment is the ack -> gang diff
+    assert dict((s["name"], s["ms"]) for s in job["segments"])["queue"] \
+        == pytest.approx(6.0)
+
+
+def test_shed_tail_is_named_queue_not_run():
+    """A job shed at dispatch time has stamps only through ack: the
+    tail (last stamp -> terminal) must take the name the NEXT boundary
+    would have had — its death was a queue wait, not a run."""
+    stamps = {"submit": 0.0, "admit": 0.5, "journal": 1.0, "ack": 1.5}
+    job = critpath.decompose(_ev(stamps, wall_ms=50.0, state="failed",
+                                 queue_wait_ms=48.5))
+    assert job["segments"][-1]["name"] == "queue"
+    assert job["segments"][-1]["ms"] == pytest.approx(48.5)
+    assert job["coverage"] == 1.0
+    assert job["queue_wait_ms"] == pytest.approx(48.5)
+
+
+def test_refused_before_any_stamp_tail_is_admit():
+    """Refused at the door: only the submit origin exists, so the whole
+    wall is the admit segment."""
+    job = critpath.decompose(_ev({"submit": 0.0}, wall_ms=2.0,
+                                 state="failed"))
+    assert [s["name"] for s in job["segments"]] == ["admit"]
+    assert job["coverage"] == 1.0
+
+
+def test_run_split_uses_job_span_attribution():
+    """The serve.job span's profiler deltas split the run tail into
+    device/deflate/host with a zero-clamped 'other' remainder."""
+    stamps = {"submit": 0.0, "admit": 1.0, "journal": 2.0, "ack": 3.0,
+              "gang": 4.0, "dispatch": 5.0, "run": 6.0}
+    span = {"job_id": 7, "device_dispatch_ms": 5.0, "deflate_ms": 3.0,
+            "host_cpu_ms": 4.0}
+    job = critpath.decompose(_ev(stamps, wall_ms=20.0), span)
+    tail = job["segments"][-1]
+    assert tail["name"] == "run"
+    assert tail["split"] == {"device": 5.0, "deflate": 3.0, "host": 4.0,
+                             "other": 2.0}
+    # overlapping phases larger than the tail: other clamps at zero
+    span_big = {"job_id": 7, "device_dispatch_ms": 40.0}
+    tail2 = critpath.decompose(_ev(stamps, wall_ms=20.0),
+                               span_big)["segments"][-1]
+    assert tail2["split"]["other"] == 0.0
+
+
+def test_critpath_events_dedup_exact_duplicates():
+    """A node's wire buffer and its CCT_TRACE_DIR shard overlap by
+    design: the exact duplicate collapses, a different pid survives."""
+    ev = _ev({"submit": 0.0, "admit": 1.0}, wall_ms=2.0)
+    other_pid = _ev({"submit": 0.0, "admit": 1.0}, wall_ms=2.0, pid=101)
+    noise = {"name": "serve.job", "ph": "X", "pid": 100,
+             "args": {"job_id": 7}}
+    out = critpath.critpath_events([ev, dict(ev), other_pid, noise])
+    assert len(out) == 2
+
+
+def test_antagonist_labels_are_concrete():
+    assert critpath.antagonist_label(
+        {"kind": "lock", "lock": "sched", "lock_holder": "dispatcher"}) \
+        == "lock:sched (held by dispatcher)"
+    assert critpath.antagonist_label(
+        {"kind": "dispatcher", "busy_on_jobs": [3, 4]}) \
+        == "dispatcher busy (jobs 3,4)"
+    assert critpath.antagonist_label({"kind": "idle"}) == "admission idle"
+    assert critpath.antagonist_label({}) == "unknown"
+
+
+def test_fleet_report_percentiles_and_dominant_antagonist():
+    jobs = []
+    for i in range(10):
+        stamps = {"submit": 0.0, "admit": 1.0, "journal": 2.0,
+                  "ack": 3.0, "gang": 3.0 + i, "dispatch": 4.0 + i,
+                  "run": 5.0 + i}
+        ant = {"kind": "dispatcher", "busy_on_jobs": [1],
+               "queue_ms": float(i)} if i < 8 else \
+            {"kind": "idle", "queue_ms": float(i)}
+        jobs.append(critpath.decompose(
+            _ev(stamps, wall_ms=10.0 + i, job_id=i, antagonist=ant)))
+    fleet = critpath.fleet_report(jobs)
+    assert fleet["jobs"] == 10
+    assert fleet["coverage_min"] == 1.0
+    q = fleet["segments"]["queue"]
+    assert q["jobs"] == 10 and q["p50_ms"] >= q["p50_ms"] >= 0
+    assert q["p99_ms"] >= q["p90_ms"] >= q["p50_ms"]
+    # dispatcher blamed for 0+..+7=28ms vs idle's 8+9=17ms
+    assert fleet["dominant_queue_antagonist"] \
+        == "dispatcher busy (jobs 1)"
+    assert fleet["antagonists"]["admission idle"]["jobs"] == 2
+
+
+def test_render_report_and_job_smoke():
+    stamps = {"submit": 0.0, "admit": 1.0, "journal": 2.0, "ack": 3.0,
+              "gang": 9.0, "dispatch": 10.0, "run": 11.0}
+    ant = {"kind": "lock", "lock": "sched", "queue_ms": 6.0}
+    doc = critpath.report_doc(
+        [_ev(stamps, wall_ms=15.0, antagonist=ant)])
+    text = critpath.render_report(doc)
+    assert "queue" in text and "lock:sched" in text and "dominant" in text
+    jline = critpath.render_job(doc["jobs"][0])
+    assert "coverage=1.0" in jline and "lock:sched" in jline
+    # --json payload round-trips
+    assert json.loads(critpath.to_json(doc))["fleet"]["jobs"] == 1
+
+
+# ----------------------------------------------------- live scheduler
+
+def _spec(i, **kw):
+    spec = {"input": f"/in/{i}.bam", "output": f"/out/{i}",
+            "name": f"j{i}"}
+    spec.update(kw)
+    return spec
+
+
+def test_live_scheduler_emits_decomposable_critpath(monkeypatch):
+    """Real dispatcher, stubbed job body: every terminal job gets a
+    serve.critpath event whose decomposition covers >=95% of the wall
+    and ends in a run segment — the ci gate's exact invariant."""
+    monkeypatch.setenv("CCT_TRACE", "1")
+    obs_trace.drain_events()
+    monkeypatch.setattr(Scheduler, "_run_job", lambda self, job: None)
+    sched = Scheduler(backend="tpu", queue_bound=16, gang_size=1)
+    try:
+        jobs = [sched.submit(_spec(i)) for i in range(3)]
+        for job in jobs:
+            assert sched.wait(job.id, timeout=30).state == "done"
+    finally:
+        sched.shutdown()
+    decomposed = critpath.from_events(obs_trace.drain_events())
+    done = [j for j in decomposed if j["state"] == "done"]
+    assert len(done) == 3
+    for job in done:
+        assert job["coverage"] is None or job["coverage"] >= 0.95
+        assert job["segments"][-1]["name"] == "run"
+        assert {"queue", "run"} <= {s["name"] for s in job["segments"]}
+    fleet = critpath.fleet_report(done)
+    assert fleet["dominant_queue_antagonist"] is not None
+
+
+def test_shed_job_critpath_carries_queue_wait(monkeypatch):
+    """Satellite regression: the dispatch-time shed path must stamp
+    queue_wait_ms on its critpath event and decompose with a 'queue'
+    tail — rejected work is accounted, not dropped."""
+    monkeypatch.setenv("CCT_TRACE", "1")
+    obs_trace.drain_events()
+    monkeypatch.setattr(Scheduler, "_run_job", lambda self, job: None)
+    sched = Scheduler(backend="tpu", queue_bound=16, gang_size=1,
+                      paused=True)
+    try:
+        job = sched.submit(_spec(0, deadline_s=0.05))
+        time.sleep(0.15)  # deadline expires while parked in the queue
+        sched.release()
+        done = sched.wait(job.id, timeout=30)
+        assert done.state == "failed" and "shed" in (done.error or "")
+    finally:
+        sched.shutdown()
+    events = [j for j in critpath.from_events(obs_trace.drain_events())
+              if j["state"] == "failed"]
+    assert len(events) == 1
+    shed = events[0]
+    assert shed["queue_wait_ms"] > 0
+    assert shed["segments"][-1]["name"] == "queue"
+    assert shed["coverage"] >= 0.95
+
+
+# ------------------------------------------------------------------ cli
+
+def _fast_wire_failure(monkeypatch):
+    # the CLI probes the wire before falling back to shards: make
+    # the connection-refused path instant instead of 5 retries
+    monkeypatch.setenv("CCT_SERVE_CLIENT_RETRIES", "0")
+    monkeypatch.setenv("CCT_RETRY_BASE_S", "0.01")
+
+
+def test_cli_critpath_report_from_shards(tmp_path, capsys,
+                                         monkeypatch):
+    _fast_wire_failure(monkeypatch)
+    """Offline path: no fleet listening, --dir names trace shards — the
+    report and the --json doc both come out of the on-disk events."""
+    from consensuscruncher_tpu.cli import main as cli_main
+
+    shard = tmp_path / "trace-1.ndjson"
+    stamps = {"submit": 0.0, "admit": 1.0, "journal": 2.0, "ack": 3.0,
+              "gang": 9.0, "dispatch": 10.0, "run": 11.0}
+    ev = _ev(stamps, wall_ms=15.0,
+             antagonist={"kind": "idle", "queue_ms": 6.0})
+    with open(shard, "w") as fh:
+        fh.write(json.dumps(ev) + "\n")
+    rc = cli_main(["critpath", "report", "--dir", str(tmp_path),
+                   "--port", "1"])  # port 1: wire always refuses
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "queue" in out and "admission idle" in out
+
+    rc = cli_main(["critpath", "report", "--dir", str(tmp_path),
+                   "--port", "1", "--json", "-"])
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["fleet"]["jobs"] == 1
+    assert doc["fleet"]["coverage_min"] >= 0.95
+
+    rc = cli_main(["critpath", "job", "k7", "--dir", str(tmp_path),
+                   "--port", "1"])
+    assert rc == 0
+    assert "key=k7" in capsys.readouterr().out
+
+
+def test_cli_critpath_no_events_is_actionable_error(tmp_path,
+                                                    monkeypatch):
+    _fast_wire_failure(monkeypatch)
+    from consensuscruncher_tpu.cli import main as cli_main
+
+    with pytest.raises(SystemExit, match="no serve.critpath events"):
+        cli_main(["critpath", "report", "--dir", str(tmp_path),
+                  "--port", "1"])

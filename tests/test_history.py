@@ -1,0 +1,266 @@
+"""Durable telemetry history (obs/history.py): delta discipline, the
+torn-tail shard contract, retention eviction, fleet merge, query/trend.
+
+The load-bearing assertions:
+
+- **Shard discipline**: a shard truncated at EVERY byte offset still
+  parses — complete lines survive, the torn tail is skipped, never an
+  exception (the trace/prof contract, swept exhaustively).
+- **Delta semantics**: lines carry movement since the previous line;
+  flat intervals write nothing at all.
+- **Retention**: eviction unlinks whole shards oldest-mtime-first and
+  never the live shard this process is appending to.
+- **Fleet merge**: the wire reply and the on-disk shard overlap by
+  design; (pid, seq) identity dedups them into one clean series.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from consensuscruncher_tpu.obs import history  # noqa: E402
+
+
+@pytest.fixture
+def history_dir(tmp_path, monkeypatch):
+    d = tmp_path / "hist"
+    d.mkdir()
+    monkeypatch.setenv("CCT_HISTORY_DIR", str(d))
+    monkeypatch.delenv("CCT_HISTORY_MAX_BYTES", raising=False)
+    history.reset_for_tests()
+    yield str(d)
+    history.reset_for_tests()
+
+
+def _shard(d):
+    return os.path.join(d, f"history-{os.getpid()}.ndjson")
+
+
+# ------------------------------------------------------------- appending
+
+def test_append_writes_deltas_and_skips_flat_intervals(history_dir):
+    n = history.append_snapshot({"jobs_done": 5}, {"queue_depth": 2})
+    assert n > 0
+    # flat interval: same cumulative totals -> no line at all
+    assert history.append_snapshot({"jobs_done": 5}) == 0
+    assert history.append_snapshot({"jobs_done": 9}) > 0
+    lines = history.read_shard(_shard(history_dir))
+    assert [ln["cum"] for ln in lines] == [{"jobs_done": 5},
+                                           {"jobs_done": 4}]
+    assert lines[0]["gauges"] == {"queue_depth": 2}
+    assert lines[0]["seq"] == 1 and lines[1]["seq"] == 2
+    assert lines[0]["pid"] == os.getpid()
+    tallies = history.counter_snapshot()
+    assert tallies["history_snapshots"] == 2
+    assert tallies["history_bytes"] > 0
+
+
+def test_append_is_noop_without_sink(monkeypatch):
+    monkeypatch.delenv("CCT_HISTORY_DIR", raising=False)
+    history.reset_for_tests()
+    assert history.append_snapshot({"jobs_done": 1}) == 0
+
+
+def test_non_numeric_counter_values_are_skipped(history_dir):
+    n = history.append_snapshot({"jobs_done": 3, "weird": "nan?"})
+    assert n > 0
+    (line,) = history.read_shard(_shard(history_dir))
+    assert line["cum"] == {"jobs_done": 3}
+
+
+# ---------------------------------------------------- torn-tail contract
+
+def test_truncation_at_every_byte_never_raises(history_dir):
+    """kill -9 mid-write leaves a torn tail: at every possible truncation
+    point the reader returns exactly the complete lines before the tear
+    and never raises.  Swept over the whole shard, byte by byte."""
+    for i in range(4):
+        history.append_snapshot({"jobs_done": (i + 1) * 10},
+                                {"gauge": i})
+    shard = _shard(history_dir)
+    data = open(shard, "rb").read()
+    offsets = [len(ln) + 1 for ln in data.split(b"\n")[:-1]]
+    torn = os.path.join(history_dir, "history-99999.ndjson")
+    for cut in range(len(data) + 1):
+        with open(torn, "wb") as fh:
+            fh.write(data[:cut])
+        lines = history.read_shard(torn)
+        whole = 0
+        consumed = 0
+        for off in offsets:
+            if consumed + off <= cut:
+                whole += 1
+                consumed += off
+        # a tail cut exactly at the closing brace (newline missing) is
+        # still a complete JSON doc — the reader may recover it, never
+        # more; anything mid-doc is skipped silently
+        assert whole <= len(lines) <= whole + 1, f"cut at byte {cut}"
+        if len(lines) == whole + 1:
+            assert cut == consumed + offsets[whole] - 1
+        for n, ln in enumerate(lines):
+            assert ln["seq"] == n + 1
+    os.unlink(torn)
+
+
+# -------------------------------------------------------------- retention
+
+def test_retention_evicts_oldest_first_and_spares_live_shard(
+        history_dir, monkeypatch):
+    """Three foreign shards with staggered mtimes + the live one, budget
+    sized to force eviction: the oldest foreign shards go first, the
+    live shard survives even when the budget says otherwise."""
+    live_line = history.append_snapshot({"jobs_done": 1})
+    assert live_line > 0
+    foreign = []
+    for i, pid in enumerate((11, 22, 33)):
+        path = os.path.join(history_dir, f"history-{pid}.ndjson")
+        with open(path, "w") as fh:
+            fh.write(json.dumps({"v": 1, "pid": pid, "seq": 1,
+                                 "cum": {"x": 1}, "pad": "y" * 200}) + "\n")
+        os.utime(path, (1000 + i, 1000 + i))  # oldest -> newest: 11,22,33
+        foreign.append(path)
+    os.utime(_shard(history_dir), (2000, 2000))
+    # budget fits the live shard + one foreign shard only
+    keep = os.path.getsize(_shard(history_dir)) \
+        + os.path.getsize(foreign[2]) + 10
+    monkeypatch.setenv("CCT_HISTORY_MAX_BYTES", str(keep))
+    assert history.enforce_retention() == 2
+    assert not os.path.exists(foreign[0])  # oldest gone first
+    assert not os.path.exists(foreign[1])
+    assert os.path.exists(foreign[2])
+    assert os.path.exists(_shard(history_dir))
+    assert history.counter_snapshot()["history_evictions"] == 2
+    # live shard alone over budget: never self-evicts
+    monkeypatch.setenv("CCT_HISTORY_MAX_BYTES", "1")
+    history.enforce_retention()
+    assert os.path.exists(_shard(history_dir))
+
+
+# -------------------------------------------------- merge + query + trend
+
+def test_fleet_merge_dedups_wire_and_shard_overlap(history_dir):
+    history.append_snapshot({"jobs_done": 2})
+    mine = history.collect(node="n0")
+    assert mine["lines"] and mine["node"] == "n0"
+    other = {"node": "n1", "pid": 777, "lines": [
+        {"v": 1, "pid": 777, "seq": 1, "node": "n1", "t": 1.0,
+         "dt_s": 2.0, "cum": {"jobs_done": 8}, "gauges": {}}]}
+    merged = history.merge_history([mine, other, mine, other])
+    assert len(merged) == 2  # (pid, seq) dedup across the overlap
+    rows = history.trend(merged, "jobs_done")
+    assert {r["delta"] for r in rows} == {2.0, 8.0}
+    by_node = {r["node"]: r for r in rows}
+    assert by_node["n1"]["rate"] == pytest.approx(4.0)  # 8 over 2s
+    # gauges trend as values, no rate
+    gauge_lines = [{"pid": 1, "seq": 1, "node": "n2", "t": 2.0,
+                    "cum": {}, "gauges": {"canary_ok": 1}}]
+    (g,) = history.trend(gauge_lines, "canary_ok")
+    assert g["value"] == 1 and g["rate"] is None
+    assert "canary_ok" in history.render_trend([g], "canary_ok")
+
+
+def test_query_filters_metric_node_and_last(history_dir):
+    lines = [
+        {"pid": 1, "seq": 1, "node": "a", "t": 1.0,
+         "cum": {"x": 1}, "gauges": {}},
+        {"pid": 1, "seq": 2, "node": "a", "t": 2.0,
+         "cum": {"y": 1}, "gauges": {}},
+        {"pid": 2, "seq": 1, "node": "b", "t": 3.0,
+         "cum": {"x": 4}, "gauges": {}},
+    ]
+    assert len(history.query(lines, metric="x")) == 2
+    assert len(history.query(lines, node="a")) == 2
+    assert history.query(lines, metric="x", node="b")[0]["cum"] == {"x": 4}
+    assert history.query(lines, last=1)[-1]["t"] == 3.0
+    assert history.query(lines, metric="zzz") == []
+
+
+# --------------------------------------------------------------- recorder
+
+def test_recorder_stamps_on_interval_and_final_on_stop(history_dir):
+    state = {"done": 0}
+
+    def supplier():
+        state["done"] += 3
+        return {"cum": {"jobs_done": state["done"]},
+                "gauges": {"canary_ok": 1}}
+
+    monkeypatched = os.environ.get("CCT_HISTORY_INTERVAL_S")
+    os.environ["CCT_HISTORY_INTERVAL_S"] = "0.2"
+    try:
+        assert history.maybe_start(supplier) is True
+        assert history.running()
+        assert history.maybe_start(supplier) is False  # idempotent
+        deadline = 30
+        import time
+        t0 = time.monotonic()
+        while history.counter_snapshot()["history_snapshots"] < 2:
+            assert time.monotonic() - t0 < deadline
+            time.sleep(0.05)
+        history.stop()
+        assert not history.running()
+    finally:
+        if monkeypatched is None:
+            os.environ.pop("CCT_HISTORY_INTERVAL_S", None)
+        else:
+            os.environ["CCT_HISTORY_INTERVAL_S"] = monkeypatched
+    lines = history.read_shard(_shard(history_dir))
+    assert len(lines) >= 2  # interval ticks + the shutdown stamp
+    assert all(ln["gauges"] == {"canary_ok": 1} for ln in lines)
+
+
+# ------------------------------------------------------------------ cli
+
+def _fast_wire_failure(monkeypatch):
+    # the CLI probes the wire before falling back to shards: make
+    # the connection-refused path instant instead of 5 retries
+    monkeypatch.setenv("CCT_SERVE_CLIENT_RETRIES", "0")
+    monkeypatch.setenv("CCT_RETRY_BASE_S", "0.01")
+
+
+def test_cli_history_query_and_trend_from_shards(history_dir, capsys,
+                                                 monkeypatch):
+    _fast_wire_failure(monkeypatch)
+    from consensuscruncher_tpu.cli import main as cli_main
+
+    history.append_snapshot({"jobs_done": 5}, {"canary_ok": 1})
+    import time
+    time.sleep(0.01)
+    history.append_snapshot({"jobs_done": 9})
+    rc = cli_main(["history", "query", "--dir", history_dir,
+                   "--port", "1"])  # port 1: wire always refuses
+    assert rc == 0
+    out = [json.loads(ln) for ln in
+           capsys.readouterr().out.strip().splitlines()]
+    assert [ln["cum"] for ln in out] == [{"jobs_done": 5},
+                                         {"jobs_done": 4}]
+
+    rc = cli_main(["history", "query", "--dir", history_dir,
+                   "--port", "1", "--last", "1"])
+    assert rc == 0
+    assert len(capsys.readouterr().out.strip().splitlines()) == 1
+
+    rc = cli_main(["history", "trend", "--dir", history_dir,
+                   "--port", "1", "--metric", "jobs_done"])
+    assert rc == 0
+    trend_out = capsys.readouterr().out
+    assert "jobs_done" in trend_out and "2 interval(s)" in trend_out
+
+    with pytest.raises(SystemExit, match="--metric"):
+        cli_main(["history", "trend", "--dir", history_dir,
+                  "--port", "1"])
+
+
+def test_cli_history_empty_is_actionable_error(tmp_path, monkeypatch):
+    _fast_wire_failure(monkeypatch)
+    from consensuscruncher_tpu.cli import main as cli_main
+
+    with pytest.raises(SystemExit, match="nothing collected"):
+        cli_main(["history", "query", "--dir", str(tmp_path / "none"),
+                  "--port", "1"])

@@ -42,6 +42,7 @@ def test_repo_is_lint_clean():
     ("serve/viol_jit.py", {"CCT501"}),
     ("viol_obscov.py", {"CCT601", "CCT602", "CCT603"}),
     ("viol_qc_series.py", {"CCT605"}),
+    ("viol_critpath_series.py", {"CCT606"}),
     ("serve/viol_trace_prop.py", {"CCT604"}),
     ("serve/viol_protocol.py",
      {"CCT701", "CCT702", "CCT703", "CCT704", "CCT705"}),
@@ -65,6 +66,7 @@ def test_each_pass_detects_its_seeded_violation(rel, expected):
     "serve/clean_trace_prop.py",
     "serve/clean_cache_store.py",
     "clean_qc_series.py",
+    "clean_critpath_series.py",
     "policies/clean_policycov.py",
     "effects/clean_effects.py",
     "serve/clean_wire.py",

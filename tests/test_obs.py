@@ -308,3 +308,55 @@ def test_golden_parity_with_tracing_on_and_export_validates(
                   if e["name"] == "device.dispatch"]
     assert dispatches and all(
         e["args"]["trace_id"] == tid for e in dispatches)
+
+
+# ------------------------------------------- canary gauges + crit panel
+
+def test_prometheus_renders_canary_gauges():
+    """A metrics doc carrying prober status exports cct_canary_ok /
+    cct_canary_age_s; a doc without one exports neither line."""
+    doc = {"canary": {"ok": True, "age_s": 12.5, "runs": 3,
+                      "pass": 3, "fail": 0}}
+    text = obs_metrics.render_prometheus(doc)
+    assert "cct_canary_ok 1" in text
+    assert "cct_canary_age_s 12.5" in text
+    assert "# TYPE cct_canary_ok gauge" in text
+    doc["canary"]["ok"] = False
+    assert "cct_canary_ok 0" in obs_metrics.render_prometheus(doc)
+    assert "cct_canary" not in obs_metrics.render_prometheus({})
+
+
+def test_top_crit_row_renders_and_dash_degrades():
+    from consensuscruncher_tpu.obs import top as obs_top
+
+    expo = """\
+cct_jobs_done_total 4
+cct_lock_wait_us_total{lock="sched.cond"} 1500
+cct_lock_wait_us_total{lock="job.id_lock"} 40
+cct_dispatcher_idle_us_total 900000
+cct_dispatcher_busy_us_total 100000
+cct_canary_ok 1
+cct_canary_age_s 3
+"""
+    frame = obs_top.render_frame(obs_top.parse_prometheus(expo), "x",
+                                 now=0.0)
+    (crit,) = [ln for ln in frame.splitlines() if ln.startswith("crit:")]
+    assert "lock=sched.cond (1.5ms waited)" in crit  # hottest lock wins
+    assert "disp_idle=90.0%" in crit
+    assert "canary=OK (3s ago)" in crit
+    # probes counter absent on this daemon: cell dashes, never KeyError
+    assert "probes=-" in crit
+
+    # a failing canary flips the verdict
+    frame = obs_top.render_frame(
+        obs_top.parse_prometheus(expo.replace("cct_canary_ok 1",
+                                              "cct_canary_ok 0")),
+        "x", now=0.0)
+    (crit,) = [ln for ln in frame.splitlines() if ln.startswith("crit:")]
+    assert "canary=FAIL" in crit
+
+    # pre-critpath daemon: no crit series at all -> no crit row
+    frame = obs_top.render_frame(
+        obs_top.parse_prometheus("cct_jobs_done_total 4\n"), "x", now=0.0)
+    assert not any(ln.startswith("crit:")
+                   for ln in frame.splitlines())

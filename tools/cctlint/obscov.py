@@ -37,6 +37,16 @@ CCT605  QC series are discovered through the registry's ``QC_SERIES``
         when the scan includes the QC emission home
         (``serve/scheduler.py``) — partial scans prove nothing about
         absence.
+CCT606  the critical-path observatory's series families (``lock_*``
+        contention-ledger counters, ``canary_*`` prober tallies/gauges,
+        ``history_*`` recorder tallies) are consumed by ``cct top``'s
+        crit row, ``cct history`` and the Prometheus exposition purely
+        by name — an undeclared name emitted anywhere outside obs/
+        would flow to disk and wire yet be invisible to every one of
+        those surfaces.  Any string literal with one of those prefixes
+        passed as a call's first positional argument outside obs/ must
+        be declared in the registry (COUNTERS, HISTOGRAMS, LABELED_*,
+        or GAUGES).
 CCT604  fleet tracing only survives kills and failovers if the trace
         context rides EVERY hand-off.  In serve/ code: (a) a wire ack
         reply — a dict literal carrying both ``"ok"`` and ``"job_id"``
@@ -102,6 +112,7 @@ def _load_registry(ctx: LintContext):
                 if "labeled_histograms" in override else None),
             "qos_classes": frozenset(override.get("qos_classes", ())),
             "qc_series": tuple(override.get("qc_series", ())),
+            "gauges": frozenset(override.get("gauges", ())),
         }
     path = os.path.join(ctx.root, REGISTRY_REL)
     if not os.path.isfile(path):
@@ -120,6 +131,7 @@ def _load_registry(ctx: LintContext):
             getattr(mod, "LABELED_HISTOGRAMS", None)) or None,
         "qos_classes": frozenset(getattr(mod, "QOS_CLASSES", ())),
         "qc_series": tuple(getattr(mod, "QC_SERIES", ())),
+        "gauges": frozenset(getattr(mod, "GAUGES", ())),
     }
 
 
@@ -351,6 +363,48 @@ def _check_qc_series(ctx: LintContext, qc_series: tuple) -> list[Finding]:
     return findings
 
 
+# built by concatenation so this module's own source never matches the
+# prefix scan below (the lint scans tools/ too)
+CRITPATH_PREFIXES = ("lock" + "_", "canary" + "_", "history" + "_")
+
+
+def _check_critpath_series(ctx: LintContext, reg: dict) -> list[Finding]:
+    """CCT606: critical-path observatory series must be registered.
+
+    Every string literal with a ``lock_``/``canary_``/``history_``
+    prefix passed as a call's first positional argument outside obs/
+    must be declared somewhere in the registry — those families are
+    consumed by name (cct top's crit row, cct history, the Prometheus
+    exposition), so an undeclared emission is invisible to every
+    surface.  CLI flag literals (``--lock...``) are skipped."""
+    declared = (reg["counters"] | reg["histograms"] | reg["gauges"]
+                | frozenset(reg["labeled_counters"] or ())
+                | frozenset(reg["labeled_histograms"] or ()))
+    findings: list[Finding] = []
+    for src in ctx.parsed():
+        if src.rel.replace(os.sep, "/").startswith(
+                "consensuscruncher_tpu/obs/"):
+            continue
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _name_arg(node)
+            if name is None or name.startswith("--"):
+                continue
+            if not name.startswith(CRITPATH_PREFIXES):
+                continue
+            if name not in declared:
+                findings.append(Finding(
+                    "CCT606", src.rel, node.lineno,
+                    f"critical-path series '{name}' is not declared in "
+                    "consensuscruncher_tpu/obs/registry.py — lock_*/"
+                    "canary_*/history_* names are discovered by the crit "
+                    "surfaces (cct top, cct history, /metrics) through "
+                    "the registry; declare it in COUNTERS/HISTOGRAMS/"
+                    "LABELED_*/GAUGES or rename it", "obscov"))
+    return findings
+
+
 def _check_trace_propagation(ctx: LintContext) -> list[Finding]:
     """CCT604: trace context must ride every serve-layer hand-off — ack
     replies and journal records are the two durable carriers."""
@@ -409,4 +463,5 @@ def run(ctx: LintContext) -> list[Finding]:
             findings.extend(_check_labeled_names(ctx, reg))
         if reg.get("qc_series"):
             findings.extend(_check_qc_series(ctx, reg["qc_series"]))
+        findings.extend(_check_critpath_series(ctx, reg))
     return findings

@@ -134,6 +134,14 @@ WIRE_REPLY_KEYS = frozenset({
     # courtesy reply says ``reaped``.  Legacy peers never send or
     # receive any of these.
     "seq", "crc", "crc_error", "reaped",
+    # telemetry history (ISSUE 20): the ``history`` op returns durable
+    # counter-delta shard lines (one process's, or the fleet's via the
+    # router) so "what changed over the last hour" survives restarts
+    "history",
+    # golden canary prober status (ISSUE 20): rides every metrics reply
+    # under ``canary`` — verdict, probe staleness, tallies, the pinned
+    # golden digest, and the last failure's human reason
+    "age_s", "runs", "pass", "fail", "golden", "last_error",
 })
 
 # ---------------------------------------------------------- helpers ----

@@ -9,7 +9,7 @@ set -euo pipefail
 REPO="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
 cd "$REPO"
 
-echo "== cctlint (all passes, incl. obscov CCT601-603) =="
+echo "== cctlint (all passes, incl. obscov CCT601-606) =="
 PYTHONPATH="$REPO" python -m tools.cctlint consensuscruncher_tpu tools
 
 echo "== cctlint protocol typestate gate (CCT7xx/CCT8xx, serve plane) =="
@@ -399,6 +399,7 @@ SAMPLE = os.path.join(REPO, "test", "data", "sample.bam")
 sock = os.path.join(WORK, "route.sock")
 TRACES = os.path.join(WORK, "traces")
 PROFS = os.path.join(WORK, "profs")
+HIST = os.path.join(WORK, "history")
 boot = ("import sys; sys.path.insert(0, %r); "
         "from consensuscruncher_tpu.cli import main; "
         "sys.exit(main(sys.argv[1:]))" % REPO)
@@ -415,7 +416,12 @@ router = subprocess.Popen(
      "--gang_size", "1", "--queue_bound", "8", "--drain_s", "60"],
     stdout=log, stderr=subprocess.STDOUT,
     env=dict(os.environ, CCT_TRACE="1", CCT_TRACE_DIR=TRACES,
-             CCT_PROF="1", CCT_PROF_HZ="199", CCT_PROF_DIR=PROFS))
+             CCT_PROF="1", CCT_PROF_HZ="199", CCT_PROF_DIR=PROFS,
+             # critpath antagonist attribution + durable telemetry
+             # history ride the same chaos run: the lock ledger feeds
+             # queue-blame, the 1s recorder stamps counter-delta shards
+             CCT_LOCK_LEDGER="1", CCT_HISTORY_DIR=HIST,
+             CCT_HISTORY_INTERVAL_S="1"))
 ok = False
 try:
     client = ServeClient(sock, retries=60, retry_base_s=0.25)
@@ -469,12 +475,42 @@ try:
         if nd["coverage"] is not None:
             assert nd["coverage"] >= 0.95, (node, nd)
     n_stacks = sum(1 for ln in open(flame) if ln.strip())
+    # critpath: decompose every finished job's wall from the same
+    # merged fleet events; the telescoping boundary stamps must explain
+    # >=95% of EVERY job's wall and blame a concrete queue antagonist —
+    # a scheduler path that forgot to stamp fails here, not in prod
+    crit_json = os.path.join(WORK, "critpath.json")
+    assert cct_main(["critpath", "report", "--socket", sock,
+                     "--dir", TRACES, "--json", crit_json]) in (0, None)
+    crit = json.load(open(crit_json))
+    assert crit["fleet"]["jobs"] >= len(subs), crit["fleet"]
+    assert crit["fleet"]["coverage_min"] is not None \
+        and crit["fleet"]["coverage_min"] >= 0.95, crit["fleet"]
+    assert crit["fleet"]["antagonists"], "critpath antagonist table empty"
+    assert crit["fleet"]["dominant_queue_antagonist"], crit["fleet"]
+    for cj in crit["jobs"]:
+        assert cj["coverage"] is None or cj["coverage"] >= 0.95, cj
+    # durable history: the 1s recorder left counter-delta shards the
+    # killed worker's restart cannot erase; the trend query must see
+    # job movement end to end (wire op + on-disk shards merged)
+    from consensuscruncher_tpu.obs import history as obs_history
+    hist_lines = obs_history.merge_history(
+        [{"lines": obs_history.read_dir(HIST)}])
+    assert hist_lines, "history recorder left no shard lines"
+    assert obs_history.trend(hist_lines, "batches_dispatched"), \
+        "history lines never recorded dispatch movement"
+    assert cct_main(["history", "trend", "--socket", sock, "--dir", HIST,
+                     "--metric", "batches_dispatched"]) in (0, None)
     ok = True
     print("ci_check: fleet smoke OK (killed %s; %d jobs byte-identical; "
           "resubmits=%d; %d trace events merged; %d collapsed stacks, "
-          "%d node(s) wall-attributed)"
+          "%d node(s) wall-attributed; critpath %d job(s) cov>=%.2f, "
+          "dominant antagonist %r; %d history line(s))"
           % (victim, len(subs), cum["route_resubmits"], n_events,
-             n_stacks, len(attr["nodes"])))
+             n_stacks, len(attr["nodes"]), crit["fleet"]["jobs"],
+             crit["fleet"]["coverage_min"],
+             crit["fleet"]["dominant_queue_antagonist"],
+             len(hist_lines)))
 finally:
     router.send_signal(signal.SIGTERM)
     try:
@@ -493,6 +529,51 @@ echo "== fleet trace completeness (killed-owner span tree connected) =="
 # across both workers and the router by follows_from links
 PYTHONPATH="$REPO" python tools/trace_check.py --fleet \
   "$WORK/fleet/trace_fleet.json" --journals "$WORK"/fleet/*.journal
+
+echo "== canary probes (honest pin re-verified; corrupted pin MUST flip the gauge) =="
+# both directions of the golden canary: an honest probe self-mints the
+# golden and a re-probe reproduces it byte-identically (green), then a
+# deliberately corrupted pinned golden MUST flip cct_canary_ok to 0 and
+# fail the leg — a canary that cannot see seeded rot is worse than none
+JAX_PLATFORMS=cpu PYTHONPATH="$REPO" python - "$WORK/canary" <<'PY'
+import os, sys
+
+WORK = sys.argv[1]
+os.makedirs(WORK, exist_ok=True)
+from consensuscruncher_tpu.obs import metrics as obs_metrics
+from consensuscruncher_tpu.serve.canary import CanaryProber
+from consensuscruncher_tpu.serve.scheduler import Scheduler
+
+sched = Scheduler(backend="xla_cpu", queue_bound=8, gang_size=1)
+try:
+    honest = CanaryProber(sched, os.path.join(WORK, "honest"),
+                          interval_s=3600.0, latency_s=300.0)
+    assert honest.probe_once() is True, honest.status()
+    golden = honest.golden
+    assert golden, "honest probe minted no golden"
+    assert honest.probe_once() is True, honest.status()
+    expo = obs_metrics.render_prometheus({"canary": honest.status()})
+    assert "cct_canary_ok 1" in expo, expo
+
+    rigged = CanaryProber(sched, os.path.join(WORK, "rigged"),
+                          interval_s=3600.0, latency_s=300.0,
+                          golden="0" * 64)
+    verdict = rigged.probe_once()
+    doc = rigged.status()
+    expo = obs_metrics.render_prometheus({"canary": doc})
+    if verdict is not False or doc["ok"] is not False \
+            or "cct_canary_ok 0" not in expo:
+        print("ci_check: FAILED — corrupted canary golden was NOT "
+              "caught (verdict=%r status=%r)" % (verdict, doc))
+        sys.exit(1)
+    tally = sched.counters.snapshot()
+    assert tally.get("canary_pass", 0) == 2, tally
+    assert tally.get("canary_fail", 0) == 1, tally
+    print("ci_check: canary OK (honest golden %s.. re-verified; "
+          "corrupted pin flipped cct_canary_ok to 0)" % golden[:12])
+finally:
+    sched.shutdown()
+PY
 
 echo "== router HA smoke (kill -9 the ACTIVE router; standby takes over) =="
 JAX_PLATFORMS=cpu PYTHONPATH="$REPO" python - "$WORK/ha" <<'PY'
